@@ -1,0 +1,25 @@
+"""dib_tpu: a TPU-native (JAX/XLA/Flax/pjit/Pallas) Distributed Information Bottleneck framework.
+
+Re-designed from scratch for TPU with the capabilities of the reference codebase
+``distributed-information-bottleneck.github.io`` (see SURVEY.md at the repo root
+for the full structural blueprint with file:line citations).
+
+Architecture stance (not a port):
+  - Per-feature probabilistic encoders are ONE vmapped module over stacked
+    parameters (the reference loops over ``feature_encoders`` in Python,
+    reference ``models.py:105``).
+  - The bottleneck strength ``beta`` is a *traced input* to a jitted train step,
+    so annealing is a schedule function and a beta *grid* is just another batch
+    axis (the reference mutates a ``tf.Variable`` per epoch,
+    reference ``models.py:86``, ``models.py:147-149``).
+  - The beta sweep and the data batch shard over a ``jax.sharding.Mesh`` with
+    axes ``('beta', 'data')``; XLA inserts the ICI collectives.
+  - Mutual-information sandwich bounds are computed in log space so float32 on
+    TPU matches the reference's float64 CPU results (reference ``utils.py:39-41``
+    casts to float64 because it exponentiates densities; we never leave
+    log space).
+"""
+
+__version__ = "0.1.0"
+
+from dib_tpu import ops, models, data, train, parallel, utils, viz  # noqa: F401

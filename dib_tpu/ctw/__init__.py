@@ -1,0 +1,1 @@
+"""dib_tpu.ctw (populated incrementally)."""

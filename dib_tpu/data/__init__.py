@@ -1,0 +1,32 @@
+"""Dataset registry, loaders, simulators, and generators.
+
+Importing this package registers every dataset; use
+``dib_tpu.data.get_dataset(name, **kwargs)``. The registry mirrors the
+reference's ``DATASETS`` dict (reference ``data.py:397-406``) plus the
+notebook-only workloads (amorphous plasticity, chaotic maps).
+"""
+
+from dib_tpu.data.registry import (
+    DatasetBundle,
+    register_dataset,
+    get_dataset,
+    available_datasets,
+)
+from dib_tpu.data import boolean_circuit, pendulum, chaos_maps, tabular, amorphous  # noqa: F401
+from dib_tpu.data.boolean_circuit import (
+    PAPER_CIRCUIT,
+    FIG_S1_CIRCUITS,
+    apply_gates,
+    full_truth_table,
+    random_circuit,
+    exact_subset_informations,
+)
+from dib_tpu.data.pendulum import simulate_double_pendulum, total_energy, unroll_angles
+from dib_tpu.data.chaos_maps import generate_data, ENTROPY_RATE_BITS
+from dib_tpu.data.amorphous import (
+    per_particle_features,
+    synthetic_glass_neighborhoods,
+    build_neighborhood_arrays,
+    PARTICLE_FEATURE_DIM,
+)
+from dib_tpu.data.tabular import TabularPreprocessor

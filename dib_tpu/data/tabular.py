@@ -1,0 +1,345 @@
+"""Tabular datasets: preprocessing pipeline and the UCI loaders.
+
+The reference's tabular path (reference ``data.py:149-395``) is NODE-GAM
+derived and largely broken as committed (undefined variables in
+``fetch_mice_protein``, ``data.py:337-369``; nodegam stubs returning None,
+``data.py:372-395`` — see SURVEY.md section 0). This module supplies *working*
+equivalents with no nodegam dependency:
+
+  - ``TabularPreprocessor``: one-hot categorical encoding + noisy
+    QuantileTransformer + optional y standardization (behavior of
+    ``MyPreprocessor``, reference ``data.py:178-297``).
+  - loaders for mice_protein / wine / bikeshare / credit / support2 /
+    microsoft: read local files when present under ``data_path`` (this
+    environment has no network egress; ``download`` raises with the URL so
+    users know what to fetch), otherwise generate schema-faithful synthetic
+    surrogates so every pipeline trains end to end.
+
+Each scalar feature becomes its own bottleneck channel (feature dims all 1
+after preprocessing of numeric columns; one-hot groups stay one channel per
+original categorical column).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+import numpy as np
+import pandas as pd
+from sklearn.preprocessing import QuantileTransformer
+
+from dib_tpu.data.registry import DatasetBundle, register_dataset
+
+DATASET_URLS = {
+    "mice_protein": "https://archive.ics.uci.edu/ml/machine-learning-databases/00342/Data_Cortex_Nuclear.xls",
+    "wine": "https://archive.ics.uci.edu/ml/machine-learning-databases/wine-quality/winequality-red.csv",
+    "bikeshare": "https://archive.ics.uci.edu/ml/machine-learning-databases/00275/Bike-Sharing-Dataset.zip",
+}
+
+
+def download(url: str, filename: str):
+    """Placeholder for the reference's downloader (``data.py:152-174``): this
+    environment has zero egress, so surface the URL instead of fetching."""
+    raise RuntimeError(
+        f"No network egress available. Download {url} manually to {filename}."
+    )
+
+
+@dataclass
+class TabularPreprocessor:
+    """One-hot categoricals + noisy quantile transform + y standardization.
+
+    ``quantile_noise`` adds Gaussian noise (std = noise / max(col std, noise))
+    only while FITTING the transformer, making discrete values separable —
+    the transform itself is applied to clean data (reference
+    ``data.py:243-254`` semantics).
+    """
+
+    random_state: int = 1337
+    cat_features: tuple = ()
+    y_normalize: bool = False
+    quantile_transform: bool = True
+    output_distribution: str = "normal"
+    n_quantiles: int = 2000
+    quantile_noise: float = 1e-3
+
+    def fit(self, x: pd.DataFrame, y: np.ndarray | None = None):
+        self.columns_ = list(x.columns)
+        self.cat_maps_ = {}
+        for col in self.cat_features:
+            self.cat_maps_[col] = sorted(pd.unique(x[col]))
+        encoded = self._encode(x)
+        self.feature_dimensionalities_ = []
+        for col in self.columns_:
+            self.feature_dimensionalities_.append(
+                len(self.cat_maps_[col]) if col in self.cat_maps_ else 1
+            )
+        if self.quantile_transform:
+            values = encoded.astype(np.float64)
+            rng = np.random.RandomState(self.random_state)
+            if self.quantile_noise:
+                stds = np.std(values, axis=0, keepdims=True)
+                noise_std = self.quantile_noise / np.maximum(stds, self.quantile_noise)
+                fit_values = values + noise_std * rng.randn(*values.shape)
+            else:
+                fit_values = values
+            self.qt_ = QuantileTransformer(
+                random_state=self.random_state,
+                n_quantiles=min(self.n_quantiles, len(x)),
+                output_distribution=self.output_distribution,
+            )
+            self.qt_.fit(fit_values)
+        if y is not None and self.y_normalize:
+            self.y_mu_, self.y_std_ = float(np.mean(y)), float(np.std(y))
+        else:
+            self.y_mu_, self.y_std_ = 0.0, 1.0
+        return self
+
+    def _encode(self, x: pd.DataFrame) -> np.ndarray:
+        blocks = []
+        for col in self.columns_:
+            if col in self.cat_maps_:
+                cats = self.cat_maps_[col]
+                idx = pd.Categorical(x[col], categories=cats).codes
+                onehot = np.eye(len(cats), dtype=np.float32)[np.clip(idx, 0, len(cats) - 1)]
+                onehot[idx < 0] = 0.0
+                blocks.append(onehot)
+            else:
+                blocks.append(np.asarray(x[col], dtype=np.float32)[:, None])
+        return np.concatenate(blocks, axis=-1)
+
+    def transform(self, x: pd.DataFrame, y: np.ndarray | None = None):
+        encoded = self._encode(x)
+        if self.quantile_transform:
+            encoded = self.qt_.transform(encoded.astype(np.float64)).astype(np.float32)
+        encoded = encoded.astype(np.float32)
+        if y is None:
+            return encoded
+        y = np.asarray(y, dtype=np.float32)
+        if self.y_normalize:
+            y = (y - self.y_mu_) / self.y_std_
+        return encoded, y
+
+
+def _split_frame(df: pd.DataFrame, target: str, seed: int, valid_fraction: float = 0.2):
+    rng = np.random.default_rng(seed)
+    idx = rng.permutation(len(df))
+    n_valid = int(len(df) * valid_fraction)
+    valid, train = df.iloc[idx[:n_valid]], df.iloc[idx[n_valid:]]
+    return (
+        train.drop(columns=[target]), train[target].to_numpy(),
+        valid.drop(columns=[target]), valid[target].to_numpy(),
+    )
+
+
+def _bundle_from_frame(
+    df: pd.DataFrame,
+    target: str,
+    problem: str,
+    cat_features: tuple = (),
+    seed: int = 1337,
+    name: str = "",
+) -> DatasetBundle:
+    x_tr_df, y_tr, x_va_df, y_va = _split_frame(df, target, seed)
+    prep = TabularPreprocessor(
+        random_state=seed,
+        cat_features=cat_features,
+        y_normalize=(problem == "regression"),
+    ).fit(x_tr_df, y_tr)
+    x_train, y_train = prep.transform(x_tr_df, y_tr)
+    x_valid, y_valid = prep.transform(x_va_df, y_va)
+    x_valid_raw = prep._encode(x_va_df)
+
+    if problem == "regression":
+        output_dim, loss, info_based, out_act, metrics = 1, "mse", False, None, ("mse",)
+        y_train = y_train.reshape(-1, 1)
+        y_valid = y_valid.reshape(-1, 1)
+    elif problem == "binary":
+        output_dim, loss, info_based, out_act, metrics = 1, "bce", True, None, ("accuracy",)
+        y_train = y_train.reshape(-1, 1).astype(np.float32)
+        y_valid = y_valid.reshape(-1, 1).astype(np.float32)
+    else:  # multiclass
+        output_dim = int(max(y_tr.max(), y_va.max())) + 1
+        loss, info_based, out_act, metrics = "sparse_ce", True, None, ("accuracy",)
+        y_train = y_train.astype(np.int32)
+        y_valid = y_valid.astype(np.int32)
+
+    return DatasetBundle(
+        x_train=x_train,
+        y_train=y_train,
+        x_valid=x_valid,
+        y_valid=y_valid,
+        feature_dimensionalities=list(prep.feature_dimensionalities_),
+        output_dimensionality=output_dim,
+        loss=loss,
+        loss_is_info_based=info_based,
+        output_activation=out_act,
+        metrics=metrics,
+        feature_labels=[str(c) for c in prep.columns_],
+        x_valid_raw=x_valid_raw,
+        extras={"preprocessor": prep, "problem": problem, "name": name},
+    )
+
+
+def _synthetic_frame(num_rows, num_features, problem, seed, num_classes=2, num_cats=0):
+    """Schema-faithful synthetic surrogate with planted feature-relevance
+    structure (a few strong features, a few weak, the rest noise) so DIB
+    information allocation has ground truth to find."""
+    rng = np.random.default_rng(seed)
+    cols = {}
+    strengths = np.zeros(num_features)
+    strengths[: max(num_features // 4, 1)] = np.linspace(2.0, 0.5, max(num_features // 4, 1))
+    signal = np.zeros(num_rows)
+    for i in range(num_features):
+        col = rng.normal(size=num_rows)
+        signal = signal + strengths[i] * col
+        cols[f"f{i}"] = col
+    for j in range(num_cats):
+        cats = rng.integers(0, 4, size=num_rows)
+        signal = signal + 0.5 * (cats == 0)
+        cols[f"cat{j}"] = cats.astype(str)
+    if problem == "regression":
+        cols["target"] = signal + 0.1 * rng.normal(size=num_rows)
+    elif problem == "binary":
+        p = 1.0 / (1.0 + np.exp(-signal / max(np.std(signal), 1e-6)))
+        cols["target"] = (rng.random(num_rows) < p).astype(np.float64)
+    else:
+        q = np.quantile(signal, np.linspace(0, 1, num_classes + 1)[1:-1])
+        cols["target"] = np.digitize(signal, q).astype(np.int64)
+    return pd.DataFrame(cols)
+
+
+def _local_or_synthetic(name, data_path, loader, synth_args, problem, cat_features=(), seed=1337):
+    import warnings
+
+    try:
+        df = loader(data_path)
+        source = "real"
+    except (FileNotFoundError, RuntimeError):
+        # Only "file absent" / "no egress" fall back to the synthetic
+        # surrogate — a malformed real file must raise, never silently train
+        # on fake data.
+        warnings.warn(
+            f"Dataset {name!r} not found under {data_path}; using a synthetic "
+            f"schema-faithful surrogate (bundle.extras['source'] == 'synthetic'). "
+            f"Download: {DATASET_URLS.get(name, '<see loader>')}",
+            stacklevel=3,
+        )
+        df = _synthetic_frame(**synth_args)
+        source = "synthetic"
+    bundle = _bundle_from_frame(df, "target", problem, cat_features=cat_features, seed=seed, name=name)
+    bundle.extras["source"] = source
+    return bundle
+
+
+@register_dataset("wine")
+def fetch_wine(data_path: str = "./data/", seed: int = 1337, **_) -> DatasetBundle:
+    def load(path):
+        f = os.path.join(path, "winequality-red.csv")
+        if not os.path.exists(f):
+            raise FileNotFoundError(f)
+        df = pd.read_csv(f, sep=";")
+        return df.rename(columns={"quality": "target"})
+
+    return _local_or_synthetic(
+        "wine", data_path, load,
+        dict(num_rows=1599, num_features=11, problem="regression", seed=seed),
+        "regression", seed=seed,
+    )
+
+
+@register_dataset("bikeshare")
+def fetch_bikeshare(data_path: str = "./data/", seed: int = 1337, **_) -> DatasetBundle:
+    def load(path):
+        f = os.path.join(path, "hour.csv")
+        if not os.path.exists(f):
+            raise FileNotFoundError(f)
+        df = pd.read_csv(f)
+        df = df.drop(columns=[c for c in ("instant", "dteday", "casual", "registered") if c in df])
+        return df.rename(columns={"cnt": "target"})
+
+    return _local_or_synthetic(
+        "bikeshare", data_path, load,
+        dict(num_rows=4096, num_features=12, problem="regression", seed=seed),
+        "regression", seed=seed,
+    )
+
+
+@register_dataset("mice_protein")
+def fetch_mice_protein(data_path: str = "./data/", seed: int = 1337, **_) -> DatasetBundle:
+    """77 protein expression levels -> 8 classes (the working re-implementation
+    of the reference's broken loader, ``data.py:299-369``)."""
+
+    def load(path):
+        f = os.path.join(path, "mice_protein", "Data_Cortex_Nuclear.xls")
+        if not os.path.exists(f):
+            raise FileNotFoundError(f)
+        raw = pd.read_excel(f)
+        proteins = raw.columns[1:78]
+        x = raw[proteins].astype(np.float64)
+        # class = 3-bit code of (Genotype, Treatment, Behavior), as in LassoNet
+        bits = [
+            (raw["Genotype"] == "Control").astype(int),
+            (raw["Treatment"] == "Memantine").astype(int),
+            (raw["Behavior"] == "C/S").astype(int),
+        ]
+        target = bits[0] + 2 * bits[1] + 4 * bits[2]
+        x = x.fillna(x.groupby(target).transform("mean"))
+        df = x.copy()
+        df["target"] = target
+        return df
+
+    return _local_or_synthetic(
+        "mice_protein", data_path, load,
+        dict(num_rows=1080, num_features=77, problem="multiclass", seed=seed, num_classes=8),
+        "multiclass", seed=seed,
+    )
+
+
+@register_dataset("credit")
+def fetch_credit(data_path: str = "./data/", seed: int = 1337, **_) -> DatasetBundle:
+    def load(path):
+        f = os.path.join(path, "credit", "data.csv")
+        if not os.path.exists(f):
+            raise FileNotFoundError(f)
+        df = pd.read_csv(f)
+        return df.rename(columns={df.columns[-1]: "target"})
+
+    return _local_or_synthetic(
+        "credit", data_path, load,
+        dict(num_rows=4096, num_features=10, problem="binary", seed=seed),
+        "binary", seed=seed,
+    )
+
+
+@register_dataset("support2")
+def fetch_support2(data_path: str = "./data/", seed: int = 1337, **_) -> DatasetBundle:
+    def load(path):
+        f = os.path.join(path, "support2", "support2.csv")
+        if not os.path.exists(f):
+            raise FileNotFoundError(f)
+        df = pd.read_csv(f)
+        return df.rename(columns={"death": "target"})
+
+    return _local_or_synthetic(
+        "support2", data_path, load,
+        dict(num_rows=4096, num_features=20, problem="binary", seed=seed, num_cats=2),
+        "binary", cat_features=("cat0", "cat1"), seed=seed,
+    )
+
+
+@register_dataset("microsoft")
+def fetch_microsoft(data_path: str = "./data/", seed: int = 1337, **_) -> DatasetBundle:
+    def load(path):
+        f = os.path.join(path, "microsoft", "train.csv")
+        if not os.path.exists(f):
+            raise FileNotFoundError(f)
+        df = pd.read_csv(f)
+        return df.rename(columns={df.columns[0]: "target"})
+
+    return _local_or_synthetic(
+        "microsoft", data_path, load,
+        dict(num_rows=8192, num_features=16, problem="regression", seed=seed),
+        "regression", seed=seed,
+    )

@@ -1,0 +1,20 @@
+"""Flax model families: the Distributed IB core, simple binary encoders,
+the set transformer, and the chaos measurement stack."""
+
+from dib_tpu.models.mlp import MLP, resolve_activation
+from dib_tpu.models.encoders import (
+    GaussianEncoder,
+    FeatureEncoderBank,
+    SimpleBinaryEncoder,
+    SimpleBinaryEncoderBank,
+    pad_and_stack_features,
+)
+from dib_tpu.models.dib import DistributedIBModel
+from dib_tpu.models.set_transformer import SetTransformer, SetAttentionBlock
+from dib_tpu.models.measurement import (
+    StateEncoder,
+    VectorQuantizer,
+    MeasurementAggregator,
+    ReferenceStateEncoder,
+    MeasurementStack,
+)

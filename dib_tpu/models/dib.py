@@ -1,0 +1,127 @@
+"""The flagship Distributed IB model.
+
+Functional re-design of the reference's ``DistributedIBNet``
+(``models.py:26-123``): instead of Keras side channels (``add_loss`` /
+``add_metric``, ``models.py:115-121``), the model *returns* everything the
+training step and the instrumentation need — prediction, per-feature KL,
+and the Gaussian channel parameters. Beta never lives inside the model: the
+train step combines ``task_loss + beta * total_kl`` with beta as a traced
+input (see ``dib_tpu.train``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from dib_tpu.models.encoders import FeatureEncoderBank
+from dib_tpu.models.mlp import MLP
+from dib_tpu.ops.gaussian import kl_diagonal_gaussian, reparameterize
+
+Array = jax.Array
+
+
+class DistributedIBModel(nn.Module):
+    """Per-feature Gaussian encoders -> reparameterized samples -> integration MLP.
+
+    Returns ``(prediction, aux)`` where aux carries:
+      - ``kl_per_feature``: [F] batch-mean KL (nats) of each channel
+        (reference metric ``KL{i}``, ``models.py:111-115``)
+      - ``mus`` / ``logvars``: [F, B, d] channel parameters (for MI bounds and
+        compression-matrix artifacts)
+      - ``embeddings``: [B, F * d] the concatenated samples fed to the
+        integration network
+
+    Vanilla IB = single-element ``feature_dimensionalities``
+    (reference ``train.py:111-113``).
+    """
+
+    feature_dimensionalities: Sequence[int]
+    encoder_hidden: Sequence[int] = (128, 128)
+    integration_hidden: Sequence[int] = (256, 256)
+    output_dim: int = 1
+    embedding_dim: int = 32
+    use_positional_encoding: bool = True
+    num_posenc_frequencies: int = 4
+    activation: str | Callable | None = "relu"
+    output_activation: str | Callable | None = None
+    logvar_offset: float = 0.0
+
+    @nn.compact
+    def __call__(self, x: Array, key: Array, sample: bool = True):
+        mus, logvars = FeatureEncoderBank(
+            feature_dimensionalities=tuple(self.feature_dimensionalities),
+            hidden=tuple(self.encoder_hidden),
+            embedding_dim=self.embedding_dim,
+            num_posenc_frequencies=self.num_posenc_frequencies,
+            activation=self.activation,
+            logvar_offset=self.logvar_offset,
+            use_positional_encoding=self.use_positional_encoding,
+            name="encoders",
+        )(x)                                                     # [F, B, d] each
+
+        if sample:
+            u = reparameterize(key, mus, logvars)
+        else:
+            u = mus
+
+        # KL per channel: sum over latent dim, mean over batch (models.py:111-112)
+        kl_per_feature = jnp.mean(kl_diagonal_gaussian(mus, logvars, axis=-1), axis=-1)
+
+        # [F, B, d] -> [B, F*d] feature-major concat, matching the reference's
+        # concat over the feature list (models.py:122)
+        batch = x.shape[0]
+        embeddings = jnp.moveaxis(u, 0, 1).reshape(batch, -1)
+
+        prediction = MLP(
+            tuple(self.integration_hidden),
+            self.output_dim,
+            self.activation,
+            self.output_activation,
+            name="integration",
+        )(embeddings)
+
+        aux = {
+            "kl_per_feature": kl_per_feature,
+            "mus": mus,
+            "logvars": logvars,
+            "embeddings": embeddings,
+        }
+        return prediction, aux
+
+    @property
+    def num_features(self) -> int:
+        return len(self.feature_dimensionalities)
+
+    @nn.nowrap
+    def encode(self, params, x: Array):
+        """Channel parameters only (no sampling/prediction): [F, B, d] each."""
+        bank = FeatureEncoderBank(
+            feature_dimensionalities=tuple(self.feature_dimensionalities),
+            hidden=tuple(self.encoder_hidden),
+            embedding_dim=self.embedding_dim,
+            num_posenc_frequencies=self.num_posenc_frequencies,
+            activation=self.activation,
+            logvar_offset=self.logvar_offset,
+            use_positional_encoding=self.use_positional_encoding,
+        )
+        return bank.apply({"params": params["params"]["encoders"]}, x)
+
+    @nn.nowrap
+    def encode_feature(self, params, feature_index: int, x_feature: Array):
+        """One feature's channel parameters from raw single-feature data."""
+        bank = FeatureEncoderBank(
+            feature_dimensionalities=tuple(self.feature_dimensionalities),
+            hidden=tuple(self.encoder_hidden),
+            embedding_dim=self.embedding_dim,
+            num_posenc_frequencies=self.num_posenc_frequencies,
+            activation=self.activation,
+            logvar_offset=self.logvar_offset,
+            use_positional_encoding=self.use_positional_encoding,
+        )
+        return bank.encode_single(
+            {"params": params["params"]["encoders"]}, feature_index, x_feature
+        )

@@ -1,0 +1,187 @@
+"""Probabilistic feature encoders.
+
+``FeatureEncoderBank`` is the framework's TPU-first answer to the reference's
+Python list of per-feature Keras Sequentials iterated serially per batch
+(reference ``models.py:71-79``, loop at ``models.py:105``): all F feature
+encoders are ONE module vmapped over stacked parameters, so the whole bank is a
+single fused XLA computation (batched matmuls on the MXU) instead of F
+sequential MLP dispatches.
+
+Ragged features (e.g. pendulum dims [2, 1, 2, 1], reference ``data.py:127``)
+are zero-padded to a common width. This is exactly equivalent to per-feature
+exact widths because (a) sin(0) = 0 keeps the positional encoding zero on
+padding, and (b) first-layer weights multiplying zero inputs contribute nothing
+to outputs or gradients — each feature still has its own independent
+parameters along the stacked axis.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dib_tpu.models.mlp import MLP
+from dib_tpu.ops.posenc import positional_encoding, positional_encoding_frequencies
+
+Array = jax.Array
+
+
+def pad_and_stack_features(x: Array, feature_dimensionalities: Sequence[int]) -> Array:
+    """Split [B, sum(dims)] into per-feature blocks, zero-pad to the max width,
+    and stack to [F, B, max_dim] (feature-major for the vmapped bank)."""
+    dims = list(feature_dimensionalities)
+    max_dim = max(dims)
+    splits = np.cumsum(dims)[:-1]
+    blocks = jnp.split(x, splits, axis=-1)
+    padded = [
+        jnp.pad(b, [(0, 0)] * (b.ndim - 1) + [(0, max_dim - d)]) for b, d in zip(blocks, dims)
+    ]
+    return jnp.stack(padded, axis=0)
+
+
+class GaussianEncoder(nn.Module):
+    """Positional encoding + MLP -> (mu, logvar) for one feature.
+
+    Equivalent role to one entry of the reference's encoder list
+    (``models.py:73-78``) and to the chaos workload's
+    ``create_info_bott_encoder`` (chaos notebook cell 3).
+
+    ``logvar_offset`` shifts the predicted log-variances at the output — the
+    initialization trick from the amorphous workload (logvars start near -3 so
+    particles are easily discernible, amorphous notebook cell 8).
+    """
+
+    hidden: Sequence[int] = (128, 128)
+    embedding_dim: int = 32
+    num_posenc_frequencies: int = 4  # reference default 5 -> 2**arange(1,5) = 4 freqs
+    posenc_start_power: int = 1
+    activation: str | Callable | None = "relu"
+    logvar_offset: float = 0.0
+
+    @nn.compact
+    def __call__(self, x: Array) -> tuple[Array, Array]:
+        freqs = positional_encoding_frequencies(
+            self.num_posenc_frequencies, self.posenc_start_power
+        )
+        h = positional_encoding(x, freqs)
+        out = MLP(self.hidden, 2 * self.embedding_dim, self.activation)(h)
+        mus, logvars = jnp.split(out, 2, axis=-1)
+        return mus, logvars + self.logvar_offset
+
+
+class FeatureEncoderBank(nn.Module):
+    """All per-feature Gaussian encoders as one vmapped module.
+
+    Input: [B, sum(feature_dimensionalities)] concatenated features.
+    Output: (mus, logvars), each [F, B, embedding_dim].
+
+    Passing a single-element ``feature_dimensionalities`` recovers the vanilla
+    (non-distributed) IB, as in the reference's ``--ib`` flag
+    (``train.py:111-113``).
+    """
+
+    feature_dimensionalities: Sequence[int]
+    hidden: Sequence[int] = (128, 128)
+    embedding_dim: int = 32
+    num_posenc_frequencies: int = 4
+    posenc_start_power: int = 1
+    activation: str | Callable | None = "relu"
+    logvar_offset: float = 0.0
+    use_positional_encoding: bool = True
+
+    @nn.compact
+    def __call__(self, x: Array) -> tuple[Array, Array]:
+        stacked = pad_and_stack_features(x, self.feature_dimensionalities)  # [F, B, maxd]
+        bank = nn.vmap(
+            GaussianEncoder,
+            in_axes=0,
+            out_axes=0,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+        )(
+            hidden=tuple(self.hidden),
+            embedding_dim=self.embedding_dim,
+            num_posenc_frequencies=(
+                self.num_posenc_frequencies if self.use_positional_encoding else 0
+            ),
+            posenc_start_power=self.posenc_start_power,
+            activation=self.activation,
+            logvar_offset=self.logvar_offset,
+        )
+        return bank(stacked)
+
+    @nn.nowrap
+    def encode_single(self, params, feature_index: int, x_feature: Array):
+        """Run one feature's encoder on raw single-feature data [B, dim_i].
+
+        Used by the MI-bounds instrumentation, which probes encoders
+        individually (reference ``models.py:217-222``). Slices that feature's
+        parameters out of the stacked bank and pads the input to the bank
+        width.
+        """
+        dims = list(self.feature_dimensionalities)
+        max_dim = max(dims)
+        pad = max_dim - dims[feature_index]
+        x_padded = jnp.pad(x_feature, [(0, 0)] * (x_feature.ndim - 1) + [(0, pad)])
+        single_params = jax.tree.map(lambda p: p[feature_index], params["params"])
+        encoder = GaussianEncoder(
+            hidden=tuple(self.hidden),
+            embedding_dim=self.embedding_dim,
+            num_posenc_frequencies=(
+                self.num_posenc_frequencies if self.use_positional_encoding else 0
+            ),
+            posenc_start_power=self.posenc_start_power,
+            activation=self.activation,
+            logvar_offset=self.logvar_offset,
+        )
+        # The vmapped bank nests each encoder's params under 'VmapGaussianEncoder_0'.
+        inner = single_params[next(iter(single_params))]
+        return encoder.apply({"params": inner}, x_padded)
+
+
+class SimpleBinaryEncoder(nn.Module):
+    """Two-parameter encoder for a binary +-1 feature: x -> N(x * mu_scale, e^logvar).
+
+    Parity: boolean notebook cell 4 (``SimpleEncoder``): trainable mu scaling
+    (init 1) and a shared trainable logvar (init -3).
+    """
+
+    embedding_dim: int = 1
+    logvar_init: float = -3.0
+
+    @nn.compact
+    def __call__(self, x: Array) -> tuple[Array, Array]:
+        mu_scale = self.param("mu_scale", nn.initializers.ones, (1, self.embedding_dim))
+        logvar = self.param(
+            "logvar", nn.initializers.constant(self.logvar_init), (1, self.embedding_dim)
+        )
+        mus = x * mu_scale
+        logvars = jnp.ones_like(mus) * logvar
+        return mus, logvars
+
+
+class SimpleBinaryEncoderBank(nn.Module):
+    """F independent SimpleBinaryEncoders, vmapped over stacked parameters.
+
+    Input: [B, F] of +-1 values. Output: (mus, logvars) each [F, B, d].
+    """
+
+    num_features: int
+    embedding_dim: int = 1
+    logvar_init: float = -3.0
+
+    @nn.compact
+    def __call__(self, x: Array) -> tuple[Array, Array]:
+        stacked = jnp.swapaxes(x, 0, 1)[..., None]               # [F, B, 1]
+        bank = nn.vmap(
+            SimpleBinaryEncoder,
+            in_axes=0,
+            out_axes=0,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+        )(embedding_dim=self.embedding_dim, logvar_init=self.logvar_init)
+        return bank(stacked)

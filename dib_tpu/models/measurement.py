@@ -1,0 +1,176 @@
+"""Measurement-optimization stack for chaotic systems (nonlinear IB + soft VQ).
+
+Behavior parity: chaos notebook cell 10 — four networks trained jointly:
+  1. ``StateEncoder``: positional encoding (frequencies 2^0..2^(k-1)) + MLP ->
+     Gaussian (mu, logvar) in IB space (chaos notebook cell 3,
+     ``create_info_bott_encoder``).
+  2. ``VectorQuantizer``: MLP from a reparameterized IB point to alphabet
+     logits; softmax applied at temperature 1 during training, argmax at
+     inference (soft measurement).
+  3. ``MeasurementAggregator``: flattens a sequence of L soft symbols and MLPs
+     to the InfoNCE space.
+  4. ``ReferenceStateEncoder``: positional encoding + MLP from the raw
+     reference state to the same InfoNCE space.
+
+The loss couples them: beta * L * KL^2 (nonlinear-IB exponent 2, times the
+number of measurements L) + symmetric InfoNCE / 2 between the aggregated
+measurement sequence and the reference-state embedding. The loss lives in
+``dib_tpu.train``; these modules only define the computations.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from dib_tpu.models.mlp import MLP
+from dib_tpu.ops.gaussian import kl_diagonal_gaussian, reparameterize
+from dib_tpu.ops.posenc import positional_encoding, positional_encoding_frequencies
+
+Array = jax.Array
+
+
+class StateEncoder(nn.Module):
+    """Raw state -> diagonal Gaussian in IB space (chaos notebook cell 3)."""
+
+    hidden: Sequence[int] = (128, 128)
+    embedding_dim: int = 8
+    num_posenc_frequencies: int = 10
+    activation: str | Callable | None = "leaky_relu"
+
+    @nn.compact
+    def __call__(self, x: Array) -> tuple[Array, Array]:
+        freqs = positional_encoding_frequencies(self.num_posenc_frequencies, start_power=0)
+        h = positional_encoding(x, freqs)
+        out = MLP(tuple(self.hidden), 2 * self.embedding_dim, self.activation)(h)
+        return jnp.split(out, 2, axis=-1)
+
+
+class VectorQuantizer(nn.Module):
+    """IB-space point -> alphabet logits (softmax applied by the caller)."""
+
+    hidden: Sequence[int] = (128, 128)
+    alphabet_size: int = 2
+    activation: str | Callable | None = "leaky_relu"
+
+    @nn.compact
+    def __call__(self, u: Array) -> Array:
+        return MLP(tuple(self.hidden), self.alphabet_size, self.activation)(u)
+
+
+class MeasurementAggregator(nn.Module):
+    """[B, L, alphabet] soft symbols -> InfoNCE-space embedding."""
+
+    hidden: Sequence[int] = (256, 256)
+    output_dim: int = 32
+    activation: str | Callable | None = "leaky_relu"
+
+    @nn.compact
+    def __call__(self, soft_symbols: Array) -> Array:
+        flat = soft_symbols.reshape(soft_symbols.shape[0], -1)
+        return MLP(tuple(self.hidden), self.output_dim, self.activation)(flat)
+
+
+class ReferenceStateEncoder(nn.Module):
+    """Raw reference state -> InfoNCE-space embedding."""
+
+    hidden: Sequence[int] = (256, 256)
+    output_dim: int = 32
+    num_posenc_frequencies: int = 10
+    activation: str | Callable | None = "leaky_relu"
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        freqs = positional_encoding_frequencies(self.num_posenc_frequencies, start_power=0)
+        h = positional_encoding(x, freqs)
+        return MLP(tuple(self.hidden), self.output_dim, self.activation)(h)
+
+
+class MeasurementStack(nn.Module):
+    """The four chaos networks as one module (single param tree / optimizer)."""
+
+    ib_embedding_dim: int = 8
+    alphabet_size: int = 2
+    num_states: int = 12
+    infonce_dim: int = 32
+    encoder_hidden: Sequence[int] = (128, 128)
+    vq_hidden: Sequence[int] = (128, 128)
+    aggregator_hidden: Sequence[int] = (256, 256)
+    reference_hidden: Sequence[int] = (256, 256)
+    num_posenc_frequencies: int = 10
+    activation: str | Callable | None = "leaky_relu"
+
+    def setup(self):
+        self.state_encoder = StateEncoder(
+            hidden=tuple(self.encoder_hidden),
+            embedding_dim=self.ib_embedding_dim,
+            num_posenc_frequencies=self.num_posenc_frequencies,
+            activation=self.activation,
+        )
+        self.quantizer = VectorQuantizer(
+            hidden=tuple(self.vq_hidden),
+            alphabet_size=self.alphabet_size,
+            activation=self.activation,
+        )
+        self.aggregator = MeasurementAggregator(
+            hidden=tuple(self.aggregator_hidden),
+            output_dim=self.infonce_dim,
+            activation=self.activation,
+        )
+        self.reference_encoder = ReferenceStateEncoder(
+            hidden=tuple(self.reference_hidden),
+            output_dim=self.infonce_dim,
+            num_posenc_frequencies=self.num_posenc_frequencies,
+            activation=self.activation,
+        )
+
+    def __call__(self, states: Array, key: Array, reference_timestep: int = 0):
+        """Full forward pass for one batch of state sequences.
+
+        Args:
+          states: [B, L, state_dim] consecutive system states.
+          key: PRNG key for the reparameterized sample.
+          reference_timestep: which timestep the reference encoder sees.
+
+        Returns:
+          (sequence_embedding [B, infonce_dim],
+           reference_embedding [B, infonce_dim],
+           kl mean scalar (nats),
+           soft_symbols [B, L, alphabet])
+        """
+        batch, length, state_dim = states.shape
+        flat = states.reshape(-1, state_dim)
+        mus, logvars = self.state_encoder(flat)
+        kl = jnp.mean(kl_diagonal_gaussian(mus, logvars))
+        u = reparameterize(key, mus, logvars)
+        logits = self.quantizer(u)
+        soft_symbols = jax.nn.softmax(logits, axis=-1).reshape(batch, length, self.alphabet_size)
+        sequence_embedding = self.aggregator(soft_symbols)
+        reference_embedding = self.reference_encoder(states[:, reference_timestep])
+        return sequence_embedding, reference_embedding, kl, soft_symbols
+
+    def encode_states(self, states_flat: Array) -> tuple[Array, Array]:
+        """IB channel parameters for raw states (for MI bounds / symbolization)."""
+        return self.state_encoder(states_flat)
+
+    def symbolize(self, states_flat: Array, key: Array, num_noise_draws: int = 100) -> Array:
+        """Hard symbol assignment with the shared-noise averaging trick.
+
+        Parity: chaos notebook cell 10 symbolization — a FIXED set of
+        ``num_noise_draws`` noise vectors is shared across all states; each
+        state's symbol is the majority argmax over the draws. Deterministic
+        given ``key``.
+        """
+        mus, logvars = self.state_encoder(states_flat)
+        noise = jax.random.normal(key, (num_noise_draws, 1, self.ib_embedding_dim), mus.dtype)
+        u = mus[None] + noise * jnp.exp(0.5 * logvars)[None]     # [K, N, d]
+        logits = self.quantizer(u.reshape(-1, self.ib_embedding_dim))
+        assignments = jnp.argmax(logits, axis=-1).reshape(num_noise_draws, -1)
+        # majority vote (binary: mean > 0.5; general: per-symbol histogram argmax)
+        if self.alphabet_size == 2:
+            return (jnp.mean(assignments, axis=0) > 0.5).astype(jnp.uint8)
+        one_hot = jax.nn.one_hot(assignments, self.alphabet_size)
+        return jnp.argmax(jnp.sum(one_hot, axis=0), axis=-1).astype(jnp.uint8)

@@ -1,0 +1,54 @@
+"""Basic MLP building blocks shared by every model family."""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import flax.linen as nn
+import jax
+
+Array = jax.Array
+
+
+def resolve_activation(activation) -> Callable:
+    """Accepts a callable or a name ('relu', 'leaky_relu', 'tanh', None)."""
+    if activation is None:
+        return lambda x: x
+    if callable(activation):
+        return activation
+    table = {
+        "relu": nn.relu,
+        "leaky_relu": lambda x: nn.leaky_relu(x, negative_slope=0.1),
+        "tanh": nn.tanh,
+        "gelu": nn.gelu,
+        "sigmoid": nn.sigmoid,
+        "none": lambda x: x,
+        "linear": lambda x: x,
+    }
+    if activation not in table:
+        raise ValueError(f"Unknown activation: {activation!r}")
+    return table[activation]
+
+
+class MLP(nn.Module):
+    """Dense stack with a linear output layer.
+
+    Args:
+      hidden: widths of the hidden layers.
+      output_dim: width of the final (linear unless output_activation) layer.
+      activation: hidden-layer activation (name or callable).
+      output_activation: optional activation on the output layer.
+    """
+
+    hidden: Sequence[int]
+    output_dim: int
+    activation: str | Callable | None = "relu"
+    output_activation: str | Callable | None = None
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        act = resolve_activation(self.activation)
+        for width in self.hidden:
+            x = act(nn.Dense(width)(x))
+        x = nn.Dense(self.output_dim)(x)
+        return resolve_activation(self.output_activation)(x)

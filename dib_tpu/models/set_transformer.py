@@ -1,0 +1,77 @@
+"""Set transformer aggregator for per-particle (permutation-invariant) workloads.
+
+Behavior parity: amorphous notebook cell 8 — 6 post-LN attention blocks
+(MultiHeadAttention 12 heads x key_dim 128, residual, LayerNorm, feed-forward
+[128, bottleneck], residual, LayerNorm), mean-pool over the set, head MLP
+[256] with LeakyReLU(0.1), linear output. Architecture family from Lee et al.
+2019 as used by the reference.
+
+TPU notes: attention over sets of ~50 particles is a single fused
+dot-product-attention; the batch of neighborhoods — not the set axis — is the
+parallel/sharded axis (SURVEY.md section 5, long-context note).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import flax.linen as nn
+import jax
+
+from dib_tpu.models.mlp import MLP, resolve_activation
+
+Array = jax.Array
+
+
+class SetAttentionBlock(nn.Module):
+    """Post-LN self-attention block: x + MHA(x) -> LN -> (+FF) -> LN."""
+
+    num_heads: int = 12
+    key_dim: int = 128
+    ff_hidden: Sequence[int] = (128,)
+    model_dim: int = 32
+    ff_activation: str | Callable | None = "relu"
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        attn = nn.MultiHeadDotProductAttention(
+            num_heads=self.num_heads,
+            qkv_features=self.num_heads * self.key_dim,
+            out_features=self.model_dim,
+        )(x, x)
+        h = nn.LayerNorm()(x + attn)
+        ff = MLP(tuple(self.ff_hidden), self.model_dim, self.ff_activation,
+                 output_activation=self.ff_activation)(h)
+        return nn.LayerNorm()(h + ff)
+
+
+class SetTransformer(nn.Module):
+    """Stack of set-attention blocks -> mean pool -> head MLP -> linear output."""
+
+    num_blocks: int = 6
+    num_heads: int = 12
+    key_dim: int = 128
+    model_dim: int = 32
+    ff_hidden: Sequence[int] = (128,)
+    head_hidden: Sequence[int] = (256,)
+    output_dim: int = 1
+    ff_activation: str | Callable | None = "relu"
+    head_activation: str | Callable | None = "leaky_relu"
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        # x: [B, set_size, model_dim]
+        for _ in range(self.num_blocks):
+            x = SetAttentionBlock(
+                num_heads=self.num_heads,
+                key_dim=self.key_dim,
+                ff_hidden=tuple(self.ff_hidden),
+                model_dim=self.model_dim,
+                ff_activation=self.ff_activation,
+            )(x)
+        pooled = x.mean(axis=-2)
+        act = resolve_activation(self.head_activation)
+        h = pooled
+        for width in self.head_hidden:
+            h = act(nn.Dense(width)(h))
+        return nn.Dense(self.output_dim)(h)

@@ -1,0 +1,44 @@
+"""Pure-function math kernels: Gaussian bottleneck ops, schedules, similarities,
+mutual-information sandwich bounds, and entropy helpers.
+
+Everything here is functional, jit-safe, and shape-static. These are the
+building blocks every model/workload composes; nothing in this package touches
+the host or carries state.
+"""
+
+from dib_tpu.ops.gaussian import (
+    kl_diagonal_gaussian,
+    reparameterize,
+    bhattacharyya_dist_mat,
+    kl_divergence_mat,
+    gaussian_log_density_mat,
+)
+from dib_tpu.ops.posenc import positional_encoding, positional_encoding_frequencies, posenc_output_dim
+from dib_tpu.ops.schedules import (
+    log_annealed_beta,
+    beta_schedule,
+    beta_grid,
+    linear_warmup,
+)
+from dib_tpu.ops.similarity import (
+    pairwise_sqeuclidean,
+    pairwise_l1,
+    pairwise_linf,
+    scaled_similarity,
+    infonce_loss,
+    symmetric_infonce,
+)
+from dib_tpu.ops.info_bounds import (
+    mi_sandwich_from_params,
+    mi_sandwich_bounds,
+    mi_sandwich_probe,
+)
+from dib_tpu.ops.entropy import (
+    entropy_bits,
+    sequence_entropy_bits,
+    joint_entropy_bits,
+    mutual_information_bits,
+    entropy_rate_scaling_ansatz,
+    nats_to_bits,
+    LN2,
+)

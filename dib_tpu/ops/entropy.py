@@ -1,0 +1,82 @@
+"""Entropy helpers, exact truth-table information, and the Schurmann-Grassberger
+entropy-rate extrapolation ansatz.
+
+Behavior parity targets:
+  - ``compute_entropy_bits`` over a probability vector: reference ``utils.py:250-251``
+  - ``compute_entropy`` over a symbol sequence: reference ``utils.py:258-262``
+  - exact truth-table entropy / mutual information used as the boolean-circuit
+    ground-truth oracle: boolean notebook cell 5 (``compute_entropy``,
+    ``compute_info``)
+  - ``entropy_rate_scaling_ansatz``: reference ``utils.py:253-256``
+
+These are small host-side NumPy utilities (they feed scipy curve fitting and
+plotting); the device-side unit conversion lives here too so every workload
+converts nats -> bits at the same reporting boundary (reference
+``train.py:175-178``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+LN2 = float(np.log(2.0))
+
+
+def nats_to_bits(x):
+    """Convert nats to bits at the reporting boundary."""
+    return np.asarray(x) / LN2
+
+
+def entropy_bits(probabilities) -> float:
+    """Shannon entropy (bits) of a probability vector; zero entries contribute 0."""
+    p = np.asarray(probabilities, dtype=np.float64)
+    return float(-np.sum(p * np.log2(np.where(p > 0, p, 1.0))))
+
+
+def _rows_to_codes(vals: np.ndarray) -> np.ndarray:
+    """Map rows of a small integer array to unique integer codes."""
+    vals = np.asarray(vals)
+    if vals.ndim == 1:
+        return vals
+    _, codes = np.unique(vals, axis=0, return_inverse=True)
+    return codes
+
+
+def sequence_entropy_bits(seq) -> float:
+    """Empirical entropy (bits) of a symbol sequence (rows hashed if 2-D)."""
+    codes = _rows_to_codes(np.asarray(seq))
+    _, counts = np.unique(codes, return_counts=True)
+    return entropy_bits(counts / counts.sum())
+
+
+def joint_entropy_bits(vals1, vals2) -> float:
+    """Empirical joint entropy (bits) of two aligned symbol sequences."""
+    c1 = _rows_to_codes(np.asarray(vals1))
+    c2 = _rows_to_codes(np.asarray(vals2))
+    joint = np.stack([c1, c2], axis=-1)
+    return sequence_entropy_bits(joint)
+
+
+def mutual_information_bits(vals1, vals2) -> float:
+    """Exact empirical mutual information (bits): H(A) + H(B) - H(A,B).
+
+    On a full truth table this is the *exact* MI oracle the boolean workload
+    validates against (boolean notebook cells 5/7).
+    """
+    return (
+        sequence_entropy_bits(vals1)
+        + sequence_entropy_bits(vals2)
+        - joint_entropy_bits(vals1, vals2)
+    )
+
+
+def entropy_rate_scaling_ansatz(N, h_inf, gamma, c):
+    """Schurmann & Grassberger (1995) finite-size scaling of the entropy rate:
+
+        h(N) = h_inf + log2(N) / N^gamma / |c|
+
+    Used with ``scipy.optimize.curve_fit`` to extrapolate CTW estimates at
+    several sequence lengths to the infinite-length entropy rate.
+    """
+    N = np.asarray(N, dtype=np.float64)
+    return h_inf + np.log2(N) / (N ** gamma) / np.abs(c)

@@ -1,0 +1,50 @@
+"""Sinusoidal positional encoding for low-dimensional features.
+
+Behavior parity: reference ``models.py:12-23`` appends ``sin(f * x)`` for
+frequencies ``f = 2^1 .. 2^(k-1)`` (note: ``2**np.arange(1, k)`` yields k-1
+frequencies, reference ``models.py:70``); the chaos workload uses
+``2^0 .. 2^(k-1)`` (k frequencies, chaos notebook cell 3). Both conventions are
+supported via ``start_power``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+def positional_encoding_frequencies(num_frequencies: int, start_power: int = 1) -> np.ndarray:
+    """Frequency ladder ``2^start_power .. 2^(start_power + num - 1)``.
+
+    With the reference's default convention (``start_power=1`` and the count
+    coming from ``number_positional_encoding_frequencies - 1``), pass
+    ``num_frequencies = n - 1`` to mirror ``2**np.arange(1, n)``.
+    """
+    if num_frequencies <= 0:
+        return np.zeros((0,), dtype=np.float32)
+    return (2.0 ** np.arange(start_power, start_power + num_frequencies)).astype(np.float32)
+
+
+def positional_encoding(x: Array, frequencies) -> Array:
+    """Concatenate ``[x, sin(f_1 x), ..., sin(f_k x)]`` along the last axis.
+
+    Padding-safe: sin(0) = 0, so zero-padded feature dimensions stay zero
+    through the encoding (required by the vmapped feature-encoder bank, which
+    pads ragged features to a common width).
+    """
+    frequencies = jnp.asarray(frequencies, dtype=x.dtype)
+    if frequencies.size == 0:
+        return x
+    # [..., d] -> [..., d * (1 + k)]
+    sines = jnp.sin(x[..., None] * frequencies)                  # [..., d, k]
+    sines = jnp.moveaxis(sines, -1, -2)                          # [..., k, d]
+    sines = sines.reshape(*x.shape[:-1], -1)                     # [..., k*d]
+    return jnp.concatenate([x, sines], axis=-1)
+
+
+def posenc_output_dim(input_dim: int, num_frequencies: int) -> int:
+    """Output width of ``positional_encoding`` for an ``input_dim``-wide input."""
+    return input_dim * (1 + max(num_frequencies, 0))

@@ -1,0 +1,81 @@
+"""Bottleneck-strength (beta) schedules and optimizer warmup.
+
+Beta is a *traced scalar input* to the jitted train step — never a mutated
+variable (the reference assigns a ``tf.Variable`` from the host every epoch,
+reference ``models.py:147-149``). That makes a beta sweep an ordinary batch
+axis: ``jax.vmap(schedule)(grid)``.
+
+Schedule parity targets:
+  - flat pretraining then log-linear ramp (reference ``models.py:147-149``)
+  - per-step upward ramp (boolean notebook cell 6; amorphous notebook cell 8)
+  - per-step *downward* ramp, clipped progress (chaos notebook cell 10:
+    ``min(step/total, 1)``; downward 10 -> 1e-4)
+  - linear learning-rate warmup (amorphous notebook cell 8)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def log_annealed_beta(
+    step,
+    beta_start: float,
+    beta_end: float,
+    num_annealing_steps: int,
+    num_pretraining_steps: int = 0,
+    clip_progress: bool = True,
+):
+    """Log-linear beta ramp with optional flat pretraining phase.
+
+    beta(t) = exp( log b0 + p(t) * (log b1 - log b0) ),
+    p(t) = (t - pre) / anneal, clamped to [0, 1] when ``clip_progress``
+    (the reference's epoch callback clamps only below, ``models.py:148-149``;
+    its per-step loops clamp above too — clipping both is strictly safer and
+    identical within the scheduled range).
+
+    Works for upward (b1 > b0) and downward (b1 < b0) anneals. ``step`` may be a
+    traced scalar or an array (for a grid of phases).
+    """
+    step = jnp.asarray(step, dtype=jnp.float32)
+    progress = (step - num_pretraining_steps) / jnp.float32(max(num_annealing_steps, 1))
+    progress = jnp.clip(progress, 0.0, 1.0) if clip_progress else jnp.maximum(progress, 0.0)
+    log_b0 = jnp.log(jnp.float32(beta_start))
+    log_b1 = jnp.log(jnp.float32(beta_end))
+    return jnp.exp(log_b0 + progress * (log_b1 - log_b0))
+
+
+def beta_schedule(
+    beta_start: float,
+    beta_end: float,
+    num_annealing_steps: int,
+    num_pretraining_steps: int = 0,
+):
+    """Returns ``schedule(step) -> beta`` as a closure suitable for jit tracing."""
+
+    def schedule(step):
+        return log_annealed_beta(
+            step, beta_start, beta_end, num_annealing_steps, num_pretraining_steps
+        )
+
+    return schedule
+
+
+def beta_grid(beta_start: float, beta_end: float, num: int) -> Array:
+    """Logarithmically spaced grid of beta values — the sweep axis.
+
+    The reference sweeps beta by re-running the whole script per value (chaos
+    notebook cell 10 header: "loop over number_states ... 20 repeats per");
+    here the grid is an array to vmap/shard over the mesh ``beta`` axis.
+    """
+    return jnp.logspace(jnp.log10(beta_start), jnp.log10(beta_end), num)
+
+
+def linear_warmup(step, base_value: float, num_warmup_steps: int):
+    """Linear 0 -> base ramp over ``num_warmup_steps``, then constant."""
+    step = jnp.asarray(step, dtype=jnp.float32)
+    scale = jnp.minimum(step / jnp.float32(max(num_warmup_steps, 1)), 1.0)
+    return scale * base_value

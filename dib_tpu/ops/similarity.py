@@ -1,0 +1,99 @@
+"""Pairwise similarities and the (symmetric) InfoNCE contrastive loss.
+
+Behavior parity targets:
+  - pairwise squared-L2 / L1 / Linf distances: reference ``utils.py:75-124``
+  - ``get_scaled_similarity`` with types {l2sq, l2, l1, linf, cosine} and a
+    temperature: reference ``utils.py:127-175``
+  - symmetric InfoNCE over a similarity matrix: reference ``train.py:207-216``
+    (both row- and column-wise cross entropy against the diagonal) and the
+    halved variant of chaos notebook cell 10.
+
+TPU notes: the squared-L2 path uses the norm-expansion matmul form so the
+[B, B] similarity rides the MXU (fine here — InfoNCE only needs relative
+similarities, unlike the MI bounds which need exact log densities). L1/Linf are
+broadcast reductions on the VPU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+Array = jax.Array
+
+_EPS = 1e-9
+
+
+def pairwise_sqeuclidean(pts1: Array, pts2: Array) -> Array:
+    """[N, M] matrix of squared L2 distances, MXU-friendly norm-expansion form."""
+    n1 = jnp.sum(jnp.square(pts1), axis=-1, keepdims=True)      # [N, 1]
+    n2 = jnp.sum(jnp.square(pts2), axis=-1)[None, :]            # [1, M]
+    cross = pts1 @ pts2.T                                        # [N, M] on MXU
+    return jnp.maximum(n1 + n2 - 2.0 * cross, 0.0)
+
+
+def pairwise_l1(pts1: Array, pts2: Array) -> Array:
+    """[N, M] matrix of L1 (Manhattan) distances."""
+    return jnp.sum(jnp.abs(pts1[:, None, :] - pts2[None, :, :]), axis=-1)
+
+
+def pairwise_linf(pts1: Array, pts2: Array) -> Array:
+    """[N, M] matrix of Chebyshev (L_infinity) distances."""
+    return jnp.max(jnp.abs(pts1[:, None, :] - pts2[None, :, :]), axis=-1)
+
+
+def scaled_similarity(
+    embeddings1: Array,
+    embeddings2: Array,
+    similarity_type: str = "l2",
+    temperature: float = 1.0,
+) -> Array:
+    """[N, M] similarity matrix divided by ``temperature``.
+
+    Distance-derived similarities are negated distances (range -inf..0); cosine
+    ranges -1..1.
+    """
+    if similarity_type == "l2sq":
+        sim = -pairwise_sqeuclidean(embeddings1, embeddings2)
+    elif similarity_type == "l2":
+        # eps inside the sqrt keeps the gradient finite at zero distance.
+        sim = -jnp.sqrt(pairwise_sqeuclidean(embeddings1, embeddings2) + _EPS)
+    elif similarity_type == "l1":
+        sim = -pairwise_l1(embeddings1, embeddings2)
+    elif similarity_type == "linf":
+        sim = -pairwise_linf(embeddings1, embeddings2)
+    elif similarity_type == "cosine":
+        e1 = embeddings1 / (jnp.linalg.norm(embeddings1, axis=-1, keepdims=True) + _EPS)
+        e2 = embeddings2 / (jnp.linalg.norm(embeddings2, axis=-1, keepdims=True) + _EPS)
+        sim = e1 @ e2.T
+    else:
+        raise ValueError(f"Similarity type not implemented: {similarity_type}")
+    return sim / temperature
+
+
+def infonce_loss(similarity_matrix: Array) -> Array:
+    """Mean cross entropy of each row against its diagonal entry (nats)."""
+    batch = similarity_matrix.shape[0]
+    labels = jnp.arange(batch)
+    return jnp.mean(
+        optax.softmax_cross_entropy_with_integer_labels(similarity_matrix, labels)
+    )
+
+
+def symmetric_infonce(
+    embeddings1: Array,
+    embeddings2: Array,
+    similarity_type: str = "l2",
+    temperature: float = 1.0,
+    halved: bool = False,
+) -> Array:
+    """Row-wise + column-wise InfoNCE against the matched diagonal.
+
+    ``halved=False`` matches the CLI trainer (reference ``train.py:209-214``,
+    sum of both directions); ``halved=True`` matches the chaos workload
+    (cell 10, ``loss_prediction / 2``).
+    """
+    sim = scaled_similarity(embeddings1, embeddings2, similarity_type, temperature)
+    loss = infonce_loss(sim) + infonce_loss(sim.T)
+    return loss / 2.0 if halved else loss

@@ -1,0 +1,1 @@
+"""dib_tpu.parallel (populated incrementally)."""

@@ -1,0 +1,13 @@
+"""Jitted training: losses, the chunked epoch-scan trainer, device history,
+and host-side instrumentation hooks."""
+
+from dib_tpu.train.losses import (
+    bce_with_logits,
+    sparse_ce_with_logits,
+    mse,
+    resolve_loss,
+    accuracy_for,
+)
+from dib_tpu.train.history import HistoryRecord, history_init, history_record
+from dib_tpu.train.loop import TrainConfig, TrainState, DIBTrainer, make_optimizer
+from dib_tpu.train.hooks import InfoPerFeatureHook, CompressionMatrixHook

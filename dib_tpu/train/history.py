@@ -1,0 +1,94 @@
+"""Device-side training history.
+
+The training history IS the scientific product of a Distributed IB run
+("the fruits of training are signals that map out the information in the
+data", reference README.md:6). The reference stores it as Keras fit history /
+Python lists appended from the host every epoch (``train.py:169-178``,
+``train.py:237-275``); here it is a preallocated pytree of device arrays
+written with ``dynamic_update_slice`` inside the jitted scan, fetched to host
+once (or in chunks) — no per-epoch host sync.
+
+Unit convention: everything is recorded in NATS on device and converted to
+bits by ``HistoryRecord.to_bits()`` at the reporting boundary, the same
+boundary the reference uses (``train.py:175-178``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+def history_init(num_records: int, num_features: int) -> dict:
+    """Preallocated device history: one row per recorded epoch."""
+    f = jnp.float32
+    return {
+        "cursor": jnp.zeros((), jnp.int32),
+        "beta": jnp.zeros((num_records,), f),
+        "kl_per_feature": jnp.zeros((num_records, num_features), f),
+        "loss": jnp.zeros((num_records,), f),
+        "val_loss": jnp.zeros((num_records,), f),
+        "metric": jnp.zeros((num_records,), f),
+        "val_metric": jnp.zeros((num_records,), f),
+    }
+
+
+def history_record(history: dict, row: dict) -> dict:
+    """Write one record at the cursor (jit-safe)."""
+    cur = history["cursor"]
+    out = dict(history)
+    for name, value in row.items():
+        buf = history[name]
+        value = jnp.asarray(value, buf.dtype)
+        out[name] = jax.lax.dynamic_update_index_in_dim(
+            buf, value, cur, axis=0
+        )
+    out["cursor"] = cur + 1
+    return out
+
+
+@dataclass
+class HistoryRecord:
+    """Host-side view of a fetched history (trimmed to the cursor)."""
+
+    beta: np.ndarray
+    kl_per_feature: np.ndarray       # [T, F] nats
+    loss: np.ndarray                 # [T] nats (task loss only, beta*KL removed)
+    val_loss: np.ndarray
+    metric: np.ndarray
+    val_metric: np.ndarray
+
+    @classmethod
+    def from_device(cls, history: dict) -> "HistoryRecord":
+        n = int(history["cursor"])
+        return cls(
+            beta=np.asarray(history["beta"])[:n],
+            kl_per_feature=np.asarray(history["kl_per_feature"])[:n],
+            loss=np.asarray(history["loss"])[:n],
+            val_loss=np.asarray(history["val_loss"])[:n],
+            metric=np.asarray(history["metric"])[:n],
+            val_metric=np.asarray(history["val_metric"])[:n],
+        )
+
+    def to_bits(self, loss_is_info_based: bool = True) -> "HistoryRecord":
+        """Nats -> bits for KL always; for losses only when info-based
+        (reference train.py:175-178)."""
+        ln2 = np.log(2.0)
+        scale = ln2 if loss_is_info_based else 1.0
+        return HistoryRecord(
+            beta=self.beta,
+            kl_per_feature=self.kl_per_feature / ln2,
+            loss=self.loss / scale,
+            val_loss=self.val_loss / scale,
+            metric=self.metric,
+            val_metric=self.val_metric,
+        )
+
+    @property
+    def total_kl(self) -> np.ndarray:
+        return self.kl_per_feature.sum(-1)

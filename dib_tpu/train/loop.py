@@ -1,0 +1,270 @@
+"""Jitted Distributed-IB training.
+
+Re-design of the reference's two training paths (Keras ``model.fit`` with
+callbacks, ``train.py:133-178``; custom InfoNCE loop, ``train.py:180-289``)
+as ONE jitted program: a ``lax.scan`` over epochs, each epoch a ``lax.scan``
+over steps, with beta computed from the epoch index by a schedule function
+(never host-assigned), batches drawn by on-device PRNG, and history written
+into preallocated device arrays. The host only re-enters between *chunks* of
+epochs, where instrumentation hooks (MI bounds, compression-scheme dumps)
+run on fetched arrays — keeping the hot loop free of host syncs
+(SURVEY.md section 7, host/device choreography).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Callable, NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from dib_tpu.ops.schedules import log_annealed_beta
+from dib_tpu.ops.similarity import symmetric_infonce
+from dib_tpu.train.history import HistoryRecord, history_init, history_record
+from dib_tpu.train.losses import accuracy_for, resolve_loss
+
+Array = jax.Array
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    """Flag surface mirroring the reference CLI (``train.py:12-74``) minus
+    TF-isms, plus TPU-side knobs (chunking, val subset size)."""
+
+    learning_rate: float = 3e-4
+    batch_size: int = 128
+    beta_start: float = 1e-4
+    beta_end: float = 3.0
+    num_pretraining_epochs: int = 1000
+    num_annealing_epochs: int = 10000
+    steps_per_epoch: int = 0            # 0 -> ceil(num_train / batch_size)
+    warmup_steps: int = 0               # linear LR warmup (amorphous workload)
+    optimizer: str = "adam"
+    max_val_points: int = 4096          # fixed val subset evaluated per epoch
+    infonce_similarity: str = "l2"
+    infonce_temperature: float = 1.0
+
+    @property
+    def num_epochs(self) -> int:
+        return self.num_pretraining_epochs + self.num_annealing_epochs
+
+
+class TrainState(NamedTuple):
+    params: dict
+    opt_state: object
+    epoch: Array          # int32 scalar
+
+
+def make_optimizer(config: TrainConfig):
+    if config.warmup_steps > 0:
+        lr = optax.linear_schedule(0.0, config.learning_rate, config.warmup_steps)
+    else:
+        lr = config.learning_rate
+    if config.optimizer == "adam":
+        return optax.adam(lr)
+    if config.optimizer == "sgd":
+        return optax.sgd(lr)
+    raise ValueError(f"Unknown optimizer {config.optimizer!r}")
+
+
+class DIBTrainer:
+    """Trains a DistributedIBModel (supervised or contrastive) on a bundle.
+
+    Supervised mode: loss = task(prediction, y) + beta * sum_f KL_f
+    (reference ``models.py:118`` + ``train.py:138-142``).
+    InfoNCE mode (``bundle.loss == 'infonce'``): the model's output is an
+    embedding matched against ``y_encoder(y)`` with symmetric InfoNCE
+    (reference ``train.py:201-220``); requires ``y_encoder``.
+    """
+
+    def __init__(self, model, bundle, config: TrainConfig, y_encoder=None):
+        self.model = model
+        self.bundle = bundle
+        self.config = config
+        self.y_encoder = y_encoder
+        self.contrastive = bundle.loss == "infonce"
+        if self.contrastive and y_encoder is None:
+            raise ValueError("infonce loss requires a y_encoder model")
+        self.optimizer = make_optimizer(config)
+        n = bundle.x_train.shape[0]
+        self.steps_per_epoch = config.steps_per_epoch or max(1, -(-n // config.batch_size))
+        self.num_features = bundle.number_features
+
+        self._x_train = jnp.asarray(bundle.x_train)
+        self._y_train = jnp.asarray(bundle.y_train)
+        nv = min(bundle.x_valid.shape[0], config.max_val_points)
+        if self.contrastive:
+            # InfoNCE has a log(B) baseline, so validation must use the SAME
+            # batch size as training for comparable loss values (the reference
+            # evaluates validation in batch_size batches, train.py:230-236).
+            self._val_chunk = min(config.batch_size, nv)
+            nv = max((nv // self._val_chunk) * self._val_chunk, self._val_chunk)
+        else:
+            self._val_chunk = None
+        self._x_valid = jnp.asarray(bundle.x_valid[:nv])
+        self._y_valid = jnp.asarray(bundle.y_valid[:nv])
+
+        if not self.contrastive:
+            self._task_loss = resolve_loss(bundle.loss)
+            self._metric = (
+                accuracy_for(bundle.loss) if "accuracy" in tuple(bundle.metrics) else None
+            )
+        else:
+            self._task_loss = None
+            self._metric = None
+
+    # ------------------------------------------------------------------ setup
+    def init(self, key: Array) -> tuple[TrainState, dict]:
+        k_model, k_y, k_noise = jax.random.split(key, 3)
+        x0 = self._x_train[: self.config.batch_size]
+        params = {"model": self.model.init(k_model, x0, k_noise)}
+        if self.contrastive:
+            params["y_encoder"] = self.y_encoder.init(
+                k_y, self._y_train[: self.config.batch_size]
+            )
+        opt_state = self.optimizer.init(params)
+        history = history_init(self.config.num_epochs, self.num_features)
+        return TrainState(params, opt_state, jnp.zeros((), jnp.int32)), history
+
+    # ------------------------------------------------------------- loss cores
+    def _forward_loss(self, params, x, y, beta, key):
+        prediction, aux = self.model.apply(params["model"], x, key)
+        kl_per_feature = aux["kl_per_feature"]
+        if self.contrastive:
+            y_emb = self.y_encoder.apply(params["y_encoder"], y)
+            task = symmetric_infonce(
+                prediction,
+                y_emb,
+                self.config.infonce_similarity,
+                self.config.infonce_temperature,
+            )
+        else:
+            task = self._task_loss(prediction, y)
+        loss = task + beta * jnp.sum(kl_per_feature)
+        metric = (
+            self._metric(prediction, y) if self._metric is not None else jnp.zeros(())
+        )
+        return loss, {"task": task, "kl": kl_per_feature, "metric": metric}
+
+    # ------------------------------------------------------------ epoch scan
+    def _epoch_body(self, state: TrainState, key: Array) -> tuple[TrainState, dict]:
+        cfg = self.config
+        beta = log_annealed_beta(
+            state.epoch, cfg.beta_start, cfg.beta_end,
+            cfg.num_annealing_epochs, cfg.num_pretraining_epochs,
+        )
+        n = self._x_train.shape[0]
+        grad_fn = jax.value_and_grad(self._forward_loss, has_aux=True)
+
+        def step_body(carry, k):
+            params, opt_state = carry
+            k_batch, k_noise = jax.random.split(k)
+            idx = jax.random.randint(k_batch, (cfg.batch_size,), 0, n)
+            (loss, aux), grads = grad_fn(
+                params, self._x_train[idx], self._y_train[idx], beta, k_noise
+            )
+            updates, opt_state = self.optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state), {
+                "task": aux["task"], "kl": aux["kl"], "metric": aux["metric"],
+            }
+
+        keys = jax.random.split(key, self.steps_per_epoch + 1)
+        (params, opt_state), stats = jax.lax.scan(
+            step_body, (state.params, state.opt_state), keys[:-1]
+        )
+        if self.contrastive:
+            # evaluate in training-batch-sized chunks (see __init__ note)
+            xv = self._x_valid.reshape(-1, self._val_chunk, self._x_valid.shape[-1])
+            yv = self._y_valid.reshape(-1, self._val_chunk, self._y_valid.shape[-1])
+            vkeys = jax.random.split(keys[-1], xv.shape[0])
+
+            def val_one(args):
+                xc, yc, k = args
+                _, aux = self._forward_loss(params, xc, yc, beta, k)
+                return aux["task"], aux["metric"]
+
+            v_task, v_metric = jax.lax.map(val_one, (xv, yv, vkeys))
+            val_aux = {"task": jnp.mean(v_task), "metric": jnp.mean(v_metric)}
+        else:
+            _, val_aux = self._forward_loss(
+                params, self._x_valid, self._y_valid, beta, keys[-1]
+            )
+        row = {
+            "beta": beta,
+            "kl_per_feature": jnp.mean(stats["kl"], 0),
+            "loss": jnp.mean(stats["task"]),
+            "val_loss": val_aux["task"],
+            "metric": jnp.mean(stats["metric"]),
+            "val_metric": val_aux["metric"],
+        }
+        return TrainState(params, opt_state, state.epoch + 1), row
+
+    @partial(jax.jit, static_argnames=("self", "num_epochs"))
+    def run_chunk(self, state: TrainState, history: dict, key: Array, num_epochs: int):
+        """Scan ``num_epochs`` epochs fully on device."""
+
+        def body(carry, k):
+            state, history = carry
+            state, row = self._epoch_body(state, k)
+            history = history_record(history, row)
+            return (state, history), None
+
+        keys = jax.random.split(key, num_epochs)
+        (state, history), _ = jax.lax.scan(body, (state, history), keys)
+        return state, history
+
+    # ------------------------------------------------------------------ fit
+    def fit(
+        self,
+        key: Array,
+        num_epochs: int | None = None,
+        hooks: Sequence[Callable] = (),
+        hook_every: int = 0,
+        state: TrainState | None = None,
+        history: dict | None = None,
+    ) -> tuple[TrainState, HistoryRecord]:
+        """Python-level driver: jitted chunks + host hooks between them.
+
+        ``hooks`` are called as ``hook(trainer, state, epoch)`` every
+        ``hook_every`` epochs (0 -> single chunk, no hooks) — the functional
+        equivalent of the reference's Keras callbacks
+        (``InfoPerFeatureCallback`` / ``SaveCompressionMatricesCallback``,
+        reference ``models.py:152-223``).
+        """
+        num_epochs = self.config.num_epochs if num_epochs is None else num_epochs
+        if state is None or history is None:
+            key, k_init = jax.random.split(key)
+            state, history = self.init(k_init)
+        capacity = history["beta"].shape[0]
+        cursor = int(history["cursor"])
+        if cursor + num_epochs > capacity:
+            raise ValueError(
+                f"History buffer holds {capacity} epochs but {cursor} are already "
+                f"recorded and {num_epochs} more were requested; allocate a larger "
+                f"buffer (history_init) or train fewer epochs."
+            )
+        chunk = hook_every if (hook_every and hooks) else num_epochs
+        done = 0
+        while done < num_epochs:
+            this_chunk = min(chunk, num_epochs - done)
+            key, k_chunk = jax.random.split(key)
+            state, history = self.run_chunk(state, history, k_chunk, this_chunk)
+            done += this_chunk
+            for hook in hooks:
+                hook(self, state, int(state.epoch))
+        return state, HistoryRecord.from_device(history)
+
+    # ------------------------------------------------------------ inspection
+    def encode_feature(self, state: TrainState, feature_index: int, x_feature):
+        return self.model.encode_feature(state.params["model"], feature_index, x_feature)
+
+    def feature_data(self, feature_index: int, split: str = "valid") -> np.ndarray:
+        dims = list(self.bundle.feature_dimensionalities)
+        start = int(np.sum(dims[:feature_index]))
+        x = self.bundle.x_valid if split == "valid" else self.bundle.x_train
+        return x[:, start : start + dims[feature_index]]

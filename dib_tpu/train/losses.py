@@ -1,0 +1,61 @@
+"""Task losses and metrics, resolved by name from the dataset bundle.
+
+Loss names mirror the reference's dataset-dict ``loss`` field
+(``data.py:65``, ``data.py:131``): 'bce' (binary CE from logits),
+'sparse_ce' (multiclass from logits), 'mse', and 'infonce' (handled by the
+contrastive train step, ``dib_tpu.train.loop``). All losses return nats (mean
+over the batch); conversion to bits happens only at the reporting boundary.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+Array = jax.Array
+
+
+def bce_with_logits(logits: Array, labels: Array) -> Array:
+    """Mean binary cross entropy; logits [B, 1] or [B], labels in {0, 1}."""
+    logits = logits.reshape(labels.shape[0], -1).squeeze(-1)
+    labels = labels.reshape(labels.shape[0], -1).squeeze(-1)
+    return jnp.mean(optax.sigmoid_binary_cross_entropy(logits, labels))
+
+
+def sparse_ce_with_logits(logits: Array, labels: Array) -> Array:
+    """Mean categorical cross entropy; logits [B, C], integer labels [B]."""
+    labels = labels.reshape(-1).astype(jnp.int32)
+    return jnp.mean(optax.softmax_cross_entropy_with_integer_labels(logits, labels))
+
+
+def mse(predictions: Array, targets: Array) -> Array:
+    targets = targets.reshape(predictions.shape)
+    return jnp.mean(jnp.square(predictions - targets))
+
+
+LOSSES = {
+    "bce": bce_with_logits,
+    "sparse_ce": sparse_ce_with_logits,
+    "mse": mse,
+}
+
+
+def resolve_loss(name: str):
+    if name not in LOSSES:
+        raise ValueError(f"Unknown loss {name!r} (infonce is handled by the contrastive step)")
+    return LOSSES[name]
+
+
+def binary_accuracy(logits: Array, labels: Array) -> Array:
+    logits = logits.reshape(labels.shape[0], -1).squeeze(-1)
+    labels = labels.reshape(labels.shape[0], -1).squeeze(-1)
+    return jnp.mean(((logits > 0).astype(jnp.float32) == labels).astype(jnp.float32))
+
+
+def multiclass_accuracy(logits: Array, labels: Array) -> Array:
+    return jnp.mean((jnp.argmax(logits, -1) == labels.reshape(-1)).astype(jnp.float32))
+
+
+def accuracy_for(loss_name: str):
+    return binary_accuracy if loss_name == "bce" else multiclass_accuracy

@@ -1,0 +1,5 @@
+"""Visualization artifacts: info plane, compression matrices, probe info maps."""
+
+from dib_tpu.viz.info_plane import save_distributed_info_plane
+from dib_tpu.viz.compression import save_compression_matrix, compression_matrix
+from dib_tpu.viz.probe_maps import save_info_maps, density_mask

@@ -1,0 +1,104 @@
+"""Compression-scheme matrices: how distinguishable are feature values in
+latent space?
+
+Behavior parity: reference ``visualization.py:14-81`` (with its bugs fixed —
+the committed version references undefined ``tf``/``n``, see SURVEY.md
+section 0): sort/sample feature values, compute exp(-Bhattacharyya)
+distinguishability between their latent Gaussians, render with marginal
+histograms (<10 unique values) or value curves.
+"""
+
+from __future__ import annotations
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt
+import numpy as np
+
+from dib_tpu.ops.gaussian import bhattacharyya_dist_mat
+
+
+def compression_matrix(mus: np.ndarray, logvars: np.ndarray) -> np.ndarray:
+    """exp(-Bhattacharyya) distinguishability matrix in [0, 1]."""
+    d = np.asarray(bhattacharyya_dist_mat(mus, logvars, mus, logvars))
+    return np.exp(-d)
+
+
+def save_compression_matrix(
+    mus: np.ndarray,
+    logvars: np.ndarray,
+    raw_values: np.ndarray,
+    out_fname: str,
+    feature_label: str | None = None,
+    max_number_to_display: int = 128,
+    rng: np.random.Generator | None = None,
+) -> str:
+    """Render one feature's compression matrix with marginals.
+
+    Args:
+      mus, logvars: [N, d] latent Gaussians for the feature's data points
+        (aligned with ``raw_values``).
+      raw_values: [N] or [N, 1] raw feature values for axis ordering/marginals.
+      out_fname: output PNG path.
+    """
+    rng = rng or np.random.default_rng(0)
+    raw = np.asarray(raw_values).reshape(len(raw_values), -1)[:, 0]
+
+    unique_vals, unique_idx = np.unique(raw, return_index=True)
+    if len(unique_vals) < 10:
+        display_histogram = True
+        order = np.argsort(unique_vals)
+        sel = unique_idx[order]
+        sorted_raw = unique_vals[order]
+        counts = np.array([np.mean(raw == v) for v in sorted_raw])
+    else:
+        display_histogram = False
+        pick = rng.choice(len(raw), min(max_number_to_display, len(raw)), replace=False)
+        order = np.argsort(raw[pick])
+        sel = pick[order]
+        sorted_raw = raw[sel]
+        counts = None
+
+    mat = compression_matrix(np.asarray(mus)[sel], np.asarray(logvars)[sel])
+    n = len(sel)
+
+    fig = plt.figure(figsize=(6, 6))
+    gs = fig.add_gridspec(
+        2, 2, width_ratios=(1, 2), height_ratios=(1, 2),
+        left=0.1, right=0.9, bottom=0.1, top=0.9, wspace=0.05, hspace=0.05,
+    )
+    ax = fig.add_subplot(gs[1, 1])
+    ax.imshow(mat, vmin=0, vmax=1, cmap="Blues_r")
+    ax.set_axis_off()
+
+    ax_left = fig.add_subplot(gs[1, 0])
+    ax_top = fig.add_subplot(gs[0, 1])
+    if display_histogram:
+        ax_left.barh(sorted_raw, counts, height=0.8)
+        ax_left.set_xlim(0, 1)
+        ax_left.set_xticks([])
+        ax_top.bar(sorted_raw, counts, width=0.8)
+        ax_top.set_ylim(0, 1)
+        ax_top.set_yticks([])
+    else:
+        ax_left.plot(sorted_raw, np.arange(n), "k", lw=3)
+        ax_left.set_ylim(n, 0)
+        ax_left.set_yticks([])
+        ax_top.plot(np.arange(n), sorted_raw, "k", lw=3)
+        ax_top.set_xlim(0, n)
+        ax_top.set_xticks([])
+    for a in (ax_left, ax_top):
+        for side in ("top", "right", "left", "bottom"):
+            a.spines[side].set_visible(False)
+
+    ax_label = fig.add_subplot(gs[0, 0])
+    if feature_label:
+        ax_label.text(0, 0, feature_label)
+    ax_label.set_xlim(-0.5, 0.5)
+    ax_label.set_ylim(-0.5, 0.5)
+    ax_label.set_axis_off()
+
+    fig.savefig(out_fname)
+    plt.close(fig)
+    return out_fname

@@ -1,0 +1,78 @@
+"""The distributed information plane.
+
+Behavior parity: reference ``visualization.py:83-114`` — loss-vs-total-KL
+trajectory (black, thick) with per-feature KL curves on a twin axis, optional
+H(Y) guide line, saved as ``distributed_info_plane.png``; series sieved to at
+most ~1000 points and the first half skipped (warmup).
+"""
+
+from __future__ import annotations
+
+import os
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt
+import numpy as np
+
+DEFAULT_COLORS = plt.rcParams["axes.prop_cycle"].by_key()["color"]
+
+
+def save_distributed_info_plane(
+    kl_series: np.ndarray,
+    loss_series: np.ndarray,
+    outdir: str,
+    entropy_y: float | None = None,
+    info_plot_lims=(0.0, 15.0),
+    filename: str = "distributed_info_plane.png",
+    skip_fraction: float = 0.5,
+) -> str:
+    """Plot the info-plane trajectory.
+
+    Args:
+      kl_series: [T, F] per-feature KL (bits).
+      loss_series: [T] task loss (bits if info-based).
+      outdir: output directory.
+      entropy_y: optional H(Y) guide line (bits).
+      info_plot_lims: x-axis limits for total transmitted information.
+      skip_fraction: fraction of the (sieved) series to skip as warmup.
+
+    Returns the saved path.
+    """
+    os.makedirs(outdir, exist_ok=True)
+    kl_series = np.asarray(kl_series)
+    loss_series = np.asarray(loss_series)
+    num_features = kl_series.shape[1]
+
+    target_len = min(1000, kl_series.shape[0])
+    sieve = max(kl_series.shape[0] // target_len, 1)
+    kl = kl_series[::sieve]
+    loss = loss_series[::sieve]
+    start = int(kl.shape[0] * skip_fraction)
+
+    total_kl = kl.sum(-1)
+
+    fig = plt.figure(figsize=(8, 4))
+    ax = plt.gca()
+    ax.plot(total_kl[start:], loss[start:], lw=4, color="k")
+    if entropy_y is not None:
+        ax.plot(list(info_plot_lims), [entropy_y] * 2, "k:")
+    ax.set_xlim(info_plot_lims)
+    ax.set_xlabel("Total information into model (bits)")
+    ax.set_ylabel("Task loss (bits)")
+    if num_features > 1:
+        ax2 = ax.twinx()
+        for f in range(num_features):
+            ax2.plot(
+                total_kl[start:], kl[start:, f],
+                color=DEFAULT_COLORS[f % len(DEFAULT_COLORS)], lw=3,
+            )
+        ax2.set_ylabel("Information per feature (bits)")
+        ax.set_zorder(ax2.get_zorder() + 1)
+        ax.patch.set_visible(False)
+
+    path = os.path.join(outdir, filename)
+    fig.savefig(path, dpi=300, bbox_inches="tight")
+    plt.close(fig)
+    return path

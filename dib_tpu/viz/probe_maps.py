@@ -1,0 +1,64 @@
+"""Per-particle information heat maps over a spatial probe grid.
+
+Behavior parity: amorphous notebook cell 8 probe-grid rendering — the
+[grid, grid] mean of the InfoNCE/LOO bounds in bits, optionally masked by the
+pair-correlation density (NaN inside the excluded-volume core), drawn with the
+'gist_heat_r' colormap per particle type.
+"""
+
+from __future__ import annotations
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt
+import numpy as np
+
+from dib_tpu.ops.entropy import LN2
+
+
+def density_mask(
+    probe_positions: np.ndarray,
+    g_r: np.ndarray,
+    g_r_bins: np.ndarray,
+    grid_side_length: int,
+    density_threshold: float = 1e-6,
+) -> np.ndarray:
+    """NaN-mask for probe points inside the region where g(r) < threshold
+    (no physical particles there, so the network output is meaningless)."""
+    below = np.where(g_r < density_threshold)[0]
+    cutoff_radius = g_r_bins[below[-1]] if len(below) else 0.0
+    radii = np.hypot(probe_positions[:, 0], probe_positions[:, 1])
+    mask = np.where(radii < cutoff_radius, np.nan, 1.0)
+    return mask.reshape(grid_side_length, grid_side_length)
+
+
+def save_info_maps(
+    info_bounds_grids,
+    out_fname: str,
+    masks=None,
+    titles=None,
+    cmap: str = "gist_heat_r",
+) -> str:
+    """Render per-type probe-grid info maps side by side.
+
+    Args:
+      info_bounds_grids: list of [G, G, 2] arrays (lower/upper bounds, nats).
+      masks: optional list of [G, G] NaN-masks.
+      out_fname: output path (PNG/SVG).
+    """
+    num = len(info_bounds_grids)
+    fig = plt.figure(figsize=(9 * num, 8))
+    for i, grid in enumerate(info_bounds_grids):
+        ax = fig.add_subplot(1, num, i + 1)
+        img = np.mean(np.asarray(grid), axis=-1) / LN2
+        if masks is not None:
+            img = img * masks[i]
+        im = ax.imshow(img, cmap=cmap)
+        ax.set_axis_off()
+        if titles:
+            ax.set_title(titles[i])
+        fig.colorbar(im, ax=ax)
+    fig.savefig(out_fname)
+    plt.close(fig)
+    return out_fname

@@ -1,0 +1,33 @@
+"""Test harness configuration.
+
+Tests run on a virtual 8-device CPU mesh (the standard JAX fake-backend idiom)
+so pjit sharding and collectives are exercised without TPU hardware. This must
+be set before JAX initializes its backends, hence the env mutation at import
+time (pytest imports conftest before test modules import jax).
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # hard override: the ambient env pins axon (TPU)
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+# Persistent compilation cache: this box has a single CPU core, so avoiding
+# recompiles across pytest runs matters more than anything else. Use a
+# CPU-specific dir — the ambient cache dir holds AOT results from the remote
+# TPU compile service whose CPU-feature flags mismatch this host.
+os.environ["JAX_COMPILATION_CACHE_DIR"] = "/root/.cache/jax_comp_cache_cpu"
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.2")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,180 @@
+"""Data-layer tests: registry, boolean circuits, pendulum physics oracles,
+chaotic maps, amorphous feature engineering, tabular preprocessing."""
+
+import numpy as np
+import pytest
+
+from dib_tpu.data import (
+    available_datasets,
+    get_dataset,
+    PAPER_CIRCUIT,
+    FIG_S1_CIRCUITS,
+    full_truth_table,
+    random_circuit,
+    exact_subset_informations,
+    total_energy,
+    unroll_angles,
+    generate_data,
+    ENTROPY_RATE_BITS,
+    per_particle_features,
+    synthetic_glass_neighborhoods,
+    build_neighborhood_arrays,
+    TabularPreprocessor,
+)
+from dib_tpu.data.pendulum import simulate_double_pendulum
+from dib_tpu.ops.entropy import sequence_entropy_bits
+
+
+def test_registry_has_reference_parity_names():
+    names = available_datasets()
+    # reference data.py:397-406 registry
+    for name in ["boolean_circuit", "double_pendulum", "mice_protein", "microsoft",
+                 "credit", "support2", "wine", "bikeshare"]:
+        assert name in names
+    # notebook workloads promoted to first-class datasets
+    assert "amorphous_particles" in names
+    assert "amorphous_radial_shells" in names
+
+
+# ------------------------------------------------------------------- boolean
+def test_paper_circuit_truth_table():
+    table = full_truth_table(PAPER_CIRCUIT)
+    assert table.shape == (1024, 19)
+    y = table[:, -1]
+    assert set(np.unique(y)) <= {0, 1}
+    assert 0.4 < sequence_entropy_bits(y) <= 1.0
+
+
+def test_random_circuit_structure(rng):
+    spec = random_circuit(6, rng)
+    assert sum(1 for v in spec if isinstance(v, (int, np.integer))) == 6
+    table = full_truth_table(spec)
+    assert table.shape[0] == 64
+
+
+def test_exact_subset_informations_monotone():
+    infos = exact_subset_informations(full_truth_table(FIG_S1_CIRCUITS[1]), 3)
+    # MI is monotone under superset inclusion
+    assert infos[(0,)] <= infos[(0, 1)] + 1e-12
+    assert infos[(0, 1)] <= infos[(0, 1, 2)] + 1e-12
+
+
+# ------------------------------------------------------------------ pendulum
+@pytest.mark.slow
+def test_pendulum_energy_conservation():
+    data = simulate_double_pendulum(
+        num_trajectories=4, initial_time=2.0, simulation_time=3.0, seed=0
+    )
+    assert data.shape[0] == 4 and data.shape[-1] == 4
+    e = np.asarray(total_energy(data))
+    drift = np.abs(e - e[:, :1]) / np.abs(e[:, :1])
+    assert drift.max() < 1e-3  # the reference's rejection tolerance
+
+
+def test_unroll_angles_geometry(rng):
+    arr = rng.normal(size=(2, 5, 4))
+    out = unroll_angles(arr)
+    assert out.shape == (2, 5, 6)
+    np.testing.assert_allclose(out[..., 0] ** 2 + out[..., 1] ** 2, 1.0, rtol=1e-6)
+    np.testing.assert_allclose(out[..., 2], arr[..., 1])
+
+
+def test_fetch_double_pendulum_bundle(tmp_path):
+    bundle = get_dataset(
+        "double_pendulum",
+        data_path=str(tmp_path),
+        num_trajectories=12,
+        pendulum_time_delta=1.0,
+        regenerate=True,
+    )
+    assert bundle.feature_dimensionalities == [2, 1, 2, 1]
+    assert bundle.x_train.shape[-1] == 6
+    assert bundle.loss == "infonce"
+    # y is the state time_delta later: same manifold (unit arm vectors)
+    np.testing.assert_allclose(
+        bundle.y_train[:, 0] ** 2 + bundle.y_train[:, 1] ** 2, 1.0, rtol=1e-5
+    )
+
+
+# --------------------------------------------------------------------- chaos
+@pytest.mark.parametrize("system", ["logistic", "henon", "ikeda"])
+def test_chaos_maps_stay_on_attractor(system):
+    data = generate_data(system, number_iterations=5000, number_skip_iterations=500, seed=1)
+    assert data.shape == (5000, 1 if system == "logistic" else 2)
+    assert np.all(np.isfinite(data))
+    # bounded attractors
+    assert np.abs(data).max() < 10.0
+    # chaotic: not collapsed to a fixed point
+    assert np.std(data[-100:], axis=0).max() > 1e-2
+
+
+def test_logistic_map_recurrence_exact():
+    data = generate_data("logistic", number_iterations=100, number_skip_iterations=0, seed=3)
+    x = data[:, 0]
+    np.testing.assert_allclose(x[1:], 3.7115 * x[:-1] * (1 - x[:-1]), rtol=1e-10)
+
+
+def test_known_entropy_rates_table():
+    assert ENTROPY_RATE_BITS == {"logistic": 0.5203, "henon": 0.6048, "ikeda": 0.726}
+
+
+# ----------------------------------------------------------------- amorphous
+def test_per_particle_features_layout(rng):
+    pos = rng.normal(size=(30, 2)).astype(np.float32)
+    typ = rng.integers(1, 3, size=30)
+    feats = per_particle_features(pos, typ, number_particles_to_use=20)
+    assert feats.shape == (20, 12)
+    # radius column (index 4) must be sorted ascending after clipping
+    assert np.all(np.diff(feats[:, 4]) >= 0)
+    # one-hot columns sum to 1
+    np.testing.assert_allclose(feats[:, 10] + feats[:, 11], 1.0)
+
+
+def test_amorphous_particles_bundle():
+    bundle = get_dataset("amorphous_particles", num_synthetic_neighborhoods=64,
+                         number_particles_to_use=16)
+    sets = bundle.extras["sets_train"]
+    assert sets.ndim == 3 and sets.shape[1:] == (16, 12)
+    assert bundle.x_train.shape == (sets.shape[0], 16 * 12)
+    assert set(np.unique(bundle.y_train)) <= {0.0, 1.0}
+    # planted signal: labels not all identical
+    assert 0.05 < bundle.y_train.mean() < 0.95
+
+
+def test_amorphous_radial_shells_bundle():
+    bundle = get_dataset("amorphous_radial_shells", num_synthetic_neighborhoods=64,
+                         num_shells=6)
+    assert bundle.feature_dimensionalities == [1] * 12
+    assert bundle.x_train.shape[-1] == 12
+    assert np.all(bundle.x_train >= 0)  # densities
+
+
+# ------------------------------------------------------------------- tabular
+def test_tabular_preprocessor_quantile_and_onehot(rng):
+    import pandas as pd
+
+    df = pd.DataFrame({
+        "a": rng.normal(size=200),
+        "b": rng.exponential(size=200),
+        "c": rng.choice(["x", "y", "z"], size=200),
+    })
+    y = rng.normal(size=200)
+    prep = TabularPreprocessor(cat_features=("c",), y_normalize=True).fit(df, y)
+    x_t, y_t = prep.transform(df, y)
+    assert x_t.shape == (200, 5)  # a, b, 3x onehot
+    assert prep.feature_dimensionalities_ == [1, 1, 3]
+    assert abs(float(np.mean(y_t))) < 1e-6
+    # quantile-normal output: roughly standard normal for continuous cols
+    assert abs(float(np.std(x_t[:, 0])) - 1.0) < 0.2
+
+
+@pytest.mark.parametrize("name", ["wine", "bikeshare", "mice_protein", "credit",
+                                  "support2", "microsoft"])
+def test_tabular_bundles_synthesize_without_files(name, tmp_path):
+    bundle = get_dataset(name, data_path=str(tmp_path))
+    assert bundle.extras["source"] == "synthetic"
+    assert bundle.x_train.shape[0] > 100
+    assert bundle.x_train.dtype == np.float32
+    assert bundle.number_features == len(bundle.feature_dimensionalities)
+    if bundle.loss == "sparse_ce":
+        assert bundle.output_dimensionality >= 2

@@ -1,0 +1,169 @@
+"""Validation of the log-space float32 MI sandwich bounds.
+
+Three layers of defense, mirroring the reference's characterization notebook
+(estimator vs Monte Carlo / analytic ground truth) plus a direct float64 oracle
+for the exact reference algorithm (reference utils.py:36-65):
+  1. numerical parity: f32 log-space == f64 density-space oracle on shared samples
+  2. invariants: lower <= upper; lower <= log(batch)
+  3. ground truth: well-separated k-bit discrete X transmits exactly k bits
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dib_tpu.ops import mi_sandwich_from_params, mi_sandwich_bounds, mi_sandwich_probe
+from dib_tpu.ops.gaussian import reparameterize
+
+
+def _f64_reference_bounds(u, mus, logvars):
+    """Float64 oracle implementing the reference's density-space algorithm
+    (utils.py:48-64): explicit p(u_i|x_j) matrix, mean over rows, diagonal
+    zeroed for the LOO bound but still divided by B."""
+    u = u.astype(np.float64)
+    mus = mus.astype(np.float64)
+    logvars = logvars.astype(np.float64)
+    B, d = mus.shape
+    stddevs = np.exp(logvars / 2.0)
+    z = (u[:, None, :] - mus[None, :, :]) / stddevs[None, :, :]
+    p = np.exp(-np.sum(z**2, -1) / 2.0 - np.sum(logvars, -1)[None, :] / 2.0)
+    p = p / (2.0 * np.pi) ** (d / 2.0)
+    p_ii = np.diagonal(p)
+    lower = np.mean(np.log(p_ii / np.mean(p, axis=1)))
+    p_off = p * (1.0 - np.eye(B))
+    upper = np.mean(np.log(p_ii / np.mean(p_off, axis=1)))
+    return lower, upper
+
+
+def test_f32_logspace_matches_f64_density_space(rng):
+    """The precision design question from SURVEY.md section 7: log-space f32
+    must match the reference's f64 result to well under 0.01 bits."""
+    B, d = 256, 32
+    mus = rng.normal(scale=2.0, size=(B, d)).astype(np.float32)
+    logvars = rng.normal(scale=1.0, size=(B, d)).astype(np.float32) - 1.0
+    key = jax.random.key(0)
+    u = np.asarray(reparameterize(key, jnp.array(mus), jnp.array(logvars)))
+
+    want_lower, want_upper = _f64_reference_bounds(u, mus, logvars)
+    # recompute through the jitted path with the same key => same sample
+    got_lower, got_upper = mi_sandwich_from_params(key, jnp.array(mus), jnp.array(logvars))
+    assert abs(float(got_lower) - want_lower) / np.log(2) < 1e-3  # < 0.001 bits
+    assert abs(float(got_upper) - want_upper) / np.log(2) < 1e-3
+
+
+def test_f32_logspace_survives_extreme_separation(rng):
+    """Densities that underflow f32 (and even f64) in density space: log space
+    must stay finite and ordered."""
+    B, d = 64, 16
+    mus = (rng.integers(0, 2, size=(B, 1)) * 2 - 1) * 50.0  # +-50, huge separation
+    mus = np.concatenate([mus, np.zeros((B, d - 1))], -1).astype(np.float32)
+    logvars = np.full((B, d), -6.0, dtype=np.float32)
+    lower, upper = mi_sandwich_from_params(jax.random.key(1), jnp.array(mus), jnp.array(logvars))
+    assert np.isfinite(float(lower)) and np.isfinite(float(upper))
+    assert float(lower) <= float(upper) + 1e-5
+
+
+def test_bound_ordering_and_log_batch_cap(rng):
+    B, d = 128, 8
+    for seed in range(3):
+        mus = rng.normal(scale=1.5, size=(B, d)).astype(np.float32)
+        logvars = rng.normal(scale=0.5, size=(B, d)).astype(np.float32)
+        lower, upper = mi_sandwich_from_params(jax.random.key(seed), jnp.array(mus), jnp.array(logvars))
+        assert float(lower) <= float(upper) + 1e-5
+        assert float(lower) <= np.log(B) + 1e-5  # InfoNCE <= log batch size
+
+
+@pytest.mark.parametrize("bits", [1, 2])
+def test_exact_mi_recovery_discrete_channel(bits):
+    """Characterization-notebook style ground truth: X uniform over 2^bits
+    well-separated centers with tiny variance transmits exactly `bits` bits."""
+    B, d = 1024, 8
+    rng = np.random.default_rng(42)
+    centers = np.array(np.meshgrid(*[[-4.0, 4.0]] * bits)).reshape(bits, -1).T  # [2^bits, bits]
+    x_ids = rng.integers(0, centers.shape[0], size=B)
+    mus = np.concatenate([centers[x_ids], np.zeros((B, d - bits))], -1).astype(np.float32)
+    logvars = np.zeros((B, d), dtype=np.float32)
+
+    lowers, uppers = [], []
+    for seed in range(8):
+        lo, up = mi_sandwich_from_params(jax.random.key(seed), jnp.array(mus), jnp.array(logvars))
+        lowers.append(float(lo))
+        uppers.append(float(up))
+    lower_bits = np.mean(lowers) / np.log(2)
+    upper_bits = np.mean(uppers) / np.log(2)
+    assert lower_bits == pytest.approx(bits, abs=0.05)
+    assert upper_bits == pytest.approx(bits, abs=0.05)
+    # sandwich tightness: the reference claims ~0.01-bit gaps (boolean nb cell 6)
+    assert upper_bits - lower_bits < 0.02
+
+
+def test_zero_information_channel():
+    """Identical Gaussians for every x => I = 0; LOO upper also ~0."""
+    B, d = 512, 4
+    mus = np.zeros((B, d), dtype=np.float32)
+    logvars = np.zeros((B, d), dtype=np.float32)
+    lower, upper = mi_sandwich_from_params(jax.random.key(3), jnp.array(mus), jnp.array(logvars))
+    assert abs(float(lower)) < 0.02
+    assert abs(float(upper)) < 0.02
+
+
+def test_row_block_equals_unblocked(rng):
+    B, d = 128, 8
+    mus = rng.normal(size=(B, d)).astype(np.float32)
+    logvars = rng.normal(scale=0.3, size=(B, d)).astype(np.float32)
+    key = jax.random.key(5)
+    full = mi_sandwich_from_params(key, jnp.array(mus), jnp.array(logvars))
+    blocked = mi_sandwich_from_params(key, jnp.array(mus), jnp.array(logvars), row_block=32)
+    np.testing.assert_allclose(float(full[0]), float(blocked[0]), rtol=1e-5)
+    np.testing.assert_allclose(float(full[1]), float(blocked[1]), rtol=1e-5)
+
+
+def test_mi_sandwich_bounds_encoder_contract(rng):
+    """End-to-end averaging path with an encode_fn, 1-bit channel."""
+    data = np.array([[-1.0], [1.0]] * 256, dtype=np.float32)
+
+    def encode_fn(batch):
+        mus = jnp.concatenate([batch * 4.0, jnp.zeros((batch.shape[0], 7))], -1)
+        return mus, jnp.zeros_like(mus)
+
+    lower, upper = mi_sandwich_bounds(
+        encode_fn, jnp.array(data), jax.random.key(0),
+        evaluation_batch_size=256, number_evaluation_batches=4,
+    )
+    assert float(lower) / np.log(2) == pytest.approx(1.0, abs=0.05)
+    assert float(upper) / np.log(2) == pytest.approx(1.0, abs=0.05)
+
+
+def test_probe_bounds_match_symmetric_case(rng):
+    """When probes ARE the data batch (same key => same sample), the probe
+    variant's LOO denominator (mean over the N data densities, which then
+    include the self term once) is *identical* to the symmetric InfoNCE
+    denominator — so probe-upper must equal symmetric-lower exactly. The probe
+    InfoNCE counts the self term twice in its N+1-term denominator, so it sits
+    slightly below."""
+    B, d = 256, 8
+    mus = rng.normal(scale=2.0, size=(B, d)).astype(np.float32)
+    logvars = np.full((B, d), -1.0, dtype=np.float32)
+    key = jax.random.key(9)
+    lower_sym, _ = mi_sandwich_from_params(key, jnp.array(mus), jnp.array(logvars))
+    lower_p, upper_p = mi_sandwich_probe(
+        key, jnp.array(mus), jnp.array(logvars), jnp.array(mus), jnp.array(logvars)
+    )
+    assert lower_p.shape == (B,)
+    np.testing.assert_allclose(float(jnp.mean(upper_p)), float(lower_sym), rtol=1e-5)
+    assert float(jnp.mean(lower_p)) <= float(jnp.mean(upper_p)) + 1e-5
+
+
+def test_probe_bounds_ordering(rng):
+    M, N, d = 50, 200, 8
+    probe_mus = rng.normal(scale=2.0, size=(M, d)).astype(np.float32)
+    probe_logvars = np.full((M, d), -2.0, dtype=np.float32)
+    data_mus = rng.normal(scale=2.0, size=(N, d)).astype(np.float32)
+    data_logvars = np.full((N, d), -2.0, dtype=np.float32)
+    lower, upper = mi_sandwich_probe(
+        jax.random.key(2),
+        jnp.array(probe_mus), jnp.array(probe_logvars),
+        jnp.array(data_mus), jnp.array(data_logvars),
+    )
+    assert np.all(np.asarray(lower) <= np.asarray(upper) + 1e-5)

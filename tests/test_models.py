@@ -1,0 +1,160 @@
+"""Model-layer tests: vmapped encoder bank equivalence, DIB model contract,
+set transformer invariances, measurement stack shapes."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dib_tpu.models import (
+    DistributedIBModel,
+    FeatureEncoderBank,
+    SimpleBinaryEncoderBank,
+    SetTransformer,
+    MeasurementStack,
+    pad_and_stack_features,
+)
+
+
+def test_pad_and_stack_ragged(rng):
+    x = jnp.array(rng.normal(size=(5, 6)).astype(np.float32))
+    stacked = pad_and_stack_features(x, [2, 1, 2, 1])
+    assert stacked.shape == (4, 5, 2)
+    np.testing.assert_array_equal(np.asarray(stacked[0]), np.asarray(x[:, :2]))
+    np.testing.assert_array_equal(np.asarray(stacked[1, :, 0]), np.asarray(x[:, 2]))
+    np.testing.assert_array_equal(np.asarray(stacked[1, :, 1]), 0.0)  # padding
+    np.testing.assert_array_equal(np.asarray(stacked[3, :, 0]), np.asarray(x[:, 5]))
+
+
+def test_encoder_bank_shapes_and_independence(rng):
+    """Each feature must have its OWN parameters: encoding feature i must not
+    change when another feature's input changes."""
+    bank = FeatureEncoderBank(feature_dimensionalities=(2, 1), hidden=(16,), embedding_dim=4)
+    key = jax.random.key(0)
+    x = jnp.array(rng.normal(size=(6, 3)).astype(np.float32))
+    params = bank.init(key, x)
+    mus, logvars = bank.apply(params, x)
+    assert mus.shape == (2, 6, 4) and logvars.shape == (2, 6, 4)
+
+    x2 = x.at[:, 2].set(99.0)  # perturb only feature 1
+    mus2, _ = bank.apply(params, x2)
+    np.testing.assert_array_equal(np.asarray(mus[0]), np.asarray(mus2[0]))
+    assert not np.allclose(np.asarray(mus[1]), np.asarray(mus2[1]))
+
+
+def test_encoder_bank_params_differ_across_features(rng):
+    """Stacked init must give each feature different weights (split rngs)."""
+    bank = FeatureEncoderBank(feature_dimensionalities=(1, 1), hidden=(8,), embedding_dim=2)
+    params = bank.init(jax.random.key(0), jnp.ones((2, 2)))
+    leaves = jax.tree.leaves(params)
+    kernels = [l for l in leaves if l.ndim >= 3]  # stacked kernels [F, in, out]
+    assert kernels
+    for leaf in kernels:
+        assert not np.allclose(np.asarray(leaf[0]), np.asarray(leaf[1]))
+
+
+def test_encode_single_matches_bank(rng):
+    bank = FeatureEncoderBank(feature_dimensionalities=(2, 1, 3), hidden=(8,), embedding_dim=4)
+    x = jnp.array(rng.normal(size=(5, 6)).astype(np.float32))
+    params = bank.init(jax.random.key(0), x)
+    mus_all, logvars_all = bank.apply(params, x)
+    for f, (start, dim) in enumerate([(0, 2), (2, 1), (3, 3)]):
+        mus_f, logvars_f = bank.encode_single(params, f, x[:, start : start + dim])
+        np.testing.assert_allclose(np.asarray(mus_f), np.asarray(mus_all[f]), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(logvars_f), np.asarray(logvars_all[f]), rtol=1e-6)
+
+
+def test_dib_model_contract(rng):
+    model = DistributedIBModel(
+        feature_dimensionalities=(2, 1, 2, 1),
+        encoder_hidden=(16,),
+        integration_hidden=(32,),
+        output_dim=6,
+        embedding_dim=8,
+    )
+    key = jax.random.key(0)
+    x = jnp.array(rng.normal(size=(4, 6)).astype(np.float32))
+    params = model.init(key, x, key)
+    pred, aux = model.apply(params, x, key)
+    assert pred.shape == (4, 6)
+    assert aux["kl_per_feature"].shape == (4,)
+    assert aux["mus"].shape == (4, 4, 8)
+    assert aux["embeddings"].shape == (4, 32)
+    assert np.all(np.asarray(aux["kl_per_feature"]) >= 0)
+
+
+def test_dib_model_sample_flag(rng):
+    model = DistributedIBModel(
+        feature_dimensionalities=(1, 1), encoder_hidden=(8,),
+        integration_hidden=(8,), output_dim=1, embedding_dim=2,
+    )
+    key = jax.random.key(0)
+    x = jnp.ones((3, 2))
+    params = model.init(key, x, key)
+    det1, _ = model.apply(params, x, jax.random.key(1), sample=False)
+    det2, _ = model.apply(params, x, jax.random.key(2), sample=False)
+    np.testing.assert_array_equal(np.asarray(det1), np.asarray(det2))
+    s1, _ = model.apply(params, x, jax.random.key(1))
+    s2, _ = model.apply(params, x, jax.random.key(2))
+    assert not np.allclose(np.asarray(s1), np.asarray(s2))
+
+
+def test_logvar_offset_shifts_output(rng):
+    kw = dict(feature_dimensionalities=(1,), hidden=(8,), embedding_dim=2)
+    x = jnp.ones((3, 1))
+    bank0 = FeatureEncoderBank(**kw, logvar_offset=0.0)
+    bank3 = FeatureEncoderBank(**kw, logvar_offset=-3.0)
+    params = bank0.init(jax.random.key(0), x)
+    _, lv0 = bank0.apply(params, x)
+    _, lv3 = bank3.apply(params, x)
+    np.testing.assert_allclose(np.asarray(lv3), np.asarray(lv0) - 3.0, rtol=1e-6)
+
+
+def test_simple_binary_encoder_bank():
+    bank = SimpleBinaryEncoderBank(num_features=3)
+    x = jnp.array([[1.0, -1.0, 1.0], [-1.0, 1.0, -1.0]])
+    params = bank.init(jax.random.key(0), x)
+    mus, logvars = bank.apply(params, x)
+    assert mus.shape == (3, 2, 1)
+    # init: mu_scale = 1 => mus == inputs; logvar == -3
+    np.testing.assert_allclose(np.asarray(mus[:, :, 0]), np.asarray(x.T), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(logvars), -3.0, rtol=1e-6)
+
+
+def test_set_transformer_permutation_invariance(rng):
+    st = SetTransformer(num_blocks=2, num_heads=2, key_dim=8, model_dim=8,
+                        ff_hidden=(16,), head_hidden=(16,), output_dim=1)
+    x = jnp.array(rng.normal(size=(2, 10, 8)).astype(np.float32))
+    params = st.init(jax.random.key(0), x)
+    out = st.apply(params, x)
+    perm = jnp.array(rng.permutation(10))
+    out_perm = st.apply(params, x[:, perm])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out_perm), rtol=1e-4, atol=1e-5)
+
+
+def test_measurement_stack_contract(rng):
+    ms = MeasurementStack(ib_embedding_dim=4, alphabet_size=3, num_states=5, infonce_dim=8,
+                          encoder_hidden=(16,), vq_hidden=(16,),
+                          aggregator_hidden=(16,), reference_hidden=(16,))
+    key = jax.random.key(0)
+    states = jnp.array(rng.normal(size=(4, 5, 2)).astype(np.float32))
+    params = ms.init(key, states, key)
+    seq_emb, ref_emb, kl, soft = ms.apply(params, states, key)
+    assert seq_emb.shape == (4, 8) and ref_emb.shape == (4, 8)
+    assert float(kl) >= 0
+    assert soft.shape == (4, 5, 3)
+    np.testing.assert_allclose(np.asarray(soft.sum(-1)), 1.0, rtol=1e-5)
+
+
+def test_measurement_symbolize_deterministic(rng):
+    ms = MeasurementStack(ib_embedding_dim=4, alphabet_size=2, num_states=3, infonce_dim=8,
+                          encoder_hidden=(8,), vq_hidden=(8,),
+                          aggregator_hidden=(8,), reference_hidden=(8,))
+    key = jax.random.key(0)
+    states = jnp.array(rng.normal(size=(2, 3, 2)).astype(np.float32))
+    params = ms.init(key, states, key)
+    flat = jnp.array(rng.normal(size=(20, 2)).astype(np.float32))
+    s1 = ms.apply(params, flat, jax.random.key(5), num_noise_draws=16, method="symbolize")
+    s2 = ms.apply(params, flat, jax.random.key(5), num_noise_draws=16, method="symbolize")
+    np.testing.assert_array_equal(np.asarray(s1), np.asarray(s2))
+    assert s1.shape == (20,) and s1.dtype == np.uint8

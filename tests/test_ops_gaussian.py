@@ -1,0 +1,143 @@
+"""Unit tests for dib_tpu.ops.gaussian against independent float64 NumPy/SciPy oracles."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dib_tpu.ops import (
+    kl_diagonal_gaussian,
+    reparameterize,
+    bhattacharyya_dist_mat,
+    kl_divergence_mat,
+    gaussian_log_density_mat,
+)
+
+
+def _np_kl_to_unit(mu, logvar):
+    return 0.5 * np.sum(mu**2 + np.exp(logvar) - logvar - 1.0, axis=-1)
+
+
+def test_kl_diagonal_gaussian_matches_f64_closed_form(rng):
+    mu = rng.normal(size=(16, 8)).astype(np.float32)
+    logvar = rng.normal(scale=0.5, size=(16, 8)).astype(np.float32)
+    got = np.asarray(kl_diagonal_gaussian(jnp.array(mu), jnp.array(logvar)))
+    want = _np_kl_to_unit(mu.astype(np.float64), logvar.astype(np.float64))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_kl_zero_at_prior():
+    mu = jnp.zeros((4, 8))
+    logvar = jnp.zeros((4, 8))
+    np.testing.assert_allclose(np.asarray(kl_diagonal_gaussian(mu, logvar)), 0.0, atol=1e-7)
+
+
+def test_reparameterize_statistics():
+    key = jax.random.key(0)
+    mu = jnp.full((20000, 2), 1.5)
+    logvar = jnp.full((20000, 2), np.log(0.25))
+    samples = np.asarray(reparameterize(key, mu, logvar))
+    np.testing.assert_allclose(samples.mean(axis=0), 1.5, atol=0.02)
+    np.testing.assert_allclose(samples.std(axis=0), 0.5, atol=0.02)
+
+
+def test_reparameterize_deterministic_per_key():
+    key = jax.random.key(7)
+    mu = jnp.ones((4, 3))
+    logvar = jnp.zeros((4, 3))
+    a = reparameterize(key, mu, logvar)
+    b = reparameterize(key, mu, logvar)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def _np_bhattacharyya(mus1, logvars1, mus2, logvars2):
+    """Independent float64 oracle, elementwise loops (no broadcasting tricks)."""
+    n, m = mus1.shape[0], mus2.shape[0]
+    out = np.zeros((n, m))
+    for i in range(n):
+        for j in range(m):
+            v1, v2 = np.exp(logvars1[i]), np.exp(logvars2[j])
+            vbar = 0.5 * (v1 + v2)
+            diff = mus1[i] - mus2[j]
+            t1 = 0.125 * np.sum(diff**2 / vbar)
+            t2 = 0.5 * np.log(np.prod(vbar) / np.sqrt(np.prod(v1) * np.prod(v2)))
+            out[i, j] = t1 + t2
+    return out
+
+
+def _np_kl_mat(mus1, logvars1, mus2, logvars2):
+    n, m, d = mus1.shape[0], mus2.shape[0], mus1.shape[1]
+    out = np.zeros((n, m))
+    for i in range(n):
+        for j in range(m):
+            v1, v2 = np.exp(logvars1[i]), np.exp(logvars2[j])
+            diff = mus2[j] - mus1[i]
+            out[i, j] = 0.5 * (
+                np.sum(v1 / v2) + np.sum(diff**2 / v2) - d + np.sum(logvars2[j]) - np.sum(logvars1[i])
+            )
+    return out
+
+
+@pytest.mark.parametrize("n,m,d", [(5, 7, 3), (1, 4, 2), (6, 1, 5)])
+def test_bhattacharyya_matches_oracle(rng, n, m, d):
+    mus1 = rng.normal(size=(n, d))
+    logvars1 = rng.normal(scale=0.7, size=(n, d))
+    mus2 = rng.normal(size=(m, d))
+    logvars2 = rng.normal(scale=0.7, size=(m, d))
+    got = np.asarray(
+        bhattacharyya_dist_mat(*(jnp.array(a, dtype=jnp.float32) for a in (mus1, logvars1, mus2, logvars2)))
+    )
+    want = _np_bhattacharyya(mus1, logvars1, mus2, logvars2)
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=2e-4)
+
+
+def test_bhattacharyya_zero_on_identical_gaussians(rng):
+    mus = rng.normal(size=(4, 3))
+    logvars = rng.normal(size=(4, 3))
+    mat = np.asarray(
+        bhattacharyya_dist_mat(*(jnp.array(a, dtype=jnp.float32) for a in (mus, logvars, mus, logvars)))
+    )
+    np.testing.assert_allclose(np.diagonal(mat), 0.0, atol=1e-4)
+
+
+@pytest.mark.parametrize("n,m,d", [(5, 7, 3), (3, 3, 4)])
+def test_kl_divergence_mat_matches_oracle(rng, n, m, d):
+    mus1 = rng.normal(size=(n, d))
+    logvars1 = rng.normal(scale=0.7, size=(n, d))
+    mus2 = rng.normal(size=(m, d))
+    logvars2 = rng.normal(scale=0.7, size=(m, d))
+    got = np.asarray(
+        kl_divergence_mat(*(jnp.array(a, dtype=jnp.float32) for a in (mus1, logvars1, mus2, logvars2)))
+    )
+    want = _np_kl_mat(mus1, logvars1, mus2, logvars2)
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=2e-4)
+
+
+def test_kl_divergence_mat_diag_vs_prior_formula(rng):
+    """KL matrix against the unit normal must reduce to the bottleneck KL."""
+    mus = rng.normal(size=(6, 4)).astype(np.float32)
+    logvars = rng.normal(scale=0.5, size=(6, 4)).astype(np.float32)
+    mat = kl_divergence_mat(
+        jnp.array(mus), jnp.array(logvars), jnp.zeros((1, 4)), jnp.zeros((1, 4))
+    )
+    direct = kl_diagonal_gaussian(jnp.array(mus), jnp.array(logvars))
+    np.testing.assert_allclose(np.asarray(mat[:, 0]), np.asarray(direct), rtol=1e-5)
+
+
+def test_gaussian_log_density_matches_scipy(rng):
+    from scipy.stats import multivariate_normal
+
+    u = rng.normal(size=(4, 3))
+    mus = rng.normal(size=(5, 3))
+    logvars = rng.normal(scale=0.5, size=(5, 3))
+    got = np.asarray(
+        gaussian_log_density_mat(
+            jnp.array(u, dtype=jnp.float32),
+            jnp.array(mus, dtype=jnp.float32),
+            jnp.array(logvars, dtype=jnp.float32),
+        )
+    )
+    for i in range(4):
+        for j in range(5):
+            want = multivariate_normal.logpdf(u[i], mean=mus[j], cov=np.diag(np.exp(logvars[j])))
+            np.testing.assert_allclose(got[i, j], want, rtol=1e-4)

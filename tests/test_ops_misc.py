@@ -1,0 +1,185 @@
+"""Tests for positional encoding, schedules, similarities, and entropy helpers."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dib_tpu.ops import (
+    positional_encoding,
+    positional_encoding_frequencies,
+    posenc_output_dim,
+    log_annealed_beta,
+    beta_grid,
+    linear_warmup,
+    pairwise_sqeuclidean,
+    pairwise_l1,
+    pairwise_linf,
+    scaled_similarity,
+    symmetric_infonce,
+    entropy_bits,
+    sequence_entropy_bits,
+    mutual_information_bits,
+    entropy_rate_scaling_ansatz,
+    LN2,
+)
+
+
+# ---------------------------------------------------------------- posenc
+def test_posenc_frequencies_reference_convention():
+    # reference models.py:70 -> 2**np.arange(1, 5) == [2, 4, 8, 16]
+    freqs = positional_encoding_frequencies(4, start_power=1)
+    np.testing.assert_array_equal(freqs, [2.0, 4.0, 8.0, 16.0])
+    # chaos notebook cell 3 -> 2**np.arange(10) starts at 1
+    freqs = positional_encoding_frequencies(3, start_power=0)
+    np.testing.assert_array_equal(freqs, [1.0, 2.0, 4.0])
+
+
+def test_posenc_shape_and_values(rng):
+    x = rng.normal(size=(7, 3)).astype(np.float32)
+    freqs = [2.0, 4.0]
+    out = np.asarray(positional_encoding(jnp.array(x), freqs))
+    assert out.shape == (7, posenc_output_dim(3, 2))
+    np.testing.assert_allclose(out[:, :3], x, rtol=1e-6)
+    # frequency-major grouping: [x, sin(2x), sin(4x)]
+    np.testing.assert_allclose(out[:, 3:6], np.sin(2.0 * x), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(out[:, 6:9], np.sin(4.0 * x), rtol=1e-5, atol=1e-6)
+
+
+def test_posenc_zero_padding_stays_zero():
+    x = jnp.zeros((4, 2))
+    out = np.asarray(positional_encoding(x, [2.0, 4.0, 8.0]))
+    np.testing.assert_array_equal(out, 0.0)
+
+
+def test_posenc_no_frequencies_identity(rng):
+    x = rng.normal(size=(4, 2)).astype(np.float32)
+    out = np.asarray(positional_encoding(jnp.array(x), []))
+    np.testing.assert_array_equal(out, x)
+
+
+# ---------------------------------------------------------------- schedules
+def test_beta_schedule_endpoints_and_pretraining():
+    b0, b1, pre, ann = 1e-4, 3.0, 10, 100
+    assert np.isclose(float(log_annealed_beta(0, b0, b1, ann, pre)), b0)
+    assert np.isclose(float(log_annealed_beta(pre, b0, b1, ann, pre)), b0)
+    assert np.isclose(float(log_annealed_beta(pre + ann, b0, b1, ann, pre)), b1, rtol=1e-5)
+    # log-linear midpoint
+    mid = float(log_annealed_beta(pre + ann // 2, b0, b1, ann, pre))
+    assert np.isclose(np.log(mid), 0.5 * (np.log(b0) + np.log(b1)), rtol=1e-5)
+
+
+def test_beta_schedule_matches_reference_formula():
+    # reference models.py:147-149: exp(log b0 + max(e-pre,0)/N * (log b1 - log b0))
+    b0, b1, pre, ann = 2e-6, 2e-1, 3, 50
+    for epoch in [0, 2, 3, 10, 37, 53]:
+        want = np.exp(
+            np.log(b0) + max(epoch - pre, 0) / ann * (np.log(b1) - np.log(b0))
+        )
+        got = float(log_annealed_beta(epoch, b0, b1, ann, pre))
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_beta_schedule_downward():
+    # chaos notebook cell 10: beta ramps DOWN 10 -> 1e-4
+    assert float(log_annealed_beta(0, 10.0, 1e-4, 100)) == pytest.approx(10.0)
+    assert float(log_annealed_beta(100, 10.0, 1e-4, 100)) == pytest.approx(1e-4, rel=1e-4)
+    assert float(log_annealed_beta(200, 10.0, 1e-4, 100)) == pytest.approx(1e-4, rel=1e-4)
+
+
+def test_beta_grid_log_spacing():
+    grid = np.asarray(beta_grid(1e-4, 1.0, 5))
+    np.testing.assert_allclose(np.diff(np.log(grid)), np.log(10.0), rtol=1e-5)
+
+
+def test_beta_schedule_vmaps_over_phase_grid():
+    steps = jnp.arange(5) * 25
+    betas = jax.vmap(lambda s: log_annealed_beta(s, 1e-3, 1.0, 100))(steps)
+    assert betas.shape == (5,)
+    assert float(betas[0]) < float(betas[-1])
+
+
+def test_linear_warmup():
+    assert float(linear_warmup(0, 1e-4, 100)) == 0.0
+    assert float(linear_warmup(50, 1e-4, 100)) == pytest.approx(5e-5)
+    assert float(linear_warmup(1000, 1e-4, 100)) == pytest.approx(1e-4)
+
+
+# ---------------------------------------------------------------- similarity
+def test_pairwise_distances_match_numpy(rng):
+    a = rng.normal(size=(6, 4)).astype(np.float32)
+    b = rng.normal(size=(9, 4)).astype(np.float32)
+    diff = a[:, None, :] - b[None, :, :]
+    np.testing.assert_allclose(
+        np.asarray(pairwise_sqeuclidean(jnp.array(a), jnp.array(b))),
+        np.sum(diff**2, -1), rtol=1e-4, atol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(pairwise_l1(jnp.array(a), jnp.array(b))),
+        np.sum(np.abs(diff), -1), rtol=1e-5,
+    )
+    np.testing.assert_allclose(
+        np.asarray(pairwise_linf(jnp.array(a), jnp.array(b))),
+        np.max(np.abs(diff), -1), rtol=1e-5,
+    )
+
+
+@pytest.mark.parametrize("sim_type", ["l2sq", "l2", "l1", "linf", "cosine"])
+def test_scaled_similarity_types(rng, sim_type):
+    a = rng.normal(size=(5, 3)).astype(np.float32)
+    b = rng.normal(size=(5, 3)).astype(np.float32)
+    sim = np.asarray(scaled_similarity(jnp.array(a), jnp.array(b), sim_type, temperature=2.0))
+    assert sim.shape == (5, 5)
+    if sim_type != "cosine":
+        assert np.all(sim <= 1e-5)  # negated distances
+
+
+def test_scaled_similarity_unknown_type_raises(rng):
+    with pytest.raises(ValueError):
+        scaled_similarity(jnp.ones((2, 2)), jnp.ones((2, 2)), "hamming", 1.0)
+
+
+def test_symmetric_infonce_perfect_alignment_lower_than_random(rng):
+    e = jnp.array(rng.normal(size=(16, 8)).astype(np.float32))
+    shuffled = e[jnp.array(rng.permutation(16))]
+    aligned = float(symmetric_infonce(e * 10, e * 10, "l2sq"))
+    misaligned = float(symmetric_infonce(e * 10, shuffled * 10, "l2sq"))
+    assert aligned < misaligned
+    # with perfectly separable embeddings, loss -> 0
+    assert aligned < 0.01
+
+
+def test_symmetric_infonce_bounded_by_log_batch(rng):
+    e1 = jnp.array(rng.normal(size=(32, 4)).astype(np.float32))
+    e2 = jnp.array(rng.normal(size=(32, 4)).astype(np.float32))
+    loss = float(symmetric_infonce(e1, e2, "l2", halved=True))
+    # InfoNCE cross entropy can't exceed ~log B by much for random inputs
+    assert loss < 2 * np.log(32)
+
+
+# ---------------------------------------------------------------- entropy
+def test_entropy_bits_uniform():
+    assert entropy_bits([0.25] * 4) == pytest.approx(2.0)
+    assert entropy_bits([0.5, 0.5, 0.0]) == pytest.approx(1.0)
+
+
+def test_sequence_entropy_and_mi_on_xor():
+    # XOR truth table: Y = A xor B. I(A;Y)=0, I(B;Y)=0, I((A,B);Y)=1
+    a = np.array([0, 0, 1, 1])
+    b = np.array([0, 1, 0, 1])
+    y = a ^ b
+    assert sequence_entropy_bits(y) == pytest.approx(1.0)
+    assert mutual_information_bits(a, y) == pytest.approx(0.0, abs=1e-12)
+    assert mutual_information_bits(np.stack([a, b], -1), y) == pytest.approx(1.0)
+
+
+def test_entropy_rate_ansatz_limits():
+    # as N -> inf the correction vanishes
+    assert entropy_rate_scaling_ansatz(1e12, 0.52, 0.5, 1.0) == pytest.approx(0.52, abs=1e-3)
+    assert entropy_rate_scaling_ansatz(100, 0.5, 0.5, 2.0) == pytest.approx(
+        0.5 + np.log2(100) / 10.0 / 2.0
+    )
+
+
+def test_ln2_constant():
+    assert LN2 == pytest.approx(np.log(2))

@@ -1,0 +1,117 @@
+"""End-to-end slice: boolean circuit -> DistributedIBModel -> beta-annealed
+jitted training -> MI bounds, validated against the exact truth-table oracle
+(SURVEY.md section 7, milestone 6).
+
+Uses a small 3-input circuit (Fig. S1a) and short schedules so the test runs
+in seconds on CPU while still exercising every layer.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from dib_tpu.data import get_dataset, FIG_S1_CIRCUITS, exact_subset_informations
+from dib_tpu.models import DistributedIBModel
+from dib_tpu.train import DIBTrainer, TrainConfig, InfoPerFeatureHook
+from dib_tpu.ops.entropy import LN2
+
+
+@pytest.fixture(scope="module")
+def small_circuit_bundle():
+    return get_dataset("boolean_circuit", circuit_specification=FIG_S1_CIRCUITS[0])
+
+
+def test_bundle_contract(small_circuit_bundle):
+    b = small_circuit_bundle
+    assert b.x_train.shape == (8, 3)          # 2^3 truth table
+    assert set(np.unique(b.x_train)) == {-1.0, 1.0}
+    assert b.number_features == 3
+    assert b.loss == "bce" and b.loss_is_info_based
+    assert 0.0 < b.extras["entropy_y_bits"] <= 1.0
+
+
+def test_exact_subset_oracle(small_circuit_bundle):
+    """Exact MI oracle sanity: full-input subset carries all of H(Y)."""
+    b = small_circuit_bundle
+    infos = exact_subset_informations(b.extras["truth_table"], 3)
+    assert infos[(0, 1, 2)] == pytest.approx(b.extras["entropy_y_bits"], abs=1e-9)
+    assert all(v <= b.extras["entropy_y_bits"] + 1e-9 for v in infos.values())
+
+
+@pytest.fixture(scope="module")
+def trained(small_circuit_bundle):
+    bundle = small_circuit_bundle
+    model = DistributedIBModel(
+        feature_dimensionalities=tuple(bundle.feature_dimensionalities),
+        encoder_hidden=(32,),
+        integration_hidden=(64, 64),
+        output_dim=1,
+        embedding_dim=4,
+    )
+    config = TrainConfig(
+        learning_rate=1e-3,
+        batch_size=64,
+        beta_start=1e-4,
+        beta_end=2.0,
+        num_pretraining_epochs=250,
+        num_annealing_epochs=250,
+        steps_per_epoch=2,
+        max_val_points=8,
+    )
+    trainer = DIBTrainer(model, bundle, config)
+    hook = InfoPerFeatureHook(evaluation_batch_size=256, number_evaluation_batches=2)
+    state, history = trainer.fit(
+        jax.random.key(0), hooks=[hook], hook_every=125
+    )
+    return trainer, state, history, hook
+
+
+def test_training_learns_circuit(trained):
+    trainer, state, history, hook = trained
+    entropy_y = trainer.bundle.extras["entropy_y_bits"]
+    # By the end of pretraining (tiny beta) the model must fit the circuit:
+    # task BCE (bits) well below H(Y) means real predictive information.
+    h = history.to_bits()
+    assert h.loss[230:260].min() < 0.3 * entropy_y
+    assert h.metric[230:260].max() > 0.9  # train accuracy
+
+
+def test_history_semantics(trained):
+    _, _, history, _ = trained
+    assert history.beta.shape == (500,)
+    # beta flat during pretraining, then rising
+    np.testing.assert_allclose(history.beta[:250], history.beta[0], rtol=1e-5)
+    assert history.beta[-1] > history.beta[0] * 1000
+    # KL should collapse as beta ramps up hard
+    assert history.total_kl[-1] < 0.25 * history.total_kl[250]
+    # loss series is the task loss only (no beta*KL mixed in):
+    assert np.all(history.loss >= -1e-5)
+
+
+def test_mi_bounds_hook_sane(trained):
+    trainer, state, history, hook = trained
+    bounds = hook.bounds_bits                   # [T, F, 2]
+    assert bounds.shape[1] == 3 and bounds.shape[2] == 2
+    # each feature is 1 bit max; bounds ordered and within [~0, ~1]
+    assert np.all(bounds[..., 0] <= bounds[..., 1] + 1e-4)
+    assert np.all(bounds <= 1.1)
+    assert np.all(bounds >= -0.1)
+
+
+def test_ib_mode_single_bottleneck(small_circuit_bundle):
+    bundle = small_circuit_bundle.as_vanilla_ib()
+    assert bundle.feature_dimensionalities == [3]
+    model = DistributedIBModel(
+        feature_dimensionalities=(3,),
+        encoder_hidden=(16,),
+        integration_hidden=(16,),
+        output_dim=1,
+        embedding_dim=4,
+    )
+    config = TrainConfig(
+        batch_size=8, num_pretraining_epochs=3, num_annealing_epochs=3,
+        steps_per_epoch=1, max_val_points=8,
+    )
+    trainer = DIBTrainer(model, bundle, config)
+    state, history = trainer.fit(jax.random.key(1))
+    assert history.kl_per_feature.shape == (6, 1)
