@@ -163,7 +163,14 @@ def fetch_double_pendulum(
     """Predict the state ``pendulum_time_delta`` seconds ahead, features
     [2, 1, 2, 1] = (arm-1 direction, arm-1 omega, arm-2 direction, arm-2 omega)."""
     os.makedirs(data_path, exist_ok=True)
-    cache = os.path.join(data_path, "double_pendulum.npy")
+    # Cache keyed by the generation parameters so a request with a different
+    # trajectory count or seed never silently reuses a stale file.
+    cache = os.path.join(data_path, f"double_pendulum_n{num_trajectories}_s{seed}.npy")
+    legacy = os.path.join(data_path, "double_pendulum.npy")
+    if not os.path.exists(cache) and os.path.exists(legacy) and not regenerate:
+        legacy_arr = np.load(legacy)
+        if legacy_arr.shape[0] == num_trajectories:
+            cache = legacy
     if os.path.exists(cache) and not regenerate:
         data_arr = np.load(cache)
     else:

@@ -27,8 +27,7 @@ Behavior parity targets:
 
 Memory: the [B, B] (or [M, N]) log-density matrix needs a [rows, cols, d]
 broadcast intermediate. ``row_block`` chunks the row axis with ``lax.map`` so
-peak memory is [block, cols, d] — the standard TPU blocking pattern (a Pallas
-kernel is available for the fused path, see ``dib_tpu.ops.pallas_kernels``).
+peak memory is [block, cols, d] — the standard TPU blocking pattern.
 """
 
 from __future__ import annotations

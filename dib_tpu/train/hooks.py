@@ -92,12 +92,7 @@ class CompressionMatrixHook:
         raw_all = trainer.bundle.x_valid_raw
         for f in range(trainer.num_features):
             x_f = trainer.feature_data(f)
-            if raw_all is not None:
-                dims = list(trainer.bundle.feature_dimensionalities)
-                start = int(np.sum(dims[:f]))
-                raw_f = raw_all[:, start : start + dims[f]]
-            else:
-                raw_f = x_f
+            raw_f = trainer.feature_data(f, arr=raw_all) if raw_all is not None else x_f
             mus, logvars = trainer.encode_feature(state, f, jnp.asarray(x_f))
             fname = os.path.join(
                 self.outdir, f"feature_{f}_log10beta_{np.log10(beta):.3f}.png"

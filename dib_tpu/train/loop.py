@@ -263,8 +263,12 @@ class DIBTrainer:
     def encode_feature(self, state: TrainState, feature_index: int, x_feature):
         return self.model.encode_feature(state.params["model"], feature_index, x_feature)
 
-    def feature_data(self, feature_index: int, split: str = "valid") -> np.ndarray:
+    def feature_data(
+        self, feature_index: int, split: str = "valid", arr: np.ndarray | None = None
+    ) -> np.ndarray:
+        """One feature's columns, from a split or from ``arr`` (e.g. raw values)."""
         dims = list(self.bundle.feature_dimensionalities)
         start = int(np.sum(dims[:feature_index]))
-        x = self.bundle.x_valid if split == "valid" else self.bundle.x_train
-        return x[:, start : start + dims[feature_index]]
+        if arr is None:
+            arr = self.bundle.x_valid if split == "valid" else self.bundle.x_train
+        return arr[:, start : start + dims[feature_index]]

@@ -26,8 +26,15 @@ def density_mask(
 ) -> np.ndarray:
     """NaN-mask for probe points inside the region where g(r) < threshold
     (no physical particles there, so the network output is meaningless)."""
-    below = np.where(g_r < density_threshold)[0]
-    cutoff_radius = g_r_bins[below[-1]] if len(below) else 0.0
+    # The excluded-volume core is the initial contiguous run of empty bins;
+    # empty bins at large radius (beyond the sampled region) must not widen it.
+    occupied = np.where(g_r >= density_threshold)[0]
+    if len(occupied) == 0:
+        cutoff_radius = g_r_bins[-1]
+    elif occupied[0] == 0:
+        cutoff_radius = 0.0
+    else:
+        cutoff_radius = g_r_bins[occupied[0] - 1]
     radii = np.hypot(probe_positions[:, 0], probe_positions[:, 1])
     mask = np.where(radii < cutoff_radius, np.nan, 1.0)
     return mask.reshape(grid_side_length, grid_side_length)

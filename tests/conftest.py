@@ -14,6 +14,13 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
+# sitecustomize pre-imports jax with JAX_PLATFORMS=axon baked into jax.config,
+# so the env mutation above is too late for the platform choice — override the
+# already-read config value directly (backends have not initialized yet).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 # Persistent compilation cache: this box has a single CPU core, so avoiding
 # recompiles across pytest runs matters more than anything else. Use a
 # CPU-specific dir — the ambient cache dir holds AOT results from the remote
