@@ -167,9 +167,11 @@ def fetch_double_pendulum(
     # trajectory count or seed never silently reuses a stale file.
     cache = os.path.join(data_path, f"double_pendulum_n{num_trajectories}_s{seed}.npy")
     legacy = os.path.join(data_path, "double_pendulum.npy")
-    if not os.path.exists(cache) and os.path.exists(legacy) and not regenerate:
-        legacy_arr = np.load(legacy)
-        if legacy_arr.shape[0] == num_trajectories:
+    # A pre-existing un-keyed cache file is only trusted for the default seed
+    # (it carries no seed provenance) and only when its trajectory count
+    # matches; the shape probe is a header-only mmap, not a full read.
+    if not os.path.exists(cache) and os.path.exists(legacy) and not regenerate and seed == 0:
+        if np.load(legacy, mmap_mode="r").shape[0] == num_trajectories:
             cache = legacy
     if os.path.exists(cache) and not regenerate:
         data_arr = np.load(cache)

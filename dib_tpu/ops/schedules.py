@@ -40,18 +40,24 @@ def log_annealed_beta(
     identical within the scheduled range).
 
     Works for upward (b1 > b0) and downward (b1 < b0) anneals. ``step`` may be a
-    traced scalar or an array (for a grid of phases).
+    traced scalar or an array (for a grid of phases); ``beta_start``/``beta_end``
+    may be traced arrays (for a per-replica grid of endpoints in a sweep).
     """
     step = jnp.asarray(step, dtype=jnp.float32)
     progress = (step - num_pretraining_steps) / jnp.float32(max(num_annealing_steps, 1))
     progress = jnp.clip(progress, 0.0, 1.0) if clip_progress else jnp.maximum(progress, 0.0)
-    # Endpoints are static Python floats: take the log-span on the host in
-    # float64 and factor beta_start out of the exp, so beta(0) == beta_start
-    # exactly and only the exp rounds in float32 elsewhere. Taking log(beta) on
-    # device costs ~1e-4 relative at the ramp end when the log span is large
-    # (e.g. 1e-4 -> 3 spans ~10.3 nats).
-    delta = jnp.float32(math.log(beta_end) - math.log(beta_start))
-    return jnp.float32(beta_start) * jnp.exp(progress * delta)
+    if isinstance(beta_start, (int, float)) and isinstance(beta_end, (int, float)):
+        # Static endpoints: take the log-span on the host in float64 and factor
+        # beta_start out of the exp, so beta(0) == beta_start exactly and only
+        # the exp rounds in float32. Taking log(beta) on device costs ~1e-4
+        # relative at the ramp end when the log span is large (1e-4 -> 3 spans
+        # ~10.3 nats).
+        delta = jnp.float32(math.log(beta_end) - math.log(beta_start))
+    else:
+        delta = jnp.log(jnp.asarray(beta_end, jnp.float32)) - jnp.log(
+            jnp.asarray(beta_start, jnp.float32)
+        )
+    return jnp.asarray(beta_start, jnp.float32) * jnp.exp(progress * delta)
 
 
 def beta_schedule(

@@ -1,1 +1,31 @@
-"""dib_tpu.parallel (populated incrementally)."""
+"""Mesh + sweep parallelism (the reference has none; SURVEY.md section 2.3)."""
+
+from dib_tpu.parallel.mesh import (
+    BETA_AXIS,
+    DATA_AXIS,
+    batch_sharding,
+    factor_devices,
+    make_sweep_mesh,
+    replica_sharding,
+    replicate,
+    replicated_sharding,
+    shard_replicas,
+    validate_sweep_shapes,
+)
+from dib_tpu.parallel.sweep import BetaSweepTrainer, PerReplicaHook, sweep_records
+
+__all__ = [
+    "BETA_AXIS",
+    "DATA_AXIS",
+    "BetaSweepTrainer",
+    "PerReplicaHook",
+    "batch_sharding",
+    "factor_devices",
+    "make_sweep_mesh",
+    "replica_sharding",
+    "replicate",
+    "replicated_sharding",
+    "shard_replicas",
+    "sweep_records",
+    "validate_sweep_shapes",
+]

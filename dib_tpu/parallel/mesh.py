@@ -1,0 +1,109 @@
+"""Device-mesh construction and sharding helpers.
+
+The reference is a single-process, single-device codebase with no parallelism
+or communication backend of any kind (reference ``train.py:157-166``; grep
+finds no ``tf.distribute``/NCCL/MPI anywhere — SURVEY.md section 2.3). The
+TPU-native replacement is a ``jax.sharding.Mesh`` with two named axes:
+
+  - ``'beta'``: the beta-sweep axis. The reference runs one beta *schedule*
+    serially per training run and re-runs the whole script for sweeps (chaos
+    notebook cell 10 header: "loop over number_states from 2 to 15, with 20
+    repeats per"); here a sweep is a leading replica axis on params/opt-state
+    /history, sharded across devices. Embarrassingly parallel — no collectives
+    except the final history gather.
+  - ``'data'``: batch-dimension sharding within each replica. XLA inserts the
+    gradient all-reduce (psum over ICI) automatically when the batch axis of a
+    jitted computation is sharded and the loss is a mean.
+
+Multi-host note: built from ``jax.devices()`` these meshes span all hosts of a
+slice; the same code drives a v4-8 or a pod slice, with XLA routing collectives
+over ICI (and DCN across slices) — there is no user-visible transport layer.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Mapping, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+BETA_AXIS = "beta"
+DATA_AXIS = "data"
+
+
+def make_sweep_mesh(
+    num_beta: int | None = None,
+    num_data: int | None = None,
+    devices: Sequence | None = None,
+) -> Mesh:
+    """A ``(beta, data)`` mesh over the available devices.
+
+    With neither size given, all devices go to the ``beta`` axis (the sweep is
+    the embarrassingly parallel signature axis, so it is the default use of
+    chips). Sizes must multiply to at most the device count; leftover devices
+    are unused (a warning-free truncation, as in common JAX practice).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if num_beta is None and num_data is None:
+        num_beta, num_data = n, 1
+    elif num_beta is None:
+        num_beta = n // num_data
+    elif num_data is None:
+        num_data = n // num_beta
+    if num_beta < 1 or num_data < 1 or num_beta * num_data > n:
+        raise ValueError(
+            f"Mesh {num_beta}x{num_data} is not satisfiable with {n} devices"
+        )
+    grid = np.asarray(devices[: num_beta * num_data]).reshape(num_beta, num_data)
+    return Mesh(grid, (BETA_AXIS, DATA_AXIS))
+
+
+def replica_sharding(mesh: Mesh) -> NamedSharding:
+    """Leading-axis-over-'beta' sharding for stacked replica pytrees."""
+    return NamedSharding(mesh, P(BETA_AXIS))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Fully replicated sharding (e.g. for the training data arrays)."""
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """[R, B, ...] batches: replicas over 'beta', batch rows over 'data'."""
+    return NamedSharding(mesh, P(BETA_AXIS, DATA_AXIS))
+
+
+def shard_replicas(tree, mesh: Mesh):
+    """Place a stacked-replica pytree with its leading axis split over 'beta'."""
+    return jax.device_put(tree, replica_sharding(mesh))
+
+
+def replicate(tree, mesh: Mesh):
+    """Place a pytree fully replicated over the mesh."""
+    return jax.device_put(tree, replicated_sharding(mesh))
+
+
+def validate_sweep_shapes(mesh: Mesh, num_replicas: int, batch_size: int) -> None:
+    """Divisibility checks that turn opaque XLA sharding errors into messages."""
+    nb = mesh.shape[BETA_AXIS]
+    nd = mesh.shape[DATA_AXIS]
+    if num_replicas % nb:
+        raise ValueError(
+            f"num_replicas={num_replicas} not divisible by mesh beta axis {nb}"
+        )
+    if batch_size % nd:
+        raise ValueError(
+            f"batch_size={batch_size} not divisible by mesh data axis {nd}"
+        )
+
+
+def factor_devices(n: int) -> tuple[int, int]:
+    """Default (beta, data) split of ``n`` devices: the most-square factoring
+    biased toward beta (sweep parallelism first, data parallelism second)."""
+    for d in range(int(math.isqrt(n)), 0, -1):
+        if n % d == 0:
+            return n // d, d
+    return n, 1

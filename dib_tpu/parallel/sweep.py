@@ -1,0 +1,237 @@
+"""The beta-sweep trainer: a grid of DIB replicas trained as ONE jitted program.
+
+This is the framework's signature parallelism (SURVEY.md section 2.3). The
+reference anneals a single beta schedule per run (reference ``models.py:147-149``)
+and re-runs the whole script to sweep configurations (chaos notebook cell 10
+header: "loop over number_states from 2 to 15, with 20 repeats per"). Here a
+sweep is a *leading replica axis*:
+
+  - R replicas, each with its own (beta_start, beta_end) endpoints and its own
+    PRNG chain (the papers' "20 repeats per config" = repeated endpoints with
+    different seeds);
+  - params / optimizer state / history stacked [R, ...] and sharded over the
+    mesh ``'beta'`` axis — embarrassingly parallel, zero collectives until the
+    final history fetch;
+  - within each replica, batch rows sharded over the mesh ``'data'`` axis via a
+    sharding constraint inside the vmapped epoch body (``spmd_axis_name`` keeps
+    the axes composable); XLA inserts the gradient all-reduce over ICI itself.
+
+Numerical contract: a sweep replica reproduces the serial ``DIBTrainer`` run
+with the same key and endpoints exactly — same key-split structure, same epoch
+body (it literally vmaps ``DIBTrainer._epoch_body``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from dib_tpu.parallel.mesh import (
+    BETA_AXIS,
+    DATA_AXIS,
+    replica_sharding,
+    shard_replicas,
+    validate_sweep_shapes,
+)
+from dib_tpu.train.history import HistoryRecord, history_record
+from dib_tpu.train.loop import DIBTrainer, TrainConfig, TrainState
+
+Array = jax.Array
+
+
+class BetaSweepTrainer:
+    """Trains R DIB replicas over a grid of beta endpoints in one program.
+
+    Args:
+      model, bundle, config, y_encoder: as for ``DIBTrainer``.
+      beta_starts, beta_ends: [R] endpoint grids (scalars broadcast to R; the
+        common cases are a grid of end-betas with a shared start, or repeated
+        identical endpoints with different seeds).
+      mesh: optional ``(beta, data)`` mesh from ``make_sweep_mesh``. Without a
+        mesh the sweep still runs (single device, vmapped) — useful for tests
+        and small grids.
+    """
+
+    def __init__(
+        self,
+        model,
+        bundle,
+        config: TrainConfig,
+        beta_starts,
+        beta_ends,
+        mesh=None,
+        y_encoder=None,
+    ):
+        starts = jnp.atleast_1d(jnp.asarray(beta_starts, jnp.float32))
+        ends = jnp.atleast_1d(jnp.asarray(beta_ends, jnp.float32))
+        starts, ends = jnp.broadcast_arrays(starts, ends)
+        self.beta_starts = starts
+        self.beta_ends = ends
+        self.num_replicas = int(starts.shape[0])
+        self.mesh = mesh
+        self.base = DIBTrainer(model, bundle, config, y_encoder)
+        if mesh is not None:
+            validate_sweep_shapes(mesh, self.num_replicas, config.batch_size)
+            self.base.batch_constraint = NamedSharding(mesh, P(DATA_AXIS))
+            self.beta_starts = jax.device_put(
+                self.beta_starts, replica_sharding(mesh)
+            )
+            self.beta_ends = jax.device_put(self.beta_ends, replica_sharding(mesh))
+
+    # ------------------------------------------------------------------ setup
+    def init(self, keys: Array) -> tuple[TrainState, dict]:
+        """Stacked replica init from [R] PRNG keys."""
+        keys = self._check_keys(keys)
+        states, histories = jax.vmap(self.base.init)(keys)
+        if self.mesh is not None:
+            states = shard_replicas(states, self.mesh)
+            histories = shard_replicas(histories, self.mesh)
+        return states, histories
+
+    def _check_keys(self, keys: Array) -> Array:
+        keys = jnp.asarray(keys)
+        if keys.shape[0] != self.num_replicas:
+            raise ValueError(
+                f"Expected {self.num_replicas} replica keys, got {keys.shape[0]}"
+            )
+        return keys
+
+    # ------------------------------------------------------------ chunk scan
+    @partial(jax.jit, static_argnames=("self", "num_epochs"))
+    def run_chunk(self, states, histories, keys, num_epochs: int):
+        """Scan ``num_epochs`` epochs for all replicas, fully on device."""
+
+        def epoch(carry, ks):
+            states, hists = carry
+
+            def one(state, hist, k, b0, b1):
+                state, row = self.base._epoch_body(state, k, (b0, b1))
+                return state, history_record(hist, row)
+
+            states, hists = jax.vmap(
+                one, spmd_axis_name=BETA_AXIS if self.mesh is not None else None
+            )(states, hists, ks, self.beta_starts, self.beta_ends)
+            return (states, hists), None
+
+        # per-replica epoch key chains, identical in structure to the serial
+        # trainer's split(k_chunk, num_epochs)
+        epoch_keys = jax.vmap(lambda k: jax.random.split(k, num_epochs))(keys)
+        epoch_keys = jnp.moveaxis(epoch_keys, 1, 0)          # [E, R]
+        (states, histories), _ = jax.lax.scan(epoch, (states, histories), epoch_keys)
+        return states, histories
+
+    # ------------------------------------------------------------------ fit
+    def fit(
+        self,
+        keys: Array,
+        num_epochs: int | None = None,
+        hooks: Sequence[Callable] = (),
+        hook_every: int = 0,
+        states: TrainState | None = None,
+        histories: dict | None = None,
+    ) -> tuple[TrainState, list[HistoryRecord]]:
+        """Drive the sweep: jitted chunks + host hooks between them.
+
+        ``hooks`` are called as ``hook(sweep_trainer, states, epoch)``.
+        Returns the stacked final states and one ``HistoryRecord`` per replica.
+        """
+        keys = self._check_keys(keys)
+        num_epochs = self.base.config.num_epochs if num_epochs is None else num_epochs
+        if (states is None) != (histories is None):
+            raise ValueError(
+                "Resuming needs BOTH states and histories; got exactly one "
+                "(the other would be silently re-initialized)."
+            )
+        if states is None or histories is None:
+            split = jax.vmap(jax.random.split)(keys)          # [R, 2]
+            keys, init_keys = split[:, 0], split[:, 1]
+            states, histories = self.init(init_keys)
+        capacity = histories["beta"].shape[1]
+        cursor = int(np.max(jax.device_get(histories["cursor"])))
+        if cursor + num_epochs > capacity:
+            raise ValueError(
+                f"History buffer holds {capacity} epochs/replica but {cursor} are "
+                f"already recorded and {num_epochs} more were requested."
+            )
+        chunk = hook_every if (hook_every and hooks) else num_epochs
+        done = 0
+        while done < num_epochs:
+            this_chunk = min(chunk, num_epochs - done)
+            split = jax.vmap(jax.random.split)(keys)
+            keys, chunk_keys = split[:, 0], split[:, 1]
+            states, histories = self.run_chunk(states, histories, chunk_keys, this_chunk)
+            done += this_chunk
+            for hook in hooks:
+                hook(self, states, int(jax.device_get(states.epoch)[0]))
+        return states, sweep_records(histories)
+
+    # ------------------------------------------------------------ inspection
+    def replica_state(self, states: TrainState, r: int) -> TrainState:
+        """One replica's (unstacked) train state, fetched as needed."""
+        return jax.tree.map(lambda a: a[r], states)
+
+    def replica_trainer(self, r: int) -> DIBTrainer:
+        """A serial-trainer view of replica ``r`` (its own beta endpoints).
+
+        Shares the model/bundle/loss plumbing with ``self.base`` but carries
+        replica r's (beta_start, beta_end) in its config, so serial hooks that
+        read ``trainer.config`` (e.g. the compression-matrix beta label) see
+        the right schedule. Views are cached per replica."""
+        if not hasattr(self, "_replica_trainers"):
+            self._replica_trainers: dict[int, DIBTrainer] = {}
+        if r not in self._replica_trainers:
+            import copy
+            import dataclasses
+
+            view = copy.copy(self.base)
+            view.config = dataclasses.replace(
+                self.base.config,
+                beta_start=float(self.beta_starts[r]),
+                beta_end=float(self.beta_ends[r]),
+            )
+            self._replica_trainers[r] = view
+        return self._replica_trainers[r]
+
+    def encode_feature(self, states: TrainState, r: int, feature_index: int, x_feature):
+        state = self.replica_state(states, r)
+        return self.base.model.encode_feature(
+            state.params["model"], feature_index, x_feature
+        )
+
+
+class PerReplicaHook:
+    """Adapts a serial-trainer hook to sweeps: one independent instance per
+    replica, each invoked with that replica's trainer view and unstacked state.
+
+    Example (compression matrices at every beta checkpoint during a sweep —
+    the north-star instrumentation, reference ``models.py:152-186``):
+
+        hook = PerReplicaHook(lambda r: CompressionMatrixHook(f"out/replica{r}"))
+        sweep.fit(keys, hooks=[hook], hook_every=100)
+    """
+
+    def __init__(self, make_hook: Callable[[int], Callable]):
+        self.make_hook = make_hook
+        self.replica_hooks: dict[int, Callable] = {}
+
+    def __call__(self, sweep: "BetaSweepTrainer", states: TrainState, epoch: int):
+        for r in range(sweep.num_replicas):
+            if r not in self.replica_hooks:
+                self.replica_hooks[r] = self.make_hook(r)
+            hook = self.replica_hooks[r]
+            hook(sweep.replica_trainer(r), sweep.replica_state(states, r), epoch)
+
+
+def sweep_records(histories: dict) -> list[HistoryRecord]:
+    """Fetch a stacked [R, ...] history once and split into per-replica records."""
+    host = jax.device_get(histories)
+    num_replicas = int(np.asarray(host["cursor"]).shape[0])
+    return [
+        HistoryRecord.from_device(jax.tree.map(lambda a: a[r], host))
+        for r in range(num_replicas)
+    ]

@@ -86,6 +86,10 @@ class DIBTrainer:
         self.bundle = bundle
         self.config = config
         self.y_encoder = y_encoder
+        # Optional sharding constraint applied to each gathered batch. Set by
+        # the sweep trainer (dib_tpu.parallel) to shard batch rows over the
+        # mesh 'data' axis; XLA then inserts the gradient all-reduce itself.
+        self.batch_constraint = None
         self.contrastive = bundle.loss == "infonce"
         if self.contrastive and y_encoder is None:
             raise ValueError("infonce loss requires a y_encoder model")
@@ -151,10 +155,18 @@ class DIBTrainer:
         return loss, {"task": task, "kl": kl_per_feature, "metric": metric}
 
     # ------------------------------------------------------------ epoch scan
-    def _epoch_body(self, state: TrainState, key: Array) -> tuple[TrainState, dict]:
+    def _epoch_body(
+        self, state: TrainState, key: Array, beta_endpoints=None
+    ) -> tuple[TrainState, dict]:
+        """One epoch. ``beta_endpoints`` optionally overrides the config's
+        static (beta_start, beta_end) with traced values — the sweep trainer
+        vmaps this body over a grid of endpoints."""
         cfg = self.config
+        b0, b1 = (
+            (cfg.beta_start, cfg.beta_end) if beta_endpoints is None else beta_endpoints
+        )
         beta = log_annealed_beta(
-            state.epoch, cfg.beta_start, cfg.beta_end,
+            state.epoch, b0, b1,
             cfg.num_annealing_epochs, cfg.num_pretraining_epochs,
         )
         n = self._x_train.shape[0]
@@ -164,9 +176,11 @@ class DIBTrainer:
             params, opt_state = carry
             k_batch, k_noise = jax.random.split(k)
             idx = jax.random.randint(k_batch, (cfg.batch_size,), 0, n)
-            (loss, aux), grads = grad_fn(
-                params, self._x_train[idx], self._y_train[idx], beta, k_noise
-            )
+            x_b, y_b = self._x_train[idx], self._y_train[idx]
+            if self.batch_constraint is not None:
+                x_b = jax.lax.with_sharding_constraint(x_b, self.batch_constraint)
+                y_b = jax.lax.with_sharding_constraint(y_b, self.batch_constraint)
+            (loss, aux), grads = grad_fn(params, x_b, y_b, beta, k_noise)
             updates, opt_state = self.optimizer.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             return (params, opt_state), {
@@ -237,6 +251,11 @@ class DIBTrainer:
         reference ``models.py:152-223``).
         """
         num_epochs = self.config.num_epochs if num_epochs is None else num_epochs
+        if (state is None) != (history is None):
+            raise ValueError(
+                "Resuming needs BOTH state and history; got exactly one "
+                "(the other would be silently re-initialized)."
+            )
         if state is None or history is None:
             key, k_init = jax.random.split(key)
             state, history = self.init(k_init)

@@ -1,0 +1,197 @@
+"""Mesh + beta-sweep parallelism tests on the virtual 8-device CPU mesh.
+
+These are the distributed tests the reference does not have (SURVEY.md
+section 4): sharding and collectives are exercised through real pjit
+partitioning over ``--xla_force_host_platform_device_count=8`` devices.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dib_tpu.data import get_dataset
+from dib_tpu.models import DistributedIBModel
+from dib_tpu.parallel import (
+    BetaSweepTrainer,
+    factor_devices,
+    make_sweep_mesh,
+    replica_sharding,
+)
+from dib_tpu.train import DIBTrainer, TrainConfig
+
+
+def tiny_model(bundle):
+    return DistributedIBModel(
+        feature_dimensionalities=tuple(bundle.feature_dimensionalities),
+        encoder_hidden=(16,),
+        integration_hidden=(32,),
+        output_dim=bundle.output_dimensionality,
+        embedding_dim=4,
+        output_activation=bundle.output_activation,
+    )
+
+
+@pytest.fixture(scope="module")
+def bundle():
+    return get_dataset("boolean_circuit", number_inputs=6, seed=1)
+
+
+CFG = TrainConfig(
+    batch_size=64,
+    beta_start=1e-3,
+    beta_end=1.0,
+    num_pretraining_epochs=2,
+    num_annealing_epochs=6,
+    steps_per_epoch=2,
+    max_val_points=128,
+)
+
+
+# ---------------------------------------------------------------- mesh utils
+def test_make_sweep_mesh_shapes():
+    mesh = make_sweep_mesh(4, 2)
+    assert mesh.shape == {"beta": 4, "data": 2}
+    mesh = make_sweep_mesh()          # all devices on the sweep axis
+    assert mesh.shape["beta"] == len(jax.devices())
+    with pytest.raises(ValueError):
+        make_sweep_mesh(16, 16)
+
+
+def test_factor_devices():
+    assert factor_devices(8) == (4, 2)
+    assert factor_devices(7) == (7, 1)
+    assert factor_devices(1) == (1, 1)
+
+
+# ------------------------------------------------------------- sweep trainer
+def test_sweep_matches_serial_trainer(bundle):
+    """One sweep replica == the serial trainer, exactly (same keys/endpoints)."""
+    model = tiny_model(bundle)
+    key = jax.random.key(7)
+
+    serial = DIBTrainer(model, bundle, CFG)
+    _, hist_serial = serial.fit(key)
+
+    sweep = BetaSweepTrainer(
+        model, bundle, CFG, beta_starts=CFG.beta_start, beta_ends=CFG.beta_end
+    )
+    _, records = sweep.fit(jnp.stack([key]))
+
+    np.testing.assert_allclose(records[0].beta, hist_serial.beta, rtol=1e-6)
+    np.testing.assert_allclose(
+        records[0].kl_per_feature, hist_serial.kl_per_feature, rtol=2e-4, atol=1e-6
+    )
+    np.testing.assert_allclose(records[0].loss, hist_serial.loss, rtol=2e-4, atol=1e-6)
+
+
+def test_sweep_on_mesh_runs_and_shards(bundle):
+    """4x2 mesh: 4 beta replicas x 2-way batch sharding, one jitted program."""
+    model = tiny_model(bundle)
+    mesh = make_sweep_mesh(4, 2)
+    betas_end = jnp.asarray([0.03, 0.1, 0.3, 1.0])
+    sweep = BetaSweepTrainer(
+        model, bundle, CFG, beta_starts=1e-3, beta_ends=betas_end, mesh=mesh
+    )
+    keys = jax.random.split(jax.random.key(0), 4)
+    states, records = sweep.fit(keys)
+
+    # replica axis really is sharded over the beta mesh axis
+    leaf = jax.tree.leaves(states.params)[0]
+    assert leaf.sharding.spec == replica_sharding(mesh).spec
+    assert len(records) == 4
+    for r, rec in enumerate(records):
+        assert rec.beta.shape == (CFG.num_epochs,)
+        # beta is recorded at epoch START, so the last record sits at progress
+        # (num_epochs - 1 - pre) / anneal on replica r's own log ramp
+        progress = (CFG.num_epochs - 1 - CFG.num_pretraining_epochs) / (
+            CFG.num_annealing_epochs
+        )
+        expected = CFG.beta_start * (betas_end[r] / CFG.beta_start) ** progress
+        np.testing.assert_allclose(rec.beta[-1], expected, rtol=1e-4)
+    # each replica annealed toward ITS OWN endpoint
+    assert records[0].beta[-1] < records[-1].beta[-1]
+
+
+def test_sweep_mesh_matches_no_mesh(bundle):
+    """Sharding must not change the math: mesh vs no-mesh, same keys."""
+    model = tiny_model(bundle)
+    keys = jax.random.split(jax.random.key(3), 2)
+    ends = jnp.asarray([0.1, 1.0])
+
+    plain = BetaSweepTrainer(model, bundle, CFG, 1e-3, ends)
+    _, rec_plain = plain.fit(keys, num_epochs=4)
+
+    mesh = make_sweep_mesh(2, 2)
+    sharded = BetaSweepTrainer(model, bundle, CFG, 1e-3, ends, mesh=mesh)
+    _, rec_shard = sharded.fit(keys, num_epochs=4)
+
+    for a, b in zip(rec_plain, rec_shard):
+        np.testing.assert_allclose(a.loss, b.loss, rtol=5e-4, atol=1e-5)
+        np.testing.assert_allclose(
+            a.kl_per_feature, b.kl_per_feature, rtol=5e-4, atol=1e-5
+        )
+
+
+def test_sweep_higher_beta_lower_kl(bundle):
+    """Physics sanity across the grid: stronger bottlenecks compress more."""
+    model = tiny_model(bundle)
+    cfg = TrainConfig(
+        batch_size=64, beta_start=1e-3, beta_end=1.0,
+        num_pretraining_epochs=10, num_annealing_epochs=60,
+        steps_per_epoch=4, max_val_points=128, learning_rate=3e-3,
+    )
+    mesh = make_sweep_mesh(4, 2)
+    # repeated-endpoint replicas differ only by seed; distinct endpoints order KL
+    ends = jnp.asarray([0.01, 0.1, 1.0, 10.0])
+    sweep = BetaSweepTrainer(model, bundle, cfg, 1e-3, ends, mesh=mesh)
+    keys = jax.random.split(jax.random.key(11), 4)
+    _, records = sweep.fit(keys)
+    final_kl = np.asarray([r.total_kl[-5:].mean() for r in records])
+    assert final_kl[0] > final_kl[-1], final_kl
+
+
+def test_per_replica_hook_adapts_serial_hooks(bundle, tmp_path):
+    """Serial hooks (MI bounds, compression matrices) run inside a sweep via
+    PerReplicaHook, each replica getting its own instance and beta label."""
+    from dib_tpu.parallel import PerReplicaHook
+    from dib_tpu.train import CompressionMatrixHook, InfoPerFeatureHook
+
+    model = tiny_model(bundle)
+    mesh = make_sweep_mesh(2, 2)
+    sweep = BetaSweepTrainer(
+        model, bundle, CFG, 1e-3, jnp.asarray([0.1, 1.0]), mesh=mesh
+    )
+    info_hooks: dict[int, InfoPerFeatureHook] = {}
+
+    def make_info(r):
+        info_hooks[r] = InfoPerFeatureHook(64, 1, seed=r)
+        return info_hooks[r]
+
+    hooks = [
+        PerReplicaHook(make_info),
+        PerReplicaHook(lambda r: CompressionMatrixHook(str(tmp_path / f"r{r}"))),
+    ]
+    keys = jax.random.split(jax.random.key(5), 2)
+    sweep.fit(keys, num_epochs=4, hooks=hooks, hook_every=2)
+
+    assert set(info_hooks) == {0, 1}
+    for hook in info_hooks.values():
+        assert hook.bounds_bits.shape == (2, bundle.number_features, 2)
+    pngs = sorted(p.name for p in (tmp_path / "r1").glob("*.png"))
+    assert len(pngs) == 2 * bundle.number_features
+    # replica 1's beta label comes from ITS endpoints (end=1.0), not replica 0's
+    assert any("log10beta_" in p for p in pngs)
+
+
+def test_sweep_validates_divisibility(bundle):
+    model = tiny_model(bundle)
+    mesh = make_sweep_mesh(4, 2)
+    with pytest.raises(ValueError, match="not divisible"):
+        BetaSweepTrainer(model, bundle, CFG, 1e-3, jnp.ones((6,)), mesh=mesh)
+    bad_cfg = TrainConfig(batch_size=63)
+    with pytest.raises(ValueError, match="batch_size"):
+        BetaSweepTrainer(model, bundle, bad_cfg, 1e-3, jnp.ones((4,)), mesh=mesh)
+    with pytest.raises(ValueError, match="replica keys"):
+        sweep = BetaSweepTrainer(model, bundle, CFG, 1e-3, jnp.ones((4,)))
+        sweep.fit(jax.random.split(jax.random.key(0), 3))
