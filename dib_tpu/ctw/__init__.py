@@ -1,1 +1,194 @@
-"""dib_tpu.ctw (populated incrementally)."""
+"""Context Tree Weighting entropy-rate estimation (native C++ component).
+
+Host-side counterpart of the TPU workloads: the chaos
+measurement-optimization pipeline symbolizes long trajectories on device,
+then scores the symbol sequences' entropy rate here (reference call stack:
+chaos notebook cell 10 -> ctw.estimate_entropy, chaos/ctw.pyx:2 ->
+chaos/cppctw.cpp:163). CTW is inherently sequential pointer-chasing, so it
+stays native/CPU by design.
+
+The C++ core (``ctw.cpp``) is compiled on first use into a shared library
+and bound through ``ctypes`` (no Cython/pybind build dependency). Beyond
+the reference's one-shot ``estimate_entropy``, this module exposes
+:class:`CTWEstimator`, an incremental estimator whose tree grows across
+``append`` calls — entropy-rate-vs-length scaling curves (the
+Schürmann–Grassberger extrapolation workload) reuse one tree instead of
+rebuilding per length.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Sequence
+
+import numpy as np
+
+__all__ = ["estimate_entropy", "CTWEstimator", "DEFAULT_MAX_DEPTH"]
+
+# Same default context-depth cap as the reference (chaos/cppctw.cpp:13).
+DEFAULT_MAX_DEPTH = 512
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "ctw.cpp")
+_LIB_PATH = os.path.join(_HERE, "libdibctw.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _build_library() -> None:
+    # Compile to a temp name and rename into place: concurrent processes
+    # (pytest workers, sweep shards on shared FS) may race import-time
+    # builds, and POSIX rename keeps dlopen from ever seeing a partial ELF.
+    tmp_path = f"{_LIB_PATH}.{os.getpid()}.tmp"
+    cmd = [
+        "g++",
+        "-O3",
+        "-std=c++17",
+        "-shared",
+        "-fPIC",
+        "-o",
+        tmp_path,
+        _SRC,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    except subprocess.CalledProcessError as e:
+        raise RuntimeError(f"CTW native build failed:\n{e.stderr}") from e
+    os.replace(tmp_path, _LIB_PATH)
+
+
+def _load() -> ctypes.CDLL:
+    """Compile (if stale) and load the shared library, configuring signatures."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if (not os.path.exists(_LIB_PATH)) or (
+            os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC)
+        ):
+            _build_library()
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.dib_ctw_entropy.argtypes = [
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int64,
+            ctypes.c_int32,
+            ctypes.c_int32,
+        ]
+        lib.dib_ctw_entropy.restype = ctypes.c_double
+        lib.dib_ctw_new.argtypes = [ctypes.c_int32, ctypes.c_int32]
+        lib.dib_ctw_new.restype = ctypes.c_void_p
+        lib.dib_ctw_free.argtypes = [ctypes.c_void_p]
+        lib.dib_ctw_free.restype = None
+        lib.dib_ctw_append.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.c_int64,
+        ]
+        lib.dib_ctw_append.restype = None
+        lib.dib_ctw_code_length.argtypes = [ctypes.c_void_p]
+        lib.dib_ctw_code_length.restype = ctypes.c_double
+        lib.dib_ctw_length.argtypes = [ctypes.c_void_p]
+        lib.dib_ctw_length.restype = ctypes.c_int64
+        lib.dib_ctw_num_nodes.argtypes = [ctypes.c_void_p]
+        lib.dib_ctw_num_nodes.restype = ctypes.c_int64
+        _lib = lib
+        return _lib
+
+
+def _as_symbols(sequence: Sequence[int] | np.ndarray, alphabet_size: int) -> np.ndarray:
+    seq = np.ascontiguousarray(sequence, dtype=np.int32)
+    if seq.ndim != 1:
+        raise ValueError(f"sequence must be 1-D, got shape {seq.shape}")
+    if seq.size and (seq.min() < 0 or seq.max() >= alphabet_size):
+        raise ValueError(
+            f"symbols must lie in [0, {alphabet_size}); "
+            f"got range [{seq.min()}, {seq.max()}]"
+        )
+    return seq
+
+
+def estimate_entropy(
+    sequence: Sequence[int] | np.ndarray,
+    alphabet_size: int,
+    max_depth: int = DEFAULT_MAX_DEPTH,
+) -> float:
+    """CTW entropy-rate estimate of ``sequence`` in bits/symbol.
+
+    API parity with the reference binding (chaos/ctw.pyx:2-3), with the
+    depth cap exposed instead of hardcoded.
+    """
+    if alphabet_size < 2:
+        raise ValueError("alphabet_size must be >= 2")
+    seq = _as_symbols(sequence, alphabet_size)
+    if seq.size == 0:
+        return 0.0
+    lib = _load()
+    ptr = seq.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+    return float(lib.dib_ctw_entropy(ptr, seq.size, alphabet_size, max_depth))
+
+
+class CTWEstimator:
+    """Incremental CTW estimator: append symbols, query entropy at any point.
+
+    The underlying context tree persists across ``append`` calls, so scoring
+    a sequence at many prefix lengths costs one tree build instead of one
+    per length (the reference rebuilds from scratch per length,
+    chaos notebook cell 10 post-training loop).
+    """
+
+    def __init__(self, alphabet_size: int, max_depth: int = DEFAULT_MAX_DEPTH):
+        if alphabet_size < 2:
+            raise ValueError("alphabet_size must be >= 2")
+        self.alphabet_size = int(alphabet_size)
+        self.max_depth = int(max_depth)
+        self._lib = _load()
+        self._handle = self._lib.dib_ctw_new(self.alphabet_size, self.max_depth)
+        if not self._handle:
+            raise RuntimeError("failed to allocate CTW context tree")
+
+    def append(self, sequence: Sequence[int] | np.ndarray) -> "CTWEstimator":
+        seq = _as_symbols(sequence, self.alphabet_size)
+        if seq.size:
+            ptr = seq.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+            self._lib.dib_ctw_append(self._handle, ptr, seq.size)
+        return self
+
+    @property
+    def length(self) -> int:
+        return int(self._lib.dib_ctw_length(self._handle))
+
+    @property
+    def num_nodes(self) -> int:
+        return int(self._lib.dib_ctw_num_nodes(self._handle))
+
+    def code_length_bits(self) -> float:
+        """Total CTW weighted code length of everything appended, in bits."""
+        return float(self._lib.dib_ctw_code_length(self._handle))
+
+    def entropy_rate(self) -> float:
+        """Current entropy-rate estimate in bits/symbol."""
+        n = self.length
+        return self.code_length_bits() / n if n else 0.0
+
+    def close(self) -> None:
+        if getattr(self, "_handle", None):
+            self._lib.dib_ctw_free(self._handle)
+            self._handle = None
+
+    def __enter__(self) -> "CTWEstimator":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self):  # best-effort; prefer close()/context manager
+        try:
+            self.close()
+        except Exception:
+            pass
