@@ -1,0 +1,116 @@
+"""Benchmark: amorphous-plasticity set-transformer beta-sweep on TPU.
+
+This is the BASELINE.json north-star workload: the full per-particle DIB
+set-transformer configuration from the reference (amorphous notebook cell 8
+— encoder MLP 128x2 -> 2x32, 6 attention blocks x 12 heads x key_dim 128,
+batch 32 neighborhoods x 50 particles, 25,000 steps) swept over a grid of
+beta endpoints as ONE jitted vmapped program.
+
+It times the steady-state sweep throughput on the available device and
+projects the wall-clock of the complete north-star run (R replicas x 25k
+steps). ``vs_baseline`` is the projection divided by the 10-minute target
+the driver set for a v4-8 (BASELINE.json ``north_star``); < 1.0 beats the
+target.
+
+Prints exactly ONE JSON line to stdout; diagnostics go to stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+NUM_REPLICAS = 8
+FULL_SWEEP_STEPS = 25_000          # reference run length per protocol
+BASELINE_MINUTES = 10.0            # driver-set north-star target (v4-8)
+STEPS_PER_EPOCH = 50
+MEASURE_EPOCHS = 6                 # 6 * 50 * 8 replicas = 2400 sweep steps
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dib_tpu.data import get_dataset
+    from dib_tpu.models import PerParticleDIBModel
+    from dib_tpu.parallel import BetaSweepTrainer
+    from dib_tpu.train import TrainConfig
+
+    devices = jax.devices()
+    log(f"devices: {devices}")
+
+    bundle = get_dataset("amorphous_particles", num_synthetic_neighborhoods=2048)
+    model = PerParticleDIBModel(num_particles=50)   # full paper architecture
+    config = TrainConfig(
+        learning_rate=1e-4,
+        batch_size=32,
+        num_pretraining_epochs=0,
+        num_annealing_epochs=FULL_SWEEP_STEPS // STEPS_PER_EPOCH,
+        steps_per_epoch=STEPS_PER_EPOCH,
+        max_val_points=256,
+        warmup_steps=500,
+    )
+    # Grid of annealing end-betas around the paper's 2e-1, shared start 2e-6.
+    beta_ends = np.logspace(-2, 0, NUM_REPLICAS)
+    sweep = BetaSweepTrainer(model, bundle, config, 2e-6, beta_ends)
+
+    init_keys = jax.random.split(jax.random.key(0), NUM_REPLICAS)
+    warm_keys = jax.random.split(jax.random.key(1), NUM_REPLICAS)
+    meas_keys = jax.random.split(jax.random.key(2), NUM_REPLICAS)
+    t0 = time.time()
+    states, histories = sweep.init(init_keys)
+
+    # Warmup chunk: triggers compile of the full epoch scan (num_epochs is a
+    # static arg, so warm with the same value the measurement uses).
+    states, histories = sweep.run_chunk(states, histories, warm_keys, MEASURE_EPOCHS)
+    jax.block_until_ready(states.params)
+    compile_s = time.time() - t0
+    log(f"init+compile+first epoch: {compile_s:.1f}s")
+
+    t1 = time.time()
+    states, histories = sweep.run_chunk(
+        states, histories, meas_keys, MEASURE_EPOCHS
+    )
+    jax.block_until_ready(states.params)
+    measure_s = time.time() - t1
+
+    sweep_steps = MEASURE_EPOCHS * STEPS_PER_EPOCH * NUM_REPLICAS
+    steps_per_s = sweep_steps / measure_s
+    # Validation runs once per epoch inside the measured chunk, so the
+    # projection includes instrumentation overhead, as the north star does.
+    projected_s = FULL_SWEEP_STEPS * NUM_REPLICAS / steps_per_s + compile_s
+    projected_min = projected_s / 60.0
+
+    log(
+        f"measured {sweep_steps} sweep steps in {measure_s:.2f}s "
+        f"({steps_per_s:.0f} steps/s); projected full sweep "
+        f"({NUM_REPLICAS} replicas x {FULL_SWEEP_STEPS} steps): "
+        f"{projected_min:.2f} min"
+    )
+    # Sanity: training must not have gone non-finite anywhere in the run.
+    kl = np.asarray(histories["kl_per_feature"])
+    assert np.isfinite(kl).all(), "non-finite KL in benchmark run"
+
+    print(
+        json.dumps(
+            {
+                "metric": "amorphous_set_transformer_beta_sweep_projected",
+                "value": round(projected_min, 3),
+                "unit": "minutes",
+                "vs_baseline": round(projected_min / BASELINE_MINUTES, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -10,6 +10,7 @@ from dib_tpu.models.encoders import (
     pad_and_stack_features,
 )
 from dib_tpu.models.dib import DistributedIBModel
+from dib_tpu.models.per_particle import PerParticleDIBModel
 from dib_tpu.models.set_transformer import SetTransformer, SetAttentionBlock
 from dib_tpu.models.measurement import (
     StateEncoder,

@@ -1,0 +1,9 @@
+"""End-to-end workload drivers (the notebook equivalents, scriptable)."""
+
+from dib_tpu.workloads.chaos import (
+    KNOWN_ENTROPY_RATES,
+    entropy_rate_scaling_curve,
+    fit_entropy_rate,
+    random_partition_entropy,
+    run_chaos_workload,
+)

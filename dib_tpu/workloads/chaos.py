@@ -1,0 +1,203 @@
+"""Chaos measurement-optimization workload, end to end.
+
+The pipeline of the PRL paper (reference chaos notebook cells 3-10):
+  1. generate a long chaotic trajectory (logistic / Henon / Ikeda);
+  2. train the measurement stack — IB encoder, soft vector quantizer,
+     sequence aggregator, reference-state encoder — with the nonlinear-IB
+     objective and the downward beta anneal, stopping when the IB channel
+     carries ``mi_stop_bits``;
+  3. hard-symbolize a much longer trajectory with the shared-noise trick;
+  4. score the symbol sequence's entropy rate with the native CTW estimator
+     at several lengths and extrapolate to infinite length with the
+     Schurmann–Grassberger ansatz;
+  5. compare against randomly initialized measurement networks (the
+     random-partition baseline, chaos notebook cell 7).
+
+Every stage is a plain function so tests can shrink the configuration; the
+module-level defaults reproduce the paper run (2e7-state characterization,
+15 lengths x 5 draws).
+"""
+
+from __future__ import annotations
+
+from dataclasses import asdict
+from typing import Sequence
+
+import jax
+import numpy as np
+
+from dib_tpu.ctw import CTWEstimator
+from dib_tpu.data.chaos_maps import generate_data
+from dib_tpu.models.measurement import MeasurementStack
+from dib_tpu.ops.entropy import entropy_rate_scaling_ansatz
+from dib_tpu.train.measurement import (
+    MeasurementConfig,
+    MeasurementTrainer,
+    make_state_windows,
+)
+
+# Literature entropy rates (bits/symbol) the reference pins as truth lines
+# (chaos notebook cell 2, ``entropy_rate_dict``).
+KNOWN_ENTROPY_RATES = {
+    "logistic": 0.5203,
+    "henon": 0.6048,
+    "ikeda": 0.726,
+}
+
+
+def entropy_rate_scaling_curve(
+    symbols: np.ndarray,
+    lengths: Sequence[int],
+    alphabet_size: int,
+    num_draws: int = 5,
+    seed: int = 0,
+) -> np.ndarray:
+    """CTW entropy-rate estimates at several sequence lengths.
+
+    For each draw, a random starting offset is chosen and ONE incremental
+    CTW tree is grown through the nested prefixes — each of the (sorted)
+    lengths costs only the marginal symbols, where the reference rebuilds
+    the whole tree per (length, draw) pair (chaos notebook cell 10).
+
+    Returns [num_draws, len(lengths)] entropy rates in bits/symbol, with
+    columns in ascending-length order. ``lengths`` must already be sorted
+    ascending so callers can never mis-pair columns with their own order.
+    """
+    lengths = [int(x) for x in lengths]
+    if lengths != sorted(lengths):
+        raise ValueError(f"lengths must be sorted ascending, got {lengths}")
+    if lengths[-1] > len(symbols):
+        raise ValueError(
+            f"longest requested length {lengths[-1]} exceeds the "
+            f"{len(symbols)}-symbol sequence"
+        )
+    rng = np.random.default_rng(seed)
+    rates = np.zeros((num_draws, len(lengths)))
+    for d in range(num_draws):
+        offset = int(rng.integers(0, len(symbols) - lengths[-1] + 1))
+        with CTWEstimator(alphabet_size) as est:
+            done = 0
+            for j, n in enumerate(lengths):
+                est.append(symbols[offset + done : offset + n])
+                done = n
+                rates[d, j] = est.entropy_rate()
+    return rates
+
+
+def fit_entropy_rate(lengths, rates) -> dict:
+    """Schurmann–Grassberger extrapolation to the infinite-length rate.
+
+    ``rates`` may be [num_draws, L] (averaged) or [L]. Returns the fitted
+    parameters and the extrapolated ``h_inf`` in bits/symbol.
+    """
+    from scipy.optimize import curve_fit
+
+    lengths = np.asarray(lengths, np.float64)
+    rates = np.asarray(rates, np.float64)
+    mean_rates = rates.mean(axis=0) if rates.ndim == 2 else rates
+    p0 = (float(mean_rates[-1]), 0.5, 1.0)
+    try:
+        popt, _ = curve_fit(
+            entropy_rate_scaling_ansatz, lengths, mean_rates, p0=p0, maxfev=20_000
+        )
+        h_inf, gamma, c = (float(v) for v in popt)
+    except RuntimeError:  # no convergence: fall back to the longest estimate
+        h_inf, gamma, c = float(mean_rates[-1]), float("nan"), float("nan")
+    return {"h_inf": h_inf, "gamma": gamma, "c": c, "mean_rates": mean_rates}
+
+
+def random_partition_entropy(
+    trajectory: np.ndarray,
+    alphabet_size: int,
+    num_states: int,
+    num_partitions: int = 5,
+    num_noise_draws: int = 100,
+    seed: int = 0,
+    chunk_size: int = 10_000,
+) -> np.ndarray:
+    """Entropy rates under randomly initialized measurement networks.
+
+    The reference's baseline (chaos notebook cell 7): untrained stacks
+    partition state space essentially at random; their symbol sequences
+    bound what optimization buys.
+    """
+    cfg = MeasurementConfig(batch_size=min(256, len(trajectory) - num_states + 1))
+    windows = make_state_windows(trajectory[: cfg.batch_size + num_states], num_states)
+    rates = np.zeros(num_partitions)
+    for p in range(num_partitions):
+        key = jax.random.key(seed + 1000 * p)
+        k_init, k_sym = jax.random.split(key)
+        stack = MeasurementStack(alphabet_size=alphabet_size, num_states=num_states)
+        trainer = MeasurementTrainer(stack, windows, cfg)
+        state = trainer.init(k_init)
+        symbols = trainer.symbolize_trajectory(
+            state, trajectory, k_sym, num_noise_draws, chunk_size
+        )
+        with CTWEstimator(alphabet_size) as est:
+            rates[p] = est.append(symbols).entropy_rate()
+    return rates
+
+
+def run_chaos_workload(
+    system: str = "ikeda",
+    alphabet_size: int = 2,
+    num_states: int = 12,
+    train_iterations: int = 1_000_000,
+    characterization_iterations: int = 20_000_000,
+    config: MeasurementConfig | None = None,
+    scaling_lengths: Sequence[int] | None = None,
+    num_scaling_draws: int = 5,
+    num_noise_draws: int = 100,
+    include_random_baseline: bool = True,
+    seed: int = 0,
+    chunk_size: int = 10_000,
+) -> dict:
+    """The full chaos pipeline; returns a result dict (JSON-serializable
+    except for the raw arrays)."""
+    config = config or MeasurementConfig()
+    train_traj = generate_data(system, number_iterations=train_iterations, seed=seed)
+    windows = make_state_windows(train_traj, num_states)
+
+    stack = MeasurementStack(alphabet_size=alphabet_size, num_states=num_states)
+    trainer = MeasurementTrainer(stack, windows, config)
+    state, history = trainer.fit(jax.random.key(seed))
+
+    char_traj = generate_data(
+        system, number_iterations=characterization_iterations, seed=seed + 1
+    )
+    symbols = trainer.symbolize_trajectory(
+        state, char_traj, jax.random.key(seed + 2), num_noise_draws, chunk_size
+    )
+
+    if scaling_lengths is None:
+        scaling_lengths = np.unique(
+            np.logspace(4, np.log10(len(symbols)), 15).astype(np.int64)
+        )
+    scaling_lengths = sorted(int(x) for x in scaling_lengths)
+    rates = entropy_rate_scaling_curve(
+        symbols, scaling_lengths, alphabet_size, num_scaling_draws, seed
+    )
+    fit = fit_entropy_rate(scaling_lengths, rates)
+
+    result = {
+        "system": system,
+        "alphabet_size": alphabet_size,
+        "num_states": num_states,
+        "config": asdict(config),
+        "history": history,
+        "symbols": symbols,
+        "scaling_lengths": np.asarray(scaling_lengths),
+        "scaling_rates": rates,
+        "fit": fit,
+        "h_known": KNOWN_ENTROPY_RATES.get(system),
+    }
+    if include_random_baseline:
+        result["random_partition_rates"] = random_partition_entropy(
+            char_traj[: min(len(char_traj), 200_000)],
+            alphabet_size,
+            num_states,
+            seed=seed,
+            num_noise_draws=num_noise_draws,
+            chunk_size=chunk_size,
+        )
+    return result

@@ -1,0 +1,148 @@
+"""Tests for the chaos measurement-optimization workload (training loop,
+symbolization, entropy-rate scaling, end-to-end pipeline)."""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+import pytest
+
+from dib_tpu.data.chaos_maps import generate_data
+from dib_tpu.models.measurement import MeasurementStack
+from dib_tpu.train.measurement import (
+    MeasurementConfig,
+    MeasurementTrainer,
+    make_state_windows,
+)
+from dib_tpu.workloads.chaos import (
+    KNOWN_ENTROPY_RATES,
+    entropy_rate_scaling_curve,
+    fit_entropy_rate,
+    run_chaos_workload,
+)
+
+
+class TestWindows:
+    def test_shapes_and_content(self):
+        traj = np.arange(10, dtype=np.float32)
+        w = make_state_windows(traj, 4)
+        assert w.shape == (7, 4, 1)
+        np.testing.assert_array_equal(w[0, :, 0], [0, 1, 2, 3])
+        np.testing.assert_array_equal(w[-1, :, 0], [6, 7, 8, 9])
+
+    def test_2d_trajectory(self):
+        traj = np.random.default_rng(0).random((20, 2)).astype(np.float32)
+        w = make_state_windows(traj, 5)
+        assert w.shape == (16, 5, 2)
+        np.testing.assert_array_equal(w[3], traj[3:8])
+
+    def test_too_short_raises(self):
+        with pytest.raises(ValueError):
+            make_state_windows(np.zeros(3), 5)
+
+
+@pytest.fixture(scope="module")
+def tiny_setup():
+    traj = generate_data("logistic", number_iterations=4000,
+                         number_skip_iterations=500, seed=0)
+    windows = make_state_windows(traj, 3)
+    stack = MeasurementStack(
+        alphabet_size=2, num_states=3, ib_embedding_dim=4,
+        encoder_hidden=(32,), vq_hidden=(32,), aggregator_hidden=(32,),
+        reference_hidden=(32,), infonce_dim=8, num_posenc_frequencies=4,
+    )
+    cfg = MeasurementConfig(
+        batch_size=128, num_steps=60, check_every=30,
+        mi_eval_batch_size=128, mi_eval_batches=1, mi_stop_bits=50.0,
+    )
+    return stack, windows, cfg, traj
+
+
+class TestMeasurementTrainer:
+    def test_loss_decreases_and_beta_descends(self, tiny_setup):
+        stack, windows, cfg, _ = tiny_setup
+        trainer = MeasurementTrainer(stack, windows, cfg)
+        state, history = trainer.fit(jax.random.key(0))
+        assert int(state.step) == cfg.num_steps
+        assert history["beta"][0] > history["beta"][-1]  # downward anneal
+        assert np.isfinite(history["loss"]).all()
+        # InfoNCE match improves from its log(B)-ish start
+        assert history["match"][-5:].mean() < history["match"][:5].mean()
+        assert len(history["mi_bounds"]) == 2
+
+    def test_mi_early_stop(self, tiny_setup):
+        stack, windows, cfg, _ = tiny_setup
+        import dataclasses
+
+        eager = dataclasses.replace(cfg, mi_stop_bits=1e-6)
+        trainer = MeasurementTrainer(stack, windows, eager)
+        state, history = trainer.fit(jax.random.key(0))
+        assert history["stopped_early"]
+        assert int(state.step) == eager.check_every  # stopped at first check
+
+    def test_symbolization_deterministic_and_chunked(self, tiny_setup):
+        stack, windows, cfg, traj = tiny_setup
+        trainer = MeasurementTrainer(stack, windows, cfg)
+        state = trainer.init(jax.random.key(1))
+        s1 = trainer.symbolize_trajectory(state, traj[:1000], jax.random.key(7),
+                                          num_noise_draws=10, chunk_size=300)
+        s2 = trainer.symbolize_trajectory(state, traj[:1000], jax.random.key(7),
+                                          num_noise_draws=10, chunk_size=1000)
+        assert s1.shape == (1000,)
+        assert s1.dtype == np.uint8
+        # same key + params -> identical partition regardless of chunking
+        np.testing.assert_array_equal(s1, s2)
+        assert set(np.unique(s1)) <= {0, 1}
+
+    def test_window_mismatch_raises(self, tiny_setup):
+        stack, windows, cfg, _ = tiny_setup
+        bad = windows[:, :2]  # 2 states, stack expects 3
+        with pytest.raises(ValueError):
+            MeasurementTrainer(stack, bad, cfg)
+
+
+class TestEntropyScaling:
+    def test_curve_monotone_lengths_and_fit(self):
+        rng = np.random.default_rng(0)
+        symbols = rng.integers(0, 2, size=30_000).astype(np.uint8)
+        lengths = [2000, 8000, 30_000]
+        rates = entropy_rate_scaling_curve(symbols, lengths, 2, num_draws=3, seed=0)
+        assert rates.shape == (3, 3)
+        # iid uniform: every estimate near 1 bit, tighter with length
+        assert np.all(rates > 0.9)
+        fit = fit_entropy_rate(lengths, rates)
+        assert fit["h_inf"] == pytest.approx(1.0, abs=0.05)
+
+    def test_length_validation(self):
+        with pytest.raises(ValueError):
+            entropy_rate_scaling_curve(np.zeros(10, np.uint8), [100], 2)
+
+
+class TestEndToEnd:
+    def test_logistic_pipeline_recovers_entropy_rate(self):
+        res = run_chaos_workload(
+            system="logistic", alphabet_size=2, num_states=4,
+            train_iterations=20_000, characterization_iterations=60_000,
+            config=MeasurementConfig(
+                batch_size=256, num_steps=300, check_every=100,
+                mi_eval_batch_size=256, mi_eval_batches=2,
+            ),
+            scaling_lengths=[5_000, 15_000, 30_000, 60_000],
+            num_scaling_draws=2, num_noise_draws=20,
+            include_random_baseline=False, seed=0, chunk_size=20_000,
+        )
+        assert res["symbols"].shape == (60_000,)
+        # trained partition must land in the physical ballpark of the
+        # literature rate (0.5203). The longest-length CTW estimate is the
+        # robust check for a tiny run; the Schurmann-Grassberger
+        # extrapolation is only required to be sane (it amplifies noise
+        # when given few lengths).
+        longest_rate = res["scaling_rates"].mean(0)[-1]
+        assert longest_rate == pytest.approx(
+            KNOWN_ENTROPY_RATES["logistic"], abs=0.12
+        )
+        assert np.isfinite(res["fit"]["h_inf"])
+        assert 0.0 < res["fit"]["h_inf"] < 1.0
+        # and both symbols must actually be used
+        counts = np.bincount(res["symbols"], minlength=2)
+        assert counts.min() > 0.05 * counts.sum()
