@@ -1,5 +1,14 @@
 """End-to-end workload drivers (the notebook equivalents, scriptable)."""
 
+from dib_tpu.workloads.boolean import (
+    BooleanDIBModel,
+    BooleanTrainer,
+    BooleanWorkloadConfig,
+    best_subsets_by_size,
+    logistic_regression_importances,
+    run_boolean_workload,
+    shapley_values_bits,
+)
 from dib_tpu.workloads.chaos import (
     KNOWN_ENTROPY_RATES,
     entropy_rate_scaling_curve,

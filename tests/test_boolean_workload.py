@@ -1,0 +1,122 @@
+"""Boolean-circuit workload: exact-oracle tests.
+
+The truth table gives EXACT ground truth (boolean notebook cells 5/7/10), so
+these tests check hard equalities, not tolerances-of-convenience:
+  - Shapley efficiency: sum_i phi_i == I(all inputs; Y) == H(Y) for a
+    deterministic circuit.
+  - Null player: an input the circuit ignores gets phi == 0 exactly.
+  - Symmetry: interchangeable inputs (e.g. x0, x1 of XOR) get equal phi.
+  - The trained DIB recovers the important channels of a small circuit.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from dib_tpu.data.boolean_circuit import (
+    FIG_S1_CIRCUITS,
+    exact_subset_informations,
+    fetch_boolean_circuit,
+    full_truth_table,
+    num_circuit_inputs,
+)
+from dib_tpu.workloads.boolean import (
+    BooleanTrainer,
+    BooleanWorkloadConfig,
+    best_subsets_by_size,
+    logistic_regression_importances,
+    run_boolean_workload,
+    shapley_values_bits,
+)
+
+# x2 feeds only a dead gate (g3 = x2 XOR x2), so it is an exact null player.
+AND_WITH_SPECTATOR = [0, 1, 2, [2, 2, 2], [0, 0, 1]]  # y = x0 AND x1
+XOR3 = [0, 1, 2, [2, 0, 1], [2, 3, 2]]                # y = x0 XOR x1 XOR x2
+
+
+def test_shapley_efficiency_and_null_player():
+    table = full_truth_table(AND_WITH_SPECTATOR)
+    n = 3
+    infos = exact_subset_informations(table, n)
+    phi = shapley_values_bits(table, n, infos)
+    # efficiency: sum of Shapley values == v(grand coalition) == H(Y)
+    assert np.isclose(phi.sum(), infos[(0, 1, 2)], atol=1e-12)
+    # null player: x2 cannot affect y
+    assert np.isclose(phi[2], 0.0, atol=1e-12)
+    # symmetry: x0 and x1 are interchangeable in AND
+    assert np.isclose(phi[0], phi[1], atol=1e-12)
+
+
+def test_shapley_xor_symmetry():
+    table = full_truth_table(XOR3)
+    phi = shapley_values_bits(table, 3)
+    # XOR of 3 fair bits: every input has identical Shapley value, and they
+    # sum to H(Y) = 1 bit, so each phi == 1/3 bit.
+    assert np.allclose(phi, 1.0 / 3.0, atol=1e-12)
+
+
+def test_best_subsets_oracle():
+    table = full_truth_table(AND_WITH_SPECTATOR)
+    infos = exact_subset_informations(table, 3)
+    best = best_subsets_by_size(infos)
+    # the best pair must be (x0, x1) with full H(Y); H(Y) for AND = h(1/4)
+    h_y = -(0.25 * np.log2(0.25) + 0.75 * np.log2(0.75))
+    assert best[2][0] == (0, 1)
+    assert np.isclose(best[2][1], h_y, atol=1e-12)
+    # singletons of AND carry identical information
+    assert np.isclose(infos[(0,)], infos[(1,)], atol=1e-12)
+
+
+def test_logreg_importances_spectator_small():
+    table = full_truth_table(AND_WITH_SPECTATOR)
+    x = (2 * table[:, :3] - 1).astype(np.float64)
+    y = table[:, -1]
+    imp = logistic_regression_importances(x, y)
+    assert imp.shape == (3,)
+    # the dead input gets (near-)zero weight; live inputs clearly positive
+    assert imp[2] < 0.1 * min(imp[0], imp[1])
+
+
+@pytest.mark.slow
+def test_run_boolean_workload_small_circuit():
+    config = BooleanWorkloadConfig(
+        num_steps=600, batch_size=64, mi_every=200, integration_hidden=(32, 32)
+    )
+    result = run_boolean_workload(
+        key=0, config=config, circuit_specification=FIG_S1_CIRCUITS[0]
+    )
+    n = num_circuit_inputs(FIG_S1_CIRCUITS[0])
+    hist = result["history"]
+    assert hist["task"].shape == (600,)
+    assert hist["mi_lower_bits"].shape[1] == n
+    # sandwich ordering: lower <= upper at every check, every channel
+    assert np.all(hist["mi_lower_bits"] <= hist["mi_upper_bits"] + 1e-6)
+    # beta ramps upward
+    assert hist["beta"][0] < hist["beta"][-1]
+    # channel information never exceeds 1 bit (binary input) by more than slack
+    assert np.all(hist["mi_lower_bits"] <= 1.0 + 0.05)
+    # exact oracles present and consistent
+    assert result["entropy_y_bits"] <= 1.0 + 1e-12
+    phi_sum = result["shapley_bits"].sum()
+    grand = result["subset_informations"][tuple(range(n))]
+    assert np.isclose(phi_sum, grand, atol=1e-9)
+
+
+@pytest.mark.slow
+def test_boolean_trainer_learns_at_low_beta():
+    # With beta held tiny, the model must learn the circuit (acc ~ 1 on the
+    # full table) — the pretraining-phase behavior of the notebook.
+    bundle = fetch_boolean_circuit(circuit_specification=XOR3)
+    config = BooleanWorkloadConfig(
+        num_steps=1500,
+        batch_size=8,
+        beta_start=1e-6,
+        beta_end=1e-6,
+        mi_every=1500,
+        integration_hidden=(64, 64),
+        learning_rate=3e-3,
+    )
+    trainer = BooleanTrainer(bundle, config)
+    state, _ = trainer.fit(jax.random.key(1))
+    _, acc = trainer.full_table_eval(state, jax.random.key(2))
+    assert float(acc) == 1.0
