@@ -24,6 +24,24 @@ import numpy as np
 from dib_tpu.ops.info_bounds import mi_sandwich_bounds
 
 
+class Every:
+    """Run ``hook`` only when the epoch is a multiple of ``cadence``.
+
+    Lets hooks with different cadences share one ``fit(hook_every=...)``
+    chunk granularity (e.g. MI bounds every 250 steps but probe maps every
+    1000, amorphous notebook cell 8): pass the gcd as ``hook_every`` and
+    wrap each hook with its own cadence.
+    """
+
+    def __init__(self, cadence: int, hook):
+        self.cadence = max(int(cadence), 1)
+        self.hook = hook
+
+    def __call__(self, trainer, state, epoch: int):
+        if epoch % self.cadence == 0:
+            self.hook(trainer, state, epoch)
+
+
 class InfoPerFeatureHook:
     """Accumulates (epoch, feature, lower, upper) MI bounds in nats."""
 

@@ -1,5 +1,14 @@
 """End-to-end workload drivers (the notebook equivalents, scriptable)."""
 
+from dib_tpu.workloads.amorphous import (
+    AmorphousWorkloadConfig,
+    ProbeGridHook,
+    pair_correlation,
+    probe_grid_positions,
+    probe_info_maps,
+    run_amorphous_sweep,
+    run_amorphous_workload,
+)
 from dib_tpu.workloads.boolean import (
     BooleanDIBModel,
     BooleanTrainer,

@@ -1,0 +1,335 @@
+"""Amorphous-plasticity set-transformer workload — the north-star run.
+
+Scriptable equivalent of the reference's amorphous notebook
+(``complex_systems/InfoDecomp_Amorphous_plasticity_per_particle_measurements_
+and_set_transformer.ipynb``), cell 8:
+
+  - per-particle DIB (shared Gaussian encoder, KL summed over latent dims and
+    particles) + set-transformer aggregator
+    (:class:`~dib_tpu.models.per_particle.PerParticleDIBModel`);
+  - 25k steps, batch 32 neighborhoods x 50 particles, per-step beta log-ramp
+    2e-6 -> 2e-1, linear LR warmup;
+  - per-particle MI sandwich bounds every ``eval_every`` steps (cell 5's
+    ``compute_infos_mus_logvars`` — here the standard ``InfoPerFeatureHook``);
+  - probe-grid information maps every ``probe_every`` steps: a grid of
+    phantom particles of each type scored against a bank of real data
+    particles with the asymmetric M x N sandwich bounds
+    (:func:`~dib_tpu.ops.info_bounds.mi_sandwich_probe`), masked where the
+    pair-correlation density g(r) vanishes (the excluded-volume core);
+  - the distributed info plane: task loss vs transmitted information, with
+    per-particle curves (rendered by ``dib_tpu.viz``).
+
+The sweep driver (:func:`run_amorphous_sweep`) is the BASELINE.json north
+star: the whole configuration swept over a grid of beta endpoints (and/or
+seed repeats) as ONE jitted program on a ``(beta, data)`` mesh.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dib_tpu.data.amorphous import per_particle_features
+from dib_tpu.data.registry import get_dataset
+from dib_tpu.models.per_particle import PerParticleDIBModel
+from dib_tpu.ops.entropy import LN2, sequence_entropy_bits
+from dib_tpu.ops.info_bounds import mi_sandwich_probe
+from dib_tpu.parallel.mesh import make_sweep_mesh
+from dib_tpu.parallel.sweep import BetaSweepTrainer, PerReplicaHook
+from dib_tpu.train.hooks import Every, InfoPerFeatureHook
+from dib_tpu.train.loop import DIBTrainer, TrainConfig
+from dib_tpu.viz.info_plane import save_distributed_info_plane
+from dib_tpu.viz.probe_maps import density_mask, save_info_maps
+
+Array = jax.Array
+
+
+@dataclass(frozen=True)
+class AmorphousWorkloadConfig:
+    """Amorphous notebook cell 8 defaults."""
+
+    learning_rate: float = 1e-4
+    batch_size: int = 32
+    num_steps: int = 25_000
+    beta_start: float = 2e-6
+    beta_end: float = 2e-1
+    warmup_steps: int = 500
+    eval_every: int = 250             # MI bounds cadence
+    probe_every: int = 1000           # info-map cadence (0 -> off)
+    number_particles: int = 50
+    grid_side: int = 100              # probe grid resolution
+    grid_extent: float = 8.0          # probe positions span [-extent, extent]^2
+    probe_data_batch: int = 512       # real-particle bank per bound evaluation
+    mi_eval_batch_size: int = 1024
+    mi_eval_batches: int = 4
+
+    def train_config(self, steps_per_epoch: int = 1) -> TrainConfig:
+        """As a TrainConfig with epoch == ``steps_per_epoch`` train steps.
+
+        With the default 1 the beta ramp advances per STEP, exactly the
+        notebook's schedule; the sweep/bench drivers use coarser epochs to
+        amortize host re-entry."""
+        return TrainConfig(
+            learning_rate=self.learning_rate,
+            batch_size=self.batch_size,
+            beta_start=self.beta_start,
+            beta_end=self.beta_end,
+            num_pretraining_epochs=0,
+            num_annealing_epochs=self.num_steps // steps_per_epoch,
+            steps_per_epoch=steps_per_epoch,
+            warmup_steps=self.warmup_steps,
+            max_val_points=1024,
+        )
+
+
+def build_model(config: AmorphousWorkloadConfig, **overrides) -> PerParticleDIBModel:
+    """The full paper architecture (amorphous notebook cell 8); ``overrides``
+    shrink it for tests/smoke runs."""
+    return PerParticleDIBModel(num_particles=config.number_particles, **overrides)
+
+
+# ---------------------------------------------------------------- probe grids
+
+def probe_grid_positions(grid_side: int, extent: float) -> np.ndarray:
+    """[G*G, 2] xy positions of the phantom-particle grid."""
+    axis = np.linspace(-extent, extent, grid_side, dtype=np.float32)
+    xx, yy = np.meshgrid(axis, axis)
+    return np.stack([xx.ravel(), yy.ravel()], axis=-1)
+
+
+def probe_features_for_type(positions: np.ndarray, type_id: int) -> np.ndarray:
+    """[M, 12] engineered features of phantom particles of one type."""
+    types = np.full(positions.shape[0], type_id, dtype=np.int32)
+    return per_particle_features(positions, types, number_particles_to_use=-1)
+
+
+def pair_correlation(
+    sets: np.ndarray, num_bins: int = 64, max_radius: float | None = None
+) -> tuple[np.ndarray, np.ndarray]:
+    """Radial pair-correlation histogram g(r) of real particles around the
+    central site, from [N, P, 12] feature sets (radius is feature column 4).
+
+    Normalized by the annulus area so empty excluded-volume bins read 0 — the
+    quantity the reference masks probe maps with (amorphous notebook cell 8).
+    Returns (g_r [num_bins], bin_edges [num_bins + 1]).
+    """
+    radii = np.asarray(sets)[..., 4].ravel()
+    radii = radii[radii > 0]          # zero-padded slots sit at the origin
+    if max_radius is None:
+        max_radius = float(radii.max())
+    hist, edges = np.histogram(radii, bins=num_bins, range=(0.0, max_radius))
+    areas = np.pi * (edges[1:] ** 2 - edges[:-1] ** 2)
+    g_r = hist / (areas * max(len(radii), 1))
+    return g_r, edges
+
+
+def probe_info_maps(
+    model: PerParticleDIBModel,
+    params,
+    data_particles: np.ndarray,
+    key: Array,
+    config: AmorphousWorkloadConfig,
+) -> list[np.ndarray]:
+    """[G, G, 2] (lower, upper) info grids in nats, one per particle type.
+
+    Parity: amorphous notebook cell 8 — asymmetric M-probe x N-data bounds
+    with the shared particle encoder.
+    """
+    positions = probe_grid_positions(config.grid_side, config.grid_extent)
+    k_bank, k_type1, k_type2 = jax.random.split(key, 3)
+    idx = jax.random.randint(
+        k_bank, (config.probe_data_batch,), 0, data_particles.shape[0]
+    )
+    bank = jnp.asarray(data_particles)[idx]
+    data_mus, data_logvars = model.encode_feature(params, 0, bank)
+
+    grids = []
+    for type_id, k in ((1, k_type1), (2, k_type2)):
+        feats = jnp.asarray(probe_features_for_type(positions, type_id))
+        probe_mus, probe_logvars = model.encode_feature(params, 0, feats)
+        lower, upper = mi_sandwich_probe(
+            k, probe_mus, probe_logvars, data_mus, data_logvars
+        )
+        grid = np.stack([np.asarray(lower), np.asarray(upper)], axis=-1)
+        grids.append(grid.reshape(config.grid_side, config.grid_side, 2))
+    return grids
+
+
+class ProbeGridHook:
+    """Saves per-type probe-grid information maps at each invocation.
+
+    The g(r) density mask is computed once from the training sets; maps are
+    written as ``info_map_step{N}.png`` (amorphous notebook cell 8's
+    every-1000-steps rendering).
+    """
+
+    def __init__(
+        self,
+        outdir: str,
+        model: PerParticleDIBModel,
+        sets_train: np.ndarray,
+        config: AmorphousWorkloadConfig,
+        seed: int = 0,
+    ):
+        self.outdir = outdir
+        self.model = model
+        self.config = config
+        os.makedirs(outdir, exist_ok=True)
+        self.key = jax.random.key(seed)
+        # flat bank of real per-particle features for the data side
+        self.data_particles = np.asarray(sets_train).reshape(-1, sets_train.shape[-1])
+        g_r, edges = pair_correlation(sets_train)
+        mask = density_mask(
+            probe_grid_positions(config.grid_side, config.grid_extent),
+            g_r, edges[1:], config.grid_side,
+        )
+        self.masks = [mask, mask]
+        self.grids_by_step: dict[int, list[np.ndarray]] = {}
+
+    def __call__(self, trainer, state, epoch: int):
+        self.key, k = jax.random.split(self.key)
+        params = state.params["model"] if "model" in state.params else state.params
+        grids = probe_info_maps(
+            self.model, params, self.data_particles, k, self.config
+        )
+        self.grids_by_step[epoch] = grids
+        save_info_maps(
+            grids,
+            os.path.join(self.outdir, f"info_map_step{epoch}.png"),
+            masks=self.masks,
+            titles=["type A", "type B"],
+        )
+
+
+# ------------------------------------------------------------------- drivers
+
+def run_amorphous_workload(
+    key: Array | int = 0,
+    config: AmorphousWorkloadConfig | None = None,
+    outdir: str = "./amorphous_out",
+    steps_per_epoch: int = 1,
+    probe_maps: bool = True,
+    model_overrides: dict | None = None,
+    **fetch_kwargs,
+) -> dict:
+    """Single-schedule end-to-end run (one protocol, one beta ramp).
+
+    Returns the trained state, history (bits), MI-bound trajectory, probe-map
+    grids, and artifact paths.
+    """
+    config = config or AmorphousWorkloadConfig()
+    if isinstance(key, int):
+        key = jax.random.key(key)
+    bundle = get_dataset("amorphous_particles",
+                         number_particles_to_use=config.number_particles,
+                         **fetch_kwargs)
+    model = build_model(config, **(model_overrides or {}))
+    trainer = DIBTrainer(model, bundle, config.train_config(steps_per_epoch))
+
+    info_hook = InfoPerFeatureHook(
+        config.mi_eval_batch_size, config.mi_eval_batches
+    )
+    cadences = [max(config.eval_every // steps_per_epoch, 1)]
+    hooks = [Every(cadences[0], info_hook)]
+    probe_hook = None
+    if probe_maps and config.probe_every:
+        probe_hook = ProbeGridHook(
+            outdir, model, bundle.extras["sets_train"], config
+        )
+        cadences.append(max(config.probe_every // steps_per_epoch, 1))
+        hooks.append(Every(cadences[-1], probe_hook))
+    hook_every = int(np.gcd.reduce(cadences))
+
+    state, history = trainer.fit(key, hooks=hooks, hook_every=hook_every)
+    bits = history.to_bits()
+    entropy_y = sequence_entropy_bits(bundle.y_train.reshape(-1))
+    plane_path = save_distributed_info_plane(
+        bits.kl_per_feature, bits.loss, outdir,
+        entropy_y=entropy_y, info_plot_lims=(0.0, float(bits.total_kl.max()) + 1.0),
+    )
+    return {
+        "state": state,
+        "history": bits,
+        "bundle": bundle,
+        "entropy_y_bits": entropy_y,
+        "mi_bounds_bits": info_hook.bounds_bits,     # [T, P, 2]
+        "mi_epochs": info_hook.epochs,
+        "probe_grids": probe_hook.grids_by_step if probe_hook else {},
+        "info_plane_path": plane_path,
+    }
+
+
+def run_amorphous_sweep(
+    key: Array | int = 0,
+    config: AmorphousWorkloadConfig | None = None,
+    beta_ends: Sequence[float] | None = None,
+    num_repeats: int = 1,
+    outdir: str = "./amorphous_sweep_out",
+    steps_per_epoch: int = 50,
+    mesh=None,
+    use_mesh: bool = True,
+    model_overrides: dict | None = None,
+    **fetch_kwargs,
+) -> dict:
+    """The north-star run: the full set-transformer configuration swept over a
+    grid of beta endpoints (x seed repeats) as ONE jitted program on a
+    ``(beta, data)`` mesh.
+
+    ``beta_ends`` defaults to a log grid around the paper's 2e-1; each endpoint
+    is repeated ``num_repeats`` times with independent seeds (the papers run
+    "20 repeats per" config, chaos notebook cell 10 header). Returns per-replica
+    history records, the endpoint grid, wall-clock, and per-replica info-plane
+    artifact paths.
+    """
+    config = config or AmorphousWorkloadConfig()
+    if isinstance(key, int):
+        key = jax.random.key(key)
+    if beta_ends is None:
+        beta_ends = np.logspace(-2, 0, 8)
+    ends = np.repeat(np.asarray(beta_ends, np.float64), num_repeats)
+    num_replicas = len(ends)
+
+    bundle = get_dataset("amorphous_particles",
+                         number_particles_to_use=config.number_particles,
+                         **fetch_kwargs)
+    model = build_model(config, **(model_overrides or {}))
+    if mesh is None and use_mesh and len(jax.devices()) > 1:
+        num_beta = int(np.gcd(num_replicas, len(jax.devices())))
+        mesh = make_sweep_mesh(num_beta=num_beta)
+
+    sweep = BetaSweepTrainer(
+        model, bundle, config.train_config(steps_per_epoch),
+        config.beta_start, ends, mesh=mesh,
+    )
+    keys = jax.random.split(key, num_replicas)
+    t0 = time.time()
+    states, records = sweep.fit(keys)
+    jax.block_until_ready(states.params)
+    wall_s = time.time() - t0
+
+    entropy_y = sequence_entropy_bits(bundle.y_train.reshape(-1))
+    paths = []
+    os.makedirs(outdir, exist_ok=True)
+    for r, record in enumerate(records):
+        bits = record.to_bits()
+        paths.append(save_distributed_info_plane(
+            bits.kl_per_feature, bits.loss, outdir,
+            entropy_y=entropy_y,
+            info_plot_lims=(0.0, float(bits.total_kl.max()) + 1.0),
+            filename=f"info_plane_replica{r}_betaend{ends[r]:.2e}.png",
+        ))
+    return {
+        "states": states,
+        "records": records,
+        "beta_ends": ends,
+        "wall_clock_s": wall_s,
+        "entropy_y_bits": entropy_y,
+        "info_plane_paths": paths,
+        "mesh": mesh,
+    }
