@@ -18,6 +18,14 @@ from dib_tpu.workloads.boolean import (
     run_boolean_workload,
     shapley_values_bits,
 )
+from dib_tpu.workloads.characterization import (
+    CharacterizationResult,
+    SyntheticChannel,
+    estimate_bounds_bits,
+    monte_carlo_mi_bits,
+    run_characterization,
+    save_characterization_plots,
+)
 from dib_tpu.workloads.chaos import (
     KNOWN_ENTROPY_RATES,
     entropy_rate_scaling_curve,

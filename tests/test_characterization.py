@@ -1,0 +1,80 @@
+"""Characterization workload: the estimator-validation suite (SURVEY.md section 4).
+
+The MC oracle itself is validated against analytic values first (well-separated
+k-bit channels transmit exactly k bits; zero-scale channels transmit 0), then
+the production f32 log-space estimator is validated against the oracle.
+"""
+
+import numpy as np
+import pytest
+
+from dib_tpu.workloads.characterization import (
+    CharacterizationResult,
+    SyntheticChannel,
+    estimate_bounds_bits,
+    monte_carlo_mi_bits,
+    run_characterization,
+    save_characterization_plots,
+)
+
+
+def test_mc_oracle_analytic_limits():
+    # Well-separated 2-bit channel: exactly 2 bits.
+    ch = SyntheticChannel(input_bits=2, scale=8.0, logvar=-2.0)
+    assert monte_carlo_mi_bits(ch, num_samples=4000) == pytest.approx(2.0, abs=0.01)
+    # Zero separation: exactly 0 bits.
+    ch0 = SyntheticChannel(input_bits=2, scale=0.0)
+    assert monte_carlo_mi_bits(ch0, num_samples=4000) == pytest.approx(0.0, abs=1e-9)
+
+
+def test_mc_oracle_continuous_increases_with_scale():
+    lo = monte_carlo_mi_bits(SyntheticChannel(input_bits=0, scale=0.5),
+                             num_samples=4000, num_marginal_centers=1024)
+    hi = monte_carlo_mi_bits(SyntheticChannel(input_bits=0, scale=5.0),
+                             num_samples=4000, num_marginal_centers=1024)
+    assert 0.0 <= lo < hi
+
+
+def test_estimator_brackets_mc_truth_intermediate_regime():
+    """In the partial-information regime the sandwich must bracket the truth
+    (to within estimator noise) — the core claim of the notebook."""
+    ch = SyntheticChannel(input_bits=2, scale=1.0)
+    truth = monte_carlo_mi_bits(ch, num_samples=20_000)
+    lowers, uppers = estimate_bounds_bits(ch, batch_size=1024, num_repeats=6)
+    assert lowers.mean() <= truth + 0.02
+    assert uppers.mean() >= truth - 0.02
+    # and at B=1024 the sandwich is tight for a <=2-bit channel
+    assert uppers.mean() - lowers.mean() < 0.05
+
+
+def test_lower_bound_saturates_at_log_batch():
+    """InfoNCE lower bound <= log2(B): at 6 bits true MI and B=64 (log2=6),
+    the lower bound must be visibly capped below the truth while the upper
+    bound is not — the batch-size effect the notebook sweeps."""
+    ch = SyntheticChannel(input_bits=6, scale=8.0, logvar=-2.0)
+    lowers, uppers = estimate_bounds_bits(ch, batch_size=64, num_repeats=4)
+    assert lowers.mean() <= np.log2(64) + 0.01
+    assert uppers.mean() >= 5.5
+
+
+@pytest.mark.slow
+def test_run_characterization_sweep_and_plots(tmp_path):
+    results = run_characterization(
+        input_bits_list=(1, 0),
+        scales=(0.5, 4.0),
+        batch_sizes=(64, 256),
+        num_repeats=3,
+        mc_samples=4000,
+    )
+    assert len(results) == 2 * 2 * 2
+    for r in results:
+        assert isinstance(r, CharacterizationResult)
+        assert r.lower_mean <= r.upper_mean + 0.02
+        # residual sanity in this easy regime: within a tenth of a bit + noise
+        if r.batch_size >= 256 and r.channel.scale >= 4.0 and r.channel.is_discrete:
+            assert abs(r.lower_residual) < 0.1
+            assert abs(r.upper_residual) < 0.1
+    paths = save_characterization_plots(results, str(tmp_path))
+    assert len(paths) == 2
+    for p in paths:
+        assert (tmp_path / p.split("/")[-1]).exists()
