@@ -1,0 +1,7 @@
+"""``python -m dib_tpu`` entry point."""
+
+import sys
+
+from dib_tpu.cli import main
+
+sys.exit(main())

@@ -1,0 +1,286 @@
+"""Command-line trainer: ``python -m dib_tpu [train] --dataset ...``.
+
+Flag-surface parity with the reference's ``train.py:12-74`` (~25 flags:
+dataset selection, beta schedule, architecture specs, InfoNCE options,
+dataset-specific flags), with the reference's bugs fixed (its ``type=bool``
+flags silently coerce every string to True; here booleans use
+``BooleanOptionalAction``; its ``--infonce_shared_dimensionality`` /
+``args.infonce_space_dimensionality`` mismatch, reference ``train.py:55`` vs
+``train.py:116``, does not exist) and TPU-native extras: a beta-endpoint
+sweep grid trained as one jitted program on the ``(beta, data)`` mesh,
+deterministic seeding, and chunked host re-entry for instrumentation.
+
+Artifacts (written to ``--artifact_outdir``):
+  - ``history.npz``: beta / per-feature KL / loss / val-loss series (bits)
+  - ``distributed_info_plane.png`` (reference ``visualization.py:83-114``)
+  - compression matrices at beta checkpoints (``--save_compression_matrices_frequency``)
+  - per-feature MI bound trajectories (``--info_bounds_frequency``)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Sequence
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="dib_tpu",
+        description="Train a Distributed IB model on any registered dataset.",
+    )
+    parser.add_argument("command", nargs="?", default="train", choices=["train"],
+                        help="Subcommand (only 'train' for now).")
+    parser.add_argument("--dataset", default="boolean_circuit",
+                        help="Registered dataset name (see dib_tpu.data.available_datasets()).")
+    parser.add_argument("--data_path", type=str, default="./data/")
+    parser.add_argument("--artifact_outdir", type=str, default="./training_artifacts/")
+    parser.add_argument("--ib", action=argparse.BooleanOptionalAction, default=False,
+                        help="Vanilla IB: all features into a single bottleneck.")
+    parser.add_argument("--learning_rate", type=float, default=3e-4)
+    parser.add_argument("--beta_start", type=float, default=1e-4)
+    parser.add_argument("--beta_end", type=float, default=3e0)
+    parser.add_argument("--number_pretraining_epochs", type=int, default=10**3)
+    parser.add_argument("--number_annealing_epochs", type=int, default=10**4)
+    parser.add_argument("--batch_size", type=int, default=128)
+    parser.add_argument("--use_positional_encoding",
+                        action=argparse.BooleanOptionalAction, default=True)
+    parser.add_argument("--activation_fn", type=str, default="relu")
+    parser.add_argument("--feature_embedding_dimension", type=int, default=32)
+    parser.add_argument("--optimizer", type=str, default="adam")
+    parser.add_argument("--save_compression_matrices_frequency", type=int, default=0)
+    parser.add_argument("--feature_encoder_architecture", type=int, nargs="+",
+                        default=[128, 128])
+    parser.add_argument("--number_positional_encoding_frequencies", type=int, default=5,
+                        help="Reference convention: n yields 2^1..2^(n-1), i.e. n-1 sinusoids.")
+    parser.add_argument("--integration_network_architecture", type=int, nargs="+",
+                        default=[256, 256])
+
+    # InfoNCE (the custom-loop path, reference train.py:180-289)
+    parser.add_argument("--infonce_loss", action=argparse.BooleanOptionalAction,
+                        default=False)
+    parser.add_argument("--infonce_shared_dimensionality", type=int, default=64)
+    parser.add_argument("--infonce_y_encoder_architecture", type=int, nargs="+",
+                        default=[128, 128])
+    parser.add_argument("--infonce_similarity", type=str, default="l2",
+                        choices=["l2sq", "l2", "l1", "linf", "cosine"])
+    parser.add_argument("--infonce_temperature", type=float, default=1.0)
+
+    # Dataset specific (reference train.py:64-72)
+    parser.add_argument("--boolean_random_circuit",
+                        action=argparse.BooleanOptionalAction, default=False)
+    parser.add_argument("--boolean_number_input_gates", type=int, default=10)
+    parser.add_argument("--pendulum_time_delta", type=float, default=2)
+
+    # TPU-native extras
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--steps_per_epoch", type=int, default=0,
+                        help="0 -> ceil(num_train / batch_size).")
+    parser.add_argument("--warmup_steps", type=int, default=0)
+    parser.add_argument("--max_val_points", type=int, default=4096)
+    parser.add_argument("--info_bounds_frequency", type=int, default=0,
+                        help="Epoch cadence of per-feature MI sandwich bounds (0 = off).")
+    parser.add_argument("--sweep_beta_ends", type=float, nargs="+", default=None,
+                        help="Train a replica per end-beta as one jitted sweep "
+                             "(sharded over the mesh beta axis).")
+    parser.add_argument("--sweep_repeats", type=int, default=1,
+                        help="Independent seeds per sweep endpoint.")
+    parser.add_argument("--mesh_beta", type=int, default=None,
+                        help="Mesh beta-axis size (default: all devices).")
+    parser.add_argument("--mesh_data", type=int, default=None,
+                        help="Mesh data-axis size.")
+    return parser
+
+
+def _dataset_kwargs(args) -> dict:
+    return {
+        "data_path": args.data_path,
+        "boolean_random_circuit": args.boolean_random_circuit,
+        "boolean_number_input_gates": args.boolean_number_input_gates,
+        "pendulum_time_delta": args.pendulum_time_delta,
+        "seed": args.seed,
+    }
+
+
+def run(args) -> dict:
+    """Execute a training run from parsed flags. Returns a result summary."""
+    import jax
+    import numpy as np
+
+    from dib_tpu.data import get_dataset
+    from dib_tpu.models import DistributedIBModel, YEncoder
+    from dib_tpu.ops.entropy import sequence_entropy_bits
+    from dib_tpu.parallel import BetaSweepTrainer, make_sweep_mesh
+    from dib_tpu.train import (
+        CompressionMatrixHook,
+        DIBTrainer,
+        Every,
+        InfoPerFeatureHook,
+        TrainConfig,
+    )
+    from dib_tpu.parallel.sweep import PerReplicaHook
+    from dib_tpu.viz import save_distributed_info_plane
+
+    bundle = get_dataset(args.dataset, **_dataset_kwargs(args))
+    if args.ib:
+        bundle = bundle.as_vanilla_ib()
+    contrastive = args.infonce_loss
+    if contrastive:
+        bundle.loss = "infonce"
+
+    # n posenc frequencies in the reference convention = n-1 sinusoids
+    nfreq = (args.number_positional_encoding_frequencies - 1
+             if args.use_positional_encoding else 0)
+    model = DistributedIBModel(
+        feature_dimensionalities=tuple(bundle.feature_dimensionalities),
+        encoder_hidden=tuple(args.feature_encoder_architecture),
+        integration_hidden=tuple(args.integration_network_architecture),
+        output_dim=(args.infonce_shared_dimensionality if contrastive
+                    else bundle.output_dimensionality),
+        embedding_dim=args.feature_embedding_dimension,
+        use_positional_encoding=args.use_positional_encoding,
+        num_posenc_frequencies=max(nfreq, 0),
+        activation=args.activation_fn,
+        output_activation=bundle.output_activation,
+    )
+    y_encoder = None
+    if contrastive:
+        y_encoder = YEncoder(
+            hidden=tuple(args.infonce_y_encoder_architecture),
+            shared_dim=args.infonce_shared_dimensionality,
+            num_posenc_frequencies=max(nfreq, 0),
+            activation=args.activation_fn,
+        )
+
+    config = TrainConfig(
+        learning_rate=args.learning_rate,
+        batch_size=args.batch_size,
+        beta_start=args.beta_start,
+        beta_end=args.beta_end,
+        num_pretraining_epochs=args.number_pretraining_epochs,
+        num_annealing_epochs=args.number_annealing_epochs,
+        steps_per_epoch=args.steps_per_epoch,
+        warmup_steps=args.warmup_steps,
+        optimizer=args.optimizer,
+        max_val_points=args.max_val_points,
+        infonce_similarity=args.infonce_similarity,
+        infonce_temperature=args.infonce_temperature,
+    )
+
+    outdir = args.artifact_outdir
+    os.makedirs(outdir, exist_ok=True)
+
+    cadences = []
+    if args.save_compression_matrices_frequency:
+        cadences.append(args.save_compression_matrices_frequency)
+    if args.info_bounds_frequency:
+        cadences.append(args.info_bounds_frequency)
+    hook_every = int(np.gcd.reduce(cadences)) if cadences else 0
+
+    def make_hooks(subdir: str):
+        hooks = []
+        info_hook = None
+        if args.info_bounds_frequency:
+            info_hook = InfoPerFeatureHook(seed=args.seed)
+            hooks.append(Every(args.info_bounds_frequency, info_hook))
+        if args.save_compression_matrices_frequency:
+            hooks.append(Every(
+                args.save_compression_matrices_frequency,
+                CompressionMatrixHook(subdir, seed=args.seed),
+            ))
+        return hooks, info_hook
+
+    entropy_y = None
+    if bundle.loss_is_info_based:
+        try:
+            entropy_y = sequence_entropy_bits(np.asarray(bundle.y_train).reshape(-1))
+        except Exception:
+            entropy_y = None
+
+    summary: dict = {"dataset": args.dataset, "artifacts": []}
+
+    if args.sweep_beta_ends:
+        ends = np.repeat(np.asarray(args.sweep_beta_ends, np.float64),
+                         args.sweep_repeats)
+        mesh = None
+        if len(jax.devices()) > 1:
+            nb = args.mesh_beta or int(np.gcd(len(ends), len(jax.devices())))
+            mesh = make_sweep_mesh(num_beta=nb, num_data=args.mesh_data)
+        sweep = BetaSweepTrainer(model, bundle, config, args.beta_start, ends,
+                                 mesh=mesh, y_encoder=y_encoder)
+        replica_info_hooks: dict[int, object] = {}
+
+        def make_replica_hook(r: int):
+            hooks_r, info_hook_r = make_hooks(os.path.join(outdir, f"replica{r}"))
+            if info_hook_r is not None:
+                replica_info_hooks[r] = info_hook_r
+            return _CombinedHooks(hooks_r)
+
+        hooks = [PerReplicaHook(make_replica_hook)] if cadences else []
+        keys = jax.random.split(jax.random.key(args.seed), len(ends))
+        states, records = sweep.fit(keys, hooks=hooks, hook_every=hook_every)
+        for r, record in enumerate(records):
+            info_hook_r = replica_info_hooks.get(r)
+            if info_hook_r is not None and info_hook_r.records:
+                bounds_path = os.path.join(outdir, f"info_bounds_replica{r}.npz")
+                np.savez(bounds_path, epochs=info_hook_r.epochs,
+                         bounds_bits=info_hook_r.bounds_bits)
+                summary["artifacts"].append(bounds_path)
+            bits = record.to_bits(bundle.loss_is_info_based)
+            path = save_distributed_info_plane(
+                bits.kl_per_feature, bits.loss, outdir, entropy_y=entropy_y,
+                filename=f"distributed_info_plane_replica{r}.png",
+            )
+            np.savez(os.path.join(outdir, f"history_replica{r}.npz"),
+                     beta=bits.beta, kl_per_feature=bits.kl_per_feature,
+                     loss=bits.loss, val_loss=bits.val_loss,
+                     metric=bits.metric, val_metric=bits.val_metric)
+            summary["artifacts"].append(path)
+        summary["num_replicas"] = len(ends)
+        summary["beta_ends"] = [float(b) for b in ends]
+        summary["final_val_loss"] = [float(rec.val_loss[-1]) for rec in records]
+    else:
+        trainer = DIBTrainer(model, bundle, config, y_encoder=y_encoder)
+        hooks, info_hook = make_hooks(outdir)
+        state, history = trainer.fit(jax.random.key(args.seed), hooks=hooks,
+                                     hook_every=hook_every)
+        bits = history.to_bits(bundle.loss_is_info_based)
+        path = save_distributed_info_plane(
+            bits.kl_per_feature, bits.loss, outdir, entropy_y=entropy_y)
+        np.savez(os.path.join(outdir, "history.npz"),
+                 beta=bits.beta, kl_per_feature=bits.kl_per_feature,
+                 loss=bits.loss, val_loss=bits.val_loss,
+                 metric=bits.metric, val_metric=bits.val_metric)
+        summary["artifacts"].append(path)
+        summary["final_loss"] = float(bits.loss[-1])
+        summary["final_val_loss"] = float(bits.val_loss[-1])
+        summary["final_total_kl_bits"] = float(bits.total_kl[-1])
+        if info_hook is not None and info_hook.records:
+            np.savez(os.path.join(outdir, "info_bounds.npz"),
+                     epochs=info_hook.epochs, bounds_bits=info_hook.bounds_bits)
+            summary["artifacts"].append(os.path.join(outdir, "info_bounds.npz"))
+    return summary
+
+
+class _CombinedHooks:
+    """Folds several serial hooks into one callable (for PerReplicaHook)."""
+
+    def __init__(self, hooks: Sequence):
+        self.hooks = list(hooks)
+
+    def __call__(self, trainer, state, epoch: int):
+        for hook in self.hooks:
+            hook(trainer, state, epoch)
+
+
+def main(argv: Sequence[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    summary = run(args)
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -7,6 +7,7 @@ from dib_tpu.models.encoders import (
     FeatureEncoderBank,
     SimpleBinaryEncoder,
     SimpleBinaryEncoderBank,
+    YEncoder,
     pad_and_stack_features,
 )
 from dib_tpu.models.dib import DistributedIBModel

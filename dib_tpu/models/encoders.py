@@ -143,6 +143,28 @@ class FeatureEncoderBank(nn.Module):
         return encoder.apply({"params": inner}, x_padded)
 
 
+class YEncoder(nn.Module):
+    """Deterministic output-side encoder for InfoNCE training.
+
+    Positional encoding + MLP into the shared embedding space, the Y-side of
+    the reference's custom InfoNCE loop (reference ``train.py:186-193``).
+    """
+
+    hidden: Sequence[int] = (128, 128)
+    shared_dim: int = 64
+    num_posenc_frequencies: int = 4
+    posenc_start_power: int = 1
+    activation: str | Callable | None = "relu"
+
+    @nn.compact
+    def __call__(self, y: Array) -> Array:
+        freqs = positional_encoding_frequencies(
+            self.num_posenc_frequencies, self.posenc_start_power
+        )
+        h = positional_encoding(y, freqs)
+        return MLP(tuple(self.hidden), self.shared_dim, self.activation)(h)
+
+
 class SimpleBinaryEncoder(nn.Module):
     """Two-parameter encoder for a binary +-1 feature: x -> N(x * mu_scale, e^logvar).
 

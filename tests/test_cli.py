@@ -1,0 +1,95 @@
+"""CLI trainer: flag surface, artifact production, sweep path, InfoNCE path."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from dib_tpu.cli import build_parser, run
+
+
+def make_args(tmp_path, *extra):
+    argv = [
+        "train",
+        "--dataset", "boolean_circuit",
+        "--artifact_outdir", str(tmp_path),
+        "--number_pretraining_epochs", "5",
+        "--number_annealing_epochs", "10",
+        "--batch_size", "64",
+        "--feature_encoder_architecture", "16",
+        "--integration_network_architecture", "32",
+        "--feature_embedding_dimension", "4",
+        "--max_val_points", "256",
+        *extra,
+    ]
+    return build_parser().parse_args(argv)
+
+
+def test_parser_defaults_match_reference_surface():
+    args = build_parser().parse_args([])
+    # reference train.py defaults (train.py:12-74)
+    assert args.dataset == "boolean_circuit"
+    assert args.learning_rate == 3e-4
+    assert args.beta_start == 1e-4 and args.beta_end == 3.0
+    assert args.number_pretraining_epochs == 1000
+    assert args.number_annealing_epochs == 10000
+    assert args.batch_size == 128
+    assert args.feature_encoder_architecture == [128, 128]
+    assert args.integration_network_architecture == [256, 256]
+    assert args.number_positional_encoding_frequencies == 5
+    assert args.infonce_shared_dimensionality == 64
+    assert args.infonce_similarity == "l2"
+    assert args.use_positional_encoding is True
+    # boolean flags are real booleans, not the reference's broken type=bool
+    args2 = build_parser().parse_args(["--no-use_positional_encoding", "--ib"])
+    assert args2.use_positional_encoding is False and args2.ib is True
+
+
+@pytest.mark.slow
+def test_cli_train_produces_artifacts(tmp_path):
+    args = make_args(tmp_path, "--info_bounds_frequency", "5")
+    summary = run(args)
+    assert summary["dataset"] == "boolean_circuit"
+    assert os.path.exists(tmp_path / "history.npz")
+    assert os.path.exists(tmp_path / "distributed_info_plane.png")
+    assert os.path.exists(tmp_path / "info_bounds.npz")
+    hist = np.load(tmp_path / "history.npz")
+    assert hist["beta"].shape == (15,)
+    assert hist["kl_per_feature"].shape == (15, 10)
+    bounds = np.load(tmp_path / "info_bounds.npz")
+    assert bounds["bounds_bits"].shape[1:] == (10, 2)
+    assert np.isfinite(summary["final_val_loss"])
+    json.dumps(summary)  # summary must be JSON-serializable
+
+
+@pytest.mark.slow
+def test_cli_vanilla_ib_single_bottleneck(tmp_path):
+    args = make_args(tmp_path, "--ib")
+    summary = run(args)
+    hist = np.load(tmp_path / "history.npz")
+    assert hist["kl_per_feature"].shape == (15, 1)   # one joint bottleneck
+
+
+@pytest.mark.slow
+def test_cli_sweep_path(tmp_path):
+    args = make_args(tmp_path, "--sweep_beta_ends", "0.1", "1.0",
+                     "--sweep_repeats", "2")
+    summary = run(args)
+    assert summary["num_replicas"] == 4
+    assert len(summary["final_val_loss"]) == 4
+    for r in range(4):
+        assert os.path.exists(tmp_path / f"history_replica{r}.npz")
+        assert os.path.exists(tmp_path / f"distributed_info_plane_replica{r}.png")
+
+
+@pytest.mark.slow
+def test_cli_infonce_path(tmp_path):
+    args = make_args(
+        tmp_path, "--infonce_loss",
+        "--infonce_shared_dimensionality", "8",
+        "--infonce_y_encoder_architecture", "16",
+    )
+    summary = run(args)
+    assert np.isfinite(summary["final_val_loss"])
+    assert os.path.exists(tmp_path / "history.npz")
