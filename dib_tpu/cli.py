@@ -194,10 +194,9 @@ def run(args) -> dict:
 
     entropy_y = None
     if bundle.loss_is_info_based:
-        try:
-            entropy_y = sequence_entropy_bits(np.asarray(bundle.y_train).reshape(-1))
-        except Exception:
-            entropy_y = None
+        # sequence_entropy_bits hashes 2-D rows, so multi-column y gets the
+        # JOINT entropy (flattening would pool components into one marginal).
+        entropy_y = sequence_entropy_bits(np.asarray(bundle.y_train))
 
     summary: dict = {"dataset": args.dataset, "artifacts": []}
 
@@ -240,7 +239,11 @@ def run(args) -> dict:
             summary["artifacts"].append(path)
         summary["num_replicas"] = len(ends)
         summary["beta_ends"] = [float(b) for b in ends]
-        summary["final_val_loss"] = [float(rec.val_loss[-1]) for rec in records]
+        # same units as the serial path: bits when the loss is info-based
+        summary["final_val_loss"] = [
+            float(rec.to_bits(bundle.loss_is_info_based).val_loss[-1])
+            for rec in records
+        ]
     else:
         trainer = DIBTrainer(model, bundle, config, y_encoder=y_encoder)
         hooks, info_hook = make_hooks(outdir)

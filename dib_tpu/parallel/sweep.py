@@ -166,6 +166,9 @@ class BetaSweepTrainer:
             keys, chunk_keys = split[:, 0], split[:, 1]
             states, histories = self.run_chunk(states, histories, chunk_keys, this_chunk)
             done += this_chunk
+            # Published for CheckpointHook (see DIBTrainer.fit).
+            self.resume_key = keys
+            self.latest_history = histories
             for hook in hooks:
                 hook(self, states, int(jax.device_get(states.epoch)[0]))
         return states, sweep_records(histories)

@@ -1,0 +1,138 @@
+"""Checkpoint / resume via Orbax.
+
+The reference has NO checkpointing — no ``model.save``/``save_weights`` call
+exists anywhere; its persisted artifacts are measurements, not weights
+(SURVEY.md section 5). For pod-scale beta sweeps the framework needs real
+resume points: a checkpoint bundles (params, optimizer state, epoch, the
+device history buffer, and the NEXT chunk's PRNG key) so a resumed run
+continues the exact key chain — the continuation is bit-identical to an
+uninterrupted run with the same chunk boundaries.
+
+Sweep recovery: beta-sweep members are embarrassingly parallel, so recovery =
+restore the stacked states/histories and continue; a lost-shard re-run only
+needs the stacked checkpoint (SURVEY.md section 5, failure detection).
+
+Usage::
+
+    ckpt = DIBCheckpointer(directory)
+    hook = CheckpointHook(ckpt)
+    trainer.fit(key, hooks=[hook], hook_every=100)
+    ...
+    state, history, key = ckpt.restore(trainer)       # latest step
+    trainer.fit(key, num_epochs=remaining, state=state, history=history)
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from dib_tpu.train.history import history_init
+
+
+def _pack_key(key: jax.Array) -> dict:
+    """Typed PRNG key -> serializable {data, impl-name} payload."""
+    return {
+        "data": jax.random.key_data(key),
+        "impl": np.frombuffer(
+            str(jax.random.key_impl(key)).encode().ljust(32), dtype=np.uint8
+        ).copy(),
+    }
+
+
+def _unpack_key(payload: dict) -> jax.Array:
+    impl = bytes(np.asarray(payload["impl"])).decode().rstrip()
+    return jax.random.wrap_key_data(np.asarray(payload["data"]), impl=impl)
+
+
+class DIBCheckpointer:
+    """Orbax-backed checkpoint store for trainer (or sweep) state.
+
+    Stores a pytree ``{"state": TrainState, "history": dict, "key": uint32}``
+    per step. Works for the serial ``DIBTrainer`` and (with stacked [R, ...]
+    leaves) the ``BetaSweepTrainer`` unchanged — sharded arrays are gathered
+    by Orbax on save and restored with the template's sharding.
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        self.directory = os.path.abspath(directory)
+        self.manager = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True
+            ),
+        )
+
+    def save(self, step: int, state: Any, history: dict, key: jax.Array) -> None:
+        payload = {
+            "state": state,
+            "history": history,
+            "key": _pack_key(key),
+        }
+        # Async: the write overlaps the next training chunk; readers
+        # (restore / latest_step) wait for in-flight saves first.
+        self.manager.save(step, args=ocp.args.StandardSave(payload))
+
+    @property
+    def latest_step(self) -> int | None:
+        self.manager.wait_until_finished()
+        return self.manager.latest_step()
+
+    def restore(self, trainer, step: int | None = None, template_key=None):
+        """Restore (state, history, key) using ``trainer`` for the template.
+
+        ``trainer`` may be a ``DIBTrainer`` or ``BetaSweepTrainer``; its
+        ``init`` provides the structure/shape/dtype template Orbax needs.
+        ``template_key``: for sweeps pass the [R]-key array template (defaults
+        to the serial scalar key / an [R] grid inferred from the trainer).
+        """
+        self.manager.wait_until_finished()
+        step = self.latest_step if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"No checkpoint found in {self.directory}")
+        if template_key is None:
+            if hasattr(trainer, "num_replicas"):   # sweep
+                template_key = jax.random.split(
+                    jax.random.key(0), trainer.num_replicas
+                )
+            else:
+                template_key = jax.random.key(0)
+        # trainer.init is a cheap structure template (it runs the model once
+        # on a single batch); Orbax restores into its shapes/dtypes.
+        template_state, template_history = trainer.init(template_key)
+        template = {
+            "state": template_state,
+            "history": template_history,
+            "key": _pack_key(template_key),
+        }
+        abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
+        restored = self.manager.restore(step, args=ocp.args.StandardRestore(abstract))
+        return restored["state"], restored["history"], _unpack_key(restored["key"])
+
+    def close(self) -> None:
+        self.manager.wait_until_finished()
+        self.manager.close()
+
+
+class CheckpointHook:
+    """Saves a checkpoint at every invocation (compose with ``Every`` for a
+    cadence). Reads the resume key and live history that ``fit`` publishes on
+    the trainer before hooks run (``trainer.resume_key`` /
+    ``trainer.latest_history``)."""
+
+    def __init__(self, checkpointer: DIBCheckpointer):
+        self.checkpointer = checkpointer
+
+    def __call__(self, trainer, state, epoch: int) -> None:
+        key = getattr(trainer, "resume_key", None)
+        history = getattr(trainer, "latest_history", None)
+        if key is None or history is None:
+            raise RuntimeError(
+                "CheckpointHook needs trainer.resume_key / trainer.latest_history; "
+                "run it via fit(hooks=[...]) on a trainer that publishes them."
+            )
+        self.checkpointer.save(epoch, state, history, key)

@@ -274,6 +274,11 @@ class DIBTrainer:
             key, k_chunk = jax.random.split(key)
             state, history = self.run_chunk(state, history, k_chunk, this_chunk)
             done += this_chunk
+            # Published for CheckpointHook: resuming fit(resume_key, ...) with
+            # the same chunk size continues the exact key chain, so the
+            # continuation is bit-identical to an uninterrupted run.
+            self.resume_key = key
+            self.latest_history = history
             for hook in hooks:
                 hook(self, state, int(state.epoch))
         return state, HistoryRecord.from_device(history)

@@ -1,1 +1,8 @@
-"""dib_tpu.utils (populated incrementally)."""
+"""dib_tpu.utils: profiling/tracing helpers."""
+
+from dib_tpu.utils.profiling import (
+    PhaseTimer,
+    device_trace,
+    steps_per_second,
+    timed_blocked,
+)

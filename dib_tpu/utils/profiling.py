@@ -1,0 +1,114 @@
+"""Tracing / profiling helpers.
+
+The reference has no profiling subsystem — ad-hoc ``time.time()`` deltas
+around training loops (chaos notebook cells 7/10) are its only timing. Here
+(SURVEY.md section 5): ``jax.profiler`` trace contexts around jitted steps,
+``block_until_ready``-correct wall-clock timers, and a per-phase report —
+the north-star metric is beta-sweep wall-clock, so honest device timing is
+part of the framework, not an afterthought.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from dataclasses import dataclass, field
+
+import jax
+
+
+class _PhaseHandle:
+    """Collects the arrays a phase must block on before its interval closes."""
+
+    def __init__(self):
+        self._outputs: list = []
+
+    def block_on(self, *arrays):
+        """Register device outputs produced inside the phase; the timer blocks
+        on them at phase exit so their compute time lands in this phase."""
+        self._outputs.extend(arrays)
+        return arrays[0] if len(arrays) == 1 else arrays
+
+
+@dataclass
+class PhaseTimer:
+    """Accumulates wall-clock per named phase; async-dispatch safe.
+
+    JAX dispatch is asynchronous, so naive ``time.time()`` deltas around a
+    jitted call measure only the dispatch. Register the phase's device
+    outputs on the yielded handle and the timer blocks on them before
+    closing the interval::
+
+        with timer.phase("step") as p:
+            out = p.block_on(train_step(state))
+    """
+
+    totals: dict = field(default_factory=dict)
+    counts: dict = field(default_factory=dict)
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        handle = _PhaseHandle()
+        start = time.perf_counter()
+        try:
+            yield handle
+        finally:
+            if handle._outputs:
+                jax.block_until_ready(handle._outputs)
+            elapsed = time.perf_counter() - start
+            self.totals[name] = self.totals.get(name, 0.0) + elapsed
+            self.counts[name] = self.counts.get(name, 0) + 1
+
+    def report(self) -> dict:
+        """{phase: {"total_s", "count", "mean_s"}} summary."""
+        return {
+            name: {
+                "total_s": round(self.totals[name], 4),
+                "count": self.counts[name],
+                "mean_s": round(self.totals[name] / self.counts[name], 4),
+            }
+            for name in self.totals
+        }
+
+    def report_json(self) -> str:
+        return json.dumps(self.report())
+
+
+def timed_blocked(fn, *args, **kwargs):
+    """(result, seconds) with ``block_until_ready`` on the result — the
+    correct way to time one jitted call."""
+    start = time.perf_counter()
+    out = fn(*args, **kwargs)
+    jax.block_until_ready(out)
+    return out, time.perf_counter() - start
+
+
+@contextlib.contextmanager
+def device_trace(logdir: str | None):
+    """``jax.profiler`` trace context; no-op when ``logdir`` is None/empty.
+
+    View the trace with TensorBoard's profile plugin or Perfetto. Wrap a few
+    steady-state steps, not the compile (trace the second chunk)."""
+    if not logdir:
+        yield
+        return
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def steps_per_second(fn, *args, repeats: int = 3, warmup: int = 1, **kwargs):
+    """Throughput of a nullary-ish jitted call: runs ``warmup`` unmeasured
+    calls (compile + autotune), then ``repeats`` measured, returns
+    (calls_per_second, per_call_seconds_list)."""
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args, **kwargs))
+    times = []
+    for _ in range(repeats):
+        _, dt = timed_blocked(fn, *args, **kwargs)
+        times.append(dt)
+    mean = sum(times) / len(times)
+    return 1.0 / mean, times
