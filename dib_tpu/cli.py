@@ -193,10 +193,13 @@ def run(args) -> dict:
         return hooks, info_hook
 
     entropy_y = None
-    if bundle.loss_is_info_based:
-        # sequence_entropy_bits hashes 2-D rows, so multi-column y gets the
-        # JOINT entropy (flattening would pool components into one marginal).
-        entropy_y = sequence_entropy_bits(np.asarray(bundle.y_train))
+    y_arr = np.asarray(bundle.y_train)
+    if (bundle.loss_is_info_based and not contrastive
+            and np.allclose(y_arr, np.round(y_arr))):
+        # Discrete labels only: sequence_entropy_bits hashes 2-D rows, so
+        # multi-column y gets the JOINT entropy. Continuous y (e.g. pendulum
+        # states) would make every row unique and H(Y) a log2(N) artifact.
+        entropy_y = sequence_entropy_bits(y_arr)
 
     summary: dict = {"dataset": args.dataset, "artifacts": []}
 

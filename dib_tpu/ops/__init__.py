@@ -32,7 +32,9 @@ from dib_tpu.ops.info_bounds import (
     mi_sandwich_from_params,
     mi_sandwich_bounds,
     mi_sandwich_probe,
+    set_density_backend,
 )
+from dib_tpu.ops.pallas_density import gaussian_log_density_mat_pallas
 from dib_tpu.ops.entropy import (
     entropy_bits,
     sequence_entropy_bits,

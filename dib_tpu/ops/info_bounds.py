@@ -43,13 +43,44 @@ Array = jax.Array
 
 _NEG_INF = -1e30
 
+# 'auto': the Pallas kernel on TPU, the XLA broadcast path elsewhere.
+_DENSITY_BACKEND = "auto"
+
+
+def set_density_backend(backend: str) -> None:
+    """Select the [N, M] log-density implementation: 'auto' | 'xla' | 'pallas'.
+
+    'pallas' forces the tiled kernel (interpreter mode off-TPU — slow, for
+    tests); 'xla' forces the broadcast path; 'auto' picks per backend.
+    """
+    global _DENSITY_BACKEND
+    if backend not in ("auto", "xla", "pallas"):
+        raise ValueError(f"Unknown density backend {backend!r}")
+    if backend != _DENSITY_BACKEND:
+        _DENSITY_BACKEND = backend
+        # the choice is baked in at trace time; drop cached traces so
+        # already-jitted consumers (mi_sandwich_from_params etc.) re-trace
+        jax.clear_caches()
+
+
+def _use_pallas() -> bool:
+    if _DENSITY_BACKEND == "pallas":
+        return True
+    return _DENSITY_BACKEND == "auto" and jax.default_backend() == "tpu"
+
 
 def _log_density_blocked(u: Array, mus: Array, logvars: Array, row_block: int | None) -> Array:
-    """[N, M] log-density matrix, optionally row-blocked to bound peak memory.
+    """[N, M] log-density matrix, memory-bounded.
 
-    N not divisible by ``row_block`` is handled by zero-padding the row axis
-    (extra rows computed then sliced away) so blocking is never silently
-    dropped."""
+    Pallas path: the tiled kernel bounds VMEM by construction (row_block is
+    ignored — tiling is the kernel's own). XLA path: optional ``lax.map``
+    row-blocking; N not divisible by ``row_block`` is handled by zero-padding
+    the row axis (extra rows computed then sliced away) so blocking is never
+    silently dropped."""
+    if _use_pallas():
+        from dib_tpu.ops.pallas_density import gaussian_log_density_mat_pallas
+
+        return gaussian_log_density_mat_pallas(u, mus, logvars)
     n = u.shape[0]
     if row_block is None or row_block >= n:
         return gaussian_log_density_mat(u, mus, logvars)
@@ -157,7 +188,7 @@ def mi_sandwich_probe(
         + jnp.sum(probe_logvars, axis=-1)
         + d * jnp.log(2.0 * jnp.pi)
     )                                                             # [M]
-    log_p_data = gaussian_log_density_mat(u, data_mus, data_logvars)  # [M, N]
+    log_p_data = _log_density_blocked(u, data_mus, data_logvars, None)  # [M, N]
     # lower: denominator mean over N+1 terms including the probe's own density
     lse_with_self = jax.scipy.special.logsumexp(
         jnp.concatenate([log_p_ii[:, None], log_p_data], axis=1), axis=1

@@ -1,0 +1,92 @@
+"""Pallas TPU kernel for the pairwise Gaussian log-density matrix.
+
+The [B, B] (or [M, N]) matrix of conditional log densities
+``log p(u_i | x_j)`` is the O(B^2 d) hot spot of the MI sandwich bounds
+(SURVEY.md section 7): the XLA path materializes a [rows, cols, d] broadcast
+intermediate (bounded by ``lax.map`` row-blocking,
+``dib_tpu.ops.info_bounds._log_density_blocked``). This kernel tiles the
+output over a (rows/bm, cols/bn) grid and forms each [bm, bn, d] diff block
+in VMEM only, fusing the scale/square/reduce and the normalization constant
+into one pass — no HBM intermediate at any size.
+
+Precision note: the kernel keeps the DIRECT difference form
+``z = (u - mu) * exp(-logvar/2)`` — not the norm-expansion matmul trick —
+because the diagonal entries have u ~= mu and the expansion's cancellation
+is exactly what the log-space design must avoid
+(see ``dib_tpu.ops.gaussian.gaussian_log_density_mat``). The work is
+VPU-bound by construction; the win over XLA is memory traffic, not FLOPs.
+
+On non-TPU backends the kernel runs in interpreter mode (tests exercise it
+on the CPU mesh); dispatch is opt-in via
+``dib_tpu.ops.info_bounds.set_density_backend`` or automatic on TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+Array = jax.Array
+
+_LOG_2PI = 1.8378770664093453
+
+
+def _density_kernel(u_ref, mu_ref, lv_ref, out_ref):
+    """One [bm, bn] output tile from [bm, d] u rows and [bn, d] mu/lv rows."""
+    u = u_ref[:]                                   # [bm, d]
+    mu = mu_ref[:]                                 # [bn, d]
+    lv = lv_ref[:]                                 # [bn, d]
+    inv_std = jnp.exp(-0.5 * lv)                   # [bn, d]
+    z = (u[:, None, :] - mu[None, :, :]) * inv_std[None, :, :]   # [bm, bn, d]
+    quad = jnp.sum(z * z, axis=-1)                 # [bm, bn]
+    log_norm = jnp.sum(lv, axis=-1)[None, :]       # [1, bn]
+    d = u.shape[-1]
+    out_ref[:] = -0.5 * (quad + log_norm + d * _LOG_2PI)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_rows", "block_cols", "interpret")
+)
+def gaussian_log_density_mat_pallas(
+    u: Array,
+    mus: Array,
+    logvars: Array,
+    block_rows: int = 128,
+    block_cols: int = 128,
+    interpret: bool | None = None,
+) -> Array:
+    """[N, M] log-density matrix via the tiled Pallas kernel.
+
+    Same contract as :func:`dib_tpu.ops.gaussian.gaussian_log_density_mat`.
+    N and M need not divide the block sizes — inputs are zero-padded (zero
+    mus/logvars give finite densities) and the result sliced back.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n, d = u.shape
+    m = mus.shape[0]
+    bm = min(block_rows, max(n, 1))
+    bn = min(block_cols, max(m, 1))
+    pad_n = (-n) % bm
+    pad_m = (-m) % bn
+    u_p = jnp.pad(u, ((0, pad_n), (0, 0)))
+    mus_p = jnp.pad(mus, ((0, pad_m), (0, 0)))
+    lv_p = jnp.pad(logvars, ((0, pad_m), (0, 0)))
+
+    grid = (u_p.shape[0] // bm, mus_p.shape[0] // bn)
+    out = pl.pallas_call(
+        _density_kernel,
+        out_shape=jax.ShapeDtypeStruct((u_p.shape[0], mus_p.shape[0]), u.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bm, d), lambda i, j: (i, 0)),
+            pl.BlockSpec((bn, d), lambda i, j: (j, 0)),
+            pl.BlockSpec((bn, d), lambda i, j: (j, 0)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
+        interpret=interpret,
+    )(u_p, mus_p, lv_p)
+    return out[:n, :m]
