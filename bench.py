@@ -49,7 +49,9 @@ def main() -> None:
     log(f"devices: {devices}")
 
     bundle = get_dataset("amorphous_particles", num_synthetic_neighborhoods=2048)
-    model = PerParticleDIBModel(num_particles=50)   # full paper architecture
+    # Full paper architecture; attention/FF matmuls in bfloat16 (MXU-native,
+    # ~1.5x over f32 on v5e) — KL, sampling, and logits stay float32.
+    model = PerParticleDIBModel(num_particles=50, compute_dtype="bfloat16")
     config = TrainConfig(
         learning_rate=1e-4,
         batch_size=32,

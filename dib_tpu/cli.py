@@ -75,6 +75,10 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--pendulum_time_delta", type=float, default=2)
 
     # TPU-native extras
+    parser.add_argument("--compute_dtype", type=str, default=None,
+                        choices=[None, "float32", "bfloat16"],
+                        help="Matmul compute dtype (params stay float32); "
+                             "bfloat16 targets the MXU's native precision.")
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--steps_per_epoch", type=int, default=0,
                         help="0 -> ceil(num_train / batch_size).")
@@ -144,6 +148,8 @@ def run(args) -> dict:
         num_posenc_frequencies=max(nfreq, 0),
         activation=args.activation_fn,
         output_activation=bundle.output_activation,
+        compute_dtype=(None if args.compute_dtype in (None, "float32")
+                       else args.compute_dtype),
     )
     y_encoder = None
     if contrastive:
@@ -152,6 +158,8 @@ def run(args) -> dict:
             shared_dim=args.infonce_shared_dimensionality,
             num_posenc_frequencies=max(nfreq, 0),
             activation=args.activation_fn,
+            compute_dtype=(None if args.compute_dtype in (None, "float32")
+                           else args.compute_dtype),
         )
 
     config = TrainConfig(

@@ -49,6 +49,8 @@ class DistributedIBModel(nn.Module):
     activation: str | Callable | None = "relu"
     output_activation: str | Callable | None = None
     logvar_offset: float = 0.0
+    compute_dtype: str | None = None   # 'bfloat16' -> MXU-native matmuls;
+                                       # KL/sampling/logits stay float32
 
     @nn.compact
     def __call__(self, x: Array, key: Array, sample: bool = True):
@@ -60,6 +62,7 @@ class DistributedIBModel(nn.Module):
             activation=self.activation,
             logvar_offset=self.logvar_offset,
             use_positional_encoding=self.use_positional_encoding,
+            compute_dtype=self.compute_dtype,
             name="encoders",
         )(x)                                                     # [F, B, d] each
 
@@ -81,7 +84,9 @@ class DistributedIBModel(nn.Module):
             self.output_dim,
             self.activation,
             self.output_activation,
-            name="integration",
+            dtype=self.compute_dtype,
+            output_dtype=jnp.float32,   # logits (and any output activation)
+            name="integration",         # in float32 for loss precision
         )(embeddings)
 
         aux = {
@@ -107,6 +112,7 @@ class DistributedIBModel(nn.Module):
             activation=self.activation,
             logvar_offset=self.logvar_offset,
             use_positional_encoding=self.use_positional_encoding,
+            compute_dtype=self.compute_dtype,
         )
         return bank.apply({"params": params["params"]["encoders"]}, x)
 
@@ -121,6 +127,7 @@ class DistributedIBModel(nn.Module):
             activation=self.activation,
             logvar_offset=self.logvar_offset,
             use_positional_encoding=self.use_positional_encoding,
+            compute_dtype=self.compute_dtype,
         )
         return bank.encode_single(
             {"params": params["params"]["encoders"]}, feature_index, x_feature

@@ -61,6 +61,7 @@ class GaussianEncoder(nn.Module):
     posenc_start_power: int = 1
     activation: str | Callable | None = "relu"
     logvar_offset: float = 0.0
+    compute_dtype: str | None = None
 
     @nn.compact
     def __call__(self, x: Array) -> tuple[Array, Array]:
@@ -68,7 +69,10 @@ class GaussianEncoder(nn.Module):
             self.num_posenc_frequencies, self.posenc_start_power
         )
         h = positional_encoding(x, freqs)
-        out = MLP(self.hidden, 2 * self.embedding_dim, self.activation)(h)
+        # channel parameters always float32 (output_dtype): KL, sampling, and
+        # the MI bounds are precision-critical regardless of the matmul dtype
+        out = MLP(self.hidden, 2 * self.embedding_dim, self.activation,
+                  dtype=self.compute_dtype, output_dtype=jnp.float32)(h)
         mus, logvars = jnp.split(out, 2, axis=-1)
         return mus, logvars + self.logvar_offset
 
@@ -92,6 +96,7 @@ class FeatureEncoderBank(nn.Module):
     activation: str | Callable | None = "relu"
     logvar_offset: float = 0.0
     use_positional_encoding: bool = True
+    compute_dtype: str | None = None
 
     @nn.compact
     def __call__(self, x: Array) -> tuple[Array, Array]:
@@ -111,6 +116,7 @@ class FeatureEncoderBank(nn.Module):
             posenc_start_power=self.posenc_start_power,
             activation=self.activation,
             logvar_offset=self.logvar_offset,
+            compute_dtype=self.compute_dtype,
         )
         return bank(stacked)
 
@@ -137,6 +143,7 @@ class FeatureEncoderBank(nn.Module):
             posenc_start_power=self.posenc_start_power,
             activation=self.activation,
             logvar_offset=self.logvar_offset,
+            compute_dtype=self.compute_dtype,
         )
         # The vmapped bank nests each encoder's params under 'VmapGaussianEncoder_0'.
         inner = single_params[next(iter(single_params))]
@@ -155,6 +162,7 @@ class YEncoder(nn.Module):
     num_posenc_frequencies: int = 4
     posenc_start_power: int = 1
     activation: str | Callable | None = "relu"
+    compute_dtype: str | None = None
 
     @nn.compact
     def __call__(self, y: Array) -> Array:
@@ -162,7 +170,9 @@ class YEncoder(nn.Module):
             self.num_posenc_frequencies, self.posenc_start_power
         )
         h = positional_encoding(y, freqs)
-        return MLP(tuple(self.hidden), self.shared_dim, self.activation)(h)
+        # embeddings feed the InfoNCE similarity matrix: final layer float32
+        return MLP(tuple(self.hidden), self.shared_dim, self.activation,
+                   dtype=self.compute_dtype, output_dtype=jnp.float32)(h)
 
 
 class SimpleBinaryEncoder(nn.Module):

@@ -38,17 +38,26 @@ class MLP(nn.Module):
       output_dim: width of the final (linear unless output_activation) layer.
       activation: hidden-layer activation (name or callable).
       output_activation: optional activation on the output layer.
+      dtype: computation dtype for the matmuls (params stay float32);
+        'bfloat16' targets the MXU's native precision on TPU.
+      output_dtype: dtype override for the FINAL layer (None -> ``dtype``).
+        Set to 'float32' when the output feeds precision-critical math
+        (logits into losses, Gaussian channel parameters into KL/MI bounds)
+        so only the hidden layers run reduced-precision.
     """
 
     hidden: Sequence[int]
     output_dim: int
     activation: str | Callable | None = "relu"
     output_activation: str | Callable | None = None
+    dtype: str | None = None
+    output_dtype: str | None = None
 
     @nn.compact
     def __call__(self, x: Array) -> Array:
         act = resolve_activation(self.activation)
         for width in self.hidden:
-            x = act(nn.Dense(width)(x))
-        x = nn.Dense(self.output_dim)(x)
+            x = act(nn.Dense(width, dtype=self.dtype)(x))
+        final_dtype = self.output_dtype if self.output_dtype is not None else self.dtype
+        x = nn.Dense(self.output_dim, dtype=final_dtype)(x)
         return resolve_activation(self.output_activation)(x)

@@ -52,6 +52,8 @@ class PerParticleDIBModel(nn.Module):
     head_hidden: Sequence[int] = (256,)
     output_dim: int = 1
     activation: str | Callable | None = "relu"
+    compute_dtype: str | None = None   # 'bfloat16' -> MXU-native matmuls;
+                                       # KL/sampling/logits stay float32
 
     @nn.nowrap
     def _encoder(self, name: str | None = None) -> GaussianEncoder:
@@ -64,6 +66,7 @@ class PerParticleDIBModel(nn.Module):
             num_posenc_frequencies=0,   # engineered 12-dim features, no posenc
             activation=self.activation,
             logvar_offset=self.logvar_offset,
+            compute_dtype=self.compute_dtype,
             name=name,
         )
 
@@ -88,6 +91,7 @@ class PerParticleDIBModel(nn.Module):
             ff_hidden=tuple(self.ff_hidden),
             head_hidden=tuple(self.head_hidden),
             output_dim=self.output_dim,
+            compute_dtype=self.compute_dtype,
             name="aggregator",
         )(u)
 

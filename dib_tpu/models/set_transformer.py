@@ -17,6 +17,7 @@ from typing import Callable, Sequence
 
 import flax.linen as nn
 import jax
+import jax.numpy as jnp
 
 from dib_tpu.models.mlp import MLP, resolve_activation
 
@@ -24,13 +25,19 @@ Array = jax.Array
 
 
 class SetAttentionBlock(nn.Module):
-    """Post-LN self-attention block: x + MHA(x) -> LN -> (+FF) -> LN."""
+    """Post-LN self-attention block: x + MHA(x) -> LN -> (+FF) -> LN.
+
+    ``compute_dtype='bfloat16'`` runs the attention and feed-forward matmuls
+    at the MXU's native precision; LayerNorms and residual sums stay float32
+    (the standard TPU mixed-precision recipe — params are float32 either way).
+    """
 
     num_heads: int = 12
     key_dim: int = 128
     ff_hidden: Sequence[int] = (128,)
     model_dim: int = 32
     ff_activation: str | Callable | None = "relu"
+    compute_dtype: str | None = None
 
     @nn.compact
     def __call__(self, x: Array) -> Array:
@@ -38,11 +45,12 @@ class SetAttentionBlock(nn.Module):
             num_heads=self.num_heads,
             qkv_features=self.num_heads * self.key_dim,
             out_features=self.model_dim,
+            dtype=self.compute_dtype,
         )(x, x)
-        h = nn.LayerNorm()(x + attn)
+        h = nn.LayerNorm(dtype=jnp.float32)(x + attn.astype(x.dtype))
         ff = MLP(tuple(self.ff_hidden), self.model_dim, self.ff_activation,
-                 output_activation=self.ff_activation)(h)
-        return nn.LayerNorm()(h + ff)
+                 output_activation=self.ff_activation, dtype=self.compute_dtype)(h)
+        return nn.LayerNorm(dtype=jnp.float32)(h + ff.astype(h.dtype))
 
 
 class SetTransformer(nn.Module):
@@ -57,6 +65,7 @@ class SetTransformer(nn.Module):
     output_dim: int = 1
     ff_activation: str | Callable | None = "relu"
     head_activation: str | Callable | None = "leaky_relu"
+    compute_dtype: str | None = None
 
     @nn.compact
     def __call__(self, x: Array) -> Array:
@@ -68,10 +77,12 @@ class SetTransformer(nn.Module):
                 ff_hidden=tuple(self.ff_hidden),
                 model_dim=self.model_dim,
                 ff_activation=self.ff_activation,
+                compute_dtype=self.compute_dtype,
             )(x)
         pooled = x.mean(axis=-2)
         act = resolve_activation(self.head_activation)
         h = pooled
         for width in self.head_hidden:
-            h = act(nn.Dense(width)(h))
-        return nn.Dense(self.output_dim)(h)
+            h = act(nn.Dense(width, dtype=self.compute_dtype)(h))
+        # logits in float32 regardless of the compute dtype (loss precision)
+        return nn.Dense(self.output_dim, dtype=jnp.float32)(h.astype(jnp.float32))
