@@ -137,6 +137,8 @@ def run(args) -> dict:
     # n posenc frequencies in the reference convention = n-1 sinusoids
     nfreq = (args.number_positional_encoding_frequencies - 1
              if args.use_positional_encoding else 0)
+    compute_dtype = (None if args.compute_dtype in (None, "float32")
+                     else args.compute_dtype)
     model = DistributedIBModel(
         feature_dimensionalities=tuple(bundle.feature_dimensionalities),
         encoder_hidden=tuple(args.feature_encoder_architecture),
@@ -148,8 +150,7 @@ def run(args) -> dict:
         num_posenc_frequencies=max(nfreq, 0),
         activation=args.activation_fn,
         output_activation=bundle.output_activation,
-        compute_dtype=(None if args.compute_dtype in (None, "float32")
-                       else args.compute_dtype),
+        compute_dtype=compute_dtype,
     )
     y_encoder = None
     if contrastive:
@@ -158,8 +159,7 @@ def run(args) -> dict:
             shared_dim=args.infonce_shared_dimensionality,
             num_posenc_frequencies=max(nfreq, 0),
             activation=args.activation_fn,
-            compute_dtype=(None if args.compute_dtype in (None, "float32")
-                           else args.compute_dtype),
+            compute_dtype=compute_dtype,
         )
 
     config = TrainConfig(

@@ -116,21 +116,38 @@ def build_neighborhood_arrays(positions, types, number_particles_to_use=50):
     )
 
 
-def load_glass_protocol(data_dir: str, protocol: str, number_particles_to_use: int = 50):
-    """Load a real {protocol}.npz (as produced by the reference's csv ingestion,
-    amorphous notebook cell 3) into train/valid arrays, or None if missing."""
+def load_glass_splits(data_dir: str, protocol: str):
+    """Raw (positions, types, labels) per split from a real {protocol}.npz
+    (as produced by the reference's csv ingestion, amorphous notebook cell 3),
+    or None if missing. Shared by the per-particle and radial-shell loaders."""
     path = os.path.join(data_dir, f"{protocol}.npz")
     if not os.path.exists(path):
         return None
     pkl = np.load(path, allow_pickle=True)
     out = {}
     for split in ("train", "val"):
-        feats = build_neighborhood_arrays(
-            pkl[f"{split}_particle_positions"], pkl[f"{split}_types"], number_particles_to_use
-        )
         labels = np.squeeze(np.concatenate(pkl[f"{split}_is_loci"])).reshape(-1, 1)
-        out[split] = (feats, labels.astype(np.float32))
+        out[split] = (
+            pkl[f"{split}_particle_positions"],
+            pkl[f"{split}_types"],
+            labels.astype(np.float32),
+        )
     return out
+
+
+def load_glass_protocol(data_dir: str, protocol: str, number_particles_to_use: int = 50):
+    """Per-particle feature arrays per split from a real {protocol}.npz, or
+    None if missing."""
+    splits = load_glass_splits(data_dir, protocol)
+    if splits is None:
+        return None
+    return {
+        split: (
+            build_neighborhood_arrays(pos, typ, number_particles_to_use),
+            labels,
+        )
+        for split, (pos, typ, labels) in splits.items()
+    }
 
 
 @register_dataset("amorphous_particles")
@@ -200,15 +217,7 @@ def fetch_amorphous_radial_shells(
     area. These feed the standard DistributedIBModel (one bottleneck per
     shell-type feature), exactly the tabular pipeline with physics features.
     """
-    real = None
-    path = os.path.join(data_path, f"{protocol}.npz")
-    if os.path.exists(path):
-        pkl = np.load(path, allow_pickle=True)
-        real = {
-            split: (pkl[f"{split}_particle_positions"], pkl[f"{split}_types"],
-                    np.squeeze(np.concatenate(pkl[f"{split}_is_loci"])).reshape(-1, 1))
-            for split in ("train", "val")
-        }
+    real = load_glass_splits(data_path, protocol)
 
     if real is None:
         pos, typ, labels = synthetic_glass_neighborhoods(num_synthetic_neighborhoods, seed=seed)

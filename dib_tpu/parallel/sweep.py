@@ -206,6 +206,41 @@ class BetaSweepTrainer:
             state.params["model"], feature_index, x_feature
         )
 
+    # ---------------------------------------------------------- recovery
+    def recover_replica(self, states, histories, keys, r: int):
+        """Carve out sweep member ``r`` for independent re-running.
+
+        Sweep members are embarrassingly parallel, so recovery from a lost
+        shard = restore the stacked checkpoint, slice member ``r``, and
+        continue it as a 1-replica sweep on any device (SURVEY.md section 5,
+        failure detection / elastic recovery). The continuation uses the same
+        key chain and beta schedule as the member would have inside the full
+        sweep; XLA may order float32 reductions differently at a different
+        sweep width, so agreement is to float tolerance (~1e-8 per step,
+        amplified by training dynamics) — bitwise identity holds only when
+        resuming at the original width (see ``DIBCheckpointer``).
+
+        IMPORTANT: the epoch-key chain depends on chunk boundaries (``fit``
+        splits one key per chunk). Continue with the SAME chunk size as the
+        original run (same ``hook_every``, passing a no-op hook if needed) —
+        a single big chunk would draw a different key per epoch and the
+        recovered trajectory would be a different (valid but incomparable)
+        sample of the same config.
+
+        Returns ``(sub_sweep, state_r, history_r, key_r)``, each keeping the
+        leading replica axis (length 1) — continue with
+        ``sub_sweep.fit(key_r, n, states=state_r, histories=history_r)``.
+        """
+        sub = BetaSweepTrainer(
+            self.base.model, self.base.bundle, self.base.config,
+            jax.device_get(self.beta_starts)[r : r + 1],
+            jax.device_get(self.beta_ends)[r : r + 1],
+            y_encoder=self.base.y_encoder,
+        )
+        state_r = jax.tree.map(lambda a: a[r : r + 1], states)
+        history_r = jax.tree.map(lambda a: a[r : r + 1], histories)
+        return sub, state_r, history_r, keys[r : r + 1]
+
 
 class PerReplicaHook:
     """Adapts a serial-trainer hook to sweeps: one independent instance per

@@ -92,3 +92,17 @@ class HistoryRecord:
     @property
     def total_kl(self) -> np.ndarray:
         return self.kl_per_feature.sum(-1)
+
+    @property
+    def combined_loss(self) -> np.ndarray:
+        """The reference's *reported* loss series: task + beta * total KL.
+
+        The reference's Keras history logs the combined objective and un-mixes
+        it on host afterwards (``train.py:169-174``); this framework records
+        the components separately, so the combined series is reconstructed
+        here for info-plane trajectory parity checks. Use the raw (nats)
+        record for exact parity with the reference's objective; after
+        ``to_bits`` the identity still holds for info-based losses (both
+        terms scale by 1/ln2) but NOT for e.g. MSE, where to_bits converts
+        only the KL."""
+        return self.loss + self.beta * self.total_kl

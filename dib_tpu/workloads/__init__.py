@@ -26,6 +26,10 @@ from dib_tpu.workloads.characterization import (
     run_characterization,
     save_characterization_plots,
 )
+from dib_tpu.workloads.radial_shells import (
+    RadialShellsConfig,
+    run_radial_shells_workload,
+)
 from dib_tpu.workloads.chaos import (
     KNOWN_ENTROPY_RATES,
     entropy_rate_scaling_curve,

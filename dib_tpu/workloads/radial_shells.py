@@ -1,0 +1,141 @@
+"""Amorphous-plasticity radial-density-shell workload.
+
+The reference's radial-density notebook is a missing blob in the mirror
+(``/root/reference/.MISSING_LARGE_BLOBS``); per SURVEY.md section 0 it is the
+standard ``DistributedIBNet`` tabular path over per-shell density features:
+each radial shell (x particle type) is one scalar feature with its own
+bottleneck, and the beta anneal maps out which shells carry information about
+whether the central site is a rearrangement locus.
+
+This driver is that reconstruction: the ``amorphous_radial_shells`` dataset
+(``dib_tpu.data.amorphous.fetch_amorphous_radial_shells``) through the
+standard ``DistributedIBModel`` + ``DIBTrainer`` with MI-bound hooks and the
+distributed info plane, plus the per-shell information profile (information
+vs shell radius) — the workload's headline figure.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+
+from dib_tpu.data.registry import get_dataset
+from dib_tpu.models.dib import DistributedIBModel
+from dib_tpu.ops.entropy import sequence_entropy_bits
+from dib_tpu.train.hooks import Every, InfoPerFeatureHook
+from dib_tpu.train.loop import DIBTrainer, TrainConfig
+from dib_tpu.viz.info_plane import save_distributed_info_plane
+
+Array = jax.Array
+
+
+@dataclass(frozen=True)
+class RadialShellsConfig:
+    """Tabular-path defaults (reference CLI scale, shrunk pretraining)."""
+
+    learning_rate: float = 3e-4
+    batch_size: int = 128
+    beta_start: float = 1e-4
+    beta_end: float = 1.0
+    num_pretraining_epochs: int = 200
+    num_annealing_epochs: int = 2000
+    num_shells: int = 10
+    max_radius: float = 8.0
+    encoder_hidden: tuple = (64, 64)
+    integration_hidden: tuple = (128, 128)
+    embedding_dim: int = 8
+    eval_every: int = 200
+    mi_eval_batch_size: int = 1024
+    mi_eval_batches: int = 4
+
+
+def run_radial_shells_workload(
+    key: Array | int = 0,
+    config: RadialShellsConfig | None = None,
+    outdir: str = "./radial_shells_out",
+    **fetch_kwargs,
+) -> dict:
+    """Train the per-shell DIB and produce the information-vs-radius profile.
+
+    Returns the trained state, history (bits), per-shell MI bounds at each
+    check, the final per-shell information profile, and artifact paths.
+    """
+    config = config or RadialShellsConfig()
+    if isinstance(key, int):
+        key = jax.random.key(key)
+    bundle = get_dataset(
+        "amorphous_radial_shells",
+        num_shells=config.num_shells,
+        max_radius=config.max_radius,
+        **fetch_kwargs,
+    )
+    model = DistributedIBModel(
+        feature_dimensionalities=tuple(bundle.feature_dimensionalities),
+        encoder_hidden=config.encoder_hidden,
+        integration_hidden=config.integration_hidden,
+        output_dim=bundle.output_dimensionality,
+        embedding_dim=config.embedding_dim,
+    )
+    trainer = DIBTrainer(model, bundle, TrainConfig(
+        learning_rate=config.learning_rate,
+        batch_size=config.batch_size,
+        beta_start=config.beta_start,
+        beta_end=config.beta_end,
+        num_pretraining_epochs=config.num_pretraining_epochs,
+        num_annealing_epochs=config.num_annealing_epochs,
+    ))
+    info_hook = InfoPerFeatureHook(config.mi_eval_batch_size, config.mi_eval_batches)
+    state, history = trainer.fit(
+        key, hooks=[Every(config.eval_every, info_hook)], hook_every=config.eval_every
+    )
+    bits = history.to_bits()
+    entropy_y = sequence_entropy_bits(np.asarray(bundle.y_train))
+
+    os.makedirs(outdir, exist_ok=True)
+    plane_path = save_distributed_info_plane(
+        bits.kl_per_feature, bits.loss, outdir, entropy_y=entropy_y,
+        info_plot_lims=(0.0, float(bits.total_kl.max()) + 1.0),
+    )
+    profile_path = _save_shell_profile(
+        info_hook, bundle.extras["shell_edges"], config.num_shells,
+        os.path.join(outdir, "information_vs_radius.png"),
+    )
+    return {
+        "state": state,
+        "history": bits,
+        "bundle": bundle,
+        "entropy_y_bits": entropy_y,
+        "mi_bounds_bits": info_hook.bounds_bits,       # [T, 2*num_shells, 2]
+        "mi_epochs": info_hook.epochs,
+        "final_shell_profile_bits": (
+            info_hook.bounds_bits[-1, :, 0] if info_hook.records else None
+        ),
+        "info_plane_path": plane_path,
+        "profile_path": profile_path,
+    }
+
+
+def _save_shell_profile(info_hook, shell_edges, num_shells, path) -> str | None:
+    """Information (lower bound, bits) vs shell radius, one curve per type."""
+    if not info_hook.records:
+        return None
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    final = info_hook.bounds_bits[-1, :, 0]            # [2 * num_shells]
+    centers = 0.5 * (np.asarray(shell_edges)[:-1] + np.asarray(shell_edges)[1:])
+    fig, ax = plt.subplots(figsize=(6, 4))
+    for t, label in enumerate("AB"):
+        ax.plot(centers, final[t * num_shells:(t + 1) * num_shells],
+                marker="o", label=f"type {label}")
+    ax.set(xlabel="shell radius", ylabel="information (bits, InfoNCE lower)",
+           title="Where the information lives, by radius")
+    ax.legend()
+    fig.savefig(path, dpi=150, bbox_inches="tight")
+    plt.close(fig)
+    return path

@@ -104,6 +104,59 @@ def test_sweep_checkpoint_roundtrip(tmp_path):
     ckpt.close()
 
 
+@pytest.mark.slow
+def test_lost_sweep_member_recovery(tmp_path):
+    """Elastic recovery (SURVEY.md section 5): a lost sweep member re-run from
+    the stacked checkpoint as a 1-replica sweep reproduces the full sweep's
+    result for that member — same key chain and schedule; agreement to float
+    tolerance (XLA reduction order differs across sweep widths, and ulp-level
+    differences amplify through training)."""
+    bundle = get_dataset("boolean_circuit")
+    model = DistributedIBModel(
+        feature_dimensionalities=tuple(bundle.feature_dimensionalities),
+        encoder_hidden=(8,), integration_hidden=(16,),
+        output_dim=1, embedding_dim=2,
+    )
+    config = TrainConfig(
+        batch_size=64, num_pretraining_epochs=2, num_annealing_epochs=4,
+        steps_per_epoch=2, max_val_points=128,
+    )
+    keys = jax.random.split(jax.random.key(3), 2)
+
+    # Full run with a checkpoint halfway.
+    sweep = BetaSweepTrainer(model, bundle, config, 1e-4, [0.1, 1.0])
+    ckpt = DIBCheckpointer(str(tmp_path / "ck"))
+
+    def save_at_3(trainer, states, epoch):
+        if epoch == 3:
+            CheckpointHook(ckpt)(trainer, states, epoch)
+
+    states_full, records_full = sweep.fit(keys, hooks=[save_at_3], hook_every=3)
+
+    # "Member 1 was lost": restore the stacked checkpoint, carve it out,
+    # continue the remaining 3 epochs independently.
+    sweep2 = BetaSweepTrainer(model, bundle, config, 1e-4, [0.1, 1.0])
+    states_3, hists_3, keys_3 = ckpt.restore(sweep2)
+    sub, state_r, hist_r, key_r = sweep2.recover_replica(states_3, hists_3, keys_3, 1)
+    states_rec, records_rec = sub.fit(
+        key_r, num_epochs=3, states=state_r, histories=hist_r, hook_every=3,
+        hooks=[lambda *a: None],
+    )
+
+    # beta schedule: deterministic scalar math, exact at any width
+    np.testing.assert_array_equal(records_full[1].beta, records_rec[0].beta)
+    # loss trajectory and params: float-tolerance agreement (ulp differences
+    # from the width change, amplified over the 3 continued epochs)
+    np.testing.assert_allclose(
+        records_full[1].loss, records_rec[0].loss, rtol=0.05, atol=5e-3
+    )
+    want = jax.tree.map(lambda a: np.asarray(a)[1], states_full.params)
+    got = jax.tree.map(lambda a: np.asarray(a)[0], states_rec.params)
+    for w, g in zip(jax.tree.leaves(want), jax.tree.leaves(got)):
+        np.testing.assert_allclose(w, g, atol=5e-3)
+    ckpt.close()
+
+
 def test_restore_without_checkpoint_raises(tmp_path):
     ckpt = DIBCheckpointer(str(tmp_path / "empty"))
     with pytest.raises(FileNotFoundError):
