@@ -158,7 +158,8 @@ class BetaSweepTrainer:
                 f"History buffer holds {capacity} epochs/replica but {cursor} are "
                 f"already recorded and {num_epochs} more were requested."
             )
-        chunk = hook_every if (hook_every and hooks) else num_epochs
+        # chunking decoupled from hooks — see DIBTrainer.fit
+        chunk = hook_every if hook_every else num_epochs
         done = 0
         while done < num_epochs:
             this_chunk = min(chunk, num_epochs - done)

@@ -267,7 +267,10 @@ class DIBTrainer:
                 f"recorded and {num_epochs} more were requested; allocate a larger "
                 f"buffer (history_init) or train fewer epochs."
             )
-        chunk = hook_every if (hook_every and hooks) else num_epochs
+        # hook_every bounds chunk size even with no hooks (very long device
+        # programs can exceed runtime execution limits); note the chunk
+        # boundaries define the PRNG chain (one key split per chunk)
+        chunk = hook_every if hook_every else num_epochs
         done = 0
         while done < num_epochs:
             this_chunk = min(chunk, num_epochs - done)

@@ -275,6 +275,8 @@ def run_amorphous_sweep(
     mesh=None,
     use_mesh: bool = True,
     model_overrides: dict | None = None,
+    hooks=(),
+    chunk_epochs: int = 25,
     **fetch_kwargs,
 ) -> dict:
     """The north-star run: the full set-transformer configuration swept over a
@@ -309,7 +311,9 @@ def run_amorphous_sweep(
     )
     keys = jax.random.split(key, num_replicas)
     t0 = time.time()
-    states, records = sweep.fit(keys)
+    # chunk_epochs bounds single-dispatch size (very long device programs
+    # can exceed runtime execution limits) and gives hooks their cadence
+    states, records = sweep.fit(keys, hooks=list(hooks), hook_every=chunk_epochs)
     jax.block_until_ready(states.params)
     wall_s = time.time() - t0
 

@@ -25,7 +25,7 @@ import numpy as np
 from dib_tpu.data.registry import get_dataset
 from dib_tpu.models.dib import DistributedIBModel
 from dib_tpu.ops.entropy import sequence_entropy_bits
-from dib_tpu.train.hooks import Every, InfoPerFeatureHook
+from dib_tpu.train.hooks import InfoPerFeatureHook
 from dib_tpu.train.loop import DIBTrainer, TrainConfig
 from dib_tpu.viz.info_plane import save_distributed_info_plane
 
@@ -87,9 +87,11 @@ def run_radial_shells_workload(
         num_pretraining_epochs=config.num_pretraining_epochs,
         num_annealing_epochs=config.num_annealing_epochs,
     ))
+    # bare hook (no Every wrapper): fit invokes hooks after EVERY chunk,
+    # including a short final one, so the last evaluation is never skipped
     info_hook = InfoPerFeatureHook(config.mi_eval_batch_size, config.mi_eval_batches)
     state, history = trainer.fit(
-        key, hooks=[Every(config.eval_every, info_hook)], hook_every=config.eval_every
+        key, hooks=[info_hook], hook_every=config.eval_every
     )
     bits = history.to_bits()
     entropy_y = sequence_entropy_bits(np.asarray(bundle.y_train))
@@ -122,10 +124,7 @@ def _save_shell_profile(info_hook, shell_edges, num_shells, path) -> str | None:
     """Information (lower bound, bits) vs shell radius, one curve per type."""
     if not info_hook.records:
         return None
-    import matplotlib
-
-    matplotlib.use("Agg")
-    import matplotlib.pyplot as plt
+    import matplotlib.pyplot as plt  # Agg already set by dib_tpu.viz import
 
     final = info_hook.bounds_bits[-1, :, 0]            # [2 * num_shells]
     centers = 0.5 * (np.asarray(shell_edges)[:-1] + np.asarray(shell_edges)[1:])

@@ -1,0 +1,56 @@
+"""NaN-safety under jax_debug_nans (SURVEY.md section 5, sanitizers row).
+
+With ``jax_debug_nans`` enabled JAX re-runs any primitive that produced a
+NaN eagerly and raises — the functional-purity analogue of a sanitizer.
+The train step must stay NaN-free even at aggressive beta and learning
+rates (log-space bounds and f32-safe schedule math are what make this
+hold; the reference's density-space math would NaN here).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from dib_tpu.data import get_dataset
+from dib_tpu.models import DistributedIBModel
+from dib_tpu.ops import mi_sandwich_from_params
+from dib_tpu.train import DIBTrainer, TrainConfig
+
+
+@pytest.fixture
+def debug_nans():
+    jax.config.update("jax_debug_nans", True)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_debug_nans", False)
+
+
+def test_train_chunk_nan_free_under_debug_nans(debug_nans):
+    bundle = get_dataset("boolean_circuit")
+    model = DistributedIBModel(
+        feature_dimensionalities=tuple(bundle.feature_dimensionalities),
+        encoder_hidden=(8,), integration_hidden=(16,),
+        output_dim=1, embedding_dim=2,
+    )
+    # aggressive corner: large beta from the start, hot learning rate
+    config = TrainConfig(
+        learning_rate=3e-2, batch_size=64, beta_start=5.0, beta_end=50.0,
+        num_pretraining_epochs=1, num_annealing_epochs=5, steps_per_epoch=2,
+        max_val_points=128,
+    )
+    trainer = DIBTrainer(model, bundle, config)
+    state, history = trainer.fit(jax.random.key(0))   # raises on any NaN
+    rec = history.to_bits()
+    assert np.isfinite(rec.loss).all()
+    assert np.isfinite(rec.kl_per_feature).all()
+
+
+def test_mi_bounds_nan_free_under_debug_nans(debug_nans):
+    # extreme separations / tiny variances — the regime that NaNs in density
+    # space (reference utils.py:54-57) but not in log space
+    rng = np.random.default_rng(0)
+    mus = jax.numpy.asarray(rng.normal(scale=50.0, size=(128, 8)), jax.numpy.float32)
+    logvars = jax.numpy.full((128, 8), -12.0, jax.numpy.float32)
+    lower, upper = mi_sandwich_from_params(jax.random.key(0), mus, logvars)
+    assert np.isfinite(float(lower)) and np.isfinite(float(upper))
