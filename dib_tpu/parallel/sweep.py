@@ -102,9 +102,17 @@ class BetaSweepTrainer:
         return keys
 
     # ------------------------------------------------------------ chunk scan
-    @partial(jax.jit, static_argnames=("self", "num_epochs"))
+    @partial(
+        jax.jit,
+        static_argnames=("self", "num_epochs"),
+        donate_argnames=("states", "histories"),
+    )
     def run_chunk(self, states, histories, keys, num_epochs: int):
-        """Scan ``num_epochs`` epochs for all replicas, fully on device."""
+        """Scan ``num_epochs`` epochs for all replicas, fully on device.
+
+        Stacked replica states/histories are donated (see
+        ``DIBTrainer.run_chunk``) — at R replicas the in-place reuse saves a
+        full copy of R x (params + opt state + history) in HBM per chunk."""
 
         def epoch(carry, ks):
             states, hists = carry
@@ -139,6 +147,9 @@ class BetaSweepTrainer:
 
         ``hooks`` are called as ``hook(sweep_trainer, states, epoch)``.
         Returns the stacked final states and one ``HistoryRecord`` per replica.
+
+        Caller-supplied ``states``/``histories`` are CONSUMED (buffers
+        donated to the first chunk on accelerators) — see ``DIBTrainer.fit``.
         """
         keys = self._check_keys(keys)
         num_epochs = self.base.config.num_epochs if num_epochs is None else num_epochs

@@ -218,9 +218,18 @@ class DIBTrainer:
         }
         return TrainState(params, opt_state, state.epoch + 1), row
 
-    @partial(jax.jit, static_argnames=("self", "num_epochs"))
+    @partial(
+        jax.jit,
+        static_argnames=("self", "num_epochs"),
+        donate_argnames=("state", "history"),
+    )
     def run_chunk(self, state: TrainState, history: dict, key: Array, num_epochs: int):
-        """Scan ``num_epochs`` epochs fully on device."""
+        """Scan ``num_epochs`` epochs fully on device.
+
+        ``state``/``history`` buffers are donated: the inputs are dead after
+        the call (callers rebind to the returned values), so XLA reuses their
+        HBM in place instead of holding params + optimizer state + history
+        twice."""
 
         def body(carry, k):
             state, history = carry
@@ -249,6 +258,11 @@ class DIBTrainer:
         equivalent of the reference's Keras callbacks
         (``InfoPerFeatureCallback`` / ``SaveCompressionMatricesCallback``,
         reference ``models.py:152-223``).
+
+        A caller-supplied ``state``/``history`` (e.g. restored from a
+        checkpoint) is CONSUMED: on accelerators its buffers are donated to
+        the first chunk and must not be reused afterwards. To branch two
+        runs from one checkpoint, restore (or copy) once per branch.
         """
         num_epochs = self.config.num_epochs if num_epochs is None else num_epochs
         if (state is None) != (history is None):

@@ -121,9 +121,13 @@ class MeasurementTrainer:
         return loss, {"match": match, "kl": kl}
 
     # ------------------------------------------------------------------ chunk
-    @partial(jax.jit, static_argnames=("self", "num_steps"))
+    @partial(
+        jax.jit, static_argnames=("self", "num_steps"), donate_argnames=("state",)
+    )
     def run_chunk(self, state: MeasurementTrainState, key: Array, num_steps: int):
-        """``num_steps`` training steps fully on device; returns per-step stats."""
+        """``num_steps`` training steps fully on device; returns per-step stats.
+
+        ``state`` is donated — callers rebind to the returned state."""
         cfg = self.config
         n = self._windows.shape[0]
         grad_fn = jax.value_and_grad(self._loss, has_aux=True)

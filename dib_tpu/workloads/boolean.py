@@ -146,7 +146,9 @@ class BooleanTrainer:
         loss = task + beta * jnp.sum(aux["kl_per_feature"])
         return loss, {"task": task, "kl": aux["kl_per_feature"], "logits": logits}
 
-    @partial(jax.jit, static_argnames=("self", "num_steps"))
+    @partial(
+        jax.jit, static_argnames=("self", "num_steps"), donate_argnames=("state",)
+    )
     def run_chunk(self, state: BooleanTrainState, key: Array, num_steps: int):
         cfg = self.config
         n = self._x.shape[0]

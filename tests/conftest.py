@@ -31,8 +31,19 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+import warnings  # noqa: E402
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+if jax.default_backend() == "cpu":
+    # Buffer donation (run_chunk) is a TPU/GPU optimization the CPU backend
+    # ignores with this warning. Scoped to CPU on purpose: on accelerator CI
+    # the warning must stay visible — it is the only signal that donation
+    # stopped being applied.
+    warnings.filterwarnings(
+        "ignore", message="Some donated buffers were not usable"
+    )
 
 
 @pytest.fixture
