@@ -35,6 +35,42 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def _wait_for_device(retries: int = 6, delay_s: float = 60.0):
+    """Probe the backend with retries: a freshly restarted TPU worker (or a
+    tunnel recovering from a crash) can be UNAVAILABLE for minutes."""
+    import jax
+    import jax.numpy as jnp
+
+    for attempt in range(retries):
+        try:
+            devices = jax.devices()
+            if devices[0].platform == "cpu" and not os.environ.get(
+                "DIB_BENCH_ALLOW_CPU"
+            ):
+                # a swallowed TPU-init failure silently falls back to CPU;
+                # a CPU number against the 10-min TPU target is meaningless
+                raise RuntimeError(
+                    "benchmark backend resolved to CPU (TPU init failed or "
+                    "JAX_PLATFORMS unset); set DIB_BENCH_ALLOW_CPU=1 to "
+                    "force a CPU run"
+                )
+            jax.block_until_ready(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+            return devices
+        except Exception as e:  # backend init / transport errors
+            log(f"device probe {attempt + 1}/{retries} failed: {e}")
+            if attempt == retries - 1:
+                raise
+            try:
+                # drop any cached dead client so the next probe re-inits the
+                # backend instead of reusing a broken connection
+                import jax.extend as jex
+
+                jex.backend.clear_backends()
+            except Exception:
+                pass
+            time.sleep(delay_s)
+
+
 def main() -> None:
     import jax
     import jax.numpy as jnp
@@ -45,7 +81,7 @@ def main() -> None:
     from dib_tpu.parallel import BetaSweepTrainer
     from dib_tpu.train import TrainConfig
 
-    devices = jax.devices()
+    devices = _wait_for_device()
     log(f"devices: {devices}")
 
     bundle = get_dataset("amorphous_particles", num_synthetic_neighborhoods=2048)
