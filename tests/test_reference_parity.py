@@ -1,0 +1,215 @@
+"""Differential parity against the ACTUAL reference implementation.
+
+These tests import and execute the reference codebase (read-only at
+/root/reference, TF/Keras) as a behavioral oracle — no code is copied; the
+reference runs as-is and its outputs are compared with dib-tpu's:
+
+  1. the beta annealing schedule (reference ``models.py:125-149``) vs
+     ``dib_tpu.ops.schedules.log_annealed_beta`` — exact math parity,
+  2. the float64 MI sandwich-bound estimator (reference ``utils.py:10-73``)
+     vs the f32 log-space ``mi_sandwich_bounds`` — statistical parity on a
+     known channel,
+  3. an end-to-end boolean-circuit training run (reference ``DistributedIBNet``
+     + Keras fit + annealing callback, the ``train.py:133-178`` path) vs
+     ``DIBTrainer`` — info-plane trajectory parity (the BASELINE.json
+     criterion) at a shrunk configuration.
+
+Skipped wherever TensorFlow or the reference checkout is unavailable.
+"""
+
+import os
+import sys
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+REFERENCE_PATH = "/root/reference"
+
+
+@pytest.fixture(scope="module")
+def reference():
+    if not os.path.isdir(REFERENCE_PATH):
+        pytest.skip("reference checkout not available")
+    # The reference is Keras-2 code (add_metric/add_loss in call()); route
+    # tf.keras to the legacy tf_keras package. Must happen before TF imports.
+    if "tensorflow" in sys.modules and os.environ.get("TF_USE_LEGACY_KERAS") != "1":
+        pytest.skip("tensorflow already imported without TF_USE_LEGACY_KERAS")
+    pytest.importorskip("tf_keras")
+    prev_env = os.environ.get("TF_USE_LEGACY_KERAS")
+    prev_bytecode = sys.dont_write_bytecode
+    os.environ["TF_USE_LEGACY_KERAS"] = "1"
+    sys.dont_write_bytecode = True          # /root/reference is read-only
+    sys.path.insert(0, REFERENCE_PATH)
+    try:
+        tf = pytest.importorskip("tensorflow")
+        tf.config.set_visible_devices([], "GPU")
+        import models as ref_models
+        import utils as ref_utils
+
+        yield SimpleNamespace(models=ref_models, utils=ref_utils, tf=tf)
+    finally:
+        sys.path.remove(REFERENCE_PATH)
+        sys.dont_write_bytecode = prev_bytecode
+        if prev_env is None:
+            os.environ.pop("TF_USE_LEGACY_KERAS", None)
+        else:
+            os.environ["TF_USE_LEGACY_KERAS"] = prev_env
+        for name in ("models", "utils", "visualization"):
+            sys.modules.pop(name, None)
+
+
+@pytest.mark.slow
+def test_beta_schedule_matches_reference_exactly(reference):
+    """Our schedule function reproduces the reference callback's beta at every
+    epoch — including its quirks: clamped below (pretraining), NOT clamped
+    above (it extrapolates past beta_end if trained longer)."""
+    from dib_tpu.ops.schedules import log_annealed_beta
+
+    tf = reference.tf
+    cb = reference.models.InfoBottleneckAnnealingCallback(
+        beta_start=1e-3, beta_end=5.0,
+        number_pretraining_epochs=10, number_annealing_epochs=100,
+    )
+    holder = SimpleNamespace(beta=tf.Variable(1.0, dtype=tf.float32))
+    cb.set_model(holder)
+    for epoch in [0, 3, 10, 11, 37, 60, 109, 110, 150]:
+        cb.on_epoch_begin(epoch)
+        ref_beta = float(holder.beta.numpy())
+        ours = float(log_annealed_beta(
+            epoch, 1e-3, 5.0, 100, 10, clip_progress=False
+        ))
+        assert ours == pytest.approx(ref_beta, rel=2e-5), f"epoch {epoch}"
+
+
+@pytest.mark.slow
+def test_mi_bounds_match_reference_estimator(reference):
+    """The reference's f64 density-space estimator and our f32 log-space one
+    agree on a known 2-bit channel (independent u-draws -> statistical
+    tolerance; the channel is tight so bounds concentrate)."""
+    import jax
+
+    from dib_tpu.ops.info_bounds import mi_sandwich_bounds
+
+    tf = reference.tf
+    rng = np.random.default_rng(0)
+    n, d, bits = 2048, 8, 2
+    corners = np.array(np.meshgrid(*[[-4.0, 4.0]] * bits)).reshape(bits, -1).T
+    mus = np.concatenate(
+        [corners[rng.integers(0, 4, n)], np.zeros((n, d - bits))], -1
+    )
+    logvars = np.full((n, d), -2.0)
+    concat = np.concatenate([mus, logvars], -1).astype(np.float64)
+
+    tf.random.set_seed(0)
+    dataset = tf.data.Dataset.from_tensor_slices(concat)
+    ref_lower, ref_upper = reference.utils.estimate_mi_sandwich_bounds(
+        lambda batch: batch, dataset,
+        evaluation_batch_size=256, number_evaluation_batches=4,
+    )
+
+    import jax.numpy as jnp
+
+    data = jnp.asarray(concat, jnp.float32)
+    ours_lower, ours_upper = mi_sandwich_bounds(
+        lambda batch: (batch[:, :d], batch[:, d:]),
+        data, jax.random.key(0),
+        evaluation_batch_size=256, number_evaluation_batches=4,
+    )
+    ln2 = np.log(2.0)
+    assert float(ours_lower) / ln2 == pytest.approx(float(ref_lower) / ln2, abs=0.05)
+    assert float(ours_upper) / ln2 == pytest.approx(float(ref_upper) / ln2, abs=0.05)
+    # both sandwiches contain the true 2 bits
+    assert float(ref_lower) / ln2 <= 2.0 + 0.05
+    assert float(ours_lower) / ln2 <= 2.0 + 0.05
+
+
+@pytest.mark.slow
+def test_info_plane_trajectory_parity_boolean(reference):
+    """End-to-end: the reference Keras path and dib-tpu trained on the same
+    circuit with the same schedule produce matching info-plane trajectories
+    (statistical: different RNG/init/optimizer internals)."""
+    import jax
+
+    from dib_tpu.data import get_dataset
+    from dib_tpu.models import DistributedIBModel
+    from dib_tpu.train import DIBTrainer, TrainConfig
+
+    tf = reference.tf
+    tf.keras.utils.set_random_seed(0)
+
+    bundle = get_dataset("boolean_circuit")        # the paper circuit
+    x, y = bundle.x_train, bundle.y_train
+    pre, anneal, batch = 100, 200, 256
+    beta_start, beta_end = 1e-4, 3.0
+    arch, integ, emb = [32], [64], 4
+    lr = 1e-3
+
+    ref_model = reference.models.DistributedIBNet(
+        feature_dimensionalities=[1] * 10,
+        feature_encoder_architecture=arch,
+        integration_network_architecture=integ,
+        output_dimensionality=1,
+        feature_embedding_dimension=emb,
+    )
+    # The reference's DistributedIBNet.build calls
+    # self.integration_network.build() with no input shape — a documented
+    # breakage (SURVEY.md section 0; reference models.py:93) that modern
+    # Keras rejects. The sub-Sequentials are already built (they start with
+    # Input layers), so a no-op build is the working behavior.
+    ref_model.build = lambda *a, **k: setattr(ref_model, "built", True)
+    ref_model.compile(
+        optimizer=tf.keras.optimizers.Adam(lr),
+        loss=tf.keras.losses.BinaryCrossentropy(from_logits=True),
+    )
+    cb = reference.models.InfoBottleneckAnnealingCallback(
+        beta_start, beta_end, pre, anneal)
+    hist = ref_model.fit(
+        x, y, batch_size=batch, epochs=pre + anneal, callbacks=[cb], verbose=0
+    ).history
+    betas = np.array([
+        np.exp(np.log(beta_start)
+               + max(e - pre, 0) / anneal * (np.log(beta_end) - np.log(beta_start)))
+        for e in range(pre + anneal)
+    ])
+    ref_kl = np.stack([hist[f"KL{i}"] for i in range(10)], -1)      # nats
+    ref_total_kl_bits = ref_kl.sum(-1) / np.log(2.0)
+    # Keras 'loss' is the epoch-averaged combined objective; un-mix it the
+    # way the reference does on host (train.py:169-174)
+    ref_task_bits = (np.array(hist["loss"]) - betas * ref_kl.sum(-1)) / np.log(2.0)
+
+    model = DistributedIBModel(
+        feature_dimensionalities=tuple(bundle.feature_dimensionalities),
+        encoder_hidden=tuple(arch), integration_hidden=tuple(integ),
+        output_dim=1, embedding_dim=emb,
+    )
+    config = TrainConfig(
+        learning_rate=lr, batch_size=batch,
+        beta_start=beta_start, beta_end=beta_end,
+        num_pretraining_epochs=pre, num_annealing_epochs=anneal,
+        max_val_points=1024,
+    )
+    trainer = DIBTrainer(model, bundle, config)
+    _, history = trainer.fit(jax.random.key(0))
+    ours = history.to_bits()
+
+    # 1. pretraining learns the task in both frameworks (H(Y) = 0.758 bits)
+    assert ref_task_bits[pre - 1] < 0.65
+    assert ours.loss[pre - 1] < 0.65
+    # 2. the anneal crushes the channel in both (same final beta)
+    assert ref_total_kl_bits[-1] < 1.5
+    assert float(ours.total_kl[-1]) < 1.5
+    # 3. trajectory shape parity: total-KL series strongly rank-correlated
+    #    across the anneal (the info-plane x-axis)
+    from scipy.stats import spearmanr
+
+    rho = spearmanr(ref_total_kl_bits[pre:], np.asarray(ours.total_kl)[pre:]).statistic
+    assert rho > 0.9, f"info-plane KL trajectories diverge (spearman {rho:.3f})"
+    # 4. magnitudes comparable at matched beta checkpoints (loose: independent
+    #    inits/RNG) — compare at 25/50/75% of the anneal
+    for frac in (0.25, 0.5, 0.75):
+        e = pre + int(frac * anneal)
+        a, b = ref_total_kl_bits[e], float(ours.total_kl[e])
+        assert abs(a - b) < 0.5 * max(a, b) + 2.0, (
+            f"KL at anneal {frac:.0%}: reference {a:.2f} vs ours {b:.2f} bits"
+        )
