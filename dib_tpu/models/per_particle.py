@@ -54,6 +54,9 @@ class PerParticleDIBModel(nn.Module):
     activation: str | Callable | None = "relu"
     compute_dtype: str | None = None   # 'bfloat16' -> MXU-native matmuls;
                                        # KL/sampling/logits stay float32
+    seq_axis: str | None = None   # context parallelism: mesh axis the particle
+    seq_impl: str = "ring"        # axis is sharded over (parallel/context.py)
+    data_axis: str | None = None  # optional batch sharding alongside seq_axis
 
     @nn.nowrap
     def _encoder(self, name: str | None = None) -> GaussianEncoder:
@@ -76,12 +79,22 @@ class PerParticleDIBModel(nn.Module):
         sets = x.reshape(batch, self.num_particles, self.particle_feature_dim)
 
         mus, logvars = self._encoder("particle_encoder")(sets)  # [B, P, d] each
+        if self.seq_axis is not None:
+            # one shard per mesh position holds num_particles/axis_size
+            # particles; decorrelate their sampling noise across shards
+            key = jax.random.fold_in(key, jax.lax.axis_index(self.seq_axis))
+        if self.data_axis is not None:
+            key = jax.random.fold_in(key, jax.lax.axis_index(self.data_axis))
         u = reparameterize(key, mus, logvars) if sample else mus
 
         # KL per particle slot: sum over latent dim, mean over batch -> [P].
         # total KL (trainer sums this) = reference's sum over (dim, particle),
         # mean over batch (amorphous notebook cell 8 train_step).
         kl_per_feature = jnp.mean(kl_diagonal_gaussian(mus, logvars, axis=-1), axis=0)
+        if self.data_axis is not None:
+            # batch rows sharded: the global batch mean is the pmean of the
+            # equal-sized shard means
+            kl_per_feature = jax.lax.pmean(kl_per_feature, self.data_axis)
 
         prediction = SetTransformer(
             num_blocks=self.num_blocks,
@@ -92,6 +105,8 @@ class PerParticleDIBModel(nn.Module):
             head_hidden=tuple(self.head_hidden),
             output_dim=self.output_dim,
             compute_dtype=self.compute_dtype,
+            seq_axis=self.seq_axis,
+            seq_impl=self.seq_impl,
             name="aggregator",
         )(u)
 

@@ -8,7 +8,10 @@ Behavior parity: amorphous notebook cell 8 — 6 post-LN attention blocks
 
 TPU notes: attention over sets of ~50 particles is a single fused
 dot-product-attention; the batch of neighborhoods — not the set axis — is the
-parallel/sharded axis (SURVEY.md section 5, long-context note).
+default parallel/sharded axis (SURVEY.md section 5, long-context note). For
+sets that outgrow one chip, ``seq_axis`` switches every block to collective
+attention (ring or Ulysses all-to-all, ``dib_tpu.parallel.context``) with the
+SET axis sharded over the mesh — the long-context scale-out path.
 """
 
 from __future__ import annotations
@@ -20,8 +23,38 @@ import jax
 import jax.numpy as jnp
 
 from dib_tpu.models.mlp import MLP, resolve_activation
+from dib_tpu.parallel.context import self_attention
 
 Array = jax.Array
+
+
+class MultiHeadSelfAttention(nn.Module):
+    """QKV/out projections around a pluggable attention core.
+
+    Parameter layout matches ``nn.MultiHeadDotProductAttention`` (DenseGeneral
+    'query'/'key'/'value' -> [in, H, D], 'out' -> [H, D, out]), but the core
+    dispatches on ``seq_axis``: dense fused attention on one device, ring or
+    Ulysses collective attention when the sequence axis is sharded.
+    """
+
+    num_heads: int
+    qkv_features: int
+    out_features: int
+    dtype: str | None = None
+    seq_axis: str | None = None
+    seq_impl: str = "ring"
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        head_dim = self.qkv_features // self.num_heads
+        proj = lambda name: nn.DenseGeneral(  # noqa: E731
+            features=(self.num_heads, head_dim), dtype=self.dtype, name=name
+        )
+        q, k, v = proj("query")(x), proj("key")(x), proj("value")(x)
+        o = self_attention(q, k, v, self.seq_axis, self.seq_impl)
+        return nn.DenseGeneral(
+            features=self.out_features, axis=(-2, -1), dtype=self.dtype, name="out"
+        )(o.astype(q.dtype))
 
 
 class SetAttentionBlock(nn.Module):
@@ -38,15 +71,19 @@ class SetAttentionBlock(nn.Module):
     model_dim: int = 32
     ff_activation: str | Callable | None = "relu"
     compute_dtype: str | None = None
+    seq_axis: str | None = None
+    seq_impl: str = "ring"
 
     @nn.compact
     def __call__(self, x: Array) -> Array:
-        attn = nn.MultiHeadDotProductAttention(
+        attn = MultiHeadSelfAttention(
             num_heads=self.num_heads,
             qkv_features=self.num_heads * self.key_dim,
             out_features=self.model_dim,
             dtype=self.compute_dtype,
-        )(x, x)
+            seq_axis=self.seq_axis,
+            seq_impl=self.seq_impl,
+        )(x)
         h = nn.LayerNorm(dtype=jnp.float32)(x + attn.astype(x.dtype))
         ff = MLP(tuple(self.ff_hidden), self.model_dim, self.ff_activation,
                  output_activation=self.ff_activation, dtype=self.compute_dtype)(h)
@@ -66,10 +103,12 @@ class SetTransformer(nn.Module):
     ff_activation: str | Callable | None = "relu"
     head_activation: str | Callable | None = "leaky_relu"
     compute_dtype: str | None = None
+    seq_axis: str | None = None   # mesh axis the SET dimension is sharded over
+    seq_impl: str = "ring"        # 'ring' | 'ulysses'
 
     @nn.compact
     def __call__(self, x: Array) -> Array:
-        # x: [B, set_size, model_dim]
+        # x: [B, set_size, model_dim] (local shard of set_size under seq_axis)
         for _ in range(self.num_blocks):
             x = SetAttentionBlock(
                 num_heads=self.num_heads,
@@ -78,8 +117,14 @@ class SetTransformer(nn.Module):
                 model_dim=self.model_dim,
                 ff_activation=self.ff_activation,
                 compute_dtype=self.compute_dtype,
+                seq_axis=self.seq_axis,
+                seq_impl=self.seq_impl,
             )(x)
         pooled = x.mean(axis=-2)
+        if self.seq_axis is not None:
+            # local means are equal-weight (equal shard sizes): global mean
+            # pool = pmean of shard means over the sequence axis.
+            pooled = jax.lax.pmean(pooled, self.seq_axis)
         act = resolve_activation(self.head_activation)
         h = pooled
         for width in self.head_hidden:

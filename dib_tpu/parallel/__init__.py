@@ -1,10 +1,23 @@
-"""Mesh + sweep parallelism (the reference has none; SURVEY.md section 2.3)."""
+"""Mesh + sweep + context parallelism (the reference has none; SURVEY.md
+section 2.3 / section 5 — the beta-sweep axis, data parallelism, and the
+ring/Ulysses sequence-parallel scale-out path)."""
 
+from dib_tpu.parallel.context import (
+    context_model_view,
+    context_parallel_apply,
+    context_parallel_step_fn,
+    dense_self_attention,
+    ring_self_attention,
+    self_attention,
+    ulysses_self_attention,
+)
 from dib_tpu.parallel.mesh import (
     BETA_AXIS,
     DATA_AXIS,
+    SEQ_AXIS,
     batch_sharding,
     factor_devices,
+    make_context_mesh,
     make_sweep_mesh,
     replica_sharding,
     replicate,
@@ -17,15 +30,24 @@ from dib_tpu.parallel.sweep import BetaSweepTrainer, PerReplicaHook, sweep_recor
 __all__ = [
     "BETA_AXIS",
     "DATA_AXIS",
+    "SEQ_AXIS",
     "BetaSweepTrainer",
     "PerReplicaHook",
     "batch_sharding",
+    "context_model_view",
+    "context_parallel_apply",
+    "context_parallel_step_fn",
+    "dense_self_attention",
     "factor_devices",
+    "make_context_mesh",
     "make_sweep_mesh",
     "replica_sharding",
     "replicate",
     "replicated_sharding",
+    "ring_self_attention",
+    "self_attention",
     "shard_replicas",
     "sweep_records",
+    "ulysses_self_attention",
     "validate_sweep_shapes",
 ]

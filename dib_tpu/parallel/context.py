@@ -1,0 +1,231 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+The reference's largest attention "sequence" is a set of 50 particles
+(amorphous notebook cell 8), which fits on any single chip — SURVEY.md
+section 5 records that no sequence-parallel machinery exists there. This
+module supplies the TPU-native scale-out path anyway, so neighborhoods far
+larger than VMEM (or future long-sequence workloads) shard the *set/sequence
+axis itself* across the mesh:
+
+  - **Ring attention** (Liu et al. 2023 style): queries stay put; key/value
+    shards rotate around the mesh axis with ``lax.ppermute`` while an online
+    (flash-attention) softmax accumulates partial results. Communication is
+    neighbor-to-neighbor — exactly the ICI torus topology — and overlaps with
+    the per-block matmuls. Works for any number of heads and any axis size.
+  - **Ulysses** (all-to-all): one ``lax.all_to_all`` re-shards from
+    sequence-parallel to head-parallel, attention runs dense per head group,
+    and a second all-to-all restores sequence sharding. Cheaper at moderate
+    sequence lengths but requires ``num_heads % axis_size == 0``.
+
+Both are *shard-level* functions: they expect to run inside ``jax.shard_map``
+(or any context where ``axis_name`` is bound) on arrays whose sequence axis
+holds only the local shard. ``dense_self_attention`` is the single-device
+reference implementation sharing the same math — the parity tests pin
+ring/Ulysses outputs to it exactly.
+
+Gradients flow through both (ppermute/all_to_all transpose to themselves),
+so a context-parallel *training* step is just ``jax.grad`` through a
+``shard_map``-wrapped forward — see ``context_parallel_step_fn``.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+Array = jax.Array
+
+
+# --------------------------------------------------------------------------
+# Shard-level attention kernels. All take [B, S, H, D] (sequence axis = local
+# shard when an axis name is bound) and return [B, S, H, D] in float32.
+# --------------------------------------------------------------------------
+
+def dense_self_attention(q: Array, k: Array, v: Array) -> Array:
+    """Plain softmax attention — the single-device reference for the
+    collective variants (same scaling and float32 softmax numerics)."""
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+
+
+def ring_self_attention(q: Array, k: Array, v: Array, axis_name: str) -> Array:
+    """Blockwise ring attention over mesh axis ``axis_name``.
+
+    Online-softmax accumulation: running max ``m``, normalizer ``l`` and
+    weighted values ``o`` are updated per K/V block; K/V rotate one mesh
+    neighbor per step (``ppermute``), so after ``axis_size`` steps every query
+    shard has attended to every key shard and the buffers are back home. The
+    loop is unrolled (axis sizes are small and static), letting XLA overlap
+    each step's ppermute with the previous step's matmuls.
+    """
+    axis_size = jax.lax.axis_size(axis_name)
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    # K/V rotate in their NATIVE dtype (bf16 under the mixed-precision path):
+    # half the ppermute bytes on ICI, MXU-rate matmuls. Scores and the online
+    # accumulators are float32 via the matmul accumulator dtype.
+    qs = q * scale
+    kc, vc = k, v
+    batch, seq, heads, dim = q.shape
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    m = jnp.full((batch, heads, seq), -jnp.inf, jnp.float32)
+    l = jnp.zeros((batch, heads, seq), jnp.float32)
+    o = jnp.zeros((batch, seq, heads, dim), jnp.float32)
+    for step in range(axis_size):
+        s = jnp.einsum(
+            "bqhd,bkhd->bhqk", qs, kc, preferred_element_type=jnp.float32
+        )
+        new_m = jnp.maximum(m, s.max(axis=-1))
+        corr = jnp.exp(m - new_m)               # 0 at the -inf start: exp(-inf)
+        p = jnp.exp(s - new_m[..., None])
+        l = l * corr + p.sum(axis=-1)
+        o = o * jnp.moveaxis(corr, 1, 2)[..., None] + jnp.einsum(
+            "bhqk,bkhd->bqhd", p.astype(v.dtype), vc,
+            preferred_element_type=jnp.float32,
+        )
+        m = new_m
+        if step + 1 < axis_size:
+            kc = jax.lax.ppermute(kc, axis_name, perm)
+            vc = jax.lax.ppermute(vc, axis_name, perm)
+    return o / jnp.moveaxis(l, 1, 2)[..., None]
+
+
+def ulysses_self_attention(q: Array, k: Array, v: Array, axis_name: str) -> Array:
+    """All-to-all (DeepSpeed-Ulysses style) attention over ``axis_name``.
+
+    Re-shards [B, S/n, H, D] -> [B, S, H/n, D] with one tiled all-to-all per
+    operand, runs dense attention on the full sequence for the local head
+    group, and all-to-alls the output back to sequence sharding.
+    """
+    axis_size = jax.lax.axis_size(axis_name)
+    if q.shape[2] % axis_size:
+        raise ValueError(
+            f"Ulysses attention needs num_heads ({q.shape[2]}) divisible by the "
+            f"'{axis_name}' axis size ({axis_size}); use ring attention otherwise"
+        )
+    to_heads = partial(
+        jax.lax.all_to_all, axis_name=axis_name, split_axis=2, concat_axis=1,
+        tiled=True,
+    )
+    o = dense_self_attention(to_heads(q), to_heads(k), to_heads(v))
+    return jax.lax.all_to_all(o, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+
+def self_attention(q: Array, k: Array, v: Array, seq_axis: str | None,
+                   seq_impl: str = "ring") -> Array:
+    """Dispatch: dense when no axis is bound, else ring or Ulysses."""
+    if seq_axis is None:
+        return dense_self_attention(q, k, v)
+    if seq_impl == "ring":
+        return ring_self_attention(q, k, v, seq_axis)
+    if seq_impl == "ulysses":
+        return ulysses_self_attention(q, k, v, seq_axis)
+    raise ValueError(f"Unknown sequence-parallel impl {seq_impl!r}")
+
+
+# --------------------------------------------------------------------------
+# Context-parallel drivers for the per-particle flagship model.
+# --------------------------------------------------------------------------
+
+def context_model_view(model, mesh: Mesh, seq_axis: str, seq_impl: str = "ring",
+                       data_axis: str | None = None):
+    """A shard-local view of a ``PerParticleDIBModel``: same parameters, but
+    ``num_particles`` divided over the '``seq_axis``' mesh axis and collective
+    attention/pooling enabled. Parameters are particle-count independent (one
+    shared encoder; attention has no length-dependent weights), so the view
+    applies the *same* param pytree as the global model. When the mesh also
+    has a nontrivial '``data``' axis, batch rows shard over it (the KL batch
+    mean becomes a pmean inside the model)."""
+    n = mesh.shape[seq_axis]
+    if model.num_particles % n:
+        raise ValueError(
+            f"num_particles={model.num_particles} not divisible by mesh axis "
+            f"'{seq_axis}' of size {n}"
+        )
+    if data_axis is not None and mesh.shape.get(data_axis, 1) == 1:
+        data_axis = None  # trivial axis: skip the pmean/fold_in
+    return model.clone(
+        num_particles=model.num_particles // n, seq_axis=seq_axis,
+        seq_impl=seq_impl, data_axis=data_axis,
+    )
+
+
+def context_parallel_apply(model, params, x: Array, key: Array, mesh: Mesh,
+                           seq_axis: str = "seq", seq_impl: str = "ring",
+                           sample: bool = True):
+    """Forward the per-particle model with the PARTICLE axis sharded.
+
+    ``x`` is the usual [B, P*F] neighborhood batch (particle-major flatten, so
+    splitting the trailing axis into ``axis_size`` contiguous chunks splits
+    whole particles). Batch rows additionally shard over the mesh's '``data``'
+    axis when it is nontrivial. Returns the same ``(prediction, aux)``
+    contract as the unsharded model; per-particle aux arrays come back sharded
+    over ``seq_axis``, predictions over the data axis.
+    """
+    from dib_tpu.parallel.mesh import DATA_AXIS
+
+    data_axis = DATA_AXIS if mesh.shape.get(DATA_AXIS, 1) > 1 else None
+    local = context_model_view(model, mesh, seq_axis, seq_impl, data_axis)
+
+    def fwd(params, x_shard, key):
+        return local.apply(params, x_shard, key, sample=sample)
+
+    aux_specs = {
+        "kl_per_feature": P(seq_axis),              # pmean'd over data inside
+        "mus": P(seq_axis, data_axis),              # [P, B, d]
+        "logvars": P(seq_axis, data_axis),
+        "embeddings": P(data_axis, seq_axis),       # [B, P*d]
+    }
+    return jax.shard_map(
+        fwd,
+        mesh=mesh,
+        in_specs=(P(), P(data_axis, seq_axis), P()),
+        out_specs=(P(data_axis), aux_specs),
+    )(params, x, key)
+
+
+def context_parallel_step_fn(model, optimizer, mesh: Mesh, seq_axis: str = "seq",
+                             seq_impl: str = "ring",
+                             loss_fn: Callable | None = None):
+    """Build a jitted context-parallel train step for the per-particle model.
+
+    The loss closes over a ``shard_map``-wrapped forward; ``jax.grad``
+    differentiates straight through the collectives (ppermute/all-to-all are
+    their own transposes), so parameter gradients arrive already summed over
+    the sequence shards — no hand-written reduce. ``loss_fn(logits, y)`` is a
+    scalar task loss (defaults to mean sigmoid BCE, the amorphous workload's
+    objective — amorphous notebook cell 8 ``train_step``).
+    """
+    import optax
+
+    if loss_fn is None:
+        def loss_fn(logits, y):
+            return jnp.mean(
+                optax.sigmoid_binary_cross_entropy(logits.squeeze(-1), y)
+            )
+
+    def total_loss(params, x, y, key, beta):
+        prediction, aux = context_parallel_apply(
+            model, params, x, key, mesh, seq_axis, seq_impl
+        )
+        task = loss_fn(prediction, y)
+        kl = jnp.sum(aux["kl_per_feature"])
+        return task + beta * kl, (task, kl)
+
+    @jax.jit
+    def step(params, opt_state, x, y, key, beta):
+        (loss, (task, kl)), grads = jax.value_and_grad(total_loss, has_aux=True)(
+            params, x, y, key, beta
+        )
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, {"loss": loss, "task": task, "kl": kl}
+
+    return step

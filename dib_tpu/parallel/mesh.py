@@ -31,6 +31,42 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 BETA_AXIS = "beta"
 DATA_AXIS = "data"
+SEQ_AXIS = "seq"
+
+
+def _make_mesh(axis_names: tuple[str, str], sizes: tuple[int | None, int | None],
+               devices: Sequence | None, default_axis: int) -> Mesh:
+    """Shared two-axis mesh constructor: infer the unset size(s), validate,
+    truncate leftover devices, reshape. ``default_axis`` gets all devices when
+    neither size is given."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    a, b = sizes
+    if a is None and b is None:
+        a, b = (n, 1) if default_axis == 0 else (1, n)
+    elif a is None:
+        a = n // b
+    elif b is None:
+        b = n // a
+    if a < 1 or b < 1 or a * b > n:
+        raise ValueError(
+            f"Mesh {axis_names[0]}={a} x {axis_names[1]}={b} is not "
+            f"satisfiable with {n} devices"
+        )
+    grid = np.asarray(devices[: a * b]).reshape(a, b)
+    return Mesh(grid, axis_names)
+
+
+def make_context_mesh(
+    num_seq: int | None = None,
+    num_data: int | None = 1,
+    devices: Sequence | None = None,
+) -> Mesh:
+    """A ``(data, seq)`` mesh for context parallelism (``parallel/context.py``):
+    the set/sequence axis of one model is sharded over '``seq``', with optional
+    batch sharding over '``data``'. Defaults to all devices on '``seq``'."""
+    return _make_mesh((DATA_AXIS, SEQ_AXIS), (num_data, num_seq), devices,
+                      default_axis=1)
 
 
 def make_sweep_mesh(
@@ -45,20 +81,8 @@ def make_sweep_mesh(
     chips). Sizes must multiply to at most the device count; leftover devices
     are unused (a warning-free truncation, as in common JAX practice).
     """
-    devices = list(devices if devices is not None else jax.devices())
-    n = len(devices)
-    if num_beta is None and num_data is None:
-        num_beta, num_data = n, 1
-    elif num_beta is None:
-        num_beta = n // num_data
-    elif num_data is None:
-        num_data = n // num_beta
-    if num_beta < 1 or num_data < 1 or num_beta * num_data > n:
-        raise ValueError(
-            f"Mesh {num_beta}x{num_data} is not satisfiable with {n} devices"
-        )
-    grid = np.asarray(devices[: num_beta * num_data]).reshape(num_beta, num_data)
-    return Mesh(grid, (BETA_AXIS, DATA_AXIS))
+    return _make_mesh((BETA_AXIS, DATA_AXIS), (num_beta, num_data), devices,
+                      default_axis=0)
 
 
 def replica_sharding(mesh: Mesh) -> NamedSharding:
