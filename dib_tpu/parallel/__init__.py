@@ -25,6 +25,11 @@ from dib_tpu.parallel.mesh import (
     shard_replicas,
     validate_sweep_shapes,
 )
+from dib_tpu.parallel.multihost import (
+    fetch_to_host,
+    initialize,
+    process_local_batch,
+)
 from dib_tpu.parallel.sweep import BetaSweepTrainer, PerReplicaHook, sweep_records
 
 __all__ = [
@@ -39,6 +44,9 @@ __all__ = [
     "context_parallel_step_fn",
     "dense_self_attention",
     "factor_devices",
+    "fetch_to_host",
+    "initialize",
+    "process_local_batch",
     "make_context_mesh",
     "make_sweep_mesh",
     "replica_sharding",

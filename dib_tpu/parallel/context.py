@@ -48,11 +48,24 @@ Array = jax.Array
 
 def dense_self_attention(q: Array, k: Array, v: Array) -> Array:
     """Plain softmax attention — the single-device reference for the
-    collective variants (same scaling and float32 softmax numerics)."""
+    collective variants.
+
+    Numerics (same recipe as the ring variant): q is scaled BEFORE the
+    matmul and the scores come out of the MXU directly in float32
+    (``preferred_element_type``) — no bfloat16 round-trip of potentially
+    huge score values, which XLA fusion can otherwise push to non-finite
+    on large activations. Softmax stays float32; the value matmul runs in
+    the input dtype with a float32 accumulator.
+    """
     scale = 1.0 / math.sqrt(q.shape[-1])
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q * scale, k, preferred_element_type=jnp.float32
+    )
     p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return jnp.einsum(
+        "bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
 
 
 def ring_self_attention(q: Array, k: Array, v: Array, axis_name: str) -> Array:

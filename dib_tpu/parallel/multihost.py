@@ -1,0 +1,119 @@
+"""Multi-host (multi-process) execution helpers.
+
+The reference is strictly single-process (SURVEY.md section 2.3: no
+NCCL/MPI/`tf.distribute` anywhere). The TPU-native story for scaling past one
+host is JAX's multi-controller runtime: every host runs THIS SAME program,
+`jax.distributed.initialize` wires the processes into one cluster, and the
+`Mesh` built from `jax.devices()` then spans all hosts of the slice — XLA
+routes collectives over ICI within a slice and DCN across slices without any
+user-visible transport code. These helpers cover the three host-side chores
+that remain:
+
+  1. `initialize()` — idempotent cluster setup (no-op on single host / when
+     already initialized, e.g. under a test harness).
+  2. `process_local_batch()` — build a GLOBAL sharded array from each host's
+     local rows (the data-loading pattern: every host reads only its shard).
+  3. `fetch_to_host()` — gather a (possibly cross-host-sharded) history or
+     measurement pytree into host-local numpy, via `jax.experimental
+     .multihost_utils` semantics — addressable shards only, then
+     process-level allgather when needed.
+
+Mesh-axis layout guidance (applies to `make_sweep_mesh` on a pod slice): put
+the embarrassingly parallel 'beta' axis on the OUTERMOST device dimension so
+sweep replicas never communicate across hosts; the 'data' axis then lives
+inside a host (or a slice) where the gradient all-reduce rides ICI.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+Array = jax.Array
+
+
+def initialize(coordinator_address: str | None = None,
+               num_processes: int | None = None,
+               process_id: int | None = None) -> bool:
+    """Idempotent `jax.distributed.initialize`.
+
+    Returns True if a multi-process cluster is (now) active. On a single
+    host with no coordinator configured this is a no-op returning False —
+    the same program then runs in the ordinary one-controller mode, which
+    is what keeps one codepath for laptop tests and pod runs.
+    """
+    # Must NOT touch jax.process_count()/jax.devices() before initializing:
+    # they initialize the XLA backend, after which distributed.initialize()
+    # refuses to run. is_initialized() is backend-free.
+    if jax.distributed.is_initialized():
+        return True  # already initialized by the launcher
+    if coordinator_address is None and num_processes is None:
+        # No explicit cluster spec: rely on environment autodetection only
+        # when an orchestrator set it up (TPU pod metadata); otherwise stay
+        # single-process.
+        try:
+            jax.distributed.initialize()
+        except RuntimeError as e:
+            # Backend-ordering violation ("must be called before any JAX
+            # calls"): on a single host this is the expected no-op, but on a
+            # pod it means the call site ran JAX ops first and each host
+            # would train UNCOORDINATED — surface it loudly either way.
+            import warnings
+
+            warnings.warn(
+                f"jax.distributed.initialize skipped ({e}); continuing "
+                "single-process. On a multi-host pod, call initialize() "
+                "before any other JAX usage."
+            )
+            return False
+        except Exception:
+            return False  # no cluster spec in the environment: single host
+        return jax.process_count() > 1
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return jax.process_count() > 1
+
+
+def process_local_batch(local_rows: np.ndarray, sharding) -> Array:
+    """Assemble a global batch array from this process's local rows.
+
+    Every host feeds only the rows destined for its own devices; the result
+    is one logical array whose global shape is the concatenation over
+    processes along the sharded batch axis. On a single process this is just
+    `device_put` (the degenerate case), so data pipelines written against
+    this function run unchanged from 1 host to N.
+    """
+    if jax.process_count() == 1:
+        return jax.device_put(local_rows, sharding)
+    from jax import make_array_from_process_local_data
+
+    return make_array_from_process_local_data(sharding, local_rows)
+
+
+def fetch_to_host(tree):
+    """Device->host fetch that works for cross-host-sharded pytrees.
+
+    Single process: plain `jax.device_get`. Multi-process: gather each leaf's
+    addressable shards and allgather across processes so every host ends with
+    the full array (histories/measurements are small — the reference's
+    'history is the product' convention, README.md:6 — so the broadcast cost
+    is negligible next to training).
+    """
+    if jax.process_count() == 1:
+        return jax.device_get(tree)
+    from jax.experimental import multihost_utils
+
+    def one(leaf):
+        # Only non-fully-addressable arrays need the cross-process gather;
+        # host-local leaves (numpy, scalars, single-host arrays) would be
+        # wrongly concatenated/stacked by process_allgather's tiled mode.
+        if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+            return jax.device_get(
+                multihost_utils.process_allgather(leaf, tiled=True)
+            )
+        return jax.device_get(leaf)
+
+    return jax.tree.map(one, tree)

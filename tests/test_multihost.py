@@ -1,0 +1,36 @@
+"""Multi-host helpers on the single-process virtual-device backend.
+
+True multi-process runs need N hosts; what CAN be pinned here is the
+single-process degenerate path (which pod code shares) and the sharding
+semantics of the global-batch builder on the 8-device mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from dib_tpu.parallel.mesh import DATA_AXIS, make_sweep_mesh
+from dib_tpu.parallel.multihost import fetch_to_host, initialize, process_local_batch
+
+
+def test_initialize_single_process_is_noop():
+    assert initialize() is False
+    assert jax.process_count() == 1
+
+
+def test_process_local_batch_shards_rows(rng):
+    mesh = make_sweep_mesh(1, 8)
+    sharding = NamedSharding(mesh, P(None, DATA_AXIS))
+    rows = rng.standard_normal((4, 16)).astype(np.float32)
+    arr = process_local_batch(rows, sharding)
+    assert arr.shape == (4, 16)
+    assert len(arr.addressable_shards) == 8
+    np.testing.assert_array_equal(np.asarray(arr), rows)
+
+
+def test_fetch_to_host_roundtrip(rng):
+    tree = {"a": jnp.arange(8.0), "b": [jnp.ones((2, 3))]}
+    host = fetch_to_host(tree)
+    assert isinstance(host["a"], np.ndarray)
+    np.testing.assert_array_equal(host["a"], np.arange(8.0))
