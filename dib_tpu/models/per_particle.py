@@ -57,6 +57,8 @@ class PerParticleDIBModel(nn.Module):
     seq_axis: str | None = None   # context parallelism: mesh axis the particle
     seq_impl: str = "ring"        # axis is sharded over (parallel/context.py)
     data_axis: str | None = None  # optional batch sharding alongside seq_axis
+    use_flash: bool | None = None  # blockwise Pallas attention (None = auto on
+    flash_min_seq: int = 1024      # TPU for sets >= flash_min_seq)
 
     @nn.nowrap
     def _encoder(self, name: str | None = None) -> GaussianEncoder:
@@ -107,6 +109,8 @@ class PerParticleDIBModel(nn.Module):
             compute_dtype=self.compute_dtype,
             seq_axis=self.seq_axis,
             seq_impl=self.seq_impl,
+            use_flash=self.use_flash,
+            flash_min_seq=self.flash_min_seq,
             name="aggregator",
         )(u)
 

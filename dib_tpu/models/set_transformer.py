@@ -33,8 +33,11 @@ class MultiHeadSelfAttention(nn.Module):
 
     Parameter layout matches ``nn.MultiHeadDotProductAttention`` (DenseGeneral
     'query'/'key'/'value' -> [in, H, D], 'out' -> [H, D, out]), but the core
-    dispatches on ``seq_axis``: dense fused attention on one device, ring or
-    Ulysses collective attention when the sequence axis is sharded.
+    dispatches on the setting: dense fused attention for ordinary sets, the
+    blockwise Pallas flash kernel for large single-device sets (>=
+    ``flash_min_seq``, where the [S, S] score matrix stops being HBM-friendly),
+    ring or Ulysses collective attention when the sequence axis is sharded
+    over the mesh (``seq_axis``).
     """
 
     num_heads: int
@@ -43,6 +46,8 @@ class MultiHeadSelfAttention(nn.Module):
     dtype: str | None = None
     seq_axis: str | None = None
     seq_impl: str = "ring"
+    flash_min_seq: int = 1024
+    use_flash: bool | None = None   # None = auto (TPU and set >= flash_min_seq)
 
     @nn.compact
     def __call__(self, x: Array) -> Array:
@@ -51,10 +56,21 @@ class MultiHeadSelfAttention(nn.Module):
             features=(self.num_heads, head_dim), dtype=self.dtype, name=name
         )
         q, k, v = proj("query")(x), proj("key")(x), proj("value")(x)
-        o = self_attention(q, k, v, self.seq_axis, self.seq_impl)
+        if self.seq_axis is None and self._flash(x.shape[-2]):
+            from dib_tpu.ops.pallas_attention import flash_self_attention
+
+            o = flash_self_attention(q, k, v)
+        else:
+            o = self_attention(q, k, v, self.seq_axis, self.seq_impl)
         return nn.DenseGeneral(
             features=self.out_features, axis=(-2, -1), dtype=self.dtype, name="out"
         )(o.astype(q.dtype))
+
+    @nn.nowrap
+    def _flash(self, set_size: int) -> bool:
+        if self.use_flash is not None:
+            return self.use_flash
+        return set_size >= self.flash_min_seq and jax.default_backend() == "tpu"
 
 
 class SetAttentionBlock(nn.Module):
@@ -73,6 +89,8 @@ class SetAttentionBlock(nn.Module):
     compute_dtype: str | None = None
     seq_axis: str | None = None
     seq_impl: str = "ring"
+    use_flash: bool | None = None
+    flash_min_seq: int = 1024
 
     @nn.compact
     def __call__(self, x: Array) -> Array:
@@ -83,6 +101,8 @@ class SetAttentionBlock(nn.Module):
             dtype=self.compute_dtype,
             seq_axis=self.seq_axis,
             seq_impl=self.seq_impl,
+            use_flash=self.use_flash,
+            flash_min_seq=self.flash_min_seq,
         )(x)
         h = nn.LayerNorm(dtype=jnp.float32)(x + attn.astype(x.dtype))
         ff = MLP(tuple(self.ff_hidden), self.model_dim, self.ff_activation,
@@ -105,6 +125,8 @@ class SetTransformer(nn.Module):
     compute_dtype: str | None = None
     seq_axis: str | None = None   # mesh axis the SET dimension is sharded over
     seq_impl: str = "ring"        # 'ring' | 'ulysses'
+    use_flash: bool | None = None  # blockwise Pallas attention (None = auto)
+    flash_min_seq: int = 1024      # auto-dispatch threshold on the set size
 
     @nn.compact
     def __call__(self, x: Array) -> Array:
@@ -119,6 +141,8 @@ class SetTransformer(nn.Module):
                 compute_dtype=self.compute_dtype,
                 seq_axis=self.seq_axis,
                 seq_impl=self.seq_impl,
+                use_flash=self.use_flash,
+                flash_min_seq=self.flash_min_seq,
             )(x)
         pooled = x.mean(axis=-2)
         if self.seq_axis is not None:

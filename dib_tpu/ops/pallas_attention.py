@@ -1,0 +1,219 @@
+"""Pallas TPU kernel: blockwise (flash) self-attention for large sets.
+
+Single-chip complement to the cross-chip ring attention in
+``dib_tpu.parallel.context``: where ring attention shards the set axis over
+the MESH, this kernel blocks it over the GRID, so a set far larger than VMEM
+never materializes its [S, S] score matrix in HBM. Same online-softmax
+recurrence as the ring (running max / normalizer / weighted accumulator),
+tiled (query block x key block) with the key axis as the innermost,
+sequentially-executed grid dimension.
+
+The reference has nothing like this (its sets are 50 particles, SURVEY.md
+section 5); this is the scale-out path for long-context single-chip
+workloads. Numerics match ``dense_self_attention`` exactly in float32 and to
+bfloat16-rounding tolerance in mixed precision: q is scaled before the
+matmul and scores/accumulators are float32 (the stability recipe from
+``dense_self_attention``'s docstring).
+
+On non-TPU backends the kernel runs in interpreter mode (the CPU test suite
+exercises it); ``MultiHeadSelfAttention`` dispatches here automatically for
+large sets on TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+Array = jax.Array
+
+_NEG_INF = -1e30  # large-finite: avoids inf-inf NaN traps inside the kernel
+_LANES = 128      # TPU vector lane count: scratch carries live [bq, 128]
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+                  *, scale: float, num_k_blocks: int, kv_len: int,
+                  block_k: int):
+    """One (batch*head, q-block) tile; accumulates over the k-block grid axis.
+
+    Scratch (``m_ref``/``l_ref``: [bq, LANES] lane-replicated, ``acc_ref``:
+    [bq, D]) persists across the innermost grid axis — TPU grids execute
+    sequentially, which is exactly the flash-attention recurrence.
+    """
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0]                                    # [bq, d]
+    k = k_ref[0]                                    # [bk, d]
+    v = v_ref[0]                                    # [bk, d]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale                                       # [bq, bk] float32
+
+    # mask key padding (last block may run past kv_len)
+    col = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    s = jnp.where(col < kv_len, s, _NEG_INF)
+
+    m_prev = m_ref[:]                               # [bq, LANES] (replicated)
+    l_prev = l_ref[:]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))  # bcast
+    corr = jnp.exp(m_prev - m_new)                  # [bq, LANES]
+    p = jnp.exp(s - m_new[:, :1])                   # [bq, bk] float32
+    l_ref[:] = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+    acc_ref[:] = acc_ref[:] * corr[:, :1] + jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m_ref[:] = m_new
+
+    @pl.when(j == num_k_blocks - 1)
+    def _finalize():
+        o_ref[0] = (acc_ref[:] / l_ref[:, :1]).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_q", "block_k", "interpret")
+)
+def flash_self_attention(
+    q: Array,
+    k: Array,
+    v: Array,
+    block_q: int = 256,
+    block_k: int = 256,
+    interpret: bool | None = None,
+) -> Array:
+    """[B, S, H, D] self-attention, [S, S] never materialized.
+
+    Same contract and numerics as
+    :func:`dib_tpu.parallel.context.dense_self_attention` (which is the
+    parity oracle in the tests); float32 output. Differentiable: the
+    backward pass recomputes attention one query block at a time (the
+    standard flash-attention recompute strategy, here as blocked XLA), so
+    no [S, S] intermediate exists in either direction.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash_vjp(q, k, v, block_q, block_k, interpret)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_vjp(q, k, v, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, block_q, block_k, interpret)
+
+
+def _flash_fwd_rule(q, k, v, block_q, block_k, interpret):
+    out = _flash_forward(q, k, v, block_q, block_k, interpret)
+    return out, (q, k, v, out)
+
+
+def _flash_bwd_rule(block_q, block_k, interpret, residuals, d_out):
+    q, k, v, out = residuals
+    batch, s_q, heads, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+
+    def fold(x):
+        return jnp.moveaxis(x, 2, 1).reshape(batch * heads, -1, d).astype(jnp.float32)
+
+    qf = fold(q) * scale
+    kf, vf, of, dof = fold(k), fold(v), fold(out), fold(d_out)
+    d_rows = jnp.sum(of * dof, axis=-1)             # [BH, S]
+
+    bq = min(block_q, s_q)
+    pad_q = (-s_q) % bq
+    if pad_q:
+        qf = jnp.pad(qf, ((0, 0), (0, pad_q), (0, 0)))
+        dof = jnp.pad(dof, ((0, 0), (0, pad_q), (0, 0)))
+        d_rows = jnp.pad(d_rows, ((0, 0), (0, pad_q)))
+    nq = qf.shape[1] // bq
+    qb = qf.reshape(-1, nq, bq, d).swapaxes(0, 1)   # [nq, BH, bq, d]
+    dob = dof.reshape(-1, nq, bq, d).swapaxes(0, 1)
+    drb = d_rows.reshape(-1, nq, bq).swapaxes(0, 1)
+    # mask padded query rows out of the dk/dv accumulation
+    row = jnp.arange(nq * bq).reshape(nq, 1, bq)
+    valid = (row < s_q).astype(jnp.float32)         # [nq, 1, bq]
+
+    def one_block(carry, args):
+        dk_acc, dv_acc = carry
+        qi, doi, di, vm = args                      # [BH, bq, d], ..., [1, bq]
+        s = jnp.einsum("bqd,bkd->bqk", qi, kf)      # [BH, bq, S]
+        lse = jax.nn.logsumexp(s, axis=-1, keepdims=True)
+        p = jnp.exp(s - lse) * vm[..., None]        # zero padded rows
+        dp = jnp.einsum("bqd,bkd->bqk", doi, vf)
+        ds = p * (dp - di[..., None])
+        dq_i = jnp.einsum("bqk,bkd->bqd", ds, kf) * scale
+        dk_acc = dk_acc + jnp.einsum("bqk,bqd->bkd", ds, qi)
+        dv_acc = dv_acc + jnp.einsum("bqk,bqd->bkd", p, doi)
+        return (dk_acc, dv_acc), dq_i
+
+    zeros = jnp.zeros_like(kf)
+    (dk_f, dv_f), dq_blocks = jax.lax.scan(
+        one_block, (zeros, zeros), (qb, dob, drb, valid)
+    )
+    dq_f = dq_blocks.swapaxes(0, 1).reshape(-1, nq * bq, d)[:, :s_q]
+
+    def unfold(x, like):
+        x = x.reshape(batch, heads, -1, d)
+        return jnp.moveaxis(x, 1, 2).astype(like.dtype)
+
+    return unfold(dq_f, q), unfold(dk_f, k), unfold(dv_f, v)
+
+
+_flash_vjp.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def _flash_forward(q, k, v, block_q, block_k, interpret):
+    batch, s_q, heads, d = q.shape
+    s_kv = k.shape[1]
+    scale = 1.0 / math.sqrt(d)
+
+    bq = min(block_q, s_q)
+    bk = min(block_k, s_kv)
+    pad_q = (-s_q) % bq
+    pad_k = (-s_kv) % bk
+
+    def fold(x, pad):
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        return jnp.moveaxis(x, 2, 1).reshape(batch * heads, -1, d)
+
+    qf, kf, vf = fold(q, pad_q), fold(k, pad_k), fold(v, pad_k)
+    nq = qf.shape[1] // bq
+    nk = kf.shape[1] // bk
+
+    out = pl.pallas_call(
+        functools.partial(
+            _flash_kernel, scale=scale, num_k_blocks=nk, kv_len=s_kv,
+            block_k=bk,
+        ),
+        out_shape=jax.ShapeDtypeStruct(qf.shape, jnp.float32),
+        grid=(batch * heads, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        scratch_shapes=[
+            _vmem((bq, _LANES), jnp.float32),
+            _vmem((bq, _LANES), jnp.float32),
+            _vmem((bq, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    out = out.reshape(batch, heads, -1, d)[:, :, :s_q]
+    return jnp.moveaxis(out, 1, 2)                  # [B, S, H, D]
+
+
+def _vmem(shape, dtype):
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.VMEM(shape, dtype)
