@@ -31,8 +31,10 @@ def build_parser() -> argparse.ArgumentParser:
         prog="dib_tpu",
         description="Train a Distributed IB model on any registered dataset.",
     )
-    parser.add_argument("command", nargs="?", default="train", choices=["train"],
-                        help="Subcommand (only 'train' for now).")
+    parser.add_argument("command", nargs="?", default="train",
+                        choices=["train", "workload"],
+                        help="Subcommand: 'train' (flags below) or 'workload' "
+                             "(paper workloads; see `dib_tpu workload --help`).")
     parser.add_argument("--dataset", default="boolean_circuit",
                         help="Registered dataset name (see dib_tpu.data.available_datasets()).")
     parser.add_argument("--data_path", type=str, default="./data/")
@@ -289,7 +291,146 @@ class _CombinedHooks:
             hook(trainer, state, epoch)
 
 
+# ---------------------------------------------------------------- workloads
+# ``python -m dib_tpu workload <name>`` — the notebook-equivalent drivers
+# (docs/workloads.md) as CLI entry points. Config overrides are generic
+# ``--set field=value`` pairs against each workload's config dataclass (or
+# keyword surface), so the full parameter space is reachable without a
+# bespoke flag per field.
+
+def _coerce(value: str):
+    import ast
+
+    lowered = value.lower()
+    if lowered in ("true", "false"):
+        return lowered == "true"   # bool('false') is True — never pass through
+    if lowered in ("none", "null"):
+        return None
+    try:
+        return ast.literal_eval(value)
+    except (ValueError, SyntaxError):
+        return value  # bare strings (e.g. system=ikeda)
+
+
+def _parse_sets(pairs: Sequence[str]) -> dict:
+    out = {}
+    for pair in pairs:
+        if "=" not in pair:
+            raise SystemExit(f"--set expects field=value, got {pair!r}")
+        k, v = pair.split("=", 1)
+        out[k] = _coerce(v)
+    return out
+
+
+def _check_kwargs(fn, overrides: dict) -> dict:
+    """Validate --set names against a kwargs-style workload's signature."""
+    import inspect
+
+    valid = set(inspect.signature(fn).parameters) - {"seed"}
+    bad = set(overrides) - valid
+    if "seed" in overrides:
+        raise SystemExit("Use --seed, not --set seed=...")
+    if bad:
+        raise SystemExit(
+            f"Unknown {fn.__name__} argument(s) {sorted(bad)}; "
+            f"valid: {sorted(valid)}"
+        )
+    return overrides
+
+
+def _apply_config(config_cls, overrides: dict):
+    import dataclasses
+
+    fields = {f.name for f in dataclasses.fields(config_cls)}
+    bad = set(overrides) - fields
+    if bad:
+        raise SystemExit(
+            f"Unknown {config_cls.__name__} field(s) {sorted(bad)}; "
+            f"valid: {sorted(fields)}"
+        )
+    return config_cls(**overrides)
+
+
+def _json_safe(x, depth: int = 0):
+    """Compact JSON-serializable view of a workload result (arrays -> shapes)."""
+    import dataclasses
+
+    import numpy as np
+
+    if isinstance(x, (bool, int, float, str)) or x is None:
+        return x
+    if isinstance(x, (np.integer, np.floating)):
+        return x.item()
+    if dataclasses.is_dataclass(x) and not isinstance(x, type):
+        return _json_safe(dataclasses.asdict(x), depth)
+    if isinstance(x, dict) and depth < 2:
+        return {str(k): _json_safe(v, depth + 1) for k, v in x.items()}
+    if isinstance(x, (list, tuple)) and len(x) <= 12:
+        vals = [_json_safe(v, depth + 1) for v in x]
+        if all(isinstance(v, (bool, int, float, str, type(None))) for v in vals):
+            return vals
+    try:
+        arr = np.asarray(x)
+        if arr.dtype != object:
+            return f"<array {list(arr.shape)} {arr.dtype}>"
+    except (ValueError, TypeError):
+        pass
+    return f"<{type(x).__name__}>"
+
+
+def workload_main(argv: Sequence[str]) -> int:
+    parser = argparse.ArgumentParser(
+        prog="dib_tpu workload",
+        description="Run a paper workload end to end (see docs/workloads.md).",
+    )
+    parser.add_argument("name", choices=[
+        "boolean", "amorphous", "chaos", "characterization", "radial_shells",
+    ])
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--outdir", default=None,
+                        help="Artifact directory (workloads that write artifacts).")
+    parser.add_argument("--set", action="append", default=[], metavar="FIELD=VALUE",
+                        help="Override a workload config field / keyword "
+                             "(repeatable), e.g. --set num_steps=1000")
+    args = parser.parse_args(argv)
+    overrides = _parse_sets(args.set)
+
+    from dib_tpu import workloads as wl
+
+    if args.name == "boolean":
+        result = wl.run_boolean_workload(
+            args.seed, _apply_config(wl.BooleanWorkloadConfig, overrides)
+        )
+    elif args.name == "amorphous":
+        kwargs = {"outdir": args.outdir} if args.outdir else {}
+        result = wl.run_amorphous_workload(
+            args.seed, _apply_config(wl.AmorphousWorkloadConfig, overrides),
+            **kwargs,
+        )
+    elif args.name == "radial_shells":
+        kwargs = {"outdir": args.outdir} if args.outdir else {}
+        result = wl.run_radial_shells_workload(
+            args.seed, _apply_config(wl.RadialShellsConfig, overrides), **kwargs
+        )
+    elif args.name == "chaos":
+        result = wl.run_chaos_workload(
+            seed=args.seed, **_check_kwargs(wl.run_chaos_workload, overrides)
+        )
+    else:
+        result = {
+            "results": wl.run_characterization(
+                seed=args.seed,
+                **_check_kwargs(wl.run_characterization, overrides),
+            )
+        }
+    print(json.dumps(_json_safe(result)))
+    return 0
+
+
 def main(argv: Sequence[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "workload":
+        return workload_main(argv[1:])
     args = build_parser().parse_args(argv)
     summary = run(args)
     print(json.dumps(summary))

@@ -56,6 +56,12 @@ class MultiHeadSelfAttention(nn.Module):
             features=(self.num_heads, head_dim), dtype=self.dtype, name=name
         )
         q, k, v = proj("query")(x), proj("key")(x), proj("value")(x)
+        if self.use_flash and self.seq_axis is not None:
+            raise ValueError(
+                "use_flash=True conflicts with seq_axis: the flash kernel is "
+                "single-device; sharded sets use ring/Ulysses (the per-shard "
+                "blocks are already VMEM-tiled)"
+            )
         if self.seq_axis is None and self._flash(x.shape[-2]):
             from dib_tpu.ops.pallas_attention import flash_self_attention
 

@@ -93,3 +93,23 @@ def test_cli_infonce_path(tmp_path):
     summary = run(args)
     assert np.isfinite(summary["final_val_loss"])
     assert os.path.exists(tmp_path / "history.npz")
+
+
+def test_cli_workload_boolean_tiny(capsys):
+    from dib_tpu.cli import main
+
+    rc = main([
+        "workload", "boolean",
+        "--set", "num_steps=40", "--set", "mi_every=20",
+        "--set", "batch_size=64",
+    ])
+    assert rc == 0
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert "history" in summary
+
+
+def test_cli_workload_rejects_unknown_field():
+    from dib_tpu.cli import main
+
+    with pytest.raises(SystemExit):
+        main(["workload", "boolean", "--set", "not_a_field=1"])
