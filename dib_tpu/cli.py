@@ -397,6 +397,14 @@ def workload_main(argv: Sequence[str]) -> int:
 
     from dib_tpu import workloads as wl
 
+    if args.outdir and args.name in ("boolean", "chaos"):
+        # strict, like _check_kwargs: these return result dicts and write no
+        # artifact files — a silently ignored --outdir wastes a long run
+        raise SystemExit(
+            f"workload {args.name!r} does not write artifacts; drop --outdir "
+            "and consume the JSON summary (or use the Python API)"
+        )
+
     if args.name == "boolean":
         result = wl.run_boolean_workload(
             args.seed, _apply_config(wl.BooleanWorkloadConfig, overrides)
@@ -417,12 +425,14 @@ def workload_main(argv: Sequence[str]) -> int:
             seed=args.seed, **_check_kwargs(wl.run_chaos_workload, overrides)
         )
     else:
-        result = {
-            "results": wl.run_characterization(
-                seed=args.seed,
-                **_check_kwargs(wl.run_characterization, overrides),
-            )
-        }
+        results = wl.run_characterization(
+            seed=args.seed, **_check_kwargs(wl.run_characterization, overrides)
+        )
+        if args.outdir:
+            wl.save_characterization_plots(results, args.outdir)
+        # element-wise serialization, no outer pass: the sweep IS the product
+        print(json.dumps({"results": [_json_safe(r) for r in results]}))
+        return 0
     print(json.dumps(_json_safe(result)))
     return 0
 
@@ -432,6 +442,12 @@ def main(argv: Sequence[str] | None = None) -> int:
     if argv and argv[0] == "workload":
         return workload_main(argv[1:])
     args = build_parser().parse_args(argv)
+    if args.command == "workload":
+        # 'workload' parsed from a non-leading position (e.g. flags first):
+        # its flags are not the train flags, so re-dispatching would misparse
+        raise SystemExit(
+            "Place the subcommand first: python -m dib_tpu workload <name> ..."
+        )
     summary = run(args)
     print(json.dumps(summary))
     return 0

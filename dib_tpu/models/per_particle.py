@@ -59,6 +59,7 @@ class PerParticleDIBModel(nn.Module):
     data_axis: str | None = None  # optional batch sharding alongside seq_axis
     use_flash: bool | None = None  # blockwise Pallas attention (None = auto on
     flash_min_seq: int = 1024      # TPU for sets >= flash_min_seq)
+    remat: bool = False            # rematerialize attention blocks (HBM saver)
 
     @nn.nowrap
     def _encoder(self, name: str | None = None) -> GaussianEncoder:
@@ -111,6 +112,7 @@ class PerParticleDIBModel(nn.Module):
             seq_impl=self.seq_impl,
             use_flash=self.use_flash,
             flash_min_seq=self.flash_min_seq,
+            remat=self.remat,
             name="aggregator",
         )(u)
 

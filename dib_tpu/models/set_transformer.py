@@ -133,12 +133,19 @@ class SetTransformer(nn.Module):
     seq_impl: str = "ring"        # 'ring' | 'ulysses'
     use_flash: bool | None = None  # blockwise Pallas attention (None = auto)
     flash_min_seq: int = 1024      # auto-dispatch threshold on the set size
+    remat: bool = False            # rematerialize each block on the backward
+                                   # pass: activations per block drop from
+                                   # O(S*qkv_features) to O(S*model_dim)
 
     @nn.compact
     def __call__(self, x: Array) -> Array:
         # x: [B, set_size, model_dim] (local shard of set_size under seq_axis)
-        for _ in range(self.num_blocks):
-            x = SetAttentionBlock(
+        # remat wraps the block class; explicit names keep the param tree
+        # identical either way (checkpoints/params swap freely)
+        block_cls = nn.remat(SetAttentionBlock) if self.remat else SetAttentionBlock
+        for i in range(self.num_blocks):
+            x = block_cls(
+                name=f"SetAttentionBlock_{i}",
                 num_heads=self.num_heads,
                 key_dim=self.key_dim,
                 ff_hidden=tuple(self.ff_hidden),

@@ -126,3 +126,35 @@ class TestTraining:
         assert hook.bounds_bits.shape == (2, 8, 2)
         lower, upper = hook.bounds_bits[..., 0], hook.bounds_bits[..., 1]
         assert (lower <= upper + 1e-6).all()
+
+
+def test_remat_preserves_values_and_grads(rng):
+    import optax
+    from dib_tpu.models.per_particle import PerParticleDIBModel
+
+    model = PerParticleDIBModel(
+        num_particles=8, particle_feature_dim=3, encoder_hidden=(16,),
+        embedding_dim=8, num_blocks=2, num_heads=2, key_dim=8,
+        ff_hidden=(16,), head_hidden=(16,),
+    )
+    x = jnp.asarray(rng.standard_normal((4, 8 * 3)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 2, 4), jnp.float32)
+    key = jax.random.key(1)
+    params = model.init(jax.random.key(0), x, key)
+    remat = model.clone(remat=True)
+
+    def loss(m):
+        def inner(p):
+            pred, aux = m.apply(p, x, key, sample=False)
+            return (
+                jnp.mean(optax.sigmoid_binary_cross_entropy(pred.squeeze(-1), y))
+                + 1e-3 * jnp.sum(aux["kl_per_feature"])
+            )
+        return inner
+
+    l0, g0 = jax.value_and_grad(loss(model))(params)
+    l1, g1 = jax.value_and_grad(loss(remat))(params)
+    np.testing.assert_allclose(float(l1), float(l0), rtol=1e-6)
+    f0, _ = jax.flatten_util.ravel_pytree(g0)
+    f1, _ = jax.flatten_util.ravel_pytree(g1)
+    np.testing.assert_allclose(np.asarray(f1), np.asarray(f0), rtol=1e-5, atol=1e-6)
