@@ -12,3 +12,10 @@ from dib_tpu.train.history import HistoryRecord, history_init, history_record
 from dib_tpu.train.loop import TrainConfig, TrainState, DIBTrainer, make_optimizer
 from dib_tpu.train.hooks import Every, InfoPerFeatureHook, CompressionMatrixHook
 from dib_tpu.train.checkpoint import DIBCheckpointer, CheckpointHook
+from dib_tpu.train.measurement import (
+    MeasurementConfig,
+    MeasurementRepeatTrainer,
+    MeasurementTrainer,
+    MeasurementTrainState,
+    make_state_windows,
+)

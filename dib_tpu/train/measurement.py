@@ -241,3 +241,146 @@ class MeasurementTrainer:
         return self.stack.apply(
             params, flat, key, num_noise_draws, method=self.stack.symbolize
         )
+
+
+class MeasurementRepeatTrainer:
+    """R independent repeats of the measurement optimization as ONE program.
+
+    The chaos paper's protocol is "loop over number_states from 2 to 15, with
+    20 repeats per" (chaos notebook cell 10 header) — the reference re-runs
+    the whole script per repeat. Here the REPEATS of one configuration are a
+    leading replica axis (same windows/config, different PRNG chains),
+    vmapped into a single jitted program and optionally sharded over the mesh
+    ``'beta'`` axis exactly like :class:`~dib_tpu.parallel.sweep
+    .BetaSweepTrainer` members. (Different ``num_states`` values change array
+    shapes, so that outer loop stays a loop — each iteration gets its own
+    repeat ensemble.)
+
+    Per-repeat MI early stopping matches the serial trainer at chunk
+    granularity: a replica whose lower bound has crossed ``mi_stop_bits``
+    has its updates masked to zero from the next chunk on (its parameters
+    freeze exactly as if its run had ended).
+    """
+
+    def __init__(self, stack, windows: np.ndarray, config: MeasurementConfig,
+                 num_repeats: int, mesh=None):
+        self.base = MeasurementTrainer(stack, windows, config)
+        self.num_repeats = int(num_repeats)
+        self.mesh = mesh
+        if mesh is not None:
+            from dib_tpu.parallel.mesh import BETA_AXIS, validate_sweep_shapes
+
+            validate_sweep_shapes(mesh, self.num_repeats, 1)
+            self._spmd_axis = BETA_AXIS
+        else:
+            self._spmd_axis = None
+
+    def init(self, keys: Array) -> MeasurementTrainState:
+        states = jax.vmap(self.base.init)(self._check(keys))
+        if self.mesh is not None:
+            from dib_tpu.parallel.mesh import shard_replicas
+
+            states = shard_replicas(states, self.mesh)
+        return states
+
+    def _check(self, keys: Array) -> Array:
+        keys = jnp.asarray(keys)
+        if keys.shape[0] != self.num_repeats:
+            raise ValueError(
+                f"Expected {self.num_repeats} repeat keys, got {keys.shape[0]}"
+            )
+        return keys
+
+    @partial(
+        jax.jit, static_argnames=("self", "num_steps"), donate_argnames=("states",)
+    )
+    def run_chunk(self, states, keys, active, num_steps: int):
+        """Vmapped chunk with per-replica update masking (``active`` [R])."""
+
+        def one(state, key, live):
+            # the serial epoch body, un-jitted (class attr __wrapped__) —
+            # vmap supplies the batching, the outer jit the compilation
+            new_state, stats = MeasurementTrainer.run_chunk.__wrapped__(
+                self.base, state, key, num_steps
+            )
+            # frozen (early-stopped) replicas keep their old state verbatim,
+            # and their stats are NaN-masked: the chunk's computed values come
+            # from discarded updates, and recording them would fabricate a
+            # training curve past the stop (the serial path truncates there)
+            return (
+                jax.tree.map(
+                    lambda new, old: jnp.where(live, new, old), new_state, state
+                ),
+                jax.tree.map(lambda s: jnp.where(live, s, jnp.nan), stats),
+            )
+
+        return jax.vmap(one, spmd_axis_name=self._spmd_axis)(
+            states, keys, self._check_active(active)
+        )
+
+    def _check_active(self, active) -> Array:
+        active = jnp.asarray(active, bool)
+        if active.shape != (self.num_repeats,):
+            raise ValueError(f"active mask must be [{self.num_repeats}]")
+        return active
+
+    def channel_mi_bounds(self, states, keys):
+        def one(state, key):
+            return self.base.channel_mi_bounds(state, key)
+
+        return jax.vmap(one, spmd_axis_name=self._spmd_axis)(
+            states, self._check(keys)
+        )
+
+    def fit(self, keys: Array):
+        """All repeats to completion (or early stop). Returns (states, history).
+
+        ``history['mi_bounds']`` records [R] lower/upper pairs per check;
+        per-step series come back stacked [R, steps].
+        """
+        cfg = self.base.config
+        keys = self._check(keys)
+        split = jax.vmap(jax.random.split)(keys)
+        keys, init_keys = split[:, 0], split[:, 1]
+        states = self.init(init_keys)
+        active = jnp.ones((self.num_repeats,), bool)
+        series: dict = {"loss": [], "match": [], "kl": [], "beta": []}
+        checks = []
+        done = 0
+        while done < cfg.num_steps and bool(np.any(np.asarray(active))):
+            chunk = min(cfg.check_every, cfg.num_steps - done)
+            split = jax.vmap(lambda k: jax.random.split(k, 3))(keys)
+            keys, k_chunk, k_mi = split[:, 0], split[:, 1], split[:, 2]
+            states, stats = self.run_chunk(states, k_chunk, active, chunk)
+            for name in series:
+                series[name].append(np.asarray(stats[name]))
+            lower, upper = self.channel_mi_bounds(states, k_mi)
+            lower_bits = np.asarray(lower) / np.log(2.0)
+            checks.append({
+                "step": done + chunk,
+                "lower": np.asarray(lower),
+                "upper": np.asarray(upper),
+                "active": np.asarray(active),
+            })
+            active = active & jnp.asarray(lower_bits < cfg.mi_stop_bits)
+            done += chunk
+        history = {
+            name: np.concatenate(vals, axis=1) if vals else np.zeros((self.num_repeats, 0))
+            for name, vals in series.items()
+        }
+        history["mi_bounds"] = checks
+        history["stopped_early"] = np.asarray(~active)
+        # per-replica step count at which training actually ended (the first
+        # check that flipped the replica inactive; num_steps if it never did)
+        stop_steps = np.full((self.num_repeats,), done, np.int64)
+        alive = np.ones((self.num_repeats,), bool)
+        for check in checks:
+            flipped = alive & (np.asarray(check["lower"]) / np.log(2.0)
+                               >= cfg.mi_stop_bits)
+            stop_steps[flipped] = check["step"]
+            alive &= ~flipped
+        history["stop_steps"] = stop_steps
+        return states, history
+
+    def replica_state(self, states, r: int) -> MeasurementTrainState:
+        return jax.tree.map(lambda a: a[r], states)

@@ -32,6 +32,7 @@ from dib_tpu.models.measurement import MeasurementStack
 from dib_tpu.ops.entropy import entropy_rate_scaling_ansatz
 from dib_tpu.train.measurement import (
     MeasurementConfig,
+    MeasurementRepeatTrainer,
     MeasurementTrainer,
     make_state_windows,
 )
@@ -151,16 +152,52 @@ def run_chaos_workload(
     include_random_baseline: bool = True,
     seed: int = 0,
     chunk_size: int = 10_000,
+    num_repeats: int = 1,
+    mesh=None,
 ) -> dict:
     """The full chaos pipeline; returns a result dict (JSON-serializable
-    except for the raw arrays)."""
+    except for the raw arrays).
+
+    ``num_repeats > 1`` trains that many repeats of the configuration as one
+    vmapped program (the paper's "20 repeats per" protocol, optionally
+    sharded over a mesh's 'beta' axis) and carries the repeat with the
+    highest MI lower bound into the characterization phase; per-repeat
+    training curves are returned under ``repeat_history``.
+    """
     config = config or MeasurementConfig()
     train_traj = generate_data(system, number_iterations=train_iterations, seed=seed)
     windows = make_state_windows(train_traj, num_states)
 
     stack = MeasurementStack(alphabet_size=alphabet_size, num_states=num_states)
     trainer = MeasurementTrainer(stack, windows, config)
-    state, history = trainer.fit(jax.random.key(seed))
+    repeat_history = None
+    if num_repeats > 1:
+        repeats = MeasurementRepeatTrainer(
+            stack, windows, config, num_repeats, mesh=mesh
+        )
+        states, repeat_history = repeats.fit(
+            jax.random.split(jax.random.key(seed), num_repeats)
+        )
+        final = repeat_history["mi_bounds"][-1]
+        best = int(np.argmax(np.asarray(final["lower"])))
+        state = repeats.replica_state(states, best)
+        # truncate at the replica's actual stop step (serial-path semantics:
+        # post-stop series segments are NaN-masked, not training)
+        stop = int(repeat_history["stop_steps"][best])
+        history = {
+            name: np.asarray(repeat_history[name][best][:stop])
+            for name in ("loss", "match", "kl", "beta")
+        }
+        history["mi_bounds"] = [
+            {"step": c["step"], "lower": float(c["lower"][best]),
+             "upper": float(c["upper"][best])}
+            for c in repeat_history["mi_bounds"]
+            if c["step"] <= stop
+        ]
+        history["stopped_early"] = bool(repeat_history["stopped_early"][best])
+        history["best_repeat"] = best
+    else:
+        state, history = trainer.fit(jax.random.key(seed))
 
     char_traj = generate_data(
         system, number_iterations=characterization_iterations, seed=seed + 1
@@ -191,6 +228,9 @@ def run_chaos_workload(
         "fit": fit,
         "h_known": KNOWN_ENTROPY_RATES.get(system),
     }
+    if repeat_history is not None:
+        result["repeat_history"] = repeat_history
+        result["num_repeats"] = num_repeats
     if include_random_baseline:
         result["random_partition_rates"] = random_partition_entropy(
             char_traj[: min(len(char_traj), 200_000)],

@@ -1,0 +1,130 @@
+"""Repeat-ensemble training of the chaos measurement stack.
+
+The paper's protocol is N repeats per configuration (chaos notebook cell 10
+header); the ensemble trainer runs them as one vmapped program. Pins: replica
+parity with the serial trainer, per-replica early-stop freezing, and the
+mesh-sharded path.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dib_tpu.data.chaos_maps import generate_data
+from dib_tpu.models.measurement import MeasurementStack
+from dib_tpu.train.measurement import (
+    MeasurementConfig,
+    MeasurementRepeatTrainer,
+    MeasurementTrainer,
+    make_state_windows,
+)
+
+
+def _setup(mi_stop_bits=10.0, num_steps=40):
+    traj = generate_data("logistic", number_iterations=2000, seed=0)
+    windows = make_state_windows(traj, 4)
+    stack = MeasurementStack(alphabet_size=2, num_states=4)
+    config = MeasurementConfig(
+        batch_size=64, num_steps=num_steps, check_every=20,
+        mi_eval_batch_size=128, mi_eval_batches=1, mi_stop_bits=mi_stop_bits,
+    )
+    return stack, windows, config
+
+
+def test_repeat_replica_matches_serial():
+    stack, windows, config = _setup()
+    key = jax.random.key(7)
+    serial = MeasurementTrainer(stack, windows, config)
+    s_state, s_hist = serial.fit(key)
+
+    repeats = MeasurementRepeatTrainer(stack, windows, config, num_repeats=2)
+    keys = jnp.stack([key, jax.random.key(8)])
+    r_states, r_hist = repeats.fit(keys)
+
+    # same key chain and schedule; XLA reorders float32 reductions under
+    # vmap, so agreement is to accumulated-float tolerance over 40 steps
+    # (the BetaSweepTrainer.recover_replica caveat)
+    flat_s, _ = jax.flatten_util.ravel_pytree(s_state.params)
+    flat_r, _ = jax.flatten_util.ravel_pytree(repeats.replica_state(r_states, 0).params)
+    np.testing.assert_allclose(np.asarray(flat_r), np.asarray(flat_s),
+                               rtol=1e-2, atol=2e-3)
+    np.testing.assert_allclose(r_hist["loss"][0], s_hist["loss"],
+                               rtol=1e-2, atol=2e-3)
+    # second replica is a genuinely different sample
+    assert not np.allclose(r_hist["loss"][1], s_hist["loss"])
+
+
+def test_repeat_early_stop_freezes_replicas():
+    stack, windows, config = _setup(mi_stop_bits=0.0, num_steps=100)
+    repeats = MeasurementRepeatTrainer(stack, windows, config, num_repeats=2)
+    states, hist = repeats.fit(jax.random.split(jax.random.key(0), 2))
+    # every replica crosses a 0-bit threshold at the first check
+    assert bool(hist["stopped_early"].all())
+    assert hist["loss"].shape == (2, config.check_every)
+    assert len(hist["mi_bounds"]) == 1
+
+
+def test_repeat_sharded_over_mesh():
+    from dib_tpu.parallel.mesh import make_sweep_mesh
+
+    stack, windows, config = _setup(num_steps=20)
+    mesh = make_sweep_mesh(2, 1, devices=jax.devices()[:2])
+    repeats = MeasurementRepeatTrainer(stack, windows, config, num_repeats=2,
+                                       mesh=mesh)
+    states, hist = repeats.fit(jax.random.split(jax.random.key(1), 2))
+    assert hist["loss"].shape == (2, 20)
+    assert np.isfinite(hist["loss"]).all()
+
+
+def test_repeat_mixed_active_mask_freezes_only_inactive():
+    """Direct run_chunk with active=[True, False]: the frozen replica's
+    params must be bit-identical before/after; the live one must move; the
+    frozen replica's stats must be NaN-masked."""
+    stack, windows, config = _setup()
+    repeats = MeasurementRepeatTrainer(stack, windows, config, num_repeats=2)
+    keys = jax.random.split(jax.random.key(3), 2)
+    states = repeats.init(keys)
+    before = jax.device_get(states.params)
+    new_states, stats = repeats.run_chunk(
+        states, jax.random.split(jax.random.key(4), 2),
+        jnp.asarray([True, False]), 5,
+    )
+    after = jax.device_get(new_states.params)
+    f_before, _ = jax.flatten_util.ravel_pytree(
+        jax.tree.map(lambda a: a[1], before))
+    f_after, _ = jax.flatten_util.ravel_pytree(
+        jax.tree.map(lambda a: a[1], after))
+    np.testing.assert_array_equal(f_after, f_before)  # frozen: bit-identical
+    l_before, _ = jax.flatten_util.ravel_pytree(
+        jax.tree.map(lambda a: a[0], before))
+    l_after, _ = jax.flatten_util.ravel_pytree(
+        jax.tree.map(lambda a: a[0], after))
+    assert not np.array_equal(l_after, l_before)      # live: trained
+    assert np.isnan(np.asarray(stats["loss"])[1]).all()
+    assert np.isfinite(np.asarray(stats["loss"])[0]).all()
+
+
+def test_repeat_rejects_wrong_key_count():
+    stack, windows, config = _setup()
+    repeats = MeasurementRepeatTrainer(stack, windows, config, num_repeats=3)
+    with pytest.raises(ValueError, match="3 repeat keys"):
+        repeats.fit(jax.random.split(jax.random.key(0), 2))
+
+
+def test_chaos_workload_with_repeats():
+    from dib_tpu.workloads import run_chaos_workload
+
+    result = run_chaos_workload(
+        system="logistic", num_states=4, train_iterations=2000,
+        characterization_iterations=30_000,
+        config=MeasurementConfig(batch_size=64, num_steps=40, check_every=20,
+                                 mi_eval_batch_size=128, mi_eval_batches=1),
+        scaling_lengths=[5_000, 10_000, 20_000], num_scaling_draws=1,
+        num_noise_draws=8, include_random_baseline=False, chunk_size=5_000,
+        num_repeats=2,
+    )
+    assert result["num_repeats"] == 2
+    assert result["repeat_history"]["loss"].shape[0] == 2
+    assert "best_repeat" in result["history"]
+    assert np.isfinite(result["fit"]["h_inf"])
