@@ -322,11 +322,18 @@ def _parse_sets(pairs: Sequence[str]) -> dict:
     return out
 
 
-def _check_kwargs(fn, overrides: dict) -> dict:
-    """Validate --set names against a kwargs-style workload's signature."""
+def _check_kwargs(fn, overrides: dict, *extra_fns, exclude: tuple = ()) -> dict:
+    """Validate --set names against kwargs-style workload signature(s).
+
+    ``extra_fns`` extend the valid set for drivers that forward
+    ``**workload_kwargs`` to another entry point; ``exclude`` names params
+    the driver binds itself (so a --set would collide with them)."""
     import inspect
 
     valid = set(inspect.signature(fn).parameters) - {"seed"}
+    for other in extra_fns:
+        valid |= set(inspect.signature(other).parameters)
+    valid -= {"seed", "workload_kwargs", *exclude}
     bad = set(overrides) - valid
     if "seed" in overrides:
         raise SystemExit("Use --seed, not --set seed=...")
@@ -336,6 +343,23 @@ def _check_kwargs(fn, overrides: dict) -> dict:
             f"valid: {sorted(valid)}"
         )
     return overrides
+
+
+def _pop_config(overrides: dict) -> dict:
+    """Fold ``config.field=value`` dotted overrides into a MeasurementConfig
+    (the chaos workloads' nested hyperparameter dataclass)."""
+    nested = {k[len("config."):]: v for k, v in overrides.items()
+              if k.startswith("config.")}
+    if not nested:
+        return overrides
+    from dib_tpu.train.measurement import MeasurementConfig
+
+    rest = {k: v for k, v in overrides.items() if not k.startswith("config.")}
+    if "config" in rest:
+        raise SystemExit("Pass either config.field=... overrides or a whole "
+                         "config=..., not both")
+    rest["config"] = _apply_config(MeasurementConfig, nested)
+    return rest
 
 
 def _apply_config(config_cls, overrides: dict):
@@ -384,7 +408,8 @@ def workload_main(argv: Sequence[str]) -> int:
         description="Run a paper workload end to end (see docs/workloads.md).",
     )
     parser.add_argument("name", choices=[
-        "boolean", "amorphous", "chaos", "characterization", "radial_shells",
+        "boolean", "amorphous", "chaos", "chaos_state_sweep",
+        "characterization", "radial_shells",
     ])
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--outdir", default=None,
@@ -422,7 +447,19 @@ def workload_main(argv: Sequence[str]) -> int:
         )
     elif args.name == "chaos":
         result = wl.run_chaos_workload(
-            seed=args.seed, **_check_kwargs(wl.run_chaos_workload, overrides)
+            seed=args.seed,
+            **_check_kwargs(wl.run_chaos_workload, _pop_config(overrides))
+        )
+    elif args.name == "chaos_state_sweep":
+        result = wl.run_chaos_state_sweep(
+            seed=args.seed,
+            outdir=args.outdir,
+            **_check_kwargs(
+                wl.run_chaos_state_sweep, _pop_config(overrides),
+                wl.run_chaos_workload,
+                # bound by the sweep driver itself — a --set would collide
+                exclude=("num_states", "outdir"),
+            ),
         )
     else:
         results = wl.run_characterization(

@@ -35,5 +35,7 @@ from dib_tpu.workloads.chaos import (
     entropy_rate_scaling_curve,
     fit_entropy_rate,
     random_partition_entropy,
+    run_chaos_state_sweep,
     run_chaos_workload,
+    save_state_sweep_plot,
 )

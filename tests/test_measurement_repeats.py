@@ -128,3 +128,22 @@ def test_chaos_workload_with_repeats():
     assert result["repeat_history"]["loss"].shape[0] == 2
     assert "best_repeat" in result["history"]
     assert np.isfinite(result["fit"]["h_inf"])
+
+
+def test_chaos_state_sweep(tmp_path):
+    from dib_tpu.workloads import run_chaos_state_sweep
+
+    result = run_chaos_state_sweep(
+        system="logistic", state_counts=(2, 4), num_repeats=2,
+        outdir=str(tmp_path),
+        train_iterations=2000, characterization_iterations=30_000,
+        config=MeasurementConfig(batch_size=64, num_steps=40, check_every=20,
+                                 mi_eval_batch_size=128, mi_eval_batches=1),
+        scaling_lengths=[5_000, 10_000, 20_000], num_scaling_draws=1,
+        num_noise_draws=8, include_random_baseline=False, chunk_size=5_000,
+    )
+    curve = result["curve"]
+    assert list(curve["state_counts"]) == [2, 4]
+    assert np.isfinite(curve["h_inf"]).all()
+    assert (tmp_path / "logistic_state_sweep.png").exists()
+    assert set(result["per_state"]) == {2, 4}
