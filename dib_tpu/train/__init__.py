@@ -13,6 +13,7 @@ from dib_tpu.train.loop import TrainConfig, TrainState, DIBTrainer, make_optimiz
 from dib_tpu.train.hooks import Every, InfoPerFeatureHook, CompressionMatrixHook
 from dib_tpu.train.checkpoint import DIBCheckpointer, CheckpointHook
 from dib_tpu.train.measurement import (
+    MeasurementCheckpointer,
     MeasurementConfig,
     MeasurementRepeatTrainer,
     MeasurementTrainer,

@@ -174,14 +174,25 @@ class MeasurementTrainer:
         )
 
     # -------------------------------------------------------------------- fit
-    def fit(self, key: Array, state: MeasurementTrainState | None = None):
-        """Train with the MI early stop. Returns (state, history dict)."""
+    def fit(self, key: Array, state: MeasurementTrainState | None = None,
+            hooks=()):
+        """Train with the MI early stop. Returns (state, history dict).
+
+        ``hooks`` are called as ``hook(trainer, state, step)`` after every
+        stopping check; ``trainer.resume_key`` / ``trainer.latest_history``
+        are published first (the DIBTrainer convention), so a
+        ``MeasurementCheckpointer`` save in a hook captures the exact resume
+        point — ``fit(restored_key, state=restored_state)`` continues the key
+        chain bit-identically at the same chunk boundaries.
+        """
         cfg = self.config
         if state is None:
             key, k_init = jax.random.split(key)
             state = self.init(k_init)
         history = {"loss": [], "match": [], "kl": [], "beta": [], "mi_bounds": []}
         stopped = False
+        self.resume_key = key    # defined even if the loop body never runs
+        self.latest_history = history
         while int(state.step) < cfg.num_steps and not stopped:
             chunk = min(cfg.check_every, cfg.num_steps - int(state.step))
             key, k_chunk, k_mi = jax.random.split(key, 3)
@@ -194,6 +205,10 @@ class MeasurementTrainer:
                 {"step": int(state.step), "lower": float(lower), "upper": float(upper)}
             )
             stopped = lower_bits >= cfg.mi_stop_bits
+            self.resume_key = key
+            self.latest_history = history
+            for hook in hooks:
+                hook(self, state, int(state.step))
         for name in ("loss", "match", "kl", "beta"):
             history[name] = (
                 np.concatenate(history[name]) if history[name] else np.zeros(0)
@@ -332,21 +347,45 @@ class MeasurementRepeatTrainer:
             states, self._check(keys)
         )
 
-    def fit(self, keys: Array):
+    def fit(self, keys: Array, hooks=(), states=None, active=None,
+            stop_steps=None):
         """All repeats to completion (or early stop). Returns (states, history).
 
         ``history['mi_bounds']`` records [R] lower/upper pairs per check;
-        per-step series come back stacked [R, steps].
+        per-step series come back stacked [R, steps]. ``hooks`` follow the
+        serial trainer's convention (``hook(trainer, states, step)`` after
+        each check, with ``resume_key`` published as the [R] key array and
+        the live ``latest_active`` / ``latest_stop_steps`` alongside).
+
+        Resume: pass the ``(states, active, stop_steps)`` triple a
+        ``MeasurementCheckpointer`` restored (all three or none — a resumed
+        run without the mask would retrain early-stopped replicas). The
+        chunk done-count continues from ``max(states.step)``.
         """
         cfg = self.base.config
         keys = self._check(keys)
-        split = jax.vmap(jax.random.split)(keys)
-        keys, init_keys = split[:, 0], split[:, 1]
-        states = self.init(init_keys)
-        active = jnp.ones((self.num_repeats,), bool)
+        resumed = [states, active, stop_steps]
+        if any(x is None for x in resumed) != all(x is None for x in resumed):
+            raise ValueError(
+                "Resuming needs states, active AND stop_steps (a restored "
+                "checkpoint provides all three); got a partial set."
+            )
+        if states is None:
+            split = jax.vmap(jax.random.split)(keys)
+            keys, init_keys = split[:, 0], split[:, 1]
+            states = self.init(init_keys)
+            active = jnp.ones((self.num_repeats,), bool)
+            stop_steps = np.full((self.num_repeats,), cfg.num_steps, np.int64)
+            done = 0
+        else:
+            active = self._check_active(active)
+            stop_steps = np.asarray(stop_steps, np.int64).copy()
+            done = int(np.max(np.asarray(jax.device_get(states.step))))
         series: dict = {"loss": [], "match": [], "kl": [], "beta": []}
         checks = []
-        done = 0
+        self.resume_key = keys
+        self.latest_active = np.asarray(active)
+        self.latest_stop_steps = stop_steps
         while done < cfg.num_steps and bool(np.any(np.asarray(active))):
             chunk = min(cfg.check_every, cfg.num_steps - done)
             split = jax.vmap(lambda k: jax.random.split(k, 3))(keys)
@@ -362,25 +401,149 @@ class MeasurementRepeatTrainer:
                 "upper": np.asarray(upper),
                 "active": np.asarray(active),
             })
-            active = active & jnp.asarray(lower_bits < cfg.mi_stop_bits)
             done += chunk
+            # the single place the stop criterion lives: replicas flipping
+            # inactive here record `done` as their true final step
+            still_training = lower_bits < cfg.mi_stop_bits
+            flipped = np.asarray(active) & ~still_training
+            stop_steps[flipped] = done
+            active = active & jnp.asarray(still_training)
+            self.resume_key = keys
+            self.latest_active = np.asarray(active)
+            self.latest_stop_steps = stop_steps
+            for hook in hooks:
+                hook(self, states, done)
         history = {
             name: np.concatenate(vals, axis=1) if vals else np.zeros((self.num_repeats, 0))
             for name, vals in series.items()
         }
         history["mi_bounds"] = checks
         history["stopped_early"] = np.asarray(~active)
-        # per-replica step count at which training actually ended (the first
-        # check that flipped the replica inactive; num_steps if it never did)
-        stop_steps = np.full((self.num_repeats,), done, np.int64)
-        alive = np.ones((self.num_repeats,), bool)
-        for check in checks:
-            flipped = alive & (np.asarray(check["lower"]) / np.log(2.0)
-                               >= cfg.mi_stop_bits)
-            stop_steps[flipped] = check["step"]
-            alive &= ~flipped
         history["stop_steps"] = stop_steps
         return states, history
 
     def replica_state(self, states, r: int) -> MeasurementTrainState:
         return jax.tree.map(lambda a: a[r], states)
+
+
+class MeasurementCheckpointer:
+    """Orbax checkpoint/resume for the measurement trainers.
+
+    Serial trainer: saves ``(state, next_key)``; resume with
+    ``fit(key, state=state)``. Repeat trainer: additionally saves the
+    per-replica ``active`` mask and ``stop_steps`` (read off the trainer's
+    published ``latest_active`` / ``latest_stop_steps``); resume with
+    ``fit(keys, states=..., active=..., stop_steps=...)``. The host-side
+    history series are stored as a 1-D-per-series ``.npz`` sidecar (sidecars
+    are pruned with the same retention as the Orbax steps); resumed runs
+    continue the step-indexed beta schedule and key chain exactly.
+    """
+
+    _SERIES = ("loss", "match", "kl", "beta")
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        import os
+
+        import orbax.checkpoint as ocp
+
+        self.directory = os.path.abspath(directory)
+        self.max_to_keep = max_to_keep
+        self.manager = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True
+            ),
+        )
+
+    def save(self, step: int, state: MeasurementTrainState, key: Array,
+             history: dict | None = None, active=None, stop_steps=None) -> None:
+        import glob
+        import os
+
+        import orbax.checkpoint as ocp
+
+        from dib_tpu.train.checkpoint import _pack_key
+
+        payload = {"state": state, "key": _pack_key(key)}
+        if (active is None) != (stop_steps is None):
+            raise ValueError("Pass active and stop_steps together (repeat "
+                             "checkpoints) or neither (serial).")
+        if active is not None:
+            payload["active"] = np.asarray(active, bool)
+            payload["stop_steps"] = np.asarray(stop_steps, np.int64)
+        self.manager.save(int(step), args=ocp.args.StandardSave(payload))
+        if history is not None:
+            series = {}
+            for name in self._SERIES:
+                if name not in history:
+                    continue
+                val = history[name]
+                # mid-run (fit's latest_history) series are lists of
+                # per-chunk arrays — possibly ragged chunks; concatenate to
+                # the same 1-D (or [R, steps]) form fit returns
+                series[name] = (
+                    np.concatenate(val, axis=-1) if isinstance(val, list)
+                    else np.asarray(val)
+                )
+            np.savez(os.path.join(self.directory, f"history_{int(step)}.npz"),
+                     **series)
+        # sidecar retention mirrors the manager's max_to_keep
+        sidecars = sorted(
+            glob.glob(os.path.join(self.directory, "history_*.npz")),
+            key=lambda p: int(os.path.basename(p)[8:-4]),
+        )
+        for stale in sidecars[: -self.max_to_keep]:
+            os.remove(stale)
+
+    @property
+    def latest_step(self) -> int | None:
+        self.manager.wait_until_finished()
+        return self.manager.latest_step()
+
+    def restore(self, trainer, step: int | None = None):
+        """Restore from the latest (or given) step.
+
+        Serial trainer: returns ``(state, key, history)``. Repeat trainer:
+        returns ``(states, keys, history, active, stop_steps)`` — pass the
+        last three array values straight back into ``fit``.
+        ``history`` is None when no series sidecar was saved.
+        """
+        import os
+
+        import jax as _jax
+        import orbax.checkpoint as ocp
+
+        from dib_tpu.train.checkpoint import _pack_key, _unpack_key
+
+        self.manager.wait_until_finished()
+        step = self.latest_step if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"No checkpoint found in {self.directory}")
+        is_repeat = isinstance(trainer, MeasurementRepeatTrainer)
+        if is_repeat:
+            template_key = _jax.random.split(
+                _jax.random.key(0), trainer.num_repeats
+            )
+            n = trainer.num_repeats
+        else:
+            template_key = _jax.random.key(0)
+        template_state = trainer.init(template_key)
+        template = {"state": template_state, "key": _pack_key(template_key)}
+        if is_repeat:
+            template["active"] = np.zeros((n,), bool)
+            template["stop_steps"] = np.zeros((n,), np.int64)
+        abstract = _jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
+        restored = self.manager.restore(
+            step, args=ocp.args.StandardRestore(abstract)
+        )
+        path = os.path.join(self.directory, f"history_{int(step)}.npz")
+        history = dict(np.load(path)) if os.path.exists(path) else None
+        out = (restored["state"], _unpack_key(restored["key"]), history)
+        if is_repeat:
+            out += (np.asarray(restored["active"]),
+                    np.asarray(restored["stop_steps"]))
+        return out
+
+    def close(self) -> None:
+        self.manager.wait_until_finished()
+        self.manager.close()

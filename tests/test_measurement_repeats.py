@@ -147,3 +147,72 @@ def test_chaos_state_sweep(tmp_path):
     assert np.isfinite(curve["h_inf"]).all()
     assert (tmp_path / "logistic_state_sweep.png").exists()
     assert set(result["per_state"]) == {2, 4}
+
+
+def test_measurement_checkpoint_bitwise_resume(tmp_path):
+    """Checkpoint mid-run; the resumed run must match an uninterrupted one
+    bit-for-bit (same chunk boundaries, same key chain)."""
+    from dib_tpu.train.measurement import MeasurementCheckpointer
+
+    stack, windows, config = _setup(num_steps=40)  # check_every=20 -> 2 chunks
+    tr_full = MeasurementTrainer(stack, windows, config)
+    s_full, _ = tr_full.fit(jax.random.key(5))
+
+    ckpt = MeasurementCheckpointer(str(tmp_path / "ck"))
+    saved = []
+
+    def hook(trainer, state, step):
+        if step == 20 and not saved:
+            ckpt.save(step, state, trainer.resume_key, trainer.latest_history)
+            saved.append(step)
+
+    tr_a = MeasurementTrainer(stack, windows, config)
+    tr_a.fit(jax.random.key(5), hooks=[hook])
+    assert saved == [20]
+
+    tr_b = MeasurementTrainer(stack, windows, config)
+    state, key, history = ckpt.restore(tr_b)
+    assert int(state.step) == 20
+    s_resumed, _ = tr_b.fit(key, state=state)
+    f_full, _ = jax.flatten_util.ravel_pytree(jax.device_get(s_full.params))
+    f_res, _ = jax.flatten_util.ravel_pytree(jax.device_get(s_resumed.params))
+    np.testing.assert_array_equal(np.asarray(f_res), np.asarray(f_full))
+    ckpt.close()
+
+
+def test_measurement_checkpoint_repeats_resume(tmp_path):
+    """Checkpoint a repeat run mid-way and resume: the continuation must
+    match the uninterrupted run bit-for-bit (same widths, same key chain)."""
+    from dib_tpu.train.measurement import MeasurementCheckpointer
+
+    stack, windows, config = _setup(num_steps=40)  # 2 chunks of 20
+    keys = jax.random.split(jax.random.key(9), 2)
+
+    full = MeasurementRepeatTrainer(stack, windows, config, num_repeats=2)
+    s_full, _ = full.fit(keys)
+
+    ckpt = MeasurementCheckpointer(str(tmp_path / "ck"))
+    saved = []
+
+    def hook(trainer, states, step):
+        if step == 20 and not saved:
+            ckpt.save(step, states, trainer.resume_key,
+                      active=trainer.latest_active,
+                      stop_steps=trainer.latest_stop_steps)
+            saved.append(step)
+
+    interrupted = MeasurementRepeatTrainer(stack, windows, config, num_repeats=2)
+    interrupted.fit(keys, hooks=[hook])
+    assert saved == [20]
+
+    resumed_tr = MeasurementRepeatTrainer(stack, windows, config, num_repeats=2)
+    states, r_keys, history, active, stop_steps = ckpt.restore(resumed_tr)
+    assert history is None
+    assert active.shape == (2,)
+    s_resumed, _ = resumed_tr.fit(
+        r_keys, states=states, active=active, stop_steps=stop_steps
+    )
+    f_full, _ = jax.flatten_util.ravel_pytree(jax.device_get(s_full.params))
+    f_res, _ = jax.flatten_util.ravel_pytree(jax.device_get(s_resumed.params))
+    np.testing.assert_array_equal(np.asarray(f_res), np.asarray(f_full))
+    ckpt.close()
