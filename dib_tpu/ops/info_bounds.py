@@ -163,6 +163,7 @@ def mi_sandwich_probe(
     probe_logvars: Array,
     data_mus: Array,
     data_logvars: Array,
+    u: Array | None = None,
 ) -> tuple[Array, Array]:
     """Per-probe sandwich bounds against a bank of data Gaussians.
 
@@ -170,6 +171,8 @@ def mi_sandwich_probe(
       probe_mus, probe_logvars: [M, d] channel parameters at probe (phantom)
         inputs — e.g. a grid of phantom particles.
       data_mus, data_logvars: [N, d] channel parameters at real data samples.
+      u: optional pre-drawn [M, d] samples (overrides ``key``; the sharded
+        evaluator passes per-shard draws so dense/sharded parity is exact).
 
     Returns:
       ([M] infonce_lower, [M] loo_upper) in nats, per probe point.
@@ -179,7 +182,8 @@ def mi_sandwich_probe(
     conditionals); the LOO denominator is the mean over the N data conditionals.
     """
     n = data_mus.shape[0]
-    u = reparameterize(key, probe_mus, probe_logvars)            # [M, d]
+    if u is None:
+        u = reparameterize(key, probe_mus, probe_logvars)        # [M, d]
     # own-density term log p(u_i | probe_i), diagonal only
     d = probe_mus.shape[-1]
     diff = (u - probe_mus) * jnp.exp(-0.5 * probe_logvars)

@@ -9,6 +9,7 @@ from dib_tpu.parallel.context import (
     dense_self_attention,
     ring_self_attention,
     self_attention,
+    sharded_probe_bounds,
     ulysses_self_attention,
 )
 from dib_tpu.parallel.mesh import (
@@ -55,6 +56,7 @@ __all__ = [
     "ring_self_attention",
     "self_attention",
     "shard_replicas",
+    "sharded_probe_bounds",
     "sweep_records",
     "ulysses_self_attention",
     "validate_sweep_shapes",

@@ -30,6 +30,7 @@ so a context-parallel *training* step is just ``jax.grad`` through a
 
 from __future__ import annotations
 
+import functools
 import math
 from functools import partial
 from typing import Callable
@@ -202,6 +203,50 @@ def context_parallel_apply(model, params, x: Array, key: Array, mesh: Mesh,
         in_specs=(P(), P(data_axis, seq_axis), P()),
         out_specs=(P(data_axis), aux_specs),
     )(params, x, key)
+
+
+def sharded_probe_bounds(key, probe_mus, probe_logvars, data_mus, data_logvars,
+                         mesh: Mesh, axis: str = "seq"):
+    """Probe-grid MI sandwich bounds with the PROBE axis sharded over ``axis``.
+
+    The probe evaluation (amorphous notebook cell 8's information maps —
+    typically 10k phantom particles against a data bank, the heaviest
+    instrumentation compute at a beta checkpoint) is embarrassingly parallel
+    over probes: each shard scores its probes against the full (replicated)
+    data bank, no collectives. Each shard draws its own sampling noise
+    (``fold_in`` by mesh position), so results equal a dense
+    ``mi_sandwich_probe`` call evaluated with the same per-shard draws.
+    Probes are padded to the axis size and the padding sliced off.
+    """
+    n = mesh.shape[axis]
+    m = probe_mus.shape[0]
+    pad = (-m) % n
+    if pad:
+        probe_mus = jnp.pad(probe_mus, ((0, pad), (0, 0)))
+        probe_logvars = jnp.pad(probe_logvars, ((0, pad), (0, 0)))
+    lower, upper = _probe_shard_fn(mesh, axis)(
+        key, probe_mus, probe_logvars, data_mus, data_logvars
+    )
+    return lower[:m], upper[:m]
+
+
+@functools.lru_cache(maxsize=8)
+def _probe_shard_fn(mesh: Mesh, axis: str):
+    """Jitted shard_map for the probe evaluation, cached per (mesh, axis) so
+    repeated beta-checkpoint calls hit the dispatch cache instead of
+    re-tracing (Mesh is hashable)."""
+    from dib_tpu.ops.info_bounds import mi_sandwich_probe
+
+    def shard(key, p_mus, p_lvs, d_mus, d_lvs):
+        key = jax.random.fold_in(key, jax.lax.axis_index(axis))
+        return mi_sandwich_probe(key, p_mus, p_lvs, d_mus, d_lvs)
+
+    return jax.jit(jax.shard_map(
+        shard,
+        mesh=mesh,
+        in_specs=(P(), P(axis), P(axis), P(), P()),
+        out_specs=(P(axis), P(axis)),
+    ))
 
 
 def context_parallel_step_fn(model, optimizer, mesh: Mesh, seq_axis: str = "seq",

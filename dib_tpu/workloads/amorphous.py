@@ -135,11 +135,15 @@ def probe_info_maps(
     data_particles: np.ndarray,
     key: Array,
     config: AmorphousWorkloadConfig,
+    mesh=None,
 ) -> list[np.ndarray]:
     """[G, G, 2] (lower, upper) info grids in nats, one per particle type.
 
     Parity: amorphous notebook cell 8 — asymmetric M-probe x N-data bounds
-    with the shared particle encoder.
+    with the shared particle encoder. With ``mesh``, the probe grid (the
+    heaviest beta-checkpoint instrumentation: grid_side^2 phantom particles)
+    shards over the mesh's trailing axis via
+    :func:`dib_tpu.parallel.context.sharded_probe_bounds`.
     """
     positions = probe_grid_positions(config.grid_side, config.grid_extent)
     k_bank, k_type1, k_type2 = jax.random.split(key, 3)
@@ -153,9 +157,17 @@ def probe_info_maps(
     for type_id, k in ((1, k_type1), (2, k_type2)):
         feats = jnp.asarray(probe_features_for_type(positions, type_id))
         probe_mus, probe_logvars = model.encode_feature(params, 0, feats)
-        lower, upper = mi_sandwich_probe(
-            k, probe_mus, probe_logvars, data_mus, data_logvars
-        )
+        if mesh is not None:
+            from dib_tpu.parallel.context import sharded_probe_bounds
+
+            lower, upper = sharded_probe_bounds(
+                k, probe_mus, probe_logvars, data_mus, data_logvars,
+                mesh, axis=mesh.axis_names[-1],
+            )
+        else:
+            lower, upper = mi_sandwich_probe(
+                k, probe_mus, probe_logvars, data_mus, data_logvars
+            )
         grid = np.stack([np.asarray(lower), np.asarray(upper)], axis=-1)
         grids.append(grid.reshape(config.grid_side, config.grid_side, 2))
     return grids
@@ -176,10 +188,12 @@ class ProbeGridHook:
         sets_train: np.ndarray,
         config: AmorphousWorkloadConfig,
         seed: int = 0,
+        mesh=None,   # shard the probe grid over this mesh's trailing axis
     ):
         self.outdir = outdir
         self.model = model
         self.config = config
+        self.mesh = mesh
         os.makedirs(outdir, exist_ok=True)
         self.key = jax.random.key(seed)
         # flat bank of real per-particle features for the data side
@@ -196,7 +210,8 @@ class ProbeGridHook:
         self.key, k = jax.random.split(self.key)
         params = state.params["model"] if "model" in state.params else state.params
         grids = probe_info_maps(
-            self.model, params, self.data_particles, k, self.config
+            self.model, params, self.data_particles, k, self.config,
+            mesh=self.mesh,
         )
         self.grids_by_step[epoch] = grids
         save_info_maps(
@@ -216,12 +231,14 @@ def run_amorphous_workload(
     steps_per_epoch: int = 1,
     probe_maps: bool = True,
     model_overrides: dict | None = None,
+    probe_mesh=None,
     **fetch_kwargs,
 ) -> dict:
     """Single-schedule end-to-end run (one protocol, one beta ramp).
 
     Returns the trained state, history (bits), MI-bound trajectory, probe-map
-    grids, and artifact paths.
+    grids, and artifact paths. ``probe_mesh`` shards the probe-grid
+    evaluation (the heaviest checkpoint instrumentation) over a device mesh.
     """
     config = config or AmorphousWorkloadConfig()
     if isinstance(key, int):
@@ -240,7 +257,7 @@ def run_amorphous_workload(
     probe_hook = None
     if probe_maps and config.probe_every:
         probe_hook = ProbeGridHook(
-            outdir, model, bundle.extras["sets_train"], config
+            outdir, model, bundle.extras["sets_train"], config, mesh=probe_mesh
         )
         cadences.append(max(config.probe_every // steps_per_epoch, 1))
         hooks.append(Every(cadences[-1], probe_hook))
