@@ -6,6 +6,7 @@ from dib_tpu.workloads.amorphous import (
     pair_correlation,
     probe_grid_positions,
     probe_info_maps,
+    run_amorphous_protocols,
     run_amorphous_sweep,
     run_amorphous_workload,
 )

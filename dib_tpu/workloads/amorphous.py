@@ -354,3 +354,36 @@ def run_amorphous_sweep(
         "info_plane_paths": paths,
         "mesh": mesh,
     }
+
+
+def run_amorphous_protocols(
+    key: Array | int = 0,
+    protocols: Sequence[str] = ("GradualQuench", "RapidQuench"),
+    config: AmorphousWorkloadConfig | None = None,
+    outdir: str = "./amorphous_out",
+    **workload_kwargs,
+) -> dict:
+    """The reference's outer loop (amorphous notebook cell 8: ``for protocol
+    in ['GradualQuench', 'RapidQuench']``): one full per-particle run per
+    quench protocol, each with its own artifact subdirectory and PRNG stream.
+
+    Real ``{protocol}.npz`` exports are used when present under
+    ``data_path``; otherwise each protocol gets an independent synthetic
+    surrogate (distinct fetch seed). Returns ``{protocol: result}`` with the
+    same per-run contract as :func:`run_amorphous_workload`.
+    """
+    if isinstance(key, int):
+        key = jax.random.key(key)
+    results = {}
+    for i, protocol in enumerate(protocols):
+        fetch = dict(workload_kwargs)
+        fetch.setdefault("seed", 0)
+        fetch["seed"] = fetch["seed"] + 7919 * i   # independent surrogates
+        results[protocol] = run_amorphous_workload(
+            jax.random.fold_in(key, i),
+            config=config,
+            outdir=os.path.join(outdir, protocol),
+            protocol=protocol,
+            **fetch,
+        )
+    return results

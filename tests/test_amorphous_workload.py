@@ -99,3 +99,26 @@ def test_run_amorphous_sweep_tiny(tmp_path):
     # endpoint grid is repeated pairwise
     assert result["beta_ends"][0] == result["beta_ends"][1]
     assert len(result["info_plane_paths"]) == 4
+
+
+@pytest.mark.slow
+def test_protocol_loop_runs_both(tmp_path):
+    from dib_tpu.workloads import run_amorphous_protocols
+
+    cfg = tiny_config(
+        num_steps=6, eval_every=3, probe_every=0, number_particles=6,
+        warmup_steps=0,
+    )
+    results = run_amorphous_protocols(
+        key=0, config=cfg, outdir=str(tmp_path),
+        model_overrides=TINY_MODEL,
+        num_synthetic_neighborhoods=64,
+    )
+    assert set(results) == {"GradualQuench", "RapidQuench"}
+    for protocol, res in results.items():
+        assert res["bundle"].extras["protocol"] == protocol
+        assert (tmp_path / protocol / "distributed_info_plane.png").exists()
+    # independent surrogate data per protocol
+    a = results["GradualQuench"]["bundle"].x_train
+    b = results["RapidQuench"]["bundle"].x_train
+    assert not np.array_equal(a, b)
