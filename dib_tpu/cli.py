@@ -408,8 +408,8 @@ def workload_main(argv: Sequence[str]) -> int:
         description="Run a paper workload end to end (see docs/workloads.md).",
     )
     parser.add_argument("name", choices=[
-        "boolean", "amorphous", "chaos", "chaos_state_sweep",
-        "characterization", "radial_shells",
+        "boolean", "amorphous", "amorphous_protocols", "chaos",
+        "chaos_state_sweep", "characterization", "radial_shells",
     ])
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--outdir", default=None,
@@ -438,6 +438,22 @@ def workload_main(argv: Sequence[str]) -> int:
         kwargs = {"outdir": args.outdir} if args.outdir else {}
         result = wl.run_amorphous_workload(
             args.seed, _apply_config(wl.AmorphousWorkloadConfig, overrides),
+            **kwargs,
+        )
+    elif args.name == "amorphous_protocols":
+        import dataclasses
+
+        kwargs = {"outdir": args.outdir} if args.outdir else {}
+        fields = {f.name for f in dataclasses.fields(wl.AmorphousWorkloadConfig)}
+        cfg = {k: v for k, v in overrides.items() if k in fields}
+        # non-config --set names pass through as workload/fetch kwargs
+        # (protocols, model_overrides, data_path, ... — the fetcher's surface
+        # is open-ended, so they are not pre-validated here)
+        rest = {k: v for k, v in overrides.items() if k not in fields}
+        result = wl.run_amorphous_protocols(
+            key=args.seed,
+            config=_apply_config(wl.AmorphousWorkloadConfig, cfg) if cfg else None,
+            **rest,
             **kwargs,
         )
     elif args.name == "radial_shells":
