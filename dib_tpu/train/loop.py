@@ -101,6 +101,14 @@ class DIBTrainer:
         self._x_train = jnp.asarray(bundle.x_train)
         self._y_train = jnp.asarray(bundle.y_train)
         nv = min(bundle.x_valid.shape[0], config.max_val_points)
+        if nv == 0:
+            raise ValueError(
+                "No validation points available (x_valid has "
+                f"{bundle.x_valid.shape[0]} rows, max_val_points="
+                f"{config.max_val_points}) — the per-epoch validation pass "
+                "needs at least one; enlarge the dataset's validation split "
+                "or raise max_val_points."
+            )
         if self.contrastive:
             # InfoNCE has a log(B) baseline, so validation must use the SAME
             # batch size as training for comparable loss values (the reference
