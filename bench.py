@@ -35,40 +35,82 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def _wait_for_device(retries: int = 6, delay_s: float = 60.0):
-    """Probe the backend with retries: a freshly restarted TPU worker (or a
-    tunnel recovering from a crash) can be UNAVAILABLE for minutes."""
+def _subprocess_probe(timeout_s: int) -> str | None:
+    """Probe backend init in a KILLABLE child process.
+
+    A dead TPU tunnel makes ``jax.devices()`` HANG indefinitely rather than
+    raise (observed: multi-hour hangs that SIGALRM cannot interrupt — the
+    block never yields to Python signal handlers). Probing in a subprocess
+    with a hard timeout turns the hang into a retryable failure without
+    wedging the benchmark process. Returns None on success, else a reason.
+    """
+    import subprocess
+
+    code = (
+        "import os, jax, jax.numpy as jnp\n"
+        "d = jax.devices()\n"
+        "assert d[0].platform != 'cpu' or os.environ.get('DIB_BENCH_ALLOW_CPU'), \\\n"
+        "    'backend resolved to CPU'\n"
+        "jax.block_until_ready(jnp.ones((8, 8)) @ jnp.ones((8, 8)))\n"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            timeout=timeout_s, capture_output=True, text=True,
+        )
+    except subprocess.TimeoutExpired:
+        return f"probe hung > {timeout_s}s (tunnel down?)"
+    if proc.returncode != 0:
+        stderr = (proc.stderr or "").strip()
+        return stderr.splitlines()[-1] if stderr else "probe failed"
+    return None
+
+
+def _wait_for_device(retries: int = 6, delay_s: float = 60.0,
+                     probe_timeout_s: int = 150):
+    """Wait for a usable accelerator: a freshly restarted TPU worker (or a
+    tunnel recovering from a crash) can be unavailable — or hanging — for
+    minutes. Only after a subprocess probe succeeds does THIS process
+    initialize its backend (avoiding an un-killable in-process hang)."""
     import jax
     import jax.numpy as jnp
 
+    last_error: Exception | None = None
     for attempt in range(retries):
-        try:
-            devices = jax.devices()
-            if devices[0].platform == "cpu" and not os.environ.get(
-                "DIB_BENCH_ALLOW_CPU"
-            ):
-                # a swallowed TPU-init failure silently falls back to CPU;
-                # a CPU number against the 10-min TPU target is meaningless
-                raise RuntimeError(
-                    "benchmark backend resolved to CPU (TPU init failed or "
-                    "JAX_PLATFORMS unset); set DIB_BENCH_ALLOW_CPU=1 to "
-                    "force a CPU run"
-                )
-            jax.block_until_ready(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
-            return devices
-        except Exception as e:  # backend init / transport errors
-            log(f"device probe {attempt + 1}/{retries} failed: {e}")
-            if attempt == retries - 1:
-                raise
+        reason = _subprocess_probe(probe_timeout_s)
+        if reason is None:
+            # the parent's own init can still hit a transient transport
+            # error in the window after the probe — keep it retryable
             try:
-                # drop any cached dead client so the next probe re-inits the
-                # backend instead of reusing a broken connection
-                import jax.extend as jex
+                devices = jax.devices()
+                if devices[0].platform == "cpu" and not os.environ.get(
+                    "DIB_BENCH_ALLOW_CPU"
+                ):
+                    # a swallowed TPU-init failure silently falls back to
+                    # CPU; a CPU number against the 10-min TPU target is
+                    # meaningless
+                    raise RuntimeError(
+                        "benchmark backend resolved to CPU (TPU init failed "
+                        "or JAX_PLATFORMS unset); set DIB_BENCH_ALLOW_CPU=1 "
+                        "to force a CPU run"
+                    )
+                jax.block_until_ready(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+                return devices
+            except Exception as e:
+                reason, last_error = str(e), e
+                try:
+                    # drop the dead client so the next attempt re-inits
+                    import jax.extend as jex
 
-                jex.backend.clear_backends()
-            except Exception:
-                pass
-            time.sleep(delay_s)
+                    jex.backend.clear_backends()
+                except Exception:
+                    pass
+        log(f"device probe {attempt + 1}/{retries} failed: {reason}")
+        if attempt == retries - 1:
+            raise last_error or RuntimeError(
+                f"no usable device after {retries} probes: {reason}"
+            )
+        time.sleep(delay_s)
 
 
 def main() -> None:
