@@ -253,3 +253,20 @@ def test_sharded_probe_bounds_matches_dense(rng):
                                rtol=1e-5, atol=1e-5)
     # (no pointwise lower<=upper assertion: the sandwich ordering holds in
     # expectation, not per single-sample probe estimate)
+
+
+def test_dense_attention_emits_f32_scores_from_bf16():
+    """Stability-recipe regression guard (see dense_self_attention docstring):
+    with bf16 inputs the scores matmul must produce float32 directly — a
+    bf16 score round-trip NaN'd under XLA fusion on the flagship workload.
+    The TPU repro can't run in CPU CI, so pin the implementation property:
+    every dot_general in the jaxpr outputs float32."""
+    q = jnp.ones((2, 8, 2, 4), jnp.bfloat16)
+    jaxpr = jax.make_jaxpr(dense_self_attention)(q, q, q)
+    dots = [e for e in jaxpr.jaxpr.eqns if e.primitive.name == "dot_general"]
+    assert dots, "expected dot_general ops in dense attention"
+    for eqn in dots:
+        assert eqn.outvars[0].aval.dtype == jnp.float32, (
+            f"dot_general emits {eqn.outvars[0].aval.dtype}; the f32-scores "
+            "stability recipe has been regressed"
+        )
