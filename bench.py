@@ -6,11 +6,27 @@ set-transformer configuration from the reference (amorphous notebook cell 8
 batch 32 neighborhoods x 50 particles, 25,000 steps) swept over a grid of
 beta endpoints as ONE jitted vmapped program.
 
-It times the steady-state sweep throughput on the available device and
-projects the wall-clock of the complete north-star run (R replicas x 25k
-steps). ``vs_baseline`` is the projection divided by the 10-minute target
-the driver set for a v4-8 (BASELINE.json ``north_star``); < 1.0 beats the
-target.
+It times the steady-state sweep throughput on the available device, projects
+the wall-clock of the complete north-star run (R replicas x 25k steps), and
+reports MFU (model FLOPs from XLA ``cost_analysis`` vs the chip's peak for
+the dtype mix). ``vs_baseline`` is the projection divided by the 10-minute
+target the driver set for a v4-8 (BASELINE.json ``north_star``); < 1.0 beats
+the target.
+
+Architecture (hardened after round 1, where a dead TPU tunnel burned the
+whole perf round): a PARENT process that never initializes an accelerator
+backend orchestrates a CHILD (``bench.py --child``) that does all device
+work. A dead tunnel makes backend init HANG un-killably in-process (signals
+never fire), so every device interaction lives in a killable subprocess.
+The parent retries within a total time budget and ALWAYS prints exactly one
+JSON line and exits 0: a fresh measurement when the device cooperates,
+otherwise a ``degraded`` record embedding the last good measurement from
+the committed ``BENCH_CACHE.json``.
+
+Environment knobs:
+  DIB_BENCH_TOTAL_BUDGET_S  total parent budget, default 2400
+  DIB_BENCH_ALLOW_CPU       permit a CPU measurement (testing only)
+  DIB_BENCH_FRESH           ignore the cache (degraded output has value null)
 
 Prints exactly ONE JSON line to stdout; diagnostics go to stderr.
 """
@@ -19,101 +35,64 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
 
-NUM_REPLICAS = 8
+CACHE_PATH = os.path.join(REPO, "BENCH_CACHE.json")
+METRIC = "amorphous_set_transformer_beta_sweep_projected"
+
+NUM_REPLICAS = int(os.environ.get("DIB_BENCH_REPLICAS", "8"))
 FULL_SWEEP_STEPS = 25_000          # reference run length per protocol
 BASELINE_MINUTES = 10.0            # driver-set north-star target (v4-8)
-STEPS_PER_EPOCH = 50
-MEASURE_EPOCHS = 6                 # 6 * 50 * 8 replicas = 2400 sweep steps
+STEPS_PER_EPOCH = int(os.environ.get("DIB_BENCH_STEPS_PER_EPOCH", "50"))
+MEASURE_EPOCHS = int(os.environ.get("DIB_BENCH_MEASURE_EPOCHS", "6"))
+
+# Peak dense-matmul TFLOP/s per chip for the bf16 dtype mix (public specs).
+# device_kind substrings as reported by jax; conservative bf16 numbers.
+PEAK_BF16_TFLOPS = {
+    "v6": 918.0,        # Trillium / v6e
+    "v5p": 459.0,
+    "v5": 197.0,        # v5e / "TPU v5 lite"
+    "v4": 275.0,
+    "v3": 123.0,        # v3 has no bf16 MXU gain over f32? (bf16 peak)
+    "v2": 45.0,
+}
 
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def _subprocess_probe(timeout_s: int) -> str | None:
-    """Probe backend init in a KILLABLE child process.
-
-    A dead TPU tunnel makes ``jax.devices()`` HANG indefinitely rather than
-    raise (observed: multi-hour hangs that SIGALRM cannot interrupt — the
-    block never yields to Python signal handlers). Probing in a subprocess
-    with a hard timeout turns the hang into a retryable failure without
-    wedging the benchmark process. Returns None on success, else a reason.
-    """
-    import subprocess
-
-    code = (
-        "import os, jax, jax.numpy as jnp\n"
-        "d = jax.devices()\n"
-        "assert d[0].platform != 'cpu' or os.environ.get('DIB_BENCH_ALLOW_CPU'), \\\n"
-        "    'backend resolved to CPU'\n"
-        "jax.block_until_ready(jnp.ones((8, 8)) @ jnp.ones((8, 8)))\n"
-    )
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", code],
-            timeout=timeout_s, capture_output=True, text=True,
-        )
-    except subprocess.TimeoutExpired:
-        return f"probe hung > {timeout_s}s (tunnel down?)"
-    if proc.returncode != 0:
-        stderr = (proc.stderr or "").strip()
-        return stderr.splitlines()[-1] if stderr else "probe failed"
+def peak_tflops_for(device_kind: str) -> float | None:
+    kind = device_kind.lower()
+    for key in ("v6", "v5p", "v5", "v4", "v3", "v2"):
+        if key in kind:
+            return PEAK_BF16_TFLOPS[key]
     return None
 
 
-def _wait_for_device(retries: int = 6, delay_s: float = 60.0,
-                     probe_timeout_s: int = 150):
-    """Wait for a usable accelerator: a freshly restarted TPU worker (or a
-    tunnel recovering from a crash) can be unavailable — or hanging — for
-    minutes. Only after a subprocess probe succeeds does THIS process
-    initialize its backend (avoiding an un-killable in-process hang)."""
+# ==========================================================================
+# CHILD: all device work happens here, killable from the parent.
+# ==========================================================================
+
+def _honor_platform_env() -> None:
+    """Re-apply JAX_PLATFORMS after import: this box's sitecustomize
+    pre-imports jax with the tunnel backend baked into jax.config, so the
+    env var alone is read too early to take effect (same workaround as
+    tests/conftest.py)."""
     import jax
-    import jax.numpy as jnp
 
-    last_error: Exception | None = None
-    for attempt in range(retries):
-        reason = _subprocess_probe(probe_timeout_s)
-        if reason is None:
-            # the parent's own init can still hit a transient transport
-            # error in the window after the probe — keep it retryable
-            try:
-                devices = jax.devices()
-                if devices[0].platform == "cpu" and not os.environ.get(
-                    "DIB_BENCH_ALLOW_CPU"
-                ):
-                    # a swallowed TPU-init failure silently falls back to
-                    # CPU; a CPU number against the 10-min TPU target is
-                    # meaningless
-                    raise RuntimeError(
-                        "benchmark backend resolved to CPU (TPU init failed "
-                        "or JAX_PLATFORMS unset); set DIB_BENCH_ALLOW_CPU=1 "
-                        "to force a CPU run"
-                    )
-                jax.block_until_ready(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
-                return devices
-            except Exception as e:
-                reason, last_error = str(e), e
-                try:
-                    # drop the dead client so the next attempt re-inits
-                    import jax.extend as jex
-
-                    jex.backend.clear_backends()
-                except Exception:
-                    pass
-        log(f"device probe {attempt + 1}/{retries} failed: {reason}")
-        if attempt == retries - 1:
-            raise last_error or RuntimeError(
-                f"no usable device after {retries} probes: {reason}"
-            )
-        time.sleep(delay_s)
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        jax.config.update("jax_platforms", plat)
 
 
-def main() -> None:
+def child_main() -> None:
+    _honor_platform_env()
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -123,12 +102,20 @@ def main() -> None:
     from dib_tpu.parallel import BetaSweepTrainer
     from dib_tpu.train import TrainConfig
 
-    devices = _wait_for_device()
+    devices = jax.devices()
+    if devices[0].platform == "cpu" and not os.environ.get("DIB_BENCH_ALLOW_CPU"):
+        # a swallowed TPU-init failure silently falls back to CPU; a CPU
+        # number against the 10-min TPU target is meaningless
+        raise RuntimeError(
+            "benchmark backend resolved to CPU (TPU init failed or "
+            "JAX_PLATFORMS unset); set DIB_BENCH_ALLOW_CPU=1 to force"
+        )
+    device_kind = devices[0].device_kind
     log(f"devices: {devices}")
 
     bundle = get_dataset("amorphous_particles", num_synthetic_neighborhoods=2048)
-    # Full paper architecture; attention/FF matmuls in bfloat16 (MXU-native,
-    # ~1.5x over f32 on v5e) — KL, sampling, and logits stay float32.
+    # Full paper architecture; attention/FF matmuls in bfloat16 (MXU-native)
+    # — KL, sampling, and logits stay float32.
     model = PerParticleDIBModel(num_particles=50, compute_dtype="bfloat16")
     config = TrainConfig(
         learning_rate=1e-4,
@@ -149,17 +136,34 @@ def main() -> None:
     t0 = time.time()
     states, histories = sweep.init(init_keys)
 
+    # Model FLOPs per executed chunk from XLA's own cost model, captured off
+    # the exact computation being timed (VERDICT round 1: report MFU so
+    # steps/s is judgeable against the chip).
+    chunk_flops = None
+    try:
+        # .lower via the class attribute: jit's bound-method wrapper does
+        # not forward .lower with self bound.
+        lowered = BetaSweepTrainer.run_chunk.lower(
+            sweep, states, histories, warm_keys, MEASURE_EPOCHS
+        )
+        cost = lowered.compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        flops = float(cost.get("flops", 0.0))
+        if flops > 0:
+            chunk_flops = flops
+    except Exception as e:  # cost model availability varies by backend
+        log(f"cost_analysis unavailable: {e}")
+
     # Warmup chunk: triggers compile of the full epoch scan (num_epochs is a
     # static arg, so warm with the same value the measurement uses).
     states, histories = sweep.run_chunk(states, histories, warm_keys, MEASURE_EPOCHS)
     jax.block_until_ready(states.params)
     compile_s = time.time() - t0
-    log(f"init+compile+first epoch: {compile_s:.1f}s")
+    log(f"init+compile+first chunk: {compile_s:.1f}s")
 
     t1 = time.time()
-    states, histories = sweep.run_chunk(
-        states, histories, meas_keys, MEASURE_EPOCHS
-    )
+    states, histories = sweep.run_chunk(states, histories, meas_keys, MEASURE_EPOCHS)
     jax.block_until_ready(states.params)
     measure_s = time.time() - t1
 
@@ -170,11 +174,21 @@ def main() -> None:
     projected_s = FULL_SWEEP_STEPS * NUM_REPLICAS / steps_per_s + compile_s
     projected_min = projected_s / 60.0
 
+    mfu = achieved_tflops = flops_per_step = None
+    if chunk_flops:
+        flops_per_step = chunk_flops / sweep_steps
+        achieved_tflops = flops_per_step * steps_per_s / 1e12
+        peak = peak_tflops_for(device_kind)
+        if peak:
+            mfu = achieved_tflops / peak
+
     log(
         f"measured {sweep_steps} sweep steps in {measure_s:.2f}s "
         f"({steps_per_s:.0f} steps/s); projected full sweep "
         f"({NUM_REPLICAS} replicas x {FULL_SWEEP_STEPS} steps): "
-        f"{projected_min:.2f} min"
+        f"{projected_min:.2f} min; "
+        f"flops/step={flops_per_step}, achieved_tflops={achieved_tflops}, "
+        f"mfu={mfu}"
     )
     # Sanity: training must not have gone non-finite anywhere in the run.
     kl = np.asarray(histories["kl_per_feature"])
@@ -183,14 +197,191 @@ def main() -> None:
     print(
         json.dumps(
             {
-                "metric": "amorphous_set_transformer_beta_sweep_projected",
+                "metric": METRIC,
                 "value": round(projected_min, 3),
                 "unit": "minutes",
                 "vs_baseline": round(projected_min / BASELINE_MINUTES, 4),
+                "steps_per_s": round(steps_per_s, 1),
+                "compile_s": round(compile_s, 1),
+                "flops_per_step": flops_per_step,
+                "achieved_tflops": (
+                    round(achieved_tflops, 2) if achieved_tflops else None
+                ),
+                "mfu": round(mfu, 4) if mfu else None,
+                "device_kind": device_kind,
+                "num_replicas": NUM_REPLICAS,
+                "full_sweep_steps": FULL_SWEEP_STEPS,
             }
-        )
+        ),
+        flush=True,
     )
 
 
+# ==========================================================================
+# PARENT: orchestration only. Never initializes jax.
+# ==========================================================================
+
+def probe_device(timeout_s: int) -> str | None:
+    """Backend-init probe in a killable child. None on success, else reason."""
+    code = (
+        "import os, jax, jax.numpy as jnp\n"
+        "p = os.environ.get('JAX_PLATFORMS')\n"
+        "p and jax.config.update('jax_platforms', p)\n"
+        "d = jax.devices()\n"
+        "assert d[0].platform != 'cpu' or os.environ.get('DIB_BENCH_ALLOW_CPU'), \\\n"
+        "    'backend resolved to CPU'\n"
+        "jax.block_until_ready(jnp.ones((8, 8)) @ jnp.ones((8, 8)))\n"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            timeout=timeout_s, capture_output=True, text=True,
+        )
+    except subprocess.TimeoutExpired as e:
+        if e.stderr:
+            sys.stderr.write(
+                e.stderr if isinstance(e.stderr, str) else e.stderr.decode()
+            )
+        return f"probe hung > {timeout_s}s (tunnel down?)"
+    if proc.returncode != 0:
+        stderr = (proc.stderr or "").strip()
+        return stderr.splitlines()[-1] if stderr else "probe failed"
+    return None
+
+
+def run_child(timeout_s: int) -> tuple[dict | None, str]:
+    """Run the measurement child; returns (parsed result, reason)."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child"],
+            timeout=timeout_s, capture_output=True, text=True,
+        )
+    except subprocess.TimeoutExpired as e:
+        # keep the child's partial diagnostics (device list, compile log):
+        # for a hang they are the only forensic record
+        if e.stderr:
+            sys.stderr.write(
+                e.stderr if isinstance(e.stderr, str) else e.stderr.decode()
+            )
+        return None, f"measurement hung > {timeout_s}s"
+    sys.stderr.write(proc.stderr or "")
+    if proc.returncode != 0:
+        stderr = (proc.stderr or "").strip()
+        return None, stderr.splitlines()[-1] if stderr else "child failed"
+    for line in reversed((proc.stdout or "").strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+            if isinstance(parsed, dict) and "value" in parsed:
+                return parsed, "ok"
+        except json.JSONDecodeError:
+            continue
+    return None, "child printed no JSON result"
+
+
+def load_cache() -> dict | None:
+    if os.environ.get("DIB_BENCH_FRESH"):
+        return None
+    try:
+        with open(CACHE_PATH) as f:
+            cached = json.load(f)
+        return cached if isinstance(cached, dict) and "value" in cached else None
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def save_cache(result: dict) -> None:
+    # Never let a test configuration masquerade as the last good north-star
+    # measurement: the degraded path reports the cache against the 10-min
+    # TPU target, so only default-config accelerator runs may refresh it.
+    if os.environ.get("DIB_BENCH_ALLOW_CPU") or any(
+        os.environ.get(k)
+        for k in ("DIB_BENCH_REPLICAS", "DIB_BENCH_MEASURE_EPOCHS",
+                  "DIB_BENCH_STEPS_PER_EPOCH")
+    ):
+        log("cache not refreshed: non-default benchmark configuration")
+        return
+    record = dict(result)
+    record["measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    try:
+        with open(CACHE_PATH, "w") as f:
+            json.dump(record, f, indent=1)
+            f.write("\n")
+    except OSError as e:
+        log(f"cache write failed: {e}")
+
+
+def emit(result: dict) -> None:
+    print(json.dumps(result), flush=True)
+
+
+def parent_main() -> None:
+    budget_s = float(os.environ.get("DIB_BENCH_TOTAL_BUDGET_S", "2400"))
+    deadline = time.time() + budget_s
+    probe_timeout = 150
+    measure_timeout = 1500
+    backoff = 30.0
+
+    attempt = 0
+    device_ever_up = False
+    last_failure = "no probe attempted"
+    while True:
+        attempt += 1
+        remaining = deadline - time.time()
+        if remaining < probe_timeout + 60:
+            break
+        reason = probe_device(min(probe_timeout, int(remaining - 30)))
+        if reason is None:
+            device_ever_up = True
+            remaining = deadline - time.time()
+            child_budget = int(min(measure_timeout, max(remaining - 10, 60)))
+            log(f"attempt {attempt}: device up, measuring (budget {child_budget}s)")
+            result, why = run_child(child_budget)
+            if result is not None:
+                save_cache(result)
+                emit(result)
+                return
+            last_failure = f"measurement failed: {why}"
+            log(f"attempt {attempt}: {last_failure}")
+        else:
+            last_failure = reason
+            log(f"attempt {attempt}: {reason}")
+        sleep_s = min(backoff, max(deadline - time.time() - probe_timeout, 0))
+        if sleep_s > 0:
+            time.sleep(sleep_s)
+        backoff = min(backoff * 2, 240.0)
+
+    # Budget exhausted: degrade, embedding the last good measurement so the
+    # round still carries a parseable perf record (VERDICT round 1, item 1).
+    # Distinguish a dead tunnel from a live device whose measurement kept
+    # failing — they send the operator to entirely different bugs.
+    cached = load_cache()
+    degraded = {
+        "metric": METRIC,
+        "value": cached.get("value") if cached else None,
+        "unit": "minutes",
+        "vs_baseline": cached.get("vs_baseline") if cached else None,
+        "degraded": "measurement_failed" if device_ever_up else "no_device",
+        "detail": (
+            f"budget {budget_s:.0f}s exhausted; last failure: {last_failure}; "
+            + (
+                "value is the last good measurement (see cache_measured_at)"
+                if cached
+                else "no cached measurement available"
+            )
+        ),
+    }
+    if cached:
+        for key in ("steps_per_s", "mfu", "achieved_tflops", "device_kind",
+                    "measured_at"):
+            if key in cached:
+                degraded["cache_" + key if key == "measured_at" else key] = (
+                    cached[key]
+                )
+    emit(degraded)
+
+
 if __name__ == "__main__":
-    main()
+    if "--child" in sys.argv:
+        child_main()
+    else:
+        parent_main()
