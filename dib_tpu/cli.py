@@ -333,7 +333,9 @@ def _check_kwargs(fn, overrides: dict, *extra_fns, exclude: tuple = ()) -> dict:
     valid = set(inspect.signature(fn).parameters) - {"seed"}
     for other in extra_fns:
         valid |= set(inspect.signature(other).parameters)
-    valid -= {"seed", "workload_kwargs", *exclude}
+    # 'mesh' takes a jax.sharding.Mesh — inexpressible as a --set literal
+    # (the coerced string would fail deep inside the workload)
+    valid -= {"seed", "workload_kwargs", "mesh", *exclude}
     bad = set(overrides) - valid
     if "seed" in overrides:
         raise SystemExit("Use --seed, not --set seed=...")

@@ -66,8 +66,25 @@ def initialize(coordinator_address: str | None = None,
                 "before any other JAX usage."
             )
             return False
-        except Exception:
-            return False  # no cluster spec in the environment: single host
+        except Exception as e:
+            # "coordinator_address should be defined" is the EXPECTED
+            # single-host outcome (no cluster spec anywhere) — stay quiet.
+            # Exact-message match only: a MALFORMED coordinator address also
+            # mentions coordinator_address but must warn. Anything else is a
+            # broken cluster spec and must not silently degrade a pod into N
+            # uncoordinated single-process trainers — same loud path as the
+            # RuntimeError branch above.
+            if "coordinator_address should be defined" in str(e):
+                return False
+            import warnings
+
+            warnings.warn(
+                f"jax.distributed.initialize failed ({type(e).__name__}: {e}); "
+                "continuing single-process. If this host is part of a pod, "
+                "fix the cluster environment — training would otherwise run "
+                "uncoordinated."
+            )
+            return False
         return jax.process_count() > 1
     jax.distributed.initialize(
         coordinator_address=coordinator_address,

@@ -374,6 +374,10 @@ def run_amorphous_protocols(
     """
     if isinstance(key, int):
         key = jax.random.key(key)
+    if isinstance(protocols, str):
+        # a bare protocol name would iterate character-by-character,
+        # launching one junk run per letter
+        protocols = (protocols,)
     results = {}
     for i, protocol in enumerate(protocols):
         fetch = dict(workload_kwargs)

@@ -113,3 +113,31 @@ def test_cli_workload_rejects_unknown_field():
 
     with pytest.raises(SystemExit):
         main(["workload", "boolean", "--set", "not_a_field=1"])
+
+
+def test_cli_workload_rejects_mesh_override():
+    # 'mesh' takes a jax.sharding.Mesh and cannot be expressed as a --set
+    # literal; a coerced string would fail deep inside the workload
+    from dib_tpu.cli import main
+
+    with pytest.raises(SystemExit):
+        main(["workload", "chaos_state_sweep", "--set", "mesh=beta2"])
+
+
+def test_bare_string_protocols_wrapped(monkeypatch, tmp_path):
+    # protocols="GradualQuench" (e.g. from --set coercion or a Python API
+    # caller) must run ONE protocol, not iterate character-by-character
+    import dib_tpu.workloads.amorphous as am
+
+    calls = []
+
+    def fake_workload(key, config=None, outdir=None, protocol=None, **kw):
+        calls.append(protocol)
+        return {"protocol": protocol}
+
+    monkeypatch.setattr(am, "run_amorphous_workload", fake_workload)
+    result = am.run_amorphous_protocols(
+        0, protocols="GradualQuench", outdir=str(tmp_path)
+    )
+    assert calls == ["GradualQuench"]
+    assert set(result) == {"GradualQuench"}

@@ -19,6 +19,25 @@ def test_initialize_single_process_is_noop():
     assert jax.process_count() == 1
 
 
+def test_initialize_warns_on_malformed_cluster_spec(monkeypatch):
+    # A real cluster-spec error (not the benign missing-coordinator case)
+    # must warn loudly: silently degrading a pod to N uncoordinated
+    # single-process trainers is the failure mode the RuntimeError branch
+    # already guards against.
+    import warnings as warnings_mod
+
+    import dib_tpu.parallel.multihost as mh
+
+    def boom():
+        raise ValueError("malformed TPU cluster metadata: worker 3 missing")
+
+    monkeypatch.setattr(jax.distributed, "initialize", boom)
+    with warnings_mod.catch_warnings(record=True) as caught:
+        warnings_mod.simplefilter("always")
+        assert mh.initialize() is False
+    assert any("uncoordinated" in str(w.message) for w in caught)
+
+
 def test_process_local_batch_shards_rows(rng):
     mesh = make_sweep_mesh(1, 8)
     sharding = NamedSharding(mesh, P(None, DATA_AXIS))
