@@ -116,6 +116,81 @@ def build_neighborhood_arrays(positions, types, number_particles_to_use=50):
     )
 
 
+def convert_glass_csv_exports(
+    data_dir: str,
+    protocols=("RapidQuench", "GradualQuench"),
+    out_dir: str | None = None,
+) -> list[str]:
+    """The reference's csv -> npz ingestion (amorphous notebook cell 3).
+
+    ``glass_data.tar.gz`` (the manuscript's accessible export) stores each
+    array as padded csv rows with the true neighborhood length as the last
+    entry of each row; this reproduces the notebook's parsing exactly:
+
+      - ``{protocol}_{split}_is_loci.csv``: one label per example -> [N, 1].
+      - ``{protocol}_{split}_particle_positions.csv``: each row reshaped to
+        [-1, 2]; ``int(row[-1, 0])`` is the neighborhood size; keep the first
+        ``size`` pairs.
+      - ``{protocol}_{split}_types.csv``: same with one value per particle.
+      - ``g_r_A{A,B}_{protocol}.csv`` and ``g_r_bins.csv`` -> .npy verbatim.
+
+    Writes ``{protocol}.npz`` (object arrays of per-neighborhood float32
+    arrays — the ragged schema ``load_glass_splits`` consumes) and the g(r)
+    ``.npy`` files next to them. Returns the written paths. Unlike the
+    notebook (TF eager tensors inside a pickled list) the arrays here are
+    plain numpy, so loading needs no TensorFlow.
+    """
+    out_dir = data_dir if out_dir is None else out_dir
+    os.makedirs(out_dir, exist_ok=True)
+    written = []
+    for protocol in protocols:
+        pkl = {}
+        for split in ("val", "train"):
+            arr = np.atleast_1d(np.loadtxt(
+                os.path.join(data_dir, f"{protocol}_{split}_is_loci.csv"),
+                delimiter=",",
+            ))
+            number_examples = arr.shape[0]
+            pkl[f"{split}_is_loci"] = arr.astype(np.float32)[:, None]
+            for data_label, rows_per in (
+                ("particle_positions", 2), ("types", 1),
+            ):
+                arr = np.loadtxt(
+                    os.path.join(
+                        data_dir, f"{protocol}_{split}_{data_label}.csv"
+                    ),
+                    delimiter=",",
+                ).reshape(number_examples, -1)
+                neighborhoods = []
+                for row in arr:
+                    neighborhood = row.reshape(-1, rows_per)
+                    size = int(neighborhood[-1, 0])
+                    neighborhood = neighborhood[:size]
+                    if data_label == "types":
+                        neighborhood = neighborhood[:, 0]
+                    neighborhoods.append(neighborhood.astype(np.float32))
+                ragged = np.empty(len(neighborhoods), dtype=object)
+                ragged[:] = neighborhoods
+                pkl[f"{split}_{data_label}"] = ragged
+        npz_path = os.path.join(out_dir, f"{protocol}.npz")
+        np.savez(npz_path, **pkl)
+        written.append(npz_path)
+        for particle_type in "AB":
+            csv = os.path.join(data_dir, f"g_r_A{particle_type}_{protocol}.csv")
+            if os.path.exists(csv):
+                npy = os.path.join(
+                    out_dir, f"g_r_A{particle_type}_{protocol}.npy"
+                )
+                np.save(npy, np.loadtxt(csv, delimiter=","))
+                written.append(npy)
+    bins_csv = os.path.join(data_dir, "g_r_bins.csv")
+    if os.path.exists(bins_csv):
+        npy = os.path.join(out_dir, "g_r_bins.npy")
+        np.save(npy, np.loadtxt(bins_csv, delimiter=","))
+        written.append(npy)
+    return written
+
+
 def load_glass_splits(data_dir: str, protocol: str):
     """Raw (positions, types, labels) per split from a real {protocol}.npz
     (as produced by the reference's csv ingestion, amorphous notebook cell 3),

@@ -214,7 +214,13 @@ def _local_or_synthetic(name, data_path, loader, synth_args, problem, cat_featur
     import warnings
 
     try:
-        df = loader(data_path)
+        loaded = loader(data_path)
+        # A loader may return (frame, cat_feature_names) when the real
+        # file's categorical columns differ from the synthetic surrogate's.
+        if isinstance(loaded, tuple):
+            df, cat_features = loaded
+        else:
+            df = loaded
         source = "real"
     except (FileNotFoundError, RuntimeError):
         # Only "file absent" / "no egress" fall back to the synthetic
@@ -272,10 +278,17 @@ def fetch_mice_protein(data_path: str = "./data/", seed: int = 1337, **_) -> Dat
     of the reference's broken loader, ``data.py:299-369``)."""
 
     def load(path):
-        f = os.path.join(path, "mice_protein", "Data_Cortex_Nuclear.xls")
-        if not os.path.exists(f):
-            raise FileNotFoundError(f)
-        raw = pd.read_excel(f)
+        # The UCI distribution is .xls; a csv export of the same sheet is
+        # accepted first because no Excel engine ships in this image
+        # (pd.read_excel needs xlrd, which cannot be installed offline).
+        f_csv = os.path.join(path, "mice_protein", "Data_Cortex_Nuclear.csv")
+        f_xls = os.path.join(path, "mice_protein", "Data_Cortex_Nuclear.xls")
+        if os.path.exists(f_csv):
+            raw = pd.read_csv(f_csv)
+        elif os.path.exists(f_xls):
+            raw = pd.read_excel(f_xls)
+        else:
+            raise FileNotFoundError(f_csv)
         proteins = raw.columns[1:78]
         x = raw[proteins].astype(np.float64)
         # class = 3-bit code of (Genotype, Treatment, Behavior), as in LassoNet
@@ -315,12 +328,32 @@ def fetch_credit(data_path: str = "./data/", seed: int = 1337, **_) -> DatasetBu
 
 @register_dataset("support2")
 def fetch_support2(data_path: str = "./data/", seed: int = 1337, **_) -> DatasetBundle:
+    # The reference's loader is a broken nodegam stub (reference
+    # data.py:384-387 returns None); the real file is the Vanderbilt
+    # SUPPORT2 export (support2.csv). Feature selection mirrors the
+    # NODE-GAM preparation the reference leaned on: physiological +
+    # severity scores as numeric, demographic/diagnostic strings as
+    # categorical, outcome/leakage columns dropped.
+    SUPPORT2_NUMERIC = (
+        "age", "slos", "num.co", "edu", "scoma", "avtisst", "sps", "aps",
+        "surv2m", "surv6m", "hday", "diabetes", "dementia", "meanbp",
+        "wblc", "hrt", "resp", "temp", "pafi", "alb", "bili", "crea",
+        "sod", "ph", "glucose", "bun", "urine", "adlsc",
+    )
+    SUPPORT2_CATEGORICAL = ("sex", "dzgroup", "dzclass", "race", "ca", "income")
+
     def load(path):
         f = os.path.join(path, "support2", "support2.csv")
         if not os.path.exists(f):
             raise FileNotFoundError(f)
-        df = pd.read_csv(f)
-        return df.rename(columns={"death": "target"})
+        raw = pd.read_csv(f)
+        numeric = [c for c in SUPPORT2_NUMERIC if c in raw]
+        cats = [c for c in SUPPORT2_CATEGORICAL if c in raw]
+        df = raw[numeric + cats].copy()
+        df[numeric] = df[numeric].fillna(df[numeric].median())
+        df[cats] = df[cats].fillna("missing")
+        df["target"] = raw["death"]
+        return df, tuple(cats)
 
     return _local_or_synthetic(
         "support2", data_path, load,

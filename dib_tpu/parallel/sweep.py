@@ -167,7 +167,8 @@ class BetaSweepTrainer:
         if cursor + num_epochs > capacity:
             raise ValueError(
                 f"History buffer holds {capacity} epochs/replica but {cursor} are "
-                f"already recorded and {num_epochs} more were requested."
+                f"already recorded and {num_epochs} more were requested; grow it "
+                f"with history_extend(histories, n)."
             )
         # chunking decoupled from hooks — see DIBTrainer.fit
         chunk = hook_every if hook_every else num_epochs
@@ -181,6 +182,7 @@ class BetaSweepTrainer:
             # Published for CheckpointHook (see DIBTrainer.fit).
             self.resume_key = keys
             self.latest_history = histories
+            self.resume_chunk = chunk
             for hook in hooks:
                 hook(self, states, int(jax.device_get(states.epoch)[0]))
         return states, sweep_records(histories)
@@ -237,7 +239,9 @@ class BetaSweepTrainer:
         original run (same ``hook_every``, passing a no-op hook if needed) —
         a single big chunk would draw a different key per epoch and the
         recovered trajectory would be a different (valid but incomparable)
-        sample of the same config.
+        sample of the same config. Checkpoints written by ``CheckpointHook``
+        record the chunk size, and ``DIBCheckpointer.restore(...,
+        chunk_size=...)`` enforces the match.
 
         Returns ``(sub_sweep, state_r, history_r, key_r)``, each keeping the
         leading replica axis (length 1) — continue with

@@ -8,7 +8,12 @@ from dib_tpu.train.losses import (
     resolve_loss,
     accuracy_for,
 )
-from dib_tpu.train.history import HistoryRecord, history_init, history_record
+from dib_tpu.train.history import (
+    HistoryRecord,
+    history_extend,
+    history_init,
+    history_record,
+)
 from dib_tpu.train.loop import TrainConfig, TrainState, DIBTrainer, make_optimizer
 from dib_tpu.train.hooks import Every, InfoPerFeatureHook, CompressionMatrixHook
 from dib_tpu.train.checkpoint import DIBCheckpointer, CheckpointHook

@@ -65,13 +65,23 @@ class DIBCheckpointer:
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep, create=True
             ),
+            # Registered up front so item_metadata() resolves from a FRESH
+            # process (the restore path inspects on-disk shapes before any
+            # save/restore call has implicitly registered a handler).
+            item_handlers=ocp.StandardCheckpointHandler(),
         )
 
-    def save(self, step: int, state: Any, history: dict, key: jax.Array) -> None:
+    def save(self, step: int, state: Any, history: dict, key: jax.Array,
+             chunk_size: int | None = None) -> None:
         payload = {
             "state": state,
             "history": history,
             "key": _pack_key(key),
+            # The PRNG epoch-key chain depends on chunk boundaries (one key
+            # split per fit chunk), so the chunk size is part of the resume
+            # contract — restore() refuses a mismatched continuation rather
+            # than silently producing a different (valid-looking) trajectory.
+            "chunk_size": np.asarray(chunk_size or 0, np.int32),
         }
         # Async: the write overlaps the next training chunk; readers
         # (restore / latest_step) wait for in-flight saves first.
@@ -82,13 +92,19 @@ class DIBCheckpointer:
         self.manager.wait_until_finished()
         return self.manager.latest_step()
 
-    def restore(self, trainer, step: int | None = None, template_key=None):
+    def restore(self, trainer, step: int | None = None, template_key=None,
+                chunk_size: int | None = None):
         """Restore (state, history, key) using ``trainer`` for the template.
 
         ``trainer`` may be a ``DIBTrainer`` or ``BetaSweepTrainer``; its
         ``init`` provides the structure/shape/dtype template Orbax needs.
         ``template_key``: for sweeps pass the [R]-key array template (defaults
         to the serial scalar key / an [R] grid inferred from the trainer).
+        ``chunk_size``: the ``hook_every`` the continuation will use. If the
+        checkpoint recorded one, a mismatch raises — the epoch-key chain is
+        keyed to chunk boundaries, so continuing at a different chunk size
+        silently yields a different (valid-looking) trajectory. The recorded
+        value is also available as ``self.restored_chunk_size``.
         """
         self.manager.wait_until_finished()
         step = self.latest_step if step is None else step
@@ -110,7 +126,34 @@ class DIBCheckpointer:
             "key": _pack_key(template_key),
         }
         abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
+        # The history template must match the ON-DISK shapes, not the
+        # trainer's: a run grown with history_extend carries larger record
+        # buffers than trainer.init allocates. Where shapes agree the init
+        # template (with its sharding) is kept; where they differ the stored
+        # shape wins (restored unsharded — reshard on first use if needed).
+        meta = self.manager.item_metadata(step)
+        abstract["history"] = jax.tree.map(
+            lambda tmpl, stored: tmpl
+            if tuple(tmpl.shape) == tuple(stored.shape)
+            else jax.ShapeDtypeStruct(stored.shape, tmpl.dtype),
+            abstract["history"], dict(meta["history"]),
+        )
+        # Checkpoints written before chunk-size tracking lack the key; the
+        # template must omit it too or Orbax refuses the restore outright.
+        has_chunk = "chunk_size" in meta
+        if has_chunk:
+            abstract["chunk_size"] = jax.ShapeDtypeStruct((), np.int32)
         restored = self.manager.restore(step, args=ocp.args.StandardRestore(abstract))
+        saved_chunk = int(np.asarray(restored["chunk_size"])) if has_chunk else 0
+        self.restored_chunk_size = saved_chunk or None
+        if chunk_size is not None and saved_chunk and saved_chunk != chunk_size:
+            raise ValueError(
+                f"Checkpoint was written with chunk size (hook_every) "
+                f"{saved_chunk} but the continuation requests {chunk_size}; "
+                f"the PRNG epoch-key chain is keyed to chunk boundaries, so "
+                f"this would continue a DIFFERENT trajectory. Resume with "
+                f"hook_every={saved_chunk}."
+            )
         return restored["state"], restored["history"], _unpack_key(restored["key"])
 
     def close(self) -> None:
@@ -135,4 +178,7 @@ class CheckpointHook:
                 "CheckpointHook needs trainer.resume_key / trainer.latest_history; "
                 "run it via fit(hooks=[...]) on a trainer that publishes them."
             )
-        self.checkpointer.save(epoch, state, history, key)
+        self.checkpointer.save(
+            epoch, state, history, key,
+            chunk_size=getattr(trainer, "resume_chunk", None),
+        )

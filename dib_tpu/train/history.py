@@ -38,6 +38,30 @@ def history_init(num_records: int, num_features: int) -> dict:
     }
 
 
+def history_extend(history: dict, extra_records: int) -> dict:
+    """Grow a history buffer's capacity by ``extra_records`` (host-side).
+
+    For resuming a converged run past its preallocated horizon: pads every
+    record buffer with zeros past the end, leaving the cursor and all
+    recorded rows untouched. Works for serial histories (record axis 0) and
+    stacked sweep histories ([R, T, ...] — record axis 1), inferred from the
+    ``beta`` leaf's rank. Returns a NEW history dict; do not reuse the old
+    one if its buffers were donated.
+    """
+    if extra_records < 0:
+        raise ValueError(f"extra_records must be >= 0, got {extra_records}")
+    axis = history["beta"].ndim - 1          # 0 serial, 1 stacked sweep
+    out = {}
+    for name, buf in history.items():
+        if name == "cursor":
+            out[name] = buf
+            continue
+        pad = [(0, 0)] * buf.ndim
+        pad[axis] = (0, extra_records)
+        out[name] = jnp.pad(buf, pad)
+    return out
+
+
 def history_record(history: dict, row: dict) -> dict:
     """Write one record at the cursor (jit-safe)."""
     cur = history["cursor"]

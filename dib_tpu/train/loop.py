@@ -286,8 +286,8 @@ class DIBTrainer:
         if cursor + num_epochs > capacity:
             raise ValueError(
                 f"History buffer holds {capacity} epochs but {cursor} are already "
-                f"recorded and {num_epochs} more were requested; allocate a larger "
-                f"buffer (history_init) or train fewer epochs."
+                f"recorded and {num_epochs} more were requested; grow it with "
+                f"history_extend(history, n) or train fewer epochs."
             )
         # hook_every bounds chunk size even with no hooks (very long device
         # programs can exceed runtime execution limits); note the chunk
@@ -304,6 +304,7 @@ class DIBTrainer:
             # continuation is bit-identical to an uninterrupted run.
             self.resume_key = key
             self.latest_history = history
+            self.resume_chunk = chunk
             for hook in hooks:
                 hook(self, state, int(state.epoch))
         return state, HistoryRecord.from_device(history)

@@ -46,6 +46,15 @@ if jax.default_backend() == "cpu":
     )
 
 
+def pytest_collection_modifyitems(config, items):
+    # Tier split (VERDICT round 1: the full suite cannot finish in 10 min on
+    # this 1-core box). Everything not explicitly @pytest.mark.slow is the
+    # smoke tier: `pytest -m smoke` must stay green under ~2 min here.
+    for item in items:
+        if "slow" not in item.keywords:
+            item.add_marker(pytest.mark.smoke)
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)

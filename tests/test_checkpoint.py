@@ -162,3 +162,118 @@ def test_restore_without_checkpoint_raises(tmp_path):
     with pytest.raises(FileNotFoundError):
         ckpt.restore(make_trainer())
     ckpt.close()
+
+
+def test_restore_rejects_mismatched_chunk_size(tmp_path):
+    """The epoch-key chain is keyed to chunk boundaries: continuing a
+    checkpoint at a different hook_every would silently sample a different
+    (valid-looking) trajectory, so restore() must refuse."""
+    key = jax.random.key(11)
+    trainer = make_trainer()
+    ckpt = DIBCheckpointer(str(tmp_path / "ck"))
+    trainer.fit(key, num_epochs=4, hooks=[CheckpointHook(ckpt)], hook_every=2)
+
+    trainer2 = make_trainer()
+    with pytest.raises(ValueError, match="chunk size"):
+        ckpt.restore(trainer2, chunk_size=3)
+    # matching chunk size restores fine and records what was saved
+    state, hist, k = ckpt.restore(trainer2, chunk_size=2)
+    assert ckpt.restored_chunk_size == 2
+    assert int(state.epoch) == 4
+    ckpt.close()
+
+
+def test_history_extend_past_capacity():
+    """history_extend grows the record buffers so a resumed run can train
+    past the preallocated horizon; recorded rows and cursor are untouched."""
+    from dib_tpu.train import history_extend
+
+    trainer = make_trainer()           # capacity = 10 epochs
+    key = jax.random.key(5)
+    noop = lambda *a: None
+    state, _ = trainer.fit(key, num_epochs=10, hooks=[noop], hook_every=5)
+    history = trainer.latest_history
+    resume_key = trainer.resume_key
+
+    with pytest.raises(ValueError, match="history_extend"):
+        trainer.fit(resume_key, num_epochs=2, state=state, history=history)
+
+    bigger = history_extend(history, 4)
+    assert bigger["beta"].shape[0] == 14
+    before = np.asarray(history["beta"]).copy()
+    state2, record = trainer.fit(
+        resume_key, num_epochs=4, state=state, history=bigger
+    )
+    assert int(state2.epoch) == 14
+    assert record.beta.shape[0] == 14
+    np.testing.assert_array_equal(record.beta[:10], before)
+
+
+def test_restore_old_format_checkpoint_without_chunk_size(tmp_path):
+    """Checkpoints written before chunk-size tracking (no 'chunk_size' key)
+    must still restore — the resume path exists precisely for runs started
+    earlier."""
+    import orbax.checkpoint as ocp
+
+    from dib_tpu.train.checkpoint import _pack_key
+
+    trainer = make_trainer()
+    key = jax.random.key(2)
+    state, _ = trainer.fit(key, num_epochs=2)
+    history = trainer.latest_history
+
+    mgr = ocp.CheckpointManager(
+        str(tmp_path / "old"), options=ocp.CheckpointManagerOptions(create=True)
+    )
+    mgr.save(2, args=ocp.args.StandardSave(
+        {"state": state, "history": history, "key": _pack_key(trainer.resume_key)}
+    ))
+    mgr.wait_until_finished()
+    mgr.close()
+
+    ckpt = DIBCheckpointer(str(tmp_path / "old"))
+    state_r, hist_r, key_r = ckpt.restore(make_trainer(), chunk_size=7)
+    assert int(state_r.epoch) == 2
+    assert ckpt.restored_chunk_size is None   # nothing recorded, nothing enforced
+    ckpt.close()
+
+
+def test_restore_extended_history_checkpoint(tmp_path):
+    """A checkpoint saved AFTER history_extend has larger record buffers than
+    trainer.init allocates; restore must follow the stored shapes."""
+    from dib_tpu.train import history_extend
+
+    trainer = make_trainer()           # capacity = 10
+    key = jax.random.key(9)
+    noop = lambda *a: None
+    state, _ = trainer.fit(key, num_epochs=10, hooks=[noop], hook_every=5)
+    bigger = history_extend(trainer.latest_history, 6)
+
+    ckpt = DIBCheckpointer(str(tmp_path / "ext"))
+    hook = CheckpointHook(ckpt)
+    state2, _ = trainer.fit(
+        trainer.resume_key, num_epochs=6, state=state, history=bigger,
+        hooks=[hook], hook_every=5,
+    )
+    state_r, hist_r, key_r = ckpt.restore(make_trainer(), chunk_size=5)
+    assert hist_r["beta"].shape[0] == 16
+    assert int(np.asarray(hist_r["cursor"])) == 16
+    assert int(state_r.epoch) == 16
+    ckpt.close()
+
+
+def test_history_extend_stacked_sweep_axis():
+    """Stacked [R, T, ...] sweep histories extend along the record axis."""
+    from dib_tpu.train.history import history_extend, history_init
+
+    stacked = jax.vmap(lambda _: history_init(3, 2))(jnp_arange2())
+    grown = history_extend(stacked, 5)
+    assert grown["beta"].shape == (2, 8)
+    assert grown["kl_per_feature"].shape == (2, 8, 2)
+    assert grown["cursor"].shape == (2,)
+
+
+def jnp_arange2():
+    import jax.numpy as jnp
+
+    return jnp.arange(2)
