@@ -124,8 +124,11 @@ def convert_glass_csv_exports(
     """The reference's csv -> npz ingestion (amorphous notebook cell 3).
 
     ``glass_data.tar.gz`` (the manuscript's accessible export) stores each
-    array as padded csv rows with the true neighborhood length as the last
-    entry of each row; this reproduces the notebook's parsing exactly:
+    array as padded csv rows carrying the true neighborhood length in the
+    final slot's FIRST column — after the notebook reshapes a row to
+    ``[-1, number_rows_per]``, ``int(row[-1, 0])`` is the length (for
+    positions that is the second-to-last flat entry, not the last). This
+    reproduces the notebook's parsing exactly:
 
       - ``{protocol}_{split}_is_loci.csv``: one label per example -> [N, 1].
       - ``{protocol}_{split}_particle_positions.csv``: each row reshaped to

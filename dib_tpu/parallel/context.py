@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 from functools import partial
 from typing import Callable
 
@@ -47,22 +48,49 @@ Array = jax.Array
 # shard when an axis name is bound) and return [B, S, H, D] in float32.
 # --------------------------------------------------------------------------
 
+def _dense_score_dtype():
+    """Score dtype for ``dense_self_attention``, default float32.
+
+    Perf experiment knob (round-1 history, PARITY.md): emitting bf16 scores
+    from the MXU measured 721 steps/s on the north-star sweep but NaN'd
+    under XLA fusion when the unscaled scores round-tripped through bf16;
+    the float32 default measured 549. The middle variant — q scaled BEFORE
+    the matmul (so scores are softmax-ranged), bf16 score emission, float32
+    softmax — measured 634 and is selected with DIB_ATTN_SCORE_DTYPE=bfloat16
+    pending its full-run stability result on hardware. Read at TRACE time:
+    set the env before any attention call in the process (flipping it later
+    is silently ignored by jit's trace cache unless jax.clear_caches() is
+    called); tests pin both settings.
+    """
+    name = os.environ.get("DIB_ATTN_SCORE_DTYPE", "float32").lower()
+    if name in ("bfloat16", "bf16"):
+        return jnp.bfloat16
+    if name in ("float32", "f32"):
+        return jnp.float32
+    # silent fallback would record the wrong variant in perf reports
+    raise ValueError(
+        f"DIB_ATTN_SCORE_DTYPE={name!r}: use 'float32' or 'bfloat16'"
+    )
+
+
 def dense_self_attention(q: Array, k: Array, v: Array) -> Array:
     """Plain softmax attention — the single-device reference for the
     collective variants.
 
     Numerics (same recipe as the ring variant): q is scaled BEFORE the
-    matmul and the scores come out of the MXU directly in float32
-    (``preferred_element_type``) — no bfloat16 round-trip of potentially
-    huge score values, which XLA fusion can otherwise push to non-finite
-    on large activations. Softmax stays float32; the value matmul runs in
-    the input dtype with a float32 accumulator.
+    matmul and the scores come out of the MXU in float32 by default (no
+    bfloat16 round-trip of potentially huge score values, which XLA fusion
+    can otherwise push to non-finite on large activations) — see
+    ``_dense_score_dtype`` for the measured bf16-scores variant. Softmax is
+    always computed in float32; the value matmul runs in the input dtype
+    with a float32 accumulator.
     """
     scale = 1.0 / math.sqrt(q.shape[-1])
     s = jnp.einsum(
-        "bqhd,bkhd->bhqk", q * scale, k, preferred_element_type=jnp.float32
+        "bqhd,bkhd->bhqk", q * scale, k,
+        preferred_element_type=_dense_score_dtype(),
     )
-    p = jax.nn.softmax(s, axis=-1)
+    p = jax.nn.softmax(s.astype(jnp.float32), axis=-1)
     return jnp.einsum(
         "bhqk,bkhd->bqhd", p.astype(v.dtype), v,
         preferred_element_type=jnp.float32,

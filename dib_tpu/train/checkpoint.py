@@ -18,8 +18,11 @@ Usage::
     hook = CheckpointHook(ckpt)
     trainer.fit(key, hooks=[hook], hook_every=100)
     ...
-    state, history, key = ckpt.restore(trainer)       # latest step
-    trainer.fit(key, num_epochs=remaining, state=state, history=history)
+    # chunk_size enforces the resume contract (pass the hook_every the
+    # continuation will use; omitting it skips the check)
+    state, history, key = ckpt.restore(trainer, chunk_size=100)
+    trainer.fit(key, num_epochs=remaining, state=state, history=history,
+                hooks=[hook], hook_every=100)
 """
 
 from __future__ import annotations
@@ -79,8 +82,10 @@ class DIBCheckpointer:
             "key": _pack_key(key),
             # The PRNG epoch-key chain depends on chunk boundaries (one key
             # split per fit chunk), so the chunk size is part of the resume
-            # contract — restore() refuses a mismatched continuation rather
-            # than silently producing a different (valid-looking) trajectory.
+            # contract — restore(chunk_size=...) refuses a mismatched
+            # continuation rather than silently producing a different
+            # (valid-looking) trajectory. (Enforcement is opt-in: restore
+            # cannot know the continuation's hook_every unless told.)
             "chunk_size": np.asarray(chunk_size or 0, np.int32),
         }
         # Async: the write overlaps the next training chunk; readers
@@ -146,14 +151,31 @@ class DIBCheckpointer:
         restored = self.manager.restore(step, args=ocp.args.StandardRestore(abstract))
         saved_chunk = int(np.asarray(restored["chunk_size"])) if has_chunk else 0
         self.restored_chunk_size = saved_chunk or None
-        if chunk_size is not None and saved_chunk and saved_chunk != chunk_size:
-            raise ValueError(
-                f"Checkpoint was written with chunk size (hook_every) "
-                f"{saved_chunk} but the continuation requests {chunk_size}; "
-                f"the PRNG epoch-key chain is keyed to chunk boundaries, so "
-                f"this would continue a DIFFERENT trajectory. Resume with "
-                f"hook_every={saved_chunk}."
-            )
+        if chunk_size is not None and saved_chunk:
+            if saved_chunk != chunk_size:
+                raise ValueError(
+                    f"Checkpoint was written with chunk size (hook_every) "
+                    f"{saved_chunk} but the continuation requests {chunk_size}; "
+                    f"the PRNG epoch-key chain is keyed to chunk boundaries, so "
+                    f"this would continue a DIFFERENT trajectory. Resume with "
+                    f"hook_every={saved_chunk}."
+                )
+            # Alignment matters too: a save after a PARTIAL final chunk
+            # (num_epochs % hook_every != 0) sits off the chunk grid, so a
+            # continuation from it draws keys at different boundaries than
+            # an uninterrupted longer run would.
+            # sweeps carry [R] epochs; members advance in lockstep
+            epoch = int(np.max(np.asarray(jax.device_get(restored["state"].epoch))))
+            if epoch % saved_chunk != 0:
+                raise ValueError(
+                    f"Checkpoint at epoch {epoch} is not on the chunk grid "
+                    f"(chunk size {saved_chunk}): it was saved after a "
+                    f"partial final chunk. A continuation from here is NOT "
+                    f"bit-identical to an uninterrupted run — restore an "
+                    f"aligned step (restore(step=...)) for crash recovery, "
+                    f"or omit chunk_size to extend this finished run on a "
+                    f"fresh chunk grid."
+                )
         return restored["state"], restored["history"], _unpack_key(restored["key"])
 
     def close(self) -> None:

@@ -90,10 +90,15 @@ class InfoPerFeatureHook:
 class CompressionMatrixHook:
     """Saves per-feature compression-scheme matrices at each invocation."""
 
-    def __init__(self, outdir: str, max_number_to_display: int = 128, seed: int = 0):
+    def __init__(self, outdir: str, max_number_to_display: int = 128,
+                 seed: int = 0, features=None):
         self.outdir = outdir
         self.max_number_to_display = max_number_to_display
         self.rng = np.random.default_rng(seed)
+        # features=None -> all; for weight-shared encoder banks (the
+        # per-particle model: one encoder across 50 particle slots) pass
+        # (0,) — the other slots' schemes are identical.
+        self.features = features
         os.makedirs(outdir, exist_ok=True)
 
     def __call__(self, trainer, state, epoch: int):
@@ -108,7 +113,9 @@ class CompressionMatrixHook:
             )
         )
         raw_all = trainer.bundle.x_valid_raw
-        for f in range(trainer.num_features):
+        feature_ids = (range(trainer.num_features)
+                       if self.features is None else self.features)
+        for f in feature_ids:
             x_f = trainer.feature_data(f)
             raw_f = trainer.feature_data(f, arr=raw_all) if raw_all is not None else x_f
             mus, logvars = trainer.encode_feature(state, f, jnp.asarray(x_f))

@@ -1,0 +1,143 @@
+"""The REAL north-star run: 8 replicas x 25,000 steps, instrumented, measured.
+
+VERDICT round 1, item 2: ``bench.py`` projects the north-star wall-clock from
+a short measured chunk; this script runs the complete sweep — the full
+set-transformer configuration (amorphous notebook cell 8) over a grid of
+beta endpoints with the north star's instrumentation enabled:
+
+  - compression-scheme pulls from device at each beta checkpoint for every
+    replica (the ``SaveCompressionMatricesCallback`` equivalent the
+    BASELINE.json north-star text names; reference ``models.py:152-186``),
+  - per-replica MI sandwich bounds at the same cadence,
+  - per-replica info-plane PNGs at the end,
+
+with wall-clock measured end to end (init + compile + train + hooks) and a
+committed run report (``NORTHSTAR_RUN.json``).
+
+Run on the TPU (ambient env, ALONE — no concurrent device users):
+
+    python scripts/northstar_run.py [--outdir northstar_out] [--steps 25000]
+
+Environment: DIB_ATTN_SCORE_DTYPE=bfloat16 selects the measured-faster
+attention-score variant (see dib_tpu/parallel/context.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BASELINE_MINUTES = 10.0
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--outdir", default="northstar_out")
+    parser.add_argument("--steps", type=int, default=25_000)
+    parser.add_argument("--replicas", type=int, default=8)
+    parser.add_argument("--steps-per-epoch", type=int, default=50)
+    parser.add_argument("--chunk-epochs", type=int, default=25,
+                        help="beta-checkpoint cadence in epochs "
+                             "(25 x 50 = every 1250 steps -> 20 checkpoints)")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--report", default="NORTHSTAR_RUN.json")
+    args = parser.parse_args()
+
+    import jax
+    import numpy as np
+
+    from dib_tpu.parallel.sweep import PerReplicaHook
+    from dib_tpu.train.hooks import CompressionMatrixHook, InfoPerFeatureHook
+    from dib_tpu.workloads.amorphous import (
+        AmorphousWorkloadConfig,
+        run_amorphous_sweep,
+    )
+
+    devices = jax.devices()
+    print(f"devices: {devices}", file=sys.stderr)
+    config = AmorphousWorkloadConfig(num_steps=args.steps)
+
+    # Per-replica instrumentation at every chunk boundary (= beta checkpoint).
+    # CompressionMatrixHook pulls (mu, logvar) compression schemes from
+    # device; InfoPerFeatureHook runs the sandwich bounds on validation data.
+    info_hooks: dict[int, InfoPerFeatureHook] = {}
+
+    def make_hooks(r: int):
+        # feature 0 only: the per-particle model shares ONE encoder across
+        # all particle slots, so the other slots' schemes are identical
+        comp = CompressionMatrixHook(
+            os.path.join(args.outdir, f"replica{r}", "compression"),
+            features=(0,),
+        )
+        info_hooks[r] = InfoPerFeatureHook(
+            config.mi_eval_batch_size, config.mi_eval_batches
+        )
+        info = info_hooks[r]
+
+        def both(trainer, state, epoch):
+            comp(trainer, state, epoch)
+            info(trainer, state, epoch)
+
+        return both
+
+    t0 = time.time()
+    result = run_amorphous_sweep(
+        key=args.seed,
+        config=config,
+        num_repeats=max(args.replicas // 8, 1),
+        beta_ends=np.logspace(-2, 0, min(args.replicas, 8)),
+        outdir=args.outdir,
+        steps_per_epoch=args.steps_per_epoch,
+        chunk_epochs=args.chunk_epochs,
+        hooks=[PerReplicaHook(make_hooks)],
+        model_overrides={"compute_dtype": "bfloat16"},
+    )
+    total_s = time.time() - t0
+
+    records = result["records"]
+    finite = all(
+        np.isfinite(rec.kl_per_feature).all() and np.isfinite(rec.loss).all()
+        for rec in records
+    )
+    report = {
+        "metric": "amorphous_set_transformer_beta_sweep_measured",
+        "value": round(total_s / 60.0, 3),
+        "unit": "minutes",
+        "vs_baseline": round(total_s / 60.0 / BASELINE_MINUTES, 4),
+        "sweep_wall_clock_s": round(result["wall_clock_s"], 1),
+        "total_wall_clock_s": round(total_s, 1),
+        "replicas": len(records),
+        "steps_per_replica": args.steps,
+        "steps_per_epoch": args.steps_per_epoch,
+        "beta_checkpoints": len(next(iter(info_hooks.values())).epochs)
+        if info_hooks else 0,
+        "all_finite": bool(finite),
+        "score_dtype": os.environ.get("DIB_ATTN_SCORE_DTYPE", "float32"),
+        "device_kind": devices[0].device_kind,
+        "entropy_y_bits": round(float(result["entropy_y_bits"]), 4),
+        "final_total_kl_bits_per_replica": [
+            round(float(rec.to_bits().total_kl[-1]), 4) for rec in records
+        ],
+        "final_val_loss_bits_per_replica": [
+            round(float(rec.to_bits().val_loss[-1]), 4) for rec in records
+        ],
+        "info_plane_paths": result["info_plane_paths"],
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    with open(args.report, "w") as f:
+        json.dump(report, f, indent=1)
+        f.write("\n")
+    print(json.dumps(report))
+    if not finite:
+        print("NON-FINITE VALUES IN RUN", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

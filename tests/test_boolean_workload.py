@@ -103,6 +103,33 @@ def test_run_boolean_workload_small_circuit():
 
 
 @pytest.mark.slow
+def test_sandwich_gap_at_reference_tightness():
+    """The reference claims the sandwich bounds stay within ~0.01 bits of
+    each other during boolean training (boolean notebook cell 6 comment;
+    SURVEY.md section 6). Pin that regime quantitatively: converged binary
+    channels on the full truth table must show gap <= 0.01 bits with the
+    sandwich containing the true 1 bit per +-1 input."""
+    import jax
+
+    bundle = fetch_boolean_circuit()
+    cfg = BooleanWorkloadConfig(
+        num_steps=3000, beta_start=1e-3, beta_end=1e-3,   # converged, low beta
+        batch_size=512, mi_every=3000,
+    )
+    trainer = BooleanTrainer(bundle, cfg)
+    state, _ = trainer.fit(jax.random.key(0))
+    lower, upper = trainer.channel_mi_bounds(state, jax.random.key(1))
+    lower_bits = np.asarray(lower) / np.log(2.0)
+    upper_bits = np.asarray(upper) / np.log(2.0)
+    gap = upper_bits - lower_bits
+    assert (gap >= -1e-6).all(), "LOO upper fell below InfoNCE lower"
+    assert gap.max() <= 0.01, f"sandwich gap {gap.max():.4f} bits > 0.01"
+    # each +-1 input carries exactly 1 bit; the sandwich must contain it
+    assert (lower_bits <= 1.0 + 1e-3).all()
+    assert (upper_bits >= 1.0 - 5e-3).all()
+
+
+@pytest.mark.slow
 def test_boolean_trainer_learns_at_low_beta():
     # With beta held tiny, the model must learn the circuit (acc ~ 1 on the
     # full table) — the pretraining-phase behavior of the notebook.

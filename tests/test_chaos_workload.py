@@ -59,6 +59,7 @@ def tiny_setup():
 
 
 class TestMeasurementTrainer:
+    @pytest.mark.slow
     def test_loss_decreases_and_beta_descends(self, tiny_setup):
         stack, windows, cfg, _ = tiny_setup
         trainer = MeasurementTrainer(stack, windows, cfg)
@@ -70,6 +71,7 @@ class TestMeasurementTrainer:
         assert history["match"][-5:].mean() < history["match"][:5].mean()
         assert len(history["mi_bounds"]) == 2
 
+    @pytest.mark.slow
     def test_mi_early_stop(self, tiny_setup):
         stack, windows, cfg, _ = tiny_setup
         import dataclasses
@@ -118,6 +120,7 @@ class TestEntropyScaling:
             entropy_rate_scaling_curve(np.zeros(10, np.uint8), [100], 2)
 
 
+@pytest.mark.slow
 class TestEndToEnd:
     def test_logistic_pipeline_recovers_entropy_rate(self):
         res = run_chaos_workload(

@@ -164,6 +164,7 @@ def test_restore_without_checkpoint_raises(tmp_path):
     ckpt.close()
 
 
+@pytest.mark.slow
 def test_restore_rejects_mismatched_chunk_size(tmp_path):
     """The epoch-key chain is keyed to chunk boundaries: continuing a
     checkpoint at a different hook_every would silently sample a different
@@ -183,6 +184,7 @@ def test_restore_rejects_mismatched_chunk_size(tmp_path):
     ckpt.close()
 
 
+@pytest.mark.slow
 def test_history_extend_past_capacity():
     """history_extend grows the record buffers so a resumed run can train
     past the preallocated horizon; recorded rows and cursor are untouched."""
@@ -209,6 +211,7 @@ def test_history_extend_past_capacity():
     np.testing.assert_array_equal(record.beta[:10], before)
 
 
+@pytest.mark.slow
 def test_restore_old_format_checkpoint_without_chunk_size(tmp_path):
     """Checkpoints written before chunk-size tracking (no 'chunk_size' key)
     must still restore — the resume path exists precisely for runs started
@@ -238,6 +241,7 @@ def test_restore_old_format_checkpoint_without_chunk_size(tmp_path):
     ckpt.close()
 
 
+@pytest.mark.slow
 def test_restore_extended_history_checkpoint(tmp_path):
     """A checkpoint saved AFTER history_extend has larger record buffers than
     trainer.init allocates; restore must follow the stored shapes."""
@@ -255,25 +259,29 @@ def test_restore_extended_history_checkpoint(tmp_path):
         trainer.resume_key, num_epochs=6, state=state, history=bigger,
         hooks=[hook], hook_every=5,
     )
-    state_r, hist_r, key_r = ckpt.restore(make_trainer(), chunk_size=5)
+    # epoch 16 sits OFF the 5-chunk grid (the final chunk was partial), so
+    # a chunk_size-enforced restore refuses it...
+    with pytest.raises(ValueError, match="chunk grid"):
+        ckpt.restore(make_trainer(), chunk_size=5)
+    # ...while the extension path (no continuation contract) restores fine
+    state_r, hist_r, key_r = ckpt.restore(make_trainer())
     assert hist_r["beta"].shape[0] == 16
     assert int(np.asarray(hist_r["cursor"])) == 16
     assert int(state_r.epoch) == 16
+    # and an aligned earlier step restores under the contract
+    state_15, _, _ = ckpt.restore(make_trainer(), step=15, chunk_size=5)
+    assert int(state_15.epoch) == 15
     ckpt.close()
 
 
 def test_history_extend_stacked_sweep_axis():
     """Stacked [R, T, ...] sweep histories extend along the record axis."""
+    import jax.numpy as jnp
+
     from dib_tpu.train.history import history_extend, history_init
 
-    stacked = jax.vmap(lambda _: history_init(3, 2))(jnp_arange2())
+    stacked = jax.vmap(lambda _: history_init(3, 2))(jnp.arange(2))
     grown = history_extend(stacked, 5)
     assert grown["beta"].shape == (2, 8)
     assert grown["kl_per_feature"].shape == (2, 8, 2)
     assert grown["cursor"].shape == (2,)
-
-
-def jnp_arange2():
-    import jax.numpy as jnp
-
-    return jnp.arange(2)

@@ -95,6 +95,7 @@ def test_cli_infonce_path(tmp_path):
     assert os.path.exists(tmp_path / "history.npz")
 
 
+@pytest.mark.slow
 def test_cli_workload_boolean_tiny(capsys):
     from dib_tpu.cli import main
 

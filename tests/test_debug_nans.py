@@ -26,6 +26,7 @@ def debug_nans():
         jax.config.update("jax_debug_nans", False)
 
 
+@pytest.mark.slow
 def test_train_chunk_nan_free_under_debug_nans(debug_nans):
     bundle = get_dataset("boolean_circuit")
     model = DistributedIBModel(

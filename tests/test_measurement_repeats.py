@@ -11,6 +11,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from dib_tpu.data.chaos_maps import generate_data
 from dib_tpu.models.measurement import MeasurementStack
 from dib_tpu.train.measurement import (

@@ -4,10 +4,12 @@ precision-critical outputs (channel parameters, KL, logits)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from dib_tpu.models import DistributedIBModel, PerParticleDIBModel
 
 
+@pytest.mark.slow
 def test_distributed_ib_bf16_contract():
     model = DistributedIBModel(
         feature_dimensionalities=(2, 1), encoder_hidden=(16,),
@@ -28,6 +30,7 @@ def test_distributed_ib_bf16_contract():
     assert np.isfinite(np.asarray(aux["kl_per_feature"])).all()
 
 
+@pytest.mark.slow
 def test_per_particle_bf16_matches_f32_loosely():
     """bf16 compute must stay within bf16 rounding of the f32 forward pass
     (same params => same function up to precision)."""

@@ -55,6 +55,7 @@ def test_kernel_preserves_diagonal_precision(rng):
     )
 
 
+@pytest.mark.slow
 def test_backend_dispatch_roundtrip(rng):
     """Forcing the pallas backend must give the same sandwich bounds as the
     XLA path (end-to-end through the jitted estimator), and restore cleanly."""

@@ -10,6 +10,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from dib_tpu.data import get_dataset
 from dib_tpu.models import DistributedIBModel
 from dib_tpu.parallel import (

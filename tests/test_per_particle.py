@@ -101,6 +101,7 @@ class TestModel:
         )
 
 
+@pytest.mark.slow
 class TestTraining:
     def test_trains_and_hooks_work(self, bundle, tmp_path):
         from dib_tpu.train import InfoPerFeatureHook
@@ -128,6 +129,7 @@ class TestTraining:
         assert (lower <= upper + 1e-6).all()
 
 
+@pytest.mark.slow
 def test_remat_preserves_values_and_grads(rng):
     import optax
     from dib_tpu.models.per_particle import PerParticleDIBModel

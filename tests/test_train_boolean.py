@@ -66,6 +66,7 @@ def trained(small_circuit_bundle):
     return trainer, state, history, hook
 
 
+@pytest.mark.slow
 def test_training_learns_circuit(trained):
     trainer, state, history, hook = trained
     entropy_y = trainer.bundle.extras["entropy_y_bits"]
@@ -76,6 +77,7 @@ def test_training_learns_circuit(trained):
     assert h.metric[230:260].max() > 0.9  # train accuracy
 
 
+@pytest.mark.slow
 def test_history_semantics(trained):
     _, _, history, _ = trained
     assert history.beta.shape == (500,)
@@ -88,6 +90,7 @@ def test_history_semantics(trained):
     assert np.all(history.loss >= -1e-5)
 
 
+@pytest.mark.slow
 def test_mi_bounds_hook_sane(trained):
     trainer, state, history, hook = trained
     bounds = hook.bounds_bits                   # [T, F, 2]
@@ -98,6 +101,7 @@ def test_mi_bounds_hook_sane(trained):
     assert np.all(bounds >= -0.1)
 
 
+@pytest.mark.slow
 def test_ib_mode_single_bottleneck(small_circuit_bundle):
     bundle = small_circuit_bundle.as_vanilla_ib()
     assert bundle.feature_dimensionalities == [3]
