@@ -102,7 +102,7 @@ def run_radial_shells_workload(
         info_plot_lims=(0.0, float(bits.total_kl.max()) + 1.0),
     )
     profile_path = _save_shell_profile(
-        info_hook, bundle.extras["shell_edges"], config.num_shells,
+        bits, bundle.extras["shell_edges"], config.num_shells,
         os.path.join(outdir, "information_vs_radius.png"),
     )
     return {
@@ -115,26 +115,66 @@ def run_radial_shells_workload(
         "final_shell_profile_bits": (
             info_hook.bounds_bits[-1, :, 0] if info_hook.records else None
         ),
+        # max over the anneal: the information each shell CAN carry about Y
+        # (at the final check, beta_end has crushed every channel by design)
+        "peak_shell_profile_bits": (
+            info_hook.bounds_bits[:, :, 0].max(axis=0)
+            if info_hook.records else None
+        ),
         "info_plane_path": plane_path,
         "profile_path": profile_path,
     }
 
 
-def _save_shell_profile(info_hook, shell_edges, num_shells, path) -> str | None:
-    """Information (lower bound, bits) vs shell radius, one curve per type."""
-    if not info_hook.records:
-        return None
+def _save_shell_profile(bits, shell_edges, num_shells, path) -> str | None:
+    """Information ALLOCATED per shell (KL, bits) vs radius as the budget
+    tightens.
+
+    The anneal kills channels in inverse order of their predictive value,
+    so the shells still holding information when the budget is scarce are
+    where the task-relevant information lives — the DIB method's headline
+    readout (reference README.md:6). Raw retained information I(U; X_shell)
+    (the MI hook) is NOT this profile: it tracks each shell's own entropy,
+    which grows with shell area regardless of relevance.
+
+    One curve per remaining-budget fraction: per-shell KL at the anneal
+    epochs where total KL has shrunk to 50% / 25% / 10% of its value at the
+    anneal's start.
+    """
     import matplotlib.pyplot as plt  # Agg already set by dib_tpu.viz import
 
-    final = info_hook.bounds_bits[-1, :, 0]            # [2 * num_shells]
+    kl = bits.kl_per_feature                          # [T, 2 * num_shells]
+    total = kl.sum(-1)
+    peak = int(np.argmax(total))
+    start = float(total[peak])
+    if start <= 0:
+        return None
     centers = 0.5 * (np.asarray(shell_edges)[:-1] + np.asarray(shell_edges)[1:])
-    fig, ax = plt.subplots(figsize=(6, 4))
-    for t, label in enumerate("AB"):
-        ax.plot(centers, final[t * num_shells:(t + 1) * num_shells],
-                marker="o", label=f"type {label}")
-    ax.set(xlabel="shell radius", ylabel="information (bits, InfoNCE lower)",
-           title="Where the information lives, by radius")
-    ax.legend()
+    fig, axes = plt.subplots(1, 2, figsize=(9.6, 4), sharey=True)
+    # epochs where the post-peak total KL crosses each budget fraction; if
+    # the anneal never got that far (short run / small beta_end), fall back
+    # to the final epoch so the figure is never blank
+    checkpoints = []
+    for frac, alpha in ((0.5, 0.35), (0.25, 0.65), (0.1, 1.0)):
+        # first epoch AFTER the KL peak below the threshold (KL starts near
+        # zero at init, so an unanchored search would land on epoch 0)
+        below = np.nonzero(total[peak:] <= frac * start)[0]
+        if len(below):
+            checkpoints.append((f"{frac:.0%} budget left",
+                                peak + int(below[0]), alpha))
+    if not checkpoints:
+        checkpoints = [("final epoch", kl.shape[0] - 1, 1.0)]
+    for label_text, epoch, alpha in checkpoints:
+        for t, ax in enumerate(axes):
+            sl = slice(t * num_shells, (t + 1) * num_shells)
+            ax.plot(centers, kl[epoch, sl], marker="o", alpha=alpha,
+                    color="C0" if t == 0 else "C1", label=label_text)
+    for ax, type_label in zip(axes, "AB"):
+        ax.set(xlabel="shell radius", title=f"type {type_label}")
+        ax.legend(fontsize=8)
+    axes[0].set_ylabel("information allocated (KL, bits)")
+    fig.suptitle("Where the information lives, by radius")
+    fig.tight_layout()
     fig.savefig(path, dpi=150, bbox_inches="tight")
     plt.close(fig)
     return path

@@ -1,0 +1,198 @@
+"""Generate the static site's figures by RUNNING dib-tpu workloads.
+
+The reference is, literally, a GitHub Pages site (reference
+``index.html``, ``website_files/``) whose figures come from its papers.
+This builds the equivalent L6 artifact for dib-tpu with figures produced
+by this framework's own workloads at documentation scale:
+
+  - boolean info plane + per-feature information allocation (circuit.svg
+    analogue; boolean notebook cells 6-7),
+  - per-particle probe-grid information heat map (transformer.svg
+    analogue; amorphous notebook cell 8),
+  - compression matrices across the anneal (ICLR paper's signature viz),
+  - double-pendulum trajectory (pendy_anim.gif analogue, static),
+  - radial-shell information profile (the reconstructed workload).
+
+Run: PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python scripts/build_site.py
+(about 5 minutes on the 1-core CPU box; instant-ish on TPU).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ASSETS = os.path.join(REPO, "site", "assets")
+
+
+def boolean_figures() -> None:
+    import jax
+
+    from dib_tpu.data import get_dataset
+    from dib_tpu.workloads.boolean import (
+        BooleanTrainer,
+        BooleanWorkloadConfig,
+        run_boolean_workload,
+    )
+
+    config = BooleanWorkloadConfig(
+        num_steps=4000, batch_size=512, mi_every=200,
+        beta_start=1e-3, beta_end=5.0,
+    )
+    result = run_boolean_workload(0, config)
+    hist = result["history"]
+    lower = hist["mi_lower_bits"]                      # [C, F]
+    betas = hist["mi_betas"]
+
+    fig, ax = plt.subplots(figsize=(7, 4.2))
+    cmap = plt.get_cmap("tab10")
+    for f in range(lower.shape[1]):
+        ax.plot(betas, lower[:, f], color=cmap(f % 10),
+                label=f"input {f + 1}", lw=1.6)
+    ax.set_xscale("log")
+    ax.set_xlabel(r"bottleneck strength $\beta$")
+    ax.set_ylabel("information used per input (bits)")
+    ax.set_title("Reverse-engineering a Boolean circuit: information allocation")
+    ax.legend(ncol=2, fontsize=7, frameon=False)
+    fig.tight_layout()
+    fig.savefig(os.path.join(ASSETS, "boolean_allocation.png"), dpi=130)
+    plt.close(fig)
+
+
+def glass_probe_map() -> None:
+    from dib_tpu.workloads.amorphous import (
+        AmorphousWorkloadConfig,
+        run_amorphous_workload,
+    )
+
+    config = AmorphousWorkloadConfig(
+        num_steps=4000, number_particles=20, batch_size=32,
+        warmup_steps=200, eval_every=4000, probe_every=2000,
+        grid_side=48, probe_data_batch=256,
+        mi_eval_batch_size=256, mi_eval_batches=1,
+        beta_start=2e-6, beta_end=2e-1,
+    )
+    result = run_amorphous_workload(
+        key=0, config=config, outdir=os.path.join(ASSETS, "_glass_tmp"),
+        steps_per_epoch=20,
+        model_overrides={
+            "encoder_hidden": (64,), "embedding_dim": 8, "num_blocks": 2,
+            "num_heads": 4, "key_dim": 32, "ff_hidden": (64,),
+            "head_hidden": (64,),
+        },
+        num_synthetic_neighborhoods=512,
+    )
+    # keep the final probe map as the site figure
+    import shutil
+
+    steps = sorted(result["probe_grids"])
+    src = os.path.join(ASSETS, "_glass_tmp", f"info_map_step{steps[-1]}.png")
+    shutil.copy(src, os.path.join(ASSETS, "glass_info_map.png"))
+    shutil.copy(result["info_plane_path"],
+                os.path.join(ASSETS, "glass_info_plane.png"))
+    shutil.rmtree(os.path.join(ASSETS, "_glass_tmp"))
+
+
+def compression_matrices() -> None:
+    import jax
+
+    from dib_tpu.data import get_dataset
+    from dib_tpu.models import DistributedIBModel
+    from dib_tpu.train import CompressionMatrixHook, DIBTrainer, Every, TrainConfig
+
+    bundle = get_dataset("wine", data_path=os.path.join(REPO, "tests/fixtures/tabular"))
+    model = DistributedIBModel(
+        feature_dimensionalities=tuple(bundle.feature_dimensionalities),
+        encoder_hidden=(32,), integration_hidden=(64,), output_dim=1,
+        embedding_dim=2,
+    )
+    config = TrainConfig(
+        batch_size=32, beta_start=1e-4, beta_end=2.0,
+        num_pretraining_epochs=100, num_annealing_epochs=400,
+        steps_per_epoch=2, max_val_points=16,
+    )
+    trainer = DIBTrainer(model, bundle, config)
+    outdir = os.path.join(ASSETS, "_comp_tmp")
+    hook = CompressionMatrixHook(outdir, features=(10,))   # alcohol
+    trainer.fit(jax.random.key(0), hooks=[Every(100, hook)], hook_every=100)
+
+    import shutil
+
+    # mid-anneal checkpoint: distinctions partially merged (the signature
+    # visual); the final beta=2.0 matrix is uniformly crushed
+    pngs = sorted(os.listdir(outdir))
+    mid = [p for p in pngs if "log10beta_-0." in p] or pngs
+    shutil.copy(os.path.join(outdir, mid[0]),
+                os.path.join(ASSETS, "compression_matrix.png"))
+    shutil.rmtree(outdir)
+
+
+def pendulum_figure() -> None:
+    from dib_tpu.data.pendulum import simulate_double_pendulum
+
+    traj = simulate_double_pendulum(
+        num_trajectories=1, simulation_time=18.0, seed=4
+    )[0]
+    theta1, theta2 = traj[:, 0], traj[:, 2]
+    l1 = l2 = 1.0
+    x1, y1 = l1 * np.sin(theta1), -l1 * np.cos(theta1)
+    x2, y2 = x1 + l2 * np.sin(theta2), y1 - l2 * np.cos(theta2)
+
+    fig, ax = plt.subplots(figsize=(4.6, 4.6))
+    points = np.stack([x2, y2], -1)
+    for i in range(len(points) - 1):
+        ax.plot(points[i:i + 2, 0], points[i:i + 2, 1],
+                color=plt.get_cmap("viridis")(i / len(points)), lw=0.8)
+    ax.plot([0, x1[-1], x2[-1]], [0, y1[-1], y2[-1]], "o-", color="k", lw=2)
+    ax.set_aspect("equal")
+    ax.set_xlim(-2.1, 2.1); ax.set_ylim(-2.1, 2.1)
+    ax.set_title("Double pendulum: chaotic tip trajectory")
+    ax.axis("off")
+    fig.tight_layout()
+    fig.savefig(os.path.join(ASSETS, "pendulum_trajectory.png"), dpi=130)
+    plt.close(fig)
+
+
+def radial_shell_figure() -> None:
+    from dib_tpu.workloads.radial_shells import RadialShellsConfig, run_radial_shells_workload
+
+    result = run_radial_shells_workload(
+        0,
+        RadialShellsConfig(
+            num_pretraining_epochs=500, num_annealing_epochs=3000,
+            num_shells=8, eval_every=250,
+        ),
+        outdir=os.path.join(ASSETS, "_shell_tmp"),
+    )
+    import shutil
+
+    shutil.copy(result["profile_path"],
+                os.path.join(ASSETS, "radial_shells.png"))
+    shutil.rmtree(os.path.join(ASSETS, "_shell_tmp"))
+
+
+def main() -> None:
+    os.makedirs(ASSETS, exist_ok=True)
+    for name, fn in [
+        ("pendulum", pendulum_figure),
+        ("boolean", boolean_figures),
+        ("compression", compression_matrices),
+        ("radial shells", radial_shell_figure),
+        ("glass probe map", glass_probe_map),
+    ]:
+        print(f"building {name} figure...", flush=True)
+        fn()
+    print("site assets written to", ASSETS)
+
+
+if __name__ == "__main__":
+    main()
