@@ -27,12 +27,15 @@ from dib_tpu.ops.info_bounds import mi_sandwich_bounds, mi_sandwich_from_params
 
 
 @functools.lru_cache(maxsize=32)
-def _all_features_bounds_fn(model, batch_size: int, num_batches: int):
+def _all_features_bounds_fn(model, batch_size: int, num_batches: int,
+                            row_block: int | None):
     """Jitted (params, rows, key) -> ([F] lower, [F] upper) for a model with
     a vmapped all-features ``encode``; bounds averaged over ``num_batches``
     evaluation batches drawn with replacement from ``rows``. Cached on the
     (hashable) flax module so every hook instance measuring the same model
-    shares one compiled program."""
+    shares one compiled program. ``row_block`` chunks the [B, B] log-density
+    rows — the feature vmap holds F matrices live at once (F x B^2 floats),
+    so large F x batch_size combinations need it to fit memory."""
 
     @jax.jit
     def fn(params, rows, key):
@@ -43,7 +46,11 @@ def _all_features_bounds_fn(model, batch_size: int, num_batches: int):
             idx = jax.random.randint(k_idx, (batch_size,), 0, n)
             mus, logvars = model.encode(params, rows[idx])
             keys = jax.random.split(k_mi, mus.shape[0])
-            lower, upper = jax.vmap(mi_sandwich_from_params)(keys, mus, logvars)
+            lower, upper = jax.vmap(
+                lambda kk, m, lv: mi_sandwich_from_params(
+                    kk, m, lv, row_block=row_block
+                )
+            )(keys, mus, logvars)
             return None, (lower, upper)
 
         # sequential over eval batches (vmap would hold num_batches x F
@@ -91,9 +98,11 @@ class InfoPerFeatureHook:
         evaluation_batch_size: int = 1024,
         number_evaluation_batches: int = 8,
         seed: int = 0,
+        row_block: int | None = None,
     ):
         self.evaluation_batch_size = evaluation_batch_size
         self.number_evaluation_batches = number_evaluation_batches
+        self.row_block = row_block   # chunk the [B, B] density rows (memory)
         self.key = jax.random.key(seed)
         self.records: list[dict] = []
         self._batched_fn = None
@@ -112,7 +121,7 @@ class InfoPerFeatureHook:
                 # measure through ONE compiled program)
                 self._batched_fn = _all_features_bounds_fn(
                     model, self.evaluation_batch_size,
-                    self.number_evaluation_batches,
+                    self.number_evaluation_batches, self.row_block,
                 )
             params = (state.params["model"]
                       if "model" in state.params else state.params)

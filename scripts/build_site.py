@@ -35,11 +35,7 @@ ASSETS = os.path.join(REPO, "site", "assets")
 
 
 def boolean_figures() -> None:
-    import jax
-
-    from dib_tpu.data import get_dataset
     from dib_tpu.workloads.boolean import (
-        BooleanTrainer,
         BooleanWorkloadConfig,
         run_boolean_workload,
     )

@@ -40,10 +40,10 @@ def test_radial_shells_end_to_end(tmp_path):
     assert hist.kl_per_feature.shape == (40, 8)       # 2 types x 4 shells
     assert np.isfinite(hist.loss).all()
     assert result["final_shell_profile_bits"].shape == (8,)
-    # the peak profile is the per-shell max of the recorded bound series
-    np.testing.assert_allclose(
-        result["peak_shell_profile_bits"],
-        result["mi_bounds_bits"][:, :, 0].max(axis=0),
-    )
+    # peak profile: per-shell (not per-epoch) reduction that dominates
+    # every recorded check — catches wrong-axis reductions
+    peak = result["peak_shell_profile_bits"]
+    assert peak.shape == (8,)
+    assert (peak[None, :] >= result["mi_bounds_bits"][:, :, 0] - 1e-9).all()
     assert (tmp_path / "distributed_info_plane.png").exists()
     assert (tmp_path / "information_vs_radius.png").exists()
