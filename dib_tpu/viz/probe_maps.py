@@ -24,19 +24,36 @@ def density_mask(
     grid_side_length: int,
     density_threshold: float = 1e-6,
 ) -> np.ndarray:
-    """NaN-mask for probe points inside the region where g(r) < threshold
-    (no physical particles there, so the network output is meaningless)."""
-    # The excluded-volume core is the initial contiguous run of empty bins;
-    # empty bins at large radius (beyond the sampled region) must not widen it.
+    """NaN-mask for probe points in regions where g(r) < threshold
+    (no physical particles there, so the network output is meaningless).
+
+    ``g_r_bins`` holds the RIGHT edge of each g(r) bin — same length as
+    ``g_r`` (``pair_correlation`` returns full edges; pass ``edges[1:]``,
+    as ``ProbeGridHook`` does).
+
+    Masks BOTH unsupported regions: the excluded-volume core (initial
+    contiguous run of empty bins — interior empty bins between occupied
+    shells must not widen it) and everything beyond the outermost occupied
+    bin, where the asymmetric LOO upper bound diverges for probes outside
+    the data support (amorphous notebook cell 8 masks by g(r) the same way).
+    """
+    g_r_bins = np.asarray(g_r_bins)
+    if len(g_r_bins) != len(np.asarray(g_r)):
+        raise ValueError(
+            f"g_r_bins must be the per-bin RIGHT edges (len == len(g_r)); "
+            f"got {len(g_r_bins)} edges for {len(np.asarray(g_r))} bins — "
+            f"pass edges[1:] from pair_correlation"
+        )
     occupied = np.where(g_r >= density_threshold)[0]
     if len(occupied) == 0:
-        cutoff_radius = g_r_bins[-1]
-    elif occupied[0] == 0:
-        cutoff_radius = 0.0
+        inner_cutoff, outer_cutoff = 0.0, 0.0     # nothing supported
     else:
-        cutoff_radius = g_r_bins[occupied[0] - 1]
+        inner_cutoff = 0.0 if occupied[0] == 0 else g_r_bins[occupied[0] - 1]
+        outer_cutoff = g_r_bins[occupied[-1]]
     radii = np.hypot(probe_positions[:, 0], probe_positions[:, 1])
-    mask = np.where(radii < cutoff_radius, np.nan, 1.0)
+    mask = np.where(
+        (radii < inner_cutoff) | (radii > outer_cutoff), np.nan, 1.0
+    )
     return mask.reshape(grid_side_length, grid_side_length)
 
 

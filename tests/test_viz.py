@@ -59,11 +59,32 @@ def test_info_maps_and_density_mask(tmp_path, rng):
     grids = [np.abs(rng.normal(size=(g, g, 2))) for _ in range(2)]
     xx, yy = np.meshgrid(np.linspace(-3, 3, g), np.linspace(-3, 3, g))
     probes = np.stack([xx, yy], -1).reshape(-1, 2)
-    g_r_bins = np.linspace(0, 3, 20)
-    g_r = np.concatenate([np.zeros(5), np.ones(15)])  # empty core r < ~0.63
+    # per-bin RIGHT edges, the ProbeGridHook convention (edges[1:])
+    g_r_bins = np.linspace(0, 3, 20)[1:]               # 19 bins
+    g_r = np.concatenate([np.zeros(5), np.ones(14)])   # empty core r < ~0.79
     mask = density_mask(probes, g_r, g_r_bins, g)
-    assert np.isnan(mask[g // 2, g // 2])  # center masked
-    assert mask[0, 0] == 1.0               # corner kept
+    assert np.isnan(mask[g // 2, g // 2])  # excluded-volume core masked
+    # corner (radius ~4.2) lies beyond the outermost occupied bin (r=3):
+    # out-of-support probes have divergent LOO uppers and must be masked
+    assert np.isnan(mask[0, 0])
+    # a supported mid-ring probe (x~1.0, y~0.33, radius ~1.05) stays
+    assert mask[g // 2, int(g * 0.65)] == 1.0
+    # interior empty bins between occupied shells must NOT extend the core
+    g_r_gap = np.concatenate([np.zeros(5), np.ones(4), np.zeros(3), np.ones(7)])
+    mask_gap = density_mask(probes, g_r_gap, g_r_bins, g)
+    assert np.isnan(mask_gap[g // 2, g // 2])
+    np.testing.assert_array_equal(np.isnan(mask_gap), np.isnan(mask))
+    # trailing empty bins pull the outer cutoff in: r ~2.33 probes now
+    # outside support (last occupied right edge ~2.2) must be masked
+    g_r_trail = np.concatenate([np.zeros(5), np.ones(9), np.zeros(5)])
+    mask_trail = density_mask(probes, g_r_trail, g_r_bins, g)
+    assert np.isnan(mask_trail[g // 2, g - 1])         # x=3.0
+    assert np.isnan(mask_trail[g // 2, int(g * 0.85)])  # x~2.33
+    # full-edges arrays are rejected loudly (ambiguous convention)
+    import pytest
+
+    with pytest.raises(ValueError, match="RIGHT edges"):
+        density_mask(probes, g_r, np.linspace(0, 3, 20), g)
     out = save_info_maps(grids, str(tmp_path / "maps.png"), masks=[mask, mask], titles=["A", "B"])
     import os
 
