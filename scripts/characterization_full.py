@@ -1,0 +1,87 @@
+"""Full MI-bound characterization sweep vs Monte Carlo (SURVEY §6 anchor).
+
+The characterization notebook's complete protocol (cells 3-4): synthetic
+channels of 1/2/4/6 binary input bits plus a continuous channel, swept over
+7 Gaussian separation scales x evaluation batch sizes {64, 256, 1024}, each
+cell's sandwich bounds compared against a 20k-sample Monte Carlo oracle.
+Summarizes the regime behind the reference's "bounds separated by no more
+than ~0.01 bits" claim: at B=1024 on channels whose MI is well below
+log2(B), the sandwich must bracket the MC truth with a tight gap.
+
+Writes ``CHARACTERIZATION_FULL.json`` and the residual plots.
+
+Run: PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python scripts/characterization_full.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main() -> int:
+    from dib_tpu.workloads import run_characterization, save_characterization_plots
+
+    t0 = time.time()
+    results = run_characterization(seed=0)
+    wall_s = time.time() - t0
+    save_characterization_plots(results, "characterization_out")
+
+    rows = []
+    for r in results:
+        rows.append({
+            "input_bits": r.channel.input_bits,
+            "scale": round(r.channel.scale, 4),
+            "batch_size": r.batch_size,
+            "mc_truth_bits": round(r.mc_truth, 4),
+            "lower_bits": round(r.lower_mean, 4),
+            "lower_std_bits": round(r.lower_std, 4),
+            "upper_bits": round(r.upper_mean, 4),
+            "upper_std_bits": round(r.upper_std, 4),
+            "gap_bits": round(r.upper_mean - r.lower_mean, 4),
+        })
+
+    # The headline regime: B=1024, channel MI comfortably below log2(B).
+    tight = [
+        row for row in rows
+        if row["batch_size"] == 1024 and 0.05 < row["mc_truth_bits"] < 6.0
+    ]
+    gaps = np.array([row["gap_bits"] for row in tight])
+    # sandwich brackets the MC truth within the measured estimator noise
+    # (3 sigma of the across-repeat std per bound — not a flat slack, so a
+    # bias regression several times the claimed precision cannot hide)
+    brackets = np.array([
+        row["lower_bits"] - 3 * row["lower_std_bits"]
+        <= row["mc_truth_bits"]
+        <= row["upper_bits"] + 3 * row["upper_std_bits"]
+        for row in tight
+    ])
+    report = {
+        "metric": "mi_bound_characterization_median_gap_B1024",
+        "value": round(float(np.median(gaps)), 4),
+        "unit": "bits",
+        "cells_total": len(rows),
+        "cells_B1024_informative": len(tight),
+        "bracketing_fraction": round(float(brackets.mean()), 4),
+        "gap_bits_median": round(float(np.median(gaps)), 4),
+        "gap_bits_p90": round(float(np.percentile(gaps, 90)), 4),
+        "gap_bits_max": round(float(gaps.max()), 4),
+        "wall_clock_s": round(wall_s, 1),
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "cells": rows,
+    }
+    with open("CHARACTERIZATION_FULL.json", "w") as f:
+        json.dump(report, f, indent=1)
+        f.write("\n")
+    print(json.dumps({k: v for k, v in report.items() if k != "cells"}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
