@@ -8,10 +8,13 @@ beta endpoints as ONE jitted vmapped program.
 
 It times the steady-state sweep throughput on the available device, projects
 the wall-clock of the complete north-star run (R replicas x 25k steps), and
-reports MFU (model FLOPs from XLA ``cost_analysis`` vs the chip's peak for
-the dtype mix). ``vs_baseline`` is the projection divided by the 10-minute
-target the driver set for a v4-8 (BASELINE.json ``north_star``); < 1.0 beats
-the target.
+reports MFU two ways: the HEADLINE ``mfu`` is conventional (analytic model
+matmul FLOPs, fwd + bwd, vs the chip's bf16 peak), and ``mfu_hlo`` is the
+whole-chunk-program XLA ``cost_analysis`` figure (training + validation +
+bookkeeping; backend-dependent and NOT convention-comparable — see
+docs/performance.md). ``vs_baseline`` is the projection divided by the
+10-minute target the driver set for a v4-8 (BASELINE.json ``north_star``);
+< 1.0 beats the target.
 
 Architecture (hardened after round 1, where a dead TPU tunnel burned the
 whole perf round): a PARENT process that never initializes an accelerator
@@ -50,11 +53,19 @@ sys.path.insert(0, REPO)
 CACHE_PATH = os.path.join(REPO, "BENCH_CACHE.json")
 METRIC = "amorphous_set_transformer_beta_sweep_projected"
 
-NUM_REPLICAS = int(os.environ.get("DIB_BENCH_REPLICAS", "8"))
+DEFAULT_REPLICAS = 8
+DEFAULT_STEPS_PER_EPOCH = 50
+DEFAULT_MEASURE_EPOCHS = 6
+NUM_REPLICAS = int(os.environ.get("DIB_BENCH_REPLICAS", DEFAULT_REPLICAS))
 FULL_SWEEP_STEPS = 25_000          # reference run length per protocol
 BASELINE_MINUTES = 10.0            # driver-set north-star target (v4-8)
-STEPS_PER_EPOCH = int(os.environ.get("DIB_BENCH_STEPS_PER_EPOCH", "50"))
-MEASURE_EPOCHS = int(os.environ.get("DIB_BENCH_MEASURE_EPOCHS", "6"))
+STEPS_PER_EPOCH = int(
+    os.environ.get("DIB_BENCH_STEPS_PER_EPOCH", DEFAULT_STEPS_PER_EPOCH)
+)
+MEASURE_EPOCHS = int(
+    os.environ.get("DIB_BENCH_MEASURE_EPOCHS", DEFAULT_MEASURE_EPOCHS)
+)
+BENCH_BATCH_SIZE = 32              # reference batch (amorphous nb cell 8)
 
 # Peak dense-matmul TFLOP/s per chip for the bf16 dtype mix (public specs).
 # device_kind substrings as reported by jax; conservative bf16 numbers.
@@ -78,6 +89,38 @@ def peak_tflops_for(device_kind: str) -> float | None:
         if key in kind:
             return PEAK_BF16_TFLOPS[key]
     return None
+
+
+def analytic_model_flops_per_step(model, batch_size: int) -> float:
+    """Matmul FLOPs of one train step (fwd + 2x bwd), conventional-MFU style.
+
+    Counts only the dense/attention matmuls (2*M*N*K each) of the
+    per-particle DIB model — encoder MLP, QKV/out projections, the two
+    [P, P] attention matmuls, feed-forward, head — exactly the FLOPs the
+    standard MFU definition uses (elementwise ops, LayerNorms, optimizer
+    update excluded). The HLO ``cost_analysis`` number is reported
+    separately: it covers the whole chunk program (training + per-epoch
+    validation + history bookkeeping) and its availability/semantics vary
+    by backend, so it is not comparable across rounds (ADVICE round 2).
+    """
+    P = model.num_particles
+    F = model.particle_feature_dim
+    d = model.embedding_dim
+    qkv = model.num_heads * model.key_dim
+
+    def mlp_flops(dims):
+        return 2 * sum(a * b for a, b in zip(dims[:-1], dims[1:]))
+
+    enc = P * mlp_flops([F, *model.encoder_hidden, 2 * d])
+    attn = (
+        3 * 2 * P * d * qkv          # Q, K, V projections
+        + 2 * 2 * P * P * qkv        # scores + attention-weighted values
+        + 2 * P * qkv * d            # output projection
+    )
+    ff = P * mlp_flops([d, *model.ff_hidden, d])
+    head = mlp_flops([d, *model.head_hidden, model.output_dim])
+    forward = batch_size * (enc + model.num_blocks * (attn + ff) + head)
+    return 3.0 * forward             # backward ~= 2x forward for matmuls
 
 
 # ==========================================================================
@@ -105,7 +148,10 @@ def child_main() -> None:
     from dib_tpu.data import get_dataset
     from dib_tpu.models import PerParticleDIBModel
     from dib_tpu.parallel import BetaSweepTrainer
+    from dib_tpu.parallel.context import _dense_score_dtype
     from dib_tpu.train import TrainConfig
+
+    score_dtype_name = _dense_score_dtype().__name__
 
     devices = jax.devices()
     if devices[0].platform == "cpu" and not os.environ.get("DIB_BENCH_ALLOW_CPU"):
@@ -124,7 +170,7 @@ def child_main() -> None:
     model = PerParticleDIBModel(num_particles=50, compute_dtype="bfloat16")
     config = TrainConfig(
         learning_rate=1e-4,
-        batch_size=32,
+        batch_size=BENCH_BATCH_SIZE,
         num_pretraining_epochs=0,
         num_annealing_epochs=FULL_SWEEP_STEPS // STEPS_PER_EPOCH,
         steps_per_epoch=STEPS_PER_EPOCH,
@@ -182,21 +228,29 @@ def child_main() -> None:
     projected_s = FULL_SWEEP_STEPS * NUM_REPLICAS / steps_per_s + compile_s
     projected_min = projected_s / 60.0
 
-    mfu = achieved_tflops = flops_per_step = None
+    # Conventional MFU: analytic model matmul FLOPs (fwd + bwd) per replica
+    # step vs chip peak. The whole-program HLO number is kept as auxiliary
+    # (``*_hlo``); on some backends cost_analysis is unreliable, so it never
+    # feeds the headline MFU (ADVICE round 2, bench.py:169).
+    model_flops_per_step = analytic_model_flops_per_step(model, BENCH_BATCH_SIZE)
+    achieved_tflops = model_flops_per_step * steps_per_s / 1e12
+    peak = peak_tflops_for(device_kind)
+    mfu = achieved_tflops / peak if peak else None
+
+    mfu_hlo = flops_per_step_hlo = None
     if chunk_flops:
-        flops_per_step = chunk_flops / sweep_steps
-        achieved_tflops = flops_per_step * steps_per_s / 1e12
-        peak = peak_tflops_for(device_kind)
+        flops_per_step_hlo = chunk_flops / sweep_steps
         if peak:
-            mfu = achieved_tflops / peak
+            mfu_hlo = flops_per_step_hlo * steps_per_s / 1e12 / peak
 
     log(
         f"measured {sweep_steps} sweep steps in {measure_s:.2f}s "
         f"({steps_per_s:.0f} steps/s); projected full sweep "
         f"({NUM_REPLICAS} replicas x {FULL_SWEEP_STEPS} steps): "
         f"{projected_min:.2f} min; "
-        f"flops/step={flops_per_step}, achieved_tflops={achieved_tflops}, "
-        f"mfu={mfu}"
+        f"model flops/step={model_flops_per_step:.3e}, "
+        f"achieved_tflops={achieved_tflops:.2f}, mfu={mfu}, "
+        f"hlo flops/step={flops_per_step_hlo}, mfu_hlo={mfu_hlo}"
     )
     # Sanity: training must not have gone non-finite anywhere in the run.
     kl = np.asarray(histories["kl_per_feature"])
@@ -211,11 +265,12 @@ def child_main() -> None:
                 "vs_baseline": round(projected_min / BASELINE_MINUTES, 4),
                 "steps_per_s": round(steps_per_s, 1),
                 "compile_s": round(compile_s, 1),
-                "flops_per_step": flops_per_step,
-                "achieved_tflops": (
-                    round(achieved_tflops, 2) if achieved_tflops else None
-                ),
+                "flops_per_step_model": model_flops_per_step,
+                "achieved_tflops": round(achieved_tflops, 2),
                 "mfu": round(mfu, 4) if mfu else None,
+                "flops_per_step_hlo": flops_per_step_hlo,
+                "mfu_hlo": round(mfu_hlo, 4) if mfu_hlo else None,
+                "score_dtype": score_dtype_name,
                 "device_kind": device_kind,
                 "num_replicas": NUM_REPLICAS,
                 "full_sweep_steps": FULL_SWEEP_STEPS,
@@ -301,10 +356,18 @@ def save_cache(result: dict) -> None:
     # Never let a test configuration masquerade as the last good north-star
     # measurement: the degraded path reports the cache against the 10-min
     # TPU target, so only default-config accelerator runs may refresh it.
-    if os.environ.get("DIB_BENCH_ALLOW_CPU") or any(
-        os.environ.get(k)
-        for k in ("DIB_BENCH_REPLICAS", "DIB_BENCH_MEASURE_EPOCHS",
-                  "DIB_BENCH_STEPS_PER_EPOCH")
+    # Compare EFFECTIVE values against the defaults (not env-var presence):
+    # an operator exporting the default values must still refresh the cache
+    # (ADVICE round 2, bench.py:280).
+    # The effective score-dtype default is bfloat16 (context.py, adopted
+    # round 3): only runs at that default may refresh — re-validating the
+    # f32 fallback must not overwrite the cache with the slower variant.
+    if os.environ.get("DIB_BENCH_ALLOW_CPU") or (
+        NUM_REPLICAS != DEFAULT_REPLICAS
+        or MEASURE_EPOCHS != DEFAULT_MEASURE_EPOCHS
+        or STEPS_PER_EPOCH != DEFAULT_STEPS_PER_EPOCH
+        or os.environ.get("DIB_ATTN_SCORE_DTYPE", "bfloat16").lower()
+        not in ("bfloat16", "bf16")
     ):
         log("cache not refreshed: non-default benchmark configuration")
         return

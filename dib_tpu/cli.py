@@ -212,6 +212,11 @@ def run(args) -> dict:
         entropy_y = sequence_entropy_bits(y_arr)
 
     summary: dict = {"dataset": args.dataset, "artifacts": []}
+    # Provenance in the run record: 'real' (file ingestion) vs 'synthetic'
+    # (schema-faithful surrogate) — see data/README.md and tabular.py
+    # `_local_or_synthetic`. Committed run artifacts must say which.
+    if "source" in getattr(bundle, "extras", {}):
+        summary["data_source"] = bundle.extras["source"]
 
     if args.sweep_beta_ends:
         ends = np.repeat(np.asarray(args.sweep_beta_ends, np.float64),
@@ -277,6 +282,9 @@ def run(args) -> dict:
             np.savez(os.path.join(outdir, "info_bounds.npz"),
                      epochs=info_hook.epochs, bounds_bits=info_hook.bounds_bits)
             summary["artifacts"].append(os.path.join(outdir, "info_bounds.npz"))
+    with open(os.path.join(outdir, "run_summary.json"), "w") as f:
+        json.dump(summary, f, indent=1)
+        f.write("\n")
     return summary
 
 

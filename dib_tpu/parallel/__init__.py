@@ -32,6 +32,10 @@ from dib_tpu.parallel.multihost import (
     process_local_batch,
 )
 from dib_tpu.parallel.sweep import BetaSweepTrainer, PerReplicaHook, sweep_records
+from dib_tpu.parallel.sweep_hooks import (
+    SweepCompressionHook,
+    SweepInfoPerFeatureHook,
+)
 
 __all__ = [
     "BETA_AXIS",
@@ -39,6 +43,8 @@ __all__ = [
     "SEQ_AXIS",
     "BetaSweepTrainer",
     "PerReplicaHook",
+    "SweepCompressionHook",
+    "SweepInfoPerFeatureHook",
     "batch_sharding",
     "context_model_view",
     "context_parallel_apply",

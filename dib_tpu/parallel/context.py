@@ -49,20 +49,23 @@ Array = jax.Array
 # --------------------------------------------------------------------------
 
 def _dense_score_dtype():
-    """Score dtype for ``dense_self_attention``, default float32.
+    """Score dtype for ``dense_self_attention``, default bfloat16.
 
-    Perf experiment knob (round-1 history, PARITY.md): emitting bf16 scores
-    from the MXU measured 721 steps/s on the north-star sweep but NaN'd
-    under XLA fusion when the unscaled scores round-tripped through bf16;
-    the float32 default measured 549. The middle variant — q scaled BEFORE
-    the matmul (so scores are softmax-ranged), bf16 score emission, float32
-    softmax — measured 634 and is selected with DIB_ATTN_SCORE_DTYPE=bfloat16
-    pending its full-run stability result on hardware. Read at TRACE time:
-    set the env before any attention call in the process (flipping it later
-    is silently ignored by jit's trace cache unless jax.clear_caches() is
-    called); tests pin both settings.
+    Perf history (PARITY.md): emitting bf16 scores from UNSCALED q·k NaN'd
+    under XLA fusion (round 1, 721 steps/s variant, killed); all-f32 scores
+    measured 549-550 steps/s. The adopted default is the middle variant — q
+    scaled BEFORE the matmul (so scores are softmax-ranged and bf16's
+    ~8-bit exponent headroom is never stressed), bf16 score emission from
+    the MXU, float32 softmax. Resolved round 3 on hardware: 616 vs 550
+    steps/s on the v5e bench (+12%), and the full 25k-step x 8-replica
+    north-star sweep ran all-finite (NORTHSTAR_BF16.json), so the variant
+    is now the default; DIB_ATTN_SCORE_DTYPE=float32 restores the
+    conservative path. Read at TRACE time: set the env before any attention
+    call in the process (flipping it later is silently ignored by jit's
+    trace cache unless jax.clear_caches() is called); tests pin both
+    settings.
     """
-    name = os.environ.get("DIB_ATTN_SCORE_DTYPE", "float32").lower()
+    name = os.environ.get("DIB_ATTN_SCORE_DTYPE", "bfloat16").lower()
     if name in ("bfloat16", "bf16"):
         return jnp.bfloat16
     if name in ("float32", "f32"):
@@ -78,17 +81,24 @@ def dense_self_attention(q: Array, k: Array, v: Array) -> Array:
     collective variants.
 
     Numerics (same recipe as the ring variant): q is scaled BEFORE the
-    matmul and the scores come out of the MXU in float32 by default (no
-    bfloat16 round-trip of potentially huge score values, which XLA fusion
-    can otherwise push to non-finite on large activations) — see
-    ``_dense_score_dtype`` for the measured bf16-scores variant. Softmax is
-    always computed in float32; the value matmul runs in the input dtype
-    with a float32 accumulator.
+    matmul — scale-first keeps the scores softmax-ranged, which is what
+    makes the default bf16 score emission safe (an UNSCALED bf16 round-trip
+    of potentially huge score values NaN'd under XLA fusion; see
+    ``_dense_score_dtype`` for the measured history and the float32
+    fallback). Softmax is always computed in float32; the value matmul runs
+    in the input dtype with a float32 accumulator.
     """
     scale = 1.0 / math.sqrt(q.shape[-1])
+    # bf16 score emission is a MIXED-PRECISION optimization: it only applies
+    # when the model already computes in bf16. Full-precision models (f32
+    # inputs) always get f32 scores — a preferred_element_type below the
+    # input precision would silently downcast them.
+    score_dtype = (
+        _dense_score_dtype() if q.dtype == jnp.bfloat16 else jnp.float32
+    )
     s = jnp.einsum(
         "bqhd,bkhd->bhqk", q * scale, k,
-        preferred_element_type=_dense_score_dtype(),
+        preferred_element_type=score_dtype,
     )
     p = jax.nn.softmax(s.astype(jnp.float32), axis=-1)
     return jnp.einsum(

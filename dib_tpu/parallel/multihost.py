@@ -26,10 +26,30 @@ inside a host (or a slice) where the gradient all-reduce rides ICI.
 
 from __future__ import annotations
 
+import os
+
 import jax
 import numpy as np
 
 Array = jax.Array
+
+# Environment variables that indicate a multi-host cluster launcher set this
+# process up (TPU pod metadata, explicit JAX coordinator spec, SLURM/MPI).
+# Their absence means a plain single-host run, where a failed autodetect is
+# the expected quiet no-op rather than a broken pod.
+_CLUSTER_ENV_VARS = (
+    "JAX_COORDINATOR_ADDRESS",
+    "COORDINATOR_ADDRESS",
+    "MEGASCALE_COORDINATOR_ADDRESS",
+    "TPU_WORKER_HOSTNAMES",
+    "CLOUD_TPU_TASK_ID",
+    "SLURM_JOB_NUM_NODES",
+    "OMPI_COMM_WORLD_SIZE",
+)
+
+
+def _cluster_env_configured() -> bool:
+    return any(os.environ.get(k) for k in _CLUSTER_ENV_VARS)
 
 
 def initialize(coordinator_address: str | None = None,
@@ -69,12 +89,24 @@ def initialize(coordinator_address: str | None = None,
         except Exception as e:
             # "coordinator_address should be defined" is the EXPECTED
             # single-host outcome (no cluster spec anywhere) — stay quiet.
-            # Exact-message match only: a MALFORMED coordinator address also
-            # mentions coordinator_address but must warn. Anything else is a
+            # The exact message is a JAX internal and may be reworded, so
+            # also accept any coordinator_address complaint when NO cluster
+            # env var is set (a plain single-host run). When cluster config
+            # IS present in the environment, a coordinator_address error
+            # means a malformed spec and must warn. Anything else is a
             # broken cluster spec and must not silently degrade a pod into N
             # uncoordinated single-process trainers — same loud path as the
             # RuntimeError branch above.
-            if "coordinator_address should be defined" in str(e):
+            # Residual tradeoff: a pod whose launcher configures the cluster
+            # through a channel other than _CLUSTER_ENV_VARS (e.g. pure
+            # GCE-metadata autodetection) and then produces a malformed-spec
+            # coordinator_address error lands on the quiet path. Such
+            # launchers should pass coordinator_address explicitly — the
+            # explicit branch below propagates every error loudly.
+            msg = str(e)
+            if "coordinator_address should be defined" in msg or (
+                "coordinator_address" in msg and not _cluster_env_configured()
+            ):
                 return False
             import warnings
 

@@ -1,0 +1,222 @@
+"""Sweep-native instrumentation: all replicas measured in ONE dispatch.
+
+``PerReplicaHook`` (sweep.py) adapts serial hooks by slicing the stacked
+state and invoking R independent host round-trips per beta checkpoint. That
+is correct but slow on the instrumented north-star run: at R=8 replicas x 20
+checkpoints the host re-enters the device 160+ times, and matplotlib
+rasterization rides the measured wall-clock (reference
+``SaveCompressionMatricesCallback``, models.py:152-186, renders inline —
+acceptable at 1 serial run, not inside a sweep whose wall-clock IS the
+benchmark). The hooks here are the sweep-scale redesign:
+
+  - ``SweepInfoPerFeatureHook``: MI sandwich bounds for ALL replicas x ALL
+    channels as one jitted program per checkpoint (vmap over the replica
+    axis around the same log-space bound kernel the serial hook uses).
+  - ``SweepCompressionHook``: ONE vmapped encode per (checkpoint, feature)
+    pulls every replica's compression scheme; arrays are saved as .npz
+    immediately (cheap) and PNG rendering is deferred to ``render()`` after
+    the timed run — identical images, zero matplotlib on the hot path.
+
+Both record per-replica results in the same shapes/units as their serial
+counterparts (``dib_tpu/train/hooks.py``), so downstream plotting is
+unchanged.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dib_tpu.ops.schedules import log_annealed_beta
+from dib_tpu.train.hooks import all_features_bounds_kernel
+
+__all__ = ["SweepInfoPerFeatureHook", "SweepCompressionHook"]
+
+
+def _model_params(params):
+    return params["model"] if "model" in params else params
+
+
+class SweepInfoPerFeatureHook:
+    """[R, F] MI sandwich bounds per checkpoint, one dispatch for the sweep.
+
+    Interface: called as a sweep hook ``hook(sweep, states, epoch)``;
+    accumulates ``records`` of ``{"epoch": int, "bounds": [R, F, 2] nats}``.
+    ``replica_view(r)`` exposes a serial-hook-shaped view (``.epochs``,
+    ``.bounds_bits``) for per-replica plotting.
+    """
+
+    def __init__(
+        self,
+        evaluation_batch_size: int = 1024,
+        number_evaluation_batches: int = 8,
+        seed: int = 0,
+        row_block: int | None = None,
+    ):
+        self.evaluation_batch_size = evaluation_batch_size
+        self.number_evaluation_batches = number_evaluation_batches
+        self.row_block = row_block
+        self.key = jax.random.key(seed)
+        self.records: list[dict] = []
+        self._fn = None
+        self._device_rows = None
+        self._cache_for = None   # strong (sweep, model) refs, not ids —
+                                 # id reuse after GC must not retain caches
+
+    def _build(self, model):
+        # THE serial measurement kernel, vmapped over the replica axis —
+        # shared body (hooks.all_features_bounds_kernel), so sweep and
+        # serial bounds are the same computation by construction.
+        kernel = all_features_bounds_kernel(
+            model, self.evaluation_batch_size,
+            self.number_evaluation_batches, self.row_block,
+        )
+        return jax.jit(jax.vmap(kernel, in_axes=(0, None, 0)))
+
+    def __call__(self, sweep, states, epoch: int):
+        model = sweep.base.model
+        if (self._cache_for is None or sweep is not self._cache_for[0]
+                or model is not self._cache_for[1]):
+            self._fn = self._build(model)
+            self._device_rows = jnp.asarray(sweep.base.bundle.x_valid)
+            self._cache_for = (sweep, model)
+        self.key, k = jax.random.split(self.key)
+        keys = jax.random.split(k, sweep.num_replicas)
+        lower, upper = self._fn(
+            _model_params(states.params), self._device_rows, keys
+        )
+        bounds = np.stack(
+            [np.asarray(lower), np.asarray(upper)], axis=-1
+        )  # [R, F, 2] nats
+        self.records.append({"epoch": epoch, "bounds": bounds})
+
+    @property
+    def epochs(self) -> np.ndarray:
+        return np.asarray([r["epoch"] for r in self.records])
+
+    def bounds_bits(self, r: int) -> np.ndarray:
+        """[T, F, 2] (lower, upper) in bits for replica ``r``."""
+        return np.asarray(
+            [rec["bounds"][r] for rec in self.records]
+        ) / np.log(2.0)
+
+    class _ReplicaView:
+        def __init__(self, parent, r):
+            self.epochs = parent.epochs
+            self.bounds_bits = parent.bounds_bits(r)
+
+    def replica_view(self, r: int) -> "_ReplicaView":
+        return self._ReplicaView(self, r)
+
+
+class SweepCompressionHook:
+    """Compression schemes for all replicas, rendering deferred off the clock.
+
+    At each checkpoint: one vmapped encode per selected feature produces
+    [R, N, d] channel parameters; they are written as
+    ``{outdir}/schemes/scheme_epoch{E}_feature{F}.npz`` (with the
+    per-replica betas) in milliseconds. ``render()`` — called AFTER the
+    timed run — rasterizes the saved schemes into exactly the PNGs the
+    serial ``CompressionMatrixHook`` would have produced, at
+    ``{outdir}/replica{r}/compression/feature_{f}_log10beta_{β:.3f}.png``.
+    """
+
+    def __init__(self, outdir: str, features=(0,),
+                 max_number_to_display: int = 128, seed: int = 0):
+        self.outdir = outdir
+        self.features = tuple(features)
+        self.max_number_to_display = max_number_to_display
+        self.seed = seed
+        self.saved: list[dict] = []
+        self._fns = {}
+        self._feature_rows = {}
+        self._cache_for = None   # strong sweep ref (see info hook note)
+        os.makedirs(os.path.join(outdir, "schemes"), exist_ok=True)
+
+    def _encode_fn(self, model, f: int):
+        if f not in self._fns:
+            self._fns[f] = jax.jit(
+                jax.vmap(lambda p, x: model.encode_feature(p, f, x),
+                         in_axes=(0, None))
+            )
+        return self._fns[f]
+
+    def __call__(self, sweep, states, epoch: int):
+        model = sweep.base.model
+        if sweep is not self._cache_for:
+            self._fns.clear()
+            self._feature_rows.clear()
+            self._cache_for = sweep
+        cfg = sweep.base.config
+        starts = np.asarray(jax.device_get(sweep.beta_starts), np.float64)
+        ends = np.asarray(jax.device_get(sweep.beta_ends), np.float64)
+        betas = np.array([
+            float(log_annealed_beta(
+                epoch, starts[r], ends[r],
+                cfg.num_annealing_epochs, cfg.num_pretraining_epochs,
+            ))
+            for r in range(sweep.num_replicas)
+        ])
+        params = _model_params(states.params)
+        for f in self.features:
+            if f not in self._feature_rows:
+                self._feature_rows[f] = jnp.asarray(
+                    sweep.base.feature_data(f)
+                )
+            mus, logvars = self._encode_fn(model, f)(
+                params, self._feature_rows[f]
+            )
+            path = os.path.join(
+                self.outdir, "schemes", f"scheme_epoch{epoch}_feature{f}.npz"
+            )
+            np.savez(path, mus=np.asarray(mus), logvars=np.asarray(logvars),
+                     betas=betas, epoch=epoch, feature=f)
+            self.saved.append({"path": path, "epoch": epoch, "feature": f})
+
+    def render(self, bundle) -> list[str]:
+        """Rasterize every saved scheme; returns the PNG paths.
+
+        RNG parity with the serial path: ``CompressionMatrixHook`` gives
+        each replica its own ``default_rng(seed)`` advanced once per
+        (checkpoint, feature) in call order, so the deferred render loops
+        replicas on the OUTSIDE and the saved records (already in call
+        order) inside — the display-row subsets match the PNGs the serial
+        hook would have produced.
+        """
+        from dib_tpu.viz.compression import save_compression_matrix
+
+        dims = list(bundle.feature_dimensionalities)
+        raw_all = bundle.x_valid_raw
+        paths = []
+        num_replicas = (
+            int(np.load(self.saved[0]["path"])["mus"].shape[0])
+            if self.saved else 0
+        )
+        for r in range(num_replicas):
+            rng = np.random.default_rng(self.seed)
+            outdir = os.path.join(self.outdir, f"replica{r}", "compression")
+            os.makedirs(outdir, exist_ok=True)
+            for rec in self.saved:
+                data = np.load(rec["path"])
+                f = int(data["feature"])
+                start = int(np.sum(dims[:f]))
+                raw_f = (raw_all if raw_all is not None else bundle.x_valid)[
+                    :, start : start + dims[f]
+                ]
+                fname = os.path.join(
+                    outdir,
+                    f"feature_{f}_log10beta_"
+                    f"{np.log10(data['betas'][r]):.3f}.png",
+                )
+                save_compression_matrix(
+                    data["mus"][r], data["logvars"][r], raw_f, fname,
+                    feature_label=(bundle.feature_labels[f]
+                                   if bundle.feature_labels else None),
+                    max_number_to_display=self.max_number_to_display,
+                    rng=rng,
+                )
+                paths.append(fname)
+        return paths

@@ -26,19 +26,22 @@ import functools
 from dib_tpu.ops.info_bounds import mi_sandwich_bounds, mi_sandwich_from_params
 
 
-@functools.lru_cache(maxsize=32)
-def _all_features_bounds_fn(model, batch_size: int, num_batches: int,
-                            row_block: int | None):
-    """Jitted (params, rows, key) -> ([F] lower, [F] upper) for a model with
-    a vmapped all-features ``encode``; bounds averaged over ``num_batches``
-    evaluation batches drawn with replacement from ``rows``. Cached on the
-    (hashable) flax module so every hook instance measuring the same model
-    shares one compiled program. ``row_block`` chunks the [B, B] log-density
-    rows — the feature vmap holds F matrices live at once (F x B^2 floats),
-    so large F x batch_size combinations need it to fit memory."""
+def all_features_bounds_kernel(model, batch_size: int, num_batches: int,
+                               row_block: int | None):
+    """UNJITTED (params, rows, key) -> ([F] lower, [F] upper) kernel.
 
-    @jax.jit
-    def fn(params, rows, key):
+    The single source of truth for the all-channels MI measurement: the
+    serial hook jits it directly (``_all_features_bounds_fn``) and the
+    sweep hook vmaps it over the replica axis
+    (``dib_tpu/parallel/sweep_hooks.py``) — one body, so the two paths
+    cannot silently diverge. Bounds are averaged over ``num_batches``
+    evaluation batches drawn with replacement from ``rows``; ``row_block``
+    chunks the [B, B] log-density rows (the feature vmap holds F matrices
+    live at once — F x B^2 floats — so large F x batch_size combinations
+    need it to fit memory).
+    """
+
+    def kernel(params, rows, key):
         n = rows.shape[0]
 
         def one_batch(_, k):
@@ -60,7 +63,18 @@ def _all_features_bounds_fn(model, batch_size: int, num_batches: int,
         )
         return lower.mean(0), upper.mean(0)
 
-    return fn
+    return kernel
+
+
+@functools.lru_cache(maxsize=32)
+def _all_features_bounds_fn(model, batch_size: int, num_batches: int,
+                            row_block: int | None):
+    """Jitted ``all_features_bounds_kernel``, cached on the (hashable) flax
+    module so every hook instance measuring the same model shares one
+    compiled program."""
+    return jax.jit(
+        all_features_bounds_kernel(model, batch_size, num_batches, row_block)
+    )
 
 
 class Every:
@@ -107,6 +121,12 @@ class InfoPerFeatureHook:
         self.records: list[dict] = []
         self._batched_fn = None
         self._device_rows = None    # x_valid uploaded once, reused per call
+        self._cache_for = None      # STRONG refs (model, bundle) the caches
+                                    # were built for — holding the objects
+                                    # (not ids) makes invalidation immune to
+                                    # CPython id reuse, and sweep replica
+                                    # views sharing one model/bundle keep
+                                    # the caches warm across checkpoints
 
     def __call__(self, trainer, state, epoch: int):
         # Note: batch size deliberately NOT capped at the dataset size —
@@ -115,6 +135,15 @@ class InfoPerFeatureHook:
         # information even for repeated x, and large batches keep the
         # LOO bound tight even on tiny datasets (e.g. binary features).
         model = getattr(trainer, "model", None)
+        bundle = getattr(trainer, "bundle", None)
+        if (self._cache_for is None
+                or model is not self._cache_for[0]
+                or bundle is not self._cache_for[1]):
+            # Reusing one hook across trainers/bundles must not measure
+            # bounds on a stale compiled fn or stale validation rows.
+            self._batched_fn = None
+            self._device_rows = None
+            self._cache_for = (model, bundle)
         if hasattr(model, "encode"):
             if self._batched_fn is None:
                 # shared across hook instances (e.g. 8 sweep-replica hooks

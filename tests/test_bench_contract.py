@@ -55,3 +55,71 @@ def test_cache_file_is_committed_and_coherent():
     assert cached["metric"] == "amorphous_set_transformer_beta_sweep_projected"
     assert cached["value"] > 0
     assert cached["vs_baseline"] == pytest.approx(cached["value"] / 10.0, rel=0.01)
+    # The cache must carry the CURRENT writer's MFU semantics — a cache from
+    # an older bench.py (different keys / HLO-based headline mfu) would be
+    # republished verbatim on every degraded run (code review round 3).
+    assert "flops_per_step_model" in cached
+    sys.path.insert(0, REPO)
+    import bench
+    from dib_tpu.models import PerParticleDIBModel
+
+    model = PerParticleDIBModel(num_particles=50, compute_dtype="bfloat16")
+    expect = bench.analytic_model_flops_per_step(model, bench.BENCH_BATCH_SIZE)
+    assert cached["flops_per_step_model"] == pytest.approx(expect, rel=1e-6)
+    peak = bench.peak_tflops_for(cached["device_kind"])
+    assert cached["mfu"] == pytest.approx(
+        expect * cached["steps_per_s"] / 1e12 / peak, abs=2e-4
+    )
+
+
+def test_analytic_model_flops_are_plausible():
+    # The headline MFU divides analytic model matmul FLOPs by chip peak; a
+    # silent unit slip (per-particle vs per-batch, fwd vs fwd+bwd) would be
+    # invisible in the JSON, so pin the magnitude for the paper config.
+    sys.path.insert(0, REPO)
+    import bench
+    from dib_tpu.models import PerParticleDIBModel
+
+    model = PerParticleDIBModel(num_particles=50)
+    flops = bench.analytic_model_flops_per_step(model, bench.BENCH_BATCH_SIZE)
+    # 6 blocks x 12 heads x key_dim 128 over 50 particles at batch 32,
+    # fwd+bwd: order 10 GFLOP. Bracket generously but exclude the failure
+    # modes above (they are each >= 3x off).
+    assert 5e9 < flops < 1e11, flops
+    assert bench.analytic_model_flops_per_step(model, 64) == pytest.approx(
+        2.0 * flops, rel=1e-6
+    )
+
+
+def test_save_cache_refreshes_when_env_matches_defaults(tmp_path, monkeypatch):
+    # ADVICE round 2: exporting the DEFAULT values must not block the cache
+    # refresh — only effectively non-default configurations may.
+    import importlib
+
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    import bench
+
+    env_vars = ("DIB_BENCH_REPLICAS", "DIB_BENCH_MEASURE_EPOCHS",
+                "DIB_BENCH_STEPS_PER_EPOCH")
+    try:
+        monkeypatch.setenv("DIB_BENCH_REPLICAS", "8")
+        monkeypatch.setenv("DIB_BENCH_MEASURE_EPOCHS", "6")
+        monkeypatch.setenv("DIB_BENCH_STEPS_PER_EPOCH", "50")
+        bench = importlib.reload(bench)   # re-read env into module constants
+        monkeypatch.setattr(bench, "CACHE_PATH", str(tmp_path / "cache.json"))
+        bench.save_cache({"metric": bench.METRIC, "value": 1.0})
+        assert os.path.exists(bench.CACHE_PATH)
+
+        monkeypatch.setenv("DIB_BENCH_REPLICAS", "2")
+        bench = importlib.reload(bench)
+        monkeypatch.setattr(bench, "CACHE_PATH", str(tmp_path / "cache2.json"))
+        bench.save_cache({"metric": bench.METRIC, "value": 1.0})
+        assert not os.path.exists(bench.CACHE_PATH)
+    finally:
+        # monkeypatch teardown restores the env but NOT the reloaded module:
+        # restore it here even when an assertion above fails, or the stale
+        # constants (NUM_REPLICAS=2) cascade into later tests.
+        for var in env_vars:
+            monkeypatch.delenv(var, raising=False)
+        importlib.reload(bench)

@@ -38,6 +38,46 @@ def test_initialize_warns_on_malformed_cluster_spec(monkeypatch):
     assert any("uncoordinated" in str(w.message) for w in caught)
 
 
+def test_initialize_quiet_on_reworded_coordinator_error(monkeypatch):
+    # A JAX upgrade may reword the "coordinator_address should be defined"
+    # internal message; with no cluster env vars set, any coordinator_address
+    # complaint is still the benign single-host outcome and must stay quiet.
+    import warnings as warnings_mod
+
+    import dib_tpu.parallel.multihost as mh
+
+    for var in mh._CLUSTER_ENV_VARS:
+        monkeypatch.delenv(var, raising=False)
+
+    def boom():
+        raise ValueError("coordinator_address must be set for multi-process")
+
+    monkeypatch.setattr(jax.distributed, "initialize", boom)
+    with warnings_mod.catch_warnings(record=True) as caught:
+        warnings_mod.simplefilter("always")
+        assert mh.initialize() is False
+    assert not caught
+
+
+def test_initialize_warns_on_coordinator_error_with_cluster_env(monkeypatch):
+    # Same coordinator_address complaint, but cluster config IS present in
+    # the environment: that is a malformed spec on a real pod — warn loudly.
+    import warnings as warnings_mod
+
+    import dib_tpu.parallel.multihost as mh
+
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.1:1234")
+
+    def boom():
+        raise ValueError("coordinator_address must be set for multi-process")
+
+    monkeypatch.setattr(jax.distributed, "initialize", boom)
+    with warnings_mod.catch_warnings(record=True) as caught:
+        warnings_mod.simplefilter("always")
+        assert mh.initialize() is False
+    assert any("uncoordinated" in str(w.message) for w in caught)
+
+
 def test_process_local_batch_shards_rows(rng):
     mesh = make_sweep_mesh(1, 8)
     sharding = NamedSharding(mesh, P(None, DATA_AXIS))

@@ -208,15 +208,17 @@ def test_info_plane_trajectory_parity_boolean(reference):
     # 4. quantitative beta-matched parity (VERDICT round 1, item 5). Two
     #    regimes at each matched beta checkpoint:
     #    - CONSTRAINED (the anneal has started compressing, KL <= 50 bits):
-    #      total KL within a factor of 2 (0.75-bit absolute floor where the
-    #      channel is nearly crushed). Measured agreement is 1.0-1.6x; the
-    #      factor-2 bound is margin for independent inits/RNG.
+    #      total KL within a factor of 1.75 (0.75-bit absolute floor where
+    #      the channel is nearly crushed). Measured agreement is 1.0-1.6x;
+    #      the bound is ratcheted to that envelope (VERDICT round 2, item 8)
+    #      with a small margin for independent inits/RNG.
     #    - WIDE-OPEN (early anneal, both > 50 bits): KL is initialization
     #      noise — the reference itself varies ~1.7x run to run there — so
     #      only a both-channels-wide-open sanity check applies.
     #    The RECOVERED TASK LOSS (info-plane y-axis, loss minus beta*KL,
-    #    un-mixed the reference's way) must match within 0.25 bits at EVERY
-    #    checkpoint (measured: <= 0.16).
+    #    un-mixed the reference's way) must match within 0.2 bits at EVERY
+    #    checkpoint (measured: <= 0.16; ratcheted from 0.25, VERDICT round
+    #    2, item 8).
     ours_task_bits = np.asarray(ours.loss)
     for frac in (0.1, 0.25, 0.4, 0.5, 0.6, 0.75, 0.9, 1.0):
         e = min(pre + int(frac * anneal), pre + anneal - 1)
@@ -225,12 +227,12 @@ def test_info_plane_trajectory_parity_boolean(reference):
         if lo > 50.0:      # wide open: init noise dominates
             pass
         else:
-            assert hi - lo < 0.75 or hi < 2.0 * lo, (
+            assert hi - lo < 0.75 or hi < 1.75 * lo, (
                 f"KL at anneal {frac:.0%} (beta {betas[e]:.2e}): reference "
-                f"{a:.2f} vs ours {b:.2f} bits (> 2x apart)"
+                f"{a:.2f} vs ours {b:.2f} bits (> 1.75x apart)"
             )
         ta, tb = ref_task_bits[e], ours_task_bits[e]
-        assert abs(ta - tb) < 0.25, (
+        assert abs(ta - tb) < 0.2, (
             f"recovered task loss at anneal {frac:.0%}: reference {ta:.3f} "
             f"vs ours {tb:.3f} bits"
         )
