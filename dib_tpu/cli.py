@@ -97,6 +97,12 @@ def build_parser() -> argparse.ArgumentParser:
                         help="Mesh beta-axis size (default: all devices).")
     parser.add_argument("--mesh_data", type=int, default=None,
                         help="Mesh data-axis size.")
+    parser.add_argument("--checkpoint_dir", type=str, default="",
+                        help="Enable Orbax checkpoint/resume (serial AND "
+                             "sweep paths): save every --checkpoint_frequency "
+                             "epochs and auto-resume when the dir holds a "
+                             "checkpoint.")
+    parser.add_argument("--checkpoint_frequency", type=int, default=500)
     return parser
 
 
@@ -187,6 +193,8 @@ def run(args) -> dict:
         cadences.append(args.save_compression_matrices_frequency)
     if args.info_bounds_frequency:
         cadences.append(args.info_bounds_frequency)
+    if args.checkpoint_dir:
+        cadences.append(args.checkpoint_frequency)
     hook_every = int(np.gcd.reduce(cadences)) if cadences else 0
 
     def make_hooks(subdir: str):
@@ -237,7 +245,35 @@ def run(args) -> dict:
 
         hooks = [PerReplicaHook(make_replica_hook)] if cadences else []
         keys = jax.random.split(jax.random.key(args.seed), len(ends))
-        states, records = sweep.fit(keys, hooks=hooks, hook_every=hook_every)
+        resume_states = resume_histories = None
+        remaining = None
+        if args.checkpoint_dir:
+            # Same crash-resume contract as the serial branch below;
+            # DIBCheckpointer handles stacked [R, ...] sweep leaves.
+            from dib_tpu.train.checkpoint import CheckpointHook, DIBCheckpointer
+            from dib_tpu.train.history import history_extend
+
+            ckpt = DIBCheckpointer(args.checkpoint_dir)
+            hooks.append(Every(args.checkpoint_frequency, CheckpointHook(ckpt)))
+            if ckpt.latest_step is not None:
+                resume_states, resume_histories, keys = ckpt.restore(
+                    sweep, chunk_size=hook_every
+                )
+                done = int(np.max(jax.device_get(resume_states.epoch)))
+                remaining = max(config.num_epochs - done, 0)
+                capacity = resume_histories["beta"].shape[-1]
+                cursor = int(np.max(jax.device_get(resume_histories["cursor"])))
+                if cursor + remaining > capacity:
+                    resume_histories = history_extend(
+                        resume_histories, cursor + remaining - capacity
+                    )
+                summary["resumed_from_epoch"] = done
+                print(f"resuming sweep from checkpoint at epoch {done} "
+                      f"({remaining} to go)", file=sys.stderr)
+        states, records = sweep.fit(keys, num_epochs=remaining, hooks=hooks,
+                                    hook_every=hook_every,
+                                    states=resume_states,
+                                    histories=resume_histories)
         for r, record in enumerate(records):
             info_hook_r = replica_info_hooks.get(r)
             if info_hook_r is not None and info_hook_r.records:
@@ -265,8 +301,43 @@ def run(args) -> dict:
     else:
         trainer = DIBTrainer(model, bundle, config, y_encoder=y_encoder)
         hooks, info_hook = make_hooks(outdir)
-        state, history = trainer.fit(jax.random.key(args.seed), hooks=hooks,
-                                     hook_every=hook_every)
+        fit_key = jax.random.key(args.seed)
+        resume_state = resume_history = None
+        remaining = None
+        if args.checkpoint_dir:
+            # Crash-resumable long runs (flaky-device insurance, SURVEY
+            # section 5 checkpoint/resume through the CLI surface): save at
+            # --checkpoint_frequency; when the directory already holds a
+            # checkpoint, continue its trajectory (same PRNG chain + chunk
+            # grid — DIBCheckpointer enforces the chunk-size contract).
+            from dib_tpu.train.checkpoint import CheckpointHook, DIBCheckpointer
+
+            ckpt = DIBCheckpointer(args.checkpoint_dir)
+            hooks.append(Every(args.checkpoint_frequency, CheckpointHook(ckpt)))
+            if ckpt.latest_step is not None:
+                resume_state, resume_history, fit_key = ckpt.restore(
+                    trainer, chunk_size=hook_every
+                )
+                done = int(jax.device_get(resume_state.epoch))
+                remaining = max(config.num_epochs - done, 0)
+                # A longer continuation than the original budget needs a
+                # grown record buffer (the checkpoint preallocated only the
+                # original horizon).
+                from dib_tpu.train.history import history_extend
+
+                capacity = resume_history["beta"].shape[-1]
+                cursor = int(jax.device_get(resume_history["cursor"]))
+                if cursor + remaining > capacity:
+                    resume_history = history_extend(
+                        resume_history, cursor + remaining - capacity
+                    )
+                summary["resumed_from_epoch"] = done
+                print(f"resuming from checkpoint at epoch {done} "
+                      f"({remaining} to go)", file=sys.stderr)
+        state, history = trainer.fit(fit_key, num_epochs=remaining,
+                                     hooks=hooks, hook_every=hook_every,
+                                     state=resume_state,
+                                     history=resume_history)
         bits = history.to_bits(bundle.loss_is_info_based)
         path = save_distributed_info_plane(
             bits.kl_per_feature, bits.loss, outdir, entropy_y=entropy_y)

@@ -149,6 +149,9 @@ class SweepCompressionHook:
         if sweep is not self._cache_for:
             self._fns.clear()
             self._feature_rows.clear()
+            # a new sweep is a new run record: keep render() from mixing
+            # replica counts/schemes across sweeps
+            self.saved.clear()
             self._cache_for = sweep
         cfg = sweep.base.config
         starts = np.asarray(jax.device_get(sweep.beta_starts), np.float64)

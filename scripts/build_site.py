@@ -176,6 +176,44 @@ def radial_shell_figure() -> None:
     shutil.rmtree(os.path.join(ASSETS, "_shell_tmp"))
 
 
+def compression_anneal_gif(
+    compression_dir: str | None = None, feature: int = 0
+) -> None:
+    """Animate one channel's compression schemes across the beta anneal.
+
+    Frames come from a north-star run's per-checkpoint scheme PNGs (the
+    sweep instrumentation output, ``SweepCompressionHook.render``); the
+    committed ``site/assets/compression_anneal.gif`` was built from the
+    measured run behind ``NORTHSTAR_RUN.json`` (replica 7). Skipped with a
+    note when no run directory is present — regenerate the run first with
+    ``scripts/northstar_run.py``.
+    """
+    import glob as _glob
+    import re as _re
+
+    from PIL import Image
+
+    compression_dir = compression_dir or os.path.join(
+        REPO, "northstar_out", "replica7", "compression"
+    )
+    paths = _glob.glob(
+        os.path.join(compression_dir, f"feature_{feature}_log10beta_*.png")
+    )
+    if not paths:
+        print(f"  (no schemes under {compression_dir}; run "
+              "scripts/northstar_run.py first — keeping committed gif)")
+        return
+    paths.sort(key=lambda p: float(
+        _re.search(r"log10beta_(-?[\d.]+)\.png", p).group(1)
+    ))
+    frames = [Image.open(p).convert("P", palette=Image.ADAPTIVE)
+              for p in paths]
+    frames[0].save(
+        os.path.join(ASSETS, "compression_anneal.gif"),
+        save_all=True, append_images=frames[1:], duration=350, loop=0,
+    )
+
+
 def main() -> None:
     os.makedirs(ASSETS, exist_ok=True)
     for name, fn in [
@@ -184,6 +222,7 @@ def main() -> None:
         ("compression", compression_matrices),
         ("radial shells", radial_shell_figure),
         ("glass probe map", glass_probe_map),
+        ("compression anneal gif", compression_anneal_gif),
     ]:
         print(f"building {name} figure...", flush=True)
         fn()

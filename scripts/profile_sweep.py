@@ -96,7 +96,7 @@ def main() -> int:
     best_s = min(plain_s)
     steps_per_s = sweep_steps / best_s
     model_flops = bench.analytic_model_flops_per_step(model, config.batch_size)
-    peak = bench.peak_tflops_for(devices[0].device_kind) or float("nan")
+    peak = bench.peak_tflops_for(devices[0].device_kind)  # None if unknown
     achieved = model_flops * steps_per_s / 1e12
 
     # Roofline attribution inputs: bytes moved per step (params + opt state
@@ -107,7 +107,11 @@ def main() -> int:
     # Steady state per replica step reads params, writes grads+opt updates:
     # >= 3 accesses x 4 bytes (f32 master params).
     param_bytes_per_step = 3 * 4 * n_params
-    hbm_gbps = 819.0 if "v5" in devices[0].device_kind.lower() else None
+    # Public per-chip HBM bandwidth (GB/s); ORDER matters (v5p before v5).
+    hbm_peaks = (("v6", 1640.0), ("v5p", 2765.0), ("v5", 819.0),
+                 ("v4", 1228.0), ("v3", 900.0), ("v2", 700.0))
+    kind = devices[0].device_kind.lower()
+    hbm_gbps = next((gbps for key, gbps in hbm_peaks if key in kind), None)
 
     summary = {
         "device_kind": devices[0].device_kind,
@@ -123,8 +127,9 @@ def main() -> int:
         "steps_per_s": round(steps_per_s, 1),
         "model_flops_per_step": model_flops,
         "achieved_tflops": round(achieved, 2),
-        "peak_tflops": peak,
-        "mfu": round(achieved / peak, 4),
+        "peak_tflops": peak,                # None on unlisted device kinds —
+        "mfu": (round(achieved / peak, 4)   # NaN would break strict JSON
+                if peak else None),
         "params_per_replica": n_params,
         "param_traffic_gb_per_s": round(
             param_bytes_per_step * steps_per_s / 1e9, 2

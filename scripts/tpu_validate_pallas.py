@@ -35,18 +35,37 @@ def main() -> int:
     rng = np.random.default_rng(0)
     checks = {}
 
+    # Lowering-correctness checks run at matmul precision 'highest' (f32
+    # accumulation through the MXU): at the DEFAULT precision the MXU
+    # computes f32 matmuls through bf16 passes and the Pallas kernel and the
+    # XLA einsum oracle round differently (~2e-3 abs — checked separately,
+    # loose tolerance), which would mask real lowering bugs at tight tol.
     # ---- flash attention vs dense oracle, compiled lowering ----
-    for seq, block in [(64, 32), (50, 16), (37, 32), (1024, 128)]:
-        q, k, v = (
-            jnp.asarray(rng.standard_normal((2, seq, 3, 16)), jnp.float32)
-            for _ in range(3)
-        )
-        got = flash_self_attention(q, k, v, block_q=block, block_k=block,
-                                   interpret=False)
-        want = dense_self_attention(q, k, v)
-        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
-                                   rtol=2e-4, atol=2e-4)
-        checks[f"flash_fwd_seq{seq}_block{block}"] = "ok"
+    with jax.default_matmul_precision("highest"):
+        for seq, block in [(64, 32), (50, 16), (37, 32), (1024, 128)]:
+            q, k, v = (
+                jnp.asarray(rng.standard_normal((2, seq, 3, 16)), jnp.float32)
+                for _ in range(3)
+            )
+            got = flash_self_attention(q, k, v, block_q=block, block_k=block,
+                                       interpret=False)
+            want = dense_self_attention(q, k, v)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       rtol=2e-4, atol=2e-4)
+            checks[f"flash_fwd_seq{seq}_block{block}"] = "ok"
+
+    # default-precision agreement (what production runs use): bf16-pass MXU
+    # rounding differs between the two implementations — loose tolerance
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((2, 64, 3, 16)), jnp.float32)
+        for _ in range(3)
+    )
+    got = flash_self_attention(q, k, v, block_q=32, block_k=32,
+                               interpret=False)
+    want = dense_self_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-2, atol=2e-2)
+    checks["flash_fwd_default_precision"] = "ok (loose tol: bf16 MXU passes)"
 
     # large scores stay finite (the flagship failure mode)
     q, k, v = (
@@ -75,31 +94,32 @@ def main() -> int:
     assert all(bool(jnp.isfinite(g).all()) for g in grads)
 
     # dense-oracle gradient agreement at a checkable size
-    small = jnp.asarray(rng.standard_normal((2, 128, 2, 16)), jnp.float32)
+    with jax.default_matmul_precision("highest"):
+        small = jnp.asarray(rng.standard_normal((2, 128, 2, 16)), jnp.float32)
 
-    def loss_flash(q):
-        return flash_self_attention(q, small, small, block_q=64, block_k=64,
-                                    interpret=False).sum()
+        def loss_flash(q):
+            return flash_self_attention(q, small, small, block_q=64,
+                                        block_k=64, interpret=False).sum()
 
-    def loss_dense(q):
-        return dense_self_attention(q, small, small).sum()
+        def loss_dense(q):
+            return dense_self_attention(q, small, small).sum()
 
-    g_flash = jax.grad(loss_flash)(small)
-    g_dense = jax.grad(loss_dense)(small)
-    np.testing.assert_allclose(np.asarray(g_flash), np.asarray(g_dense),
-                               rtol=2e-3, atol=2e-3)
-    checks["flash_bwd_matches_dense"] = "ok"
+        g_flash = jax.grad(loss_flash)(small)
+        g_dense = jax.grad(loss_dense)(small)
+        np.testing.assert_allclose(np.asarray(g_flash), np.asarray(g_dense),
+                                   rtol=2e-3, atol=2e-3)
+        checks["flash_bwd_matches_dense"] = "ok"
 
-    # ---- tiled density kernel vs the XLA reference ----
-    for b, d, tile in [(256, 8, 128), (1024, 32, 256)]:
-        u = jnp.asarray(rng.standard_normal((b, d)), jnp.float32)
-        mus = jnp.asarray(rng.standard_normal((b, d)), jnp.float32)
-        lvs = jnp.asarray(rng.standard_normal((b, d)) * 0.3, jnp.float32)
-        got = gaussian_log_density_mat_pallas(u, mus, lvs, interpret=False)
-        want = gaussian_log_density_mat(u, mus, lvs)
-        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
-                                   rtol=1e-4, atol=1e-4)
-        checks[f"density_b{b}_d{d}"] = "ok"
+        # ---- tiled density kernel vs the XLA reference ----
+        for b, d, tile in [(256, 8, 128), (1024, 32, 256)]:
+            u = jnp.asarray(rng.standard_normal((b, d)), jnp.float32)
+            mus = jnp.asarray(rng.standard_normal((b, d)), jnp.float32)
+            lvs = jnp.asarray(rng.standard_normal((b, d)) * 0.3, jnp.float32)
+            got = gaussian_log_density_mat_pallas(u, mus, lvs, interpret=False)
+            want = gaussian_log_density_mat(u, mus, lvs)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       rtol=1e-4, atol=1e-4)
+            checks[f"density_b{b}_d{d}"] = "ok"
 
     commit = subprocess.run(
         ["git", "rev-parse", "--short", "HEAD"],

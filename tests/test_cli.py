@@ -142,3 +142,41 @@ def test_bare_string_protocols_wrapped(monkeypatch, tmp_path):
     )
     assert calls == ["GradualQuench"]
     assert set(result) == {"GradualQuench"}
+
+
+def test_cli_checkpoint_resume(tmp_path):
+    """--checkpoint_dir saves on the cadence and a re-invocation continues
+    the run instead of restarting (crash-resumable long runs; SURVEY
+    section 5 checkpoint/resume exposed through the CLI)."""
+    ckpt = str(tmp_path / "ckpt")
+    args = make_args(tmp_path, "--checkpoint_dir", ckpt,
+                     "--checkpoint_frequency", "5")
+    summary1 = run(args)
+    assert "resumed_from_epoch" not in summary1
+    assert os.path.isdir(ckpt) and os.listdir(ckpt)
+
+    # second invocation with a LONGER budget resumes at the saved epoch
+    args2 = make_args(tmp_path, "--checkpoint_dir", ckpt,
+                      "--checkpoint_frequency", "5",
+                      "--number_annealing_epochs", "20")
+    summary2 = run(args2)
+    assert summary2["resumed_from_epoch"] == 15
+
+
+def test_cli_sweep_checkpoint_resume(tmp_path):
+    """--checkpoint_dir on the SWEEP path: stacked [R, ...] checkpoint saved
+    on the cadence; a re-invocation with a longer budget resumes every
+    replica at the saved epoch (code review round 3: the flag must not be
+    silently inert on sweeps)."""
+    ckpt = str(tmp_path / "ckpt")
+    base = ["--sweep_beta_ends", "0.1", "1.0",
+            "--checkpoint_dir", ckpt, "--checkpoint_frequency", "5"]
+    summary1 = run(make_args(tmp_path, *base))
+    assert "resumed_from_epoch" not in summary1
+    assert summary1["num_replicas"] == 2
+    assert os.path.isdir(ckpt) and os.listdir(ckpt)
+
+    summary2 = run(make_args(tmp_path, *base,
+                             "--number_annealing_epochs", "20"))
+    assert summary2["resumed_from_epoch"] == 15
+    assert len(summary2["final_val_loss"]) == 2
