@@ -214,6 +214,35 @@ def compression_anneal_gif(
     )
 
 
+def info_map_anneal_gif(maps_dir: str | None = None,
+                        size: tuple[int, int] = (900, 400)) -> None:
+    """Animate the probe-grid info maps of a full protocol run.
+
+    Frames come from ``run_amorphous_protocols`` output
+    (``info_map_step{N}.png``); the committed gif was built from the
+    25k-step GradualQuench TPU run behind ``AMORPHOUS_PROTOCOLS.json``.
+    Skipped with a note when no run directory is present.
+    """
+    import glob as _glob
+    import re as _re
+
+    from PIL import Image
+
+    maps_dir = maps_dir or os.path.join(REPO, "amorphous_out", "GradualQuench")
+    paths = _glob.glob(os.path.join(maps_dir, "info_map_step*.png"))
+    if not paths:
+        print(f"  (no info maps under {maps_dir}; run "
+              "scripts/amorphous_protocols_run.py first — keeping committed gif)")
+        return
+    paths.sort(key=lambda p: int(_re.search(r"step(\d+)\.png", p).group(1)))
+    frames = [Image.open(p).resize(size, Image.LANCZOS)
+              .convert("P", palette=Image.ADAPTIVE) for p in paths]
+    frames[0].save(
+        os.path.join(ASSETS, "info_map_anneal.gif"),
+        save_all=True, append_images=frames[1:], duration=280, loop=0,
+    )
+
+
 def main() -> None:
     os.makedirs(ASSETS, exist_ok=True)
     for name, fn in [
@@ -223,6 +252,7 @@ def main() -> None:
         ("radial shells", radial_shell_figure),
         ("glass probe map", glass_probe_map),
         ("compression anneal gif", compression_anneal_gif),
+        ("info map anneal gif", info_map_anneal_gif),
     ]:
         print(f"building {name} figure...", flush=True)
         fn()
