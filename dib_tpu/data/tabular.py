@@ -280,6 +280,51 @@ def fetch_diabetes(data_path: str = "./data/", seed: int = 1337, **_) -> Dataset
     )
 
 
+@register_dataset("breast_cancer")
+def fetch_breast_cancer(data_path: str = "./data/", seed: int = 1337, **_) -> DatasetBundle:
+    """Wisconsin diagnostic breast cancer: 569 real tumors, 30 morphology
+    features, benign/malignant target (UCI; public domain, ships with
+    scikit-learn). Like ``diabetes``, the committed ``data/breast_cancer.csv``
+    (``scripts/export_sklearn_datasets.py``) makes this a guaranteed-REAL
+    end-to-end path in an egress-free environment — a binary task whose BCE
+    loss is info-based, so the info plane reads in bits against H(Y)
+    (reference registry shape: ``data.py:372-406``)."""
+
+    def load(path):
+        f = os.path.join(path, "breast_cancer.csv")
+        if not os.path.exists(f):
+            raise FileNotFoundError(f)
+        return pd.read_csv(f)   # already has a 'target' column
+
+    return _local_or_synthetic(
+        "breast_cancer", data_path, load,
+        dict(num_rows=569, num_features=30, problem="binary", seed=seed),
+        "binary", seed=seed,
+    )
+
+
+@register_dataset("wine_recognition")
+def fetch_wine_recognition(data_path: str = "./data/", seed: int = 1337, **_) -> DatasetBundle:
+    """Wine recognition (Forina 1991): 178 real wines, 13 chemical analyses,
+    3 cultivars (UCI; ships with scikit-learn — distinct from the ``wine``
+    entry, which is the UCI wine-QUALITY file the reference's registry names).
+    Committed as ``data/wine_recognition.csv`` so the multiclass sparse-CE
+    path also has a guaranteed-real dataset."""
+
+    def load(path):
+        f = os.path.join(path, "wine_recognition.csv")
+        if not os.path.exists(f):
+            raise FileNotFoundError(f)
+        return pd.read_csv(f)
+
+    return _local_or_synthetic(
+        "wine_recognition", data_path, load,
+        dict(num_rows=178, num_features=13, problem="multiclass", seed=seed,
+             num_classes=3),
+        "multiclass", seed=seed,
+    )
+
+
 @register_dataset("bikeshare")
 def fetch_bikeshare(data_path: str = "./data/", seed: int = 1337, **_) -> DatasetBundle:
     def load(path):

@@ -1,5 +1,6 @@
 """dib_tpu.utils: profiling/tracing helpers."""
 
+from dib_tpu.utils.compile_cache import enable_persistent_cache
 from dib_tpu.utils.profiling import (
     PhaseTimer,
     device_trace,

@@ -1,0 +1,47 @@
+"""Persistent XLA compilation cache (cold-start killer).
+
+The tunneled v5e pays ~145 s of XLA compilation on every cold process
+(`BENCH_r03.json` ``compile_s``) while a warm persistent cache brings the
+same programs up in ~25 s.  Round 3 wired the cache only into
+``scripts/northstar_run.py``; this helper makes it the DEFAULT for every
+entry point (``bench.py``, the CLI, scripts) with one opt-out.
+
+Environment:
+  DIB_COMPILE_CACHE  cache directory; set to '' to disable. Default
+                     ``~/.cache/jax_comp_cache_tpu`` (the dir the round-3
+                     north-star runs populated).
+
+The JAX persistent cache keys on backend + program fingerprint, so CPU
+test runs and TPU runs coexist in one directory without collisions.
+"""
+
+from __future__ import annotations
+
+import os
+
+_DEFAULT_DIR = "~/.cache/jax_comp_cache_tpu"
+
+
+def enable_persistent_cache(path: str | None = None) -> str:
+    """Point JAX at a persistent compilation cache.
+
+    Returns the cache status for run artifacts: ``"off"`` (disabled),
+    ``"warm"`` (directory already holds entries), or ``"cold-populating"``
+    (first run; entries will be written for the next one).  Must be called
+    before the first jitted computation executes; calling it later leaves
+    already-compiled programs uncached but is harmless.
+    """
+    if path is None:
+        path = os.environ.get("DIB_COMPILE_CACHE", _DEFAULT_DIR)
+    if not path:
+        return "off"
+    path = os.path.expanduser(path)
+    import jax
+
+    had_entries = os.path.isdir(path) and bool(os.listdir(path))
+    jax.config.update("jax_compilation_cache_dir", path)
+    # Cache everything that took XLA real work; the default thresholds skip
+    # small programs, which is exactly the long tail the 1-core host feels.
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    return "warm" if had_entries else "cold-populating"

@@ -1,0 +1,44 @@
+"""Export scikit-learn's bundled public-domain datasets to committed CSVs.
+
+This environment has zero network egress, so the UCI files the reference's
+registry names (reference ``data.py:372-406``) cannot be downloaded — but
+several classic datasets SHIP with scikit-learn and are public domain, so
+their CSV exports can be committed to ``data/`` and loaded as REAL files
+(``bundle.extras['source'] == 'real'``).  Round 3 proved the pattern with
+``load_diabetes`` -> ``data/diabetes.csv``; this script generalizes it
+(VERDICT round 3 item 5):
+
+  - ``data/diabetes.csv``        load_diabetes (442 x 10, regression)
+  - ``data/breast_cancer.csv``   load_breast_cancer (569 x 30, binary)
+  - ``data/wine_recognition.csv``load_wine (178 x 13, 3-class)
+
+Idempotent: rewrites the CSVs from the sklearn distribution each run.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pandas as pd
+from sklearn.datasets import load_breast_cancer, load_diabetes, load_wine
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DATA = os.path.join(REPO, "data")
+
+
+def export(loader, filename: str, **kw) -> str:
+    ds = loader(**kw)
+    df = pd.DataFrame(ds.data, columns=[c.replace(" ", "_") for c in ds.feature_names])
+    df["target"] = ds.target
+    path = os.path.join(DATA, filename)
+    df.to_csv(path, index=False)
+    print(f"{path}: {df.shape[0]} rows x {df.shape[1] - 1} features")
+    return path
+
+
+if __name__ == "__main__":
+    os.makedirs(DATA, exist_ok=True)
+    # scaled=False: physiological units, matching the round-3 commit
+    export(load_diabetes, "diabetes.csv", scaled=False)
+    export(load_breast_cancer, "breast_cancer.csv")
+    export(load_wine, "wine_recognition.csv")

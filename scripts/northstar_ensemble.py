@@ -1,0 +1,149 @@
+"""North-star wall-clock as a DISTRIBUTION, not a sample.
+
+VERDICT round 3, weak item 1: `NORTHSTAR_RUN.json` measured 6.89 min but a
+same-config run earlier that day (`NORTHSTAR_BF16.json`) took 11.6 min — a
+1.7x spread with no contention record. On a tunneled, 1-core box a single
+sub-10-minute sample is not a claim; this driver runs the full instrumented
+north star (scripts/northstar_run.py) N times BACK TO BACK in fresh
+processes, records per-run wall-clocks together with host-contention
+markers (loadavg before/after, concurrent-python census), and commits the
+median + spread to ``NORTHSTAR_ENSEMBLE.json``.
+
+Each run is a fresh process so compile behavior is what a user sees
+(persistent XLA cache warm after the first run). Rendering of compression
+schemes is skipped (--no-render): it is presentation time, excluded from
+the headline ``value`` by construction.
+
+Run ALONE on the TPU box — the point is to measure an idle-host
+distribution; the script itself records whether the host was actually idle.
+
+    python scripts/northstar_ensemble.py [--runs 3] [--report NORTHSTAR_ENSEMBLE.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from dib_tpu.utils.compile_cache import _DEFAULT_DIR  # noqa: E402
+
+DEFAULT_CACHE = os.path.expanduser(os.environ.get("DIB_COMPILE_CACHE",
+                                                  _DEFAULT_DIR))
+
+
+def loadavg() -> list[float]:
+    with open("/proc/loadavg") as f:
+        return [float(x) for x in f.read().split()[:3]]
+
+
+def python_census() -> int:
+    """Other live python processes (contention witnesses), excluding self."""
+    try:
+        out = subprocess.run(["ps", "-eo", "pid,comm"], capture_output=True,
+                             text=True, timeout=10).stdout
+    except Exception:
+        return -1
+    me = os.getpid()
+    return sum(
+        1
+        for line in out.splitlines()[1:]
+        for pid, comm in [line.split(None, 1)]
+        if "python" in comm and int(pid) != me
+    )
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--runs", type=int, default=3)
+    parser.add_argument("--steps", type=int, default=25_000)
+    parser.add_argument("--outdir", default="northstar_ensemble_out")
+    parser.add_argument("--report", default="NORTHSTAR_ENSEMBLE.json")
+    parser.add_argument("--compile-cache", default=DEFAULT_CACHE)
+    parser.add_argument("--timeout", type=float, default=1800.0,
+                        help="per-run kill timeout (s); a hung tunnel must "
+                             "not wedge the ensemble")
+    args = parser.parse_args()
+
+    runs = []
+    for i in range(args.runs):
+        run_outdir = os.path.join(args.outdir, f"run{i}")
+        report_path = os.path.join(args.outdir, f"run{i}.json")
+        os.makedirs(run_outdir, exist_ok=True)
+        # a stale report from a previous ensemble invocation must never be
+        # ingested as this run's measurement
+        if os.path.exists(report_path):
+            os.unlink(report_path)
+        cmd = [
+            sys.executable, os.path.join(REPO, "scripts", "northstar_run.py"),
+            "--outdir", run_outdir,
+            "--steps", str(args.steps),
+            "--report", report_path,
+            "--no-render",
+            "--compile-cache", args.compile_cache,
+        ]
+        entry: dict = {
+            "run": i,
+            "load_1m_before": loadavg()[0],
+            "other_python_processes": python_census(),
+        }
+        print(f"run {i}: load={entry['load_1m_before']:.2f} "
+              f"census={entry['other_python_processes']}", file=sys.stderr)
+        t0 = time.time()
+        try:
+            proc = subprocess.run(cmd, timeout=args.timeout)
+            entry["returncode"] = proc.returncode
+        except subprocess.TimeoutExpired:
+            entry["returncode"] = None
+            entry["error"] = f"killed after {args.timeout:.0f}s"
+        entry["driver_wall_clock_s"] = round(time.time() - t0, 1)
+        entry["load_1m_after"] = loadavg()[0]
+        try:
+            with open(report_path) as f:
+                rep = json.load(f)
+            for key in ("value", "sweep_wall_clock_s", "measured_wall_clock_s",
+                        "compile_cache", "all_finite", "score_dtype",
+                        "device_kind", "final_total_kl_bits_per_replica"):
+                if key in rep:
+                    entry[key] = rep[key]
+        except (OSError, json.JSONDecodeError):
+            entry.setdefault("error", "no run report written")
+        runs.append(entry)
+        print(f"run {i}: {entry.get('value')} min "
+              f"(rc={entry['returncode']})", file=sys.stderr)
+
+    import statistics
+
+    values = sorted(e["value"] for e in runs if isinstance(e.get("value"), (int, float)))
+    median = round(statistics.median(values), 3) if values else None
+    report = {
+        "metric": "amorphous_set_transformer_beta_sweep_measured_ensemble",
+        "unit": "minutes",
+        "runs_requested": args.runs,
+        "runs_completed": len(values),
+        "per_run_minutes": [e.get("value") for e in runs],
+        "median_minutes": median,
+        "min_minutes": values[0] if values else None,
+        "max_minutes": values[-1] if values else None,
+        "spread_ratio": round(values[-1] / values[0], 3) if values else None,
+        "vs_baseline_median": round(median / 10.0, 4) if values else None,
+        "runs": runs,
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    with open(args.report, "w") as f:
+        json.dump(report, f, indent=1)
+        f.write("\n")
+    print(json.dumps({k: report[k] for k in
+                      ("median_minutes", "min_minutes", "max_minutes",
+                       "spread_ratio", "runs_completed")}))
+    return 0 if values else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

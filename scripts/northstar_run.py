@@ -65,15 +65,12 @@ def main() -> int:
 
     import jax
 
-    compile_cache = "cold"
-    if args.compile_cache:
-        had_entries = os.path.isdir(args.compile_cache) and bool(
-            os.listdir(args.compile_cache)
-        )
-        jax.config.update("jax_compilation_cache_dir", args.compile_cache)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-        compile_cache = "warm" if had_entries else "cold-populating"
+    from dib_tpu.utils.compile_cache import enable_persistent_cache
+
+    # '' keeps the historical explicit-opt-in semantics of this flag (maps
+    # to "off" in the shared helper; the report still says "cold")
+    status = enable_persistent_cache(args.compile_cache or "")
+    compile_cache = "cold" if status == "off" else status
 
     import numpy as np
 

@@ -28,6 +28,10 @@ jax.config.update("jax_platforms", "cpu")
 os.environ["JAX_COMPILATION_CACHE_DIR"] = "/root/.cache/jax_comp_cache_cpu"
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.2")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+# Tests that drive the CLI entry points (main()/workload_main()) must not
+# redirect the process-global cache config to the shared TPU cache dir —
+# the CPU dir above stays authoritative for the whole pytest process.
+os.environ["DIB_COMPILE_CACHE"] = ""
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

@@ -163,6 +163,50 @@ def test_cli_checkpoint_resume(tmp_path):
     assert summary2["resumed_from_epoch"] == 15
 
 
+def test_save_info_bounds_merges_on_resume(tmp_path):
+    """ADVICE round 3 (cli.py:281): a resumed run's info_bounds npz must
+    keep the pre-crash trajectory, not silently overwrite it."""
+    from dib_tpu.cli import _save_info_bounds
+
+    path = str(tmp_path / "info_bounds.npz")
+    _save_info_bounds(path, [2, 4], np.zeros((2, 3, 2)))
+    with np.load(path) as d:
+        assert d["epochs"].tolist() == [2, 4]
+        assert "resumed_from_epoch" not in d
+
+    # resumed segment starts after the crash point: earlier records prepended
+    _save_info_bounds(path, [6, 8], np.ones((2, 3, 2)), resumed_from=4)
+    with np.load(path) as d:
+        assert d["epochs"].tolist() == [2, 4, 6, 8]
+        assert int(d["resumed_from_epoch"]) == 4
+        np.testing.assert_array_equal(d["bounds_bits"][:2], 0.0)
+        np.testing.assert_array_equal(d["bounds_bits"][2:], 1.0)
+
+    # overlap (hook re-recorded epoch 4 post-resume): no duplicate epochs
+    _save_info_bounds(path, [4, 10], np.full((2, 3, 2), 2.0), resumed_from=2)
+    with np.load(path) as d:
+        assert d["epochs"].tolist() == [2, 4, 10]
+
+
+@pytest.mark.slow
+def test_cli_resume_preserves_info_bounds_trajectory(tmp_path):
+    """End-to-end: --info_bounds_frequency + checkpoint resume yields ONE
+    npz spanning both segments (ADVICE round 3)."""
+    ckpt = str(tmp_path / "ckpt")
+    base = ["--checkpoint_dir", ckpt, "--checkpoint_frequency", "5",
+            "--info_bounds_frequency", "5"]
+    run(make_args(tmp_path, *base))
+    first = np.load(tmp_path / "info_bounds.npz")["epochs"].tolist()
+    assert first == [5, 10, 15]
+
+    summary2 = run(make_args(tmp_path, *base,
+                             "--number_annealing_epochs", "20"))
+    assert summary2["resumed_from_epoch"] == 15
+    with np.load(tmp_path / "info_bounds.npz") as d:
+        assert d["epochs"].tolist() == [5, 10, 15, 20, 25]
+        assert int(d["resumed_from_epoch"]) == 15
+
+
 def test_cli_sweep_checkpoint_resume(tmp_path):
     """--checkpoint_dir on the SWEEP path: stacked [R, ...] checkpoint saved
     on the cadence; a re-invocation with a longer budget resumes every

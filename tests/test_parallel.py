@@ -278,12 +278,17 @@ def test_sweep_native_hooks_match_serial(bundle, tmp_path):
 
     schemes = sorted(glob.glob(str(tmp_path / "schemes" / "*.npz")))
     assert len(schemes) == 2 * 2                   # 2 checkpoints x 2 features
-    data = np.load(schemes[0])
-    r1_mus, _ = sweep.encode_feature(
-        states, 1, int(data["feature"]),
-        jnp.asarray(sweep.base.feature_data(int(data["feature"]))),
-    )
-    if int(data["epoch"]) == 4:                    # final-state scheme only
+    # final-state (epoch-4) schemes only: `states` holds the END params, so
+    # only those npzs can be compared against a fresh encode (ADVICE round 3:
+    # select them explicitly — lexical order puts epoch 2 first)
+    final_schemes = [p for p in schemes if int(np.load(p)["epoch"]) == 4]
+    assert len(final_schemes) == 2                 # one per feature
+    for path in final_schemes:
+        data = np.load(path)
+        r1_mus, _ = sweep.encode_feature(
+            states, 1, int(data["feature"]),
+            jnp.asarray(sweep.base.feature_data(int(data["feature"]))),
+        )
         np.testing.assert_allclose(data["mus"][1], np.asarray(r1_mus), rtol=1e-5)
     pngs = comp.render(bundle)
     assert len(pngs) == 2 * 2 * 2                  # x 2 replicas

@@ -167,3 +167,32 @@ def test_diabetes_committed_real_file():
     assert bundle.feature_labels[:4] == ["age", "sex", "bmi", "bp"]
     assert bundle.loss == "mse"
     assert np.isfinite(bundle.x_train).all()
+
+
+def test_breast_cancer_committed_real_file():
+    """Second committed-real registry entry (VERDICT round 3 item 5):
+    data/breast_cancer.csv via scripts/export_sklearn_datasets.py — covers
+    the BINARY (info-based BCE) loss on real data."""
+    repo_data = os.path.join(os.path.dirname(os.path.dirname(__file__)), "data")
+    bundle = get_dataset("breast_cancer", data_path=repo_data, seed=3)
+    assert bundle.extras["source"] == "real"
+    assert bundle.x_train.shape[0] + bundle.x_valid.shape[0] == 569
+    assert bundle.number_features == 30
+    assert bundle.loss == "bce" and bundle.loss_is_info_based
+    assert set(np.unique(bundle.y_train)) <= {0.0, 1.0}
+    assert np.isfinite(bundle.x_train).all()
+
+
+def test_wine_recognition_committed_real_file():
+    """Third committed-real registry entry (VERDICT round 3 item 5):
+    data/wine_recognition.csv — covers the MULTICLASS sparse-CE loss on
+    real data (distinct from 'wine', the UCI wine-quality file)."""
+    repo_data = os.path.join(os.path.dirname(os.path.dirname(__file__)), "data")
+    bundle = get_dataset("wine_recognition", data_path=repo_data, seed=3)
+    assert bundle.extras["source"] == "real"
+    assert bundle.x_train.shape[0] + bundle.x_valid.shape[0] == 178
+    assert bundle.number_features == 13
+    assert bundle.loss == "sparse_ce"
+    assert bundle.output_dimensionality == 3
+    assert set(np.unique(bundle.y_train)) <= {0, 1, 2}
+    assert np.isfinite(bundle.x_train).all()

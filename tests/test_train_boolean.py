@@ -131,6 +131,93 @@ def test_mi_hook_batched_matches_per_feature(trained):
         assert fast[f, 1] == pytest.approx(float(upper), abs=0.15)
 
 
+def test_permutation_batch_sampling_trains(small_circuit_bundle):
+    """batch_sampling='permutation' (one epoch-gather instead of per-step
+    gathers, VERDICT round 3 item 4a) must train equivalently: finite
+    history, same shapes, and a trajectory that actually differs from
+    replacement sampling (different batch order) while converging to a
+    comparable loss."""
+    import jax
+
+    bundle = small_circuit_bundle
+    model = DistributedIBModel(
+        feature_dimensionalities=tuple(bundle.feature_dimensionalities),
+        encoder_hidden=(16,), integration_hidden=(32,), output_dim=1,
+        embedding_dim=2,
+    )
+
+    def train(sampling):
+        config = TrainConfig(
+            learning_rate=3e-3, batch_size=16, beta_start=1e-4, beta_end=1e-4,
+            num_pretraining_epochs=40, num_annealing_epochs=0,
+            steps_per_epoch=3,          # 48 rows/epoch > 8-row dataset:
+            max_val_points=8,           # exercises the tiled-permutation path
+            batch_sampling=sampling,
+        )
+        _, history = DIBTrainer(model, bundle, config).fit(jax.random.key(0))
+        return history.to_bits()
+
+    perm, repl = train("permutation"), train("replacement")
+    assert np.isfinite(perm.loss).all() and np.isfinite(perm.kl_per_feature).all()
+    assert perm.loss.shape == repl.loss.shape
+    assert not np.allclose(perm.loss, repl.loss)       # different batch order
+    # both fit the tiny circuit to a similar level by the end
+    assert perm.loss[-5:].mean() < repl.loss[-5:].mean() + 0.2
+
+    with pytest.raises(ValueError, match="batch_sampling"):
+        config = TrainConfig(batch_sampling="bogus", num_pretraining_epochs=1,
+                             num_annealing_epochs=0, max_val_points=8)
+        DIBTrainer(model, bundle, config).fit(jax.random.key(0))
+
+
+def test_mi_hook_invalidates_cache_across_trainers(small_circuit_bundle):
+    """Regression (ADVICE round 2 / VERDICT round 3 item 6): one hook
+    instance reused across trainers with DIFFERENT bundles must re-upload
+    the new validation rows, not measure bounds on the first trainer's
+    cached device rows."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from dib_tpu.train.hooks import InfoPerFeatureHook, _all_features_bounds_fn
+
+    bundle_a = small_circuit_bundle
+    # same schema, different validation rows: the stale-cache bug would
+    # silently measure bundle_a's rows with trainer_b's params
+    bundle_b = dataclasses.replace(
+        bundle_a, x_valid=-np.asarray(bundle_a.x_valid)[:4]
+    )
+    model = DistributedIBModel(
+        feature_dimensionalities=tuple(bundle_a.feature_dimensionalities),
+        encoder_hidden=(16,), integration_hidden=(16,), output_dim=1,
+        embedding_dim=2,
+    )
+    config = TrainConfig(batch_size=8, num_pretraining_epochs=1,
+                         num_annealing_epochs=1, steps_per_epoch=1,
+                         max_val_points=8)
+    trainer_a = DIBTrainer(model, bundle_a, config)
+    trainer_b = DIBTrainer(model, bundle_b, config)
+    state_a, _ = trainer_a.fit(jax.random.key(0), num_epochs=1)
+    state_b, _ = trainer_b.fit(jax.random.key(1), num_epochs=1)
+
+    hook = InfoPerFeatureHook(evaluation_batch_size=64,
+                              number_evaluation_batches=2, seed=0)
+    hook(trainer_a, state_a, epoch=1)
+    hook(trainer_b, state_b, epoch=1)          # must invalidate cached rows
+
+    # replica-match the hook's key chain: first call consumed one split
+    key = jax.random.key(0)
+    key, _ = jax.random.split(key)
+    _, k_second = jax.random.split(key)
+    fn = _all_features_bounds_fn(model, 64, 2, None)
+    lower, upper = fn(state_b.params["model"]
+                      if "model" in state_b.params else state_b.params,
+                      jnp.asarray(bundle_b.x_valid), k_second)
+    expected = [(float(a), float(b)) for a, b in zip(lower, upper)]
+    assert hook.records[1]["bounds"] == pytest.approx(expected, abs=1e-6)
+
+
 @pytest.mark.slow
 def test_ib_mode_single_bottleneck(small_circuit_bundle):
     bundle = small_circuit_bundle.as_vanilla_ib()
