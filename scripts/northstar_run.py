@@ -94,7 +94,34 @@ def main() -> int:
         config.mi_eval_batch_size, config.mi_eval_batches
     )
 
+    class _CheckpointPhaseTimer:
+        """Per-checkpoint chunk-vs-instrumentation wall clocks (round 4:
+        the ensemble showed a 1.65x run-to-run spread on an idle host —
+        this records WHERE a slow run loses the time). ``pre`` runs as the
+        FIRST hook and blocks on the chunk's outputs, so its interval is
+        the 1250-step train chunk; ``post`` runs LAST, so its interval is
+        the measurement/pull work of the checkpoint."""
+
+        def __init__(self):
+            self.chunk_s: list = []
+            self.hook_s: list = []
+            self._t = time.time()
+
+        def pre(self, sweep, states, epoch):
+            jax.block_until_ready(states.params)
+            now = time.time()
+            self.chunk_s.append(round(now - self._t, 2))
+            self._t = now
+
+        def post(self, sweep, states, epoch):
+            now = time.time()
+            self.hook_s.append(round(now - self._t, 2))
+            self._t = now
+
+    timer = _CheckpointPhaseTimer()
+
     t0 = time.time()
+    timer._t = t0
     result = run_amorphous_sweep(
         key=args.seed,
         config=config,
@@ -103,7 +130,7 @@ def main() -> int:
         outdir=args.outdir,
         steps_per_epoch=args.steps_per_epoch,
         chunk_epochs=args.chunk_epochs,
-        hooks=[comp, info],
+        hooks=[timer.pre, comp, info, timer.post],
         model_overrides={"compute_dtype": "bfloat16"},
     )
     # Everything that constitutes the MEASURED run is done: init, compile,
@@ -144,6 +171,9 @@ def main() -> int:
         "render_s": round(render_s, 1),
         "total_wall_clock_s": round(total_s, 1),
         "compile_cache": compile_cache,
+        # first chunk_s entry includes init+compile; the rest are steady-state
+        "checkpoint_chunk_s": timer.chunk_s,
+        "checkpoint_instrumentation_s": timer.hook_s,
         "replicas": len(records),
         "steps_per_replica": args.steps,
         "steps_per_epoch": args.steps_per_epoch,
