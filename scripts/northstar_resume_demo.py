@@ -1,0 +1,203 @@
+"""Failure recovery PROVEN at flagship scale: kill the north-star sweep
+mid-run on the TPU, resume from its checkpoint, and verify the result is
+bit-identical to an uninterrupted run.
+
+VERDICT round 3 stretch item 9. The chunk-size contract in
+``DIBCheckpointer`` (checkpoint carries params + opt state + history +
+the PRNG resume key; resuming with the same ``hook_every`` continues the
+exact key chain) makes the continuation bit-identical — previously proven
+only in CPU unit tests (`tests/test_checkpoint.py`); this script proves it
+on hardware at the full 8-replica x 25k-step north-star configuration
+(amorphous notebook cell 8 scale).
+
+Protocol (one driver process, device work in subprocesses):
+  1. ``--phase run`` child A: full sweep with checkpointing -> baseline
+     history npz.
+  2. child B: same seeds/config, fresh checkpoint dir — SIGKILLed from the
+     driver mid-run (after >= 1 checkpoint lands).
+  3. child B': identical invocation; finds the checkpoint, resumes to
+     completion.
+  4. Driver compares the two final histories element-wise (exact) and
+     writes ``NORTHSTAR_RESUME.json``.
+
+Run on the TPU box (ambient env, ALONE): python scripts/northstar_resume_demo.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+def child_main(args) -> int:
+    from dib_tpu.utils.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
+
+    import jax
+    import numpy as np
+
+    from dib_tpu.parallel import BetaSweepTrainer, make_sweep_mesh  # noqa: F401
+    from dib_tpu.train.checkpoint import CheckpointHook, DIBCheckpointer
+    from dib_tpu.train.hooks import Every
+    from dib_tpu.workloads.amorphous import AmorphousWorkloadConfig, build_model
+    from dib_tpu.data import get_dataset
+
+    config = AmorphousWorkloadConfig(num_steps=args.steps)
+    bundle = get_dataset("amorphous_particles",
+                         number_particles_to_use=config.number_particles)
+    model = build_model(config, compute_dtype="bfloat16")
+    beta_ends = np.logspace(-2, 0, 8)
+    sweep = BetaSweepTrainer(
+        model, bundle, config.train_config(50), config.beta_start, beta_ends,
+    )
+    keys = jax.random.split(jax.random.key(0), len(beta_ends))
+
+    ckpt = DIBCheckpointer(os.path.abspath(args.checkpoint_dir))
+    hooks = [Every(args.checkpoint_every, CheckpointHook(ckpt))]
+    states = histories = None
+    remaining = None
+    resumed_from = None
+    if ckpt.latest_step is not None:
+        states, histories, keys = ckpt.restore(
+            sweep, chunk_size=args.chunk_epochs
+        )
+        resumed_from = int(np.max(jax.device_get(states.epoch)))
+        remaining = max(config.train_config(50).num_epochs - resumed_from, 0)
+        print(f"resuming from epoch {resumed_from} ({remaining} to go)",
+              file=sys.stderr, flush=True)
+
+    final_states, records = sweep.fit(
+        keys, num_epochs=remaining, hooks=hooks, hook_every=args.chunk_epochs,
+        states=states, histories=histories,
+    )
+    out = {}
+    for r, rec in enumerate(records):
+        out[f"kl_{r}"] = np.asarray(rec.kl_per_feature)
+        out[f"loss_{r}"] = np.asarray(rec.loss)
+        out[f"val_loss_{r}"] = np.asarray(rec.val_loss)
+    out["epoch"] = np.asarray(jax.device_get(final_states.epoch))
+    np.savez(args.history_out, **out)
+    print(json.dumps({"resumed_from": resumed_from,
+                      "final_epoch": int(out["epoch"].max())}), flush=True)
+    return 0
+
+
+def run_child(args, history_out, checkpoint_dir, kill_after=None):
+    cmd = [sys.executable, os.path.abspath(__file__), "--phase", "run",
+           "--history-out", history_out, "--checkpoint-dir", checkpoint_dir,
+           "--steps", str(args.steps),
+           "--chunk-epochs", str(args.chunk_epochs),
+           "--checkpoint-every", str(args.checkpoint_every)]
+    t0 = time.time()
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True)
+    if kill_after is None:
+        stdout, _ = proc.communicate()
+        entry = {"returncode": proc.returncode,
+                 "wall_s": round(time.time() - t0, 1)}
+        for line in reversed((stdout or "").strip().splitlines()):
+            try:
+                entry.update(json.loads(line))
+                break
+            except json.JSONDecodeError:
+                continue
+        return entry
+    time.sleep(kill_after)
+    proc.send_signal(signal.SIGKILL)
+    proc.wait()
+    return {"returncode": "SIGKILL", "wall_s": round(time.time() - t0, 1)}
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--phase", default="driver", choices=["driver", "run"])
+    parser.add_argument("--steps", type=int, default=25_000)
+    parser.add_argument("--outdir", default="northstar_resume_out")
+    parser.add_argument("--history-out", default="")
+    parser.add_argument("--checkpoint-dir", default="")
+    parser.add_argument("--kill-after", type=float, default=240.0,
+                        help="seconds into the victim run before SIGKILL "
+                             "(must be past the first checkpoint save)")
+    parser.add_argument("--chunk-epochs", type=int, default=25,
+                        help="beta-checkpoint cadence (the north star's 25)")
+    parser.add_argument("--checkpoint-every", type=int, default=125,
+                        help="epochs between Orbax saves")
+    parser.add_argument("--report", default="NORTHSTAR_RESUME.json")
+    args = parser.parse_args()
+    if args.phase == "run":
+        return child_main(args)
+
+    os.makedirs(args.outdir, exist_ok=True)
+    baseline_npz = os.path.join(args.outdir, "baseline_history.npz")
+    resumed_npz = os.path.join(args.outdir, "resumed_history.npz")
+
+    print("=== phase 1: uninterrupted baseline ===", file=sys.stderr)
+    base = run_child(args, baseline_npz,
+                     os.path.join(args.outdir, "ckpt_baseline"))
+    assert base["returncode"] == 0, base
+
+    print(f"=== phase 2: victim (SIGKILL at {args.kill_after:.0f}s) ===",
+          file=sys.stderr)
+    victim = run_child(args, resumed_npz,
+                       os.path.join(args.outdir, "ckpt_victim"),
+                       kill_after=args.kill_after)
+
+    print("=== phase 3: resume to completion ===", file=sys.stderr)
+    resume = run_child(args, resumed_npz,
+                       os.path.join(args.outdir, "ckpt_victim"))
+    assert resume["returncode"] == 0, resume
+    # the demo is void if the kill landed before the first checkpoint save
+    # (the "resume" would just be a fresh full run)
+    assert resume.get("resumed_from") is not None, (
+        "victim died before its first checkpoint; raise --kill-after", resume)
+
+    import numpy as np
+
+    a = np.load(baseline_npz)
+    b = np.load(resumed_npz)
+    mismatches = []
+    for k in a.files:
+        if not np.array_equal(a[k], b[k]):
+            mismatches.append(k)
+    report = {
+        "metric": "northstar_sweep_kill_resume_bit_identical",
+        "value": bool(not mismatches),
+        "unit": "bool",
+        "steps_per_replica": args.steps,
+        "replicas": 8,
+        "checkpoint_every_epochs": args.checkpoint_every,
+        "chunk_epochs": args.chunk_epochs,
+        "baseline_wall_s": base["wall_s"],
+        "victim_killed_after_s": victim["wall_s"],
+        "resume_wall_s": resume["wall_s"],
+        "resumed_from_epoch": resume.get("resumed_from"),
+        "compared_series": sorted(a.files),
+        "mismatching_series": mismatches,
+        "note": (
+            "victim process SIGKILLed mid-sweep on the TPU; identical "
+            "re-invocation restored the Orbax checkpoint (params + opt "
+            "state + history + PRNG resume key) and continued. Equality is "
+            "EXACT (np.array_equal) on every per-replica KL / loss / "
+            "val-loss series vs the uninterrupted baseline — the "
+            "DIBCheckpointer chunk-size contract at flagship scale."
+        ),
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    with open(args.report, "w") as f:
+        json.dump(report, f, indent=1)
+        f.write("\n")
+    print(json.dumps({k: report[k] for k in
+                      ("value", "mismatching_series", "baseline_wall_s",
+                       "resume_wall_s")}))
+    return 0 if not mismatches else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
