@@ -207,20 +207,30 @@ def test_cli_resume_preserves_info_bounds_trajectory(tmp_path):
         assert int(d["resumed_from_epoch"]) == 15
 
 
+@pytest.mark.slow
 def test_cli_sweep_checkpoint_resume(tmp_path):
     """--checkpoint_dir on the SWEEP path: stacked [R, ...] checkpoint saved
     on the cadence; a re-invocation with a longer budget resumes every
     replica at the saved epoch (code review round 3: the flag must not be
-    silently inert on sweeps)."""
+    silently inert on sweeps). With --info_bounds_frequency, each replica's
+    bounds npz must splice the pre-crash trajectory on resume, like the
+    serial path (ADVICE round 3)."""
     ckpt = str(tmp_path / "ckpt")
     base = ["--sweep_beta_ends", "0.1", "1.0",
-            "--checkpoint_dir", ckpt, "--checkpoint_frequency", "5"]
+            "--checkpoint_dir", ckpt, "--checkpoint_frequency", "5",
+            "--info_bounds_frequency", "5"]
     summary1 = run(make_args(tmp_path, *base))
     assert "resumed_from_epoch" not in summary1
     assert summary1["num_replicas"] == 2
     assert os.path.isdir(ckpt) and os.listdir(ckpt)
+    assert np.load(tmp_path / "info_bounds_replica0.npz")["epochs"].tolist() \
+        == [5, 10, 15]
 
     summary2 = run(make_args(tmp_path, *base,
                              "--number_annealing_epochs", "20"))
     assert summary2["resumed_from_epoch"] == 15
     assert len(summary2["final_val_loss"]) == 2
+    for r in range(2):
+        with np.load(tmp_path / f"info_bounds_replica{r}.npz") as d:
+            assert d["epochs"].tolist() == [5, 10, 15, 20, 25]
+            assert int(d["resumed_from_epoch"]) == 15

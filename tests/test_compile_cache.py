@@ -1,0 +1,54 @@
+"""Persistent-compile-cache helper contract (round 4, VERDICT item 4b)."""
+
+import os
+
+import jax
+import pytest
+
+from dib_tpu.utils.compile_cache import enable_persistent_cache
+
+
+_TOUCHED_KEYS = (
+    # every config key enable_persistent_cache mutates — all must be
+    # restored or they leak into the rest of the pytest session
+    "jax_compilation_cache_dir",
+    "jax_persistent_cache_min_entry_size_bytes",
+    "jax_persistent_cache_min_compile_time_secs",
+)
+
+
+@pytest.fixture
+def restore_cache_config():
+    before = {k: getattr(jax.config, k) for k in _TOUCHED_KEYS}
+    yield
+    for k, v in before.items():
+        jax.config.update(k, v)
+
+
+def test_disabled_by_empty_env(monkeypatch, restore_cache_config):
+    monkeypatch.setenv("DIB_COMPILE_CACHE", "")
+    assert enable_persistent_cache() == "off"
+
+
+def test_explicit_empty_path_is_off(restore_cache_config):
+    assert enable_persistent_cache("") == "off"
+
+
+def test_cold_then_warm(tmp_path, restore_cache_config):
+    target = tmp_path / "cache"
+    # nonexistent dir: enabled but cold
+    assert enable_persistent_cache(str(target)) == "cold-populating"
+    assert jax.config.jax_compilation_cache_dir == str(target)
+    # dir with an entry: warm
+    target.mkdir()
+    (target / "entry").write_bytes(b"x")
+    assert enable_persistent_cache(str(target)) == "warm"
+
+
+def test_env_default_expands_user(monkeypatch, tmp_path, restore_cache_config):
+    monkeypatch.setenv("HOME", str(tmp_path))
+    monkeypatch.setenv("DIB_COMPILE_CACHE", "~/jcache")
+    assert enable_persistent_cache() == "cold-populating"
+    assert jax.config.jax_compilation_cache_dir == os.path.join(
+        str(tmp_path), "jcache"
+    )
