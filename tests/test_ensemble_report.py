@@ -1,0 +1,81 @@
+"""Unit contract for the north-star ensemble report builder
+(scripts/northstar_ensemble.py): stall detection and the bimodal split
+behind NORTHSTAR_ENSEMBLE.json's distribution_analysis."""
+
+import importlib.util
+import os
+import sys
+
+import pytest
+
+_SPEC = importlib.util.spec_from_file_location(
+    "northstar_ensemble",
+    os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                 "scripts", "northstar_ensemble.py"),
+)
+ens = importlib.util.module_from_spec(_SPEC)
+sys.modules.setdefault("northstar_ensemble", ens)
+_SPEC.loader.exec_module(ens)
+
+
+def test_annotate_stalls_flags_only_outliers():
+    chunks = [54.8] + [16.4] * 18 + [280.0]
+    e = ens.annotate_stalls({"checkpoint_chunk_s": chunks})
+    assert e["device_stall_s"] == [280.0]
+    assert e["steady_chunk_median_s"] == pytest.approx(16.4)
+
+    clean = ens.annotate_stalls({"checkpoint_chunk_s": [54.8] + [16.4] * 19})
+    assert clean["device_stall_s"] == []
+
+
+def test_annotate_stalls_ignores_first_chunk_and_missing_data():
+    # chunk 0 carries init+compile and is excluded from detection
+    e = ens.annotate_stalls({"checkpoint_chunk_s": [300.0] + [16.4] * 19})
+    assert e["device_stall_s"] == []
+    # uninstrumented entries pass through untouched
+    assert "device_stall_s" not in ens.annotate_stalls({"value": 7.0})
+
+
+def test_build_report_median_and_split():
+    runs = [
+        {"run": 0, "value": 6.9,
+         "checkpoint_chunk_s": [54.0] + [16.4] * 19},
+        {"run": 1, "value": 11.2,
+         "checkpoint_chunk_s": [54.0] + [16.4] * 18 + [280.0]},
+        {"run": 2, "value": 11.0},          # uninstrumented slow run
+        {"run": 3, "value": 6.8},           # uninstrumented fast run
+    ]
+    rep = ens.build_report(runs, runs_requested=4)
+    assert rep["runs_completed"] == 4
+    assert rep["median_minutes"] == pytest.approx((6.9 + 11.0) / 2)
+    ana = rep["distribution_analysis"]
+    assert ana["stall_free_mode_minutes"] == [6.8, 6.9]
+    assert ana["stalled_mode_minutes"] == [11.0, 11.2]
+    assert ana["stalls_directly_observed"] == 1
+    assert "1 of the stalled runs" in ana["summary"]
+
+
+def test_build_report_first_chunk_stall_falls_back_to_midpoint():
+    # a stall hidden in chunk 0 yields device_stall_s == [] but the VALUE
+    # heuristic must still classify the run as stalled (code review round 4)
+    runs = [
+        {"run": 0, "value": 6.9, "checkpoint_chunk_s": [54.0] + [16.4] * 19},
+        {"run": 1, "value": 10.8,
+         "checkpoint_chunk_s": [290.0] + [16.4] * 19},
+    ]
+    ana = ens.build_report(runs, 2)["distribution_analysis"]
+    assert ana["stalled_mode_minutes"] == [10.8]
+    assert ana["stalls_directly_observed"] == 0
+
+
+def test_build_report_uniform_runs_are_all_stall_free():
+    runs = [{"run": i, "value": 6.8 + 0.05 * i} for i in range(3)]
+    ana = ens.build_report(runs, 3)["distribution_analysis"]
+    assert ana["stalled_mode_minutes"] == []
+    assert len(ana["stall_free_mode_minutes"]) == 3
+
+
+def test_build_report_empty():
+    rep = ens.build_report([{"run": 0, "error": "killed"}], 1)
+    assert rep["runs_completed"] == 0
+    assert rep["median_minutes"] is None
