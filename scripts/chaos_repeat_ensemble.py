@@ -41,6 +41,11 @@ def main() -> int:
                              "the variance the ensemble measures; draw "
                              "variance is secondary)")
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--report", default=None,
+                        help="report path (default CHAOS_ENSEMBLE[_SYSTEM]"
+                             ".json; set explicitly when adding an "
+                             "independent seed batch so the committed "
+                             "artifact is not overwritten)")
     args = parser.parse_args()
     smoke = bool(os.environ.get("DIB_CHAOS_SMOKE"))
 
@@ -146,8 +151,8 @@ def main() -> int:
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
     suffix = "" if args.system == "logistic" else f"_{args.system.upper()}"
-    out = (f"CHAOS_ENSEMBLE_SMOKE{suffix}.json" if smoke
-           else f"CHAOS_ENSEMBLE{suffix}.json")
+    out = args.report or (f"CHAOS_ENSEMBLE_SMOKE{suffix}.json" if smoke
+                          else f"CHAOS_ENSEMBLE{suffix}.json")
     with open(out, "w") as f:
         json.dump(report, f, indent=1)
         f.write("\n")
