@@ -379,6 +379,7 @@ def _save_info_bounds(path: str, epochs, bounds_bits,
     if resumed_from is not None:
         extras["resumed_from_epoch"] = np.asarray(resumed_from)
         if os.path.exists(path) and epochs.size:
+            import zipfile
             try:
                 with np.load(path) as prev:
                     prev_epochs = np.asarray(prev["epochs"])
@@ -387,8 +388,12 @@ def _save_info_bounds(path: str, epochs, bounds_bits,
                 if keep.any() and prev_bounds.shape[1:] == bounds_bits.shape[1:]:
                     epochs = np.concatenate([prev_epochs[keep], epochs])
                     bounds_bits = np.concatenate([prev_bounds[keep], bounds_bits])
-            except Exception:
-                pass    # unreadable prior npz: keep the post-resume segment
+            except (OSError, KeyError, ValueError, zipfile.BadZipFile) as exc:
+                # unreadable/old-format prior npz: keep only the post-resume
+                # segment, but say so — silently dropping the pre-crash
+                # trajectory is the failure this helper exists to prevent
+                print(f"warning: discarding unreadable prior trajectory "
+                      f"{path}: {exc}", file=sys.stderr)
     np.savez(path, epochs=epochs, bounds_bits=bounds_bits, **extras)
 
 

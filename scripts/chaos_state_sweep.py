@@ -77,9 +77,13 @@ def main() -> int:
             # the note must describe the budget actually run (VERDICT round
             # 3 item 2: the anchor L values should carry no reduced-budget
             # disclaimer once run at paper scale)
+            # exact wording of the committed CHAOS_STATE_SWEEP.json so a
+            # re-run of the documented command reproduces the artifact
+            # (ADVICE round 4)
             "paper-scale per-config budget (1e6 train / 2e7 characterization "
-            "states); repeats per L below the paper's 20 are stated in "
-            "repeats_per_state"
+            f"states) at the anchor L values; {args.repeats} repeats per L "
+            "(paper: 20). The full 14-L shape at reduced budget is "
+            "CHAOS_STATE_SWEEP_SHAPE.json."
             if args.train_iterations >= 1_000_000
             and args.char_iterations >= 20_000_000
             else
