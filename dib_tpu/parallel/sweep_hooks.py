@@ -55,16 +55,52 @@ class SweepInfoPerFeatureHook:
         number_evaluation_batches: int = 8,
         seed: int = 0,
         row_block: int | None = None,
+        persist: str | None = None,
     ):
         self.evaluation_batch_size = evaluation_batch_size
         self.number_evaluation_batches = number_evaluation_batches
         self.row_block = row_block
-        self.key = jax.random.key(seed)
+        self._base_key = jax.random.key(seed)
         self.records: list[dict] = []
         self._fn = None
         self._device_rows = None
         self._cache_for = None   # strong (sweep, model) refs, not ids —
                                  # id reuse after GC must not retain caches
+        # Resume support (train/watchdog.py): with a persist dir every
+        # record is mirrored to disk at call time and reloaded here, so a
+        # killed-and-relaunched worker reports the FULL trajectory, not
+        # just post-resume checkpoints.
+        self.persist = persist
+        if persist:
+            import re
+
+            os.makedirs(persist, exist_ok=True)
+            finished, torn = [], []
+            for fname in os.listdir(persist):
+                m = re.fullmatch(r"epoch(\d+)\.npz", fname)
+                if m:
+                    finished.append((int(m.group(1)), fname))
+                elif ".tmp" in fname:
+                    torn.append(fname)   # a SIGKILL mid-savez leaves these
+            for fname in torn:
+                os.unlink(os.path.join(persist, fname))
+            for epoch, fname in sorted(finished):
+                data = np.load(os.path.join(persist, fname))
+                self.records.append({
+                    "epoch": int(data["epoch"]),
+                    "bounds": np.asarray(data["bounds"]),
+                })
+
+    def _key_for_call(self, n: int):
+        """The n-th call's evaluation key (0-indexed), derived by walking
+        the same split chain the stateful implementation used — per-call
+        derivation makes the chain resume-invariant: a relaunched worker
+        re-measuring checkpoint n draws exactly the key the uninterrupted
+        run would have."""
+        k = self._base_key
+        for _ in range(n + 1):
+            k, k_call = jax.random.split(k)
+        return k_call
 
     def _build(self, model):
         # THE serial measurement kernel, vmapped over the replica axis —
@@ -83,7 +119,13 @@ class SweepInfoPerFeatureHook:
             self._fn = self._build(model)
             self._device_rows = jnp.asarray(sweep.base.bundle.x_valid)
             self._cache_for = (sweep, model)
-        self.key, k = jax.random.split(self.key)
+        # A resumed worker re-measures from its restore point: drop any
+        # preloaded records at/after this epoch (their npz mirrors are
+        # simply overwritten) so the call index — and with it the key
+        # chain — matches the uninterrupted run's.
+        if self.records and self.records[-1]["epoch"] >= epoch:
+            self.records = [r for r in self.records if r["epoch"] < epoch]
+        k = self._key_for_call(len(self.records))
         keys = jax.random.split(k, sweep.num_replicas)
         lower, upper = self._fn(
             _model_params(states.params), self._device_rows, keys
@@ -92,6 +134,10 @@ class SweepInfoPerFeatureHook:
             [np.asarray(lower), np.asarray(upper)], axis=-1
         )  # [R, F, 2] nats
         self.records.append({"epoch": epoch, "bounds": bounds})
+        if self.persist:
+            path = os.path.join(self.persist, f"epoch{epoch}.npz")
+            np.savez(f"{path}.tmp.npz", epoch=epoch, bounds=bounds)
+            os.replace(f"{path}.tmp.npz", path)
 
     @property
     def epochs(self) -> np.ndarray:
@@ -125,7 +171,8 @@ class SweepCompressionHook:
     """
 
     def __init__(self, outdir: str, features=(0,),
-                 max_number_to_display: int = 128, seed: int = 0):
+                 max_number_to_display: int = 128, seed: int = 0,
+                 resume: bool = False):
         self.outdir = outdir
         self.features = tuple(features)
         self.max_number_to_display = max_number_to_display
@@ -135,6 +182,24 @@ class SweepCompressionHook:
         self._feature_rows = {}
         self._cache_for = None   # strong sweep ref (see info hook note)
         os.makedirs(os.path.join(outdir, "schemes"), exist_ok=True)
+        if resume:
+            # rebuild the call-order record from the npzs already on disk
+            # (train/watchdog.py relaunch): epochs ascending, features in
+            # this hook's declared order — exactly the order the calls
+            # that wrote them ran in, so render()'s per-replica RNG chain
+            # matches the uninterrupted run's
+            found = {}
+            for fname in os.listdir(os.path.join(outdir, "schemes")):
+                if fname.startswith("scheme_epoch") and fname.endswith(".npz"):
+                    e, f = fname[len("scheme_epoch"):-len(".npz")].split("_feature")
+                    found[(int(e), int(f))] = fname
+            for e in sorted({k[0] for k in found}):
+                for f in self.features:
+                    if (e, f) in found:
+                        self.saved.append({
+                            "path": os.path.join(outdir, "schemes", found[(e, f)]),
+                            "epoch": e, "feature": f,
+                        })
 
     def _encode_fn(self, model, f: int):
         if f not in self._fns:
@@ -149,10 +214,17 @@ class SweepCompressionHook:
         if sweep is not self._cache_for:
             self._fns.clear()
             self._feature_rows.clear()
-            # a new sweep is a new run record: keep render() from mixing
-            # replica counts/schemes across sweeps
-            self.saved.clear()
+            # a new sweep IN THIS PROCESS is a new run record: keep
+            # render() from mixing replica counts/schemes across sweeps.
+            # (_cache_for is None on the first call, which preserves
+            # records preloaded with resume=True.)
+            if self._cache_for is not None:
+                self.saved.clear()
             self._cache_for = sweep
+        # resumed worker re-measuring from its restore point: the npzs are
+        # overwritten in place, so just drop the stale list entries
+        if self.saved and self.saved[-1]["epoch"] >= epoch:
+            self.saved = [s for s in self.saved if s["epoch"] < epoch]
         cfg = sweep.base.config
         starts = np.asarray(jax.device_get(sweep.beta_starts), np.float64)
         ends = np.asarray(jax.device_get(sweep.beta_ends), np.float64)

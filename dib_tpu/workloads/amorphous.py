@@ -294,6 +294,7 @@ def run_amorphous_sweep(
     model_overrides: dict | None = None,
     hooks=(),
     chunk_epochs: int = 25,
+    checkpoint_dir: str | None = None,
     **fetch_kwargs,
 ) -> dict:
     """The north-star run: the full set-transformer configuration swept over a
@@ -305,6 +306,13 @@ def run_amorphous_sweep(
     "20 repeats per" config, chaos notebook cell 10 header). Returns per-replica
     history records, the endpoint grid, wall-clock, and per-replica info-plane
     artifact paths.
+
+    ``checkpoint_dir`` arms crash/stall recovery (train/watchdog.py): an
+    Orbax checkpoint is saved at every chunk boundary, and when the
+    directory already holds one the run RESUMES from it on the exact key
+    chain (``DIBCheckpointer`` chunk-size contract) instead of starting
+    over — a killed-and-relaunched invocation is bit-identical to an
+    uninterrupted one. The result dict gains ``resumed_from_epoch``.
     """
     config = config or AmorphousWorkloadConfig()
     if isinstance(key, int):
@@ -327,11 +335,34 @@ def run_amorphous_sweep(
         config.beta_start, ends, mesh=mesh,
     )
     keys = jax.random.split(key, num_replicas)
+    hooks = list(hooks)
+    states = histories = None
+    remaining = None
+    resumed_from = None
+    if checkpoint_dir:
+        from dib_tpu.train.checkpoint import CheckpointHook, DIBCheckpointer
+
+        ckpt = DIBCheckpointer(os.path.abspath(checkpoint_dir))
+        # last, so a checkpoint is only written once the other hooks'
+        # persisted instrumentation for that epoch is already on disk
+        hooks.append(CheckpointHook(ckpt))
+        if ckpt.latest_step is not None:
+            states, histories, keys = ckpt.restore(
+                sweep, chunk_size=chunk_epochs
+            )
+            resumed_from = int(np.max(jax.device_get(states.epoch)))
+            total = config.train_config(steps_per_epoch).num_epochs
+            remaining = max(total - resumed_from, 0)
     t0 = time.time()
     # chunk_epochs bounds single-dispatch size (very long device programs
     # can exceed runtime execution limits) and gives hooks their cadence
-    states, records = sweep.fit(keys, hooks=list(hooks), hook_every=chunk_epochs)
+    states, records = sweep.fit(
+        keys, num_epochs=remaining, hooks=hooks, hook_every=chunk_epochs,
+        states=states, histories=histories,
+    )
     jax.block_until_ready(states.params)
+    if checkpoint_dir:
+        ckpt.close()        # drain the async final save before returning
     wall_s = time.time() - t0
 
     entropy_y = sequence_entropy_bits(bundle.y_train.reshape(-1))
@@ -353,6 +384,7 @@ def run_amorphous_sweep(
         "entropy_y_bits": entropy_y,
         "info_plane_paths": paths,
         "mesh": mesh,
+        "resumed_from_epoch": resumed_from,
     }
 
 

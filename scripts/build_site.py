@@ -243,6 +243,89 @@ def info_map_anneal_gif(maps_dir: str | None = None,
     )
 
 
+def chaos_scaling_figure() -> None:
+    """The PRL paper's headline (Fig. 3): entropy-rate estimate vs number
+    of measurement outcomes L, saturating on the known KS entropy.
+
+    Built from the COMMITTED hardware artifacts (no re-run): the
+    paper-budget anchors (`CHAOS_STATE_SWEEP.json`, 1e6 train / 2e7 char
+    states per config on the TPU) over the reduced-budget 14-L shape
+    sweep (`CHAOS_STATE_SWEEP_SHAPE.json`). Reference protocol:
+    chaos notebook cell 10 ("loop over number_states from 2 to 15")."""
+    import json
+
+    with open(os.path.join(REPO, "CHAOS_STATE_SWEEP.json")) as f:
+        anchor = json.load(f)
+    shape = None
+    shape_path = os.path.join(REPO, "CHAOS_STATE_SWEEP_SHAPE.json")
+    if os.path.exists(shape_path):
+        with open(shape_path) as f:
+            shape = json.load(f)
+
+    fig, ax = plt.subplots(figsize=(6.4, 4.2))
+    known = anchor["known_rate_bits"]
+    ax.axhline(known, color="0.25", lw=1.2, ls="--",
+               label=f"known rate ({known:.3f} bits)")
+    if shape is not None:
+        ax.plot(shape["state_counts"], shape["h_inf_bits"], "o-",
+                color="#9ecae1", ms=4, lw=1.2,
+                label="14-L shape sweep (reduced budget)")
+    ax.plot(anchor["state_counts"], anchor["h_inf_bits"], "o-",
+            color="#1f77b4", ms=7, lw=2.2,
+            label="paper-budget anchors (TPU)")
+    ax.set_xlabel("number of measurement outcomes  L")
+    ax.set_ylabel("entropy rate estimate  (bits / iteration)")
+    ax.set_title(f"{anchor['system'].capitalize()} map: IB-optimized "
+                 "measurements approach the KS entropy")
+    ax.legend(frameon=False, loc="lower right")
+    ax.spines[["top", "right"]].set_visible(False)
+    fig.tight_layout()
+    fig.savefig(os.path.join(ASSETS, "chaos_entropy_scaling.png"), dpi=160)
+    plt.close(fig)
+
+
+def characterization_residual_figure() -> None:
+    """MI sandwich-bound residuals against the Monte-Carlo oracle across
+    the 105-cell characterization sweep (`CHARACTERIZATION_FULL.json`,
+    measured on the TPU): lower/upper bound errors vs ground truth at each
+    batch size, showing the float32 log-space kernel brackets the truth.
+    Reference: Characterizing_mutual_information_bounds.ipynb's bound
+    tightness study."""
+    import json
+
+    with open(os.path.join(REPO, "CHARACTERIZATION_FULL.json")) as f:
+        art = json.load(f)
+    cells = [c for c in art["cells"] if c["batch_size"] == 1024]
+    gap_median = float(np.median([c["gap_bits"] for c in cells]))
+    truth = np.array([c["mc_truth_bits"] for c in cells])
+    lower = np.array([c["lower_bits"] for c in cells]) - truth
+    upper = np.array([c["upper_bits"] for c in cells]) - truth
+    lstd = np.array([c["lower_std_bits"] for c in cells])
+    ustd = np.array([c["upper_std_bits"] for c in cells])
+
+    fig, ax = plt.subplots(figsize=(6.4, 4.2))
+    ax.axhline(0.0, color="0.25", lw=1.0)
+    ax.errorbar(truth, lower, yerr=lstd, fmt="v", ms=5, lw=0.9,
+                color="#1f77b4", capsize=2, label="lower bound − truth")
+    ax.errorbar(truth, upper, yerr=ustd, fmt="^", ms=5, lw=0.9,
+                color="#9ecae1", capsize=2, label="upper bound − truth")
+    ax.set_xlabel("Monte-Carlo ground-truth MI  (bits)")
+    ax.set_ylabel("bound residual  (bits)")
+    ax.set_title("MI sandwich bounds vs a Monte-Carlo oracle  (B = 1024)")
+    ax.text(0.02, 0.97,
+            f"{art['bracketing_fraction']:.0%} of {art['cells_total']} "
+            "sweep cells bracketed\n"
+            f"median sandwich gap {gap_median:.4f} bits at B=1024 "
+            "(float32, log-space, on TPU)",
+            transform=ax.transAxes, va="top", fontsize=9, color="0.3")
+    ax.legend(frameon=False, loc="lower left", fontsize=9)
+    ax.spines[["top", "right"]].set_visible(False)
+    fig.tight_layout()
+    fig.savefig(os.path.join(ASSETS, "characterization_residuals.png"),
+                dpi=160)
+    plt.close(fig)
+
+
 def main() -> None:
     os.makedirs(ASSETS, exist_ok=True)
     for name, fn in [
@@ -253,6 +336,8 @@ def main() -> None:
         ("glass probe map", glass_probe_map),
         ("compression anneal gif", compression_anneal_gif),
         ("info map anneal gif", info_map_anneal_gif),
+        ("chaos entropy scaling", chaos_scaling_figure),
+        ("characterization residuals", characterization_residual_figure),
     ]:
         print(f"building {name} figure...", flush=True)
         fn()

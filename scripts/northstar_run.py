@@ -45,6 +45,66 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 BASELINE_MINUTES = 10.0
 
 
+def watchdog_main(args) -> int:
+    """Supervised north star: the same command re-launched as a worker under
+    ``dib_tpu.train.watchdog.supervise``. A chunk that stalls past
+    3x the trailing-median chunk wall-clock gets its worker SIGKILLed and
+    relaunched; the worker resumes bit-identically from its chunk-boundary
+    Orbax checkpoint. The final report is the worker's, augmented with a
+    ``watchdog`` section and the headline ``value`` replaced by the
+    END-TO-END supervised wall-clock — kills, restarts, re-compiles and
+    re-done chunks all count against the 10-minute target."""
+    from dib_tpu.train.watchdog import WatchdogConfig, supervise
+
+    os.makedirs(args.outdir, exist_ok=True)
+    heartbeat = args.heartbeat or os.path.join(args.outdir, "heartbeat.json")
+    checkpoint_dir = args.checkpoint_dir or os.path.join(args.outdir, "ckpt")
+    worker_cmd = [sys.executable, os.path.abspath(__file__)]
+    skip = {"--watchdog"}
+    argv = [a for a in sys.argv[1:] if a not in skip]
+    for flag, value in (("--heartbeat", heartbeat),
+                        ("--checkpoint-dir", checkpoint_dir)):
+        if flag not in argv:
+            argv += [flag, value]
+    worker_cmd += argv
+
+    cfg = WatchdogConfig(first_beat_timeout_s=args.watchdog_first_timeout_s,
+                         floor_s=args.watchdog_floor_s)
+    t0 = time.time()
+    result = supervise(worker_cmd, heartbeat, cfg)
+    total_s = time.time() - t0
+    try:
+        # a report predating this supervised run is some EARLIER run's
+        # artifact, not the worker's — never splice metrics into it
+        if os.path.getmtime(args.report) < t0:
+            raise OSError("stale report")
+        with open(args.report) as f:
+            report = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        report = {"metric": "amorphous_set_transformer_beta_sweep_measured",
+                  "unit": "minutes", "error": "worker never wrote a report"}
+    report["single_process_minutes"] = report.get("value")
+    report["value"] = round(total_s / 60.0, 3)
+    report["vs_baseline"] = round(total_s / 60.0 / BASELINE_MINUTES, 4)
+    report["watchdog"] = {
+        "enabled": True,
+        "launches": result["launches"],
+        "mitigations": result["mitigations"],
+        "supervised_wall_s": round(total_s, 1),
+        "worker_returncode": result["returncode"],
+        "policy": {"k": cfg.k, "floor_s": cfg.floor_s,
+                   "first_beat_timeout_s": cfg.first_beat_timeout_s},
+    }
+    with open(args.report, "w") as f:
+        json.dump(report, f, indent=1)
+        f.write("\n")
+    print(json.dumps({"value": report["value"],
+                      "launches": result["launches"],
+                      "mitigations": len(result["mitigations"]),
+                      "returncode": result["returncode"]}))
+    return 0 if result["returncode"] == 0 else 1
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--outdir", default="northstar_out")
@@ -61,7 +121,26 @@ def main() -> int:
     parser.add_argument("--compile-cache", default="",
                         help="persistent XLA compilation cache dir ('' = off; "
                              "compile_s in the report says which applied)")
+    parser.add_argument("--checkpoint-dir", default="",
+                        help="arm chunk-boundary Orbax checkpointing; an "
+                             "existing checkpoint there RESUMES the run "
+                             "(bit-identical continuation)")
+    parser.add_argument("--heartbeat", default="",
+                        help="write a chunk-boundary heartbeat JSON here "
+                             "(read by the --watchdog supervisor)")
+    parser.add_argument("--watchdog", action="store_true",
+                        help="supervise the run: relaunch this command as a "
+                             "worker (checkpoint+heartbeat under --outdir), "
+                             "SIGKILL it when a chunk stalls past 3x the "
+                             "trailing-median chunk time, and resume it "
+                             "from its checkpoint — every run finishes even "
+                             "on a stalling device (VERDICT r4 item 1)")
+    parser.add_argument("--watchdog-floor-s", type=float, default=45.0)
+    parser.add_argument("--watchdog-first-timeout-s", type=float, default=600.0)
     args = parser.parse_args()
+
+    if args.watchdog:
+        return watchdog_main(args)
 
     import jax
 
@@ -89,9 +168,11 @@ def main() -> int:
     # compression-scheme pulls (feature 0 only: the per-particle model
     # shares ONE encoder across particle slots, so other slots' schemes are
     # identical) + MI sandwich bounds for every replica.
-    comp = SweepCompressionHook(args.outdir, features=(0,))
+    resuming = bool(args.checkpoint_dir)
+    comp = SweepCompressionHook(args.outdir, features=(0,), resume=resuming)
     info = SweepInfoPerFeatureHook(
-        config.mi_eval_batch_size, config.mi_eval_batches
+        config.mi_eval_batch_size, config.mi_eval_batches,
+        persist=os.path.join(args.outdir, "mi_bounds") if resuming else None,
     )
 
     class _CheckpointPhaseTimer:
@@ -120,6 +201,14 @@ def main() -> int:
 
     timer = _CheckpointPhaseTimer()
 
+    hooks = [timer.pre, comp, info, timer.post]
+    if args.heartbeat:
+        from dib_tpu.train.watchdog import HeartbeatHook
+
+        # first: it blocks on the chunk itself, so the supervisor's
+        # inter-beat intervals are true chunk wall-clocks
+        hooks.insert(0, HeartbeatHook(args.heartbeat))
+
     t0 = time.time()
     timer._t = t0
     result = run_amorphous_sweep(
@@ -130,8 +219,9 @@ def main() -> int:
         outdir=args.outdir,
         steps_per_epoch=args.steps_per_epoch,
         chunk_epochs=args.chunk_epochs,
-        hooks=[timer.pre, comp, info, timer.post],
+        hooks=hooks,
         model_overrides={"compute_dtype": "bfloat16"},
+        checkpoint_dir=args.checkpoint_dir or None,
     )
     # Everything that constitutes the MEASURED run is done: init, compile,
     # 25k steps x R, per-checkpoint device measurements + host pulls, final
@@ -171,6 +261,8 @@ def main() -> int:
         "render_s": round(render_s, 1),
         "total_wall_clock_s": round(total_s, 1),
         "compile_cache": compile_cache,
+        # a resumed worker only re-measures its own (post-restore) chunks
+        "resumed_from_epoch": result.get("resumed_from_epoch"),
         # first chunk_s entry includes init+compile; the rest are steady-state
         "checkpoint_chunk_s": timer.chunk_s,
         "checkpoint_instrumentation_s": timer.hook_s,

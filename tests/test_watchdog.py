@@ -1,0 +1,229 @@
+"""Fault-injection tests for the stall watchdog (dib_tpu/train/watchdog.py).
+
+VERDICT round-4 item 1: the tunneled v5e shows discrete ~280 s device
+stalls; the framework must detect a wedged chunk and re-dispatch from the
+last checkpoint WITHOUT human intervention. These tests inject the fault:
+
+  - supervisor-level: scripted workers that stall (stop heartbeating) or
+    crash; ``supervise`` must kill/restart them and record each mitigation;
+  - end-to-end: a real ``BetaSweepTrainer`` worker whose hook sleeps
+    mid-run on its FIRST launch only — the supervised result must be
+    bit-identical to an uninterrupted run (the ``DIBCheckpointer``
+    chunk-size contract carried through a SIGKILL).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import numpy as np
+import pytest
+
+from dib_tpu.train.watchdog import HeartbeatHook, WatchdogConfig, supervise
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _worker_env():
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "PALLAS_AXON_POOL_IPS": "",
+        "DIB_COMPILE_CACHE": "",
+        "JAX_COMPILATION_CACHE_DIR": "/root/.cache/jax_comp_cache_cpu",
+        "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "0.2",
+        "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES": "0",
+    })
+    return env
+
+
+# ------------------------------------------------------- supervisor logic
+def _scripted_worker(tmp_path, body: str) -> list:
+    path = tmp_path / "worker.py"
+    path.write_text(textwrap.dedent(body))
+    return [sys.executable, str(path)]
+
+
+def test_supervise_kills_stalled_worker_and_relaunches(tmp_path):
+    hb = str(tmp_path / "hb.json")
+    marker = str(tmp_path / "stalled_once")
+    # first launch: beat twice, then wedge (no further beats); relaunch:
+    # beat to completion
+    cmd = _scripted_worker(tmp_path, f"""
+        import json, os, time
+        hb, marker = {hb!r}, {marker!r}
+        def beat(n, t0):
+            payload = {{"pid": os.getpid(), "epoch": n, "beat": n,
+                        "time": time.time(),
+                        "intervals_s": [0.2] * n}}
+            with open(hb + ".tmp", "w") as f:
+                json.dump(payload, f)
+            os.replace(hb + ".tmp", hb)
+        t0 = time.time()
+        for n in range(1, 3):
+            time.sleep(0.2); beat(n, t0)
+        if not os.path.exists(marker):
+            open(marker, "w").close()
+            time.sleep(600)          # the injected stall
+        for n in range(3, 6):
+            time.sleep(0.2); beat(n, t0)
+    """)
+    t0 = time.time()
+    result = supervise(
+        cmd, hb,
+        WatchdogConfig(first_beat_timeout_s=60.0, floor_s=1.0, k=3.0,
+                       poll_s=0.1, max_restarts=2),
+    )
+    assert result["returncode"] == 0
+    assert result["launches"] == 2
+    kinds = [m["type"] for m in result["mitigations"]]
+    assert kinds == ["stall_kill"]
+    assert result["mitigations"][0]["beats"] == 2
+    # detection must be prompt: the 600 s sleep must NOT be waited out
+    assert time.time() - t0 < 60
+
+
+def test_supervise_restarts_crashed_worker(tmp_path):
+    hb = str(tmp_path / "hb.json")
+    marker = str(tmp_path / "crashed_once")
+    cmd = _scripted_worker(tmp_path, f"""
+        import os, sys
+        marker = {marker!r}
+        if not os.path.exists(marker):
+            open(marker, "w").close()
+            sys.exit(3)              # simulated tunnel crash
+        sys.exit(0)
+    """)
+    result = supervise(cmd, hb, WatchdogConfig(poll_s=0.05, max_restarts=2))
+    assert result["returncode"] == 0
+    assert [m["type"] for m in result["mitigations"]] == ["crash_restart"]
+    assert result["mitigations"][0]["returncode"] == 3
+
+
+def test_supervise_gives_up_after_max_restarts(tmp_path):
+    hb = str(tmp_path / "hb.json")
+    cmd = _scripted_worker(tmp_path, "import sys; sys.exit(7)")
+    result = supervise(cmd, hb, WatchdogConfig(poll_s=0.05, max_restarts=1))
+    assert result["returncode"] == 7
+    assert "error" in result
+    assert result["launches"] == 2
+
+
+def test_heartbeat_hook_writes_atomic_beats(tmp_path):
+    hb = str(tmp_path / "hb.json")
+    hook = HeartbeatHook(hb)
+
+    class S:
+        params = {"w": np.zeros(3)}
+
+    hook(None, S(), 2)
+    time.sleep(0.05)
+    hook(None, S(), 4)
+    with open(hb) as f:
+        beat = json.load(f)
+    assert beat["beat"] == 2 and beat["epoch"] == 4
+    assert len(beat["intervals_s"]) == 2
+    assert beat["intervals_s"][1] >= 0.05
+
+
+# ------------------------------------------- end-to-end: bit-identical
+_TRAIN_WORKER = """
+    import os, sys, time
+    sys.path.insert(0, {repo!r})
+    import jax, numpy as np
+    from dib_tpu.data import get_dataset
+    from dib_tpu.models import DistributedIBModel
+    from dib_tpu.parallel import BetaSweepTrainer
+    from dib_tpu.train import TrainConfig
+    from dib_tpu.train.checkpoint import CheckpointHook, DIBCheckpointer
+    from dib_tpu.train.watchdog import HeartbeatHook
+
+    outdir, stall_epoch, stall_s = sys.argv[1], int(sys.argv[2]), float(sys.argv[3])
+    marker = os.path.join(outdir, "stalled_once")
+    bundle = get_dataset("boolean_circuit", number_inputs=6, seed=1)
+    model = DistributedIBModel(
+        feature_dimensionalities=tuple(bundle.feature_dimensionalities),
+        encoder_hidden=(16,), integration_hidden=(32,),
+        output_dim=bundle.output_dimensionality, embedding_dim=4,
+        output_activation=bundle.output_activation,
+    )
+    cfg = TrainConfig(batch_size=64, beta_start=1e-3, beta_end=1.0,
+                      num_pretraining_epochs=2, num_annealing_epochs=6,
+                      steps_per_epoch=2, max_val_points=128)
+    sweep = BetaSweepTrainer(model, bundle, cfg, 1e-3, [0.1, 1.0])
+    keys = jax.random.split(jax.random.key(5), 2)
+    ckpt = DIBCheckpointer(os.path.join(outdir, "ckpt"))
+
+    def stall(trainer, states, epoch):
+        if epoch == stall_epoch and not os.path.exists(marker):
+            open(marker, "w").close()
+            time.sleep(stall_s)      # wedged device, as seen from the host
+
+    hooks = [HeartbeatHook(os.path.join(outdir, "hb.json")), stall,
+             CheckpointHook(ckpt)]
+    total, chunk = 8, 2
+    states = histories = None
+    remaining = None
+    if ckpt.latest_step is not None:
+        states, histories, keys = ckpt.restore(sweep, chunk_size=chunk)
+        remaining = total - int(np.max(jax.device_get(states.epoch)))
+    final, records = sweep.fit(
+        keys, num_epochs=remaining if remaining is not None else total,
+        hooks=hooks, hook_every=chunk, states=states, histories=histories,
+    )
+    ckpt.close()
+    out = {{}}
+    for r, rec in enumerate(records):
+        out[f"kl_{{r}}"] = np.asarray(rec.kl_per_feature)
+        out[f"loss_{{r}}"] = np.asarray(rec.loss)
+        out[f"val_loss_{{r}}"] = np.asarray(rec.val_loss)
+    np.savez(os.path.join(outdir, "hist.npz"), **out)
+"""
+
+
+@pytest.mark.slow
+def test_supervised_stall_recovery_is_bit_identical(tmp_path):
+    worker = tmp_path / "train_worker.py"
+    worker.write_text(textwrap.dedent(_TRAIN_WORKER.format(repo=REPO)))
+    env = _worker_env()
+
+    # uninterrupted baseline (stall_epoch = -1 never fires)
+    base_dir = tmp_path / "base"
+    base_dir.mkdir()
+    subprocess.run(
+        [sys.executable, str(worker), str(base_dir), "-1", "0"],
+        env=env, check=True, timeout=600,
+    )
+
+    # victim: hook wedges for 300 s at epoch 6 on the first launch only;
+    # the supervisor must SIGKILL it and the relaunch must resume from the
+    # epoch-4 checkpoint (epoch-6's save runs after the stalling hook)
+    vic_dir = tmp_path / "victim"
+    vic_dir.mkdir()
+    hb = str(vic_dir / "hb.json")
+    t0 = time.time()
+    result = supervise(
+        [sys.executable, str(worker), str(vic_dir), "6", "300"],
+        hb,
+        WatchdogConfig(first_beat_timeout_s=300.0, floor_s=8.0, k=3.0,
+                       poll_s=0.25, max_restarts=2),
+        env=env,
+    )
+    wall = time.time() - t0
+    assert result["returncode"] == 0, result
+    assert [m["type"] for m in result["mitigations"]] == ["stall_kill"], result
+    assert result["launches"] == 2
+    assert wall < 300, "the 300 s injected stall must not be waited out"
+    assert os.path.exists(vic_dir / "stalled_once")
+
+    a = np.load(base_dir / "hist.npz")
+    b = np.load(vic_dir / "hist.npz")
+    assert sorted(a.files) == sorted(b.files)
+    for k in a.files:
+        np.testing.assert_array_equal(
+            a[k], b[k],
+            err_msg=f"{k}: supervised kill+resume diverged from baseline",
+        )
