@@ -175,8 +175,12 @@ def child_main() -> None:
     t_init = time.time()
     bundle = get_dataset("amorphous_particles", num_synthetic_neighborhoods=2048)
     # Full paper architecture; attention/FF matmuls in bfloat16 (MXU-native)
-    # — KL, sampling, and logits stay float32.
-    model = PerParticleDIBModel(num_particles=50, compute_dtype="bfloat16")
+    # — KL, sampling, and logits stay float32. DIB_BENCH_FUSED_QKV=1 A/Bs the
+    # fused QKV projection (roofline remedy, scripts/roofline.py).
+    model = PerParticleDIBModel(
+        num_particles=50, compute_dtype="bfloat16",
+        fuse_qkv=bool(os.environ.get("DIB_BENCH_FUSED_QKV")),
+    )
     config = TrainConfig(
         learning_rate=1e-4,
         batch_size=BENCH_BATCH_SIZE,
@@ -358,6 +362,7 @@ def save_cache(result: dict) -> None:
         or MEASURE_EPOCHS != DEFAULT_MEASURE_EPOCHS
         or STEPS_PER_EPOCH != DEFAULT_STEPS_PER_EPOCH
         or os.environ.get("DIB_BENCH_SAMPLING", "replacement") != "replacement"
+        or os.environ.get("DIB_BENCH_FUSED_QKV")
         or os.environ.get("DIB_ATTN_SCORE_DTYPE", "bfloat16").lower()
         not in ("bfloat16", "bf16")
     ):
@@ -448,6 +453,17 @@ def parent_main() -> None:
                 degraded["cache_" + key if key == "measured_at" else key] = (
                     cached[key]
                 )
+        # How stale the embedded measurement is, loudly and at top level
+        # (VERDICT round 4 weak #2): consumers must see at a glance that
+        # the value is N hours old, not a live number.
+        try:
+            import calendar
+
+            measured = calendar.timegm(time.strptime(
+                cached.get("measured_at", ""), "%Y-%m-%dT%H:%M:%SZ"))
+            degraded["stale_seconds"] = int(time.time() - measured)
+        except (ValueError, TypeError):
+            degraded["stale_seconds"] = None
     emit(degraded)
 
 

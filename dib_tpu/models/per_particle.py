@@ -45,6 +45,13 @@ class PerParticleDIBModel(nn.Module):
     encoder_hidden: Sequence[int] = (128, 128)
     embedding_dim: int = 32
     logvar_offset: float = -3.0
+    # The reference's particle encoder puffs the 12 engineered features out
+    # with 4 sinusoid frequencies (amorphous notebook cell 8,
+    # 2**np.arange(1, 5)) before the MLP; dib-tpu ships 0 by default (the
+    # engineered features already carry the geometry) — set 4 for an
+    # architecture-matched comparison against the executed reference
+    # (tests/test_reference_parity.py).
+    num_posenc_frequencies: int = 0
     num_blocks: int = 6
     num_heads: int = 12
     key_dim: int = 128
@@ -59,6 +66,7 @@ class PerParticleDIBModel(nn.Module):
     data_axis: str | None = None  # optional batch sharding alongside seq_axis
     use_flash: bool | None = None  # blockwise Pallas attention (None = auto on
     flash_min_seq: int = 1024      # TPU for sets >= flash_min_seq)
+    fuse_qkv: bool = False         # fused QKV projection (roofline remedy)
     remat: bool = False            # rematerialize attention blocks (HBM saver)
 
     @nn.nowrap
@@ -69,7 +77,7 @@ class PerParticleDIBModel(nn.Module):
         return GaussianEncoder(
             hidden=tuple(self.encoder_hidden),
             embedding_dim=self.embedding_dim,
-            num_posenc_frequencies=0,   # engineered 12-dim features, no posenc
+            num_posenc_frequencies=self.num_posenc_frequencies,
             activation=self.activation,
             logvar_offset=self.logvar_offset,
             compute_dtype=self.compute_dtype,
@@ -112,6 +120,7 @@ class PerParticleDIBModel(nn.Module):
             seq_impl=self.seq_impl,
             use_flash=self.use_flash,
             flash_min_seq=self.flash_min_seq,
+            fuse_qkv=self.fuse_qkv,
             remat=self.remat,
             name="aggregator",
         )(u)

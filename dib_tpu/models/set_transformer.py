@@ -32,12 +32,15 @@ class MultiHeadSelfAttention(nn.Module):
     """QKV/out projections around a pluggable attention core.
 
     Parameter layout matches ``nn.MultiHeadDotProductAttention`` (DenseGeneral
-    'query'/'key'/'value' -> [in, H, D], 'out' -> [H, D, out]), but the core
-    dispatches on the setting: dense fused attention for ordinary sets, the
-    blockwise Pallas flash kernel for large single-device sets (>=
-    ``flash_min_seq``, where the [S, S] score matrix stops being HBM-friendly),
-    ring or Ulysses collective attention when the sequence axis is sharded
-    over the mesh (``seq_axis``).
+    'query'/'key'/'value' -> [in, H, D], 'out' -> [H, D, out]) — unless
+    ``fuse_qkv=True``, which replaces the three projections with ONE
+    DenseGeneral 'qkv' -> [in, 3, H, D] (same math, different tree; the two
+    layouts' checkpoints are not interchangeable). The core dispatches on
+    the setting: dense fused attention for ordinary sets, the blockwise
+    Pallas flash kernel for large single-device sets (>= ``flash_min_seq``,
+    where the [S, S] score matrix stops being HBM-friendly), ring or Ulysses
+    collective attention when the sequence axis is sharded over the mesh
+    (``seq_axis``).
     """
 
     num_heads: int
@@ -48,14 +51,27 @@ class MultiHeadSelfAttention(nn.Module):
     seq_impl: str = "ring"
     flash_min_seq: int = 1024
     use_flash: bool | None = None   # None = auto (TPU and set >= flash_min_seq)
+    fuse_qkv: bool = False          # one [in, 3*H*D] projection instead of 3
+                                    # [in, H*D] matmuls — at the paper's K=32
+                                    # contraction a 3x wider N amortizes the
+                                    # MXU tile fill (roofline remedy; changes
+                                    # the param tree, so off by default for
+                                    # checkpoint compatibility)
 
     @nn.compact
     def __call__(self, x: Array) -> Array:
         head_dim = self.qkv_features // self.num_heads
-        proj = lambda name: nn.DenseGeneral(  # noqa: E731
-            features=(self.num_heads, head_dim), dtype=self.dtype, name=name
-        )
-        q, k, v = proj("query")(x), proj("key")(x), proj("value")(x)
+        if self.fuse_qkv:
+            qkv = nn.DenseGeneral(
+                features=(3, self.num_heads, head_dim), dtype=self.dtype,
+                name="qkv",
+            )(x)
+            q, k, v = qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :]
+        else:
+            proj = lambda name: nn.DenseGeneral(  # noqa: E731
+                features=(self.num_heads, head_dim), dtype=self.dtype, name=name
+            )
+            q, k, v = proj("query")(x), proj("key")(x), proj("value")(x)
         if self.use_flash and self.seq_axis is not None:
             raise ValueError(
                 "use_flash=True conflicts with seq_axis: the flash kernel is "
@@ -97,6 +113,7 @@ class SetAttentionBlock(nn.Module):
     seq_impl: str = "ring"
     use_flash: bool | None = None
     flash_min_seq: int = 1024
+    fuse_qkv: bool = False
 
     @nn.compact
     def __call__(self, x: Array) -> Array:
@@ -109,6 +126,7 @@ class SetAttentionBlock(nn.Module):
             seq_impl=self.seq_impl,
             use_flash=self.use_flash,
             flash_min_seq=self.flash_min_seq,
+            fuse_qkv=self.fuse_qkv,
         )(x)
         h = nn.LayerNorm(dtype=jnp.float32)(x + attn.astype(x.dtype))
         ff = MLP(tuple(self.ff_hidden), self.model_dim, self.ff_activation,
@@ -133,6 +151,7 @@ class SetTransformer(nn.Module):
     seq_impl: str = "ring"        # 'ring' | 'ulysses'
     use_flash: bool | None = None  # blockwise Pallas attention (None = auto)
     flash_min_seq: int = 1024      # auto-dispatch threshold on the set size
+    fuse_qkv: bool = False         # single fused QKV projection per block
     remat: bool = False            # rematerialize each block on the backward
                                    # pass: activations per block drop from
                                    # O(S*qkv_features) to O(S*model_dim)
@@ -156,6 +175,7 @@ class SetTransformer(nn.Module):
                 seq_impl=self.seq_impl,
                 use_flash=self.use_flash,
                 flash_min_seq=self.flash_min_seq,
+                fuse_qkv=self.fuse_qkv,
             )(x)
         pooled = x.mean(axis=-2)
         if self.seq_axis is not None:

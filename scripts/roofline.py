@@ -1,0 +1,212 @@
+"""Measure the utilization CEILING of the north-star's matmul shapes on the
+actual device (VERDICT round-4 item 3 / weak #5).
+
+``docs/performance.md`` argues analytically that the paper shapes (batch 32
+x 50 particles, model_dim 32 — contraction dims K=32 in the projections and
+K=50/128 in the attention matmuls) leave the 128x128 MXU mostly idle BY
+CONSTRUCTION. This script replaces the analytic claim with measurements:
+
+  1. every distinct matmul of one sweep step, timed STANDALONE at the exact
+     shapes the compiled step uses (8-replica batched, bfloat16), reporting
+     achieved TFLOP/s per shape;
+  2. reference points showing what the chip CAN do when shapes cooperate:
+     a 4096^3 dense matmul (the MXU-friendly ceiling) and the same op mix
+     with the contraction dims scaled up;
+  3. remedy microbenchmarks: the fused QKV projection (one K=32 -> N=4608
+     matmul vs three N=1536) and shared-weight row folding ([R*M, K] x one
+     weight vs the R-batched matmul the per-replica sweep needs);
+  4. the shape-implied ceiling: serial sum of best-case per-shape times ->
+     the steps/s the matmuls alone would allow if everything else were free,
+     vs the measured end-to-end steps/s from ``BENCH_CACHE.json``.
+
+Run ALONE on the TPU box (ambient env):  python scripts/roofline.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# North-star shape constants (amorphous notebook cell 8 / bench.py)
+R, B, P, F = 8, 32, 50, 12
+D_MODEL, HEADS, KEY_DIM = 32, 12, 128
+QKV = HEADS * KEY_DIM                       # 1536
+FF = 128
+ENC_H = 128
+ENC_OUT = 2 * D_MODEL
+HEAD_H = 256
+
+
+def time_matmul(a_shape, b_shape, *, iters=200, dtype="bfloat16",
+                batched=True) -> dict:
+    """Achieved TFLOP/s of ``a @ b`` at these shapes, steady-state.
+
+    The loop carries a data dependency (the operand is nudged by the
+    previous product's mean) so XLA cannot hoist or elide the matmuls; the
+    nudge's elementwise cost is O(M*K), negligible next to 2*M*K*N.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    dt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+    key = jax.random.key(0)
+    a = jax.random.normal(key, a_shape, jnp.float32).astype(dt)
+    b = jax.random.normal(key, b_shape, jnp.float32).astype(dt)
+    contract = "...mk,...kn->...mn" if batched else "mk,kn->mn"
+
+    def step(carry, _):
+        x, y = carry
+        out = jnp.einsum(contract, x, y)
+        x = x * (1.0 + 1e-6 * out.mean().astype(x.dtype))
+        return (x, y), None
+
+    @jax.jit
+    def run(a, b):
+        (a, _), _ = jax.lax.scan(step, (a, b), None, length=iters)
+        return a
+
+    run(a, b).block_until_ready()            # compile + warm
+    t0 = time.time()
+    run(a, b).block_until_ready()
+    dt_s = time.time() - t0
+
+    m, k = a_shape[-2], a_shape[-1]
+    n = b_shape[-1]
+    batch = 1
+    for s in a_shape[:-2]:
+        batch *= s
+    flops = 2.0 * batch * m * k * n * iters
+    return {
+        "a_shape": list(a_shape),
+        "b_shape": list(b_shape),
+        "dtype": dtype,
+        "iters": iters,
+        "wall_s": round(dt_s, 4),
+        "achieved_tflops": round(flops / dt_s / 1e12, 3),
+        "flops_per_call": flops / iters,
+    }
+
+
+def main() -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--report", default="ROOFLINE.json")
+    parser.add_argument("--iters", type=int, default=200)
+    args = parser.parse_args()
+
+    from dib_tpu.utils.compile_cache import enable_persistent_cache
+
+    enable_persistent_cache()
+    import jax
+
+    devices = jax.devices()
+    device_kind = devices[0].device_kind
+    print(f"devices: {devices}", file=sys.stderr)
+
+    it = args.iters
+    M = B * P                                  # rows per replica, 1600
+
+    shapes = {}
+    t_all = time.time()
+    # --- 1. the sweep step's own matmuls (R-batched: per-replica weights) ---
+    shapes["encoder_l1_K12"] = time_matmul((R, M, F), (R, F, ENC_H), iters=it)
+    shapes["encoder_l2_K128"] = time_matmul((R, M, ENC_H), (R, ENC_H, ENC_H), iters=it)
+    shapes["encoder_out_K128"] = time_matmul((R, M, ENC_H), (R, ENC_H, ENC_OUT), iters=it)
+    shapes["qkv_proj_K32_N1536"] = time_matmul((R, M, D_MODEL), (R, D_MODEL, QKV), iters=it)
+    shapes["attn_scores_K128"] = time_matmul(
+        (R * B * HEADS, P, KEY_DIM), (R * B * HEADS, KEY_DIM, P), iters=it)
+    shapes["attn_values_K50"] = time_matmul(
+        (R * B * HEADS, P, P), (R * B * HEADS, P, KEY_DIM), iters=it)
+    shapes["out_proj_K1536"] = time_matmul((R, M, QKV), (R, QKV, D_MODEL), iters=it)
+    shapes["ff1_K32"] = time_matmul((R, M, D_MODEL), (R, D_MODEL, FF), iters=it)
+    shapes["ff2_K128"] = time_matmul((R, M, FF), (R, FF, D_MODEL), iters=it)
+    shapes["head_K32"] = time_matmul((R, B, D_MODEL), (R, D_MODEL, HEAD_H), iters=it)
+
+    # --- 2. what the chip can do when shapes cooperate ---
+    shapes["ceiling_4096cubed"] = time_matmul(
+        (4096, 4096), (4096, 4096), iters=20, batched=False)
+    shapes["scaled_K512_N1536"] = time_matmul((R, M, 512), (R, 512, QKV), iters=it)
+
+    # --- 3. remedies ---
+    shapes["remedy_fused_qkv_K32_N4608"] = time_matmul(
+        (R, M, D_MODEL), (R, D_MODEL, 3 * QKV), iters=it)
+    shapes["remedy_shared_weight_rows_K32_N1536"] = time_matmul(
+        (R * M, D_MODEL), (D_MODEL, QKV), iters=it, batched=False)
+
+    # --- 4. shape-implied ceiling vs the measured end-to-end number ---
+    # Serial best case: one step's matmuls (fwd + ~2x bwd), each running at
+    # its measured standalone throughput, nothing else on the clock.
+    per_step = {
+        "encoder_l1_K12": 1, "encoder_l2_K128": 1, "encoder_out_K128": 1,
+        "qkv_proj_K32_N1536": 3 * 6, "attn_scores_K128": 6,
+        "attn_values_K50": 6, "out_proj_K1536": 6,
+        "ff1_K32": 6, "ff2_K128": 6,
+        "head_K32": 1,
+    }
+    serial_s = 0.0
+    total_flops = 0.0
+    for name, count in per_step.items():
+        entry = shapes[name]
+        call_s = entry["wall_s"] / entry["iters"]
+        serial_s += 3.0 * count * call_s              # fwd + 2x bwd
+        total_flops += 3.0 * count * entry["flops_per_call"]
+    ceiling_replica_steps_per_s = R / serial_s
+    cached = None
+    try:
+        with open(os.path.join(REPO, "BENCH_CACHE.json")) as f:
+            cached = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        pass
+    measured = cached.get("steps_per_s") if cached else None
+
+    report = {
+        "metric": "northstar_shape_matmul_ceiling",
+        "value": round(ceiling_replica_steps_per_s, 1),
+        "unit": "sweep steps/s (matmuls alone, measured per-shape ceilings)",
+        "measured_end_to_end_steps_per_s": measured,
+        "fraction_of_shape_ceiling": round(measured / ceiling_replica_steps_per_s, 3)
+        if measured else None,
+        "device_kind": device_kind,
+        "config": {"replicas": R, "batch": B, "particles": P,
+                   "model_dim": D_MODEL, "heads": HEADS, "key_dim": KEY_DIM},
+        "shapes": shapes,
+        "remedy_summary": {
+            "fused_qkv_tflops_vs_split": [
+                shapes["remedy_fused_qkv_K32_N4608"]["achieved_tflops"],
+                shapes["qkv_proj_K32_N1536"]["achieved_tflops"],
+            ],
+            "shared_weight_rows_tflops_vs_batched": [
+                shapes["remedy_shared_weight_rows_K32_N1536"]["achieved_tflops"],
+                shapes["qkv_proj_K32_N1536"]["achieved_tflops"],
+            ],
+        },
+        "note": (
+            "Per-shape standalone throughput of every matmul in one sweep "
+            "step at the exact compiled shapes (8-replica batched, bf16), "
+            "plus cooperative-shape references and remedy variants. The "
+            "shape-implied ceiling assumes fwd+2x-bwd matmuls run serially "
+            "at their standalone rates with everything else free; the "
+            "measured end-to-end steps/s (BENCH_CACHE.json) includes "
+            "sampling, KL, LayerNorms, validation, optimizer and history "
+            "writes."
+        ),
+        "wall_clock_s": round(time.time() - t_all, 1),
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    with open(args.report, "w") as f:
+        json.dump(report, f, indent=1)
+        f.write("\n")
+    print(json.dumps({k: report[k] for k in
+                      ("value", "measured_end_to_end_steps_per_s",
+                       "fraction_of_shape_ceiling")}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
