@@ -103,6 +103,18 @@ def build_parser() -> argparse.ArgumentParser:
                              "epochs and auto-resume when the dir holds a "
                              "checkpoint.")
     parser.add_argument("--checkpoint_frequency", type=int, default=500)
+    parser.add_argument("--watchdog", action="store_true",
+                        help="Supervise the run (train/watchdog.py): relaunch "
+                             "this command as a worker with checkpointing + a "
+                             "heartbeat, SIGKILL it when a chunk stalls past "
+                             "3x the trailing-median chunk wall-clock (or it "
+                             "crashes), and resume it from its checkpoint — "
+                             "stall/crash recovery without human attention.")
+    parser.add_argument("--heartbeat", type=str, default="",
+                        help="Write a chunk-boundary heartbeat JSON here "
+                             "(set automatically under --watchdog).")
+    parser.add_argument("--watchdog_floor_s", type=float, default=45.0)
+    parser.add_argument("--watchdog_first_timeout_s", type=float, default=600.0)
     return parser
 
 
@@ -244,6 +256,12 @@ def run(args) -> dict:
             return _CombinedHooks(hooks_r)
 
         hooks = [PerReplicaHook(make_replica_hook)] if cadences else []
+        if args.heartbeat:
+            from dib_tpu.train.watchdog import HeartbeatHook
+
+            # first: it blocks on the chunk itself, so the supervisor's
+            # inter-beat intervals are true chunk wall-clocks
+            hooks.insert(0, HeartbeatHook(args.heartbeat))
         keys = jax.random.split(jax.random.key(args.seed), len(ends))
         resume_states = resume_histories = None
         remaining = None
@@ -302,6 +320,10 @@ def run(args) -> dict:
     else:
         trainer = DIBTrainer(model, bundle, config, y_encoder=y_encoder)
         hooks, info_hook = make_hooks(outdir)
+        if args.heartbeat:
+            from dib_tpu.train.watchdog import HeartbeatHook
+
+            hooks.insert(0, HeartbeatHook(args.heartbeat))
         fit_key = jax.random.key(args.seed)
         resume_state = resume_history = None
         remaining = None
@@ -623,6 +645,30 @@ def _enable_cli_compile_cache() -> None:
         print(f"compile cache: {status}", file=sys.stderr)
 
 
+def _watchdog_main(args, argv: Sequence[str]) -> int:
+    """Supervised CLI training: re-exec this command as a worker under
+    ``dib_tpu.train.watchdog.supervise`` with checkpointing + a heartbeat;
+    stalled or crashed workers are killed and resumed from their last
+    chunk-aligned checkpoint (bit-identical continuation)."""
+    from dib_tpu.train.watchdog import WatchdogConfig, supervise_self
+
+    result = supervise_self(
+        [sys.executable, "-m", "dib_tpu.cli"], argv,
+        outdir=args.artifact_outdir,
+        watchdog_flag="--watchdog",
+        heartbeat_flag="--heartbeat",
+        checkpoint_flag="--checkpoint_dir",
+        heartbeat=args.heartbeat,
+        checkpoint_dir=args.checkpoint_dir,
+        config=WatchdogConfig(
+            first_beat_timeout_s=args.watchdog_first_timeout_s,
+            floor_s=args.watchdog_floor_s,
+        ),
+    )
+    print(json.dumps({"watchdog": result}))
+    return 0 if result["returncode"] == 0 else 1
+
+
 def main(argv: Sequence[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if argv and argv[0] == "workload":
@@ -634,6 +680,8 @@ def main(argv: Sequence[str] | None = None) -> int:
         raise SystemExit(
             "Place the subcommand first: python -m dib_tpu workload <name> ..."
         )
+    if args.watchdog:
+        return _watchdog_main(args, argv)
     _enable_cli_compile_cache()
     summary = run(args)
     print(json.dumps(summary))

@@ -42,7 +42,7 @@ import sys
 import time
 from typing import Sequence
 
-__all__ = ["HeartbeatHook", "WatchdogConfig", "supervise"]
+__all__ = ["HeartbeatHook", "WatchdogConfig", "supervise", "supervise_self"]
 
 
 class HeartbeatHook:
@@ -142,6 +142,82 @@ def supervise(
     cfg = config or WatchdogConfig()
     mitigations: list[dict] = []
     t_start = time.time()
+    # The worker runs in its own session (so WE can kill its whole group),
+    # which also means it would SURVIVE the supervisor's death — an external
+    # SIGTERM/SIGINT to the supervisor must take the worker down with it,
+    # or a timed-out supervisor leaves an orphan training against the same
+    # checkpoint dir as its replacement.
+    current: list[subprocess.Popen | None] = [None]
+
+    def _teardown(signum, frame):
+        proc = current[0]
+        if proc is not None and proc.poll() is None:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+        signal.signal(signum, signal.SIG_DFL)
+        os.kill(os.getpid(), signum)
+
+    prev_handlers = {}
+    for sig in (signal.SIGTERM, signal.SIGINT, signal.SIGHUP):
+        try:
+            if signal.getsignal(sig) is signal.SIG_IGN:
+                continue   # nohup'd/shielded runs keep their protection
+            prev_handlers[sig] = signal.signal(sig, _teardown)
+        except (ValueError, OSError):   # non-main thread / unsupported
+            pass
+    try:
+        return _supervise_loop(cmd, heartbeat_path, cfg, env, log,
+                               mitigations, t_start, current)
+    finally:
+        for sig, handler in prev_handlers.items():
+            signal.signal(sig, handler)
+        proc = current[0]
+        if proc is not None and proc.poll() is None:   # exception path
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+
+
+def supervise_self(
+    worker_prefix: Sequence[str],
+    argv: Sequence[str],
+    *,
+    outdir: str,
+    watchdog_flag: str,
+    heartbeat_flag: str,
+    checkpoint_flag: str,
+    heartbeat: str = "",
+    checkpoint_dir: str = "",
+    config: WatchdogConfig | None = None,
+) -> dict:
+    """Re-exec the CURRENT command as a supervised worker.
+
+    Shared wrapper for self-supervising entry points (``dib_tpu.cli
+    --watchdog``, ``scripts/northstar_run.py --watchdog``): strips the
+    watchdog flag from ``argv``, defaults the heartbeat/checkpoint paths
+    under ``outdir``, injects the two flags if the caller didn't pass them,
+    and runs :func:`supervise` on ``worker_prefix + argv``. Returns the
+    supervise() report plus the resolved ``heartbeat``/``checkpoint_dir``.
+    """
+    os.makedirs(outdir, exist_ok=True)
+    heartbeat = heartbeat or os.path.join(outdir, "heartbeat.json")
+    checkpoint_dir = checkpoint_dir or os.path.join(outdir, "ckpt")
+    worker = [a for a in argv if a != watchdog_flag]
+    for flag, value in ((heartbeat_flag, heartbeat),
+                        (checkpoint_flag, checkpoint_dir)):
+        if flag not in worker:
+            worker += [flag, value]
+    result = supervise(list(worker_prefix) + worker, heartbeat, config)
+    result["heartbeat"] = heartbeat
+    result["checkpoint_dir"] = checkpoint_dir
+    return result
+
+
+def _supervise_loop(cmd, heartbeat_path, cfg, env, log, mitigations,
+                    t_start, current) -> dict:
     launches = 0
     while True:
         # a stale beat from the previous attempt must not mask a wedged
@@ -150,6 +226,7 @@ def supervise(
             os.unlink(heartbeat_path)
         launches += 1
         proc = subprocess.Popen(list(cmd), env=env, start_new_session=True)
+        current[0] = proc
         launched = time.time()
         last_beat: dict | None = None
         last_beat_seen = launched

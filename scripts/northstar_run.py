@@ -54,24 +54,21 @@ def watchdog_main(args) -> int:
     ``watchdog`` section and the headline ``value`` replaced by the
     END-TO-END supervised wall-clock — kills, restarts, re-compiles and
     re-done chunks all count against the 10-minute target."""
-    from dib_tpu.train.watchdog import WatchdogConfig, supervise
-
-    os.makedirs(args.outdir, exist_ok=True)
-    heartbeat = args.heartbeat or os.path.join(args.outdir, "heartbeat.json")
-    checkpoint_dir = args.checkpoint_dir or os.path.join(args.outdir, "ckpt")
-    worker_cmd = [sys.executable, os.path.abspath(__file__)]
-    skip = {"--watchdog"}
-    argv = [a for a in sys.argv[1:] if a not in skip]
-    for flag, value in (("--heartbeat", heartbeat),
-                        ("--checkpoint-dir", checkpoint_dir)):
-        if flag not in argv:
-            argv += [flag, value]
-    worker_cmd += argv
+    from dib_tpu.train.watchdog import WatchdogConfig, supervise_self
 
     cfg = WatchdogConfig(first_beat_timeout_s=args.watchdog_first_timeout_s,
                          floor_s=args.watchdog_floor_s)
     t0 = time.time()
-    result = supervise(worker_cmd, heartbeat, cfg)
+    result = supervise_self(
+        [sys.executable, os.path.abspath(__file__)], sys.argv[1:],
+        outdir=args.outdir,
+        watchdog_flag="--watchdog",
+        heartbeat_flag="--heartbeat",
+        checkpoint_flag="--checkpoint-dir",
+        heartbeat=args.heartbeat,
+        checkpoint_dir=args.checkpoint_dir,
+        config=cfg,
+    )
     total_s = time.time() - t0
     try:
         # a report predating this supervised run is some EARLIER run's

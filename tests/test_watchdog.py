@@ -227,3 +227,90 @@ def test_supervised_stall_recovery_is_bit_identical(tmp_path):
             a[k], b[k],
             err_msg=f"{k}: supervised kill+resume diverged from baseline",
         )
+
+
+@pytest.mark.slow
+def test_cli_watchdog_supervised_run(tmp_path):
+    """`python -m dib_tpu.cli train --watchdog`: the CLI re-execs itself as
+    a heartbeating, checkpointing worker under supervise() and reports the
+    watchdog result. (Stall/crash mitigation logic is covered by the unit
+    tests above; this pins the CLI wiring end to end.)"""
+    outdir = tmp_path / "art"
+    cmd = [
+        sys.executable, "-m", "dib_tpu.cli", "train",
+        "--watchdog",
+        "--dataset", "boolean_circuit",
+        "--artifact_outdir", str(outdir),
+        "--number_pretraining_epochs", "5",
+        "--number_annealing_epochs", "10",
+        "--batch_size", "64",
+        "--feature_encoder_architecture", "16",
+        "--integration_network_architecture", "32",
+        "--feature_embedding_dimension", "4",
+        "--max_val_points", "256",
+        "--checkpoint_frequency", "5",
+        "--watchdog_first_timeout_s", "420",
+    ]
+    proc = subprocess.run(cmd, env=_worker_env(), capture_output=True,
+                          text=True, timeout=540, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    result = json.loads(proc.stdout.strip().splitlines()[-1])["watchdog"]
+    assert result["returncode"] == 0
+    assert result["launches"] == 1 and result["mitigations"] == []
+    assert os.path.exists(outdir / "history.npz")
+    assert os.path.exists(outdir / "heartbeat.json")
+    with open(outdir / "heartbeat.json") as f:
+        beat = json.load(f)
+    assert beat["beat"] >= 3          # one per 5-epoch chunk over 15 epochs
+
+
+def test_supervisor_termination_kills_worker(tmp_path):
+    """SIGTERM to the supervisor must take the worker down with it —
+    otherwise a timed-out supervisor leaves an orphan training against the
+    same checkpoint dir as its replacement."""
+    hb = str(tmp_path / "hb.json")
+    pidfile = str(tmp_path / "worker.pid")
+    worker_body = f"""
+        import os, time
+        with open({pidfile!r}, "w") as f:
+            f.write(str(os.getpid()))
+        time.sleep(600)
+    """
+    sup_body = f"""
+        import sys
+        sys.path.insert(0, {REPO!r})
+        from dib_tpu.train.watchdog import WatchdogConfig, supervise
+        supervise([sys.executable, {str(tmp_path / 'inner.py')!r}], {hb!r},
+                  WatchdogConfig(first_beat_timeout_s=500, poll_s=0.1))
+    """
+    (tmp_path / "inner.py").write_text(textwrap.dedent(worker_body))
+    (tmp_path / "sup.py").write_text(textwrap.dedent(sup_body))
+    sup = subprocess.Popen([sys.executable, str(tmp_path / "sup.py")])
+    worker_pid = None
+    try:
+        deadline = time.time() + 30
+        while not os.path.exists(pidfile) and time.time() < deadline:
+            time.sleep(0.1)
+        assert os.path.exists(pidfile), "worker never started"
+        worker_pid = int(open(pidfile).read())
+        sup.terminate()                       # what `timeout` sends
+        assert sup.wait(timeout=15) != 0
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            try:
+                os.kill(worker_pid, 0)        # still alive?
+            except ProcessLookupError:
+                break
+            time.sleep(0.2)
+        else:
+            pytest.fail("worker survived the supervisor's SIGTERM")
+    finally:
+        # never leak the supervisor or its sleeping worker into the session
+        if sup.poll() is None:
+            sup.kill()
+            sup.wait()
+        if worker_pid is not None:
+            try:
+                os.kill(worker_pid, 9)
+            except ProcessLookupError:
+                pass
