@@ -96,11 +96,27 @@ def build_report(runs: list[dict], runs_requested: int) -> dict:
     # ordinary chunk 0 counts stall-free regardless of its value.
     stall_free, stalled = [], []
     n_observed = 0
+    n_mitigated = 0
     for e in runs:
         v = e.get("value")
         if not isinstance(v, (int, float)):
             continue
-        if e.get("device_stall_s"):
+        mitigations = (e.get("watchdog") or {}).get("mitigations", [])
+        if any(m.get("type") == "stall_kill" for m in mitigations):
+            # the watchdog killed and re-dispatched mid-run: the stall is
+            # directly observed AND mitigated (post-resume chunk clocks
+            # alone would look clean)
+            n_observed += 1
+            n_mitigated += 1
+            stalled.append(v)
+        elif mitigations:
+            # crash_restart only: not a device stall, but the end-to-end
+            # value carries a re-init/re-compile — not a clean
+            # single-process measurement, so it must not tighten the
+            # stall-free mode
+            n_mitigated += 1
+            stalled.append(v)
+        elif e.get("device_stall_s"):
             n_observed += 1
             stalled.append(v)
         elif (("checkpoint_chunk_s" not in e or e.get("chunk0_suspect"))
@@ -112,14 +128,18 @@ def build_report(runs: list[dict], runs_requested: int) -> dict:
     analysis = {
         "summary": (
             f"Bimodal split: {len(stall_free)} stall-free / {len(stalled)} "
-            f"stalled runs. {n_observed} of the stalled runs have the stall "
-            "directly observed in checkpoint_chunk_s (device_stall_s: a "
-            "chunk of the same compiled executable running >3x the steady "
-            "median); the rest are runs where instrumentation cannot rule a "
-            "stall out (no chunk clocks, or a chunk-0 excess beyond "
-            "warm-compile scale) classified by the range-midpoint heuristic "
-            "— instrumented runs with clean chunks count stall-free. "
-            "Steady-state "
+            f"stalled/mitigated runs. {n_observed} of those have the stall "
+            "directly observed — via device_stall_s (a chunk of the same "
+            "compiled executable running >3x the steady median in "
+            "checkpoint_chunk_s) or via a watchdog stall_kill mitigation "
+            "(heartbeat overdue; post-resume chunk clocks are clean by "
+            f"construction). {n_mitigated} runs carry watchdog mitigations "
+            "(stall or crash) and are excluded from the stall-free mode "
+            "regardless of their chunk clocks. The rest are runs where "
+            "instrumentation cannot rule a stall out (no chunk clocks, or "
+            "a chunk-0 excess beyond warm-compile scale) classified by the "
+            "range-midpoint heuristic — instrumented runs with clean "
+            "chunks count stall-free. Steady-state "
             "throughput is uniform wherever instrumented — stalls are "
             "shared-tunneled-device artifacts, not program behavior; see "
             "docs/performance.md."
@@ -127,6 +147,7 @@ def build_report(runs: list[dict], runs_requested: int) -> dict:
         "stall_free_mode_minutes": sorted(stall_free),
         "stalled_mode_minutes": sorted(stalled),
         "stalls_directly_observed": n_observed,
+        "stalls_mitigated_by_watchdog": n_mitigated,
     }
     return {
         "metric": "amorphous_set_transformer_beta_sweep_measured_ensemble",
@@ -155,6 +176,11 @@ def main() -> int:
     parser.add_argument("--timeout", type=float, default=1800.0,
                         help="per-run kill timeout (s); a hung tunnel must "
                              "not wedge the ensemble")
+    parser.add_argument("--watchdog", action="store_true",
+                        help="run every member under northstar_run's "
+                             "--watchdog supervision (stall kill + "
+                             "checkpoint re-dispatch); each entry then "
+                             "records the mitigations its run needed")
     parser.add_argument("--merge", nargs="+", default=None, metavar="REPORT",
                         help="aggregate existing ensemble reports (their "
                              "'runs' entries) into one report instead of "
@@ -205,6 +231,8 @@ def main() -> int:
             "--no-render",
             "--compile-cache", args.compile_cache,
         ]
+        if args.watchdog:
+            cmd.append("--watchdog")
         entry: dict = {
             "run": i,
             "load_1m_before": loadavg()[0],
@@ -227,9 +255,20 @@ def main() -> int:
             for key in ("value", "sweep_wall_clock_s", "measured_wall_clock_s",
                         "compile_cache", "all_finite", "score_dtype",
                         "device_kind", "final_total_kl_bits_per_replica",
-                        "checkpoint_chunk_s", "checkpoint_instrumentation_s"):
+                        "checkpoint_chunk_s", "checkpoint_instrumentation_s",
+                        "single_process_minutes", "resumed_from_epoch",
+                        "watchdog", "error"):
                 if key in rep:
                     entry[key] = rep[key]
+            # A failed run (non-finite values; watchdog gave up) may still
+            # have written a report with a wall-clock 'value' — that is the
+            # duration of a FAILURE, not a measurement, and must not enter
+            # the ensemble statistics.
+            if entry.get("returncode") != 0 and "value" in entry:
+                entry["unmeasured_value_minutes"] = entry.pop("value")
+                entry.setdefault(
+                    "error", f"run failed (rc={entry.get('returncode')})"
+                )
         except (OSError, json.JSONDecodeError):
             entry.setdefault("error", "no run report written")
         runs.append(entry)

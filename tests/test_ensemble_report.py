@@ -52,7 +52,7 @@ def test_build_report_median_and_split():
     assert ana["stall_free_mode_minutes"] == [6.8, 6.9]
     assert ana["stalled_mode_minutes"] == [11.0, 11.2]
     assert ana["stalls_directly_observed"] == 1
-    assert "1 of the stalled runs" in ana["summary"]
+    assert "1 of those have the stall directly observed" in ana["summary"]
 
 
 def test_build_report_first_chunk_stall_falls_back_to_midpoint():
@@ -79,3 +79,34 @@ def test_build_report_empty():
     rep = ens.build_report([{"run": 0, "error": "killed"}], 1)
     assert rep["runs_completed"] == 0
     assert rep["median_minutes"] is None
+
+
+def test_build_report_watchdog_mitigated_run_counts_as_stalled():
+    # a watchdog-mitigated run has CLEAN post-resume chunk clocks; the
+    # mitigation record itself is the direct stall observation
+    runs = [
+        {"run": 0, "value": 6.9, "checkpoint_chunk_s": [54.0] + [16.4] * 19},
+        {"run": 1, "value": 8.4,
+         "checkpoint_chunk_s": [54.0] + [16.4] * 12,
+         "watchdog": {"launches": 2, "mitigations": [
+             {"type": "stall_kill", "epoch": 175, "waited_s": 51.0}]}},
+    ]
+    ana = ens.build_report(runs, 2)["distribution_analysis"]
+    assert ana["stalled_mode_minutes"] == [8.4]
+    assert ana["stalls_directly_observed"] == 1
+    assert ana["stalls_mitigated_by_watchdog"] == 1
+
+
+def test_build_report_crash_mitigated_run_excluded_from_stall_free_mode():
+    runs = [
+        {"run": 0, "value": 6.8, "checkpoint_chunk_s": [54.0] + [16.4] * 19},
+        {"run": 1, "value": 7.9,
+         "checkpoint_chunk_s": [54.0] + [16.4] * 10,
+         "watchdog": {"launches": 2, "mitigations": [
+             {"type": "crash_restart", "returncode": 1}]}},
+    ]
+    ana = ens.build_report(runs, 2)["distribution_analysis"]
+    assert ana["stall_free_mode_minutes"] == [6.8]
+    assert ana["stalled_mode_minutes"] == [7.9]
+    assert ana["stalls_directly_observed"] == 0
+    assert ana["stalls_mitigated_by_watchdog"] == 1
