@@ -81,7 +81,8 @@ def annotate_stalls(entry: dict) -> dict:
     return entry
 
 
-def build_report(runs: list[dict], runs_requested: int) -> dict:
+def build_report(runs: list[dict], runs_requested: int,
+                 member_extra: list | tuple = ()) -> dict:
     import statistics
 
     runs = [annotate_stalls(dict(e)) for e in runs]
@@ -149,7 +150,7 @@ def build_report(runs: list[dict], runs_requested: int) -> dict:
         "stalls_directly_observed": n_observed,
         "stalls_mitigated_by_watchdog": n_mitigated,
     }
-    return {
+    report = {
         "metric": "amorphous_set_transformer_beta_sweep_measured_ensemble",
         "unit": "minutes",
         "runs_requested": runs_requested,
@@ -164,6 +165,13 @@ def build_report(runs: list[dict], runs_requested: int) -> dict:
         "runs": runs,
         "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
+    if member_extra:
+        # non-default member configuration: the 10-minute target applies to
+        # the full-scale north star only
+        report["member_extra_flags"] = list(member_extra)
+        report["non_default_configuration"] = True
+        report["vs_baseline_median"] = None
+    return report
 
 
 def main() -> int:
@@ -186,7 +194,16 @@ def main() -> int:
                              "'runs' entries) into one report instead of "
                              "measuring — how the committed multi-batch "
                              "NORTHSTAR_ENSEMBLE.json is built")
-    args = parser.parse_args()
+    # unknown flags pass through to every northstar_run member (e.g.
+    # --replicas/--steps-per-epoch/--chunk-epochs for reduced-scale demos);
+    # they are recorded in the report and disqualify the baseline ratio
+    args, member_extra = parser.parse_known_args()
+    if args.merge and member_extra:
+        raise SystemExit(
+            f"unrecognized flags with --merge: {member_extra} (member "
+            "passthrough only applies when measuring; a typo here would "
+            "silently change which artifact gets written)"
+        )
 
     if args.merge:
         merged: list[dict] = []
@@ -233,6 +250,7 @@ def main() -> int:
         ]
         if args.watchdog:
             cmd.append("--watchdog")
+        cmd += member_extra
         entry: dict = {
             "run": i,
             "load_1m_before": loadavg()[0],
@@ -241,10 +259,21 @@ def main() -> int:
         print(f"run {i}: load={entry['load_1m_before']:.2f} "
               f"census={entry['other_python_processes']}", file=sys.stderr)
         t0 = time.time()
+        proc = subprocess.Popen(cmd)
         try:
-            proc = subprocess.run(cmd, timeout=args.timeout)
-            entry["returncode"] = proc.returncode
+            entry["returncode"] = proc.wait(timeout=args.timeout)
         except subprocess.TimeoutExpired:
+            # SIGTERM first: under --watchdog the member is a SUPERVISOR
+            # whose worker lives in its own session — only a catchable
+            # signal lets its teardown handler take the worker down too
+            # (a straight SIGKILL orphans a full training process against
+            # the run's checkpoint dir).
+            proc.terminate()
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
             entry["returncode"] = None
             entry["error"] = f"killed after {args.timeout:.0f}s"
         entry["driver_wall_clock_s"] = round(time.time() - t0, 1)
@@ -275,7 +304,7 @@ def main() -> int:
         print(f"run {i}: {entry.get('value')} min "
               f"(rc={entry['returncode']})", file=sys.stderr)
 
-    report = build_report(runs, args.runs)
+    report = build_report(runs, args.runs, member_extra)
     with open(args.report, "w") as f:
         json.dump(report, f, indent=1)
         f.write("\n")

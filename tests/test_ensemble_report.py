@@ -110,3 +110,12 @@ def test_build_report_crash_mitigated_run_excluded_from_stall_free_mode():
     assert ana["stalled_mode_minutes"] == [7.9]
     assert ana["stalls_directly_observed"] == 0
     assert ana["stalls_mitigated_by_watchdog"] == 1
+
+
+def test_build_report_member_extras_disqualify_baseline():
+    runs = [{"run": 0, "value": 1.2}]
+    rep = ens.build_report(runs, 1, ["--replicas", "2"])
+    assert rep["vs_baseline_median"] is None
+    assert rep["non_default_configuration"] is True
+    assert rep["member_extra_flags"] == ["--replicas", "2"]
+    assert rep["median_minutes"] == 1.2
