@@ -197,6 +197,25 @@ def child_main() -> None:
     beta_ends = np.logspace(-2, 0, NUM_REPLICAS)
     sweep = BetaSweepTrainer(model, bundle, config, 2e-6, beta_ends)
 
+    # Event stream for the measurement itself (docs/observability.md): the
+    # child appends run_start/compile/chunk/run_end and the printed record
+    # embeds the rolled-up summary, so every bench line is comparable to a
+    # full run's events.jsonl via `dib_tpu telemetry compare`.
+    import tempfile
+
+    from dib_tpu.telemetry import EventWriter, runtime_manifest, summarize
+    from dib_tpu.telemetry.events import device_memory_stats
+
+    persistent_dir = os.environ.get("DIB_BENCH_TELEMETRY_DIR")
+    telemetry_dir = persistent_dir or tempfile.mkdtemp(prefix="bench_events_")
+    telemetry = EventWriter(telemetry_dir)
+    telemetry.run_start(runtime_manifest(
+        config=config,
+        extra={"bench": METRIC, "replicas": NUM_REPLICAS,
+               "compile_cache": cache_status,
+               "score_dtype": score_dtype_name},
+    ))
+
     init_keys = jax.random.split(jax.random.key(0), NUM_REPLICAS)
     warm_keys = jax.random.split(jax.random.key(1), NUM_REPLICAS)
     meas_keys = jax.random.split(jax.random.key(2), NUM_REPLICAS)
@@ -217,6 +236,8 @@ def child_main() -> None:
     log(f"init+compile+first chunk: {compile_s:.1f}s "
         f"(model init {t_after_init - t0:.1f}s, "
         f"chunk compile+exec {time.time() - t_after_init:.1f}s)")
+    telemetry.compile(name="sweep_chunk", seconds=compile_s,
+                      cache=cache_status)
 
     t1 = time.time()
     states, histories = sweep.run_chunk(states, histories, meas_keys, MEASURE_EPOCHS)
@@ -225,6 +246,9 @@ def child_main() -> None:
 
     sweep_steps = MEASURE_EPOCHS * STEPS_PER_EPOCH * NUM_REPLICAS
     steps_per_s = sweep_steps / measure_s
+    telemetry.chunk(epoch=2 * MEASURE_EPOCHS, steps=sweep_steps,
+                    seconds=measure_s, replicas=NUM_REPLICAS,
+                    memory=device_memory_stats())
     # Validation runs once per epoch inside the measured chunk, so the
     # projection includes instrumentation overhead, as the north star does.
     projected_s = FULL_SWEEP_STEPS * NUM_REPLICAS / steps_per_s + compile_s
@@ -252,6 +276,8 @@ def child_main() -> None:
     kl = np.asarray(histories["kl_per_feature"])
     assert np.isfinite(kl).all(), "non-finite KL in benchmark run"
 
+    telemetry.run_end(status="ok", projected_minutes=round(projected_min, 3))
+    telemetry.close()
     print(
         json.dumps(
             {
@@ -269,10 +295,24 @@ def child_main() -> None:
                 "device_kind": device_kind,
                 "num_replicas": NUM_REPLICAS,
                 "full_sweep_steps": FULL_SWEEP_STEPS,
+                # the run's own event stream, rolled up (same shape as
+                # `dib_tpu telemetry summarize`) — makes every bench line
+                # comparable/gateable against any run's events.jsonl.
+                # run_id-scoped: a reused DIB_BENCH_TELEMETRY_DIR appends
+                # runs, and the summary must cover THIS one only
+                "telemetry": summarize(telemetry_dir,
+                                       run_id=telemetry.run_id),
+                # a lasting path only when the caller asked for one — the
+                # unnamed tmpdir is deleted below once rolled up
+                "events_path": telemetry.path if persistent_dir else None,
             }
         ),
         flush=True,
     )
+    if not persistent_dir:
+        import shutil
+
+        shutil.rmtree(telemetry_dir, ignore_errors=True)
 
 
 # ==========================================================================
@@ -469,6 +509,17 @@ def parent_main() -> None:
 
 if __name__ == "__main__":
     if "--child" in sys.argv:
-        child_main()
+        try:
+            child_main()
+        except BaseException as exc:
+            # crash-path terminal record: the child's event stream must
+            # not end on a dangling chunk (docs/observability.md) — e.g.
+            # the non-finite-KL assert fires before run_end. The path is
+            # logged because an unnamed tmpdir is otherwise undiscoverable
+            # (it is NOT cleaned up on failure: it's the crash forensics).
+            from dib_tpu.telemetry import finalize_crashed
+
+            finalize_crashed(exc, log=log)
+            raise
     else:
         parent_main()

@@ -22,4 +22,23 @@ Architecture stance (not a port):
 
 __version__ = "0.1.0"
 
-from dib_tpu import ops, models, data, train, parallel, utils, viz  # noqa: F401
+# PEP 562 lazy submodule access: `dib_tpu.train` / `from dib_tpu import ops`
+# still work, but importing the package no longer imports jax — host-only
+# entry points (`python -m dib_tpu telemetry`, the watchdog supervisor)
+# must stay backend-free and fast.
+_SUBMODULES = ("ops", "models", "data", "train", "parallel", "utils", "viz",
+               "workloads", "telemetry", "ctw")
+
+
+def __getattr__(name):
+    if name in _SUBMODULES:
+        import importlib
+
+        module = importlib.import_module(f"dib_tpu.{name}")
+        globals()[name] = module
+        return module
+    raise AttributeError(f"module 'dib_tpu' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_SUBMODULES))

@@ -26,15 +26,27 @@ import sys
 from typing import Sequence
 
 
+def _add_telemetry_dir_flag(parser, default_desc: str) -> None:
+    """The one definition of --telemetry-dir (train and workload parsers
+    share it; only the default-resolution description differs)."""
+    parser.add_argument("--telemetry-dir", "--telemetry_dir",
+                        dest="telemetry_dir", type=str, default=None,
+                        help="Directory for the run's events.jsonl "
+                             "(docs/observability.md). Default: "
+                             f"{default_desc}; pass '' to disable.")
+
+
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="dib_tpu",
         description="Train a Distributed IB model on any registered dataset.",
     )
     parser.add_argument("command", nargs="?", default="train",
-                        choices=["train", "workload"],
-                        help="Subcommand: 'train' (flags below) or 'workload' "
-                             "(paper workloads; see `dib_tpu workload --help`).")
+                        choices=["train", "workload", "telemetry"],
+                        help="Subcommand: 'train' (flags below), 'workload' "
+                             "(paper workloads; see `dib_tpu workload --help`), "
+                             "or 'telemetry' (summarize/compare run event "
+                             "streams; see `dib_tpu telemetry --help`).")
     parser.add_argument("--dataset", default="boolean_circuit",
                         help="Registered dataset name (see dib_tpu.data.available_datasets()).")
     parser.add_argument("--data_path", type=str, default="./data/")
@@ -115,6 +127,7 @@ def build_parser() -> argparse.ArgumentParser:
                              "(set automatically under --watchdog).")
     parser.add_argument("--watchdog_floor_s", type=float, default=45.0)
     parser.add_argument("--watchdog_first_timeout_s", type=float, default=600.0)
+    _add_telemetry_dir_flag(parser, "the run dir (--artifact_outdir)")
     return parser
 
 
@@ -128,7 +141,7 @@ def _dataset_kwargs(args) -> dict:
     }
 
 
-def run(args) -> dict:
+def run(args, compile_cache_status: str | None = None) -> dict:
     """Execute a training run from parsed flags. Returns a result summary."""
     import jax
     import numpy as np
@@ -200,6 +213,37 @@ def run(args) -> dict:
     outdir = args.artifact_outdir
     os.makedirs(outdir, exist_ok=True)
 
+    # Event stream (docs/observability.md): default into the run dir; an
+    # explicit '' disables. The whole telemetry layer rides chunk
+    # boundaries, so a disabled stream changes nothing on the hot path.
+    from dib_tpu.telemetry import open_writer, runtime_manifest, shared_run_id
+
+    telemetry = open_writer(
+        getattr(args, "telemetry_dir", None), outdir,
+        run_id=shared_run_id(), process_index=jax.process_index(),
+    )
+    if telemetry is not None:
+        manifest_extra = {"dataset": args.dataset, "seed": args.seed}
+        if compile_cache_status is not None:
+            manifest_extra["compile_cache"] = compile_cache_status
+
+    def _telemetry_run_start(extra=None, mesh_shape=None):
+        """The one run_start for both fit branches (sweep adds the mesh
+        shape and beta grid on top of the shared manifest extras)."""
+        if telemetry is not None:
+            telemetry.run_start(runtime_manifest(
+                config=config, mesh_shape=mesh_shape,
+                extra={**manifest_extra, **(extra or {})},
+            ))
+
+    def _timed(hooks):
+        """Per-invocation hook wall-clock onto the event stream."""
+        if telemetry is None:
+            return hooks
+        from dib_tpu.train.hooks import TimedHook
+
+        return [TimedHook(h, telemetry) for h in hooks]
+
     cadences = []
     if args.save_compression_matrices_frequency:
         cadences.append(args.save_compression_matrices_frequency)
@@ -262,6 +306,11 @@ def run(args) -> dict:
             # first: it blocks on the chunk itself, so the supervisor's
             # inter-beat intervals are true chunk wall-clocks
             hooks.insert(0, HeartbeatHook(args.heartbeat))
+        _telemetry_run_start(
+            extra={"beta_ends": [float(b) for b in ends]},
+            mesh_shape=(dict(zip(mesh.axis_names, mesh.devices.shape))
+                        if mesh is not None else None),
+        )
         keys = jax.random.split(jax.random.key(args.seed), len(ends))
         resume_states = resume_histories = None
         remaining = None
@@ -288,10 +337,12 @@ def run(args) -> dict:
                 summary["resumed_from_epoch"] = done
                 print(f"resuming sweep from checkpoint at epoch {done} "
                       f"({remaining} to go)", file=sys.stderr)
+        hooks = _timed(hooks)
         states, records = sweep.fit(keys, num_epochs=remaining, hooks=hooks,
                                     hook_every=hook_every,
                                     states=resume_states,
-                                    histories=resume_histories)
+                                    histories=resume_histories,
+                                    telemetry=telemetry)
         for r, record in enumerate(records):
             info_hook_r = replica_info_hooks.get(r)
             if info_hook_r is not None and info_hook_r.records:
@@ -324,6 +375,7 @@ def run(args) -> dict:
             from dib_tpu.train.watchdog import HeartbeatHook
 
             hooks.insert(0, HeartbeatHook(args.heartbeat))
+        _telemetry_run_start()
         fit_key = jax.random.key(args.seed)
         resume_state = resume_history = None
         remaining = None
@@ -357,10 +409,12 @@ def run(args) -> dict:
                 summary["resumed_from_epoch"] = done
                 print(f"resuming from checkpoint at epoch {done} "
                       f"({remaining} to go)", file=sys.stderr)
+        hooks = _timed(hooks)
         state, history = trainer.fit(fit_key, num_epochs=remaining,
                                      hooks=hooks, hook_every=hook_every,
                                      state=resume_state,
-                                     history=resume_history)
+                                     history=resume_history,
+                                     telemetry=telemetry)
         bits = history.to_bits(bundle.loss_is_info_based)
         path = save_distributed_info_plane(
             bits.kl_per_feature, bits.loss, outdir, entropy_y=entropy_y)
@@ -377,6 +431,14 @@ def run(args) -> dict:
                               info_hook.epochs, info_hook.bounds_bits,
                               resumed_from=summary.get("resumed_from_epoch"))
             summary["artifacts"].append(os.path.join(outdir, "info_bounds.npz"))
+    if telemetry is not None:
+        telemetry.run_end(
+            status="ok",
+            final_val_loss=summary.get("final_val_loss"),
+            resumed_from_epoch=summary.get("resumed_from_epoch"),
+        )
+        telemetry.close()
+        summary["events_path"] = telemetry.path
     with open(os.path.join(outdir, "run_summary.json"), "w") as f:
         json.dump(summary, f, indent=1)
         f.write("\n")
@@ -424,6 +486,19 @@ class _CombinedHooks:
 
     def __init__(self, hooks: Sequence):
         self.hooks = list(hooks)
+
+    def fires_at(self, epoch: int) -> bool:
+        """TimedHook's phantom-invocation guard: the combination fires
+        when ANY inner hook would (ungated hooks always fire)."""
+        for hook in self.hooks:
+            fires_at = getattr(hook, "fires_at", None)
+            if fires_at is None or fires_at(epoch):
+                return True
+        return False
+
+    @property
+    def telemetry_inner_hooks(self):
+        return self.hooks
 
     def __call__(self, trainer, state, epoch: int):
         for hook in self.hooks:
@@ -555,11 +630,12 @@ def workload_main(argv: Sequence[str]) -> int:
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--outdir", default=None,
                         help="Artifact directory (workloads that write artifacts).")
+    _add_telemetry_dir_flag(parser, "--outdir when given, else disabled")
     parser.add_argument("--set", action="append", default=[], metavar="FIELD=VALUE",
                         help="Override a workload config field / keyword "
                              "(repeatable), e.g. --set num_steps=1000")
     args = parser.parse_args(argv)
-    _enable_cli_compile_cache()
+    cache_status = _enable_cli_compile_cache()
     overrides = _parse_sets(args.set)
 
     from dib_tpu import workloads as wl
@@ -572,16 +648,32 @@ def workload_main(argv: Sequence[str]) -> int:
             "and consume the JSON summary (or use the Python API)"
         )
 
+    # Event stream: defaults into --outdir when the workload has one; the
+    # boolean workload (no artifact dir) records only when --telemetry-dir
+    # is passed explicitly. Typed chunk/mi_bounds emission is wired for the
+    # boolean trainer; other workloads get run_start/run_end bracketing.
+    from dib_tpu.telemetry import open_writer, runtime_manifest, shared_run_id
+
+    telemetry = open_writer(args.telemetry_dir, args.outdir,
+                            run_id=shared_run_id())
+
+    def _start(config=None):
+        if telemetry is not None:
+            telemetry.run_start(runtime_manifest(
+                config=config,
+                extra={"workload": args.name, "seed": args.seed,
+                       "compile_cache": cache_status},
+            ))
+
     if args.name == "boolean":
-        result = wl.run_boolean_workload(
-            args.seed, _apply_config(wl.BooleanWorkloadConfig, overrides)
-        )
+        config = _apply_config(wl.BooleanWorkloadConfig, overrides)
+        _start(config)
+        result = wl.run_boolean_workload(args.seed, config, telemetry=telemetry)
     elif args.name == "amorphous":
         kwargs = {"outdir": args.outdir} if args.outdir else {}
-        result = wl.run_amorphous_workload(
-            args.seed, _apply_config(wl.AmorphousWorkloadConfig, overrides),
-            **kwargs,
-        )
+        config = _apply_config(wl.AmorphousWorkloadConfig, overrides)
+        _start(config)
+        result = wl.run_amorphous_workload(args.seed, config, **kwargs)
     elif args.name == "amorphous_protocols":
         import dataclasses
 
@@ -592,57 +684,67 @@ def workload_main(argv: Sequence[str]) -> int:
         # (protocols, model_overrides, data_path, ... — the fetcher's surface
         # is open-ended, so they are not pre-validated here)
         rest = {k: v for k, v in overrides.items() if k not in fields}
+        config = _apply_config(wl.AmorphousWorkloadConfig, cfg) if cfg else None
+        _start(config)
         result = wl.run_amorphous_protocols(
             key=args.seed,
-            config=_apply_config(wl.AmorphousWorkloadConfig, cfg) if cfg else None,
+            config=config,
             **rest,
             **kwargs,
         )
     elif args.name == "radial_shells":
         kwargs = {"outdir": args.outdir} if args.outdir else {}
-        result = wl.run_radial_shells_workload(
-            args.seed, _apply_config(wl.RadialShellsConfig, overrides), **kwargs
-        )
+        config = _apply_config(wl.RadialShellsConfig, overrides)
+        _start(config)
+        result = wl.run_radial_shells_workload(args.seed, config, **kwargs)
     elif args.name == "chaos":
-        result = wl.run_chaos_workload(
-            seed=args.seed,
-            **_check_kwargs(wl.run_chaos_workload, _pop_config(overrides))
-        )
+        kwargs = _check_kwargs(wl.run_chaos_workload, _pop_config(overrides))
+        _start(kwargs.get("config"))
+        result = wl.run_chaos_workload(seed=args.seed, **kwargs)
     elif args.name == "chaos_state_sweep":
+        kwargs = _check_kwargs(
+            wl.run_chaos_state_sweep, _pop_config(overrides),
+            wl.run_chaos_workload,
+            # bound by the sweep driver itself — a --set would collide
+            exclude=("num_states", "outdir"),
+        )
+        _start(kwargs.get("config"))
         result = wl.run_chaos_state_sweep(
-            seed=args.seed,
-            outdir=args.outdir,
-            **_check_kwargs(
-                wl.run_chaos_state_sweep, _pop_config(overrides),
-                wl.run_chaos_workload,
-                # bound by the sweep driver itself — a --set would collide
-                exclude=("num_states", "outdir"),
-            ),
+            seed=args.seed, outdir=args.outdir, **kwargs,
         )
     else:
+        _start()
         results = wl.run_characterization(
             seed=args.seed, **_check_kwargs(wl.run_characterization, overrides)
         )
         if args.outdir:
             wl.save_characterization_plots(results, args.outdir)
+        if telemetry is not None:
+            telemetry.run_end(status="ok")
+            telemetry.close()
         # element-wise serialization, no outer pass: the sweep IS the product
         print(json.dumps({"results": [_json_safe(r) for r in results]}))
         return 0
+    if telemetry is not None:
+        telemetry.run_end(status="ok")
+        telemetry.close()
     print(json.dumps(_json_safe(result)))
     return 0
 
 
-def _enable_cli_compile_cache() -> None:
+def _enable_cli_compile_cache() -> str:
     """Persistent XLA compilation cache for CLI invocations (VERDICT round
     3 item 4b: warm starts skip the ~146 s cold compile). Called AFTER
     argument parsing so --help never pays the jax import, and here rather
     than in run()/workload_main()'s bodies so tests driving those directly
-    stay out of the shared cache; DIB_COMPILE_CACHE='' disables."""
+    stay out of the shared cache; DIB_COMPILE_CACHE='' disables. Returns
+    the status so run manifests can record it."""
     from dib_tpu.utils.compile_cache import enable_persistent_cache
 
     status = enable_persistent_cache()
     if status != "off":
         print(f"compile cache: {status}", file=sys.stderr)
+    return status
 
 
 def _watchdog_main(args, argv: Sequence[str]) -> int:
@@ -652,6 +754,19 @@ def _watchdog_main(args, argv: Sequence[str]) -> int:
     chunk-aligned checkpoint (bit-identical continuation)."""
     from dib_tpu.train.watchdog import WatchdogConfig, supervise_self
 
+    # Supervisor-side event stream: kills/restarts land on the SAME
+    # events.jsonl the worker appends to (O_APPEND — no interleaving). The
+    # supervisor never initializes a backend, hence the explicit index.
+    # Pinning the run id into the environment makes the whole supervised
+    # run — supervisor mitigations plus every worker relaunch — ONE run,
+    # so --run-id scoping keeps the mitigation gate in view.
+    from dib_tpu.telemetry import open_writer, shared_run_id
+
+    run_id = shared_run_id()
+    os.environ["DIB_TELEMETRY_RUN_ID"] = run_id
+    telemetry = open_writer(args.telemetry_dir, args.artifact_outdir,
+                            run_id=run_id, process_index=0,
+                            tags={"src": "supervisor"})
     result = supervise_self(
         [sys.executable, "-m", "dib_tpu.cli"], argv,
         outdir=args.artifact_outdir,
@@ -664,28 +779,53 @@ def _watchdog_main(args, argv: Sequence[str]) -> int:
             first_beat_timeout_s=args.watchdog_first_timeout_s,
             floor_s=args.watchdog_floor_s,
         ),
+        telemetry=telemetry,
     )
+    if telemetry is not None:
+        telemetry.close()
     print(json.dumps({"watchdog": result}))
     return 0 if result["returncode"] == 0 else 1
 
 
+def _finalize_telemetry(exc: BaseException) -> None:
+    """Crash-path terminal records (docs/observability.md): any event
+    stream this process opened but never ended gets
+    ``run_end(status="error")`` before the exception propagates, so a
+    crashed run is distinguishable from one still in flight. Touches
+    nothing unless telemetry was actually imported."""
+    events_mod = sys.modules.get("dib_tpu.telemetry.events")
+    if events_mod is not None:
+        events_mod.finalize_crashed(
+            exc, log=lambda msg: print(msg, file=sys.stderr))
+
+
 def main(argv: Sequence[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
-    if argv and argv[0] == "workload":
-        return workload_main(argv[1:])
-    args = build_parser().parse_args(argv)
-    if args.command == "workload":
-        # 'workload' parsed from a non-leading position (e.g. flags first):
-        # its flags are not the train flags, so re-dispatching would misparse
-        raise SystemExit(
-            "Place the subcommand first: python -m dib_tpu workload <name> ..."
-        )
-    if args.watchdog:
-        return _watchdog_main(args, argv)
-    _enable_cli_compile_cache()
-    summary = run(args)
-    print(json.dumps(summary))
-    return 0
+    try:
+        if argv and argv[0] == "workload":
+            return workload_main(argv[1:])
+        if argv and argv[0] == "telemetry":
+            # pure host-side file analysis: never initializes a backend
+            from dib_tpu.telemetry import telemetry_main
+
+            return telemetry_main(argv[1:])
+        args = build_parser().parse_args(argv)
+        if args.command in ("workload", "telemetry"):
+            # parsed from a non-leading position (e.g. flags first): these
+            # subcommands' flags are not the train flags, so re-dispatching
+            # would misparse
+            raise SystemExit(
+                f"Place the subcommand first: python -m dib_tpu {args.command} ..."
+            )
+        if args.watchdog:
+            return _watchdog_main(args, argv)
+        status = _enable_cli_compile_cache()
+        summary = run(args, compile_cache_status=status)
+        print(json.dumps(summary))
+        return 0
+    except BaseException as exc:
+        _finalize_telemetry(exc)
+        raise
 
 
 if __name__ == "__main__":

@@ -22,6 +22,17 @@ import numpy as np
 ENTROPY_RATE_BITS = {"logistic": 0.5203, "henon": 0.6048, "ikeda": 0.726}
 
 
+def _x64_context():
+    """Double-precision context across JAX versions: some releases expose
+    ``jax.enable_x64`` at top level, others only the original
+    ``jax.experimental.enable_x64`` (the installed 0.4.x has no top-level
+    spelling and raises AttributeError on it)."""
+    ctx = getattr(jax, "enable_x64", None)
+    if ctx is None:
+        from jax.experimental import enable_x64 as ctx
+    return ctx(True)
+
+
 @partial(jax.jit, static_argnames=("n",))
 def _scan_logistic(x0, r, n):
     def step(x, _):
@@ -84,7 +95,7 @@ def generate_data(
     # f64, so pin the scan to the host CPU backend (generation happens once,
     # and the sequence feeds host-side CTW anyway).
     cpu = jax.local_devices(backend="cpu")[0]
-    with jax.default_device(cpu), jax.enable_x64(True):
+    with jax.default_device(cpu), _x64_context():
         if system_name == "logistic":
             r = system_params.get("r", 3.7115)
             xs = _scan_logistic(jnp.float64(rng.random()), jnp.float64(r), total)

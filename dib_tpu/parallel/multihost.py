@@ -52,6 +52,28 @@ def _cluster_env_configured() -> bool:
     return any(os.environ.get(k) for k in _CLUSTER_ENV_VARS)
 
 
+# State-tracking fallback for JAX versions whose public surface has no
+# ``jax.distributed.is_initialized`` (the installed 0.4.x exposes only
+# initialize/shutdown): records whether THIS module ran initialize()
+# successfully. A launcher that initialized the cluster through some other
+# path is still caught by the internal global-state probe below when that
+# internal exists.
+_initialized_by_us = False
+
+
+def _distributed_is_initialized() -> bool:
+    """Backend-free "is the distributed client up?" across JAX versions."""
+    probe = getattr(jax.distributed, "is_initialized", None)
+    if probe is not None:
+        return bool(probe())
+    try:  # internal fallback; absent/renamed internals fall through quietly
+        from jax._src import distributed as _dist
+
+        return getattr(_dist.global_state, "client", None) is not None
+    except (ImportError, AttributeError):
+        return _initialized_by_us
+
+
 def initialize(coordinator_address: str | None = None,
                num_processes: int | None = None,
                process_id: int | None = None) -> bool:
@@ -64,8 +86,9 @@ def initialize(coordinator_address: str | None = None,
     """
     # Must NOT touch jax.process_count()/jax.devices() before initializing:
     # they initialize the XLA backend, after which distributed.initialize()
-    # refuses to run. is_initialized() is backend-free.
-    if jax.distributed.is_initialized():
+    # refuses to run. The is-initialized probe is backend-free.
+    global _initialized_by_us
+    if _distributed_is_initialized():
         return True  # already initialized by the launcher
     if coordinator_address is None and num_processes is None:
         # No explicit cluster spec: rely on environment autodetection only
@@ -117,12 +140,14 @@ def initialize(coordinator_address: str | None = None,
                 "uncoordinated."
             )
             return False
+        _initialized_by_us = True
         return jax.process_count() > 1
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
         process_id=process_id,
     )
+    _initialized_by_us = True
     return jax.process_count() > 1
 
 

@@ -142,11 +142,18 @@ class BetaSweepTrainer:
         hook_every: int = 0,
         states: TrainState | None = None,
         histories: dict | None = None,
+        telemetry=None,
     ) -> tuple[TrainState, list[HistoryRecord]]:
         """Drive the sweep: jitted chunks + host hooks between them.
 
         ``hooks`` are called as ``hook(sweep_trainer, states, epoch)``.
         Returns the stacked final states and one ``HistoryRecord`` per replica.
+
+        ``telemetry`` (an ``EventWriter``) emits a ``chunk`` event per fit
+        chunk carrying PER-REPLICA tags — each replica's current beta,
+        losses, and total KL from the chunk's last history row — so a
+        sweep's event stream stays attributable to its beta grid. Same
+        off-hot-path contract as ``DIBTrainer.fit``.
 
         Caller-supplied ``states``/``histories`` are CONSUMED (buffers
         donated to the first chunk on accelerators) — see ``DIBTrainer.fit``.
@@ -170,6 +177,18 @@ class BetaSweepTrainer:
                 f"already recorded and {num_epochs} more were requested; grow it "
                 f"with history_extend(histories, n)."
             )
+        from dib_tpu.telemetry.hooks import FitRecorder
+
+        # sweep throughput counts every replica's steps (the bench.py
+        # steps/s convention)
+        recorder = FitRecorder(
+            telemetry,
+            steps_per_epoch=self.base.steps_per_epoch * self.num_replicas,
+        )
+        beta_end_list = None
+        if telemetry is not None:
+            # static for the whole fit: fetch once, not per chunk
+            beta_end_list = [float(b) for b in jax.device_get(self.beta_ends)]
         # chunking decoupled from hooks — see DIBTrainer.fit
         chunk = hook_every if hook_every else num_epochs
         done = 0
@@ -177,14 +196,34 @@ class BetaSweepTrainer:
             this_chunk = min(chunk, num_epochs - done)
             split = jax.vmap(jax.random.split)(keys)
             keys, chunk_keys = split[:, 0], split[:, 1]
-            states, histories = self.run_chunk(states, histories, chunk_keys, this_chunk)
+            with recorder.chunk_phase() as ph:
+                states, histories = self.run_chunk(
+                    states, histories, chunk_keys, this_chunk
+                )
+                ph.block_on(states.params)
             done += this_chunk
             # Published for CheckpointHook (see DIBTrainer.fit).
             self.resume_key = keys
             self.latest_history = histories
             self.resume_chunk = chunk
+            if telemetry is not None:
+                # per-replica beta/loss/KL tags ([R] lists)
+                row = jax.device_get({
+                    name: histories[name][:, cursor + done - 1]
+                    for name in ("beta", "loss", "val_loss", "kl_per_feature")
+                })
+                recorder.record_chunk(
+                    epoch=cursor + done, chunk_epochs=this_chunk,
+                    replicas=self.num_replicas,
+                    beta=[float(b) for b in row["beta"]],
+                    beta_end=beta_end_list,
+                    loss=[float(x) for x in row["loss"]],
+                    val_loss=[float(x) for x in row["val_loss"]],
+                    kl_total=[float(x) for x in row["kl_per_feature"].sum(-1)],
+                )
             for hook in hooks:
                 hook(self, states, int(jax.device_get(states.epoch)[0]))
+        recorder.finish()
         return states, sweep_records(histories)
 
     # ------------------------------------------------------------ inspection
@@ -272,6 +311,22 @@ class PerReplicaHook:
     def __init__(self, make_hook: Callable[[int], Callable]):
         self.make_hook = make_hook
         self.replica_hooks: dict[int, Callable] = {}
+
+    def _probe_hook(self) -> Callable:
+        """Replica 0's hook, created eagerly if needed — every replica gets
+        the same hook structure, so one instance answers cadence and
+        attribution questions for the fan-out (``TimedHook`` protocol)."""
+        if 0 not in self.replica_hooks:
+            self.replica_hooks[0] = self.make_hook(0)
+        return self.replica_hooks[0]
+
+    def fires_at(self, epoch: int) -> bool:
+        fires_at = getattr(self._probe_hook(), "fires_at", None)
+        return fires_at(epoch) if fires_at is not None else True
+
+    @property
+    def telemetry_inner_hooks(self):
+        return [self._probe_hook()]
 
     def __call__(self, sweep: "BetaSweepTrainer", states: TrainState, epoch: int):
         for r in range(sweep.num_replicas):

@@ -56,14 +56,17 @@ class SweepInfoPerFeatureHook:
         seed: int = 0,
         row_block: int | None = None,
         persist: str | None = None,
+        telemetry=None,
     ):
         self.evaluation_batch_size = evaluation_batch_size
         self.number_evaluation_batches = number_evaluation_batches
         self.row_block = row_block
+        self.telemetry = telemetry   # EventWriter: one mi_bounds event/checkpoint
         self._base_key = jax.random.key(seed)
         self.records: list[dict] = []
         self._fn = None
         self._device_rows = None
+        self._beta_ends = None
         self._cache_for = None   # strong (sweep, model) refs, not ids —
                                  # id reuse after GC must not retain caches
         # Resume support (train/watchdog.py): with a persist dir every
@@ -118,6 +121,8 @@ class SweepInfoPerFeatureHook:
                 or model is not self._cache_for[1]):
             self._fn = self._build(model)
             self._device_rows = jnp.asarray(sweep.base.bundle.x_valid)
+            # static per sweep: fetch the beta tags once, not per checkpoint
+            self._beta_ends = [float(b) for b in jax.device_get(sweep.beta_ends)]
             self._cache_for = (sweep, model)
         # A resumed worker re-measures from its restore point: drop any
         # preloaded records at/after this epoch (their npz mirrors are
@@ -134,6 +139,16 @@ class SweepInfoPerFeatureHook:
             [np.asarray(lower), np.asarray(upper)], axis=-1
         )  # [R, F, 2] nats
         self.records.append({"epoch": epoch, "bounds": bounds})
+        if self.telemetry is not None:
+            ln2 = np.log(2.0)
+            # per-replica feature means in bits, tagged with each replica's
+            # annealing endpoint so sweep streams stay beta-attributable
+            self.telemetry.mi_bounds(
+                epoch=epoch,
+                lower_bits=[float(x) for x in bounds[..., 0].mean(-1) / ln2],
+                upper_bits=[float(x) for x in bounds[..., 1].mean(-1) / ln2],
+                beta_end=self._beta_ends,
+            )
         if self.persist:
             path = os.path.join(self.persist, f"epoch{epoch}.npz")
             np.savez(f"{path}.tmp.npz", epoch=epoch, bounds=bounds)

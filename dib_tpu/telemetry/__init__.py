@@ -1,0 +1,65 @@
+"""Unified run telemetry: structured events, metrics, and run summaries.
+
+See ``docs/observability.md``. The pieces:
+
+  - :mod:`dib_tpu.telemetry.events` — append-only JSONL event stream per
+    run (schema-versioned envelope; run_start / chunk / compile /
+    mitigation / hook / mi_bounds / metrics / run_end records).
+  - :mod:`dib_tpu.telemetry.metrics` — counters / gauges / histograms with
+    multihost tag-and-forward aggregation (process 0 writes).
+  - :mod:`dib_tpu.telemetry.summary` — rolls an events.jsonl into a
+    bench-record-shaped summary and diffs two runs with a regression gate
+    (``python -m dib_tpu telemetry summarize|compare``).
+  - :mod:`dib_tpu.telemetry.hooks` — fit-hook adapters (chunk/
+    instrumentation phase timing into ``PhaseTimer`` + events).
+"""
+
+from dib_tpu.telemetry.events import (
+    EVENTS_FILENAME,
+    SCHEMA_VERSION,
+    EventWriter,
+    config_fingerprint,
+    device_memory_stats,
+    finalize_crashed,
+    finalize_open_writers,
+    open_writer,
+    read_events,
+    resolve_events_path,
+    runtime_manifest,
+    shared_run_id,
+)
+from dib_tpu.telemetry.hooks import ChunkPhaseHooks
+from dib_tpu.telemetry.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    gather_snapshots,
+    write_metrics,
+)
+from dib_tpu.telemetry.summary import compare, summarize, telemetry_main
+
+__all__ = [
+    "EVENTS_FILENAME",
+    "SCHEMA_VERSION",
+    "ChunkPhaseHooks",
+    "Counter",
+    "EventWriter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "compare",
+    "config_fingerprint",
+    "device_memory_stats",
+    "finalize_crashed",
+    "finalize_open_writers",
+    "gather_snapshots",
+    "open_writer",
+    "read_events",
+    "resolve_events_path",
+    "runtime_manifest",
+    "shared_run_id",
+    "summarize",
+    "telemetry_main",
+    "write_metrics",
+]

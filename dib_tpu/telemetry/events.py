@@ -1,0 +1,451 @@
+"""Structured run-event stream: append-only JSONL, one file per run dir.
+
+The paper's central claim is that the training trajectory IS the scientific
+product ("the fruits of training are signals that map out the information in
+the data", reference README.md:6) — yet before this module every run
+recorded itself through ad-hoc schema-less JSON at the repo root. Here every
+run appends typed, schema-versioned events to ``<run_dir>/events.jsonl``:
+
+  - ``run_start``  provenance manifest (git SHA, jax/flax/optax versions,
+                   device kind + count, mesh shape, resolved config hash)
+  - ``chunk``      per-fit-chunk training signal: epoch, steps, wall-clock
+                   and steps/s (``PhaseTimer``-measured), loss, beta,
+                   per-feature KL from the fetched history row, device
+                   memory stats
+  - ``compile``    executable name, compile seconds, persistent-cache
+                   status from ``utils/compile_cache.py``
+  - ``mitigation`` watchdog kill/restart, mirroring ``watchdog.mitigations``
+  - ``hook``       host-hook wall-clock per invocation
+  - ``mi_bounds``  MI sandwich-bound measurements (sweep/boolean hooks)
+  - ``metrics``    counter/gauge/histogram snapshots (``telemetry.metrics``)
+  - ``run_end``    terminal status + total wall-clock
+
+Envelope (every line): ``v`` schema version, ``run`` run id, ``proc``
+``jax.process_index()``, ``seq`` per-writer sequence number, ``t`` unix
+time, ``mono`` monotonic clock, ``type``, then the record's fields.
+
+Durability contract: each event is ONE ``os.write`` of one ``\\n``-terminated
+line on an ``O_APPEND`` fd — concurrent writers (worker + watchdog
+supervisor) never interleave bytes, and a killed writer can leave at most
+one torn line per kill (possibly mid-file, since survivors keep appending
+after it), which :func:`read_events` skips with a warning. Instrumentation
+stays off the hot path: emission happens only at chunk boundaries on
+already-fetched arrays (see ``train/loop.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import time
+import uuid
+import weakref
+
+SCHEMA_VERSION = 1
+EVENTS_FILENAME = "events.jsonl"
+
+__all__ = [
+    "SCHEMA_VERSION",
+    "EVENTS_FILENAME",
+    "EventWriter",
+    "config_fingerprint",
+    "device_memory_stats",
+    "finalize_crashed",
+    "finalize_open_writers",
+    "open_writer",
+    "read_events",
+    "resolve_events_path",
+    "runtime_manifest",
+    "shared_run_id",
+]
+
+
+def open_writer(dir_arg: str | None, default_dir: str | None,
+                **kwargs) -> "EventWriter | None":
+    """The CLI `--telemetry-dir` convention, in one place: ``None`` means
+    "default into ``default_dir``", an empty string disables, anything
+    else is the explicit directory. Returns ``None`` when disabled (also
+    when the default itself is unset)."""
+    directory = default_dir if dir_arg is None else dir_arg
+    if not directory:
+        return None
+    return EventWriter(directory, **kwargs)
+
+
+def shared_run_id() -> str:
+    """One run = one run id across every process that writes its stream.
+
+    Precedence: the ``DIB_TELEMETRY_RUN_ID`` environment variable (the
+    watchdog supervisor pins it so the supervisor's mitigation events and
+    every worker relaunch share the run id — otherwise run_id-scoped
+    summaries would silently drop the mitigations the reliability gate
+    counts); else process 0 generates an id and a host broadcast shares it
+    SPMD-wide. Falls back to a locally generated id when jax isn't up or
+    the broadcast fails (single process, tests)."""
+    pinned = os.environ.get("DIB_TELEMETRY_RUN_ID")
+    if pinned:
+        return pinned
+    rid = _new_run_id()
+    jax_mod = sys.modules.get("jax")
+    if jax_mod is None:
+        return rid
+    try:
+        if jax_mod.process_count() <= 1:
+            return rid
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        payload = np.frombuffer(rid.encode().ljust(64), dtype=np.uint8)
+        shared = multihost_utils.broadcast_one_to_all(payload)
+        return bytes(bytearray(np.asarray(shared).tolist())).decode().strip()
+    except Exception:
+        return rid
+
+
+def _new_run_id() -> str:
+    return (time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+            + "-" + uuid.uuid4().hex[:8])
+
+# Open writers, for terminal-record insurance on crash paths: an entry
+# point's top-level except clause calls finalize_open_writers() so a
+# crashed run's stream ends with run_end(status="error") instead of a
+# dangling chunk event (a SIGKILLed worker still can't — that case is
+# covered by the supervisor's mitigation events).
+_OPEN_WRITERS: "weakref.WeakSet[EventWriter]" = weakref.WeakSet()
+
+
+def finalize_open_writers(error: str | None = None) -> list[str]:
+    """Emit ``run_end(status="error")`` on every writer whose run started
+    but never ended, then close it. Returns the paths of the streams a
+    terminal record was actually appended to — callers log them so crash
+    forensics are discoverable; a writer that never emitted run_start is
+    closed silently (there is nothing to find at its path). Safe to call
+    when nothing is open (no-op)."""
+    paths = []
+    for writer in list(_OPEN_WRITERS):
+        if writer._fd is None:
+            continue
+        if writer._started and not writer._ended:
+            writer.run_end(status="error", error=error)
+            paths.append(writer.path)
+        writer.close()
+    return paths
+
+
+def finalize_crashed(exc: BaseException, log=None) -> list[str]:
+    """The entry-point except-clause idiom, in one place: finalize open
+    writers with the exception as the terminal error and log where the
+    crash forensics landed. ``log`` is a one-string callable (stderr
+    print, bench's log); None skips logging."""
+    paths = finalize_open_writers(error=f"{type(exc).__name__}: {exc}")
+    if log is not None:
+        for path in paths:
+            log(f"telemetry: crash terminal record appended to {path}")
+    return paths
+
+
+def config_fingerprint(config) -> str:
+    """Stable short hash of a config dataclass/dict — the run_start manifest
+    records it so two runs are comparable iff their fingerprints match."""
+    if dataclasses.is_dataclass(config) and not isinstance(config, type):
+        config = dataclasses.asdict(config)
+    blob = json.dumps(config, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def _git_sha() -> str | None:
+    """SHA of the checkout THIS code runs from; None for site-packages
+    installs. Never cwd's repo — a run launched from inside an unrelated
+    project must not record that project's HEAD as its provenance."""
+    here = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            cwd=here, capture_output=True, text=True, timeout=5,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    return out.stdout.strip() if out.returncode == 0 else None
+
+
+def _package_version(name: str) -> str | None:
+    try:
+        import importlib
+
+        return getattr(importlib.import_module(name), "__version__", None)
+    except Exception:
+        return None
+
+
+def runtime_manifest(
+    config=None,
+    mesh_shape: dict | None = None,
+    device_info: bool = True,
+    extra: dict | None = None,
+) -> dict:
+    """Provenance manifest for a ``run_start`` event.
+
+    ``device_info=False`` skips everything that would initialize a JAX
+    backend — for processes (watchdog supervisor, bench parent) that must
+    never touch the accelerator.
+    """
+    manifest: dict = {
+        "git_sha": _git_sha(),
+        "python": sys.version.split()[0],
+        "versions": {
+            name: _package_version(name)
+            for name in ("jax", "flax", "optax", "numpy")
+        },
+        "argv": list(sys.argv),
+    }
+    if device_info:
+        import jax
+
+        devices = jax.devices()
+        manifest["device_kind"] = devices[0].device_kind
+        manifest["device_platform"] = devices[0].platform
+        manifest["device_count"] = len(devices)
+        manifest["process_count"] = jax.process_count()
+    if mesh_shape is not None:
+        manifest["mesh_shape"] = dict(mesh_shape)
+    if config is not None:
+        if dataclasses.is_dataclass(config) and not isinstance(config, type):
+            manifest["config"] = dataclasses.asdict(config)
+        elif isinstance(config, dict):
+            manifest["config"] = dict(config)
+        manifest["config_hash"] = config_fingerprint(config)
+    if extra:
+        manifest.update(extra)
+    return manifest
+
+
+def device_memory_stats(device=None) -> dict | None:
+    """Compact ``device.memory_stats()`` view; None when the backend has
+    none (CPU) or the call fails."""
+    try:
+        import jax
+
+        device = device or jax.devices()[0]
+        stats = device.memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    keep = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+            "largest_alloc_size")
+    out = {k: int(stats[k]) for k in keep if k in stats}
+    return out or None
+
+
+class EventWriter:
+    """Appends schema-versioned events to ``<directory>/events.jsonl``.
+
+    ``process_index=None`` resolves via ``jax.process_index()`` ONLY if the
+    jax backend is demonstrably safe to touch (jax already imported);
+    processes that must stay backend-free (watchdog supervisor, bench
+    parent) pass an explicit index (normally 0). ``tags`` ride every
+    envelope — e.g. ``{"src": "supervisor"}``.
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        run_id: str | None = None,
+        process_index: int | None = None,
+        tags: dict | None = None,
+        filename: str = EVENTS_FILENAME,
+    ):
+        os.makedirs(directory, exist_ok=True)
+        self.path = os.path.join(directory, filename)
+        self.run_id = run_id or _new_run_id()
+        if process_index is None:
+            process_index = 0
+            if "jax" in sys.modules:
+                try:
+                    process_index = sys.modules["jax"].process_index()
+                except Exception:
+                    process_index = 0
+        self.process_index = int(process_index)
+        self.tags = dict(tags or {})
+        self._seq = 0
+        self._started = False
+        self._ended = False
+        self._fd = os.open(
+            self.path, os.O_APPEND | os.O_CREAT | os.O_WRONLY, 0o644
+        )
+        _OPEN_WRITERS.add(self)
+
+    # ----------------------------------------------------------- low level
+    def emit(self, event_type: str, **data) -> dict:
+        """Append one event; returns the full record as written."""
+        record = {
+            "v": SCHEMA_VERSION,
+            "run": self.run_id,
+            "proc": self.process_index,
+            "seq": self._seq,
+            "t": time.time(),
+            "mono": time.perf_counter(),
+            "type": event_type,
+        }
+        if self.tags:
+            record["tags"] = self.tags
+        record.update(data)
+        self._seq += 1
+        # allow_nan=False: a diverged run's loss=NaN must not write a bare
+        # NaN token nothing downstream can parse — non-finite floats are
+        # encoded as the strings "NaN"/"Infinity"/"-Infinity" instead
+        # (read back by summarize; a non-finite candidate REGRESSES in
+        # compare). The sanitize walk runs only on the rare bad event.
+        try:
+            line = json.dumps(record, default=_json_default,
+                              allow_nan=False) + "\n"
+        except ValueError:
+            record = _sanitize_nonfinite(record)
+            line = json.dumps(record, default=_json_default,
+                              allow_nan=False) + "\n"
+        # one write() per line on an O_APPEND fd: concurrent writers cannot
+        # interleave, a kill can only truncate the final line
+        os.write(self._fd, line.encode())
+        return record
+
+    def close(self) -> None:
+        if self._fd is not None:
+            os.close(self._fd)
+            self._fd = None
+        _OPEN_WRITERS.discard(self)
+
+    def __enter__(self) -> "EventWriter":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        # terminal-record insurance: a run that started inside this context
+        # and died on an exception still gets a run_end on its stream
+        if (exc_type is not None and self._started and not self._ended
+                and self._fd is not None):
+            self.run_end(status="error",
+                         error=f"{exc_type.__name__}: {exc}")
+        self.close()
+
+    # -------------------------------------------------------- typed events
+    def run_start(self, manifest: dict) -> dict:
+        self._started = True
+        return self.emit("run_start", manifest=manifest)
+
+    def chunk(self, *, epoch: int, steps: int, seconds: float, **fields) -> dict:
+        steps_per_s = steps / seconds if seconds > 0 else None
+        return self.emit(
+            "chunk", epoch=int(epoch), steps=int(steps),
+            seconds=round(float(seconds), 6),
+            steps_per_s=round(steps_per_s, 3) if steps_per_s else None,
+            **fields,
+        )
+
+    def compile(self, *, name: str, seconds: float, cache: str, **fields) -> dict:
+        """``cache`` is the ``utils/compile_cache.py`` status ("warm" =
+        persistent-cache hit, "cold-populating" = miss being written,
+        "off") or a backend-specific hit/miss string."""
+        return self.emit(
+            "compile", name=name, seconds=round(float(seconds), 4),
+            cache=cache, **fields,
+        )
+
+    def mitigation(self, *, mtype: str, **fields) -> dict:
+        return self.emit("mitigation", mtype=mtype, **fields)
+
+    def hook(self, *, name: str, epoch: int, seconds: float, **fields) -> dict:
+        return self.emit(
+            "hook", name=name, epoch=int(epoch),
+            seconds=round(float(seconds), 6), **fields,
+        )
+
+    def mi_bounds(self, *, epoch: int, **fields) -> dict:
+        return self.emit("mi_bounds", epoch=int(epoch), **fields)
+
+    def metrics(self, snapshots) -> dict:
+        return self.emit("metrics", snapshots=snapshots)
+
+    def run_end(self, *, status: str = "ok", **fields) -> dict:
+        self._ended = True
+        return self.emit("run_end", status=status, **fields)
+
+
+def _json_default(x):
+    """Arrays/np scalars -> lists/floats so emit() never throws mid-run."""
+    if hasattr(x, "tolist"):
+        return x.tolist()
+    if hasattr(x, "item"):
+        return x.item()
+    return str(x)
+
+
+def _sanitize_nonfinite(x):
+    """Non-finite floats -> their float()-parseable string spellings."""
+    if hasattr(x, "tolist"):
+        x = x.tolist()
+    elif hasattr(x, "item"):
+        x = x.item()
+    if isinstance(x, float) and x != x:
+        return "NaN"
+    if isinstance(x, float) and x in (float("inf"), float("-inf")):
+        return "Infinity" if x > 0 else "-Infinity"
+    if isinstance(x, dict):
+        return {k: _sanitize_nonfinite(v) for k, v in x.items()}
+    if isinstance(x, (list, tuple)):
+        return [_sanitize_nonfinite(v) for v in x]
+    return x
+
+
+def resolve_events_path(path: str) -> str:
+    """Accept a run dir or a direct events file path."""
+    if os.path.isdir(path):
+        return os.path.join(path, EVENTS_FILENAME)
+    return path
+
+
+def read_events(
+    path: str,
+    process_index: int | None = None,
+    types=None,
+):
+    """Yield events from an events.jsonl, oldest first.
+
+    Tolerates torn lines ANYWHERE, with a warning: each event is one
+    ``os.write``, so under the append contract the only source of a
+    non-parsing line is a writer killed mid-write — and a kill is NOT
+    guaranteed to be the last word in the file, because the watchdog
+    supervisor (and the relaunched worker) keep appending to the same
+    stream after it. A torn line glued to a later complete line must not
+    make the recovered run's history unreadable. ``process_index``
+    filters to one process's events; ``types`` to a set of event types.
+    """
+    path = resolve_events_path(path)
+    if types is not None:
+        types = set(types)
+    with open(path, "rb") as f:
+        raw = f.read()
+    torn = 0
+    for i, line in enumerate(raw.split(b"\n")):
+        if not line.strip():
+            continue
+        try:
+            event = json.loads(line)
+        except json.JSONDecodeError:
+            torn += 1
+            continue
+        if process_index is not None and event.get("proc") != process_index:
+            continue
+        if types is not None and event.get("type") not in types:
+            continue
+        yield event
+    if torn:
+        import warnings
+
+        warnings.warn(
+            f"{path}: skipped {torn} torn event line(s) — a writer was "
+            f"killed mid-append (expected under watchdog kills; anything "
+            f"else violates the one-write-per-line contract)"
+        )

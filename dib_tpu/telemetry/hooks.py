@@ -1,0 +1,158 @@
+"""Fit-hook adapters that feed the event stream and a ``PhaseTimer``.
+
+:class:`ChunkPhaseHooks` replaces the private per-script timers the
+instrumented drivers used to carry (``scripts/northstar_run.py``'s deleted
+``_CheckpointPhaseTimer``): its ``pre`` hook runs FIRST in the fit hook
+list, blocks on the chunk's outputs, and closes the "chunk" phase — so the
+interval is the true train-chunk wall-clock; ``post`` runs LAST and closes
+the "instrumentation" phase covering everything the other hooks did in
+between. Per-interval series live on ``timer.intervals`` and, when an
+:class:`~dib_tpu.telemetry.events.EventWriter` is attached, each chunk also
+lands as a ``chunk`` event with steps/s and device memory.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+from dib_tpu.telemetry.events import device_memory_stats
+from dib_tpu.utils.profiling import PhaseTimer
+
+__all__ = ["ChunkPhaseHooks", "FitRecorder"]
+
+
+class _NullPhase:
+    """Stand-in for a PhaseTimer phase when telemetry is off: never blocks,
+    so dispatch keeps pipelining across chunks."""
+
+    def block_on(self, tree) -> None:
+        pass
+
+
+class FitRecorder:
+    """The per-chunk instrumentation shared by ``DIBTrainer.fit`` and
+    ``BetaSweepTrainer.fit``: a ``PhaseTimer`` around each ``run_chunk``
+    (blocking on its outputs so the interval is true wall-clock), one
+    ``chunk`` event per boundary, step/epoch counters and the chunk-seconds
+    histogram, and the end-of-fit ``metrics`` rollup. With ``telemetry``
+    None every method is a cheap no-op and nothing blocks.
+
+    ``steps_per_epoch`` is the run's TOTAL steps per epoch — a sweep passes
+    ``base.steps_per_epoch * num_replicas`` (the bench.py steps/s
+    convention of counting every replica's steps).
+    """
+
+    def __init__(self, telemetry, *, steps_per_epoch: int):
+        self.telemetry = telemetry
+        self.steps_per_epoch = int(steps_per_epoch)
+        self.timer = self.registry = None
+        if telemetry is not None:
+            from dib_tpu.telemetry.metrics import MetricsRegistry
+
+            self.timer = PhaseTimer()
+            self.registry = MetricsRegistry()
+
+    @contextlib.contextmanager
+    def chunk_phase(self):
+        """Wrap one ``run_chunk`` call; ``.block_on(outputs)`` inside."""
+        if self.timer is None:
+            yield _NullPhase()
+        else:
+            with self.timer.phase("chunk") as ph:
+                yield ph
+
+    def record_chunk(self, *, epoch: int, chunk_epochs: int,
+                     **fields) -> None:
+        """One ``chunk`` event from the just-timed chunk plus the metric
+        updates. ``fields`` carry the already-fetched history row (scalars
+        for a serial fit, [R] lists for a sweep)."""
+        if self.telemetry is None:
+            return
+        seconds = self.timer.intervals["chunk"][-1]
+        steps = chunk_epochs * self.steps_per_epoch
+        self.telemetry.chunk(
+            epoch=epoch, steps=steps, seconds=seconds,
+            memory=device_memory_stats(), **fields,
+        )
+        self.registry.counter("steps").inc(steps)
+        self.registry.histogram("chunk_s").record(seconds)
+        self.registry.gauge("epoch").set(epoch)
+
+    def finish(self) -> None:
+        """End-of-fit rollup: chunk wall-clock distribution + totals as one
+        ``metrics`` event (multihost: process 0 writes the gather)."""
+        if self.telemetry is None:
+            return
+        from dib_tpu.telemetry.metrics import write_metrics
+
+        write_metrics(self.registry, self.telemetry)
+
+
+class ChunkPhaseHooks:
+    """pre/post hook pair splitting checkpoint wall-clock into phases.
+
+    Usage (the north-star pattern)::
+
+        timer = PhaseTimer()
+        phases = ChunkPhaseHooks(timer, telemetry=writer, steps_per_epoch=50)
+        hooks = [phases.pre, *instrumentation_hooks, phases.post]
+        phases.start()
+        sweep.fit(keys, hooks=hooks, hook_every=chunk_epochs)
+        timer.intervals["chunk"]            # per-checkpoint train seconds
+        timer.intervals["instrumentation"]  # per-checkpoint hook seconds
+    """
+
+    def __init__(self, timer: PhaseTimer | None = None, telemetry=None,
+                 steps_per_epoch: int = 0, baseline_known: bool = True):
+        self.timer = timer or PhaseTimer()
+        self.telemetry = telemetry
+        self.steps_per_epoch = steps_per_epoch
+        self._t = time.perf_counter()
+        self._last_epoch = 0
+        # ``baseline_known=False``: the run may resume from a checkpoint at
+        # an epoch the caller cannot know before fitting, so the FIRST
+        # interval's step count is unattributable — it is timed but not
+        # emitted as a chunk event (an epoch-0 baseline would inflate the
+        # gated steps/s by counting the pre-restore epochs as trained).
+        self._baseline_known = baseline_known
+
+    def start(self, epoch: int | None = None) -> None:
+        """Re-anchor the clock at fit start so the first chunk interval
+        excludes setup the caller doesn't want attributed to training.
+        Passing ``epoch`` (e.g. the restore epoch of a resumed run) also
+        anchors the step baseline and marks it known."""
+        self._t = time.perf_counter()
+        if epoch is not None:
+            self._last_epoch = epoch
+            self._baseline_known = True
+
+    def pre(self, trainer, states, epoch: int) -> None:
+        import jax
+
+        jax.block_until_ready(
+            states.params if hasattr(states, "params") else states
+        )
+        now = time.perf_counter()
+        elapsed = now - self._t
+        self._t = now
+        self.timer.add("chunk", elapsed)
+        if self.telemetry is not None and self._baseline_known:
+            steps = max(epoch - self._last_epoch, 0) * self.steps_per_epoch
+            self.telemetry.chunk(
+                epoch=epoch, steps=steps, seconds=elapsed,
+                memory=device_memory_stats(),
+            )
+        self._baseline_known = True  # subsequent deltas are real
+        self._last_epoch = epoch
+
+    def post(self, trainer, states, epoch: int) -> None:
+        now = time.perf_counter()
+        elapsed = now - self._t
+        self._t = now
+        self.timer.add("instrumentation", elapsed)
+        if self.telemetry is not None:
+            self.telemetry.hook(
+                name="checkpoint_instrumentation", epoch=epoch,
+                seconds=elapsed,
+            )

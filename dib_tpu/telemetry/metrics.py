@@ -1,0 +1,171 @@
+"""Lightweight counters / gauges / histograms with multihost aggregation.
+
+Host-side metric plumbing for run telemetry — NOT a time-series database.
+Everything is in-process and cheap (a dict update per observation); the
+values reach disk only when :func:`write_metrics` snapshots the registry
+into a ``metrics`` event on the run's event stream.
+
+Multihost contract (mirrors the event-stream convention): in a
+multi-controller run every process maintains its own registry with the SAME
+metric names (SPMD — all hosts run the same program). ``write_metrics``
+tag-and-forwards: every process contributes its snapshot through a
+process allgather, and only process 0 writes the merged ``metrics`` event.
+Non-zero processes return without touching the file.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "gather_snapshots",
+    "write_metrics",
+]
+
+
+class Counter:
+    """Monotonically increasing count (events, steps, mitigations)."""
+
+    def __init__(self):
+        self.value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError(f"Counter increments must be >= 0, got {amount}")
+        self.value += amount
+
+
+class Gauge:
+    """Last-write-wins instantaneous value (memory bytes, current beta)."""
+
+    def __init__(self):
+        self.value = 0.0
+
+    def set(self, value: float) -> None:
+        self.value = float(value)
+
+
+class Histogram:
+    """Streaming distribution summary over a bounded window.
+
+    Tracks exact count/sum/min/max over the full stream and percentiles
+    over the trailing ``window`` observations — chunk wall-clocks arrive a
+    few thousand times per run at most, so a plain deque beats bucketing
+    complexity here.
+    """
+
+    def __init__(self, window: int = 4096):
+        self.count = 0
+        self.sum = 0.0
+        self.min = None
+        self.max = None
+        self._window = deque(maxlen=window)
+
+    def record(self, value: float) -> None:
+        value = float(value)
+        self.count += 1
+        self.sum += value
+        self.min = value if self.min is None else min(self.min, value)
+        self.max = value if self.max is None else max(self.max, value)
+        self._window.append(value)
+
+    def snapshot(self) -> dict:
+        out = {
+            "count": self.count,
+            "sum": self.sum,
+            "min": self.min if self.min is not None else 0.0,
+            "max": self.max if self.max is not None else 0.0,
+            "mean": self.sum / self.count if self.count else 0.0,
+        }
+        if self._window:
+            ordered = sorted(self._window)
+            for name, q in (("p50", 0.5), ("p90", 0.9), ("p99", 0.99)):
+                out[name] = ordered[min(int(q * len(ordered)), len(ordered) - 1)]
+        return out
+
+
+class MetricsRegistry:
+    """Named metric store: ``registry.counter("steps").inc(50)``."""
+
+    def __init__(self):
+        self._counters: dict[str, Counter] = {}
+        self._gauges: dict[str, Gauge] = {}
+        self._histograms: dict[str, Histogram] = {}
+
+    def counter(self, name: str) -> Counter:
+        return self._counters.setdefault(name, Counter())
+
+    def gauge(self, name: str) -> Gauge:
+        return self._gauges.setdefault(name, Gauge())
+
+    def histogram(self, name: str, window: int = 4096) -> Histogram:
+        return self._histograms.setdefault(name, Histogram(window))
+
+    def snapshot(self) -> dict:
+        """Nested JSON-ready view of every metric's current value."""
+        return {
+            "counters": {k: c.value for k, c in self._counters.items()},
+            "gauges": {k: g.value for k, g in self._gauges.items()},
+            "histograms": {
+                k: h.snapshot() for k, h in self._histograms.items()
+            },
+        }
+
+
+def _flatten(tree: dict, prefix: str = "") -> dict:
+    out = {}
+    for key in sorted(tree):
+        value = tree[key]
+        if isinstance(value, dict):
+            out.update(_flatten(value, f"{prefix}{key}."))
+        else:
+            out[prefix + key] = float(value)
+    return out
+
+
+def gather_snapshots(registry: MetricsRegistry) -> list[dict]:
+    """Per-process flat snapshots, one dict per process, ``proc`` tagged.
+
+    Single process: just the local snapshot. Multi-process: the flattened
+    numeric values ride a ``process_allgather`` (names are identical across
+    processes by the SPMD contract, so only values travel); every process
+    receives all snapshots, but by convention only process 0 writes them.
+    """
+    import jax
+
+    local = _flatten(registry.snapshot())
+    local_tagged = {"proc": jax.process_index(), **local}
+    if jax.process_count() == 1:
+        return [local_tagged]
+
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    keys = list(local.keys())
+    values = np.asarray([local[k] for k in keys], np.float64)
+    gathered = np.asarray(
+        multihost_utils.process_allgather(values)
+    ).reshape(jax.process_count(), -1)
+    return [
+        {"proc": p, **{k: float(v) for k, v in zip(keys, gathered[p])}}
+        for p in range(jax.process_count())
+    ]
+
+
+def write_metrics(registry: MetricsRegistry, writer) -> bool:
+    """Snapshot ``registry`` into a ``metrics`` event on ``writer``.
+
+    Returns True iff this process wrote (process 0); non-zero processes
+    contribute through the gather and return False without writing.
+    """
+    import jax
+
+    snapshots = gather_snapshots(registry)
+    if jax.process_index() != 0:
+        return False
+    writer.metrics(snapshots)
+    return True

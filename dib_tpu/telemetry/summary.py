@@ -1,0 +1,437 @@
+"""Run summaries and regression gating over an events.jsonl.
+
+``summarize`` rolls a run's event stream into one flat record shaped like
+the repo's committed bench records (``metric`` / ``value`` / ``unit`` plus
+breakdown keys), so the event stream and the historical one-line JSON
+artifacts stay comparable. ``compare`` diffs two runs — steps/s, final
+losses, MI lower bound, mitigation counts — and reports a regression when
+a metric moves past a threshold in its bad direction; the CLI exits
+nonzero on regression, making it a perf gate ``bench.py`` and CI can call:
+
+    python -m dib_tpu telemetry summarize <run_dir>
+    python -m dib_tpu telemetry compare <run_a> <run_b> --threshold 0.05
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+import warnings
+from math import log
+from typing import Sequence
+
+from dib_tpu.telemetry.events import (
+    SCHEMA_VERSION,
+    _sanitize_nonfinite,
+    read_events,
+)
+
+__all__ = ["summarize", "compare", "telemetry_main"]
+
+_LN2 = log(2.0)
+
+
+def _mean(xs):
+    xs = [x for x in xs if x is not None]
+    return sum(xs) / len(xs) if xs else None
+
+
+def _as_floats(value) -> list[float]:
+    """Flatten a scalar / list / nested list event field to floats.
+
+    Strings parse through ``float()`` — the event writer encodes a
+    diverged run's non-finite values as "NaN"/"Infinity" spellings
+    (events.py) and they must survive the round trip.
+    """
+    if value is None:
+        return []
+    if isinstance(value, (int, float)):
+        return [float(value)]
+    if isinstance(value, str):
+        try:
+            return [float(value)]
+        except ValueError:
+            return []
+    out = []
+    for v in value:
+        out.extend(_as_floats(v))
+    return out
+
+
+# one wire format for non-finite floats, shared with the writer side
+# (events.py) so the round-trip cannot drift
+_enc = _sanitize_nonfinite
+
+
+def summarize(path: str, process_index: int | None = None,
+              run_id: str | None = None) -> dict:
+    """Roll an events.jsonl (or its run dir) into one flat summary record.
+
+    A supervised run's stream holds several ``run_start`` events (one per
+    watchdog relaunch) plus the supervisor's ``mitigation`` events; the
+    summary reports the LAST manifest (the run that finished) and counts
+    chunks/steps across all launches — that is the honest end-to-end view
+    the watchdog report takes too. ``run_id`` restricts to one run's
+    events (for streams several invocations appended to, e.g. a reused
+    ``DIB_BENCH_TELEMETRY_DIR``).
+
+    Multihost: in an SPMD run EVERY process emits chunk/mi_bounds events
+    for the SAME global training, so with no explicit ``process_index``
+    the per-run totals (launches, steps, throughput, finals) are computed
+    from the lowest process index present — summing across processes
+    would multiply steps/s by ``process_count``. Mitigations and event
+    counts stay global.
+    """
+    events = list(read_events(path, process_index=process_index))
+    if run_id is not None:
+        events = [e for e in events if e.get("run") == run_id]
+    if not events:
+        raise ValueError(
+            f"{path}: no telemetry events"
+            + (f" for run_id {run_id!r}" if run_id is not None else "")
+            + " (expected an events.jsonl stream or its run dir)"
+        )
+
+    def of_type(t, pool):
+        return [e for e in pool if e.get("type") == t]
+
+    mitigations = of_type("mitigation", events)
+    per_run = events
+    if process_index is None:
+        chunk_procs = {e.get("proc", 0) for e in of_type("chunk", events)}
+        if len(chunk_procs) > 1:
+            lead = min(chunk_procs)
+            per_run = [e for e in events if e.get("proc", 0) == lead]
+    if not any(e.get("type") for e in events):
+        # e.g. a bench one-liner or arbitrary JSON handed to summarize:
+        # every line parsed, but nothing is an event
+        raise ValueError(
+            f"{path}: parsed {len(events)} JSON line(s) but none carry an "
+            "event 'type' — not a telemetry stream"
+        )
+    run_starts = of_type("run_start", per_run)
+    chunks = of_type("chunk", per_run)
+    compiles = of_type("compile", per_run)
+    hooks = of_type("hook", per_run)
+    mi_events = of_type("mi_bounds", per_run)
+    run_ends = of_type("run_end", per_run)
+
+    total_steps = sum(c.get("steps") or 0 for c in chunks)
+    total_chunk_s = sum(c.get("seconds") or 0.0 for c in chunks)
+    steps_per_s = total_steps / total_chunk_s if total_chunk_s > 0 else None
+
+    # Steady state excludes each launch's first chunk (compile-laden):
+    # walk the stream in order and drop the first chunk after every
+    # run_start — robust to whatever other events a launch emits in
+    # between, and to (run, seq) collisions across relaunched writers.
+    steady = []
+    awaiting_first_chunk = False
+    for e in per_run:
+        if e.get("type") == "run_start":
+            awaiting_first_chunk = True
+        elif e.get("type") == "chunk":
+            if awaiting_first_chunk:
+                awaiting_first_chunk = False
+            else:
+                steady.append(e)
+    steady_steps = sum(c.get("steps") or 0 for c in steady)
+    steady_s = sum(c.get("seconds") or 0.0 for c in steady)
+    steady_steps_per_s = steady_steps / steady_s if steady_s > 0 else steps_per_s
+
+    summary: dict = {
+        "metric": "run_telemetry_summary",
+        "value": round(steps_per_s, 3) if steps_per_s else None,
+        "unit": "steps_per_s",
+        "schema_version": SCHEMA_VERSION,
+        "num_events": len(events),
+        "launches": len(run_starts),
+        "num_chunks": len(chunks),
+        "total_steps": total_steps,
+        "total_chunk_s": round(total_chunk_s, 3),
+        "steps_per_s": round(steps_per_s, 3) if steps_per_s else None,
+        "steady_steps_per_s": (
+            round(steady_steps_per_s, 3) if steady_steps_per_s else None
+        ),
+        "processes": sorted({e.get("proc", 0) for e in events}),
+    }
+
+    runs: list[str] = []
+    for e in events:
+        if e.get("run") is not None and e["run"] not in runs:
+            runs.append(e["run"])
+    if len(runs) > 1:
+        summary["runs"] = runs
+    if run_id is None:
+        # Several run_starts are the supervised-run norm (one per watchdog
+        # relaunch of the SAME training) and aggregate honestly; several
+        # DIFFERENT configs mean independent invocations appended to a
+        # reused dir, whose blended totals gate on garbage — scope with
+        # run_id (CLI: --run-id).
+        hashes = {s.get("manifest", {}).get("config_hash")
+                  for s in of_type("run_start", events)}
+        hashes.discard(None)
+        if len(hashes) > 1:
+            warnings.warn(
+                f"{path}: {len(runs)} runs with {len(hashes)} distinct "
+                "config hashes blended into one summary — pass run_id= "
+                "(CLI: --run-id) to scope to one run"
+            )
+
+    if run_starts:
+        manifest = run_starts[-1].get("manifest", {})
+        summary["run_id"] = run_starts[-1]["run"]
+        for key in ("git_sha", "device_kind", "device_platform",
+                    "device_count", "process_count", "config_hash"):
+            if key in manifest:
+                summary[key] = manifest[key]
+    if run_starts and run_ends:
+        summary["wall_clock_s"] = round(run_ends[-1]["t"] - run_starts[0]["t"], 3)
+    # Status comes from the LAST launch's terminal record; a launch that
+    # never reached run_end (SIGKILL, still in flight) is visibly
+    # "incomplete", never silently "ok" from an earlier launch.
+    last_end = None
+    if run_starts:
+        ends_for_last = [e for e in run_ends
+                         if e.get("run") == run_starts[-1]["run"]]
+        last_end = ends_for_last[-1] if ends_for_last else None
+    elif run_ends:
+        last_end = run_ends[-1]
+    summary["status"] = (last_end.get("status") if last_end is not None
+                         else "incomplete")
+
+    if chunks:
+        last = chunks[-1]
+        summary["final_epoch"] = last.get("epoch")
+        for key in ("loss", "val_loss", "beta"):
+            if last.get(key) is not None:
+                vals = _as_floats(last[key])
+                summary[f"final_{key}"] = _enc(
+                    vals[0] if len(vals) == 1 else vals
+                )
+        kl = _as_floats(last.get("kl_per_feature"))
+        if kl:
+            summary["final_total_kl"] = _enc(sum(kl))
+        elif last.get("kl_total") is not None:
+            totals = _as_floats(last["kl_total"])
+            summary["final_total_kl"] = _enc(
+                totals[0] if len(totals) == 1 else totals
+            )
+
+    if mi_events:
+        last = mi_events[-1]
+        lower = _as_floats(last.get("lower_bits"))
+        upper = _as_floats(last.get("upper_bits"))
+        if not lower:  # nats-tagged emitters
+            lower = [x / _LN2 for x in _as_floats(last.get("lower_nats"))]
+            upper = [x / _LN2 for x in _as_floats(last.get("upper_nats"))]
+        if lower:
+            summary["final_mi_lower_bits_mean"] = _enc(round(_mean(lower), 4))
+        if upper:
+            summary["final_mi_upper_bits_mean"] = _enc(round(_mean(upper), 4))
+        summary["mi_checkpoints"] = len(mi_events)
+
+    counts: dict[str, int] = {}
+    for m in mitigations:
+        counts[m.get("mtype", "unknown")] = counts.get(m.get("mtype", "unknown"), 0) + 1
+    summary["mitigations"] = counts
+    summary["mitigations_total"] = len(mitigations)
+
+    if compiles:
+        by_cache: dict[str, int] = {}
+        for c in compiles:
+            by_cache[c.get("cache", "unknown")] = by_cache.get(c.get("cache", "unknown"), 0) + 1
+        summary["compile"] = {
+            "events": len(compiles),
+            "total_s": round(sum(c.get("seconds") or 0.0 for c in compiles), 3),
+            "cache": by_cache,
+        }
+    if hooks:
+        by_hook: dict[str, float] = {}
+        for h in hooks:
+            by_hook[h.get("name", "?")] = (
+                by_hook.get(h.get("name", "?"), 0.0) + (h.get("seconds") or 0.0)
+            )
+        summary["hook_s"] = {k: round(v, 4) for k, v in by_hook.items()}
+
+    metrics_events = of_type("metrics", per_run)
+    if metrics_events:
+        # last end-of-fit rollup, lead process's flat snapshot (chunk-time
+        # percentiles, step counters — see telemetry/metrics.py)
+        snaps = metrics_events[-1].get("snapshots") or []
+        if snaps:
+            summary["metrics"] = {
+                k: v for k, v in snaps[0].items() if k != "proc"
+            }
+    return summary
+
+
+# Gated fields: (summary key, bad direction). "down" = a drop beyond the
+# threshold regresses (throughput, MI lower bound); "up" = a rise does
+# (losses). Mitigations are gated separately — ANY increase regresses.
+_GATES: Sequence[tuple[str, str]] = (
+    ("steps_per_s", "down"),
+    ("steady_steps_per_s", "down"),
+    ("final_loss", "up"),
+    ("final_val_loss", "up"),
+    ("final_mi_lower_bits_mean", "down"),
+)
+
+
+def compare(
+    summary_a: dict, summary_b: dict, threshold: float = 0.05
+) -> tuple[dict, bool]:
+    """Diff run B (candidate) against run A (baseline).
+
+    Returns ``(report, regressed)``. A field regresses when its RELATIVE
+    move in the bad direction exceeds ``threshold``. Per-replica LIST
+    fields (sweep runs' final losses) gate on their MEAN — skipping them
+    silently would leave the flagship sweep runs ungated on quality.
+    Comparisons where either side is missing or unusable are reported
+    with an explicit ``"gated": false``.
+    """
+
+    def scalarize(v):
+        # "NaN"/"Infinity" string spellings (events.py's strict-JSON
+        # encoding of a diverged run) parse back to real floats here
+        if isinstance(v, str):
+            try:
+                v = float(v)
+            except ValueError:
+                return None
+        if isinstance(v, bool) or v is None:
+            return None
+        if isinstance(v, (int, float)):
+            return float(v)
+        if isinstance(v, (list, tuple)):
+            nums = [scalarize(x) for x in v]
+            if v and all(x is not None for x in nums):
+                return sum(nums) / len(nums)
+            return None
+        return None
+
+    fields: dict[str, dict] = {}
+    regressed = False
+    for key, bad in _GATES:
+        a_raw, b_raw = summary_a.get(key), summary_b.get(key)
+        row: dict = {"a": a_raw, "b": b_raw, "bad_direction": bad}
+        a, b = scalarize(a_raw), scalarize(b_raw)
+        if isinstance(a_raw, (list, tuple)) or isinstance(b_raw, (list, tuple)):
+            row["gated_on"] = "mean"
+        if a is not None and math.isfinite(a) \
+                and b is not None and math.isfinite(b):
+            row["delta"] = round(b - a, 6)
+            denom = max(abs(a), 1e-12)
+            rel = (b - a) / denom
+            row["rel"] = round(rel, 6)
+            row["regressed"] = (
+                rel < -threshold if bad == "down" else rel > threshold
+            )
+        elif (a is not None and math.isfinite(a)
+              and b is not None and not math.isfinite(b)):
+            # a finite baseline against a diverged candidate: that is THE
+            # regression the gate exists for, not an ungateable comparison
+            row["regressed"] = True
+            row["reason"] = "candidate non-finite"
+        else:
+            row["gated"] = False
+            row["regressed"] = False
+        regressed = regressed or row["regressed"]
+        fields[key] = row
+
+    a_mit = summary_a.get("mitigations_total", 0) or 0
+    b_mit = summary_b.get("mitigations_total", 0) or 0
+    fields["mitigations_total"] = {
+        "a": a_mit, "b": b_mit, "delta": b_mit - a_mit,
+        "bad_direction": "up",
+        # reliability, not noise: one extra kill/restart is a regression
+        "regressed": b_mit > a_mit,
+    }
+    regressed = regressed or b_mit > a_mit
+
+    if (summary_a.get("config_hash") and summary_b.get("config_hash")
+            and summary_a["config_hash"] != summary_b["config_hash"]):
+        note = "config_hash differs: runs are not like-for-like"
+    else:
+        note = None
+    report = {
+        "threshold": threshold,
+        "fields": fields,
+        "regressed": regressed,
+    }
+    if note:
+        report["note"] = note
+    return report, regressed
+
+
+def _load_side(path: str, process_index: int | None,
+               run_id: str | None = None) -> dict:
+    """A compare operand: an events.jsonl / run dir, a precomputed summary
+    JSON (detected by its ``metric`` field), or a bench one-liner (its
+    summary rides under a ``telemetry`` key — every bench line is a valid
+    baseline)."""
+    if os.path.isfile(path):
+        try:
+            with open(path) as f:
+                record = json.load(f)
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            record = None  # multi-line jsonl: summarize below
+        if isinstance(record, dict):
+            if record.get("metric") == "run_telemetry_summary":
+                return record
+            embedded = record.get("telemetry")
+            if (isinstance(embedded, dict)
+                    and embedded.get("metric") == "run_telemetry_summary"):
+                return embedded
+    return summarize(path, process_index=process_index, run_id=run_id)
+
+
+def telemetry_main(argv: Sequence[str]) -> int:
+    parser = argparse.ArgumentParser(
+        prog="dib_tpu telemetry",
+        description="Summarize or diff run event streams (docs/observability.md).",
+    )
+    sub = parser.add_subparsers(dest="action", required=True)
+    p_sum = sub.add_parser("summarize", help="Roll an events.jsonl into one record.")
+    p_sum.add_argument("path", help="Run dir or events.jsonl path.")
+    p_sum.add_argument("--process-index", type=int, default=None)
+    p_sum.add_argument("--run-id", default=None,
+                       help="Restrict to one run's events when several "
+                            "invocations appended to the same stream.")
+    p_sum.add_argument("--indent", action="store_true")
+    p_cmp = sub.add_parser("compare", help="Diff run B against baseline A.")
+    p_cmp.add_argument("baseline", help="Run dir / events.jsonl / summary JSON.")
+    p_cmp.add_argument("candidate", help="Run dir / events.jsonl / summary JSON.")
+    p_cmp.add_argument("--threshold", type=float, default=0.05,
+                       help="Relative regression threshold (default 0.05).")
+    p_cmp.add_argument("--process-index", type=int, default=None)
+    p_cmp.add_argument("--run-id-a", default=None,
+                       help="Restrict the baseline to one run's events.")
+    p_cmp.add_argument("--run-id-b", default=None,
+                       help="Restrict the candidate to one run's events.")
+    p_cmp.add_argument("--indent", action="store_true")
+    args = parser.parse_args(argv)
+
+    try:
+        if args.action == "summarize":
+            record = summarize(args.path, process_index=args.process_index,
+                               run_id=args.run_id)
+            print(json.dumps(record, indent=1 if args.indent else None))
+            return 0
+        a = _load_side(args.baseline, args.process_index,
+                       run_id=args.run_id_a)
+        b = _load_side(args.candidate, args.process_index,
+                       run_id=args.run_id_b)
+    except (ValueError, OSError) as exc:
+        # bad operand (not a stream / no events / unreadable): distinct
+        # from a regression verdict, which is exit code 1
+        print(f"telemetry {args.action}: {exc}", file=sys.stderr)
+        return 2
+    report, regressed = compare(a, b, threshold=args.threshold)
+    print(json.dumps(report, indent=1 if args.indent else None))
+    if regressed:
+        print("telemetry compare: REGRESSION beyond threshold "
+              f"{args.threshold}", file=sys.stderr)
+    return 1 if regressed else 0

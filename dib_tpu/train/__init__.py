@@ -15,7 +15,12 @@ from dib_tpu.train.history import (
     history_record,
 )
 from dib_tpu.train.loop import TrainConfig, TrainState, DIBTrainer, make_optimizer
-from dib_tpu.train.hooks import Every, InfoPerFeatureHook, CompressionMatrixHook
+from dib_tpu.train.hooks import (
+    CompressionMatrixHook,
+    Every,
+    InfoPerFeatureHook,
+    TimedHook,
+)
 from dib_tpu.train.checkpoint import DIBCheckpointer, CheckpointHook
 from dib_tpu.train.measurement import (
     MeasurementCheckpointer,

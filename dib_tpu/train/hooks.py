@@ -16,6 +16,7 @@ hot loop.
 from __future__ import annotations
 
 import os
+import time
 
 import jax
 import jax.numpy as jnp
@@ -90,9 +91,77 @@ class Every:
         self.cadence = max(int(cadence), 1)
         self.hook = hook
 
+    def fires_at(self, epoch: int) -> bool:
+        return epoch % self.cadence == 0
+
     def __call__(self, trainer, state, epoch: int):
-        if epoch % self.cadence == 0:
+        if self.fires_at(epoch):
             self.hook(trainer, state, epoch)
+
+
+def hook_display_name(hook) -> str:
+    """Attribution name for hook telemetry: unwraps cadence and fan-out
+    adapters (``Every``, and anything exposing ``telemetry_inner_hooks`` —
+    ``PerReplicaHook``, the CLI's combined-hook adapter) so stream time
+    charges to the hook doing the work, not the wrapper."""
+    if isinstance(hook, Every):
+        return hook_display_name(hook.hook)
+    inner = getattr(hook, "telemetry_inner_hooks", None)
+    if inner:
+        names: list[str] = []
+        for h in inner:
+            n = hook_display_name(h)
+            if n not in names:
+                names.append(n)
+        return "+".join(names)
+    return type(hook).__name__
+
+
+class TimedHook:
+    """Measures a hook's wall-clock per invocation.
+
+    Instrumentation hooks run on the host between jitted chunks, so their
+    cost is invisible to device profilers — this wrapper is how a slow run
+    learns whether the time went to training or to instrumentation.
+    ``seconds`` accumulates per-invocation wall-clocks; with a ``telemetry``
+    ``EventWriter`` each invocation also lands as a ``hook`` event. Wrapping
+    is transparent: attribute access falls through to the inner hook, so
+    hook-published state (e.g. ``InfoPerFeatureHook.records``) stays
+    reachable.
+    """
+
+    def __init__(self, hook, telemetry=None, name: str | None = None):
+        self.hook = hook
+        self.telemetry = telemetry
+        # name the WRAPPED hook(s), not the adapters: a stream where all
+        # time charges to "Every" or "PerReplicaHook" attributes nothing
+        self.name = name if name is not None else hook_display_name(hook)
+        self.seconds: list[float] = []
+
+    def __call__(self, trainer, state, epoch: int):
+        # a cadence-gated hook (Every, or any adapter exposing fires_at —
+        # PerReplicaHook, _CombinedHooks) that does not fire this epoch
+        # must not leave a phantom ~0 s invocation diluting its statistics
+        fires_at = getattr(self.hook, "fires_at", None)
+        if fires_at is not None and not fires_at(epoch):
+            return
+        start = time.perf_counter()
+        try:
+            self.hook(trainer, state, epoch)
+        finally:
+            elapsed = time.perf_counter() - start
+            self.seconds.append(elapsed)
+            if self.telemetry is not None:
+                self.telemetry.hook(
+                    name=self.name, epoch=int(epoch), seconds=elapsed
+                )
+
+    def __getattr__(self, attr):
+        # 'hook' missing means __init__ hasn't run (e.g. unpickling probes
+        # __setstate__) — recursing through self.hook would never terminate
+        if attr == "hook" or attr.startswith("__"):
+            raise AttributeError(attr)
+        return getattr(self.hook, attr)
 
 
 class InfoPerFeatureHook:

@@ -310,6 +310,7 @@ class DIBTrainer:
         hook_every: int = 0,
         state: TrainState | None = None,
         history: dict | None = None,
+        telemetry=None,
     ) -> tuple[TrainState, HistoryRecord]:
         """Python-level driver: jitted chunks + host hooks between them.
 
@@ -318,6 +319,13 @@ class DIBTrainer:
         equivalent of the reference's Keras callbacks
         (``InfoPerFeatureCallback`` / ``SaveCompressionMatricesCallback``,
         reference ``models.py:152-223``).
+
+        ``telemetry`` (an ``EventWriter``) makes every chunk boundary emit a
+        ``chunk`` event — wall-clock + steps/s via ``PhaseTimer`` and the
+        chunk's last recorded history row. Emission is strictly BETWEEN
+        jitted chunks on already-fetched scalars (plus one small row fetch),
+        never inside the scan; it does add one ``block_until_ready`` per
+        chunk, which hooks like HeartbeatHook impose anyway.
 
         A caller-supplied ``state``/``history`` (e.g. restored from a
         checkpoint) is CONSUMED: on accelerators its buffers are donated to
@@ -341,6 +349,9 @@ class DIBTrainer:
                 f"recorded and {num_epochs} more were requested; grow it with "
                 f"history_extend(history, n) or train fewer epochs."
             )
+        from dib_tpu.telemetry.hooks import FitRecorder
+
+        recorder = FitRecorder(telemetry, steps_per_epoch=self.steps_per_epoch)
         # hook_every bounds chunk size even with no hooks (very long device
         # programs can exceed runtime execution limits); note the chunk
         # boundaries define the PRNG chain (one key split per chunk)
@@ -349,7 +360,11 @@ class DIBTrainer:
         while done < num_epochs:
             this_chunk = min(chunk, num_epochs - done)
             key, k_chunk = jax.random.split(key)
-            state, history = self.run_chunk(state, history, k_chunk, this_chunk)
+            with recorder.chunk_phase() as ph:
+                state, history = self.run_chunk(
+                    state, history, k_chunk, this_chunk
+                )
+                ph.block_on(state.params)
             done += this_chunk
             # Published for CheckpointHook: resuming fit(resume_key, ...) with
             # the same chunk size continues the exact key chain, so the
@@ -357,8 +372,21 @@ class DIBTrainer:
             self.resume_key = key
             self.latest_history = history
             self.resume_chunk = chunk
+            if telemetry is not None:
+                row = jax.device_get({
+                    name: history[name][cursor + done - 1]
+                    for name in ("beta", "loss", "val_loss", "kl_per_feature")
+                })
+                recorder.record_chunk(
+                    epoch=cursor + done, chunk_epochs=this_chunk,
+                    beta=float(row["beta"]),
+                    loss=float(row["loss"]),
+                    val_loss=float(row["val_loss"]),
+                    kl_per_feature=[float(x) for x in row["kl_per_feature"]],
+                )
             for hook in hooks:
                 hook(self, state, int(state.epoch))
+        recorder.finish()
         return state, HistoryRecord.from_device(history)
 
     # ------------------------------------------------------------ inspection

@@ -129,6 +129,7 @@ def supervise(
     config: WatchdogConfig | None = None,
     env: dict | None = None,
     log=lambda msg: print(msg, file=sys.stderr, flush=True),
+    telemetry=None,
 ) -> dict:
     """Run ``cmd`` under stall/crash supervision until it exits 0.
 
@@ -136,11 +137,29 @@ def supervise(
     SIGKILL must continue from its own checkpoint (the north-star worker
     and the CLI both do this via ``--checkpoint-dir``).
 
+    ``telemetry`` (an ``EventWriter``, typically appending to the SAME
+    events.jsonl the worker writes — O_APPEND keeps the two writers from
+    interleaving) mirrors every mitigation onto the event stream as it
+    happens, so a run killed mid-flight still carries its kill record.
+
     Returns a report dict: ``{"returncode", "wall_s", "launches",
     "mitigations": [{"type": "stall_kill"|"crash_restart", ...}]}``.
     """
     cfg = config or WatchdogConfig()
     mitigations: list[dict] = []
+    if telemetry is not None:
+        class _MirroredList(list):
+            """append() also emits a ``mitigation`` event."""
+
+            def append(self, item):
+                super().append(item)
+                try:
+                    fields = {k: v for k, v in item.items() if k != "type"}
+                    telemetry.mitigation(mtype=item["type"], **fields)
+                except OSError as exc:   # a full disk must not kill recovery
+                    log(f"watchdog: telemetry write failed: {exc}")
+
+        mitigations = _MirroredList()
     t_start = time.time()
     # The worker runs in its own session (so WE can kill its whole group),
     # which also means it would SURVIVE the supervisor's death — an external
@@ -192,6 +211,7 @@ def supervise_self(
     heartbeat: str = "",
     checkpoint_dir: str = "",
     config: WatchdogConfig | None = None,
+    telemetry=None,
 ) -> dict:
     """Re-exec the CURRENT command as a supervised worker.
 
@@ -210,7 +230,8 @@ def supervise_self(
                         (checkpoint_flag, checkpoint_dir)):
         if flag not in worker:
             worker += [flag, value]
-    result = supervise(list(worker_prefix) + worker, heartbeat, config)
+    result = supervise(list(worker_prefix) + worker, heartbeat, config,
+                       telemetry=telemetry)
     result["heartbeat"] = heartbeat
     result["checkpoint_dir"] = checkpoint_dir
     return result

@@ -15,7 +15,9 @@ import json
 import time
 from dataclasses import dataclass, field
 
-import jax
+# jax is imported inside the functions that block/trace: this module also
+# backs host-only consumers (`dib_tpu telemetry`, the watchdog supervisor)
+# that must not pay the jax import — let alone risk backend init
 
 
 class _PhaseHandle:
@@ -46,6 +48,15 @@ class PhaseTimer:
 
     totals: dict = field(default_factory=dict)
     counts: dict = field(default_factory=dict)
+    intervals: dict = field(default_factory=dict)   # per-phase elapsed series
+
+    def add(self, name: str, elapsed: float) -> None:
+        """Record an externally measured interval under ``name`` — for
+        callers whose phase boundaries are hook invocations rather than a
+        ``with`` block (telemetry.ChunkPhaseHooks)."""
+        self.totals[name] = self.totals.get(name, 0.0) + elapsed
+        self.counts[name] = self.counts.get(name, 0) + 1
+        self.intervals.setdefault(name, []).append(elapsed)
 
     @contextlib.contextmanager
     def phase(self, name: str):
@@ -55,10 +66,10 @@ class PhaseTimer:
             yield handle
         finally:
             if handle._outputs:
+                import jax
+
                 jax.block_until_ready(handle._outputs)
-            elapsed = time.perf_counter() - start
-            self.totals[name] = self.totals.get(name, 0.0) + elapsed
-            self.counts[name] = self.counts.get(name, 0) + 1
+            self.add(name, time.perf_counter() - start)
 
     def report(self) -> dict:
         """{phase: {"total_s", "count", "mean_s"}} summary."""
@@ -78,6 +89,8 @@ class PhaseTimer:
 def timed_blocked(fn, *args, **kwargs):
     """(result, seconds) with ``block_until_ready`` on the result — the
     correct way to time one jitted call."""
+    import jax
+
     start = time.perf_counter()
     out = fn(*args, **kwargs)
     jax.block_until_ready(out)
@@ -93,6 +106,8 @@ def device_trace(logdir: str | None):
     if not logdir:
         yield
         return
+    import jax
+
     jax.profiler.start_trace(logdir)
     try:
         yield
@@ -104,6 +119,8 @@ def steps_per_second(fn, *args, repeats: int = 3, warmup: int = 1, **kwargs):
     """Throughput of a nullary-ish jitted call: runs ``warmup`` unmeasured
     calls (compile + autotune), then ``repeats`` measured, returns
     (calls_per_second, per_call_seconds_list)."""
+    import jax
+
     for _ in range(warmup):
         jax.block_until_ready(fn(*args, **kwargs))
     times = []

@@ -196,23 +196,38 @@ class BooleanTrainer:
         logits, _ = self.model.apply(state.params, self._x, key)
         return bce_with_logits(logits, self._y), binary_accuracy(logits, self._y)
 
-    def fit(self, key: Array, state: BooleanTrainState | None = None):
+    def fit(self, key: Array, state: BooleanTrainState | None = None,
+            telemetry=None):
         """Train with MI measurement every ``mi_cadence`` steps.
 
         Returns (state, history) where history carries per-step series
         (task/kl/beta) and the per-channel MI bound trajectory in BITS
         ([num_checks, F] lower/upper plus the step and beta at each check).
+
+        ``telemetry`` (an ``EventWriter``) emits one ``chunk`` event per
+        measurement chunk — ``PhaseTimer``-measured wall-clock/steps/s plus
+        the chunk's final task loss, beta, and per-channel KL, all read off
+        the ``stats`` arrays this loop fetches anyway — and one
+        ``mi_bounds`` event per checkpoint. Nothing is added inside the
+        jitted scan.
         """
         cfg = self.config
         if state is None:
             key, k_init = jax.random.split(key)
             state = self.init(k_init)
+        from dib_tpu.telemetry.hooks import FitRecorder
+
+        # this loop's chunks are counted directly in steps, so the
+        # per-"epoch" multiplier is 1
+        recorder = FitRecorder(telemetry, steps_per_epoch=1)
         series = {"task": [], "kl": [], "beta": []}
         checks = {"step": [], "beta": [], "lower_bits": [], "upper_bits": []}
         while int(state.step) < cfg.num_steps:
             chunk = min(cfg.mi_cadence, cfg.num_steps - int(state.step))
             key, k_chunk, k_mi = jax.random.split(key, 3)
-            state, stats = self.run_chunk(state, k_chunk, chunk)
+            with recorder.chunk_phase() as ph:
+                state, stats = self.run_chunk(state, k_chunk, chunk)
+                ph.block_on(state.params)
             for name in series:
                 series[name].append(np.asarray(stats[name]))
             lower, upper = self.channel_mi_bounds(state, k_mi)
@@ -220,6 +235,19 @@ class BooleanTrainer:
             checks["beta"].append(float(stats["beta"][-1]))
             checks["lower_bits"].append(np.asarray(lower) / LN2)
             checks["upper_bits"].append(np.asarray(upper) / LN2)
+            if telemetry is not None:
+                recorder.record_chunk(
+                    epoch=int(state.step), chunk_epochs=chunk,
+                    beta=float(stats["beta"][-1]),
+                    loss=float(np.asarray(stats["task"])[-1]),
+                    kl_per_feature=[float(x) for x in np.asarray(stats["kl"])[-1]],
+                )
+                telemetry.mi_bounds(
+                    epoch=int(state.step),
+                    lower_bits=[float(x) for x in checks["lower_bits"][-1]],
+                    upper_bits=[float(x) for x in checks["upper_bits"][-1]],
+                )
+        recorder.finish()
         history = {name: np.concatenate(vals) for name, vals in series.items()}
         history["mi_steps"] = np.asarray(checks["step"])
         history["mi_betas"] = np.asarray(checks["beta"])
@@ -309,6 +337,7 @@ def run_boolean_workload(
     key: Array | int = 0,
     config: BooleanWorkloadConfig | None = None,
     circuit_specification=None,
+    telemetry=None,
     **fetch_kwargs,
 ) -> dict:
     """End-to-end boolean-circuit decomposition with all exact oracles.
@@ -329,7 +358,7 @@ def run_boolean_workload(
 
     trainer = BooleanTrainer(bundle, config)
     key, k_fit, k_eval = jax.random.split(key, 3)
-    state, history = trainer.fit(k_fit)
+    state, history = trainer.fit(k_fit, telemetry=telemetry)
     bce, acc = trainer.full_table_eval(state, k_eval)
 
     subset_infos = exact_subset_informations(table, n)

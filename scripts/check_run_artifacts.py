@@ -1,0 +1,151 @@
+"""Validate committed run artifacts against the shared record schema.
+
+Every ``BENCH_*.json`` / ``NORTHSTAR_*.json`` at the repo root is part of
+the measured history the paper's claims rest on, so each must stay
+machine-readable forever. Two record shapes are legal:
+
+  - **metric records** (``metric``/``value``/``unit`` envelope — bench.py
+    output, north-star reports, ensemble rollups): ``metric`` and ``unit``
+    are non-empty strings; ``value`` is a finite number, bool, or null —
+    and a null value must be explained by a ``degraded``, ``error``, or
+    per-run breakdown field so a missing measurement can never masquerade
+    as a clean one. ``vs_baseline`` (when scalar) must be finite, and
+    ``measured_at`` (when present) must parse as ``%Y-%m-%dT%H:%M:%SZ``.
+  - **driver captures** (``{"n", "cmd", "rc", "tail"}``): the round
+    driver's raw command transcript; typed fields only.
+
+Strict JSON: ``NaN``/``Infinity`` constants (which ``json.dump`` happily
+emits and nothing else can parse) are rejected.
+
+Runnable three ways::
+
+    python scripts/check_run_artifacts.py          # standalone, rc 1 on bad
+    python -m pytest scripts/check_run_artifacts.py
+    python -m pytest tests/test_bench_contract.py  # imports check_all()
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import math
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+ARTIFACT_GLOBS = ("BENCH_*.json", "NORTHSTAR_*.json")
+
+# Null-value excuses: at least one must be present when value is null.
+_NULL_VALUE_EXCUSES = ("degraded", "error", "per_run_minutes", "runs_completed")
+
+
+def _reject_constant(name: str):
+    raise ValueError(f"non-finite JSON constant {name!r}")
+
+
+def _is_finite_number(x) -> bool:
+    return (isinstance(x, (int, float)) and not isinstance(x, bool)
+            and math.isfinite(x))
+
+
+def check_record(record: dict, problems: list[str]) -> None:
+    """Append schema violations for one parsed artifact to ``problems``."""
+    if not isinstance(record, dict):
+        problems.append(f"top level must be an object, got {type(record).__name__}")
+        return
+
+    if "metric" in record:
+        # ---- metric record
+        for key in ("metric", "unit"):
+            if not (isinstance(record.get(key), str) and record[key]):
+                problems.append(f"{key!r} must be a non-empty string")
+        value = record.get("value")
+        if value is None:
+            # null AND absent both need an explanation — ensemble rollups
+            # carry per-run breakdowns instead of one scalar, degraded
+            # bench lines say so; a bare hole is a schema violation
+            if not any(k in record for k in _NULL_VALUE_EXCUSES):
+                problems.append(
+                    "missing/null 'value' without an explaining field "
+                    f"(one of {_NULL_VALUE_EXCUSES})"
+                )
+        elif not (isinstance(value, bool) or _is_finite_number(value)):
+            problems.append(
+                f"'value' must be a finite number, bool, or null; "
+                f"got {value!r}"
+            )
+        vsb = record.get("vs_baseline")
+        if vsb is not None and isinstance(vsb, (int, float)) \
+                and not _is_finite_number(vsb):
+            problems.append(f"'vs_baseline' must be finite, got {vsb!r}")
+        measured_at = record.get("measured_at")
+        if measured_at is not None:
+            try:
+                time.strptime(measured_at, "%Y-%m-%dT%H:%M:%SZ")
+            except (TypeError, ValueError):
+                problems.append(
+                    f"'measured_at' must be %Y-%m-%dT%H:%M:%SZ, "
+                    f"got {measured_at!r}"
+                )
+    elif {"cmd", "rc"} <= set(record):
+        # ---- driver capture
+        if not isinstance(record["cmd"], str):
+            problems.append("'cmd' must be a string")
+        if not isinstance(record["rc"], int) or isinstance(record["rc"], bool):
+            problems.append("'rc' must be an integer")
+        if "tail" in record and not isinstance(record["tail"], str):
+            problems.append("'tail' must be a string")
+    else:
+        problems.append(
+            "unrecognized record shape: neither a metric record "
+            "('metric'/'value'/'unit') nor a driver capture ('cmd'/'rc')"
+        )
+
+
+def check_file(path: str) -> list[str]:
+    """Schema violations for one artifact file (empty list = clean)."""
+    problems: list[str] = []
+    try:
+        with open(path) as f:
+            record = json.load(f, parse_constant=_reject_constant)
+    except (OSError, ValueError) as exc:
+        return [f"unreadable/invalid JSON: {exc}"]
+    check_record(record, problems)
+    return problems
+
+
+def check_all(repo: str = REPO) -> dict[str, list[str]]:
+    """{relative path: problems} for every committed run artifact."""
+    results: dict[str, list[str]] = {}
+    for pattern in ARTIFACT_GLOBS:
+        for path in sorted(glob.glob(os.path.join(repo, pattern))):
+            results[os.path.relpath(path, repo)] = check_file(path)
+    return results
+
+
+# ---------------------------------------------------------------- pytest
+def test_committed_run_artifacts():
+    results = check_all()
+    assert results, "no BENCH_*/NORTHSTAR_* artifacts found at repo root"
+    bad = {path: probs for path, probs in results.items() if probs}
+    assert not bad, f"artifact schema violations: {json.dumps(bad, indent=1)}"
+
+
+def main() -> int:
+    results = check_all()
+    bad = 0
+    for path, problems in results.items():
+        if problems:
+            bad += 1
+            for problem in problems:
+                print(f"{path}: {problem}")
+        else:
+            print(f"{path}: ok")
+    print(f"{len(results)} artifacts checked, {bad} with violations")
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -58,6 +58,18 @@ def watchdog_main(args) -> int:
 
     cfg = WatchdogConfig(first_beat_timeout_s=args.watchdog_first_timeout_s,
                          floor_s=args.watchdog_floor_s)
+    # Supervisor-side mitigation events append to the same events.jsonl the
+    # worker writes (O_APPEND: no interleaving); the supervisor itself never
+    # initializes a backend, hence the explicit process index.
+    # Pinned run id: supervisor mitigations + every worker relaunch are
+    # ONE run for --run-id scoping (see cli._watchdog_main).
+    from dib_tpu.telemetry import open_writer, shared_run_id
+
+    run_id = shared_run_id()
+    os.environ["DIB_TELEMETRY_RUN_ID"] = run_id
+    telemetry = open_writer(args.telemetry_dir or None, args.outdir,
+                            run_id=run_id, process_index=0,
+                            tags={"src": "supervisor"})
     t0 = time.time()
     result = supervise_self(
         [sys.executable, os.path.abspath(__file__)], sys.argv[1:],
@@ -68,7 +80,9 @@ def watchdog_main(args) -> int:
         heartbeat=args.heartbeat,
         checkpoint_dir=args.checkpoint_dir,
         config=cfg,
+        telemetry=telemetry,
     )
+    telemetry.close()
     total_s = time.time() - t0
     try:
         # a report predating this supervised run is some EARLIER run's
@@ -134,6 +148,9 @@ def main() -> int:
                              "on a stalling device (VERDICT r4 item 1)")
     parser.add_argument("--watchdog-floor-s", type=float, default=45.0)
     parser.add_argument("--watchdog-first-timeout-s", type=float, default=600.0)
+    parser.add_argument("--telemetry-dir", default="",
+                        help="events.jsonl directory (default: --outdir; "
+                             "see docs/observability.md)")
     args = parser.parse_args()
 
     if args.watchdog:
@@ -165,40 +182,57 @@ def main() -> int:
     # compression-scheme pulls (feature 0 only: the per-particle model
     # shares ONE encoder across particle slots, so other slots' schemes are
     # identical) + MI sandwich bounds for every replica.
+    # Worker-side event stream (docs/observability.md): run_start manifest,
+    # one ``chunk`` event per beta checkpoint, ``mi_bounds`` per checkpoint
+    # measurement, ``run_end``. Under --watchdog the supervisor appends its
+    # ``mitigation`` events to the SAME file (O_APPEND, no interleaving).
+    from dib_tpu.telemetry import (
+        ChunkPhaseHooks,
+        open_writer,
+        runtime_manifest,
+        shared_run_id,
+    )
+
+    # always on: '' (the flag default) falls through to the run's outdir;
+    # under --watchdog, shared_run_id() adopts the supervisor's pinned id
+    telemetry = open_writer(args.telemetry_dir or None, args.outdir,
+                            run_id=shared_run_id())
+    # the ONE definition of the sweep grid: the fit call and every
+    # telemetry step count derive from these
+    num_repeats = max(args.replicas // 8, 1)
+    beta_ends = np.logspace(-2, 0, min(args.replicas, 8))
+    num_replicas = num_repeats * len(beta_ends)
+    telemetry.run_start(runtime_manifest(
+        config=config,
+        extra={"workload": "northstar_amorphous_sweep", "seed": args.seed,
+               "replicas": num_replicas, "compile_cache": compile_cache,
+               "score_dtype": _dense_score_dtype().__name__},
+    ))
+
     resuming = bool(args.checkpoint_dir)
     comp = SweepCompressionHook(args.outdir, features=(0,), resume=resuming)
     info = SweepInfoPerFeatureHook(
         config.mi_eval_batch_size, config.mi_eval_batches,
         persist=os.path.join(args.outdir, "mi_bounds") if resuming else None,
+        telemetry=telemetry,
     )
 
-    class _CheckpointPhaseTimer:
-        """Per-checkpoint chunk-vs-instrumentation wall clocks (round 4:
-        the ensemble showed a 1.65x run-to-run spread on an idle host —
-        this records WHERE a slow run loses the time). ``pre`` runs as the
-        FIRST hook and blocks on the chunk's outputs, so its interval is
-        the 1250-step train chunk; ``post`` runs LAST, so its interval is
-        the measurement/pull work of the checkpoint."""
+    # Per-checkpoint chunk-vs-instrumentation wall clocks (round 4: the
+    # ensemble showed a 1.65x run-to-run spread on an idle host — this
+    # records WHERE a slow run loses the time). ``phases.pre`` runs FIRST
+    # and blocks on the chunk's outputs, so its interval is the 1250-step
+    # train chunk; ``phases.post`` runs LAST, so its interval is the
+    # measurement/pull work of the checkpoint. The sweep's chunk events
+    # count every replica's steps (the bench.py steps/s convention).
+    # a resumed run's restore epoch is unknown until the sweep returns, so
+    # its first chunk's step count is unattributable — timed but not emitted
+    phases = ChunkPhaseHooks(
+        telemetry=telemetry,
+        steps_per_epoch=args.steps_per_epoch * num_replicas,
+        baseline_known=not resuming,
+    )
 
-        def __init__(self):
-            self.chunk_s: list = []
-            self.hook_s: list = []
-            self._t = time.time()
-
-        def pre(self, sweep, states, epoch):
-            jax.block_until_ready(states.params)
-            now = time.time()
-            self.chunk_s.append(round(now - self._t, 2))
-            self._t = now
-
-        def post(self, sweep, states, epoch):
-            now = time.time()
-            self.hook_s.append(round(now - self._t, 2))
-            self._t = now
-
-    timer = _CheckpointPhaseTimer()
-
-    hooks = [timer.pre, comp, info, timer.post]
+    hooks = [phases.pre, comp, info, phases.post]
     if args.heartbeat:
         from dib_tpu.train.watchdog import HeartbeatHook
 
@@ -207,12 +241,12 @@ def main() -> int:
         hooks.insert(0, HeartbeatHook(args.heartbeat))
 
     t0 = time.time()
-    timer._t = t0
+    phases.start()
     result = run_amorphous_sweep(
         key=args.seed,
         config=config,
-        num_repeats=max(args.replicas // 8, 1),
-        beta_ends=np.logspace(-2, 0, min(args.replicas, 8)),
+        num_repeats=num_repeats,
+        beta_ends=beta_ends,
         outdir=args.outdir,
         steps_per_epoch=args.steps_per_epoch,
         chunk_epochs=args.chunk_epochs,
@@ -261,8 +295,14 @@ def main() -> int:
         # a resumed worker only re-measures its own (post-restore) chunks
         "resumed_from_epoch": result.get("resumed_from_epoch"),
         # first chunk_s entry includes init+compile; the rest are steady-state
-        "checkpoint_chunk_s": timer.chunk_s,
-        "checkpoint_instrumentation_s": timer.hook_s,
+        "checkpoint_chunk_s": [
+            round(s, 2) for s in phases.timer.intervals.get("chunk", [])
+        ],
+        "checkpoint_instrumentation_s": [
+            round(s, 2)
+            for s in phases.timer.intervals.get("instrumentation", [])
+        ],
+        "events_path": telemetry.path,
         "replicas": len(records),
         "steps_per_replica": args.steps,
         "steps_per_epoch": args.steps_per_epoch,
@@ -300,6 +340,11 @@ def main() -> int:
         bounds_nats=np.stack([rec["bounds"] for rec in info.records])
         if info.records else np.zeros((0,)),
     )
+    telemetry.run_end(
+        status="ok" if (finite and bounds_finite) else "non_finite",
+        minutes=report["value"],
+    )
+    telemetry.close()
     print(json.dumps(report))
     if not (finite and bounds_finite):
         print("NON-FINITE VALUES IN RUN", file=sys.stderr)
@@ -308,4 +353,14 @@ def main() -> int:
 
 
 if __name__ == "__main__":
-    raise SystemExit(main())
+    try:
+        raise SystemExit(main())
+    except SystemExit:
+        raise
+    except BaseException as exc:
+        # crash-path terminal record for the run's event stream
+        # (docs/observability.md): never end on a dangling chunk
+        from dib_tpu.telemetry import finalize_crashed
+
+        finalize_crashed(exc, log=lambda msg: print(msg, file=sys.stderr))
+        raise

@@ -91,6 +91,47 @@ def test_analytic_model_flops_are_plausible():
     )
 
 
+def test_all_committed_run_artifacts_validate():
+    # Shared schema over EVERY committed BENCH_*/NORTHSTAR_* artifact —
+    # the full checker lives in scripts/check_run_artifacts.py (also
+    # standalone: `python scripts/check_run_artifacts.py`).
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    from check_run_artifacts import check_all
+
+    results = check_all(REPO)
+    assert results, "no run artifacts found at repo root"
+    bad = {path: probs for path, probs in results.items() if probs}
+    assert not bad, f"artifact schema violations: {bad}"
+
+
+def test_artifact_checker_rejects_malformed_records(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    from check_run_artifacts import check_file
+
+    cases = {
+        # null value with no degraded/error/breakdown explanation
+        "BENCH_hole.json": {"metric": "m", "unit": "minutes", "value": None},
+        # a number nothing downstream can parse back
+        "BENCH_nan.json": '{"metric": "m", "unit": "s", "value": NaN}',
+        # neither a metric record nor a driver capture
+        "NORTHSTAR_shape.json": {"something": "else"},
+        # unparseable timestamp
+        "BENCH_when.json": {"metric": "m", "unit": "s", "value": 1.0,
+                            "measured_at": "yesterday-ish"},
+    }
+    for name, record in cases.items():
+        path = tmp_path / name
+        path.write_text(record if isinstance(record, str)
+                        else json.dumps(record))
+        assert check_file(str(path)), f"{name} should have been rejected"
+
+    ok = tmp_path / "BENCH_ok.json"
+    ok.write_text(json.dumps(
+        {"metric": "m", "unit": "minutes", "value": 1.5,
+         "vs_baseline": 0.15, "measured_at": "2026-08-02T00:00:00Z"}))
+    assert check_file(str(ok)) == []
+
+
 def test_save_cache_refreshes_when_env_matches_defaults(tmp_path, monkeypatch):
     # ADVICE round 2: exporting the DEFAULT values must not block the cache
     # refresh — only effectively non-default configurations may.

@@ -1,0 +1,779 @@
+"""Telemetry subsystem: event stream, summaries, regression gate, overhead.
+
+Covers the durability contract (truncated-final-line tolerance from a
+killed writer, concurrent supervisor+worker appends), the event schema
+round-trip, ``summarize`` totals against a fixture stream, ``compare``
+exit codes (the perf gate), process-index filtering, and the acceptance
+bound that telemetry costs < 2% of boolean-workload steps/s.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from dib_tpu.telemetry import (
+    SCHEMA_VERSION,
+    ChunkPhaseHooks,
+    EventWriter,
+    MetricsRegistry,
+    compare,
+    config_fingerprint,
+    finalize_open_writers,
+    read_events,
+    runtime_manifest,
+    summarize,
+    telemetry_main,
+    write_metrics,
+)
+from dib_tpu.train.hooks import TimedHook
+from dib_tpu.utils.profiling import PhaseTimer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def write_fixture_run(directory, *, chunks=3, steps=100, seconds=2.0,
+                      process_index=0, mitigations=0, run_id="fixture-run"):
+    """A synthetic but schema-true run: known totals for summarize()."""
+    with EventWriter(directory, run_id=run_id,
+                     process_index=process_index) as w:
+        w.run_start({
+            "git_sha": "a" * 40,
+            "device_kind": "cpu",
+            "device_count": 1,
+            "config_hash": config_fingerprint({"lr": 1e-3}),
+        })
+        for i in range(chunks):
+            w.chunk(epoch=i + 1, steps=steps, seconds=seconds,
+                    loss=1.0 - 0.1 * i, val_loss=1.1 - 0.1 * i,
+                    beta=0.1 * (i + 1),
+                    kl_per_feature=[0.5, 0.25, 0.25])
+        w.mi_bounds(epoch=chunks, lower_bits=[0.8, 0.1], upper_bits=[0.9, 0.2])
+        for _ in range(mitigations):
+            w.mitigation(mtype="stall_kill", chunk_s=99.0)
+        w.run_end(status="ok")
+    return os.path.join(directory, "events.jsonl")
+
+
+# ===================================================================== events
+def test_event_schema_round_trip(tmp_path):
+    path = write_fixture_run(str(tmp_path))
+    events = list(read_events(path))
+    # envelope on every line
+    for e in events:
+        assert e["v"] == SCHEMA_VERSION
+        assert e["run"] == "fixture-run"
+        assert e["proc"] == 0
+        assert isinstance(e["t"], float) and isinstance(e["mono"], float)
+    assert [e["type"] for e in events] == (
+        ["run_start"] + ["chunk"] * 3 + ["mi_bounds", "run_end"]
+    )
+    # per-writer sequence numbers are gapless and ordered
+    assert [e["seq"] for e in events] == list(range(len(events)))
+    chunk = events[1]
+    assert chunk["steps"] == 100 and chunk["seconds"] == 2.0
+    assert chunk["steps_per_s"] == pytest.approx(50.0)
+    assert chunk["kl_per_feature"] == [0.5, 0.25, 0.25]
+    assert events[0]["manifest"]["git_sha"] == "a" * 40
+
+
+def test_numpy_payloads_serialize(tmp_path):
+    with EventWriter(str(tmp_path)) as w:
+        w.chunk(epoch=np.int64(1), steps=np.int32(10), seconds=np.float64(1.0),
+                kl_per_feature=np.arange(3, dtype=np.float32),
+                loss=np.float32(0.5))
+    (event,) = read_events(str(tmp_path))
+    assert event["epoch"] == 1 and event["steps"] == 10
+    assert event["kl_per_feature"] == [0.0, 1.0, 2.0]
+    assert event["loss"] == pytest.approx(0.5)
+
+
+def test_truncated_final_line_tolerated(tmp_path):
+    """A killed writer leaves at most a torn FINAL line; reads survive it."""
+    path = write_fixture_run(str(tmp_path))
+    with open(path, "ab") as f:
+        f.write(b'{"v": 1, "run": "fixture-run", "se')  # kill mid-append
+    with pytest.warns(UserWarning, match="torn event line"):
+        events = list(read_events(path))
+    assert len(events) == 6  # the torn line is dropped, nothing else
+    assert events[-1]["type"] == "run_end"
+    # summarize over the torn file works too
+    assert summarize(path)["total_steps"] == 300
+
+
+def test_torn_interior_line_skipped_with_warning(tmp_path):
+    """A watchdog kill tears a line MID-file (the supervisor and relaunched
+    worker keep appending after it): the rest must stay readable."""
+    path = write_fixture_run(str(tmp_path))
+    raw = open(path, "rb").read().split(b"\n")
+    raw[1] = b'{"v": 1, "run": "fixture-run", "se'  # SIGKILL mid-write
+    with open(path, "wb") as f:
+        f.write(b"\n".join(raw))
+    with pytest.warns(UserWarning, match="torn event line"):
+        events = list(read_events(path))
+    assert len(events) == 5  # only the torn chunk line is lost
+    assert events[-1]["type"] == "run_end"
+    assert summarize(path)["total_steps"] == 200
+
+
+def test_context_exit_emits_error_run_end(tmp_path):
+    """A run that starts inside a `with` block and dies on an exception
+    still ends its stream with run_end(status='error') — a crashed run is
+    never indistinguishable from one still in flight."""
+    with pytest.raises(RuntimeError):
+        with EventWriter(str(tmp_path), run_id="r") as w:
+            w.run_start({"config_hash": "x"})
+            w.chunk(epoch=1, steps=10, seconds=1.0)
+            raise RuntimeError("sweep diverged")
+    events = list(read_events(str(tmp_path)))
+    assert events[-1]["type"] == "run_end"
+    assert events[-1]["status"] == "error"
+    assert "RuntimeError: sweep diverged" in events[-1]["error"]
+    assert summarize(str(tmp_path))["status"] == "error"
+
+
+def test_finalize_open_writers(tmp_path):
+    """Entry points' crash-path insurance: any started-but-unended stream
+    gets a terminal record and its fd is closed; idempotent."""
+    finalize_open_writers()  # clear any stray from earlier tests
+    w = EventWriter(str(tmp_path), run_id="r")
+    w.run_start({"config_hash": "x"})
+    assert finalize_open_writers(error="OOM") == [w.path]
+    assert finalize_open_writers() == []  # nothing left open
+    events = list(read_events(str(tmp_path)))
+    assert events[-1]["type"] == "run_end"
+    assert events[-1]["status"] == "error" and events[-1]["error"] == "OOM"
+
+
+def test_open_writer_convention(tmp_path):
+    """None -> default dir, '' -> disabled, explicit dir wins; disabled
+    also when the default itself is unset."""
+    from dib_tpu.telemetry import open_writer
+
+    w = open_writer(None, str(tmp_path / "default"))
+    assert w is not None and w.path.startswith(str(tmp_path / "default"))
+    w.close()
+    w = open_writer(str(tmp_path / "explicit"), str(tmp_path / "default"))
+    assert w is not None and w.path.startswith(str(tmp_path / "explicit"))
+    w.close()
+    assert open_writer("", str(tmp_path / "default")) is None
+    assert open_writer(None, None) is None
+
+
+def test_shared_run_id_single_process():
+    from dib_tpu.telemetry import shared_run_id
+
+    rid = shared_run_id()
+    assert isinstance(rid, str) and "-" in rid and len(rid) > 10
+
+
+def test_shared_run_id_env_pin(monkeypatch):
+    """The watchdog supervisor pins DIB_TELEMETRY_RUN_ID so its mitigation
+    events and every worker relaunch share ONE run id — otherwise --run-id
+    scoping would drop the mitigations the reliability gate counts."""
+    from dib_tpu.telemetry import shared_run_id
+
+    monkeypatch.setenv("DIB_TELEMETRY_RUN_ID", "pinned-run")
+    assert shared_run_id() == "pinned-run"
+
+
+def test_finalize_skips_never_started_writers(tmp_path):
+    """A writer opened but never run_start-ed has no forensics to point
+    at: finalize closes it silently instead of logging an empty stream."""
+    finalize_open_writers()  # clear strays
+    w = EventWriter(str(tmp_path), run_id="r")
+    assert finalize_open_writers(error="boom") == []
+    assert w._fd is None  # closed all the same
+
+
+def test_timed_hook_skips_and_names_through_adapters(tmp_path):
+    """The phantom-invocation guard and name attribution must see through
+    fan-out adapters (the CLI sweep path wraps PerReplicaHook around a
+    combined-hook adapter of Every-gated hooks), not just Every."""
+    from dib_tpu.cli import _CombinedHooks
+    from dib_tpu.parallel.sweep import PerReplicaHook
+    from dib_tpu.train.hooks import Every
+
+    calls = []
+
+    class Inner:
+        def __call__(self, trainer, state, epoch):
+            calls.append(epoch)
+
+    fanout = PerReplicaHook(lambda r: _CombinedHooks([Every(100, Inner())]))
+    with EventWriter(str(tmp_path), run_id="r") as w:
+        timed = TimedHook(fanout, w)
+        assert timed.name == "Inner"       # not PerReplicaHook/_CombinedHooks
+        timed(None, None, 50)              # cadence miss: no phantom event
+        assert not timed.seconds
+    hook_events = [e for e in read_events(str(tmp_path))
+                   if e["type"] == "hook"]
+    assert hook_events == []
+
+
+def test_timed_hook_getattr_no_recursion():
+    """Attribute probes on a TimedHook whose __init__ hasn't run (pickle's
+    __setstate__ lookup) must raise AttributeError, not recurse forever."""
+    bare = TimedHook.__new__(TimedHook)
+    with pytest.raises(AttributeError):
+        bare.hook
+    with pytest.raises(AttributeError):
+        bare.__setstate__
+
+
+def test_summarize_status_incomplete_without_run_end(tmp_path):
+    """No terminal record for the last launch (SIGKILL / in flight) must
+    surface as status='incomplete', never an earlier launch's 'ok'."""
+    with EventWriter(str(tmp_path), run_id="r") as w:
+        w.run_start({"config_hash": config_fingerprint({"lr": 1e-3})})
+        w.chunk(epoch=1, steps=10, seconds=1.0)
+    assert summarize(str(tmp_path))["status"] == "incomplete"
+    # a finished first launch must not mask an unfinished relaunch
+    write_fixture_run(str(tmp_path), run_id="r2")
+    with EventWriter(str(tmp_path), run_id="r3") as w:
+        w.run_start({"config_hash": config_fingerprint({"lr": 1e-3})})
+        w.chunk(epoch=1, steps=10, seconds=1.0)
+    assert summarize(str(tmp_path))["status"] == "incomplete"
+
+
+def test_concurrent_writers_share_one_file(tmp_path):
+    """Worker + watchdog supervisor append to the same events.jsonl."""
+    worker = EventWriter(str(tmp_path), run_id="r", process_index=0)
+    supervisor = EventWriter(str(tmp_path), run_id="r", process_index=0,
+                             tags={"src": "supervisor"})
+    worker.chunk(epoch=1, steps=10, seconds=1.0)
+    supervisor.mitigation(mtype="stall_kill")
+    worker.chunk(epoch=2, steps=10, seconds=1.0)
+    worker.close()
+    supervisor.close()
+    events = list(read_events(str(tmp_path)))
+    assert [e["type"] for e in events] == ["chunk", "mitigation", "chunk"]
+    assert events[1]["tags"] == {"src": "supervisor"}
+    # each writer keeps its own gapless sequence
+    assert [e["seq"] for e in events if "tags" not in e] == [0, 1]
+
+
+def test_process_index_filtering(tmp_path):
+    write_fixture_run(str(tmp_path), process_index=0, chunks=2)
+    write_fixture_run(str(tmp_path), process_index=1, chunks=3,
+                      run_id="fixture-run-p1")
+    assert len(list(read_events(str(tmp_path), process_index=1,
+                                types=("chunk",)))) == 3
+    assert len(list(read_events(str(tmp_path), process_index=0,
+                                types=("chunk",)))) == 2
+    assert summarize(str(tmp_path), process_index=0)["total_steps"] == 200
+    assert summarize(str(tmp_path), process_index=1)["total_steps"] == 300
+    assert summarize(str(tmp_path))["processes"] == [0, 1]
+
+
+def test_summarize_run_id_filter(tmp_path):
+    """A reused telemetry dir accumulates runs (bench's
+    DIB_BENCH_TELEMETRY_DIR); run_id scopes the summary to one of them."""
+    write_fixture_run(str(tmp_path), chunks=2, run_id="run-a")
+    write_fixture_run(str(tmp_path), chunks=3, run_id="run-b")
+    assert summarize(str(tmp_path), run_id="run-a")["total_steps"] == 200
+    assert summarize(str(tmp_path), run_id="run-b")["total_steps"] == 300
+
+
+def test_summarize_rejects_non_stream(tmp_path, capsys):
+    """A bench one-liner or arbitrary JSON is not an event stream: clear
+    error instead of a KeyError or an all-None garbage summary."""
+    bogus = tmp_path / "BENCH.json"
+    bogus.write_text(json.dumps({"metric": "sweep_minutes", "value": 1.0}))
+    with pytest.raises(ValueError, match="none carry an event 'type'"):
+        summarize(str(bogus))
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    with pytest.raises(ValueError, match="no telemetry events"):
+        summarize(str(empty))
+    # CLI: bad operand is exit 2, distinct from the regression verdict (1)
+    assert telemetry_main(["summarize", str(bogus)]) == 2
+    assert "not a telemetry stream" in capsys.readouterr().err
+
+
+def test_compare_accepts_bench_line(tmp_path, capsys):
+    """bench.py embeds its run's summary under a 'telemetry' key; such a
+    line is a first-class compare operand."""
+    run = write_fixture_run(str(tmp_path / "run"))
+    bench_line = tmp_path / "BENCH.json"
+    bench_line.write_text(json.dumps(
+        {"metric": "sweep_minutes", "value": 1.0,
+         "telemetry": summarize(run)}))
+    assert telemetry_main(["compare", str(bench_line), str(run)]) == 0
+    capsys.readouterr()
+
+
+def test_summarize_warns_on_blended_configs(tmp_path):
+    """Two invocations with DIFFERENT configs appended to one dir blend
+    into garbage totals — summarize must say so (scope with run_id)."""
+    with EventWriter(str(tmp_path), run_id="a") as w:
+        w.run_start({"config_hash": config_fingerprint({"lr": 1e-3})})
+        w.chunk(epoch=1, steps=10, seconds=1.0)
+        w.run_end(status="ok")
+    with EventWriter(str(tmp_path), run_id="b") as w:
+        w.run_start({"config_hash": config_fingerprint({"lr": 1e-2})})
+        w.chunk(epoch=1, steps=10, seconds=1.0)
+        w.run_end(status="ok")
+    with pytest.warns(UserWarning, match="distinct config hashes"):
+        s = summarize(str(tmp_path))
+    assert s["runs"] == ["a", "b"]
+    # scoped: no warning, and totals cover one run only
+    s = summarize(str(tmp_path), run_id="b")
+    assert s["total_steps"] == 10 and "runs" not in s
+
+
+def test_cli_run_id_scoping(tmp_path, capsys):
+    """`--run-id` / `--run-id-a/-b` expose run scoping on the CLI, so the
+    documented gate can reproduce bench's in-process scoped summary."""
+    write_fixture_run(str(tmp_path), chunks=2, run_id="run-a")
+    write_fixture_run(str(tmp_path), chunks=3, seconds=9.0, run_id="run-b")
+    assert telemetry_main(["summarize", str(tmp_path),
+                           "--run-id", "run-a"]) == 0
+    assert json.loads(capsys.readouterr().out)["total_steps"] == 200
+    # run-b is 3x slower: scoped compare must gate on it, self-compare not
+    assert telemetry_main(["compare", str(tmp_path), str(tmp_path),
+                           "--run-id-a", "run-a",
+                           "--run-id-b", "run-b"]) == 1
+    capsys.readouterr()
+    assert telemetry_main(["compare", str(tmp_path), str(tmp_path),
+                           "--run-id-a", "run-a",
+                           "--run-id-b", "run-a"]) == 0
+
+
+def test_summarize_multihost_counts_one_process(tmp_path):
+    """SPMD: every process emits chunk events for the SAME training, so
+    unfiltered totals must come from one process, not the sum."""
+    write_fixture_run(str(tmp_path), process_index=0, chunks=2)
+    write_fixture_run(str(tmp_path), process_index=1, chunks=2,
+                      run_id="fixture-run-p1")
+    s = summarize(str(tmp_path))
+    assert s["total_steps"] == 200          # not 400
+    assert s["launches"] == 1               # not 2
+    assert s["steps_per_s"] == pytest.approx(50.0)
+    assert s["processes"] == [0, 1]         # presence stays global
+
+
+def test_runtime_manifest_provenance():
+    manifest = runtime_manifest(config={"lr": 1e-3}, extra={"seed": 7})
+    # the repo is a git checkout: the manifest must carry its SHA
+    assert isinstance(manifest["git_sha"], str) and len(manifest["git_sha"]) == 40
+    assert manifest["versions"]["jax"]
+    assert manifest["device_count"] >= 1 and manifest["device_kind"]
+    assert manifest["config_hash"] == config_fingerprint({"lr": 1e-3})
+    assert manifest["seed"] == 7
+
+
+def test_config_fingerprint_stable_and_discriminating():
+    assert config_fingerprint({"a": 1, "b": 2}) == config_fingerprint(
+        {"b": 2, "a": 1})
+    assert config_fingerprint({"a": 1}) != config_fingerprint({"a": 2})
+
+
+# ==================================================================== summary
+def test_summarize_known_totals(tmp_path):
+    path = write_fixture_run(str(tmp_path), chunks=3, steps=100, seconds=2.0,
+                             mitigations=2)
+    s = summarize(path)
+    assert s["metric"] == "run_telemetry_summary"
+    assert s["unit"] == "steps_per_s"
+    assert s["total_steps"] == 300
+    assert s["total_chunk_s"] == pytest.approx(6.0)
+    assert s["steps_per_s"] == pytest.approx(50.0)
+    # steady state drops each launch's first (compile-laden) chunk
+    assert s["steady_steps_per_s"] == pytest.approx(200 / 4.0)
+    assert s["num_chunks"] == 3 and s["launches"] == 1
+    assert s["git_sha"] == "a" * 40
+    assert s["final_loss"] == pytest.approx(0.8)
+    assert s["final_total_kl"] == pytest.approx(1.0)
+    assert s["final_mi_lower_bits_mean"] == pytest.approx(0.45)
+    assert s["mitigations"] == {"stall_kill": 2}
+    assert s["mitigations_total"] == 2
+    assert s["status"] == "ok"
+
+
+def test_compare_gates_and_directions():
+    base = {"steps_per_s": 100.0, "final_loss": 1.0, "mitigations_total": 0}
+    # 1% slower: inside the default 5% threshold
+    ok, regressed = compare(base, dict(base, steps_per_s=99.0))
+    assert not regressed and not ok["fields"]["steps_per_s"]["regressed"]
+    # 20% slower: gate fires
+    _, regressed = compare(base, dict(base, steps_per_s=80.0))
+    assert regressed
+    # loss regresses UP, not down
+    _, regressed = compare(base, dict(base, final_loss=0.5))
+    assert not regressed
+    _, regressed = compare(base, dict(base, final_loss=1.5))
+    assert regressed
+    # ANY extra mitigation regresses, regardless of threshold
+    _, regressed = compare(base, dict(base, mitigations_total=1))
+    assert regressed
+    # faster + fewer problems never regresses
+    _, regressed = compare(
+        dict(base, mitigations_total=3),
+        dict(base, steps_per_s=200.0, mitigations_total=0))
+    assert not regressed
+
+
+def test_compare_gates_per_replica_lists_on_mean():
+    """Sweep summaries carry [R] lists for final losses; the gate must not
+    silently skip them."""
+    base = {"final_loss": [1.0, 1.0, 1.0], "steps_per_s": 100.0}
+    report, regressed = compare(base, dict(base, final_loss=[2.0, 2.1, 1.9]))
+    assert regressed
+    assert report["fields"]["final_loss"]["gated_on"] == "mean"
+    _, regressed = compare(base, dict(base, final_loss=[1.0, 1.01, 0.99]))
+    assert not regressed
+    # unusable sides are reported as ungated, never crash
+    report, regressed = compare(base, dict(base, final_loss="broken"))
+    assert not regressed
+    assert report["fields"]["final_loss"]["gated"] is False
+
+
+def test_nonfinite_values_stay_strict_json_and_regress(tmp_path):
+    """A diverged run (loss=NaN) must (a) write strict JSON any parser can
+    read and (b) REGRESS in compare, not slip through an ungated row."""
+    with EventWriter(str(tmp_path / "bad")) as w:
+        w.chunk(epoch=1, steps=100, seconds=2.0, loss=float("nan"),
+                kl_per_feature=[float("inf"), 0.5])
+    raw = open(str(tmp_path / "bad" / "events.jsonl")).read()
+    json.loads(raw, parse_constant=lambda c: pytest.fail(
+        f"bare {c} token written"))
+    (event,) = read_events(str(tmp_path / "bad"))
+    assert event["loss"] == "NaN"
+    assert event["kl_per_feature"] == ["Infinity", 0.5]
+
+    s_bad = summarize(str(tmp_path / "bad"))
+    assert s_bad["final_loss"] == "NaN"   # summary is strict JSON too
+    json.dumps(s_bad, allow_nan=False)
+
+    write_fixture_run(str(tmp_path / "good"))
+    report, regressed = compare(summarize(str(tmp_path / "good")), s_bad)
+    assert regressed
+    assert report["fields"]["final_loss"]["reason"] == "candidate non-finite"
+    # a non-finite BASELINE cannot gate, and must not crash
+    _, regressed = compare(s_bad, summarize(str(tmp_path / "good")))
+    assert not regressed
+
+
+def test_compare_flags_config_mismatch():
+    report, _ = compare({"config_hash": "aaaa", "steps_per_s": 1.0},
+                        {"config_hash": "bbbb", "steps_per_s": 1.0})
+    assert "not like-for-like" in report["note"]
+
+
+def test_telemetry_main_exit_codes(tmp_path, capsys):
+    a = tmp_path / "a"
+    b = tmp_path / "b"
+    write_fixture_run(str(a), seconds=2.0)
+    write_fixture_run(str(b), seconds=4.0)  # half the steps/s: regression
+
+    assert telemetry_main(["summarize", str(a)]) == 0
+    record = json.loads(capsys.readouterr().out)
+    assert record["steps_per_s"] == pytest.approx(50.0)
+
+    assert telemetry_main(["compare", str(a), str(a)]) == 0
+    capsys.readouterr()
+    assert telemetry_main(["compare", str(a), str(b)]) == 1
+    out = capsys.readouterr()
+    assert json.loads(out.out)["regressed"] is True
+    assert "REGRESSION" in out.err
+    # a generous threshold lets the same diff pass
+    assert telemetry_main(["compare", str(a), str(b),
+                           "--threshold", "0.6"]) == 0
+
+
+def test_cli_compare_gate_subprocess(tmp_path):
+    """The acceptance gate end-to-end: `python -m dib_tpu telemetry compare`
+    exits nonzero on an injected steps/s regression."""
+    a = tmp_path / "a"
+    b = tmp_path / "b"
+    write_fixture_run(str(a), seconds=2.0)
+    write_fixture_run(str(b), seconds=3.0)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    ok = subprocess.run(
+        [sys.executable, "-m", "dib_tpu", "telemetry", "compare",
+         str(a), str(a)],
+        capture_output=True, text=True, timeout=120, cwd=REPO, env=env)
+    assert ok.returncode == 0, ok.stderr[-2000:]
+    bad = subprocess.run(
+        [sys.executable, "-m", "dib_tpu", "telemetry", "compare",
+         str(a), str(b)],
+        capture_output=True, text=True, timeout=120, cwd=REPO, env=env)
+    assert bad.returncode == 1, bad.stderr[-2000:]
+    assert json.loads(bad.stdout)["fields"]["steps_per_s"]["regressed"]
+
+
+# ==================================================================== metrics
+def test_metrics_registry_snapshot():
+    reg = MetricsRegistry()
+    reg.counter("steps").inc(50)
+    reg.counter("steps").inc(25)
+    reg.gauge("beta").set(0.3)
+    hist = reg.histogram("chunk_s")
+    for v in (1.0, 2.0, 3.0, 4.0):
+        hist.record(v)
+    snap = reg.snapshot()
+    assert snap["counters"]["steps"] == 75
+    assert snap["gauges"]["beta"] == pytest.approx(0.3)
+    h = snap["histograms"]["chunk_s"]
+    assert h["count"] == 4 and h["sum"] == pytest.approx(10.0)
+    assert h["min"] == 1.0 and h["max"] == 4.0 and h["mean"] == 2.5
+    assert h["p50"] == 3.0  # upper-median convention on the window
+    with pytest.raises(ValueError):
+        reg.counter("steps").inc(-1)
+
+
+def test_write_metrics_single_process(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("chunks").inc()
+    reg.gauge("beta").set(0.5)
+    with EventWriter(str(tmp_path)) as w:
+        assert write_metrics(reg, w) is True
+    (event,) = read_events(str(tmp_path), types=("metrics",))
+    (snap,) = event["snapshots"]
+    assert snap["proc"] == 0
+    assert snap["counters.chunks"] == 1.0
+    assert snap["gauges.beta"] == 0.5
+
+
+# ====================================================================== hooks
+def test_timed_hook_measures_and_forwards(tmp_path):
+    calls = []
+
+    class Inner:
+        records = ["sentinel"]
+
+        def __call__(self, trainer, state, epoch):
+            calls.append(epoch)
+
+    with EventWriter(str(tmp_path)) as w:
+        timed = TimedHook(Inner(), telemetry=w)
+        timed(None, None, 5)
+        timed(None, None, 10)
+    assert calls == [5, 10]
+    assert len(timed.seconds) == 2
+    assert timed.records == ["sentinel"]  # attribute passthrough
+    events = list(read_events(str(tmp_path), types=("hook",)))
+    assert [e["epoch"] for e in events] == [5, 10]
+    assert all(e["name"] == "Inner" for e in events)
+
+
+def test_timed_hook_records_time_of_raising_hook(tmp_path):
+    def bad_hook(trainer, state, epoch):
+        raise RuntimeError("boom")
+
+    with EventWriter(str(tmp_path)) as w:
+        timed = TimedHook(bad_hook, telemetry=w, name="bad")
+        with pytest.raises(RuntimeError):
+            timed(None, None, 1)
+    assert len(timed.seconds) == 1
+    assert [e["name"] for e in read_events(str(tmp_path))] == ["bad"]
+
+
+def test_timed_hook_names_unwrap_cadence_adapter(tmp_path):
+    """Every instrumentation hook arrives wrapped as Every(n, hook); the
+    event must name the inner hook or all time charges to 'Every'."""
+    from dib_tpu.train.hooks import Every
+
+    class MIHook:
+        def __call__(self, trainer, state, epoch):
+            pass
+
+    with EventWriter(str(tmp_path)) as w:
+        timed = TimedHook(Every(5, MIHook()), telemetry=w)
+        timed(None, None, 5)
+    assert timed.name == "MIHook"
+    (event,) = read_events(str(tmp_path), types=("hook",))
+    assert event["name"] == "MIHook"
+
+
+def test_timed_hook_skips_non_firing_cadence_epochs(tmp_path):
+    """Every(100, hook) at a gcd-50 chunk boundary fires nothing — no
+    phantom ~0 s 'hook' event may dilute the hook's statistics."""
+    from dib_tpu.train.hooks import Every
+
+    calls = []
+    with EventWriter(str(tmp_path)) as w:
+        timed = TimedHook(Every(100, lambda t, s, e: calls.append(e)),
+                          telemetry=w)
+        timed(None, None, 50)    # cadence miss: silent
+        timed(None, None, 100)   # fires
+    assert calls == [100]
+    assert len(timed.seconds) == 1
+    events = list(read_events(str(tmp_path), types=("hook",)))
+    assert [e["epoch"] for e in events] == [100]
+
+
+def test_chunk_phase_hooks_unknown_baseline_skips_first_event(tmp_path):
+    """A resumed run's restore epoch is unknown before fitting: the first
+    interval is timed but NOT emitted (an epoch-0 baseline would count the
+    pre-restore epochs as trained and inflate the gated steps/s)."""
+    with EventWriter(str(tmp_path)) as w:
+        phases = ChunkPhaseHooks(telemetry=w, steps_per_epoch=50,
+                                 baseline_known=False)
+        phases.start()  # re-anchors the clock, does NOT anchor the baseline
+        states = np.zeros(2)
+        phases.pre(None, states, 125)   # resumed from epoch 100: ambiguous
+        phases.post(None, states, 125)
+        phases.pre(None, states, 150)   # delta from 125: attributable
+        phases.post(None, states, 150)
+    chunks = list(read_events(str(tmp_path), types=("chunk",)))
+    assert [c["epoch"] for c in chunks] == [150]
+    assert chunks[0]["steps"] == 25 * 50
+    # both intervals were still timed
+    assert len(phases.timer.intervals["chunk"]) == 2
+
+
+def test_chunk_phase_hooks_split_phases(tmp_path):
+    with EventWriter(str(tmp_path)) as w:
+        phases = ChunkPhaseHooks(telemetry=w, steps_per_epoch=50)
+        phases.start()
+        states = np.zeros(2)  # block_until_ready accepts host arrays
+        phases.pre(None, states, 25)
+        phases.post(None, states, 25)
+        phases.pre(None, states, 50)
+        phases.post(None, states, 50)
+    timer = phases.timer
+    assert len(timer.intervals["chunk"]) == 2
+    assert len(timer.intervals["instrumentation"]) == 2
+    chunks = list(read_events(str(tmp_path), types=("chunk",)))
+    assert [c["epoch"] for c in chunks] == [25, 50]
+    # steps derive from the epoch delta: 25 epochs x 50, then 25 x 50
+    assert [c["steps"] for c in chunks] == [1250, 1250]
+    hooks = list(read_events(str(tmp_path), types=("hook",)))
+    assert all(h["name"] == "checkpoint_instrumentation" for h in hooks)
+
+
+def test_watchdog_mirrors_mitigations_onto_event_stream(tmp_path):
+    """supervise(telemetry=...) lands each mitigation on the stream AS IT
+    HAPPENS, so a run killed mid-flight still carries its kill record."""
+    import textwrap
+
+    from dib_tpu.train.watchdog import WatchdogConfig, supervise
+
+    worker = tmp_path / "worker.py"
+    marker = str(tmp_path / "crashed_once")
+    worker.write_text(textwrap.dedent(f"""
+        import os, sys
+        marker = {marker!r}
+        if not os.path.exists(marker):
+            open(marker, "w").close()
+            sys.exit(3)              # simulated tunnel crash
+        sys.exit(0)
+    """))
+    hb = str(tmp_path / "hb.json")
+    with EventWriter(str(tmp_path), process_index=0,
+                     tags={"src": "supervisor"}) as w:
+        result = supervise([sys.executable, str(worker)], hb,
+                           WatchdogConfig(poll_s=0.05, max_restarts=2),
+                           telemetry=w)
+    assert result["returncode"] == 0
+    events = list(read_events(str(tmp_path), types=("mitigation",)))
+    assert [e["mtype"] for e in events] == ["crash_restart"]
+    assert events[0]["returncode"] == 3
+    assert events[0]["tags"] == {"src": "supervisor"}
+    # the mirrored list still behaves as the report's plain list
+    assert [m["type"] for m in result["mitigations"]] == ["crash_restart"]
+
+
+# ================================================================== overhead
+def test_boolean_workload_telemetry_overhead_under_2pct(tmp_path):
+    """Acceptance bound: PhaseTimer-measured steps/s with telemetry enabled
+    within 2% of disabled on the boolean workload.
+
+    Paired same-run design: back-to-back A/B fits on this host jitter by
+    ~±13% (measured), two orders of magnitude above the overhead being
+    bounded, so differencing two noisy wall-clocks cannot certify 2%.
+    Instead both sides come from the SAME instrumented run: the disabled
+    steps/s is the PhaseTimer-measured chunk wall-clock alone; the enabled
+    steps/s adds the per-chunk emission cost (the only code the telemetry
+    path inserts between chunks), measured directly on the run's own
+    payload with real file writes.
+    """
+    import time
+
+    import jax
+
+    from dib_tpu.telemetry.events import device_memory_stats
+    from dib_tpu.workloads.boolean import (
+        BooleanTrainer,
+        BooleanWorkloadConfig,
+        fetch_boolean_circuit,
+    )
+
+    config = BooleanWorkloadConfig(num_steps=300, mi_every=100)
+    trainer = BooleanTrainer(fetch_boolean_circuit(), config)
+    trainer.fit(jax.random.key(0))  # compile warmup, unmeasured
+
+    with EventWriter(str(tmp_path / "run")) as w:
+        trainer.fit(jax.random.key(1), telemetry=w)
+    chunks = list(read_events(str(tmp_path / "run"), types=("chunk",)))
+    mi = list(read_events(str(tmp_path / "run"), types=("mi_bounds",)))
+    assert len(chunks) == 3
+    assert all(c["steps_per_s"] > 0 for c in chunks)
+    # min: host contention noise is strictly one-sided (only ever slows)
+    chunk_s = min(c["seconds"] for c in chunks)
+
+    # Per-chunk emission cost on the run's OWN payload: one chunk event +
+    # one mi_bounds event per boundary, through a real EventWriter.
+    reps = 200
+    with EventWriter(str(tmp_path / "cost")) as w:
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            w.chunk(epoch=chunks[0]["epoch"], steps=chunks[0]["steps"],
+                    seconds=chunks[0]["seconds"], beta=chunks[0]["beta"],
+                    loss=chunks[0]["loss"],
+                    kl_per_feature=chunks[0]["kl_per_feature"],
+                    memory=device_memory_stats())
+            w.mi_bounds(epoch=mi[0]["epoch"],
+                        lower_bits=mi[0]["lower_bits"],
+                        upper_bits=mi[0]["upper_bits"])
+        emit_s = (time.perf_counter() - t0) / reps
+
+    ratio = chunk_s / (chunk_s + emit_s)
+    assert ratio >= 0.98, (
+        f"telemetry overhead exceeds 2%: chunk {chunk_s * 1e3:.1f} ms, "
+        f"emission {emit_s * 1e3:.3f} ms/chunk (steps/s ratio {ratio:.4f})"
+    )
+
+
+# ============================================================== CLI smoke run
+def test_workload_cli_emits_event_stream(tmp_path, capsys):
+    """The acceptance smoke run, in-process: a boolean workload run leaves
+    an events.jsonl whose run_start manifest carries git SHA + device info
+    and whose chunk records carry steps/s and per-feature KL."""
+    from dib_tpu.cli import workload_main
+
+    rc = workload_main([
+        "boolean", "--telemetry-dir", str(tmp_path),
+        "--set", "num_steps=40", "--set", "mi_every=20",
+        "--set", "integration_hidden=(32,)", "--set", "batch_size=64",
+    ])
+    assert rc == 0
+    result = json.loads(capsys.readouterr().out)
+    assert result["final_accuracy"] >= 0.0
+
+    events = list(read_events(str(tmp_path)))
+    manifest = events[0]["manifest"]
+    assert events[0]["type"] == "run_start"
+    assert manifest["git_sha"] and manifest["device_kind"]
+    assert manifest["workload"] == "boolean"
+    assert manifest["config"]["num_steps"] == 40
+    chunks = [e for e in events if e["type"] == "chunk"]
+    assert len(chunks) == 2
+    for c in chunks:
+        assert c["steps_per_s"] > 0
+        assert len(c["kl_per_feature"]) == 10  # one per circuit input
+    assert any(e["type"] == "mi_bounds" for e in events)
+    # end-of-fit metrics rollup (chunk-time histogram, step counter)
+    (metrics,) = [e for e in events if e["type"] == "metrics"]
+    assert metrics["snapshots"][0]["counters.steps"] == 40.0
+    assert events[-1]["type"] == "run_end"
+
+    s = summarize(str(tmp_path))
+    assert s["total_steps"] == 40
+    assert s["git_sha"] == manifest["git_sha"]
+    assert s["metrics"]["histograms.chunk_s.count"] == 2.0
