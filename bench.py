@@ -67,28 +67,18 @@ MEASURE_EPOCHS = int(
 )
 BENCH_BATCH_SIZE = 32              # reference batch (amorphous nb cell 8)
 
-# Peak dense-matmul TFLOP/s per chip for the bf16 dtype mix (public specs).
-# device_kind substrings as reported by jax; conservative bf16 numbers.
-PEAK_BF16_TFLOPS = {
-    "v6": 918.0,        # Trillium / v6e
-    "v5p": 459.0,
-    "v5": 197.0,        # v5e / "TPU v5 lite"
-    "v4": 275.0,
-    "v3": 123.0,        # v3 has no bf16 MXU gain over f32? (bf16 peak)
-    "v2": 45.0,
-}
-
-
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
 def peak_tflops_for(device_kind: str) -> float | None:
-    kind = device_kind.lower()
-    for key in ("v6", "v5p", "v5", "v4", "v3", "v2"):
-        if key in kind:
-            return PEAK_BF16_TFLOPS[key]
-    return None
+    """bf16 matmul peak from the shared per-backend capability table
+    (``dib_tpu/telemetry/xla_stats.py`` — the one copy the profiler and
+    the run reports read too)."""
+    from dib_tpu.telemetry.xla_stats import backend_peaks
+
+    peaks = backend_peaks(device_kind)
+    return peaks["bf16_tflops"] if peaks else None
 
 
 def analytic_model_flops_per_step(model, batch_size: int) -> float:
@@ -203,12 +193,19 @@ def child_main() -> None:
     # full run's events.jsonl via `dib_tpu telemetry compare`.
     import tempfile
 
-    from dib_tpu.telemetry import EventWriter, runtime_manifest, summarize
-    from dib_tpu.telemetry.events import device_memory_stats
+    from dib_tpu.telemetry import (
+        EventWriter,
+        Tracer,
+        runtime_manifest,
+        summarize,
+        xla_stats,
+    )
+    from dib_tpu.telemetry.events import device_memory_stats, host_memory_stats
 
     persistent_dir = os.environ.get("DIB_BENCH_TELEMETRY_DIR")
     telemetry_dir = persistent_dir or tempfile.mkdtemp(prefix="bench_events_")
     telemetry = EventWriter(telemetry_dir)
+    tracer = Tracer(telemetry)
     telemetry.run_start(runtime_manifest(
         config=config,
         extra={"bench": METRIC, "replicas": NUM_REPLICAS,
@@ -221,14 +218,17 @@ def child_main() -> None:
     meas_keys = jax.random.split(jax.random.key(2), NUM_REPLICAS)
     t0 = time.time()
     log(f"dataset+trainer build: {t0 - t_init:.1f}s (before timed window)")
-    states, histories = sweep.init(init_keys)
-    jax.block_until_ready(states.params)
+    with tracer.span("init") as ph:
+        states, histories = sweep.init(init_keys)
+        ph.block_on(states.params)
     t_after_init = time.time()
 
     # Warmup chunk: triggers compile of the full epoch scan (num_epochs is a
     # static arg, so warm with the same value the measurement uses).
-    states, histories = sweep.run_chunk(states, histories, warm_keys, MEASURE_EPOCHS)
-    jax.block_until_ready(states.params)
+    with tracer.span("compile_and_warm") as ph:
+        states, histories = sweep.run_chunk(
+            states, histories, warm_keys, MEASURE_EPOCHS)
+        ph.block_on(states.params)
     compile_s = time.time() - t0
     # breakdown: with the persistent cache warm, 'chunk' is dominated by
     # cache deserialization + one real 2400-step execution (~4 s), not XLA
@@ -236,19 +236,33 @@ def child_main() -> None:
     log(f"init+compile+first chunk: {compile_s:.1f}s "
         f"(model init {t_after_init - t0:.1f}s, "
         f"chunk compile+exec {time.time() - t_after_init:.1f}s)")
-    telemetry.compile(name="sweep_chunk", seconds=compile_s,
-                      cache=cache_status)
 
     t1 = time.time()
-    states, histories = sweep.run_chunk(states, histories, meas_keys, MEASURE_EPOCHS)
-    jax.block_until_ready(states.params)
+    with tracer.span("sweep_chunk") as ph:
+        states, histories = sweep.run_chunk(
+            states, histories, meas_keys, MEASURE_EPOCHS)
+        ph.block_on(states.params)
     measure_s = time.time() - t1
+
+    # FLOPs/bytes of the chunk program (DIB_XLA_COST_ANALYSIS=0 opts out) —
+    # AFTER both timed windows: the AOT lower().compile() is not shared
+    # with jit's dispatch cache, so running it inside the t0..compile_s
+    # window would inflate compile_s (and the projected-minutes headline)
+    # with instrumentation cost. Lowering only reads shapes.
+    cost = xla_stats.compiled_cost_stats(
+        type(sweep).run_chunk, sweep, states, histories, meas_keys,
+        MEASURE_EPOCHS,
+    ) if xla_stats.cost_analysis_enabled() else None
+    telemetry.compile(
+        name="sweep_chunk", seconds=compile_s, cache=cache_status,
+        cost_source="xla_cost_analysis" if cost else None, **(cost or {}))
 
     sweep_steps = MEASURE_EPOCHS * STEPS_PER_EPOCH * NUM_REPLICAS
     steps_per_s = sweep_steps / measure_s
     telemetry.chunk(epoch=2 * MEASURE_EPOCHS, steps=sweep_steps,
                     seconds=measure_s, replicas=NUM_REPLICAS,
-                    memory=device_memory_stats())
+                    memory=device_memory_stats(),
+                    host_memory=host_memory_stats())
     # Validation runs once per epoch inside the measured chunk, so the
     # projection includes instrumentation overhead, as the north star does.
     projected_s = FULL_SWEEP_STEPS * NUM_REPLICAS / steps_per_s + compile_s
@@ -278,6 +292,7 @@ def child_main() -> None:
 
     telemetry.run_end(status="ok", projected_minutes=round(projected_min, 3))
     telemetry.close()
+    run_summary = summarize(telemetry_dir, run_id=telemetry.run_id)
     print(
         json.dumps(
             {
@@ -290,6 +305,11 @@ def child_main() -> None:
                 "flops_per_step_model": model_flops_per_step,
                 "achieved_tflops": round(achieved_tflops, 2),
                 "mfu": round(mfu, 4) if mfu else None,
+                # where the measured window's time went (span self-time) and
+                # the whole-program XLA cost view — BENCH_*.json lines carry
+                # a utilization trajectory across rounds
+                "span_hotspots": run_summary.get("span_hotspots"),
+                "xla_cost_analysis": cost,
                 "compile_cache": cache_status,
                 "score_dtype": score_dtype_name,
                 "device_kind": device_kind,
@@ -300,8 +320,7 @@ def child_main() -> None:
                 # comparable/gateable against any run's events.jsonl.
                 # run_id-scoped: a reused DIB_BENCH_TELEMETRY_DIR appends
                 # runs, and the summary must cover THIS one only
-                "telemetry": summarize(telemetry_dir,
-                                       run_id=telemetry.run_id),
+                "telemetry": run_summary,
                 # a lasting path only when the caller asked for one — the
                 # unnamed tmpdir is deleted below once rolled up
                 "events_path": telemetry.path if persistent_dir else None,

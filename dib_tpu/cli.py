@@ -45,8 +45,8 @@ def build_parser() -> argparse.ArgumentParser:
                         choices=["train", "workload", "telemetry"],
                         help="Subcommand: 'train' (flags below), 'workload' "
                              "(paper workloads; see `dib_tpu workload --help`), "
-                             "or 'telemetry' (summarize/compare run event "
-                             "streams; see `dib_tpu telemetry --help`).")
+                             "or 'telemetry' (summarize/compare/report run "
+                             "event streams; see `dib_tpu telemetry --help`).")
     parser.add_argument("--dataset", default="boolean_circuit",
                         help="Registered dataset name (see dib_tpu.data.available_datasets()).")
     parser.add_argument("--data_path", type=str, default="./data/")

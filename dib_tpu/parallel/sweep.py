@@ -177,6 +177,7 @@ class BetaSweepTrainer:
                 f"already recorded and {num_epochs} more were requested; grow it "
                 f"with history_extend(histories, n)."
             )
+        from dib_tpu.telemetry import trace
         from dib_tpu.telemetry.hooks import FitRecorder
 
         # sweep throughput counts every replica's steps (the bench.py
@@ -192,37 +193,51 @@ class BetaSweepTrainer:
         # chunking decoupled from hooks — see DIBTrainer.fit
         chunk = hook_every if hook_every else num_epochs
         done = 0
-        while done < num_epochs:
-            this_chunk = min(chunk, num_epochs - done)
-            split = jax.vmap(jax.random.split)(keys)
-            keys, chunk_keys = split[:, 0], split[:, 1]
-            with recorder.chunk_phase() as ph:
-                states, histories = self.run_chunk(
-                    states, histories, chunk_keys, this_chunk
-                )
-                ph.block_on(states.params)
-            done += this_chunk
-            # Published for CheckpointHook (see DIBTrainer.fit).
-            self.resume_key = keys
-            self.latest_history = histories
-            self.resume_chunk = chunk
-            if telemetry is not None:
-                # per-replica beta/loss/KL tags ([R] lists)
-                row = jax.device_get({
-                    name: histories[name][:, cursor + done - 1]
-                    for name in ("beta", "loss", "val_loss", "kl_per_feature")
-                })
-                recorder.record_chunk(
-                    epoch=cursor + done, chunk_epochs=this_chunk,
-                    replicas=self.num_replicas,
-                    beta=[float(b) for b in row["beta"]],
-                    beta_end=beta_end_list,
-                    loss=[float(x) for x in row["loss"]],
-                    val_loss=[float(x) for x in row["val_loss"]],
-                    kl_total=[float(x) for x in row["kl_per_feature"].sum(-1)],
-                )
-            for hook in hooks:
-                hook(self, states, int(jax.device_get(states.epoch)[0]))
+        # Bound for the whole fit so hook spans (PerReplicaHook's
+        # replica{r}, SpannedHook) parent into this run's trace hierarchy.
+        with trace.use_tracer(recorder.tracer):
+            while done < num_epochs:
+                this_chunk = min(chunk, num_epochs - done)
+                split = jax.vmap(jax.random.split)(keys)
+                keys, chunk_keys = split[:, 0], split[:, 1]
+                if telemetry is not None and done == 0:
+                    recorder.record_compile(
+                        "run_chunk", type(self).run_chunk,
+                        self, states, histories, chunk_keys, this_chunk,
+                        epochs=this_chunk,
+                    )
+                # chunk spans are β-tagged: a sweep's trace stays
+                # attributable to its annealing-endpoint grid
+                with recorder.chunk_phase(replicas=self.num_replicas,
+                                          beta_end=beta_end_list) as ph:
+                    states, histories = self.run_chunk(
+                        states, histories, chunk_keys, this_chunk
+                    )
+                    ph.block_on(states.params)
+                done += this_chunk
+                # Published for CheckpointHook (see DIBTrainer.fit).
+                self.resume_key = keys
+                self.latest_history = histories
+                self.resume_chunk = chunk
+                if telemetry is not None:
+                    # per-replica beta/loss/KL tags ([R] lists)
+                    row = jax.device_get({
+                        name: histories[name][:, cursor + done - 1]
+                        for name in ("beta", "loss", "val_loss",
+                                     "kl_per_feature")
+                    })
+                    recorder.record_chunk(
+                        epoch=cursor + done, chunk_epochs=this_chunk,
+                        replicas=self.num_replicas,
+                        beta=[float(b) for b in row["beta"]],
+                        beta_end=beta_end_list,
+                        loss=[float(x) for x in row["loss"]],
+                        val_loss=[float(x) for x in row["val_loss"]],
+                        kl_total=[float(x)
+                                  for x in row["kl_per_feature"].sum(-1)],
+                    )
+                for hook in hooks:
+                    hook(self, states, int(jax.device_get(states.epoch)[0]))
         recorder.finish()
         return states, sweep_records(histories)
 
@@ -311,6 +326,7 @@ class PerReplicaHook:
     def __init__(self, make_hook: Callable[[int], Callable]):
         self.make_hook = make_hook
         self.replica_hooks: dict[int, Callable] = {}
+        self._beta_ends: list[float] | None = None  # fetched once per sweep
 
     def _probe_hook(self) -> Callable:
         """Replica 0's hook, created eagerly if needed — every replica gets
@@ -329,11 +345,22 @@ class PerReplicaHook:
         return [self._probe_hook()]
 
     def __call__(self, sweep: "BetaSweepTrainer", states: TrainState, epoch: int):
+        from dib_tpu.telemetry import trace
+
+        if self._beta_ends is None:
+            self._beta_ends = [float(b)
+                               for b in jax.device_get(sweep.beta_ends)]
         for r in range(sweep.num_replicas):
             if r not in self.replica_hooks:
                 self.replica_hooks[r] = self.make_hook(r)
             hook = self.replica_hooks[r]
-            hook(sweep.replica_trainer(r), sweep.replica_state(states, r), epoch)
+            # one β-tagged span per replica fan-out leg: the per-replica
+            # host round-trips this adapter serializes become attributable
+            # in the run report (rolled up as "replica*")
+            with trace.span(f"replica{r}", replica=r,
+                            beta_end=self._beta_ends[r], epoch=int(epoch)):
+                hook(sweep.replica_trainer(r),
+                     sweep.replica_state(states, r), epoch)
 
 
 def sweep_records(histories: dict) -> list[HistoryRecord]:

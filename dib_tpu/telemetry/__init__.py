@@ -12,6 +12,14 @@ See ``docs/observability.md``. The pieces:
     (``python -m dib_tpu telemetry summarize|compare``).
   - :mod:`dib_tpu.telemetry.hooks` — fit-hook adapters (chunk/
     instrumentation phase timing into ``PhaseTimer`` + events).
+  - :mod:`dib_tpu.telemetry.trace` — nestable device-truth spans: one name
+    lands on the event stream, the ``PhaseTimer``, and the XLA trace
+    (``jax.profiler.TraceAnnotation``) at once.
+  - :mod:`dib_tpu.telemetry.xla_stats` — ``cost_analysis()`` of compiled
+    callables, the per-backend peak capability table, and roofline
+    utilization arithmetic.
+  - :mod:`dib_tpu.telemetry.report` — self-contained static HTML run
+    reports (``python -m dib_tpu telemetry report <run-dir>``).
 """
 
 from dib_tpu.telemetry.events import (
@@ -22,6 +30,7 @@ from dib_tpu.telemetry.events import (
     device_memory_stats,
     finalize_crashed,
     finalize_open_writers,
+    host_memory_stats,
     open_writer,
     read_events,
     resolve_events_path,
@@ -37,7 +46,20 @@ from dib_tpu.telemetry.metrics import (
     gather_snapshots,
     write_metrics,
 )
-from dib_tpu.telemetry.summary import compare, summarize, telemetry_main
+from dib_tpu.telemetry.summary import (
+    compare,
+    span_hotspots,
+    span_rollup,
+    summarize,
+    telemetry_main,
+)
+from dib_tpu.telemetry.trace import (
+    SpannedHook,
+    Tracer,
+    current_tracer,
+    span,
+    use_tracer,
+)
 
 __all__ = [
     "EVENTS_FILENAME",
@@ -48,18 +70,26 @@ __all__ = [
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "SpannedHook",
+    "Tracer",
     "compare",
     "config_fingerprint",
+    "current_tracer",
     "device_memory_stats",
     "finalize_crashed",
     "finalize_open_writers",
     "gather_snapshots",
+    "host_memory_stats",
     "open_writer",
     "read_events",
     "resolve_events_path",
     "runtime_manifest",
     "shared_run_id",
+    "span",
+    "span_hotspots",
+    "span_rollup",
     "summarize",
     "telemetry_main",
+    "use_tracer",
     "write_metrics",
 ]

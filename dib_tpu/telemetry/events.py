@@ -16,6 +16,8 @@ run appends typed, schema-versioned events to ``<run_dir>/events.jsonl``:
                    status from ``utils/compile_cache.py``
   - ``mitigation`` watchdog kill/restart, mirroring ``watchdog.mitigations``
   - ``hook``       host-hook wall-clock per invocation
+  - ``span``       one closed trace span (``telemetry/trace.py``): name,
+                   full slash path, span/parent ids, blocked wall-clock
   - ``mi_bounds``  MI sandwich-bound measurements (sweep/boolean hooks)
   - ``metrics``    counter/gauge/histogram snapshots (``telemetry.metrics``)
   - ``run_end``    terminal status + total wall-clock
@@ -56,6 +58,7 @@ __all__ = [
     "device_memory_stats",
     "finalize_crashed",
     "finalize_open_writers",
+    "host_memory_stats",
     "open_writer",
     "read_events",
     "resolve_events_path",
@@ -241,6 +244,30 @@ def device_memory_stats(device=None) -> dict | None:
     return out or None
 
 
+def host_memory_stats() -> dict | None:
+    """Host RSS from ``/proc/self/status``: ``{"rss_bytes", "peak_rss_bytes"}``.
+
+    The CPU backend has no ``device.memory_stats()``, so CI/CPU runs would
+    carry no memory signal at all without this fallback — it is emitted
+    ALONGSIDE device stats on every chunk (VmHWM is the process high-water
+    mark, which is what the run report's memory section keys on). None on
+    non-Linux hosts or when /proc is unreadable."""
+    try:
+        with open("/proc/self/status") as f:
+            lines = f.read().splitlines()
+    except OSError:
+        return None
+    out = {}
+    keys = {"VmRSS": "rss_bytes", "VmHWM": "peak_rss_bytes"}
+    for line in lines:
+        name, _, rest = line.partition(":")
+        if name in keys:
+            parts = rest.split()
+            if parts and parts[0].isdigit():
+                out[keys[name]] = int(parts[0]) * 1024   # kB -> bytes
+    return out or None
+
+
 class EventWriter:
     """Appends schema-versioned events to ``<directory>/events.jsonl``.
 
@@ -364,6 +391,17 @@ class EventWriter:
 
     def mi_bounds(self, *, epoch: int, **fields) -> dict:
         return self.emit("mi_bounds", epoch=int(epoch), **fields)
+
+    def span(self, *, name: str, path: str, span_id: int,
+             parent_id: int | None, seconds: float, **fields) -> dict:
+        """One closed span (``telemetry/trace.py``): ``span``/``parent`` ids
+        rebuild the tree, ``path`` is the full slash path (also the name
+        under which the interval appears in captured XLA traces)."""
+        return self.emit(
+            "span", name=name, path=path, span=int(span_id),
+            parent=parent_id if parent_id is None else int(parent_id),
+            seconds=round(float(seconds), 6), **fields,
+        )
 
     def metrics(self, snapshots) -> dict:
         return self.emit("metrics", snapshots=snapshots)

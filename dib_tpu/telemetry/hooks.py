@@ -1,4 +1,4 @@
-"""Fit-hook adapters that feed the event stream and a ``PhaseTimer``.
+"""Fit-hook adapters that feed the event stream, spans, and a ``PhaseTimer``.
 
 :class:`ChunkPhaseHooks` replaces the private per-script timers the
 instrumented drivers used to carry (``scripts/northstar_run.py``'s deleted
@@ -8,7 +8,16 @@ interval is the true train-chunk wall-clock; ``post`` runs LAST and closes
 the "instrumentation" phase covering everything the other hooks did in
 between. Per-interval series live on ``timer.intervals`` and, when an
 :class:`~dib_tpu.telemetry.events.EventWriter` is attached, each chunk also
-lands as a ``chunk`` event with steps/s and device memory.
+lands as a ``chunk`` event with steps/s and device+host memory, plus a
+``span`` event in the run's trace hierarchy (``telemetry/trace.py``).
+
+:class:`FitRecorder` additionally owns the per-fit XLA cost-analysis step
+(``telemetry/xla_stats.py``): ``record_compile`` runs
+``lower().compile().cost_analysis()`` on a jitted callable once, emits a
+``compile`` event carrying FLOPs/bytes, counts the persistent-cache status
+into hit/miss counters, and from then on every recorded chunk updates
+achieved-FLOP/s / achieved-bandwidth gauges in the ``MetricsRegistry`` —
+the live roofline position of the training program.
 """
 
 from __future__ import annotations
@@ -16,26 +25,32 @@ from __future__ import annotations
 import contextlib
 import time
 
-from dib_tpu.telemetry.events import device_memory_stats
+from dib_tpu.telemetry.events import device_memory_stats, host_memory_stats
+from dib_tpu.telemetry.trace import Tracer
 from dib_tpu.utils.profiling import PhaseTimer
 
 __all__ = ["ChunkPhaseHooks", "FitRecorder"]
 
 
 class _NullPhase:
-    """Stand-in for a PhaseTimer phase when telemetry is off: never blocks,
-    so dispatch keeps pipelining across chunks."""
+    """Stand-in for a span handle when telemetry is off: never blocks, so
+    dispatch keeps pipelining across chunks."""
 
     def block_on(self, tree) -> None:
         pass
 
+    def annotate(self, **fields) -> None:
+        pass
+
 
 class FitRecorder:
-    """The per-chunk instrumentation shared by ``DIBTrainer.fit`` and
-    ``BetaSweepTrainer.fit``: a ``PhaseTimer`` around each ``run_chunk``
-    (blocking on its outputs so the interval is true wall-clock), one
-    ``chunk`` event per boundary, step/epoch counters and the chunk-seconds
-    histogram, and the end-of-fit ``metrics`` rollup. With ``telemetry``
+    """The per-chunk instrumentation shared by ``DIBTrainer.fit``,
+    ``BetaSweepTrainer.fit`` and ``BooleanTrainer.fit``: a span around each
+    ``run_chunk`` (blocking on its outputs so the interval is true
+    wall-clock, named in captured XLA traces, and emitted as a ``span``
+    event), one ``chunk`` event per boundary, step/epoch counters and the
+    chunk-seconds histogram, utilization gauges when a compiled callable was
+    cost-analyzed, and the end-of-fit ``metrics`` rollup. With ``telemetry``
     None every method is a cheap no-op and nothing blocks.
 
     ``steps_per_epoch`` is the run's TOTAL steps per epoch — a sweep passes
@@ -46,21 +61,87 @@ class FitRecorder:
     def __init__(self, telemetry, *, steps_per_epoch: int):
         self.telemetry = telemetry
         self.steps_per_epoch = int(steps_per_epoch)
-        self.timer = self.registry = None
+        self.timer = self.registry = self.tracer = None
+        self._costs: dict[str, dict] = {}
+        self._peaks = None
         if telemetry is not None:
             from dib_tpu.telemetry.metrics import MetricsRegistry
 
             self.timer = PhaseTimer()
             self.registry = MetricsRegistry()
+            self.tracer = Tracer(telemetry, timer=self.timer)
 
     @contextlib.contextmanager
-    def chunk_phase(self):
+    def chunk_phase(self, **tags):
         """Wrap one ``run_chunk`` call; ``.block_on(outputs)`` inside."""
-        if self.timer is None:
+        if self.tracer is None:
             yield _NullPhase()
         else:
-            with self.timer.phase("chunk") as ph:
-                yield ph
+            with self.tracer.span("chunk", **tags) as handle:
+                yield handle
+
+    def span(self, name: str, **tags):
+        """A named span under this fit's tracer (no-op handle when off) —
+        for the measurement phases between chunks (MI bounds, evals)."""
+        if self.tracer is None:
+            return contextlib.nullcontext(_NullPhase())
+        return self.tracer.span(name, **tags)
+
+    def record_compile(self, name: str, jitfn, *args,
+                       epochs: int | None = None, **kwargs) -> dict | None:
+        """Cost-analyze ``jitfn`` at this call signature, once per ``name``.
+
+        Emits a ``compile`` event with FLOPs/bytes fields (duration-only on
+        backends without a cost model), bumps the persistent-cache hit/miss
+        counters, and — when ``epochs`` is given (the chunk program's
+        static epoch count) — arms per-chunk utilization gauges scaled by
+        each chunk's actual epoch count. Returns the cost dict or None.
+        """
+        if self.telemetry is None or name in self._costs:
+            return None
+        from dib_tpu.telemetry import xla_stats
+        from dib_tpu.utils.compile_cache import current_status
+
+        cache = current_status()
+        self.registry.counter(
+            "compile_cache.hits" if cache == "warm" else "compile_cache.misses"
+        ).inc()
+        cost = xla_stats.record_compile_event(
+            self.telemetry, name, jitfn, args, kwargs, cache=cache,
+        )
+        self._costs[name] = {
+            "cost": cost,
+            "per_epoch": (
+                {k: v / epochs for k, v in cost.items()}
+                if cost and epochs else None
+            ),
+        }
+        if self._peaks is None:
+            import jax
+
+            self._peaks = xla_stats.backend_peaks(
+                jax.devices()[0].device_kind
+            ) or {}
+        return cost
+
+    def _utilization_gauges(self, name: str, chunk_epochs: int,
+                            seconds: float) -> None:
+        """Achieved FLOP/s / bandwidth of the chunk that just ran, from its
+        cost-analyzed per-epoch FLOPs scaled to this chunk's epoch count."""
+        from dib_tpu.telemetry import xla_stats
+
+        entry = self._costs.get(name)
+        if entry is None or entry["per_epoch"] is None:
+            return
+        per_epoch = entry["per_epoch"]
+        rates = xla_stats.achieved(
+            seconds,
+            flops=per_epoch.get("flops", 0) * chunk_epochs,
+            bytes_accessed=per_epoch.get("bytes_accessed", 0) * chunk_epochs,
+            peaks=self._peaks,
+        )
+        for key, value in rates.items():
+            self.registry.gauge(f"{key}.{name}").set(value)
 
     def record_chunk(self, *, epoch: int, chunk_epochs: int,
                      **fields) -> None:
@@ -73,11 +154,13 @@ class FitRecorder:
         steps = chunk_epochs * self.steps_per_epoch
         self.telemetry.chunk(
             epoch=epoch, steps=steps, seconds=seconds,
-            memory=device_memory_stats(), **fields,
+            memory=device_memory_stats(), host_memory=host_memory_stats(),
+            **fields,
         )
         self.registry.counter("steps").inc(steps)
         self.registry.histogram("chunk_s").record(seconds)
         self.registry.gauge("epoch").set(epoch)
+        self._utilization_gauges("run_chunk", chunk_epochs, seconds)
 
     def finish(self) -> None:
         """End-of-fit rollup: chunk wall-clock distribution + totals as one
@@ -101,14 +184,24 @@ class ChunkPhaseHooks:
         sweep.fit(keys, hooks=hooks, hook_every=chunk_epochs)
         timer.intervals["chunk"]            # per-checkpoint train seconds
         timer.intervals["instrumentation"]  # per-checkpoint hook seconds
+
+    With a ``tracer`` (``telemetry/trace.py``) each interval additionally
+    lands as a ``span`` event ("chunk"/"instrumentation"), so the driver's
+    checkpoint cycle shows up in the run report's span breakdown — the
+    tracer's timer should be this hooks' timer (pass one or the other).
     """
 
     def __init__(self, timer: PhaseTimer | None = None, telemetry=None,
-                 steps_per_epoch: int = 0, baseline_known: bool = True):
+                 steps_per_epoch: int = 0, baseline_known: bool = True,
+                 tracer: Tracer | None = None):
+        if tracer is not None and timer is None:
+            timer = tracer.timer
         self.timer = timer or PhaseTimer()
         self.telemetry = telemetry
+        self.tracer = tracer
         self.steps_per_epoch = steps_per_epoch
         self._t = time.perf_counter()
+        self._open = None    # the in-flight instrumentation span token
         self._last_epoch = 0
         # ``baseline_known=False``: the run may resume from a checkpoint at
         # an epoch the caller cannot know before fitting, so the FIRST
@@ -116,6 +209,12 @@ class ChunkPhaseHooks:
         # emitted as a chunk event (an epoch-0 baseline would inflate the
         # gated steps/s by counting the pre-restore epochs as trained).
         self._baseline_known = baseline_known
+
+    def _add(self, name: str, elapsed: float, **tags) -> None:
+        if self.tracer is not None:
+            self.tracer.add(name, elapsed, **tags)
+        else:
+            self.timer.add(name, elapsed)
 
     def start(self, epoch: int | None = None) -> None:
         """Re-anchor the clock at fit start so the first chunk interval
@@ -136,21 +235,32 @@ class ChunkPhaseHooks:
         now = time.perf_counter()
         elapsed = now - self._t
         self._t = now
-        self.timer.add("chunk", elapsed)
+        self._add("chunk", elapsed, epoch=int(epoch))
         if self.telemetry is not None and self._baseline_known:
             steps = max(epoch - self._last_epoch, 0) * self.steps_per_epoch
             self.telemetry.chunk(
                 epoch=epoch, steps=steps, seconds=elapsed,
                 memory=device_memory_stats(),
+                host_memory=host_memory_stats(),
             )
         self._baseline_known = True  # subsequent deltas are real
         self._last_epoch = epoch
+        if self.tracer is not None:
+            # open the instrumentation span NOW so the hooks that run
+            # between pre and post (SpannedHook-wrapped measurement/pull
+            # work) parent under it instead of double-counting as siblings
+            self._open = self.tracer.begin("instrumentation",
+                                           epoch=int(epoch))
 
     def post(self, trainer, states, epoch: int) -> None:
         now = time.perf_counter()
         elapsed = now - self._t
         self._t = now
-        self.timer.add("instrumentation", elapsed)
+        if self.tracer is not None and self._open is not None:
+            self.tracer.end(self._open)
+            self._open = None
+        else:
+            self.timer.add("instrumentation", elapsed)
         if self.telemetry is not None:
             self.telemetry.hook(
                 name="checkpoint_instrumentation", epoch=epoch,

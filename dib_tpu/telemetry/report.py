@@ -1,0 +1,502 @@
+"""Self-contained static HTML run reports from an events.jsonl.
+
+``python -m dib_tpu telemetry report <run-dir>`` renders ONE html file with
+zero external resources (inline CSS + SVG; light/dark via
+``prefers-color-scheme``), so a run report can be attached to an issue or
+kept next to the run artifacts forever:
+
+  - header: provenance stat tiles (device, status, wall-clock, steps/s);
+  - span breakdown: the trace hierarchy (``telemetry/trace.py``) as a
+    flame-style indented bar list, by total time per normalized path;
+  - training trajectory: per-chunk steps/s, loss/val-loss, and total KL
+    line charts from ``chunk`` events;
+  - MI sandwich: mean lower/upper bound trajectory with the gap shaded;
+  - memory: device + host high-water marks;
+  - utilization: per-compiled-callable roofline coordinates (achieved
+    FLOP/s / bandwidth vs the backend peak table) when ``compile`` events
+    carry cost-analysis numbers — degrading to a duration-only note on
+    backends without a cost model.
+
+All computation is host-side file analysis: this module never imports jax.
+"""
+
+from __future__ import annotations
+
+import html
+import json
+import math
+import os
+
+from dib_tpu.telemetry.events import read_events, resolve_events_path
+from dib_tpu.telemetry.summary import summarize
+
+__all__ = ["render_report", "write_report"]
+
+
+# Validated default palette (dataviz reference instance): categorical slots
+# 1-3 stepped per mode, text/surface tokens, recessive grid.
+_CSS = """
+:root { color-scheme: light dark; }
+body {
+  margin: 0; padding: 24px; font: 14px/1.5 system-ui, sans-serif;
+  background: var(--surface-1); color: var(--text-primary);
+  --surface-1: #fcfcfb; --surface-2: #f1f0ee;
+  --text-primary: #0b0b0b; --text-secondary: #52514e;
+  --grid: #e4e3e0; --series-1: #2a78d6; --series-2: #eb6834;
+  --series-3: #1baf7a; --band: rgba(42, 120, 214, 0.14);
+}
+@media (prefers-color-scheme: dark) {
+  body {
+    --surface-1: #1a1a19; --surface-2: #242423;
+    --text-primary: #ffffff; --text-secondary: #c3c2b7;
+    --grid: #343432; --series-1: #3987e5; --series-2: #d95926;
+    --series-3: #199e70; --band: rgba(57, 135, 229, 0.22);
+  }
+}
+h1 { font-size: 20px; margin: 0 0 4px; }
+h2 { font-size: 15px; margin: 28px 0 8px; }
+.sub { color: var(--text-secondary); margin: 0 0 16px; }
+.tiles { display: flex; flex-wrap: wrap; gap: 12px; margin: 16px 0; }
+.tile { background: var(--surface-2); border-radius: 8px;
+        padding: 10px 14px; min-width: 120px; }
+.tile .v { font-size: 18px; font-weight: 600; }
+.tile .k { color: var(--text-secondary); font-size: 12px; }
+.spans { margin: 8px 0; max-width: 860px; }
+.span-row { display: flex; align-items: center; gap: 8px;
+            margin: 2px 0; font-size: 13px; }
+.span-name { flex: 0 0 340px; white-space: nowrap; overflow: hidden;
+             text-overflow: ellipsis; font-family: ui-monospace, monospace; }
+.span-bar-rail { flex: 1; background: var(--surface-2); border-radius: 4px;
+                 height: 14px; position: relative; }
+.span-bar { position: absolute; top: 0; bottom: 0; border-radius: 4px;
+            background: var(--series-1); min-width: 2px; }
+.span-secs { flex: 0 0 150px; color: var(--text-secondary);
+             font-size: 12px; text-align: right; }
+table { border-collapse: collapse; font-size: 13px; }
+th, td { text-align: right; padding: 4px 10px;
+         border-bottom: 1px solid var(--grid); }
+th:first-child, td:first-child { text-align: left;
+                                 font-family: ui-monospace, monospace; }
+th { color: var(--text-secondary); font-weight: 500; }
+svg text { fill: var(--text-secondary); font: 11px system-ui, sans-serif; }
+svg .gridline { stroke: var(--grid); stroke-width: 1; }
+svg .axis { stroke: var(--grid); stroke-width: 1; }
+.legend { display: flex; gap: 16px; font-size: 12px;
+          color: var(--text-secondary); margin: 2px 0 0 44px; }
+.legend .swatch { display: inline-block; width: 10px; height: 10px;
+                  border-radius: 2px; margin-right: 5px;
+                  vertical-align: -1px; }
+.note { color: var(--text-secondary); font-size: 13px; }
+details { margin: 24px 0; }
+details pre { background: var(--surface-2); padding: 12px;
+              border-radius: 8px; overflow-x: auto; font-size: 12px; }
+.charts { display: flex; flex-wrap: wrap; gap: 24px; }
+.chart h3 { font-size: 13px; margin: 0 0 2px;
+            color: var(--text-primary); font-weight: 600; }
+"""
+
+
+def _esc(x) -> str:
+    return html.escape(str(x))
+
+
+def _fmt_seconds(s: float) -> str:
+    if s >= 120:
+        return f"{s / 60:.1f} min"
+    if s >= 1:
+        return f"{s:.2f} s"
+    return f"{s * 1e3:.1f} ms"
+
+
+def _fmt_bytes(b: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(b) < 1024 or unit == "TiB":
+            return f"{b:.1f} {unit}" if unit != "B" else f"{b:.0f} B"
+        b /= 1024
+    return f"{b:.1f} TiB"
+
+
+def _finite_points(points):
+    return [(x, y) for x, y in points
+            if isinstance(y, (int, float)) and math.isfinite(y)]
+
+
+def _ticks(lo: float, hi: float, n: int = 4) -> list[float]:
+    if hi <= lo:
+        hi = lo + 1.0
+    raw = (hi - lo) / n
+    mag = 10 ** math.floor(math.log10(raw)) if raw > 0 else 1.0
+    step = next((m * mag for m in (1, 2, 2.5, 5, 10) if m * mag >= raw),
+                raw)
+    start = math.ceil(lo / step) * step
+    out = []
+    t = start
+    while t <= hi + 1e-12 * abs(hi):
+        out.append(round(t, 10))
+        t += step
+    return out or [lo, hi]
+
+
+def _fmt_tick(v: float) -> str:
+    if v == 0:
+        return "0"
+    if abs(v) >= 10000 or abs(v) < 0.01:
+        return f"{v:.1e}"
+    return f"{v:g}"
+
+
+class _Scale:
+    def __init__(self, points_lists, width, height, pad_l=44, pad_r=12,
+                 pad_t=8, pad_b=20):
+        xs = [p[0] for pts in points_lists for p in pts]
+        ys = [p[1] for pts in points_lists for p in pts]
+        self.x0, self.x1 = (min(xs), max(xs)) if xs else (0.0, 1.0)
+        self.y0, self.y1 = (min(ys), max(ys)) if ys else (0.0, 1.0)
+        if self.x1 <= self.x0:
+            self.x1 = self.x0 + 1.0
+        if self.y1 <= self.y0:
+            self.y0, self.y1 = self.y0 - 0.5, self.y1 + 0.5
+        else:  # headroom so lines don't kiss the frame
+            span = self.y1 - self.y0
+            self.y0 -= 0.05 * span
+            self.y1 += 0.05 * span
+        self.pl, self.pr, self.pt, self.pb = pad_l, pad_r, pad_t, pad_b
+        self.w, self.h = width, height
+
+    def x(self, v) -> float:
+        return self.pl + (v - self.x0) / (self.x1 - self.x0) * (
+            self.w - self.pl - self.pr)
+
+    def y(self, v) -> float:
+        return self.pt + (self.y1 - v) / (self.y1 - self.y0) * (
+            self.h - self.pt - self.pb)
+
+
+def _line_chart(title: str, series, *, width=420, height=150,
+                x_label="epoch", band_pair=None) -> str:
+    """One SVG line chart. ``series``: [(name, css_color_var, points)].
+    ``band_pair``: (i, j) series indices to shade between (MI sandwich).
+    Multi-series charts get a legend; every point carries a native hover
+    tooltip (<title>)."""
+    series = [(name, color, _finite_points(pts)) for name, color, pts in series]
+    series = [s for s in series if s[2]]
+    if not series:
+        return ""
+    sc = _Scale([pts for _, _, pts in series], width, height)
+    parts = [f'<svg viewBox="0 0 {width} {height}" width="{width}" '
+             f'height="{height}" role="img" aria-label="{_esc(title)}">']
+    for t in _ticks(sc.y0, sc.y1):
+        if not (sc.y0 <= t <= sc.y1):
+            continue
+        y = sc.y(t)
+        parts.append(f'<line class="gridline" x1="{sc.pl}" y1="{y:.1f}" '
+                     f'x2="{width - sc.pr}" y2="{y:.1f}"/>')
+        parts.append(f'<text x="{sc.pl - 6}" y="{y + 3.5:.1f}" '
+                     f'text-anchor="end">{_fmt_tick(t)}</text>')
+    parts.append(f'<line class="axis" x1="{sc.pl}" y1="{height - sc.pb}" '
+                 f'x2="{width - sc.pr}" y2="{height - sc.pb}"/>')
+    for t in _ticks(sc.x0, sc.x1, 5):
+        if not (sc.x0 <= t <= sc.x1):
+            continue
+        parts.append(f'<text x="{sc.x(t):.1f}" y="{height - 6}" '
+                     f'text-anchor="middle">{_fmt_tick(t)}</text>')
+    parts.append(f'<text x="{width - sc.pr}" y="{height - 6}" '
+                 f'text-anchor="end">{_esc(x_label)}</text>')
+    if band_pair is not None and len(series) > max(band_pair):
+        lo = series[band_pair[0]][2]
+        hi = series[band_pair[1]][2]
+        if len(lo) == len(hi):
+            pts = ([f"{sc.x(x):.1f},{sc.y(y):.1f}" for x, y in hi]
+                   + [f"{sc.x(x):.1f},{sc.y(y):.1f}" for x, y in lo[::-1]])
+            parts.append(f'<polygon points="{" ".join(pts)}" '
+                         f'fill="var(--band)" stroke="none"/>')
+    for name, color, pts in series:
+        d = " ".join(f"{sc.x(x):.1f},{sc.y(y):.1f}" for x, y in pts)
+        parts.append(f'<polyline points="{d}" fill="none" '
+                     f'stroke="var({color})" stroke-width="2" '
+                     f'stroke-linejoin="round"/>')
+        for x, y in pts:
+            parts.append(
+                f'<circle cx="{sc.x(x):.1f}" cy="{sc.y(y):.1f}" r="2.5" '
+                f'fill="var({color})"><title>{_esc(name)} @ '
+                f'{_fmt_tick(x)}: {y:.5g}</title></circle>')
+    parts.append("</svg>")
+    legend = ""
+    if len(series) > 1:
+        legend = '<div class="legend">' + "".join(
+            f'<span><span class="swatch" style="background:var({color})">'
+            f'</span>{_esc(name)}</span>'
+            for name, color, _ in series
+        ) + "</div>"
+    return (f'<div class="chart"><h3>{_esc(title)}</h3>'
+            f"{''.join(parts)}{legend}</div>")
+
+
+def _tiles(pairs) -> str:
+    cells = "".join(
+        f'<div class="tile"><div class="v">{_esc(v)}</div>'
+        f'<div class="k">{_esc(k)}</div></div>'
+        for k, v in pairs if v is not None
+    )
+    return f'<div class="tiles">{cells}</div>'
+
+
+def _span_section(summary: dict) -> str:
+    rollup = summary.get("spans") or {}
+    if not rollup:
+        return ('<p class="note">No span events in this stream — run with '
+                "telemetry enabled on a spans-wired entry point "
+                "(train/sweep/boolean/northstar) to get the trace "
+                "breakdown.</p>")
+    # Tree by NEAREST PRESENT ancestor: a span recorded with a slash name
+    # and no enclosing spans ("sweep/replica*/mi_bounds" with no "sweep"
+    # entry) roots the subtree itself instead of silently vanishing.
+    children: dict[str, list[str]] = {}
+    for path in rollup:
+        parts = path.split("/")
+        ancestor = ""
+        for i in range(len(parts) - 1, 0, -1):
+            candidate = "/".join(parts[:i])
+            if candidate in rollup:
+                ancestor = candidate
+                break
+        children.setdefault(ancestor, []).append(path)
+    roots = children.get("", [])
+    top_total = sum(rollup[p]["total_s"] for p in roots) or max(
+        (s["total_s"] for s in rollup.values()), default=1.0)
+    rows = []
+
+    def emit(ancestor: str, depth: int):
+        level = sorted(children.get(ancestor, []),
+                       key=lambda p: -rollup[p]["total_s"])
+        for path in level:
+            stats = rollup[path]
+            frac = stats["total_s"] / top_total if top_total else 0.0
+            suffix = path[len(ancestor) + 1:] if ancestor else path
+            label = ("&nbsp;" * 4 * depth) + _esc(suffix)
+            rows.append(
+                '<div class="span-row">'
+                f'<div class="span-name" title="{_esc(path)}">{label}</div>'
+                '<div class="span-bar-rail">'
+                f'<div class="span-bar" style="left:0;'
+                f'width:{min(frac, 1.0) * 100:.2f}%"></div></div>'
+                f'<div class="span-secs">{_fmt_seconds(stats["total_s"])}'
+                f' &middot; {stats["count"]}&times;'
+                f' &middot; {frac * 100:.1f}%</div></div>'
+            )
+            emit(path, depth + 1)
+
+    emit("", 0)
+    hot = summary.get("span_hotspots") or []
+    hot_html = ""
+    if hot:
+        hot_html = ('<p class="note">Hotspots (self time): '
+                    + ", ".join(
+                        f"<code>{_esc(h['path'])}</code> "
+                        f"{_fmt_seconds(h['self_s'])}"
+                        for h in hot) + "</p>")
+    return f'<div class="spans">{"".join(rows)}</div>{hot_html}'
+
+
+def _utilization_section(summary: dict) -> str:
+    util = dict(summary.get("utilization") or {})
+    peaks = util.pop("_peaks", None)
+    if not util:
+        return ('<p class="note">No XLA cost-analysis numbers on this '
+                "stream (backend without a cost model, or "
+                "<code>DIB_XLA_COST_ANALYSIS=0</code>) — spans above carry "
+                "the duration-only view.</p>")
+    head = ""
+    if peaks:
+        head = (f'<p class="note">Backend peaks: '
+                f"{peaks.get('bf16_tflops', '?')} TFLOP/s bf16, "
+                f"{peaks.get('hbm_gbps', '?')} GB/s HBM "
+                "(per-backend capability table, "
+                "<code>telemetry/xla_stats.py</code>).</p>")
+    rows = ["<tr><th>compiled callable</th><th>FLOPs/call</th>"
+            "<th>bytes/call</th><th>mean span</th>"
+            "<th>achieved GFLOP/s</th><th>% FLOP peak</th>"
+            "<th>achieved GB/s</th><th>% HBM peak</th>"
+            "<th>FLOP/byte</th></tr>"]
+    for name, entry in util.items():
+        def num(key, fmt="{:.3g}", scale=1.0, pct=False):
+            v = entry.get(key)
+            if v is None:
+                return "—"
+            return (f"{v * 100:.2f}%" if pct else fmt.format(v * scale))
+        rows.append(
+            f"<tr><td>{_esc(name)}</td>"
+            f"<td>{num('flops', '{:.3e}')}</td>"
+            f"<td>{num('bytes_accessed', '{:.3e}')}</td>"
+            f"<td>{_fmt_seconds(entry['span_mean_s']) if entry.get('span_mean_s') else '—'}</td>"
+            f"<td>{num('achieved_gflops', '{:.2f}')}</td>"
+            f"<td>{num('flops_frac_of_peak', pct=True)}</td>"
+            f"<td>{num('achieved_gbps', '{:.2f}')}</td>"
+            f"<td>{num('bandwidth_frac_of_peak', pct=True)}</td>"
+            f"<td>{num('arithmetic_intensity', '{:.2f}')}</td></tr>"
+        )
+    note = ('<p class="note">Achieved rates divide each callable\'s '
+            "cost-analyzed FLOPs/bytes by its mean span duration; "
+            "cost-analysis flop counts are backend-reported and can "
+            "undercount (see docs/performance.md) — the analytic-MFU "
+            "headline in bench.py is the cross-round comparable.</p>")
+    return head + "<table>" + "".join(rows) + "</table>" + note
+
+
+def _memory_section(chunks) -> str:
+    dev = [(c.get("epoch"), (c.get("memory") or {}).get("peak_bytes_in_use"))
+           for c in chunks]
+    host = [(c.get("epoch"),
+             (c.get("host_memory") or {}).get(
+                 "peak_rss_bytes", (c.get("host_memory") or {}).get(
+                     "rss_bytes")))
+            for c in chunks]
+    dev = [(e, v) for e, v in dev if v is not None]
+    host = [(e, v) for e, v in host if v is not None]
+    if not dev and not host:
+        return ('<p class="note">No memory stats on this stream (CPU '
+                "backend without the host-RSS fallback, or a pre-span "
+                "schema).</p>")
+    tiles = _tiles([
+        ("device peak", _fmt_bytes(max(v for _, v in dev)) if dev else None),
+        ("host RSS peak", _fmt_bytes(max(v for _, v in host)) if host else None),
+    ])
+    series = []
+    if dev:
+        series.append(("device peak bytes", "--series-1",
+                       [(e, v / 2**20) for e, v in dev]))
+    if host:
+        series.append(("host RSS", "--series-2",
+                       [(e, v / 2**20) for e, v in host]))
+    chart = _line_chart("Memory high-water (MiB)", series) if series else ""
+    return tiles + f'<div class="charts">{chart}</div>'
+
+
+def render_report(path: str, run_id: str | None = None,
+                  process_index: int | None = None) -> str:
+    """The report HTML for one events.jsonl (or its run dir)."""
+    events = list(read_events(path, process_index=process_index))
+    if run_id is not None:
+        events = [e for e in events if e.get("run") == run_id]
+    summary = summarize(path, process_index=process_index, run_id=run_id)
+
+    chunks = [e for e in events if e.get("type") == "chunk"]
+    mi = [e for e in events if e.get("type") == "mi_bounds"]
+
+    def chunk_series(key):
+        pts = []
+        for c in chunks:
+            v = c.get(key)
+            if isinstance(v, list):   # sweep runs carry [R] lists
+                vals = [x for x in v if isinstance(x, (int, float))]
+                v = sum(vals) / len(vals) if vals else None
+            if isinstance(v, (int, float)):
+                pts.append((c.get("epoch", 0), v))
+        return pts
+
+    charts = [
+        _line_chart("Throughput (steps/s)",
+                    [("steps/s", "--series-1", chunk_series("steps_per_s"))]),
+        _line_chart("Loss",
+                    [("train", "--series-1", chunk_series("loss")),
+                     ("validation", "--series-2", chunk_series("val_loss"))]),
+    ]
+    kl = chunk_series("kl_total")
+    if not kl:
+        kl = []
+        for c in chunks:
+            v = c.get("kl_per_feature")
+            if isinstance(v, list):
+                vals = [x for x in v if isinstance(x, (int, float))]
+                if vals:
+                    kl.append((c.get("epoch", 0), sum(vals)))
+    charts.append(_line_chart("Total KL (per-replica mean for sweeps)",
+                              [("total KL", "--series-3", kl)]))
+    charts = [c for c in charts if c]
+
+    mi_chart = ""
+    if mi:
+        def mean_bits(e, which):
+            vals = e.get(f"{which}_bits")
+            if vals is None and e.get(f"{which}_nats") is not None:
+                vals = [x / math.log(2.0) for x in e[f"{which}_nats"]
+                        if isinstance(x, (int, float))]
+            if isinstance(vals, list):
+                vals = [x for x in vals if isinstance(x, (int, float))]
+                return sum(vals) / len(vals) if vals else None
+            return vals if isinstance(vals, (int, float)) else None
+
+        lower = [(e.get("epoch", 0), mean_bits(e, "lower")) for e in mi]
+        upper = [(e.get("epoch", 0), mean_bits(e, "upper")) for e in mi]
+        lower = [(x, y) for x, y in lower if y is not None]
+        upper = [(x, y) for x, y in upper if y is not None]
+        mi_chart = _line_chart(
+            "MI sandwich bounds (mean bits per feature)",
+            [("lower bound", "--series-1", lower),
+             ("upper bound", "--series-2", upper)],
+            band_pair=(0, 1), width=640, height=180,
+        )
+
+    status = summary.get("status", "?")
+    wall = summary.get("wall_clock_s")
+    header_tiles = _tiles([
+        ("status", status),
+        ("device", f"{summary.get('device_kind', '?')} ×"
+                   f"{summary.get('device_count', '?')}"),
+        ("steps/s", summary.get("steps_per_s")),
+        ("steady steps/s", summary.get("steady_steps_per_s")),
+        ("total steps", summary.get("total_steps")),
+        ("wall clock", _fmt_seconds(wall) if wall else None),
+        ("launches", summary.get("launches")),
+        ("mitigations", summary.get("mitigations_total") or None),
+    ])
+    run_label = summary.get("run_id", "run")
+    git = summary.get("git_sha")
+    sub = (f"run <code>{_esc(run_label)}</code>"
+           + (f" · git <code>{_esc(str(git)[:12])}</code>" if git else "")
+           + (f" · config <code>{_esc(summary['config_hash'])}</code>"
+              if summary.get("config_hash") else ""))
+
+    summary_json = _esc(json.dumps(summary, indent=1, default=str))
+    return f"""<!DOCTYPE html>
+<html lang="en"><head><meta charset="utf-8">
+<meta name="viewport" content="width=device-width, initial-scale=1">
+<title>dib-tpu run report — {_esc(run_label)}</title>
+<style>{_CSS}</style></head>
+<body>
+<h1>dib-tpu run report</h1>
+<p class="sub">{sub}</p>
+{header_tiles}
+<h2>Span breakdown</h2>
+<p class="note">Blocked wall-clock per trace span
+(<code>telemetry/trace.py</code>); bars are fractions of the top-level
+total, indented by nesting. The same names appear in captured XLA traces
+via <code>jax.profiler.TraceAnnotation</code>.</p>
+{_span_section(summary)}
+<h2>Training trajectory</h2>
+<div class="charts">{''.join(charts)}</div>
+<h2>MI-bound trajectory</h2>
+{mi_chart or '<p class="note">No mi_bounds events in this stream.</p>'}
+<h2>Memory</h2>
+{_memory_section(chunks)}
+<h2>Roofline utilization</h2>
+{_utilization_section(summary)}
+<details><summary>Full summary record (table view)</summary>
+<pre>{summary_json}</pre></details>
+</body></html>
+"""
+
+
+def write_report(path: str, out: str | None = None,
+                 run_id: str | None = None,
+                 process_index: int | None = None) -> str:
+    """Render and write the report; returns the output path (default:
+    ``report.html`` next to the events file)."""
+    html_text = render_report(path, run_id=run_id,
+                              process_index=process_index)
+    if out is None:
+        out = os.path.join(
+            os.path.dirname(resolve_events_path(path)), "report.html")
+    with open(out, "w") as f:
+        f.write(html_text)
+    return out

@@ -10,6 +10,13 @@ nonzero on regression, making it a perf gate ``bench.py`` and CI can call:
 
     python -m dib_tpu telemetry summarize <run_dir>
     python -m dib_tpu telemetry compare <run_a> <run_b> --threshold 0.05
+    python -m dib_tpu telemetry report <run_dir>      # static HTML report
+
+``summarize`` additionally rolls ``span`` events into per-path totals
+(dynamic indices collapsed: ``sweep/replica3/...`` -> ``sweep/replica*/...``),
+ranks the top self-time hotspots, joins cost-analyzed ``compile`` events
+with span durations into per-callable roofline utilization, and reports
+device/host memory high-water marks.
 """
 
 from __future__ import annotations
@@ -18,6 +25,7 @@ import argparse
 import json
 import math
 import os
+import re
 import sys
 import warnings
 from math import log
@@ -29,7 +37,8 @@ from dib_tpu.telemetry.events import (
     read_events,
 )
 
-__all__ = ["summarize", "compare", "telemetry_main"]
+__all__ = ["summarize", "compare", "span_rollup", "span_hotspots",
+           "telemetry_main"]
 
 _LN2 = log(2.0)
 
@@ -64,6 +73,106 @@ def _as_floats(value) -> list[float]:
 # one wire format for non-finite floats, shared with the writer side
 # (events.py) so the round-trip cannot drift
 _enc = _sanitize_nonfinite
+
+
+def _normalize_span_path(path: str) -> str:
+    """Collapse dynamic trailing indices so per-instance span names roll up:
+    ``sweep/replica3/chunk12/mi_bounds`` -> ``sweep/replica*/chunk*/mi_bounds``.
+    Only a segment's TRAILING digit run is dynamic by convention."""
+    return "/".join(
+        re.sub(r"\d+$", "*", seg) for seg in path.split("/")
+    )
+
+
+def span_rollup(span_events) -> dict:
+    """{normalized path: {"total_s", "count", "mean_s"}} over span events,
+    ordered by total descending."""
+    totals: dict[str, list] = {}
+    for e in span_events:
+        path = _normalize_span_path(e.get("path") or e.get("name") or "?")
+        entry = totals.setdefault(path, [0.0, 0])
+        entry[0] += e.get("seconds") or 0.0
+        entry[1] += 1
+    return {
+        path: {
+            "total_s": round(total, 4),
+            "count": count,
+            "mean_s": round(total / count, 4) if count else 0.0,
+        }
+        for path, (total, count) in sorted(
+            totals.items(), key=lambda kv: -kv[1][0]
+        )
+    }
+
+
+def span_hotspots(rollup: dict, n: int = 3) -> list[dict]:
+    """Top-``n`` spans by SELF time (own total minus its children's) —
+    total time would double-charge every parent for its children. A child
+    is any path whose NEAREST present ancestor in the rollup is this one
+    (slash-named spans may skip intermediate levels)."""
+    child_s: dict[str, float] = {}
+    for path, stats in rollup.items():
+        parts = path.split("/")
+        for i in range(len(parts) - 1, 0, -1):
+            ancestor = "/".join(parts[:i])
+            if ancestor in rollup:
+                child_s[ancestor] = child_s.get(ancestor, 0.0) \
+                    + stats["total_s"]
+                break
+    rows = [
+        {
+            "path": path,
+            "self_s": round(
+                max(stats["total_s"] - child_s.get(path, 0.0), 0.0), 4),
+            "total_s": stats["total_s"],
+            "count": stats["count"],
+        }
+        for path, stats in rollup.items()
+    ]
+    rows.sort(key=lambda r: -r["self_s"])
+    return rows[:n]
+
+
+def _utilization_rollup(compiles, rollup: dict, device_kind) -> dict:
+    """Join cost-analyzed ``compile`` events with span durations into
+    per-callable roofline coordinates. A compiled callable matches the span
+    whose path's last segment equals its name (or the whole path does);
+    durations are the span's MEAN, so partial final chunks blur slightly —
+    the live gauges in the ``metrics`` event are the per-chunk-exact view."""
+    from dib_tpu.telemetry.xla_stats import achieved, backend_peaks
+
+    peaks = backend_peaks(device_kind)
+    util = {}
+    for c in compiles:
+        if not (c.get("flops") or c.get("bytes_accessed")):
+            continue
+        name = c.get("name", "?")
+        # compiled callables carry their method names ("run_chunk",
+        # "channel_mi_bounds") while spans carry phase names ("chunk",
+        # "mi_bounds") — match modulo the conventional verb prefix
+        aliases = {name, name.removeprefix("run_"),
+                   name.removeprefix("channel_")}
+        span = next(
+            (s for p, s in rollup.items()
+             if p in aliases or p.split("/")[-1] in aliases), None
+        )
+        entry = {
+            "flops": c.get("flops"),
+            "bytes_accessed": c.get("bytes_accessed"),
+        }
+        if span is not None:
+            entry["span_mean_s"] = span["mean_s"]
+            entry["span_count"] = span["count"]
+            entry.update({
+                k: round(v, 6) for k, v in achieved(
+                    span["mean_s"], flops=c.get("flops"),
+                    bytes_accessed=c.get("bytes_accessed"), peaks=peaks,
+                ).items()
+            })
+        util[name] = entry
+    if util and peaks:
+        util["_peaks"] = peaks
+    return util
 
 
 def summarize(path: str, process_index: int | None = None,
@@ -247,7 +356,41 @@ def summarize(path: str, process_index: int | None = None,
             "events": len(compiles),
             "total_s": round(sum(c.get("seconds") or 0.0 for c in compiles), 3),
             "cache": by_cache,
+            # hit/miss counters (utils/compile_cache.py statuses): a
+            # recompile storm shows up as a miss count out of line with the
+            # baseline's, without digging through individual events
+            "cache_hits": by_cache.get("warm", 0),
+            "cache_misses": (by_cache.get("cold-populating", 0)
+                             + by_cache.get("cold", 0)),
         }
+
+    span_events = of_type("span", per_run)
+    if span_events:
+        rollup = span_rollup(span_events)
+        summary["spans"] = rollup
+        summary["span_hotspots"] = span_hotspots(rollup)
+        util = _utilization_rollup(compiles, rollup,
+                                   summary.get("device_kind"))
+        if util:
+            summary["utilization"] = util
+
+    mem_device = [((c.get("memory") or {}).get("peak_bytes_in_use"))
+                  for c in chunks]
+    # sandboxed kernels hide VmHWM: fall back to the max sampled RSS,
+    # which is a chunk-boundary high-water mark of its own
+    mem_host = [(c.get("host_memory") or {}).get(
+                    "peak_rss_bytes", (c.get("host_memory") or {}).get(
+                        "rss_bytes"))
+                for c in chunks]
+    mem_device = [m for m in mem_device if m is not None]
+    mem_host = [m for m in mem_host if m is not None]
+    if mem_device or mem_host:
+        summary["memory"] = {}
+        if mem_device:
+            summary["memory"]["device_peak_bytes"] = max(mem_device)
+        if mem_host:
+            summary["memory"]["host_peak_rss_bytes"] = max(mem_host)
+
     if hooks:
         by_hook: dict[str, float] = {}
         for h in hooks:
@@ -412,6 +555,18 @@ def telemetry_main(argv: Sequence[str]) -> int:
     p_cmp.add_argument("--run-id-b", default=None,
                        help="Restrict the candidate to one run's events.")
     p_cmp.add_argument("--indent", action="store_true")
+    p_rep = sub.add_parser(
+        "report",
+        help="Render a self-contained static HTML run report (span "
+             "breakdown, training trajectory, MI bounds, memory, roofline "
+             "utilization).")
+    p_rep.add_argument("path", help="Run dir or events.jsonl path.")
+    p_rep.add_argument("--out", default=None,
+                       help="Output HTML path (default: report.html next to "
+                            "the events file).")
+    p_rep.add_argument("--process-index", type=int, default=None)
+    p_rep.add_argument("--run-id", default=None,
+                       help="Restrict to one run's events.")
     args = parser.parse_args(argv)
 
     try:
@@ -419,6 +574,14 @@ def telemetry_main(argv: Sequence[str]) -> int:
             record = summarize(args.path, process_index=args.process_index,
                                run_id=args.run_id)
             print(json.dumps(record, indent=1 if args.indent else None))
+            return 0
+        if args.action == "report":
+            from dib_tpu.telemetry.report import write_report
+
+            out = write_report(args.path, out=args.out,
+                               process_index=args.process_index,
+                               run_id=args.run_id)
+            print(out)
             return 0
         a = _load_side(args.baseline, args.process_index,
                        run_id=args.run_id_a)

@@ -1,0 +1,246 @@
+"""Nestable device-truth spans: one name, three sinks.
+
+``PhaseTimer`` (``utils/profiling.py``) gives honest wall-clock for a flat
+set of phases; this module generalizes it to a HIERARCHY and fans each
+interval out to every consumer that needs it:
+
+  - a ``span`` event on the run's ``events.jsonl`` (schema-versioned, with
+    span/parent ids and the full slash path, so offline tools can rebuild
+    the tree — ``telemetry report`` renders it as a flame-style breakdown);
+  - the same ``PhaseTimer`` accounting the existing intervals/report APIs
+    read (the timer key is the span's path);
+  - a ``jax.profiler.TraceAnnotation`` around the body, so the SAME name
+    appears in a captured XLA trace — the host-side span and the device
+    timeline are joined by name, which is what makes the timing
+    "device-truth": a span's wall-clock can be attributed to the XLA ops
+    that ran under it.
+
+Async-dispatch correctness is inherited from ``PhaseTimer`` semantics:
+register the span's device outputs on the yielded handle
+(``handle.block_on(...)``) and the span blocks on them before closing, so
+the compute lands in the span that launched it rather than in whichever
+span happens to fetch first.
+
+Thread safety: span ids are allocated under a lock; the nesting stack is
+per-thread (``threading.local``), so concurrent threads (e.g. a checkpoint
+writer thread) build independent, correctly-parented subtrees on one
+tracer. Span names may contain ``/`` — each segment extends the path, so
+``span("sweep/replica3/mi_bounds")`` works with or without enclosing spans.
+
+Plumbing-free instrumentation: ``use_tracer(tracer)`` binds the active
+tracer for the current context and the module-level ``span(name)`` uses it,
+so deep code (hook adapters, workload internals) can open spans without
+threading a tracer through every signature. With no tracer bound, spans
+still nest and time (into a process-local fallback timer) but emit nothing.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import sys
+import threading
+import time
+
+from dib_tpu.utils.profiling import PhaseTimer
+
+__all__ = ["SpanHandle", "SpannedHook", "Tracer", "current_tracer", "span",
+           "use_tracer"]
+
+
+class SpanHandle:
+    """What a ``span(...)`` block sees: output registration + late tags."""
+
+    def __init__(self):
+        self._outputs: list = []
+        self._fields: dict = {}
+
+    def block_on(self, *arrays):
+        """Register device outputs produced inside the span; the span blocks
+        on them at exit so their compute time lands here (PhaseTimer
+        semantics)."""
+        self._outputs.extend(arrays)
+        return arrays[0] if len(arrays) == 1 else arrays
+
+    def annotate(self, **fields) -> None:
+        """Attach fields to the span's event that are only known mid-span
+        (e.g. the epoch a chunk ended on)."""
+        self._fields.update(fields)
+
+
+def _trace_annotation(path: str):
+    """``jax.profiler.TraceAnnotation`` for ``path`` — but ONLY when jax is
+    demonstrably live in this process: host-only consumers (``dib_tpu
+    telemetry``, the watchdog supervisor) must not pay the jax import."""
+    jax_mod = sys.modules.get("jax")
+    if jax_mod is None:
+        return contextlib.nullcontext()
+    try:
+        return jax_mod.profiler.TraceAnnotation(path)
+    except Exception:
+        return contextlib.nullcontext()
+
+
+class Tracer:
+    """Span factory bound to an optional ``EventWriter`` and ``PhaseTimer``.
+
+    ``telemetry=None`` keeps spans timing into the timer (duration-only);
+    ``timer=None`` creates a private one. One tracer serves a whole run —
+    share it between the fit recorder and every hook so ids/parentage are
+    consistent across the stream.
+    """
+
+    def __init__(self, telemetry=None, timer: PhaseTimer | None = None):
+        self.telemetry = telemetry
+        self.timer = timer if timer is not None else PhaseTimer()
+        self._local = threading.local()
+        self._lock = threading.Lock()
+        self._next_id = 0
+
+    def _stack(self) -> list:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    def _new_id(self) -> int:
+        with self._lock:
+            sid = self._next_id
+            self._next_id += 1
+        return sid
+
+    @contextlib.contextmanager
+    def span(self, name: str, **tags):
+        """Open a nested span; yields a :class:`SpanHandle`."""
+        stack = self._stack()
+        span_id = self._new_id()
+        parent_id = stack[-1][1] if stack else None
+        prefix = stack[-1][0] + "/" if stack else ""
+        path = prefix + name
+        handle = SpanHandle()
+        stack.append((path, span_id))
+        start = time.perf_counter()
+        try:
+            with _trace_annotation(path):
+                yield handle
+        finally:
+            # async dispatch defers device errors to the block — the span
+            # must still pop and record even when block_until_ready raises
+            # (a corrupted thread stack would mis-parent every later span)
+            try:
+                if handle._outputs:
+                    import jax
+
+                    jax.block_until_ready(handle._outputs)
+            finally:
+                elapsed = time.perf_counter() - start
+                stack.pop()
+                self._record(name, path, span_id, parent_id, elapsed,
+                             {**tags, **handle._fields})
+
+    def add(self, name: str, seconds: float, **tags) -> None:
+        """Record an externally measured interval as a span — for callers
+        whose boundaries are hook invocations rather than ``with`` blocks
+        (``ChunkPhaseHooks``). Parented under the current span, if any."""
+        stack = self._stack()
+        parent_id = stack[-1][1] if stack else None
+        prefix = stack[-1][0] + "/" if stack else ""
+        self._record(name, prefix + name, self._new_id(), parent_id,
+                     seconds, tags)
+
+    def begin(self, name: str, **tags) -> tuple:
+        """Open a span whose close is a separate call site (hook-pair
+        boundaries: ``ChunkPhaseHooks.pre`` opens the instrumentation span,
+        ``post`` closes it) — spans opened in between parent under it, so
+        hook work nests instead of double-counting as siblings. Returns an
+        opaque token for :meth:`end`."""
+        stack = self._stack()
+        span_id = self._new_id()
+        parent_id = stack[-1][1] if stack else None
+        prefix = stack[-1][0] + "/" if stack else ""
+        path = prefix + name
+        stack.append((path, span_id))
+        return (name, path, span_id, parent_id, time.perf_counter(), tags)
+
+    def end(self, token: tuple, **fields) -> None:
+        """Close a :meth:`begin` span; tolerates a stack disturbed by an
+        exception in between (removes this span's entry wherever it is)."""
+        name, path, span_id, parent_id, start, tags = token
+        stack = self._stack()
+        entry = (path, span_id)
+        if entry in stack:
+            del stack[stack.index(entry):]   # also drop abandoned children
+        self._record(name, path, span_id, parent_id,
+                     time.perf_counter() - start, {**tags, **fields})
+
+    def _record(self, name, path, span_id, parent_id, seconds, fields):
+        self.timer.add(path, seconds)
+        if self.telemetry is not None:
+            self.telemetry.span(
+                name=name, path=path, span_id=span_id, parent_id=parent_id,
+                seconds=seconds, **fields,
+            )
+
+
+# --------------------------------------------------------------- active tracer
+# A context-local binding so instrumentation deep in the call tree (hook
+# adapters, workload internals) can open spans without signature plumbing.
+_ACTIVE: contextvars.ContextVar[Tracer | None] = contextvars.ContextVar(
+    "dib_tpu_active_tracer", default=None
+)
+_FALLBACK = Tracer()   # duration-only, process-local: span() never fails
+
+
+def current_tracer() -> Tracer:
+    """The tracer bound by the innermost ``use_tracer``, else a process-local
+    duration-only fallback (spans still nest and time, nothing is emitted)."""
+    return _ACTIVE.get() or _FALLBACK
+
+
+@contextlib.contextmanager
+def use_tracer(tracer: Tracer | None):
+    """Bind ``tracer`` as the context's active tracer (None = no-op)."""
+    if tracer is None:
+        yield
+        return
+    token = _ACTIVE.set(tracer)
+    try:
+        yield
+    finally:
+        _ACTIVE.reset(token)
+
+
+def span(name: str, **tags):
+    """``current_tracer().span(name, **tags)`` — the plumbing-free spelling."""
+    return current_tracer().span(name, **tags)
+
+
+class SpannedHook:
+    """Wraps a fit hook so each firing runs inside a named span.
+
+    Like ``train.hooks.TimedHook`` but emitting into the span hierarchy of
+    the ACTIVE tracer (``use_tracer``), so hook work nests under whatever
+    span encloses the fit loop. Cadence-gated hooks (anything exposing
+    ``fires_at``) that skip an epoch produce no phantom span; attribute
+    access falls through to the inner hook.
+    """
+
+    def __init__(self, name: str, hook):
+        self.name = name
+        self.hook = hook
+
+    def fires_at(self, epoch: int) -> bool:
+        fires_at = getattr(self.hook, "fires_at", None)
+        return fires_at(epoch) if fires_at is not None else True
+
+    def __call__(self, trainer, state, epoch: int):
+        fires_at = getattr(self.hook, "fires_at", None)
+        if fires_at is not None and not fires_at(epoch):
+            return
+        with span(self.name, epoch=int(epoch)):
+            self.hook(trainer, state, epoch)
+
+    def __getattr__(self, attr):
+        if attr in ("hook", "name") or attr.startswith("__"):
+            raise AttributeError(attr)
+        return getattr(self.hook, attr)

@@ -322,7 +322,10 @@ class DIBTrainer:
 
         ``telemetry`` (an ``EventWriter``) makes every chunk boundary emit a
         ``chunk`` event — wall-clock + steps/s via ``PhaseTimer`` and the
-        chunk's last recorded history row. Emission is strictly BETWEEN
+        chunk's last recorded history row — plus a ``span`` event per chunk
+        (the trace hierarchy; the same name lands in captured XLA traces),
+        and a one-off cost-analyzed ``compile`` event for the chunk program
+        that arms achieved-FLOP/s gauges. Emission is strictly BETWEEN
         jitted chunks on already-fetched scalars (plus one small row fetch),
         never inside the scan; it does add one ``block_until_ready`` per
         chunk, which hooks like HeartbeatHook impose anyway.
@@ -349,6 +352,7 @@ class DIBTrainer:
                 f"recorded and {num_epochs} more were requested; grow it with "
                 f"history_extend(history, n) or train fewer epochs."
             )
+        from dib_tpu.telemetry import trace
         from dib_tpu.telemetry.hooks import FitRecorder
 
         recorder = FitRecorder(telemetry, steps_per_epoch=self.steps_per_epoch)
@@ -357,35 +361,49 @@ class DIBTrainer:
         # boundaries define the PRNG chain (one key split per chunk)
         chunk = hook_every if hook_every else num_epochs
         done = 0
-        while done < num_epochs:
-            this_chunk = min(chunk, num_epochs - done)
-            key, k_chunk = jax.random.split(key)
-            with recorder.chunk_phase() as ph:
-                state, history = self.run_chunk(
-                    state, history, k_chunk, this_chunk
-                )
-                ph.block_on(state.params)
-            done += this_chunk
-            # Published for CheckpointHook: resuming fit(resume_key, ...) with
-            # the same chunk size continues the exact key chain, so the
-            # continuation is bit-identical to an uninterrupted run.
-            self.resume_key = key
-            self.latest_history = history
-            self.resume_chunk = chunk
-            if telemetry is not None:
-                row = jax.device_get({
-                    name: history[name][cursor + done - 1]
-                    for name in ("beta", "loss", "val_loss", "kl_per_feature")
-                })
-                recorder.record_chunk(
-                    epoch=cursor + done, chunk_epochs=this_chunk,
-                    beta=float(row["beta"]),
-                    loss=float(row["loss"]),
-                    val_loss=float(row["val_loss"]),
-                    kl_per_feature=[float(x) for x in row["kl_per_feature"]],
-                )
-            for hook in hooks:
-                hook(self, state, int(state.epoch))
+        # The active tracer is bound for the whole fit so hook-level spans
+        # (SpannedHook, PerReplicaHook) parent into this run's hierarchy.
+        with trace.use_tracer(recorder.tracer):
+            while done < num_epochs:
+                this_chunk = min(chunk, num_epochs - done)
+                key, k_chunk = jax.random.split(key)
+                if telemetry is not None and done == 0:
+                    # one cost-analysis pass at the real call signature:
+                    # FLOPs/bytes of the chunk program land on a `compile`
+                    # event and arm the per-chunk utilization gauges
+                    recorder.record_compile(
+                        "run_chunk", type(self).run_chunk,
+                        self, state, history, k_chunk, this_chunk,
+                        epochs=this_chunk,
+                    )
+                with recorder.chunk_phase() as ph:
+                    state, history = self.run_chunk(
+                        state, history, k_chunk, this_chunk
+                    )
+                    ph.block_on(state.params)
+                done += this_chunk
+                # Published for CheckpointHook: resuming fit(resume_key, ...)
+                # with the same chunk size continues the exact key chain, so
+                # the continuation is bit-identical to an uninterrupted run.
+                self.resume_key = key
+                self.latest_history = history
+                self.resume_chunk = chunk
+                if telemetry is not None:
+                    row = jax.device_get({
+                        name: history[name][cursor + done - 1]
+                        for name in ("beta", "loss", "val_loss",
+                                     "kl_per_feature")
+                    })
+                    recorder.record_chunk(
+                        epoch=cursor + done, chunk_epochs=this_chunk,
+                        beta=float(row["beta"]),
+                        loss=float(row["loss"]),
+                        val_loss=float(row["val_loss"]),
+                        kl_per_feature=[float(x)
+                                        for x in row["kl_per_feature"]],
+                    )
+                for hook in hooks:
+                    hook(self, state, int(state.epoch))
         recorder.finish()
         return state, HistoryRecord.from_device(history)
 

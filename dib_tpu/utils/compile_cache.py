@@ -21,6 +21,19 @@ import os
 
 _DEFAULT_DIR = "~/.cache/jax_comp_cache_tpu"
 
+# Last enable_persistent_cache() result for this process — the default
+# ``cache`` tag on compile events (telemetry/xla_stats.py) and the basis of
+# the per-run hit/miss counters (telemetry/hooks.FitRecorder), so recompile
+# storms are visible in `telemetry summarize` without re-plumbing the status
+# through every entry point. "off" until the cache is enabled.
+_STATUS = "off"
+
+
+def current_status() -> str:
+    """Persistent-cache status of this process: "warm" (directory held
+    entries when enabled), "cold-populating", or "off"."""
+    return _STATUS
+
 
 def enable_persistent_cache(path: str | None = None) -> str:
     """Point JAX at a persistent compilation cache.
@@ -31,9 +44,11 @@ def enable_persistent_cache(path: str | None = None) -> str:
     before the first jitted computation executes; calling it later leaves
     already-compiled programs uncached but is harmless.
     """
+    global _STATUS
     if path is None:
         path = os.environ.get("DIB_COMPILE_CACHE", _DEFAULT_DIR)
     if not path:
+        _STATUS = "off"
         return "off"
     path = os.path.expanduser(path)
     import jax
@@ -44,4 +59,5 @@ def enable_persistent_cache(path: str | None = None) -> str:
     # small programs, which is exactly the long tail the 1-core host feels.
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-    return "warm" if had_entries else "cold-populating"
+    _STATUS = "warm" if had_entries else "cold-populating"
+    return _STATUS

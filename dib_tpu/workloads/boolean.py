@@ -207,9 +207,11 @@ class BooleanTrainer:
         ``telemetry`` (an ``EventWriter``) emits one ``chunk`` event per
         measurement chunk — ``PhaseTimer``-measured wall-clock/steps/s plus
         the chunk's final task loss, beta, and per-channel KL, all read off
-        the ``stats`` arrays this loop fetches anyway — and one
-        ``mi_bounds`` event per checkpoint. Nothing is added inside the
-        jitted scan.
+        the ``stats`` arrays this loop fetches anyway — one ``mi_bounds``
+        event per checkpoint, ``span`` events for the chunk and the MI
+        measurement (blocked wall-clock, mirrored into captured XLA traces),
+        and a one-off cost-analyzed ``compile`` event for each compiled
+        program. Nothing is added inside the jitted scan.
         """
         cfg = self.config
         if state is None:
@@ -222,15 +224,30 @@ class BooleanTrainer:
         recorder = FitRecorder(telemetry, steps_per_epoch=1)
         series = {"task": [], "kl": [], "beta": []}
         checks = {"step": [], "beta": [], "lower_bits": [], "upper_bits": []}
+        first = True
         while int(state.step) < cfg.num_steps:
             chunk = min(cfg.mi_cadence, cfg.num_steps - int(state.step))
             key, k_chunk, k_mi = jax.random.split(key, 3)
+            if telemetry is not None and first:
+                # FLOPs/bytes of both compiled programs (the O(n^2) MI
+                # kernel is the one the roofline section is after)
+                recorder.record_compile(
+                    "run_chunk", type(self).run_chunk,
+                    self, state, k_chunk, chunk, epochs=chunk,
+                )
+                recorder.record_compile(
+                    "channel_mi_bounds", type(self).channel_mi_bounds,
+                    self, state, k_mi,
+                )
+                first = False
             with recorder.chunk_phase() as ph:
                 state, stats = self.run_chunk(state, k_chunk, chunk)
                 ph.block_on(state.params)
             for name in series:
                 series[name].append(np.asarray(stats[name]))
-            lower, upper = self.channel_mi_bounds(state, k_mi)
+            with recorder.span("mi_bounds") as sp:
+                lower, upper = self.channel_mi_bounds(state, k_mi)
+                sp.block_on((lower, upper))
             checks["step"].append(int(state.step))
             checks["beta"].append(float(stats["beta"][-1]))
             checks["lower_bits"].append(np.asarray(lower) / LN2)

@@ -1,0 +1,101 @@
+"""Static check: no bare wall-clock deltas around jitted work in the package.
+
+JAX dispatch is asynchronous — ``t0 = time.time(); f(x); dt = time.time()
+- t0`` around a jitted call measures only the DISPATCH, not the compute,
+and the resulting phantom speedup has burned real measurement rounds
+elsewhere (docs/observability.md, "async-dispatch pitfall"). The package's
+honest-timing primitives are:
+
+  - ``dib_tpu.utils.profiling.PhaseTimer`` / ``timed_blocked`` (block on
+    registered outputs before closing the interval);
+  - ``dib_tpu.telemetry.trace.span`` (same semantics, plus the event
+    stream and XLA ``TraceAnnotation``).
+
+This check greps ``dib_tpu/`` for ``time.time()`` / ``time.perf_counter()``
+calls outside the implementations of those primitives (and other
+allowlisted host-only modules) and fails with a pointer to the pitfall.
+A reviewed exception can carry a ``# timing-ok: <reason>`` pragma on the
+same line.
+
+Runnable three ways::
+
+    python scripts/check_timing_hygiene.py      # standalone, rc 1 on bad
+    python -m pytest scripts/check_timing_hygiene.py
+    python -m pytest tests/test_profiling.py    # imports scan_package()
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.join(REPO, "dib_tpu")
+
+# Module-level exemptions, each with the reason it is allowed to read a
+# wall clock directly. Everything else in the package must time through
+# PhaseTimer / trace.span (or carry a per-line `# timing-ok:` pragma).
+ALLOWLIST: dict[str, str] = {
+    "utils/profiling.py": "the blocking-timer implementation itself",
+    "telemetry/trace.py": "the span implementation itself",
+    "telemetry/events.py": "event-envelope timestamps, not intervals",
+    "telemetry/xla_stats.py": "times host-side lower/compile, no dispatch",
+    "telemetry/hooks.py": "PhaseTimer feeder: hook-boundary adds after "
+                          "an explicit block_until_ready",
+    "train/hooks.py": "TimedHook measures host hooks, which fetch their "
+                      "device results internally",
+    "train/watchdog.py": "supervisor process: times subprocess beats, "
+                         "never dispatches jitted work",
+}
+
+_PATTERN = re.compile(r"\btime\.(?:time|perf_counter)\(\)")
+_PRAGMA = "timing-ok"
+
+POINTER = (
+    "bare wall-clock delta in package code: JAX dispatch is async, so "
+    "time.time()/perf_counter() around a jitted call measures only the "
+    "dispatch — use utils.profiling.PhaseTimer/timed_blocked or "
+    "telemetry.trace.span (they block on registered outputs), or justify "
+    "with a `# timing-ok: <reason>` pragma (docs/observability.md)"
+)
+
+
+def scan_package(package_dir: str = PACKAGE) -> list[str]:
+    """``["relpath:lineno: <line>"]`` for every unjustified wall-clock call."""
+    violations: list[str] = []
+    for dirpath, dirnames, filenames in os.walk(package_dir):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, package_dir).replace(os.sep, "/")
+            if rel in ALLOWLIST:
+                continue
+            with open(path, encoding="utf-8") as f:
+                for lineno, line in enumerate(f, 1):
+                    if _PATTERN.search(line) and _PRAGMA not in line:
+                        violations.append(f"{rel}:{lineno}: {line.strip()}")
+    return violations
+
+
+# ---------------------------------------------------------------- pytest
+def test_no_bare_wallclock_timing_in_package():
+    violations = scan_package()
+    assert not violations, POINTER + "\n" + "\n".join(violations)
+
+
+def main() -> int:
+    violations = scan_package()
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"\n{len(violations)} violation(s). {POINTER}")
+        return 1
+    print("timing hygiene: ok (no bare wall-clock deltas in dib_tpu/)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

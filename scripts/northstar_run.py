@@ -188,9 +188,12 @@ def main() -> int:
     # ``mitigation`` events to the SAME file (O_APPEND, no interleaving).
     from dib_tpu.telemetry import (
         ChunkPhaseHooks,
+        SpannedHook,
+        Tracer,
         open_writer,
         runtime_manifest,
         shared_run_id,
+        use_tracer,
     )
 
     # always on: '' (the flag default) falls through to the run's outdir;
@@ -225,14 +228,21 @@ def main() -> int:
     # measurement/pull work of the checkpoint. The sweep's chunk events
     # count every replica's steps (the bench.py steps/s convention).
     # a resumed run's restore epoch is unknown until the sweep returns, so
-    # its first chunk's step count is unattributable — timed but not emitted
+    # its first chunk's step count is unattributable — timed but not emitted.
+    # The tracer mirrors each chunk/instrumentation interval as a `span`
+    # event and parents the per-hook spans below, so the checkpoint cycle
+    # shows up whole in `telemetry report`'s flame breakdown.
+    tracer = Tracer(telemetry)
     phases = ChunkPhaseHooks(
-        telemetry=telemetry,
+        telemetry=telemetry, tracer=tracer,
         steps_per_epoch=args.steps_per_epoch * num_replicas,
         baseline_known=not resuming,
     )
 
-    hooks = [phases.pre, comp, info, phases.post]
+    hooks = [phases.pre,
+             SpannedHook("compression_pull", comp),
+             SpannedHook("mi_bounds", info),
+             phases.post]
     if args.heartbeat:
         from dib_tpu.train.watchdog import HeartbeatHook
 
@@ -242,18 +252,19 @@ def main() -> int:
 
     t0 = time.time()
     phases.start()
-    result = run_amorphous_sweep(
-        key=args.seed,
-        config=config,
-        num_repeats=num_repeats,
-        beta_ends=beta_ends,
-        outdir=args.outdir,
-        steps_per_epoch=args.steps_per_epoch,
-        chunk_epochs=args.chunk_epochs,
-        hooks=hooks,
-        model_overrides={"compute_dtype": "bfloat16"},
-        checkpoint_dir=args.checkpoint_dir or None,
-    )
+    with use_tracer(tracer):
+        result = run_amorphous_sweep(
+            key=args.seed,
+            config=config,
+            num_repeats=num_repeats,
+            beta_ends=beta_ends,
+            outdir=args.outdir,
+            steps_per_epoch=args.steps_per_epoch,
+            chunk_epochs=args.chunk_epochs,
+            hooks=hooks,
+            model_overrides={"compute_dtype": "bfloat16"},
+            checkpoint_dir=args.checkpoint_dir or None,
+        )
     # Everything that constitutes the MEASURED run is done: init, compile,
     # 25k steps x R, per-checkpoint device measurements + host pulls, final
     # history fetch, info-plane PNGs (run_amorphous_sweep renders those

@@ -1,17 +1,26 @@
-"""Profile the steady-state north-star sweep step (VERDICT round 2, item 2).
+"""Profile the steady-state north-star sweep step — now a thin wrapper.
 
-Captures a ``jax.profiler`` device trace of a few measured chunks of the
-benchmark configuration (the exact program ``bench.py`` times) and prints a
-wall-clock + throughput + roofline summary so the MFU gap to peak can be
-ATTRIBUTED, not assumed. The trace directory can be inspected with
-TensorBoard / xprof offline; the printed summary is self-contained for
-``docs/performance.md``.
+The timing/roofline arithmetic this script used to carry lives in the
+telemetry layer (``dib_tpu/telemetry/trace.py`` + ``xla_stats.py``); what
+remains here is orchestration:
+
+  - build the exact benchmark configuration (``bench.py``'s program);
+  - run warm + measured chunks inside named spans — the SAME names appear
+    in the captured ``jax.profiler`` device trace via ``TraceAnnotation``,
+    so the host spans and the device timeline join by name;
+  - cost-analyze the compiled chunk program
+    (``lower().compile().cost_analysis()``) onto a ``compile`` event;
+  - append everything to ``<outdir>/events.jsonl`` so
+    ``python -m dib_tpu telemetry report <outdir>`` renders the profile
+    run (span breakdown + roofline utilization), and print the rolled-up
+    summary JSON.
 
 Run on the TPU (ambient env, ALONE):
 
     python scripts/profile_sweep.py [--outdir /tmp/sweep_trace]
 
 Environment: DIB_ATTN_SCORE_DTYPE=bfloat16 profiles the bf16-scores variant.
+Per-shape matmul ceilings live in ``scripts/roofline.py``.
 """
 
 from __future__ import annotations
@@ -20,7 +29,6 @@ import argparse
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -43,7 +51,10 @@ def main() -> int:
     from dib_tpu.data import get_dataset
     from dib_tpu.models import PerParticleDIBModel
     from dib_tpu.parallel import BetaSweepTrainer
+    from dib_tpu.telemetry import EventWriter, Tracer, runtime_manifest
+    from dib_tpu.telemetry import xla_stats
     from dib_tpu.train import TrainConfig
+    from dib_tpu.utils.profiling import device_trace
 
     devices = jax.devices()
     print(f"devices: {devices}", file=sys.stderr)
@@ -62,33 +73,47 @@ def main() -> int:
     beta_ends = np.logspace(-2, 0, args.replicas)
     sweep = BetaSweepTrainer(model, bundle, config, 2e-6, beta_ends)
 
-    init_keys = jax.random.split(jax.random.key(0), args.replicas)
-    states, histories = sweep.init(init_keys)
-    # compile + warm
-    t0 = time.time()
-    states, histories = sweep.run_chunk(
-        states, histories, jax.random.split(jax.random.key(1), args.replicas),
-        args.epochs,
-    )
-    jax.block_until_ready(states.params)
-    compile_s = time.time() - t0
+    telemetry = EventWriter(args.outdir)
+    tracer = Tracer(telemetry)
+    telemetry.run_start(runtime_manifest(
+        config=config,
+        extra={"profile": "northstar_sweep_chunk",
+               "replicas": args.replicas},
+    ))
 
-    def timed_chunk(seed):
+    init_keys = jax.random.split(jax.random.key(0), args.replicas)
+    with tracer.span("init") as ph:
+        states, histories = sweep.init(init_keys)
+        ph.block_on(states.params)
+
+    # FLOPs/bytes of the chunk program, recorded before it first executes
+    warm_keys = jax.random.split(jax.random.key(1), args.replicas)
+    cost = xla_stats.record_compile_event(
+        telemetry, "sweep_chunk", type(sweep).run_chunk,
+        (sweep, states, histories, warm_keys, args.epochs),
+    )
+    with tracer.span("compile_and_warm") as ph:
+        states, histories = sweep.run_chunk(
+            states, histories, warm_keys, args.epochs)
+        ph.block_on(states.params)
+
+    def timed_chunk(seed, name):
         keys = jax.random.split(jax.random.key(seed), args.replicas)
         nonlocal states, histories
-        t = time.time()
-        states, histories = sweep.run_chunk(states, histories, keys, args.epochs)
-        jax.block_until_ready(states.params)
-        return time.time() - t
+        with tracer.span(name) as ph:
+            states, histories = sweep.run_chunk(
+                states, histories, keys, args.epochs)
+            ph.block_on(states.params)
+        return tracer.timer.intervals[name][-1]
 
     # steady-state timing, then one traced repetition of the same chunk
-    plain_s = [timed_chunk(2), timed_chunk(3)]
+    plain_s = [timed_chunk(2, "sweep_chunk"), timed_chunk(3, "sweep_chunk")]
     traced_s = None
     trace_error = None
     if args.trace:
         try:
-            with jax.profiler.trace(args.outdir):
-                traced_s = timed_chunk(4)
+            with device_trace(args.outdir):
+                traced_s = timed_chunk(4, "sweep_chunk_traced")
         except Exception as e:   # axon/tunnel backends may lack profiler RPCs
             trace_error = f"{type(e).__name__}: {e}"
 
@@ -96,29 +121,23 @@ def main() -> int:
     best_s = min(plain_s)
     steps_per_s = sweep_steps / best_s
     model_flops = bench.analytic_model_flops_per_step(model, config.batch_size)
-    peak = bench.peak_tflops_for(devices[0].device_kind)  # None if unknown
-    achieved = model_flops * steps_per_s / 1e12
-
-    # Roofline attribution inputs: bytes moved per step (params + opt state
-    # + activations are the candidates; params dominate at batch 32).
-    n_params = sum(
-        int(np.prod(p.shape)) for p in jax.tree.leaves(states.params)
-    ) // args.replicas
-    # Steady state per replica step reads params, writes grads+opt updates:
-    # >= 3 accesses x 4 bytes (f32 master params).
-    param_bytes_per_step = 3 * 4 * n_params
-    # Public per-chip HBM bandwidth (GB/s); ORDER matters (v5p before v5).
-    hbm_peaks = (("v6", 1640.0), ("v5p", 2765.0), ("v5", 819.0),
-                 ("v4", 1228.0), ("v3", 900.0), ("v2", 700.0))
-    kind = devices[0].device_kind.lower()
-    hbm_gbps = next((gbps for key, gbps in hbm_peaks if key in kind), None)
+    peaks = xla_stats.backend_peaks(devices[0].device_kind)
+    analytic = xla_stats.achieved(
+        best_s, flops=model_flops * sweep_steps, peaks=peaks)
+    whole_program = xla_stats.achieved(
+        best_s,
+        flops=(cost or {}).get("flops"),
+        bytes_accessed=(cost or {}).get("bytes_accessed"),
+        peaks=peaks,
+    )
 
     summary = {
         "device_kind": devices[0].device_kind,
         "score_dtype": __import__(
             "dib_tpu.parallel.context", fromlist=["_dense_score_dtype"]
         )._dense_score_dtype().__name__,
-        "compile_s": round(compile_s, 1),
+        "compile_and_warm_s": round(
+            tracer.timer.totals["compile_and_warm"], 1),
         "chunk_s": [round(s, 3) for s in plain_s],
         "traced_chunk_s": round(traced_s, 3) if traced_s else None,
         "trace_outdir": args.outdir if traced_s else None,
@@ -126,25 +145,35 @@ def main() -> int:
         "sweep_steps_per_chunk": sweep_steps,
         "steps_per_s": round(steps_per_s, 1),
         "model_flops_per_step": model_flops,
-        "achieved_tflops": round(achieved, 2),
-        "peak_tflops": peak,                # None on unlisted device kinds —
-        "mfu": (round(achieved / peak, 4)   # NaN would break strict JSON
-                if peak else None),
-        "params_per_replica": n_params,
-        "param_traffic_gb_per_s": round(
-            param_bytes_per_step * steps_per_s / 1e9, 2
-        ),
-        "hbm_peak_gb_per_s": hbm_gbps,
-        "matmul_shapes_note": (
-            "per replica step the largest matmuls are [1600, 32] x [32, 1536]"
-            " (QKV) and [12*32, 50, 50] x [50, 128] (attention) — M/N/K far"
-            " below the 128x128 MXU tile in the contracted dims, so the"
-            " systolic array is mostly idle by construction at batch 32"
-        ),
+        # conventional MFU inputs (analytic model matmul FLOPs)
+        "achieved_tflops": round(
+            analytic.get("achieved_gflops", 0.0) / 1e3, 2),
+        "peak_tflops": (peaks or {}).get("bf16_tflops"),
+        "mfu": (round(analytic["flops_frac_of_peak"], 4)
+                if "flops_frac_of_peak" in analytic else None),
+        # whole-program XLA cost_analysis view (see docs/performance.md for
+        # why this is reported separately, never as the headline MFU)
+        "xla_cost_analysis": cost,
+        "xla_achieved": {k: round(v, 4) for k, v in whole_program.items()},
+        "hbm_peak_gb_per_s": (peaks or {}).get("hbm_gbps"),
+        "events_path": telemetry.path,
+        "note": ("roofline per-shape ceilings: scripts/roofline.py; render "
+                 "this run: python -m dib_tpu telemetry report "
+                 + args.outdir),
     }
+    telemetry.run_end(status="ok", steps_per_s=round(steps_per_s, 1))
+    telemetry.close()
     print(json.dumps(summary, indent=1))
     return 0
 
 
 if __name__ == "__main__":
-    raise SystemExit(main())
+    try:
+        raise SystemExit(main())
+    except SystemExit:
+        raise
+    except BaseException as exc:
+        from dib_tpu.telemetry import finalize_crashed
+
+        finalize_crashed(exc, log=lambda msg: print(msg, file=sys.stderr))
+        raise

@@ -165,10 +165,15 @@ def main() -> int:
         pass
     measured = cached.get("steps_per_s") if cached else None
 
+    # shared per-backend capability table (telemetry/xla_stats.py): per-shape
+    # achieved TFLOP/s are readable against the chip's bf16 peak in-place
+    from dib_tpu.telemetry.xla_stats import backend_peaks
+
     report = {
         "metric": "northstar_shape_matmul_ceiling",
         "value": round(ceiling_replica_steps_per_s, 1),
         "unit": "sweep steps/s (matmuls alone, measured per-shape ceilings)",
+        "backend_peaks": backend_peaks(device_kind),
         "measured_end_to_end_steps_per_s": measured,
         "fraction_of_shape_ceiling": round(measured / ceiling_replica_steps_per_s, 3)
         if measured else None,

@@ -712,25 +712,35 @@ def test_boolean_workload_telemetry_overhead_under_2pct(tmp_path):
         trainer.fit(jax.random.key(1), telemetry=w)
     chunks = list(read_events(str(tmp_path / "run"), types=("chunk",)))
     mi = list(read_events(str(tmp_path / "run"), types=("mi_bounds",)))
+    spans = list(read_events(str(tmp_path / "run"), types=("span",)))
     assert len(chunks) == 3
     assert all(c["steps_per_s"] > 0 for c in chunks)
     # min: host contention noise is strictly one-sided (only ever slows)
     chunk_s = min(c["seconds"] for c in chunks)
 
-    # Per-chunk emission cost on the run's OWN payload: one chunk event +
-    # one mi_bounds event per boundary, through a real EventWriter.
+    # Per-chunk emission cost on the run's OWN payload: one chunk event,
+    # one mi_bounds event, and the two span events (chunk + mi_bounds —
+    # the spans-enabled bound of the acceptance criteria) per boundary,
+    # through a real EventWriter.
     reps = 200
+    from dib_tpu.telemetry.events import host_memory_stats
+
     with EventWriter(str(tmp_path / "cost")) as w:
         t0 = time.perf_counter()
-        for _ in range(reps):
+        for i in range(reps):
             w.chunk(epoch=chunks[0]["epoch"], steps=chunks[0]["steps"],
                     seconds=chunks[0]["seconds"], beta=chunks[0]["beta"],
                     loss=chunks[0]["loss"],
                     kl_per_feature=chunks[0]["kl_per_feature"],
-                    memory=device_memory_stats())
+                    memory=device_memory_stats(),
+                    host_memory=host_memory_stats())
             w.mi_bounds(epoch=mi[0]["epoch"],
                         lower_bits=mi[0]["lower_bits"],
                         upper_bits=mi[0]["upper_bits"])
+            for template in spans[:2]:
+                w.span(name=template["name"], path=template["path"],
+                       span_id=2 * i, parent_id=None,
+                       seconds=template["seconds"])
         emit_s = (time.perf_counter() - t0) / reps
 
     ratio = chunk_s / (chunk_s + emit_s)

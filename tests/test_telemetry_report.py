@@ -1,0 +1,203 @@
+"""`telemetry report`: self-contained HTML from an events.jsonl.
+
+Covers HTML well-formedness (a strict tag-balance parse), the required
+sections (span breakdown, training trajectory, MI-bound sandwich, memory,
+roofline utilization), cost-model-absent degradation, CLI exit codes, and
+the committed fixture run (``tests/fixtures/telemetry_run``) staying
+renderable forever.
+"""
+
+import os
+from html.parser import HTMLParser
+
+import pytest
+
+from dib_tpu.telemetry import EventWriter, Tracer, telemetry_main
+from dib_tpu.telemetry.report import render_report, write_report
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE_RUN = os.path.join(REPO, "tests", "fixtures", "telemetry_run")
+
+
+class _BalanceParser(HTMLParser):
+    """Fails on mismatched/unclosed tags — 'valid HTML' for a generator."""
+
+    VOID = {"meta", "br", "hr", "img", "link", "input", "circle", "line",
+            "polyline", "polygon", "path", "rect"}
+
+    def __init__(self):
+        super().__init__(convert_charrefs=True)
+        self.stack: list[str] = []
+        self.errors: list[str] = []
+
+    def handle_starttag(self, tag, attrs):
+        if tag not in self.VOID:
+            self.stack.append(tag)
+
+    def handle_endtag(self, tag):
+        if tag in self.VOID:
+            return
+        if not self.stack or self.stack[-1] != tag:
+            self.errors.append(f"mismatched </{tag}> (open: {self.stack[-3:]})")
+        else:
+            self.stack.pop()
+
+
+def assert_valid_html(text: str) -> None:
+    parser = _BalanceParser()
+    parser.feed(text)
+    parser.close()
+    assert not parser.errors, parser.errors[:5]
+    assert not parser.stack, f"unclosed tags: {parser.stack}"
+    assert text.startswith("<!DOCTYPE html>")
+
+
+def write_traced_run(directory, *, with_cost=True):
+    """A run with spans, chunks, MI bounds, memory, and (optionally) a
+    cost-analyzed compile event."""
+    with EventWriter(directory, run_id="traced") as w:
+        w.run_start({"git_sha": "a" * 40, "device_kind": "TPU v5 lite",
+                     "device_count": 1, "config_hash": "cafe"})
+        if with_cost:
+            w.compile(name="run_chunk", seconds=1.0, cache="warm",
+                      flops=1e12, bytes_accessed=1e10)
+        else:
+            w.compile(name="run_chunk", seconds=1.0, cache="warm")
+        tracer = Tracer(w)
+        for i in range(3):
+            tracer.add("chunk", 1.0 + 0.1 * i, epoch=(i + 1) * 10)
+            tracer.add("mi_bounds", 0.2, epoch=(i + 1) * 10)
+            w.chunk(epoch=(i + 1) * 10, steps=100, seconds=1.0 + 0.1 * i,
+                    loss=1.0 - 0.1 * i, val_loss=1.1 - 0.1 * i,
+                    kl_per_feature=[0.5, 0.25],
+                    memory={"peak_bytes_in_use": (2 + i) * 2**30},
+                    host_memory={"rss_bytes": 2**30,
+                                 "peak_rss_bytes": (1 + i) * 2**30})
+            w.mi_bounds(epoch=(i + 1) * 10,
+                        lower_bits=[0.4 + 0.1 * i], upper_bits=[0.6 + 0.1 * i])
+        w.run_end(status="ok")
+    return directory
+
+
+def test_report_valid_html_with_all_sections(tmp_path):
+    run = write_traced_run(str(tmp_path))
+    html = render_report(run)
+    assert_valid_html(html)
+    for section in ("Span breakdown", "Training trajectory",
+                    "MI-bound trajectory", "Memory", "Roofline utilization"):
+        assert section in html
+    # span bars, the sandwich band, utilization numbers, memory tiles
+    assert "span-bar" in html
+    assert "polygon" in html                  # MI band fill
+    assert "run_chunk" in html
+    assert "% FLOP peak" in html
+    assert "GiB" in html
+    # self-contained: no external fetches of any kind
+    assert "http://" not in html and "https://" not in html
+    assert "<script" not in html
+
+
+def test_report_renders_orphan_slash_paths(tmp_path):
+    """Spans recorded with slash names and no enclosing spans (the
+    documented span('sweep/replica3/mi_bounds') form) must appear in the
+    breakdown, rooted at their nearest present ancestor."""
+    with EventWriter(str(tmp_path), run_id="orphan") as w:
+        w.run_start({"device_kind": "cpu", "config_hash": "x"})
+        tracer = Tracer(w)
+        with tracer.span("sweep/replica1/mi_bounds"):
+            pass
+        with tracer.span("sweep/replica2/mi_bounds"):
+            pass
+        w.run_end(status="ok")
+    html = render_report(str(tmp_path))
+    assert_valid_html(html)
+    assert "sweep/replica*/mi_bounds" in html
+    assert "span-bar" in html
+
+
+def test_report_degrades_without_cost_analysis(tmp_path):
+    """cost_analysis()-absent backends produce duration-only spans; the
+    utilization section must say so instead of crashing or vanishing."""
+    run = write_traced_run(str(tmp_path), with_cost=False)
+    html = render_report(run)
+    assert_valid_html(html)
+    assert "Span breakdown" in html and "span-bar" in html
+    assert "No XLA cost-analysis numbers" in html
+
+
+def test_report_empty_ish_stream_still_renders(tmp_path):
+    """A minimal stream (no spans, no MI, no memory) renders with the
+    explanatory notes, not an exception."""
+    with EventWriter(str(tmp_path), run_id="min") as w:
+        w.run_start({"device_kind": "cpu", "config_hash": "x"})
+        w.chunk(epoch=1, steps=10, seconds=1.0)
+        w.run_end(status="ok")
+    html = render_report(str(tmp_path))
+    assert_valid_html(html)
+    assert "No span events" in html
+    assert "No mi_bounds events" in html
+
+
+def test_write_report_default_path_and_cli(tmp_path, capsys):
+    run = write_traced_run(str(tmp_path))
+    out = write_report(run)
+    assert out == os.path.join(run, "report.html")
+    assert os.path.getsize(out) > 1000
+
+    rc = telemetry_main(["report", run, "--out",
+                         str(tmp_path / "custom.html")])
+    assert rc == 0
+    assert capsys.readouterr().out.strip() == str(tmp_path / "custom.html")
+    assert os.path.exists(tmp_path / "custom.html")
+
+    # bad operand: exit 2 (distinct from a regression verdict's 1)
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    assert telemetry_main(["report", str(empty)]) == 2
+    assert "telemetry report" in capsys.readouterr().err
+
+
+def test_committed_fixture_run_renders(tmp_path):
+    """The committed fixture stream (with its torn span line) must stay
+    summarizable and renderable — the report contract's regression anchor."""
+    from dib_tpu.telemetry import summarize
+
+    with pytest.warns(UserWarning, match="torn event line"):
+        s = summarize(FIXTURE_RUN)
+    assert s["spans"]["checkpoint/replica*"]["count"] == 3
+    assert s["utilization"]["run_chunk"]["flops_frac_of_peak"] > 0
+    assert s["compile"]["cache_hits"] == 1
+    assert s["compile"]["cache_misses"] == 1
+    assert s["memory"] == {"device_peak_bytes": 6 * 2**30,
+                           "host_peak_rss_bytes": 4 * 2**30}
+
+    with pytest.warns(UserWarning, match="torn event line"):
+        out = write_report(FIXTURE_RUN, out=str(tmp_path / "fixture.html"))
+    html = open(out).read()
+    assert_valid_html(html)
+    assert "replica*" in html             # per-replica spans rolled up
+    assert "mi_bounds" in html
+    assert "197" in html                  # v5e bf16 peak from the table
+
+
+def test_run_report_acceptance_cpu(tmp_path):
+    """The acceptance criterion end-to-end on a FRESH CPU run: workload ->
+    events.jsonl -> `telemetry report` emits self-contained HTML with span
+    breakdown, MI-bound trajectory, and a utilization section."""
+    from dib_tpu.cli import workload_main
+
+    run_dir = str(tmp_path / "fresh")
+    rc = workload_main([
+        "boolean", "--telemetry-dir", run_dir,
+        "--set", "num_steps=40", "--set", "mi_every=20",
+        "--set", "integration_hidden=(32,)", "--set", "batch_size=64",
+    ])
+    assert rc == 0
+    assert telemetry_main(["report", run_dir]) == 0
+    html = open(os.path.join(run_dir, "report.html")).read()
+    assert_valid_html(html)
+    assert "Span breakdown" in html and "span-bar" in html
+    assert "MI-bound trajectory" in html and "polygon" in html
+    assert "Roofline utilization" in html
+    # CPU has a cost model, so the fresh run carries real numbers
+    assert "channel_mi_bounds" in html

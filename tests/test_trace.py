@@ -1,0 +1,506 @@
+"""Span tracer + XLA cost analysis: nesting, parentage, thread safety,
+durability under killed writers, cost-model-absent degradation, and the
+fit-loop wiring (train/loop.py, workloads/boolean.py emit spans + a
+cost-analyzed compile event).
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from dib_tpu.telemetry import (
+    EventWriter,
+    Tracer,
+    read_events,
+    span_hotspots,
+    span_rollup,
+    summarize,
+    use_tracer,
+)
+from dib_tpu.telemetry import trace as trace_mod
+from dib_tpu.telemetry import xla_stats
+from dib_tpu.telemetry.hooks import FitRecorder
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ===================================================================== spans
+def test_span_nesting_and_parentage(tmp_path):
+    with EventWriter(str(tmp_path), run_id="r") as w:
+        tracer = Tracer(w)
+        with tracer.span("sweep") as outer:
+            with tracer.span("chunk", epoch=100):
+                pass
+            with tracer.span("mi_bounds"):
+                pass
+            outer.annotate(replicas=8)
+    spans = list(read_events(str(tmp_path), types=("span",)))
+    # children close (and emit) before their parent
+    assert [e["name"] for e in spans] == ["chunk", "mi_bounds", "sweep"]
+    by_name = {e["name"]: e for e in spans}
+    assert by_name["sweep"]["parent"] is None
+    assert by_name["chunk"]["parent"] == by_name["sweep"]["span"]
+    assert by_name["mi_bounds"]["parent"] == by_name["sweep"]["span"]
+    assert by_name["chunk"]["path"] == "sweep/chunk"
+    assert by_name["chunk"]["epoch"] == 100
+    assert by_name["sweep"]["replicas"] == 8     # late annotate()
+    ids = [e["span"] for e in spans]
+    assert len(set(ids)) == 3
+    assert all(e["seconds"] >= 0 for e in spans)
+    # the timer accumulated under the full path
+    assert "sweep/chunk" in tracer.timer.intervals
+
+
+def test_span_slash_names_extend_path(tmp_path):
+    """The issue's spelling — span("sweep/replica3/chunk12/mi_bounds") —
+    works with or without enclosing spans."""
+    with EventWriter(str(tmp_path), run_id="r") as w:
+        tracer = Tracer(w)
+        with tracer.span("sweep/replica3/chunk12/mi_bounds"):
+            pass
+    (e,) = read_events(str(tmp_path), types=("span",))
+    assert e["path"] == "sweep/replica3/chunk12/mi_bounds"
+
+
+def test_span_block_on_registers_outputs(tmp_path):
+    import jax.numpy as jnp
+
+    with EventWriter(str(tmp_path), run_id="r") as w:
+        tracer = Tracer(w)
+        with tracer.span("compute") as handle:
+            out = handle.block_on(jnp.ones((4, 4)) @ jnp.ones((4, 4)))
+    assert np.asarray(out).shape == (4, 4)
+    (e,) = read_events(str(tmp_path), types=("span",))
+    assert e["seconds"] > 0
+
+
+def test_spans_are_thread_safe(tmp_path):
+    """Two threads build independent, correctly-parented subtrees with
+    globally unique ids on one tracer."""
+    with EventWriter(str(tmp_path), run_id="r") as w:
+        tracer = Tracer(w)
+        barrier = threading.Barrier(2)
+
+        def work(name):
+            barrier.wait()
+            for _ in range(20):
+                with tracer.span(name):
+                    with tracer.span("inner"):
+                        pass
+
+        threads = [threading.Thread(target=work, args=(f"t{i}",))
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    spans = list(read_events(str(tmp_path), types=("span",)))
+    assert len(spans) == 80
+    assert len({e["span"] for e in spans}) == 80      # globally unique ids
+    by_id = {e["span"]: e for e in spans}
+    for e in spans:
+        if e["name"] == "inner":
+            parent = by_id[e["parent"]]
+            # an inner span is parented to ITS thread's outer span
+            assert e["path"] == parent["path"] + "/inner"
+
+
+def test_span_stack_survives_block_failure(tmp_path):
+    """A device error surfacing at block time (async dispatch defers it)
+    must still pop and record the span — later spans in the thread must
+    not inherit a dead parent or a bogus path prefix."""
+    class Exploding:
+        def block_until_ready(self):   # what a failed async chunk does
+            raise RuntimeError("device OOM surfaced at block time")
+
+    with EventWriter(str(tmp_path), run_id="r") as w:
+        tracer = Tracer(w)
+        with pytest.raises(RuntimeError, match="device OOM"):
+            with tracer.span("doomed") as h:
+                h.block_on(Exploding())
+        with tracer.span("after"):
+            pass
+    spans = list(read_events(str(tmp_path), types=("span",)))
+    assert [e["name"] for e in spans] == ["doomed", "after"]
+    assert spans[1]["parent"] is None
+    assert spans[1]["path"] == "after"       # no 'doomed/' prefix
+
+
+def test_begin_end_open_span_parents_between(tmp_path):
+    """The hook-pair span API: spans opened between begin() and end()
+    nest under it (the northstar instrumentation-phase attribution)."""
+    with EventWriter(str(tmp_path), run_id="r") as w:
+        tracer = Tracer(w)
+        token = tracer.begin("instrumentation", epoch=25)
+        with tracer.span("mi_bounds"):
+            pass
+        tracer.end(token)
+    spans = {e["name"]: e for e in read_events(str(tmp_path),
+                                               types=("span",))}
+    assert spans["mi_bounds"]["path"] == "instrumentation/mi_bounds"
+    assert spans["mi_bounds"]["parent"] == spans["instrumentation"]["span"]
+    assert spans["instrumentation"]["epoch"] == 25
+
+
+def test_chunk_phase_hooks_nest_hook_spans(tmp_path):
+    """End-to-end northstar shape: SpannedHook work between pre and post
+    parents under 'instrumentation' — no sibling double-count."""
+    from dib_tpu.telemetry import ChunkPhaseHooks, SpannedHook
+
+    with EventWriter(str(tmp_path), run_id="ns") as w:
+        tracer = Tracer(w)
+        phases = ChunkPhaseHooks(telemetry=w, tracer=tracer,
+                                 steps_per_epoch=50)
+        hook = SpannedHook("mi_bounds", lambda t, s, e: None)
+        phases.start()
+        states = np.zeros(2)
+        with use_tracer(tracer):
+            phases.pre(None, states, 25)
+            hook(None, states, 25)
+            phases.post(None, states, 25)
+    spans = {e["name"]: e for e in read_events(str(tmp_path),
+                                               types=("span",))}
+    assert spans["mi_bounds"]["path"] == "instrumentation/mi_bounds"
+    assert spans["mi_bounds"]["parent"] == spans["instrumentation"]["span"]
+    assert spans["chunk"]["parent"] is None
+    # the instrumentation interval covers its nested hook
+    assert spans["instrumentation"]["seconds"] >= spans["mi_bounds"]["seconds"]
+
+
+def test_span_hotspots_nearest_ancestor_children():
+    """Slash-named spans may skip levels: a grandchild with no recorded
+    intermediate still reduces its nearest present ancestor's self time."""
+    rollup = {
+        "a": {"total_s": 10.0, "count": 1, "mean_s": 10.0},
+        "a/b/c": {"total_s": 8.0, "count": 1, "mean_s": 8.0},
+    }
+    hot = {h["path"]: h["self_s"] for h in span_hotspots(rollup)}
+    assert hot["a"] == pytest.approx(2.0)
+    assert hot["a/b/c"] == pytest.approx(8.0)
+
+
+def test_tracer_add_external_interval(tmp_path):
+    """Hook-boundary timers (ChunkPhaseHooks) record via add() — spans
+    without a with-block, still parented and on the timer."""
+    with EventWriter(str(tmp_path), run_id="r") as w:
+        tracer = Tracer(w)
+        tracer.add("chunk", 1.25, epoch=50)
+    (e,) = read_events(str(tmp_path), types=("span",))
+    assert e["name"] == "chunk" and e["seconds"] == 1.25 and e["epoch"] == 50
+    assert tracer.timer.totals["chunk"] == 1.25
+
+
+def test_use_tracer_binds_module_level_span(tmp_path):
+    with EventWriter(str(tmp_path), run_id="r") as w:
+        tracer = Tracer(w)
+        with use_tracer(tracer):
+            assert trace_mod.current_tracer() is tracer
+            with trace_mod.span("bound"):
+                pass
+        # unbound: module-level spans still work, but emit nothing
+        with trace_mod.span("unbound"):
+            pass
+    assert [e["name"] for e in read_events(str(tmp_path), types=("span",))] \
+        == ["bound"]
+
+
+def test_spanned_hook_cadence_and_passthrough(tmp_path):
+    from dib_tpu.telemetry import SpannedHook
+    from dib_tpu.train.hooks import Every
+
+    calls = []
+
+    class Inner:
+        records = ["sentinel"]
+
+        def __call__(self, trainer, state, epoch):
+            calls.append(epoch)
+
+    with EventWriter(str(tmp_path), run_id="r") as w:
+        hook = SpannedHook("mi_bounds", Every(100, Inner()))
+        with use_tracer(Tracer(w)):
+            hook(None, None, 50)     # cadence miss: no phantom span
+            hook(None, None, 100)
+    assert calls == [100]
+    # attribute passthrough reaches the directly wrapped hook
+    assert SpannedHook("x", Inner()).records == ["sentinel"]
+    spans = list(read_events(str(tmp_path), types=("span",)))
+    assert [e["epoch"] for e in spans] == [100]
+    assert spans[0]["name"] == "mi_bounds"
+
+
+def test_torn_span_line_tolerated(tmp_path):
+    """A writer killed mid-span-append leaves one torn line; the rest of
+    the span stream (and its rollups) stays readable."""
+    with EventWriter(str(tmp_path), run_id="r") as w:
+        tracer = Tracer(w)
+        for i in range(3):
+            with tracer.span("chunk", epoch=i):
+                pass
+        w.chunk(epoch=3, steps=10, seconds=1.0)
+    path = os.path.join(str(tmp_path), "events.jsonl")
+    raw = open(path, "rb").read().split(b"\n")
+    raw[1] = b'{"v": 1, "run": "r", "type": "span", "name": "chu'  # SIGKILL
+    with open(path, "wb") as f:
+        f.write(b"\n".join(raw))
+    with pytest.warns(UserWarning, match="torn event line"):
+        spans = list(read_events(path, types=("span",)))
+    assert len(spans) == 2
+    with pytest.warns(UserWarning):
+        s = summarize(path)
+    assert s["spans"]["chunk"]["count"] == 2
+
+
+# ================================================================== rollups
+def test_span_rollup_normalizes_dynamic_indices():
+    events = [
+        {"path": "sweep/replica3/chunk12/mi_bounds", "seconds": 1.0},
+        {"path": "sweep/replica7/chunk9/mi_bounds", "seconds": 2.0},
+        {"path": "sweep/replica3", "seconds": 4.0},
+    ]
+    rollup = span_rollup(events)
+    assert rollup["sweep/replica*/chunk*/mi_bounds"]["count"] == 2
+    assert rollup["sweep/replica*/chunk*/mi_bounds"]["total_s"] == 3.0
+    assert rollup["sweep/replica*"]["total_s"] == 4.0
+
+
+def test_span_hotspots_rank_by_self_time():
+    rollup = {
+        "fit": {"total_s": 10.0, "count": 1, "mean_s": 10.0},
+        "fit/chunk": {"total_s": 7.0, "count": 5, "mean_s": 1.4},
+        "fit/mi": {"total_s": 2.0, "count": 5, "mean_s": 0.4},
+    }
+    hot = span_hotspots(rollup)
+    assert hot[0]["path"] == "fit/chunk" and hot[0]["self_s"] == 7.0
+    # fit's SELF time is 10 - 9 = 1, ranked below mi's 2
+    assert [h["path"] for h in hot] == ["fit/chunk", "fit/mi", "fit"]
+    assert hot[2]["self_s"] == pytest.approx(1.0)
+
+
+# ================================================================ xla stats
+def test_backend_peaks_ordered_match():
+    assert xla_stats.backend_peaks("TPU v5p chip")["bf16_tflops"] == 459.0
+    assert xla_stats.backend_peaks("TPU v5 lite")["bf16_tflops"] == 197.0
+    assert xla_stats.backend_peaks("cpu") is None
+    assert xla_stats.backend_peaks(None) is None
+
+
+def test_achieved_roofline_arithmetic():
+    out = xla_stats.achieved(2.0, flops=4e12, bytes_accessed=2e10,
+                             peaks={"bf16_tflops": 200.0, "hbm_gbps": 800.0})
+    assert out["achieved_gflops"] == pytest.approx(2000.0)
+    assert out["flops_frac_of_peak"] == pytest.approx(0.01)
+    assert out["achieved_gbps"] == pytest.approx(10.0)
+    assert out["bandwidth_frac_of_peak"] == pytest.approx(0.0125)
+    assert out["arithmetic_intensity"] == pytest.approx(200.0)
+    assert xla_stats.achieved(0.0, flops=1.0) == {}
+
+
+def test_compiled_cost_stats_on_cpu():
+    """The CPU backend exposes a cost model: flops/bytes of a real jitted
+    program come back as finite floats."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def f(x):
+        return (x @ x).sum()
+
+    cost = xla_stats.compiled_cost_stats(f, jnp.ones((32, 32)))
+    assert cost is not None
+    assert cost["flops"] > 0 and cost["bytes_accessed"] > 0
+
+
+def test_compiled_cost_stats_degrades_to_none():
+    class Broken:
+        def lower(self, *a, **k):
+            raise RuntimeError("no cost model on this backend")
+
+    assert xla_stats.compiled_cost_stats(Broken()) is None
+
+
+def test_record_compile_event_duration_only(tmp_path):
+    """cost_analysis()-absent backends: the compile event is still emitted
+    (duration-only) and nothing downstream crashes — summarize reports
+    spans with no utilization section."""
+
+    class Broken:
+        def lower(self, *a, **k):
+            raise RuntimeError("unsupported")
+
+    with EventWriter(str(tmp_path), run_id="r") as w:
+        tracer = Tracer(w)
+        with tracer.span("chunk"):
+            pass
+        cost = xla_stats.record_compile_event(w, "run_chunk", Broken(),
+                                              cache="off")
+        assert cost is None
+        w.chunk(epoch=1, steps=10, seconds=1.0)
+    (compile_event,) = read_events(str(tmp_path), types=("compile",))
+    assert "flops" not in compile_event
+    s = summarize(str(tmp_path))
+    assert "chunk" in s["spans"]
+    assert "utilization" not in s
+
+
+def test_fit_recorder_record_compile_counts_cache(tmp_path, monkeypatch):
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def f(x):
+        return x * 2
+
+    monkeypatch.setattr("dib_tpu.utils.compile_cache._STATUS", "warm")
+    with EventWriter(str(tmp_path), run_id="r") as w:
+        rec = FitRecorder(w, steps_per_epoch=10)
+        cost = rec.record_compile("run_chunk", f, jnp.ones(4), epochs=2)
+        assert cost is not None and cost["flops"] >= 0
+        # second call with the same name is a no-op (once per fit)
+        assert rec.record_compile("run_chunk", f, jnp.ones(4)) is None
+    snap = rec.registry.snapshot()
+    assert snap["counters"]["compile_cache.hits"] == 1.0
+    (compile_event,) = read_events(str(tmp_path), types=("compile",))
+    assert compile_event["cache"] == "warm"
+
+
+def test_cost_analysis_env_opt_out(tmp_path, monkeypatch):
+    monkeypatch.setenv("DIB_XLA_COST_ANALYSIS", "0")
+    assert not xla_stats.cost_analysis_enabled()
+
+    class Exploding:
+        def lower(self, *a, **k):  # must never be reached when opted out
+            raise AssertionError("lowered despite opt-out")
+
+    with EventWriter(str(tmp_path), run_id="r") as w:
+        assert xla_stats.record_compile_event(w, "x", Exploding(),
+                                              cache="off") is None
+    (e,) = read_events(str(tmp_path), types=("compile",))
+    assert e["name"] == "x"
+
+
+# ============================================================== memory stats
+def test_host_memory_stats_linux():
+    from dib_tpu.telemetry import host_memory_stats
+
+    stats = host_memory_stats()
+    assert stats is not None            # CI runs on Linux
+    assert stats["rss_bytes"] > 0
+    # VmHWM can be hidden by sandboxed kernels; when present it bounds RSS
+    if "peak_rss_bytes" in stats:
+        assert stats["peak_rss_bytes"] >= stats["rss_bytes"]
+
+
+# ============================================================== fit wiring
+@pytest.fixture(scope="module")
+def boolean_run(tmp_path_factory):
+    """One tiny boolean fit with telemetry: spans + cost-analyzed compiles."""
+    import jax
+
+    from dib_tpu.workloads.boolean import (
+        BooleanTrainer,
+        BooleanWorkloadConfig,
+        fetch_boolean_circuit,
+    )
+
+    tmp = tmp_path_factory.mktemp("boolean_run")
+    config = BooleanWorkloadConfig(num_steps=40, mi_every=20,
+                                   integration_hidden=(32,), batch_size=64)
+    trainer = BooleanTrainer(fetch_boolean_circuit(), config)
+    with EventWriter(str(tmp), run_id="fit") as w:
+        trainer.fit(jax.random.key(0), telemetry=w)
+    return str(tmp)
+
+
+def test_boolean_fit_emits_spans_and_cost(boolean_run):
+    events = list(read_events(boolean_run))
+    spans = [e for e in events if e["type"] == "span"]
+    assert {e["name"] for e in spans} == {"chunk", "mi_bounds"}
+    assert len([e for e in spans if e["name"] == "chunk"]) == 2
+    compiles = {e["name"]: e for e in events if e["type"] == "compile"}
+    assert set(compiles) == {"run_chunk", "channel_mi_bounds"}
+    # the CPU backend has a cost model: flops recorded
+    assert compiles["channel_mi_bounds"]["flops"] > 0
+    # chunk events carry the host-RSS fallback even though device memory
+    # stats are None on CPU
+    chunk = next(e for e in events if e["type"] == "chunk")
+    assert chunk["memory"] is None
+    assert chunk["host_memory"]["rss_bytes"] > 0
+
+
+def test_boolean_fit_summary_rollups(boolean_run):
+    s = summarize(boolean_run)
+    assert s["spans"]["chunk"]["count"] == 2
+    assert s["spans"]["mi_bounds"]["count"] == 2
+    assert len(s["span_hotspots"]) >= 2
+    assert "channel_mi_bounds" in s["utilization"]
+    assert s["utilization"]["channel_mi_bounds"]["achieved_gflops"] > 0
+    assert s["memory"]["host_peak_rss_bytes"] > 0
+    # live gauges from the metrics rollup: achieved rates for the chunk
+    gauges = {k: v for k, v in s["metrics"].items() if "achieved" in k}
+    assert any(k.startswith("gauges.achieved_gflops.run_chunk")
+               for k in gauges)
+
+
+def test_serial_trainer_fit_emits_chunk_spans(tmp_path):
+    import jax
+
+    from dib_tpu.data import get_dataset
+    from dib_tpu.models import DistributedIBModel
+    from dib_tpu.train import DIBTrainer, TrainConfig
+
+    bundle = get_dataset("boolean_circuit")
+    model = DistributedIBModel(
+        feature_dimensionalities=tuple(bundle.feature_dimensionalities),
+        encoder_hidden=(8,), integration_hidden=(8,),
+        output_dim=bundle.output_dimensionality, embedding_dim=2,
+    )
+    config = TrainConfig(num_pretraining_epochs=0, num_annealing_epochs=4,
+                         batch_size=32, steps_per_epoch=2)
+    trainer = DIBTrainer(model, bundle, config)
+    with EventWriter(str(tmp_path), run_id="serial") as w:
+        trainer.fit(jax.random.key(0), hook_every=2, telemetry=w)
+    events = list(read_events(str(tmp_path)))
+    spans = [e for e in events if e["type"] == "span"]
+    assert [e["name"] for e in spans] == ["chunk", "chunk"]
+    (compile_event,) = [e for e in events if e["type"] == "compile"]
+    assert compile_event["name"] == "run_chunk"
+
+
+def test_chunk_phase_hooks_mirror_spans(tmp_path):
+    """The northstar driver's checkpoint cycle: with a tracer attached,
+    every chunk/instrumentation interval also lands as a span event, and
+    the PhaseTimer intervals keep their historical keys."""
+    from dib_tpu.telemetry import ChunkPhaseHooks
+
+    with EventWriter(str(tmp_path), run_id="ns") as w:
+        tracer = Tracer(w)
+        phases = ChunkPhaseHooks(telemetry=w, tracer=tracer,
+                                 steps_per_epoch=50)
+        phases.start()
+        states = np.zeros(2)
+        phases.pre(None, states, 25)
+        phases.post(None, states, 25)
+        phases.pre(None, states, 50)
+        phases.post(None, states, 50)
+    assert len(phases.timer.intervals["chunk"]) == 2
+    assert len(phases.timer.intervals["instrumentation"]) == 2
+    spans = list(read_events(str(tmp_path), types=("span",)))
+    assert [e["name"] for e in spans] == ["chunk", "instrumentation"] * 2
+    assert [e["epoch"] for e in spans] == [25, 25, 50, 50]
+    # chunk events still emitted alongside (back-compat with summarize)
+    assert len(list(read_events(str(tmp_path), types=("chunk",)))) == 2
+
+
+# ======================================================== timing hygiene gate
+def test_package_timing_hygiene():
+    import sys
+
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    from check_timing_hygiene import scan_package
+
+    violations = scan_package()
+    assert not violations, "\n".join(violations)
