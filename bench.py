@@ -448,8 +448,14 @@ def parent_main() -> None:
     measure_timeout = 900    # a TPU measurement is ~2-4 min incl. compile;
                              # must fit INSIDE the default budget
     backoff = 30.0
+    # A dead tunnel fails every probe the same way; burning the whole
+    # budget on identical 150 s hangs (BENCH_r05: four of them) buys
+    # nothing over a few. Bounded retries + the exponential backoff below
+    # cap the worst case; the count is configurable for tests/operators.
+    max_probe_failures = int(os.environ.get("DIB_BENCH_MAX_PROBE_ATTEMPTS", "4"))
 
     attempt = 0
+    probe_failures = 0   # consecutive; reset when a probe succeeds
     device_ever_up = False
     last_failure = "no probe attempted"
     while True:
@@ -457,8 +463,13 @@ def parent_main() -> None:
         remaining = deadline - time.time()
         if remaining < probe_timeout + 60:
             break
+        if probe_failures >= max_probe_failures:
+            log(f"giving up after {probe_failures} consecutive probe "
+                f"failures (cap {max_probe_failures})")
+            break
         reason = probe_device(min(probe_timeout, int(remaining - 30)))
         if reason is None:
+            probe_failures = 0
             device_ever_up = True
             remaining = deadline - time.time()
             child_budget = int(min(measure_timeout, max(remaining - 10, 60)))
@@ -478,8 +489,10 @@ def parent_main() -> None:
             last_failure = failure
             log(f"attempt {attempt}: {last_failure}")
         else:
+            probe_failures += 1
             last_failure = reason
-            log(f"attempt {attempt}: {reason}")
+            log(f"attempt {attempt}: {reason} "
+                f"({probe_failures}/{max_probe_failures} probe failures)")
         sleep_s = min(backoff, max(deadline - time.time() - probe_timeout, 0))
         if sleep_s > 0:
             time.sleep(sleep_s)
@@ -504,6 +517,17 @@ def parent_main() -> None:
                 else "no cached measurement available"
             )
         ),
+        # Structured failure record (machine-readable, unlike the free-text
+        # stderr tail BENCH_r05 had to be forensically read from): how many
+        # attempts ran, how many probes failed in a row, and why.
+        "probe_failure": {
+            "attempts": attempt,
+            "consecutive_probe_failures": probe_failures,
+            "max_probe_attempts": max_probe_failures,
+            "probe_timeout_s": probe_timeout,
+            "last_reason": last_failure,
+            "device_ever_up": device_ever_up,
+        },
     }
     if cached:
         for key in ("steps_per_s", "mfu", "achieved_tflops", "device_kind",

@@ -36,35 +36,21 @@ def _add_telemetry_dir_flag(parser, default_desc: str) -> None:
                              f"{default_desc}; pass '' to disable.")
 
 
-def build_parser() -> argparse.ArgumentParser:
-    parser = argparse.ArgumentParser(
-        prog="dib_tpu",
-        description="Train a Distributed IB model on any registered dataset.",
-    )
-    parser.add_argument("command", nargs="?", default="train",
-                        choices=["train", "workload", "telemetry"],
-                        help="Subcommand: 'train' (flags below), 'workload' "
-                             "(paper workloads; see `dib_tpu workload --help`), "
-                             "or 'telemetry' (summarize/compare/report run "
-                             "event streams; see `dib_tpu telemetry --help`).")
+def _add_model_flags(parser: argparse.ArgumentParser) -> None:
+    """Flags that define the MODEL and its dataset — everything needed to
+    rebuild the architecture a checkpoint was trained with. Shared between
+    the train parser and ``dib_tpu serve`` (which must reconstruct the
+    exact param structure to restore a checkpoint; a mismatch is caught by
+    the checkpoint's integrity manifest, see train/checkpoint.py)."""
     parser.add_argument("--dataset", default="boolean_circuit",
                         help="Registered dataset name (see dib_tpu.data.available_datasets()).")
     parser.add_argument("--data_path", type=str, default="./data/")
-    parser.add_argument("--artifact_outdir", type=str, default="./training_artifacts/")
     parser.add_argument("--ib", action=argparse.BooleanOptionalAction, default=False,
                         help="Vanilla IB: all features into a single bottleneck.")
-    parser.add_argument("--learning_rate", type=float, default=3e-4)
-    parser.add_argument("--beta_start", type=float, default=1e-4)
-    parser.add_argument("--beta_end", type=float, default=3e0)
-    parser.add_argument("--number_pretraining_epochs", type=int, default=10**3)
-    parser.add_argument("--number_annealing_epochs", type=int, default=10**4)
-    parser.add_argument("--batch_size", type=int, default=128)
     parser.add_argument("--use_positional_encoding",
                         action=argparse.BooleanOptionalAction, default=True)
     parser.add_argument("--activation_fn", type=str, default="relu")
     parser.add_argument("--feature_embedding_dimension", type=int, default=32)
-    parser.add_argument("--optimizer", type=str, default="adam")
-    parser.add_argument("--save_compression_matrices_frequency", type=int, default=0)
     parser.add_argument("--feature_encoder_architecture", type=int, nargs="+",
                         default=[128, 128])
     parser.add_argument("--number_positional_encoding_frequencies", type=int, default=5,
@@ -78,9 +64,6 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--infonce_shared_dimensionality", type=int, default=64)
     parser.add_argument("--infonce_y_encoder_architecture", type=int, nargs="+",
                         default=[128, 128])
-    parser.add_argument("--infonce_similarity", type=str, default="l2",
-                        choices=["l2sq", "l2", "l1", "linf", "cosine"])
-    parser.add_argument("--infonce_temperature", type=float, default=1.0)
 
     # Dataset specific (reference train.py:64-72)
     parser.add_argument("--boolean_random_circuit",
@@ -88,12 +71,41 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--boolean_number_input_gates", type=int, default=10)
     parser.add_argument("--pendulum_time_delta", type=float, default=2)
 
-    # TPU-native extras
     parser.add_argument("--compute_dtype", type=str, default=None,
                         choices=[None, "float32", "bfloat16"],
                         help="Matmul compute dtype (params stay float32); "
                              "bfloat16 targets the MXU's native precision.")
     parser.add_argument("--seed", type=int, default=0)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="dib_tpu",
+        description="Train a Distributed IB model on any registered dataset.",
+    )
+    parser.add_argument("command", nargs="?", default="train",
+                        choices=["train", "workload", "telemetry", "serve"],
+                        help="Subcommand: 'train' (flags below), 'workload' "
+                             "(paper workloads; see `dib_tpu workload --help`), "
+                             "'telemetry' (summarize/compare/report run "
+                             "event streams; see `dib_tpu telemetry --help`), "
+                             "or 'serve' (inference over a checkpoint; see "
+                             "`dib_tpu serve --help`).")
+    _add_model_flags(parser)
+    parser.add_argument("--artifact_outdir", type=str, default="./training_artifacts/")
+    parser.add_argument("--learning_rate", type=float, default=3e-4)
+    parser.add_argument("--beta_start", type=float, default=1e-4)
+    parser.add_argument("--beta_end", type=float, default=3e0)
+    parser.add_argument("--number_pretraining_epochs", type=int, default=10**3)
+    parser.add_argument("--number_annealing_epochs", type=int, default=10**4)
+    parser.add_argument("--batch_size", type=int, default=128)
+    parser.add_argument("--optimizer", type=str, default="adam")
+    parser.add_argument("--save_compression_matrices_frequency", type=int, default=0)
+    parser.add_argument("--infonce_similarity", type=str, default="l2",
+                        choices=["l2sq", "l2", "l1", "linf", "cosine"])
+    parser.add_argument("--infonce_temperature", type=float, default=1.0)
+
+    # TPU-native extras
     parser.add_argument("--steps_per_epoch", type=int, default=0,
                         help="0 -> ceil(num_train / batch_size).")
     parser.add_argument("--warmup_steps", type=int, default=0)
@@ -141,32 +153,25 @@ def _dataset_kwargs(args) -> dict:
     }
 
 
-def run(args, compile_cache_status: str | None = None) -> dict:
-    """Execute a training run from parsed flags. Returns a result summary."""
-    import jax
-    import numpy as np
-
+def _bundle_from_args(args):
+    """Dataset bundle resolved from the shared model flags (``--ib`` and
+    ``--infonce_loss`` adjust the bundle in place, as the trainer expects)."""
     from dib_tpu.data import get_dataset
-    from dib_tpu.models import DistributedIBModel, YEncoder
-    from dib_tpu.ops.entropy import sequence_entropy_bits
-    from dib_tpu.parallel import BetaSweepTrainer, make_sweep_mesh
-    from dib_tpu.train import (
-        CompressionMatrixHook,
-        DIBTrainer,
-        Every,
-        InfoPerFeatureHook,
-        TrainConfig,
-    )
-    from dib_tpu.parallel.sweep import PerReplicaHook
-    from dib_tpu.viz import save_distributed_info_plane
 
     bundle = get_dataset(args.dataset, **_dataset_kwargs(args))
     if args.ib:
         bundle = bundle.as_vanilla_ib()
-    contrastive = args.infonce_loss
-    if contrastive:
+    if args.infonce_loss:
         bundle.loss = "infonce"
+    return bundle
 
+
+def _model_from_args(args, bundle):
+    """(model, y_encoder) from the shared model flags — the ONE place the
+    flag surface maps to architecture, so train and serve cannot drift."""
+    from dib_tpu.models import DistributedIBModel, YEncoder
+
+    contrastive = args.infonce_loss
     # n posenc frequencies in the reference convention = n-1 sinusoids
     nfreq = (args.number_positional_encoding_frequencies - 1
              if args.use_positional_encoding else 0)
@@ -194,6 +199,29 @@ def run(args, compile_cache_status: str | None = None) -> dict:
             activation=args.activation_fn,
             compute_dtype=compute_dtype,
         )
+    return model, y_encoder
+
+
+def run(args, compile_cache_status: str | None = None) -> dict:
+    """Execute a training run from parsed flags. Returns a result summary."""
+    import jax
+    import numpy as np
+
+    from dib_tpu.ops.entropy import sequence_entropy_bits
+    from dib_tpu.parallel import BetaSweepTrainer, make_sweep_mesh
+    from dib_tpu.train import (
+        CompressionMatrixHook,
+        DIBTrainer,
+        Every,
+        InfoPerFeatureHook,
+        TrainConfig,
+    )
+    from dib_tpu.parallel.sweep import PerReplicaHook
+    from dib_tpu.viz import save_distributed_info_plane
+
+    bundle = _bundle_from_args(args)
+    contrastive = args.infonce_loss
+    model, y_encoder = _model_from_args(args, bundle)
 
     config = TrainConfig(
         learning_rate=args.learning_rate,
@@ -732,6 +760,165 @@ def workload_main(argv: Sequence[str]) -> int:
     return 0
 
 
+# ---------------------------------------------------------------- serving
+# ``python -m dib_tpu serve`` — AOT-compiled inference over a training
+# checkpoint (docs/serving.md): JSON HTTP API with micro-batching, replica
+# dispatch (local devices, or β-sweep members for "the model at β≈x"), and
+# request-level telemetry on the standard events.jsonl stream.
+
+def serve_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="dib_tpu serve",
+        description="Serve a trained DIB checkpoint over a JSON HTTP API "
+                    "(docs/serving.md).",
+    )
+    _add_model_flags(parser)
+    parser.add_argument("--checkpoint_dir", type=str, required=True,
+                        help="DIBCheckpointer directory holding the trained "
+                             "run (its integrity manifest is verified).")
+    # Restore-template flags: the optimizer state in the checkpoint must
+    # restore into a structurally identical template.
+    parser.add_argument("--optimizer", type=str, default="adam")
+    parser.add_argument("--batch_size", type=int, default=128)
+    parser.add_argument("--warmup_steps", type=int, default=0)
+    parser.add_argument("--beta_start", type=float, default=1e-4)
+    parser.add_argument("--beta_end", type=float, default=3e0)
+    parser.add_argument("--sweep_beta_ends", type=float, nargs="+", default=None,
+                        help="Serve a SWEEP checkpoint: one β-labeled replica "
+                             "per end-beta (× --sweep_repeats); clients "
+                             "select with {\"beta\": x}.")
+    parser.add_argument("--sweep_repeats", type=int, default=1)
+    # Serving knobs
+    parser.add_argument("--host", type=str, default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8100,
+                        help="0 binds an ephemeral port (printed on stdout).")
+    parser.add_argument("--buckets", type=int, nargs="+", default=None,
+                        help="Padded batch sizes to AOT-compile "
+                             "(default: the engine's DEFAULT_BUCKETS, "
+                             "1 8 32 128).")
+    parser.add_argument("--max_batch", type=int, default=32)
+    parser.add_argument("--max_wait_ms", type=float, default=2.0)
+    parser.add_argument("--max_queue", type=int, default=256)
+    parser.add_argument("--num_devices", type=int, default=0,
+                        help="Local devices to replicate over (0 = all; "
+                             "ignored when serving a sweep).")
+    parser.add_argument("--serve_seconds", type=float, default=0.0,
+                        help="Auto-shutdown after this many seconds "
+                             "(0 = run until SIGINT/SIGTERM).")
+    parser.add_argument("--outdir", type=str, default="./serve_artifacts/",
+                        help="Run directory for the serving event stream.")
+    _add_telemetry_dir_flag(parser, "--outdir")
+    return parser
+
+
+def serve_main(argv: Sequence[str]) -> int:
+    args = serve_parser().parse_args(argv)
+    _enable_cli_compile_cache()
+
+    import threading
+
+    import jax
+    import numpy as np
+
+    from dib_tpu.serve import DEFAULT_BUCKETS, DIBServer, ReplicaRouter
+    from dib_tpu.telemetry import (
+        MetricsRegistry,
+        Tracer,
+        open_writer,
+        runtime_manifest,
+        shared_run_id,
+    )
+    from dib_tpu.train import DIBTrainer, DIBCheckpointer, TrainConfig
+
+    bundle = _bundle_from_args(args)
+    model, y_encoder = _model_from_args(args, bundle)
+    config = TrainConfig(
+        batch_size=args.batch_size,
+        beta_start=args.beta_start,
+        beta_end=args.beta_end,
+        optimizer=args.optimizer,
+        warmup_steps=args.warmup_steps,
+    )
+
+    if args.buckets is None:
+        args.buckets = list(DEFAULT_BUCKETS)
+    os.makedirs(args.outdir, exist_ok=True)
+    telemetry = open_writer(
+        getattr(args, "telemetry_dir", None), args.outdir,
+        run_id=shared_run_id(), process_index=jax.process_index(),
+    )
+    registry = MetricsRegistry()
+    tracer = Tracer(telemetry)
+    sweep_mode = bool(args.sweep_beta_ends)
+    if telemetry is not None:
+        telemetry.run_start(runtime_manifest(config=config, extra={
+            "mode": "serve", "dataset": args.dataset,
+            "checkpoint_dir": os.path.abspath(args.checkpoint_dir),
+            "buckets": [int(b) for b in args.buckets],
+            "max_batch": args.max_batch, "max_wait_ms": args.max_wait_ms,
+            "sweep": sweep_mode,
+        }))
+
+    batcher_kwargs = dict(
+        batch_buckets=args.buckets, telemetry=telemetry, registry=registry,
+        tracer=tracer, max_batch=args.max_batch,
+        max_wait_ms=args.max_wait_ms, max_queue=args.max_queue,
+    )
+    ckpt = DIBCheckpointer(args.checkpoint_dir)
+    try:
+        if sweep_mode:
+            from dib_tpu.parallel import BetaSweepTrainer
+
+            ends = np.repeat(np.asarray(args.sweep_beta_ends, np.float64),
+                             args.sweep_repeats)
+            sweep = BetaSweepTrainer(model, bundle, config, args.beta_start,
+                                     ends, y_encoder=y_encoder)
+            states, _, _ = ckpt.restore(sweep)
+            router = ReplicaRouter.from_sweep(sweep, states, **batcher_kwargs)
+        else:
+            trainer = DIBTrainer(model, bundle, config, y_encoder=y_encoder)
+            state, _, _ = ckpt.restore(trainer)
+            devices = jax.local_devices()
+            if args.num_devices > 0:
+                devices = devices[: args.num_devices]
+            router = ReplicaRouter.from_params(
+                model, state.params["model"], devices=devices,
+                **batcher_kwargs,
+            )
+    finally:
+        ckpt.close()
+
+    server = DIBServer(router, host=args.host, port=args.port,
+                       telemetry=telemetry, registry=registry)
+    server.start()
+    # machine-readable first line: the loadgen (and tests) read the bound
+    # port from here rather than racing a log scrape
+    print(json.dumps({
+        "serving": server.url, "port": server.port,
+        "replicas": len(router.entries), "run_dir": args.outdir,
+    }), flush=True)
+
+    stop = threading.Event()
+    if threading.current_thread() is threading.main_thread():
+        import signal
+
+        for signum in (signal.SIGINT, signal.SIGTERM):
+            signal.signal(signum, lambda *_: stop.set())
+    try:
+        if args.serve_seconds > 0:
+            stop.wait(args.serve_seconds)
+        else:
+            stop.wait()
+    finally:
+        server.close()
+    snapshot = registry.snapshot()
+    print(json.dumps({
+        "served_requests": snapshot["counters"].get("serve.requests.ok", 0),
+        "batches": snapshot["counters"].get("serve.batches", 0),
+    }), flush=True)
+    return 0
+
+
 def _enable_cli_compile_cache() -> str:
     """Persistent XLA compilation cache for CLI invocations (VERDICT round
     3 item 4b: warm starts skip the ~146 s cold compile). Called AFTER
@@ -809,14 +996,26 @@ def main(argv: Sequence[str] | None = None) -> int:
             from dib_tpu.telemetry import telemetry_main
 
             return telemetry_main(argv[1:])
+        if argv and argv[0] == "serve":
+            return serve_main(argv[1:])
         args = build_parser().parse_args(argv)
-        if args.command in ("workload", "telemetry"):
-            # parsed from a non-leading position (e.g. flags first): these
+        if args.command in ("workload", "telemetry", "serve"):
+            # parsed from a non-leading position (flags first): these
             # subcommands' flags are not the train flags, so re-dispatching
-            # would misparse
-            raise SystemExit(
-                f"Place the subcommand first: python -m dib_tpu {args.command} ..."
+            # would misparse. Name the flag that displaced the subcommand
+            # and exit 2 (usage error), matching argparse's convention.
+            offending = next(
+                (a for a in argv[: argv.index(args.command)]
+                 if a.startswith("-")), None
             )
+            print(
+                f"dib_tpu: the {args.command!r} subcommand must come first"
+                + (f" (found {offending!r} before it)" if offending else "")
+                + f"; run: python -m dib_tpu {args.command} "
+                + " ".join(a for a in argv if a != args.command),
+                file=sys.stderr,
+            )
+            return 2
         if args.watchdog:
             return _watchdog_main(args, argv)
         status = _enable_cli_compile_cache()

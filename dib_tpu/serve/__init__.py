@@ -1,0 +1,39 @@
+"""AOT-compiled inference serving for trained DIB models.
+
+See ``docs/serving.md``. The pieces:
+
+  - :mod:`dib_tpu.serve.engine` — bucket-compiled deterministic inference
+    callables (posterior-mean predict / per-feature encode / per-channel
+    KL) over one checkpointed model, cost-analyzed for online roofline
+    gauges.
+  - :mod:`dib_tpu.serve.batcher` — bounded micro-batching queue: coalesce,
+    pad to bucket, dispatch, split; per-request timeouts, backpressure,
+    and error isolation.
+  - :mod:`dib_tpu.serve.replicas` — round-robin dispatch across local
+    devices and across β-sweep members ("the model at β≈x").
+  - :mod:`dib_tpu.serve.server` — stdlib JSON HTTP API
+    (``/v1/predict``, ``/v1/encode``, ``/healthz``, ``/metrics``) behind
+    ``python -m dib_tpu serve``.
+"""
+
+from dib_tpu.serve.batcher import (
+    BatcherClosed,
+    MicroBatcher,
+    QueueFullError,
+    RequestTimeout,
+)
+from dib_tpu.serve.engine import DEFAULT_BUCKETS, InferenceEngine
+from dib_tpu.serve.replicas import ReplicaEntry, ReplicaRouter
+from dib_tpu.serve.server import DIBServer
+
+__all__ = [
+    "DEFAULT_BUCKETS",
+    "BatcherClosed",
+    "DIBServer",
+    "InferenceEngine",
+    "MicroBatcher",
+    "QueueFullError",
+    "ReplicaEntry",
+    "ReplicaRouter",
+    "RequestTimeout",
+]

@@ -1,0 +1,325 @@
+"""Bounded micro-batching in front of an :class:`InferenceEngine`.
+
+Single requests are cheap to make and expensive to dispatch one-by-one —
+the engine's compiled buckets want full batches. The batcher coalesces
+concurrent requests into padded micro-batches under two bounds:
+
+  - ``max_batch``: dispatch as soon as this many rows are waiting;
+  - ``max_wait_ms``: never hold the FIRST request of a batch longer than
+    this, even at depth 1 (the latency floor a lone request pays).
+
+Contracts the tests pin:
+
+  - **Semantic invisibility**: a request's result is bit-identical (CPU,
+    f32) whether it was dispatched alone or padded into a shared bucket —
+    guaranteed by the engine's posterior-mean, row-independent forward
+    pass; the batcher only concatenates, pads, and splits rows.
+  - **Error isolation**: shape/width validation happens at ``submit`` (a
+    malformed request is refused before it can join a batch), and a batch
+    whose dispatch still fails is retried per-request so only the guilty
+    request carries the error — batch-mates get their results.
+  - **Backpressure**: a full queue refuses new work (``QueueFullError``)
+    instead of buffering unboundedly.
+  - **Timeouts**: a request that waited past its deadline is completed
+    with ``RequestTimeout`` at the next drain, and its rows are never
+    dispatched (no zombie compute for an abandoned client).
+
+Telemetry: each dispatched micro-batch lands as a ``batch`` span event
+(rows, bucket, fill ratio, op) and each completed request as a ``request``
+span event (queue + dispatch latency, status) on the run's event stream,
+via the same ``Tracer`` training uses; queue depth / latency / fill land in
+the ``MetricsRegistry`` for ``/metrics`` and the end-of-run rollup.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+
+import numpy as np
+
+__all__ = [
+    "BatcherClosed",
+    "MicroBatcher",
+    "QueueFullError",
+    "RequestTimeout",
+]
+
+
+class QueueFullError(RuntimeError):
+    """The batcher's bounded queue refused a request (backpressure)."""
+
+
+class RequestTimeout(RuntimeError):
+    """The request waited past its deadline before dispatch completed."""
+
+
+class BatcherClosed(RuntimeError):
+    """The batcher is shut down; no new work is accepted."""
+
+
+class _Request:
+    """One submitted request: rows + a one-shot result slot."""
+
+    __slots__ = ("op", "rows", "deadline", "submitted",
+                 "_event", "_result", "_error")
+
+    def __init__(self, op: str, rows: np.ndarray, deadline: float | None):
+        self.op = op
+        self.rows = rows
+        self.deadline = deadline
+        self.submitted = time.perf_counter()   # timing-ok: host-side queue/latency clock, no jitted call in the interval
+        self._event = threading.Event()
+        self._result = None
+        self._error = None
+
+    # -------------------------------------------------------------- future
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def set_result(self, result) -> None:
+        self._result = result
+        self._event.set()
+
+    def set_error(self, error: BaseException) -> None:
+        self._error = error
+        self._event.set()
+
+    def result(self, timeout: float | None = None):
+        """Block for the result; raises the request's error if it failed."""
+        if not self._event.wait(timeout):
+            raise RequestTimeout(
+                f"no result within {timeout}s (request still queued or "
+                "in flight)"
+            )
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class MicroBatcher:
+    """Coalesces concurrent requests into padded engine dispatches.
+
+    Args:
+      engine: an :class:`~dib_tpu.serve.engine.InferenceEngine` (or any
+        object with ``predict``/``encode`` taking [B, width] rows and
+        returning a dict of [B, ...] arrays, plus ``feature_width`` /
+        ``max_bucket`` / ``bucket_for``).
+      max_batch: dispatch when this many ROWS are waiting (bounded by the
+        engine's top bucket — a larger value would always chunk).
+      max_wait_ms: longest the first waiting request is held for
+        batch-mates before dispatching whatever is there.
+      max_queue: bound on queued requests; beyond it ``submit`` raises
+        :class:`QueueFullError`.
+      tracer: optional ``telemetry.Tracer`` for ``batch``/``request`` span
+        events.
+      registry: optional ``MetricsRegistry`` for queue/latency metrics.
+    """
+
+    def __init__(
+        self,
+        engine,
+        max_batch: int = 32,
+        max_wait_ms: float = 2.0,
+        max_queue: int = 256,
+        tracer=None,
+        registry=None,
+    ):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self.engine = engine
+        self.max_batch = min(int(max_batch), int(engine.max_bucket))
+        self.max_wait_s = float(max_wait_ms) / 1e3
+        self.tracer = tracer
+        self.registry = registry
+        self._queue: "queue.Queue[_Request]" = queue.Queue(maxsize=max_queue)
+        self._closed = False
+        # Guards the closed-check + enqueue as one step against close():
+        # without it a submit that passed the check could land its request
+        # in a queue whose worker already exited (stranded forever).
+        self._lifecycle = threading.Lock()
+        self._worker = threading.Thread(
+            target=self._run, name="dib-serve-batcher", daemon=True
+        )
+        self._worker.start()
+
+    # --------------------------------------------------------------- client
+    def submit(self, x, op: str = "predict",
+               timeout_s: float | None = None) -> _Request:
+        """Enqueue one request; returns its future. Validation is eager —
+        a malformed request never reaches a batch."""
+        if self._closed:
+            raise BatcherClosed("batcher is closed")
+        if op not in ("predict", "encode"):
+            raise ValueError(f"unknown op {op!r} (predict|encode)")
+        rows = np.asarray(x, np.float32)
+        if rows.ndim == 1:
+            rows = rows[None, :]
+        if rows.ndim != 2 or rows.shape[0] == 0:
+            raise ValueError(
+                f"expected a row or non-empty row matrix, got shape {rows.shape}"
+            )
+        if rows.shape[1] != self.engine.feature_width:
+            raise ValueError(
+                f"expected rows of width {self.engine.feature_width}, "
+                f"got {rows.shape[1]}"
+            )
+        if not np.all(np.isfinite(rows)):
+            raise ValueError("request contains non-finite values")
+        deadline = (
+            time.perf_counter() + timeout_s if timeout_s is not None else None   # timing-ok: host-side queue/latency clock, no jitted call in the interval
+        )
+        request = _Request(op, rows, deadline)
+        with self._lifecycle:
+            if self._closed:
+                raise BatcherClosed("batcher is closed")
+            try:
+                self._queue.put_nowait(request)
+            except queue.Full:
+                # shed load VISIBLY: without this counter an overloaded
+                # server's rollup shows only the requests it accepted
+                if self.registry is not None:
+                    self.registry.counter("serve.requests.rejected").inc()
+                raise QueueFullError(
+                    f"serving queue full ({self._queue.maxsize} requests); "
+                    "retry with backoff"
+                ) from None
+        if self.registry is not None:
+            self.registry.gauge("serve.queue_depth").set(self._queue.qsize())
+        return request
+
+    def __call__(self, x, op: str = "predict",
+                 timeout_s: float | None = None):
+        """Blocking convenience: submit + wait (client-side timeout too)."""
+        return self.submit(x, op, timeout_s=timeout_s).result(timeout_s)
+
+    def close(self, drain: bool = True) -> None:
+        """Stop accepting work; optionally drain what is queued, then fail
+        anything left with :class:`BatcherClosed`."""
+        with self._lifecycle:
+            self._closed = True
+        if drain:
+            self._worker.join(timeout=30.0)
+        self._fail_queued()
+        self._worker.join(timeout=5.0)
+        self._fail_queued()   # nothing can enqueue after the flag; final sweep
+
+    def _fail_queued(self) -> None:
+        leftovers = []
+        try:
+            while True:
+                leftovers.append(self._queue.get_nowait())
+        except queue.Empty:
+            pass
+        for request in leftovers:
+            request.set_error(BatcherClosed("batcher closed before dispatch"))
+
+    # --------------------------------------------------------------- worker
+    def _collect(self) -> list[_Request]:
+        """Block for the first request, then gather batch-mates until
+        ``max_batch`` rows or ``max_wait_ms`` after the first arrival."""
+        try:
+            first = self._queue.get(timeout=0.05)
+        except queue.Empty:
+            return []
+        batch = [first]
+        rows = first.rows.shape[0]
+        deadline = time.perf_counter() + self.max_wait_s   # timing-ok: host-side queue/latency clock, no jitted call in the interval
+        while rows < self.max_batch:
+            remaining = deadline - time.perf_counter()   # timing-ok: host-side queue/latency clock, no jitted call in the interval
+            if remaining <= 0:
+                break
+            try:
+                request = self._queue.get(timeout=remaining)
+            except queue.Empty:
+                break
+            batch.append(request)
+            rows += request.rows.shape[0]
+        return batch
+
+    def _run(self) -> None:
+        while not (self._closed and self._queue.empty()):
+            batch = self._collect()
+            if not batch:
+                continue
+            if self.registry is not None:
+                self.registry.gauge("serve.queue_depth").set(
+                    self._queue.qsize()
+                )
+            now = time.perf_counter()   # timing-ok: host-side queue/latency clock, no jitted call in the interval
+            live: dict[str, list[_Request]] = {}
+            for request in batch:
+                if request.deadline is not None and now > request.deadline:
+                    request.set_error(RequestTimeout(
+                        "request timed out in queue before dispatch"
+                    ))
+                    self._finish(request, "timeout", now)
+                    continue
+                live.setdefault(request.op, []).append(request)
+            # one padded dispatch per op present in the drain — ops cannot
+            # share an executable, but a mixed drain still empties fully
+            for op, requests in live.items():
+                self._dispatch_group(op, requests)
+
+    def _capacity(self, n: int) -> int:
+        """Total padded rows the engine allocates for ``n`` requested rows —
+        the denominator of an honest fill ratio even when the dispatch
+        chunks at the top bucket (fill must never exceed 1)."""
+        capacity, remaining = 0, n
+        while remaining > 0:
+            take = min(remaining, self.engine.max_bucket)
+            capacity += self.engine.bucket_for(take)
+            remaining -= take
+        return capacity
+
+    def _dispatch_group(self, op: str, requests: list[_Request]) -> None:
+        rows = np.concatenate([r.rows for r in requests])
+        n = rows.shape[0]
+        bucket = (self.engine.bucket_for(n)
+                  if n <= self.engine.max_bucket else self.engine.max_bucket)
+        t0 = time.perf_counter()   # timing-ok: host-side queue/latency clock, no jitted call in the interval
+        try:
+            out = getattr(self.engine, op)(rows)
+        except Exception:
+            # isolation: re-run each request alone so only the guilty one
+            # carries the error (a batch-mate must never fail by proximity)
+            for request in requests:
+                try:
+                    result = getattr(self.engine, op)(request.rows)
+                    request.set_result(result)
+                    self._finish(request, "ok", time.perf_counter())   # timing-ok: host-side queue/latency clock, no jitted call in the interval
+                except Exception as exc:
+                    request.set_error(exc)
+                    self._finish(request, "error", time.perf_counter())   # timing-ok: host-side queue/latency clock, no jitted call in the interval
+            return
+        seconds = time.perf_counter() - t0   # timing-ok: host-side queue/latency clock, no jitted call in the interval
+        done = time.perf_counter()   # timing-ok: host-side queue/latency clock, no jitted call in the interval
+        offset = 0
+        for request in requests:
+            k = request.rows.shape[0]
+            request.set_result(
+                {key: value[offset : offset + k]
+                 for key, value in out.items()}
+            )
+            offset += k
+            self._finish(request, "ok", done)
+        fill = n / self._capacity(n)
+        if self.tracer is not None:
+            self.tracer.add(
+                "batch", seconds, op=op, rows=n, requests=len(requests),
+                bucket=bucket, fill=round(fill, 4),
+            )
+        if self.registry is not None:
+            self.registry.counter("serve.batches").inc()
+            self.registry.histogram("serve.batch_rows").record(n)
+            self.registry.histogram("serve.batch_fill").record(fill)
+
+    def _finish(self, request: _Request, status: str, now: float) -> None:
+        latency = now - request.submitted
+        if self.tracer is not None:
+            self.tracer.add("request", latency, op=request.op, status=status,
+                            rows=int(request.rows.shape[0]))
+        if self.registry is not None:
+            self.registry.counter(f"serve.requests.{status}").inc()
+            self.registry.histogram("serve.request_latency_s").record(latency)

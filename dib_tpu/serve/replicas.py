@@ -1,0 +1,148 @@
+"""Replica fan-out for serving: local devices and β-sweep members.
+
+Two independent axes of replication meet here:
+
+  - **Device replicas**: the same checkpoint pinned to several local
+    devices, each with its own engine + micro-batcher, dispatched
+    round-robin — the single-host throughput scaling story.
+  - **β replicas**: a ``BetaSweepTrainer`` checkpoint holds R models, one
+    per annealing endpoint. Serving them side by side lets a client query
+    "the model at β≈x" — the β axis is the paper's compression dial, so
+    model selection at query time is selection of a compression level.
+
+The router owns the batchers (one per entry — batching never crosses
+replicas, which would entangle their latency) and is the single object the
+HTTP server talks to.
+"""
+
+from __future__ import annotations
+
+import itertools
+import math
+import threading
+from typing import Sequence
+
+import jax
+
+from dib_tpu.serve.batcher import MicroBatcher
+from dib_tpu.serve.engine import DEFAULT_BUCKETS, InferenceEngine
+
+__all__ = ["ReplicaEntry", "ReplicaRouter"]
+
+
+class ReplicaEntry:
+    """One servable replica: an engine, its batcher, and its labels."""
+
+    def __init__(self, engine: InferenceEngine, batcher: MicroBatcher,
+                 index: int, beta_end: float | None = None, device=None):
+        self.engine = engine
+        self.batcher = batcher
+        self.index = index
+        self.beta_end = beta_end
+        self.device = device
+
+    def describe(self) -> dict:
+        entry = {"replica": self.index}
+        if self.beta_end is not None:
+            entry["beta_end"] = float(self.beta_end)
+        if self.device is not None:
+            entry["device"] = str(self.device)
+        return entry
+
+
+class ReplicaRouter:
+    """Round-robin (and β-nearest) dispatch over replica entries."""
+
+    def __init__(self, entries: Sequence[ReplicaEntry]):
+        if not entries:
+            raise ValueError("router needs at least one replica entry")
+        self.entries = list(entries)
+        self._rr = itertools.cycle(self.entries)
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------- routing
+    def route(self, beta: float | None = None) -> ReplicaEntry:
+        """Pick a replica: round-robin by default; with ``beta``, the entry
+        whose annealing endpoint is nearest in log-β (the grids are
+        log-spaced, so log distance is the natural metric; non-positive
+        operands fall back to linear distance)."""
+        if beta is None:
+            with self._lock:
+                return next(self._rr)
+        labeled = [e for e in self.entries if e.beta_end is not None]
+        if not labeled:
+            raise ValueError(
+                "beta-targeted routing needs β-labeled replicas "
+                "(serve a sweep checkpoint)"
+            )
+
+        def distance(entry: ReplicaEntry) -> float:
+            b = float(entry.beta_end)
+            if beta > 0 and b > 0:
+                return abs(math.log(b) - math.log(beta))
+            return abs(b - beta)
+
+        return min(labeled, key=distance)
+
+    def describe(self) -> list[dict]:
+        return [entry.describe() for entry in self.entries]
+
+    def close(self) -> None:
+        for entry in self.entries:
+            entry.batcher.close()
+
+    # -------------------------------------------------------- construction
+    @classmethod
+    def from_params(
+        cls,
+        model,
+        params,
+        devices=None,
+        batch_buckets: Sequence[int] = DEFAULT_BUCKETS,
+        telemetry=None,
+        registry=None,
+        tracer=None,
+        **batcher_kwargs,
+    ) -> "ReplicaRouter":
+        """One engine+batcher per local device (default: every local
+        device), all serving the same params."""
+        devices = list(devices) if devices is not None else jax.local_devices()
+        entries = []
+        for i, device in enumerate(devices):
+            engine = InferenceEngine(
+                model, params, batch_buckets=batch_buckets, device=device,
+                telemetry=telemetry, registry=registry,
+            )
+            batcher = MicroBatcher(engine, tracer=tracer, registry=registry,
+                                   **batcher_kwargs)
+            entries.append(ReplicaEntry(engine, batcher, i, device=device))
+        return cls(entries)
+
+    @classmethod
+    def from_sweep(
+        cls,
+        sweep,
+        states,
+        batch_buckets: Sequence[int] = DEFAULT_BUCKETS,
+        telemetry=None,
+        registry=None,
+        tracer=None,
+        **batcher_kwargs,
+    ) -> "ReplicaRouter":
+        """One β-labeled engine per sweep member, unstacked from the sweep's
+        [R, ...] state via ``BetaSweepTrainer.replica_state``."""
+        beta_ends = [float(b) for b in jax.device_get(sweep.beta_ends)]
+        entries = []
+        for r in range(sweep.num_replicas):
+            state_r = sweep.replica_state(states, r)
+            engine = InferenceEngine(
+                sweep.base.model, state_r.params["model"],
+                batch_buckets=batch_buckets, telemetry=telemetry,
+                registry=registry, beta_end=beta_ends[r],
+            )
+            batcher = MicroBatcher(engine, tracer=tracer, registry=registry,
+                                   **batcher_kwargs)
+            entries.append(
+                ReplicaEntry(engine, batcher, r, beta_end=beta_ends[r])
+            )
+        return cls(entries)

@@ -1,0 +1,189 @@
+"""Stdlib JSON HTTP server over a :class:`ReplicaRouter`.
+
+``ThreadingHTTPServer`` (one thread per connection) in front of the
+micro-batchers: concurrent client requests enter the batchers' queues and
+coalesce into padded engine dispatches — the server layer itself holds no
+model state and does no numeric work.
+
+Routes:
+
+  - ``POST /v1/predict``  ``{"x": row | rows, "beta"?: float,
+    "timeout_s"?: float}`` → posterior-mean predictions + per-example
+    per-channel KL (nats) from the routed replica.
+  - ``POST /v1/encode``   same request shape → per-feature Gaussian
+    channel parameters (``mus``/``logvars``).
+  - ``GET  /healthz``     liveness + the serving surface (feature width,
+    buckets, replica labels) — what a load generator needs to shape
+    traffic.
+  - ``GET  /metrics``     the ``MetricsRegistry`` snapshot (queue depth,
+    latency/fill histograms with p50/p99, dispatch counters) as JSON.
+
+Status mapping: client errors (shape/width/non-finite payloads) are 400;
+queue backpressure is 503 with ``Retry-After``; a request timeout is 504;
+everything else is 500. Errors are isolated per request — a malformed
+request cannot fail its batch-mates (see ``serve/batcher.py``).
+
+Telemetry: the server owns the run bracket (``run_start`` manifest with
+``mode: "serve"`` … ``run_end`` on graceful shutdown) and emits a final
+``metrics`` rollup, so a serving run directory summarizes and renders with
+the same ``telemetry summarize|report`` tooling as a training run.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from dib_tpu.serve.batcher import BatcherClosed, QueueFullError, RequestTimeout
+
+__all__ = ["DIBServer"]
+
+_DEFAULT_REQUEST_TIMEOUT_S = 30.0
+_MAX_BODY_BYTES = 8 << 20   # 8 MiB: ~1M f32 features as JSON text
+
+
+class DIBServer:
+    """Owns the HTTP listener, the router, and the run's telemetry bracket.
+
+    ``port=0`` binds an ephemeral port (tests, loadgen self-contained
+    mode); the bound port is ``self.port``. ``start()`` serves in a
+    daemon thread; ``close()`` drains the batchers, writes the final
+    metrics rollup + ``run_end``, and releases the socket — safe to call
+    twice (signal handler + finally).
+    """
+
+    def __init__(self, router, host: str = "127.0.0.1", port: int = 0,
+                 telemetry=None, registry=None):
+        self.router = router
+        self.telemetry = telemetry
+        self.registry = registry
+        self._closed = threading.Lock()
+        self._done = False
+        handler = _make_handler(self)
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.host, self.port = self.httpd.server_address[:2]
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, name="dib-serve-http",
+            daemon=True,
+        )
+
+    def start(self) -> "DIBServer":
+        self._thread.start()
+        return self
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def close(self) -> None:
+        with self._closed:
+            if self._done:
+                return
+            self._done = True
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        self._thread.join(timeout=10.0)
+        self.router.close()
+        if self.telemetry is not None:
+            if self.registry is not None:
+                from dib_tpu.telemetry.metrics import write_metrics
+
+                write_metrics(self.registry, self.telemetry)
+            self.telemetry.run_end(status="ok")
+            self.telemetry.close()
+
+    # ----------------------------------------------------------- app logic
+    def handle_get(self, path: str) -> tuple[int, dict]:
+        if path == "/healthz":
+            entry = self.router.entries[0]
+            return 200, {
+                "status": "ok",
+                "feature_width": entry.engine.feature_width,
+                "num_features": entry.engine.num_features,
+                "buckets": list(entry.engine.buckets),
+                "replicas": self.router.describe(),
+            }
+        if path == "/metrics":
+            return 200, (self.registry.snapshot()
+                         if self.registry is not None else {})
+        return 404, {"error": f"no route {path!r}"}
+
+    def handle_post(self, path: str, body: dict) -> tuple[int, dict]:
+        op = {"/v1/predict": "predict", "/v1/encode": "encode"}.get(path)
+        if op is None:
+            return 404, {"error": f"no route {path!r}"}
+        if not isinstance(body, dict) or "x" not in body:
+            return 400, {"error": 'request body must be {"x": row | rows}'}
+        beta = body.get("beta")
+        if beta is not None and not isinstance(beta, (int, float)):
+            return 400, {"error": '"beta" must be a number'}
+        timeout_s = body.get("timeout_s", _DEFAULT_REQUEST_TIMEOUT_S)
+        try:
+            entry = self.router.route(beta=beta)
+            result = entry.batcher(body["x"], op, timeout_s=float(timeout_s))
+        except QueueFullError as exc:
+            return 503, {"error": str(exc)}
+        except RequestTimeout as exc:
+            return 504, {"error": str(exc)}
+        except BatcherClosed as exc:
+            return 503, {"error": str(exc)}
+        except (ValueError, TypeError) as exc:
+            return 400, {"error": str(exc)}
+        payload = {key: np.asarray(value).tolist()
+                   for key, value in result.items()}
+        payload["replica"] = entry.describe()
+        return 200, payload
+
+
+def _make_handler(server: DIBServer):
+    """Handler class closed over the app object (the stdlib API wants a
+    class, the app wants instance state)."""
+
+    class Handler(BaseHTTPRequestHandler):
+        # keep client sockets from wedging a worker thread forever
+        timeout = 60
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):   # stdlib default spams stderr
+            pass
+
+        def _reply(self, status: int, payload: dict) -> None:
+            blob = json.dumps(payload).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(blob)))
+            if status == 503:
+                self.send_header("Retry-After", "1")
+            self.end_headers()
+            self.wfile.write(blob)
+
+        def do_GET(self):   # noqa: N802 (stdlib casing)
+            try:
+                status, payload = server.handle_get(self.path)
+            except Exception as exc:   # never let a bug kill the connection
+                status, payload = 500, {"error": f"{type(exc).__name__}: {exc}"}
+            self._reply(status, payload)
+
+        def do_POST(self):   # noqa: N802
+            try:
+                length = int(self.headers.get("Content-Length") or 0)
+                if length > _MAX_BODY_BYTES:
+                    # the unread body would desync a keep-alive socket (its
+                    # bytes become the "next request"); drop the connection
+                    self.close_connection = True
+                    self._reply(413, {"error": "request body too large"})
+                    return
+                try:
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                except json.JSONDecodeError as exc:
+                    self._reply(400, {"error": f"invalid JSON: {exc}"})
+                    return
+                status, payload = server.handle_post(self.path, body)
+            except Exception as exc:
+                status, payload = 500, {"error": f"{type(exc).__name__}: {exc}"}
+            self._reply(status, payload)
+
+    return Handler

@@ -48,6 +48,7 @@ from dib_tpu.telemetry.metrics import (
 )
 from dib_tpu.telemetry.summary import (
     compare,
+    serving_rollup,
     span_hotspots,
     span_rollup,
     summarize,
@@ -84,6 +85,7 @@ __all__ = [
     "read_events",
     "resolve_events_path",
     "runtime_manifest",
+    "serving_rollup",
     "shared_run_id",
     "span",
     "span_hotspots",

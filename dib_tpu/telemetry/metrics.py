@@ -15,6 +15,7 @@ Non-zero processes return without touching the file.
 
 from __future__ import annotations
 
+import threading
 from collections import deque
 
 __all__ = [
@@ -28,15 +29,23 @@ __all__ = [
 
 
 class Counter:
-    """Monotonically increasing count (events, steps, mitigations)."""
+    """Monotonically increasing count (events, steps, mitigations).
+
+    Updates are locked: the serving path (``dib_tpu/serve``) increments
+    from many batcher/HTTP threads at once, and an unlocked ``+=`` is a
+    read-modify-write that drops counts under contention. The training
+    path is single-threaded; an uncontended lock costs ~100 ns.
+    """
 
     def __init__(self):
         self.value = 0.0
+        self._lock = threading.Lock()
 
     def inc(self, amount: float = 1.0) -> None:
         if amount < 0:
             raise ValueError(f"Counter increments must be >= 0, got {amount}")
-        self.value += amount
+        with self._lock:
+            self.value += amount
 
 
 class Gauge:
@@ -46,7 +55,7 @@ class Gauge:
         self.value = 0.0
 
     def set(self, value: float) -> None:
-        self.value = float(value)
+        self.value = float(value)   # single store: atomic under the GIL
 
 
 class Histogram:
@@ -55,7 +64,7 @@ class Histogram:
     Tracks exact count/sum/min/max over the full stream and percentiles
     over the trailing ``window`` observations — chunk wall-clocks arrive a
     few thousand times per run at most, so a plain deque beats bucketing
-    complexity here.
+    complexity here. ``record``/``snapshot`` are locked (see Counter).
     """
 
     def __init__(self, window: int = 4096):
@@ -64,25 +73,29 @@ class Histogram:
         self.min = None
         self.max = None
         self._window = deque(maxlen=window)
+        self._lock = threading.Lock()
 
     def record(self, value: float) -> None:
         value = float(value)
-        self.count += 1
-        self.sum += value
-        self.min = value if self.min is None else min(self.min, value)
-        self.max = value if self.max is None else max(self.max, value)
-        self._window.append(value)
+        with self._lock:
+            self.count += 1
+            self.sum += value
+            self.min = value if self.min is None else min(self.min, value)
+            self.max = value if self.max is None else max(self.max, value)
+            self._window.append(value)
 
     def snapshot(self) -> dict:
-        out = {
-            "count": self.count,
-            "sum": self.sum,
-            "min": self.min if self.min is not None else 0.0,
-            "max": self.max if self.max is not None else 0.0,
-            "mean": self.sum / self.count if self.count else 0.0,
-        }
-        if self._window:
-            ordered = sorted(self._window)
+        with self._lock:
+            out = {
+                "count": self.count,
+                "sum": self.sum,
+                "min": self.min if self.min is not None else 0.0,
+                "max": self.max if self.max is not None else 0.0,
+                "mean": self.sum / self.count if self.count else 0.0,
+            }
+            window = list(self._window)
+        if window:
+            ordered = sorted(window)
             for name, q in (("p50", 0.5), ("p90", 0.9), ("p99", 0.99)):
                 out[name] = ordered[min(int(q * len(ordered)), len(ordered) - 1)]
         return out

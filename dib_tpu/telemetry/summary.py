@@ -37,8 +37,8 @@ from dib_tpu.telemetry.events import (
     read_events,
 )
 
-__all__ = ["summarize", "compare", "span_rollup", "span_hotspots",
-           "telemetry_main"]
+__all__ = ["summarize", "compare", "serving_rollup", "span_rollup",
+           "span_hotspots", "telemetry_main"]
 
 _LN2 = log(2.0)
 
@@ -131,6 +131,50 @@ def span_hotspots(rollup: dict, n: int = 3) -> list[dict]:
     ]
     rows.sort(key=lambda r: -r["self_s"])
     return rows[:n]
+
+
+def _percentile(ordered: list[float], q: float) -> float:
+    """Nearest-rank percentile over an already-sorted list (the histogram
+    convention in telemetry/metrics.py)."""
+    return ordered[min(int(q * len(ordered)), len(ordered) - 1)]
+
+
+def serving_rollup(span_events) -> dict | None:
+    """Latency/throughput view of a SERVING stream's ``request``/``batch``
+    spans (docs/serving.md): request count + status mix + latency
+    percentiles, micro-batch count + mean fill ratio. None when the stream
+    carries no serving spans (training runs)."""
+    requests = [e for e in span_events if e.get("name") == "request"]
+    batches = [e for e in span_events if e.get("name") == "batch"]
+    if not requests and not batches:
+        return None
+    out: dict = {}
+    if requests:
+        latencies = sorted(e.get("seconds") or 0.0 for e in requests)
+        statuses: dict[str, int] = {}
+        for e in requests:
+            s = e.get("status", "?")
+            statuses[s] = statuses.get(s, 0) + 1
+        span = (max(e.get("mono", 0.0) for e in requests)
+                - min(e.get("mono", 0.0) for e in requests))
+        out.update({
+            "requests": len(requests),
+            "rows": int(sum(e.get("rows") or 0 for e in requests)),
+            "statuses": statuses,
+            "request_p50_ms": round(_percentile(latencies, 0.5) * 1e3, 3),
+            "request_p99_ms": round(_percentile(latencies, 0.99) * 1e3, 3),
+            "request_mean_ms": round(
+                sum(latencies) / len(latencies) * 1e3, 3),
+        })
+        if span > 0:
+            out["requests_per_s"] = round(len(requests) / span, 3)
+    if batches:
+        fills = [e.get("fill") for e in batches
+                 if isinstance(e.get("fill"), (int, float))]
+        out["batches"] = len(batches)
+        if fills:
+            out["batch_fill_mean"] = round(sum(fills) / len(fills), 4)
+    return out
 
 
 def _utilization_rollup(compiles, rollup: dict, device_kind) -> dict:
@@ -373,6 +417,9 @@ def summarize(path: str, process_index: int | None = None,
                                    summary.get("device_kind"))
         if util:
             summary["utilization"] = util
+        serving = serving_rollup(span_events)
+        if serving:
+            summary["serving"] = serving
 
     mem_device = [((c.get("memory") or {}).get("peak_bytes_in_use"))
                   for c in chunks]

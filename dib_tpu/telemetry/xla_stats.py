@@ -37,6 +37,7 @@ __all__ = [
     "backend_peaks",
     "compiled_cost_stats",
     "cost_analysis_enabled",
+    "executable_cost_stats",
     "record_compile_event",
 ]
 
@@ -83,7 +84,19 @@ def compiled_cost_stats(jitfn, *args, **kwargs) -> dict | None:
     right before the first real invocation.
     """
     try:
-        analysis = jitfn.lower(*args, **kwargs).compile().cost_analysis()
+        compiled = jitfn.lower(*args, **kwargs).compile()
+    except Exception:
+        return None
+    return executable_cost_stats(compiled)
+
+
+def executable_cost_stats(compiled) -> dict | None:
+    """Cost stats of an ALREADY-compiled executable (the serve engine's AOT
+    path, which must not pay a second ``lower().compile()`` just to read
+    the numbers). Same degraded-to-None contract as
+    :func:`compiled_cost_stats`."""
+    try:
+        analysis = compiled.cost_analysis()
     except Exception:
         return None
     if isinstance(analysis, (list, tuple)):

@@ -21,7 +21,15 @@ from dib_tpu.train.hooks import (
     InfoPerFeatureHook,
     TimedHook,
 )
-from dib_tpu.train.checkpoint import DIBCheckpointer, CheckpointHook
+from dib_tpu.train.checkpoint import (
+    CHECKPOINT_SCHEMA_VERSION,
+    CheckpointHook,
+    DIBCheckpointer,
+    param_structure_hash,
+    read_manifest,
+    verify_manifest,
+    write_manifest,
+)
 from dib_tpu.train.measurement import (
     MeasurementCheckpointer,
     MeasurementConfig,

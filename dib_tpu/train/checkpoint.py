@@ -27,6 +27,8 @@ Usage::
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
 from typing import Any
 
@@ -35,6 +37,111 @@ import numpy as np
 import orbax.checkpoint as ocp
 
 from dib_tpu.train.history import history_init
+
+# Version of the {state, history, key, chunk_size} payload layout. Bumped
+# when the payload structure changes incompatibly; the manifest records it
+# so a reader from a different era fails with one line instead of a deep
+# Orbax structure error.
+CHECKPOINT_SCHEMA_VERSION = 1
+MANIFEST_FILENAME = "dib_manifest.json"
+
+
+def param_structure_rows(params) -> list[str]:
+    """Canonical ``"path shape dtype"`` row per param leaf, sorted.
+
+    The rows (not the arrays) define the checkpoint's structural identity:
+    two checkpoints are architecture-compatible iff their rows match. Used
+    for the manifest hash at save and the diff in restore's error message.
+    """
+    rows = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        shape = tuple(getattr(leaf, "shape", ()))
+        dtype = getattr(leaf, "dtype", type(leaf).__name__)
+        rows.append(
+            f"{jax.tree_util.keystr(path)} {list(shape)} {dtype}"
+        )
+    return sorted(rows)
+
+
+def param_structure_hash(params) -> str:
+    """Short stable hash of :func:`param_structure_rows`."""
+    blob = "\n".join(param_structure_rows(params))
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def write_manifest(directory: str, params) -> dict:
+    """Write the checkpoint-integrity manifest next to the step dirs.
+
+    Recorded once per checkpoint directory (rewritten on every save — the
+    structure cannot change mid-run): the payload schema version, the
+    param-tree structure hash, and the full row list so a mismatch at
+    restore can NAME the differing leaves instead of leaving the operator
+    with a deep pytree shape error.
+    """
+    manifest = {
+        "checkpoint_schema": CHECKPOINT_SCHEMA_VERSION,
+        "param_structure_hash": param_structure_hash(params),
+        "param_structure_rows": param_structure_rows(params),
+    }
+    path = os.path.join(directory, MANIFEST_FILENAME)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=1)
+        f.write("\n")
+    os.replace(tmp, path)
+    return manifest
+
+
+def read_manifest(directory: str) -> dict | None:
+    """The directory's integrity manifest, or None (pre-manifest era)."""
+    path = os.path.join(directory, MANIFEST_FILENAME)
+    try:
+        with open(path) as f:
+            manifest = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    return manifest if isinstance(manifest, dict) else None
+
+
+def verify_manifest(directory: str, params, context: str = "restore") -> None:
+    """Fail fast (and actionably) when ``params``' structure does not match
+    the checkpoint's recorded manifest.
+
+    No manifest (older checkpoint) verifies vacuously — the deep Orbax
+    error is then the best available behavior. A schema from a different
+    era and a structure mismatch each raise ``ValueError`` naming what
+    differs, so the operator fixes flags instead of decoding pytree paths.
+    """
+    manifest = read_manifest(directory)
+    if manifest is None:
+        return
+    schema = manifest.get("checkpoint_schema")
+    if schema != CHECKPOINT_SCHEMA_VERSION:
+        raise ValueError(
+            f"Checkpoint {directory} was written with checkpoint schema "
+            f"{schema!r} but this code reads schema "
+            f"{CHECKPOINT_SCHEMA_VERSION} — upgrade/downgrade dib_tpu to a "
+            f"matching version before {context}."
+        )
+    want = manifest.get("param_structure_hash")
+    got = param_structure_hash(params)
+    if want is not None and got != want:
+        saved = set(manifest.get("param_structure_rows") or [])
+        ours = set(param_structure_rows(params))
+        missing = sorted(saved - ours)[:4]
+        extra = sorted(ours - saved)[:4]
+        detail = []
+        if missing:
+            detail.append("checkpoint-only leaves: " + "; ".join(missing))
+        if extra:
+            detail.append("template-only leaves: " + "; ".join(extra))
+        raise ValueError(
+            f"Checkpoint {directory} holds a model with param structure "
+            f"{want} but the {context} template hashes to {got} — the "
+            f"architecture flags (layer widths, embedding dim, feature "
+            f"dimensionalities, optimizer) do not match the run that wrote "
+            f"the checkpoint. " + (" ".join(detail) if detail else "")
+        )
 
 
 def _pack_key(key: jax.Array) -> dict:
@@ -88,6 +195,10 @@ class DIBCheckpointer:
             # cannot know the continuation's hook_every unless told.)
             "chunk_size": np.asarray(chunk_size or 0, np.int32),
         }
+        # Integrity manifest BEFORE the (async) payload write: schema
+        # version + param-tree structure hash, so restore/serving can fail
+        # with an actionable one-liner instead of a deep pytree mismatch.
+        write_manifest(self.directory, state.params)
         # Async: the write overlaps the next training chunk; readers
         # (restore / latest_step) wait for in-flight saves first.
         self.manager.save(step, args=ocp.args.StandardSave(payload))
@@ -125,6 +236,10 @@ class DIBCheckpointer:
         # trainer.init is a cheap structure template (it runs the model once
         # on a single batch); Orbax restores into its shapes/dtypes.
         template_state, template_history = trainer.init(template_key)
+        # Structure gate first: a template built from the wrong architecture
+        # flags fails HERE, with the differing leaves named, rather than as
+        # an opaque Orbax shape error several layers down.
+        verify_manifest(self.directory, template_state.params)
         template = {
             "state": template_state,
             "history": template_history,

@@ -1,0 +1,352 @@
+"""Load generator for the DIB serving API (docs/serving.md).
+
+Drives ``/v1/predict`` with synthetic rows shaped by the server's own
+``/healthz`` surface and emits ONE bench-shaped JSON line (the repo's
+``metric``/``value``/``unit`` artifact schema, validated by
+``scripts/check_run_artifacts.py``): throughput, latency percentiles, and
+the server-side batch-fill ratio.
+
+Two traffic shapes:
+
+  - **closed loop** (default): ``--concurrency`` workers, each issuing its
+    next request when the previous one returns — measures the server at
+    its natural saturation for that client count.
+  - **open loop** (``--rate R``): requests are *scheduled* at R/s
+    regardless of completions, the honest way to measure queueing delay
+    under a fixed offered load (a closed loop self-throttles and hides
+    queue growth).
+
+Two targets:
+
+  - ``--url`` points at a running server (``python -m dib_tpu serve``);
+  - ``--self-contained`` trains a tiny boolean-circuit model for a few
+    epochs, checkpoints it, serves it in-process on an ephemeral port, and
+    load-tests that — the zero-setup CPU path CI and the committed
+    artifact use. ``--serve-run-dir`` keeps the serving event stream for
+    ``python -m dib_tpu telemetry report``.
+
+Usage::
+
+    python scripts/serve_loadgen.py --url http://127.0.0.1:8100 --duration 10
+    python scripts/serve_loadgen.py --self-contained --duration 3 --out BENCH_SERVE_CPU.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+METRIC = "serve_cpu_loadgen"
+
+
+def _get_json(url: str, timeout: float = 10.0) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _post_json(url: str, payload: dict, timeout: float = 30.0) -> tuple[int, dict]:
+    data = json.dumps(payload).encode()
+    request = urllib.request.Request(
+        url, data=data, headers={"Content-Type": "application/json"}
+    )
+    try:
+        with urllib.request.urlopen(request, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as exc:
+        try:
+            return exc.code, json.loads(exc.read())
+        except Exception:
+            return exc.code, {}
+
+
+class _Stats:
+    """Thread-safe latency/error accumulator."""
+
+    def __init__(self):
+        self.latencies: list[float] = []
+        self.errors = 0
+        self._lock = threading.Lock()
+
+    def ok(self, seconds: float) -> None:
+        with self._lock:
+            self.latencies.append(seconds)
+
+    def error(self) -> None:
+        with self._lock:
+            self.errors += 1
+
+
+def _percentile(ordered: list[float], q: float) -> float:
+    return ordered[min(int(q * len(ordered)), len(ordered) - 1)]
+
+
+def _one_request(url: str, row: list[float], stats: _Stats) -> None:
+    t0 = time.perf_counter()
+    try:
+        status, _ = _post_json(url + "/v1/predict", {"x": row})
+    except Exception:
+        stats.error()
+        return
+    if status == 200:
+        stats.ok(time.perf_counter() - t0)
+    else:
+        stats.error()
+
+
+def _make_rows(width: int, n: int = 64) -> list[list[float]]:
+    """Deterministic pseudo-input pool (no numpy needed at loadgen side)."""
+    rows = []
+    for i in range(n):
+        rows.append([((i * 31 + j * 7) % 13 - 6) / 6.0 for j in range(width)])
+    return rows
+
+
+def run_closed_loop(url: str, width: int, duration_s: float,
+                    concurrency: int) -> _Stats:
+    stats = _Stats()
+    rows = _make_rows(width)
+    deadline = time.perf_counter() + duration_s
+
+    def worker(seed: int) -> None:
+        i = seed
+        while time.perf_counter() < deadline:
+            _one_request(url, rows[i % len(rows)], stats)
+            i += 1
+
+    threads = [threading.Thread(target=worker, args=(t,), daemon=True)
+               for t in range(concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=duration_s + 60)
+    return stats
+
+
+def run_open_loop(url: str, width: int, duration_s: float,
+                  rate: float, max_inflight: int = 64) -> _Stats:
+    """Schedule sends at ``rate``/s; completions never gate the schedule
+    (bounded only by ``max_inflight`` so a dead server cannot spawn
+    unbounded threads)."""
+    stats = _Stats()
+    rows = _make_rows(width)
+    interval = 1.0 / rate
+    inflight = threading.Semaphore(max_inflight)
+    start = time.perf_counter()
+    threads = []
+    i = 0
+    while True:
+        target = start + i * interval
+        now = time.perf_counter()
+        if target - start >= duration_s:
+            break
+        if target > now:
+            time.sleep(target - now)
+        if not inflight.acquire(blocking=False):
+            stats.error()   # offered load exceeded what we can even send
+            i += 1
+            continue
+
+        def send(row):
+            try:
+                _one_request(url, row, stats)
+            finally:
+                inflight.release()
+
+        t = threading.Thread(target=send, args=(rows[i % len(rows)],),
+                             daemon=True)
+        t.start()
+        threads.append(t)
+        i += 1
+    for t in threads:
+        t.join(timeout=60)
+    return stats
+
+
+def _batch_fill_from_metrics(url: str) -> float | None:
+    try:
+        metrics = _get_json(url + "/metrics")
+        return metrics["histograms"]["serve.batch_fill"]["mean"]
+    except Exception:
+        return None
+
+
+def _self_contained_server(run_dir: str | None, train_epochs: int):
+    """Train a tiny model, checkpoint it, serve it in-process.
+
+    Returns ``(server, cleanup)`` — the checkpoint round-trip is part of
+    the point: the loadgen path exercises save → manifest-verified restore
+    → AOT compile, not just a params dict in memory.
+    """
+    import tempfile
+
+    import jax
+
+    from dib_tpu.data import get_dataset
+    from dib_tpu.models import DistributedIBModel
+    from dib_tpu.serve import DIBServer, ReplicaRouter
+    from dib_tpu.serve.engine import InferenceEngine
+    from dib_tpu.telemetry import (
+        EventWriter,
+        MetricsRegistry,
+        Tracer,
+        runtime_manifest,
+    )
+    from dib_tpu.train import (
+        CheckpointHook,
+        DIBCheckpointer,
+        DIBTrainer,
+        TrainConfig,
+    )
+
+    bundle = get_dataset("boolean_circuit")
+    model = DistributedIBModel(
+        feature_dimensionalities=tuple(bundle.feature_dimensionalities),
+        encoder_hidden=(16,), integration_hidden=(32,),
+        output_dim=1, embedding_dim=4,
+    )
+    config = TrainConfig(
+        batch_size=64, num_pretraining_epochs=train_epochs // 2,
+        num_annealing_epochs=train_epochs - train_epochs // 2,
+        steps_per_epoch=2, max_val_points=128,
+    )
+    trainer = DIBTrainer(model, bundle, config)
+    ckpt_dir = tempfile.mkdtemp(prefix="dib_serve_ckpt_")
+    ckpt = DIBCheckpointer(ckpt_dir)
+    trainer.fit(jax.random.key(0), hooks=[CheckpointHook(ckpt)],
+                hook_every=config.num_epochs)
+    ckpt.close()
+
+    writer = None
+    registry = MetricsRegistry()
+    if run_dir:
+        writer = EventWriter(run_dir)
+        writer.run_start(runtime_manifest(config=config, extra={
+            "mode": "serve", "dataset": "boolean_circuit",
+            "checkpoint_dir": ckpt_dir, "loadgen": "self_contained",
+        }))
+    tracer = Tracer(writer)
+    engine = InferenceEngine.from_checkpoint(
+        trainer, ckpt_dir, batch_buckets=(1, 8, 32),
+        telemetry=writer, registry=registry,
+    )
+    from dib_tpu.serve.batcher import MicroBatcher
+    from dib_tpu.serve.replicas import ReplicaEntry
+
+    batcher = MicroBatcher(engine, max_batch=32, max_wait_ms=2.0,
+                           tracer=tracer, registry=registry)
+    router = ReplicaRouter([ReplicaEntry(engine, batcher, 0)])
+    server = DIBServer(router, port=0, telemetry=writer,
+                       registry=registry).start()
+
+    def cleanup():
+        server.close()
+        import shutil
+
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+    return server, cleanup
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--url", default=None,
+                        help="Target server base URL (e.g. http://127.0.0.1:8100).")
+    parser.add_argument("--self-contained", action="store_true",
+                        help="Train+checkpoint+serve a tiny CPU model "
+                             "in-process and load-test that.")
+    parser.add_argument("--duration", type=float, default=5.0,
+                        help="Seconds of load.")
+    parser.add_argument("--concurrency", type=int, default=4,
+                        help="Closed-loop client threads.")
+    parser.add_argument("--rate", type=float, default=None,
+                        help="Open-loop offered load (requests/s); omits "
+                             "the closed loop.")
+    parser.add_argument("--train-epochs", type=int, default=20,
+                        help="Self-contained mode's training budget.")
+    parser.add_argument("--serve-run-dir", default=None,
+                        help="Self-contained mode: keep the serving event "
+                             "stream here (renderable by `python -m "
+                             "dib_tpu telemetry report`).")
+    parser.add_argument("--out", default=None,
+                        help="Also write the JSON record to this path.")
+    args = parser.parse_args(argv)
+
+    if bool(args.url) == bool(args.self_contained):
+        parser.error("pass exactly one of --url / --self-contained")
+
+    cleanup = None
+    if args.self_contained:
+        server, cleanup = _self_contained_server(
+            args.serve_run_dir, args.train_epochs
+        )
+        url = server.url
+    else:
+        url = args.url.rstrip("/")
+
+    record: dict = {"metric": METRIC, "unit": "req_per_s",
+                    "mode": "open" if args.rate else "closed",
+                    "duration_s": args.duration}
+    try:
+        health = _get_json(url + "/healthz")
+        width = int(health["feature_width"])
+        record["replicas"] = len(health.get("replicas", []))
+        t0 = time.perf_counter()
+        if args.rate:
+            stats = run_open_loop(url, width, args.duration, args.rate)
+            record["target_rate"] = args.rate
+        else:
+            stats = run_closed_loop(url, width, args.duration,
+                                    args.concurrency)
+            record["concurrency"] = args.concurrency
+        elapsed = time.perf_counter() - t0
+        record["batch_fill_ratio"] = _batch_fill_from_metrics(url)
+    except Exception as exc:
+        record.update({
+            "value": None,
+            "degraded": "loadgen_failed",
+            "error": f"{type(exc).__name__}: {exc}",
+        })
+        print(json.dumps(record), flush=True)
+        if cleanup is not None:
+            cleanup()
+        return 1
+
+    n = len(stats.latencies)
+    record["num_requests"] = n
+    record["errors"] = stats.errors
+    if n:
+        ordered = sorted(stats.latencies)
+        record["value"] = round(n / elapsed, 3)
+        record["latency_ms"] = {
+            "p50": round(_percentile(ordered, 0.5) * 1e3, 3),
+            "p90": round(_percentile(ordered, 0.9) * 1e3, 3),
+            "p99": round(_percentile(ordered, 0.99) * 1e3, 3),
+            "mean": round(sum(ordered) / n * 1e3, 3),
+        }
+    else:
+        record["value"] = None
+        record["degraded"] = "no_successful_requests"
+    record["measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    if cleanup is not None:
+        cleanup()   # graceful: drains batchers, writes run_end
+        if args.serve_run_dir:
+            record["serve_run_dir"] = args.serve_run_dir
+
+    line = json.dumps(record)
+    print(line, flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    return 0 if record.get("value") is not None else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

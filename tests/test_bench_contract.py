@@ -164,3 +164,49 @@ def test_save_cache_refreshes_when_env_matches_defaults(tmp_path, monkeypatch):
         for var in env_vars:
             monkeypatch.delenv(var, raising=False)
         importlib.reload(bench)
+
+
+def test_probe_retry_loop_capped_with_structured_failure(monkeypatch, capsys):
+    """ISSUE 3 satellite: a dead tunnel must not burn the whole budget on
+    identical probe hangs — the retry loop caps at
+    DIB_BENCH_MAX_PROBE_ATTEMPTS consecutive probe failures — and the
+    degraded record carries a machine-readable ``probe_failure`` field
+    instead of free-text-only tail noise (BENCH_r05)."""
+    import importlib
+
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    import bench
+
+    bench = importlib.reload(bench)
+    probes = []
+
+    def fake_probe(timeout_s):
+        probes.append(timeout_s)
+        return f"probe hung > {timeout_s}s (tunnel down?)"
+
+    monkeypatch.setenv("DIB_BENCH_MAX_PROBE_ATTEMPTS", "3")
+    # budget large enough for MANY probes: only the cap can stop the loop
+    monkeypatch.setenv("DIB_BENCH_TOTAL_BUDGET_S", "100000")
+    monkeypatch.setattr(bench, "probe_device", fake_probe)
+    monkeypatch.setattr(bench, "load_cache", lambda: None)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    bench.parent_main()
+
+    assert len(probes) == 3          # capped, not budget-bound
+    record = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert record["degraded"] == "no_device"
+    failure = record["probe_failure"]
+    assert failure["consecutive_probe_failures"] == 3
+    assert failure["max_probe_attempts"] == 3
+    assert failure["device_ever_up"] is False
+    assert "tunnel down" in failure["last_reason"]
+
+
+def test_probe_failure_field_in_budget_degraded_record():
+    """The structured field is present on the budget-exhausted path too."""
+    proc = run_bench({"DIB_BENCH_TOTAL_BUDGET_S": "1"})
+    assert proc.returncode == 0
+    record = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert "probe_failure" in record
+    assert record["probe_failure"]["attempts"] >= 1

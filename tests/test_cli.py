@@ -234,3 +234,67 @@ def test_cli_sweep_checkpoint_resume(tmp_path):
         with np.load(tmp_path / f"info_bounds_replica{r}.npz") as d:
             assert d["epochs"].tolist() == [5, 10, 15, 20, 25]
             assert int(d["resumed_from_epoch"]) == 15
+
+
+def test_subcommand_after_flags_exits_2_naming_flag(capsys):
+    """ISSUE 3 satellite: a subcommand parsed from a non-leading position
+    is a usage error — exit code 2 (argparse convention), with the flag
+    that displaced it NAMED in the message."""
+    from dib_tpu.cli import main
+
+    for command in ("telemetry", "workload", "serve"):
+        rc = main(["--seed", "1", command])
+        assert rc == 2
+        err = capsys.readouterr().err
+        assert f"'{command}' subcommand must come first" in err
+        assert "'--seed'" in err
+        assert f"python -m dib_tpu {command}" in err
+
+
+def test_subcommand_ordering_error_via_subprocess():
+    """The exit code survives the real entry point (`python -m dib_tpu`)."""
+    import subprocess
+    import sys as _sys
+
+    proc = subprocess.run(
+        [_sys.executable, "-m", "dib_tpu", "--seed", "1", "telemetry"],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 2
+    assert "subcommand must come first" in proc.stderr
+
+
+def test_serve_parser_shares_model_flag_surface():
+    """`dib_tpu serve` must accept the SAME model/architecture flags as
+    train (it rebuilds the checkpointed architecture from them), plus its
+    serving knobs."""
+    from dib_tpu.cli import serve_parser
+
+    args = serve_parser().parse_args([
+        "--checkpoint_dir", "/tmp/ck",
+        "--dataset", "boolean_circuit",
+        "--feature_encoder_architecture", "16",
+        "--integration_network_architecture", "32",
+        "--feature_embedding_dimension", "4",
+        "--port", "0", "--buckets", "1", "8",
+        "--max_batch", "16", "--max_wait_ms", "3",
+    ])
+    assert args.checkpoint_dir == "/tmp/ck"
+    assert args.feature_encoder_architecture == [16]
+    assert args.buckets == [1, 8]
+    assert args.max_batch == 16
+    # train-side defaults shared via _add_model_flags stay aligned
+    train_args = build_parser().parse_args([])
+    for flag in ("dataset", "activation_fn", "feature_embedding_dimension",
+                 "use_positional_encoding",
+                 "number_positional_encoding_frequencies", "compute_dtype"):
+        assert getattr(serve_parser().parse_args(
+            ["--checkpoint_dir", "x"]), flag) == getattr(train_args, flag)
+
+
+def test_serve_requires_checkpoint_dir():
+    from dib_tpu.cli import serve_parser
+
+    with pytest.raises(SystemExit):
+        serve_parser().parse_args([])

@@ -1,0 +1,371 @@
+"""Serving engine + micro-batcher + replica routing (docs/serving.md).
+
+The load-bearing contract is SEMANTIC INVISIBILITY of batching: for the
+same checkpoint and input, a padded micro-batch must produce bit-identical
+(CPU, f32) results to the single-request path. Everything else — timeouts,
+backpressure, error isolation, β routing — is the operational surface the
+batcher promises around that.
+"""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from dib_tpu.data import get_dataset
+from dib_tpu.models import DistributedIBModel
+from dib_tpu.serve import (
+    BatcherClosed,
+    InferenceEngine,
+    MicroBatcher,
+    QueueFullError,
+    ReplicaEntry,
+    ReplicaRouter,
+    RequestTimeout,
+)
+
+
+@pytest.fixture(scope="module")
+def bundle():
+    return get_dataset("boolean_circuit")
+
+
+@pytest.fixture(scope="module")
+def model(bundle):
+    return DistributedIBModel(
+        feature_dimensionalities=tuple(bundle.feature_dimensionalities),
+        encoder_hidden=(8,), integration_hidden=(16,),
+        output_dim=1, embedding_dim=2,
+    )
+
+
+@pytest.fixture(scope="module")
+def params(bundle, model):
+    x0 = np.asarray(bundle.x_train[:4], np.float32)
+    return model.init(jax.random.key(0), x0, jax.random.key(1))
+
+
+@pytest.fixture(scope="module")
+def engine(model, params):
+    return InferenceEngine(model, params, batch_buckets=(1, 4, 8))
+
+
+@pytest.fixture(scope="module")
+def rows(bundle):
+    return np.asarray(bundle.x_valid[:8], np.float32)
+
+
+# ------------------------------------------------------------------ engine
+def test_padded_batch_bit_identical_to_single(engine, rows):
+    """The acceptance contract: padding/bucketing is semantically
+    invisible — full-batch results equal per-row results EXACTLY."""
+    batch = engine.predict(rows[:6])          # pads 6 -> bucket 8
+    for i in range(6):
+        single = engine.predict(rows[i])      # bucket 1, no padding
+        np.testing.assert_array_equal(single["prediction"][0],
+                                      batch["prediction"][i])
+        np.testing.assert_array_equal(single["kl_per_feature"][0],
+                                      batch["kl_per_feature"][i])
+    enc_batch = engine.encode(rows[:6])
+    for i in range(6):
+        enc_single = engine.encode(rows[i])
+        np.testing.assert_array_equal(enc_single["mus"][0],
+                                      enc_batch["mus"][i])
+        np.testing.assert_array_equal(enc_single["logvars"][0],
+                                      enc_batch["logvars"][i])
+
+
+def test_engine_determinism_and_shapes(engine, rows):
+    a = engine.predict(rows[:3])
+    b = engine.predict(rows[:3])
+    np.testing.assert_array_equal(a["prediction"], b["prediction"])
+    assert a["prediction"].shape == (3, 1)
+    assert a["kl_per_feature"].shape == (3, engine.num_features)
+    enc = engine.encode(rows[:2])
+    assert enc["mus"].shape == (2, engine.num_features, 2)
+    # KL is a non-negative information quantity
+    assert np.all(a["kl_per_feature"] >= 0)
+
+
+def test_engine_bucket_selection(engine):
+    assert engine.bucket_for(1) == 1
+    assert engine.bucket_for(2) == 4
+    assert engine.bucket_for(5) == 8
+    assert engine.bucket_for(999) == 8   # top bucket; dispatch chunks
+
+
+def test_engine_chunks_oversize_batches(engine, bundle):
+    """Requests beyond the top bucket run in top-bucket chunks with
+    results concatenated — and stay bit-identical to per-row dispatch."""
+    big = np.asarray(bundle.x_valid[:19], np.float32)
+    out = engine.predict(big)
+    assert out["prediction"].shape[0] == 19
+    single = engine.predict(big[17])
+    np.testing.assert_array_equal(out["prediction"][17],
+                                  single["prediction"][0])
+
+
+def test_engine_rejects_bad_width(engine):
+    with pytest.raises(ValueError, match="width"):
+        engine.predict(np.zeros((2, 3), np.float32))
+
+
+# ----------------------------------------------------------------- batcher
+def test_batcher_results_match_engine_under_concurrency(engine, rows):
+    """Thread-pool clients racing through the batcher get EXACTLY what a
+    direct engine call would return — coalescing and padding never leak."""
+    batcher = MicroBatcher(engine, max_batch=8, max_wait_ms=5.0)
+    want = engine.predict(rows)
+    results: dict[int, dict] = {}
+    errors: list = []
+
+    def client(i: int):
+        try:
+            results[i] = batcher(rows[i], timeout_s=30.0)
+        except Exception as exc:   # pragma: no cover - fails the test below
+            errors.append(exc)
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    batcher.close()
+    assert not errors
+    assert sorted(results) == list(range(8))
+    for i in range(8):
+        np.testing.assert_array_equal(results[i]["prediction"][0],
+                                      want["prediction"][i])
+        np.testing.assert_array_equal(results[i]["kl_per_feature"][0],
+                                      want["kl_per_feature"][i])
+
+
+def test_batcher_coalesces_into_shared_buckets(engine, rows):
+    """Concurrent single-row requests actually share micro-batches (the
+    whole point of the batcher): with 8 clients and max_wait to spare,
+    dispatches must number well below requests."""
+    from dib_tpu.telemetry.metrics import MetricsRegistry
+
+    registry = MetricsRegistry()
+    batcher = MicroBatcher(engine, max_batch=8, max_wait_ms=50.0,
+                           registry=registry)
+    threads = [
+        threading.Thread(target=lambda i=i: batcher(rows[i], timeout_s=30.0))
+        for i in range(8)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    batcher.close()
+    snapshot = registry.snapshot()
+    assert snapshot["counters"]["serve.requests.ok"] == 8
+    assert snapshot["counters"]["serve.batches"] < 8
+    assert snapshot["histograms"]["serve.batch_rows"]["max"] > 1
+
+
+class _SlowEngine:
+    """Engine stub with a controllable stall (timeout/backpressure tests
+    must not depend on real dispatch being slow)."""
+
+    feature_width = 4
+    max_bucket = 8
+
+    def __init__(self, stall_s: float = 0.0):
+        self.stall_s = stall_s
+        self.release = threading.Event()
+
+    def bucket_for(self, n: int) -> int:
+        return 8
+
+    def predict(self, x):
+        if self.stall_s:
+            time.sleep(self.stall_s)
+        return {"prediction": np.asarray(x)[:, :1]}
+
+    encode = predict
+
+
+def test_batcher_request_timeout(engine, rows):
+    """A request whose deadline passes while queued is completed with
+    RequestTimeout and never dispatched."""
+    slow = _SlowEngine(stall_s=0.3)
+    batcher = MicroBatcher(slow, max_batch=1, max_wait_ms=0.0)
+    # first request occupies the worker for ~0.3s...
+    first = batcher.submit(np.zeros(4, np.float32), timeout_s=30.0)
+    # ...second expires in the queue behind it
+    second = batcher.submit(np.zeros(4, np.float32), timeout_s=0.01)
+    assert first.result(10.0) is not None
+    with pytest.raises(RequestTimeout):
+        second.result(10.0)
+    batcher.close()
+
+
+def test_batcher_client_side_wait_timeout():
+    slow = _SlowEngine(stall_s=0.5)
+    batcher = MicroBatcher(slow, max_batch=1, max_wait_ms=0.0)
+    request = batcher.submit(np.zeros(4, np.float32))
+    with pytest.raises(RequestTimeout):
+        request.result(0.01)    # result not ready within the client wait
+    batcher.close()
+
+
+def test_batcher_queue_full_backpressure():
+    from dib_tpu.telemetry.metrics import MetricsRegistry
+
+    registry = MetricsRegistry()
+    slow = _SlowEngine(stall_s=0.2)
+    batcher = MicroBatcher(slow, max_batch=1, max_wait_ms=0.0, max_queue=2,
+                           registry=registry)
+    submitted = [batcher.submit(np.zeros(4, np.float32))
+                 for _ in range(2)]
+    with pytest.raises(QueueFullError):
+        for _ in range(8):   # worker may drain one; the bound must hold
+            batcher.submit(np.zeros(4, np.float32))
+            time.sleep(0)
+    # shed load is VISIBLE: rejected requests land in the metrics
+    assert registry.snapshot()["counters"]["serve.requests.rejected"] >= 1
+    batcher.close()
+    for request in submitted:
+        request.result(10.0)
+
+
+def test_batcher_fill_capped_at_one_for_oversize_requests(engine, rows, bundle):
+    """A single request larger than the top bucket chunks inside the
+    engine; the recorded fill ratio must stay an honest <= 1 fraction of
+    the padded capacity actually allocated."""
+    from dib_tpu.telemetry.metrics import MetricsRegistry
+
+    registry = MetricsRegistry()
+    batcher = MicroBatcher(engine, max_batch=8, max_wait_ms=0.0,
+                           registry=registry)
+    big = np.asarray(bundle.x_valid[:19], np.float32)   # top bucket is 8
+    batcher(big, timeout_s=30.0)
+    fills = registry.snapshot()["histograms"]["serve.batch_fill"]
+    assert 0 < fills["max"] <= 1.0
+    # 19 rows -> chunks 8+8+3 padded to 8+8+4 = 20 allocated rows
+    assert fills["max"] == pytest.approx(19 / 20)
+    batcher.close()
+
+
+def test_batcher_rejects_malformed_at_submit(engine):
+    batcher = MicroBatcher(engine, max_batch=4, max_wait_ms=0.0)
+    with pytest.raises(ValueError, match="width"):
+        batcher.submit(np.zeros(3, np.float32))
+    with pytest.raises(ValueError, match="non-finite"):
+        batcher.submit(np.full(engine.feature_width, np.nan, np.float32))
+    with pytest.raises(ValueError, match="op"):
+        batcher.submit(np.zeros(engine.feature_width, np.float32), op="nope")
+    batcher.close()
+
+
+class _FaultyEngine:
+    """Fails any batch containing a poisoned row — per-request isolation
+    must shield batch-mates."""
+
+    feature_width = 4
+    max_bucket = 8
+
+    def bucket_for(self, n: int) -> int:
+        return 8
+
+    def predict(self, x):
+        if np.any(np.asarray(x) > 100.0):
+            raise RuntimeError("poisoned row")
+        return {"prediction": np.asarray(x)[:, :1]}
+
+    encode = predict
+
+
+def test_batcher_error_isolation(monkeypatch):
+    """One failing request in a coalesced batch must not fail its
+    batch-mates: the batch is retried per-request, and only the guilty
+    request carries the error."""
+    batcher = MicroBatcher(_FaultyEngine(), max_batch=8, max_wait_ms=50.0)
+    good1 = batcher.submit(np.ones(4, np.float32), timeout_s=30.0)
+    bad = batcher.submit(np.full(4, 999.0, np.float32), timeout_s=30.0)
+    good2 = batcher.submit(np.full(4, 2.0, np.float32), timeout_s=30.0)
+    assert good1.result(10.0)["prediction"][0][0] == 1.0
+    assert good2.result(10.0)["prediction"][0][0] == 2.0
+    with pytest.raises(RuntimeError, match="poisoned"):
+        bad.result(10.0)
+    batcher.close()
+
+
+def test_batcher_close_rejects_new_and_fails_queued():
+    slow = _SlowEngine(stall_s=0.2)
+    batcher = MicroBatcher(slow, max_batch=1, max_wait_ms=0.0)
+    batcher.submit(np.zeros(4, np.float32))
+    batcher.close()
+    with pytest.raises(BatcherClosed):
+        batcher.submit(np.zeros(4, np.float32))
+
+
+def test_batcher_multirow_requests_split_correctly(engine, rows):
+    batcher = MicroBatcher(engine, max_batch=8, max_wait_ms=1.0)
+    want = engine.predict(rows[:5])
+    got = batcher(rows[:5], timeout_s=30.0)
+    np.testing.assert_array_equal(got["prediction"], want["prediction"])
+    batcher.close()
+
+
+# ---------------------------------------------------------------- replicas
+def _entry(engine, index, beta_end=None):
+    return ReplicaEntry(engine, MicroBatcher(engine, max_wait_ms=0.0),
+                        index, beta_end=beta_end)
+
+
+def test_router_round_robin(engine):
+    router = ReplicaRouter([_entry(engine, 0), _entry(engine, 1),
+                            _entry(engine, 2)])
+    picks = [router.route().index for _ in range(6)]
+    assert picks == [0, 1, 2, 0, 1, 2]
+    router.close()
+
+
+def test_router_beta_nearest_log(engine):
+    router = ReplicaRouter([
+        _entry(engine, 0, beta_end=0.01),
+        _entry(engine, 1, beta_end=0.1),
+        _entry(engine, 2, beta_end=1.0),
+    ])
+    assert router.route(beta=0.012).index == 0
+    # log-space nearest: 0.32 is closer to 0.1 than to 1.0 in log β
+    assert router.route(beta=0.31).index == 1
+    assert router.route(beta=5.0).index == 2
+    router.close()
+
+
+def test_router_beta_requires_labels(engine):
+    router = ReplicaRouter([_entry(engine, 0)])
+    with pytest.raises(ValueError, match="label"):
+        router.route(beta=0.5)
+    router.close()
+
+
+def test_router_from_sweep_serves_each_member(bundle, model):
+    """β-sweep serving: each member's engine returns that member's params'
+    outputs (bit-identical to the unstacked replica state)."""
+    from dib_tpu.parallel import BetaSweepTrainer
+    from dib_tpu.train import TrainConfig
+
+    config = TrainConfig(batch_size=32, num_pretraining_epochs=1,
+                         num_annealing_epochs=1, steps_per_epoch=1,
+                         max_val_points=64)
+    sweep = BetaSweepTrainer(model, bundle, config, 1e-4, [0.1, 1.0])
+    keys = jax.random.split(jax.random.key(5), 2)
+    states, _ = sweep.init(keys)
+    router = ReplicaRouter.from_sweep(sweep, states, batch_buckets=(1, 4),
+                                      max_wait_ms=0.0)
+    assert [e.beta_end for e in router.entries] == [
+        pytest.approx(0.1), pytest.approx(1.0)]
+    x = np.asarray(bundle.x_valid[:2], np.float32)
+    for r, entry in enumerate(router.entries):
+        state_r = sweep.replica_state(states, r)
+        want = InferenceEngine(model, state_r.params["model"],
+                               batch_buckets=(4,)).predict(x)
+        got = entry.batcher(x, timeout_s=30.0)
+        np.testing.assert_array_equal(got["prediction"], want["prediction"])
+    router.close()

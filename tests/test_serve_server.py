@@ -1,0 +1,299 @@
+"""HTTP serving surface + the tier-1 CPU serving smoke test.
+
+The smoke test is the CI gate the serving subsystem ships behind: an
+in-process server, a handful of concurrent requests through the REAL
+batcher, then assertions that the latency events landed on the run's
+events.jsonl and that ``telemetry summarize`` / ``telemetry report``
+accept the stream — a serving run dir is a first-class telemetry run.
+"""
+
+import json
+import os
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from dib_tpu.data import get_dataset
+from dib_tpu.models import DistributedIBModel
+from dib_tpu.serve import (
+    DIBServer,
+    InferenceEngine,
+    MicroBatcher,
+    ReplicaEntry,
+    ReplicaRouter,
+)
+from dib_tpu.telemetry import (
+    EventWriter,
+    MetricsRegistry,
+    Tracer,
+    read_events,
+    runtime_manifest,
+    summarize,
+)
+
+
+@pytest.fixture(scope="module")
+def bundle():
+    return get_dataset("boolean_circuit")
+
+
+@pytest.fixture(scope="module")
+def model(bundle):
+    return DistributedIBModel(
+        feature_dimensionalities=tuple(bundle.feature_dimensionalities),
+        encoder_hidden=(8,), integration_hidden=(16,),
+        output_dim=1, embedding_dim=2,
+    )
+
+
+@pytest.fixture(scope="module")
+def params(bundle, model):
+    x0 = np.asarray(bundle.x_train[:4], np.float32)
+    return model.init(jax.random.key(0), x0, jax.random.key(1))
+
+
+def _post(url: str, payload: dict) -> tuple[int, dict]:
+    request = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(request, timeout=30) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as exc:
+        return exc.code, json.loads(exc.read())
+
+
+def _get(url: str) -> tuple[int, dict]:
+    with urllib.request.urlopen(url, timeout=30) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _serving_stack(model, params, run_dir=None, beta_ends=(None,)):
+    """An in-process server over `len(beta_ends)` entries sharing params."""
+    writer = registry = tracer = None
+    if run_dir is not None:
+        writer = EventWriter(run_dir)
+        writer.run_start(runtime_manifest(extra={"mode": "serve"}))
+        registry = MetricsRegistry()
+        tracer = Tracer(writer)
+    entries = []
+    for i, beta_end in enumerate(beta_ends):
+        engine = InferenceEngine(model, params, batch_buckets=(1, 4),
+                                 telemetry=writer, registry=registry,
+                                 beta_end=beta_end)
+        batcher = MicroBatcher(engine, max_batch=4, max_wait_ms=1.0,
+                               tracer=tracer, registry=registry)
+        entries.append(ReplicaEntry(engine, batcher, i, beta_end=beta_end))
+    router = ReplicaRouter(entries)
+    server = DIBServer(router, port=0, telemetry=writer,
+                       registry=registry).start()
+    return server, registry
+
+
+def test_serving_smoke_end_to_end(model, params, bundle, tmp_path):
+    """THE serving CI gate (ISSUE 3 satellite): in-process server, real
+    batcher, concurrent requests; latency events land on events.jsonl;
+    summarize and report both accept the serving stream."""
+    run_dir = str(tmp_path / "serve_run")
+    server, registry = _serving_stack(model, params, run_dir=run_dir)
+    rows = np.asarray(bundle.x_valid[:6], np.float32)
+    statuses = []
+
+    def client(i):
+        status, payload = _post(server.url + "/v1/predict",
+                                {"x": rows[i].tolist()})
+        statuses.append((status, payload))
+
+    threads = [threading.Thread(target=client, args=(i,)) for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert [s for s, _ in statuses] == [200] * 6
+    # responses carry the served quantities
+    for _, payload in statuses:
+        assert len(payload["prediction"]) == 1
+        assert len(payload["kl_per_feature"][0]) == model.num_features
+    status, enc = _post(server.url + "/v1/encode", {"x": rows[0].tolist()})
+    assert status == 200 and "mus" in enc and "logvars" in enc
+
+    # graceful shutdown writes the final metrics rollup + run_end
+    server.close()
+
+    events = list(read_events(run_dir))
+    types = [e["type"] for e in events]
+    assert types[0] == "run_start" and types[-1] == "run_end"
+    compiles = [e for e in events if e["type"] == "compile"]
+    assert {c["name"] for c in compiles} == {"serve.predict", "serve.encode"}
+    assert all(c["cache"] == "aot" for c in compiles)
+    spans = [e for e in events if e["type"] == "span"]
+    request_spans = [e for e in spans if e["name"] == "request"]
+    batch_spans = [e for e in spans if e["name"] == "batch"]
+    assert len(request_spans) == 7          # 6 predicts + 1 encode
+    assert all(e["status"] == "ok" and e["seconds"] >= 0
+               for e in request_spans)
+    assert batch_spans and all(0 < e["fill"] <= 1 for e in batch_spans)
+    # every request was served by some batch (coalescing itself is pinned
+    # deterministically in test_serve.py::test_batcher_coalesces_...)
+    assert len(batch_spans) <= len(request_spans)
+    assert sum(e["rows"] for e in batch_spans) == 7
+    assert any(e["type"] == "metrics" for e in events)
+
+    # `telemetry summarize` accepts the stream and rolls up serving stats
+    summary = summarize(run_dir)
+    assert summary["status"] == "ok"
+    serving = summary["serving"]
+    assert serving["requests"] == 7
+    assert serving["statuses"] == {"ok": 7}
+    assert serving["request_p99_ms"] >= serving["request_p50_ms"] >= 0
+    assert serving["batches"] == len(batch_spans)
+
+    # and `telemetry report` renders the serving run dir
+    from dib_tpu.telemetry.report import write_report
+
+    out = write_report(run_dir)
+    assert os.path.exists(out) and os.path.getsize(out) > 1000
+
+
+def test_http_error_mapping(model, params):
+    server, _ = _serving_stack(model, params)
+    try:
+        width = server.router.entries[0].engine.feature_width
+        # wrong width -> 400 with the validation message
+        status, payload = _post(server.url + "/v1/predict",
+                                {"x": [1.0, 2.0]})
+        assert status == 400 and "width" in payload["error"]
+        # missing x -> 400
+        status, _ = _post(server.url + "/v1/predict", {"rows": [1.0]})
+        assert status == 400
+        # non-finite payload -> 400 (isolated at submit, never dispatched)
+        status, payload = _post(server.url + "/v1/predict",
+                                {"x": [float("nan")] * width})
+        assert status == 400 and "non-finite" in payload["error"]
+        # unknown routes -> 404
+        status, _ = _post(server.url + "/v1/nope", {"x": [0.0] * width})
+        assert status == 404
+        with pytest.raises(urllib.error.HTTPError) as excinfo:
+            urllib.request.urlopen(server.url + "/nope", timeout=30)
+        assert excinfo.value.code == 404
+        # malformed JSON body -> 400
+        request = urllib.request.Request(
+            server.url + "/v1/predict", data=b"{not json",
+            headers={"Content-Type": "application/json"},
+        )
+        with pytest.raises(urllib.error.HTTPError) as excinfo:
+            urllib.request.urlopen(request, timeout=30)
+        assert excinfo.value.code == 400
+    finally:
+        server.close()
+
+
+def test_healthz_and_metrics_surface(model, params):
+    server, _ = _serving_stack(model, params)
+    try:
+        status, health = _get(server.url + "/healthz")
+        assert status == 200 and health["status"] == "ok"
+        assert health["feature_width"] == sum(
+            model.feature_dimensionalities)
+        assert health["buckets"] == [1, 4]
+        width = health["feature_width"]
+        _post(server.url + "/v1/predict", {"x": [0.0] * width})
+        status, metrics = _get(server.url + "/metrics")
+        assert status == 200
+        # no registry attached in this stack -> permitted empty; with one
+        # the counters appear (covered by the smoke test's metrics event)
+        assert isinstance(metrics, dict)
+    finally:
+        server.close()
+
+
+def test_beta_routing_over_http(model, params):
+    """A client asking for "the model at β≈x" reaches the replica whose
+    annealing endpoint is log-nearest."""
+    server, _ = _serving_stack(model, params, beta_ends=(0.01, 1.0))
+    try:
+        width = server.router.entries[0].engine.feature_width
+        row = [0.0] * width
+        status, payload = _post(server.url + "/v1/predict",
+                                {"x": row, "beta": 0.02})
+        assert status == 200 and payload["replica"]["beta_end"] == 0.01
+        status, payload = _post(server.url + "/v1/predict",
+                                {"x": row, "beta": 3.0})
+        assert status == 200 and payload["replica"]["beta_end"] == 1.0
+        status, payload = _post(server.url + "/v1/predict",
+                                {"x": row, "beta": "high"})
+        assert status == 400
+    finally:
+        server.close()
+
+
+def test_loadgen_closed_loop_against_live_server(model, params):
+    """The load generator's client loop drives a real server and records
+    finite latencies (full self-contained mode is exercised by the
+    committed artifact; this keeps the client path under CI)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "serve_loadgen",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "scripts", "serve_loadgen.py"),
+    )
+    loadgen = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(loadgen)
+
+    server, _ = _serving_stack(model, params)
+    try:
+        width = server.router.entries[0].engine.feature_width
+        stats = loadgen.run_closed_loop(server.url, width,
+                                        duration_s=0.5, concurrency=2)
+        assert len(stats.latencies) > 0
+        assert stats.errors == 0
+        assert all(s >= 0 for s in stats.latencies)
+    finally:
+        server.close()
+
+
+def test_engine_from_checkpoint_roundtrip(model, bundle, tmp_path):
+    """Serve-side checkpoint loading: restore + manifest verification +
+    bit-identical predictions from the restored params; an engine built
+    with MISMATCHED architecture flags fails with the actionable
+    manifest error, not a deep pytree mismatch."""
+    from dib_tpu.train import (
+        CheckpointHook,
+        DIBCheckpointer,
+        DIBTrainer,
+        TrainConfig,
+    )
+
+    config = TrainConfig(batch_size=32, num_pretraining_epochs=2,
+                         num_annealing_epochs=2, steps_per_epoch=1,
+                         max_val_points=64)
+    trainer = DIBTrainer(model, bundle, config)
+    ckpt_dir = str(tmp_path / "ck")
+    ckpt = DIBCheckpointer(ckpt_dir)
+    state, _ = trainer.fit(jax.random.key(3), hooks=[CheckpointHook(ckpt)],
+                           hook_every=4)
+    ckpt.close()
+
+    engine = InferenceEngine.from_checkpoint(trainer, ckpt_dir,
+                                             batch_buckets=(1, 4))
+    direct = InferenceEngine(model, jax.device_get(state.params["model"]),
+                             batch_buckets=(1, 4))
+    x = np.asarray(bundle.x_valid[:3], np.float32)
+    np.testing.assert_array_equal(engine.predict(x)["prediction"],
+                                  direct.predict(x)["prediction"])
+
+    wrong_model = DistributedIBModel(
+        feature_dimensionalities=tuple(bundle.feature_dimensionalities),
+        encoder_hidden=(12,), integration_hidden=(16,),   # wrong width
+        output_dim=1, embedding_dim=2,
+    )
+    wrong_trainer = DIBTrainer(wrong_model, bundle, config)
+    with pytest.raises(ValueError, match="param structure"):
+        InferenceEngine.from_checkpoint(wrong_trainer, ckpt_dir,
+                                        batch_buckets=(1,))
