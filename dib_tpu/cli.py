@@ -294,6 +294,13 @@ def run(args, compile_cache_status: str | None = None) -> dict:
             ))
         return hooks, info_hook
 
+    # Deterministic fault injection (docs/robustness.md): DIB_FAULT_PLAN
+    # arms chunk-boundary faults inside fit; fired-markers persist in the
+    # run dir so a fault survives its own SIGKILL exactly once.
+    from dib_tpu.faults import FaultPlan
+
+    fault_plan = FaultPlan.from_env(state_dir=outdir)
+
     entropy_y = None
     y_arr = np.asarray(bundle.y_train)
     if (bundle.loss_is_info_based and not contrastive
@@ -351,8 +358,9 @@ def run(args, compile_cache_status: str | None = None) -> dict:
             ckpt = DIBCheckpointer(args.checkpoint_dir)
             hooks.append(Every(args.checkpoint_frequency, CheckpointHook(ckpt)))
             if ckpt.latest_step is not None:
-                resume_states, resume_histories, keys = ckpt.restore(
-                    sweep, chunk_size=hook_every
+                resume_states, resume_histories, keys = ckpt.restore_latest_intact(
+                    sweep, chunk_size=hook_every,
+                    on_fallback=_ckpt_fallback_reporter(telemetry),
                 )
                 done = int(np.max(jax.device_get(resume_states.epoch)))
                 remaining = max(config.num_epochs - done, 0)
@@ -366,6 +374,10 @@ def run(args, compile_cache_status: str | None = None) -> dict:
                 print(f"resuming sweep from checkpoint at epoch {done} "
                       f"({remaining} to go)", file=sys.stderr)
         hooks = _timed(hooks)
+        if fault_plan:
+            print("warning: DIB_FAULT_PLAN is set but the sweep fit has no "
+                  "injection points — the plan is ignored (train serial, or "
+                  "drill through scripts/fault_drill.py)", file=sys.stderr)
         states, records = sweep.fit(keys, num_epochs=remaining, hooks=hooks,
                                     hook_every=hook_every,
                                     states=resume_states,
@@ -418,8 +430,12 @@ def run(args, compile_cache_status: str | None = None) -> dict:
             ckpt = DIBCheckpointer(args.checkpoint_dir)
             hooks.append(Every(args.checkpoint_frequency, CheckpointHook(ckpt)))
             if ckpt.latest_step is not None:
-                resume_state, resume_history, fit_key = ckpt.restore(
-                    trainer, chunk_size=hook_every
+                # newest INTACT step: a step dir truncated by the kill that
+                # triggered this very relaunch must not crash-loop the
+                # watchdog — fall back and re-train the gap instead
+                resume_state, resume_history, fit_key = ckpt.restore_latest_intact(
+                    trainer, chunk_size=hook_every,
+                    on_fallback=_ckpt_fallback_reporter(telemetry),
                 )
                 done = int(jax.device_get(resume_state.epoch))
                 remaining = max(config.num_epochs - done, 0)
@@ -442,7 +458,8 @@ def run(args, compile_cache_status: str | None = None) -> dict:
                                      hooks=hooks, hook_every=hook_every,
                                      state=resume_state,
                                      history=resume_history,
-                                     telemetry=telemetry)
+                                     telemetry=telemetry,
+                                     fault_plan=fault_plan)
         bits = history.to_bits(bundle.loss_is_info_based)
         path = save_distributed_info_plane(
             bits.kl_per_feature, bits.loss, outdir, entropy_y=entropy_y)
@@ -471,6 +488,21 @@ def run(args, compile_cache_status: str | None = None) -> dict:
         json.dump(summary, f, indent=1)
         f.write("\n")
     return summary
+
+
+def _ckpt_fallback_reporter(telemetry):
+    """on_fallback for ``restore_latest_intact``: every corrupt step skipped
+    during auto-resume is a mitigation (``checkpoint_fallback``) on the run
+    stream and a loud stderr line — recovery must never be silent."""
+
+    def report(info: dict) -> None:
+        print(f"warning: checkpoint step {info['step']} is corrupt, "
+              f"falling back to the previous step ({info['error']})",
+              file=sys.stderr)
+        if telemetry is not None:
+            telemetry.mitigation(mtype="checkpoint_fallback", **info)
+
+    return report
 
 
 def _save_info_bounds(path: str, epochs, bounds_bits,
@@ -799,6 +831,18 @@ def serve_parser() -> argparse.ArgumentParser:
     parser.add_argument("--max_batch", type=int, default=32)
     parser.add_argument("--max_wait_ms", type=float, default=2.0)
     parser.add_argument("--max_queue", type=int, default=256)
+    parser.add_argument("--eject_after", type=int, default=3,
+                        help="Consecutive dispatch failures before a "
+                             "replica is ejected from routing "
+                             "(docs/robustness.md).")
+    parser.add_argument("--probe_after_s", type=float, default=5.0,
+                        help="Rest period before an ejected replica is "
+                             "probed for re-admission (0 disables the "
+                             "probe thread).")
+    parser.add_argument("--probe_timeout_s", type=float, default=5.0,
+                        help="A re-admission probe slower than this counts "
+                             "as failed (keeps a still-slow replica from "
+                             "flapping back into rotation).")
     parser.add_argument("--num_devices", type=int, default=0,
                         help="Local devices to replicate over (0 = all; "
                              "ignored when serving a sweep).")
@@ -863,6 +907,8 @@ def serve_main(argv: Sequence[str]) -> int:
         batch_buckets=args.buckets, telemetry=telemetry, registry=registry,
         tracer=tracer, max_batch=args.max_batch,
         max_wait_ms=args.max_wait_ms, max_queue=args.max_queue,
+        eject_after=args.eject_after, probe_after_s=args.probe_after_s,
+        probe_timeout_s=args.probe_timeout_s,
     )
     ckpt = DIBCheckpointer(args.checkpoint_dir)
     try:

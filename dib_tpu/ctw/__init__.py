@@ -190,5 +190,5 @@ class CTWEstimator:
     def __del__(self):  # best-effort; prefer close()/context manager
         try:
             self.close()
-        except Exception:
+        except Exception:  # fault-ok: __del__ during interpreter shutdown must never raise; ctypes/lib state may already be torn down
             pass

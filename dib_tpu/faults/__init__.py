@@ -1,0 +1,43 @@
+"""Deterministic fault injection: prove every recovery path on demand.
+
+See ``docs/robustness.md``. The pieces:
+
+  - :mod:`dib_tpu.faults.plan` — the ``DIB_FAULT_PLAN`` grammar
+    (``stall@chunk3:45s,kill@chunk5,nan@chunk7``), the fault-kind registry,
+    and once-only fired-state that survives the faults' own kills.
+  - :mod:`dib_tpu.faults.inject` — train-scope executors applied at fit
+    chunk boundaries (stall / kill / nan / inf) and checkpoint corruption
+    (truncated step dir, bit-flipped manifest).
+  - :mod:`dib_tpu.faults.serve` — serve-scope injectors: a
+    :class:`FlakyEngine` replica that fails or crawls on schedule, and a
+    batcher-worker crash.
+
+Every injection lands as a ``fault`` event on the run's events.jsonl;
+``python -m dib_tpu telemetry summarize`` joins faults with the
+mitigations they provoked into an injected/detected/recovered rollup, and
+``scripts/fault_drill.py`` runs the whole matrix end to end on CPU.
+"""
+
+from dib_tpu.faults.inject import (
+    apply_due_train_faults,
+    corrupt_checkpoint,
+    poison_params,
+)
+from dib_tpu.faults.plan import FAULT_KINDS, FaultPlan, FaultSpec
+from dib_tpu.faults.serve import (
+    FlakyEngine,
+    InjectedReplicaFault,
+    kill_batcher_worker,
+)
+
+__all__ = [
+    "FAULT_KINDS",
+    "FaultPlan",
+    "FaultSpec",
+    "FlakyEngine",
+    "InjectedReplicaFault",
+    "apply_due_train_faults",
+    "corrupt_checkpoint",
+    "kill_batcher_worker",
+    "poison_params",
+]

@@ -1,0 +1,145 @@
+"""Fault executors: the code that actually breaks things, deterministically.
+
+``apply_train_fault`` runs inside ``DIBTrainer.fit`` at chunk boundaries
+(after the boundary's hooks, so a checkpoint hook always saved the CLEAN
+state first — the nan fault poisons the state the NEXT chunk trains on,
+never the state just persisted). ``corrupt_checkpoint`` is the
+checkpoint-scope injector used by drills and tests against a
+``DIBCheckpointer`` directory.
+
+Every executor emits a ``fault`` event on the run's stream before acting,
+so a drill's events.jsonl carries the injection alongside the mitigation
+it provoked — ``telemetry summarize`` joins the two into the
+injected/detected/recovered rollup.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import time
+
+from dib_tpu.faults.plan import FaultPlan, FaultSpec
+
+__all__ = [
+    "apply_due_train_faults",
+    "corrupt_checkpoint",
+    "poison_params",
+]
+
+
+def poison_params(params, value: float):
+    """Return ``params`` with its first (path-sorted) leaf set to ``value``.
+
+    One fully-poisoned leaf guarantees the next forward pass is non-finite
+    whatever the architecture — the deterministic stand-in for the
+    hardware bit-flip / overflow NaNs the divergence guard exists for.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    if not leaves:
+        raise ValueError("cannot poison an empty param tree")
+    leaves[0] = jnp.full_like(leaves[0], value)
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def _emit_fault(telemetry, spec: FaultSpec, **fields) -> None:
+    if telemetry is not None:
+        telemetry.fault(kind=spec.kind, spec=spec.raw, chunk=spec.chunk,
+                        **({"arg": spec.arg} if spec.arg is not None else {}),
+                        **fields)
+
+
+def apply_due_train_faults(plan: FaultPlan, chunk_index: int, state,
+                           telemetry=None,
+                           log=lambda m: print(m, file=sys.stderr, flush=True)):
+    """Fire every plan spec due at this boundary; returns the (possibly
+    poisoned) train state.
+
+    Specs are marked fired BEFORE executing — ``kill`` never returns, and
+    its relaunched worker must find the marker, not the fault.
+    """
+    epoch = None
+    for spec in plan.due(chunk_index):
+        plan.mark_fired(spec)
+        if epoch is None:
+            import jax
+
+            epoch = int(jax.device_get(state.epoch))
+        _emit_fault(telemetry, spec, epoch=epoch)
+        log(f"fault injection: {spec.raw} firing at chunk boundary "
+            f"{chunk_index} (epoch {epoch})")
+        if spec.kind == "stall":
+            time.sleep(float(spec.arg))
+        elif spec.kind == "kill":
+            os.kill(os.getpid(), signal.SIGKILL)
+        elif spec.kind in ("nan", "inf"):
+            value = float("nan") if spec.kind == "nan" else float("inf")
+            state = state._replace(params=poison_params(state.params, value))
+        else:  # parse() rejects non-train scopes; guard against drift
+            raise ValueError(f"fault kind {spec.kind!r} is not train-scoped")
+    return state
+
+
+def _latest_step_dir(directory: str) -> str:
+    """Newest numeric step dir of an Orbax checkpoint directory."""
+    steps = [d for d in os.listdir(directory)
+             if d.isdigit() and os.path.isdir(os.path.join(directory, d))]
+    if not steps:
+        raise FileNotFoundError(f"no checkpoint step dirs under {directory}")
+    return os.path.join(directory, max(steps, key=int))
+
+
+def corrupt_checkpoint(directory: str, mode: str,
+                       telemetry=None) -> dict:
+    """Corrupt a ``DIBCheckpointer`` directory the way hardware would.
+
+    Modes:
+      - ``ckpt_truncate``: truncate the largest file of the LATEST step dir
+        to half its size (torn write / partial flush at kill time);
+      - ``ckpt_bitflip_manifest``: XOR one byte in the middle of
+        ``dib_manifest.json`` (bit rot).
+
+    Returns a description of what was damaged. Emits a ``fault`` event
+    when ``telemetry`` is given.
+    """
+    from dib_tpu.train.checkpoint import MANIFEST_FILENAME
+
+    if mode == "ckpt_truncate":
+        step_dir = _latest_step_dir(directory)
+        largest, size = None, -1
+        for root, _, files in os.walk(step_dir):
+            for name in files:
+                path = os.path.join(root, name)
+                s = os.path.getsize(path)
+                if s > size:
+                    largest, size = path, s
+        if largest is None or size == 0:
+            raise FileNotFoundError(f"nothing to truncate under {step_dir}")
+        with open(largest, "rb+") as f:
+            f.truncate(size // 2)
+        detail = {"kind": mode, "path": largest,
+                  "bytes_before": size, "bytes_after": size // 2,
+                  "step_dir": step_dir}
+    elif mode == "ckpt_bitflip_manifest":
+        path = os.path.join(directory, MANIFEST_FILENAME)
+        with open(path, "rb") as f:
+            blob = bytearray(f.read())
+        if not blob:
+            raise FileNotFoundError(f"{path} is empty")
+        pos = len(blob) // 2
+        blob[pos] ^= 0xFF
+        with open(path, "wb") as f:
+            f.write(bytes(blob))
+        detail = {"kind": mode, "path": path, "flipped_byte": pos}
+    else:
+        raise ValueError(
+            f"unknown checkpoint corruption mode {mode!r} "
+            "(ckpt_truncate | ckpt_bitflip_manifest)"
+        )
+    if telemetry is not None:
+        telemetry.fault(**detail)
+    return detail

@@ -1,0 +1,186 @@
+"""Deterministic, seeded fault plans: what breaks, where, on purpose.
+
+Every mitigation this framework ships — the stall watchdog, checkpoint
+resume, the divergence rollback, serve-replica ejection — exists because a
+real failure mode burned a run (VERDICT rounds 4-5). But until this module
+each of them was validated only by whatever faults the tunneled hardware
+happened to throw: "the watchdog has never faced a real stall" on demand.
+A :class:`FaultPlan` makes failure a first-class, reproducible input:
+
+    DIB_FAULT_PLAN=stall@chunk3:45s,kill@chunk5,nan@chunk7
+
+Each spec is ``kind@chunkN[:ARG]`` — fire fault ``kind`` at the N-th fit
+chunk boundary (1-based, counted per process launch). The training loop
+applies due specs at its chunk boundaries (``train/loop.py``), emits a
+``fault`` event on the run's events.jsonl for every injection (drills are
+auditable: injected vs detected vs recovered is computable from the
+stream, see ``telemetry/summary.py:faults_rollup``), and marks the spec
+fired in ``state_dir`` so a fault survives its own consequences exactly
+once — a worker SIGKILLed at chunk 5 and relaunched from its checkpoint
+must not kill itself at chunk 5 again, forever.
+
+The registry below names every fault kind the drill matrix covers. Only
+the ``train``-scoped kinds are injectable through the plan grammar (they
+fire inside a fit); checkpoint corruption and serve faults are injected
+programmatically by ``scripts/fault_drill.py`` and the tests through
+:mod:`dib_tpu.faults.inject` / :mod:`dib_tpu.faults.serve`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+from typing import Sequence
+
+__all__ = ["FAULT_KINDS", "FaultPlan", "FaultSpec"]
+
+PLAN_ENV = "DIB_FAULT_PLAN"
+STATE_DIR_ENV = "DIB_FAULT_STATE_DIR"
+
+# kind -> (scope, arg meaning or None, description). Scope "train" = plan-
+# grammar injectable at fit chunk boundaries; "checkpoint"/"serve"/"http" =
+# injected via dib_tpu.faults.inject / dib_tpu.faults.serve by drills.
+FAULT_KINDS: dict[str, tuple[str, str | None, str]] = {
+    "stall": ("train", "seconds",
+              "simulated device stall: sleep inside the heartbeat-visible "
+              "window so the watchdog's trailing-median timeout fires"),
+    "kill": ("train", None,
+             "SIGKILL the worker process at the boundary (after its "
+             "checkpoint hook ran) — the crash-restart path"),
+    "nan": ("train", None,
+            "poison one param leaf with NaN so the next chunk's loss/KL "
+            "are non-finite — the divergence-rollback path"),
+    "inf": ("train", None,
+            "poison one param leaf with +Inf (same detector as 'nan')"),
+    "ckpt_truncate": ("checkpoint", None,
+                      "truncate the largest file of the latest Orbax step "
+                      "dir (torn write / partial flush)"),
+    "ckpt_bitflip_manifest": ("checkpoint", None,
+                              "flip one byte of dib_manifest.json (bit rot "
+                              "/ torn manifest write)"),
+    "replica_error": ("serve", "count",
+                      "a serve replica whose dispatches raise — the "
+                      "consecutive-failure ejection path"),
+    "replica_slow": ("serve", "seconds",
+                     "a serve replica whose dispatches sleep past request "
+                     "deadlines — ejection via timeout failures"),
+    "batcher_crash": ("serve", None,
+                      "kill a micro-batcher's worker thread — the truthful "
+                      "/healthz 503 path"),
+    "http_malformed": ("http", None,
+                       "invalid JSON / wrong-width rows / dropped "
+                       "connections against the HTTP server"),
+}
+
+_SPEC_RE = re.compile(
+    r"^(?P<kind>[a-z_]+)@chunk(?P<chunk>\d+)(?::(?P<arg>[\d.]+)s?)?$"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One planned injection: ``kind`` at the ``chunk``-th fit boundary."""
+
+    kind: str
+    chunk: int
+    arg: float | None
+    raw: str
+
+    @property
+    def marker(self) -> str:
+        """Filename marking this spec fired (state survives SIGKILL)."""
+        return f"fault_fired_{self.kind}_chunk{self.chunk}"
+
+
+class FaultPlan:
+    """A parsed, once-only-per-spec fault schedule.
+
+    ``state_dir``: where fired-markers persist. Without one, fired state is
+    in-memory only — fine for in-process drills, but a plan that SIGKILLs
+    its own process NEEDS a directory or the relaunch re-fires it.
+    """
+
+    def __init__(self, specs: Sequence[FaultSpec], state_dir: str | None = None):
+        self.specs = list(specs)
+        self.state_dir = state_dir
+        self._fired_memory: set[str] = set()
+        if state_dir:
+            os.makedirs(state_dir, exist_ok=True)
+
+    # ------------------------------------------------------------- parsing
+    @classmethod
+    def parse(cls, text: str, state_dir: str | None = None) -> "FaultPlan":
+        specs = []
+        for token in filter(None, (t.strip() for t in text.split(","))):
+            m = _SPEC_RE.match(token)
+            if m is None:
+                raise ValueError(
+                    f"Unparseable fault spec {token!r}; expected "
+                    "kind@chunkN[:SECONDSs], e.g. stall@chunk3:45s"
+                )
+            kind = m.group("kind")
+            if kind not in FAULT_KINDS:
+                raise ValueError(
+                    f"Unknown fault kind {kind!r}; known kinds: "
+                    f"{sorted(FAULT_KINDS)}"
+                )
+            scope, arg_name, _ = FAULT_KINDS[kind]
+            if scope != "train":
+                raise ValueError(
+                    f"Fault kind {kind!r} has scope {scope!r} — it is "
+                    "injected by the drill harness (dib_tpu.faults."
+                    "inject/serve), not through the chunk-boundary plan "
+                    "grammar"
+                )
+            arg = m.group("arg")
+            if arg_name is not None and kind == "stall" and arg is None:
+                raise ValueError(
+                    f"Fault spec {token!r} needs an argument "
+                    f"({arg_name}), e.g. {kind}@chunk3:45s"
+                )
+            specs.append(FaultSpec(
+                kind=kind, chunk=int(m.group("chunk")),
+                arg=float(arg) if arg is not None else None, raw=token,
+            ))
+        return cls(specs, state_dir=state_dir)
+
+    @classmethod
+    def from_env(cls, state_dir: str | None = None) -> "FaultPlan | None":
+        """The env-driven entry point (``DIB_FAULT_PLAN``); None when unset.
+
+        ``DIB_FAULT_STATE_DIR`` overrides the caller's ``state_dir`` (the
+        drill harness pins one so fired-markers survive worker relaunches).
+        """
+        text = os.environ.get(PLAN_ENV, "")
+        if not text:
+            return None
+        return cls.parse(text, state_dir=os.environ.get(STATE_DIR_ENV) or state_dir)
+
+    # ----------------------------------------------------------- firing
+    def fired(self, spec: FaultSpec) -> bool:
+        if spec.marker in self._fired_memory:
+            return True
+        if self.state_dir:
+            return os.path.exists(os.path.join(self.state_dir, spec.marker))
+        return False
+
+    def mark_fired(self, spec: FaultSpec) -> None:
+        """Record the spec as fired BEFORE executing it — a kill fault must
+        leave its marker behind or the relaunched worker repeats it."""
+        self._fired_memory.add(spec.marker)
+        if self.state_dir:
+            path = os.path.join(self.state_dir, spec.marker)
+            with open(path, "w") as f:
+                f.write(spec.raw + "\n")
+
+    def due(self, chunk_index: int) -> list[FaultSpec]:
+        """Not-yet-fired specs scheduled for this (1-based) boundary."""
+        return [s for s in self.specs
+                if s.chunk == chunk_index and not self.fired(s)]
+
+    def __bool__(self) -> bool:
+        return bool(self.specs)
+
+    def __repr__(self) -> str:
+        return f"FaultPlan({', '.join(s.raw for s in self.specs)})"

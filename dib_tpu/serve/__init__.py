@@ -10,7 +10,9 @@ See ``docs/serving.md``. The pieces:
     pad to bucket, dispatch, split; per-request timeouts, backpressure,
     and error isolation.
   - :mod:`dib_tpu.serve.replicas` — round-robin dispatch across local
-    devices and across β-sweep members ("the model at β≈x").
+    devices and across β-sweep members ("the model at β≈x"), with
+    per-replica health: consecutive-failure ejection, periodic probe
+    re-admission, batcher-worker revival (docs/robustness.md).
   - :mod:`dib_tpu.serve.server` — stdlib JSON HTTP API
     (``/v1/predict``, ``/v1/encode``, ``/healthz``, ``/metrics``) behind
     ``python -m dib_tpu serve``.
@@ -23,7 +25,11 @@ from dib_tpu.serve.batcher import (
     RequestTimeout,
 )
 from dib_tpu.serve.engine import DEFAULT_BUCKETS, InferenceEngine
-from dib_tpu.serve.replicas import ReplicaEntry, ReplicaRouter
+from dib_tpu.serve.replicas import (
+    NoHealthyReplicaError,
+    ReplicaEntry,
+    ReplicaRouter,
+)
 from dib_tpu.serve.server import DIBServer
 
 __all__ = [
@@ -32,6 +38,7 @@ __all__ = [
     "DIBServer",
     "InferenceEngine",
     "MicroBatcher",
+    "NoHealthyReplicaError",
     "QueueFullError",
     "ReplicaEntry",
     "ReplicaRouter",

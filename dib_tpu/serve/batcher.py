@@ -62,7 +62,7 @@ class BatcherClosed(RuntimeError):
 class _Request:
     """One submitted request: rows + a one-shot result slot."""
 
-    __slots__ = ("op", "rows", "deadline", "submitted",
+    __slots__ = ("op", "rows", "deadline", "submitted", "dispatched",
                  "_event", "_result", "_error")
 
     def __init__(self, op: str, rows: np.ndarray, deadline: float | None):
@@ -70,6 +70,11 @@ class _Request:
         self.rows = rows
         self.deadline = deadline
         self.submitted = time.perf_counter()   # timing-ok: host-side queue/latency clock, no jitted call in the interval
+        # flipped by the worker the moment the engine dispatch carrying
+        # these rows starts: a timeout BEFORE that is queue wait (the
+        # replica never got to show whether it is slow), after it the
+        # dispatch itself missed the deadline
+        self.dispatched = False
         self._event = threading.Event()
         self._result = None
         self._error = None
@@ -89,10 +94,11 @@ class _Request:
     def result(self, timeout: float | None = None):
         """Block for the result; raises the request's error if it failed."""
         if not self._event.wait(timeout):
-            raise RequestTimeout(
-                f"no result within {timeout}s (request still queued or "
-                "in flight)"
-            )
+            where = "in flight" if self.dispatched else "still queued"
+            error = RequestTimeout(f"no result within {timeout}s "
+                                   f"(request {where})")
+            error.in_queue = not self.dispatched
+            raise error
         if self._error is not None:
             raise self._error
         return self._result
@@ -194,6 +200,31 @@ class MicroBatcher:
         """Blocking convenience: submit + wait (client-side timeout too)."""
         return self.submit(x, op, timeout_s=timeout_s).result(timeout_s)
 
+    def is_alive(self) -> bool:
+        """Liveness of the dispatch worker: False once the thread has died
+        (an escaped exception) or the batcher was closed. The truthful
+        ``/healthz`` keys on this — a process whose batcher thread is dead
+        accepts requests into a queue nothing will ever drain."""
+        return self._worker.is_alive() and not self._closed
+
+    def revive(self) -> bool:
+        """Restart a DEAD dispatch worker (never a closed batcher).
+
+        The self-healing path for an escaped exception having killed the
+        drain loop: queued requests survive in the queue, and the fresh
+        worker resumes draining them. Returns True when a new worker was
+        actually started. The router's maintenance loop calls this
+        (``ReplicaRouter.probe_ejected``), emitting a ``mitigation`` event
+        per revival."""
+        with self._lifecycle:
+            if self._closed or self._worker.is_alive():
+                return False
+            self._worker = threading.Thread(
+                target=self._run, name="dib-serve-batcher", daemon=True
+            )
+            self._worker.start()
+            return True
+
     def close(self, drain: bool = True) -> None:
         """Stop accepting work; optionally drain what is queued, then fail
         anything left with :class:`BatcherClosed`."""
@@ -251,9 +282,13 @@ class MicroBatcher:
             live: dict[str, list[_Request]] = {}
             for request in batch:
                 if request.deadline is not None and now > request.deadline:
-                    request.set_error(RequestTimeout(
+                    error = RequestTimeout(
                         "request timed out in queue before dispatch"
-                    ))
+                    )
+                    # queue expiry is backpressure, not replica sickness —
+                    # the server's health accounting keys on this flag
+                    error.in_queue = True
+                    request.set_error(error)
                     self._finish(request, "timeout", now)
                     continue
                 live.setdefault(request.op, []).append(request)
@@ -274,6 +309,8 @@ class MicroBatcher:
         return capacity
 
     def _dispatch_group(self, op: str, requests: list[_Request]) -> None:
+        for request in requests:
+            request.dispatched = True
         rows = np.concatenate([r.rows for r in requests])
         n = rows.shape[0]
         bucket = (self.engine.bucket_for(n)

@@ -23,6 +23,14 @@ queue backpressure is 503 with ``Retry-After``; a request timeout is 504;
 everything else is 500. Errors are isolated per request — a malformed
 request cannot fail its batch-mates (see ``serve/batcher.py``).
 
+Self-healing (docs/robustness.md): an engine-side dispatch failure marks
+the replica (``router.report_failure``) and the request RETRIES on another
+healthy replica — one sick device does not fail client calls while a
+healthy replica is available. ``/healthz`` is truthful: 503 with a JSON
+detail when no replica can carry a request (all ejected, or the batcher
+worker thread died), 200 otherwise; health transitions are emitted as
+``mitigation`` events so a drill's detection is on the stream.
+
 Telemetry: the server owns the run bracket (``run_start`` manifest with
 ``mode: "serve"`` … ``run_end`` on graceful shutdown) and emits a final
 ``metrics`` rollup, so a serving run directory summarizes and renders with
@@ -33,11 +41,13 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
 from dib_tpu.serve.batcher import BatcherClosed, QueueFullError, RequestTimeout
+from dib_tpu.serve.replicas import NoHealthyReplicaError
 
 __all__ = ["DIBServer"]
 
@@ -62,6 +72,8 @@ class DIBServer:
         self.registry = registry
         self._closed = threading.Lock()
         self._done = False
+        self._health_lock = threading.Lock()
+        self._was_serviceable = True   # healthz transition edge detector
         handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.host, self.port = self.httpd.server_address[:2]
@@ -99,17 +111,59 @@ class DIBServer:
     def handle_get(self, path: str) -> tuple[int, dict]:
         if path == "/healthz":
             entry = self.router.entries[0]
-            return 200, {
-                "status": "ok",
+            health = self.router.health()
+            # derived from the SAME snapshot as the payload rows (a second
+            # router scan could disagree under a concurrent transition)
+            serviceable = health["healthy"] > 0
+            self._note_health_transition(serviceable, health)
+            payload = {
+                # the serving surface stays present even when degraded: a
+                # load generator shaping traffic needs it either way
+                "status": "ok" if serviceable else "unhealthy",
                 "feature_width": entry.engine.feature_width,
                 "num_features": entry.engine.num_features,
                 "buckets": list(entry.engine.buckets),
-                "replicas": self.router.describe(),
+                "replicas": health["replicas"],
+                "healthy_replicas": health["healthy"],
             }
+            if not serviceable:
+                payload["detail"] = self._unhealthy_detail(health)
+            return (200 if serviceable else 503), payload
         if path == "/metrics":
             return 200, (self.registry.snapshot()
                          if self.registry is not None else {})
         return 404, {"error": f"no route {path!r}"}
+
+    @staticmethod
+    def _unhealthy_detail(health: dict) -> str:
+        parts = []
+        if health["ejected"]:
+            parts.append(f"{health['ejected']} replica(s) ejected after "
+                         "consecutive dispatch failures")
+        if health["batchers_dead"]:
+            parts.append(f"{health['batchers_dead']} batcher worker "
+                         "thread(s) dead")
+        return ("no replica can carry a request: "
+                + "; ".join(parts or ["unknown cause"]))
+
+    def _note_health_transition(self, serviceable: bool, health: dict) -> None:
+        """Emit one mitigation event per health EDGE (not per poll): a
+        drill's detection of a dead batcher / total ejection is then on
+        the same stream as the fault that caused it."""
+        with self._health_lock:
+            changed = serviceable != self._was_serviceable
+            self._was_serviceable = serviceable
+        if changed and self.telemetry is not None:
+            if serviceable:
+                self.telemetry.mitigation(mtype="serving_recovered",
+                                          healthy=health["healthy"])
+            else:
+                self.telemetry.mitigation(
+                    mtype="serving_unhealthy",
+                    detail=self._unhealthy_detail(health),
+                    ejected=health["ejected"],
+                    batchers_dead=health["batchers_dead"],
+                )
 
     def handle_post(self, path: str, body: dict) -> tuple[int, dict]:
         op = {"/v1/predict": "predict", "/v1/encode": "encode"}.get(path)
@@ -121,21 +175,77 @@ class DIBServer:
         if beta is not None and not isinstance(beta, (int, float)):
             return 400, {"error": '"beta" must be a number'}
         timeout_s = body.get("timeout_s", _DEFAULT_REQUEST_TIMEOUT_S)
+        # Retry loop: an engine-side failure marks the replica and moves the
+        # request to the next healthy one — a client call only fails when
+        # EVERY routable replica failed it (or its own input/deadline did).
+        # Retries share ONE deadline budget: a client asking for timeout_s
+        # must never wait num_replicas x timeout_s.
         try:
-            entry = self.router.route(beta=beta)
-            result = entry.batcher(body["x"], op, timeout_s=float(timeout_s))
-        except QueueFullError as exc:
-            return 503, {"error": str(exc)}
-        except RequestTimeout as exc:
-            return 504, {"error": str(exc)}
-        except BatcherClosed as exc:
-            return 503, {"error": str(exc)}
-        except (ValueError, TypeError) as exc:
-            return 400, {"error": str(exc)}
-        payload = {key: np.asarray(value).tolist()
-                   for key, value in result.items()}
-        payload["replica"] = entry.describe()
-        return 200, payload
+            deadline = time.monotonic() + float(timeout_s)
+        except (TypeError, ValueError):
+            return 400, {"error": '"timeout_s" must be a number'}
+        tried: set[int] = set()
+        last_error: Exception | None = None
+        while len(tried) < len(self.router.entries):
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return 504, {
+                    "error": f"request deadline ({timeout_s}s) exhausted "
+                             f"after {len(tried)} failed replica "
+                             f"attempt(s); last: {last_error}",
+                }
+            try:
+                entry = self.router.route(beta=beta, exclude=tried)
+            except NoHealthyReplicaError as exc:
+                return 503, {
+                    "error": (f"{exc} (last replica error: {last_error})"
+                              if last_error is not None else str(exc)),
+                    "health": self.router.health(),
+                }
+            except ValueError as exc:   # β routing without labels
+                return 400, {"error": str(exc)}
+            try:
+                result = entry.batcher(body["x"], op, timeout_s=remaining)
+            except QueueFullError as exc:
+                # backpressure, not sickness: the replica is busy, the
+                # client should back off — never a failure mark
+                return 503, {"error": str(exc)}
+            except RequestTimeout as exc:
+                # a dispatch that missed its deadline marks the replica (a
+                # slow replica is a failing replica) — but a deadline that
+                # expired while the request was STILL QUEUED is
+                # backpressure wearing a timeout's coat (like
+                # QueueFullError, deliberately unmarked): under a load
+                # spike marking it would eject healthy replicas exactly
+                # when capacity matters most. The router additionally
+                # refuses to let timeouts eject the LAST serviceable
+                # replica. The deadline is spent either way — no retry.
+                if not getattr(exc, "in_queue", False):
+                    self.router.report_failure(entry, exc)
+                return 504, {"error": str(exc)}
+            except (ValueError, TypeError) as exc:
+                return 400, {"error": str(exc)}
+            except BatcherClosed as exc:
+                # shutdown in progress, not replica sickness: marking the
+                # replica here would emit spurious ejection mitigations
+                # (and pollute the faults rollup) for every request caught
+                # mid-close
+                return 503, {"error": str(exc)}
+            except Exception as exc:   # engine fault: mark + retry
+                self.router.report_failure(entry, exc)
+                tried.add(entry.index)
+                last_error = exc
+                continue
+            self.router.report_success(entry)
+            payload = {key: np.asarray(value).tolist()
+                       for key, value in result.items()}
+            payload["replica"] = entry.describe()
+            return 200, payload
+        return 503, {
+            "error": f"all {len(tried)} replica(s) failed this request; "
+                     f"last: {type(last_error).__name__}: {last_error}",
+            "health": self.router.health(),
+        }
 
 
 def _make_handler(server: DIBServer):

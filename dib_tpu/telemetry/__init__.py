@@ -48,6 +48,7 @@ from dib_tpu.telemetry.metrics import (
 )
 from dib_tpu.telemetry.summary import (
     compare,
+    faults_rollup,
     serving_rollup,
     span_hotspots,
     span_rollup,
@@ -77,6 +78,7 @@ __all__ = [
     "config_fingerprint",
     "current_tracer",
     "device_memory_stats",
+    "faults_rollup",
     "finalize_crashed",
     "finalize_open_writers",
     "gather_snapshots",

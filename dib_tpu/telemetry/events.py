@@ -14,7 +14,13 @@ run appends typed, schema-versioned events to ``<run_dir>/events.jsonl``:
                    memory stats
   - ``compile``    executable name, compile seconds, persistent-cache
                    status from ``utils/compile_cache.py``
-  - ``mitigation`` watchdog kill/restart, mirroring ``watchdog.mitigations``
+  - ``mitigation`` a self-healing action: watchdog kill/restart (mirroring
+                   ``watchdog.mitigations``), divergence rollback,
+                   checkpoint fallback, serve-replica ejection/re-admission
+  - ``fault``      one DELIBERATE fault injection (``dib_tpu/faults``):
+                   kind, plan spec, where it fired — drills are auditable
+                   because every injection is on the same stream as the
+                   mitigation it provoked
   - ``hook``       host-hook wall-clock per invocation
   - ``span``       one closed trace span (``telemetry/trace.py``): name,
                    full slash path, span/parent ids, blocked wall-clock
@@ -382,6 +388,13 @@ class EventWriter:
 
     def mitigation(self, *, mtype: str, **fields) -> dict:
         return self.emit("mitigation", mtype=mtype, **fields)
+
+    def fault(self, *, kind: str, **fields) -> dict:
+        """One deliberate fault injection (``dib_tpu/faults``). Emitted
+        BEFORE the fault executes — a SIGKILL fault still leaves its
+        record (one O_APPEND write, already durable when the signal
+        lands)."""
+        return self.emit("fault", kind=kind, **fields)
 
     def hook(self, *, name: str, epoch: int, seconds: float, **fields) -> dict:
         return self.emit(

@@ -372,6 +372,53 @@ def _memory_section(chunks) -> str:
     return tiles + f'<div class="charts">{chart}</div>'
 
 
+def _faults_section(summary: dict) -> str:
+    """Fault-drill evidence (docs/robustness.md): injected vs detected vs
+    recovered tiles plus the per-injection join. Empty string for normal
+    (uninjected) runs — the section only renders when drills ran."""
+    faults = summary.get("faults")
+    if not faults:
+        return ""
+    ttd = (faults.get("time_to_detect_s") or {}).get("mean")
+    ttr = (faults.get("time_to_recover_s") or {}).get("mean")
+    tiles = _tiles([
+        ("injected", faults.get("injected")),
+        ("detected", faults.get("detected")),
+        ("recovered", faults.get("recovered")),
+        ("mean detect", _fmt_seconds(ttd) if ttd is not None else None),
+        ("mean recover", _fmt_seconds(ttr) if ttr is not None else None),
+    ])
+    rows = []
+    for f in faults.get("faults", []):
+        det = ("✓ " + _esc(str(f.get("detected_by", "")))
+               if f.get("detected") else "✗ UNDETECTED")
+        rec = "✓" if f.get("recovered") else "✗"
+        ttd_s = f.get("time_to_detect_s")
+        ttr_s = f.get("time_to_recover_s")
+        rows.append(
+            "<tr>"
+            f"<td><code>{_esc(f.get('spec') or f.get('kind', '?'))}</code></td>"
+            f"<td>{det}</td>"
+            f"<td>{_fmt_seconds(ttd_s) if ttd_s is not None else '—'}</td>"
+            f"<td>{rec}</td>"
+            f"<td>{_fmt_seconds(ttr_s) if ttr_s is not None else '—'}</td>"
+            "</tr>"
+        )
+    undetected = faults.get("undetected") or []
+    warn = ""
+    if undetected:
+        warn = ('<p class="note">⚠ undetected injected fault(s): '
+                + ", ".join(f"<code>{_esc(k)}</code>" for k in undetected)
+                + " — <code>telemetry compare</code> gates on this.</p>")
+    table = ("<table><thead><tr><th>injection</th><th>detected</th>"
+             "<th>t-detect</th><th>recovered</th><th>t-recover</th>"
+             "</tr></thead><tbody>" + "".join(rows) + "</tbody></table>")
+    return ("<h2>Fault drills</h2>"
+            '<p class="note">Deliberate injections '
+            "(<code>dib_tpu/faults</code>) joined with the mitigations "
+            "they provoked.</p>" + tiles + table + warn)
+
+
 def render_report(path: str, run_id: str | None = None,
                   process_index: int | None = None) -> str:
     """The report HTML for one events.jsonl (or its run dir)."""
@@ -481,6 +528,7 @@ via <code>jax.profiler.TraceAnnotation</code>.</p>
 {_memory_section(chunks)}
 <h2>Roofline utilization</h2>
 {_utilization_section(summary)}
+{_faults_section(summary)}
 <details><summary>Full summary record (table view)</summary>
 <pre>{summary_json}</pre></details>
 </body></html>

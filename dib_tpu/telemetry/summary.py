@@ -37,8 +37,8 @@ from dib_tpu.telemetry.events import (
     read_events,
 )
 
-__all__ = ["summarize", "compare", "serving_rollup", "span_rollup",
-           "span_hotspots", "telemetry_main"]
+__all__ = ["summarize", "compare", "faults_rollup", "serving_rollup",
+           "span_rollup", "span_hotspots", "telemetry_main"]
 
 _LN2 = log(2.0)
 
@@ -175,6 +175,137 @@ def serving_rollup(span_events) -> dict | None:
         if fills:
             out["batch_fill_mean"] = round(sum(fills) / len(fills), 4)
     return out
+
+
+# Which mitigation mtypes count as DETECTING each injected fault kind
+# (dib_tpu/faults). A fault whose detector never fires after it is
+# UNDETECTED — `telemetry compare` treats that as a regression: the drill
+# proved a recovery path is broken.
+_FAULT_DETECTORS: dict[str, tuple[str, ...]] = {
+    "stall": ("stall_kill",),
+    "kill": ("crash_restart",),
+    "nan": ("divergence_rollback", "divergence_detected"),
+    "inf": ("divergence_rollback", "divergence_detected"),
+    "ckpt_truncate": ("checkpoint_fallback",),
+    "ckpt_bitflip_manifest": ("checkpoint_fallback",),
+    "replica_error": ("replica_ejected",),
+    "replica_slow": ("replica_ejected",),
+    "batcher_crash": ("serving_unhealthy", "batcher_restarted"),
+}
+
+# Recovery markers per kind, evaluated on events AFTER the detection:
+# train-scope faults recover when training demonstrably resumes (a chunk
+# with finite loss, or a clean run_end); serve-scope faults recover on the
+# matching re-admission/recovery mitigation.
+_SERVE_RECOVERERS: dict[str, tuple[str, ...]] = {
+    "replica_error": ("replica_readmitted",),
+    "replica_slow": ("replica_readmitted",),
+    "batcher_crash": ("serving_recovered", "batcher_restarted"),
+}
+
+
+def _chunk_loss_finite(event: dict) -> bool:
+    vals = _as_floats(event.get("loss"))
+    return bool(vals) and all(math.isfinite(v) for v in vals)
+
+
+def _marks_recovery(kind: str, event: dict) -> bool:
+    if kind in _SERVE_RECOVERERS:
+        return (event.get("type") == "mitigation"
+                and event.get("mtype") in _SERVE_RECOVERERS[kind])
+    if event.get("type") == "chunk":
+        return _chunk_loss_finite(event)
+    return (event.get("type") == "run_end"
+            and event.get("status") == "ok")
+
+
+def faults_rollup(events) -> dict | None:
+    """Injected vs detected vs recovered over a stream's ``fault`` events.
+
+    Computed over the GLOBAL event list (faults fire in the worker,
+    stall/crash mitigations land from the supervisor process — scoping to
+    one process would blind the join). Deltas use the wall-clock ``t``
+    envelope field, the only clock shared across processes. None when the
+    stream carries no injections (normal runs).
+    """
+    ordered = sorted(events, key=lambda e: e.get("t", 0.0))
+    faults = [e for e in ordered if e.get("type") == "fault"]
+    if not faults:
+        return None
+    per_fault = []
+    for fault in faults:
+        kind = fault.get("kind", "?")
+        t0 = fault.get("t", 0.0)
+        # An UNREGISTERED kind scores undetected — defaulting to "any
+        # later mitigation counts" would let a routine unrelated
+        # mitigation wave a genuinely undetected fault past the compare
+        # gate. (http_malformed intentionally has no detector: its
+        # containment evidence is HTTP status codes, so drills record it
+        # in FAULT_DRILL.json rather than as fault events.)
+        detectors = _FAULT_DETECTORS.get(kind, ())
+        record: dict = {"kind": kind, "spec": fault.get("spec")}
+
+        def _identity_matches(event: dict) -> bool:
+            # when BOTH sides name a replica, the join must respect it —
+            # replica 0's ejection must not mark replica 1's injected
+            # fault "detected" and wave a broken path past the gate
+            fr, mr = fault.get("replica"), event.get("replica")
+            return fr is None or mr is None or fr == mr
+
+        detection = next(
+            (e for e in ordered
+             if e.get("t", 0.0) >= t0 and e.get("type") == "mitigation"
+             and e.get("mtype") in detectors and _identity_matches(e)),
+            None,
+        )
+        record["detected"] = detection is not None
+        if detection is not None:
+            record["detected_by"] = detection.get("mtype")
+            record["time_to_detect_s"] = round(
+                detection.get("t", t0) - t0, 3)
+            recovery = next(
+                (e for e in ordered
+                 if e.get("t", 0.0) >= detection.get("t", t0)
+                 and e is not detection and _marks_recovery(kind, e)
+                 and _identity_matches(e)),   # replica 0's readmission is
+                 # not replica 1's recovery
+                None,
+            )
+            record["recovered"] = recovery is not None
+            if recovery is not None:
+                record["time_to_recover_s"] = round(
+                    recovery.get("t", t0) - t0, 3)
+        else:
+            record["recovered"] = False
+        per_fault.append(record)
+
+    def _stats(key):
+        vals = [r[key] for r in per_fault if key in r]
+        if not vals:
+            return None
+        return {"mean": round(sum(vals) / len(vals), 3),
+                "max": round(max(vals), 3)}
+
+    by_kind: dict[str, dict] = {}
+    for r in per_fault:
+        entry = by_kind.setdefault(
+            r["kind"], {"injected": 0, "detected": 0, "recovered": 0})
+        entry["injected"] += 1
+        entry["detected"] += r["detected"]
+        entry["recovered"] += r["recovered"]
+    rollup = {
+        "injected": len(per_fault),
+        "detected": sum(r["detected"] for r in per_fault),
+        "recovered": sum(r["recovered"] for r in per_fault),
+        "undetected": [r["kind"] for r in per_fault if not r["detected"]],
+        "by_kind": by_kind,
+        "faults": per_fault,
+    }
+    for key in ("time_to_detect_s", "time_to_recover_s"):
+        stats = _stats(key)
+        if stats is not None:
+            rollup[key] = stats
+    return rollup
 
 
 def _utilization_rollup(compiles, rollup: dict, device_kind) -> dict:
@@ -392,6 +523,13 @@ def summarize(path: str, process_index: int | None = None,
     summary["mitigations"] = counts
     summary["mitigations_total"] = len(mitigations)
 
+    # injected-fault drills (dib_tpu/faults): joined over GLOBAL events —
+    # faults fire in the worker, stall/crash detections land from the
+    # supervisor process
+    faults = faults_rollup(events)
+    if faults is not None:
+        summary["faults"] = faults
+
     if compiles:
         by_cache: dict[str, int] = {}
         for c in compiles:
@@ -540,6 +678,20 @@ def compare(
         "regressed": b_mit > a_mit,
     }
     regressed = regressed or b_mit > a_mit
+
+    def undetected(summary):
+        f = summary.get("faults") or {}
+        return (f.get("injected", 0) or 0) - (f.get("detected", 0) or 0)
+
+    a_und, b_und = undetected(summary_a), undetected(summary_b)
+    # An injected fault nobody detected is a broken recovery path — a
+    # regression in the candidate REGARDLESS of the baseline (a drilled
+    # mitigation that stopped firing must never pass the gate).
+    fields["faults_undetected"] = {
+        "a": a_und, "b": b_und, "bad_direction": "up",
+        "regressed": b_und > 0,
+    }
+    regressed = regressed or b_und > 0
 
     if (summary_a.get("config_hash") and summary_b.get("config_hash")
             and summary_a["config_hash"] != summary_b["config_hash"]):

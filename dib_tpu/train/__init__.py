@@ -23,6 +23,7 @@ from dib_tpu.train.hooks import (
 )
 from dib_tpu.train.checkpoint import (
     CHECKPOINT_SCHEMA_VERSION,
+    CheckpointCorruptionError,
     CheckpointHook,
     DIBCheckpointer,
     param_structure_hash,

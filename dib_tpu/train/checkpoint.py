@@ -33,6 +33,7 @@ import os
 from typing import Any
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import orbax.checkpoint as ocp
 
@@ -44,6 +45,14 @@ from dib_tpu.train.history import history_init
 # Orbax structure error.
 CHECKPOINT_SCHEMA_VERSION = 1
 MANIFEST_FILENAME = "dib_manifest.json"
+
+
+class CheckpointCorruptionError(RuntimeError):
+    """A checkpoint exists but cannot be read back (truncated step dir,
+    bit-flipped manifest, torn write). Distinct from ``ValueError`` (wrong
+    template / chunk contract): corruption is recoverable by falling back
+    to an earlier step (:meth:`DIBCheckpointer.restore_latest_intact`),
+    a contract violation is not."""
 
 
 def param_structure_rows(params) -> list[str]:
@@ -93,13 +102,27 @@ def write_manifest(directory: str, params) -> dict:
 
 
 def read_manifest(directory: str) -> dict | None:
-    """The directory's integrity manifest, or None (pre-manifest era)."""
+    """The directory's integrity manifest, or None (pre-manifest era).
+
+    A manifest that EXISTS but cannot be parsed is not "absent" — it is
+    evidence of corruption (bit rot, torn write), and silently verifying
+    vacuously would wave a damaged checkpoint through. Raises
+    :class:`CheckpointCorruptionError` naming the file instead.
+    """
     path = os.path.join(directory, MANIFEST_FILENAME)
+    if not os.path.exists(path):
+        return None
     try:
         with open(path) as f:
             manifest = json.load(f)
-    except (OSError, json.JSONDecodeError):
-        return None
+    except (OSError, json.JSONDecodeError, UnicodeDecodeError) as exc:
+        raise CheckpointCorruptionError(
+            f"{path}: integrity manifest exists but is unreadable "
+            f"({type(exc).__name__}: {exc}) — the checkpoint directory is "
+            "corrupt (bit flip / torn write). Restore an earlier step "
+            "(restore(step=...) or restore_latest_intact), or delete the "
+            "manifest to skip verification at your own risk."
+        ) from exc
     return manifest if isinstance(manifest, dict) else None
 
 
@@ -202,6 +225,17 @@ class DIBCheckpointer:
         # Async: the write overlaps the next training chunk; readers
         # (restore / latest_step) wait for in-flight saves first.
         self.manager.save(step, args=ocp.args.StandardSave(payload))
+        # ... except on the CPU backend, where async is UNSAFE with the
+        # trainer's buffer donation: a CPU jax.Array IS host memory, so the
+        # background writer reads it zero-copy while run_chunk has already
+        # donated (reused) the very same buffer for the next chunk's
+        # outputs — the step lands on disk holding a later epoch's (or a
+        # diverged chunk's) bytes. The fault drills caught this as a
+        # poisoned rollback target (docs/robustness.md). Accelerators do a
+        # real synchronous D2H snapshot inside save(), so they keep the
+        # overlap.
+        if jax.default_backend() == "cpu":
+            self.manager.wait_until_finished()
 
     @property
     def latest_step(self) -> int | None:
@@ -251,19 +285,56 @@ class DIBCheckpointer:
         # buffers than trainer.init allocates. Where shapes agree the init
         # template (with its sharding) is kept; where they differ the stored
         # shape wins (restored unsharded — reshard on first use if needed).
-        meta = self.manager.item_metadata(step)
-        abstract["history"] = jax.tree.map(
-            lambda tmpl, stored: tmpl
-            if tuple(tmpl.shape) == tuple(stored.shape)
-            else jax.ShapeDtypeStruct(stored.shape, tmpl.dtype),
-            abstract["history"], dict(meta["history"]),
-        )
+        # Orbax surfaces a truncated/bit-rotted step dir as whatever its
+        # innermost reader happens to raise (msgpack errors, shape errors,
+        # OSError, ...). Translate the on-disk reads into one actionable
+        # CheckpointCorruptionError naming the step, so callers (and the
+        # watchdog's relaunch path via restore_latest_intact) can fall back
+        # to an earlier step instead of dying in a deep pytree traceback —
+        # but keep TEMPLATE mismatches (a wrong-architecture trainer, which
+        # is wrong at every step) out of the corruption label.
+        def _corrupt(exc: Exception) -> CheckpointCorruptionError:
+            return CheckpointCorruptionError(
+                f"Checkpoint step {step} in {self.directory} failed to "
+                f"restore ({type(exc).__name__}: {exc}) — the step "
+                "directory is likely corrupt (truncated file / torn write "
+                "at kill time). Restore an earlier step with "
+                "restore(step=...), or use restore_latest_intact() to "
+                "fall back automatically."
+            )
+
+        try:
+            meta = self.manager.item_metadata(step)
+        except Exception as exc:
+            raise _corrupt(exc) from exc
+        try:
+            abstract["history"] = jax.tree.map(
+                lambda tmpl, stored: tmpl
+                if tuple(tmpl.shape) == tuple(stored.shape)
+                else jax.ShapeDtypeStruct(stored.shape, tmpl.dtype),
+                abstract["history"], dict(meta["history"]),
+            )
+        except (ValueError, TypeError, KeyError) as exc:
+            # a history tree whose STRUCTURE disagrees with the template is
+            # a wrong-trainer/config error (pre-manifest checkpoints have
+            # no hash gate to catch it earlier), not disk corruption
+            raise ValueError(
+                f"Checkpoint step {step} in {self.directory} holds a "
+                f"history layout that does not match this trainer's "
+                f"template ({type(exc).__name__}: {exc}) — the run/config "
+                "flags differ from the run that wrote the checkpoint; "
+                "this is a template mismatch, not disk corruption."
+            ) from exc
         # Checkpoints written before chunk-size tracking lack the key; the
         # template must omit it too or Orbax refuses the restore outright.
         has_chunk = "chunk_size" in meta
         if has_chunk:
             abstract["chunk_size"] = jax.ShapeDtypeStruct((), np.int32)
-        restored = self.manager.restore(step, args=ocp.args.StandardRestore(abstract))
+        try:
+            restored = self.manager.restore(
+                step, args=ocp.args.StandardRestore(abstract))
+        except Exception as exc:
+            raise _corrupt(exc) from exc
         saved_chunk = int(np.asarray(restored["chunk_size"])) if has_chunk else 0
         self.restored_chunk_size = saved_chunk or None
         if chunk_size is not None and saved_chunk:
@@ -291,7 +362,86 @@ class DIBCheckpointer:
                     f"or omit chunk_size to extend this finished run on a "
                     f"fresh chunk grid."
                 )
-        return restored["state"], restored["history"], _unpack_key(restored["key"])
+        # Copy every restored leaf onto a fresh XLA-owned buffer. Orbax can
+        # hand back arrays backed by its OWN host memory (zero-copy on
+        # CPU), and the trainer's donated run_chunk would then alias — and
+        # eventually free — buffers it does not own. The fault drills
+        # caught this as nondeterministic heap corruption and stale bytes
+        # inside later checkpoints; one copy per (rare) restore is the
+        # insurance premium.
+        restored_state = jax.tree.map(jnp.copy, restored["state"])
+        restored_history = jax.tree.map(jnp.copy, restored["history"])
+        return restored_state, restored_history, _unpack_key(restored["key"])
+
+    def restore_latest_intact(self, trainer, template_key=None,
+                              chunk_size: int | None = None,
+                              on_fallback=None):
+        """Restore the NEWEST step that reads back intact.
+
+        The crash-recovery path the watchdog depends on: a worker SIGKILLed
+        mid-save can leave its latest step dir truncated, and a relaunch
+        that insists on that step crash-loops until the supervisor gives
+        up. Here corrupt steps (``CheckpointCorruptionError`` only —
+        template/chunk-contract ``ValueError``s still propagate, a wrong
+        architecture is wrong at every step) are skipped newest→oldest
+        with ``on_fallback({"step", "error", "deleted"})`` called per skip
+        (the CLI emits a ``checkpoint_fallback`` mitigation event from
+        it), and each skipped step is DELETED: orbax refuses to re-save a
+        step ``<= latest_step``, so a corrupt step left on disk would
+        silently block the re-trained gap from ever checkpointing again —
+        and remain the poisoned target of the next divergence rollback.
+        The steps skipped are recorded on
+        ``self.fallback_skipped_steps``. Raises the last corruption error
+        when every step is damaged.
+        """
+        self.manager.wait_until_finished()
+        steps = sorted(self.manager.all_steps(), reverse=True)
+        if not steps:
+            raise FileNotFoundError(f"No checkpoint found in {self.directory}")
+        # The integrity manifest is DIRECTORY-level (one file shared by all
+        # steps) and verified before any step data is read, so a corrupt
+        # manifest makes every step raise the identical error — walking on
+        # would delete every intact step over one damaged JSON file. Raise
+        # it here instead: the error names the one-file operator fix.
+        manifest = read_manifest(self.directory)
+        # Deletion safety: with a verified manifest, a wrong-architecture
+        # template fails at verify_manifest (a ValueError that propagates),
+        # so a CheckpointCorruptionError really is an on-disk read failure
+        # — safe to delete. WITHOUT a manifest (pre-manifest dirs) a deep
+        # restore error could equally be a template mismatch at every
+        # step; deleting on that evidence would destroy a healthy
+        # checkpoint history over a flag typo. Skip-only there.
+        safe_to_delete = manifest is not None
+        self.fallback_skipped_steps: list[int] = []
+        last_exc: CheckpointCorruptionError | None = None
+        for step in steps:
+            try:
+                out = self.restore(trainer, step=step,
+                                   template_key=template_key,
+                                   chunk_size=chunk_size)
+            except CheckpointCorruptionError as exc:
+                last_exc = exc
+                self.fallback_skipped_steps.append(step)
+                if safe_to_delete:
+                    try:
+                        self.manager.delete(step)
+                        deleted = True
+                    except Exception as delete_exc:
+                        # a half-torn dir orbax cannot delete must not
+                        # block the fallback walk; the skip is reported
+                        deleted = f"delete failed: {delete_exc}"
+                else:
+                    deleted = "kept: no integrity manifest, cannot rule " \
+                              "out a template mismatch"
+                if on_fallback is not None:
+                    on_fallback({"step": step, "error": str(exc),
+                                 "deleted": deleted})
+                continue
+            return out
+        raise CheckpointCorruptionError(
+            f"All {len(steps)} checkpoint step(s) in {self.directory} are "
+            f"corrupt; last error: {last_exc}"
+        ) from last_exc
 
     def close(self) -> None:
         self.manager.wait_until_finished()

@@ -311,6 +311,7 @@ class DIBTrainer:
         state: TrainState | None = None,
         history: dict | None = None,
         telemetry=None,
+        fault_plan=None,
     ) -> tuple[TrainState, HistoryRecord]:
         """Python-level driver: jitted chunks + host hooks between them.
 
@@ -334,6 +335,26 @@ class DIBTrainer:
         checkpoint) is CONSUMED: on accelerators its buffers are donated to
         the first chunk and must not be reused afterwards. To branch two
         runs from one checkpoint, restore (or copy) once per branch.
+
+        Divergence guard: after every chunk the boundary row's loss /
+        val_loss / per-feature KL are checked for finiteness (one small
+        host fetch the heartbeat/telemetry path pays anyway). A non-finite
+        boundary emits a ``mitigation`` event and — when a checkpoint hook
+        with a saved step is in ``hooks`` — rolls back to the last
+        chunk-aligned checkpoint and replays from there. Because β is
+        computed from the restored epoch index and the checkpoint carries
+        the boundary's PRNG key, the resume is β-schedule-consistent and
+        the replay is bit-identical to a never-diverged run (for transient
+        faults). A divergence that recurs at the SAME epoch after rollback
+        is deterministic, and raises instead of looping. Without a
+        checkpoint the guard warns loudly and continues (nothing to roll
+        back to) — the run is no longer silently training on garbage
+        either way.
+
+        ``fault_plan`` (a :class:`dib_tpu.faults.FaultPlan`, e.g. from
+        ``DIB_FAULT_PLAN`` via the CLI) fires deliberate faults at chunk
+        boundaries AFTER the boundary's hooks ran, so a checkpoint hook
+        always persisted the clean state first; see docs/robustness.md.
         """
         num_epochs = self.config.num_epochs if num_epochs is None else num_epochs
         if (state is None) != (history is None):
@@ -361,6 +382,10 @@ class DIBTrainer:
         # boundaries define the PRNG chain (one key split per chunk)
         chunk = hook_every if hook_every else num_epochs
         done = 0
+        start_epoch = cursor
+        chunk_index = 0          # 1-based fit-boundary ordinal (fault plans)
+        last_rollback_epoch = None
+        diverged_warned = False
         # The active tracer is bound for the whole fit so hook-level spans
         # (SpannedHook, PerReplicaHook) parent into this run's hierarchy.
         with trace.use_tracer(recorder.tracer):
@@ -382,18 +407,19 @@ class DIBTrainer:
                     )
                     ph.block_on(state.params)
                 done += this_chunk
+                chunk_index += 1
                 # Published for CheckpointHook: resuming fit(resume_key, ...)
                 # with the same chunk size continues the exact key chain, so
                 # the continuation is bit-identical to an uninterrupted run.
                 self.resume_key = key
                 self.latest_history = history
                 self.resume_chunk = chunk
+                row = jax.device_get({
+                    name: history[name][cursor + done - 1]
+                    for name in ("beta", "loss", "val_loss",
+                                 "kl_per_feature")
+                })
                 if telemetry is not None:
-                    row = jax.device_get({
-                        name: history[name][cursor + done - 1]
-                        for name in ("beta", "loss", "val_loss",
-                                     "kl_per_feature")
-                    })
                     recorder.record_chunk(
                         epoch=cursor + done, chunk_epochs=this_chunk,
                         beta=float(row["beta"]),
@@ -402,10 +428,128 @@ class DIBTrainer:
                         kl_per_feature=[float(x)
                                         for x in row["kl_per_feature"]],
                     )
+                if not _row_finite(row):
+                    ckpt = _find_checkpointer(hooks)
+                    if ckpt is not None and ckpt.latest_step is not None:
+                        state, history, key, done, last_rollback_epoch = (
+                            self._rollback_divergence(
+                                ckpt, telemetry, chunk, row,
+                                epoch=cursor + done, start_epoch=start_epoch,
+                                last_rollback_epoch=last_rollback_epoch,
+                            )
+                        )
+                        self.resume_key = key
+                        self.latest_history = history
+                        continue   # diverged boundary: no hooks, no faults
+                    if not diverged_warned:
+                        diverged_warned = True
+                        self._warn_divergence_unrecoverable(
+                            telemetry, row, epoch=cursor + done,
+                        )
+                    # nothing to roll back to: keep training (back-compat),
+                    # but the stream + warning record the divergence
                 for hook in hooks:
                     hook(self, state, int(state.epoch))
+                if fault_plan is not None and fault_plan.due(chunk_index):
+                    # AFTER hooks: the checkpoint hook persisted the clean
+                    # state; a nan/inf fault poisons only what comes next
+                    from dib_tpu.faults import apply_due_train_faults
+
+                    state = apply_due_train_faults(
+                        fault_plan, chunk_index, state, telemetry,
+                    )
         recorder.finish()
         return state, HistoryRecord.from_device(history)
+
+    def _warn_divergence_unrecoverable(self, telemetry, row, *, epoch):
+        """Non-finite boundary with nothing to roll back to: say so, once."""
+        import warnings
+
+        if telemetry is not None:
+            telemetry.mitigation(
+                mtype="divergence_detected", epoch=epoch, action="none",
+                reason="no checkpoint hook / saved step to roll back to",
+                **_row_detail(row),
+            )
+        warnings.warn(
+            f"non-finite loss/KL at epoch {epoch} "
+            f"(loss={_row_detail(row).get('loss')}); no checkpoint to roll "
+            "back to — training continues on a diverged state. Add a "
+            "CheckpointHook to fit(hooks=...) to enable automatic "
+            "rollback (docs/robustness.md)."
+        )
+
+    def _rollback_divergence(self, ckpt, telemetry, chunk, row, *, epoch,
+                             start_epoch, last_rollback_epoch):
+        """Non-finite boundary: mitigation event + checkpoint rollback.
+
+        Returns the new ``(state, history, key, done, last_rollback_epoch)``
+        for the fit loop. Raises when the divergence is deterministic (it
+        recurred at or before the last rollback's epoch) or the restore
+        itself fails.
+        """
+        import warnings
+
+        detail = _row_detail(row)
+        if last_rollback_epoch is not None and epoch <= last_rollback_epoch:
+            raise RuntimeError(
+                f"training diverged again at epoch {epoch} after rolling "
+                f"back (previous divergence at epoch {last_rollback_epoch}) "
+                "— the trajectory diverges deterministically; lower the "
+                "learning rate or the β ceiling, or resume from an earlier "
+                "checkpoint (docs/robustness.md)."
+            )
+        def report_fallback(info: dict) -> None:
+            # a step skipped (and deleted) mid-rollback must be as loud as
+            # the CLI resume path's: mitigation event + warning — recovery
+            # is never silent
+            if telemetry is not None:
+                telemetry.mitigation(mtype="checkpoint_fallback", **info)
+            warnings.warn(
+                f"divergence rollback: checkpoint step {info['step']} is "
+                f"corrupt and was skipped (deleted={info.get('deleted')}): "
+                f"{info['error']}"
+            )
+
+        try:
+            # fallback-aware: a corrupt latest step (e.g. torn by an
+            # earlier kill) is skipped — and deleted so the re-trained gap
+            # can checkpoint again — instead of wedging every rollback
+            if hasattr(ckpt, "restore_latest_intact"):
+                state, history, key = ckpt.restore_latest_intact(
+                    self, chunk_size=chunk, on_fallback=report_fallback)
+            else:
+                state, history, key = ckpt.restore(self, chunk_size=chunk)
+        except Exception as exc:
+            raise RuntimeError(
+                f"divergence rollback failed: non-finite loss at epoch "
+                f"{epoch} and the checkpoint at step {ckpt.latest_step} "
+                f"could not be restored ({type(exc).__name__}: {exc})"
+            ) from exc
+        restored_epoch = int(jax.device_get(state.epoch))
+        if restored_epoch < start_epoch:
+            # a checkpoint from BEFORE this fit began (e.g. a reused
+            # directory holding an older run) — "rolling back" to it would
+            # drive `done` negative, index history rows from the wrong end,
+            # and silently continue a different run's trajectory
+            raise RuntimeError(
+                f"divergence rollback refused: the latest checkpoint is at "
+                f"epoch {restored_epoch}, BEFORE this fit's start epoch "
+                f"{start_epoch} — the checkpoint directory predates this "
+                "fit (reused dir?). Restart the run from that checkpoint "
+                "explicitly instead."
+            )
+        if telemetry is not None:
+            telemetry.mitigation(
+                mtype="divergence_rollback", epoch=epoch,
+                restored_epoch=restored_epoch, **detail,
+            )
+        warnings.warn(
+            f"non-finite loss/KL at epoch {epoch}; rolled back to the "
+            f"chunk-aligned checkpoint at epoch {restored_epoch} "
+            "(β-schedule-consistent resume)"
+        )
+        return state, history, key, restored_epoch - start_epoch, epoch
 
     # ------------------------------------------------------------ inspection
     def encode_feature(self, state: TrainState, feature_index: int, x_feature):
@@ -420,3 +564,47 @@ class DIBTrainer:
         if arr is None:
             arr = self.bundle.x_valid if split == "valid" else self.bundle.x_train
         return arr[:, start : start + dims[feature_index]]
+
+
+# ------------------------------------------------------- divergence guard
+def _row_finite(row: dict) -> bool:
+    """True iff every fetched boundary metric (loss/val_loss/KL) is finite."""
+    return all(
+        bool(np.isfinite(np.asarray(row[name])).all())
+        for name in ("loss", "val_loss", "kl_per_feature")
+    )
+
+
+def _row_detail(row: dict) -> dict:
+    """JSON-ready view of the diverged boundary row for mitigation events."""
+    return {
+        "loss": float(np.asarray(row["loss"]).ravel()[0]),
+        "val_loss": float(np.asarray(row["val_loss"]).ravel()[0]),
+        "kl_per_feature": [float(x)
+                           for x in np.asarray(row["kl_per_feature"]).ravel()],
+    }
+
+
+def _find_checkpointer(hooks) -> object | None:
+    """The DIBCheckpointer hiding in a fit hook list, or None.
+
+    Unwraps the adapter layers hooks actually arrive in — ``Every``
+    (cadence), ``TimedHook`` (telemetry; its ``__getattr__`` also forwards,
+    but unwrap explicitly so a missing passthrough cannot hide it), and
+    anything exposing ``telemetry_inner_hooks`` (the CLI's combined-hook
+    adapter, ``PerReplicaHook``).
+    """
+    pending = list(hooks)
+    while pending:
+        hook = pending.pop(0)
+        ckpt = getattr(hook, "checkpointer", None)
+        if ckpt is not None and hasattr(ckpt, "restore") \
+                and hasattr(ckpt, "latest_step"):
+            return ckpt
+        inner = getattr(hook, "hook", None)
+        if inner is not None:
+            pending.append(inner)
+        more = getattr(hook, "telemetry_inner_hooks", None)
+        if more:
+            pending.extend(more)
+    return None

@@ -104,6 +104,14 @@ class WatchdogConfig:
     floor_s: float = 45.0
     poll_s: float = 1.0
     max_restarts: int = 3
+    # Crash-loop damping: when a worker dies within ``min_uptime_s`` of its
+    # launch (a deterministic early crash, e.g. a poisoned checkpoint or a
+    # bad flag — not a mid-run stall), sleep ``restart_backoff_s`` x
+    # consecutive-quick-failures before relaunching, so max_restarts buys
+    # wall-clock for a transient cause (full disk, tunnel blip) to clear
+    # instead of being burned in milliseconds. 0 disables (the default).
+    restart_backoff_s: float = 0.0
+    min_uptime_s: float = 10.0
 
 
 def _read_heartbeat(path: str) -> dict | None:
@@ -240,6 +248,7 @@ def supervise_self(
 def _supervise_loop(cmd, heartbeat_path, cfg, env, log, mitigations,
                     t_start, current) -> dict:
     launches = 0
+    quick_failures = 0
     while True:
         # a stale beat from the previous attempt must not mask a wedged
         # relaunch
@@ -314,3 +323,14 @@ def _supervise_loop(cmd, heartbeat_path, cfg, env, log, mitigations,
                 "mitigations": mitigations,
                 "error": f"gave up after {launches} launches",
             }
+        # crash-loop damping (see WatchdogConfig): quick deaths back off,
+        # anything that survived min_uptime_s resets the counter
+        if time.time() - launched < cfg.min_uptime_s:
+            quick_failures += 1
+            if cfg.restart_backoff_s > 0:
+                delay = cfg.restart_backoff_s * quick_failures
+                log(f"watchdog: worker died {quick_failures}x within "
+                    f"{cfg.min_uptime_s:.0f}s — backing off {delay:.1f}s")
+                time.sleep(delay)
+        else:
+            quick_failures = 0

@@ -1,0 +1,127 @@
+"""Static check: no silently-swallowed broad exceptions in the package.
+
+A robustness subsystem is only as honest as its error handling: an
+``except Exception: pass`` turns a real fault into nothing — no re-raise,
+no error result, no telemetry event — which is exactly how a recovery
+path rots until a drill (or production) finds it. This check walks the
+``dib_tpu/`` AST and fails on any handler that
+
+  - catches a BROAD type (bare ``except:``, ``Exception``, or
+    ``BaseException`` — alone or inside a tuple), AND
+  - has a body that does NOTHING (only ``pass`` / ``...``).
+
+Handlers that re-raise, return an error result, log, emit a telemetry
+event, or catch a NARROW exception (``except ProcessLookupError: pass``
+around a kill of an already-dead pid is fine) all pass. A reviewed
+exception can carry a ``# fault-ok: <reason>`` pragma on the ``except``
+line.
+
+Runnable three ways::
+
+    python scripts/check_exception_hygiene.py   # standalone, rc 1 on bad
+    python -m pytest scripts/check_exception_hygiene.py
+    python -m pytest tests/test_faults.py       # imports scan_package()
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.join(REPO, "dib_tpu")
+
+_BROAD = {"Exception", "BaseException"}
+_PRAGMA = "fault-ok"
+
+POINTER = (
+    "silent broad exception handler in package code: every handler must "
+    "re-raise, return an error result, or emit a telemetry event — an "
+    "`except Exception: pass` hides the faults the recovery paths exist "
+    "for. Narrow the exception type, handle it, or justify with a "
+    "`# fault-ok: <reason>` pragma (docs/robustness.md)"
+)
+
+
+def _broad_names(handler: ast.ExceptHandler) -> bool:
+    """True when the handler catches Exception/BaseException or is bare."""
+    node = handler.type
+    if node is None:
+        return True
+    elts = node.elts if isinstance(node, ast.Tuple) else [node]
+    for elt in elts:
+        name = elt.id if isinstance(elt, ast.Name) else (
+            elt.attr if isinstance(elt, ast.Attribute) else None)
+        if name in _BROAD:
+            return True
+    return False
+
+
+def _body_is_silent(handler: ast.ExceptHandler) -> bool:
+    """True when the body does nothing: only pass / bare ellipsis."""
+    for stmt in handler.body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if (isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Constant)
+                and stmt.value.value is Ellipsis):
+            continue
+        return False
+    return True
+
+
+def scan_file(path: str, rel: str) -> list[str]:
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    lines = source.splitlines()
+    try:
+        tree = ast.parse(source, filename=rel)
+    except SyntaxError as exc:
+        return [f"{rel}: unparseable ({exc})"]
+    violations = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not (_broad_names(node) and _body_is_silent(node)):
+            continue
+        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+        if _PRAGMA in line:
+            continue
+        violations.append(f"{rel}:{node.lineno}: {line.strip()}")
+    return violations
+
+
+def scan_package(package_dir: str = PACKAGE) -> list[str]:
+    """``["relpath:lineno: <line>"]`` for every silent broad handler."""
+    violations: list[str] = []
+    for dirpath, dirnames, filenames in os.walk(package_dir):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, package_dir).replace(os.sep, "/")
+            violations.extend(scan_file(path, rel))
+    return violations
+
+
+# ---------------------------------------------------------------- pytest
+def test_no_silent_broad_exception_handlers_in_package():
+    violations = scan_package()
+    assert not violations, POINTER + "\n" + "\n".join(violations)
+
+
+def main() -> int:
+    violations = scan_package()
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"\n{len(violations)} violation(s). {POINTER}")
+        return 1
+    print("exception hygiene: ok (no silent broad handlers in dib_tpu/)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

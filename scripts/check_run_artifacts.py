@@ -35,7 +35,7 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-ARTIFACT_GLOBS = ("BENCH_*.json", "NORTHSTAR_*.json")
+ARTIFACT_GLOBS = ("BENCH_*.json", "NORTHSTAR_*.json", "FAULT_DRILL*.json")
 
 # Null-value excuses: at least one must be present when value is null.
 _NULL_VALUE_EXCUSES = ("degraded", "error", "per_run_minutes", "runs_completed")

@@ -1,0 +1,569 @@
+"""The fault-drill matrix: inject every fault kind, prove every recovery.
+
+Runs the full ``dib_tpu/faults`` drill matrix end to end on CPU
+(docs/robustness.md) and emits ONE bench-shaped JSON record
+(``FAULT_DRILL.json``, validated by ``scripts/check_run_artifacts.py``):
+
+  - **train drills** (subprocess CLI workers under
+    ``watchdog.supervise``): ``stall`` (watchdog SIGKILL + relaunch),
+    ``kill`` (crash restart), ``nan`` (in-fit divergence rollback) — each
+    must finish with a history **bit-identical** to an uninterrupted
+    baseline run of the same command;
+  - **checkpoint drills** (in-process): a truncated latest step falls
+    back to the previous intact step; a bit-flipped manifest raises an
+    actionable ``CheckpointCorruptionError`` instead of a deep pytree
+    traceback;
+  - **serve drills** (in-process server + HTTP clients): an erroring
+    replica is ejected with ZERO client-visible 5xx while a healthy
+    replica exists, then probe-re-admitted once healed; a slow replica is
+    ejected via timeout failures; a dead batcher thread turns
+    ``/healthz`` into a truthful 503; malformed / oversized / dropped
+    HTTP requests are contained as 4xx without wounding the server.
+
+Every injection lands as a ``fault`` event and every recovery as a
+``mitigation`` on the drills' event streams, so ``telemetry summarize``
+reproduces the injected/detected/recovered counts independently of this
+script's own bookkeeping (the committed record carries both).
+
+Usage::
+
+    python scripts/fault_drill.py --out FAULT_DRILL.json           # full
+    python scripts/fault_drill.py --quick                          # no subprocess drills
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+METRIC = "fault_drill_matrix"
+
+# Tiny CLI training run shared by the train drills and their baseline:
+# 12 epochs in 3-epoch chunks (4 boundaries), checkpoint every chunk.
+_TRAIN_FLAGS = [
+    "--dataset", "boolean_circuit",
+    "--number_pretraining_epochs", "4",
+    "--number_annealing_epochs", "8",
+    "--batch_size", "64",
+    "--feature_encoder_architecture", "16",
+    "--integration_network_architecture", "32",
+    "--feature_embedding_dimension", "4",
+    "--max_val_points", "256",
+    "--checkpoint_frequency", "3",
+]
+
+
+def _worker_env(**extra) -> dict:
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "DIB_COMPILE_CACHE": "",
+        "JAX_COMPILATION_CACHE_DIR":
+            os.path.expanduser("~/.cache/jax_comp_cache_cpu"),
+        "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "0.2",
+        "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES": "0",
+    })
+    env.pop("DIB_FAULT_PLAN", None)
+    env.pop("DIB_FAULT_STATE_DIR", None)
+    env.update(extra)
+    return env
+
+
+def _train_cmd(outdir: str) -> list[str]:
+    return [sys.executable, "-m", "dib_tpu.cli", "train",
+            "--artifact_outdir", outdir,
+            "--checkpoint_dir", os.path.join(outdir, "ckpt"),
+            "--heartbeat", os.path.join(outdir, "hb.json"),
+            *_TRAIN_FLAGS]
+
+
+def _histories_identical(dir_a: str, dir_b: str) -> bool:
+    import numpy as np
+
+    a = np.load(os.path.join(dir_a, "history.npz"))
+    b = np.load(os.path.join(dir_b, "history.npz"))
+    if sorted(a.files) != sorted(b.files):
+        return False
+    return all(np.array_equal(a[k], b[k]) for k in a.files)
+
+
+def _stream_evidence(run_dir: str) -> dict:
+    """The events-stream view of one drill: its faults rollup + counts."""
+    from dib_tpu.telemetry import summarize
+
+    summary = summarize(run_dir)
+    return {
+        "faults": summary.get("faults"),
+        "mitigations": summary.get("mitigations"),
+        "status": summary.get("status"),
+    }
+
+
+def _drill_record(name: str, kind: str, ok: bool, **details) -> dict:
+    return {"drill": name, "kind": kind, "ok": bool(ok), **details}
+
+
+# ------------------------------------------------------------ train drills
+def run_baseline(workdir: str, log) -> str:
+    outdir = os.path.join(workdir, "baseline")
+    log(f"drill baseline: uninterrupted run -> {outdir}")
+    subprocess.run(_train_cmd(outdir), env=_worker_env(), check=True,
+                   timeout=600, stdout=subprocess.DEVNULL)
+    return outdir
+
+
+def run_supervised_drill(name: str, plan: str, workdir: str, baseline: str,
+                         log) -> dict:
+    """stall / kill drill: the CLI worker under supervise() with the fault
+    plan armed; evidence = mitigation kind, completion, bit-identity."""
+    from dib_tpu.telemetry import EventWriter
+    from dib_tpu.train.watchdog import WatchdogConfig, supervise
+
+    outdir = os.path.join(workdir, name)
+    os.makedirs(outdir, exist_ok=True)
+    run_id = f"fault-drill-{name}"
+    env = _worker_env(
+        DIB_FAULT_PLAN=plan,
+        DIB_FAULT_STATE_DIR=outdir,
+        DIB_TELEMETRY_RUN_ID=run_id,
+    )
+    # supervisor mitigations land on the SAME events.jsonl the worker
+    # writes (O_APPEND; the run id is pinned so summarize sees one run)
+    telemetry = EventWriter(outdir, run_id=run_id, process_index=0,
+                            tags={"src": "supervisor"})
+    log(f"drill {name}: plan={plan} under watchdog.supervise")
+    t0 = time.time()
+    try:
+        result = supervise(
+            _train_cmd(outdir), os.path.join(outdir, "hb.json"),
+            WatchdogConfig(first_beat_timeout_s=420.0, floor_s=6.0, k=3.0,
+                           poll_s=0.25, max_restarts=2),
+            env=env, telemetry=telemetry,
+        )
+    finally:
+        telemetry.close()
+    wall = round(time.time() - t0, 1)
+    kinds = [m["type"] for m in result["mitigations"]]
+    identical = (result["returncode"] == 0
+                 and _histories_identical(baseline, outdir))
+    expect = "stall_kill" if name == "train_stall" else "crash_restart"
+    ok = (result["returncode"] == 0 and expect in kinds
+          and result["launches"] == 2 and identical)
+    return _drill_record(
+        name, plan.split("@")[0], ok,
+        watchdog={"returncode": result["returncode"],
+                  "launches": result["launches"], "mitigations": kinds},
+        bit_identical_history=identical, wall_s=wall,
+        evidence=_stream_evidence(outdir),
+    )
+
+
+def run_nan_drill(workdir: str, baseline: str, log) -> dict:
+    """nan drill: the worker itself detects the non-finite boundary and
+    rolls back to its chunk-aligned checkpoint — no supervisor involved;
+    the run must exit 0 with a bit-identical history."""
+    outdir = os.path.join(workdir, "train_nan")
+    plan = "nan@chunk2"
+    log(f"drill train_nan: plan={plan} (in-worker rollback)")
+    t0 = time.time()
+    proc = subprocess.run(
+        _train_cmd(outdir),
+        env=_worker_env(DIB_FAULT_PLAN=plan, DIB_FAULT_STATE_DIR=outdir),
+        timeout=600, capture_output=True, text=True,
+    )
+    wall = round(time.time() - t0, 1)
+    identical = proc.returncode == 0 and _histories_identical(baseline, outdir)
+    evidence = _stream_evidence(outdir) if proc.returncode == 0 else {}
+    faults = evidence.get("faults") or {}
+    ok = (proc.returncode == 0 and identical
+          and faults.get("detected") == faults.get("injected") == 1
+          and faults.get("recovered") == 1)
+    return _drill_record(
+        "train_nan", "nan", ok, returncode=proc.returncode,
+        bit_identical_history=identical, wall_s=wall, evidence=evidence,
+        **({} if proc.returncode == 0
+           else {"stderr_tail": proc.stderr[-1500:]}),
+    )
+
+
+# ------------------------------------------------------- checkpoint drills
+def _tiny_trainer():
+    from dib_tpu.data import get_dataset
+    from dib_tpu.models import DistributedIBModel
+    from dib_tpu.train import DIBTrainer, TrainConfig
+
+    bundle = get_dataset("boolean_circuit")
+    model = DistributedIBModel(
+        feature_dimensionalities=tuple(bundle.feature_dimensionalities),
+        encoder_hidden=(8,), integration_hidden=(16,),
+        output_dim=1, embedding_dim=2,
+    )
+    config = TrainConfig(batch_size=64, num_pretraining_epochs=2,
+                         num_annealing_epochs=4, steps_per_epoch=2,
+                         max_val_points=128)
+    return DIBTrainer(model, bundle, config)
+
+
+def run_ckpt_drills(workdir: str, log) -> list[dict]:
+    import jax
+
+    from dib_tpu.faults import corrupt_checkpoint
+    from dib_tpu.telemetry import EventWriter, runtime_manifest
+    from dib_tpu.train import (
+        CheckpointCorruptionError,
+        CheckpointHook,
+        DIBCheckpointer,
+    )
+
+    records = []
+    run_dir = os.path.join(workdir, "ckpt_drills")
+    writer = EventWriter(run_dir)
+    writer.run_start(runtime_manifest(extra={"mode": "fault_drill"}))
+
+    def fallback_reporter(info):
+        writer.mitigation(mtype="checkpoint_fallback", **info)
+
+    # --- truncation: fall back to the previous intact step
+    log("drill ckpt_truncate: truncated latest step -> fallback restore")
+    trainer = _tiny_trainer()
+    ckpt_dir = os.path.join(workdir, "ckpt_truncate")
+    ckpt = DIBCheckpointer(ckpt_dir)
+    trainer.fit(jax.random.key(0), hooks=[CheckpointHook(ckpt)], hook_every=3)
+    ckpt.manager.wait_until_finished()
+    detail = corrupt_checkpoint(ckpt_dir, "ckpt_truncate", telemetry=writer)
+    t0 = time.time()
+    try:
+        state, _, _ = ckpt.restore_latest_intact(
+            _tiny_trainer(), chunk_size=3, on_fallback=fallback_reporter)
+        restored_epoch = int(jax.device_get(state.epoch))
+        skipped = list(ckpt.fallback_skipped_steps)
+        ok = restored_epoch == 3 and skipped == [6]
+        err = None
+    except Exception as exc:
+        ok, restored_epoch, skipped, err = False, None, None, str(exc)
+    finally:
+        ckpt.close()
+    records.append(_drill_record(
+        "ckpt_truncate", "ckpt_truncate", ok,
+        corrupted=detail, restored_epoch=restored_epoch,
+        skipped_steps=skipped, time_to_recover_s=round(time.time() - t0, 3),
+        **({"error": err} if err else {}),
+    ))
+
+    # --- manifest bit flip: actionable error, not a deep traceback
+    log("drill ckpt_bitflip_manifest: flipped manifest byte -> actionable error")
+    trainer = _tiny_trainer()
+    ckpt_dir = os.path.join(workdir, "ckpt_manifest")
+    ckpt = DIBCheckpointer(ckpt_dir)
+    trainer.fit(jax.random.key(1), hooks=[CheckpointHook(ckpt)], hook_every=6)
+    ckpt.manager.wait_until_finished()
+    detail = corrupt_checkpoint(ckpt_dir, "ckpt_bitflip_manifest",
+                                telemetry=writer)
+    try:
+        ckpt.restore(_tiny_trainer())
+        ok, message = False, "restore of a flipped manifest did not raise"
+    except CheckpointCorruptionError as exc:
+        message = str(exc)
+        ok = "manifest" in message and "dib_manifest.json" in message
+        writer.mitigation(mtype="checkpoint_fallback",
+                          step=None, error=message[:300])
+    except Exception as exc:
+        ok, message = False, f"wrong error type {type(exc).__name__}: {exc}"
+    finally:
+        ckpt.close()
+    records.append(_drill_record(
+        "ckpt_bitflip_manifest", "ckpt_bitflip_manifest", ok,
+        corrupted=detail, error_message=message[:300],
+    ))
+    writer.run_end(status="ok")
+    writer.close()
+    for record in records:
+        record["evidence_run_dir"] = run_dir
+    return records
+
+
+# ------------------------------------------------------------ serve drills
+def _serve_stack(run_dir: str, num_replicas: int = 2, sick: dict | None = None,
+                 eject_after: int = 3, probe_after_s: float = 0.5):
+    """In-process server over ``num_replicas`` entries sharing tiny params;
+    entry 0 optionally wrapped in a FlakyEngine (``sick`` kwargs)."""
+    import jax
+    import numpy as np
+
+    from dib_tpu.data import get_dataset
+    from dib_tpu.faults import FlakyEngine
+    from dib_tpu.models import DistributedIBModel
+    from dib_tpu.serve import (
+        DIBServer,
+        InferenceEngine,
+        MicroBatcher,
+        ReplicaEntry,
+        ReplicaRouter,
+    )
+    from dib_tpu.telemetry import (
+        EventWriter,
+        MetricsRegistry,
+        Tracer,
+        runtime_manifest,
+    )
+
+    bundle = get_dataset("boolean_circuit")
+    model = DistributedIBModel(
+        feature_dimensionalities=tuple(bundle.feature_dimensionalities),
+        encoder_hidden=(8,), integration_hidden=(16,),
+        output_dim=1, embedding_dim=2,
+    )
+    x0 = np.asarray(bundle.x_train[:4], np.float32)
+    params = model.init(jax.random.key(0), x0, jax.random.key(1))
+    writer = EventWriter(run_dir)
+    writer.run_start(runtime_manifest(extra={"mode": "serve",
+                                             "fault_drill": True}))
+    registry = MetricsRegistry()
+    tracer = Tracer(writer)
+    entries, flaky = [], None
+    for i in range(num_replicas):
+        engine = InferenceEngine(model, params, batch_buckets=(1, 4),
+                                 registry=registry)
+        if i == 0 and sick is not None:
+            engine = flaky = FlakyEngine(engine, telemetry=writer,
+                                         replica=0, **sick)
+        batcher = MicroBatcher(engine, max_batch=4, max_wait_ms=0.5,
+                               tracer=tracer, registry=registry)
+        entries.append(ReplicaEntry(engine, batcher, i))
+    router = ReplicaRouter(entries, eject_after=eject_after,
+                           probe_after_s=probe_after_s, telemetry=writer,
+                           registry=registry)
+    server = DIBServer(router, port=0, telemetry=writer,
+                       registry=registry).start()
+    return server, router, flaky, writer
+
+
+def _post(url: str, payload, timeout: float = 30.0) -> int:
+    """POST and return the status; 0 when the server hung up mid-send (the
+    413 path closes the socket without draining the body, so a large
+    request can die as a broken pipe before the status is readable)."""
+    data = (payload if isinstance(payload, bytes)
+            else json.dumps(payload).encode())
+    request = urllib.request.Request(
+        url, data=data, headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(request, timeout=timeout) as resp:
+            return resp.status
+    except urllib.error.HTTPError as exc:
+        exc.read()
+        return exc.code
+    except urllib.error.URLError:
+        return 0
+
+
+def _healthz(url: str) -> tuple[int, dict]:
+    try:
+        with urllib.request.urlopen(url + "/healthz", timeout=30) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as exc:
+        return exc.code, json.loads(exc.read())
+
+
+def run_serve_drills(workdir: str, log) -> list[dict]:
+    import numpy as np
+
+    from dib_tpu.faults import kill_batcher_worker
+
+    records = []
+    width = None
+
+    # --- erroring replica: ejected, zero client-visible 5xx, re-admitted
+    log("drill serve_replica_error: sick replica among healthy ones")
+    run_dir = os.path.join(workdir, "serve_replica_error")
+    server, router, flaky, writer = _serve_stack(
+        run_dir, sick={"fail_next": 1000}, probe_after_s=30.0)
+    try:
+        width = router.entries[0].engine.feature_width
+        row = [0.0] * width
+        statuses = [_post(server.url + "/v1/predict", {"x": row})
+                    for _ in range(16)]
+        ejected = router.entries[0].ejected
+        flaky.heal()
+        router.probe_ejected(force=True)
+        readmitted = not router.entries[0].ejected
+        after = [_post(server.url + "/v1/predict", {"x": row})
+                 for _ in range(4)]
+    finally:
+        server.close()
+    ok = (all(s == 200 for s in statuses) and ejected and readmitted
+          and all(s == 200 for s in after))
+    records.append(_drill_record(
+        "serve_replica_error", "replica_error", ok,
+        statuses={s: statuses.count(s) for s in set(statuses)},
+        ejected=ejected, readmitted=readmitted,
+        client_visible_5xx=sum(1 for s in statuses + after if s >= 500),
+        evidence=_stream_evidence(run_dir),
+    ))
+
+    # --- slow replica: deadline failures count toward ejection
+    log("drill serve_replica_slow: replica sleeping past request deadlines")
+    run_dir = os.path.join(workdir, "serve_replica_slow")
+    server, router, flaky, writer = _serve_stack(
+        run_dir, sick={"delay_s": 0.6}, eject_after=2, probe_after_s=30.0)
+    try:
+        row = [0.0] * width
+        # short per-request deadlines: the slow replica times out, the
+        # healthy one answers; after ejection everything is fast 200s
+        statuses = [_post(server.url + "/v1/predict",
+                          {"x": row, "timeout_s": 0.25}) for _ in range(10)]
+        ejected = router.entries[0].ejected
+        flaky.heal()
+        router.probe_ejected(force=True)
+        after = [_post(server.url + "/v1/predict", {"x": row})
+                 for _ in range(4)]
+        readmitted = not router.entries[0].ejected
+    finally:
+        server.close()
+    # 504s on the slow replica are the injected deadline expiring — the
+    # fault working as designed; what must NOT appear is a 500/503 while
+    # the healthy replica exists
+    hard_errors = sum(1 for s in statuses + after if s in (500, 503))
+    ok = (ejected and readmitted and statuses.count(200) >= 5
+          and hard_errors == 0 and all(s == 200 for s in after))
+    records.append(_drill_record(
+        "serve_replica_slow", "replica_slow", ok,
+        statuses={s: statuses.count(s) for s in set(statuses)},
+        ejected=ejected, readmitted=readmitted,
+        client_visible_5xx=hard_errors,
+        evidence=_stream_evidence(run_dir),
+    ))
+
+    # --- dead batcher thread: /healthz tells the truth
+    log("drill serve_batcher_crash: killed worker thread -> healthz 503 "
+        "-> revival")
+    run_dir = os.path.join(workdir, "serve_batcher_crash")
+    server, router, flaky, writer = _serve_stack(run_dir, num_replicas=1,
+                                                 probe_after_s=30.0)
+    try:
+        status_before, _ = _healthz(server.url)
+        killed = kill_batcher_worker(router.entries[0].batcher,
+                                     telemetry=writer)
+        status_after, health = _healthz(server.url)
+        detail = health.get("detail", "")
+        # the maintenance tick revives the dead worker; healthz recovers
+        router.probe_ejected(force=True)
+        status_revived, _ = _healthz(server.url)
+        row = [0.0] * width
+        served_after_revival = _post(server.url + "/v1/predict", {"x": row})
+    finally:
+        server.close()
+    ok = (status_before == 200 and killed and status_after == 503
+          and "batcher" in detail and status_revived == 200
+          and served_after_revival == 200)
+    records.append(_drill_record(
+        "serve_batcher_crash", "batcher_crash", ok,
+        healthz_before=status_before, healthz_after=status_after,
+        healthz_revived=status_revived,
+        served_after_revival=served_after_revival,
+        detail=detail, evidence=_stream_evidence(run_dir),
+    ))
+
+    # --- malformed / oversized / dropped HTTP requests
+    log("drill http_malformed: bad JSON, wrong width, dropped connection")
+    run_dir = os.path.join(workdir, "serve_http_malformed")
+    server, router, flaky, writer = _serve_stack(run_dir, num_replicas=1)
+    try:
+        row = [0.0] * width
+        bad_json = _post(server.url + "/v1/predict", b"{not json")
+        wrong_width = _post(server.url + "/v1/predict",
+                            {"x": [0.0] * (width + 3)})
+        non_finite = _post(server.url + "/v1/predict",
+                           {"x": [float("nan")] * width})
+        # enough rows that the JSON body clears the server's 8 MiB cap
+        oversize_rows = (10 << 20) // (width * 5)
+        oversize = _post(server.url + "/v1/predict",
+                         {"x": [[0.0] * width] * oversize_rows})
+        # dropped connection: half a request, then hang up
+        host, port = server.host, server.port
+        with socket.create_connection((host, port), timeout=5) as sock:
+            sock.sendall(b"POST /v1/predict HTTP/1.1\r\n"
+                         b"Content-Length: 999\r\n\r\n{\"x\": [")
+        time.sleep(0.2)
+        survived = _post(server.url + "/v1/predict", {"x": row})
+    finally:
+        server.close()
+    # 0 = connection dropped mid-send: the 413 path intentionally closes
+    # the socket (an unread body would desync keep-alive), so the client
+    # may lose the pipe before the status is readable — containment either way
+    ok = (bad_json == 400 and wrong_width == 400 and non_finite == 400
+          and oversize in (413, 0) and survived == 200)
+    records.append(_drill_record(
+        "http_malformed", "http_malformed", ok,
+        bad_json=bad_json, wrong_width=wrong_width, non_finite=non_finite,
+        oversize=oversize, survived_drop=survived,
+    ))
+    return records
+
+
+# ----------------------------------------------------------------- driver
+def run_drills(workdir: str | None = None, quick: bool = False,
+               log=lambda m: print(m, file=sys.stderr, flush=True)) -> dict:
+    """Run the matrix; returns the bench-shaped record."""
+    owned = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="dib_fault_drill_")
+    matrix: list[dict] = []
+    try:
+        if not quick:
+            baseline = run_baseline(workdir, log)
+            matrix.append(run_supervised_drill(
+                "train_stall", "stall@chunk2:60", workdir, baseline, log))
+            matrix.append(run_supervised_drill(
+                "train_kill", "kill@chunk2", workdir, baseline, log))
+            matrix.append(run_nan_drill(workdir, baseline, log))
+        matrix.extend(run_ckpt_drills(workdir, log))
+        matrix.extend(run_serve_drills(workdir, log))
+    finally:
+        if owned:
+            shutil.rmtree(workdir, ignore_errors=True)
+    passed = sum(1 for d in matrix if d["ok"])
+    return {
+        "metric": METRIC,
+        "value": passed,
+        "unit": "drills_passed",
+        "total": len(matrix),
+        "quick": quick,
+        "all_passed": passed == len(matrix),
+        "matrix": matrix,
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--out", default=None,
+                        help="Also write the JSON record to this path.")
+    parser.add_argument("--quick", action="store_true",
+                        help="Skip the subprocess watchdog drills (train "
+                             "stall/kill/nan); checkpoint + serve drills "
+                             "only.")
+    parser.add_argument("--workdir", default=None,
+                        help="Keep drill artifacts here (default: a "
+                             "temp dir, removed afterwards).")
+    args = parser.parse_args(argv)
+    record = run_drills(workdir=args.workdir, quick=args.quick)
+    line = json.dumps(record)
+    print(line, flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(json.dumps(record, indent=1) + "\n")
+    return 0 if record["all_passed"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

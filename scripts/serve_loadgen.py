@@ -52,6 +52,30 @@ def _get_json(url: str, timeout: float = 10.0) -> dict:
         return json.loads(resp.read())
 
 
+def _poll_health(url: str, timeout: float = 10.0) -> tuple[int, dict]:
+    """GET /healthz tolerating a 503: a degraded server answers 503 WITH
+    the serving surface + a detail field (docs/robustness.md) — the
+    loadgen must read that body, not crash on the status."""
+    try:
+        with urllib.request.urlopen(url + "/healthz", timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as exc:
+        try:
+            return exc.code, json.loads(exc.read())
+        except Exception:
+            return exc.code, {}
+
+
+def _health_snapshot(status: int, health: dict) -> dict:
+    return {
+        "status_code": status,
+        "status": health.get("status"),
+        "healthy_replicas": health.get("healthy_replicas",
+                                       len(health.get("replicas", []))),
+        **({"detail": health["detail"]} if health.get("detail") else {}),
+    }
+
+
 def _post_json(url: str, payload: dict, timeout: float = 30.0) -> tuple[int, dict]:
     data = json.dumps(payload).encode()
     request = urllib.request.Request(
@@ -295,7 +319,18 @@ def main(argv=None) -> int:
                     "mode": "open" if args.rate else "closed",
                     "duration_s": args.duration}
     try:
-        health = _get_json(url + "/healthz")
+        # /healthz between phases: the pre-load poll shapes the traffic
+        # (feature width) and pins the starting health; the post-load poll
+        # catches a server the load itself degraded (ejected replicas,
+        # dead batcher) — a clean latency record over a half-dead server
+        # would be a lie of omission.
+        status, health = _poll_health(url)
+        record["health"] = {"before": _health_snapshot(status, health)}
+        if status != 200:
+            raise RuntimeError(
+                f"server unhealthy before load (healthz {status}: "
+                f"{health.get('detail', 'no detail')})"
+            )
         width = int(health["feature_width"])
         record["replicas"] = len(health.get("replicas", []))
         t0 = time.perf_counter()
@@ -308,6 +343,10 @@ def main(argv=None) -> int:
             record["concurrency"] = args.concurrency
         elapsed = time.perf_counter() - t0
         record["batch_fill_ratio"] = _batch_fill_from_metrics(url)
+        status, health = _poll_health(url)
+        record["health"]["after"] = _health_snapshot(status, health)
+        if status != 200:
+            record["degraded"] = "server_unhealthy_after_load"
     except Exception as exc:
         record.update({
             "value": None,

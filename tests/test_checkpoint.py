@@ -371,6 +371,151 @@ def test_manifest_absent_verifies_vacuously(tmp_path):
     verify_manifest(str(tmp_path / "nothing_here"), state.params)
 
 
+def _fit_two_checkpoints(tmp_path, name="ck"):
+    """A trainer run leaving steps [3, 6] behind."""
+    trainer = make_trainer()
+    ckpt = DIBCheckpointer(str(tmp_path / name))
+    trainer.fit(jax.random.key(0), num_epochs=6,
+                hooks=[CheckpointHook(ckpt)], hook_every=3)
+    ckpt.manager.wait_until_finished()
+    return ckpt
+
+
+def _truncate_largest(step_dir: str) -> None:
+    largest = max(
+        (os.path.join(root, name) for root, _, files in os.walk(step_dir)
+         for name in files),
+        key=os.path.getsize,
+    )
+    with open(largest, "rb+") as f:
+        f.truncate(os.path.getsize(largest) // 2)
+
+
+@pytest.mark.fault
+def test_truncated_step_raises_actionable_corruption_error(tmp_path):
+    """ISSUE 4 satellite: a truncated Orbax step dir must surface as ONE
+    actionable CheckpointCorruptionError naming the step — not a deep
+    pytree/msgpack traceback."""
+    from dib_tpu.faults import corrupt_checkpoint
+    from dib_tpu.train import CheckpointCorruptionError
+
+    ckpt = _fit_two_checkpoints(tmp_path)
+    corrupt_checkpoint(ckpt.directory, "ckpt_truncate")
+    with pytest.raises(CheckpointCorruptionError) as excinfo:
+        ckpt.restore(make_trainer(), step=6)
+    msg = str(excinfo.value)
+    assert "step 6" in msg and "restore_latest_intact" in msg
+    ckpt.close()
+
+
+@pytest.mark.fault
+def test_restore_latest_intact_falls_back_past_corruption(tmp_path):
+    """The watchdog-relaunch contract: a step truncated by the very kill
+    being recovered from must not crash-loop — fall back to the previous
+    intact step and report the skip."""
+    from dib_tpu.faults import corrupt_checkpoint
+
+    ckpt = _fit_two_checkpoints(tmp_path)
+    corrupt_checkpoint(ckpt.directory, "ckpt_truncate")
+    skipped = []
+    state, history, key = ckpt.restore_latest_intact(
+        make_trainer(), chunk_size=3, on_fallback=skipped.append)
+    assert int(state.epoch) == 3
+    assert [s["step"] for s in skipped] == [6]
+    assert ckpt.fallback_skipped_steps == [6]
+    # the restored state actually continues: finite params, right cursor
+    assert int(np.asarray(history["cursor"])) == 3
+    # the corrupt step was DELETED, not left as latest: orbax refuses to
+    # re-save step <= latest_step, so keeping it would silently block the
+    # re-trained gap from checkpointing and leave a poisoned rollback
+    # target (code review finding, verified by repro)
+    assert skipped[0]["deleted"] is True
+    assert 6 not in ckpt.manager.all_steps()
+    trainer = make_trainer()
+    state, hist2 = trainer.fit(key, num_epochs=3, state=state,
+                               history=history,
+                               hooks=[CheckpointHook(ckpt)], hook_every=3)
+    assert ckpt.latest_step == 6              # the gap re-saved cleanly
+    state6, _, _ = ckpt.restore(make_trainer(), step=6, chunk_size=3)
+    assert int(state6.epoch) == 6
+    ckpt.close()
+
+
+@pytest.mark.fault
+def test_restore_latest_intact_raises_when_everything_is_corrupt(tmp_path):
+    from dib_tpu.train import CheckpointCorruptionError
+
+    ckpt = _fit_two_checkpoints(tmp_path)
+    for step in ("3", "6"):
+        _truncate_largest(os.path.join(ckpt.directory, step))
+    with pytest.raises(CheckpointCorruptionError, match="corrupt"):
+        ckpt.restore_latest_intact(make_trainer(), chunk_size=3)
+    assert ckpt.fallback_skipped_steps == [6, 3]
+    ckpt.close()
+
+
+@pytest.mark.fault
+def test_corrupt_manifest_does_not_delete_intact_steps(tmp_path):
+    """The manifest is DIRECTORY-level: one torn JSON file must not make
+    the fallback walk delete every intact step (code review finding) —
+    restore_latest_intact raises the manifest error up front, steps
+    untouched."""
+    from dib_tpu.faults import corrupt_checkpoint
+    from dib_tpu.train import CheckpointCorruptionError
+
+    ckpt = _fit_two_checkpoints(tmp_path)
+    corrupt_checkpoint(ckpt.directory, "ckpt_bitflip_manifest")
+    with pytest.raises(CheckpointCorruptionError, match="manifest"):
+        ckpt.restore_latest_intact(make_trainer(), chunk_size=3)
+    assert sorted(ckpt.manager.all_steps()) == [3, 6]   # nothing deleted
+    # the operator action the error names actually works: delete the
+    # manifest, restore verifies vacuously, the data is intact
+    os.remove(os.path.join(ckpt.directory, "dib_manifest.json"))
+    state, _, _ = ckpt.restore_latest_intact(make_trainer(), chunk_size=3)
+    assert int(state.epoch) == 6
+    ckpt.close()
+
+
+@pytest.mark.fault
+def test_bitflipped_manifest_raises_actionable_error(tmp_path):
+    """A manifest that EXISTS but is unreadable is corruption evidence —
+    it must not silently verify vacuously like an absent one."""
+    from dib_tpu.faults import corrupt_checkpoint
+    from dib_tpu.train import CheckpointCorruptionError
+
+    ckpt = _fit_two_checkpoints(tmp_path)
+    corrupt_checkpoint(ckpt.directory, "ckpt_bitflip_manifest")
+    with pytest.raises(CheckpointCorruptionError) as excinfo:
+        ckpt.restore(make_trainer())
+    msg = str(excinfo.value)
+    assert "dib_manifest.json" in msg
+    assert "delete the manifest" in msg      # the operator action is named
+    ckpt.close()
+
+
+@pytest.mark.fault
+def test_donating_restored_buffers_cannot_poison_a_later_restore(tmp_path):
+    """The fault drills caught orbax handing back zero-copy host views
+    whose donation to run_chunk corrupted the heap and later checkpoints;
+    restore now copies every leaf onto XLA-owned buffers. Donating (and
+    overwriting) a restored tree must leave a subsequent restore of the
+    same step byte-identical."""
+    trainer = make_trainer()
+    ckpt = DIBCheckpointer(str(tmp_path / "ck"))
+    trainer.fit(jax.random.key(4), num_epochs=5,
+                hooks=[CheckpointHook(ckpt)], hook_every=5)
+    state, _, _ = ckpt.restore(make_trainer(), chunk_size=5)
+    baseline = [np.asarray(leaf).copy()
+                for leaf in jax.tree.leaves(state.params)]
+    consume = jax.jit(
+        lambda t: jax.tree.map(lambda a: a * 2.0 + 1.0, t), donate_argnums=0)
+    jax.block_until_ready(consume(state.params))   # overwrite the buffers
+    state2, _, _ = ckpt.restore(make_trainer(), chunk_size=5)
+    for want, got in zip(baseline, jax.tree.leaves(state2.params)):
+        np.testing.assert_array_equal(want, np.asarray(got))
+    ckpt.close()
+
+
 def test_param_structure_hash_properties():
     from dib_tpu.train.checkpoint import (
         param_structure_hash,

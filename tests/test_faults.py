@@ -1,0 +1,438 @@
+"""Fault-injection subsystem (dib_tpu/faults) + the divergence guard.
+
+The fast tier of the drill matrix: plan grammar, once-only fired state,
+param poisoning, the in-fit NaN drill (inject → detect → rollback →
+bit-identical finish), the faults telemetry rollup and its compare gate,
+and the exception-hygiene static check. The subprocess watchdog drills
+(stall/kill) live in ``tests/test_fault_drill.py`` behind
+``@pytest.mark.slow``.
+"""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from dib_tpu.data import get_dataset
+from dib_tpu.faults import FAULT_KINDS, FaultPlan, poison_params
+from dib_tpu.models import DistributedIBModel
+from dib_tpu.telemetry import (
+    EventWriter,
+    faults_rollup,
+    read_events,
+    runtime_manifest,
+    summarize,
+)
+from dib_tpu.telemetry.summary import compare
+from dib_tpu.train import (
+    CheckpointHook,
+    DIBCheckpointer,
+    DIBTrainer,
+    TrainConfig,
+)
+
+pytestmark = pytest.mark.fault
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------ plan grammar
+def test_plan_parses_the_readme_example():
+    plan = FaultPlan.parse("stall@chunk3:45s,kill@chunk5,nan@chunk7")
+    assert [(s.kind, s.chunk, s.arg) for s in plan.specs] == [
+        ("stall", 3, 45.0), ("kill", 5, None), ("nan", 7, None)]
+    assert [s.raw for s in plan.due(5)] == ["kill@chunk5"]
+    assert plan.due(4) == []
+
+
+def test_plan_rejects_unknown_kind_naming_the_registry():
+    with pytest.raises(ValueError, match="Unknown fault kind"):
+        FaultPlan.parse("gremlin@chunk1")
+    with pytest.raises(ValueError, match="kind@chunkN"):
+        FaultPlan.parse("stall at chunk 3")
+    # serve/checkpoint kinds are drill-injected, not plan-grammar kinds
+    with pytest.raises(ValueError, match="scope"):
+        FaultPlan.parse("replica_error@chunk1")
+
+
+def test_plan_stall_requires_seconds():
+    with pytest.raises(ValueError, match="argument"):
+        FaultPlan.parse("stall@chunk3")
+    assert FaultPlan.parse("stall@chunk3:45").specs[0].arg == 45.0
+
+
+def test_fired_markers_survive_across_plan_instances(tmp_path):
+    """The kill fault's contract: a relaunched worker re-parses the same
+    env plan but must find the fired marker and NOT re-fire."""
+    plan = FaultPlan.parse("kill@chunk2", state_dir=str(tmp_path))
+    (spec,) = plan.due(2)
+    plan.mark_fired(spec)
+    assert plan.due(2) == []
+    relaunched = FaultPlan.parse("kill@chunk2", state_dir=str(tmp_path))
+    assert relaunched.due(2) == []
+
+
+def test_plan_from_env(monkeypatch, tmp_path):
+    monkeypatch.delenv("DIB_FAULT_PLAN", raising=False)
+    assert FaultPlan.from_env() is None
+    monkeypatch.setenv("DIB_FAULT_PLAN", "nan@chunk1")
+    monkeypatch.setenv("DIB_FAULT_STATE_DIR", str(tmp_path))
+    plan = FaultPlan.from_env(state_dir="/ignored")
+    assert plan.state_dir == str(tmp_path)
+    assert plan.specs[0].kind == "nan"
+
+
+def test_registry_covers_the_drill_matrix():
+    scopes = {scope for scope, _, _ in FAULT_KINDS.values()}
+    assert scopes == {"train", "checkpoint", "serve", "http"}
+    for kind in ("stall", "kill", "nan", "ckpt_truncate",
+                 "ckpt_bitflip_manifest", "replica_error", "replica_slow",
+                 "batcher_crash", "http_malformed"):
+        assert kind in FAULT_KINDS
+
+
+# -------------------------------------------------------- fault executors
+def _tiny_trainer():
+    bundle = get_dataset("boolean_circuit")
+    model = DistributedIBModel(
+        feature_dimensionalities=tuple(bundle.feature_dimensionalities),
+        encoder_hidden=(8,), integration_hidden=(16,),
+        output_dim=1, embedding_dim=2,
+    )
+    config = TrainConfig(batch_size=64, num_pretraining_epochs=2,
+                         num_annealing_epochs=6, steps_per_epoch=2,
+                         max_val_points=128)
+    return DIBTrainer(model, bundle, config)
+
+
+def test_poison_params_makes_forward_pass_nonfinite():
+    trainer = _tiny_trainer()
+    state, _ = trainer.init(jax.random.key(0))
+    poisoned = poison_params(state.params, float("nan"))
+    x = jax.numpy.asarray(trainer.bundle.x_valid[:4])
+    loss, _ = trainer._forward_loss(poisoned, x,
+                                    jax.numpy.asarray(trainer.bundle.y_valid[:4]),
+                                    0.1, jax.random.key(1))
+    assert not np.isfinite(float(loss))
+    # structure untouched: only values were poisoned
+    assert jax.tree.structure(poisoned) == jax.tree.structure(state.params)
+
+
+# -------------------------------------------- THE fast NaN drill (tier 1)
+def test_nan_injection_rolls_back_bit_identically(tmp_path):
+    """Inject NaN at a chunk boundary; the divergence guard must emit a
+    mitigation, roll back to the chunk-aligned checkpoint, and finish with
+    a history BIT-IDENTICAL to an uninterrupted run — the acceptance
+    criterion for the nan drill, in-process and fast."""
+    trainer_a = _tiny_trainer()
+    state_a, hist_a = trainer_a.fit(jax.random.key(0),
+                                    hooks=[lambda *a: None], hook_every=2)
+
+    run_dir = str(tmp_path / "run")
+    writer = EventWriter(run_dir)
+    writer.run_start(runtime_manifest())
+    ckpt = DIBCheckpointer(str(tmp_path / "ck"))
+    plan = FaultPlan.parse("nan@chunk2", state_dir=str(tmp_path))
+    trainer_b = _tiny_trainer()
+    with pytest.warns(UserWarning, match="rolled back"):
+        state_b, hist_b = trainer_b.fit(
+            jax.random.key(0), hooks=[CheckpointHook(ckpt)], hook_every=2,
+            telemetry=writer, fault_plan=plan,
+        )
+    writer.run_end(status="ok")
+    writer.close()
+    ckpt.close()
+
+    events = list(read_events(run_dir))
+    assert [e["kind"] for e in events if e["type"] == "fault"] == ["nan"]
+    mits = [e["mtype"] for e in events if e["type"] == "mitigation"]
+    assert mits == ["divergence_rollback"]
+
+    # bit-identical continuation: the trajectory never saw the fault
+    np.testing.assert_array_equal(hist_a.loss, hist_b.loss)
+    np.testing.assert_array_equal(hist_a.beta, hist_b.beta)
+    np.testing.assert_array_equal(hist_a.kl_per_feature, hist_b.kl_per_feature)
+    for a, b in zip(jax.tree.leaves(state_a.params),
+                    jax.tree.leaves(state_b.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # the stream's own verdict agrees: injected == detected == recovered
+    summary = summarize(run_dir)
+    faults = summary["faults"]
+    assert faults["injected"] == faults["detected"] == faults["recovered"] == 1
+    assert faults["undetected"] == []
+    assert faults["time_to_detect_s"]["mean"] >= 0
+
+
+def test_divergence_without_checkpoint_warns_and_continues(tmp_path):
+    """No checkpoint hook → nothing to roll back to: the guard must emit a
+    mitigation + warning and keep going (not crash a science run), once."""
+    run_dir = str(tmp_path / "run")
+    writer = EventWriter(run_dir)
+    writer.run_start(runtime_manifest())
+    plan = FaultPlan.parse("nan@chunk1")
+    trainer = _tiny_trainer()
+    with pytest.warns(UserWarning, match="no checkpoint"):
+        _, hist = trainer.fit(jax.random.key(0), hooks=[lambda *a: None],
+                              hook_every=2, telemetry=writer,
+                              fault_plan=plan)
+    writer.run_end(status="ok")
+    writer.close()
+    assert not np.isfinite(hist.loss[-1])   # honestly diverged
+    events = list(read_events(run_dir))
+    mits = [e["mtype"] for e in events if e["type"] == "mitigation"]
+    assert mits == ["divergence_detected"]   # once, not per boundary
+
+
+def test_recurring_divergence_raises_instead_of_looping(tmp_path):
+    """A rollback whose replay diverges AGAIN at the same epoch is
+    deterministic divergence — the guard must raise actionably, not
+    restore-replay forever."""
+    trainer = _tiny_trainer()
+
+    class PoisonedCheckpointer:
+        """Restores a state that diverges immediately on the next chunk."""
+
+        latest_step = 2
+
+        def __init__(self):
+            state, history = trainer.init(jax.random.key(3))
+            self.payload = (
+                state._replace(params=poison_params(state.params,
+                                                    float("nan"))),
+                history, jax.random.key(4),
+            )
+
+        def restore(self, t, chunk_size=None):
+            return self.payload
+
+    class Hook:
+        checkpointer = PoisonedCheckpointer()
+
+        def __call__(self, *a):
+            pass
+
+    plan = FaultPlan.parse("nan@chunk1")
+    with pytest.raises(RuntimeError, match="deterministically"):
+        with pytest.warns(UserWarning):
+            trainer.fit(jax.random.key(0), hooks=[Hook()], hook_every=2,
+                        fault_plan=plan)
+
+
+# ------------------------------------------------------ telemetry rollup
+def _stream(tmp_path, events):
+    """Write a synthetic event stream; events = [(type, fields), ...]."""
+    run_dir = str(tmp_path / "synthetic")
+    writer = EventWriter(run_dir)
+    for etype, fields in events:
+        writer.emit(etype, **fields)
+    writer.close()
+    return run_dir
+
+
+def test_faults_rollup_joins_detection_and_recovery(tmp_path):
+    run_dir = _stream(tmp_path, [
+        ("run_start", {"manifest": {}}),
+        ("fault", {"kind": "stall", "spec": "stall@chunk2:45s"}),
+        ("mitigation", {"mtype": "stall_kill"}),
+        ("run_start", {"manifest": {}}),      # the relaunch
+        ("chunk", {"epoch": 6, "steps": 10, "seconds": 1.0, "loss": 0.5}),
+        ("fault", {"kind": "nan"}),
+        ("chunk", {"epoch": 8, "steps": 10, "seconds": 1.0,
+                   "loss": "NaN"}),           # diverged boundary
+        ("run_end", {"status": "ok"}),
+    ])
+    rollup = faults_rollup(list(read_events(run_dir)))
+    assert rollup["injected"] == 2
+    assert rollup["detected"] == 1            # the nan had no mitigation
+    assert rollup["undetected"] == ["nan"]
+    stall = rollup["by_kind"]["stall"]
+    assert stall == {"injected": 1, "detected": 1, "recovered": 1}
+    # a NaN-loss chunk must NOT count as the stall's recovery marker
+    (stall_row,) = [f for f in rollup["faults"] if f["kind"] == "stall"]
+    assert stall_row["detected_by"] == "stall_kill"
+    assert stall_row["recovered"] is True
+
+
+def test_detection_join_respects_replica_identity(tmp_path):
+    """Replica 0's ejection must not mark replica 1's injected fault
+    detected (code review finding) — when both events name a replica,
+    the join requires them to match."""
+    run_dir = _stream(tmp_path, [
+        ("run_start", {"manifest": {}}),
+        ("fault", {"kind": "replica_error", "replica": 0}),
+        ("fault", {"kind": "replica_error", "replica": 1}),
+        ("mitigation", {"mtype": "replica_ejected", "replica": 0}),
+        ("mitigation", {"mtype": "replica_readmitted", "replica": 0}),
+        ("run_end", {"status": "ok"}),
+    ])
+    rollup = faults_rollup(list(read_events(run_dir)))
+    assert rollup["injected"] == 2
+    assert rollup["detected"] == 1
+    assert rollup["undetected"] == ["replica_error"]
+
+
+def test_recovery_join_respects_replica_identity(tmp_path):
+    """Replica 0's readmission must not mark replica 1's fault recovered
+    — a broken re-admission path has to show in the rollup."""
+    run_dir = _stream(tmp_path, [
+        ("run_start", {"manifest": {}}),
+        ("fault", {"kind": "replica_error", "replica": 0}),
+        ("fault", {"kind": "replica_error", "replica": 1}),
+        ("mitigation", {"mtype": "replica_ejected", "replica": 0}),
+        ("mitigation", {"mtype": "replica_ejected", "replica": 1}),
+        ("mitigation", {"mtype": "replica_readmitted", "replica": 0}),
+        ("run_end", {"status": "ok"}),
+    ])
+    rollup = faults_rollup(list(read_events(run_dir)))
+    assert rollup["detected"] == 2
+    assert rollup["recovered"] == 1
+
+
+def test_rollback_refuses_checkpoint_predating_the_fit():
+    """A checkpoint directory holding an OLDER run's steps must not be
+    'rolled back' into mid-fit (code review finding): done would go
+    negative and training would silently continue a different
+    trajectory."""
+    trainer = _tiny_trainer()
+    state, _ = trainer.fit(jax.random.key(0), num_epochs=4,
+                           hooks=[lambda *a: None], hook_every=2)
+    history = trainer.latest_history
+    resume_key = trainer.resume_key
+
+    class StaleCheckpointer:
+        """Pretends to hold a checkpoint from before this fit started."""
+
+        latest_step = 2
+
+        def __init__(self):
+            s, h = trainer.init(jax.random.key(9))
+            self.payload = (s, h, jax.random.key(1))   # epoch 0 state
+
+        def restore(self, t, chunk_size=None):
+            return self.payload
+
+    class Hook:
+        checkpointer = StaleCheckpointer()
+
+        def __call__(self, *a):
+            pass
+
+    plan = FaultPlan.parse("nan@chunk1")
+    with pytest.raises(RuntimeError, match="predates"):
+        trainer.fit(resume_key, num_epochs=4, state=state, history=history,
+                    hooks=[Hook()], hook_every=2, fault_plan=plan)
+
+
+def test_unregistered_fault_kind_scores_undetected(tmp_path):
+    """A fault kind with no detector mapping must NOT be waved through by
+    an unrelated later mitigation (code review finding) — the compare
+    gate exists precisely for faults nothing detected."""
+    run_dir = _stream(tmp_path, [
+        ("run_start", {"manifest": {}}),
+        ("fault", {"kind": "mystery_future_kind"}),
+        ("mitigation", {"mtype": "replica_ejected", "replica": 0}),
+        ("run_end", {"status": "ok"}),
+    ])
+    rollup = faults_rollup(list(read_events(run_dir)))
+    assert rollup["detected"] == 0
+    assert rollup["undetected"] == ["mystery_future_kind"]
+
+
+def test_every_registered_injectable_kind_has_a_detector():
+    """FAULT_KINDS and the summary's detector map must not drift: every
+    kind whose injection emits fault events needs a detection mapping
+    (http_malformed is containment-only by design — its drills record
+    status codes, not fault events)."""
+    from dib_tpu.telemetry.summary import _FAULT_DETECTORS
+
+    emitting = set(FAULT_KINDS) - {"http_malformed"}
+    missing = emitting - set(_FAULT_DETECTORS)
+    assert not missing, (
+        f"fault kinds without a detector mapping: {sorted(missing)} — "
+        "their drills would always gate as undetected regressions"
+    )
+
+
+def test_faults_rollup_none_for_uninjected_runs(tmp_path):
+    run_dir = _stream(tmp_path, [
+        ("run_start", {"manifest": {}}),
+        ("chunk", {"epoch": 2, "steps": 4, "seconds": 1.0, "loss": 0.5}),
+        ("run_end", {"status": "ok"}),
+    ])
+    assert faults_rollup(list(read_events(run_dir))) is None
+    assert "faults" not in summarize(run_dir)
+
+
+def test_compare_gates_on_undetected_injected_fault(tmp_path):
+    """ISSUE 4 satellite: an injected fault nobody detected is a
+    regression (nonzero verdict), regardless of the baseline."""
+    base = {"metric": "run_telemetry_summary", "steps_per_s": 10.0}
+    good = {"metric": "run_telemetry_summary", "steps_per_s": 10.0,
+            "faults": {"injected": 2, "detected": 2, "recovered": 2}}
+    bad = {"metric": "run_telemetry_summary", "steps_per_s": 10.0,
+           "faults": {"injected": 2, "detected": 1, "recovered": 1}}
+    report, regressed = compare(base, good)
+    assert not report["fields"]["faults_undetected"]["regressed"]
+    report, regressed = compare(base, bad)
+    assert report["fields"]["faults_undetected"]["regressed"]
+    assert regressed
+
+
+def test_compare_cli_exits_nonzero_on_undetected_fault(tmp_path):
+    from dib_tpu.telemetry import telemetry_main
+
+    base_dir = _stream(tmp_path, [
+        ("run_start", {"manifest": {}}),
+        ("chunk", {"epoch": 2, "steps": 4, "seconds": 1.0, "loss": 0.5}),
+        ("run_end", {"status": "ok"}),
+    ])
+    cand_dir = str(tmp_path / "cand")
+    writer = EventWriter(cand_dir)
+    writer.emit("run_start", manifest={})
+    writer.emit("chunk", epoch=2, steps=4, seconds=1.0, loss=0.5)
+    writer.fault(kind="kill", spec="kill@chunk1")
+    writer.emit("run_end", status="ok")
+    writer.close()
+    rc = telemetry_main(["compare", base_dir, cand_dir])
+    assert rc == 1
+
+
+# ----------------------------------------------------- exception hygiene
+def test_exception_hygiene_gate():
+    """The static check passes on the package and its scanner actually
+    catches a violation (and honors the fault-ok pragma)."""
+    import sys
+
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    from check_exception_hygiene import scan_file, scan_package
+
+    assert scan_package() == []
+
+
+def test_exception_hygiene_scanner_flags_and_pragmas(tmp_path):
+    import sys
+
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    from check_exception_hygiene import scan_file
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "try:\n    x = 1\nexcept Exception:\n    pass\n"
+        "try:\n    y = 2\nexcept (ValueError, BaseException):\n    ...\n"
+        "try:\n    z = 3\nexcept ValueError:\n    pass\n"   # narrow: fine
+        "try:\n    w = 4\nexcept Exception:  # fault-ok: test pragma\n"
+        "    pass\n"
+    )
+    violations = scan_file(str(bad), "bad.py")
+    assert len(violations) == 2
+    assert violations[0].startswith("bad.py:3")
+    # handlers that DO something are fine even when broad
+    good = tmp_path / "good.py"
+    good.write_text(
+        "try:\n    x = 1\nexcept Exception as exc:\n    raise\n"
+        "try:\n    y = 2\nexcept:\n    y = None\n"
+    )
+    assert scan_file(str(good), "good.py") == []

@@ -1,0 +1,458 @@
+"""Serve-stack self-healing: ejection, probe re-admission, truthful healthz.
+
+The serve half of the fault-drill matrix (docs/robustness.md), in-process
+and fast: a FlakyEngine replica must be ejected after consecutive
+failures WITHOUT failing client calls (the router retries on healthy
+replicas), re-admitted by probe once healed, and ``/healthz`` must stop
+lying — 503 + detail when no replica can carry a request (all ejected, or
+the batcher worker thread died).
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from dib_tpu.data import get_dataset
+from dib_tpu.models import DistributedIBModel
+from dib_tpu.serve import (
+    DIBServer,
+    InferenceEngine,
+    MicroBatcher,
+    NoHealthyReplicaError,
+    ReplicaEntry,
+    ReplicaRouter,
+)
+from dib_tpu.faults import FlakyEngine, InjectedReplicaFault, kill_batcher_worker
+from dib_tpu.telemetry import EventWriter, read_events, runtime_manifest
+
+pytestmark = pytest.mark.fault
+
+
+@pytest.fixture(scope="module")
+def bundle():
+    return get_dataset("boolean_circuit")
+
+
+@pytest.fixture(scope="module")
+def model(bundle):
+    return DistributedIBModel(
+        feature_dimensionalities=tuple(bundle.feature_dimensionalities),
+        encoder_hidden=(8,), integration_hidden=(16,),
+        output_dim=1, embedding_dim=2,
+    )
+
+
+@pytest.fixture(scope="module")
+def params(bundle, model):
+    x0 = np.asarray(bundle.x_train[:4], np.float32)
+    return model.init(jax.random.key(0), x0, jax.random.key(1))
+
+
+def _post(url: str, payload: dict) -> tuple[int, dict]:
+    request = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(request, timeout=30) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as exc:
+        return exc.code, json.loads(exc.read())
+
+
+def _get(url: str) -> tuple[int, dict]:
+    try:
+        with urllib.request.urlopen(url, timeout=30) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as exc:
+        return exc.code, json.loads(exc.read())
+
+
+def _router(model, params, sick=None, num=2, run_dir=None, **kwargs):
+    writer = None
+    if run_dir is not None:
+        writer = EventWriter(run_dir)
+        writer.run_start(runtime_manifest(extra={"mode": "serve"}))
+    entries, flaky = [], None
+    for i in range(num):
+        engine = InferenceEngine(model, params, batch_buckets=(1, 4))
+        if i == 0 and sick is not None:
+            engine = flaky = FlakyEngine(engine, telemetry=writer,
+                                         replica=0, **sick)
+        entries.append(ReplicaEntry(
+            engine, MicroBatcher(engine, max_batch=4, max_wait_ms=0.5), i))
+    kwargs.setdefault("probe_after_s", 0.0)   # deterministic: no thread
+    router = ReplicaRouter(entries, telemetry=writer, **kwargs)
+    return router, flaky, writer
+
+
+# ------------------------------------------------------------- router unit
+def test_consecutive_failures_eject_and_probe_readmits(model, params, tmp_path):
+    run_dir = str(tmp_path / "run")
+    router, flaky, writer = _router(model, params,
+                                    sick={"fail_next": 100},
+                                    eject_after=3, run_dir=run_dir)
+    entry = router.entries[0]
+    for _ in range(3):
+        router.report_failure(entry, InjectedReplicaFault("x"))
+    assert entry.ejected and entry.consecutive_failures == 3
+    # routing skips the ejected entry entirely
+    picks = {router.route().index for _ in range(6)}
+    assert picks == {1}
+    # a failing probe keeps it ejected; a healed probe re-admits
+    assert router.probe_ejected(force=True) == 0
+    assert entry.ejected
+    flaky.heal()
+    assert router.probe_ejected(force=True) == 1
+    assert not entry.ejected and entry.consecutive_failures == 0
+    router.close()
+    writer.close()
+    mits = [e["mtype"] for e in read_events(run_dir)
+            if e["type"] == "mitigation"]
+    assert mits == ["replica_ejected", "replica_readmitted"]
+
+
+def test_intermittent_failures_do_not_eject(model, params):
+    """Only CONSECUTIVE failures eject — a success resets the count, so a
+    transient blip never takes a replica out."""
+    router, _, _ = _router(model, params, sick={"fail_next": 0},
+                           eject_after=3)
+    entry = router.entries[0]
+    for _ in range(5):
+        router.report_failure(entry, RuntimeError("blip"))
+        router.report_success(entry)
+    assert not entry.ejected
+    router.close()
+
+
+def test_all_ejected_raises_no_healthy(model, params):
+    router, _, _ = _router(model, params, num=2, eject_after=1)
+    for entry in router.entries:
+        router.report_failure(entry, RuntimeError("dead"))
+    with pytest.raises(NoHealthyReplicaError):
+        router.route()
+    router.close()
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_route_skips_dead_batcher_entries(model, params):
+    """Routing must agree with /healthz: an entry whose batcher worker
+    died is unserviceable — requests routed there would sit undrained
+    until their deadline (code review finding)."""
+    router, _, _ = _router(model, params, num=2)
+    kill_batcher_worker(router.entries[0].batcher)
+    picks = {router.route().index for _ in range(6)}
+    assert picks == {1}
+    kill_batcher_worker(router.entries[1].batcher)
+    with pytest.raises(NoHealthyReplicaError):
+        router.route()
+    router.close()
+
+
+def test_beta_routing_skips_ejected(model, params):
+    entries = []
+    for i, beta_end in enumerate((0.01, 1.0)):
+        engine = InferenceEngine(model, params, batch_buckets=(1, 4))
+        entries.append(ReplicaEntry(
+            engine, MicroBatcher(engine, max_wait_ms=0.0), i,
+            beta_end=beta_end))
+    router = ReplicaRouter(entries, eject_after=1, probe_after_s=0.0)
+    assert router.route(beta=0.02).index == 0
+    router.report_failure(entries[0], RuntimeError("sick"))
+    # nearest HEALTHY label now wins
+    assert router.route(beta=0.02).index == 1
+    router.report_failure(entries[1], RuntimeError("sick"))
+    with pytest.raises(NoHealthyReplicaError):
+        router.route(beta=0.02)
+    router.close()
+
+
+def test_timeouts_never_eject_the_last_serviceable_replica(model, params):
+    """Timeout-class failures can be systemic (a load spike hits every
+    replica), so they must degrade to 504s — never convert into a hard
+    503 outage by ejecting the last serviceable replica (code review
+    finding)."""
+    from dib_tpu.serve import RequestTimeout
+
+    router, _, _ = _router(model, params, num=2, eject_after=2)
+    a, b = router.entries
+    for _ in range(3):
+        router.report_failure(a, RequestTimeout("slow"))
+    assert a.ejected                         # others existed: eject fine
+    for _ in range(5):
+        router.report_failure(b, RequestTimeout("slow"))
+    assert not b.ejected                     # the LAST one stays in service
+    assert router.serviceable()
+    # a non-timeout failure on the last replica still ejects (it is
+    # genuinely broken, not merely slow)
+    for _ in range(2):
+        router.report_failure(b, RuntimeError("device error"))
+    assert b.ejected
+    router.close()
+
+
+def test_queue_expiry_timeouts_do_not_mark_the_replica(model, params):
+    """A deadline that expired while the request was STILL QUEUED is
+    backpressure, not replica sickness (code review finding): it must not
+    count toward ejection, while an in-flight dispatch timeout must."""
+    from dib_tpu.serve import DIBServer, RequestTimeout
+
+    class QueueExpiryBatcher:
+        def is_alive(self):
+            return True
+
+        def close(self):
+            pass
+
+        def __call__(self, x, op, timeout_s=None):
+            error = RequestTimeout("request timed out in queue")
+            error.in_queue = True
+            raise error
+
+    class FakeEngine:
+        feature_width = 4
+        num_features = 1
+        buckets = (1,)
+
+    entry = ReplicaEntry(FakeEngine(), QueueExpiryBatcher(), 0)
+    router = ReplicaRouter([entry], eject_after=1, probe_after_s=0.0)
+    server = DIBServer(router, port=0).start()
+    try:
+        for _ in range(3):
+            status, _ = server.handle_post("/v1/predict",
+                                           {"x": [0.0] * 4,
+                                            "timeout_s": 0.2})
+            assert status == 504
+        assert entry.consecutive_failures == 0
+        assert not entry.ejected
+    finally:
+        server.close()
+
+
+def test_retry_loop_shares_one_deadline_budget(model, params):
+    """Retries across replicas must fit inside the client's ONE timeout_s
+    (code review finding): each attempt gets the remaining budget, and an
+    exhausted budget returns 504 instead of visiting every replica with a
+    fresh full timeout."""
+    import time as _time
+
+    from dib_tpu.serve import DIBServer
+
+    calls = []
+
+    class FakeBatcher:
+        def __init__(self, delay):
+            self.delay = delay
+
+        def is_alive(self):
+            return True
+
+        def close(self):
+            pass
+
+        def __call__(self, x, op, timeout_s=None):
+            calls.append(round(timeout_s, 3))
+            _time.sleep(self.delay)
+            raise RuntimeError("engine fault")
+
+    class FakeEngine:
+        feature_width = 4
+        num_features = 1
+        buckets = (1,)
+
+    entries = [ReplicaEntry(FakeEngine(), FakeBatcher(0.3), i)
+               for i in range(3)]
+    router = ReplicaRouter(entries, eject_after=10, probe_after_s=0.0)
+    # started: close() calls httpd.shutdown(), which blocks forever unless
+    # serve_forever is running
+    server = DIBServer(router, port=0).start()
+    try:
+        status, payload = server.handle_post(
+            "/v1/predict", {"x": [0.0] * 4, "timeout_s": 0.5})
+    finally:
+        server.close()
+    assert status == 504
+    assert "deadline" in payload["error"]
+    assert len(calls) == 2                  # 3rd attempt never started
+    assert calls[0] <= 0.5 and calls[1] < calls[0]
+
+
+def test_slow_probe_does_not_readmit(model, params):
+    """A replica ejected for being slow must not flap back in through an
+    unbounded probe (code review finding): a probe dispatch slower than
+    probe_timeout_s counts as failed."""
+    import time as _time
+
+    router, flaky, _ = _router(model, params, sick={"delay_s": 0.3},
+                               eject_after=1, probe_timeout_s=0.1)
+    entry = router.entries[0]
+    router.report_failure(entry, RuntimeError("timeout"))
+    assert entry.ejected
+    assert router.probe_ejected(force=True) == 0
+    assert entry.ejected
+    assert "probe_timeout_s" in entry.last_error
+    # the maintenance thread was NOT wedged by the slow probe: the probe
+    # ran on a disposable thread; wait for it to drain, heal, re-probe
+    flaky.heal()
+    deadline = _time.monotonic() + 5.0
+    while entry.probe_inflight and _time.monotonic() < deadline:
+        _time.sleep(0.02)
+    assert router.probe_ejected(force=True) == 1
+    assert not entry.ejected
+    router.close()
+
+
+def test_probe_thread_readmits_in_background(model, params):
+    """The periodic probe path (not force): an ejected replica that healed
+    comes back without anyone calling probe_ejected()."""
+    router, flaky, _ = _router(model, params, sick={"fail_next": 100},
+                               eject_after=1, probe_after_s=0.1)
+    entry = router.entries[0]
+    router.report_failure(entry, InjectedReplicaFault("x"))
+    assert entry.ejected
+    flaky.heal()
+    deadline = threading.Event()
+    for _ in range(100):
+        if not entry.ejected:
+            break
+        deadline.wait(0.05)
+    assert not entry.ejected, "probe thread never re-admitted the replica"
+    router.close()
+
+
+# --------------------------------------------------------- HTTP end-to-end
+def test_sick_replica_never_fails_client_calls(model, params, tmp_path):
+    """THE serve drill acceptance: with a healthy replica available, a
+    sick one produces ZERO client-visible 5xx — requests retry onto the
+    healthy replica and the sick one is ejected."""
+    run_dir = str(tmp_path / "run")
+    router, flaky, writer = _router(model, params,
+                                    sick={"fail_next": 1000},
+                                    eject_after=3, run_dir=run_dir)
+    server = DIBServer(router, port=0, telemetry=writer).start()
+    try:
+        width = router.entries[0].engine.feature_width
+        row = [0.0] * width
+        statuses = [_post(server.url + "/v1/predict", {"x": row})[0]
+                    for _ in range(12)]
+        assert statuses == [200] * 12
+        assert router.entries[0].ejected
+        # the healthy replica carried everything after ejection
+        status, health = _get(server.url + "/healthz")
+        assert status == 200 and health["healthy_replicas"] == 1
+    finally:
+        server.close()
+    events = list(read_events(run_dir))
+    assert any(e["type"] == "fault" and e["kind"] == "replica_error"
+               for e in events)
+    assert any(e.get("mtype") == "replica_ejected" for e in events)
+
+
+def test_healthz_503_when_all_replicas_ejected(model, params, tmp_path):
+    run_dir = str(tmp_path / "run")
+    router, flaky, writer = _router(model, params,
+                                    sick={"fail_next": 1000}, num=1,
+                                    eject_after=2, run_dir=run_dir)
+    server = DIBServer(router, port=0, telemetry=writer).start()
+    try:
+        width = router.entries[0].engine.feature_width
+        row = [0.0] * width
+        # two failed requests reach eject_after=2 on the only replica
+        codes = [_post(server.url + "/v1/predict", {"x": row})[0]
+                 for _ in range(2)]
+        assert codes == [503, 503]     # the only replica failed each one
+        assert router.entries[0].ejected
+        status, health = _get(server.url + "/healthz")
+        assert status == 503
+        assert health["status"] == "unhealthy"
+        assert "ejected" in health["detail"]
+        assert health["feature_width"] == width   # surface stays present
+        # recovery: heal + probe → healthz healthy again, with an event edge
+        flaky.heal()
+        router.probe_ejected(force=True)
+        status, health = _get(server.url + "/healthz")
+        assert status == 200 and health["status"] == "ok"
+    finally:
+        server.close()
+    mits = [e["mtype"] for e in read_events(run_dir)
+            if e["type"] == "mitigation"]
+    assert "serving_unhealthy" in mits and "serving_recovered" in mits
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_healthz_503_when_batcher_thread_dies(model, params, tmp_path):
+    run_dir = str(tmp_path / "run")
+    router, _, writer = _router(model, params, num=1, run_dir=run_dir)
+    server = DIBServer(router, port=0, telemetry=writer).start()
+    try:
+        status, _ = _get(server.url + "/healthz")
+        assert status == 200
+        assert kill_batcher_worker(router.entries[0].batcher,
+                                   telemetry=writer)
+        assert not router.entries[0].batcher.is_alive()
+        status, health = _get(server.url + "/healthz")
+        assert status == 503
+        assert "batcher" in health["detail"]
+        # self-healing: the maintenance tick revives the dead worker and
+        # the server carries requests again
+        assert router.probe_ejected(force=True) == 0   # nothing ejected...
+        assert router.entries[0].batcher.is_alive()    # ...but revived
+        status, health = _get(server.url + "/healthz")
+        assert status == 200 and health["status"] == "ok"
+        width = router.entries[0].engine.feature_width
+        status, _ = _post(server.url + "/v1/predict", {"x": [0.0] * width})
+        assert status == 200
+    finally:
+        server.close()
+    events = list(read_events(run_dir))
+    assert any(e["type"] == "fault" and e["kind"] == "batcher_crash"
+               for e in events)
+    mits = [e["mtype"] for e in events if e["type"] == "mitigation"]
+    assert "batcher_restarted" in mits
+
+
+def test_request_timeout_counts_toward_ejection(model, params):
+    """A slow replica fails by deadline: 504s mark it, ejection follows,
+    later requests go healthy-only."""
+    router, flaky, _ = _router(model, params, sick={"delay_s": 0.5},
+                               eject_after=2)
+    server = DIBServer(router, port=0).start()
+    try:
+        width = router.entries[0].engine.feature_width
+        row = [0.0] * width
+        statuses = [_post(server.url + "/v1/predict",
+                          {"x": row, "timeout_s": 0.2})[0]
+                    for _ in range(8)]
+        assert router.entries[0].ejected
+        assert statuses.count(504) >= 2          # the slow replica's marks
+        assert not any(s in (500, 503) for s in statuses)
+        assert all(s == 200
+                   for s in [_post(server.url + "/v1/predict",
+                                   {"x": row})[0] for _ in range(3)])
+    finally:
+        server.close()
+
+
+def test_client_errors_never_mark_the_replica(model, params):
+    """400s are the CLIENT's fault: no failure count, no ejection."""
+    router, _, _ = _router(model, params, num=1, eject_after=1)
+    server = DIBServer(router, port=0).start()
+    try:
+        width = router.entries[0].engine.feature_width
+        status, _ = _post(server.url + "/v1/predict",
+                          {"x": [0.0] * (width + 1)})
+        assert status == 400
+        status, _ = _post(server.url + "/v1/predict",
+                          {"x": [float("nan")] * width})
+        assert status == 400
+        assert router.entries[0].consecutive_failures == 0
+        assert not router.entries[0].ejected
+    finally:
+        server.close()

@@ -264,6 +264,26 @@ def test_cli_watchdog_supervised_run(tmp_path):
     assert beat["beat"] >= 3          # one per 5-epoch chunk over 15 epochs
 
 
+@pytest.mark.fault
+def test_crash_loop_backoff_spaces_relaunches(tmp_path):
+    """A worker dying instantly on every launch must not burn max_restarts
+    in milliseconds: with restart_backoff_s the supervisor sleeps
+    (linearly growing) between quick deaths, buying wall-clock for a
+    transient cause to clear."""
+    hb = str(tmp_path / "hb.json")
+    cmd = _scripted_worker(tmp_path, "import sys; sys.exit(5)")
+    t0 = time.time()
+    result = supervise(
+        cmd, hb,
+        WatchdogConfig(poll_s=0.05, max_restarts=2,
+                       restart_backoff_s=0.4, min_uptime_s=10.0),
+    )
+    elapsed = time.time() - t0
+    assert result["returncode"] == 5 and result["launches"] == 3
+    # two backoffs: 0.4s after launch 1, 0.8s after launch 2
+    assert elapsed >= 1.2, f"backoff not applied (elapsed {elapsed:.2f}s)"
+
+
 def test_supervisor_termination_kills_worker(tmp_path):
     """SIGTERM to the supervisor must take the worker down with it —
     otherwise a timed-out supervisor leaves an orphan training against the
