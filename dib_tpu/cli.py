@@ -139,6 +139,14 @@ def build_parser() -> argparse.ArgumentParser:
                              "(set automatically under --watchdog).")
     parser.add_argument("--watchdog_floor_s", type=float, default=45.0)
     parser.add_argument("--watchdog_first_timeout_s", type=float, default=600.0)
+    parser.add_argument("--preempt_grace_s", type=float, default=30.0,
+                        help="SIGTERM/SIGINT grace budget: finish the "
+                             "in-flight chunk, write a final chunk-aligned "
+                             "checkpoint, and exit with the preemption "
+                             "code (75) the watchdog relaunches "
+                             "immediately; past the budget the process "
+                             "exits anyway (docs/robustness.md). "
+                             "0 disables the handler.")
     _add_telemetry_dir_flag(parser, "the run dir (--artifact_outdir)")
     return parser
 
@@ -301,6 +309,25 @@ def run(args, compile_cache_status: str | None = None) -> dict:
 
     fault_plan = FaultPlan.from_env(state_dir=outdir)
 
+    # Preemption tolerance (docs/robustness.md): SIGTERM/SIGINT during fit
+    # finishes the in-flight chunk, writes a final chunk-aligned
+    # checkpoint, and exits with the code the watchdog relaunches
+    # immediately. Armed only around the fit calls.
+    from dib_tpu.train.preempt import PreemptionGuard, TrainingPreempted
+
+    guard = None
+    if getattr(args, "preempt_grace_s", 0) and args.preempt_grace_s > 0:
+
+        def _grace_flush():
+            # the chunk outlived the grace budget: leave a terminal record
+            # before the hard exit so the stream still says "preempted"
+            if telemetry is not None:
+                telemetry.run_end(status="preempted", aborted_chunk=True)
+                telemetry.close()
+
+        guard = PreemptionGuard(args.preempt_grace_s,
+                                on_grace_expired=_grace_flush)
+
     entropy_y = None
     y_arr = np.asarray(bundle.y_train)
     if (bundle.loss_is_info_based and not contrastive
@@ -374,15 +401,25 @@ def run(args, compile_cache_status: str | None = None) -> dict:
                 print(f"resuming sweep from checkpoint at epoch {done} "
                       f"({remaining} to go)", file=sys.stderr)
         hooks = _timed(hooks)
-        if fault_plan:
-            print("warning: DIB_FAULT_PLAN is set but the sweep fit has no "
-                  "injection points — the plan is ignored (train serial, or "
-                  "drill through scripts/fault_drill.py)", file=sys.stderr)
-        states, records = sweep.fit(keys, num_epochs=remaining, hooks=hooks,
-                                    hook_every=hook_every,
-                                    states=resume_states,
-                                    histories=resume_histories,
-                                    telemetry=telemetry)
+        try:
+            with _arm(guard):
+                states, records = sweep.fit(
+                    keys, num_epochs=remaining, hooks=hooks,
+                    hook_every=hook_every,
+                    states=resume_states,
+                    histories=resume_histories,
+                    telemetry=telemetry,
+                    fault_plan=fault_plan,
+                    preempt=guard,
+                )
+        except TrainingPreempted as exc:
+            return _preempted_summary(summary, telemetry, outdir, exc)
+        if sweep.ejected_replicas:
+            # a quarantine-ejected member's trajectory is not science —
+            # the run record must say so, loudly
+            summary["ejected_replicas"] = {
+                str(r): info for r, info in sweep.ejected_replicas.items()
+            }
         for r, record in enumerate(records):
             info_hook_r = replica_info_hooks.get(r)
             if info_hook_r is not None and info_hook_r.records:
@@ -454,12 +491,18 @@ def run(args, compile_cache_status: str | None = None) -> dict:
                 print(f"resuming from checkpoint at epoch {done} "
                       f"({remaining} to go)", file=sys.stderr)
         hooks = _timed(hooks)
-        state, history = trainer.fit(fit_key, num_epochs=remaining,
-                                     hooks=hooks, hook_every=hook_every,
-                                     state=resume_state,
-                                     history=resume_history,
-                                     telemetry=telemetry,
-                                     fault_plan=fault_plan)
+        try:
+            with _arm(guard):
+                state, history = trainer.fit(fit_key, num_epochs=remaining,
+                                             hooks=hooks,
+                                             hook_every=hook_every,
+                                             state=resume_state,
+                                             history=resume_history,
+                                             telemetry=telemetry,
+                                             fault_plan=fault_plan,
+                                             preempt=guard)
+        except TrainingPreempted as exc:
+            return _preempted_summary(summary, telemetry, outdir, exc)
         bits = history.to_bits(bundle.loss_is_info_based)
         path = save_distributed_info_plane(
             bits.kl_per_feature, bits.loss, outdir, entropy_y=entropy_y)
@@ -482,6 +525,34 @@ def run(args, compile_cache_status: str | None = None) -> dict:
             final_val_loss=summary.get("final_val_loss"),
             resumed_from_epoch=summary.get("resumed_from_epoch"),
         )
+        telemetry.close()
+        summary["events_path"] = telemetry.path
+    with open(os.path.join(outdir, "run_summary.json"), "w") as f:
+        json.dump(summary, f, indent=1)
+        f.write("\n")
+    return summary
+
+
+def _arm(guard):
+    """The guard as a context manager, or a no-op when preemption handling
+    is disabled (--preempt_grace_s 0)."""
+    import contextlib
+
+    return guard if guard is not None else contextlib.nullcontext()
+
+
+def _preempted_summary(summary, telemetry, outdir, exc) -> dict:
+    """Terminal bookkeeping for a preempted fit: ``run_end`` with the
+    ``preempted`` status, a run_summary.json that says so, and a summary
+    ``main()`` converts into the preemption exit code the watchdog
+    relaunches immediately (docs/robustness.md)."""
+    summary["status"] = "preempted"
+    summary["preempted_at_epoch"] = exc.epoch
+    summary["checkpoint_saved"] = exc.checkpoint_saved
+    print(f"preempted: {exc} — relaunch resumes from the chunk-aligned "
+          "checkpoint", file=sys.stderr)
+    if telemetry is not None:
+        telemetry.run_end(status="preempted", epoch=exc.epoch)
         telemetry.close()
         summary["events_path"] = telemetry.path
     with open(os.path.join(outdir, "run_summary.json"), "w") as f:
@@ -1067,6 +1138,12 @@ def main(argv: Sequence[str] | None = None) -> int:
         status = _enable_cli_compile_cache()
         summary = run(args, compile_cache_status=status)
         print(json.dumps(summary))
+        if summary.get("status") == "preempted":
+            # distinct from crash exits: the watchdog relaunches this code
+            # immediately, with no crash-loop backoff (train/watchdog.py)
+            from dib_tpu.train.preempt import PREEMPT_EXIT_CODE
+
+            return PREEMPT_EXIT_CODE
         return 0
     except BaseException as exc:
         _finalize_telemetry(exc)

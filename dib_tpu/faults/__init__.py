@@ -19,9 +19,11 @@ mitigations they provoked into an injected/detected/recovered rollup, and
 """
 
 from dib_tpu.faults.inject import (
+    PoisonedReplicaRestore,
     apply_due_train_faults,
     corrupt_checkpoint,
     poison_params,
+    poison_replica_params,
 )
 from dib_tpu.faults.plan import FAULT_KINDS, FaultPlan, FaultSpec
 from dib_tpu.faults.serve import (
@@ -36,8 +38,10 @@ __all__ = [
     "FaultSpec",
     "FlakyEngine",
     "InjectedReplicaFault",
+    "PoisonedReplicaRestore",
     "apply_due_train_faults",
     "corrupt_checkpoint",
     "kill_batcher_worker",
     "poison_params",
+    "poison_replica_params",
 ]

@@ -23,9 +23,11 @@ import time
 from dib_tpu.faults.plan import FaultPlan, FaultSpec
 
 __all__ = [
+    "PoisonedReplicaRestore",
     "apply_due_train_faults",
     "corrupt_checkpoint",
     "poison_params",
+    "poison_replica_params",
 ]
 
 
@@ -44,6 +46,75 @@ def poison_params(params, value: float):
         raise ValueError("cannot poison an empty param tree")
     leaves[0] = jnp.full_like(leaves[0], value)
     return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def poison_replica_params(params, replica: int, value: float):
+    """Poison ONE sweep member: set replica ``replica``'s slice of the
+    first (path-sorted) stacked ``[R, ...]`` leaf to ``value``.
+
+    The deterministic stand-in for a single sick device corrupting one
+    β-sweep member mid-run — the fault the per-replica quarantine
+    (``BetaSweepTrainer.fit``) exists for. The other members' lanes are
+    untouched (embarrassingly parallel: NaNs cannot cross the replica
+    axis).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    if not leaves:
+        raise ValueError("cannot poison an empty param tree")
+    leaf = leaves[0]
+    if leaf.ndim < 1 or not 0 <= replica < leaf.shape[0]:
+        raise ValueError(
+            f"replica_nan target {replica} is out of range for a stacked "
+            f"leaf of shape {tuple(leaf.shape)} — the fault targets a "
+            "sweep member index in [0, R)"
+        )
+    leaves[0] = leaf.at[replica].set(
+        jnp.full(leaf.shape[1:], value, leaf.dtype)
+    )
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+class PoisonedReplicaRestore:
+    """Checkpointer proxy whose every restored stack carries a poisoned
+    member — the deterministic-divergence injector for the quarantine
+    EJECTION drill (FlakyEngine-style: wrap and drop in unchanged).
+
+    With it armed, each quarantine heal replays from a poisoned restore
+    point and re-diverges in the same chunk, so the sweep must EJECT the
+    member (degrading to R−1 live members) instead of heal-looping.
+    ``save``/``latest_step``/everything else passes through to the wrapped
+    :class:`~dib_tpu.train.checkpoint.DIBCheckpointer`.
+    """
+
+    def __init__(self, checkpointer, replica: int, value: float = float("nan"),
+                 telemetry=None):
+        self._ckpt = checkpointer
+        self._replica = int(replica)
+        self._value = float(value)
+        self._telemetry = telemetry
+        self.poisoned_restores = 0
+
+    def _poison(self, restored):
+        state, history, key = restored
+        self.poisoned_restores += 1
+        if self._telemetry is not None:
+            self._telemetry.fault(kind="replica_nan", replica=self._replica,
+                                  via="poisoned_restore")
+        state = state._replace(params=poison_replica_params(
+            state.params, self._replica, self._value))
+        return state, history, key
+
+    def restore(self, *args, **kwargs):
+        return self._poison(self._ckpt.restore(*args, **kwargs))
+
+    def restore_latest_intact(self, *args, **kwargs):
+        return self._poison(self._ckpt.restore_latest_intact(*args, **kwargs))
+
+    def __getattr__(self, attr):
+        return getattr(self._ckpt, attr)
 
 
 def _emit_fault(telemetry, spec: FaultSpec, **fields) -> None:
@@ -67,18 +138,29 @@ def apply_due_train_faults(plan: FaultPlan, chunk_index: int, state,
         plan.mark_fired(spec)
         if epoch is None:
             import jax
+            import numpy as np
 
-            epoch = int(jax.device_get(state.epoch))
-        _emit_fault(telemetry, spec, epoch=epoch)
+            # sweeps carry [R] epochs advancing in lockstep
+            epoch = int(np.max(np.asarray(jax.device_get(state.epoch))))
+        extra = ({"replica": int(spec.arg)}
+                 if spec.kind == "replica_nan" else {})
+        _emit_fault(telemetry, spec, epoch=epoch, **extra)
         log(f"fault injection: {spec.raw} firing at chunk boundary "
             f"{chunk_index} (epoch {epoch})")
         if spec.kind == "stall":
             time.sleep(float(spec.arg))
         elif spec.kind == "kill":
             os.kill(os.getpid(), signal.SIGKILL)
+        elif spec.kind == "preempt":
+            # cooperative preemption: the armed PreemptionGuard turns this
+            # into a chunk-aligned checkpoint + 'preempted' exit
+            os.kill(os.getpid(), signal.SIGTERM)
         elif spec.kind in ("nan", "inf"):
             value = float("nan") if spec.kind == "nan" else float("inf")
             state = state._replace(params=poison_params(state.params, value))
+        elif spec.kind == "replica_nan":
+            state = state._replace(params=poison_replica_params(
+                state.params, int(spec.arg), float("nan")))
         else:  # parse() rejects non-train scopes; guard against drift
             raise ValueError(f"fault kind {spec.kind!r} is not train-scoped")
     return state

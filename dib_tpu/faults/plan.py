@@ -71,7 +71,23 @@ FAULT_KINDS: dict[str, tuple[str, str | None, str]] = {
     "http_malformed": ("http", None,
                        "invalid JSON / wrong-width rows / dropped "
                        "connections against the HTTP server"),
+    "replica_nan": ("train", "replica",
+                    "poison ONE sweep member's params slice with NaN — "
+                    "the per-replica divergence quarantine / ejection "
+                    "path (sweep fits; arg = replica index)"),
+    "preempt": ("train", None,
+                "SIGTERM own process at the boundary — the cooperative "
+                "preemption path: chunk-aligned checkpoint, 'preempted' "
+                "run status, distinct exit the watchdog relaunches "
+                "without backoff"),
+    "desync": ("multihost", None,
+               "one host arrives at the chunk barrier with a stale "
+               "(run_id, chunk, git_sha) — the desync guard names it "
+               "instead of hanging; injected by the drill harness"),
 }
+
+# Plan-grammar kinds whose ARG is mandatory (the others default sensibly).
+_ARG_REQUIRED = ("stall", "replica_nan")
 
 _SPEC_RE = re.compile(
     r"^(?P<kind>[a-z_]+)@chunk(?P<chunk>\d+)(?::(?P<arg>[\d.]+)s?)?$"
@@ -89,8 +105,13 @@ class FaultSpec:
 
     @property
     def marker(self) -> str:
-        """Filename marking this spec fired (state survives SIGKILL)."""
-        return f"fault_fired_{self.kind}_chunk{self.chunk}"
+        """Filename marking this spec fired (state survives SIGKILL).
+
+        The arg participates so two same-kind specs at one boundary with
+        different args (e.g. two replica_nan targets) fire independently.
+        """
+        suffix = "" if self.arg is None else f"_{self.arg:g}"
+        return f"fault_fired_{self.kind}_chunk{self.chunk}{suffix}"
 
 
 class FaultPlan:
@@ -134,10 +155,12 @@ class FaultPlan:
                     "grammar"
                 )
             arg = m.group("arg")
-            if arg_name is not None and kind == "stall" and arg is None:
+            if kind in _ARG_REQUIRED and arg is None:
+                example = ("stall@chunk3:45s" if kind == "stall"
+                           else f"{kind}@chunk3:1")
                 raise ValueError(
                     f"Fault spec {token!r} needs an argument "
-                    f"({arg_name}), e.g. {kind}@chunk3:45s"
+                    f"({arg_name}), e.g. {example}"
                 )
             specs.append(FaultSpec(
                 kind=kind, chunk=int(m.group("chunk")),

@@ -27,6 +27,8 @@ from dib_tpu.parallel.mesh import (
     validate_sweep_shapes,
 )
 from dib_tpu.parallel.multihost import (
+    HostDesyncError,
+    assert_same_chunk,
     fetch_to_host,
     initialize,
     process_local_batch,
@@ -42,7 +44,9 @@ __all__ = [
     "DATA_AXIS",
     "SEQ_AXIS",
     "BetaSweepTrainer",
+    "HostDesyncError",
     "PerReplicaHook",
+    "assert_same_chunk",
     "SweepCompressionHook",
     "SweepInfoPerFeatureHook",
     "batch_sharding",

@@ -27,11 +27,24 @@ inside a host (or a slice) where the gradient all-reduce rides ICI.
 from __future__ import annotations
 
 import os
+import threading
 
 import jax
 import numpy as np
 
 Array = jax.Array
+
+# Barrier payload width: "run_id|chunk|git_sha" padded/truncated to a fixed
+# byte budget so every host allgathers the same shape.
+_BARRIER_PAYLOAD_BYTES = 160
+BARRIER_TIMEOUT_ENV = "DIB_BARRIER_TIMEOUT_S"
+DEFAULT_BARRIER_TIMEOUT_S = 120.0
+
+
+class HostDesyncError(RuntimeError):
+    """Hosts disagree about (run_id, chunk, git_sha) at a sync point — or a
+    straggler never reached the barrier inside the timeout. Raised instead
+    of letting the next collective hang forever with no diagnosis."""
 
 # Environment variables that indicate a multi-host cluster launcher set this
 # process up (TPU pod metadata, explicit JAX coordinator spec, SLURM/MPI).
@@ -191,3 +204,167 @@ def fetch_to_host(tree):
         return jax.device_get(leaf)
 
     return jax.tree.map(one, tree)
+
+
+# ------------------------------------------------------------ desync guard
+def _encode_barrier_row(text: str) -> np.ndarray:
+    raw = text.encode()[:_BARRIER_PAYLOAD_BYTES]
+    return np.frombuffer(
+        raw.ljust(_BARRIER_PAYLOAD_BYTES), dtype=np.uint8
+    ).copy()
+
+
+def _barrier_row(run_id: str, chunk: int, git_sha: str | None) -> str:
+    """The compared "run_id|chunk|git_sha" row, guaranteed to fit the
+    fixed payload. A run_id long enough to push chunk/sha past the byte
+    budget would otherwise be silently truncated into a row that compares
+    equal across DESYNCED hosts — masking exactly the failure the barrier
+    exists to catch — so an oversize run_id is replaced by its (identical
+    on every host) short hash instead."""
+    import hashlib
+
+    row = f"{run_id}|{int(chunk)}|{git_sha or ''}"
+    if len(row.encode()) > _BARRIER_PAYLOAD_BYTES:
+        digest = hashlib.sha256(run_id.encode()).hexdigest()[:16]
+        row = f"run#{digest}|{int(chunk)}|{git_sha or ''}"
+    return row
+
+
+def _decode_barrier_rows(stacked) -> list[str]:
+    arr = np.asarray(stacked, dtype=np.uint8).reshape(
+        -1, _BARRIER_PAYLOAD_BYTES
+    )
+    return [bytes(bytearray(row.tolist())).decode(errors="replace").strip()
+            for row in arr]
+
+
+def _default_barrier_gather(row: str) -> list[str]:
+    """Allgather one fixed-width row per process; returns all hosts' rows."""
+    from jax.experimental import multihost_utils
+
+    stacked = multihost_utils.process_allgather(_encode_barrier_row(row))
+    return _decode_barrier_rows(stacked)
+
+
+def assert_same_chunk(run_id: str, chunk: int, timeout_s: float | None = None,
+                      git_sha: str | None = None, telemetry=None,
+                      _gather=None) -> None:
+    """Timeout-bounded barrier asserting every host is at the same point.
+
+    Allgathers ``(run_id, chunk, git_sha)`` across processes and raises a
+    :class:`HostDesyncError` NAMING the divergent host(s) — instead of the
+    status quo on a desynced pod, which is the next collective hanging
+    forever (or training silently blending two different runs). Called at
+    fit start and before every checkpoint save (``CheckpointHook``); a
+    single-process run returns immediately, so laptop/CI paths pay nothing.
+
+    The gather runs on a daemon thread joined at ``timeout_s`` (default
+    ``DIB_BARRIER_TIMEOUT_S`` or 120 s): a straggler host that never
+    arrives turns into an actionable timeout error on every host that DID
+    arrive, rather than a hang. The abandoned gather thread stays parked
+    in the collective — acceptable, because the raise's purpose is to
+    crash this launch loudly so the supervisor/operator relaunches the
+    pod in lockstep.
+
+    ``telemetry`` (an ``EventWriter``) records a ``desync_detected``
+    mitigation before the raise, so the event stream carries the diagnosis
+    even when stderr is lost. ``_gather`` injects the transport for drills
+    and tests (``scripts/fault_drill.py`` desync drill).
+    """
+    if _gather is None:
+        if jax.process_count() == 1:
+            return
+        _gather = _default_barrier_gather
+    if timeout_s is None:
+        timeout_s = float(os.environ.get(BARRIER_TIMEOUT_ENV)
+                          or DEFAULT_BARRIER_TIMEOUT_S)
+    if git_sha is None:
+        git_sha = _barrier_git_sha()
+    mine = _barrier_row(run_id, chunk, git_sha)
+    box: dict = {}
+
+    def _run():
+        try:
+            box["rows"] = _gather(mine)
+        except Exception as exc:   # surfaced on the caller thread below
+            box["error"] = exc
+
+    worker = threading.Thread(target=_run, daemon=True,
+                              name="dib-barrier-gather")
+    worker.start()
+    worker.join(timeout_s)
+    try:
+        pid = jax.process_index()
+    except Exception:
+        pid = 0
+
+    def _report(detail: dict) -> None:
+        if telemetry is not None:
+            telemetry.mitigation(mtype="desync_detected", chunk=int(chunk),
+                                 run_id=run_id, **detail)
+
+    if worker.is_alive():
+        _report({"reason": "barrier_timeout", "timeout_s": timeout_s})
+        raise HostDesyncError(
+            f"multihost barrier timed out after {timeout_s:.0f}s at chunk "
+            f"{chunk} (run {run_id!r}, this host is process {pid}): at "
+            "least one host never arrived — a straggler or hung host is "
+            "holding the collective. Check the other hosts' logs and "
+            "relaunch the pod in lockstep (docs/robustness.md)."
+        )
+    if "error" in box:
+        raise HostDesyncError(
+            f"multihost barrier failed at chunk {chunk} (run {run_id!r}): "
+            f"{type(box['error']).__name__}: {box['error']}"
+        ) from box["error"]
+    rows = box.get("rows") or []
+    counts: dict[str, int] = {}
+    for row in rows:
+        counts[row] = counts.get(row, 0) + 1
+    if len(counts) > 1:
+        best = max(counts.values())
+        modal = [row for row, n in counts.items() if n == best]
+        tail = ("The pod is no longer in lockstep — a host resumed a "
+                "different run, fell a chunk behind, or runs different "
+                "code. Kill every host and relaunch from the shared "
+                "checkpoint (docs/robustness.md).")
+        if len(modal) > 1:
+            # no strict majority (e.g. a 2-host pod split 1-1): naming
+            # either side "the majority" would point the operator at an
+            # arbitrary host — possibly the HEALTHY one — so list every
+            # host's row and let the operator judge
+            named = "; ".join(
+                f"host {i} reports ({row})" for i, row in enumerate(rows)
+            )
+            _report({"reason": "desync", "majority": None,
+                     "divergent_hosts": sorted(range(len(rows)))})
+            raise HostDesyncError(
+                f"multihost desync at chunk {chunk}: hosts disagree with "
+                f"no majority [run_id|chunk|git_sha] — {named}. {tail}"
+            )
+        majority = modal[0]
+        divergent = {i: row for i, row in enumerate(rows)
+                     if row != majority}
+        named = "; ".join(
+            f"host {i} reports ({row})" for i, row in divergent.items()
+        )
+        _report({"reason": "desync", "majority": majority,
+                 "divergent_hosts": sorted(divergent)})
+        raise HostDesyncError(
+            f"multihost desync at chunk {chunk}: the majority of hosts "
+            f"report ({majority}) [run_id|chunk|git_sha] but {named}. "
+            f"{tail}"
+        )
+
+
+_BARRIER_GIT_SHA: list = []   # [sha-or-None] once computed
+
+
+def _barrier_git_sha() -> str | None:
+    """This checkout's HEAD (cached): code drift across hosts is one of the
+    desyncs the barrier exists to name."""
+    if not _BARRIER_GIT_SHA:
+        from dib_tpu.telemetry.events import _git_sha
+
+        _BARRIER_GIT_SHA.append(_git_sha())
+    return _BARRIER_GIT_SHA[0]

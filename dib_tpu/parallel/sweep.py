@@ -72,9 +72,19 @@ class BetaSweepTrainer:
         starts, ends = jnp.broadcast_arrays(starts, ends)
         self.beta_starts = starts
         self.beta_ends = ends
+        # Host-side copies of the endpoint grids, fetched ONCE: everything
+        # host-side (replica_trainer views, hook beta tags, recovery) reads
+        # these — indexing the mesh-sharded device arrays per call costs a
+        # device round-trip each time and CRASHES on a multihost mesh where
+        # the indexed shard is not addressable from this process.
+        self.beta_starts_host = np.asarray(starts, np.float64)
+        self.beta_ends_host = np.asarray(ends, np.float64)
         self.num_replicas = int(starts.shape[0])
         self.mesh = mesh
         self.base = DIBTrainer(model, bundle, config, y_encoder)
+        # members ejected by the divergence quarantine, r -> info dict
+        # (populated by fit; see docs/robustness.md "Sweep and pod failures")
+        self.ejected_replicas: dict[int, dict] = {}
         if mesh is not None:
             validate_sweep_shapes(mesh, self.num_replicas, config.batch_size)
             self.base.batch_constraint = NamedSharding(mesh, P(DATA_AXIS))
@@ -95,6 +105,21 @@ class BetaSweepTrainer:
 
     def _check_keys(self, keys: Array) -> Array:
         keys = jnp.asarray(keys)
+        # Accept only what the vmapped key plumbing can actually consume: a
+        # typed PRNG key array [R], or raw uint32 threefry data [R, 2]. Any
+        # other [R]-leading array used to pass through here and die several
+        # layers down as an opaque vmap trace error inside run_chunk.
+        typed = jax.dtypes.issubdtype(keys.dtype, jax.dtypes.prng_key)
+        raw = (keys.dtype == jnp.uint32 and keys.ndim == 2
+               and keys.shape[-1] == 2)
+        if not (typed and keys.ndim == 1) and not raw:
+            raise ValueError(
+                f"Sweep keys must be a PRNG key array: a typed key array of "
+                f"shape [{self.num_replicas}] or raw uint32 key data of "
+                f"shape [{self.num_replicas}, 2]; got dtype {keys.dtype} "
+                f"with shape {tuple(keys.shape)}. Build one with "
+                f"jax.random.split(key, {self.num_replicas})."
+            )
         if keys.shape[0] != self.num_replicas:
             raise ValueError(
                 f"Expected {self.num_replicas} replica keys, got {keys.shape[0]}"
@@ -143,6 +168,8 @@ class BetaSweepTrainer:
         states: TrainState | None = None,
         histories: dict | None = None,
         telemetry=None,
+        fault_plan=None,
+        preempt=None,
     ) -> tuple[TrainState, list[HistoryRecord]]:
         """Drive the sweep: jitted chunks + host hooks between them.
 
@@ -154,6 +181,27 @@ class BetaSweepTrainer:
         losses, and total KL from the chunk's last history row — so a
         sweep's event stream stays attributable to its beta grid. Same
         off-hot-path contract as ``DIBTrainer.fit``.
+
+        Per-replica divergence quarantine: after every chunk the stacked
+        boundary row (loss / val_loss / per-feature KL, one small fetch)
+        is checked for finiteness PER MEMBER. A non-finite member is
+        quarantined: the stacked chunk-aligned checkpoint in ``hooks`` is
+        restored, the gap is replayed at the ORIGINAL sweep width (bitwise
+        identity holds only at the original width — see
+        ``recover_replica``'s caveat), and only the quarantined member's
+        state/history/key are spliced back, tagged ``divergence_rollback``
+        with the member's replica index and β endpoint. A member whose
+        replay re-diverges in the same chunk is deterministic and is
+        EJECTED: the sweep degrades to R−1 live members (a
+        ``replica_ejected`` mitigation; the member's ``HistoryRecord``
+        carries ``ejected=True``, and ``self.ejected_replicas`` records
+        it) instead of poisoning the run or looping. Without a checkpoint
+        the guard warns loudly once and continues, like the serial path.
+
+        ``fault_plan`` / ``preempt``: same contracts as ``DIBTrainer.fit``
+        — chunk-boundary fault injection after hooks, and cooperative
+        SIGTERM checkpoint-and-exit via ``PreemptionGuard``
+        (docs/robustness.md).
 
         Caller-supplied ``states``/``histories`` are CONSUMED (buffers
         donated to the first chunk on accelerators) — see ``DIBTrainer.fit``.
@@ -177,6 +225,7 @@ class BetaSweepTrainer:
                 f"already recorded and {num_epochs} more were requested; grow it "
                 f"with history_extend(histories, n)."
             )
+        from dib_tpu.parallel.multihost import assert_same_chunk
         from dib_tpu.telemetry import trace
         from dib_tpu.telemetry.hooks import FitRecorder
 
@@ -186,17 +235,34 @@ class BetaSweepTrainer:
             telemetry,
             steps_per_epoch=self.base.steps_per_epoch * self.num_replicas,
         )
-        beta_end_list = None
-        if telemetry is not None:
-            # static for the whole fit: fetch once, not per chunk
-            beta_end_list = [float(b) for b in jax.device_get(self.beta_ends)]
+        # host-fetched once in __init__ — shared by telemetry tags,
+        # mitigation tags, and the quarantine below
+        beta_end_list = [float(b) for b in self.beta_ends_host]
         # chunking decoupled from hooks — see DIBTrainer.fit
         chunk = hook_every if hook_every else num_epochs
         done = 0
+        start_epoch = cursor
+        chunk_index = 0          # 1-based fit-boundary ordinal (fault plans)
+        ejected: dict[int, dict] = {}
+        diverged_warned = False
+        self._telemetry_run_id = telemetry.run_id if telemetry else ""
+        # desync guard: every host must enter this fit at the same chunk
+        # (no-op single-process; see parallel/multihost.py)
+        assert_same_chunk(self._telemetry_run_id, cursor, telemetry=telemetry)
         # Bound for the whole fit so hook spans (PerReplicaHook's
         # replica{r}, SpannedHook) parent into this run's trace hierarchy.
         with trace.use_tracer(recorder.tracer):
             while done < num_epochs:
+                if preempt is not None and preempt.requested:
+                    from dib_tpu.train.preempt import (
+                        chunk_aligned_preempt_exit,
+                    )
+
+                    chunk_aligned_preempt_exit(
+                        preempt, hooks, telemetry, chunk, states,
+                        histories, keys, epoch=cursor + done,
+                        run_id=self._telemetry_run_id,
+                    )
                 this_chunk = min(chunk, num_epochs - done)
                 split = jax.vmap(jax.random.split)(keys)
                 keys, chunk_keys = split[:, 0], split[:, 1]
@@ -215,17 +281,19 @@ class BetaSweepTrainer:
                     )
                     ph.block_on(states.params)
                 done += this_chunk
+                chunk_index += 1
                 # Published for CheckpointHook (see DIBTrainer.fit).
                 self.resume_key = keys
                 self.latest_history = histories
                 self.resume_chunk = chunk
+                # stacked boundary row: telemetry tags AND the per-replica
+                # divergence quarantine read it (one small fetch per chunk)
+                row = jax.device_get({
+                    name: histories[name][:, cursor + done - 1]
+                    for name in ("beta", "loss", "val_loss",
+                                 "kl_per_feature")
+                })
                 if telemetry is not None:
-                    # per-replica beta/loss/KL tags ([R] lists)
-                    row = jax.device_get({
-                        name: histories[name][:, cursor + done - 1]
-                        for name in ("beta", "loss", "val_loss",
-                                     "kl_per_feature")
-                    })
                     recorder.record_chunk(
                         epoch=cursor + done, chunk_epochs=this_chunk,
                         replicas=self.num_replicas,
@@ -236,10 +304,195 @@ class BetaSweepTrainer:
                         kl_total=[float(x)
                                   for x in row["kl_per_feature"].sum(-1)],
                     )
+                bad = [r for r in _nonfinite_members(row)
+                       if r not in ejected]
+                if bad:
+                    states, histories, keys, diverged_warned = (
+                        self._quarantine_divergence(
+                            bad, states, histories, keys, hooks, telemetry,
+                            chunk, ejected, epoch=cursor + done,
+                            start_epoch=start_epoch, row=row,
+                            beta_end_list=beta_end_list,
+                            diverged_warned=diverged_warned,
+                        )
+                    )
+                    self.resume_key = keys
+                    self.latest_history = histories
                 for hook in hooks:
                     hook(self, states, int(jax.device_get(states.epoch)[0]))
+                if fault_plan is not None and fault_plan.due(chunk_index):
+                    # AFTER hooks: the checkpoint hook persisted the clean
+                    # state first — see DIBTrainer.fit
+                    from dib_tpu.faults import apply_due_train_faults
+
+                    states = apply_due_train_faults(
+                        fault_plan, chunk_index, states, telemetry,
+                    )
         recorder.finish()
-        return states, sweep_records(histories)
+        self.ejected_replicas = ejected
+        return states, sweep_records(histories, ejected=ejected)
+
+    # ------------------------------------------------- divergence quarantine
+    def _quarantine_divergence(self, bad, states, histories, keys, hooks,
+                               telemetry, chunk, ejected, *, epoch,
+                               start_epoch, row, beta_end_list,
+                               diverged_warned):
+        """Heal (or eject) the non-finite members in ``bad``.
+
+        Restores the stacked chunk-aligned checkpoint once, replays the
+        gap at the ORIGINAL sweep width (the only width where the replay
+        is bit-identical to an uninterrupted run — XLA orders float32
+        reductions differently at other widths, see ``recover_replica``),
+        and splices only the quarantined members' state/history/key back
+        into the live stack. A member still non-finite after the replay
+        diverges deterministically and is ejected via ``_eject_replica``.
+
+        Returns the (possibly healed) ``(states, histories, keys,
+        diverged_warned)``.
+        """
+        import warnings
+
+        from dib_tpu.train.loop import _find_checkpointer
+
+        ckpt = _find_checkpointer(hooks)
+        if ckpt is None or ckpt.latest_step is None:
+            if getattr(self, "_in_quarantine_replay", False):
+                # the inner replay fit re-detecting the divergence it is
+                # replaying: the OUTER quarantine reports the outcome
+                # (heal or ejection) — a "no checkpoint configured"
+                # warning here would be false and misleading
+                return states, histories, keys, True
+            if not diverged_warned:
+                if telemetry is not None:
+                    telemetry.mitigation(
+                        mtype="divergence_detected", epoch=epoch,
+                        action="none", replicas=list(bad),
+                        beta_end=[beta_end_list[r] for r in bad],
+                        reason="no checkpoint hook / saved step to roll "
+                               "back to",
+                    )
+                warnings.warn(
+                    f"non-finite loss/KL at epoch {epoch} in sweep "
+                    f"member(s) {bad}; no checkpoint to roll back to — the "
+                    "sweep continues with diverged member(s). Add a "
+                    "CheckpointHook to fit(hooks=...) to enable the "
+                    "per-replica quarantine (docs/robustness.md)."
+                )
+            return states, histories, keys, True
+
+        def report_fallback(info: dict) -> None:
+            if telemetry is not None:
+                telemetry.mitigation(mtype="checkpoint_fallback", **info)
+            warnings.warn(
+                f"sweep quarantine: checkpoint step {info['step']} is "
+                f"corrupt and was skipped (deleted={info.get('deleted')}): "
+                f"{info['error']}"
+            )
+
+        try:
+            if hasattr(ckpt, "restore_latest_intact"):
+                st0, hi0, k0 = ckpt.restore_latest_intact(
+                    self, chunk_size=chunk, on_fallback=report_fallback)
+            else:
+                st0, hi0, k0 = ckpt.restore(self, chunk_size=chunk)
+        except Exception as exc:
+            raise RuntimeError(
+                f"sweep quarantine failed: non-finite loss at epoch {epoch} "
+                f"in member(s) {bad} and the checkpoint at step "
+                f"{ckpt.latest_step} could not be restored "
+                f"({type(exc).__name__}: {exc})"
+            ) from exc
+        restored_epoch = int(np.max(jax.device_get(st0.epoch)))
+        if restored_epoch < start_epoch:
+            raise RuntimeError(
+                f"sweep quarantine refused: the latest checkpoint is at "
+                f"epoch {restored_epoch}, BEFORE this fit's start epoch "
+                f"{start_epoch} — the checkpoint directory predates this "
+                "fit (reused dir?). Restart the run from that checkpoint "
+                "explicitly instead."
+            )
+        gap = epoch - restored_epoch
+        if gap <= 0:
+            # the latest checkpoint already holds this boundary: the saved
+            # state itself produces the divergence — deterministic
+            for r in bad:
+                self._eject_replica(r, ejected, telemetry, epoch=epoch,
+                                    beta_end=beta_end_list[r],
+                                    reason="checkpointed state itself "
+                                           "diverges (nothing to replay)")
+            return states, histories, keys, diverged_warned
+        # Replay the gap as ONE sweep at the original width; members are
+        # embarrassingly parallel, so the healthy lanes reproduce their
+        # live values exactly and the quarantined lanes reproduce the
+        # trajectory the fault never touched. The recursive fit shares
+        # ``self``: snapshot the live run id (the replay's telemetry is
+        # None and would blank it for every later CheckpointHook barrier)
+        # and flag the replay so its own divergence guard stays quiet.
+        outer_run_id = getattr(self, "_telemetry_run_id", "")
+        self._in_quarantine_replay = True
+        try:
+            replay_states, _ = self.fit(
+                k0, num_epochs=gap, hook_every=chunk,
+                states=st0, histories=hi0,
+            )
+        finally:
+            self._in_quarantine_replay = False
+            self._telemetry_run_id = outer_run_id
+        replay_histories = self.latest_history
+        replay_keys = self.resume_key
+        healed_row = jax.device_get({
+            name: replay_histories[name][:, epoch - 1]
+            for name in ("loss", "val_loss", "kl_per_feature")
+        })
+        still_bad = set(_nonfinite_members(healed_row))
+        for r in bad:
+            if r in still_bad:
+                self._eject_replica(r, ejected, telemetry, epoch=epoch,
+                                    beta_end=beta_end_list[r],
+                                    reason="re-diverged during the "
+                                           "quarantine replay")
+                continue
+            states = _splice_member(states, replay_states, r)
+            histories = _splice_member(histories, replay_histories, r)
+            keys = _splice_keys(keys, r, replay_keys)
+            detail = _member_row_detail(row, r)
+            if telemetry is not None:
+                telemetry.mitigation(
+                    mtype="divergence_rollback", epoch=epoch, replica=r,
+                    beta_end=beta_end_list[r],
+                    restored_epoch=restored_epoch, **detail,
+                )
+            warnings.warn(
+                f"non-finite loss/KL at epoch {epoch} in sweep member {r} "
+                f"(β_end={beta_end_list[r]:g}); member rolled back to the "
+                f"chunk-aligned checkpoint at epoch {restored_epoch} and "
+                "healed by an original-width replay (bit-identical splice)"
+            )
+        return states, histories, keys, diverged_warned
+
+    def _eject_replica(self, r, ejected, telemetry, *, epoch, beta_end,
+                       reason) -> None:
+        """Degrade the sweep to R-1 live members: record + announce the
+        ejection; the lane keeps computing (embarrassingly parallel, its
+        NaNs cannot cross the replica axis) but is never healed again and
+        its final record is marked."""
+        import warnings
+
+        ejected[r] = {"epoch": int(epoch), "beta_end": float(beta_end),
+                      "reason": reason}
+        if telemetry is not None:
+            telemetry.mitigation(
+                mtype="replica_ejected", replica=r, epoch=int(epoch),
+                beta_end=float(beta_end), reason=reason, scope="sweep",
+            )
+        warnings.warn(
+            f"sweep member {r} (β_end={beta_end:g}) EJECTED at epoch "
+            f"{epoch}: {reason}. The member diverges deterministically — "
+            f"the sweep continues with {self.num_replicas - len(ejected)} "
+            "live member(s); its HistoryRecord is marked ejected "
+            "(docs/robustness.md)."
+        )
+
 
     # ------------------------------------------------------------ inspection
     def replica_state(self, states: TrainState, r: int) -> TrainState:
@@ -262,8 +515,11 @@ class BetaSweepTrainer:
             view = copy.copy(self.base)
             view.config = dataclasses.replace(
                 self.base.config,
-                beta_start=float(self.beta_starts[r]),
-                beta_end=float(self.beta_ends[r]),
+                # host copies from __init__: indexing the device arrays
+                # here cost a device round-trip per call and crashed on
+                # multihost meshes (non-addressable shard)
+                beta_start=float(self.beta_starts_host[r]),
+                beta_end=float(self.beta_ends_host[r]),
             )
             self._replica_trainers[r] = view
         return self._replica_trainers[r]
@@ -300,11 +556,17 @@ class BetaSweepTrainer:
         Returns ``(sub_sweep, state_r, history_r, key_r)``, each keeping the
         leading replica axis (length 1) — continue with
         ``sub_sweep.fit(key_r, n, states=state_r, histories=history_r)``.
+
+        NOTE: the automated divergence quarantine in ``fit`` does NOT use
+        this carve-out — it replays the gap at the original width, because
+        bitwise identity with the uninterrupted sweep holds only there.
+        This method is the manual / elastic-recovery path (lost shard,
+        re-run on different hardware), at float tolerance.
         """
         sub = BetaSweepTrainer(
             self.base.model, self.base.bundle, self.base.config,
-            jax.device_get(self.beta_starts)[r : r + 1],
-            jax.device_get(self.beta_ends)[r : r + 1],
+            self.beta_starts_host[r : r + 1],
+            self.beta_ends_host[r : r + 1],
             y_encoder=self.base.y_encoder,
         )
         state_r = jax.tree.map(lambda a: a[r : r + 1], states)
@@ -348,8 +610,7 @@ class PerReplicaHook:
         from dib_tpu.telemetry import trace
 
         if self._beta_ends is None:
-            self._beta_ends = [float(b)
-                               for b in jax.device_get(sweep.beta_ends)]
+            self._beta_ends = [float(b) for b in sweep.beta_ends_host]
         for r in range(sweep.num_replicas):
             if r not in self.replica_hooks:
                 self.replica_hooks[r] = self.make_hook(r)
@@ -363,11 +624,66 @@ class PerReplicaHook:
                      sweep.replica_state(states, r), epoch)
 
 
-def sweep_records(histories: dict) -> list[HistoryRecord]:
-    """Fetch a stacked [R, ...] history once and split into per-replica records."""
+def sweep_records(histories: dict, ejected=()) -> list[HistoryRecord]:
+    """Fetch a stacked [R, ...] history once and split into per-replica records.
+
+    ``ejected``: replica indices the divergence quarantine ejected — their
+    records carry ``ejected=True`` so downstream consumers (artifact
+    writers, analysis) cannot mistake a deterministically-diverged member
+    for science.
+    """
     host = jax.device_get(histories)
     num_replicas = int(np.asarray(host["cursor"]).shape[0])
-    return [
+    records = [
         HistoryRecord.from_device(jax.tree.map(lambda a: a[r], host))
         for r in range(num_replicas)
     ]
+    for r in ejected:
+        records[r].ejected = True
+    return records
+
+
+# ----------------------------------------------------- quarantine plumbing
+def _nonfinite_members(row: dict) -> list[int]:
+    """Replica indices whose boundary metrics contain any non-finite value.
+
+    ``row`` holds stacked [R]/[R, F] arrays fetched from the history at a
+    chunk boundary (loss, val_loss, kl_per_feature, ...).
+    """
+    bad: set[int] = set()
+    for name in ("loss", "val_loss", "kl_per_feature"):
+        if name not in row:
+            continue
+        arr = np.asarray(row[name])
+        finite = np.isfinite(arr).reshape(arr.shape[0], -1).all(axis=1)
+        bad.update(int(r) for r in np.flatnonzero(~finite))
+    return sorted(bad)
+
+
+def _member_row_detail(row: dict, r: int) -> dict:
+    """JSON-ready view of member ``r``'s diverged boundary metrics."""
+    return {
+        "loss": float(np.asarray(row["loss"])[r]),
+        "val_loss": float(np.asarray(row["val_loss"])[r]),
+        "kl_per_feature": [float(x)
+                           for x in np.asarray(row["kl_per_feature"])[r]],
+    }
+
+
+def _splice_member(full, healed, r: int):
+    """Replace member ``r`` in a stacked pytree with the corresponding
+    member of another same-shape stacked pytree."""
+    return jax.tree.map(lambda a, b: a.at[r].set(b[r]), full, healed)
+
+
+def _splice_keys(keys: Array, r: int, healed: Array) -> Array:
+    """Member splice for PRNG key arrays (typed keys have no ``.at`` set
+    path across all JAX versions — go through the raw key data)."""
+    if jax.dtypes.issubdtype(keys.dtype, jax.dtypes.prng_key):
+        data = jax.random.key_data(keys).at[r].set(
+            jax.random.key_data(healed)[r]
+        )
+        return jax.random.wrap_key_data(
+            data, impl=str(jax.random.key_impl(keys))
+        )
+    return keys.at[r].set(healed[r])

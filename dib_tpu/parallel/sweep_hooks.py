@@ -121,8 +121,9 @@ class SweepInfoPerFeatureHook:
                 or model is not self._cache_for[1]):
             self._fn = self._build(model)
             self._device_rows = jnp.asarray(sweep.base.bundle.x_valid)
-            # static per sweep: fetch the beta tags once, not per checkpoint
-            self._beta_ends = [float(b) for b in jax.device_get(sweep.beta_ends)]
+            # the sweep's host-side endpoint copy (fetched once in its
+            # __init__) — no device round-trip, multihost-safe
+            self._beta_ends = [float(b) for b in sweep.beta_ends_host]
             self._cache_for = (sweep, model)
         # A resumed worker re-measures from its restore point: drop any
         # preloaded records at/after this epoch (their npz mirrors are
@@ -241,8 +242,8 @@ class SweepCompressionHook:
         if self.saved and self.saved[-1]["epoch"] >= epoch:
             self.saved = [s for s in self.saved if s["epoch"] < epoch]
         cfg = sweep.base.config
-        starts = np.asarray(jax.device_get(sweep.beta_starts), np.float64)
-        ends = np.asarray(jax.device_get(sweep.beta_ends), np.float64)
+        starts = sweep.beta_starts_host
+        ends = sweep.beta_ends_host
         betas = np.array([
             float(log_annealed_beta(
                 epoch, starts[r], ends[r],

@@ -191,6 +191,16 @@ _FAULT_DETECTORS: dict[str, tuple[str, ...]] = {
     "replica_error": ("replica_ejected",),
     "replica_slow": ("replica_ejected",),
     "batcher_crash": ("serving_unhealthy", "batcher_restarted"),
+    # sweep-level self-healing (docs/robustness.md "Sweep and pod
+    # failures"): a poisoned member is detected by its quarantine heal —
+    # or, when the divergence is deterministic, by its ejection
+    "replica_nan": ("divergence_rollback", "replica_ejected",
+                    "divergence_detected"),
+    # cooperative preemption: the worker's chunk-aligned grace checkpoint
+    # and/or the supervisor's immediate relaunch both prove detection
+    "preempt": ("preempt_checkpoint", "preempt_restart"),
+    # the multihost barrier emits desync_detected before raising
+    "desync": ("desync_detected",),
 }
 
 # Recovery markers per kind, evaluated on events AFTER the detection:

@@ -21,6 +21,11 @@ from dib_tpu.train.hooks import (
     InfoPerFeatureHook,
     TimedHook,
 )
+from dib_tpu.train.preempt import (
+    PREEMPT_EXIT_CODE,
+    PreemptionGuard,
+    TrainingPreempted,
+)
 from dib_tpu.train.checkpoint import (
     CHECKPOINT_SCHEMA_VERSION,
     CheckpointCorruptionError,

@@ -465,6 +465,17 @@ class CheckpointHook:
                 "CheckpointHook needs trainer.resume_key / trainer.latest_history; "
                 "run it via fit(hooks=[...]) on a trainer that publishes them."
             )
+        # Desync guard (no-op single-process): every host must be saving
+        # the SAME (run, chunk) — a host that drifted would otherwise hang
+        # in Orbax's cross-host save collective forever, or silently write
+        # a blended checkpoint. Raises naming the divergent host instead.
+        from dib_tpu.parallel.multihost import assert_same_chunk
+
+        assert_same_chunk(
+            getattr(trainer, "_telemetry_run_id", "")
+            or os.environ.get("DIB_TELEMETRY_RUN_ID", ""),
+            epoch,
+        )
         self.checkpointer.save(
             epoch, state, history, key,
             chunk_size=getattr(trainer, "resume_chunk", None),

@@ -86,6 +86,11 @@ class HistoryRecord:
     val_loss: np.ndarray
     metric: np.ndarray
     val_metric: np.ndarray
+    # Set by sweep_records for a member the divergence quarantine EJECTED
+    # (deterministic divergence — see docs/robustness.md): the trajectory
+    # after the ejection epoch is garbage and must not be consumed as
+    # science.
+    ejected: bool = False
 
     @classmethod
     def from_device(cls, history: dict) -> "HistoryRecord":
@@ -111,6 +116,7 @@ class HistoryRecord:
             val_loss=self.val_loss / scale,
             metric=self.metric,
             val_metric=self.val_metric,
+            ejected=self.ejected,
         )
 
     @property

@@ -312,6 +312,7 @@ class DIBTrainer:
         history: dict | None = None,
         telemetry=None,
         fault_plan=None,
+        preempt=None,
     ) -> tuple[TrainState, HistoryRecord]:
         """Python-level driver: jitted chunks + host hooks between them.
 
@@ -355,6 +356,13 @@ class DIBTrainer:
         ``DIB_FAULT_PLAN`` via the CLI) fires deliberate faults at chunk
         boundaries AFTER the boundary's hooks ran, so a checkpoint hook
         always persisted the clean state first; see docs/robustness.md.
+
+        ``preempt`` (a :class:`dib_tpu.train.preempt.PreemptionGuard`): at
+        every chunk boundary the guard's flag is checked — a pending
+        SIGTERM/SIGINT writes a final chunk-aligned checkpoint through the
+        fit's checkpoint hook, emits a ``preempt_checkpoint`` mitigation,
+        and unwinds with :class:`TrainingPreempted` so the CLI can exit
+        with the preemption code the watchdog relaunches immediately.
         """
         num_epochs = self.config.num_epochs if num_epochs is None else num_epochs
         if (state is None) != (history is None):
@@ -373,6 +381,7 @@ class DIBTrainer:
                 f"recorded and {num_epochs} more were requested; grow it with "
                 f"history_extend(history, n) or train fewer epochs."
             )
+        from dib_tpu.parallel.multihost import assert_same_chunk
         from dib_tpu.telemetry import trace
         from dib_tpu.telemetry.hooks import FitRecorder
 
@@ -386,10 +395,24 @@ class DIBTrainer:
         chunk_index = 0          # 1-based fit-boundary ordinal (fault plans)
         last_rollback_epoch = None
         diverged_warned = False
+        self._telemetry_run_id = telemetry.run_id if telemetry else ""
+        # desync guard: every host must enter this fit at the same chunk
+        # (no-op single-process; see parallel/multihost.py)
+        assert_same_chunk(self._telemetry_run_id, cursor, telemetry=telemetry)
         # The active tracer is bound for the whole fit so hook-level spans
         # (SpannedHook, PerReplicaHook) parent into this run's hierarchy.
         with trace.use_tracer(recorder.tracer):
             while done < num_epochs:
+                if preempt is not None and preempt.requested:
+                    from dib_tpu.train.preempt import (
+                        chunk_aligned_preempt_exit,
+                    )
+
+                    chunk_aligned_preempt_exit(
+                        preempt, hooks, telemetry, chunk, state, history,
+                        key, epoch=cursor + done,
+                        run_id=self._telemetry_run_id,
+                    )
                 this_chunk = min(chunk, num_epochs - done)
                 key, k_chunk = jax.random.split(key)
                 if telemetry is not None and done == 0:

@@ -1,0 +1,210 @@
+"""Preemption-tolerant training: SIGTERM grace handling + a distinct exit.
+
+TPU pods are preemptible: maintenance events and spot reclaims deliver
+SIGTERM with a short grace window, and before this module that signal was
+just a crash — the in-flight chunk died, the run's terminal record was
+missing, and the watchdog treated the relaunch like a crash loop (backoff,
+restart budget). Here preemption is a first-class, *cooperative* path:
+
+  - :class:`PreemptionGuard` arms SIGTERM/SIGINT handlers that only set a
+    flag (plus a grace-deadline abort thread). The training loops
+    (``DIBTrainer.fit`` / ``BetaSweepTrainer.fit``) check the flag at every
+    chunk boundary: the in-flight chunk finishes, a final chunk-aligned
+    checkpoint is written through the fit's checkpoint hook, a
+    ``preempt_checkpoint`` mitigation lands on the event stream, and
+    :class:`TrainingPreempted` unwinds the fit.
+  - The CLI converts :class:`TrainingPreempted` into
+    ``run_end(status="preempted")`` and exits with
+    :data:`PREEMPT_EXIT_CODE` (75, ``EX_TEMPFAIL``) — a code the watchdog
+    (``train/watchdog.py``) treats as "relaunch immediately, don't back
+    off", distinct from crash-loop exits.
+  - If the in-flight chunk cannot finish inside the grace budget
+    (``--preempt_grace_s``), the guard's abort thread exits the process
+    with the same code anyway — the previous chunk-aligned checkpoint is
+    then the resume point, and the relaunch is still bit-identical from
+    there (the ``DIBCheckpointer`` chunk-size contract).
+
+See docs/robustness.md ("Sweep and pod failures").
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+
+__all__ = ["PREEMPT_EXIT_CODE", "PreemptionGuard", "TrainingPreempted",
+           "chunk_aligned_preempt_exit"]
+
+# EX_TEMPFAIL: "try again later". The watchdog relaunches a worker exiting
+# with this code immediately (no crash-loop backoff, no restart-budget
+# burn) because the exit was cooperative — the worker checkpointed and got
+# out of the way, it did not crash.
+PREEMPT_EXIT_CODE = 75
+
+
+class TrainingPreempted(Exception):
+    """Raised by ``fit`` at a chunk boundary after a preemption signal.
+
+    Carries the chunk-aligned ``epoch`` the final checkpoint was written
+    at (``checkpoint_saved`` says whether a checkpointer was available).
+    """
+
+    def __init__(self, epoch: int, signum: int | None = None,
+                 checkpoint_saved: bool = False):
+        self.epoch = int(epoch)
+        self.signum = signum
+        self.checkpoint_saved = bool(checkpoint_saved)
+        name = (signal.Signals(signum).name
+                if signum is not None else "preemption")
+        super().__init__(
+            f"training preempted ({name}) at chunk-aligned epoch {epoch}"
+            + ("; final checkpoint written" if checkpoint_saved
+               else "; no checkpointer in the hook list")
+        )
+
+
+class PreemptionGuard:
+    """Arms SIGTERM/SIGINT for cooperative chunk-aligned shutdown.
+
+    Use as a context manager around ``fit``::
+
+        with PreemptionGuard(grace_s=30.0) as guard:
+            trainer.fit(key, hooks=[...], hook_every=100, preempt=guard)
+
+    The handler never does work itself — it sets ``requested`` and starts
+    a daemon abort thread. The fit loop notices the flag at the next chunk
+    boundary (the in-flight chunk *finishes*); if the boundary never comes
+    within ``grace_s`` (a chunk longer than the grace window, or a wedged
+    device), the abort thread calls ``on_grace_expired`` (best-effort
+    telemetry flush) and ``os._exit(exit_code)`` — a preemption deadline
+    is a hard deadline, and a half-finished chunk must not turn a SIGTERM
+    into a SIGKILL with no record.
+
+    A SECOND signal during the grace window exits immediately (the
+    conventional escalation). Arming from a non-main thread is a no-op
+    (``signal.signal`` refuses); ``requested`` then just stays False
+    unless :meth:`request` is called directly (tests, drills).
+    """
+
+    def __init__(self, grace_s: float = 30.0,
+                 signals: tuple = (signal.SIGTERM, signal.SIGINT),
+                 exit_code: int = PREEMPT_EXIT_CODE,
+                 on_grace_expired=None):
+        self.grace_s = float(grace_s)
+        self.exit_code = int(exit_code)
+        self.on_grace_expired = on_grace_expired
+        self.signum: int | None = None
+        self._signals = tuple(signals)
+        self._requested = threading.Event()
+        # set when the fit unwound (or the guard disarmed) — cancels the
+        # grace abort so a handled preemption never os._exit()s later
+        self._resolved = threading.Event()
+        self._deadline: float | None = None
+        self._prev_handlers: dict = {}
+
+    # ------------------------------------------------------------- arming
+    def __enter__(self) -> "PreemptionGuard":
+        for sig in self._signals:
+            try:
+                if signal.getsignal(sig) is signal.SIG_IGN:
+                    continue   # nohup'd/shielded runs keep their protection
+                self._prev_handlers[sig] = signal.signal(sig, self._handle)
+            except (ValueError, OSError):   # non-main thread / unsupported
+                pass
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self._resolved.set()
+        for sig, handler in self._prev_handlers.items():
+            try:
+                signal.signal(sig, handler)
+            except (ValueError, OSError):
+                pass
+        self._prev_handlers.clear()
+
+    # ------------------------------------------------------------ handling
+    def _handle(self, signum, frame) -> None:
+        if self._requested.is_set():
+            # second signal during the grace window: get out NOW
+            os._exit(self.exit_code)
+        self.request(signum)
+
+    def request(self, signum: int | None = None) -> None:
+        """Mark preemption requested (the handler body; callable directly
+        by tests and drills — no signal delivery needed)."""
+        self.signum = signum
+        self._deadline = time.monotonic() + self.grace_s
+        self._requested.set()
+        threading.Thread(target=self._abort_after_grace, daemon=True,
+                         name="preempt-grace-abort").start()
+
+    def _abort_after_grace(self) -> None:
+        if self._resolved.wait(self.grace_s):
+            return   # the boundary path (or guard exit) handled it in time
+        if self.on_grace_expired is not None:
+            try:
+                self.on_grace_expired()
+            except Exception:   # fault-ok: best-effort flush on a hard exit
+                pass
+        os._exit(self.exit_code)
+
+    # ----------------------------------------------------------- inspection
+    @property
+    def requested(self) -> bool:
+        return self._requested.is_set()
+
+    def remaining_s(self) -> float | None:
+        """Grace budget left, or None when no preemption is pending."""
+        if self._deadline is None:
+            return None
+        return max(self._deadline - time.monotonic(), 0.0)
+
+    def resolved(self) -> None:
+        """Cancel the grace abort (the boundary path finished cleanly);
+        called by the fit loops right before raising TrainingPreempted."""
+        self._resolved.set()
+
+
+def chunk_aligned_preempt_exit(guard, hooks, telemetry, chunk, state,
+                               history, key, *, epoch, run_id="") -> None:
+    """The fits' shared boundary handler for a pending preemption.
+
+    Persists a final chunk-aligned checkpoint through the fit's checkpoint
+    hook (unless this boundary's hooks already saved this epoch), waits
+    for the write, records the ``preempt_checkpoint`` mitigation, and
+    unwinds with :class:`TrainingPreempted` — the CLI converts it into
+    ``run_end(status="preempted")`` + :data:`PREEMPT_EXIT_CODE`, which the
+    watchdog relaunches without backoff. One body serves both
+    ``DIBTrainer.fit`` and ``BetaSweepTrainer.fit`` so the two paths
+    cannot silently diverge.
+
+    On a pod the SIGTERM lands on every host at a slightly different
+    moment, so hosts can reach this exit at DIFFERENT chunk boundaries —
+    and a mismatched Orbax cross-host save collective hangs until the
+    grace abort kills it mid-write. The desync barrier turns that into an
+    actionable error first (no-op single-process).
+    """
+    from dib_tpu.parallel.multihost import assert_same_chunk
+    from dib_tpu.train.loop import _find_checkpointer
+
+    assert_same_chunk(run_id, epoch, telemetry=telemetry)
+    ckpt = _find_checkpointer(hooks)
+    saved = False
+    if ckpt is not None:
+        if ckpt.latest_step != epoch:
+            ckpt.save(epoch, state, history, key, chunk_size=chunk)
+        saved = True
+    if ckpt is not None and hasattr(ckpt, "manager"):
+        # the whole point is a durable resume point: wait for the (async
+        # on accelerators) write before exiting
+        ckpt.manager.wait_until_finished()
+    if telemetry is not None:
+        telemetry.mitigation(
+            mtype="preempt_checkpoint", epoch=epoch,
+            checkpoint_saved=saved,
+            grace_remaining_s=guard.remaining_s(),
+        )
+    guard.resolved()
+    raise TrainingPreempted(epoch, guard.signum, checkpoint_saved=saved)

@@ -42,7 +42,10 @@ import sys
 import time
 from typing import Sequence
 
-__all__ = ["HeartbeatHook", "WatchdogConfig", "supervise", "supervise_self"]
+from dib_tpu.train.preempt import PREEMPT_EXIT_CODE
+
+__all__ = ["HeartbeatHook", "PREEMPT_EXIT_CODE", "WatchdogConfig",
+           "supervise", "supervise_self"]
 
 
 class HeartbeatHook:
@@ -151,7 +154,13 @@ def supervise(
     happens, so a run killed mid-flight still carries its kill record.
 
     Returns a report dict: ``{"returncode", "wall_s", "launches",
-    "mitigations": [{"type": "stall_kill"|"crash_restart", ...}]}``.
+    "mitigations": [{"type":
+    "stall_kill"|"crash_restart"|"preempt_restart", ...}]}``. A worker
+    exiting with ``PREEMPT_EXIT_CODE`` (cooperative preemption,
+    train/preempt.py) is relaunched immediately: no backoff, and no
+    restart-budget burn while each preemption lands at a LATER epoch than
+    the previous one (zero-progress rc-75 loops are budgeted like
+    crashes).
     """
     cfg = config or WatchdogConfig()
     mitigations: list[dict] = []
@@ -249,6 +258,8 @@ def _supervise_loop(cmd, heartbeat_path, cfg, env, log, mitigations,
                     t_start, current) -> dict:
     launches = 0
     quick_failures = 0
+    free_relaunches = 0   # cooperative preemptions: not crash-budget burn
+    prev_preempt_epoch = None   # progress gate between consecutive preempts
     while True:
         # a stale beat from the previous attempt must not mask a wedged
         # relaunch
@@ -306,16 +317,46 @@ def _supervise_loop(cmd, heartbeat_path, cfg, env, log, mitigations,
                     "launches": launches,
                     "mitigations": mitigations,
                 }
-            mitigations.append({
-                "type": "crash_restart",
-                "launch": launches,
-                "returncode": rc,
-                "epoch": last_beat["epoch"] if last_beat else None,
-                "at_s": round(time.time() - t_start, 1),
-            })
-            log(f"watchdog: worker exited rc={rc} — relaunching from "
-                f"checkpoint")
-        if launches > cfg.max_restarts:
+            if rc == PREEMPT_EXIT_CODE:
+                # Cooperative preemption (train/preempt.py): the worker
+                # wrote a chunk-aligned checkpoint and exited on purpose.
+                # Relaunch IMMEDIATELY — no crash-loop backoff, and no
+                # restart budget burned as long as the worker ADVANCED
+                # past the previous preemption's epoch: preemptions are
+                # routine on shared pods, crashes are not. A rc-75 with no
+                # heartbeat, or repeated preempts pinned at the SAME epoch
+                # (e.g. every chunk outliving the grace budget), is a
+                # preemption-shaped stall and falls through to the
+                # crash-loop accounting below — unbounded zero-progress
+                # relaunching must not hide behind the preemption code.
+                epoch = last_beat["epoch"] if last_beat else None
+                mitigations.append({
+                    "type": "preempt_restart",
+                    "launch": launches,
+                    "epoch": epoch,
+                    "beats": last_beat["beat"] if last_beat else 0,
+                    "at_s": round(time.time() - t_start, 1),
+                })
+                log(f"watchdog: worker preempted (rc={rc}) — relaunching "
+                    f"immediately from its checkpoint")
+                progressed = (last_beat is not None
+                              and epoch != prev_preempt_epoch)
+                prev_preempt_epoch = epoch
+                if progressed:
+                    free_relaunches += 1
+                    quick_failures = 0
+                    continue
+            else:
+                mitigations.append({
+                    "type": "crash_restart",
+                    "launch": launches,
+                    "returncode": rc,
+                    "epoch": last_beat["epoch"] if last_beat else None,
+                    "at_s": round(time.time() - t_start, 1),
+                })
+                log(f"watchdog: worker exited rc={rc} — relaunching from "
+                    f"checkpoint")
+        if launches - free_relaunches > cfg.max_restarts:
             return {
                 "returncode": rc if not killed else None,
                 "wall_s": round(time.time() - t_start, 1),

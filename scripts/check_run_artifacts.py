@@ -40,6 +40,65 @@ ARTIFACT_GLOBS = ("BENCH_*.json", "NORTHSTAR_*.json", "FAULT_DRILL*.json")
 # Null-value excuses: at least one must be present when value is null.
 _NULL_VALUE_EXCUSES = ("degraded", "error", "per_run_minutes", "runs_completed")
 
+# Drills every committed full fault_drill_matrix record must carry (the
+# docs/robustness.md guarantees are only as good as the committed
+# evidence). The sweep/preempt/desync rows are the ISSUE-5 additions.
+_REQUIRED_FAULT_DRILLS = (
+    "train_stall", "train_kill", "train_nan", "preempt",
+    "sweep_replica_nan", "sweep_replica_ejected", "desync",
+    "ckpt_truncate", "ckpt_bitflip_manifest",
+    "serve_replica_error", "serve_replica_slow", "serve_batcher_crash",
+    "http_malformed",
+)
+
+
+def _check_fault_drill_matrix(record: dict, problems: list[str]) -> None:
+    """fault_drill_matrix-specific schema: a full (non---quick) committed
+    record must cover every drill in the matrix — including the
+    sweep-quarantine, preemption, and desync rows — and show each passing
+    with typed evidence fields."""
+    matrix = record.get("matrix")
+    if not isinstance(matrix, list) or not matrix:
+        problems.append("'matrix' must be a non-empty list of drill records")
+        return
+    by_name: dict[str, dict] = {}
+    for i, drill in enumerate(matrix):
+        if not isinstance(drill, dict):
+            problems.append(f"matrix[{i}] must be an object")
+            continue
+        for key in ("drill", "kind"):
+            if not (isinstance(drill.get(key), str) and drill[key]):
+                problems.append(f"matrix[{i}]: {key!r} must be a non-empty "
+                                "string")
+        if not isinstance(drill.get("ok"), bool):
+            problems.append(f"matrix[{i}]: 'ok' must be a bool")
+        if isinstance(drill.get("drill"), str):
+            by_name[drill["drill"]] = drill
+    if record.get("quick") is False:
+        missing = [d for d in _REQUIRED_FAULT_DRILLS if d not in by_name]
+        if missing:
+            problems.append(
+                f"full drill record is missing drill(s) {missing} — "
+                "re-run scripts/fault_drill.py --out FAULT_DRILL.json"
+            )
+    failed = [name for name, d in by_name.items() if d.get("ok") is False]
+    if failed:
+        problems.append(f"committed drill record shows failures: {failed}")
+    # per-row typed evidence for the ISSUE-5 additions
+    for name in ("sweep_replica_nan", "preempt"):
+        d = by_name.get(name)
+        if d is not None and d.get("bit_identical_history") is not True:
+            problems.append(f"{name}: 'bit_identical_history' must be true")
+    d = by_name.get("sweep_replica_ejected")
+    if d is not None and d.get("neighbor_bit_identical") is not True:
+        problems.append(
+            "sweep_replica_ejected: 'neighbor_bit_identical' must be true")
+    d = by_name.get("desync")
+    if d is not None and (d.get("lagging_host_named") is not True
+                          or d.get("straggler_bounded") is not True):
+        problems.append("desync: 'lagging_host_named' and "
+                        "'straggler_bounded' must both be true")
+
 
 def _reject_constant(name: str):
     raise ValueError(f"non-finite JSON constant {name!r}")
@@ -89,6 +148,8 @@ def check_record(record: dict, problems: list[str]) -> None:
                     f"'measured_at' must be %Y-%m-%dT%H:%M:%SZ, "
                     f"got {measured_at!r}"
                 )
+        if record.get("metric") == "fault_drill_matrix":
+            _check_fault_drill_matrix(record, problems)
     elif {"cmd", "rc"} <= set(record):
         # ---- driver capture
         if not isinstance(record["cmd"], str):

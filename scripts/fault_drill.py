@@ -6,9 +6,18 @@ Runs the full ``dib_tpu/faults`` drill matrix end to end on CPU
 
   - **train drills** (subprocess CLI workers under
     ``watchdog.supervise``): ``stall`` (watchdog SIGKILL + relaunch),
-    ``kill`` (crash restart), ``nan`` (in-fit divergence rollback) — each
-    must finish with a history **bit-identical** to an uninterrupted
-    baseline run of the same command;
+    ``kill`` (crash restart), ``nan`` (in-fit divergence rollback),
+    ``preempt`` (SIGTERM → chunk-aligned checkpoint → ``preempted``
+    status → immediate ``preempt_restart`` relaunch) — each must finish
+    with a history **bit-identical** to an uninterrupted baseline run of
+    the same command;
+  - **sweep drills** (in-process): a poisoned β-sweep member healed by
+    the per-replica quarantine bit-identically to an uninterrupted
+    baseline; a twice-diverging member EJECTED with the rest of the
+    sweep unharmed;
+  - **desync drill** (in-process): the multihost barrier raises NAMING
+    the host that arrived with a stale chunk, within the timeout, and
+    bounds a straggler's hang;
   - **checkpoint drills** (in-process): a truncated latest step falls
     back to the previous intact step; a bit-flipped manifest raises an
     actionable ``CheckpointCorruptionError`` instead of a deep pytree
@@ -194,6 +203,230 @@ def run_nan_drill(workdir: str, baseline: str, log) -> dict:
         bit_identical_history=identical, wall_s=wall, evidence=evidence,
         **({} if proc.returncode == 0
            else {"stderr_tail": proc.stderr[-1500:]}),
+    )
+
+
+# ------------------------------------------------------ preemption drill
+def run_preempt_drill(workdir: str, baseline: str, log) -> dict:
+    """preempt drill: a SIGTERM-shaped fault mid-fit must produce a
+    chunk-aligned checkpoint + a ``preempted`` run status + the distinct
+    exit code the watchdog relaunches IMMEDIATELY (``preempt_restart``,
+    never ``crash_restart``) — and the relaunch must finish bit-identical
+    to an uninterrupted baseline."""
+    from dib_tpu.telemetry import EventWriter, read_events
+    from dib_tpu.train.watchdog import WatchdogConfig, supervise
+
+    outdir = os.path.join(workdir, "preempt")
+    os.makedirs(outdir, exist_ok=True)
+    run_id = "fault-drill-preempt"
+    env = _worker_env(
+        DIB_FAULT_PLAN="preempt@chunk2",
+        DIB_FAULT_STATE_DIR=outdir,
+        DIB_TELEMETRY_RUN_ID=run_id,
+    )
+    telemetry = EventWriter(outdir, run_id=run_id, process_index=0,
+                            tags={"src": "supervisor"})
+    log("drill preempt: plan=preempt@chunk2 under watchdog.supervise")
+    t0 = time.time()
+    try:
+        result = supervise(
+            _train_cmd(outdir), os.path.join(outdir, "hb.json"),
+            WatchdogConfig(first_beat_timeout_s=420.0, floor_s=6.0, k=3.0,
+                           poll_s=0.25, max_restarts=2),
+            env=env, telemetry=telemetry,
+        )
+    finally:
+        telemetry.close()
+    wall = round(time.time() - t0, 1)
+    kinds = [m["type"] for m in result["mitigations"]]
+    identical = (result["returncode"] == 0
+                 and _histories_identical(baseline, outdir))
+    # run_end statuses across launches: the preempted launch must say so
+    statuses = [e.get("status") for e in read_events(outdir)
+                if e.get("type") == "run_end"]
+    ok = (result["returncode"] == 0 and kinds == ["preempt_restart"]
+          and result["launches"] == 2 and identical
+          and "preempted" in statuses and statuses[-1] == "ok")
+    return _drill_record(
+        "preempt", "preempt", ok,
+        watchdog={"returncode": result["returncode"],
+                  "launches": result["launches"], "mitigations": kinds},
+        run_end_statuses=statuses,
+        bit_identical_history=identical, wall_s=wall,
+        evidence=_stream_evidence(outdir),
+    )
+
+
+# ------------------------------------------------------------ sweep drills
+def _tiny_sweep():
+    import jax
+
+    from dib_tpu.data import get_dataset
+    from dib_tpu.models import DistributedIBModel
+    from dib_tpu.parallel import BetaSweepTrainer
+    from dib_tpu.train import TrainConfig
+
+    bundle = get_dataset("boolean_circuit")
+    model = DistributedIBModel(
+        feature_dimensionalities=tuple(bundle.feature_dimensionalities),
+        encoder_hidden=(8,), integration_hidden=(16,),
+        output_dim=1, embedding_dim=2,
+    )
+    config = TrainConfig(batch_size=64, num_pretraining_epochs=2,
+                         num_annealing_epochs=6, steps_per_epoch=2,
+                         max_val_points=128)
+    sweep = BetaSweepTrainer(model, bundle, config, 1e-4, [0.1, 1.0])
+    keys = jax.random.split(jax.random.key(3), 2)
+    return sweep, keys
+
+
+def run_sweep_drills(workdir: str, log) -> list[dict]:
+    """replica_nan drills (in-process): a poisoned sweep member healed by
+    the per-replica quarantine bit-identically to an uninterrupted
+    baseline; a twice-diverging member EJECTED with the rest of the sweep
+    unharmed."""
+    import warnings
+
+    import jax
+    import numpy as np
+
+    from dib_tpu.faults import FaultPlan, PoisonedReplicaRestore
+    from dib_tpu.telemetry import EventWriter, runtime_manifest
+    from dib_tpu.train import CheckpointHook, DIBCheckpointer
+
+    records = []
+    log("drill sweep baseline: uninterrupted 2-member sweep (in-process)")
+    base, keys = _tiny_sweep()
+    states_a, recs_a = base.fit(keys, hooks=[lambda *a: None], hook_every=2)
+
+    def history_identical(a, b):
+        return (np.array_equal(a.loss, b.loss)
+                and np.array_equal(a.kl_per_feature, b.kl_per_feature)
+                and np.array_equal(a.beta, b.beta))
+
+    # --- quarantine heal: bit-identical splice
+    log("drill sweep_replica_nan: poisoned member healed by quarantine")
+    run_dir = os.path.join(workdir, "sweep_replica_nan")
+    writer = EventWriter(run_dir)
+    writer.run_start(runtime_manifest(extra={"mode": "fault_drill"}))
+    ckpt = DIBCheckpointer(os.path.join(workdir, "sweep_nan_ck"))
+    plan = FaultPlan.parse("replica_nan@chunk2:1",
+                           state_dir=os.path.join(workdir, "sweep_nan_state"))
+    sweep, keys = _tiny_sweep()
+    t0 = time.time()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        states_b, recs_b = sweep.fit(keys, hooks=[CheckpointHook(ckpt)],
+                                     hook_every=2, telemetry=writer,
+                                     fault_plan=plan)
+    writer.run_end(status="ok")
+    writer.close()
+    ckpt.close()
+    identical = all(history_identical(a, b) for a, b in zip(recs_a, recs_b))
+    params_identical = all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree.leaves(states_a.params),
+                        jax.tree.leaves(states_b.params)))
+    evidence = _stream_evidence(run_dir)
+    faults = evidence.get("faults") or {}
+    ok = (identical and params_identical
+          and not any(r.ejected for r in recs_b)
+          and faults.get("injected") == faults.get("detected") == 1
+          and faults.get("recovered") == 1)
+    records.append(_drill_record(
+        "sweep_replica_nan", "replica_nan", ok,
+        bit_identical_history=identical,
+        bit_identical_params=params_identical,
+        healed_replica=1, wall_s=round(time.time() - t0, 1),
+        evidence=evidence,
+    ))
+
+    # --- ejection: a deterministic diverger degrades the sweep to R-1
+    log("drill sweep_replica_ejected: twice-diverging member ejected")
+    run_dir = os.path.join(workdir, "sweep_replica_ejected")
+    writer = EventWriter(run_dir)
+    writer.run_start(runtime_manifest(extra={"mode": "fault_drill"}))
+    ckpt = DIBCheckpointer(os.path.join(workdir, "sweep_eject_ck"))
+    sick = PoisonedReplicaRestore(ckpt, replica=1)
+    plan = FaultPlan.parse(
+        "replica_nan@chunk2:1",
+        state_dir=os.path.join(workdir, "sweep_eject_state"))
+    sweep, keys = _tiny_sweep()
+    t0 = time.time()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        _, recs_c = sweep.fit(keys, hooks=[CheckpointHook(sick)],
+                              hook_every=2, telemetry=writer,
+                              fault_plan=plan)
+    writer.run_end(status="ok")
+    writer.close()
+    ckpt.close()
+    neighbor_identical = history_identical(recs_a[0], recs_c[0])
+    evidence = _stream_evidence(run_dir)
+    faults = evidence.get("faults") or {}
+    ok = (recs_c[1].ejected and not recs_c[0].ejected
+          and neighbor_identical
+          and list(sweep.ejected_replicas) == [1]
+          and faults.get("detected") == faults.get("injected")
+          and not faults.get("undetected"))
+    records.append(_drill_record(
+        "sweep_replica_ejected", "replica_nan", ok,
+        ejected_replica=1, neighbor_bit_identical=neighbor_identical,
+        ejection_info=sweep.ejected_replicas.get(1),
+        wall_s=round(time.time() - t0, 1), evidence=evidence,
+    ))
+    return records
+
+
+# ------------------------------------------------------------ desync drill
+def run_desync_drill(workdir: str, log) -> dict:
+    """desync drill (in-process): the barrier must (a) raise NAMING the
+    host that arrived with a stale chunk, within the timeout, and (b)
+    bound a straggler that never arrives — no hang in either case."""
+    from dib_tpu.parallel.multihost import HostDesyncError, assert_same_chunk
+    from dib_tpu.telemetry import EventWriter, runtime_manifest
+
+    log("drill desync: stale-host barrier + straggler timeout")
+    run_dir = os.path.join(workdir, "desync")
+    writer = EventWriter(run_dir)
+    writer.run_start(runtime_manifest(extra={"mode": "fault_drill"}))
+    writer.fault(kind="desync", host=1, stale_chunk=2)
+
+    def stale_gather(mine):
+        return [mine, "drill-run|2|sha0", mine]   # host 1 a chunk behind
+
+    t0 = time.time()
+    named = timed_out = False
+    message = ""
+    try:
+        assert_same_chunk("drill-run", 3, timeout_s=10.0, git_sha="sha0",
+                          telemetry=writer, _gather=stale_gather)
+    except HostDesyncError as exc:
+        message = str(exc)
+        named = "host 1" in message and "drill-run|2" in message
+    detect_s = round(time.time() - t0, 3)
+
+    def hang_gather(mine):
+        time.sleep(120.0)
+
+    t0 = time.time()
+    try:
+        assert_same_chunk("drill-run", 3, timeout_s=1.0, git_sha="sha0",
+                          telemetry=writer, _gather=hang_gather)
+    except HostDesyncError as exc:
+        timed_out = "never arrived" in str(exc)
+    timeout_s = round(time.time() - t0, 3)
+    writer.run_end(status="ok")
+    writer.close()
+    evidence = _stream_evidence(run_dir)
+    faults = evidence.get("faults") or {}
+    ok = (named and timed_out and timeout_s < 10.0
+          and faults.get("detected") == faults.get("injected") == 1)
+    return _drill_record(
+        "desync", "desync", ok,
+        lagging_host_named=named, straggler_bounded=timed_out,
+        time_to_detect_s=detect_s, straggler_timeout_s=timeout_s,
+        error_message=message[:300], evidence=evidence,
     )
 
 
@@ -526,6 +759,9 @@ def run_drills(workdir: str | None = None, quick: bool = False,
             matrix.append(run_supervised_drill(
                 "train_kill", "kill@chunk2", workdir, baseline, log))
             matrix.append(run_nan_drill(workdir, baseline, log))
+            matrix.append(run_preempt_drill(workdir, baseline, log))
+        matrix.extend(run_sweep_drills(workdir, log))
+        matrix.append(run_desync_drill(workdir, log))
         matrix.extend(run_ckpt_drills(workdir, log))
         matrix.extend(run_serve_drills(workdir, log))
     finally:
@@ -550,8 +786,8 @@ def main(argv=None) -> int:
                         help="Also write the JSON record to this path.")
     parser.add_argument("--quick", action="store_true",
                         help="Skip the subprocess watchdog drills (train "
-                             "stall/kill/nan); checkpoint + serve drills "
-                             "only.")
+                             "stall/kill/nan/preempt); in-process "
+                             "sweep/desync/checkpoint/serve drills only.")
     parser.add_argument("--workdir", default=None,
                         help="Keep drill artifacts here (default: a "
                              "temp dir, removed afterwards).")

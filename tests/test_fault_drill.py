@@ -21,7 +21,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ARTIFACT = os.path.join(REPO, "FAULT_DRILL.json")
 
 EXPECTED_DRILLS = {
-    "train_stall", "train_kill", "train_nan",
+    "train_stall", "train_kill", "train_nan", "preempt",
+    "sweep_replica_nan", "sweep_replica_ejected", "desync",
     "ckpt_truncate", "ckpt_bitflip_manifest",
     "serve_replica_error", "serve_replica_slow", "serve_batcher_crash",
     "http_malformed",
@@ -76,10 +77,22 @@ def test_committed_drill_evidence_has_detection_and_recovery():
         assert faults["detected"] == faults["injected"], d["drill"]
         assert faults["recovered"] == faults["injected"], d["drill"]
         assert faults["time_to_detect_s"]["mean"] >= 0, d["drill"]
-    # the watchdog drills carry the bit-identity verdict explicitly
-    for name in ("train_stall", "train_kill", "train_nan"):
+    # the watchdog + sweep-heal drills carry the bit-identity verdict
+    # explicitly (a healed replica must be indistinguishable from a run
+    # the fault never touched)
+    for name in ("train_stall", "train_kill", "train_nan", "preempt",
+                 "sweep_replica_nan"):
         (d,) = [x for x in record["matrix"] if x["drill"] == name]
         assert d["bit_identical_history"] is True, name
+    # the ejection drill proves degradation, not healing: the member is
+    # marked, the neighbor untouched
+    (d,) = [x for x in record["matrix"]
+            if x["drill"] == "sweep_replica_ejected"]
+    assert d["ejected_replica"] == 1 and d["neighbor_bit_identical"] is True
+    # the desync drill proves naming + bounded detection
+    (d,) = [x for x in record["matrix"] if x["drill"] == "desync"]
+    assert d["lagging_host_named"] is True
+    assert d["straggler_bounded"] is True
 
 
 @pytest.mark.slow
@@ -107,6 +120,7 @@ def test_quick_serve_and_ckpt_drills(tmp_path):
     failed = [d for d in record["matrix"] if not d["ok"]]
     assert not failed, json.dumps(failed, indent=1, default=str)[:4000]
     assert {d["drill"] for d in record["matrix"]} == {
+        "sweep_replica_nan", "sweep_replica_ejected", "desync",
         "ckpt_truncate", "ckpt_bitflip_manifest", "serve_replica_error",
         "serve_replica_slow", "serve_batcher_crash", "http_malformed",
     }

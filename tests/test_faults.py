@@ -86,11 +86,28 @@ def test_plan_from_env(monkeypatch, tmp_path):
 
 def test_registry_covers_the_drill_matrix():
     scopes = {scope for scope, _, _ in FAULT_KINDS.values()}
-    assert scopes == {"train", "checkpoint", "serve", "http"}
+    assert scopes == {"train", "checkpoint", "serve", "http", "multihost"}
     for kind in ("stall", "kill", "nan", "ckpt_truncate",
                  "ckpt_bitflip_manifest", "replica_error", "replica_slow",
-                 "batcher_crash", "http_malformed"):
+                 "batcher_crash", "http_malformed",
+                 "replica_nan", "preempt", "desync"):
         assert kind in FAULT_KINDS
+
+
+def test_plan_replica_nan_requires_replica_and_parses():
+    with pytest.raises(ValueError, match="argument"):
+        FaultPlan.parse("replica_nan@chunk2")
+    (spec,) = FaultPlan.parse("replica_nan@chunk2:1").specs
+    assert (spec.kind, spec.chunk, spec.arg) == ("replica_nan", 2, 1.0)
+    # two same-kind specs at one boundary with different targets fire
+    # independently (the marker embeds the arg)
+    plan = FaultPlan.parse("replica_nan@chunk2:0,replica_nan@chunk2:1")
+    a, b = plan.specs
+    assert a.marker != b.marker
+    # desync is drill-injected, never plan-grammar injectable
+    with pytest.raises(ValueError, match="scope"):
+        FaultPlan.parse("desync@chunk1")
+    assert FaultPlan.parse("preempt@chunk3").specs[0].kind == "preempt"
 
 
 # -------------------------------------------------------- fault executors

@@ -1,0 +1,504 @@
+"""Sweep-level self-healing, preemption tolerance, and the desync guard.
+
+The fast tier of the ISSUE-5 drill matrix (docs/robustness.md, "Sweep and
+pod failures"):
+
+  - per-replica divergence quarantine: a poisoned sweep member is healed
+    by an original-width replay spliced back bit-identically; a member
+    whose replay re-diverges is EJECTED and the rest of the sweep is
+    unharmed;
+  - preemption: a SIGTERM-shaped request at a chunk boundary writes a
+    final chunk-aligned checkpoint, unwinds with ``TrainingPreempted``,
+    and the watchdog treats the distinct exit code as "relaunch
+    immediately, no backoff";
+  - multihost desync guard: ``assert_same_chunk`` raises naming the
+    divergent host (and bounds a straggler's hang with a timeout) instead
+    of wedging in a collective.
+
+The full subprocess preemption matrix lives in ``scripts/fault_drill.py``
+(re-run end-to-end behind ``@pytest.mark.slow`` in test_fault_drill.py).
+"""
+
+import os
+import sys
+import textwrap
+import time
+import warnings
+
+import jax
+import numpy as np
+import pytest
+
+from dib_tpu.data import get_dataset
+from dib_tpu.faults import FaultPlan, PoisonedReplicaRestore
+from dib_tpu.models import DistributedIBModel
+from dib_tpu.parallel import BetaSweepTrainer
+from dib_tpu.parallel.multihost import HostDesyncError, assert_same_chunk
+from dib_tpu.telemetry import (
+    EventWriter,
+    read_events,
+    runtime_manifest,
+    summarize,
+)
+from dib_tpu.train import (
+    CheckpointHook,
+    DIBCheckpointer,
+    DIBTrainer,
+    PreemptionGuard,
+    TrainConfig,
+    TrainingPreempted,
+)
+from dib_tpu.train.preempt import PREEMPT_EXIT_CODE
+from dib_tpu.train.watchdog import WatchdogConfig, supervise
+
+pytestmark = pytest.mark.fault
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CFG = TrainConfig(batch_size=64, num_pretraining_epochs=2,
+                   num_annealing_epochs=6, steps_per_epoch=2,
+                   max_val_points=128)
+
+
+def _tiny_model(bundle):
+    return DistributedIBModel(
+        feature_dimensionalities=tuple(bundle.feature_dimensionalities),
+        encoder_hidden=(8,), integration_hidden=(16,),
+        output_dim=1, embedding_dim=2,
+    )
+
+
+@pytest.fixture(scope="module")
+def sweep_parts():
+    bundle = get_dataset("boolean_circuit")
+    model = _tiny_model(bundle)
+    return model, bundle
+
+
+@pytest.fixture(scope="module")
+def sweep_keys():
+    return jax.random.split(jax.random.key(3), 2)
+
+
+@pytest.fixture(scope="module")
+def baseline(sweep_parts, sweep_keys):
+    """Uninterrupted 2-member sweep: 8 epochs in chunks of 2."""
+    model, bundle = sweep_parts
+    sweep = BetaSweepTrainer(model, bundle, _CFG, 1e-4, [0.1, 1.0])
+    states, records = sweep.fit(sweep_keys, hooks=[lambda *a: None],
+                                hook_every=2)
+    return states, records
+
+
+def _mk_sweep(sweep_parts):
+    model, bundle = sweep_parts
+    return BetaSweepTrainer(model, bundle, _CFG, 1e-4, [0.1, 1.0])
+
+
+# ------------------------------------------------- per-replica quarantine
+def test_replica_nan_quarantine_heals_bit_identically(
+        tmp_path, sweep_parts, sweep_keys, baseline):
+    """Poison ONE member mid-sweep; the quarantine must roll back only
+    that member, replay at the original width, splice it back, and finish
+    with EVERY member's history and params bit-identical to the
+    uninterrupted baseline — the replica_nan drill's acceptance
+    criterion, in-process and fast."""
+    states_a, recs_a = baseline
+    run_dir = str(tmp_path / "run")
+    writer = EventWriter(run_dir)
+    writer.run_start(runtime_manifest())
+    ckpt = DIBCheckpointer(str(tmp_path / "ck"))
+    plan = FaultPlan.parse("replica_nan@chunk2:1", state_dir=str(tmp_path))
+    sweep = _mk_sweep(sweep_parts)
+    with pytest.warns(UserWarning, match="member 1.*rolled back"):
+        states_b, recs_b = sweep.fit(
+            sweep_keys, hooks=[CheckpointHook(ckpt)], hook_every=2,
+            telemetry=writer, fault_plan=plan,
+        )
+    writer.run_end(status="ok")
+    writer.close()
+    ckpt.close()
+
+    for r in range(2):
+        assert not recs_b[r].ejected
+        np.testing.assert_array_equal(recs_a[r].loss, recs_b[r].loss)
+        np.testing.assert_array_equal(recs_a[r].kl_per_feature,
+                                      recs_b[r].kl_per_feature)
+        np.testing.assert_array_equal(recs_a[r].beta, recs_b[r].beta)
+    for a, b in zip(jax.tree.leaves(states_a.params),
+                    jax.tree.leaves(states_b.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    events = list(read_events(run_dir))
+    faults = [e for e in events if e["type"] == "fault"]
+    assert [(e["kind"], e.get("replica")) for e in faults] == [
+        ("replica_nan", 1)]
+    mits = [e for e in events if e["type"] == "mitigation"]
+    assert [(e["mtype"], e.get("replica")) for e in mits] == [
+        ("divergence_rollback", 1)]
+    # the mitigation is β-attributable, as the event schema promises
+    assert mits[0]["beta_end"] == pytest.approx(1.0)
+
+    rollup = summarize(run_dir)["faults"]
+    assert rollup["injected"] == rollup["detected"] == rollup["recovered"] == 1
+    assert rollup["undetected"] == []
+
+
+def test_twice_diverging_replica_is_ejected(
+        tmp_path, sweep_parts, sweep_keys, baseline):
+    """A member whose quarantine replay re-diverges in the same chunk is
+    deterministic: it must be EJECTED (replica_ejected mitigation, record
+    marked) while the rest of the sweep finishes unharmed — never healed
+    in a loop, never poisoning the run."""
+    _, recs_a = baseline
+    run_dir = str(tmp_path / "run")
+    writer = EventWriter(run_dir)
+    writer.run_start(runtime_manifest())
+    # FlakyEngine-style injector: every restore hands back a stack whose
+    # member 1 is poisoned, so each heal replay re-diverges
+    ckpt = DIBCheckpointer(str(tmp_path / "ck"))
+    sick = PoisonedReplicaRestore(ckpt, replica=1)
+    plan = FaultPlan.parse("replica_nan@chunk2:1", state_dir=str(tmp_path))
+    sweep = _mk_sweep(sweep_parts)
+    with pytest.warns(UserWarning, match="EJECTED"):
+        _, recs_b = sweep.fit(
+            sweep_keys, hooks=[CheckpointHook(sick)], hook_every=2,
+            telemetry=writer, fault_plan=plan,
+        )
+    writer.run_end(status="ok")
+    writer.close()
+    ckpt.close()
+
+    assert [r.ejected for r in recs_b] == [False, True]
+    assert list(sweep.ejected_replicas) == [1]
+    info = sweep.ejected_replicas[1]
+    assert info["beta_end"] == pytest.approx(1.0)
+    # the ejected flag survives the reporting-units conversion
+    assert recs_b[1].to_bits().ejected is True
+    # the healthy member's trajectory is untouched by its neighbor's death
+    np.testing.assert_array_equal(recs_a[0].loss, recs_b[0].loss)
+    np.testing.assert_array_equal(recs_a[0].kl_per_feature,
+                                  recs_b[0].kl_per_feature)
+    # the ejected member's tail is honestly non-finite, not spliced over
+    assert not np.isfinite(recs_b[1].loss[-1])
+
+    mits = [(e["mtype"], e.get("replica"))
+            for e in read_events(run_dir) if e["type"] == "mitigation"]
+    assert ("replica_ejected", 1) in mits
+    rollup = summarize(run_dir)["faults"]
+    assert rollup["detected"] == rollup["injected"]
+    assert rollup["undetected"] == []
+
+
+def test_sweep_divergence_without_checkpoint_warns_once(
+        tmp_path, sweep_parts, sweep_keys):
+    """No checkpoint hook in a sweep fit: the guard must warn loudly once
+    (mitigation divergence_detected naming the members) and keep going —
+    parity with the serial trainer's degraded path."""
+    run_dir = str(tmp_path / "run")
+    writer = EventWriter(run_dir)
+    writer.run_start(runtime_manifest())
+    plan = FaultPlan.parse("replica_nan@chunk1:0", state_dir=str(tmp_path))
+    sweep = _mk_sweep(sweep_parts)
+    with pytest.warns(UserWarning, match="no checkpoint"):
+        _, recs = sweep.fit(sweep_keys, hooks=[lambda *a: None],
+                            hook_every=2, telemetry=writer, fault_plan=plan)
+    writer.run_end(status="ok")
+    writer.close()
+    assert not np.isfinite(recs[0].loss[-1])     # honestly diverged
+    assert np.isfinite(recs[1].loss).all()       # neighbor untouched
+    mits = [e for e in read_events(run_dir) if e["type"] == "mitigation"]
+    assert [m["mtype"] for m in mits] == ["divergence_detected"]
+    assert mits[0]["replicas"] == [0]
+
+
+# ------------------------------------------------------------- key checks
+def test_check_keys_rejects_non_key_arrays(sweep_parts):
+    sweep = _mk_sweep(sweep_parts)
+    with pytest.raises(ValueError, match=r"jax\.random\.split"):
+        sweep.fit(np.zeros(2, np.float32), num_epochs=2)
+    with pytest.raises(ValueError, match=r"jax\.random\.split"):
+        sweep._check_keys(np.zeros((2, 3), np.uint32))
+    # typed [R] keys and raw uint32 [R, 2] key data both pass
+    typed = jax.random.split(jax.random.key(0), 2)
+    sweep._check_keys(typed)
+    sweep._check_keys(np.asarray(jax.random.key_data(typed)))
+    with pytest.raises(ValueError, match="replica keys"):
+        sweep._check_keys(jax.random.split(jax.random.key(0), 3))
+
+
+def test_host_beta_endpoints_back_replica_views(sweep_parts):
+    """replica_trainer/PerReplicaHook read host numpy endpoints fetched
+    once in __init__ — no per-call device round-trip, multihost-safe."""
+    sweep = _mk_sweep(sweep_parts)
+    assert isinstance(sweep.beta_ends_host, np.ndarray)
+    assert sweep.replica_trainer(1).config.beta_end == pytest.approx(1.0)
+    assert sweep.replica_trainer(0).config.beta_end == pytest.approx(0.1)
+    from dib_tpu.parallel.sweep import PerReplicaHook
+
+    seen = {}
+    hook = PerReplicaHook(lambda r: (lambda tr, st, ep:
+                                     seen.setdefault(r, tr.config.beta_end)))
+    states, _ = sweep.init(jax.random.split(jax.random.key(0), 2))
+    hook(sweep, states, 0)
+    assert seen == {0: pytest.approx(0.1), 1: pytest.approx(1.0)}
+
+
+# ------------------------------------------------------------- preemption
+def _serial_trainer():
+    bundle = get_dataset("boolean_circuit")
+    return DIBTrainer(_tiny_model(bundle), bundle, _CFG)
+
+
+def test_preempt_checkpoints_at_boundary_and_resumes_bit_identically(
+        tmp_path):
+    """A preemption request mid-fit must finish the in-flight chunk, write
+    a chunk-aligned checkpoint, emit preempt_checkpoint, and unwind with
+    TrainingPreempted; the relaunch must resume bit-identically."""
+    key = jax.random.key(0)
+    trainer_a = _serial_trainer()
+    state_a, hist_a = trainer_a.fit(key, hooks=[lambda *a: None],
+                                    hook_every=2)
+
+    run_dir = str(tmp_path / "run")
+    writer = EventWriter(run_dir)
+    writer.run_start(runtime_manifest())
+    ckpt = DIBCheckpointer(str(tmp_path / "ck"))
+    guard = PreemptionGuard(grace_s=120.0)
+
+    def request_at_4(trainer, state, epoch):
+        if epoch == 4:
+            guard.request()          # the SIGTERM handler body, sans signal
+
+    trainer_b = _serial_trainer()
+    with pytest.raises(TrainingPreempted) as excinfo:
+        trainer_b.fit(key, hooks=[request_at_4, CheckpointHook(ckpt)],
+                      hook_every=2, telemetry=writer, preempt=guard)
+    writer.run_end(status="preempted", epoch=excinfo.value.epoch)
+    writer.close()
+    assert excinfo.value.epoch == 4
+    assert excinfo.value.checkpoint_saved
+    assert ckpt.latest_step == 4
+    mits = [e["mtype"] for e in read_events(run_dir)
+            if e["type"] == "mitigation"]
+    assert mits == ["preempt_checkpoint"]
+    assert summarize(run_dir)["status"] == "preempted"
+
+    # the relaunch: restore and finish — bit-identical to uninterrupted
+    trainer_c = _serial_trainer()
+    state_4, hist_4, key_4 = ckpt.restore(trainer_c, chunk_size=2)
+    state_c, hist_c = trainer_c.fit(key_4, num_epochs=4, state=state_4,
+                                    history=hist_4,
+                                    hooks=[lambda *a: None], hook_every=2)
+    np.testing.assert_array_equal(hist_a.loss, hist_c.loss)
+    for a, c in zip(jax.tree.leaves(state_a.params),
+                    jax.tree.leaves(state_c.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(c))
+    ckpt.close()
+
+
+def test_sweep_preempt_uses_the_same_contract(tmp_path, sweep_parts,
+                                              sweep_keys):
+    ckpt = DIBCheckpointer(str(tmp_path / "ck"))
+    guard = PreemptionGuard(grace_s=120.0)
+
+    def request_at_4(sweep, states, epoch):
+        if epoch == 4:
+            guard.request()
+
+    sweep = _mk_sweep(sweep_parts)
+    with pytest.raises(TrainingPreempted) as excinfo:
+        sweep.fit(sweep_keys, hooks=[request_at_4, CheckpointHook(ckpt)],
+                  hook_every=2, preempt=guard)
+    assert excinfo.value.epoch == 4
+    assert ckpt.latest_step == 4
+    ckpt.close()
+
+
+def test_preempt_guard_arms_and_restores_handlers():
+    import signal as signal_mod
+
+    before = signal_mod.getsignal(signal_mod.SIGTERM)
+    with PreemptionGuard(grace_s=60.0) as guard:
+        assert signal_mod.getsignal(signal_mod.SIGTERM) == guard._handle
+        assert not guard.requested
+        assert guard.remaining_s() is None
+    assert signal_mod.getsignal(signal_mod.SIGTERM) == before
+
+
+# ----------------------------------------------------- watchdog exit code
+def _scripted_worker(tmp_path, body: str) -> list:
+    path = tmp_path / "worker.py"
+    path.write_text(textwrap.dedent(body))
+    return [sys.executable, str(path)]
+
+
+def test_watchdog_relaunches_preempted_worker_without_backoff(tmp_path):
+    """rc=75 with heartbeat progress: immediate relaunch, a
+    preempt_restart mitigation (never crash_restart), no backoff sleep,
+    and no restart-budget burn."""
+    hb = str(tmp_path / "hb.json")
+    marker = str(tmp_path / "preempted_once")
+    cmd = _scripted_worker(tmp_path, f"""
+        import json, os, sys, time
+        hb, marker = {hb!r}, {marker!r}
+        def beat(n):
+            payload = {{"pid": os.getpid(), "epoch": n, "beat": n,
+                        "time": time.time(), "intervals_s": [0.1] * n}}
+            with open(hb + ".tmp", "w") as f:
+                json.dump(payload, f)
+            os.replace(hb + ".tmp", hb)
+        beat(1); time.sleep(0.2); beat(2)
+        if not os.path.exists(marker):
+            open(marker, "w").close()
+            sys.exit({PREEMPT_EXIT_CODE})   # cooperative preemption
+        sys.exit(0)
+    """)
+    t0 = time.time()
+    result = supervise(
+        cmd, hb,
+        # max_restarts=0: ANY crash-budget burn would give up — proving
+        # the preempt relaunch is budget-free; backoff would show in wall
+        WatchdogConfig(poll_s=0.05, max_restarts=0,
+                       restart_backoff_s=30.0, min_uptime_s=0.0),
+    )
+    assert result["returncode"] == 0
+    assert result["launches"] == 2
+    assert [m["type"] for m in result["mitigations"]] == ["preempt_restart"]
+    assert result["mitigations"][0]["beats"] == 2
+    assert time.time() - t0 < 25     # no 30 s backoff was taken
+
+
+def test_watchdog_preempts_pinned_at_one_epoch_are_budgeted(tmp_path):
+    """Repeated rc-75 exits that never ADVANCE past the previous
+    preemption's epoch (every chunk outliving the grace budget, or a
+    worker wedged at one checkpoint) are a preemption-shaped stall: they
+    must burn the restart budget, not relaunch forever."""
+    hb = str(tmp_path / "hb.json")
+    cmd = _scripted_worker(tmp_path, f"""
+        import json, os, sys, time
+        hb = {hb!r}
+        payload = {{"pid": os.getpid(), "epoch": 2, "beat": 1,
+                    "time": time.time(), "intervals_s": [0.1]}}
+        with open(hb + ".tmp", "w") as f:
+            json.dump(payload, f)
+        os.replace(hb + ".tmp", hb)
+        sys.exit({PREEMPT_EXIT_CODE})    # same epoch, every launch
+    """)
+    result = supervise(cmd, hb, WatchdogConfig(poll_s=0.05, max_restarts=1))
+    assert result["returncode"] == PREEMPT_EXIT_CODE
+    assert "error" in result
+    # first preempt (epoch advanced from nothing) is free; the repeats at
+    # the same epoch burn the budget of 1
+    assert result["launches"] == 3
+    assert all(m["type"] == "preempt_restart" for m in result["mitigations"])
+
+
+def test_watchdog_zero_progress_preempt_exit_is_budgeted(tmp_path):
+    """A worker spinning on rc=75 without EVER heartbeating is a crash
+    loop wearing the preemption code — it must burn the restart budget,
+    not relaunch forever."""
+    hb = str(tmp_path / "hb.json")
+    cmd = _scripted_worker(
+        tmp_path, f"import sys; sys.exit({PREEMPT_EXIT_CODE})")
+    result = supervise(cmd, hb, WatchdogConfig(poll_s=0.05, max_restarts=1))
+    assert result["returncode"] == PREEMPT_EXIT_CODE
+    assert "error" in result
+    assert result["launches"] == 2
+
+
+# ------------------------------------------------------------ desync guard
+def test_desync_barrier_single_process_is_noop():
+    assert_same_chunk("run", 3, timeout_s=0.5) is None
+
+
+def test_desync_barrier_names_the_lagging_host(tmp_path):
+    """One host arrives with a stale chunk: the barrier must raise naming
+    THAT host and its (run_id, chunk), and record a desync_detected
+    mitigation on the stream."""
+    run_dir = str(tmp_path / "run")
+    writer = EventWriter(run_dir)
+
+    def gather(mine):
+        return [mine, "run-a|2|sha0", mine]   # host 1 is a chunk behind
+
+    with pytest.raises(HostDesyncError, match="host 1") as excinfo:
+        assert_same_chunk("run-a", 3, timeout_s=5.0, git_sha="sha0",
+                          telemetry=writer, _gather=gather)
+    writer.close()
+    assert "run-a|2" in str(excinfo.value)     # the stale value is named
+    mits = [e for e in read_events(run_dir) if e["type"] == "mitigation"]
+    assert [m["mtype"] for m in mits] == ["desync_detected"]
+    assert mits[0]["divergent_hosts"] == [1]
+
+
+def test_desync_barrier_two_host_tie_names_both_sides():
+    """A 2-host pod split 1-1 has no majority: claiming one would point
+    the operator at an arbitrary (possibly healthy) host — the error must
+    list every host's row instead."""
+    def gather(mine):
+        return [mine, "drill|1|other"]
+
+    with pytest.raises(HostDesyncError, match="no majority") as excinfo:
+        assert_same_chunk("run-a", 3, timeout_s=5.0, git_sha="sha0",
+                          _gather=gather)
+    msg = str(excinfo.value)
+    assert "host 0" in msg and "host 1" in msg
+    assert "drill|1|other" in msg
+
+
+def test_desync_barrier_names_code_drift():
+    def gather(mine):
+        other = mine.rsplit("|", 1)[0] + "|othersha"
+        return [mine, mine, other]
+
+    with pytest.raises(HostDesyncError, match="host 2"):
+        assert_same_chunk("run-a", 3, timeout_s=5.0, git_sha="mysha",
+                          _gather=gather)
+
+
+def test_desync_barrier_timeout_bounds_a_straggler(tmp_path):
+    """A host that never arrives must turn into an actionable error within
+    the timeout — not a forever-hang in the collective."""
+    def hang(mine):
+        time.sleep(60.0)
+
+    t0 = time.time()
+    with pytest.raises(HostDesyncError, match="never arrived"):
+        assert_same_chunk("run-a", 3, timeout_s=0.5, git_sha="sha0",
+                          _gather=hang)
+    assert time.time() - t0 < 5.0
+
+
+def test_desync_barrier_agreement_passes():
+    def gather(mine):
+        return [mine] * 4
+
+    assert_same_chunk("run-a", 3, timeout_s=5.0, git_sha="sha0",
+                      _gather=gather)
+
+
+def test_desync_barrier_oversize_run_id_still_compares_chunk():
+    """A run_id longer than the fixed payload must not silently truncate
+    the chunk/sha out of the compared row (desynced hosts would then
+    compare equal) — the oversize id is hashed instead, and a stale chunk
+    still raises."""
+    from dib_tpu.parallel.multihost import _BARRIER_PAYLOAD_BYTES, _barrier_row
+
+    long_id = "r" * (_BARRIER_PAYLOAD_BYTES + 40)
+    row = _barrier_row(long_id, 3, "sha0")
+    assert len(row.encode()) <= _BARRIER_PAYLOAD_BYTES
+    assert row.endswith("|3|sha0")
+
+    def stale_gather(mine):
+        return [mine, _barrier_row(long_id, 2, "sha0")]
+
+    with pytest.raises(HostDesyncError, match="host 1"):
+        assert_same_chunk(long_id, 3, timeout_s=5.0, git_sha="sha0",
+                          _gather=stale_gather)
+
+    def agree_gather(mine):
+        return [mine, mine]
+
+    assert_same_chunk(long_id, 3, timeout_s=5.0, git_sha="sha0",
+                      _gather=agree_gather)
