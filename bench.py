@@ -441,6 +441,31 @@ def emit(result: dict) -> None:
     print(json.dumps(result), flush=True)
 
 
+def register_bench(result: dict) -> None:
+    """Append this invocation's headline numbers to the fleet run registry
+    (docs/observability.md): ``DIB_RUNS_ROOT`` if set (empty disables),
+    else the repo's committed ``runs/`` root — `telemetry runs trajectory`
+    and the index report render the resulting perf trajectory. Degraded
+    records register only under an EXPLICIT root: a dead-tunnel retry loop
+    (or the degraded-path tests) must not grow the committed index with
+    no-signal rows. Registry failure never fails the bench."""
+    root = os.environ.get("DIB_RUNS_ROOT")
+    if root is None:
+        if result.get("degraded"):
+            return
+        root = os.path.join(REPO, "runs")
+    if not root:
+        return
+    try:
+        from dib_tpu.telemetry.registry import RunRegistry, bench_entry
+
+        record = RunRegistry(root).append(bench_entry(result))
+        log(f"run registry: bench entry appended under {root} "
+            f"(kind={record['kind']})")
+    except Exception as exc:
+        log(f"run registry append failed: {exc}")
+
+
 def parent_main() -> None:
     budget_s = float(os.environ.get("DIB_BENCH_TOTAL_BUDGET_S", "1050"))
     deadline = time.time() + budget_s
@@ -477,6 +502,7 @@ def parent_main() -> None:
             result, why = run_child(child_budget)
             if result is not None:
                 save_cache(result)
+                register_bench(result)
                 emit(result)
                 return
             failure = f"measurement failed: {why}"
@@ -547,6 +573,7 @@ def parent_main() -> None:
             degraded["stale_seconds"] = int(time.time() - measured)
         except (ValueError, TypeError):
             degraded["stale_seconds"] = None
+    register_bench(degraded)
     emit(degraded)
 
 

@@ -34,6 +34,12 @@ def _add_telemetry_dir_flag(parser, default_desc: str) -> None:
                         help="Directory for the run's events.jsonl "
                              "(docs/observability.md). Default: "
                              f"{default_desc}; pass '' to disable.")
+    parser.add_argument("--runs-root", "--runs_root", dest="runs_root",
+                        type=str, default="",
+                        help="Register this run in the fleet run registry "
+                             "(<runs-root>/index.jsonl) at run end; "
+                             "default: DIB_RUNS_ROOT when set, else off. "
+                             "`dib_tpu telemetry runs list` reads it.")
 
 
 def _add_model_flags(parser: argparse.ArgumentParser) -> None:
@@ -413,7 +419,7 @@ def run(args, compile_cache_status: str | None = None) -> dict:
                     preempt=guard,
                 )
         except TrainingPreempted as exc:
-            return _preempted_summary(summary, telemetry, outdir, exc)
+            return _preempted_summary(args, summary, telemetry, outdir, exc)
         if sweep.ejected_replicas:
             # a quarantine-ejected member's trajectory is not science —
             # the run record must say so, loudly
@@ -502,7 +508,7 @@ def run(args, compile_cache_status: str | None = None) -> dict:
                                              fault_plan=fault_plan,
                                              preempt=guard)
         except TrainingPreempted as exc:
-            return _preempted_summary(summary, telemetry, outdir, exc)
+            return _preempted_summary(args, summary, telemetry, outdir, exc)
         bits = history.to_bits(bundle.loss_is_info_based)
         path = save_distributed_info_plane(
             bits.kl_per_feature, bits.loss, outdir, entropy_y=entropy_y)
@@ -527,6 +533,7 @@ def run(args, compile_cache_status: str | None = None) -> dict:
         )
         telemetry.close()
         summary["events_path"] = telemetry.path
+        _register_run_dir(args, os.path.dirname(telemetry.path))
     with open(os.path.join(outdir, "run_summary.json"), "w") as f:
         json.dump(summary, f, indent=1)
         f.write("\n")
@@ -541,7 +548,19 @@ def _arm(guard):
     return guard if guard is not None else contextlib.nullcontext()
 
 
-def _preempted_summary(summary, telemetry, outdir, exc) -> dict:
+def _register_run_dir(args, run_dir: str) -> None:
+    """Fleet-registry registration at run end (docs/observability.md):
+    ``--runs-root`` flag, else ``DIB_RUNS_ROOT``, else off. Registration
+    failure must never fail the run it records (register_run warns)."""
+    root = getattr(args, "runs_root", "") or os.environ.get("DIB_RUNS_ROOT")
+    if not root:
+        return
+    from dib_tpu.telemetry.registry import register_run
+
+    register_run(run_dir, root=root)
+
+
+def _preempted_summary(args, summary, telemetry, outdir, exc) -> dict:
     """Terminal bookkeeping for a preempted fit: ``run_end`` with the
     ``preempted`` status, a run_summary.json that says so, and a summary
     ``main()`` converts into the preemption exit code the watchdog
@@ -555,6 +574,9 @@ def _preempted_summary(summary, telemetry, outdir, exc) -> dict:
         telemetry.run_end(status="preempted", epoch=exc.epoch)
         telemetry.close()
         summary["events_path"] = telemetry.path
+        # the registry's status column is how `runs list` distinguishes
+        # preempted/incomplete runs from clean ones — register here too
+        _register_run_dir(args, os.path.dirname(telemetry.path))
     with open(os.path.join(outdir, "run_summary.json"), "w") as f:
         json.dump(summary, f, indent=1)
         f.write("\n")
@@ -853,12 +875,14 @@ def workload_main(argv: Sequence[str]) -> int:
         if telemetry is not None:
             telemetry.run_end(status="ok")
             telemetry.close()
+            _register_run_dir(args, os.path.dirname(telemetry.path))
         # element-wise serialization, no outer pass: the sweep IS the product
         print(json.dumps({"results": [_json_safe(r) for r in results]}))
         return 0
     if telemetry is not None:
         telemetry.run_end(status="ok")
         telemetry.close()
+        _register_run_dir(args, os.path.dirname(telemetry.path))
     print(json.dumps(_json_safe(result)))
     return 0
 
@@ -1028,6 +1052,9 @@ def serve_main(argv: Sequence[str]) -> int:
             stop.wait()
     finally:
         server.close()
+    if telemetry is not None:
+        # after close(): the stream now carries its metrics rollup+run_end
+        _register_run_dir(args, os.path.dirname(telemetry.path))
     snapshot = registry.snapshot()
     print(json.dumps({
         "served_requests": snapshot["counters"].get("serve.requests.ok", 0),
@@ -1084,9 +1111,15 @@ def _watchdog_main(args, argv: Sequence[str]) -> int:
             floor_s=args.watchdog_floor_s,
         ),
         telemetry=telemetry,
+        # liveness from the worker's heartbeat EVENTS where the stream is
+        # on: "stalled" then means the same thing here and in `tail`
+        events_path=telemetry.path if telemetry is not None else None,
     )
     if telemetry is not None:
         telemetry.close()
+        # supersedes the worker's own registration with the supervised
+        # end-to-end view (launches, stall/crash mitigations included)
+        _register_run_dir(args, os.path.dirname(telemetry.path))
     print(json.dumps({"watchdog": result}))
     return 0 if result["returncode"] == 0 else 1
 

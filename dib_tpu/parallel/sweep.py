@@ -251,7 +251,9 @@ class BetaSweepTrainer:
         assert_same_chunk(self._telemetry_run_id, cursor, telemetry=telemetry)
         # Bound for the whole fit so hook spans (PerReplicaHook's
         # replica{r}, SpannedHook) parent into this run's trace hierarchy.
-        with trace.use_tracer(recorder.tracer):
+        # heartbeats(): bounded-interval liveness beats (boundary + mid-
+        # chunk) for `telemetry tail` / the watchdog — docs/observability.md.
+        with trace.use_tracer(recorder.tracer), recorder.heartbeats():
             while done < num_epochs:
                 if preempt is not None and preempt.requested:
                     from dib_tpu.train.preempt import (

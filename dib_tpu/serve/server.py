@@ -16,7 +16,11 @@ Routes:
     buckets, replica labels) — what a load generator needs to shape
     traffic.
   - ``GET  /metrics``     the ``MetricsRegistry`` snapshot (queue depth,
-    latency/fill histograms with p50/p99, dispatch counters) as JSON.
+    latency/fill histograms with p50/p99, dispatch counters) as JSON —
+    or, under content negotiation (``Accept: text/plain`` /
+    ``?format=prometheus``), in Prometheus text exposition format so a
+    stock scraper can point at the endpoint unmodified
+    (``telemetry/metrics.py:prometheus_text``).
 
 Status mapping: client errors (shape/width/non-finite payloads) are 400;
 queue backpressure is 503 with ``Retry-After``; a request timeout is 504;
@@ -108,7 +112,30 @@ class DIBServer:
             self.telemetry.close()
 
     # ----------------------------------------------------------- app logic
+    def metrics_text(self) -> str:
+        """The registry snapshot in Prometheus text exposition format."""
+        from dib_tpu.telemetry.metrics import prometheus_text
+
+        return prometheus_text(
+            self.registry.snapshot() if self.registry is not None else {})
+
+    @staticmethod
+    def wants_prometheus(path: str, accept: str | None) -> bool:
+        """Content negotiation for /metrics: an explicit
+        ``?format=prometheus`` (or ``format=text``), or an Accept header
+        that prefers ``text/plain`` (Prometheus scrapers send
+        ``text/plain;version=0.0.4``) over JSON."""
+        query = path.partition("?")[2]
+        for pair in query.split("&"):
+            key, _, value = pair.partition("=")
+            if key == "format":
+                return value in ("prometheus", "text")
+        accept = (accept or "").lower()
+        return ("text/plain" in accept or "openmetrics" in accept) \
+            and "application/json" not in accept
+
     def handle_get(self, path: str) -> tuple[int, dict]:
+        path = path.partition("?")[0]
         if path == "/healthz":
             entry = self.router.entries[0]
             health = self.router.health()
@@ -270,8 +297,24 @@ def _make_handler(server: DIBServer):
             self.end_headers()
             self.wfile.write(blob)
 
+        def _reply_text(self, status: int, text: str,
+                        content_type: str) -> None:
+            blob = text.encode()
+            self.send_response(status)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(blob)))
+            self.end_headers()
+            self.wfile.write(blob)
+
         def do_GET(self):   # noqa: N802 (stdlib casing)
             try:
+                if self.path.partition("?")[0] == "/metrics" \
+                        and server.wants_prometheus(
+                            self.path, self.headers.get("Accept")):
+                    self._reply_text(
+                        200, server.metrics_text(),
+                        "text/plain; version=0.0.4; charset=utf-8")
+                    return
                 status, payload = server.handle_get(self.path)
             except Exception as exc:   # never let a bug kill the connection
                 status, payload = 500, {"error": f"{type(exc).__name__}: {exc}"}

@@ -19,7 +19,15 @@ See ``docs/observability.md``. The pieces:
     callables, the per-backend peak capability table, and roofline
     utilization arithmetic.
   - :mod:`dib_tpu.telemetry.report` — self-contained static HTML run
-    reports (``python -m dib_tpu telemetry report <run-dir>``).
+    reports (``python -m dib_tpu telemetry report <run-dir>``) and the
+    multi-run fleet index page (``telemetry report --index``).
+  - :mod:`dib_tpu.telemetry.live` — follow a growing events.jsonl and
+    render a live terminal dashboard (``telemetry tail <run-dir>``).
+  - :mod:`dib_tpu.telemetry.slo` — declarative SLO rules (``SLO.json``)
+    evaluated live and terminally, writing durable ``alert`` /
+    ``transition`` events (``telemetry check <run-dir>``).
+  - :mod:`dib_tpu.telemetry.registry` — append-only fleet run registry
+    under a runs root (``telemetry runs list|show|trajectory``).
 """
 
 from dib_tpu.telemetry.events import (
@@ -37,7 +45,14 @@ from dib_tpu.telemetry.events import (
     runtime_manifest,
     shared_run_id,
 )
-from dib_tpu.telemetry.hooks import ChunkPhaseHooks
+from dib_tpu.telemetry.hooks import ChunkPhaseHooks, heartbeat_interval_s
+from dib_tpu.telemetry.live import (
+    LiveRunState,
+    StreamFollower,
+    liveness,
+    render_dashboard,
+    tail,
+)
 from dib_tpu.telemetry.metrics import (
     Counter,
     Gauge,
@@ -45,6 +60,19 @@ from dib_tpu.telemetry.metrics import (
     MetricsRegistry,
     gather_snapshots,
     write_metrics,
+)
+from dib_tpu.telemetry.registry import (
+    RunRegistry,
+    register_run,
+    resolve_runs_root,
+)
+from dib_tpu.telemetry.slo import (
+    SLOEngine,
+    TransitionTracker,
+    check_run,
+    detect_transitions,
+    evaluate_rules,
+    load_slo,
 )
 from dib_tpu.telemetry.summary import (
     compare,
@@ -71,10 +99,25 @@ __all__ = [
     "EventWriter",
     "Gauge",
     "Histogram",
+    "LiveRunState",
     "MetricsRegistry",
+    "RunRegistry",
+    "SLOEngine",
     "SpannedHook",
+    "StreamFollower",
     "Tracer",
+    "TransitionTracker",
+    "check_run",
     "compare",
+    "detect_transitions",
+    "evaluate_rules",
+    "heartbeat_interval_s",
+    "liveness",
+    "load_slo",
+    "register_run",
+    "render_dashboard",
+    "resolve_runs_root",
+    "tail",
     "config_fingerprint",
     "current_tracer",
     "device_memory_stats",

@@ -25,6 +25,19 @@ run appends typed, schema-versioned events to ``<run_dir>/events.jsonl``:
   - ``span``       one closed trace span (``telemetry/trace.py``): name,
                    full slash path, span/parent ids, blocked wall-clock
   - ``mi_bounds``  MI sandwich-bound measurements (sweep/boolean hooks)
+  - ``heartbeat``  bounded-interval liveness beat (``telemetry/hooks.py``
+                   FitRecorder): ``boundary`` beats at chunk boundaries
+                   carry trailing inter-boundary intervals (the watchdog's
+                   stall clock); ``chunk`` beats land mid-chunk from a
+                   daemon thread so a live reader can tell "long chunk"
+                   from "hung run" while the main thread is blocked on
+                   the device
+  - ``alert``      one SLO rule violation (``telemetry/slo.py``): rule
+                   name, observed value vs budget — durable, so a violated
+                   budget outlives the tail session that spotted it
+  - ``transition`` an info-plane transition: a channel's KL crossing the
+                   configured threshold between chunk boundaries
+                   (``telemetry/slo.py``)
   - ``metrics``    counter/gauge/histogram snapshots (``telemetry.metrics``)
   - ``run_end``    terminal status + total wall-clock
 
@@ -49,6 +62,7 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import time
 import uuid
 import weakref
@@ -307,6 +321,10 @@ class EventWriter:
         self._seq = 0
         self._started = False
         self._ended = False
+        # The heartbeat emitter (telemetry/hooks.py) writes from a daemon
+        # thread while the main thread is blocked on the device; the lock
+        # keeps seq gapless and the record/write pairing consistent.
+        self._lock = threading.Lock()
         self._fd = os.open(
             self.path, os.O_APPEND | os.O_CREAT | os.O_WRONLY, 0o644
         )
@@ -314,41 +332,50 @@ class EventWriter:
 
     # ----------------------------------------------------------- low level
     def emit(self, event_type: str, **data) -> dict:
-        """Append one event; returns the full record as written."""
-        record = {
-            "v": SCHEMA_VERSION,
-            "run": self.run_id,
-            "proc": self.process_index,
-            "seq": self._seq,
-            "t": time.time(),
-            "mono": time.perf_counter(),
-            "type": event_type,
-        }
-        if self.tags:
-            record["tags"] = self.tags
-        record.update(data)
-        self._seq += 1
-        # allow_nan=False: a diverged run's loss=NaN must not write a bare
-        # NaN token nothing downstream can parse — non-finite floats are
-        # encoded as the strings "NaN"/"Infinity"/"-Infinity" instead
-        # (read back by summarize; a non-finite candidate REGRESSES in
-        # compare). The sanitize walk runs only on the rare bad event.
-        try:
-            line = json.dumps(record, default=_json_default,
-                              allow_nan=False) + "\n"
-        except ValueError:
-            record = _sanitize_nonfinite(record)
-            line = json.dumps(record, default=_json_default,
-                              allow_nan=False) + "\n"
-        # one write() per line on an O_APPEND fd: concurrent writers cannot
-        # interleave, a kill can only truncate the final line
-        os.write(self._fd, line.encode())
+        """Append one event; returns the full record as written.
+
+        A writer another thread already closed (preemption grace-abort,
+        shutdown racing a heartbeat) drops the event instead of crashing
+        the emitting thread."""
+        with self._lock:
+            if self._fd is None:
+                return {}
+            record = {
+                "v": SCHEMA_VERSION,
+                "run": self.run_id,
+                "proc": self.process_index,
+                "seq": self._seq,
+                "t": time.time(),
+                "mono": time.perf_counter(),
+                "type": event_type,
+            }
+            if self.tags:
+                record["tags"] = self.tags
+            record.update(data)
+            self._seq += 1
+            # allow_nan=False: a diverged run's loss=NaN must not write a
+            # bare NaN token nothing downstream can parse — non-finite
+            # floats are encoded as the strings "NaN"/"Infinity"/
+            # "-Infinity" instead (read back by summarize; a non-finite
+            # candidate REGRESSES in compare). The sanitize walk runs only
+            # on the rare bad event.
+            try:
+                line = json.dumps(record, default=_json_default,
+                                  allow_nan=False) + "\n"
+            except ValueError:
+                record = _sanitize_nonfinite(record)
+                line = json.dumps(record, default=_json_default,
+                                  allow_nan=False) + "\n"
+            # one write() per line on an O_APPEND fd: concurrent writers
+            # cannot interleave, a kill can only truncate the final line
+            os.write(self._fd, line.encode())
         return record
 
     def close(self) -> None:
-        if self._fd is not None:
-            os.close(self._fd)
-            self._fd = None
+        with self._lock:
+            if self._fd is not None:
+                os.close(self._fd)
+                self._fd = None
         _OPEN_WRITERS.discard(self)
 
     def __enter__(self) -> "EventWriter":
@@ -404,6 +431,28 @@ class EventWriter:
 
     def mi_bounds(self, *, epoch: int, **fields) -> dict:
         return self.emit("mi_bounds", epoch=int(epoch), **fields)
+
+    def heartbeat(self, *, beat: int, epoch: int, phase: str,
+                  **fields) -> dict:
+        """One liveness beat (telemetry/hooks.py FitRecorder). ``phase``
+        is ``"boundary"`` (chunk boundary, main thread — carries trailing
+        ``intervals_s``, the watchdog's stall clock) or ``"chunk"``
+        (mid-chunk daemon thread — carries ``chunk_elapsed_s``)."""
+        return self.emit("heartbeat", beat=int(beat), epoch=int(epoch),
+                         phase=phase, **fields)
+
+    def alert(self, *, rule: str, **fields) -> dict:
+        """One durable SLO violation (``telemetry/slo.py``): the rule
+        name plus the observed value vs its budget."""
+        return self.emit("alert", rule=rule, **fields)
+
+    def transition(self, *, channel: int, epoch: int, direction: str,
+                   **fields) -> dict:
+        """One info-plane transition: channel ``channel``'s KL crossed
+        the configured threshold between chunk boundaries (``direction``
+        ``"up"``/``"down"``)."""
+        return self.emit("transition", channel=int(channel),
+                         epoch=int(epoch), direction=direction, **fields)
 
     def span(self, *, name: str, path: str, span_id: int,
              parent_id: int | None, seconds: float, **fields) -> dict:

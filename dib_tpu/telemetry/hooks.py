@@ -18,18 +18,43 @@ lands as a ``chunk`` event with steps/s and device+host memory, plus a
 into hit/miss counters, and from then on every recorded chunk updates
 achieved-FLOP/s / achieved-bandwidth gauges in the ``MetricsRegistry`` —
 the live roofline position of the training program.
+
+It also owns the run's **heartbeat** stream (docs/observability.md):
+``recorder.heartbeats()`` wraps the fit loop, emitting a ``boundary``
+beat at every recorded chunk (trailing inter-boundary intervals — the
+same stall clock ``train/watchdog.py`` consumes) plus mid-chunk ``chunk``
+beats from a daemon thread at a bounded wall-clock interval
+(``DIB_HEARTBEAT_S``, default 10 s), so a live reader — ``telemetry
+tail``, the watchdog — can tell "long chunk, process alive" from "hung
+run" while the main thread is blocked on the device.
 """
 
 from __future__ import annotations
 
 import contextlib
+import os
+import threading
 import time
 
 from dib_tpu.telemetry.events import device_memory_stats, host_memory_stats
 from dib_tpu.telemetry.trace import Tracer
 from dib_tpu.utils.profiling import PhaseTimer
 
-__all__ = ["ChunkPhaseHooks", "FitRecorder"]
+__all__ = ["ChunkPhaseHooks", "FitRecorder", "heartbeat_interval_s"]
+
+# How many trailing inter-boundary intervals a boundary beat carries (the
+# watchdog's trailing-median stall clock; mirrors HeartbeatHook.keep).
+_KEEP_INTERVALS = 32
+
+
+def heartbeat_interval_s() -> float:
+    """The configured mid-chunk heartbeat bound: ``DIB_HEARTBEAT_S``
+    seconds (default 10.0; ``0`` disables the mid-chunk daemon thread —
+    boundary beats still land with every chunk event)."""
+    try:
+        return float(os.environ.get("DIB_HEARTBEAT_S", "10"))
+    except ValueError:
+        return 10.0
 
 
 class _NullPhase:
@@ -64,6 +89,19 @@ class FitRecorder:
         self.timer = self.registry = self.tracer = None
         self._costs: dict[str, dict] = {}
         self._peaks = None
+        # heartbeat state (shared between the fit thread and the mid-chunk
+        # daemon thread; the counter is guarded so beat numbers stay
+        # strictly increasing across both emitters)
+        self._hb_lock = threading.Lock()
+        self._beats = 0
+        self._boundary_intervals: list[float] = []
+        # anchored at fit start so the FIRST inter-boundary interval is the
+        # compile-laden one, matching HeartbeatHook's convention (the
+        # watchdog's steady median starts at intervals_s[1])
+        # timing-ok: inter-beat anchor, not a measured jitted interval
+        self._last_boundary_t: float | None = time.perf_counter()
+        self._last_epoch = 0
+        self._chunk_t0: float | None = None
         if telemetry is not None:
             from dib_tpu.telemetry.metrics import MetricsRegistry
 
@@ -77,8 +115,60 @@ class FitRecorder:
         if self.tracer is None:
             yield _NullPhase()
         else:
-            with self.tracer.span("chunk", **tags) as handle:
-                yield handle
+            # timing-ok: chunk-in-flight marker for the heartbeat thread,
+            # not a measured interval (the span below owns the timing)
+            self._chunk_t0 = time.perf_counter()
+            try:
+                with self.tracer.span("chunk", **tags) as handle:
+                    yield handle
+            finally:
+                self._chunk_t0 = None
+
+    def _emit_heartbeat(self, phase: str, **fields) -> None:
+        if self.telemetry is None:
+            return
+        with self._hb_lock:
+            self._beats += 1
+            beat = self._beats
+        self.telemetry.heartbeat(beat=beat, epoch=self._last_epoch,
+                                 phase=phase, **fields)
+
+    @contextlib.contextmanager
+    def heartbeats(self, interval_s: float | None = None):
+        """Run the fit loop under a bounded-interval heartbeat: a daemon
+        thread emits a ``chunk``-phase beat every ``interval_s`` (default
+        ``DIB_HEARTBEAT_S``) while the fit is in flight — including while
+        the main thread is blocked inside ``run_chunk`` — so a live
+        reader can distinguish a long chunk from a hung run. Boundary
+        beats are emitted by :meth:`record_chunk` regardless. No-op when
+        telemetry is off or the interval is 0."""
+        interval = (heartbeat_interval_s() if interval_s is None
+                    else float(interval_s))
+        if self.telemetry is None or interval <= 0:
+            yield
+            return
+        stop = threading.Event()
+
+        def _beat_loop():
+            while not stop.wait(interval):
+                t0 = self._chunk_t0
+                fields = {"interval_s": interval}
+                if t0 is not None:
+                    # timing-ok: elapsed-in-chunk is reporting, not a
+                    # performance interval (the chunk span owns timing)
+                    fields["phase_elapsed_s"] = round(
+                        time.perf_counter() - t0, 3)
+                self._emit_heartbeat(
+                    "chunk" if t0 is not None else "host", **fields)
+
+        thread = threading.Thread(target=_beat_loop, name="dib-heartbeat",
+                                  daemon=True)
+        thread.start()
+        try:
+            yield
+        finally:
+            stop.set()
+            thread.join(timeout=max(1.0, interval))
 
     def span(self, name: str, **tags):
         """A named span under this fit's tracer (no-op handle when off) —
@@ -108,6 +198,10 @@ class FitRecorder:
         ).inc()
         cost = xla_stats.record_compile_event(
             self.telemetry, name, jitfn, args, kwargs, cache=cache,
+            # the chunk program's static epoch count rides the event so a
+            # live reader (telemetry tail) can scale the program FLOPs to
+            # each chunk's actual epochs for its MFU gauge
+            **({"epochs": epochs} if epochs else {}),
         )
         self._costs[name] = {
             "cost": cost,
@@ -153,7 +247,7 @@ class FitRecorder:
         seconds = self.timer.intervals["chunk"][-1]
         steps = chunk_epochs * self.steps_per_epoch
         self.telemetry.chunk(
-            epoch=epoch, steps=steps, seconds=seconds,
+            epoch=epoch, steps=steps, seconds=seconds, epochs=chunk_epochs,
             memory=device_memory_stats(), host_memory=host_memory_stats(),
             **fields,
         )
@@ -161,6 +255,20 @@ class FitRecorder:
         self.registry.histogram("chunk_s").record(seconds)
         self.registry.gauge("epoch").set(epoch)
         self._utilization_gauges("run_chunk", chunk_epochs, seconds)
+        # boundary heartbeat: device progress proven (the chunk event above
+        # was emitted AFTER blocking on the chunk's outputs). Trailing
+        # inter-boundary intervals are the watchdog's stall clock.
+        self._last_epoch = int(epoch)
+        now = time.perf_counter()   # timing-ok: inter-beat wall-clock,
+        # measured across an already-blocked boundary (same contract as
+        # train/watchdog.py HeartbeatHook)
+        if self._last_boundary_t is not None:
+            self._boundary_intervals.append(
+                round(now - self._last_boundary_t, 2))
+            del self._boundary_intervals[:-_KEEP_INTERVALS]
+        self._last_boundary_t = now
+        self._emit_heartbeat("boundary",
+                             intervals_s=list(self._boundary_intervals))
 
     def finish(self) -> None:
         """End-of-fit rollup: chunk wall-clock distribution + totals as one

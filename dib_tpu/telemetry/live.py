@@ -1,0 +1,457 @@
+"""Live run monitoring: follow a growing events.jsonl, render a dashboard.
+
+``python -m dib_tpu telemetry tail <run-dir>`` attaches to a run IN FLIGHT
+and renders a refreshing terminal dashboard from the same event stream the
+post-hoc tools (``summarize``/``report``) read after the fact:
+
+  - throughput: recent steps/s (trailing window of ``chunk`` events) and
+    the run's cumulative average;
+  - quality: last loss / val_loss and the per-channel KL row — the
+    info-plane position, live;
+  - **live MFU gauge**: the chunk program's cost-analyzed FLOPs (from its
+    ``compile`` event, scaled to each chunk's actual epoch count) divided
+    by chunk wall-clock, against the per-backend peak table
+    (``telemetry/xla_stats.py``) — the roofline position while the run
+    still has time to be fixed;
+  - span hotspots (self-time, same arithmetic as ``summarize``);
+  - a mitigation / fault / alert / transition ticker (most recent last);
+  - liveness: heartbeat staleness — "chunk in flight, beat 2 s ago"
+    vs "SILENT for 40 s", the mid-chunk distinction the boundary-only
+    telemetry could not make.
+
+The follower (:class:`StreamFollower`) is incremental and torn-line
+tolerant: a final line still being appended is buffered until its
+newline arrives (never mis-parsed), and a torn line mid-file (killed
+writer) is skipped and counted — the same durability contract
+``events.read_events`` honors, applied to a file that is still growing.
+
+Everything here is host-side file analysis: this module never imports jax.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from collections import deque
+
+from dib_tpu.telemetry.events import resolve_events_path
+
+__all__ = ["LiveRunState", "StreamFollower", "liveness", "render_dashboard",
+           "tail"]
+
+
+class StreamFollower:
+    """Incremental reader over a (possibly still growing) events.jsonl.
+
+    ``poll()`` returns the complete, parseable events appended since the
+    last call. The bytes after the final newline are an in-progress append
+    and stay buffered — a torn FINAL line is never mis-read, it is simply
+    not ready yet. A complete line that does not parse (a writer killed
+    mid-append earlier in the file) is skipped and counted in ``torn``.
+
+    A file that does not exist yet polls as empty (attach before the run
+    starts); a file that SHRANK (rotated/truncated) resets the follower to
+    the top rather than reading garbage from a stale offset.
+    """
+
+    def __init__(self, path: str):
+        # resolved lazily each poll: attaching BEFORE the run dir exists
+        # must re-resolve once the run creates it as a directory
+        self._given = path
+        self._offset = 0
+        self._buf = b""
+        self.torn = 0
+        self.events_read = 0
+
+    @property
+    def path(self) -> str:
+        return resolve_events_path(self._given)
+
+    def poll(self) -> list[dict]:
+        try:
+            size = os.stat(self.path).st_size
+        except OSError:
+            return []
+        if size < self._offset:   # truncated/rotated under us: start over
+            self._offset = 0
+            self._buf = b""
+        if size == self._offset and not self._buf:
+            return []
+        with open(self.path, "rb") as f:
+            f.seek(self._offset)
+            data = f.read()
+        self._offset += len(data)
+        data = self._buf + data
+        lines = data.split(b"\n")
+        self._buf = lines.pop()   # bytes after the last newline: in flight
+        out = []
+        for line in lines:
+            if not line.strip():
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                self.torn += 1
+        self.events_read += len(out)
+        return out
+
+
+def liveness(state: "LiveRunState", now: float | None = None) -> dict:
+    """The shared staleness verdict (dashboard, watchdog, drills agree):
+
+    - ``silent_s``: wall-clock since the last heartbeat (any phase) — the
+      process-liveness clock; None before the first beat.
+    - ``progress_s``: since the last chunk boundary (``chunk`` event or
+      boundary beat) — the device-progress clock.
+    - ``silent``: no beat within 3x the configured heartbeat interval —
+      the emitting process is presumed hung or dead (a merely LONG chunk
+      keeps beating mid-chunk).
+    - ``in_chunk``: the last beat reported a chunk in flight.
+    """
+    now = time.time() if now is None else now   # timing-ok: staleness vs
+    # event wall-clock stamps, no jitted work in this module
+    out = {
+        "silent_s": (round(now - state.last_beat_t, 1)
+                     if state.last_beat_t else None),
+        "progress_s": (round(now - state.last_progress_t, 1)
+                       if state.last_progress_t else None),
+        "in_chunk": state.in_chunk,
+        "silent": False,
+    }
+    if state.heartbeat_interval_s and state.last_beat_t:
+        out["silent"] = (now - state.last_beat_t
+                         > 3.0 * state.heartbeat_interval_s)
+    return out
+
+
+class LiveRunState:
+    """Incremental rollup of a run's event stream for the dashboard.
+
+    Feed events in file order via :meth:`update`; read the rendered view
+    off the attributes (or :func:`render_dashboard`). Keeps bounded
+    windows only — following a week-long run must not grow without bound.
+    """
+
+    def __init__(self, window: int = 64, ticker: int = 8):
+        self.run_id = None
+        self.manifest: dict = {}
+        self.status = "waiting"       # no run_start seen yet
+        self.launches = 0
+        self.chunks = deque(maxlen=window)     # recent chunk events
+        self.total_steps = 0
+        self.total_chunk_s = 0.0
+        self.num_chunks = 0
+        # steady-state totals mirror summarize: each launch's FIRST chunk
+        # (compile-laden) is excluded, so a live SLO floor on
+        # steady_steps_per_s sees the same metric the budget was written
+        # against instead of false-firing on the compile chunk
+        self.steady_steps = 0
+        self.steady_s = 0.0
+        self._awaiting_first_chunk = True
+        self.compiles: dict[str, dict] = {}    # name -> compile event
+        self.span_totals: dict[str, list] = {}  # path -> [total_s, count]
+        self.ticker = deque(maxlen=ticker)     # mitigation/fault/alert rows
+        self.counts = {"mitigation": 0, "fault": 0, "alert": 0,
+                       "transition": 0}
+        self.last_beat_t = None
+        self.last_progress_t = None
+        self.in_chunk = False
+        self.heartbeat_interval_s = None
+        self.last_mi: dict | None = None
+        self._lead_proc = None
+
+    # ------------------------------------------------------------- update
+    def update(self, event: dict) -> None:
+        etype = event.get("type")
+        proc = event.get("proc", 0)
+        if self.run_id is None and event.get("run"):
+            self.run_id = event["run"]
+        # multihost streams: mirror summarize's convention — per-run
+        # rollups come from the lowest process index seen emitting chunks
+        if etype == "chunk":
+            if self._lead_proc is None or proc < self._lead_proc:
+                self._lead_proc = proc
+            if proc != self._lead_proc:
+                return
+        if etype == "run_start":
+            self.launches += 1
+            self.run_id = event.get("run")
+            self.manifest = event.get("manifest") or {}
+            self.status = "running"
+            self._awaiting_first_chunk = True
+        elif etype == "chunk":
+            self.chunks.append(event)
+            self.total_steps += event.get("steps") or 0
+            self.total_chunk_s += event.get("seconds") or 0.0
+            self.num_chunks += 1
+            self.last_progress_t = event.get("t")
+            if self._awaiting_first_chunk:
+                self._awaiting_first_chunk = False
+            else:
+                self.steady_steps += event.get("steps") or 0
+                self.steady_s += event.get("seconds") or 0.0
+        elif etype == "compile":
+            self.compiles[event.get("name", "?")] = event
+        elif etype == "span":
+            path = event.get("path") or event.get("name") or "?"
+            entry = self.span_totals.setdefault(path, [0.0, 0])
+            entry[0] += event.get("seconds") or 0.0
+            entry[1] += 1
+        elif etype == "heartbeat":
+            self.last_beat_t = event.get("t")
+            self.in_chunk = event.get("phase") == "chunk"
+            if event.get("intervals_s") is not None:
+                self.last_progress_t = event.get("t")
+                self.in_chunk = False
+            if event.get("interval_s"):
+                self.heartbeat_interval_s = event["interval_s"]
+        elif etype == "mi_bounds":
+            self.last_mi = event
+        elif etype in ("mitigation", "fault", "alert", "transition"):
+            self.counts[etype] += 1
+            self.ticker.append(self._ticker_row(etype, event))
+        elif etype == "run_end":
+            self.status = event.get("status", "?")
+
+    @staticmethod
+    def _ticker_row(etype: str, event: dict) -> str:
+        stamp = time.strftime("%H:%M:%S", time.localtime(event.get("t", 0)))
+        if etype == "mitigation":
+            what = event.get("mtype", "?")
+        elif etype == "fault":
+            what = f"fault {event.get('kind', '?')}"
+        elif etype == "alert":
+            what = (f"ALERT {event.get('rule', '?')}: "
+                    f"{event.get('value')} vs {event.get('budget')}")
+        else:
+            what = (f"transition ch{event.get('channel', '?')} "
+                    f"{event.get('direction', '?')} @ "
+                    f"epoch {event.get('epoch', '?')}")
+        extra = ""
+        if etype in ("mitigation", "fault") and event.get("epoch") is not None:
+            extra = f" @ epoch {event['epoch']}"
+        return f"{stamp}  {what}{extra}"
+
+    # ------------------------------------------------------------ derived
+    @property
+    def recent_steps_per_s(self) -> float | None:
+        steps = sum(c.get("steps") or 0 for c in self.chunks)
+        secs = sum(c.get("seconds") or 0.0 for c in self.chunks)
+        return steps / secs if secs > 0 else None
+
+    @property
+    def steps_per_s(self) -> float | None:
+        return (self.total_steps / self.total_chunk_s
+                if self.total_chunk_s > 0 else None)
+
+    @property
+    def steady_steps_per_s(self) -> float | None:
+        """summarize's steady-state metric, live: None until a launch has
+        produced a chunk BEYOND its compile-laden first one."""
+        return (self.steady_steps / self.steady_s
+                if self.steady_s > 0 else None)
+
+    def last_chunk(self) -> dict | None:
+        return self.chunks[-1] if self.chunks else None
+
+    def mfu(self) -> dict | None:
+        """Live roofline gauge from the chunk program's cost-analyzed
+        FLOPs (``compile`` event, per-epoch scaled) over the last chunk's
+        wall-clock, vs the backend peak table. None until both a
+        cost-analyzed compile event and a chunk have landed."""
+        from dib_tpu.telemetry.xla_stats import achieved, backend_peaks
+
+        chunk = self.last_chunk()
+        compile_event = self.compiles.get("run_chunk") \
+            or self.compiles.get("sweep_chunk")
+        if chunk is None or compile_event is None:
+            return None
+        flops = compile_event.get("flops")
+        nbytes = compile_event.get("bytes_accessed")
+        seconds = chunk.get("seconds")
+        if not seconds or not (flops or nbytes):
+            return None
+        compiled_epochs = compile_event.get("epochs")
+        chunk_epochs = chunk.get("epochs")
+        scale = 1.0
+        if compiled_epochs and chunk_epochs:
+            scale = chunk_epochs / compiled_epochs
+        peaks = backend_peaks(self.manifest.get("device_kind"))
+        out = achieved(seconds,
+                       flops=flops * scale if flops else None,
+                       bytes_accessed=nbytes * scale if nbytes else None,
+                       peaks=peaks)
+        if peaks:
+            out["peaks"] = peaks
+        return out or None
+
+    def hotspots(self, n: int = 3) -> list[dict]:
+        from dib_tpu.telemetry.summary import (
+            _normalize_span_path,
+            span_hotspots,
+        )
+
+        rollup: dict[str, dict] = {}
+        for path, (total, count) in self.span_totals.items():
+            norm = _normalize_span_path(path)
+            entry = rollup.setdefault(norm, {"total_s": 0.0, "count": 0})
+            entry["total_s"] += total
+            entry["count"] += count
+        return span_hotspots(rollup, n)
+
+
+# ------------------------------------------------------------- rendering
+_BAR_WIDTH = 24
+
+
+def _bar(frac: float | None, width: int = _BAR_WIDTH) -> str:
+    if frac is None:
+        return "·" * width
+    frac = max(0.0, min(1.0, frac))
+    filled = round(frac * width)
+    return "█" * filled + "·" * (width - filled)
+
+
+def _fmt(v, fmt="{:.3g}") -> str:
+    if v is None:
+        return "—"
+    if isinstance(v, float):
+        return fmt.format(v)
+    return str(v)
+
+
+def render_dashboard(state: LiveRunState, now: float | None = None,
+                     width: int = 78) -> str:
+    """One dashboard frame as plain text (no ANSI — the tail loop owns
+    screen control), so tests and logs can consume frames verbatim."""
+    man = state.manifest
+    live = liveness(state, now)
+    lines = []
+    device = (f"{man.get('device_kind', '?')} ×{man.get('device_count', '?')}"
+              if man else "?")
+    head = (f"run {state.run_id or '?'}  ·  {state.status}  ·  {device}"
+            + (f"  ·  launch {state.launches}" if state.launches > 1 else ""))
+    lines.append(head[:width])
+    lines.append("─" * min(width, len(head) + 8))
+
+    chunk = state.last_chunk()
+    epoch = chunk.get("epoch") if chunk else None
+    lines.append(
+        f"steps/s   recent {_fmt(state.recent_steps_per_s, '{:.1f}')}"
+        f"   run {_fmt(state.steps_per_s, '{:.1f}')}"
+        f"   steps {state.total_steps}"
+        + (f"   epoch {epoch}" if epoch is not None else ""))
+
+    if chunk is not None:
+        loss = chunk.get("loss")
+        val = chunk.get("val_loss")
+        if isinstance(loss, list):
+            loss = sum(loss) / len(loss) if loss else None
+        if isinstance(val, list):
+            val = sum(val) / len(val) if val else None
+        lines.append(f"loss      {_fmt(loss, '{:.5g}')}"
+                     f"   val_loss {_fmt(val, '{:.5g}')}")
+        kl = chunk.get("kl_per_feature")
+        if isinstance(kl, list) and kl:
+            vals = [v for v in kl if isinstance(v, (int, float))]
+            if vals:
+                peak = max(max(vals), 1e-12)
+                cells = "".join(
+                    " ▁▂▃▄▅▆▇█"[min(int(v / peak * 8), 8)] if v > 0 else " "
+                    for v in vals[:48])
+                lines.append(f"KL/chan   [{cells}]  Σ {sum(vals):.4g} nats"
+                             f"  ({len(vals)} channels)")
+        elif isinstance(chunk.get("kl_total"), list):
+            tot = [v for v in chunk["kl_total"]
+                   if isinstance(v, (int, float))]
+            if tot:
+                lines.append(f"KL total  [{', '.join(f'{v:.3g}' for v in tot[:8])}"
+                             + ("…]" if len(tot) > 8 else "]")
+                             + f"  ({len(tot)} replicas)")
+
+    mfu = state.mfu()
+    if mfu:
+        frac = mfu.get("flops_frac_of_peak")
+        gflops = mfu.get("achieved_gflops")
+        peak = (mfu.get("peaks") or {}).get("bf16_tflops")
+        lines.append(
+            f"MFU       {_bar(frac)} "
+            + (f"{frac * 100:.2f}% of {peak:g} TF/s peak"
+               if frac is not None and peak else
+               f"{_fmt(gflops, '{:.1f}')} GFLOP/s (no peak table row)"))
+        bw = mfu.get("bandwidth_frac_of_peak")
+        if bw is not None:
+            lines.append(f"HBM       {_bar(bw)} {bw * 100:.2f}% of "
+                         f"{mfu['peaks']['hbm_gbps']:g} GB/s peak")
+
+    hot = state.hotspots()
+    if hot:
+        tops = "  ".join(f"{h['path']} {h['self_s']:.2f}s" for h in hot)
+        lines.append(f"hotspots  {tops}"[:width])
+
+    beat = ("no heartbeat yet" if live["silent_s"] is None else
+            f"beat {live['silent_s']}s ago"
+            + (", chunk in flight" if live["in_chunk"] else ""))
+    if live["silent"]:
+        beat = f"SILENT for {live['silent_s']}s — run presumed hung"
+    prog = (f"   boundary {live['progress_s']}s ago"
+            if live["progress_s"] is not None else "")
+    lines.append(f"liveness  {beat}{prog}")
+
+    if state.counts["alert"] or state.counts["transition"] \
+            or state.counts["mitigation"] or state.counts["fault"]:
+        lines.append(
+            f"events    {state.counts['mitigation']} mitigations, "
+            f"{state.counts['fault']} faults, "
+            f"{state.counts['alert']} alerts, "
+            f"{state.counts['transition']} transitions")
+    for row in state.ticker:
+        lines.append(f"  {row}"[:width])
+    return "\n".join(lines)
+
+
+def tail(path: str, *, slo=None, refresh_s: float = 1.0,
+         duration_s: float | None = None, follow_after_end: bool = False,
+         out=None, ansi: bool | None = None,
+         max_frames: int | None = None) -> LiveRunState:
+    """Follow ``path`` (run dir or events.jsonl), rendering a refreshing
+    dashboard until the run ends (or ``duration_s`` elapses).
+
+    ``slo`` is an optional :class:`dib_tpu.telemetry.slo.SLOEngine`; when
+    given, every poll feeds it the new events and violations/transitions
+    are written DURABLY onto the run's own stream (and show in the
+    ticker on the next poll). Returns the final :class:`LiveRunState`.
+    """
+    out = sys.stdout if out is None else out
+    if ansi is None:
+        ansi = hasattr(out, "isatty") and out.isatty()
+    follower = StreamFollower(path)
+    state = LiveRunState()
+    deadline = (time.time() + duration_s) if duration_s else None
+    # timing-ok: host-side poll pacing; no jitted work in this module
+    frames = 0
+    while True:
+        for event in follower.poll():
+            state.update(event)
+            if slo is not None:
+                slo.observe(event)
+        if slo is not None:
+            slo.flush()
+        frame = render_dashboard(state)
+        if ansi:
+            out.write("\x1b[2J\x1b[H" + frame + "\n")
+        else:
+            out.write(frame + "\n\n")
+        out.flush()
+        frames += 1
+        ended = state.status not in ("waiting", "running")
+        if ended and not follow_after_end:
+            break
+        if deadline is not None and time.time() >= deadline:
+            break   # timing-ok: poll pacing
+        if max_frames is not None and frames >= max_frames:
+            break
+        time.sleep(refresh_s)   # timing-ok: poll pacing
+    return state

@@ -15,6 +15,7 @@ Non-zero processes return without touching the file.
 
 from __future__ import annotations
 
+import re
 import threading
 from collections import deque
 
@@ -24,6 +25,7 @@ __all__ = [
     "Histogram",
     "MetricsRegistry",
     "gather_snapshots",
+    "prometheus_text",
     "write_metrics",
 ]
 
@@ -127,6 +129,60 @@ class MetricsRegistry:
                 k: h.snapshot() for k, h in self._histograms.items()
             },
         }
+
+
+_PROM_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str, prefix: str) -> str:
+    """Prometheus metric name: dotted registry names become underscored,
+    everything outside [a-zA-Z0-9_:] sanitized, ``prefix_`` prepended."""
+    return f"{prefix}_{_PROM_BAD.sub('_', name)}"
+
+
+def prometheus_text(snapshot: dict, prefix: str = "dib") -> str:
+    """Render a :meth:`MetricsRegistry.snapshot` in Prometheus text
+    exposition format (version 0.0.4 — what every Prometheus scraper and
+    most collectors speak).
+
+    Counters map to ``counter``, gauges to ``gauge``; histograms map to a
+    ``summary`` (``_count``/``_sum`` plus ``quantile``-labelled samples
+    from the windowed p50/p90/p99) with ``_min``/``_max`` gauges — the
+    registry keeps nearest-rank percentiles, not cumulative buckets, so a
+    summary is the honest mapping. The serving ``/metrics`` endpoint
+    returns this under content negotiation (docs/serving.md)."""
+    lines: list[str] = []
+
+    def sample(name: str, value, labels: str = "") -> None:
+        v = float(value)
+        if v != v:   # NaN never reaches a scraper
+            return
+        # shortest round-trip repr, never '%g': a 7-digit request counter
+        # must not be exposed as 1.23457e+06 (rate()/increase() over
+        # scrapes would drift from truth)
+        text = str(int(v)) if v == int(v) and abs(v) < 1e15 else repr(v)
+        lines.append(f"{name}{labels} {text}")
+
+    for name, value in sorted((snapshot.get("counters") or {}).items()):
+        prom = _prom_name(name, prefix)
+        lines.append(f"# TYPE {prom} counter")
+        sample(prom, value)
+    for name, value in sorted((snapshot.get("gauges") or {}).items()):
+        prom = _prom_name(name, prefix)
+        lines.append(f"# TYPE {prom} gauge")
+        sample(prom, value)
+    for name, hist in sorted((snapshot.get("histograms") or {}).items()):
+        prom = _prom_name(name, prefix)
+        lines.append(f"# TYPE {prom} summary")
+        for label, key in (("0.5", "p50"), ("0.9", "p90"), ("0.99", "p99")):
+            if key in hist:
+                sample(prom, hist[key], labels='{quantile="%s"}' % label)
+        sample(f"{prom}_sum", hist.get("sum", 0.0))
+        sample(f"{prom}_count", hist.get("count", 0))
+        for edge in ("min", "max"):
+            lines.append(f"# TYPE {prom}_{edge} gauge")
+            sample(f"{prom}_{edge}", hist.get(edge) or 0.0)
+    return "\n".join(lines) + "\n"
 
 
 def _flatten(tree: dict, prefix: str = "") -> dict:

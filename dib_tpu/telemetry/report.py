@@ -30,7 +30,7 @@ import os
 from dib_tpu.telemetry.events import read_events, resolve_events_path
 from dib_tpu.telemetry.summary import summarize
 
-__all__ = ["render_report", "write_report"]
+__all__ = ["render_index", "render_report", "write_index", "write_report"]
 
 
 # Validated default palette (dataviz reference instance): categorical slots
@@ -419,6 +419,46 @@ def _faults_section(summary: dict) -> str:
             "they provoked.</p>" + tiles + table + warn)
 
 
+def _slo_section(events) -> str:
+    """Durable SLO residue (telemetry/slo.py): alert and info-plane
+    transition events on the stream. Empty for runs with neither."""
+    alerts = [e for e in events if e.get("type") == "alert"]
+    transitions = [e for e in events if e.get("type") == "transition"]
+    if not alerts and not transitions:
+        return ""
+    parts = ["<h2>SLO alerts &amp; info-plane transitions</h2>"]
+    if alerts:
+        rows = "".join(
+            f"<tr><td>{_esc(a.get('rule', '?'))}</td>"
+            f"<td>{_esc(a.get('metric', '?'))}</td>"
+            f"<td>{_esc(a.get('value'))}</td>"
+            f"<td>{_esc(a.get('bound', '?'))} {_esc(a.get('budget'))}</td>"
+            f"<td>{_esc(a.get('severity', '?'))}</td>"
+            f"<td>{_esc(a.get('source', '?'))}</td></tr>"
+            for a in alerts)
+        parts.append(
+            '<p class="note">⚠ budgets violated (SLO.json, '
+            "<code>telemetry check</code>):</p>"
+            "<table><thead><tr><th>rule</th><th>metric</th><th>observed</th>"
+            "<th>budget</th><th>severity</th><th>source</th></tr></thead>"
+            f"<tbody>{rows}</tbody></table>")
+    if transitions:
+        rows = "".join(
+            f"<tr><td>{_esc(t.get('channel', '?'))}</td>"
+            f"<td>{_esc(t.get('epoch', '?'))}</td>"
+            f"<td>{_esc(t.get('direction', '?'))}</td>"
+            f"<td>{_esc(t.get('kl_before'))} → {_esc(t.get('kl_after'))}</td>"
+            f"<td>{_esc(t.get('beta', '—'))}</td></tr>"
+            for t in transitions)
+        parts.append(
+            '<p class="note">Per-channel KL threshold crossings — the '
+            "info-plane transitions the β-grid refinement targets:</p>"
+            "<table><thead><tr><th>channel</th><th>epoch</th>"
+            "<th>direction</th><th>KL (nats)</th><th>β</th></tr></thead>"
+            f"<tbody>{rows}</tbody></table>")
+    return "".join(parts)
+
+
 def render_report(path: str, run_id: str | None = None,
                   process_index: int | None = None) -> str:
     """The report HTML for one events.jsonl (or its run dir)."""
@@ -529,6 +569,7 @@ via <code>jax.profiler.TraceAnnotation</code>.</p>
 <h2>Roofline utilization</h2>
 {_utilization_section(summary)}
 {_faults_section(summary)}
+{_slo_section(events)}
 <details><summary>Full summary record (table view)</summary>
 <pre>{summary_json}</pre></details>
 </body></html>
@@ -545,6 +586,142 @@ def write_report(path: str, out: str | None = None,
     if out is None:
         out = os.path.join(
             os.path.dirname(resolve_events_path(path)), "report.html")
+    with open(out, "w") as f:
+        f.write(html_text)
+    return out
+
+
+# ------------------------------------------------------------- fleet index
+def render_index(runs_root: str, out_dir: str | None = None) -> str:
+    """The multi-run index page for a runs root (``telemetry report
+    --index``): one row per registered run linking its per-run report,
+    plus the bench perf trajectory as table + SVG chart. Same
+    self-contained HTML contract as the per-run report."""
+    from dib_tpu.telemetry.registry import RunRegistry
+
+    registry = RunRegistry(runs_root)
+    out_dir = out_dir or runs_root
+    latest = registry.latest()
+    bench = registry.bench_history()
+
+    rows = []
+    for run_id, entry in sorted(latest.items(),
+                                key=lambda kv: kv[1].get("t", 0.0)):
+        metrics = entry.get("metrics") or {}
+        prov = entry.get("provenance") or {}
+        run_dir = entry.get("run_dir") or ""
+        report_path = os.path.join(run_dir, "report.html")
+        # link relative to where the index page lands, when expressible
+        try:
+            href = os.path.relpath(report_path, out_dir)
+        except ValueError:   # different drive (windows): absolute
+            href = report_path
+        name = (f'<a href="{_esc(href)}">{_esc(run_id)}</a>'
+                if run_dir and os.path.exists(report_path)
+                else _esc(run_id))
+        alerts = metrics.get("alerts", 0)
+        rows.append(
+            "<tr>"
+            f"<td>{name}</td>"
+            f"<td>{_esc(entry.get('status', '?'))}</td>"
+            f"<td>{_esc(prov.get('device_kind', '—'))}</td>"
+            f"<td>{_esc(_num(metrics.get('steps_per_s')))}</td>"
+            f"<td>{_esc(_num(metrics.get('mfu')))}</td>"
+            f"<td>{_esc(_num(metrics.get('final_val_loss')))}</td>"
+            f"<td>{_esc(_num(metrics.get('serving_p99_ms')))}</td>"
+            f"<td>{'⚠ ' if alerts else ''}{alerts or '—'}</td>"
+            f"<td>{_esc(_num(metrics.get('mitigations_total', 0)))}</td>"
+            "</tr>")
+    runs_table = (
+        "<table><thead><tr><th>run</th><th>status</th><th>device</th>"
+        "<th>steps/s</th><th>MFU</th><th>val loss</th><th>serve p99 ms</th>"
+        "<th>alerts</th><th>mitigations</th></tr></thead>"
+        f"<tbody>{''.join(rows)}</tbody></table>"
+        if rows else
+        '<p class="note">No runs registered yet — runs register at '
+        "run_end when a runs root is configured (<code>--runs-root</code> "
+        "/ <code>DIB_RUNS_ROOT</code>; docs/observability.md).</p>")
+
+    trajectory_html = ('<p class="note">No bench entries yet — '
+                       "<code>bench.py</code> appends every invocation's "
+                       "headline numbers here.</p>")
+    if bench:
+        # the minutes chart is the north-star projection only — other
+        # bench kinds (serve req/s) carry different units and would
+        # scramble the axis
+        minutes = [(i, e.get("value")) for i, e in enumerate(bench)
+                   if isinstance(e.get("value"), (int, float))
+                   and e.get("unit") == "minutes"]
+        steps = [(i, e.get("steps_per_s")) for i, e in enumerate(bench)
+                 if isinstance(e.get("steps_per_s"), (int, float))]
+        mfu = [(i, e.get("mfu") * 100) for i, e in enumerate(bench)
+               if isinstance(e.get("mfu"), (int, float))]
+        charts = [c for c in (
+            _line_chart("Projected north-star sweep (minutes)",
+                        [("minutes", "--series-1", minutes)],
+                        x_label="bench #"),
+            _line_chart("Sweep throughput (steps/s)",
+                        [("steps/s", "--series-2", steps)],
+                        x_label="bench #"),
+            _line_chart("MFU (%)", [("mfu %", "--series-3", mfu)],
+                        x_label="bench #"),
+        ) if c]
+        bench_rows = "".join(
+            "<tr>"
+            f"<td>{i}</td>"
+            f"<td>{_esc(e.get('measured_at', '—'))}</td>"
+            f"<td>{_esc(_num(e.get('value')))}</td>"
+            f"<td>{_esc(e.get('unit', '—'))}</td>"
+            f"<td>{_esc(_num(e.get('steps_per_s')))}</td>"
+            f"<td>{_esc(_num(e.get('mfu')))}</td>"
+            f"<td>{_esc(_num(e.get('vs_baseline')))}</td>"
+            f"<td>{_esc(e.get('device_kind', '—'))}"
+            f"{' [degraded]' if e.get('degraded') else ''}</td></tr>"
+            for i, e in enumerate(bench))
+        trajectory_html = (
+            f'<div class="charts">{"".join(charts)}</div>'
+            "<table><thead><tr><th>#</th><th>measured at</th><th>value</th>"
+            "<th>unit</th><th>steps/s</th><th>MFU</th><th>vs baseline</th>"
+            "<th>device</th></tr></thead>"
+            f"<tbody>{bench_rows}</tbody></table>")
+
+    return f"""<!DOCTYPE html>
+<html lang="en"><head><meta charset="utf-8">
+<meta name="viewport" content="width=device-width, initial-scale=1">
+<title>dib-tpu fleet index — {_esc(runs_root)}</title>
+<style>{_CSS}</style></head>
+<body>
+<h1>dib-tpu fleet index</h1>
+<p class="sub">runs root <code>{_esc(os.path.abspath(runs_root))}</code>
+ · {len(latest)} run(s) · {len(bench)} bench point(s)</p>
+<h2>Runs</h2>
+<p class="note">Latest registry entry per run
+(<code>{_esc(os.path.join(runs_root, 'index.jsonl'))}</code>, append-only);
+run names link to each run's per-run report where one has been
+rendered.</p>
+{runs_table}
+<h2>Performance trajectory</h2>
+<p class="note">Every <code>bench.py</code> invocation's headline numbers,
+oldest first — the cross-run record the MFU and serving campaigns gate
+against (<code>telemetry runs trajectory</code> is the terminal view).</p>
+{trajectory_html}
+</body></html>
+"""
+
+
+def _num(v) -> str:
+    if v is None:
+        return "—"
+    if isinstance(v, float):
+        return f"{v:.4g}"
+    return str(v)
+
+
+def write_index(runs_root: str, out: str | None = None) -> str:
+    """Render and write the fleet index page (default:
+    ``<runs_root>/index.html``)."""
+    out = out or os.path.join(runs_root, "index.html")
+    html_text = render_index(runs_root, out_dir=os.path.dirname(out) or ".")
     with open(out, "w") as f:
         f.write(html_text)
     return out

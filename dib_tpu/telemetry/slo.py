@@ -1,0 +1,467 @@
+"""Declarative SLOs over run telemetry: budgets in, durable alerts out.
+
+``compare`` (telemetry/summary.py) gates a candidate run against a
+BASELINE run. This module gates a run against ABSOLUTE budgets — a
+committed ``SLO.json`` of declarative rules — the same exit-code shape,
+usable both terminally and live:
+
+    python -m dib_tpu telemetry check <run-dir> [--slo SLO.json]  # rc 1 on violation
+    python -m dib_tpu telemetry tail  <run-dir> --slo SLO.json    # live evaluation
+
+Rule grammar (``SLO.json``)::
+
+    {
+      "slo_version": 1,
+      "rules": [
+        {"name": "north_star_mfu_floor",       # unique id, rides the alert
+         "metric": "mfu",                      # dotted path into the run summary
+         "min": 0.05,                          # exactly one of min / max
+         "when": {"device_platform": "tpu"},   # optional applicability guard
+         "severity": "warn",                   # free-form label, default "page"
+         "description": "..."}
+      ],
+      "transitions": {"kl_threshold_nats": 0.05}
+    }
+
+Semantics:
+
+- ``metric`` resolves dotted paths against the ``summarize`` record
+  (``serving.request_p99_ms``, ``heartbeats.max_gap_s``, ...). Numeric
+  lists resolve to their MEAN (a sweep's per-replica finals), non-numeric
+  lists to their LENGTH (``faults.undetected`` — "zero undetected faults"
+  is ``max: 0``). A rule whose metric is absent is **skipped**, not
+  violated (a training rule must not fire on a serving stream); pass
+  ``"required": true`` to make absence itself a violation.
+- ``when`` guards applicability: every key (dotted, same resolution) must
+  equal the given value (or be IN it, when a list) for the rule to apply.
+- **Transitions** are detections, not violations: a channel's per-feature
+  KL crossing ``kl_threshold_nats`` between chunk boundaries is an
+  info-plane transition — the β-grid refinement signal the scheduler
+  roadmap item needs — emitted as a durable ``transition`` event.
+
+Durability: violations are appended to the run's OWN events.jsonl as
+``alert`` events (one per rule per run — re-checking is idempotent), so a
+budget violated at 3am outlives the tail session that spotted it and
+shows up in ``summarize``/``report`` forever after.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+
+from dib_tpu.telemetry.events import EventWriter, read_events
+
+__all__ = ["SLOEngine", "TransitionTracker", "check_run",
+           "detect_transitions", "evaluate_rules", "load_slo",
+           "resolve_metric", "validate_slo"]
+
+DEFAULT_SLO_PATH = "SLO.json"
+SLO_VERSION = 1
+
+
+# ------------------------------------------------------------------ rules
+def load_slo(path: str) -> dict:
+    """Parse and validate an SLO file; raises ``ValueError`` on a shape
+    problem (naming the offending rule) so a typo'd budget fails the CI
+    gate loudly instead of silently never firing."""
+    with open(path) as f:
+        spec = json.load(f)
+    problems = validate_slo(spec)
+    if problems:
+        raise ValueError(f"{path}: " + "; ".join(problems))
+    return spec
+
+
+def validate_slo(spec) -> list[str]:
+    """Schema problems for a parsed SLO spec (empty list = valid). Shared
+    with ``scripts/check_run_artifacts.py`` so the committed SLO.json is
+    validated in CI with the same rules the loader enforces."""
+    problems: list[str] = []
+    if not isinstance(spec, dict):
+        return ["top level must be an object"]
+    rules = spec.get("rules")
+    if not isinstance(rules, list) or not rules:
+        problems.append("'rules' must be a non-empty list")
+        rules = []
+    seen: set[str] = set()
+    for i, rule in enumerate(rules):
+        label = f"rules[{i}]"
+        if not isinstance(rule, dict):
+            problems.append(f"{label} must be an object")
+            continue
+        name = rule.get("name")
+        if not (isinstance(name, str) and name):
+            problems.append(f"{label}: 'name' must be a non-empty string")
+        elif name in seen:
+            problems.append(f"{label}: duplicate rule name {name!r}")
+        else:
+            seen.add(name)
+            label = f"rule {name!r}"
+        if not (isinstance(rule.get("metric"), str) and rule["metric"]):
+            problems.append(f"{label}: 'metric' must be a non-empty string")
+        bounds = [k for k in ("min", "max") if k in rule]
+        if len(bounds) != 1:
+            problems.append(f"{label}: exactly one of 'min'/'max' required")
+        for k in bounds:
+            v = rule[k]
+            if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                    or not math.isfinite(v):
+                problems.append(f"{label}: {k!r} must be a finite number")
+        when = rule.get("when")
+        if when is not None and not isinstance(when, dict):
+            problems.append(f"{label}: 'when' must be an object")
+    transitions = spec.get("transitions")
+    if transitions is not None:
+        thr = (transitions or {}).get("kl_threshold_nats") \
+            if isinstance(transitions, dict) else None
+        if not isinstance(transitions, dict) or not isinstance(
+                thr, (int, float)) or isinstance(thr, bool) or thr <= 0:
+            problems.append("'transitions' must be an object with a "
+                            "positive 'kl_threshold_nats'")
+    return problems
+
+
+def resolve_metric(summary: dict, dotted: str):
+    """Resolve a dotted path in a summary record to a gateable number.
+
+    Numbers pass through (bools don't); "NaN"/"Infinity" string spellings
+    parse back to floats; numeric lists resolve to their mean; other
+    lists to their length. Missing path / unusable value -> None.
+    """
+    node = summary
+    for part in dotted.split("."):
+        if not isinstance(node, dict) or part not in node:
+            return None
+        node = node[part]
+    return _scalarize(node)
+
+
+def _scalarize(v):
+    if isinstance(v, bool) or v is None:
+        return None
+    if isinstance(v, str):
+        try:
+            return float(v)
+        except ValueError:
+            return None
+    if isinstance(v, (int, float)):
+        return float(v)
+    if isinstance(v, (list, tuple)):
+        nums = [_scalarize(x) for x in v]
+        if v and all(isinstance(x, (int, float)) and not isinstance(x, bool)
+                     for x in v):
+            return sum(nums) / len(nums)
+        return float(len(v))
+    return None
+
+
+def _when_applies(rule: dict, summary: dict) -> bool:
+    for key, want in (rule.get("when") or {}).items():
+        node = summary
+        for part in key.split("."):
+            if not isinstance(node, dict) or part not in node:
+                return False
+            node = node[part]
+        if isinstance(want, list):
+            if node not in want:
+                return False
+        elif node != want:
+            return False
+    return True
+
+
+def evaluate_rules(rules, summary: dict) -> list[dict]:
+    """One row per rule: ``{"rule", "metric", "value", "bound", "budget",
+    "status": "ok"|"violated"|"skipped", ...}``. Skipped rows carry the
+    reason (guard unmatched / metric absent)."""
+    rows = []
+    for rule in rules:
+        bound = "min" if "min" in rule else "max"
+        row = {
+            "rule": rule.get("name", "?"),
+            "metric": rule.get("metric", "?"),
+            "bound": bound,
+            "budget": rule.get(bound),
+            "severity": rule.get("severity", "page"),
+        }
+        if not _when_applies(rule, summary):
+            row.update(status="skipped", reason="when-guard unmatched")
+            rows.append(row)
+            continue
+        value = resolve_metric(summary, rule.get("metric", ""))
+        row["value"] = value
+        if value is None or not math.isfinite(value):
+            if rule.get("required"):
+                row.update(status="violated",
+                           reason="required metric absent/non-finite")
+            else:
+                row.update(status="skipped", reason="metric absent")
+            rows.append(row)
+            continue
+        violated = (value < rule["min"] if bound == "min"
+                    else value > rule["max"])
+        row["status"] = "violated" if violated else "ok"
+        rows.append(row)
+    return rows
+
+
+# ------------------------------------------------------------ transitions
+class TransitionTracker:
+    """Incremental per-channel KL threshold-crossing detector.
+
+    Feed ``chunk`` events in stream order; each call returns the
+    transitions that boundary revealed. Operates on the
+    ``kl_per_feature`` rows serial/boolean streams carry (sweep streams
+    carry per-replica totals — no per-channel signal, no transitions).
+    ``direction`` is ``"down"`` when the channel fell through the
+    threshold (compressed away by the annealing β) and ``"up"`` when it
+    rose through it.
+    """
+
+    def __init__(self, threshold_nats: float):
+        self.threshold_nats = float(threshold_nats)
+        self._prev: dict[int, float] = {}
+
+    def step(self, event: dict) -> list[dict]:
+        kl = event.get("kl_per_feature")
+        if not isinstance(kl, list):
+            return []
+        out = []
+        for channel, value in enumerate(kl):
+            value = _scalarize(value)
+            if value is None or not math.isfinite(value):
+                continue
+            before = self._prev.get(channel)
+            if before is not None:
+                above_then = before >= self.threshold_nats
+                above_now = value >= self.threshold_nats
+                if above_then != above_now:
+                    record = {
+                        "channel": channel,
+                        "epoch": event.get("epoch", 0),
+                        "direction": "down" if above_then else "up",
+                        "kl_before": round(before, 6),
+                        "kl_after": round(value, 6),
+                    }
+                    beta = _scalarize(event.get("beta"))
+                    if beta is not None:
+                        record["beta"] = round(beta, 6)
+                    out.append(record)
+            self._prev[channel] = value
+        return out
+
+
+def detect_transitions(chunk_events, threshold_nats: float) -> list[dict]:
+    """All info-plane transitions in an ordered chunk-event list (the
+    terminal view of :class:`TransitionTracker`)."""
+    tracker = TransitionTracker(threshold_nats)
+    out: list[dict] = []
+    for event in chunk_events:
+        out.extend(tracker.step(event))
+    return out
+
+
+# --------------------------------------------------------------- durable
+class _AlertSink:
+    """Idempotent durable writes of alert/transition events onto a run's
+    own stream. Existing events are scanned once so re-checking (CI re-
+    runs, a tail reattach) never duplicates a record."""
+
+    def __init__(self, directory: str, run_id: str | None,
+                 existing_events=()):
+        self._dir = directory
+        self.run_id = run_id
+        self._writer = None
+        self._seen_alerts = set()
+        self._seen_transitions = set()
+        for e in existing_events:
+            self.note_existing(e)
+
+    def note_existing(self, event: dict) -> None:
+        # Dedup is per RULE / per CROSSING within the stream: alerts from
+        # an earlier check/tail under a different writer id must still
+        # suppress re-writes, so the run id is not part of the key.
+        if event.get("type") == "alert":
+            self._seen_alerts.add(event.get("rule"))
+        elif event.get("type") == "transition":
+            self._seen_transitions.add(
+                (event.get("channel"), event.get("epoch"),
+                 event.get("direction")))
+
+    def _ensure_writer(self):
+        if self._writer is None:
+            self._writer = EventWriter(
+                self._dir, run_id=self.run_id, process_index=0,
+                tags={"src": "slo"},
+            )
+        return self._writer
+
+    def alert(self, row: dict, source: str) -> bool:
+        key = row["rule"]
+        if key in self._seen_alerts:
+            return False
+        self._seen_alerts.add(key)
+        self._ensure_writer().alert(
+            rule=row["rule"], metric=row["metric"], value=row.get("value"),
+            bound=row["bound"], budget=row["budget"],
+            severity=row["severity"], source=source,
+            **({"reason": row["reason"]} if row.get("reason") else {}),
+        )
+        return True
+
+    def transition(self, record: dict, threshold_nats: float,
+                   source: str) -> bool:
+        key = (record["channel"], record["epoch"], record["direction"])
+        if key in self._seen_transitions:
+            return False
+        self._seen_transitions.add(key)
+        self._ensure_writer().transition(
+            threshold_nats=threshold_nats, source=source, **record)
+        return True
+
+    def close(self) -> None:
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+
+
+# ------------------------------------------------------------------ live
+class SLOEngine:
+    """Incremental SLO evaluation for ``telemetry tail``.
+
+    ``observe(event)`` feeds stream events as the follower yields them;
+    ``flush()`` evaluates the rules against the current live view and
+    writes durable ``alert``/``transition`` events (idempotently) onto
+    the run's stream. Rules whose metrics only exist terminally
+    (``faults.undetected`` needs the full join) are evaluated against
+    whatever the live view can resolve and skipped otherwise — the
+    terminal ``telemetry check`` is the authoritative gate.
+    """
+
+    def __init__(self, spec: dict, directory: str, write: bool = True):
+        from dib_tpu.telemetry.live import LiveRunState
+
+        self.spec = spec
+        self.rules = spec.get("rules") or []
+        self.threshold_nats = (spec.get("transitions") or {}).get(
+            "kl_threshold_nats")
+        self._state = LiveRunState()
+        self._write = write
+        self._sink = _AlertSink(directory, run_id=None)
+        self._tracker = (TransitionTracker(self.threshold_nats)
+                         if self.threshold_nats else None)
+        self._pending_transitions: list[dict] = []
+        self.alerts: list[dict] = []
+        self.transitions: list[dict] = []
+
+    def observe(self, event: dict) -> None:
+        self._state.update(event)
+        self._sink.note_existing(event)   # replayed alerts never re-write
+        if self._sink.run_id is None and event.get("run"):
+            self._sink.run_id = event["run"]
+        if self._tracker is not None and event.get("type") == "chunk":
+            self._pending_transitions.extend(self._tracker.step(event))
+
+    def live_summary(self) -> dict:
+        """The live view the rules resolve against — summarize-shaped keys
+        from the rolling state."""
+        st = self._state
+        chunk = st.last_chunk() or {}
+        view: dict = {
+            "steps_per_s": st.steps_per_s,
+            # summarize's steady-state semantics (first chunk per launch
+            # excluded): None until a steady chunk landed, so a floor rule
+            # SKIPS early instead of writing a durable false alert off the
+            # compile-laden first chunk
+            "steady_steps_per_s": st.steady_steps_per_s,
+            "status": st.status,
+        }
+        for key in ("device_kind", "device_platform", "config_hash"):
+            if key in st.manifest:
+                view[key] = st.manifest[key]
+        for src, dst in (("loss", "final_loss"),
+                         ("val_loss", "final_val_loss")):
+            if chunk.get(src) is not None:
+                view[dst] = chunk[src]
+        mfu = st.mfu() or {}
+        if mfu.get("flops_frac_of_peak") is not None:
+            view["mfu"] = mfu["flops_frac_of_peak"]
+        return view
+
+    def flush(self) -> None:
+        rows = evaluate_rules(self.rules, self.live_summary())
+        for row in rows:
+            if row["status"] != "violated":
+                continue
+            if not self._write or self._sink.alert(row, source="tail"):
+                self.alerts.append(row)
+        for record in self._pending_transitions:
+            if not self._write or self._sink.transition(
+                    record, self.threshold_nats, source="tail"):
+                self.transitions.append(record)
+        self._pending_transitions = []
+
+    def close(self) -> None:
+        self._sink.close()
+
+
+# -------------------------------------------------------------- terminal
+def check_run(path: str, slo_path: str = DEFAULT_SLO_PATH, *,
+              run_id: str | None = None, process_index: int | None = None,
+              write: bool = True) -> dict:
+    """Evaluate a finished (or in-flight) run against the SLO file.
+
+    Returns a report dict: per-rule rows, detected transitions, and the
+    ``violations`` count; writes durable ``alert``/``transition`` events
+    onto the run's stream unless ``write=False`` (a clean run writes
+    NOTHING — checking a committed fixture leaves it bit-identical).
+    ``telemetry check`` exits 1 when ``violations > 0``, 2 on unusable
+    operands — the ``compare`` convention, against absolute budgets.
+    """
+    from dib_tpu.telemetry.summary import summarize
+
+    spec = load_slo(slo_path)
+    summary = summarize(path, process_index=process_index, run_id=run_id)
+    events = list(read_events(path, process_index=process_index))
+    if run_id is not None:
+        events = [e for e in events if e.get("run") == run_id]
+
+    rows = evaluate_rules(spec.get("rules") or [], summary)
+    threshold = (spec.get("transitions") or {}).get("kl_threshold_nats")
+    transitions = []
+    if threshold:
+        chunks = [e for e in events if e.get("type") == "chunk"]
+        transitions = detect_transitions(chunks, threshold)
+
+    directory = (path if os.path.isdir(path)
+                 else os.path.dirname(path) or ".")
+    # the sink's writer tags its events with the run they belong to —
+    # fall back to any event's run when the stream never saw a run_start
+    sink_run_id = run_id or summary.get("run_id") or next(
+        (e.get("run") for e in events if e.get("run")), None)
+    sink = _AlertSink(directory, run_id=sink_run_id,
+                      existing_events=events)
+    written = {"alerts": 0, "transitions": 0}
+    try:
+        for row in rows:
+            if row["status"] == "violated" and write:
+                written["alerts"] += sink.alert(row, source="check")
+        if write:
+            for record in transitions:
+                written["transitions"] += sink.transition(
+                    record, threshold, source="check")
+    finally:
+        sink.close()
+
+    violations = [r for r in rows if r["status"] == "violated"]
+    return {
+        "slo": os.path.basename(slo_path),
+        "run_id": summary.get("run_id"),
+        "rules": rows,
+        "violations": len(violations),
+        "skipped": sum(r["status"] == "skipped" for r in rows),
+        "transitions": transitions,
+        "written": written,
+    }

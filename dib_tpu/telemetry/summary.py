@@ -593,6 +593,69 @@ def summarize(path: str, process_index: int | None = None,
                 by_hook.get(h.get("name", "?"), 0.0) + (h.get("seconds") or 0.0)
             )
         summary["hook_s"] = {k: round(v, 4) for k, v in by_hook.items()}
+        # instrumentation share: host-hook wall-clock as a fraction of the
+        # run's train+hook time — the SLO overhead ceiling gates on it
+        hook_total = sum(by_hook.values())
+        if total_chunk_s > 0:
+            summary["overhead"] = {
+                "hook_s_total": round(hook_total, 4),
+                "hook_frac": round(
+                    hook_total / (total_chunk_s + hook_total), 6),
+            }
+
+    # headline MFU alias: the chunk program's roofline FLOP fraction (the
+    # SLO mfu floor and the run registry read this without digging through
+    # the per-callable utilization table)
+    util = summary.get("utilization") or {}
+    for name in ("run_chunk", "sweep_chunk"):
+        frac = (util.get(name) or {}).get("flops_frac_of_peak")
+        if frac is not None:
+            summary["mfu"] = frac
+            break
+
+    # Heartbeat coverage (docs/observability.md): the liveness signal's
+    # max silent gap, measured over the lead process's beats INCLUDING the
+    # edges (run_start -> first beat, last beat -> run_end) — a worker that
+    # died silent mid-run shows the gap even though no beat recorded it.
+    # Present only when the stream carries heartbeats (older streams gate
+    # as "not comparable", never as a fake zero-gap).
+    heartbeats = of_type("heartbeat", per_run)
+    if heartbeats:
+        stamps = [e.get("t", 0.0) for e in heartbeats]
+        for edge in of_type("run_start", per_run) + run_ends:
+            stamps.append(edge.get("t", 0.0))
+        stamps.sort()
+        max_gap = max(
+            (b - a for a, b in zip(stamps, stamps[1:])), default=0.0)
+        intervals = [e.get("interval_s") for e in heartbeats
+                     if e.get("interval_s")]
+        summary["heartbeats"] = {
+            "count": len(heartbeats),
+            "boundary_beats": sum(
+                1 for e in heartbeats if e.get("phase") == "boundary"),
+            "max_gap_s": round(max_gap, 3),
+            "interval_s": intervals[-1] if intervals else None,
+        }
+        summary["heartbeat_max_gap_s"] = round(max_gap, 3)
+
+    # SLO engine residue (telemetry/slo.py): durable alerts + info-plane
+    # transitions, counted so `compare`/dashboards see them at a glance
+    alerts = of_type("alert", events)
+    if alerts:
+        by_rule: dict[str, int] = {}
+        for a in alerts:
+            by_rule[a.get("rule", "?")] = by_rule.get(a.get("rule", "?"), 0) + 1
+        summary["alerts"] = {"count": len(alerts), "by_rule": by_rule}
+    transitions = of_type("transition", events)
+    if transitions:
+        summary["transitions"] = {
+            "count": len(transitions),
+            "channels": sorted({t.get("channel") for t in transitions
+                                if t.get("channel") is not None}),
+            "down": sum(1 for t in transitions
+                        if t.get("direction") == "down"),
+            "up": sum(1 for t in transitions if t.get("direction") == "up"),
+        }
 
     metrics_events = of_type("metrics", per_run)
     if metrics_events:
@@ -615,6 +678,10 @@ _GATES: Sequence[tuple[str, str]] = (
     ("final_loss", "up"),
     ("final_val_loss", "up"),
     ("final_mi_lower_bits_mean", "down"),
+    # silent-gap regression: the longest interval with no heartbeat grew —
+    # a run that goes dark for longer than its baseline did is a liveness
+    # regression even when throughput held (docs/observability.md)
+    ("heartbeat_max_gap_s", "up"),
 )
 
 
@@ -768,14 +835,81 @@ def telemetry_main(argv: Sequence[str]) -> int:
         "report",
         help="Render a self-contained static HTML run report (span "
              "breakdown, training trajectory, MI bounds, memory, roofline "
-             "utilization).")
-    p_rep.add_argument("path", help="Run dir or events.jsonl path.")
+             "utilization) — or, with --index, the multi-run fleet index "
+             "page with the perf trajectory.")
+    p_rep.add_argument("path", nargs="?", default=None,
+                       help="Run dir or events.jsonl path (omit with "
+                            "--index).")
     p_rep.add_argument("--out", default=None,
                        help="Output HTML path (default: report.html next to "
-                            "the events file).")
+                            "the events file; index.html under the runs "
+                            "root with --index).")
     p_rep.add_argument("--process-index", type=int, default=None)
     p_rep.add_argument("--run-id", default=None,
                        help="Restrict to one run's events.")
+    p_rep.add_argument("--index", action="store_true",
+                       help="Render the fleet index page from the run "
+                            "registry instead of one run's report.")
+    p_rep.add_argument("--runs-root", "--runs_root", dest="runs_root",
+                       default=None,
+                       help="Runs root for --index (default: DIB_RUNS_ROOT "
+                            "or ./runs).")
+    p_tail = sub.add_parser(
+        "tail",
+        help="Follow a (growing) events.jsonl and render a live terminal "
+             "dashboard: steps/s, loss, per-channel KL, live MFU vs the "
+             "backend peak, span hotspots, mitigation/alert ticker, "
+             "heartbeat liveness (docs/observability.md).")
+    p_tail.add_argument("path", help="Run dir or events.jsonl path (may "
+                                     "not exist yet — tail waits).")
+    p_tail.add_argument("--refresh-s", type=float, default=1.0,
+                        help="Poll/redraw period (default 1s).")
+    p_tail.add_argument("--duration-s", type=float, default=None,
+                        help="Detach after this many seconds (default: "
+                             "until the run ends).")
+    p_tail.add_argument("--follow-after-end", action="store_true",
+                        help="Keep following after a run_end (supervised "
+                             "runs relaunch onto the same stream).")
+    p_tail.add_argument("--slo", default=None,
+                        help="Evaluate SLO rules live (path to SLO.json); "
+                             "violations/transitions are written DURABLY "
+                             "onto the run's stream.")
+    p_tail.add_argument("--once", action="store_true",
+                        help="Render one frame and exit (scripts/tests).")
+    p_tail.add_argument("--no-ansi", action="store_true",
+                        help="Append frames instead of redrawing in place.")
+    p_chk = sub.add_parser(
+        "check",
+        help="Evaluate a run against the committed SLO budgets "
+             "(SLO.json); exits 1 on violation — the compare gate shape, "
+             "against absolute budgets instead of a baseline run.")
+    p_chk.add_argument("path", help="Run dir or events.jsonl path.")
+    p_chk.add_argument("--slo", default=None,
+                       help="SLO file (default: SLO.json next to the "
+                            "package checkout, then ./SLO.json).")
+    p_chk.add_argument("--process-index", type=int, default=None)
+    p_chk.add_argument("--run-id", default=None)
+    p_chk.add_argument("--no-write", action="store_true",
+                       help="Report only; skip the durable alert/"
+                            "transition writes.")
+    p_chk.add_argument("--indent", action="store_true")
+    p_runs = sub.add_parser(
+        "runs",
+        help="Query the fleet run registry (append-only "
+             "<runs-root>/index.jsonl; docs/observability.md).")
+    runs_sub = p_runs.add_subparsers(dest="runs_action", required=True)
+    p_list = runs_sub.add_parser("list", help="Latest entry per run.")
+    p_show = runs_sub.add_parser("show", help="One run's full entry.")
+    p_show.add_argument("run_id")
+    p_show.add_argument("--full-history", action="store_true",
+                        help="Every index line for the run, not just the "
+                             "latest.")
+    p_traj = runs_sub.add_parser(
+        "trajectory", help="The bench perf trajectory, oldest first.")
+    for p in (p_list, p_show, p_traj):
+        p.add_argument("--runs-root", "--runs_root", dest="runs_root",
+                       default=None,
+                       help="Runs root (default: DIB_RUNS_ROOT or ./runs).")
     args = parser.parse_args(argv)
 
     try:
@@ -785,13 +919,35 @@ def telemetry_main(argv: Sequence[str]) -> int:
             print(json.dumps(record, indent=1 if args.indent else None))
             return 0
         if args.action == "report":
-            from dib_tpu.telemetry.report import write_report
+            from dib_tpu.telemetry.report import write_index, write_report
 
+            if args.index:
+                from dib_tpu.telemetry.registry import resolve_runs_root
+
+                root = resolve_runs_root(args.runs_root)
+                if not root:
+                    print("telemetry report --index: no runs root",
+                          file=sys.stderr)
+                    return 2
+                print(write_index(root, out=args.out))
+                return 0
+            if not args.path:
+                print("telemetry report: a run dir/events path is required "
+                      "(or pass --index)", file=sys.stderr)
+                return 2
             out = write_report(args.path, out=args.out,
                                process_index=args.process_index,
                                run_id=args.run_id)
             print(out)
             return 0
+        if args.action == "tail":
+            return _tail_main(args)
+        if args.action == "check":
+            return _check_main(args)
+        if args.action == "runs":
+            from dib_tpu.telemetry.registry import runs_main
+
+            return runs_main(args)
         a = _load_side(args.baseline, args.process_index,
                        run_id=args.run_id_a)
         b = _load_side(args.candidate, args.process_index,
@@ -807,3 +963,61 @@ def telemetry_main(argv: Sequence[str]) -> int:
         print("telemetry compare: REGRESSION beyond threshold "
               f"{args.threshold}", file=sys.stderr)
     return 1 if regressed else 0
+
+
+def _default_slo_path() -> str:
+    """The committed SLO.json: next to the package checkout first (the
+    repo root), falling back to the working directory."""
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    candidate = os.path.join(here, "SLO.json")
+    return candidate if os.path.exists(candidate) else "SLO.json"
+
+
+def _tail_main(args) -> int:
+    from dib_tpu.telemetry.live import tail
+
+    engine = None
+    if args.slo:
+        from dib_tpu.telemetry.slo import SLOEngine, load_slo
+
+        directory = (args.path if os.path.isdir(args.path)
+                     else os.path.dirname(args.path) or ".")
+        engine = SLOEngine(load_slo(args.slo), directory)
+    try:
+        state = tail(
+            args.path, slo=engine, refresh_s=args.refresh_s,
+            duration_s=args.duration_s,
+            follow_after_end=args.follow_after_end,
+            ansi=False if args.no_ansi else None,
+            max_frames=1 if args.once else None,
+        )
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        if engine is not None:
+            engine.close()
+    if engine is not None and engine.alerts:
+        print(f"telemetry tail: {len(engine.alerts)} SLO alert(s) written",
+              file=sys.stderr)
+        return 1
+    return 0 if state.status in ("ok", "waiting", "running") else 1
+
+
+def _check_main(args) -> int:
+    from dib_tpu.telemetry.slo import check_run
+
+    slo_path = args.slo or _default_slo_path()
+    try:
+        report = check_run(args.path, slo_path, run_id=args.run_id,
+                           process_index=args.process_index,
+                           write=not args.no_write)
+    except FileNotFoundError as exc:
+        print(f"telemetry check: {exc}", file=sys.stderr)
+        return 2
+    print(json.dumps(report, indent=1 if args.indent else None))
+    if report["violations"]:
+        print(f"telemetry check: {report['violations']} SLO violation(s) "
+              f"against {slo_path}", file=sys.stderr)
+        return 1
+    return 0

@@ -401,7 +401,11 @@ class DIBTrainer:
         assert_same_chunk(self._telemetry_run_id, cursor, telemetry=telemetry)
         # The active tracer is bound for the whole fit so hook-level spans
         # (SpannedHook, PerReplicaHook) parent into this run's hierarchy.
-        with trace.use_tracer(recorder.tracer):
+        # heartbeats(): bounded-interval liveness beats on the event stream
+        # — boundary beats at every chunk plus mid-chunk beats from a
+        # daemon thread, so `telemetry tail` and the watchdog can tell a
+        # long chunk from a hung run (docs/observability.md).
+        with trace.use_tracer(recorder.tracer), recorder.heartbeats():
             while done < num_epochs:
                 if preempt is not None and preempt.requested:
                     from dib_tpu.train.preempt import (

@@ -125,6 +125,62 @@ def _read_heartbeat(path: str) -> dict | None:
         return None
 
 
+class _EventStreamBeats:
+    """Heartbeat probe over the run's events.jsonl (docs/observability.md).
+
+    Where a telemetry dir is configured the supervisor consumes the
+    stream's ``heartbeat`` events instead of the side-channel JSON file,
+    so "stalled" means the same thing here as in ``telemetry tail``:
+    BOUNDARY beats (emitted after blocking on the chunk's outputs, with
+    trailing inter-boundary ``intervals_s``) drive the same trailing-
+    median stall timeout as the file probe; mid-chunk beats are surfaced
+    as ``worker_alive_s`` so a stall-kill record can say whether the
+    process was still breathing when the device stopped progressing.
+    """
+
+    def __init__(self, events_path: str):
+        from dib_tpu.telemetry.live import StreamFollower
+
+        self._follower = StreamFollower(events_path)
+        self._boundary: dict | None = None
+        self._last_any_beat_t: float | None = None
+
+    def read(self, min_t: float = 0.0) -> dict | None:
+        """The latest boundary beat with ``t >= min_t`` seen so far, as
+        the probe dict the supervise loop expects (``epoch`` / ``beat`` /
+        ``time`` / ``intervals_s``). ``min_t`` (the launch time) keeps a
+        RELAUNCH from crediting the killed worker's final beats — the
+        fresh worker must earn its own first beat within the first-beat
+        timeout, exactly like the file probe after its unlink."""
+        for event in self._follower.poll():
+            if event.get("type") != "heartbeat":
+                continue
+            if event.get("t", 0.0) < min_t:
+                continue
+            self._last_any_beat_t = event.get("t")
+            if event.get("phase") == "boundary":
+                self._boundary = {
+                    "epoch": event.get("epoch"),
+                    "beat": event.get("beat"),
+                    "time": event.get("t"),
+                    "intervals_s": event.get("intervals_s") or [],
+                }
+        return self._boundary
+
+    def worker_alive_s(self) -> float | None:
+        """Seconds since ANY beat (mid-chunk included) — the process-
+        liveness clock, for kill forensics."""
+        if self._last_any_beat_t is None:
+            return None
+        return time.time() - self._last_any_beat_t
+
+    def reset(self) -> None:
+        """Per-relaunch reset: drop the dead worker's beats (the stream
+        keeps growing — only the follower's rollup state resets)."""
+        self._boundary = None
+        self._last_any_beat_t = None
+
+
 def _steady_timeout(intervals: Sequence[float], cfg: WatchdogConfig) -> float:
     steady = list(intervals[1:])
     if not steady:
@@ -141,6 +197,7 @@ def supervise(
     env: dict | None = None,
     log=lambda msg: print(msg, file=sys.stderr, flush=True),
     telemetry=None,
+    events_path: str | None = None,
 ) -> dict:
     """Run ``cmd`` under stall/crash supervision until it exits 0.
 
@@ -152,6 +209,15 @@ def supervise(
     events.jsonl the worker writes — O_APPEND keeps the two writers from
     interleaving) mirrors every mitigation onto the event stream as it
     happens, so a run killed mid-flight still carries its kill record.
+
+    ``events_path`` (the worker's events.jsonl) switches LIVENESS to the
+    stream's ``heartbeat`` events instead of the side-channel JSON file:
+    boundary beats carry the same trailing intervals the file probe did,
+    so the stall timeout math — and therefore what "stalled" MEANS — is
+    identical in the supervisor, ``telemetry tail``, and the drills
+    (docs/observability.md). Mid-chunk beats additionally let a
+    stall-kill record say whether the worker process was still alive
+    (``worker_alive_s``) when device progress stopped.
 
     Returns a report dict: ``{"returncode", "wall_s", "launches",
     "mitigations": [{"type":
@@ -205,7 +271,8 @@ def supervise(
             pass
     try:
         return _supervise_loop(cmd, heartbeat_path, cfg, env, log,
-                               mitigations, t_start, current)
+                               mitigations, t_start, current,
+                               events_path=events_path)
     finally:
         for sig, handler in prev_handlers.items():
             signal.signal(sig, handler)
@@ -229,6 +296,7 @@ def supervise_self(
     checkpoint_dir: str = "",
     config: WatchdogConfig | None = None,
     telemetry=None,
+    events_path: str | None = None,
 ) -> dict:
     """Re-exec the CURRENT command as a supervised worker.
 
@@ -248,23 +316,28 @@ def supervise_self(
         if flag not in worker:
             worker += [flag, value]
     result = supervise(list(worker_prefix) + worker, heartbeat, config,
-                       telemetry=telemetry)
+                       telemetry=telemetry, events_path=events_path)
     result["heartbeat"] = heartbeat
     result["checkpoint_dir"] = checkpoint_dir
     return result
 
 
 def _supervise_loop(cmd, heartbeat_path, cfg, env, log, mitigations,
-                    t_start, current) -> dict:
+                    t_start, current, events_path=None) -> dict:
     launches = 0
     quick_failures = 0
     free_relaunches = 0   # cooperative preemptions: not crash-budget burn
     prev_preempt_epoch = None   # progress gate between consecutive preempts
+    # stream-based liveness (docs/observability.md): one incremental
+    # follower across relaunches — the workers all append to one stream
+    events_beats = (_EventStreamBeats(events_path) if events_path else None)
     while True:
         # a stale beat from the previous attempt must not mask a wedged
         # relaunch
         if os.path.exists(heartbeat_path):
             os.unlink(heartbeat_path)
+        if events_beats is not None:
+            events_beats.reset()
         launches += 1
         proc = subprocess.Popen(list(cmd), env=env, start_new_session=True)
         current[0] = proc
@@ -274,7 +347,10 @@ def _supervise_loop(cmd, heartbeat_path, cfg, env, log, mitigations,
         killed = False
         while True:
             rc = proc.poll()
-            beat = _read_heartbeat(heartbeat_path)
+            if events_beats is not None:
+                beat = events_beats.read(min_t=launched)
+            else:
+                beat = _read_heartbeat(heartbeat_path)
             if beat is not None and (
                 last_beat is None or beat["time"] != last_beat["time"]
             ):
@@ -294,7 +370,7 @@ def _supervise_loop(cmd, heartbeat_path, cfg, env, log, mitigations,
                 except ProcessLookupError:
                     pass
                 proc.wait()
-                mitigations.append({
+                record = {
                     "type": "stall_kill",
                     "launch": launches,
                     "epoch": last_beat["epoch"] if last_beat else None,
@@ -302,7 +378,15 @@ def _supervise_loop(cmd, heartbeat_path, cfg, env, log, mitigations,
                     "waited_s": round(waited, 1),
                     "timeout_s": round(timeout, 1),
                     "at_s": round(time.time() - t_start, 1),
-                })
+                }
+                if events_beats is not None:
+                    alive = events_beats.worker_alive_s()
+                    if alive is not None:
+                        # device stall vs process wedge: mid-chunk beats
+                        # kept landing iff the PROCESS was alive when
+                        # boundary progress stopped
+                        record["worker_alive_s"] = round(alive, 1)
+                mitigations.append(record)
                 log(f"watchdog: no heartbeat for {waited:.0f}s "
                     f"(timeout {timeout:.0f}s) — killed pid {proc.pid}, "
                     f"relaunching from checkpoint")

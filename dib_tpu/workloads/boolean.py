@@ -224,6 +224,23 @@ class BooleanTrainer:
         recorder = FitRecorder(telemetry, steps_per_epoch=1)
         series = {"task": [], "kl": [], "beta": []}
         checks = {"step": [], "beta": [], "lower_bits": [], "upper_bits": []}
+        # heartbeats(): boundary + mid-chunk liveness beats for live
+        # readers (`telemetry tail`, the watchdog) — docs/observability.md
+        with recorder.heartbeats():
+            state, series, checks = self._fit_loop(
+                key, state, recorder, telemetry, series, checks)
+        recorder.finish()
+        history = {name: np.concatenate(vals) for name, vals in series.items()}
+        history["mi_steps"] = np.asarray(checks["step"])
+        history["mi_betas"] = np.asarray(checks["beta"])
+        history["mi_lower_bits"] = np.stack(checks["lower_bits"])   # [C, F]
+        history["mi_upper_bits"] = np.stack(checks["upper_bits"])
+        return state, history
+
+    def _fit_loop(self, key, state, recorder, telemetry, series, checks):
+        """The chunked measurement loop of :meth:`fit` (factored so the
+        heartbeat context wraps exactly the in-flight portion)."""
+        cfg = self.config
         first = True
         while int(state.step) < cfg.num_steps:
             chunk = min(cfg.mi_cadence, cfg.num_steps - int(state.step))
@@ -264,13 +281,7 @@ class BooleanTrainer:
                     lower_bits=[float(x) for x in checks["lower_bits"][-1]],
                     upper_bits=[float(x) for x in checks["upper_bits"][-1]],
                 )
-        recorder.finish()
-        history = {name: np.concatenate(vals) for name, vals in series.items()}
-        history["mi_steps"] = np.asarray(checks["step"])
-        history["mi_betas"] = np.asarray(checks["beta"])
-        history["mi_lower_bits"] = np.stack(checks["lower_bits"])   # [C, F]
-        history["mi_upper_bits"] = np.stack(checks["upper_bits"])
-        return state, history
+        return state, series, checks
 
 
 # --------------------------------------------------------------------------

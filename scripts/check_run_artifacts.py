@@ -14,6 +14,15 @@ machine-readable forever. Two record shapes are legal:
   - **driver captures** (``{"n", "cmd", "rc", "tail"}``): the round
     driver's raw command transcript; typed fields only.
 
+Also validated, with their own schemas:
+
+  - ``SLO.json`` — the committed SLO rule set (``telemetry check``'s
+    budgets), via ``dib_tpu.telemetry.slo.validate_slo`` — the SAME
+    validation the loader enforces, so a rule that would fail to load
+    fails CI first;
+  - ``runs/index.jsonl`` — the committed fleet run registry seed, one
+    entry per line via ``dib_tpu.telemetry.registry.validate_index_entry``.
+
 Strict JSON: ``NaN``/``Infinity`` constants (which ``json.dump`` happily
 emits and nothing else can parse) are rejected.
 
@@ -177,12 +186,58 @@ def check_file(path: str) -> list[str]:
     return problems
 
 
+def check_slo_file(path: str) -> list[str]:
+    """Schema violations for an SLO.json (telemetry/slo.py grammar)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(path)))
+    from dib_tpu.telemetry.slo import validate_slo
+
+    try:
+        with open(path) as f:
+            spec = json.load(f, parse_constant=_reject_constant)
+    except (OSError, ValueError) as exc:
+        return [f"unreadable/invalid JSON: {exc}"]
+    return validate_slo(spec)
+
+
+def check_runs_index(path: str) -> list[str]:
+    """Schema violations for a runs/index.jsonl (registry entry shape)."""
+    from dib_tpu.telemetry.registry import validate_index_entry
+
+    problems: list[str] = []
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+    except OSError as exc:
+        return [f"unreadable: {exc}"]
+    lines = [ln for ln in raw.split(b"\n") if ln.strip()]
+    if not lines:
+        return ["index is empty (expected at least the seeded bench "
+                "history)"]
+    for i, line in enumerate(lines):
+        try:
+            entry = json.loads(line, parse_constant=_reject_constant)
+        except ValueError as exc:
+            problems.append(f"line {i + 1}: invalid JSON: {exc}")
+            continue
+        for prob in validate_index_entry(entry):
+            problems.append(f"line {i + 1}: {prob}")
+    return problems
+
+
 def check_all(repo: str = REPO) -> dict[str, list[str]]:
     """{relative path: problems} for every committed run artifact."""
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
     results: dict[str, list[str]] = {}
     for pattern in ARTIFACT_GLOBS:
         for path in sorted(glob.glob(os.path.join(repo, pattern))):
             results[os.path.relpath(path, repo)] = check_file(path)
+    slo = os.path.join(repo, "SLO.json")
+    if os.path.exists(slo):
+        results["SLO.json"] = check_slo_file(slo)
+    index = os.path.join(repo, "runs", "index.jsonl")
+    if os.path.exists(index):
+        results[os.path.join("runs", "index.jsonl")] = check_runs_index(index)
     return results
 
 

@@ -47,6 +47,9 @@ ALLOWLIST: dict[str, str] = {
                       "device results internally",
     "train/watchdog.py": "supervisor process: times subprocess beats, "
                          "never dispatches jitted work",
+    "telemetry/live.py": "host-side stream follower/dashboard: staleness "
+                         "vs event wall-clock stamps, no jitted work",
+    "telemetry/registry.py": "host-side registry timestamps, no intervals",
 }
 
 _PATTERN = re.compile(r"\btime\.(?:time|perf_counter)\(\)")

@@ -81,6 +81,9 @@ def watchdog_main(args) -> int:
         checkpoint_dir=args.checkpoint_dir,
         config=cfg,
         telemetry=telemetry,
+        # stream-based liveness (docs/observability.md): "stalled" means
+        # the same thing here, in `telemetry tail`, and in the drills
+        events_path=telemetry.path if telemetry is not None else None,
     )
     telemetry.close()
     total_s = time.time() - t0

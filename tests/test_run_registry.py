@@ -1,0 +1,261 @@
+"""Fleet run registry (telemetry/registry.py): append-only index
+semantics, entry schemas, CLI (`telemetry runs list|show|trajectory`),
+the committed seed index, bench registration, end-of-run registration
+through the workload CLI, and the multi-run index report page.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+from dib_tpu.telemetry.events import EventWriter
+from dib_tpu.telemetry.registry import (
+    RunRegistry,
+    bench_entry,
+    register_run,
+    resolve_runs_root,
+    run_entry,
+    validate_index_entry,
+)
+from dib_tpu.telemetry.summary import telemetry_main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write_stream(directory, run_id="reg-run", status="ok"):
+    with EventWriter(str(directory), run_id=run_id) as w:
+        w.run_start({"device_kind": "cpu", "device_platform": "cpu",
+                     "config_hash": "cafe"})
+        w.chunk(epoch=10, steps=100, seconds=1.0, loss=2.0, val_loss=2.5,
+                kl_per_feature=[0.1, 0.2], beta=0.1)
+        w.run_end(status=status)
+
+
+# ================================================================= registry
+def test_append_latest_supersede(tmp_path):
+    registry = RunRegistry(str(tmp_path / "root"))
+    registry.append({"kind": "run", "run_id": "a", "status": "incomplete",
+                     "metrics": {}})
+    registry.append({"kind": "run", "run_id": "b", "status": "ok",
+                     "metrics": {}})
+    registry.append({"kind": "run", "run_id": "a", "status": "ok",
+                     "metrics": {"steps_per_s": 5.0}})
+    latest = registry.latest()
+    assert set(latest) == {"a", "b"}
+    # append-only supersede: the LATEST line wins, history is retained
+    assert latest["a"]["status"] == "ok"
+    assert len(registry.history("a")) == 2
+    assert registry.history("a")[0]["status"] == "incomplete"
+    # every appended line is stamped with schema version + time
+    for entry in registry.entries():
+        assert validate_index_entry(entry) == []
+
+
+def test_registry_tolerates_torn_final_line(tmp_path):
+    registry = RunRegistry(str(tmp_path))
+    registry.append({"kind": "bench", "metric": "m", "value": 1.0})
+    with open(registry.path, "a") as f:
+        f.write('{"kind": "bench", "met')   # writer killed mid-append
+    assert len(registry.entries()) == 1
+    assert len(registry.bench_history()) == 1
+
+
+def test_run_entry_headline_metrics(tmp_path):
+    _write_stream(tmp_path, status="preempted")
+    entry = run_entry(str(tmp_path))
+    assert entry["kind"] == "run"
+    assert entry["run_id"] == "reg-run"
+    assert entry["status"] == "preempted"       # incl. preempted/incomplete
+    assert entry["metrics"]["steps_per_s"] == pytest.approx(100.0)
+    assert entry["metrics"]["final_val_loss"] == 2.5
+    assert entry["provenance"]["config_hash"] == "cafe"
+    assert validate_index_entry({"v": 1, "t": 0.0, **entry}) == []
+
+
+def test_register_run_disabled_and_degraded(tmp_path, monkeypatch):
+    monkeypatch.delenv("DIB_RUNS_ROOT", raising=False)
+    # empty root disables; a missing stream degrades to a warning
+    assert register_run(str(tmp_path / "nope"), root="") is None
+    with pytest.warns(UserWarning, match="could not register"):
+        assert register_run(str(tmp_path / "nope"),
+                            root=str(tmp_path / "r")) is None
+
+
+def test_resolve_runs_root_precedence(monkeypatch):
+    monkeypatch.setenv("DIB_RUNS_ROOT", "/env/root")
+    assert resolve_runs_root(None) == "/env/root"
+    assert resolve_runs_root("/flag/root") == "/flag/root"
+    assert resolve_runs_root("") is None
+    monkeypatch.delenv("DIB_RUNS_ROOT")
+    assert resolve_runs_root(None) == "runs"    # the committed default
+
+
+def test_validate_index_entry_rejects_shapes():
+    assert validate_index_entry([]) == ["entry must be an object"]
+    assert any("kind" in p for p in validate_index_entry(
+        {"v": 1, "t": 0.0, "kind": "mystery"}))
+    assert any("run_id" in p for p in validate_index_entry(
+        {"v": 1, "t": 0.0, "kind": "run", "status": "ok", "metrics": {}}))
+    assert any("value" in p for p in validate_index_entry(
+        {"v": 1, "t": 0.0, "kind": "bench", "metric": "m"}))
+    # degraded bench entries may carry a null value — explained
+    assert validate_index_entry(
+        {"v": 1, "t": 0.0, "kind": "bench", "metric": "m",
+         "degraded": "no_device"}) == []
+
+
+def test_bench_entry_from_bench_line():
+    entry = bench_entry({
+        "metric": "amorphous_set_transformer_beta_sweep_projected",
+        "value": 6.0, "unit": "minutes", "vs_baseline": 0.6,
+        "steps_per_s": 617.0, "mfu": 0.0654, "device_kind": "TPU v5 lite",
+        "telemetry": {"run_id": "bench-run"},
+    })
+    assert entry["kind"] == "bench"
+    assert entry["run_id"] == "bench-run"
+    assert entry["mfu"] == 0.0654
+    assert validate_index_entry({"v": 1, "t": 0.0, **entry}) == []
+
+
+def test_committed_seed_index_validates_and_carries_history():
+    """The committed runs/index.jsonl seeds the perf trajectory from the
+    committed BENCH_CACHE/BENCH_SERVE_CPU measurements."""
+    registry = RunRegistry(os.path.join(REPO, "runs"))
+    entries = registry.entries()
+    assert entries, "committed runs/index.jsonl missing or empty"
+    for entry in entries:
+        assert validate_index_entry(entry) == [], entry
+    bench = registry.bench_history()
+    metrics = {e["metric"] for e in bench}
+    assert "amorphous_set_transformer_beta_sweep_projected" in metrics
+    assert "serve_cpu_loadgen" in metrics
+
+
+# ====================================================================== CLI
+def test_runs_cli_list_show_trajectory(tmp_path, capsys):
+    root = str(tmp_path / "root")
+    _write_stream(tmp_path / "run_a")
+    register_run(str(tmp_path / "run_a"), root=root)
+    RunRegistry(root).append(bench_entry({
+        "metric": "m", "value": 2.5, "unit": "minutes",
+        "steps_per_s": 700.0, "mfu": 0.08, "device_kind": "TPU v5 lite"}))
+
+    assert telemetry_main(["runs", "list", "--runs-root", root]) == 0
+    out = capsys.readouterr().out
+    assert "reg-run" in out and "ok" in out
+
+    assert telemetry_main(["runs", "show", "reg-run",
+                           "--runs-root", root]) == 0
+    shown = json.loads(capsys.readouterr().out)
+    assert shown["run_id"] == "reg-run"
+    assert shown["metrics"]["steps_per_s"] == pytest.approx(100.0)
+
+    assert telemetry_main(["runs", "show", "ghost",
+                           "--runs-root", root]) == 2
+    capsys.readouterr()
+
+    assert telemetry_main(["runs", "trajectory", "--runs-root", root]) == 0
+    out = capsys.readouterr().out
+    assert "700" in out and "0.08" in out
+
+    # empty/missing registries answer instead of crashing
+    assert telemetry_main(["runs", "list", "--runs-root",
+                           str(tmp_path / "empty")]) == 0
+    assert "no runs registered" in capsys.readouterr().out
+
+
+def test_workload_cli_registers_run_at_end(tmp_path, capsys):
+    """End-of-run registration through the real CLI surface: a boolean
+    workload run with --runs-root lands in the index with its headline
+    metrics, and `runs list` shows it."""
+    from dib_tpu.cli import workload_main
+
+    root = str(tmp_path / "fleet")
+    rc = workload_main([
+        "boolean", "--telemetry-dir", str(tmp_path / "run"),
+        "--runs-root", root,
+        "--set", "num_steps=20", "--set", "mi_every=10",
+        "--set", "integration_hidden=(32,)", "--set", "batch_size=64",
+    ])
+    capsys.readouterr()
+    assert rc == 0
+    latest = RunRegistry(root).latest()
+    assert len(latest) == 1
+    (entry,) = latest.values()
+    assert entry["status"] == "ok"
+    assert entry["metrics"]["total_steps"] == 20
+    assert entry["metrics"]["heartbeat_max_gap_s"] >= 0
+    assert entry["run_dir"] == str(tmp_path / "run")
+
+
+# ============================================================== index page
+def test_index_report_links_runs_and_charts_trajectory(tmp_path, capsys):
+    from dib_tpu.telemetry.report import write_report
+
+    root = str(tmp_path / "root")
+    run_dir = tmp_path / "run_a"
+    _write_stream(run_dir)
+    register_run(str(run_dir), root=root)
+    write_report(str(run_dir))                 # per-run report to link
+    registry = RunRegistry(root)
+    for value, steps in ((6.0, 617.0), (4.0, 900.0)):
+        registry.append(bench_entry({
+            "metric": "m", "value": value, "unit": "minutes",
+            "steps_per_s": steps, "mfu": 0.07,
+            "device_kind": "TPU v5 lite",
+            "measured_at": "2026-08-01T00:00:00Z"}))
+
+    assert telemetry_main(["report", "--index", "--runs-root", root]) == 0
+    out_path = capsys.readouterr().out.strip()
+    assert out_path == os.path.join(root, "index.html")
+    html = open(out_path).read()
+    assert html.count("<svg") >= 2              # trajectory charts
+    assert "reg-run" in html
+    assert 'href="../run_a/report.html"' in html
+    assert "617" in html and "900" in html
+    assert "Performance trajectory" in html
+    # balanced-ish document contract like the per-run report
+    assert html.startswith("<!DOCTYPE html>") and html.rstrip().endswith(
+        "</html>")
+
+
+def test_index_report_empty_root_renders_placeholders(tmp_path):
+    from dib_tpu.telemetry.report import write_index
+
+    out = write_index(str(tmp_path))
+    html = open(out).read()
+    assert "No runs registered yet" in html
+    assert "No bench entries yet" in html
+
+
+def test_report_index_cli_requires_some_operand(capsys):
+    assert telemetry_main(["report"]) == 2
+    assert "required" in capsys.readouterr().err
+
+
+def test_bench_register_helper(tmp_path, monkeypatch):
+    """bench.py's registration hook: fresh records register under the
+    default root; degraded ones only under an explicit DIB_RUNS_ROOT."""
+    sys.path.insert(0, REPO)
+    import bench
+
+    root = str(tmp_path / "r")
+    monkeypatch.setenv("DIB_RUNS_ROOT", root)
+    bench.register_bench({"metric": "m", "value": 1.0, "unit": "minutes",
+                          "steps_per_s": 10.0})
+    bench.register_bench({"metric": "m", "value": None, "unit": "minutes",
+                          "degraded": "no_device"})
+    assert len(RunRegistry(root).bench_history()) == 2
+    # unset env + degraded: never grows the committed index
+    monkeypatch.delenv("DIB_RUNS_ROOT")
+    committed = RunRegistry(os.path.join(REPO, "runs"))
+    before = len(committed.entries())
+    bench.register_bench({"metric": "m", "value": None,
+                          "degraded": "no_device"})
+    assert len(committed.entries()) == before
+    # empty env root disables entirely
+    monkeypatch.setenv("DIB_RUNS_ROOT", "")
+    bench.register_bench({"metric": "m", "value": 1.0})
+    assert len(RunRegistry(root).bench_history()) == 2

@@ -297,3 +297,84 @@ def test_engine_from_checkpoint_roundtrip(model, bundle, tmp_path):
     with pytest.raises(ValueError, match="param structure"):
         InferenceEngine.from_checkpoint(wrong_trainer, ckpt_dir,
                                         batch_buckets=(1,))
+
+
+def test_metrics_prometheus_content_negotiation(model, params, bundle,
+                                                tmp_path):
+    """/metrics content-negotiates: JSON by default, Prometheus text
+    exposition for text/plain Accept headers or ?format=prometheus —
+    with counters/gauges/summaries carrying the dib_ prefix."""
+    server, registry = _serving_stack(model, params,
+                                      run_dir=str(tmp_path / "serve"))
+    try:
+        # drive one request so real serving counters exist
+        row = np.asarray(bundle.x_valid[0], np.float32).tolist()
+        status, _ = _post(server.url + "/v1/predict", {"x": row})
+        assert status == 200
+
+        # default stays JSON (unchanged surface)
+        status, snapshot = _get(server.url + "/metrics")
+        assert status == 200
+        assert "counters" in snapshot
+
+        def fetch_text(url, accept=None):
+            request = urllib.request.Request(url)
+            if accept:
+                request.add_header("Accept", accept)
+            with urllib.request.urlopen(request, timeout=30) as resp:
+                return (resp.status, resp.headers.get("Content-Type"),
+                        resp.read().decode())
+
+        for url, accept in (
+            (server.url + "/metrics", "text/plain;version=0.0.4"),
+            (server.url + "/metrics?format=prometheus", None),
+        ):
+            status, ctype, text = fetch_text(url, accept)
+            assert status == 200
+            assert ctype.startswith("text/plain")
+            assert "# TYPE dib_serve_requests_ok counter" in text
+            assert "dib_serve_requests_ok 1" in text
+            # latency histogram maps to a summary with quantile samples
+            assert "# TYPE dib_serve_request_latency_s summary" in text
+            assert 'dib_serve_request_latency_s{quantile="0.99"}' in text
+            assert "dib_serve_request_latency_s_count 1" in text
+
+        # an Accept that prefers JSON keeps JSON even with text/* present
+        status, ctype, text = fetch_text(
+            server.url + "/metrics", "application/json, text/plain")
+        assert ctype.startswith("application/json")
+        assert json.loads(text)
+    finally:
+        server.close()
+
+
+def test_prometheus_text_renderer_shapes():
+    from dib_tpu.telemetry.metrics import prometheus_text
+
+    registry = MetricsRegistry()
+    registry.counter("serve.requests.ok").inc(3)
+    registry.gauge("queue.depth").set(2.0)
+    hist = registry.histogram("latency_s")
+    for v in (0.1, 0.2, 0.3):
+        hist.record(v)
+    text = prometheus_text(registry.snapshot())
+    assert "# TYPE dib_serve_requests_ok counter" in text
+    assert "dib_serve_requests_ok 3" in text
+    assert "dib_queue_depth 2" in text
+    assert 'dib_latency_s{quantile="0.5"} 0.2' in text
+    assert "dib_latency_s_sum 0.6" in text
+    assert "dib_latency_s_count 3" in text
+    assert "dib_latency_s_max 0.3" in text
+    assert text.endswith("\n")
+
+
+def test_prometheus_counters_keep_full_precision():
+    """Review hardening: a 7-digit counter must not be exposed in %g
+    scientific form (scraped rate()/increase() would drift)."""
+    from dib_tpu.telemetry.metrics import prometheus_text
+
+    registry = MetricsRegistry()
+    registry.counter("serve.requests.ok").inc(1234567)
+    text = prometheus_text(registry.snapshot())
+    assert "dib_serve_requests_ok 1234567\n" in text
+    assert "e+06" not in text

@@ -1,0 +1,361 @@
+"""SLO engine (telemetry/slo.py): rule grammar validation, metric
+resolution, transition detection, durable+idempotent alert writes,
+`telemetry check` exit codes (in-process and subprocess), live
+evaluation through the tail engine, and the committed SLO.json contract
+(valid grammar; the committed fixture stream passes it clean).
+"""
+
+import io
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from dib_tpu.telemetry.events import EventWriter, read_events
+from dib_tpu.telemetry.slo import (
+    SLOEngine,
+    check_run,
+    detect_transitions,
+    evaluate_rules,
+    load_slo,
+    resolve_metric,
+    validate_slo,
+)
+from dib_tpu.telemetry.summary import telemetry_main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE_RUN = os.path.join(REPO, "tests", "fixtures", "telemetry_run")
+
+
+# ================================================================== grammar
+def test_validate_slo_accepts_minimal_and_rejects_shapes():
+    ok = {"rules": [{"name": "a", "metric": "m", "min": 1.0}]}
+    assert validate_slo(ok) == []
+    bad = {
+        "rules": [
+            {"metric": "m", "min": 1.0},                 # no name
+            {"name": "b", "min": 1.0},                   # no metric
+            {"name": "c", "metric": "m"},                # no bound
+            {"name": "d", "metric": "m", "min": 1, "max": 2},  # two bounds
+            {"name": "d", "metric": "m", "min": 1.0},    # dup name
+            {"name": "e", "metric": "m", "max": float("nan")},
+            {"name": "f", "metric": "m", "min": 0, "when": "tpu"},
+        ],
+        "transitions": {"kl_threshold_nats": -1},
+    }
+    problems = validate_slo(bad)
+    assert len(problems) >= 7
+    assert any("duplicate" in p for p in problems)
+    assert any("kl_threshold_nats" in p for p in problems)
+
+
+def test_load_slo_raises_on_invalid(tmp_path):
+    path = tmp_path / "slo.json"
+    path.write_text(json.dumps({"rules": []}))
+    with pytest.raises(ValueError, match="non-empty list"):
+        load_slo(str(path))
+
+
+def test_committed_slo_json_is_valid():
+    spec = load_slo(os.path.join(REPO, "SLO.json"))
+    names = [r["name"] for r in spec["rules"]]
+    # the budgets the ISSUE grounds in BENCH_r05/BENCH_SERVE_CPU history
+    assert "north_star_mfu_floor" in names
+    assert "serve_p99_ceiling" in names
+    assert "no_undetected_faults" in names
+    assert spec["transitions"]["kl_threshold_nats"] > 0
+
+
+# ======================================================== metric resolution
+def test_resolve_metric_semantics():
+    summary = {
+        "steps_per_s": 100.0,
+        "final_loss": ["1.0", 3.0],                  # numeric-ish list
+        "faults": {"undetected": ["nan", "stall"]},  # non-numeric list
+        "serving": {"request_p99_ms": 12.5},
+        "diverged": "NaN",
+        "flag": True,
+    }
+    assert resolve_metric(summary, "steps_per_s") == 100.0
+    assert resolve_metric(summary, "final_loss") == pytest.approx(2.0)
+    assert resolve_metric(summary, "faults.undetected") == 2.0
+    assert resolve_metric(summary, "serving.request_p99_ms") == 12.5
+    assert resolve_metric(summary, "missing.path") is None
+    assert resolve_metric(summary, "flag") is None    # bools never gate
+    nan = resolve_metric(summary, "diverged")
+    assert nan != nan                                  # parses to real NaN
+
+
+def test_evaluate_rules_statuses():
+    rules = [
+        {"name": "floor_ok", "metric": "steps_per_s", "min": 50.0},
+        {"name": "floor_bad", "metric": "steps_per_s", "min": 200.0},
+        {"name": "guarded_off", "metric": "steps_per_s", "min": 1e9,
+         "when": {"device_platform": "tpu"}},
+        {"name": "absent", "metric": "serving.request_p99_ms", "max": 1.0},
+        {"name": "required_absent", "metric": "nope", "max": 1.0,
+         "required": True},
+        {"name": "nonfinite_skips", "metric": "diverged", "max": 1.0},
+    ]
+    summary = {"steps_per_s": 100.0, "device_platform": "cpu",
+               "diverged": "NaN"}
+    by_name = {r["rule"]: r for r in evaluate_rules(rules, summary)}
+    assert by_name["floor_ok"]["status"] == "ok"
+    assert by_name["floor_bad"]["status"] == "violated"
+    assert by_name["guarded_off"]["status"] == "skipped"
+    assert by_name["guarded_off"]["reason"] == "when-guard unmatched"
+    assert by_name["absent"]["status"] == "skipped"
+    assert by_name["required_absent"]["status"] == "violated"
+    assert by_name["nonfinite_skips"]["status"] == "skipped"
+
+
+def test_when_guard_membership_list():
+    rules = [{"name": "r", "metric": "x", "min": 0.0,
+              "when": {"device_platform": ["tpu", "gpu"]}}]
+    (tpu,) = evaluate_rules(rules, {"x": 1.0, "device_platform": "tpu"})
+    (cpu,) = evaluate_rules(rules, {"x": 1.0, "device_platform": "cpu"})
+    assert tpu["status"] == "ok"
+    assert cpu["status"] == "skipped"
+
+
+# ============================================================== transitions
+def test_detect_transitions_crossings():
+    chunks = [
+        {"epoch": 10, "kl_per_feature": [0.5, 0.01], "beta": 0.1},
+        {"epoch": 20, "kl_per_feature": [0.5, 0.20], "beta": 0.2},  # ch1 up
+        {"epoch": 30, "kl_per_feature": [0.02, 0.20], "beta": 0.3},  # ch0 dn
+        {"epoch": 40, "kl_per_feature": [0.01, 0.20], "beta": 0.4},  # none
+    ]
+    out = detect_transitions(chunks, 0.05)
+    assert [(t["channel"], t["epoch"], t["direction"]) for t in out] == [
+        (1, 20, "up"), (0, 30, "down")]
+    assert out[1]["kl_before"] == 0.5 and out[1]["kl_after"] == 0.02
+    assert out[1]["beta"] == pytest.approx(0.3)
+
+
+def test_transitions_ignore_sweep_streams():
+    # sweep chunk events carry per-replica totals, no per-channel signal
+    assert detect_transitions(
+        [{"epoch": 1, "kl_total": [1.0, 2.0]},
+         {"epoch": 2, "kl_total": [0.0, 0.0]}], 0.05) == []
+
+
+# ================================================== durable alerts / check
+def _write_run(directory, *, steps_per_s=100.0, kl_rows=None,
+               status="ok", run_id="slo-run"):
+    with EventWriter(str(directory), run_id=run_id) as w:
+        w.run_start({"device_kind": "cpu", "device_platform": "cpu"})
+        rows = kl_rows or [[0.5, 0.5]] * 2
+        for i, row in enumerate(rows):
+            w.chunk(epoch=(i + 1) * 10, steps=int(steps_per_s),
+                    seconds=1.0, loss=1.0, val_loss=1.1,
+                    kl_per_feature=row, beta=0.1 * (i + 1))
+        w.run_end(status=status)
+
+
+def test_check_run_clean_writes_nothing(tmp_path):
+    _write_run(tmp_path)
+    slo = tmp_path / "slo.json"
+    slo.write_text(json.dumps({"rules": [
+        {"name": "floor", "metric": "steps_per_s", "min": 1.0}]}))
+    before = open(tmp_path / "events.jsonl", "rb").read()
+    report = check_run(str(tmp_path), str(slo))
+    assert report["violations"] == 0
+    # a clean run's stream stays BIT-IDENTICAL (fixture safety)
+    assert open(tmp_path / "events.jsonl", "rb").read() == before
+
+
+def test_check_run_violation_durable_and_idempotent(tmp_path):
+    _write_run(tmp_path, kl_rows=[[0.5, 0.5], [0.5, 0.01]])
+    slo = tmp_path / "slo.json"
+    slo.write_text(json.dumps({
+        "rules": [{"name": "floor", "metric": "steps_per_s", "min": 1e9}],
+        "transitions": {"kl_threshold_nats": 0.05},
+    }))
+    report = check_run(str(tmp_path), str(slo))
+    assert report["violations"] == 1
+    assert report["written"] == {"alerts": 1, "transitions": 1}
+    # durable: the events are ON the stream, tagged with their source
+    alerts = list(read_events(str(tmp_path), types=("alert",)))
+    transitions = list(read_events(str(tmp_path), types=("transition",)))
+    assert alerts[0]["rule"] == "floor" and alerts[0]["source"] == "check"
+    assert alerts[0]["budget"] == 1e9 and alerts[0]["tags"] == {"src": "slo"}
+    assert transitions[0]["channel"] == 1
+    assert transitions[0]["direction"] == "down"
+    assert transitions[0]["threshold_nats"] == 0.05
+    # idempotent: re-checking writes nothing new
+    again = check_run(str(tmp_path), str(slo))
+    assert again["written"] == {"alerts": 0, "transitions": 0}
+    assert len(list(read_events(str(tmp_path), types=("alert",)))) == 1
+    # and the durable residue shows up in summarize + compare's view
+    from dib_tpu.telemetry.summary import summarize
+
+    s = summarize(str(tmp_path))
+    assert s["alerts"] == {"count": 1, "by_rule": {"floor": 1}}
+    assert s["transitions"]["count"] == 1
+    assert s["transitions"]["down"] == 1
+
+
+def test_check_cli_exit_codes_in_process(tmp_path, capsys):
+    _write_run(tmp_path)
+    clean = tmp_path / "clean.json"
+    clean.write_text(json.dumps({"rules": [
+        {"name": "floor", "metric": "steps_per_s", "min": 1.0}]}))
+    violated = tmp_path / "violated.json"
+    violated.write_text(json.dumps({"rules": [
+        {"name": "floor", "metric": "steps_per_s", "min": 1e9}]}))
+    assert telemetry_main(["check", str(tmp_path), "--slo",
+                           str(clean)]) == 0
+    assert telemetry_main(["check", str(tmp_path), "--slo",
+                           str(violated)]) == 1
+    err = capsys.readouterr().err
+    assert "SLO violation" in err
+    # unusable operands: exit 2, distinct from the violation verdict
+    assert telemetry_main(["check", str(tmp_path / "nope"), "--slo",
+                           str(clean)]) == 2
+    bad_slo = tmp_path / "bad.json"
+    bad_slo.write_text(json.dumps({"rules": []}))
+    assert telemetry_main(["check", str(tmp_path), "--slo",
+                           str(bad_slo)]) == 2
+
+
+def test_check_cli_subprocess(tmp_path):
+    """Each seeded violation kind exits nonzero through the real CLI."""
+    _write_run(tmp_path / "run")
+    cases = {
+        "steps_floor": {"name": "f", "metric": "steps_per_s", "min": 1e9},
+        "loss_ceiling": {"name": "f", "metric": "final_loss", "max": 0.0},
+        "gap_required": {"name": "f", "metric": "heartbeats.max_gap_s",
+                         "max": 1.0, "required": True},
+    }
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    for label, rule in cases.items():
+        slo = tmp_path / f"{label}.json"
+        slo.write_text(json.dumps({"rules": [rule]}))
+        proc = subprocess.run(
+            [sys.executable, "-m", "dib_tpu", "telemetry", "check",
+             str(tmp_path / "run"), "--slo", str(slo), "--no-write"],
+            capture_output=True, text=True, timeout=120, env=env)
+        assert proc.returncode == 1, (label, proc.stderr)
+        assert json.loads(proc.stdout)["violations"] == 1
+    ok = tmp_path / "ok.json"
+    ok.write_text(json.dumps({"rules": [
+        {"name": "f", "metric": "steps_per_s", "min": 1.0}]}))
+    proc = subprocess.run(
+        [sys.executable, "-m", "dib_tpu", "telemetry", "check",
+         str(tmp_path / "run"), "--slo", str(ok)],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert proc.returncode == 0, proc.stderr
+
+
+def test_no_write_flag_leaves_stream_untouched(tmp_path, capsys):
+    _write_run(tmp_path)
+    slo = tmp_path / "slo.json"
+    slo.write_text(json.dumps({"rules": [
+        {"name": "floor", "metric": "steps_per_s", "min": 1e9}]}))
+    before = open(tmp_path / "events.jsonl", "rb").read()
+    assert telemetry_main(["check", str(tmp_path), "--slo", str(slo),
+                           "--no-write"]) == 1
+    capsys.readouterr()
+    assert open(tmp_path / "events.jsonl", "rb").read() == before
+
+
+# ===================================================== committed fixture
+def test_committed_fixture_passes_committed_slo():
+    """THE tier-1 wiring the ISSUE asks for: `telemetry check` against
+    the committed fixture stream under the committed SLO.json exits 0 —
+    and, being clean, writes nothing into the committed fixture."""
+    before = open(os.path.join(FIXTURE_RUN, "events.jsonl"), "rb").read()
+    with pytest.warns(UserWarning, match="torn event line"):
+        report = check_run(FIXTURE_RUN, os.path.join(REPO, "SLO.json"))
+    assert report["violations"] == 0
+    assert report["written"] == {"alerts": 0, "transitions": 0}
+    after = open(os.path.join(FIXTURE_RUN, "events.jsonl"), "rb").read()
+    assert after == before
+    # the TPU-guarded rules actually APPLIED to this tpu-labeled fixture
+    by_name = {r["rule"]: r for r in report["rules"]}
+    assert by_name["north_star_mfu_floor"]["status"] == "ok"
+    assert by_name["north_star_steps_per_s_floor"]["status"] == "ok"
+
+
+# ================================================================ live SLO
+def test_live_engine_alerts_through_tail(tmp_path):
+    """tail --slo: the live engine writes the same durable events the
+    terminal check does, while the run is still in flight."""
+    from dib_tpu.telemetry.live import tail
+
+    def write():
+        with EventWriter(str(tmp_path), run_id="live") as w:
+            w.run_start({"device_platform": "cpu"})
+            w.chunk(epoch=10, steps=10, seconds=1.0, loss=1.0,
+                    kl_per_feature=[0.5, 0.5], beta=0.1)
+            w.chunk(epoch=20, steps=10, seconds=1.0, loss=1.0,
+                    kl_per_feature=[0.5, 0.01], beta=0.2)
+            w.run_end(status="ok")
+
+    engine = SLOEngine({
+        "rules": [{"name": "floor", "metric": "steps_per_s", "min": 1e9}],
+        "transitions": {"kl_threshold_nats": 0.05},
+    }, str(tmp_path))
+    thread = threading.Thread(target=write)
+    thread.start()
+    tail(str(tmp_path), slo=engine, refresh_s=0.02, duration_s=30,
+         out=io.StringIO(), ansi=False)
+    thread.join()
+    engine.close()
+    assert [a["rule"] for a in engine.alerts] == ["floor"]
+    assert len(engine.transitions) == 1
+    alerts = list(read_events(str(tmp_path), types=("alert",)))
+    assert alerts and alerts[0]["source"] == "tail"
+    transitions = list(read_events(str(tmp_path), types=("transition",)))
+    assert transitions[0]["channel"] == 1
+    # a terminal re-check sees the live engine's residue: idempotent
+    slo = tmp_path / "slo.json"
+    slo.write_text(json.dumps({
+        "rules": [{"name": "floor", "metric": "steps_per_s", "min": 1e9}],
+        "transitions": {"kl_threshold_nats": 0.05}}))
+    report = check_run(str(tmp_path), str(slo))
+    assert report["written"] == {"alerts": 0, "transitions": 0}
+
+
+def test_live_engine_steady_floor_skips_compile_chunk(tmp_path):
+    """Review hardening: a steady_steps_per_s floor must not write a
+    durable false alert off the compile-laden FIRST chunk — live
+    evaluation mirrors summarize's steady-state exclusion (skip until a
+    steady chunk lands), then fires on real steady data."""
+    engine = SLOEngine({
+        "rules": [{"name": "floor", "metric": "steady_steps_per_s",
+                   "min": 100.0}],
+    }, str(tmp_path))
+    engine.observe({"type": "run_start", "run": "r", "t": 0.0,
+                    "manifest": {}})
+    # first chunk: compile-laden, 1 step/s — would false-fire naively
+    engine.observe({"type": "chunk", "proc": 0, "epoch": 1, "steps": 10,
+                    "seconds": 10.0, "t": 10.0})
+    engine.flush()
+    assert engine.alerts == []
+    # steady chunk at 10 steps/s: now the floor legitimately fires
+    engine.observe({"type": "chunk", "proc": 0, "epoch": 2, "steps": 10,
+                    "seconds": 1.0, "t": 11.0})
+    engine.flush()
+    engine.close()
+    assert [a["rule"] for a in engine.alerts] == ["floor"]
+    (alert,) = read_events(str(tmp_path), types=("alert",))
+    assert alert["value"] == pytest.approx(10.0)   # steady, not blended
+
+
+def test_check_run_bare_filename_operand(tmp_path, monkeypatch):
+    """Review hardening: `cd <run-dir> && telemetry check events.jsonl`
+    must write the durable alert and exit 1, not crash on dirname('')."""
+    _write_run(tmp_path)
+    slo = tmp_path / "slo.json"
+    slo.write_text(json.dumps({"rules": [
+        {"name": "floor", "metric": "steps_per_s", "min": 1e9}]}))
+    monkeypatch.chdir(tmp_path)
+    assert telemetry_main(["check", "events.jsonl", "--slo",
+                           "slo.json"]) == 1
+    (alert,) = read_events(str(tmp_path), types=("alert",))
+    assert alert["rule"] == "floor"

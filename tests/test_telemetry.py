@@ -718,13 +718,23 @@ def test_boolean_workload_telemetry_overhead_under_2pct(tmp_path):
     # min: host contention noise is strictly one-sided (only ever slows)
     chunk_s = min(c["seconds"] for c in chunks)
 
-    # Per-chunk emission cost on the run's OWN payload: one chunk event,
-    # one mi_bounds event, and the two span events (chunk + mi_bounds —
-    # the spans-enabled bound of the acceptance criteria) per boundary,
-    # through a real EventWriter.
-    reps = 200
-    from dib_tpu.telemetry.events import host_memory_stats
+    heartbeats = list(read_events(str(tmp_path / "run"),
+                                  types=("heartbeat",)))
+    boundary = [h for h in heartbeats if h["phase"] == "boundary"]
+    assert len(boundary) == 3   # one per chunk, main thread
 
+    # Per-chunk emission cost on the run's OWN payload: one chunk event,
+    # one mi_bounds event, the two span events (chunk + mi_bounds), AND
+    # the heartbeat traffic a chunk interval admits — the boundary beat
+    # plus the mid-chunk daemon beats one chunk's wall-clock buys at the
+    # default DIB_HEARTBEAT_S (the spans+heartbeats-enabled bound of the
+    # acceptance criteria) — through a real EventWriter.
+    from dib_tpu.telemetry.events import host_memory_stats
+    from dib_tpu.telemetry.hooks import heartbeat_interval_s
+
+    mid_beats_per_chunk = max(
+        int(chunk_s / max(heartbeat_interval_s(), 1e-9)), 0) + 1
+    reps = 200
     with EventWriter(str(tmp_path / "cost")) as w:
         t0 = time.perf_counter()
         for i in range(reps):
@@ -741,6 +751,14 @@ def test_boolean_workload_telemetry_overhead_under_2pct(tmp_path):
                 w.span(name=template["name"], path=template["path"],
                        span_id=2 * i, parent_id=None,
                        seconds=template["seconds"])
+            w.heartbeat(beat=2 * i, epoch=chunks[0]["epoch"],
+                        phase="boundary",
+                        intervals_s=boundary[-1].get("intervals_s") or [])
+            for j in range(mid_beats_per_chunk):
+                w.heartbeat(beat=2 * i + 1 + j, epoch=chunks[0]["epoch"],
+                            phase="chunk",
+                            interval_s=heartbeat_interval_s(),
+                            phase_elapsed_s=1.234)
         emit_s = (time.perf_counter() - t0) / reps
 
     ratio = chunk_s / (chunk_s + emit_s)
@@ -787,3 +805,133 @@ def test_workload_cli_emits_event_stream(tmp_path, capsys):
     assert s["total_steps"] == 40
     assert s["git_sha"] == manifest["git_sha"]
     assert s["metrics"]["histograms.chunk_s.count"] == 2.0
+
+
+# ===================================================== heartbeat coverage
+def test_summarize_heartbeat_coverage_and_silent_gap_gate(tmp_path):
+    """summarize reports heartbeat coverage (count, boundary beats, max
+    silent gap incl. the run_start/run_end edges) and compare gates on a
+    silent-gap regression; streams WITHOUT heartbeats stay ungated
+    instead of faking a zero gap."""
+    a = tmp_path / "a"
+    with EventWriter(str(a), run_id="hb-a") as w:
+        w.run_start({"config_hash": "x"})
+        base = w.emit("heartbeat", beat=1, epoch=0, phase="boundary",
+                      intervals_s=[])["t"]
+        for i, dt in enumerate((1.0, 2.0, 1.0)):
+            base += dt
+            record = {"beat": i + 2, "epoch": i, "phase": "chunk",
+                      "interval_s": 1.0}
+            w.emit("heartbeat", **record)
+            # rewrite t: synthetic gaps without sleeping
+        w.chunk(epoch=3, steps=30, seconds=3.0, loss=1.0)
+        w.run_end(status="ok")
+    # patch wall-clocks directly for deterministic gaps: 1s, 5s, 1s
+    lines = [json.loads(line) for line in
+             open(a / "events.jsonl").read().splitlines()]
+    t0 = 1000.0
+    stamps = {1: t0, 2: t0 + 1.0, 3: t0 + 6.0, 4: t0 + 7.0}
+    beats_seen = 0
+    for event in lines:
+        if event["type"] == "run_start":
+            event["t"] = t0
+        elif event["type"] == "heartbeat":
+            beats_seen += 1
+            event["t"] = stamps[beats_seen]
+        else:
+            event["t"] = t0 + 7.5
+    with open(a / "events.jsonl", "w") as f:
+        for event in lines:
+            f.write(json.dumps(event) + "\n")
+
+    s = summarize(str(a))
+    assert s["heartbeats"]["count"] == 4
+    assert s["heartbeats"]["boundary_beats"] == 1
+    assert s["heartbeats"]["interval_s"] == 1.0
+    assert s["heartbeats"]["max_gap_s"] == pytest.approx(5.0)
+    assert s["heartbeat_max_gap_s"] == pytest.approx(5.0)
+
+    # candidate whose worst silent gap doubled: gated as a regression
+    b_summary = dict(s, heartbeat_max_gap_s=10.0)
+    report, regressed = compare(s, b_summary, threshold=0.05)
+    assert regressed
+    assert report["fields"]["heartbeat_max_gap_s"]["regressed"]
+
+    # no heartbeats on either side: explicitly ungated, not zero-gap
+    plain = tmp_path / "plain"
+    write_fixture_run(str(plain))
+    sp = summarize(str(plain))
+    assert "heartbeats" not in sp
+    report, regressed = compare(sp, sp)
+    assert report["fields"]["heartbeat_max_gap_s"]["gated"] is False
+    assert not regressed
+
+
+def test_fit_emits_heartbeats_with_boundary_intervals(tmp_path, monkeypatch):
+    """DIBTrainer.fit under telemetry: boundary beats at every chunk with
+    trailing intervals (the watchdog's stall clock), mid-chunk beats from
+    the daemon thread at the configured interval."""
+    import jax
+
+    from dib_tpu.data import get_dataset
+    from dib_tpu.models import DistributedIBModel
+    from dib_tpu.train import DIBTrainer, TrainConfig
+
+    monkeypatch.setenv("DIB_HEARTBEAT_S", "0.05")
+    bundle = get_dataset("boolean_circuit")
+    model = DistributedIBModel(
+        feature_dimensionalities=tuple(bundle.feature_dimensionalities),
+        encoder_hidden=(16,), integration_hidden=(16,),
+        output_dim=bundle.output_dimensionality,
+        embedding_dim=4, use_positional_encoding=False,
+        output_activation=bundle.output_activation,
+    )
+    config = TrainConfig(num_pretraining_epochs=0, num_annealing_epochs=9,
+                         batch_size=32, max_val_points=64)
+    trainer = DIBTrainer(model, bundle, config)
+    with EventWriter(str(tmp_path)) as w:
+        trainer.fit(jax.random.key(0), hook_every=3, telemetry=w)
+        w.run_end(status="ok")
+    beats = list(read_events(str(tmp_path), types=("heartbeat",)))
+    boundary = [b for b in beats if b["phase"] == "boundary"]
+    assert len(boundary) == 3                   # one per chunk
+    assert [b["beat"] for b in beats] == sorted(b["beat"] for b in beats)
+    # trailing intervals grow with the boundaries; first includes compile
+    assert len(boundary[0]["intervals_s"]) == 1
+    assert len(boundary[-1]["intervals_s"]) == 3
+    s = summarize(str(tmp_path))
+    assert s["heartbeats"]["boundary_beats"] == 3
+    assert s["heartbeats"]["max_gap_s"] >= 0.0
+    # chunk events carry their epoch count (the live MFU gauge's scale)
+    chunks = list(read_events(str(tmp_path), types=("chunk",)))
+    assert all(c["epochs"] == 3 for c in chunks)
+
+
+def test_sweep_fit_emits_heartbeats(tmp_path, monkeypatch):
+    """BetaSweepTrainer.fit shares the same heartbeat recorder."""
+    import jax
+
+    from dib_tpu.data import get_dataset
+    from dib_tpu.models import DistributedIBModel
+    from dib_tpu.parallel import BetaSweepTrainer
+    from dib_tpu.train import TrainConfig
+
+    monkeypatch.setenv("DIB_HEARTBEAT_S", "0")   # boundary beats only
+    bundle = get_dataset("boolean_circuit")
+    model = DistributedIBModel(
+        feature_dimensionalities=tuple(bundle.feature_dimensionalities),
+        encoder_hidden=(8,), integration_hidden=(8,),
+        output_dim=bundle.output_dimensionality,
+        embedding_dim=4, use_positional_encoding=False,
+        output_activation=bundle.output_activation,
+    )
+    config = TrainConfig(num_pretraining_epochs=0, num_annealing_epochs=4,
+                         batch_size=32, max_val_points=64)
+    sweep = BetaSweepTrainer(model, bundle, config, 1e-4, [0.1, 1.0])
+    keys = jax.random.split(jax.random.key(0), 2)
+    with EventWriter(str(tmp_path)) as w:
+        sweep.fit(keys, hook_every=2, telemetry=w)
+        w.run_end(status="ok")
+    beats = list(read_events(str(tmp_path), types=("heartbeat",)))
+    assert [b["phase"] for b in beats] == ["boundary", "boundary"]
+    assert beats[-1]["epoch"] == 4

@@ -1,0 +1,315 @@
+"""Live monitor (`telemetry tail`): the incremental follower, the rolling
+dashboard state (steps/s, live MFU, liveness), and the end-to-end live
+drill of the acceptance criteria — a real training run followed MID-RUN
+by a concurrent tail, with heartbeats, a seeded SLO violation leaving a
+durable alert, and the run landing in the fleet registry/index.
+"""
+
+import io
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from dib_tpu.telemetry.events import EventWriter, read_events
+from dib_tpu.telemetry.live import (
+    LiveRunState,
+    StreamFollower,
+    liveness,
+    render_dashboard,
+    tail,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ============================================================ StreamFollower
+def test_follower_reads_incrementally(tmp_path):
+    path = tmp_path / "events.jsonl"
+    follower = StreamFollower(str(tmp_path))   # run-dir form
+    assert follower.poll() == []               # file does not exist yet
+    with open(path, "a") as f:
+        f.write(json.dumps({"type": "chunk", "epoch": 1}) + "\n")
+    (first,) = follower.poll()
+    assert first["epoch"] == 1
+    assert follower.poll() == []               # nothing new
+    with open(path, "a") as f:
+        f.write(json.dumps({"type": "chunk", "epoch": 2}) + "\n")
+        f.write(json.dumps({"type": "chunk", "epoch": 3}) + "\n")
+    assert [e["epoch"] for e in follower.poll()] == [2, 3]
+
+
+def test_follower_buffers_torn_final_line(tmp_path):
+    """An in-progress append (no trailing newline yet) must be BUFFERED,
+    not mis-parsed — and parse once its newline arrives."""
+    path = tmp_path / "events.jsonl"
+    whole = json.dumps({"type": "chunk", "epoch": 7})
+    with open(path, "w") as f:
+        f.write(json.dumps({"type": "run_start"}) + "\n")
+        f.write(whole[:10])                    # torn mid-append
+    follower = StreamFollower(str(path))
+    events = follower.poll()
+    assert [e["type"] for e in events] == ["run_start"]
+    with open(path, "a") as f:
+        f.write(whole[10:] + "\n")
+    (done,) = follower.poll()
+    assert done == {"type": "chunk", "epoch": 7}
+    assert follower.torn == 0                  # never counted as torn
+
+
+def test_follower_skips_torn_interior_line(tmp_path):
+    """A COMPLETE line that does not parse (writer killed mid-append
+    earlier in the file, survivors appended after) is skipped + counted."""
+    path = tmp_path / "events.jsonl"
+    with open(path, "w") as f:
+        f.write('{"type": "chunk", "epo\n')    # killed writer's torn line
+        f.write(json.dumps({"type": "chunk", "epoch": 2}) + "\n")
+    follower = StreamFollower(str(path))
+    events = follower.poll()
+    assert [e.get("epoch") for e in events] == [2]
+    assert follower.torn == 1
+
+
+def test_follower_resets_on_truncation(tmp_path):
+    path = tmp_path / "events.jsonl"
+    with open(path, "w") as f:
+        f.write(json.dumps({"type": "chunk", "epoch": 1}) + "\n")
+    follower = StreamFollower(str(path))
+    assert len(follower.poll()) == 1
+    with open(path, "w") as f:                 # rotated/truncated under us
+        f.write(json.dumps({"type": "x"}) + "\n")   # strictly shorter
+    (again,) = follower.poll()
+    assert again["type"] == "x"
+
+
+def test_follower_handles_concurrent_writer(tmp_path):
+    """Poll races a thread appending real EventWriter lines; every event
+    arrives exactly once, in order, with no torn parses."""
+    stop = threading.Event()
+
+    def write_events():
+        with EventWriter(str(tmp_path)) as w:
+            for i in range(200):
+                w.emit("chunk", epoch=i)
+        stop.set()
+
+    thread = threading.Thread(target=write_events)
+    follower = StreamFollower(str(tmp_path))
+    seen = []
+    thread.start()
+    while not (stop.is_set() and not follower.poll()):
+        seen.extend(follower.poll())
+    thread.join()
+    seen.extend(follower.poll())
+    assert [e["epoch"] for e in seen] == list(range(200))
+    assert follower.torn == 0
+
+
+# ============================================================= LiveRunState
+def _feed(state, events):
+    for e in events:
+        state.update(e)
+
+
+def test_live_state_rollups_and_mfu():
+    state = LiveRunState()
+    _feed(state, [
+        {"type": "run_start", "run": "r1", "t": 100.0,
+         "manifest": {"device_kind": "TPU v5 lite", "device_count": 1}},
+        {"type": "compile", "name": "run_chunk", "flops": 4e12,
+         "bytes_accessed": 4e10, "epochs": 10, "t": 101.0},
+        {"type": "chunk", "epoch": 10, "steps": 500, "seconds": 2.0,
+         "epochs": 10, "loss": 1.5, "val_loss": 1.6,
+         "kl_per_feature": [0.5, 0.1, 0.0], "steps_per_s": 250.0,
+         "t": 103.0},
+        {"type": "heartbeat", "beat": 1, "epoch": 10, "phase": "boundary",
+         "intervals_s": [2.0], "t": 103.0},
+        {"type": "mitigation", "mtype": "stall_kill", "t": 104.0},
+        {"type": "run_end", "status": "ok", "t": 105.0},
+    ])
+    assert state.run_id == "r1"
+    assert state.status == "ok"
+    assert state.total_steps == 500
+    assert state.steps_per_s == pytest.approx(250.0)
+    mfu = state.mfu()
+    # 4e12 flops / 2 s = 2 TFLOP/s over the 197 TFLOP/s v5e peak
+    assert mfu["flops_frac_of_peak"] == pytest.approx(2.0 / 197.0, rel=1e-6)
+    assert state.counts["mitigation"] == 1
+    frame = render_dashboard(state, now=106.0)
+    assert "steps/s" in frame and "250.0" in frame
+    assert "MFU" in frame and "197" in frame
+    assert "stall_kill" in frame
+
+
+def test_live_mfu_scales_partial_chunk():
+    """A final partial chunk (fewer epochs than the compiled program)
+    scales the program FLOPs down by epochs ratio."""
+    state = LiveRunState()
+    _feed(state, [
+        {"type": "run_start", "run": "r", "t": 0.0,
+         "manifest": {"device_kind": "TPU v5 lite"}},
+        {"type": "compile", "name": "run_chunk", "flops": 1e13,
+         "epochs": 10, "t": 0.0},
+        {"type": "chunk", "epoch": 12, "steps": 100, "seconds": 1.0,
+         "epochs": 2, "t": 1.0},
+    ])
+    mfu = state.mfu()
+    assert mfu["achieved_gflops"] == pytest.approx(2e12 / 1e9)
+
+
+def test_liveness_silent_detection():
+    state = LiveRunState()
+    _feed(state, [
+        {"type": "heartbeat", "beat": 1, "epoch": 0, "phase": "chunk",
+         "interval_s": 1.0, "t": 100.0},
+    ])
+    fresh = liveness(state, now=101.0)
+    assert fresh["silent"] is False and fresh["in_chunk"] is True
+    stale = liveness(state, now=110.0)
+    assert stale["silent"] is True
+    assert "SILENT" in render_dashboard(state, now=110.0)
+
+
+def test_dashboard_renders_sweep_kl_totals():
+    state = LiveRunState()
+    _feed(state, [
+        {"type": "chunk", "epoch": 5, "steps": 10, "seconds": 1.0,
+         "loss": [1.0, 2.0], "val_loss": [1.1, 2.1],
+         "kl_total": [3.0, 4.0], "t": 1.0},
+    ])
+    frame = render_dashboard(state)
+    assert "KL total" in frame and "2 replicas" in frame
+    assert "loss      1.5" in frame   # [R] lists render as means
+
+
+# ===================================================================== tail
+def test_tail_follows_concurrent_writer_to_preempted_end(tmp_path):
+    """tail attaches BEFORE the stream exists, follows a writer thread,
+    and detaches on the terminal run_end — here a preempted run."""
+
+    def write():
+        time.sleep(0.1)
+        with EventWriter(str(tmp_path), run_id="p1") as w:
+            w.run_start({"device_kind": "cpu"})
+            for i in range(3):
+                w.chunk(epoch=i + 1, steps=10, seconds=0.01)
+                time.sleep(0.05)
+            w.run_end(status="preempted", epoch=3)
+
+    thread = threading.Thread(target=write)
+    thread.start()
+    out = io.StringIO()
+    state = tail(str(tmp_path), refresh_s=0.02, duration_s=30,
+                 out=out, ansi=False)
+    thread.join()
+    assert state.status == "preempted"
+    assert state.num_chunks == 3
+    assert "preempted" in out.getvalue()
+
+
+def test_tail_detaches_on_duration_for_incomplete_stream(tmp_path):
+    """A stream whose run never ended (killed writer — status stays
+    'running') must not hang tail: the duration bound detaches."""
+    with EventWriter(str(tmp_path)) as w:
+        w.run_start({})
+        w.chunk(epoch=1, steps=5, seconds=0.01)
+    state = tail(str(tmp_path), refresh_s=0.02, duration_s=0.2,
+                 out=io.StringIO(), ansi=False)
+    assert state.status == "running"
+    assert state.num_chunks == 1
+
+
+def test_tail_cli_once_frame(tmp_path, capsys):
+    from dib_tpu.telemetry.summary import telemetry_main
+
+    with EventWriter(str(tmp_path), run_id="cli-run") as w:
+        w.run_start({"device_kind": "cpu"})
+        w.chunk(epoch=1, steps=50, seconds=0.5)
+        w.run_end(status="ok")
+    rc = telemetry_main(["tail", str(tmp_path), "--once", "--no-ansi"])
+    frame = capsys.readouterr().out
+    assert rc == 0
+    assert "cli-run" in frame and "steps/s" in frame
+
+
+# ==================================================== acceptance live drill
+def test_live_drill_end_to_end(tmp_path, monkeypatch):
+    """THE acceptance criterion: a real CPU training run with `tail`
+    attached mid-run rendering steps/s + live MFU from real events; a
+    seeded SLO violation leaves a durable alert and a nonzero check
+    exit; the run shows in `runs list` and the `--index` page."""
+    import jax
+
+    from dib_tpu.telemetry.registry import RunRegistry, register_run
+    from dib_tpu.telemetry.report import write_index
+    from dib_tpu.telemetry.slo import check_run
+    from dib_tpu.workloads.boolean import (
+        BooleanTrainer,
+        BooleanWorkloadConfig,
+        fetch_boolean_circuit,
+    )
+
+    monkeypatch.setenv("DIB_HEARTBEAT_S", "0.2")
+    run_dir = tmp_path / "live_run"
+    config = BooleanWorkloadConfig(num_steps=60, mi_every=20)
+    trainer = BooleanTrainer(fetch_boolean_circuit(), config)
+
+    def train():
+        with EventWriter(str(run_dir), run_id="drill") as w:
+            w.run_start({"device_kind": jax.devices()[0].device_kind,
+                         "device_platform": jax.devices()[0].platform})
+            trainer.fit(jax.random.key(0), telemetry=w)
+            w.run_end(status="ok")
+
+    thread = threading.Thread(target=train)
+    thread.start()
+    out = io.StringIO()
+    state = tail(str(run_dir), refresh_s=0.05, duration_s=120,
+                 out=out, ansi=False)
+    thread.join()
+
+    # tail attached mid-run and rendered real throughput + the MFU gauge
+    assert state.status == "ok"
+    assert state.num_chunks == 3
+    assert state.steps_per_s and state.steps_per_s > 0
+    frames = out.getvalue()
+    assert "steps/s" in frames and "MFU" in frames
+    assert state.mfu() is not None           # live gauge armed from real
+    assert state.last_beat_t is not None     # heartbeats observed live
+
+    # seeded SLO violation -> durable alert event + nonzero check
+    slo_path = tmp_path / "slo.json"
+    slo_path.write_text(json.dumps({
+        "slo_version": 1,
+        "rules": [{"name": "impossible_floor", "metric": "steps_per_s",
+                   "min": 1e12}],
+        "transitions": {"kl_threshold_nats": 0.05},
+    }))
+    report = check_run(str(run_dir), str(slo_path))
+    assert report["violations"] == 1
+    alerts = list(read_events(str(run_dir), types=("alert",)))
+    assert [a["rule"] for a in alerts] == ["impossible_floor"]
+    proc = subprocess.run(
+        [sys.executable, "-m", "dib_tpu", "telemetry", "check",
+         str(run_dir), "--slo", str(slo_path)],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 1
+    assert "SLO violation" in proc.stderr
+
+    # registry + index page show the run (alert count included)
+    register_run(str(run_dir), root=str(tmp_path / "runsroot"))
+    latest = RunRegistry(str(tmp_path / "runsroot")).latest()
+    assert "drill" in latest
+    assert latest["drill"]["metrics"]["alerts"] == 1
+    from dib_tpu.telemetry.report import write_report
+
+    write_report(str(run_dir))
+    index = write_index(str(tmp_path / "runsroot"))
+    html = open(index).read()
+    assert "drill" in html and "report.html" in html

@@ -334,3 +334,78 @@ def test_supervisor_termination_kills_worker(tmp_path):
                 os.kill(worker_pid, 9)
             except ProcessLookupError:
                 pass
+
+
+# ------------------------------------------- event-stream liveness probe
+def test_supervise_consumes_heartbeat_events(tmp_path):
+    """Where a telemetry dir is configured, liveness comes from the
+    stream's heartbeat events: a worker that stops emitting boundary
+    beats is stall-killed (with worker_alive_s forensics — its mid-chunk
+    beats kept landing), and the relaunch runs to completion. The
+    side-channel heartbeat FILE never exists in this drill."""
+    events_dir = str(tmp_path / "run")
+    hb = str(tmp_path / "hb.json")   # passed but never written
+    marker = str(tmp_path / "stalled_once")
+    cmd = _scripted_worker(tmp_path, f"""
+        import os, sys, time
+        sys.path.insert(0, {REPO!r})
+        from dib_tpu.telemetry.events import EventWriter
+        marker = {marker!r}
+        w = EventWriter({events_dir!r}, run_id="drill")
+        for n in range(1, 3):
+            time.sleep(0.2)
+            w.heartbeat(beat=n, epoch=n, phase="boundary",
+                        intervals_s=[0.2] * n)
+        if not os.path.exists(marker):
+            open(marker, "w").close()
+            # device "stalls": boundary progress stops, but the process
+            # stays alive and keeps emitting mid-chunk beats
+            for n in range(3, 2000):
+                time.sleep(0.1)
+                w.heartbeat(beat=n, epoch=2, phase="chunk",
+                            interval_s=0.1, phase_elapsed_s=n * 0.1)
+        for n in range(3, 6):
+            time.sleep(0.2)
+            w.heartbeat(beat=n, epoch=n, phase="boundary",
+                        intervals_s=[0.2] * 3)
+    """)
+    t0 = time.time()
+    result = supervise(
+        cmd, hb,
+        WatchdogConfig(first_beat_timeout_s=60.0, floor_s=1.0, k=3.0,
+                       poll_s=0.1, max_restarts=2),
+        env=_worker_env(),
+        events_path=os.path.join(events_dir, "events.jsonl"),
+    )
+    assert result["returncode"] == 0
+    assert result["launches"] == 2
+    (kill,) = [m for m in result["mitigations"]
+               if m["type"] == "stall_kill"]
+    assert kill["beats"] == 2 and kill["epoch"] == 2
+    # the process-vs-device distinction: mid-chunk beats kept landing
+    assert kill["worker_alive_s"] < 2.0
+    assert not os.path.exists(hb)    # file probe never involved
+    assert time.time() - t0 < 60
+
+
+def test_events_beats_reader_filters_stale_launches(tmp_path):
+    """A relaunch must not credit the killed worker's final beats: only
+    beats stamped after the launch count (the stream-probe equivalent of
+    the file probe's stale-beat unlink)."""
+    from dib_tpu.train.watchdog import _EventStreamBeats
+
+    from dib_tpu.telemetry.events import EventWriter
+
+    with EventWriter(str(tmp_path), run_id="r") as w:
+        old = w.heartbeat(beat=1, epoch=5, phase="boundary",
+                          intervals_s=[0.2])
+    reader = _EventStreamBeats(os.path.join(str(tmp_path), "events.jsonl"))
+    assert reader.read(min_t=0.0)["epoch"] == 5
+    reader.reset()
+    launched = old["t"] + 0.05        # "relaunch" strictly after the beat
+    assert reader.read(min_t=launched) is None
+    time.sleep(0.1)                   # the fresh worker's beat is newer
+    with EventWriter(str(tmp_path), run_id="r") as w:
+        w.heartbeat(beat=1, epoch=7, phase="boundary",
+                    intervals_s=[0.3])
+    assert reader.read(min_t=launched)["epoch"] == 7
