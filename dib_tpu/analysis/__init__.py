@@ -1,0 +1,51 @@
+"""dib-lint: a pass-based JAX-correctness static-analysis suite.
+
+PR 4's fault drills found two latent buffer-donation bugs at *runtime*
+(async checkpoint saves reading buffers ``run_chunk``'s donation had
+already reused) — a defect class that is decidable from the AST. This
+package is the static-analysis layer that catches those bug classes
+before a drill (or production) has to: one shared AST walker with
+parent/scope tracking (``core.py``), a pass registry, one pragma
+grammar (``# lint-ok(<pass>): <reason>`` — reasons mandatory), per-pass
+module allowlists with justifications, and one CLI::
+
+    python -m dib_tpu lint [paths...] [--select pass,...] [--json]
+
+Exit codes: 0 clean, 1 findings, 2 bad usage. See docs/static-analysis.md
+for the pass catalog (each pass names the runtime incident it prevents)
+and how to add a pass.
+"""
+
+from dib_tpu.analysis.core import (
+    Finding,
+    LintPass,
+    Module,
+    all_passes,
+    get_pass,
+    register,
+    run_passes,
+)
+from dib_tpu.analysis.cli import lint_main
+
+# Importing the pass modules registers them (each module calls @register
+# at import time). Keep this list in sync with docs/static-analysis.md.
+from dib_tpu.analysis.passes import (  # noqa: F401
+    donation,
+    event_schema,
+    exceptions,
+    host_sync,
+    prng,
+    thread_state,
+    timing,
+)
+
+__all__ = [
+    "Finding",
+    "LintPass",
+    "Module",
+    "all_passes",
+    "get_pass",
+    "lint_main",
+    "register",
+    "run_passes",
+]

@@ -1,0 +1,123 @@
+"""``python -m dib_tpu lint`` — the one CLI over every pass.
+
+Exit codes follow the repo's gate convention (``telemetry check``,
+``compare``): 0 clean, 1 findings, 2 bad usage. ``--json`` emits a
+stable machine-readable report (the shape tests/test_lint/test_cli.py
+pins); the default output is one ``path:line: [pass] message`` per
+finding, clickable in a terminal.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Sequence
+
+from dib_tpu.analysis import core
+
+JSON_VERSION = 1
+
+
+def _resolve_paths(paths: Sequence[str], root: str):
+    """Explicit CLI paths -> (abs, repo-relative) file pairs."""
+    pairs: list[tuple[str, str]] = []
+    for p in paths:
+        ap = os.path.abspath(p)
+        if not os.path.exists(ap):
+            raise FileNotFoundError(p)
+        if os.path.isdir(ap):
+            rel_root = os.path.relpath(ap, root).replace(os.sep, "/")
+            pairs.extend(core.iter_source_files(root, roots=(rel_root,)))
+        else:
+            pairs.append((ap, os.path.relpath(ap, root).replace(os.sep, "/")))
+    return pairs
+
+
+def lint_main(argv: Sequence[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="dib_tpu lint",
+        description="JAX-correctness static analysis over dib_tpu/ and "
+                    "scripts/ (docs/static-analysis.md). Exit 0 clean, "
+                    "1 findings, 2 bad usage.",
+    )
+    parser.add_argument("paths", nargs="*",
+                        help="Files or directories to lint (default: the "
+                             "whole tree — dib_tpu/ and scripts/).")
+    parser.add_argument("--select", default=None,
+                        help="Comma-separated pass ids to run (default: "
+                             "all). Pragma-grammar findings always "
+                             "report.")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="Machine-readable report on stdout.")
+    parser.add_argument("--list", action="store_true", dest="list_passes",
+                        help="Print the pass catalog and exit 0.")
+    parser.add_argument("--root", default=core.REPO,
+                        help=argparse.SUPPRESS)  # tests point at fixtures
+    try:
+        args = parser.parse_args(list(argv) if argv is not None else None)
+    except SystemExit as exc:
+        # argparse exits 2 on usage errors already; normalize --help to 0
+        return int(exc.code or 0)
+
+    passes = core.all_passes()
+    if args.list_passes:
+        for lint in passes:
+            print(f"{lint.id}: {lint.description}")
+            print(f"    prevents: {lint.incident}")
+        return 0
+
+    select = None
+    if args.select is not None:
+        select = [s.strip() for s in args.select.split(",") if s.strip()]
+        if not select:
+            print("dib_tpu lint: --select needs at least one pass id",
+                  file=sys.stderr)
+            return 2
+
+    files = None
+    if args.paths:
+        try:
+            files = _resolve_paths(args.paths, args.root)
+        except FileNotFoundError as exc:
+            print(f"dib_tpu lint: no such path: {exc}", file=sys.stderr)
+            return 2
+    try:
+        findings = core.run_passes(root=args.root, select=select,
+                                   files=files)
+    except KeyError as exc:
+        print(f"dib_tpu lint: {exc.args[0]}", file=sys.stderr)
+        return 2
+
+    if args.as_json:
+        selected = (passes if select is None
+                    else [core.get_pass(s) for s in sorted(set(select))])
+        print(json.dumps({
+            "version": JSON_VERSION,
+            "passes": [
+                {"id": p.id, "description": p.description,
+                 "incident": p.incident, "scope": p.scope}
+                for p in selected
+            ],
+            "findings": [
+                {"pass": f.pass_id, "path": f.path, "line": f.line,
+                 "message": f.message}
+                for f in findings
+            ],
+            "summary": {"findings": len(findings)},
+        }, indent=1, sort_keys=True))
+    else:
+        for f in findings:
+            print(f.format())
+        n = len(findings)
+        scope_desc = ("selected passes" if select is not None
+                      else f"{len(passes)} passes")
+        where = "given paths" if files is not None else "dib_tpu/ + scripts/"
+        if n:
+            print(f"\ndib-lint: {n} finding(s) from {scope_desc} over "
+                  f"{where}. Suppress a reviewed exception with "
+                  "`# lint-ok(<pass>): <reason>` (docs/static-analysis.md).")
+        else:
+            print(f"dib-lint: ok ({scope_desc} over {where})")
+    return 1 if findings else 0

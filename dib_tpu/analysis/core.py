@@ -1,0 +1,481 @@
+"""Framework shared by every lint pass: walker, pragmas, allowlists, registry.
+
+One engine, one pragma grammar. A pass sees a :class:`Module` — parsed
+source with AST parent links, per-line pragma table, and scope helpers —
+and returns :class:`Finding`\\s. The framework owns everything a pass
+should not re-implement:
+
+  - **walking** the tree (``dib_tpu/`` + ``scripts/`` by default, one
+    parse per file shared by every pass);
+  - **suppression**: a finding on a line carrying
+    ``# lint-ok(<pass>): <reason>`` is dropped — the reason is MANDATORY
+    (a reasonless pragma is itself a finding, pass id ``pragma``), and so
+    is naming a real pass (typos surface instead of silently
+    suppressing nothing). Legacy spellings ``# timing-ok: <reason>`` and
+    ``# fault-ok: <reason>`` map to the migrated ``timing-hygiene`` /
+    ``exception-hygiene`` passes so the pre-framework pragmas keep
+    working;
+  - **allowlists**: each pass may exempt whole modules, every entry
+    carrying the justification that would otherwise live in a review
+    thread (enforced non-empty at registration);
+  - **scoping**: a pass declares where it applies — the package, the
+    scripts tree, both, or an explicit module list (``target_modules``).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import os
+import re
+import tokenize
+from typing import Iterable, Iterator
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: Default lint roots, relative to the repo checkout.
+DEFAULT_ROOTS = ("dib_tpu", "scripts")
+
+#: The reserved pass id for pragma-grammar findings (always reported,
+#: never selectable away — a suppression that doesn't parse must not
+#: silently suppress, and must not silently NOT suppress either).
+PRAGMA_PASS_ID = "pragma"
+
+_PRAGMA_RE = re.compile(r"#\s*lint-ok\s*\(([^)]*)\)\s*(?::\s*(.*))?")
+#: Legacy per-check pragmas (pre-framework), mapped onto their passes.
+LEGACY_PRAGMAS = {
+    "timing-ok": "timing-hygiene",
+    "fault-ok": "exception-hygiene",
+}
+_LEGACY_RES = {
+    word: re.compile(r"#\s*" + re.escape(word) + r"\b\s*(?::\s*(.*))?")
+    for word in LEGACY_PRAGMAS
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One lint finding, anchored to a repo-relative path and 1-based line."""
+
+    pass_id: str
+    path: str          # repo-relative, forward slashes
+    line: int
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: [{self.pass_id}] {self.message}"
+
+
+@dataclasses.dataclass(frozen=True)
+class Pragma:
+    """A parsed suppression on one physical line."""
+
+    passes: tuple[str, ...]
+    reason: str
+
+
+class Module:
+    """One parsed source file, shared by every pass that looks at it.
+
+    ``tree`` is the parsed AST with parent links (``parent_of``) or
+    ``None`` when the file does not parse (``parse_error`` carries the
+    SyntaxError; the framework reports unparseable files itself).
+    ``pragmas`` maps 1-based line numbers to :class:`Pragma`.
+    """
+
+    def __init__(self, path: str, rel: str, source: str):
+        self.path = path
+        self.rel = rel
+        self.source = source
+        self.lines = source.splitlines()
+        self.pragma_findings: list[Finding] = []
+        self.pragmas: dict[int, Pragma] = {}
+        self._parse_pragmas()
+        self.parse_error: SyntaxError | None = None
+        self._parents: dict[ast.AST, ast.AST] = {}
+        try:
+            self.tree: ast.Module | None = ast.parse(source, filename=rel)
+        except SyntaxError as exc:
+            self.tree = None
+            self.parse_error = exc
+        else:
+            for parent in ast.walk(self.tree):
+                for child in ast.iter_child_nodes(parent):
+                    self._parents[child] = parent
+
+    # ------------------------------------------------------------- pragmas
+    def _comments(self) -> Iterator[tuple[int, int, str]]:
+        """(lineno, col, text) for every real COMMENT token — pragmas live
+        in comments only, so a docstring *describing* the grammar is never
+        mistaken for a suppression."""
+        try:
+            tokens = tokenize.generate_tokens(
+                io.StringIO(self.source).readline)
+            for tok in tokens:
+                if tok.type == tokenize.COMMENT:
+                    yield tok.start[0], tok.start[1], tok.string
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            return  # unparseable files are reported by the framework
+
+    def _anchor(self, lineno: int, col: int) -> int:
+        """The line a pragma suppresses: its own when it trails code, else
+        (comment-only line, where long reasons live) the next code line."""
+        if self.lines[lineno - 1][:col].strip():
+            return lineno
+        n = lineno + 1
+        while n <= len(self.lines):
+            text = self.lines[n - 1].strip()
+            if text and not text.startswith("#"):
+                return n
+            n += 1
+        return lineno
+
+    def _parse_pragmas(self) -> None:
+        for lineno, col, line in self._comments():
+            m = _PRAGMA_RE.search(line)
+            if m:
+                ids = tuple(p.strip() for p in m.group(1).split(",") if p.strip())
+                reason = (m.group(2) or "").strip()
+                if not ids or not reason:
+                    self.pragma_findings.append(Finding(
+                        PRAGMA_PASS_ID, self.rel, lineno,
+                        "suppression must name a pass and carry a reason: "
+                        "`# lint-ok(<pass>): <reason>`",
+                    ))
+                    continue
+                self._add_pragma(self._anchor(lineno, col), ids, reason)
+                continue
+            if "lint-ok" in line:
+                self.pragma_findings.append(Finding(
+                    PRAGMA_PASS_ID, self.rel, lineno,
+                    "malformed lint-ok pragma (expected "
+                    "`# lint-ok(<pass>): <reason>`)",
+                ))
+                continue
+            for word, regex in _LEGACY_RES.items():
+                m = regex.search(line)
+                if m is None:
+                    continue
+                reason = (m.group(1) or "").strip()
+                if not reason:
+                    self.pragma_findings.append(Finding(
+                        PRAGMA_PASS_ID, self.rel, lineno,
+                        f"legacy `# {word}:` pragma needs a reason",
+                    ))
+                else:
+                    self._add_pragma(self._anchor(lineno, col),
+                                     (LEGACY_PRAGMAS[word],), reason)
+
+    def _add_pragma(self, anchor: int, ids, reason: str) -> None:
+        """Record one suppression; stacked comment-only pragma lines that
+        anchor to the same code line MERGE their pass ids instead of the
+        later one silently dropping the earlier."""
+        prev = self.pragmas.get(anchor)
+        if prev is not None:
+            ids = (*prev.passes, *ids)
+        self.pragmas[anchor] = Pragma(tuple(ids), reason)
+
+    def suppressed(self, pass_id: str, line: int) -> bool:
+        pragma = self.pragmas.get(line)
+        return pragma is not None and pass_id in pragma.passes
+
+    # --------------------------------------------------------- AST helpers
+    def parent_of(self, node: ast.AST) -> ast.AST | None:
+        return self._parents.get(node)
+
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        cur = self._parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self._parents.get(cur)
+
+    def enclosing_class(self, node: ast.AST) -> ast.ClassDef | None:
+        for anc in self.ancestors(node):
+            if isinstance(anc, ast.ClassDef):
+                return anc
+        return None
+
+    def functions(self) -> Iterator[ast.FunctionDef | ast.AsyncFunctionDef]:
+        """Every function/method in the file, outermost first."""
+        if self.tree is None:
+            return
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield node
+
+    def line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+
+def statements_in_order(fn: ast.AST) -> list[ast.stmt]:
+    """Every statement lexically inside ``fn`` (excluding nested function/
+    class bodies), in source order — the linearization the scope-local
+    passes (donation, PRNG) reason over. Branches of an ``if``/``try``
+    appear in source order; that is deliberate for a lint: a read that is
+    lexically after a donating call is worth a look even when one branch
+    can't reach it."""
+    out: list[ast.stmt] = []
+
+    def visit(body: Iterable[ast.stmt]) -> None:
+        for stmt in body:
+            out.append(stmt)
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue  # nested scopes analyze separately
+            for field in ("body", "orelse", "finalbody"):
+                inner = getattr(stmt, field, None)
+                if inner:
+                    visit(inner)
+            for handler in getattr(stmt, "handlers", ()) or ():
+                visit(handler.body)
+            for case in getattr(stmt, "cases", ()) or ():
+                visit(case.body)
+
+    visit(getattr(fn, "body", ()))
+    return out
+
+
+def stmt_expr_roots(stmt: ast.stmt) -> list[ast.AST]:
+    """The expression nodes that belong to ONE statement in the
+    :func:`statements_in_order` linearization. For a simple statement
+    that is the statement itself; for a compound statement it is only
+    the header (an ``if``/``while`` test, a ``for`` iterable, ``with``
+    context expressions) — the nested statements appear later in the
+    linearization in their own right, so walking the whole subtree here
+    would double-count every read and call inside the body."""
+    if isinstance(stmt, (ast.If, ast.While)):
+        return [stmt.test]
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.iter]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return [item.context_expr for item in stmt.items]
+    if isinstance(stmt, ast.Try):
+        return []
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef)):
+        return list(stmt.decorator_list)
+    if isinstance(stmt, ast.Match):
+        return [stmt.subject]
+    return [stmt]
+
+
+def walk_stmt_exprs(stmt: ast.stmt) -> Iterator[ast.AST]:
+    """Walk exactly the nodes :func:`stmt_expr_roots` owns, pruning
+    nested function/class/lambda subtrees (separate scopes — analyzed,
+    if at all, on their own)."""
+    stack = list(stmt_expr_roots(stmt))
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+                continue
+            stack.append(child)
+
+
+def assigned_names(stmt: ast.stmt) -> set[str]:
+    """Bare names (re)bound by one statement: assignment targets including
+    tuple unpacking, aug-assign, ``for`` targets, and ``with ... as``."""
+    names: set[str] = set()
+
+    def collect(target: ast.AST) -> None:
+        if isinstance(target, ast.Name):
+            names.add(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                collect(elt)
+        elif isinstance(target, ast.Starred):
+            collect(target.value)
+
+    if isinstance(stmt, ast.Assign):
+        for target in stmt.targets:
+            collect(target)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        collect(stmt.target)
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        collect(stmt.target)
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        for item in stmt.items:
+            if item.optional_vars is not None:
+                collect(item.optional_vars)
+    return names
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> str | None:
+    """Dotted name of a call's callee (``jax.random.split`` etc.), or None
+    for computed callees."""
+    return dotted_name(call.func)
+
+
+# ------------------------------------------------------------------ passes
+class LintPass:
+    """Base class for one lint pass.
+
+    Subclasses set:
+
+    - ``id``: the pass id used in ``--select`` and pragmas (kebab-case);
+    - ``description``: one line, shown by ``lint --list``;
+    - ``incident``: the runtime incident this pass prevents (shown in the
+      pass catalog — every pass exists because something burned time);
+    - ``scope``: ``"all"`` (default), ``"package"`` (``dib_tpu/`` only),
+      or ``"scripts"``;
+    - ``target_modules``: optional explicit repo-relative module list —
+      when set, the pass runs ONLY on those modules (e.g. host-sync
+      hygiene applies to the chunk-loop modules);
+    - ``allowlist``: ``{repo-relative path: justification}`` module
+      exemptions.
+
+    and implement :meth:`check_module`; :meth:`check_project` optionally
+    adds whole-project checks (e.g. schema-vs-docs drift).
+    """
+
+    id: str = ""
+    description: str = ""
+    incident: str = ""
+    scope: str = "all"
+    target_modules: tuple[str, ...] | None = None
+    allowlist: dict[str, str] = {}
+
+    def applies_to(self, rel: str) -> bool:
+        if self.target_modules is not None:
+            return rel in self.target_modules
+        if self.scope == "package":
+            return rel.startswith("dib_tpu/")
+        if self.scope == "scripts":
+            return rel.startswith("scripts/")
+        return True
+
+    def check_module(self, module: Module) -> list[Finding]:
+        return []
+
+    def check_project(self, root: str) -> list[Finding]:
+        return []
+
+    def finding(self, module: Module, line: int, message: str) -> Finding:
+        return Finding(self.id, module.rel, line, message)
+
+
+REGISTRY: dict[str, LintPass] = {}
+
+
+def register(cls: type[LintPass]) -> type[LintPass]:
+    """Class decorator: instantiate and register one pass."""
+    inst = cls()
+    if not inst.id or not inst.description or not inst.incident:
+        raise ValueError(
+            f"{cls.__name__}: a pass must declare id, description, and the "
+            "runtime incident it prevents")
+    if inst.id == PRAGMA_PASS_ID:
+        raise ValueError(f"pass id {PRAGMA_PASS_ID!r} is reserved")
+    if inst.id in REGISTRY:
+        raise ValueError(f"duplicate pass id {inst.id!r}")
+    for rel, why in inst.allowlist.items():
+        if not why or not why.strip():
+            raise ValueError(
+                f"{inst.id}: allowlist entry {rel!r} needs a justification")
+    REGISTRY[inst.id] = inst
+    return cls
+
+
+def all_passes() -> list[LintPass]:
+    return [REGISTRY[k] for k in sorted(REGISTRY)]
+
+
+def get_pass(pass_id: str) -> LintPass:
+    return REGISTRY[pass_id]
+
+
+# ------------------------------------------------------------------ runner
+def iter_source_files(root: str, roots: Iterable[str] = DEFAULT_ROOTS,
+                      ) -> Iterator[tuple[str, str]]:
+    """Yield ``(abs_path, repo_relative)`` for every ``.py`` under the lint
+    roots, sorted, ``__pycache__`` pruned."""
+    for sub in roots:
+        base = os.path.join(root, sub)
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+            for fname in sorted(filenames):
+                if not fname.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fname)
+                yield path, os.path.relpath(path, root).replace(os.sep, "/")
+
+
+def load_module(path: str, rel: str) -> Module:
+    with open(path, encoding="utf-8") as f:
+        return Module(path, rel, f.read())
+
+
+def run_passes(
+    root: str = REPO,
+    roots: Iterable[str] = DEFAULT_ROOTS,
+    select: Iterable[str] | None = None,
+    files: Iterable[tuple[str, str]] | None = None,
+) -> list[Finding]:
+    """Run the (selected) passes over the tree; returns surviving findings.
+
+    Pragma suppression and allowlists are applied here — a pass never
+    sees its own suppressions. Pragma-grammar problems (reasonless or
+    malformed suppressions, pragmas naming unknown passes) are reported
+    under the reserved ``pragma`` id regardless of ``select``: a
+    suppression that doesn't parse silently changes what the suite
+    checks, so it can never be filtered out.
+    """
+    passes = all_passes()
+    if select is not None:
+        select = sorted(set(select))
+        unknown = [s for s in select if s not in REGISTRY]
+        if unknown:
+            raise KeyError(
+                f"unknown pass id(s) {unknown}; available: "
+                f"{sorted(REGISTRY)}")
+        passes = [REGISTRY[s] for s in select]
+    known_ids = set(REGISTRY)
+
+    findings: list[Finding] = []
+    pairs = list(files) if files is not None else list(
+        iter_source_files(root, roots))
+    for path, rel in pairs:
+        module = load_module(path, rel)
+        findings.extend(module.pragma_findings)
+        for lineno, pragma in module.pragmas.items():
+            for pid in pragma.passes:
+                if pid not in known_ids:
+                    findings.append(Finding(
+                        PRAGMA_PASS_ID, rel, lineno,
+                        f"pragma suppresses unknown pass {pid!r} "
+                        f"(available: {sorted(known_ids)})"))
+        if module.parse_error is not None:
+            findings.append(Finding(
+                PRAGMA_PASS_ID, rel, module.parse_error.lineno or 1,
+                f"file does not parse: {module.parse_error.msg}"))
+            continue
+        for lint in passes:
+            if not lint.applies_to(rel):
+                continue
+            if rel in lint.allowlist:
+                continue
+            for finding in lint.check_module(module):
+                if not module.suppressed(lint.id, finding.line):
+                    findings.append(finding)
+    if files is None:  # project-level checks run only on full-tree runs
+        for lint in passes:
+            findings.extend(lint.check_project(root))
+    findings.sort(key=lambda f: (f.path, f.line, f.pass_id, f.message))
+    return findings

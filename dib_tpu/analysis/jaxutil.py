@@ -1,0 +1,163 @@
+"""JAX-aware AST helpers shared by the analysis passes.
+
+The donation, host-sync, and PRNG passes all need the same facts about a
+module: which locally-defined callables are jitted, which of those donate
+which parameters, and how a call site's arguments map onto those
+parameters. This module derives them once per :class:`~.core.Module`.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from dib_tpu.analysis.core import Module, call_name, dotted_name
+
+
+@dataclasses.dataclass(frozen=True)
+class JittedFn:
+    """One locally-defined jitted callable."""
+
+    name: str
+    params: tuple[str, ...]      # positional-or-keyword params, in order
+    donated: frozenset[str]      # subset of params donated to XLA
+    is_method: bool              # defined inside a class (self-first)
+    lineno: int
+
+    def donated_args(self, call: ast.Call) -> dict[str, int]:
+        """``{variable name: lineno}`` for every bare-Name argument the
+        call binds to a donated parameter. A bound-method call
+        (``self.run_chunk(state, ...)``) maps positionals one parameter
+        later than an unbound call — and an unbound call through an
+        attribute (``type(self).run_chunk(self, state, ...)``,
+        ``Trainer.run_chunk(self, ...)``) is recognized by its explicit
+        leading ``self`` argument, which a bound call never passes."""
+        offset = 0
+        if self.is_method and isinstance(call.func, ast.Attribute):
+            first = call.args[0] if call.args else None
+            explicit_self = (self.params
+                             and isinstance(first, ast.Name)
+                             and first.id == self.params[0])
+            offset = 0 if explicit_self else 1
+        out: dict[str, int] = {}
+        for i, arg in enumerate(call.args):
+            idx = i + offset
+            if idx < len(self.params) and self.params[idx] in self.donated \
+                    and isinstance(arg, ast.Name):
+                out[arg.id] = arg.lineno
+        for kw in call.keywords:
+            if kw.arg in self.donated and isinstance(kw.value, ast.Name):
+                out[kw.value.id] = kw.value.lineno
+        return out
+
+
+def _jit_decoration(node: ast.expr) -> dict | None:
+    """Inspect one decorator (or an assigned value): returns
+    ``{"donate_argnames": [...], "donate_argnums": [...]}`` (either may be
+    empty) when the expression is a ``jax.jit``/``partial(jax.jit, ...)``
+    application, else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    callee = call_name(node)
+    inner_is_jit = False
+    if callee in ("partial", "functools.partial") and node.args:
+        inner = dotted_name(node.args[0])
+        inner_is_jit = inner in ("jax.jit", "jit", "pjit", "jax.pjit")
+    is_jit = callee in ("jax.jit", "jit", "pjit", "jax.pjit") or inner_is_jit
+    if not is_jit:
+        return None
+    spec: dict = {"donate_argnames": [], "donate_argnums": []}
+    for kw in node.keywords:
+        if kw.arg == "donate_argnames":
+            spec["donate_argnames"] = _string_elts(kw.value)
+        elif kw.arg == "donate_argnums":
+            spec["donate_argnums"] = _int_elts(kw.value)
+    return spec
+
+
+def _string_elts(node: ast.expr) -> list[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)]
+    return []
+
+
+def _int_elts(node: ast.expr) -> list[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, int)]
+    return []
+
+
+def _params(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> tuple[str, ...]:
+    args = fn.args
+    return tuple(a.arg for a in (*args.posonlyargs, *args.args))
+
+
+def jitted_callables(module: Module) -> dict[str, JittedFn]:
+    """Every locally-defined jitted callable in the module, by name —
+    ``@partial(jax.jit, ...)`` / ``@jax.jit`` decorated defs plus
+    ``name = jax.jit(fn, ...)`` rebindings of a local def. ``donated``
+    resolves ``donate_argnames`` directly and ``donate_argnums`` through
+    the wrapped function's parameter list."""
+    if module.tree is None:
+        return {}
+    defs: dict[str, ast.FunctionDef | ast.AsyncFunctionDef] = {}
+    for node in ast.walk(module.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs[node.name] = node
+    out: dict[str, JittedFn] = {}
+    for name, fn in defs.items():
+        for deco in fn.decorator_list:
+            spec = _jit_decoration(deco)
+            if spec is None:
+                continue
+            params = _params(fn)
+            donated = set(spec["donate_argnames"])
+            donated.update(params[i] for i in spec["donate_argnums"]
+                           if i < len(params))
+            out[name] = JittedFn(
+                name, params, frozenset(donated),
+                is_method=module.enclosing_class(fn) is not None,
+                lineno=fn.lineno,
+            )
+            break
+    # name = jax.jit(local_fn, donate_argnums=...) rebindings
+    for node in ast.walk(module.tree):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)):
+            continue
+        spec = _jit_decoration(node.value)
+        if spec is None:
+            continue
+        bound = node.targets[0].id
+        wrapped = (node.value.args[0] if node.value.args else None)
+        wrapped_def = (defs.get(wrapped.id)
+                       if isinstance(wrapped, ast.Name) else None)
+        params = _params(wrapped_def) if wrapped_def is not None else ()
+        donated = set(spec["donate_argnames"])
+        donated.update(params[i] for i in spec["donate_argnums"]
+                       if i < len(params))
+        out[bound] = JittedFn(
+            bound, params, frozenset(donated),
+            is_method=False, lineno=node.lineno,
+        )
+    return out
+
+
+def match_callable(call: ast.Call, registry: dict[str, JittedFn]
+                   ) -> JittedFn | None:
+    """The registry entry a call site resolves to: a bare-name call
+    (``run_chunk(...)``) or any attribute call with a matching terminal
+    name (``self.run_chunk(...)``, ``trainer.run_chunk(...)``)."""
+    func = call.func
+    if isinstance(func, ast.Name):
+        return registry.get(func.id)
+    if isinstance(func, ast.Attribute):
+        return registry.get(func.attr)
+    return None

@@ -1,0 +1,4 @@
+"""The shipped lint passes. Importing a module registers its pass(es);
+``dib_tpu/analysis/__init__.py`` imports them all. Each module carries
+one pass and names, in its docstring, the runtime incident that pass
+exists to prevent — see docs/static-analysis.md for the catalog."""

@@ -1,0 +1,164 @@
+"""donation-safety: reads of donated buffers are use-after-free.
+
+The incident (PR 4, docs/robustness.md "Checkpoint corruption"): orbax's
+async save read zero-copy host buffers that ``run_chunk``'s
+``donate_argnames`` donation had already reused — checkpoint steps landed
+on disk holding a LATER epoch's bytes, poisoning the divergence-rollback
+target. The bug class is decidable from the AST, and this pass decides
+it, two ways:
+
+1. **read-after-donation**: an argument bound to a ``donate_argnames`` /
+   ``donate_argnums`` parameter is dead after the donating call — XLA owns
+   (and will reuse) its buffer. Any later read of that name in the same
+   scope is flagged, unless the name was rebound first (the
+   ``state, history = self.run_chunk(state, history, ...)`` idiom rebinds
+   at the same statement and is clean).
+
+2. **async-save-of-device-buffers**: a jitted-call result handed to an
+   (async) checkpoint ``save``/``async_save`` without an intervening host
+   copy — the background writer races the next chunk's donation for the
+   same memory. Rebinding through ``jax.device_get`` / ``np.array`` /
+   ``.copy()`` clears the taint; synchronous writers (``np.save`` etc.)
+   are exempt.
+
+Both analyses are intraprocedural over lexical statement order — precise
+enough to flag the PR 4 shape (see tests/test_lint/fixtures/) while
+leaving the fixed ``train/checkpoint.py`` (which waits on CPU) clean.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from dib_tpu.analysis.core import (
+    Finding,
+    LintPass,
+    Module,
+    assigned_names,
+    register,
+    statements_in_order,
+    walk_stmt_exprs,
+)
+from dib_tpu.analysis.jaxutil import jitted_callables, match_callable
+
+#: Attribute names treated as an async checkpoint save sink.
+_SAVE_ATTRS = {"save", "async_save"}
+#: Receivers whose ``.save`` is a synchronous host write, not an async
+#: checkpointer (numpy/matplotlib/json et al read the buffer before
+#: returning, which is safe — donation only reuses buffers on the NEXT
+#: jitted call, by which point a synchronous save has completed).
+_SYNC_SAVE_BASES = {"np", "numpy", "jnp", "plt", "pickle", "json", "os"}
+def _names_read(stmt: ast.stmt) -> list[ast.Name]:
+    """Every bare-Name load owned by one statement (compound-statement
+    bodies and nested defs excluded — they are analyzed on their own)."""
+    return [n for n in walk_stmt_exprs(stmt)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)]
+
+
+def _calls(stmt: ast.stmt) -> list[ast.Call]:
+    return [n for n in walk_stmt_exprs(stmt) if isinstance(n, ast.Call)]
+
+
+@register
+class DonationSafetyPass(LintPass):
+    id = "donation-safety"
+    description = ("reads of donated buffers after the donating call, and "
+                   "jitted results handed to async checkpoint saves "
+                   "without a host copy")
+    incident = ("PR 4: async orbax saves read buffers run_chunk's donation "
+                "had already reused — checkpoint steps held a later "
+                "epoch's bytes (docs/robustness.md)")
+
+    def check_module(self, module: Module) -> list[Finding]:
+        registry = jitted_callables(module)
+        if not registry:
+            return []
+        findings: list[Finding] = []
+        for fn in module.functions():
+            findings.extend(self._check_scope(module, fn, registry))
+        return findings
+
+    def _check_scope(self, module, fn, registry) -> list[Finding]:
+        findings: list[Finding] = []
+        # name -> (donating call lineno, callee name); dead after donation
+        dead: dict[str, tuple[int, str]] = {}
+        # name -> (assigning lineno, callee name); device-fresh jit results
+        fresh: dict[str, tuple[int, str]] = {}
+        for stmt in statements_in_order(fn):
+            # 1. reads of donated names (before this stmt's own donations:
+            #    the donating call's own argument reads are legal)
+            for name_node in _names_read(stmt):
+                hit = dead.get(name_node.id)
+                if hit is not None:
+                    call_line, callee = hit
+                    findings.append(self.finding(
+                        module, name_node.lineno,
+                        f"`{name_node.id}` was donated to `{callee}` at "
+                        f"line {call_line} — its buffer now belongs to XLA "
+                        "and may hold the next call's output; rebind the "
+                        "name to the call's result or fetch what you need "
+                        "before the donating call",
+                    ))
+            # 2. async checkpoint saves of device-fresh jit results
+            for call in _calls(stmt):
+                func = call.func
+                if not (isinstance(func, ast.Attribute)
+                        and func.attr in _SAVE_ATTRS):
+                    continue
+                base = func.value
+                base_name = base.id if isinstance(base, ast.Name) else None
+                if base_name in _SYNC_SAVE_BASES:
+                    continue
+                tainted = None
+                for expr in (*call.args,
+                             *(kw.value for kw in call.keywords)):
+                    for node in ast.walk(expr):
+                        if isinstance(node, ast.Name) and node.id in fresh:
+                            tainted = node.id
+                            break
+                    if tainted:
+                        break
+                if tainted:
+                    src_line, callee = fresh[tainted]
+                    findings.append(self.finding(
+                        module, call.lineno,
+                        f"`{tainted}` (result of jitted `{callee}` at "
+                        f"line {src_line}) handed to an async checkpoint "
+                        f"`{func.attr}` without a host copy — the "
+                        "background writer reads it zero-copy while the "
+                        "next donating call reuses the same buffer (the "
+                        "PR 4 incident); `jax.device_get` it first, or "
+                        "wait for the save before the next chunk",
+                    ))
+            # 3. this stmt's donations kill their argument names …
+            for call in _calls(stmt):
+                target = match_callable(call, registry)
+                if target is None or not target.donated:
+                    continue
+                for name, _line in target.donated_args(call).items():
+                    dead[name] = (call.lineno, target.name)
+            # 4. … and any (re)assignment resurrects / re-taints names.
+            #    Assignment runs after the RHS call, so the
+            #    `x, y = f(x, y)` rebind idiom ends up alive, and a name
+            #    assigned from a jitted call becomes device-fresh (a host
+            #    copy clears the taint instead).
+            assigned = assigned_names(stmt)
+            if assigned:
+                value = getattr(stmt, "value", None)
+                value_jit = (match_callable(value, registry)
+                             if isinstance(value, ast.Call) else None)
+                for name in assigned:
+                    dead.pop(name, None)
+                    if value_jit is not None:
+                        fresh[name] = (stmt.lineno, value_jit.name)
+                    else:
+                        # any other assignment — including a host copy
+                        # (jax.device_get / np.array / .copy()) — clears
+                        # the device-buffer taint
+                        fresh.pop(name, None)
+            if isinstance(stmt, ast.Delete):
+                for target in stmt.targets:
+                    if isinstance(target, ast.Name):
+                        dead.pop(target.id, None)
+                        fresh.pop(target.id, None)
+        return findings

@@ -1,0 +1,196 @@
+"""event-schema: every emit call site agrees with EVENT_SCHEMA, and so
+do the docs.
+
+The incident: the event stream grew to 13 kinds across six PRs with the
+schema living only in prose — ``events.py``'s docstring claimed mid-chunk
+heartbeats carry ``chunk_elapsed_s`` while the code emitted
+``phase_elapsed_s`` (found by writing this pass), and nothing stopped a
+call site from inventing a kind or misspelling a field that ``summarize``
+would then silently never roll up. The registry
+(``dib_tpu.telemetry.events.EVENT_SCHEMA``) is now the single source of
+truth; this pass holds the other two surfaces to it:
+
+- **call sites**: every ``<writer>.emit("<kind>", ...)`` and typed-helper
+  call (``.mitigation(...)``, ``.heartbeat(...)``, …) on a recognized
+  writer is checked — the kind must exist, explicit keyword fields must
+  be in the kind's vocabulary, and a literal-kind ``emit`` must pass
+  every required field (``**kwargs`` forwarding defers to runtime, where
+  ``DIB_TELEMETRY_STRICT=1`` still gates kind membership);
+- **docs**: the record-type table in docs/observability.md must list
+  exactly the schema's kinds (``request``/``batch`` are documented
+  aliases of ``span``).
+
+Writers are recognized conservatively by receiver shape (``telemetry``,
+``writer``, ``self.telemetry``, ``self._telemetry``, or a local assigned
+from ``EventWriter(...)``/``open_writer(...)``) — a ``.save()``-shaped
+heuristic that never fires is worse than one that misses an exotic
+alias, and every emitting module in the tree uses these names.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from dib_tpu.analysis.core import (
+    Finding,
+    LintPass,
+    Module,
+    dotted_name,
+    register,
+)
+
+#: Receiver spellings recognized as an EventWriter.
+_WRITER_RECEIVERS = {"telemetry", "writer", "self.telemetry",
+                     "self._telemetry", "self.writer", "self._writer"}
+#: Kinds documented in docs/observability.md as named span events.
+_DOC_SPAN_ALIASES = {"request", "batch"}
+
+#: Typed helpers whose parameter names differ from the wire field they
+#: emit (``EventWriter.span(span_id=..., parent_id=...)`` writes
+#: ``span``/``parent``); call-site kwargs are translated before the
+#: vocabulary check.
+_HELPER_PARAM_ALIASES = {
+    "span": {"span_id": "span", "parent_id": "parent"},
+}
+
+_DOC_KIND_RE = re.compile(r"\*\*`([a-z_]+)`\*\*")
+
+
+def _schema():
+    from dib_tpu.telemetry.events import EVENT_SCHEMA
+
+    return EVENT_SCHEMA
+
+
+def _writer_locals(module: Module) -> set[str]:
+    """Local names assigned from EventWriter(...) / open_writer(...)."""
+    out: set[str] = set()
+    if module.tree is None:
+        return out
+    for node in ast.walk(module.tree):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)):
+            continue
+        callee = dotted_name(node.value.func)
+        if callee and callee.split(".")[-1] in ("EventWriter",
+                                                "open_writer"):
+            out.add(node.targets[0].id)
+    return out
+
+
+@register
+class EventSchemaPass(LintPass):
+    id = "event-schema"
+    description = ("emit/typed-helper call sites checked against the "
+                   "EVENT_SCHEMA registry; docs/observability.md checked "
+                   "against the same rows")
+    incident = ("events.py documented a heartbeat field the code never "
+                "emitted (chunk_elapsed_s vs phase_elapsed_s); a "
+                "misspelled field is invisible to summarize forever")
+
+    def check_module(self, module: Module) -> list[Finding]:
+        if module.tree is None:
+            return []
+        if module.rel == "dib_tpu/telemetry/events.py":
+            # the registry's own module: typed helpers forward to emit()
+            # with a variable kind — nothing checkable at this layer
+            return []
+        schema = _schema()
+        helper_kinds = set(schema)  # every typed helper is named its kind
+        receivers = set(_WRITER_RECEIVERS) | _writer_locals(module)
+        findings: list[Finding] = []
+        for call in ast.walk(module.tree):
+            if not (isinstance(call, ast.Call)
+                    and isinstance(call.func, ast.Attribute)):
+                continue
+            method = call.func.attr
+            if method != "emit" and method not in helper_kinds:
+                continue
+            recv = dotted_name(call.func.value)
+            if recv not in receivers:
+                continue
+            if method == "emit":
+                if not (call.args
+                        and isinstance(call.args[0], ast.Constant)
+                        and isinstance(call.args[0].value, str)):
+                    continue  # variable kind: runtime strict mode owns it
+                kind = call.args[0].value
+                if kind not in schema:
+                    findings.append(self.finding(
+                        module, call.lineno,
+                        f"emit of unknown event kind {kind!r} — add a row "
+                        "to telemetry/events.py EVENT_SCHEMA and document "
+                        "it in docs/observability.md",
+                    ))
+                    continue
+            else:
+                kind = method
+            spec = schema[kind]
+            vocab = set(spec.required) | set(spec.optional)
+            has_splat = any(kw.arg is None for kw in call.keywords)
+            aliases = _HELPER_PARAM_ALIASES.get(kind, {})
+            explicit = {aliases.get(kw.arg, kw.arg)
+                        for kw in call.keywords if kw.arg}
+            unknown = sorted(explicit - vocab)
+            if unknown:
+                findings.append(self.finding(
+                    module, call.lineno,
+                    f"event kind {kind!r} has no field(s) {unknown} in "
+                    "EVENT_SCHEMA — add them to the kind's row (and "
+                    "docs/observability.md) or fix the spelling",
+                ))
+            if method == "emit" and not has_splat:
+                missing = sorted(set(spec.required) - explicit)
+                if missing:
+                    findings.append(self.finding(
+                        module, call.lineno,
+                        f"emit of kind {kind!r} is missing required "
+                        f"field(s) {missing} — or use the typed "
+                        f"`.{kind}(...)` helper, whose signature binds "
+                        "them",
+                    ))
+        return findings
+
+    # ------------------------------------------------------ project level
+    def check_project(self, root: str) -> list[Finding]:
+        """Schema ↔ docs drift: docs/observability.md's record-type list
+        must contain exactly EVENT_SCHEMA's kinds (+ the span aliases)."""
+        schema = _schema()
+        doc_rel = "docs/observability.md"
+        path = os.path.join(root, doc_rel)
+        try:
+            with open(path, encoding="utf-8") as f:
+                lines = f.read().splitlines()
+        except OSError:
+            return [Finding(self.id, doc_rel, 1,
+                            "docs/observability.md missing — the event "
+                            "schema must stay documented")]
+        documented: dict[str, int] = {}
+        in_section = False
+        for lineno, line in enumerate(lines, 1):
+            if line.startswith("Record types and their payloads"):
+                in_section = True
+                continue
+            if in_section and line.startswith("#"):
+                break
+            if in_section and line.lstrip().startswith("- **`"):
+                for kind in _DOC_KIND_RE.findall(line):
+                    documented.setdefault(kind, lineno)
+        findings: list[Finding] = []
+        for kind in sorted(set(schema) - set(documented)):
+            findings.append(Finding(
+                self.id, doc_rel, 1,
+                f"EVENT_SCHEMA kind {kind!r} is not documented in the "
+                "record-type list",
+            ))
+        for kind, lineno in sorted(documented.items()):
+            if kind not in schema and kind not in _DOC_SPAN_ALIASES:
+                findings.append(Finding(
+                    self.id, doc_rel, lineno,
+                    f"documented record type {kind!r} has no EVENT_SCHEMA "
+                    "row — the registry is the source of truth",
+                ))
+        return findings

@@ -1,0 +1,81 @@
+"""exception-hygiene: no silently-swallowed broad exceptions.
+
+The incident (PR 4, docs/robustness.md): a robustness subsystem is only
+as honest as its error handling — an ``except Exception: pass`` turns a
+real fault into nothing (no re-raise, no error result, no telemetry
+event), which is exactly how a recovery path rots until a drill or
+production finds it.
+
+Migrated from ``scripts/check_exception_hygiene.py`` (which now
+delegates here), and widened from the package to the whole tree —
+``scripts/`` drive the committed benchmarks and drills, where a
+swallowed exception corrupts the measured history instead of a serving
+path. Flags any handler that catches a BROAD type (bare ``except:``,
+``Exception``, ``BaseException`` — alone or in a tuple) with a body that
+does NOTHING (only ``pass``/``...``). Narrow handlers, re-raises,
+logging, and error results all pass. The legacy ``# fault-ok: <reason>``
+pragma still works; new code should prefer
+``# lint-ok(exception-hygiene): <reason>``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from dib_tpu.analysis.core import Finding, LintPass, Module, register
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _broad_names(handler: ast.ExceptHandler) -> bool:
+    """True when the handler catches Exception/BaseException or is bare."""
+    node = handler.type
+    if node is None:
+        return True
+    elts = node.elts if isinstance(node, ast.Tuple) else [node]
+    for elt in elts:
+        name = elt.id if isinstance(elt, ast.Name) else (
+            elt.attr if isinstance(elt, ast.Attribute) else None)
+        if name in _BROAD:
+            return True
+    return False
+
+
+def _body_is_silent(handler: ast.ExceptHandler) -> bool:
+    """True when the body does nothing: only pass / bare ellipsis."""
+    for stmt in handler.body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if (isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Constant)
+                and stmt.value.value is Ellipsis):
+            continue
+        return False
+    return True
+
+
+@register
+class ExceptionHygienePass(LintPass):
+    id = "exception-hygiene"
+    description = ("broad exception handlers (bare/Exception/"
+                   "BaseException) whose body does nothing")
+    incident = ("PR 4: `except Exception: pass` hides exactly the faults "
+                "the recovery paths exist for; the drills only prove "
+                "paths that are allowed to fail loudly "
+                "(docs/robustness.md)")
+
+    def check_module(self, module: Module) -> list[Finding]:
+        if module.tree is None:
+            return []
+        findings: list[Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if _broad_names(node) and _body_is_silent(node):
+                findings.append(self.finding(
+                    module, node.lineno,
+                    "silent broad exception handler: re-raise, return an "
+                    "error result, or emit a telemetry event — or narrow "
+                    "the type (docs/robustness.md)",
+                ))
+        return findings

@@ -1,0 +1,116 @@
+"""host-sync: implicit device→host coercions stall the dispatch pipeline.
+
+The incident (PR 2, docs/observability.md "async-dispatch pitfall" and
+the MFU work in BENCH_r05): a bare ``float(loss)`` / ``.item()`` /
+``np.asarray(...)`` on a jitted call's result is a BLOCKING device fetch
+— it parks the host until the whole dispatched program finishes, breaks
+chunk-to-chunk pipelining, and (when it sneaks into a loop) turns an
+async training loop into a synchronous one. The repo's idiom is ONE
+explicit ``jax.device_get`` of a small dict per chunk boundary (see
+``train/loop.py``'s boundary-row fetch), after the boundary's
+``block_on`` has already paid for the sync.
+
+This pass guards the chunk-loop modules (``target_modules``): inside
+them, applying ``float()`` / ``int()`` / ``bool()`` / ``.item()`` /
+``np.asarray()`` to a value that came from a locally-jitted call is
+flagged. Fetching through ``jax.device_get`` first — or rebinding the
+result at all — clears the taint, so the blocking-fetch idiom passes
+clean. A deliberate coercion (e.g. a one-off pre-loop fetch) carries a
+``# lint-ok(host-sync): <reason>`` pragma.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from dib_tpu.analysis.core import (
+    Finding,
+    LintPass,
+    Module,
+    assigned_names,
+    call_name,
+    register,
+    statements_in_order,
+    walk_stmt_exprs,
+)
+from dib_tpu.analysis.jaxutil import jitted_callables, match_callable
+
+_COERCIONS = {"float", "int", "bool"}
+_ARRAY_COERCIONS = {"np.asarray", "numpy.asarray", "np.array", "numpy.array"}
+
+
+def _base_name(node: ast.expr) -> str | None:
+    """The root Name of a Name/Attribute/Subscript chain."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+@register
+class HostSyncPass(LintPass):
+    id = "host-sync"
+    description = ("implicit device→host coercions (float()/int()/bool()/"
+                   ".item()/np.asarray) on jitted results in the "
+                   "chunk-loop modules")
+    incident = ("PR 2 / BENCH_r05: hidden blocking fetches serialized the "
+                "chunk pipeline — the MFU work exists because the host "
+                "kept parking on implicit syncs (docs/observability.md, "
+                "async-dispatch pitfall)")
+    # The modules whose inner loops are the product's hot path. Everything
+    # else may fetch freely — drivers and hooks run between chunks.
+    target_modules = (
+        "dib_tpu/train/loop.py",
+        "dib_tpu/parallel/sweep.py",
+        "dib_tpu/workloads/boolean.py",
+    )
+
+    def check_module(self, module: Module) -> list[Finding]:
+        registry = jitted_callables(module)
+        if not registry:
+            return []
+        findings: list[Finding] = []
+        for fn in module.functions():
+            findings.extend(self._check_scope(module, fn, registry))
+        return findings
+
+    def _check_scope(self, module, fn, registry) -> list[Finding]:
+        findings: list[Finding] = []
+        device: dict[str, int] = {}   # name -> line it became device-fresh
+        for stmt in statements_in_order(fn):
+            for call in (n for n in walk_stmt_exprs(stmt)
+                         if isinstance(n, ast.Call)):
+                name = call_name(call)
+                coerced: ast.expr | None = None
+                kind = None
+                if name in _COERCIONS and len(call.args) == 1:
+                    coerced, kind = call.args[0], f"{name}()"
+                elif name in _ARRAY_COERCIONS and call.args:
+                    coerced, kind = call.args[0], f"{name}()"
+                elif (isinstance(call.func, ast.Attribute)
+                        and call.func.attr == "item" and not call.args):
+                    coerced, kind = call.func.value, ".item()"
+                if coerced is None:
+                    continue
+                base = _base_name(coerced)
+                if base is not None and base in device:
+                    findings.append(self.finding(
+                        module, call.lineno,
+                        f"{kind} on `{base}` (device-fresh since line "
+                        f"{device[base]}) is an implicit blocking "
+                        "device→host fetch in a chunk-loop module — batch "
+                        "it into the boundary's single `jax.device_get` "
+                        "fetch (the blocking-fetch idiom, "
+                        "docs/observability.md)",
+                    ))
+            assigned = assigned_names(stmt)
+            if assigned:
+                value = getattr(stmt, "value", None)
+                value_jit = (match_callable(value, registry)
+                             if isinstance(value, ast.Call) else None)
+                for name in assigned:
+                    if value_jit is not None:
+                        device[name] = stmt.lineno
+                    else:
+                        # jax.device_get / any other rebind clears it
+                        device.pop(name, None)
+        return findings

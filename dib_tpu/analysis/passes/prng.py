@@ -1,0 +1,173 @@
+"""prng-reuse: one PRNG key, one consumer.
+
+The incident class: reusing a key across two consuming calls silently
+correlates the "random" draws — batches sampled identically to the noise,
+val splits identical across replicas, sweeps whose members share
+trajectories. Nothing crashes; the statistics are just wrong, which is
+the worst way for a training run to fail (the reference codebase's own
+key-handling was one of the bug classes PARITY.md had to characterize).
+
+The rule: a key variable — one assigned from ``jax.random.PRNGKey`` /
+``split`` / ``fold_in`` / ``wrap_key_data`` (including tuple-unpack from
+``split``) or a parameter named like a key (``key``, ``rng``, ``k_*``) —
+may be passed to at most ONE consuming call before being rebound through
+``jax.random.split`` / ``fold_in``. Passing a key to ``split``/``fold_in``
+derives fresh keys and is sanctioned; anything else (a ``jax.random.*``
+sampler, a model ``init``/``apply``, a fit) consumes it. A second
+consumption without an intervening rebind is flagged, as is a consumption
+inside a loop whose body never rebinds the key (every iteration reuses
+the same key — the classic copy-paste bug).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from dib_tpu.analysis.core import (
+    Finding,
+    LintPass,
+    Module,
+    assigned_names,
+    call_name,
+    register,
+    statements_in_order,
+    walk_stmt_exprs,
+)
+
+#: jax.random calls that derive fresh keys or only inspect one — passing
+#: a key to these never consumes its entropy.
+_DERIVING = {"split", "fold_in", "wrap_key_data", "PRNGKey", "key", "clone",
+             "key_data", "key_impl"}
+
+#: Parameter names treated as keys on sight (locals are tracked by
+#: provenance instead — anything assigned from a deriving call).
+_KEY_PARAM = re.compile(r"^(key|rng|prng_key|k_[a-z0-9_]+)$")
+
+
+def _is_deriving_call(call: ast.Call) -> bool:
+    name = call_name(call)
+    if name is None:
+        return False
+    parts = name.split(".")
+    if len(parts) == 1:
+        # bare `split(key)` via `from jax.random import split`; the other
+        # deriving names are too generic to trust unqualified
+        return parts[0] in ("split", "fold_in", "PRNGKey")
+    return parts[-1] in _DERIVING and parts[0] in ("jax", "random", "jr")
+
+
+def _is_key_producing(value: ast.expr) -> bool:
+    return isinstance(value, ast.Call) and _is_deriving_call(value)
+
+
+@register
+class PrngReusePass(LintPass):
+    id = "prng-reuse"
+    description = ("a PRNG key passed to two consuming calls without an "
+                   "intervening jax.random.split/fold_in rebind")
+    incident = ("reused keys correlate 'independent' draws — batches "
+                "sampled identically to the reparameterization noise, "
+                "replicas sharing trajectories; wrong statistics, no "
+                "crash")
+
+    def check_module(self, module: Module) -> list[Finding]:
+        findings: list[Finding] = []
+        # Key-shaped PARAMETER names only mean "PRNG key" in modules that
+        # actually touch jax.random — elsewhere `key` is a dict key
+        # (telemetry/report.py's chunk_series) and tracking it would be
+        # all noise. Locals are tracked by provenance regardless.
+        params_are_keys = "jax.random" in module.source
+        for fn in module.functions():
+            findings.extend(
+                self._check_scope(module, fn, params_are_keys))
+        return findings
+
+    def _check_scope(self, module: Module, fn,
+                     params_are_keys: bool) -> list[Finding]:
+        findings: list[Finding] = []
+        stmts = statements_in_order(fn)
+        # name -> line of the assignment that made it a key (or 0 = param)
+        keys: dict[str, int] = {}
+        if params_are_keys:
+            for arg in (*fn.args.posonlyargs, *fn.args.args,
+                        *fn.args.kwonlyargs):
+                if _KEY_PARAM.match(arg.arg):
+                    keys[arg.arg] = 0
+        # name -> line of its one allowed consumption
+        consumed: dict[str, int] = {}
+        loop_rebinds = self._loop_rebinds(fn)
+        for stmt in stmts:
+            for call in (n for n in walk_stmt_exprs(stmt)
+                         if isinstance(n, ast.Call)):
+                deriving = _is_deriving_call(call)
+                for arg in (*call.args,
+                            *(kw.value for kw in call.keywords)):
+                    if not (isinstance(arg, ast.Name) and arg.id in keys):
+                        continue
+                    if deriving:
+                        continue  # split/fold_in derive, never consume
+                    prior = consumed.get(arg.id)
+                    if prior is not None:
+                        findings.append(self.finding(
+                            module, arg.lineno,
+                            f"key `{arg.id}` already consumed at line "
+                            f"{prior} — split it first "
+                            "(`k1, k2 = jax.random.split(...)`) so the "
+                            "two consumers draw independent randomness",
+                        ))
+                        continue
+                    consumed[arg.id] = arg.lineno
+                    loop = self._stale_loop(module, stmt, arg.id,
+                                            keys[arg.id], loop_rebinds)
+                    if loop is not None:
+                        findings.append(self.finding(
+                            module, arg.lineno,
+                            f"key `{arg.id}` (bound at line "
+                            f"{keys[arg.id] or 'parameter'}) is consumed "
+                            f"inside the loop at line {loop.lineno} but "
+                            "never rebound per iteration — every "
+                            "iteration reuses the same randomness; "
+                            "`jax.random.split`/`fold_in` it inside the "
+                            "loop",
+                        ))
+            assigned = assigned_names(stmt)
+            for name in assigned:
+                consumed.pop(name, None)
+                if _is_key_producing(getattr(stmt, "value", None)):
+                    keys[name] = stmt.lineno
+                else:
+                    keys.pop(name, None)
+        return findings
+
+    def _loop_rebinds(self, fn) -> dict[ast.stmt, set[str]]:
+        """For each loop statement in the scope: the names its body (or
+        iteration header) rebinds on every pass."""
+        out: dict[ast.stmt, set[str]] = {}
+        for stmt in statements_in_order(fn):
+            if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                rebinds: set[str] = set()
+                for inner in statements_in_order(stmt):
+                    rebinds |= assigned_names(inner)
+                rebinds |= assigned_names(stmt)  # for-target itself
+                out[stmt] = rebinds
+        return out
+
+    def _stale_loop(self, module: Module, stmt: ast.stmt, name: str,
+                    bound_line: int, loop_rebinds) -> ast.stmt | None:
+        """The innermost enclosing loop that consumes ``name`` without a
+        per-iteration rebind, when the key was bound OUTSIDE that loop."""
+        for anc in module.ancestors(stmt):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                return None
+            rebinds = loop_rebinds.get(anc)
+            if rebinds is None:
+                continue
+            if name in rebinds:
+                return None
+            if bound_line and anc.lineno <= bound_line <= (
+                    getattr(anc, "end_lineno", 0) or 0):
+                return None  # bound inside the loop after all
+            return anc
+        return None

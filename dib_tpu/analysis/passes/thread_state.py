@@ -1,0 +1,149 @@
+"""thread-shared-state: unguarded self-mutation from a thread target.
+
+The incident (PR 6, CHANGES.md): ``EventWriter.emit`` was called from
+both the fit thread and the new mid-chunk heartbeat daemon thread — the
+unsynchronized ``seq`` counter produced gapped/duplicated sequence
+numbers until a lock was added by hand in review. The shape is general:
+a module spawns ``threading.Thread(target=...)``, the target mutates
+``self.<attr>``, and the class holds no ``Lock``/``RLock`` — every such
+attribute is a data race waiting for a scheduler interleaving to prove
+it.
+
+This pass finds, per module that spawns threads: every assignment (or
+aug-assignment, the classic ``self.x += 1`` read-modify-write) to a
+``self`` attribute inside a thread-target function — a method, a local
+closure, or anything reachable as the ``target=`` argument — whose
+owning class nowhere assigns a ``threading.Lock()`` / ``RLock()``.
+Classes that hold a lock are trusted to use it (locking *correctness* is
+beyond a linter); classes with no lock at all cannot possibly be
+synchronized, which is exactly the decidable half of the bug.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from dib_tpu.analysis.core import (
+    Finding,
+    LintPass,
+    Module,
+    call_name,
+    register,
+)
+
+_LOCKISH = {"Lock", "RLock"}
+
+
+def _is_thread_ctor(call: ast.Call) -> bool:
+    name = call_name(call)
+    return name is not None and name.split(".")[-1] == "Thread"
+
+
+def _lock_classes(module: Module) -> set[ast.ClassDef]:
+    """Classes that assign a threading.Lock/RLock anywhere in their body
+    (``self._lock = threading.Lock()`` in __init__, or a class attr)."""
+    out: set[ast.ClassDef] = set()
+    if module.tree is None:
+        return out
+    for cls in ast.walk(module.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Call):
+                name = call_name(node)
+                if name and name.split(".")[-1] in _LOCKISH:
+                    out.add(cls)
+                    break
+    return out
+
+
+def _self_mutations(fn: ast.AST) -> list[tuple[int, str]]:
+    """(line, attr) for every ``self.<attr> = ...`` / ``self.<attr> op= ...``
+    inside ``fn``, nested closures included (they share the race)."""
+    out: list[tuple[int, str]] = []
+    for node in ast.walk(fn):
+        targets: list[ast.expr] = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        for target in targets:
+            if (isinstance(target, ast.Attribute)
+                    and isinstance(target.value, ast.Name)
+                    and target.value.id == "self"):
+                out.append((target.lineno, target.attr))
+    return out
+
+
+@register
+class ThreadSharedStatePass(LintPass):
+    id = "thread-shared-state"
+    description = ("self-attribute mutation from a threading.Thread target "
+                   "in a class that holds no Lock/RLock")
+    incident = ("PR 6: EventWriter.emit raced the mid-chunk heartbeat "
+                "thread — gapped seq numbers until a lock was added by "
+                "hand in review (CHANGES.md)")
+
+    def _resolve_target(self, module: Module, call: ast.Call,
+                        target: ast.expr):
+        """The FunctionDef a ``target=`` expression names, resolved in the
+        right scope: ``target=self._run`` searches the spawning class
+        (NOT a module-wide name map — another class's same-named method
+        must not shadow it), ``target=<name>`` searches the enclosing
+        functions innermost-first, then the module top level."""
+        defs = (ast.FunctionDef, ast.AsyncFunctionDef)
+        if isinstance(target, ast.Name):
+            for anc in module.ancestors(call):
+                if isinstance(anc, defs):
+                    for node in ast.walk(anc):
+                        if (isinstance(node, defs) and node is not anc
+                                and node.name == target.id):
+                            return node, target.id
+            for node in module.tree.body:
+                if isinstance(node, defs) and node.name == target.id:
+                    return node, target.id
+        elif (isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self"):
+            cls = module.enclosing_class(call)
+            if cls is not None:
+                for node in ast.walk(cls):
+                    if isinstance(node, defs) and node.name == target.attr:
+                        return node, f"self.{target.attr}"
+        return None, None
+
+    def check_module(self, module: Module) -> list[Finding]:
+        if module.tree is None or "Thread" not in module.source:
+            return []
+        locked = _lock_classes(module)
+        findings: list[Finding] = []
+        seen: set[tuple[int, str]] = set()
+        for call in ast.walk(module.tree):
+            if not (isinstance(call, ast.Call) and _is_thread_ctor(call)):
+                continue
+            target = next((kw.value for kw in call.keywords
+                           if kw.arg == "target"), None)
+            if target is None:
+                continue
+            target_fn, target_name = self._resolve_target(
+                module, call, target)
+            if target_fn is None:
+                continue
+            # the class whose state the target can reach: the target's own
+            # enclosing class, else the spawner's (closures inside methods)
+            cls = (module.enclosing_class(target_fn)
+                   or module.enclosing_class(call))
+            if cls is None or cls in locked:
+                continue
+            for line, attr in _self_mutations(target_fn):
+                if (line, attr) in seen:
+                    continue
+                seen.add((line, attr))
+                findings.append(self.finding(
+                    module, line,
+                    f"`self.{attr}` is mutated from thread target "
+                    f"`{target_name}` but class `{cls.name}` holds no "
+                    "threading.Lock/RLock — the EventWriter.emit race "
+                    "class; guard the shared state with a lock",
+                ))
+        return findings

@@ -1,0 +1,80 @@
+"""timing-hygiene: no bare wall-clock deltas around jitted work.
+
+The incident (PR 2, docs/observability.md "async-dispatch pitfall"): JAX
+dispatch is asynchronous, so ``t0 = time.time(); f(x); dt = time.time()
+- t0`` measures only the DISPATCH — a phantom speedup that burned real
+measurement rounds before the blocking timers existed. The package's
+honest primitives are ``utils.profiling.PhaseTimer`` / ``timed_blocked``
+and ``telemetry.trace.span`` (both block on registered outputs before
+closing the interval).
+
+Migrated from ``scripts/check_timing_hygiene.py`` (which now delegates
+here): flags every ``time.time()`` / ``time.perf_counter()`` in package
+code outside the allowlisted host-only modules. The legacy
+``# timing-ok: <reason>`` pragma still works (the framework maps it onto
+this pass); new code should prefer ``# lint-ok(timing-hygiene): <reason>``.
+Scope is the package only — ``scripts/`` are host-side drivers whose
+wall clocks time subprocesses and I/O, not jitted dispatch.
+"""
+
+from __future__ import annotations
+
+import re
+
+from dib_tpu.analysis.core import Finding, LintPass, Module, register
+
+_PATTERN = re.compile(r"\btime\.(?:time|perf_counter)\(\)")
+
+
+@register
+class TimingHygienePass(LintPass):
+    id = "timing-hygiene"
+    description = ("bare time.time()/perf_counter() in package code — "
+                   "async dispatch makes the interval a lie")
+    incident = ("PR 2: wall-clock deltas around jitted calls measured "
+                "only the dispatch; the phantom speedups burned "
+                "measurement rounds (docs/observability.md)")
+    scope = "package"
+    # Module-level exemptions, each with the reason it may read a wall
+    # clock directly. Everything else times through PhaseTimer/trace.span
+    # or carries a per-line pragma.
+    allowlist = {
+        "dib_tpu/utils/profiling.py":
+            "the blocking-timer implementation itself",
+        "dib_tpu/telemetry/trace.py": "the span implementation itself",
+        "dib_tpu/telemetry/events.py":
+            "event-envelope timestamps, not intervals",
+        "dib_tpu/telemetry/xla_stats.py":
+            "times host-side lower/compile, no dispatch",
+        "dib_tpu/telemetry/hooks.py":
+            "PhaseTimer feeder: hook-boundary adds after an explicit "
+            "block_until_ready",
+        "dib_tpu/train/hooks.py":
+            "TimedHook measures host hooks, which fetch their device "
+            "results internally",
+        "dib_tpu/train/watchdog.py":
+            "supervisor process: times subprocess beats, never "
+            "dispatches jitted work",
+        "dib_tpu/telemetry/live.py":
+            "host-side stream follower/dashboard: staleness vs event "
+            "wall-clock stamps, no jitted work",
+        "dib_tpu/telemetry/registry.py":
+            "host-side registry timestamps, no intervals",
+        "dib_tpu/analysis/passes/timing.py":
+            "this pass: its docstring, pattern, and messages spell the "
+            "forbidden calls",
+    }
+
+    def check_module(self, module: Module) -> list[Finding]:
+        findings: list[Finding] = []
+        for lineno, line in enumerate(module.lines, 1):
+            if _PATTERN.search(line):
+                findings.append(self.finding(
+                    module, lineno,
+                    "bare wall-clock call: JAX dispatch is async, so "
+                    "time.time()/perf_counter() around a jitted call "
+                    "measures only the dispatch — use "
+                    "utils.profiling.PhaseTimer/timed_blocked or "
+                    "telemetry.trace.span (docs/observability.md)",
+                ))
+        return findings

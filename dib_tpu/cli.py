@@ -90,13 +90,16 @@ def build_parser() -> argparse.ArgumentParser:
         description="Train a Distributed IB model on any registered dataset.",
     )
     parser.add_argument("command", nargs="?", default="train",
-                        choices=["train", "workload", "telemetry", "serve"],
+                        choices=["train", "workload", "telemetry", "serve",
+                                 "lint"],
                         help="Subcommand: 'train' (flags below), 'workload' "
                              "(paper workloads; see `dib_tpu workload --help`), "
                              "'telemetry' (summarize/compare/report run "
                              "event streams; see `dib_tpu telemetry --help`), "
-                             "or 'serve' (inference over a checkpoint; see "
-                             "`dib_tpu serve --help`).")
+                             "'serve' (inference over a checkpoint; see "
+                             "`dib_tpu serve --help`), or 'lint' (static "
+                             "analysis over the tree; see "
+                             "`dib_tpu lint --help`).")
     _add_model_flags(parser)
     parser.add_argument("--artifact_outdir", type=str, default="./training_artifacts/")
     parser.add_argument("--learning_rate", type=float, default=3e-4)
@@ -1148,8 +1151,13 @@ def main(argv: Sequence[str] | None = None) -> int:
             return telemetry_main(argv[1:])
         if argv and argv[0] == "serve":
             return serve_main(argv[1:])
+        if argv and argv[0] == "lint":
+            # pure host-side AST analysis: never initializes a backend
+            from dib_tpu.analysis import lint_main
+
+            return lint_main(argv[1:])
         args = build_parser().parse_args(argv)
-        if args.command in ("workload", "telemetry", "serve"):
+        if args.command in ("workload", "telemetry", "serve", "lint"):
             # parsed from a non-leading position (flags first): these
             # subcommands' flags are not the train flags, so re-dispatching
             # would misparse. Name the flag that displaced the subcommand

@@ -70,9 +70,116 @@ import weakref
 SCHEMA_VERSION = 1
 EVENTS_FILENAME = "events.jsonl"
 
+
+@dataclasses.dataclass(frozen=True)
+class EventKindSpec:
+    """One row of the declarative event-schema registry: the field
+    vocabulary of one event kind. ``required`` fields are what every
+    record of the kind must carry (the typed ``EventWriter`` helpers bind
+    them by signature); ``optional`` is the full documented detail-field
+    vocabulary. The static-analysis drift pass
+    (``dib_tpu/analysis/passes/event_schema.py``) checks every emit call
+    site in the tree against these rows, and checks the rows against the
+    docs/observability.md record-type table — so code, schema, and docs
+    cannot diverge silently. A NEW field starts here: add it to the row,
+    document it, then emit it."""
+
+    required: tuple[str, ...]
+    optional: tuple[str, ...] = ()
+    doc: str = ""
+
+
+#: Envelope fields every record carries (written by :meth:`EventWriter.emit`
+#: itself, never passed by callers).
+ENVELOPE_FIELDS = ("v", "run", "proc", "seq", "t", "mono", "type", "tags")
+
+#: kind -> field vocabulary; one row per documented record type
+#: (docs/observability.md "Record types and their payloads").
+EVENT_SCHEMA: dict[str, EventKindSpec] = {
+    "run_start": EventKindSpec(
+        required=("manifest",),
+        doc="provenance manifest at (re)launch"),
+    "chunk": EventKindSpec(
+        required=("epoch", "steps", "seconds"),
+        optional=("steps_per_s", "epochs", "loss", "val_loss", "beta",
+                  "kl_per_feature", "metric", "val_metric", "memory",
+                  "host_memory", "beta_ends", "replica"),
+        doc="per-fit-chunk training signal (sweeps carry [R] lists)"),
+    "compile": EventKindSpec(
+        required=("name", "seconds", "cache"),
+        optional=("flops", "bytes_accessed", "optimal_seconds", "epochs",
+                  "op", "bucket", "error", "cost_source"),
+        doc="executable compile: seconds, cache status, cost analysis"),
+    "mitigation": EventKindSpec(
+        required=("mtype",),
+        optional=("epoch", "chunk", "step", "action", "reason", "error",
+                  "restored_epoch", "loss", "val_loss", "kl_per_feature",
+                  "replica", "beta_end", "scope", "members", "deleted",
+                  "detail", "host", "hosts", "expected", "observed",
+                  "launches", "uptime_s", "worker_alive_s", "surface",
+                  "skipped_steps", "timeout_s", "grace_s", "exit_code",
+                  "signal", "run_id", "replicas", "consecutive_failures",
+                  "healthy", "ejected", "batchers_dead",
+                  "checkpoint_saved", "grace_remaining_s"),
+        doc="one self-healing action (watchdog, rollback, serve health)"),
+    "fault": EventKindSpec(
+        required=("kind",),
+        optional=("spec", "chunk", "epoch", "replica", "op", "host",
+                  "stale_chunk", "detail", "step", "via"),
+        doc="one deliberate injection (dib_tpu/faults), pre-execution"),
+    "hook": EventKindSpec(
+        required=("name", "epoch", "seconds"),
+        doc="host-hook wall-clock per invocation"),
+    "span": EventKindSpec(
+        required=("name", "path", "span", "parent", "seconds"),
+        optional=("epoch", "replica", "beta_end", "op", "bucket",
+                  "status", "rows", "fill", "queued_s", "padded_rows"),
+        doc="one closed trace span (serving emits request/batch spans)"),
+    "mi_bounds": EventKindSpec(
+        required=("epoch",),
+        optional=("lower_bits", "upper_bits", "beta", "replica",
+                  "beta_end", "per_feature", "feature"),
+        doc="MI sandwich-bound measurements"),
+    "heartbeat": EventKindSpec(
+        required=("beat", "epoch", "phase"),
+        optional=("intervals_s", "interval_s", "phase_elapsed_s"),
+        doc="bounded-interval liveness beat (boundary / chunk / host)"),
+    "alert": EventKindSpec(
+        required=("rule",),
+        optional=("metric", "value", "bound", "budget", "severity",
+                  "source", "when"),
+        doc="one durable SLO violation (telemetry/slo.py)"),
+    "transition": EventKindSpec(
+        required=("channel", "epoch", "direction"),
+        optional=("kl_before", "kl_after", "beta", "threshold_nats",
+                  "replica"),
+        doc="info-plane transition: per-channel KL threshold crossing"),
+    "metrics": EventKindSpec(
+        required=("snapshots",),
+        doc="counter/gauge/histogram snapshots"),
+    "run_end": EventKindSpec(
+        required=("status",),
+        optional=("error", "seconds", "epoch", "aborted_chunk",
+                  "steps_per_s", "requests", "ejected_replicas",
+                  "final_val_loss", "resumed_from_epoch", "minutes"),
+        doc="terminal status"),
+}
+
+
+def _strict() -> bool:
+    """``DIB_TELEMETRY_STRICT=1``: emit() rejects kinds outside
+    EVENT_SCHEMA instead of durably writing a record nothing downstream
+    understands. Off by default — a production run must never die on a
+    telemetry typo; CI and the drills turn it on."""
+    return os.environ.get("DIB_TELEMETRY_STRICT") == "1"
+
+
 __all__ = [
     "SCHEMA_VERSION",
     "EVENTS_FILENAME",
+    "ENVELOPE_FIELDS",
+    "EVENT_SCHEMA",
+    "EventKindSpec",
     "EventWriter",
     "config_fingerprint",
     "device_memory_stats",
@@ -336,7 +443,17 @@ class EventWriter:
 
         A writer another thread already closed (preemption grace-abort,
         shutdown racing a heartbeat) drops the event instead of crashing
-        the emitting thread."""
+        the emitting thread. Under ``DIB_TELEMETRY_STRICT=1`` an
+        ``event_type`` outside :data:`EVENT_SCHEMA` raises instead of
+        writing a record no reader understands."""
+        if _strict() and event_type not in EVENT_SCHEMA:
+            raise ValueError(
+                f"unknown event kind {event_type!r} "
+                f"(DIB_TELEMETRY_STRICT=1; known kinds: "
+                f"{sorted(EVENT_SCHEMA)}) — add a row to "
+                "telemetry/events.py EVENT_SCHEMA and document it in "
+                "docs/observability.md first"
+            )
         with self._lock:
             if self._fd is None:
                 return {}
@@ -437,7 +554,9 @@ class EventWriter:
         """One liveness beat (telemetry/hooks.py FitRecorder). ``phase``
         is ``"boundary"`` (chunk boundary, main thread — carries trailing
         ``intervals_s``, the watchdog's stall clock) or ``"chunk"``
-        (mid-chunk daemon thread — carries ``chunk_elapsed_s``)."""
+        (mid-chunk daemon thread — carries ``interval_s`` and
+        ``phase_elapsed_s``; between chunks the same thread beats with
+        phase ``"host"``)."""
         return self.emit("heartbeat", beat=int(beat), epoch=int(epoch),
                          phase=phase, **fields)
 

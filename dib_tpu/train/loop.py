@@ -422,10 +422,14 @@ class DIBTrainer:
                 if telemetry is not None and done == 0:
                     # one cost-analysis pass at the real call signature:
                     # FLOPs/bytes of the chunk program land on a `compile`
-                    # event and arm the per-chunk utilization gauges
+                    # event and arm the per-chunk utilization gauges. The
+                    # probe gets a DERIVED key — lowering only needs the
+                    # signature, and reusing k_chunk would alias the key
+                    # the real run_chunk below consumes (prng-reuse).
                     recorder.record_compile(
                         "run_chunk", type(self).run_chunk,
-                        self, state, history, k_chunk, this_chunk,
+                        self, state, history,
+                        jax.random.fold_in(k_chunk, 0), this_chunk,
                         epochs=this_chunk,
                     )
                 with recorder.chunk_phase() as ph:

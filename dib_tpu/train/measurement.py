@@ -243,6 +243,11 @@ class MeasurementTrainer:
             chunk = jnp.asarray(padded[start : start + chunk_size])
             out.append(
                 np.asarray(
+                    # lint-ok(prng-reuse): deterministic symbolization —
+                    # every chunk reuses the same measurement noise by
+                    # design; fresh keys would make the symbol stream
+                    # depend on the chunking and invalidate the committed
+                    # characterization artifacts
                     self._symbolize_chunk(state.params, chunk, key, num_noise_draws)
                 )
             )

@@ -115,6 +115,8 @@ def main() -> int:
         t_arm = time.time()
         trainer = MeasurementTrainer(stack, windows, config)
         repeats = MeasurementRepeatTrainer(stack, windows, config, args.repeats)
+        # lint-ok(prng-reuse): deliberate paired design — every arm trains
+        # the SAME seeds so arm differences cannot be seed noise
         states, rh = repeats.fit(repeat_keys)
         train_s = time.time() - t_arm
 

@@ -1,108 +1,78 @@
-"""Static check: no silently-swallowed broad exceptions in the package.
+"""Back-compat shim: the exception-hygiene check now lives in the
+static-analysis framework (``dib_tpu/analysis/passes/exceptions.py``,
+pass id ``exception-hygiene``) — one engine, one pragma grammar, one CLI
+(``python -m dib_tpu lint``; docs/static-analysis.md).
 
-A robustness subsystem is only as honest as its error handling: an
-``except Exception: pass`` turns a real fault into nothing — no re-raise,
-no error result, no telemetry event — which is exactly how a recovery
-path rots until a drill (or production) finds it. This check walks the
-``dib_tpu/`` AST and fails on any handler that
-
-  - catches a BROAD type (bare ``except:``, ``Exception``, or
-    ``BaseException`` — alone or inside a tuple), AND
-  - has a body that does NOTHING (only ``pass`` / ``...``).
-
-Handlers that re-raise, return an error result, log, emit a telemetry
-event, or catch a NARROW exception (``except ProcessLookupError: pass``
-around a kill of an already-dead pid is fine) all pass. A reviewed
-exception can carry a ``# fault-ok: <reason>`` pragma on the ``except``
-line.
-
-Runnable three ways::
+This wrapper keeps the pre-framework surface working all three ways::
 
     python scripts/check_exception_hygiene.py   # standalone, rc 1 on bad
     python -m pytest scripts/check_exception_hygiene.py
-    python -m pytest tests/test_faults.py       # imports scan_package()
+    python -m pytest tests/test_faults.py       # imports scan_file/scan_package
+
+``scan_file``/``scan_package`` return the legacy ``"rel:lineno: line"``
+strings (package-relative paths) and honor both the legacy ``# fault-ok:
+<reason>`` pragma and the framework's ``# lint-ok(exception-hygiene):
+<reason>``.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PACKAGE = os.path.join(REPO, "dib_tpu")
 
-_BROAD = {"Exception", "BaseException"}
-_PRAGMA = "fault-ok"
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
 
 POINTER = (
     "silent broad exception handler in package code: every handler must "
     "re-raise, return an error result, or emit a telemetry event — an "
     "`except Exception: pass` hides the faults the recovery paths exist "
     "for. Narrow the exception type, handle it, or justify with a "
-    "`# fault-ok: <reason>` pragma (docs/robustness.md)"
+    "`# fault-ok: <reason>` pragma (docs/robustness.md; the full suite is "
+    "`python -m dib_tpu lint`, docs/static-analysis.md)"
 )
 
-
-def _broad_names(handler: ast.ExceptHandler) -> bool:
-    """True when the handler catches Exception/BaseException or is bare."""
-    node = handler.type
-    if node is None:
-        return True
-    elts = node.elts if isinstance(node, ast.Tuple) else [node]
-    for elt in elts:
-        name = elt.id if isinstance(elt, ast.Name) else (
-            elt.attr if isinstance(elt, ast.Attribute) else None)
-        if name in _BROAD:
-            return True
-    return False
+_PASS_ID = "exception-hygiene"
 
 
-def _body_is_silent(handler: ast.ExceptHandler) -> bool:
-    """True when the body does nothing: only pass / bare ellipsis."""
-    for stmt in handler.body:
-        if isinstance(stmt, ast.Pass):
-            continue
-        if (isinstance(stmt, ast.Expr)
-                and isinstance(stmt.value, ast.Constant)
-                and stmt.value.value is Ellipsis):
-            continue
-        return False
-    return True
+def _lint_pass():
+    import dib_tpu.analysis  # noqa: F401  (registers the passes)
+    from dib_tpu.analysis.core import get_pass
+
+    return get_pass(_PASS_ID)
 
 
 def scan_file(path: str, rel: str) -> list[str]:
+    """Legacy single-file scan: ``["rel:lineno: <line>"]`` for every
+    unsuppressed silent broad handler in one file."""
+    from dib_tpu.analysis.core import Module
+
     with open(path, encoding="utf-8") as f:
-        source = f.read()
-    lines = source.splitlines()
-    try:
-        tree = ast.parse(source, filename=rel)
-    except SyntaxError as exc:
-        return [f"{rel}: unparseable ({exc})"]
-    violations = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.ExceptHandler):
-            continue
-        if not (_broad_names(node) and _body_is_silent(node)):
-            continue
-        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
-        if _PRAGMA in line:
-            continue
-        violations.append(f"{rel}:{node.lineno}: {line.strip()}")
-    return violations
+        module = Module(path, rel, f.read())
+    if module.parse_error is not None:
+        return [f"{rel}: unparseable ({module.parse_error.msg})"]
+    lint = _lint_pass()
+    return [
+        f"{rel}:{f.line}: {module.line(f.line)}"
+        for f in lint.check_module(module)
+        if not module.suppressed(_PASS_ID, f.line)
+    ]
 
 
 def scan_package(package_dir: str = PACKAGE) -> list[str]:
-    """``["relpath:lineno: <line>"]`` for every silent broad handler."""
+    """``["relpath:lineno: <line>"]`` for every silent broad handler in
+    the package (paths relative to ``package_dir``, as before)."""
+    from dib_tpu.analysis.core import iter_source_files
+
+    root = os.path.dirname(package_dir)
+    sub = os.path.basename(package_dir)
     violations: list[str] = []
-    for dirpath, dirnames, filenames in os.walk(package_dir):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for fname in sorted(filenames):
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fname)
-            rel = os.path.relpath(path, package_dir).replace(os.sep, "/")
-            violations.extend(scan_file(path, rel))
+    for path, _rel in iter_source_files(root, roots=(sub,)):
+        pkg_rel = os.path.relpath(path, package_dir).replace(os.sep, "/")
+        violations.extend(scan_file(path, pkg_rel))
     return violations
 
 

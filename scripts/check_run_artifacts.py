@@ -26,6 +26,11 @@ Also validated, with their own schemas:
 Strict JSON: ``NaN``/``Infinity`` constants (which ``json.dump`` happily
 emits and nothing else can parse) are rejected.
 
+The standalone path additionally runs the static-analysis suite
+(``python -m dib_tpu lint``, docs/static-analysis.md) so one command
+gates everything committed; the pytest path covers lint separately via
+``tests/test_lint/``.
+
 Runnable three ways::
 
     python scripts/check_run_artifacts.py          # standalone, rc 1 on bad
@@ -249,6 +254,18 @@ def test_committed_run_artifacts():
     assert not bad, f"artifact schema violations: {json.dumps(bad, indent=1)}"
 
 
+def run_lint(repo: str = REPO) -> list[str]:
+    """The static-analysis suite (docs/static-analysis.md) as formatted
+    finding strings — the standalone gate runs it alongside the artifact
+    schemas so one command covers everything committed. (The pytest path
+    covers lint separately via tests/test_lint/.)"""
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    from dib_tpu.analysis import run_passes
+
+    return [f.format() for f in run_passes(root=repo)]
+
+
 def main() -> int:
     results = check_all()
     bad = 0
@@ -260,7 +277,12 @@ def main() -> int:
         else:
             print(f"{path}: ok")
     print(f"{len(results)} artifacts checked, {bad} with violations")
-    return 1 if bad else 0
+    findings = run_lint()
+    for finding in findings:
+        print(finding)
+    print("dib-lint: " + (f"{len(findings)} finding(s)" if findings
+                          else "ok (python -m dib_tpu lint)"))
+    return 1 if bad or findings else 0
 
 
 if __name__ == "__main__":

@@ -1,85 +1,64 @@
-"""Static check: no bare wall-clock deltas around jitted work in the package.
+"""Back-compat shim: the timing-hygiene check now lives in the
+static-analysis framework (``dib_tpu/analysis/passes/timing.py``, pass
+id ``timing-hygiene``) — one engine, one pragma grammar, one CLI
+(``python -m dib_tpu lint``; docs/static-analysis.md).
 
-JAX dispatch is asynchronous — ``t0 = time.time(); f(x); dt = time.time()
-- t0`` around a jitted call measures only the DISPATCH, not the compute,
-and the resulting phantom speedup has burned real measurement rounds
-elsewhere (docs/observability.md, "async-dispatch pitfall"). The package's
-honest-timing primitives are:
-
-  - ``dib_tpu.utils.profiling.PhaseTimer`` / ``timed_blocked`` (block on
-    registered outputs before closing the interval);
-  - ``dib_tpu.telemetry.trace.span`` (same semantics, plus the event
-    stream and XLA ``TraceAnnotation``).
-
-This check greps ``dib_tpu/`` for ``time.time()`` / ``time.perf_counter()``
-calls outside the implementations of those primitives (and other
-allowlisted host-only modules) and fails with a pointer to the pitfall.
-A reviewed exception can carry a ``# timing-ok: <reason>`` pragma on the
-same line.
-
-Runnable three ways::
+This wrapper keeps the pre-framework surface working all three ways::
 
     python scripts/check_timing_hygiene.py      # standalone, rc 1 on bad
     python -m pytest scripts/check_timing_hygiene.py
-    python -m pytest tests/test_profiling.py    # imports scan_package()
+    python -m pytest tests/test_trace.py        # imports scan_package()
+
+``scan_package`` returns the legacy ``"rel:lineno: line"`` strings
+(package-relative paths), honors the pass's module allowlist, and
+accepts both the legacy ``# timing-ok: <reason>`` pragma and the
+framework's ``# lint-ok(timing-hygiene): <reason>``.
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PACKAGE = os.path.join(REPO, "dib_tpu")
 
-# Module-level exemptions, each with the reason it is allowed to read a
-# wall clock directly. Everything else in the package must time through
-# PhaseTimer / trace.span (or carry a per-line `# timing-ok:` pragma).
-ALLOWLIST: dict[str, str] = {
-    "utils/profiling.py": "the blocking-timer implementation itself",
-    "telemetry/trace.py": "the span implementation itself",
-    "telemetry/events.py": "event-envelope timestamps, not intervals",
-    "telemetry/xla_stats.py": "times host-side lower/compile, no dispatch",
-    "telemetry/hooks.py": "PhaseTimer feeder: hook-boundary adds after "
-                          "an explicit block_until_ready",
-    "train/hooks.py": "TimedHook measures host hooks, which fetch their "
-                      "device results internally",
-    "train/watchdog.py": "supervisor process: times subprocess beats, "
-                         "never dispatches jitted work",
-    "telemetry/live.py": "host-side stream follower/dashboard: staleness "
-                         "vs event wall-clock stamps, no jitted work",
-    "telemetry/registry.py": "host-side registry timestamps, no intervals",
-}
-
-_PATTERN = re.compile(r"\btime\.(?:time|perf_counter)\(\)")
-_PRAGMA = "timing-ok"
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
 
 POINTER = (
     "bare wall-clock delta in package code: JAX dispatch is async, so "
     "time.time()/perf_counter() around a jitted call measures only the "
     "dispatch — use utils.profiling.PhaseTimer/timed_blocked or "
     "telemetry.trace.span (they block on registered outputs), or justify "
-    "with a `# timing-ok: <reason>` pragma (docs/observability.md)"
+    "with a `# timing-ok: <reason>` pragma (docs/observability.md; the "
+    "full suite is `python -m dib_tpu lint`, docs/static-analysis.md)"
 )
+
+_PASS_ID = "timing-hygiene"
 
 
 def scan_package(package_dir: str = PACKAGE) -> list[str]:
-    """``["relpath:lineno: <line>"]`` for every unjustified wall-clock call."""
+    """``["relpath:lineno: <line>"]`` for every unjustified wall-clock
+    call (paths relative to ``package_dir``, as before)."""
+    import dib_tpu.analysis  # noqa: F401  (registers the passes)
+    from dib_tpu.analysis.core import Module, get_pass, iter_source_files
+
+    lint = get_pass(_PASS_ID)
+    root = os.path.dirname(package_dir)
+    sub = os.path.basename(package_dir)
     violations: list[str] = []
-    for dirpath, dirnames, filenames in os.walk(package_dir):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for fname in sorted(filenames):
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fname)
-            rel = os.path.relpath(path, package_dir).replace(os.sep, "/")
-            if rel in ALLOWLIST:
-                continue
-            with open(path, encoding="utf-8") as f:
-                for lineno, line in enumerate(f, 1):
-                    if _PATTERN.search(line) and _PRAGMA not in line:
-                        violations.append(f"{rel}:{lineno}: {line.strip()}")
+    for path, rel in iter_source_files(root, roots=(sub,)):
+        if rel in lint.allowlist:   # keys are repo-relative (dib_tpu/...)
+            continue
+        pkg_rel = os.path.relpath(path, package_dir).replace(os.sep, "/")
+        with open(path, encoding="utf-8") as f:
+            module = Module(path, pkg_rel, f.read())
+        violations.extend(
+            f"{pkg_rel}:{f.line}: {module.line(f.line)}"
+            for f in lint.check_module(module)
+            if not module.suppressed(_PASS_ID, f.line)
+        )
     return violations
 
 

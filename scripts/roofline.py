@@ -54,9 +54,9 @@ def time_matmul(a_shape, b_shape, *, iters=200, dtype="bfloat16",
     import jax.numpy as jnp
 
     dt = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
-    key = jax.random.key(0)
-    a = jax.random.normal(key, a_shape, jnp.float32).astype(dt)
-    b = jax.random.normal(key, b_shape, jnp.float32).astype(dt)
+    k_a, k_b = jax.random.split(jax.random.key(0))
+    a = jax.random.normal(k_a, a_shape, jnp.float32).astype(dt)
+    b = jax.random.normal(k_b, b_shape, jnp.float32).astype(dt)
     contract = "...mk,...kn->...mn" if batched else "mk,kn->mn"
 
     def step(carry, _):

@@ -1,0 +1,32 @@
+"""Shared helpers for the lint-suite tests: fixture loading by name and
+line lookup by substring (fixtures document that their line numbers
+matter, but tests locate lines by content so edits don't silently
+invalidate assertions)."""
+
+import os
+
+import pytest
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "fixtures")
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+@pytest.fixture
+def load_fixture():
+    from dib_tpu.analysis.core import load_module
+
+    def _load(name: str):
+        path = os.path.join(FIXTURES, name)
+        return load_module(path, f"tests/test_lint/fixtures/{name}")
+
+    return _load
+
+
+def line_of(module, substring: str, nth: int = 0) -> int:
+    """1-based line number of the nth line containing ``substring``."""
+    hits = [i for i, line in enumerate(module.lines, 1)
+            if substring in line]
+    assert hits, f"{module.rel}: no line contains {substring!r}"
+    return hits[nth]

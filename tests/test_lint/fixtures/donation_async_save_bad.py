@@ -1,0 +1,28 @@
+"""Committed fixture: the EXACT PR 4 async-save/donated-buffer bug shape.
+
+``train/checkpoint.py`` used to do this before the fault drills caught
+it (docs/robustness.md "Async save vs. donation"): an orbax-style
+manager's async ``save`` reads the chunk's output buffers zero-copy in a
+background thread, while the NEXT ``run_chunk`` call's ``donate_argnames``
+donation reuses those same buffers for its outputs — the step lands on
+disk holding a later epoch's bytes. The donation-safety pass must flag
+the ``manager.save`` line (see tests/test_lint/test_passes.py).
+"""
+
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, donate_argnames=("state", "history"))
+def run_chunk(state, history, key, num_epochs):
+    return state, history
+
+
+def train(manager, state, history, key, steps):
+    for step in range(steps):
+        state, history = run_chunk(state, history, key, 64)
+        # BUG: async save reads `state`/`history` zero-copy while the next
+        # iteration's donation reuses the same memory
+        manager.save(step, args={"state": state, "history": history})
+    return state, history

@@ -1,0 +1,34 @@
+"""Fixture: the SAFE donation idioms — must lint clean.
+
+Rebinding the donated names to the call's results (the ``x, y = f(x, y)``
+idiom) resurrects them, and a ``jax.device_get`` host copy before the
+save clears the device-buffer taint.
+"""
+
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, donate_argnames=("state", "history"))
+def run_chunk(state, history, key, num_epochs):
+    return state, history
+
+
+def good_rebind(state, history, key):
+    state, history = run_chunk(state, history, key, 8)
+    return state, history["loss"]
+
+
+def good_save(manager, state, history, key, steps):
+    for step in range(steps):
+        state, history = run_chunk(state, history, key, 64)
+        snapshot = jax.device_get({"state": state, "history": history})
+        manager.save(step, args=snapshot)
+    return state, history
+
+
+def good_fetch_before(state, history, key):
+    last_loss = history["loss"]
+    state, history = run_chunk(state, history, key, 8)
+    return state, history, last_loss

@@ -1,0 +1,16 @@
+"""Fixture: read of a donated argument after the donating call."""
+
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, donate_argnames=("state", "history"))
+def run_chunk(state, history, key, num_epochs):
+    return state, history
+
+
+def bad_read(state, history, key):
+    new_state, new_history = run_chunk(state, history, key, 8)
+    loss = history["loss"]   # BUG: history's buffer was donated above
+    return new_state, new_history, loss

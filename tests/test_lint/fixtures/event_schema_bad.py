@@ -1,0 +1,9 @@
+"""Fixture: event-schema drift at emit call sites."""
+
+
+def emit_bad(telemetry, writer):
+    telemetry.emit("chnk", epoch=1, steps=10, seconds=0.5)  # typo'd kind
+    writer.emit("chunk", epoch=1)                  # missing steps/seconds
+    telemetry.mitigation(mtype="x", mtyp="typo")   # unknown field
+    writer.heartbeat(beat=1, epoch=0, phase="boundary",
+                     chunk_elapsed_s=1.0)          # field docs invented

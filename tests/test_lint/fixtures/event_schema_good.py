@@ -1,0 +1,12 @@
+"""Fixture: schema-conformant emission — must lint clean."""
+
+
+def emit_good(telemetry, writer, other):
+    telemetry.emit("chunk", epoch=1, steps=10, seconds=0.5, loss=0.1)
+    writer.mitigation(mtype="divergence_rollback", epoch=3,
+                      restored_epoch=2)
+    telemetry.heartbeat(beat=1, epoch=0, phase="chunk", interval_s=10.0,
+                        phase_elapsed_s=3.2)
+    fields = {"loss": 0.1}
+    writer.chunk(epoch=1, steps=10, seconds=0.5, **fields)  # splat: defer
+    other.alert(rule=1, metric="x", wrong_field=True)  # not a writer name

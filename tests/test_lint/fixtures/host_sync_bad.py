@@ -1,0 +1,21 @@
+"""Fixture: implicit device→host coercions on jitted results."""
+
+from functools import partial
+
+import jax
+import numpy as np
+
+
+@partial(jax.jit, static_argnames=("n",))
+def run_chunk(state, key, n):
+    return state, {"loss": state}
+
+
+def chunk_loop(state, key, steps):
+    series = []
+    for _ in range(steps):
+        state, stats = run_chunk(state, key, 8)
+        series.append(float(stats["loss"]))       # BUG: blocking fetch
+        series.append(np.asarray(stats["loss"]))  # BUG: blocking fetch
+        step = int(state)                          # BUG: blocking fetch
+    return series, step

@@ -1,0 +1,22 @@
+"""Fixture: the blocking-fetch idiom — one device_get per boundary."""
+
+from functools import partial
+
+import jax
+import numpy as np
+
+
+@partial(jax.jit, static_argnames=("n",))
+def run_chunk(state, key, n):
+    return state, {"loss": state}
+
+
+def chunk_loop(state, key, steps):
+    series = []
+    for _ in range(steps):
+        state, stats = run_chunk(state, key, 8)
+        fetched = jax.device_get({"stats": stats, "step": state})
+        series.append(float(fetched["stats"]["loss"]))
+        series.append(np.asarray(fetched["stats"]["loss"]))
+        step = int(fetched["step"])
+    return series, step

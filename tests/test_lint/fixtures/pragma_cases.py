@@ -1,0 +1,38 @@
+"""Fixture: pragma grammar — suppression, missing reasons, unknown ids.
+
+Line numbers matter to tests/test_lint/test_framework.py; edit with care.
+"""
+
+import time
+
+
+def suppressed_trailing():
+    t0 = time.time()   # lint-ok(timing-hygiene): host-only fixture clock
+    return t0
+
+
+def suppressed_comment_line():
+    # lint-ok(timing-hygiene): comment-only pragma applies to the
+    # next code line — long reasons live up here
+    t1 = time.time()
+    return t1
+
+
+def reasonless():
+    t2 = time.time()   # lint-ok(timing-hygiene):
+    return t2
+
+
+def unknown_pass():
+    t3 = time.time()   # lint-ok(not-a-pass): suppresses nothing real
+    return t3
+
+
+def legacy_pragma():
+    t4 = time.time()   # timing-ok: legacy spelling still honored
+    return t4
+
+
+def unsuppressed():
+    t5 = time.time()
+    return t5

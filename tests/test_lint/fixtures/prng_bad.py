@@ -1,0 +1,18 @@
+"""Fixture: PRNG-key reuse — two consumers, and a loop without rebind."""
+
+import jax
+
+
+def double_consume(x):
+    key = jax.random.PRNGKey(0)
+    noise = jax.random.normal(key, x.shape)
+    more = jax.random.normal(key, x.shape)   # BUG: same key, same draws
+    return noise + more
+
+
+def loop_reuse(xs):
+    key = jax.random.PRNGKey(0)
+    out = []
+    for x in xs:
+        out.append(jax.random.normal(key, x.shape))   # BUG: every pass
+    return out

@@ -1,0 +1,22 @@
+"""Fixture: disciplined key handling — must lint clean."""
+
+import jax
+
+
+def split_consumers(x):
+    key = jax.random.PRNGKey(0)
+    k_a, k_b = jax.random.split(key)
+    return jax.random.normal(k_a, x.shape) + jax.random.normal(k_b, x.shape)
+
+
+def loop_rebind(key, xs):
+    out = []
+    for i, x in enumerate(xs):
+        key, k_draw = jax.random.split(key)
+        out.append(jax.random.normal(k_draw, x.shape))
+    return out
+
+
+def fold_in_loop(key, xs):
+    return [jax.random.normal(jax.random.fold_in(key, i), x.shape)
+            for i, x in enumerate(xs)]

@@ -1,0 +1,20 @@
+"""Fixture: unguarded self-mutation from a thread target (the
+EventWriter.emit race shape, both method- and closure-target forms)."""
+
+import threading
+
+
+class Emitter:
+    def __init__(self):
+        self.seq = 0
+        self.thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        while True:
+            self.seq += 1   # BUG: racing the main thread, no lock held
+
+    def start_closure(self):
+        def beat_loop():
+            self.last_beat = "now"   # BUG: same race, closure form
+
+        threading.Thread(target=beat_loop, daemon=True).start()

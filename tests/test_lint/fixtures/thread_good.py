@@ -1,0 +1,16 @@
+"""Fixture: thread-target mutation in a class that holds a lock — the
+pass trusts lock-holding classes (locking correctness is not decidable)."""
+
+import threading
+
+
+class LockedEmitter:
+    def __init__(self):
+        self.seq = 0
+        self._lock = threading.Lock()
+        self.thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        while True:
+            with self._lock:
+                self.seq += 1

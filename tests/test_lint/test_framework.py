@@ -1,0 +1,166 @@
+"""Framework tests: pragma grammar, allowlists, registry, runner."""
+
+import os
+
+import pytest
+
+from tests.test_lint.conftest import FIXTURES, REPO, line_of
+
+
+# ------------------------------------------------------------- registry
+def test_registry_has_the_seven_passes():
+    from dib_tpu.analysis import all_passes
+
+    ids = [p.id for p in all_passes()]
+    assert ids == sorted(ids)
+    for expected in ("donation-safety", "prng-reuse", "host-sync",
+                     "thread-shared-state", "event-schema",
+                     "timing-hygiene", "exception-hygiene"):
+        assert expected in ids
+
+
+def test_every_pass_names_its_incident():
+    from dib_tpu.analysis import all_passes
+
+    for lint in all_passes():
+        assert lint.description
+        assert lint.incident, f"{lint.id}: a pass must name the runtime " \
+                              "incident it prevents"
+
+
+def test_register_rejects_reasonless_allowlist_and_dup_ids():
+    from dib_tpu.analysis.core import LintPass, register
+
+    with pytest.raises(ValueError, match="justification"):
+        @register
+        class BadAllowlist(LintPass):
+            id = "tmp-bad-allowlist"
+            description = "x"
+            incident = "y"
+            allowlist = {"dib_tpu/foo.py": ""}
+
+    with pytest.raises(ValueError, match="duplicate"):
+        @register
+        class DupId(LintPass):
+            id = "timing-hygiene"
+            description = "x"
+            incident = "y"
+
+    with pytest.raises(ValueError, match="reserved"):
+        @register
+        class ReservedId(LintPass):
+            id = "pragma"
+            description = "x"
+            incident = "y"
+
+
+# -------------------------------------------------------------- pragmas
+def test_pragma_trailing_and_comment_line_suppress(load_fixture):
+    from dib_tpu.analysis.core import get_pass
+
+    module = load_fixture("pragma_cases.py")
+    lint = get_pass("timing-hygiene")
+    flagged = {f.line for f in lint.check_module(module)
+               if not module.suppressed(lint.id, f.line)}
+    lines = {name: line_of(module, name) for name in
+             ("t0 =", "t1 =", "t2 =", "t3 =", "t4 =", "t5 =")}
+    assert lines["t0 ="] not in flagged      # trailing pragma
+    assert lines["t1 ="] not in flagged      # comment-line pragma
+    assert lines["t4 ="] not in flagged      # legacy timing-ok
+    assert lines["t2 ="] in flagged          # reasonless: NOT suppressed
+    assert lines["t3 ="] in flagged          # wrong pass id: NOT suppressed
+    assert lines["t5 ="] in flagged          # no pragma at all
+
+
+def test_reasonless_and_unknown_pragmas_are_findings(load_fixture):
+    module = load_fixture("pragma_cases.py")
+    assert any("reason" in f.message for f in module.pragma_findings)
+    from dib_tpu.analysis.core import run_passes
+
+    findings = run_passes(
+        root=REPO,
+        files=[(os.path.join(FIXTURES, "pragma_cases.py"),
+                "tests/test_lint/fixtures/pragma_cases.py")],
+        select=["exception-hygiene"],   # pragma findings ignore select
+    )
+    pragma = [f for f in findings if f.pass_id == "pragma"]
+    assert any("reason" in f.message for f in pragma)
+    assert any("unknown pass 'not-a-pass'" in f.message for f in pragma)
+
+
+def test_stacked_comment_pragmas_merge_at_one_anchor(tmp_path):
+    """Review regression: two comment-only pragmas above one code line
+    both apply — the later must not silently overwrite the earlier."""
+    from dib_tpu.analysis.core import load_module
+
+    src = (
+        "import time\n"
+        "def f():\n"
+        "    # lint-ok(timing-hygiene): host-only clock\n"
+        "    # lint-ok(exception-hygiene): also justified\n"
+        "    t = time.time()\n"
+        "    return t\n"
+    )
+    path = tmp_path / "stacked.py"
+    path.write_text(src)
+    module = load_module(str(path), "stacked.py")
+    assert module.suppressed("timing-hygiene", 5)
+    assert module.suppressed("exception-hygiene", 5)
+
+
+def test_docstring_mention_of_grammar_is_not_a_pragma():
+    """core.py's own docstrings spell the grammar; tokenize-based comment
+    extraction must not read them as suppressions."""
+    from dib_tpu.analysis.core import load_module
+
+    path = os.path.join(REPO, "dib_tpu", "analysis", "core.py")
+    module = load_module(path, "dib_tpu/analysis/core.py")
+    assert not module.pragma_findings
+    for pragma in module.pragmas.values():
+        assert "<pass>" not in pragma.passes
+
+
+# ----------------------------------------------------------- the runner
+def test_run_passes_unknown_select_raises():
+    from dib_tpu.analysis.core import run_passes
+
+    with pytest.raises(KeyError, match="no-such-pass"):
+        run_passes(root=REPO, select=["no-such-pass"], files=[])
+
+
+def test_scope_and_target_modules():
+    from dib_tpu.analysis.core import get_pass
+
+    timing = get_pass("timing-hygiene")
+    assert timing.applies_to("dib_tpu/train/loop.py")
+    assert not timing.applies_to("scripts/bench_driver.py")
+    host = get_pass("host-sync")
+    assert host.applies_to("dib_tpu/train/loop.py")
+    assert not host.applies_to("dib_tpu/serve/engine.py")
+
+
+def test_statement_linearization_and_assigned_names():
+    import ast
+
+    from dib_tpu.analysis.core import (
+        assigned_names,
+        statements_in_order,
+        stmt_expr_roots,
+    )
+
+    src = (
+        "def f(x):\n"
+        "    while x > 0:\n"
+        "        a, b = g(x)\n"
+        "        with h() as c:\n"
+        "            d = i(c)\n"
+        "    return a\n"
+    )
+    fn = ast.parse(src).body[0]
+    stmts = statements_in_order(fn)
+    kinds = [type(s).__name__ for s in stmts]
+    assert kinds == ["While", "Assign", "With", "Assign", "Return"]
+    # compound statements own only their headers
+    assert [type(r).__name__ for r in stmt_expr_roots(stmts[0])] == ["Compare"]
+    assert assigned_names(stmts[1]) == {"a", "b"}
+    assert assigned_names(stmts[2]) == {"c"}

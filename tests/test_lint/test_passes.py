@@ -1,0 +1,268 @@
+"""Per-pass fixture tests: bad snippet flagged with the right pass id and
+line, good snippet clean — the contract docs/static-analysis.md's catalog
+describes."""
+
+import os
+
+from tests.test_lint.conftest import REPO, line_of
+
+
+def _findings(module, pass_id):
+    from dib_tpu.analysis.core import get_pass
+
+    lint = get_pass(pass_id)
+    return [f for f in lint.check_module(module)
+            if not module.suppressed(pass_id, f.line)]
+
+
+# ------------------------------------------------------ donation-safety
+def test_donation_flags_the_pr4_async_save_shape(load_fixture):
+    """THE acceptance fixture: run_chunk's donated outputs handed to an
+    async checkpoint save inside the chunk loop (docs/robustness.md,
+    'Async save vs. donation')."""
+    module = load_fixture("donation_async_save_bad.py")
+    findings = _findings(module, "donation-safety")
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.pass_id == "donation-safety"
+    assert f.line == line_of(module, "manager.save(")
+    assert "async checkpoint" in f.message
+    assert "run_chunk" in f.message
+
+
+def test_donation_flags_read_after_donation(load_fixture):
+    module = load_fixture("donation_read_after_bad.py")
+    findings = _findings(module, "donation-safety")
+    assert len(findings) == 1
+    assert findings[0].line == line_of(module, 'history["loss"]')
+    assert "`history` was donated" in findings[0].message
+
+
+def test_donation_good_idioms_are_clean(load_fixture):
+    module = load_fixture("donation_good.py")
+    assert _findings(module, "donation-safety") == []
+
+
+def test_donation_pragma_suppresses(tmp_path):
+    from dib_tpu.analysis.core import load_module
+
+    src = (
+        "from functools import partial\n"
+        "import jax\n"
+        "@partial(jax.jit, donate_argnames=('state',))\n"
+        "def run_chunk(state, key):\n"
+        "    return state\n"
+        "def f(manager, state, key):\n"
+        "    out = run_chunk(state, key)\n"
+        "    # lint-ok(donation-safety): CPU-only path, save is synchronous\n"
+        "    manager.save(0, args=out)\n"
+        "    return out\n"
+    )
+    path = tmp_path / "snippet.py"
+    path.write_text(src)
+    module = load_module(str(path), "snippet.py")
+    assert _findings(module, "donation-safety") == []
+
+
+def test_donation_unbound_attribute_call_maps_args_correctly(tmp_path):
+    """Review regression: `T.run_chunk(self, state, key)` passes self
+    explicitly — positional mapping must not shift by one (which both
+    missed the real read-after-donation of `state` and falsely marked
+    `self` as donated)."""
+    from dib_tpu.analysis.core import load_module
+
+    src = (
+        "from functools import partial\n"
+        "import jax\n"
+        "class T:\n"
+        "    @partial(jax.jit, donate_argnames=('state',))\n"
+        "    def run_chunk(self, state, key):\n"
+        "        return state\n"
+        "    def f(self, state, key):\n"
+        "        out = T.run_chunk(self, state, key)\n"
+        "        leak = state\n"
+        "        ok = self.f\n"
+        "        return out, leak, ok\n"
+    )
+    path = tmp_path / "unbound.py"
+    path.write_text(src)
+    module = load_module(str(path), "unbound.py")
+    findings = _findings(module, "donation-safety")
+    assert [f.line for f in findings] == [9]          # the `state` read
+    assert "`state` was donated" in findings[0].message
+    assert not any("`self`" in f.message for f in findings)
+
+
+# ----------------------------------------------------------- prng-reuse
+def test_prng_flags_double_consumption_and_loop_reuse(load_fixture):
+    module = load_fixture("prng_bad.py")
+    findings = _findings(module, "prng-reuse")
+    lines = {f.line for f in findings}
+    assert line_of(module, "more = jax.random.normal(key") in lines
+    assert line_of(module, "out.append(jax.random.normal(key") in lines
+
+
+def test_prng_good_is_clean(load_fixture):
+    module = load_fixture("prng_good.py")
+    assert _findings(module, "prng-reuse") == []
+
+
+# ------------------------------------------------------------ host-sync
+def test_host_sync_flags_implicit_coercions(load_fixture):
+    module = load_fixture("host_sync_bad.py")
+    findings = _findings(module, "host-sync")
+    lines = {f.line for f in findings}
+    assert line_of(module, 'float(stats["loss"])') in lines
+    assert line_of(module, 'np.asarray(stats["loss"])') in lines
+    assert line_of(module, "int(state)") in lines
+
+
+def test_host_sync_device_get_idiom_is_clean(load_fixture):
+    module = load_fixture("host_sync_good.py")
+    assert _findings(module, "host-sync") == []
+
+
+def test_host_sync_targets_only_chunk_loop_modules():
+    from dib_tpu.analysis.core import get_pass
+
+    host = get_pass("host-sync")
+    assert set(host.target_modules) == {
+        "dib_tpu/train/loop.py",
+        "dib_tpu/parallel/sweep.py",
+        "dib_tpu/workloads/boolean.py",
+    }
+
+
+# -------------------------------------------------- thread-shared-state
+def test_thread_flags_method_and_closure_targets(load_fixture):
+    module = load_fixture("thread_bad.py")
+    findings = _findings(module, "thread-shared-state")
+    lines = {f.line for f in findings}
+    assert line_of(module, "self.seq += 1") in lines
+    assert line_of(module, 'self.last_beat = "now"') in lines
+    assert all("Emitter" in f.message for f in findings)
+
+
+def test_thread_locked_class_is_trusted(load_fixture):
+    module = load_fixture("thread_good.py")
+    assert _findings(module, "thread-shared-state") == []
+
+
+def test_thread_target_resolves_in_the_spawning_class(tmp_path):
+    """Review regression: `target=self._run` must resolve to the
+    SPAWNING class's method — a later same-named method on a
+    lock-holding class must not shadow it and hide the race."""
+    from dib_tpu.analysis.core import load_module
+
+    src = (
+        "import threading\n"
+        "class Unlocked:\n"
+        "    def spawn(self):\n"
+        "        threading.Thread(target=self._run).start()\n"
+        "    def _run(self):\n"
+        "        self.count = 0\n"
+        "class Locked:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def _run(self):\n"
+        "        self.count = 0\n"
+    )
+    path = tmp_path / "shadow.py"
+    path.write_text(src)
+    module = load_module(str(path), "shadow.py")
+    findings = _findings(module, "thread-shared-state")
+    assert len(findings) == 1
+    assert findings[0].line == 6
+    assert "Unlocked" in findings[0].message
+
+
+# --------------------------------------------------------- event-schema
+def test_event_schema_flags_drift(load_fixture):
+    module = load_fixture("event_schema_bad.py")
+    findings = _findings(module, "event-schema")
+    messages = "\n".join(f.message for f in findings)
+    assert "'chnk'" in messages                       # unknown kind
+    assert "missing required" in messages             # emit without fields
+    assert "mtyp" in messages                         # unknown field
+    assert "chunk_elapsed_s" in messages              # documented-but-fake
+
+
+def test_event_schema_good_is_clean(load_fixture):
+    module = load_fixture("event_schema_good.py")
+    assert _findings(module, "event-schema") == []
+
+
+def test_event_schema_docs_in_sync_with_registry():
+    """The committed docs/observability.md record-type table matches
+    EVENT_SCHEMA exactly (the satellite's docs-cannot-drift guarantee)."""
+    from dib_tpu.analysis.core import get_pass
+
+    assert get_pass("event-schema").check_project(REPO) == []
+
+
+def test_event_schema_docs_drift_detected(tmp_path):
+    from dib_tpu.analysis.core import get_pass
+
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "observability.md").write_text(
+        "Record types and their payloads:\n\n"
+        "- **`chunk`** — per-chunk signal.\n"
+        "- **`made_up_kind`** — not in the registry.\n"
+    )
+    findings = get_pass("event-schema").check_project(str(tmp_path))
+    messages = "\n".join(f.message for f in findings)
+    assert "made_up_kind" in messages          # documented, no schema row
+    assert "'mitigation'" in messages          # schema row, undocumented
+
+
+def test_strict_mode_rejects_unknown_kind(tmp_path, monkeypatch):
+    from dib_tpu.telemetry.events import EventWriter
+
+    monkeypatch.setenv("DIB_TELEMETRY_STRICT", "1")
+    writer = EventWriter(str(tmp_path))
+    try:
+        writer.emit("chunk", epoch=0, steps=1, seconds=0.1)  # known: fine
+        import pytest
+
+        with pytest.raises(ValueError, match="unknown event kind"):
+            writer.emit("chnk", epoch=0)
+    finally:
+        writer.close()
+
+
+def test_schema_registry_covers_every_typed_helper():
+    """Every typed EventWriter helper is named after a schema kind and
+    vice versa — the registry cannot drift from the writer surface."""
+    import inspect
+
+    from dib_tpu.telemetry.events import EVENT_SCHEMA, EventWriter
+
+    helper_names = {
+        name for name, member in inspect.getmembers(
+            EventWriter, predicate=inspect.isfunction)
+        if not name.startswith("_") and name not in (
+            "emit", "close", "metrics")
+    } | {"metrics"}
+    assert helper_names == set(EVENT_SCHEMA)
+
+
+# --------------------------------------------------- migrated passes
+def test_timing_pass_flags_and_allowlists():
+    from dib_tpu.analysis.core import Module, get_pass
+
+    lint = get_pass("timing-hygiene")
+    module = Module("x.py", "dib_tpu/x.py",
+                    "import time\nt0 = time.time()\n")
+    assert [f.line for f in lint.check_module(module)] == [2]
+    assert "dib_tpu/utils/profiling.py" in lint.allowlist
+    for rel, why in lint.allowlist.items():
+        assert why.strip()
+
+
+def test_exception_pass_scope_is_the_whole_tree():
+    from dib_tpu.analysis.core import get_pass
+
+    lint = get_pass("exception-hygiene")
+    assert lint.applies_to("dib_tpu/train/loop.py")
+    assert lint.applies_to("scripts/fault_drill.py")
