@@ -57,11 +57,17 @@ class HostSyncPass(LintPass):
                 "kept parking on implicit syncs (docs/observability.md, "
                 "async-dispatch pitfall)")
     # The modules whose inner loops are the product's hot path. Everything
-    # else may fetch freely — drivers and hooks run between chunks.
+    # else may fetch freely — drivers and hooks run between chunks. The
+    # sched modules are included from day one: the scheduler's worker
+    # pool runs MANY units' chunk loops concurrently, so a hidden
+    # blocking fetch there serializes the whole pool, not one run.
     target_modules = (
         "dib_tpu/train/loop.py",
         "dib_tpu/parallel/sweep.py",
         "dib_tpu/workloads/boolean.py",
+        "dib_tpu/sched/runner.py",
+        "dib_tpu/sched/pool.py",
+        "dib_tpu/sched/scheduler.py",
     )
 
     def check_module(self, module: Module) -> list[Finding]:

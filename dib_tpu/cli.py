@@ -91,15 +91,17 @@ def build_parser() -> argparse.ArgumentParser:
     )
     parser.add_argument("command", nargs="?", default="train",
                         choices=["train", "workload", "telemetry", "serve",
-                                 "lint"],
+                                 "lint", "sched"],
                         help="Subcommand: 'train' (flags below), 'workload' "
                              "(paper workloads; see `dib_tpu workload --help`), "
                              "'telemetry' (summarize/compare/report run "
                              "event streams; see `dib_tpu telemetry --help`), "
                              "'serve' (inference over a checkpoint; see "
-                             "`dib_tpu serve --help`), or 'lint' (static "
+                             "`dib_tpu serve --help`), 'lint' (static "
                              "analysis over the tree; see "
-                             "`dib_tpu lint --help`).")
+                             "`dib_tpu lint --help`), or 'sched' (the "
+                             "fault-tolerant β-grid scheduler; see "
+                             "`dib_tpu sched --help`).")
     _add_model_flags(parser)
     parser.add_argument("--artifact_outdir", type=str, default="./training_artifacts/")
     parser.add_argument("--learning_rate", type=float, default=3e-4)
@@ -1156,8 +1158,15 @@ def main(argv: Sequence[str] | None = None) -> int:
             from dib_tpu.analysis import lint_main
 
             return lint_main(argv[1:])
+        if argv and argv[0] == "sched":
+            # submit/status are pure journal file analysis; run-pool
+            # initializes the backend itself when it trains
+            from dib_tpu.sched.cli import sched_main
+
+            return sched_main(argv[1:])
         args = build_parser().parse_args(argv)
-        if args.command in ("workload", "telemetry", "serve", "lint"):
+        if args.command in ("workload", "telemetry", "serve", "lint",
+                            "sched"):
             # parsed from a non-leading position (flags first): these
             # subcommands' flags are not the train flags, so re-dispatching
             # would misparse. Name the flag that displaced the subcommand

@@ -22,8 +22,10 @@ from dib_tpu.faults.inject import (
     PoisonedReplicaRestore,
     apply_due_train_faults,
     corrupt_checkpoint,
+    expire_lease,
     poison_params,
     poison_replica_params,
+    tear_journal,
 )
 from dib_tpu.faults.plan import FAULT_KINDS, FaultPlan, FaultSpec
 from dib_tpu.faults.serve import (
@@ -41,7 +43,9 @@ __all__ = [
     "PoisonedReplicaRestore",
     "apply_due_train_faults",
     "corrupt_checkpoint",
+    "expire_lease",
     "kill_batcher_worker",
     "poison_params",
     "poison_replica_params",
+    "tear_journal",
 ]

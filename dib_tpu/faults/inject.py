@@ -26,8 +26,10 @@ __all__ = [
     "PoisonedReplicaRestore",
     "apply_due_train_faults",
     "corrupt_checkpoint",
+    "expire_lease",
     "poison_params",
     "poison_replica_params",
+    "tear_journal",
 ]
 
 
@@ -164,6 +166,37 @@ def apply_due_train_faults(plan: FaultPlan, chunk_index: int, state,
         else:  # parse() rejects non-train scopes; guard against drift
             raise ValueError(f"fault kind {spec.kind!r} is not train-scoped")
     return state
+
+
+# ---------------------------------------------------------- sched faults
+def tear_journal(journal_path: str, telemetry=None) -> dict:
+    """Tear the scheduler journal mid-append: append HALF a record with
+    no trailing newline — exactly the bytes a scheduler SIGKILLed inside
+    its one ``os.write`` would leave behind. Replay on the next scheduler
+    construction must skip the torn line (counting it) and rebuild the
+    queue from the surviving records (``journal_recovered`` mitigation).
+
+    Emitted as a ``fault`` event BEFORE the tear, like every injector.
+    """
+    if telemetry is not None:
+        telemetry.fault(kind="journal_torn", detail=journal_path)
+    torn = '{"v": 1, "kind": "lease", "unit_id": "torn-mid-app'
+    with open(journal_path, "ab") as f:
+        f.write(torn.encode())
+    return {"kind": "journal_torn", "path": journal_path,
+            "torn_bytes": len(torn)}
+
+
+def expire_lease(scheduler, unit_id: str, telemetry=None) -> bool:
+    """Force-expire a unit's live lease while its holder still runs —
+    the deterministic stand-in for a straggler blowing its lease
+    deadline. The scheduler re-queues the unit (``lease_stolen``
+    mitigation); the stale holder's next renewal/completion is rejected,
+    which is the double-execution guard under test.
+    """
+    if telemetry is not None:
+        telemetry.fault(kind="lease_expire", detail=unit_id)
+    return scheduler.force_expire(unit_id, "injected lease expiry")
 
 
 def _latest_step_dir(directory: str) -> str:

@@ -84,6 +84,22 @@ FAULT_KINDS: dict[str, tuple[str, str | None, str]] = {
                "one host arrives at the chunk barrier with a stale "
                "(run_id, chunk, git_sha) — the desync guard names it "
                "instead of hanging; injected by the drill harness"),
+    "sched_worker_kill": ("sched", "chunk",
+                          "kill one pool worker dead mid-unit (no release, "
+                          "no fail — its lease just goes silent): the "
+                          "pool degrades to N-1 and the reaper steals the "
+                          "unit for a live worker, which resumes it from "
+                          "its newest intact checkpoint"),
+    "lease_expire": ("sched", None,
+                     "force a held lease past its deadline while the "
+                     "holder still runs — the work-stealing path: a live "
+                     "worker re-leases the unit, and the stale holder's "
+                     "renewal/completion is REJECTED (no double-execution)"),
+    "journal_torn": ("sched", None,
+                     "tear the scheduler journal mid-append (the SIGKILL-"
+                     "mid-write shape) — replay on scheduler restart skips "
+                     "the torn line, recovers the queue, and surfaces a "
+                     "journal_recovered mitigation"),
 }
 
 # Plan-grammar kinds whose ARG is mandatory (the others default sensibly).

@@ -1,0 +1,69 @@
+"""Sweep-as-a-service: a fault-tolerant work-stealing β-grid scheduler.
+
+The paper's scientific product is the whole annealing trajectory across
+many β points and seeds (NORTHSTAR_ENSEMBLE is exactly that), yet before
+this package one sweep = one fixed launch: an ejected replica permanently
+degraded the sweep and a dead host lost its slice of the grid. PR 4/5
+built the *worker* half of durability — chunk-aligned checkpoints,
+exit-75 preemption, a 13/13-green fault matrix — and this package is the
+scheduling layer above it (docs/robustness.md "Sweep as a service"):
+
+  - :mod:`dib_tpu.sched.journal` — durable append-only job journal
+    (``journal.jsonl``, the events.jsonl durability contract: one
+    ``O_APPEND`` write per record, torn-final-line tolerant on replay) —
+    the scheduler's ENTIRE state is a fold over this file, so a
+    SIGKILLed scheduler restarts into exactly the queue it died with;
+  - :mod:`dib_tpu.sched.scheduler` — β-grid jobs (dense grids,
+    refinement around info-plane transitions, multi-seed ensembles)
+    decomposed into chunk-resumable work units, handed to workers under
+    **leases**: a unit whose lease expires (or whose worker dies) is
+    re-leased to a live worker — work-stealing — and a completion or
+    renewal under a superseded lease is REJECTED, so a presumed-dead
+    worker that returns can never double-execute a unit. Failures retry
+    with exponential backoff against a per-job retry budget; budget
+    exhaustion marks the job failed instead of retrying forever;
+  - :mod:`dib_tpu.sched.pool` — a worker pool draining the queue:
+    worker death shrinks the pool (its leased unit is stolen, never
+    lost), cooperative preemption re-enqueues lease-free exactly like
+    the watchdog's budget-free relaunch;
+  - :mod:`dib_tpu.sched.runner` — the per-unit trainer: one β point ×
+    seed trained with chunk-aligned checkpoints under the unit's own
+    directory, resuming from the newest intact step
+    (``restore_latest_intact``) so a stolen or retried unit continues
+    bit-identically to an uninterrupted run;
+  - :mod:`dib_tpu.sched.cli` — ``python -m dib_tpu sched
+    submit|status|run-pool``.
+
+``scripts/chaos_suite.py`` runs the fault matrix *against this layer
+under load* — killing workers, expiring leases, tearing the journal
+mid-append — and the committed ``CHAOS_SCHED.json`` proves zero lost
+units, no double-executions, and bit-identical per-β histories.
+"""
+
+from dib_tpu.sched.journal import JOURNAL_FILENAME, JobJournal, read_journal
+from dib_tpu.sched.scheduler import (
+    JobSpec,
+    Lease,
+    Scheduler,
+    WorkUnit,
+    dense_beta_grid,
+    refine_beta_grid,
+)
+from dib_tpu.sched.pool import LeaseLost, WorkerKilled, WorkerPool
+from dib_tpu.sched.runner import TrainingUnitRunner
+
+__all__ = [
+    "JOURNAL_FILENAME",
+    "JobJournal",
+    "JobSpec",
+    "Lease",
+    "LeaseLost",
+    "Scheduler",
+    "TrainingUnitRunner",
+    "WorkUnit",
+    "WorkerKilled",
+    "WorkerPool",
+    "dense_beta_grid",
+    "read_journal",
+    "refine_beta_grid",
+]

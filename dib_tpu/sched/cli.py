@@ -1,0 +1,301 @@
+"""``python -m dib_tpu sched submit|status|run-pool`` — sweep as a service.
+
+``submit`` appends a β-grid job to a scheduler directory's durable
+journal; ``status`` replays the journal into a queue snapshot; and
+``run-pool`` drains the queue with a worker pool of training unit
+runners, optionally under watchdog supervision (``--watchdog``:
+crash-relaunched, rc-75 preemptions relaunched budget-free while the
+journal shows progress). The scheduler directory is also the run
+directory: ``journal.jsonl`` next to ``events.jsonl``, so
+``telemetry tail``/``summarize``/``check`` see the queue's ``job`` /
+``lease`` events alongside everything else (docs/robustness.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Sequence
+
+__all__ = ["sched_main"]
+
+
+def _add_sched_dir(parser) -> None:
+    parser.add_argument("--sched-dir", "--sched_dir", dest="sched_dir",
+                        required=True,
+                        help="Scheduler directory: holds the durable "
+                             "journal.jsonl, the run's events.jsonl, and "
+                             "per-unit checkpoints/artifacts under units/.")
+
+
+def build_sched_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="dib_tpu sched",
+        description="Fault-tolerant work-stealing β-grid scheduler "
+                    "(docs/robustness.md 'Sweep as a service').",
+    )
+    sub = parser.add_subparsers(dest="action", required=True)
+
+    p_sub = sub.add_parser(
+        "submit", help="Append a β-grid job (dense grid, refinement, or "
+                       "explicit list × seeds) to the journal.")
+    _add_sched_dir(p_sub)
+    p_sub.add_argument("--betas", type=float, nargs="+", default=None,
+                       help="Explicit β endpoints.")
+    p_sub.add_argument("--grid", type=float, nargs=3, default=None,
+                       metavar=("START", "STOP", "NUM"),
+                       help="Dense log-spaced grid: start stop num.")
+    p_sub.add_argument("--refine-around", type=float, nargs="+",
+                       default=None, dest="refine_around",
+                       help="Refinement grid around these β values (e.g. "
+                            "info-plane transition events).")
+    p_sub.add_argument("--refine-num", type=int, default=4,
+                       dest="refine_num",
+                       help="Points per refinement center (default 4).")
+    p_sub.add_argument("--seeds", type=int, nargs="+", default=[0],
+                       help="Seeds per β point (multi-seed ensembles).")
+    p_sub.add_argument("--retry-budget", type=int, default=3,
+                       dest="retry_budget",
+                       help="Per-job retry budget: unit failures beyond "
+                            "it mark the job failed (default 3).")
+    p_sub.add_argument("--name", default="", help="Job label.")
+    p_sub.add_argument("--set", action="append", default=[],
+                       metavar="FIELD=VALUE",
+                       help="Unit training-spec override (repeatable), "
+                            "e.g. --set num_annealing_epochs=6")
+
+    p_stat = sub.add_parser(
+        "status", help="Replay the journal into a queue snapshot.")
+    _add_sched_dir(p_stat)
+    p_stat.add_argument("--json", action="store_true",
+                        help="Machine-readable snapshot.")
+
+    p_pool = sub.add_parser(
+        "run-pool", help="Drain the queue with a pool of training "
+                         "workers (work-stealing, retry/backoff, "
+                         "preemption-tolerant).")
+    _add_sched_dir(p_pool)
+    p_pool.add_argument("--workers", type=int, default=2)
+    p_pool.add_argument("--lease-s", type=float, default=60.0,
+                        dest="lease_s",
+                        help="Lease duration; a unit unrenewed past it is "
+                             "stolen by a live worker (default 60).")
+    p_pool.add_argument("--duration-s", type=float, default=None,
+                        dest="duration_s",
+                        help="Stop the pool after this long even if the "
+                             "queue is not drained.")
+    p_pool.add_argument("--preempt_grace_s", type=float, default=30.0,
+                        help="SIGTERM/SIGINT grace budget: in-flight "
+                             "units checkpoint chunk-aligned, re-enqueue "
+                             "lease-free, and the pool exits with the "
+                             "preemption code (75). 0 disables.")
+    p_pool.add_argument("--watchdog", action="store_true",
+                        help="Supervise this pool (train/watchdog.py "
+                             "supervise_pool): crashes relaunch with "
+                             "backoff against a restart budget; rc-75 "
+                             "preemptions relaunch immediately and "
+                             "budget-free while units keep finishing "
+                             "(terminal journal records).")
+    p_pool.add_argument("--max-restarts", type=int, default=3,
+                        dest="max_restarts")
+    p_pool.add_argument("--telemetry-dir", "--telemetry_dir",
+                        dest="telemetry_dir", type=str, default=None,
+                        help="Events stream directory (default: the "
+                             "scheduler dir; '' disables).")
+    p_pool.add_argument("--runs-root", "--runs_root", dest="runs_root",
+                        type=str, default="",
+                        help="Register the pool run in the fleet registry "
+                             "(default: DIB_RUNS_ROOT when set, else off).")
+    return parser
+
+
+def _resolve_betas(args) -> list[float]:
+    from dib_tpu.sched.scheduler import dense_beta_grid, refine_beta_grid
+
+    given = [name for name, value in (
+        ("--betas", args.betas), ("--grid", args.grid),
+        ("--refine-around", args.refine_around)) if value]
+    if len(given) != 1:
+        raise SystemExit(
+            "sched submit: pass exactly one of --betas / --grid / "
+            f"--refine-around (got {given or 'none'})")
+    if args.betas:
+        return [float(b) for b in args.betas]
+    if args.grid:
+        start, stop, num = args.grid
+        return dense_beta_grid(start, stop, int(num))
+    return refine_beta_grid(args.refine_around, num=args.refine_num)
+
+
+def _parse_spec_sets(pairs: Sequence[str]) -> dict:
+    from dib_tpu.cli import _parse_sets
+
+    return _parse_sets(pairs)
+
+
+def _submit_main(args) -> int:
+    from dib_tpu.sched.scheduler import JobSpec, Scheduler
+
+    betas = _resolve_betas(args)
+    spec = JobSpec(betas=tuple(betas), seeds=tuple(args.seeds),
+                   train=_parse_spec_sets(args.set),
+                   retry_budget=args.retry_budget, name=args.name)
+    scheduler = Scheduler(args.sched_dir)
+    try:
+        job_id = scheduler.submit(spec)
+        counts = scheduler.status()["counts"]
+    finally:
+        scheduler.close()
+    print(json.dumps({"job_id": job_id, "units": len(betas) * len(args.seeds),
+                      "betas": betas, "seeds": list(args.seeds),
+                      "queue": counts}))
+    return 0
+
+
+def _status_main(args) -> int:
+    from dib_tpu.sched.scheduler import Scheduler
+
+    scheduler = Scheduler(args.sched_dir)
+    try:
+        snapshot = scheduler.status()
+        snapshot["replayed_records"] = scheduler.replayed_records
+        snapshot["replayed_torn"] = scheduler.replayed_torn
+    finally:
+        scheduler.close()
+    if args.json:
+        print(json.dumps(snapshot, indent=1))
+        return 0
+    counts = snapshot["counts"]
+    print(f"queue: {counts['pending']} pending / {counts['leased']} leased "
+          f"/ {counts['done']} done / {counts['failed']} failed"
+          + (f"  (journal: {snapshot['replayed_records']} records, "
+             f"{snapshot['replayed_torn']} torn)"
+             if snapshot["replayed_torn"] else ""))
+    for job_id, job in snapshot["jobs"].items():
+        print(f"job {job_id}  {job['status']:8} units={job['units']} "
+              f"retries={job['retries_used']}/{job['retry_budget']}"
+              + (f"  [{job['name']}]" if job["name"] else ""))
+    for row in snapshot["units"]:
+        worker = f"  worker={row['worker']}" if row["worker"] else ""
+        print(f"  {row['unit_id']:28} {row['status']:8} "
+              f"beta={row['beta']:<10g} seed={row['seed']} "
+              f"attempts={row['attempts']}{worker}")
+    return 0
+
+
+def _run_pool_supervised(args, argv: Sequence[str]) -> int:
+    """Re-exec this run-pool command as a supervised worker process: the
+    journal makes a relaunched pool resume the exact queue, so crash
+    supervision needs no heartbeat file — rc-75 preemptions relaunch
+    budget-free while the journal grew (the epoch-progress gate's
+    journal-shaped twin)."""
+    from dib_tpu.sched.journal import JOURNAL_FILENAME
+    from dib_tpu.telemetry import open_writer, shared_run_id
+    from dib_tpu.train.watchdog import WatchdogConfig, supervise_pool
+
+    run_id = shared_run_id()
+    os.environ["DIB_TELEMETRY_RUN_ID"] = run_id
+    telemetry = open_writer(args.telemetry_dir, args.sched_dir,
+                            run_id=run_id, process_index=0,
+                            tags={"src": "supervisor"})
+    # remove only the FIRST token that spells the flag — argparse
+    # accepts unambiguous prefixes (--watch, --watchd, ...), so exact
+    # .remove("--watchdog") would crash on an abbreviated spelling; and
+    # filtering by value equality would also strip an argument VALUE
+    # that happens to spell the same. Option values can never start
+    # with "--", so a prefix match here is always the flag itself.
+    worker = list(argv)
+    for i, token in enumerate(worker):
+        if token.startswith("--wa") and "--watchdog".startswith(token):
+            del worker[i]
+            break
+    result = supervise_pool(
+        [sys.executable, "-m", "dib_tpu.cli", "sched", "run-pool", *worker],
+        config=WatchdogConfig(max_restarts=args.max_restarts),
+        telemetry=telemetry,
+        journal_path=os.path.join(args.sched_dir, JOURNAL_FILENAME),
+    )
+    if telemetry is not None:
+        telemetry.close()
+    print(json.dumps({"watchdog": result}))
+    return 0 if result["returncode"] == 0 else 1
+
+
+def _run_pool_main(args, argv: Sequence[str]) -> int:
+    if args.watchdog:
+        return _run_pool_supervised(args, argv)
+
+    import jax
+
+    from dib_tpu.sched.pool import WorkerPool
+    from dib_tpu.sched.runner import TrainingUnitRunner
+    from dib_tpu.sched.scheduler import Scheduler
+    from dib_tpu.telemetry import open_writer, runtime_manifest, shared_run_id
+    from dib_tpu.train.preempt import (
+        PREEMPT_EXIT_CODE,
+        PreemptionGuard,
+    )
+
+    os.makedirs(args.sched_dir, exist_ok=True)
+    telemetry = open_writer(args.telemetry_dir, args.sched_dir,
+                            run_id=shared_run_id(),
+                            process_index=jax.process_index())
+    if telemetry is not None:
+        telemetry.run_start(runtime_manifest(extra={
+            "mode": "sched_pool", "sched_dir": os.path.abspath(args.sched_dir),
+            "workers": args.workers, "lease_s": args.lease_s,
+        }))
+    guard = None
+    if args.preempt_grace_s and args.preempt_grace_s > 0:
+
+        def _grace_flush():
+            if telemetry is not None:
+                telemetry.run_end(status="preempted", aborted_chunk=True)
+                telemetry.close()
+
+        guard = PreemptionGuard(args.preempt_grace_s,
+                                on_grace_expired=_grace_flush)
+
+    scheduler = Scheduler(args.sched_dir, telemetry=telemetry,
+                          lease_s=args.lease_s)
+    runner = TrainingUnitRunner(args.sched_dir, telemetry=telemetry,
+                                preempt=guard)
+    pool = WorkerPool(scheduler, runner, num_workers=args.workers,
+                      telemetry=telemetry, preempt=guard)
+    try:
+        if guard is not None:
+            with guard:
+                stats = pool.run(duration_s=args.duration_s)
+        else:
+            stats = pool.run(duration_s=args.duration_s)
+    finally:
+        scheduler.close()
+    stats["queue"] = scheduler.status()["counts"]
+    if telemetry is not None:
+        telemetry.run_end(
+            status="preempted" if stats["preempted"] else "ok")
+        telemetry.close()
+        root = args.runs_root or os.environ.get("DIB_RUNS_ROOT")
+        if root:
+            from dib_tpu.telemetry.registry import register_run
+
+            register_run(os.path.dirname(telemetry.path), root=root)
+    print(json.dumps(stats))
+    if stats["preempted"]:
+        return PREEMPT_EXIT_CODE
+    return 0 if stats["drained"] else 1
+
+
+def sched_main(argv: Sequence[str]) -> int:
+    argv = list(argv)
+    args = build_sched_parser().parse_args(argv)
+    if args.action == "submit":
+        return _submit_main(args)
+    if args.action == "status":
+        return _status_main(args)
+    # the subparser action is positionally first (the parser defines no
+    # pre-subcommand flags); strip it by POSITION — filtering by value
+    # would also eat e.g. a --sched-dir literally named "run-pool"
+    return _run_pool_main(args, argv[1:])

@@ -1,0 +1,132 @@
+"""Durable append-only job journal: the scheduler's only persistent state.
+
+The whole scheduler (``dib_tpu/sched/scheduler.py``) is a fold over this
+file — there is no database, no lock file, no state snapshot to go stale.
+Every state transition (job submitted, unit added, lease granted/renewed/
+released/expired, unit done/failed, job done/failed) is ONE JSON line
+appended with the events.jsonl durability contract (telemetry/events.py):
+a single ``os.write`` of one ``\\n``-terminated line on an ``O_APPEND``
+fd, so concurrent appenders never interleave bytes and a writer killed
+mid-append can tear at most the line it was writing. Replay
+(:func:`read_journal`) skips torn lines with a count, so a scheduler
+SIGKILLed mid-append restarts into exactly the queue it died with — the
+one lost transition is re-derived (an un-journaled lease grant simply
+never happened; the unit is still pending and is leased again).
+
+Record envelope: ``v`` (journal schema version), ``seq`` (per-writer
+sequence), ``t`` (unix time), ``kind``, then the transition's fields.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+__all__ = ["JOURNAL_FILENAME", "JOURNAL_VERSION", "JobJournal",
+           "read_journal"]
+
+JOURNAL_FILENAME = "journal.jsonl"
+JOURNAL_VERSION = 1
+
+
+class JobJournal:
+    """Appends scheduler state transitions to ``<directory>/journal.jsonl``.
+
+    Thread-safe: pool workers complete/fail units concurrently, and the
+    lock keeps ``seq`` gapless and the record/write pairing consistent
+    (the EventWriter.emit discipline).
+    """
+
+    def __init__(self, directory: str, filename: str = JOURNAL_FILENAME):
+        os.makedirs(directory, exist_ok=True)
+        self.directory = directory
+        self.path = os.path.join(directory, filename)
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._fd = os.open(
+            self.path, os.O_APPEND | os.O_CREAT | os.O_WRONLY, 0o644
+        )
+        # Seal a torn final line (the previous scheduler died mid-append):
+        # without the newline, THIS writer's first record would glue onto
+        # the torn bytes and be lost to every future replay as part of one
+        # unparseable line. One scheduler per directory is the deployment
+        # contract, so the seal can never split a live writer's record.
+        try:
+            size = os.fstat(self._fd).st_size
+            if size > 0:
+                with open(self.path, "rb") as f:
+                    f.seek(size - 1)
+                    if f.read(1) != b"\n":
+                        os.write(self._fd, b"\n")
+        except OSError:
+            pass
+
+    def append(self, kind: str, **fields) -> dict:
+        """Append one transition; returns the record as written. A closed
+        journal drops the append (mirrors EventWriter: a racing shutdown
+        must not crash the appending worker thread)."""
+        with self._lock:
+            if self._fd is None:
+                return {}
+            record = {
+                "v": JOURNAL_VERSION,
+                "seq": self._seq,
+                "t": round(time.time(), 6),   # timing-ok: record
+                # timestamp, not a measured interval
+                "kind": kind,
+                **fields,
+            }
+            self._seq += 1
+            line = json.dumps(record, allow_nan=False) + "\n"
+            # one write() per line on an O_APPEND fd: a kill can only
+            # truncate the final line, never corrupt an earlier one
+            os.write(self._fd, line.encode())
+        return record
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fd is not None:
+                os.close(self._fd)
+                self._fd = None
+
+    def __enter__(self) -> "JobJournal":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()
+
+
+def read_journal(path: str) -> tuple[list[dict], int]:
+    """All parseable records of a journal file, oldest first, plus the
+    count of torn lines skipped.
+
+    A torn line is evidence of a writer killed mid-append (the SIGKILL
+    the durability contract is designed around); the caller — scheduler
+    replay — surfaces the count as a ``journal_recovered`` mitigation so
+    crash recovery is never silent. A missing file replays as empty (a
+    fresh scheduler directory).
+    """
+    if os.path.isdir(path):
+        path = os.path.join(path, JOURNAL_FILENAME)
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+    except OSError:
+        return [], 0
+    records: list[dict] = []
+    torn = 0
+    for line in raw.split(b"\n"):
+        if not line.strip():
+            continue
+        try:
+            record = json.loads(line)
+        except json.JSONDecodeError:
+            torn += 1
+            continue
+        if isinstance(record, dict):
+            records.append(record)
+        else:
+            torn += 1
+    return records, torn

@@ -1,0 +1,224 @@
+"""Worker pool: drain the scheduler's queue, degrade gracefully, steal work.
+
+The pool is the live half of the scheduling layer: N worker threads
+acquire units under leases, run them through the unit runner
+(``sched/runner.py``), renew their leases at chunk boundaries, and report
+terminal outcomes back. Its failure semantics mirror the watchdog's
+(docs/robustness.md):
+
+  - **Worker death shrinks the pool, never loses a unit**: a worker that
+    dies mid-unit (the chaos suite's :class:`WorkerKilled`, or a real
+    crash) leaves its lease silent; the reaper thread notices the dead
+    thread and force-expires the lease immediately — the SLO-gap
+    heartbeat-silence path — so a live worker steals the unit and
+    resumes it from its newest intact checkpoint. Wall-clock lease
+    expiry (:meth:`Scheduler.reap`) covers workers that die without a
+    trace (SIGKILLed pool processes).
+  - **Stale workers abandon, never double-execute**: a lease renewal
+    rejected by the scheduler (the unit was stolen while this worker
+    stalled) surfaces as :class:`LeaseLost` at the worker's next chunk
+    boundary — BEFORE it writes a checkpoint or a result — and the
+    worker drops the unit on the floor. The thief's execution is the
+    only one that lands.
+  - **Cooperative preemption re-queues budget-free**: a unit unwinding
+    with ``TrainingPreempted`` (the armed guard's chunk-aligned exit) is
+    released lease-free — no retry burned, no backoff — exactly like the
+    watchdog's budget-free rc-75 relaunch.
+  - Any other unit exception is a FAILURE: retried with exponential
+    backoff against the job's retry budget (``Scheduler.fail``).
+
+The pool never imports jax — device work lives in the runner.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+
+from dib_tpu.train.preempt import TrainingPreempted
+
+__all__ = ["LeaseLost", "WorkerKilled", "WorkerPool"]
+
+
+class WorkerKilled(Exception):
+    """Injected sudden worker death (chaos suite): the worker thread dies
+    where it stands — no release, no fail, its lease just goes silent."""
+
+
+class LeaseLost(Exception):
+    """Raised by the pool's heartbeat when a renewal is rejected: the
+    unit was stolen; the holder must abandon it WITHOUT completing."""
+
+
+class WorkerPool:
+    """N worker threads + a reaper draining one :class:`Scheduler`.
+
+    ``runner(unit, heartbeat=...)`` executes one unit; ``heartbeat()``
+    (pool-provided) renews the worker's lease and raises
+    :class:`LeaseLost` when the renewal is rejected. ``preempt`` (a
+    ``PreemptionGuard``) stops the pool cooperatively: workers finish or
+    release their in-flight unit and exit, and :meth:`run` reports
+    ``preempted`` so the CLI can exit with the preemption code.
+    """
+
+    def __init__(self, scheduler, runner, num_workers: int = 2,
+                 poll_s: float = 0.05, reap_every_s: float = 0.25,
+                 telemetry=None, preempt=None, name: str = "pool"):
+        self.scheduler = scheduler
+        self.runner = runner
+        self.num_workers = int(num_workers)
+        self.poll_s = float(poll_s)
+        self.reap_every_s = float(reap_every_s)
+        # Instance-unique worker-name prefix: a relaunched pool (same
+        # process name, same worker indices) must NOT alias the dead
+        # pool's lease holders in the journal, or _reap_dead_workers
+        # would mistake an orphaned lease for its own live worker's and
+        # wait out the wall-clock deadline instead of stealing now.
+        self.name = f"{name}-{uuid.uuid4().hex[:6]}"
+        self._telemetry = telemetry
+        self._preempt = preempt
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._workers_done = threading.Event()
+        self._threads: dict[str, threading.Thread] = {}
+        self._dead_reported: set[str] = set()
+        self.stats = {"completed": 0, "failed": 0, "released": 0,
+                      "stale_abandoned": 0, "stale_completions": 0,
+                      "workers_died": 0, "stolen": 0}
+
+    # ------------------------------------------------------------- workers
+    def _heartbeat_for(self, lease):
+        def heartbeat() -> bool:
+            if not self.scheduler.renew(lease):
+                raise LeaseLost(
+                    f"lease {lease.lease_id} for unit {lease.unit_id} was "
+                    "superseded — the unit was stolen; abandoning it")
+            return True
+
+        return heartbeat
+
+    def _worker(self, worker_name: str) -> None:
+        while not self._stop.is_set():
+            if self._preempt is not None and self._preempt.requested:
+                return
+            lease = self.scheduler.acquire(worker_name)
+            if lease is None:
+                if self.scheduler.drained():
+                    return
+                time.sleep(self.poll_s)
+                continue
+            unit = self.scheduler.unit(lease.unit_id)["unit"]
+            try:
+                result = self.runner(
+                    unit, heartbeat=self._heartbeat_for(lease))
+            except LeaseLost:
+                with self._lock:
+                    self.stats["stale_abandoned"] += 1
+                continue
+            except TrainingPreempted:
+                # cooperative: the runner checkpointed chunk-aligned;
+                # re-queue lease-free (no retry burned, no backoff)
+                self.scheduler.release(lease, reason="preempt")
+                with self._lock:
+                    self.stats["released"] += 1
+                continue
+            except WorkerKilled:
+                # sudden death: the lease goes silent and the reaper
+                # steals the unit; the pool degrades to N-1 workers
+                with self._lock:
+                    self.stats["workers_died"] += 1
+                return
+            except Exception as exc:
+                self.scheduler.fail(
+                    lease, f"{type(exc).__name__}: {exc}")
+                with self._lock:
+                    self.stats["failed"] += 1
+                continue
+            if self.scheduler.complete(
+                    lease, result if isinstance(result, dict) else None):
+                with self._lock:
+                    self.stats["completed"] += 1
+            else:
+                with self._lock:
+                    self.stats["stale_completions"] += 1
+
+    # -------------------------------------------------------------- reaper
+    def _reap_dead_workers(self) -> None:
+        """Force-expire leases held by provably dead holders: a worker
+        thread of THIS pool that is no longer alive, or a holder this
+        pool never spawned (a previous pool instance that crashed — one
+        pool per scheduler directory is the deployment contract). Both
+        are heartbeat-silent forever, so waiting out the wall-clock
+        deadline only delays the steal."""
+        for row in self.scheduler.status()["units"]:
+            if row["status"] != "leased" or not row["worker"]:
+                continue
+            holder = row["worker"]
+            thread = self._threads.get(holder)
+            if thread is not None and thread.is_alive():
+                continue
+            if self.scheduler.force_expire(
+                    row["unit_id"],
+                    "worker dead" if thread is not None
+                    else "holder not in this pool (previous pool died)"):
+                with self._lock:
+                    self.stats["stolen"] += 1
+                if (self._telemetry is not None
+                        and holder not in self._dead_reported):
+                    self._dead_reported.add(holder)
+                    self._telemetry.mitigation(
+                        mtype="worker_dead", detail=holder,
+                        reason=("pool worker died mid-unit; its lease was "
+                                "force-expired and the unit re-queued"))
+
+    def _reaper(self) -> None:
+        while not self._workers_done.wait(self.reap_every_s):
+            self._reap_dead_workers()
+            with self._lock:
+                self.stats["stolen"] += len(self.scheduler.reap())
+
+    # ----------------------------------------------------------------- run
+    def run(self, duration_s: float | None = None) -> dict:
+        """Drain the queue: returns the stats dict plus ``drained`` and
+        ``preempted``. Workers exit when every unit is terminal (or the
+        pool is preempted/stopped); ``duration_s`` bounds the run — past
+        it the pool stops accepting units, each worker finishes (and
+        completes) its in-flight unit, and the rest of the queue is left
+        for the next pool. ``duration_s=0`` stops after at most one unit
+        per worker."""
+        for i in range(self.num_workers):
+            worker_name = f"{self.name}-w{i}"
+            thread = threading.Thread(
+                target=self._worker, args=(worker_name,),
+                name=worker_name, daemon=True)
+            self._threads[worker_name] = thread
+            thread.start()
+        reaper = threading.Thread(target=self._reaper, name=f"{self.name}-reaper",
+                                  daemon=True)
+        reaper.start()
+        deadline = ((time.time() + duration_s)     # timing-ok: host-side
+                    if duration_s is not None else None)  # deadline pacing
+        try:
+            for thread in self._threads.values():
+                while thread.is_alive():
+                    if deadline is not None \
+                            and time.time() >= deadline:   # timing-ok: pacing
+                        self._stop.set()
+                    # floor the join timeout so a passed deadline waits
+                    # out the worker's in-flight unit without spinning a
+                    # core the training threads need
+                    timeout = (min(1.0, max(deadline - time.time(), 0.05))  # timing-ok: pacing
+                               if deadline is not None else 1.0)
+                    thread.join(timeout=timeout)
+        finally:
+            self._stop.set()
+            self._workers_done.set()
+            reaper.join(timeout=5.0)
+        with self._lock:
+            out = dict(self.stats)
+        out["drained"] = self.scheduler.drained()
+        out["preempted"] = bool(
+            self._preempt is not None and self._preempt.requested)
+        out["workers"] = self.num_workers
+        return out

@@ -1,0 +1,162 @@
+"""Per-unit trainer: one β point × seed, chunk-checkpointed, resumable.
+
+The runner is where the scheduling layer meets the PR 4/5 worker
+machinery: every unit trains with a ``CheckpointHook`` at every chunk
+boundary under the unit's OWN directory
+(``<base_dir>/units/<unit_id>/ckpt``), and a unit that arrives with a
+checkpoint on disk — because its previous holder was killed, preempted,
+or stalled out of its lease — resumes from the newest intact step via
+``restore_latest_intact`` and continues the exact PRNG chain. The
+``DIBCheckpointer`` chunk-size contract makes the continuation
+bit-identical to an uninterrupted run, which is precisely what the chaos
+suite asserts per β (``CHAOS_SCHED.json``).
+
+Boundary hook order is load-bearing:
+
+  1. the pool's ``heartbeat`` (lease renewal) runs FIRST, so a worker
+     whose lease was stolen aborts with ``LeaseLost`` *before* touching
+     the unit's checkpoint directory or artifacts — the thief may
+     already be writing there;
+  2. the ``CheckpointHook`` persists the clean chunk-aligned state;
+  3. the injected ``boundary_hook`` (the chaos suite's fault injector)
+     runs LAST, so a kill/preempt fault always finds the checkpoint it
+     will be resumed from already durable — the ``apply_train_fault``
+     ordering, one layer up.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["DEFAULT_TRAIN_SPEC", "TrainingUnitRunner"]
+
+#: Training-spec defaults for a unit (the fault-drill tiny-model scale —
+#: the scheduler schedules; callers size the science via JobSpec.train).
+DEFAULT_TRAIN_SPEC: dict = {
+    "dataset": "boolean_circuit",
+    "encoder_hidden": (8,),
+    "integration_hidden": (16,),
+    "embedding_dim": 2,
+    "batch_size": 64,
+    "beta_start": 1e-4,
+    "num_pretraining_epochs": 2,
+    "num_annealing_epochs": 6,
+    "steps_per_epoch": 2,
+    "max_val_points": 128,
+    "chunk_epochs": 2,
+}
+
+
+class TrainingUnitRunner:
+    """Builds and fits one ``DIBTrainer`` per work unit.
+
+    ``boundary_hook(unit, epoch)``, when given, is called at every chunk
+    boundary after the checkpoint hook — the chaos suite raises its
+    faults (``WorkerKilled`` / ``TrainingPreempted``) from it.
+    ``preempt`` (a ``PreemptionGuard``) is forwarded to ``fit`` so a
+    pool-level SIGTERM checkpoints chunk-aligned and unwinds
+    cooperatively.
+    """
+
+    def __init__(self, base_dir: str, telemetry=None, boundary_hook=None,
+                 preempt=None):
+        self.base_dir = base_dir
+        self._telemetry = telemetry
+        self._boundary_hook = boundary_hook
+        self._preempt = preempt
+
+    def unit_dir(self, unit) -> str:
+        return os.path.join(self.base_dir, "units",
+                            unit.unit_id.replace("/", "__"))
+
+    def history_path(self, unit) -> str:
+        return os.path.join(self.unit_dir(unit), "history.npz")
+
+    def _fallback_reporter(self, info: dict) -> None:
+        """A corrupt step skipped during a unit resume is a mitigation on
+        the scheduler's stream — recovery is never silent."""
+        if self._telemetry is not None:
+            self._telemetry.mitigation(mtype="checkpoint_fallback", **info)
+
+    def __call__(self, unit, heartbeat=None) -> dict:
+        import jax
+        import numpy as np
+
+        from dib_tpu.data import get_dataset
+        from dib_tpu.models import DistributedIBModel
+        from dib_tpu.train import (
+            CheckpointHook,
+            DIBCheckpointer,
+            DIBTrainer,
+            TrainConfig,
+        )
+
+        spec = dict(DEFAULT_TRAIN_SPEC)
+        spec.update(unit.train or {})
+        bundle = get_dataset(spec["dataset"])
+        model = DistributedIBModel(
+            feature_dimensionalities=tuple(bundle.feature_dimensionalities),
+            encoder_hidden=tuple(spec["encoder_hidden"]),
+            integration_hidden=tuple(spec["integration_hidden"]),
+            output_dim=bundle.output_dimensionality,
+            embedding_dim=int(spec["embedding_dim"]),
+        )
+        config = TrainConfig(
+            batch_size=int(spec["batch_size"]),
+            beta_start=float(spec["beta_start"]),
+            beta_end=float(unit.beta),
+            num_pretraining_epochs=int(spec["num_pretraining_epochs"]),
+            num_annealing_epochs=int(spec["num_annealing_epochs"]),
+            steps_per_epoch=int(spec["steps_per_epoch"]),
+            max_val_points=int(spec["max_val_points"]),
+        )
+        trainer = DIBTrainer(model, bundle, config)
+        chunk = int(spec["chunk_epochs"])
+        udir = self.unit_dir(unit)
+        os.makedirs(udir, exist_ok=True)
+        ckpt = DIBCheckpointer(os.path.join(udir, "ckpt"))
+
+        hooks = []
+        if heartbeat is not None:
+            # FIRST: a stolen lease aborts here, before any write
+            hooks.append(lambda trainer, state, epoch: heartbeat())
+        hooks.append(CheckpointHook(ckpt))
+        if self._boundary_hook is not None:
+            boundary_hook = self._boundary_hook
+            hooks.append(
+                lambda trainer, state, epoch: boundary_hook(unit, epoch))
+
+        try:
+            resume_state = resume_history = None
+            remaining = None
+            key = jax.random.key(int(unit.seed))
+            if ckpt.latest_step is not None:
+                # a retried/stolen unit continues its own trajectory: the
+                # newest INTACT step (a step torn by the previous holder's
+                # death must not wedge the retry)
+                resume_state, resume_history, key = ckpt.restore_latest_intact(
+                    trainer, chunk_size=chunk,
+                    on_fallback=self._fallback_reporter,
+                )
+                done = int(jax.device_get(resume_state.epoch))
+                remaining = max(config.num_epochs - done, 0)
+            _, history = trainer.fit(
+                key, num_epochs=remaining, hooks=hooks, hook_every=chunk,
+                state=resume_state, history=resume_history,
+                preempt=self._preempt,
+            )
+        finally:
+            ckpt.close()
+
+        bits = history.to_bits(bundle.loss_is_info_based)
+        np.savez(self.history_path(unit),
+                 beta=bits.beta, kl_per_feature=bits.kl_per_feature,
+                 loss=bits.loss, val_loss=bits.val_loss)
+        return {
+            "beta": float(unit.beta),
+            "seed": int(unit.seed),
+            "epochs": int(bits.loss.shape[0]),
+            "final_loss": float(bits.loss[-1]),
+            "final_val_loss": float(bits.val_loss[-1]),
+            "history_path": self.history_path(unit),
+        }

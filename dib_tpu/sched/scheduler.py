@@ -1,0 +1,563 @@
+"""Lease-based β-grid scheduler: jobs in, chunk-resumable work units out.
+
+The scheduling model (docs/robustness.md "Sweep as a service"):
+
+  - A **job** is a β grid × seed ensemble (dense grids via
+    :func:`dense_beta_grid`, refinement around info-plane transitions via
+    :func:`refine_beta_grid`, explicit lists) plus training parameters
+    and a per-job retry budget. Submission decomposes it into one
+    **work unit** per (β, seed) — each independently trainable and
+    chunk-resumable (the unit runner checkpoints every chunk and resumes
+    from the newest intact step, so a unit can die and continue anywhere).
+  - Workers **acquire** units under a lease: a grant names the worker,
+    carries a wall-clock deadline, and must be renewed (the worker's
+    chunk-boundary heartbeat) before it expires. The oldest eligible
+    pending unit wins (FIFO, honoring retry backoff holds).
+  - **Work-stealing**: :meth:`Scheduler.reap` re-queues any unit whose
+    lease deadline passed — a straggler, a dead worker, a vanished pool —
+    and the next ``acquire`` hands it to a live worker, which resumes
+    from the unit's newest intact checkpoint. The superseded lease is
+    remembered: a completion or renewal under it is **rejected**
+    (returns False), so a presumed-dead worker that comes back cannot
+    double-execute a unit or overwrite the thief's result.
+  - **Retry with backoff**: a failed unit re-queues with an exponential
+    not-before hold (``backoff_base_s * 2**(attempt-1)``) against the
+    job's retry budget; exhaustion marks the unit AND the job failed
+    (``retry_exhausted`` mitigation) instead of retrying forever.
+  - **Graceful degradation**: lease expiry and cooperative preemption
+    (:meth:`release`) re-queue budget-free — a dying worker is the
+    pool's problem, never the job's (the watchdog's budget-free rc-75
+    relaunch, at the scheduling layer).
+
+Durability: every transition is journaled BEFORE the in-memory state
+changes (``sched/journal.py``); construction replays the journal, so a
+SIGKILLed scheduler restarts into the exact queue it died with, torn
+final line tolerated (surfaced as a ``journal_recovered`` mitigation).
+
+Telemetry: with an ``EventWriter``, transitions land as typed ``job`` /
+``lease`` events on the run's events.jsonl (docs/observability.md), and
+recovery actions as ``mitigation`` events (``lease_stolen``,
+``retry_exhausted``, ``preempt_requeue``, ``journal_recovered``) — the
+same stream the chaos suite's faults land on, so ``telemetry summarize``
+joins injections with the scheduler's reactions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import threading
+import time
+import uuid
+from typing import Sequence
+
+from dib_tpu.sched.journal import JobJournal, read_journal
+
+__all__ = ["JobSpec", "Lease", "Scheduler", "WorkUnit", "dense_beta_grid",
+           "refine_beta_grid"]
+
+
+# ------------------------------------------------------------------ grids
+def dense_beta_grid(start: float, stop: float, num: int) -> list[float]:
+    """``num`` log-spaced β endpoints in [start, stop] — the dense-grid
+    job shape (the paper's info plane is log-β structured, so linear
+    spacing would waste most of the grid on the top decade)."""
+    if num < 1 or start <= 0 or stop <= 0 or stop < start:
+        raise ValueError(
+            f"dense_beta_grid needs 0 < start <= stop and num >= 1; got "
+            f"start={start}, stop={stop}, num={num}"
+        )
+    if num == 1:
+        return [float(start)]
+    lo, hi = math.log10(start), math.log10(stop)
+    return [round(10 ** (lo + (hi - lo) * i / (num - 1)), 10)
+            for i in range(num)]
+
+
+def refine_beta_grid(around: Sequence[float], num: int = 4,
+                     span_decades: float = 0.25) -> list[float]:
+    """Refinement grid around info-plane transition βs: ``num`` log-spaced
+    points within ±``span_decades`` of each center, merged/deduped/sorted.
+
+    ``around`` is typically the β values of ``transition`` events
+    (telemetry/slo.py detects per-channel KL threshold crossings) — the
+    machine-readable signal this scheduler's refinement jobs key on.
+    """
+    out: set[float] = set()
+    for center in around:
+        if center <= 0:
+            raise ValueError(f"refinement center must be positive, got {center}")
+        out.update(dense_beta_grid(
+            10 ** (math.log10(center) - span_decades),
+            10 ** (math.log10(center) + span_decades), num,
+        ))
+    return sorted(out)
+
+
+# ------------------------------------------------------------- dataclasses
+@dataclasses.dataclass(frozen=True)
+class JobSpec:
+    """One β-grid job: the grid, the seeds, the training parameters the
+    unit runner needs, and the job's retry budget."""
+
+    betas: tuple[float, ...]
+    seeds: tuple[int, ...] = (0,)
+    train: dict = dataclasses.field(default_factory=dict)
+    retry_budget: int = 3
+    name: str = ""
+
+    def __post_init__(self):
+        if not self.betas:
+            raise ValueError("a job needs at least one β endpoint")
+        if not self.seeds:
+            raise ValueError("a job needs at least one seed")
+        if self.retry_budget < 0:
+            raise ValueError("retry_budget must be >= 0")
+
+    def to_dict(self) -> dict:
+        return {
+            "betas": [float(b) for b in self.betas],
+            "seeds": [int(s) for s in self.seeds],
+            "train": dict(self.train),
+            "retry_budget": int(self.retry_budget),
+            "name": self.name,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "JobSpec":
+        return cls(
+            betas=tuple(d.get("betas") or ()),
+            seeds=tuple(d.get("seeds") or (0,)),
+            train=dict(d.get("train") or {}),
+            retry_budget=int(d.get("retry_budget", 3)),
+            name=d.get("name", ""),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class WorkUnit:
+    """One (β, seed) training unit of a job — the unit of leasing,
+    stealing, retrying, and checkpoint-resumable execution."""
+
+    unit_id: str
+    job_id: str
+    beta: float
+    seed: int
+    train: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass(frozen=True)
+class Lease:
+    """One grant of one unit to one worker, valid until ``expires_t``."""
+
+    unit_id: str
+    lease_id: str
+    worker: str
+    expires_t: float
+    attempt: int
+
+
+class Scheduler:
+    """The persistent β-grid scheduler over one journal directory.
+
+    ``clock`` is injectable (tests drive lease expiry without sleeping);
+    everything else reads wall-clock. All public methods are thread-safe
+    — pool workers acquire/renew/complete concurrently.
+    """
+
+    def __init__(self, directory: str, telemetry=None,
+                 lease_s: float = 60.0, backoff_base_s: float = 0.5,
+                 clock=time.time):
+        self.directory = directory
+        self.lease_s = float(lease_s)
+        self.backoff_base_s = float(backoff_base_s)
+        self._telemetry = telemetry
+        self._clock = clock
+        self._lock = threading.RLock()
+        self._jobs: dict[str, dict] = {}
+        self._units: dict[str, dict] = {}
+        self._order: list[str] = []      # unit submission order (FIFO base)
+        self.replayed_records = 0
+        self.replayed_torn = 0
+        records, torn = read_journal(directory)
+        for record in records:
+            self._fold(record)
+        self.replayed_records = len(records)
+        self.replayed_torn = torn
+        # journal opened AFTER replay: the replay must never read the
+        # fd this instance is about to append with
+        self._journal = JobJournal(directory)
+        if torn:
+            # crash recovery is never silent: a torn line means a writer
+            # died mid-append and the transition it was recording is
+            # re-derived from the surviving state
+            if telemetry is not None:
+                telemetry.mitigation(
+                    mtype="journal_recovered", detail=(
+                        f"replayed {len(records)} journal record(s), "
+                        f"skipped {torn} torn line(s)"),
+                )
+
+    # -------------------------------------------------------------- replay
+    def _fold(self, r: dict) -> None:
+        """Apply one journal record to the in-memory state (replay path;
+        the live paths journal first, then call this)."""
+        kind = r.get("kind")
+        if kind == "job":
+            self._jobs[r["job_id"]] = {
+                "spec": JobSpec.from_dict(r.get("spec") or {}),
+                "status": "running", "retries_used": 0, "units": [],
+            }
+        elif kind == "unit":
+            unit = WorkUnit(
+                unit_id=r["unit_id"], job_id=r["job_id"],
+                beta=float(r["beta"]), seed=int(r["seed"]),
+                train=dict(r.get("train") or {}),
+            )
+            self._units[unit.unit_id] = {
+                "unit": unit, "status": "pending", "attempts": 0,
+                "not_before": 0.0, "lease": None,
+                "enqueue_t": r.get("t", 0.0),
+            }
+            self._order.append(unit.unit_id)
+            job = self._jobs.get(unit.job_id)
+            if job is not None:
+                job["units"].append(unit.unit_id)
+        elif kind == "lease":
+            entry = self._units.get(r["unit_id"])
+            if entry is not None:
+                entry["status"] = "leased"
+                entry["lease"] = {
+                    "lease_id": r["lease_id"], "worker": r.get("worker"),
+                    "expires_t": r.get("expires_t", 0.0),
+                    "attempt": r.get("attempt", 0),
+                }
+        elif kind == "renew":
+            entry = self._units.get(r["unit_id"])
+            if entry is not None and entry.get("lease") \
+                    and entry["lease"]["lease_id"] == r.get("lease_id"):
+                entry["lease"]["expires_t"] = r.get("expires_t", 0.0)
+        elif kind in ("release", "expire"):
+            entry = self._units.get(r["unit_id"])
+            if entry is not None:
+                # superseding is implemented by clearing the live lease:
+                # _current() compares lease ids against it, so every
+                # older lease is rejected from here on
+                entry["status"] = "pending"
+                entry["lease"] = None
+                entry["enqueue_t"] = r.get("t", 0.0)
+        elif kind == "fail":
+            entry = self._units.get(r["unit_id"])
+            if entry is not None:
+                entry["attempts"] += 1
+                entry["lease"] = None
+                if r.get("requeued"):
+                    entry["status"] = "pending"
+                    entry["not_before"] = r.get("not_before", 0.0)
+                    entry["enqueue_t"] = r.get("t", 0.0)
+                else:
+                    entry["status"] = "failed"
+                job = self._jobs.get(entry["unit"].job_id)
+                # only an actual RETRY spends the budget: the final,
+                # non-requeued failure is the budget being enforced, and
+                # counting it would report retries = budget+1 and trip
+                # the sched_retry_ceiling SLO on correct fail-fast
+                if job is not None and r.get("requeued"):
+                    job["retries_used"] += 1
+        elif kind == "done":
+            entry = self._units.get(r["unit_id"])
+            if entry is not None:
+                entry["status"] = "done"
+                entry["lease"] = None
+                entry["result"] = r.get("result")
+        elif kind == "job_done":
+            job = self._jobs.get(r["job_id"])
+            if job is not None:
+                job["status"] = "done"
+        elif kind == "job_failed":
+            job = self._jobs.get(r["job_id"])
+            if job is not None:
+                job["status"] = "failed"
+
+    # --------------------------------------------------------------- submit
+    def submit(self, spec: JobSpec) -> str:
+        """Decompose a job into (β, seed) units and enqueue them FIFO.
+        Returns the job id."""
+        with self._lock:
+            job_id = f"job-{len(self._jobs):04d}-{uuid.uuid4().hex[:6]}"
+            self._fold(self._journal.append(
+                "job", job_id=job_id, spec=spec.to_dict()))
+            for i, beta in enumerate(spec.betas):
+                for seed in spec.seeds:
+                    unit_id = f"{job_id}/u{i:03d}s{seed}"
+                    self._fold(self._journal.append(
+                        "unit", unit_id=unit_id, job_id=job_id,
+                        beta=float(beta), seed=int(seed),
+                        train=dict(spec.train)))
+            if self._telemetry is not None:
+                self._telemetry.job(
+                    job_id=job_id, action="submitted",
+                    units=len(spec.betas) * len(spec.seeds),
+                    betas=[float(b) for b in spec.betas],
+                    seeds=[int(s) for s in spec.seeds],
+                    retry_budget=spec.retry_budget)
+            return job_id
+
+    # -------------------------------------------------------------- leasing
+    def acquire(self, worker: str, lease_s: float | None = None) -> Lease | None:
+        """Lease the oldest eligible pending unit to ``worker``; None when
+        nothing is currently eligible (empty queue or backoff holds)."""
+        with self._lock:
+            now = self._clock()
+            for unit_id in self._order:
+                entry = self._units[unit_id]
+                if entry["status"] != "pending" or entry["not_before"] > now:
+                    continue
+                attempt = entry["attempts"] + 1
+                lease = Lease(
+                    unit_id=unit_id,
+                    lease_id=f"{unit_id}#a{attempt}-{uuid.uuid4().hex[:6]}",
+                    worker=worker,
+                    expires_t=now + (lease_s or self.lease_s),
+                    attempt=attempt,
+                )
+                queue_wait = max(now - entry["enqueue_t"], 0.0)
+                self._fold(self._journal.append(
+                    "lease", unit_id=unit_id, lease_id=lease.lease_id,
+                    worker=worker, expires_t=lease.expires_t,
+                    attempt=attempt))
+                if self._telemetry is not None:
+                    self._telemetry.lease(
+                        unit=unit_id, action="granted", worker=worker,
+                        lease=lease.lease_id,
+                        job_id=entry["unit"].job_id,
+                        expires_s=round(lease.expires_t - now, 3),
+                        queue_wait_s=round(queue_wait, 3),
+                        attempt=attempt)
+                return lease
+            return None
+
+    def _current(self, lease: Lease) -> dict | None:
+        """The unit entry iff ``lease`` is still the unit's live lease."""
+        entry = self._units.get(lease.unit_id)
+        if entry is None or entry.get("lease") is None:
+            return None
+        if entry["lease"]["lease_id"] != lease.lease_id:
+            return None
+        return entry
+
+    def _reject_stale(self, lease: Lease, action: str) -> bool:
+        if self._telemetry is not None:
+            self._telemetry.lease(
+                unit=lease.unit_id, action="rejected", worker=lease.worker,
+                lease=lease.lease_id, reason=f"superseded lease ({action})")
+        return False
+
+    def renew(self, lease: Lease, lease_s: float | None = None) -> bool:
+        """Extend a live lease (the worker's heartbeat). False when the
+        lease was superseded — the caller must ABANDON the unit: someone
+        else owns it now, and continuing would double-execute it."""
+        with self._lock:
+            entry = self._current(lease)
+            if entry is None:
+                return self._reject_stale(lease, "renew")
+            expires_t = self._clock() + (lease_s or self.lease_s)
+            self._fold(self._journal.append(
+                "renew", unit_id=lease.unit_id, lease_id=lease.lease_id,
+                expires_t=expires_t))
+            if self._telemetry is not None:
+                self._telemetry.lease(
+                    unit=lease.unit_id, action="renewed",
+                    worker=lease.worker, lease=lease.lease_id)
+            return True
+
+    # ------------------------------------------------------------ terminals
+    def complete(self, lease: Lease, result: dict | None = None) -> bool:
+        """Mark the unit done. False (and NO state change) under a
+        superseded lease — the double-execution guard: the thief's result
+        stands, the returned worker's is dropped."""
+        with self._lock:
+            entry = self._current(lease)
+            if entry is None:
+                return self._reject_stale(lease, "complete")
+            unit = entry["unit"]
+            self._fold(self._journal.append(
+                "done", unit_id=lease.unit_id, lease_id=lease.lease_id,
+                result=result))
+            if self._telemetry is not None:
+                self._telemetry.job(
+                    job_id=unit.job_id, action="unit_done",
+                    unit=lease.unit_id, worker=lease.worker,
+                    beta=unit.beta, seed=unit.seed)
+            self._maybe_finish_job(unit.job_id)
+            return True
+
+    def fail(self, lease: Lease, error: str) -> str | bool:
+        """Record a unit failure: re-queue with exponential backoff while
+        the job's retry budget lasts (returns ``"requeued"``), else mark
+        the unit AND job failed (returns ``"exhausted"``). False under a
+        superseded lease (the failure belongs to a stolen attempt)."""
+        with self._lock:
+            entry = self._current(lease)
+            if entry is None:
+                return self._reject_stale(lease, "fail")
+            unit = entry["unit"]
+            job = self._jobs[unit.job_id]
+            budget = job["spec"].retry_budget
+            requeued = job["retries_used"] < budget
+            backoff = (self.backoff_base_s * (2 ** entry["attempts"])
+                       if requeued else 0.0)
+            self._fold(self._journal.append(
+                "fail", unit_id=lease.unit_id, lease_id=lease.lease_id,
+                error=str(error)[:500], requeued=requeued,
+                not_before=self._clock() + backoff))
+            if self._telemetry is not None:
+                self._telemetry.job(
+                    job_id=unit.job_id, action="unit_failed",
+                    unit=lease.unit_id, error=str(error)[:300],
+                    retries=job["retries_used"],
+                    retry_budget=budget,
+                    backoff_s=round(backoff, 3))
+            if not requeued:
+                self._fold(self._journal.append(
+                    "job_failed", job_id=unit.job_id))
+                if self._telemetry is not None:
+                    self._telemetry.mitigation(
+                        mtype="retry_exhausted", reason=(
+                            f"unit {lease.unit_id} failed with the job's "
+                            f"retry budget ({budget}) spent"),
+                        detail=str(error)[:300])
+                    self._telemetry.job(
+                        job_id=unit.job_id, action="failed",
+                        unit=lease.unit_id,
+                        reason="retry budget exhausted")
+                return "exhausted"
+            return "requeued"
+
+    def release(self, lease: Lease, reason: str = "preempt") -> bool:
+        """Budget-free re-queue (cooperative preemption / clean worker
+        shutdown): no attempt burned, no backoff hold — the exit-75
+        contract at the scheduling layer."""
+        with self._lock:
+            entry = self._current(lease)
+            if entry is None:
+                return self._reject_stale(lease, "release")
+            self._fold(self._journal.append(
+                "release", unit_id=lease.unit_id, lease_id=lease.lease_id))
+            if self._telemetry is not None:
+                self._telemetry.lease(
+                    unit=lease.unit_id, action="released",
+                    worker=lease.worker, lease=lease.lease_id,
+                    reason=reason)
+                if reason == "preempt":
+                    self._telemetry.mitigation(
+                        mtype="preempt_requeue",
+                        reason=(f"unit {lease.unit_id} re-enqueued "
+                                "lease-free after cooperative preemption"))
+            return True
+
+    # ------------------------------------------------------- work-stealing
+    def reap(self, now: float | None = None) -> list[str]:
+        """Re-queue every unit whose lease deadline passed (straggler /
+        dead worker / vanished pool). The next ``acquire`` hands each to
+        a live worker — work-stealing; the superseded lease is rejected
+        forever after."""
+        with self._lock:
+            now = self._clock() if now is None else now
+            stolen = []
+            for unit_id, entry in self._units.items():
+                lease = entry.get("lease")
+                if entry["status"] != "leased" or lease is None:
+                    continue
+                if lease["expires_t"] <= now:
+                    self._expire_locked(unit_id, entry, "lease expired")
+                    stolen.append(unit_id)
+            return stolen
+
+    def force_expire(self, unit_id: str, reason: str) -> bool:
+        """Expire a unit's live lease NOW (reaper path for a provably dead
+        worker; also the chaos suite's ``lease_expire`` injector)."""
+        with self._lock:
+            entry = self._units.get(unit_id)
+            if entry is None or entry["status"] != "leased" \
+                    or entry.get("lease") is None:
+                return False
+            self._expire_locked(unit_id, entry, reason)
+            return True
+
+    def _expire_locked(self, unit_id: str, entry: dict, reason: str) -> None:
+        lease = entry["lease"]
+        self._fold(self._journal.append(
+            "expire", unit_id=unit_id, lease_id=lease["lease_id"],
+            reason=reason))
+        if self._telemetry is not None:
+            self._telemetry.lease(
+                unit=unit_id, action="expired", worker=lease.get("worker"),
+                lease=lease["lease_id"], reason=reason)
+            self._telemetry.mitigation(
+                mtype="lease_stolen", reason=(
+                    f"unit {unit_id} re-queued from worker "
+                    f"{lease.get('worker')} ({reason}); the next acquire "
+                    "resumes it from its newest intact checkpoint"))
+
+    # ------------------------------------------------------------- queries
+    def drained(self) -> bool:
+        """True when every unit is terminal (done or failed)."""
+        with self._lock:
+            return all(e["status"] in ("done", "failed")
+                       for e in self._units.values())
+
+    def has_pending(self) -> bool:
+        with self._lock:
+            return any(e["status"] == "pending"
+                       for e in self._units.values())
+
+    def unit(self, unit_id: str) -> dict:
+        with self._lock:
+            entry = self._units[unit_id]
+            return {"unit": entry["unit"], "status": entry["status"],
+                    "attempts": entry["attempts"],
+                    "not_before": entry["not_before"]}
+
+    def status(self) -> dict:
+        """Queue snapshot for the CLI / tests: per-job and aggregate unit
+        state counts."""
+        with self._lock:
+            counts = {"pending": 0, "leased": 0, "done": 0, "failed": 0}
+            units = []
+            for unit_id in self._order:
+                entry = self._units[unit_id]
+                counts[entry["status"]] += 1
+                lease = entry.get("lease")
+                units.append({
+                    "unit_id": unit_id, "status": entry["status"],
+                    "beta": entry["unit"].beta, "seed": entry["unit"].seed,
+                    "attempts": entry["attempts"],
+                    "worker": lease.get("worker") if lease else None,
+                })
+            jobs = {
+                job_id: {
+                    "status": job["status"],
+                    "retries_used": job["retries_used"],
+                    "retry_budget": job["spec"].retry_budget,
+                    "units": len(job["units"]),
+                    "name": job["spec"].name,
+                }
+                for job_id, job in self._jobs.items()
+            }
+            return {"jobs": jobs, "units": units, "counts": counts,
+                    "drained": all(e["status"] in ("done", "failed")
+                                   for e in self._units.values())}
+
+    def _maybe_finish_job(self, job_id: str) -> None:
+        job = self._jobs.get(job_id)
+        if job is None or job["status"] != "running":
+            return
+        if all(self._units[u]["status"] == "done" for u in job["units"]):
+            self._fold(self._journal.append("job_done", job_id=job_id))
+            if self._telemetry is not None:
+                self._telemetry.job(job_id=job_id, action="done",
+                                    units=len(job["units"]))
+
+    def close(self) -> None:
+        self._journal.close()

@@ -154,6 +154,19 @@ EVENT_SCHEMA: dict[str, EventKindSpec] = {
         optional=("kl_before", "kl_after", "beta", "threshold_nats",
                   "replica"),
         doc="info-plane transition: per-channel KL threshold crossing"),
+    "job": EventKindSpec(
+        required=("job_id", "action"),
+        optional=("unit", "units", "betas", "seeds", "beta", "seed",
+                  "worker", "retries", "retry_budget", "backoff_s",
+                  "reason", "error", "status"),
+        doc="one β-grid scheduler job transition (dib_tpu/sched): "
+            "submitted / unit_done / unit_failed / done / failed"),
+    "lease": EventKindSpec(
+        required=("unit", "action"),
+        optional=("job_id", "worker", "lease", "expires_s",
+                  "queue_wait_s", "attempt", "reason"),
+        doc="one work-unit lease transition (dib_tpu/sched): granted / "
+            "renewed / released / expired / rejected"),
     "metrics": EventKindSpec(
         required=("snapshots",),
         doc="counter/gauge/histogram snapshots"),
@@ -583,6 +596,19 @@ class EventWriter:
             parent=parent_id if parent_id is None else int(parent_id),
             seconds=round(float(seconds), 6), **fields,
         )
+
+    def job(self, *, job_id: str, action: str, **fields) -> dict:
+        """One β-grid scheduler job transition (``dib_tpu/sched``):
+        ``action`` is ``submitted`` / ``unit_done`` / ``unit_failed`` /
+        ``done`` / ``failed``."""
+        return self.emit("job", job_id=job_id, action=action, **fields)
+
+    def lease(self, *, unit: str, action: str, **fields) -> dict:
+        """One work-unit lease transition (``dib_tpu/sched``): ``action``
+        is ``granted`` / ``renewed`` / ``released`` / ``expired`` /
+        ``rejected`` (a superseded lease's completion or renewal — the
+        double-execution guard firing)."""
+        return self.emit("lease", unit=unit, action=action, **fields)
 
     def metrics(self, snapshots) -> dict:
         return self.emit("metrics", snapshots=snapshots)

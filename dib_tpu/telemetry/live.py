@@ -161,6 +161,13 @@ class LiveRunState:
         self.heartbeat_interval_s = None
         self.last_mi: dict | None = None
         self._lead_proc = None
+        # β-grid scheduler queue view (dib_tpu/sched): unit -> status,
+        # folded from job/lease events; bounded by the job's unit count
+        self.sched_submitted = 0
+        self.sched_units: dict[str, str] = {}
+        self.sched_workers: set = set()
+        self.sched_stolen = 0
+        self.sched_rejected = 0
 
     # ------------------------------------------------------------- update
     def update(self, event: dict) -> None:
@@ -212,6 +219,31 @@ class LiveRunState:
         elif etype in ("mitigation", "fault", "alert", "transition"):
             self.counts[etype] += 1
             self.ticker.append(self._ticker_row(etype, event))
+        elif etype == "job":
+            action = event.get("action")
+            if action == "submitted":
+                self.sched_submitted += event.get("units") or 0
+            elif action == "unit_done":
+                self.sched_units[event.get("unit", "?")] = "done"
+            elif action == "unit_failed":
+                # requeued: pending again (a later grant re-leases it)
+                self.sched_units.pop(event.get("unit", "?"), None)
+            elif action == "failed" and event.get("unit"):
+                self.sched_units[event["unit"]] = "failed"
+        elif etype == "lease":
+            action = event.get("action")
+            unit = event.get("unit", "?")
+            if action == "granted":
+                self.sched_units[unit] = "leased"
+                if event.get("worker"):
+                    self.sched_workers.add(event["worker"])
+            elif action in ("released", "expired"):
+                if self.sched_units.get(unit) == "leased":
+                    self.sched_units.pop(unit, None)
+                if action == "expired":
+                    self.sched_stolen += 1
+            elif action == "rejected":
+                self.sched_rejected += 1
         elif etype == "run_end":
             self.status = event.get("status", "?")
 
@@ -390,6 +422,25 @@ def render_dashboard(state: LiveRunState, now: float | None = None,
     if hot:
         tops = "  ".join(f"{h['path']} {h['self_s']:.2f}s" for h in hot)
         lines.append(f"hotspots  {tops}"[:width])
+
+    if state.sched_submitted or state.sched_units:
+        # `submitted` counts come from the job's `submitted` event; a job
+        # submitted by a separate `sched submit` process (journal-only)
+        # has none, so pending is derivable only once units are seen —
+        # the leased/done/failed counts stay exact either way
+        statuses = list(state.sched_units.values())
+        done = statuses.count("done")
+        leased = statuses.count("leased")
+        failed = statuses.count("failed")
+        pending = max(state.sched_submitted - done - leased - failed, 0)
+        queue = (f"queue     {pending} pending / {leased} leased / "
+                 f"{done} done / {failed} failed"
+                 f" · {len(state.sched_workers)} workers")
+        if state.sched_stolen:
+            queue += f" · {state.sched_stolen} stolen"
+        if state.sched_rejected:
+            queue += f" · {state.sched_rejected} stale-rejected"
+        lines.append(queue[:width])
 
     beat = ("no heartbeat yet" if live["silent_s"] is None else
             f"beat {live['silent_s']}s ago"

@@ -40,9 +40,9 @@ import json
 import os
 import time
 
-__all__ = ["INDEX_FILENAME", "RunRegistry", "bench_entry", "register_run",
-           "resolve_runs_root", "run_entry", "runs_main",
-           "validate_index_entry"]
+__all__ = ["INDEX_FILENAME", "RunRegistry", "bench_entry",
+           "register_drill_record", "register_run", "resolve_runs_root",
+           "run_entry", "runs_main", "validate_index_entry"]
 
 INDEX_FILENAME = "index.jsonl"
 INDEX_VERSION = 1
@@ -209,6 +209,26 @@ def bench_entry(record: dict, extra: dict | None = None) -> dict:
     if extra:
         entry.update(extra)
     return entry
+
+
+def register_drill_record(record: dict, root: str | None = None,
+                          extra: dict | None = None) -> dict | None:
+    """Register a drill-matrix record (fault_drill / chaos_suite) as a
+    bench entry, so ``telemetry runs trajectory`` carries the robustness
+    history alongside the perf history. Only under an EXPLICIT root
+    (``root`` argument or ``DIB_RUNS_ROOT``) — never the ``./runs``
+    default, because ad-hoc local drill runs must not grow the committed
+    index. Returns the appended entry, or None when no explicit root is
+    configured."""
+    root = root or os.environ.get("DIB_RUNS_ROOT")
+    if not root:
+        return None
+    entry = bench_entry(record, extra={
+        "total": record.get("total"),
+        "all_passed": record.get("all_passed"),
+        **(extra or {}),
+    })
+    return RunRegistry(root).append(entry)
 
 
 # -------------------------------------------------------------- validation

@@ -37,8 +37,9 @@ from dib_tpu.telemetry.events import (
     read_events,
 )
 
-__all__ = ["summarize", "compare", "faults_rollup", "serving_rollup",
-           "span_rollup", "span_hotspots", "telemetry_main"]
+__all__ = ["summarize", "compare", "faults_rollup", "scheduler_rollup",
+           "serving_rollup", "span_rollup", "span_hotspots",
+           "telemetry_main"]
 
 _LN2 = log(2.0)
 
@@ -196,11 +197,21 @@ _FAULT_DETECTORS: dict[str, tuple[str, ...]] = {
     # or, when the divergence is deterministic, by its ejection
     "replica_nan": ("divergence_rollback", "replica_ejected",
                     "divergence_detected"),
-    # cooperative preemption: the worker's chunk-aligned grace checkpoint
-    # and/or the supervisor's immediate relaunch both prove detection
-    "preempt": ("preempt_checkpoint", "preempt_restart"),
+    # cooperative preemption: the worker's chunk-aligned grace checkpoint,
+    # the supervisor's immediate relaunch, and the scheduler's lease-free
+    # re-queue (dib_tpu/sched) all prove detection
+    "preempt": ("preempt_checkpoint", "preempt_restart",
+                "preempt_requeue"),
     # the multihost barrier emits desync_detected before raising
     "desync": ("desync_detected",),
+    # scheduler faults (dib_tpu/sched, docs/robustness.md "Sweep as a
+    # service"): a killed worker is detected by the pool's dead-worker
+    # steal (worker_dead and/or the lease_stolen it provokes); a forced
+    # lease expiry by the steal alone; a torn journal by the restarted
+    # scheduler's replay surfacing journal_recovered
+    "sched_worker_kill": ("worker_dead", "lease_stolen"),
+    "lease_expire": ("lease_stolen",),
+    "journal_torn": ("journal_recovered",),
 }
 
 # Recovery markers per kind, evaluated on events AFTER the detection:
@@ -213,6 +224,12 @@ _SERVE_RECOVERERS: dict[str, tuple[str, ...]] = {
     "batcher_crash": ("serving_recovered", "batcher_restarted"),
 }
 
+# Scheduler faults recover when the queue demonstrably moves again: a
+# unit (or the whole job) completing AFTER the detection proves the
+# stolen/recovered work actually ran to the end — a clean run_end alone
+# would also say so, but the job event is the sharper signal.
+_SCHED_FAULT_KINDS = ("sched_worker_kill", "lease_expire", "journal_torn")
+
 
 def _chunk_loss_finite(event: dict) -> bool:
     vals = _as_floats(event.get("loss"))
@@ -223,6 +240,9 @@ def _marks_recovery(kind: str, event: dict) -> bool:
     if kind in _SERVE_RECOVERERS:
         return (event.get("type") == "mitigation"
                 and event.get("mtype") in _SERVE_RECOVERERS[kind])
+    if kind in _SCHED_FAULT_KINDS:
+        return (event.get("type") == "job"
+                and event.get("action") in ("unit_done", "done"))
     if event.get("type") == "chunk":
         return _chunk_loss_finite(event)
     return (event.get("type") == "run_end"
@@ -316,6 +336,59 @@ def faults_rollup(events) -> dict | None:
         if stats is not None:
             rollup[key] = stats
     return rollup
+
+
+def scheduler_rollup(events) -> dict | None:
+    """Queue-health view of a stream's ``job``/``lease`` events
+    (``dib_tpu/sched``): job/unit outcome counts, lease transition
+    counts (``leases_expired`` is the SLO ceiling's metric), the worst
+    per-unit retry count (``unit_retries_max`` vs the retry-budget
+    ceiling), and queue-wait percentiles from lease grants
+    (``queue_wait_p99_s`` vs its ceiling — see SLO.json). None when the
+    stream carries no scheduler events (ordinary runs).
+    """
+    jobs = [e for e in events if e.get("type") == "job"]
+    leases = [e for e in events if e.get("type") == "lease"]
+    if not jobs and not leases:
+        return None
+    job_actions: dict[str, int] = {}
+    for e in jobs:
+        a = e.get("action", "?")
+        job_actions[a] = job_actions.get(a, 0) + 1
+    lease_actions: dict[str, int] = {}
+    for e in leases:
+        a = e.get("action", "?")
+        lease_actions[a] = lease_actions.get(a, 0) + 1
+    out: dict = {
+        "jobs": {
+            "submitted": job_actions.get("submitted", 0),
+            "done": job_actions.get("done", 0),
+            "failed": job_actions.get("failed", 0),
+        },
+        "units": {
+            "submitted": sum(e.get("units") or 0 for e in jobs
+                             if e.get("action") == "submitted"),
+            "done": job_actions.get("unit_done", 0),
+            "failed_attempts": job_actions.get("unit_failed", 0),
+        },
+        "leases": lease_actions,
+        "leases_expired": lease_actions.get("expired", 0),
+        "leases_rejected": lease_actions.get("rejected", 0),
+    }
+    # `retries` on a unit_failed event is the job's retries_used AFTER
+    # that failure, so the max over the stream is the worst per-job spend
+    retries = [e.get("retries") for e in jobs
+               if e.get("action") == "unit_failed"
+               and isinstance(e.get("retries"), (int, float))]
+    out["retries_max"] = int(max(retries)) if retries else 0
+    waits = sorted(e.get("queue_wait_s") for e in leases
+                   if e.get("action") == "granted"
+                   and isinstance(e.get("queue_wait_s"), (int, float)))
+    if waits:
+        out["queue_wait_p50_s"] = round(_percentile(waits, 0.5), 3)
+        out["queue_wait_p99_s"] = round(_percentile(waits, 0.99), 3)
+        out["queue_wait_max_s"] = round(waits[-1], 3)
+    return out
 
 
 def _utilization_rollup(compiles, rollup: dict, device_kind) -> dict:
@@ -539,6 +612,13 @@ def summarize(path: str, process_index: int | None = None,
     faults = faults_rollup(events)
     if faults is not None:
         summary["faults"] = faults
+
+    # β-grid scheduler queue health (dib_tpu/sched): job/lease events are
+    # global like mitigations — the pool's workers and the supervisor may
+    # emit from different processes onto one stream
+    sched = scheduler_rollup(events)
+    if sched is not None:
+        summary["scheduler"] = sched
 
     if compiles:
         by_cache: dict[str, int] = {}

@@ -45,7 +45,7 @@ from typing import Sequence
 from dib_tpu.train.preempt import PREEMPT_EXIT_CODE
 
 __all__ = ["HeartbeatHook", "PREEMPT_EXIT_CODE", "WatchdogConfig",
-           "supervise", "supervise_self"]
+           "supervise", "supervise_pool", "supervise_self"]
 
 
 class HeartbeatHook:
@@ -229,20 +229,7 @@ def supervise(
     crashes).
     """
     cfg = config or WatchdogConfig()
-    mitigations: list[dict] = []
-    if telemetry is not None:
-        class _MirroredList(list):
-            """append() also emits a ``mitigation`` event."""
-
-            def append(self, item):
-                super().append(item)
-                try:
-                    fields = {k: v for k, v in item.items() if k != "type"}
-                    telemetry.mitigation(mtype=item["type"], **fields)
-                except OSError as exc:   # a full disk must not kill recovery
-                    log(f"watchdog: telemetry write failed: {exc}")
-
-        mitigations = _MirroredList()
+    mitigations = _mirrored_mitigations(telemetry, log)
     t_start = time.time()
     # The worker runs in its own session (so WE can kill its whole group),
     # which also means it would SURVIVE the supervisor's death — an external
@@ -320,6 +307,132 @@ def supervise_self(
     result["heartbeat"] = heartbeat
     result["checkpoint_dir"] = checkpoint_dir
     return result
+
+
+def supervise_pool(
+    cmd: Sequence[str],
+    config: WatchdogConfig | None = None,
+    env: dict | None = None,
+    log=lambda msg: print(msg, file=sys.stderr, flush=True),
+    telemetry=None,
+    journal_path: str | None = None,
+) -> dict:
+    """Run a scheduler worker-pool command under crash/preemption
+    supervision until it exits 0 (docs/robustness.md "Sweep as a
+    service").
+
+    Pool supervision needs no heartbeat file: the pool's entire queue
+    state is its durable journal (``dib_tpu/sched/journal.py``), so a
+    relaunched pool resumes exactly where the dead one stopped — leases
+    the dead pool held simply expire and are stolen by the fresh
+    workers. Exit semantics mirror :func:`supervise`'s: rc 0 finishes;
+    ``PREEMPT_EXIT_CODE`` (cooperative preemption) relaunches
+    immediately, budget-free while the run is making progress — here
+    "progress" is a TERMINAL journal record (a unit ``done``/``fail``,
+    a job finishing) landing during the launch, the epoch-progress
+    gate's journal-shaped twin. Mere journal growth is not progress: a
+    flapping preemption appends lease/release records every cycle
+    without ever finishing a unit, and that rc-75 spinner is budgeted
+    like a crash. Any other exit is a ``crash_restart`` against
+    ``max_restarts`` with the quick-death backoff.
+
+    ``telemetry`` mirrors every mitigation onto the run's event stream
+    as it happens, exactly like :func:`supervise`.
+    """
+    cfg = config or WatchdogConfig()
+    mitigations = _mirrored_mitigations(telemetry, log)
+    t_start = time.time()
+
+    def _journal_terminal_count() -> int:
+        """Terminal unit/job transitions in the journal — the progress
+        signal. Lease/renew/release records don't count: a flapping
+        preemption appends those every cycle without finishing a thing."""
+        if not journal_path:
+            return -1
+        from dib_tpu.sched.journal import read_journal
+
+        records, _ = read_journal(journal_path)
+        return sum(r.get("kind") in ("done", "fail", "job_done",
+                                     "job_failed") for r in records)
+
+    launches = 0
+    quick_failures = 0
+    free_relaunches = 0
+    while True:
+        launches += 1
+        terminal_before = _journal_terminal_count()
+        launched = time.time()
+        proc = subprocess.Popen(list(cmd), env=env)
+        rc = proc.wait()
+        if rc == 0:
+            return {
+                "returncode": 0,
+                "wall_s": round(time.time() - t_start, 1),
+                "launches": launches,
+                "mitigations": mitigations,
+            }
+        if rc == PREEMPT_EXIT_CODE:
+            mitigations.append({
+                "type": "preempt_restart",
+                "launch": launches,
+                "at_s": round(time.time() - t_start, 1),
+            })
+            log(f"watchdog: pool preempted (rc={rc}) — relaunching "
+                "immediately; the journal resumes the queue")
+            # budget-free only while the launch FINISHED something —
+            # with no journal path to watch, every preemption is free
+            # (the operator opted out of the progress gate)
+            progressed = (journal_path is None
+                          or _journal_terminal_count() > terminal_before)
+            if progressed:
+                free_relaunches += 1
+                quick_failures = 0
+                continue
+        else:
+            mitigations.append({
+                "type": "crash_restart",
+                "launch": launches,
+                "returncode": rc,
+                "at_s": round(time.time() - t_start, 1),
+            })
+            log(f"watchdog: pool exited rc={rc} — relaunching; the "
+                "journal resumes the queue")
+        if launches - free_relaunches > cfg.max_restarts:
+            return {
+                "returncode": rc,
+                "wall_s": round(time.time() - t_start, 1),
+                "launches": launches,
+                "mitigations": mitigations,
+                "error": f"gave up after {launches} launches",
+            }
+        if time.time() - launched < cfg.min_uptime_s:
+            quick_failures += 1
+            if cfg.restart_backoff_s > 0:
+                delay = cfg.restart_backoff_s * quick_failures
+                log(f"watchdog: pool died {quick_failures}x within "
+                    f"{cfg.min_uptime_s:.0f}s — backing off {delay:.1f}s")
+                time.sleep(delay)
+        else:
+            quick_failures = 0
+
+
+def _mirrored_mitigations(telemetry, log) -> list:
+    """A mitigation list that (when telemetry is given) also emits each
+    append as a ``mitigation`` event — the supervise()/supervise_pool()
+    shared idiom."""
+    if telemetry is None:
+        return []
+
+    class _MirroredList(list):
+        def append(self, item):
+            super().append(item)
+            try:
+                fields = {k: v for k, v in item.items() if k != "type"}
+                telemetry.mitigation(mtype=item["type"], **fields)
+            except OSError as exc:   # a full disk must not kill recovery
+                log(f"watchdog: telemetry write failed: {exc}")
+
+    return _MirroredList()
 
 
 def _supervise_loop(cmd, heartbeat_path, cfg, env, log, mitigations,

@@ -1,0 +1,524 @@
+"""Continuous chaos suite: the fault matrix against the scheduler UNDER LOAD.
+
+``scripts/fault_drill.py`` proves each worker-level recovery path in
+isolation; this suite proves the *scheduling layer* (``dib_tpu/sched``,
+docs/robustness.md "Sweep as a service") keeps its three invariants
+while faults land on a live β-grid job:
+
+  - **zero lost work units** — every submitted unit ends ``done``;
+  - **no double-executed unit** — the journal records exactly one
+    ``done`` per unit (superseded leases were rejected);
+  - **bit-identical per-β histories** — every unit's committed history
+    equals an uninterrupted baseline's, byte for byte (the stolen /
+    retried / preempted continuations resumed the exact trajectory).
+
+Drills (each runs a fresh 2-unit β-grid job through a worker pool):
+
+  - ``worker_kill``  — one worker dies mid-unit (``WorkerKilled``): the
+    pool degrades to N−1, the reaper steals the silent lease, a live
+    worker resumes from the unit's newest intact checkpoint;
+  - ``lease_expire`` — a held lease is force-expired while its holder
+    stalls: a live worker steals the unit; the stale holder's next
+    renewal is REJECTED and it abandons without writing anything;
+  - ``preempt``      — a unit unwinds with ``TrainingPreempted`` at a
+    chunk boundary (checkpoint already durable): re-queued lease-free,
+    no retry burned, finished by the next acquire;
+  - ``journal_torn`` — the journal is torn mid-append (SIGKILL shape)
+    and the scheduler restarted: replay skips the torn line
+    (``journal_recovered``), the orphaned lease is stolen, the queue
+    drains;
+  - ``pool_kill`` (full mode only) — the whole ``sched run-pool``
+    WORKER PROCESS is SIGKILLed mid-run and relaunched: the durable
+    journal resumes the exact queue across processes.
+
+Every injection lands as a ``fault`` event and every recovery as a
+``mitigation`` / ``job`` event on the drill's stream, so ``telemetry
+summarize`` reproduces injected/detected/recovered independently of this
+script's bookkeeping. The committed record is ``CHAOS_SCHED.json``
+(validated per-row by ``scripts/check_run_artifacts.py``).
+
+Usage::
+
+    python scripts/chaos_suite.py --out CHAOS_SCHED.json   # full
+    python scripts/chaos_suite.py --quick                  # in-process only
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+METRIC = "chaos_sched_matrix"
+
+#: Tiny per-unit training spec: 4 epochs in 2-epoch chunks (2 boundaries,
+#: checkpoint each) — enough structure to kill, steal, and resume against.
+TRAIN_SPEC = {
+    "num_pretraining_epochs": 2,
+    "num_annealing_epochs": 2,
+    "steps_per_epoch": 1,
+    "batch_size": 32,
+    "max_val_points": 64,
+    "chunk_epochs": 2,
+}
+BETAS = (0.1, 1.0)
+SEEDS = (0,)
+
+
+def _job_spec():
+    from dib_tpu.sched import JobSpec
+
+    return JobSpec(betas=BETAS, seeds=SEEDS, train=dict(TRAIN_SPEC),
+                   retry_budget=3)
+
+
+def _stream_evidence(run_dir: str) -> dict:
+    from dib_tpu.telemetry import summarize
+
+    summary = summarize(run_dir)
+    return {
+        "faults": summary.get("faults"),
+        "scheduler": summary.get("scheduler"),
+        "mitigations": summary.get("mitigations"),
+        "status": summary.get("status"),
+    }
+
+
+def _journal_invariants(sched_dir: str) -> dict:
+    """The journal's own verdict: every unit done exactly once."""
+    from dib_tpu.sched import read_journal
+
+    records, torn = read_journal(sched_dir)
+    units = [r["unit_id"] for r in records if r.get("kind") == "unit"]
+    done: dict[str, int] = {}
+    for r in records:
+        if r.get("kind") == "done":
+            done[r["unit_id"]] = done.get(r["unit_id"], 0) + 1
+    return {
+        "units": len(units),
+        "zero_lost_units": bool(units) and all(u in done for u in units),
+        "no_double_execution": all(n == 1 for n in done.values()),
+        "done_counts": done,
+        "journal_torn_lines": torn,
+    }
+
+
+def _histories_identical(runner, baseline: dict, scheduler) -> bool:
+    import numpy as np
+
+    for row in scheduler.status()["units"]:
+        unit = scheduler.unit(row["unit_id"])["unit"]
+        ref = baseline[(unit.beta, unit.seed)]
+        try:
+            got = dict(np.load(runner.history_path(unit)))
+        except OSError:
+            return False
+        if sorted(ref) != sorted(got):
+            return False
+        if not all(np.array_equal(ref[k], got[k]) for k in ref):
+            return False
+    return True
+
+
+def run_baseline(workdir: str, log) -> dict:
+    """Uninterrupted single-worker run of the drill job: the per-(β,seed)
+    history arrays every drill's continuations must match bitwise."""
+    import numpy as np
+
+    from dib_tpu.sched import Scheduler, TrainingUnitRunner, WorkerPool
+
+    log("chaos baseline: uninterrupted 2-unit job, one worker")
+    d = os.path.join(workdir, "baseline")
+    scheduler = Scheduler(d)
+    scheduler.submit(_job_spec())
+    runner = TrainingUnitRunner(d)
+    stats = WorkerPool(scheduler, runner, num_workers=1, poll_s=0.01).run()
+    if not (stats["drained"] and stats["completed"] == len(BETAS) * len(SEEDS)):
+        raise RuntimeError(f"chaos baseline did not drain cleanly: {stats}")
+    histories = {}
+    for row in scheduler.status()["units"]:
+        unit = scheduler.unit(row["unit_id"])["unit"]
+        histories[(unit.beta, unit.seed)] = dict(
+            np.load(runner.history_path(unit)))
+    scheduler.close()
+    return histories
+
+
+def _drill_stack(workdir: str, name: str, boundary_hook=None,
+                 lease_s: float = 60.0):
+    """Scheduler + pool + runner + event stream for one drill."""
+    from dib_tpu.sched import Scheduler, TrainingUnitRunner, WorkerPool
+    from dib_tpu.telemetry import EventWriter, runtime_manifest
+
+    d = os.path.join(workdir, name)
+    writer = EventWriter(d, run_id=f"chaos-{name}")
+    writer.run_start(runtime_manifest(extra={"mode": "chaos_sched",
+                                             "drill": name}))
+    scheduler = Scheduler(d, telemetry=writer, lease_s=lease_s)
+    scheduler.submit(_job_spec())
+    runner = TrainingUnitRunner(d, telemetry=writer,
+                                boundary_hook=boundary_hook)
+    pool = WorkerPool(scheduler, runner, num_workers=2, telemetry=writer,
+                      poll_s=0.01, reap_every_s=0.05)
+    return d, writer, scheduler, runner, pool
+
+
+def _drill_record(name: str, kind: str, ok: bool, **details) -> dict:
+    return {"drill": name, "kind": kind, "ok": bool(ok), **details}
+
+
+def _finish(name, kind, ok_extra, d, writer, scheduler, runner, baseline,
+            stats, t0, **details) -> dict:
+    writer.run_end(status="ok")
+    writer.close()
+    invariants = _journal_invariants(d)
+    identical = _histories_identical(runner, baseline, scheduler)
+    scheduler.close()
+    evidence = _stream_evidence(d)
+    faults = evidence.get("faults") or {}
+    ok = (ok_extra and stats["drained"]
+          and invariants["zero_lost_units"]
+          and invariants["no_double_execution"]
+          and identical
+          and faults.get("injected") == faults.get("detected") == 1
+          and faults.get("recovered") == 1)
+    return _drill_record(
+        name, kind, ok,
+        zero_lost_units=invariants["zero_lost_units"],
+        no_double_execution=invariants["no_double_execution"],
+        bit_identical_histories=identical,
+        pool_stats={k: stats[k] for k in
+                    ("completed", "failed", "released", "stale_abandoned",
+                     "stale_completions", "workers_died", "stolen")},
+        wall_s=round(time.time() - t0, 1),
+        evidence=evidence, **details,
+    )
+
+
+# ------------------------------------------------------------------ drills
+def run_worker_kill_drill(workdir: str, baseline: dict, log) -> dict:
+    """One worker dies dead mid-unit; the reaper steals its silent lease
+    and a live worker resumes the unit from its newest intact checkpoint."""
+    log("chaos worker_kill: worker dies at a chunk boundary under load")
+    fired = threading.Event()
+    state = {}
+
+    def boundary_hook(unit, epoch):
+        from dib_tpu.sched import WorkerKilled
+
+        if unit.beta == BETAS[0] and not fired.is_set():
+            fired.set()
+            state["writer"].fault(kind="sched_worker_kill",
+                                  detail=unit.unit_id, epoch=epoch)
+            raise WorkerKilled(f"chaos: worker killed at epoch {epoch}")
+
+    t0 = time.time()
+    d, writer, scheduler, runner, pool = _drill_stack(
+        workdir, "worker_kill", boundary_hook)
+    state["writer"] = writer
+    stats = pool.run()
+    return _finish("worker_kill", "sched_worker_kill",
+                   stats["workers_died"] == 1 and stats["stolen"] >= 1,
+                   d, writer, scheduler, runner, baseline, stats, t0)
+
+
+def run_lease_expire_drill(workdir: str, baseline: dict, log) -> dict:
+    """A held lease is force-expired while its holder stalls: the unit is
+    stolen and completed by a live worker; the stale holder's renewal is
+    rejected and it abandons without writing a thing."""
+    log("chaos lease_expire: stalled holder loses its lease to a thief")
+    stalled = threading.Event()
+    fired = threading.Event()
+    state = {}
+
+    def boundary_hook(unit, epoch):
+        if unit.beta == BETAS[0] and not fired.is_set():
+            fired.set()
+            stalled.set()
+            # stall past the injected expiry: the thief takes the unit
+            # while this worker sleeps; its next heartbeat is rejected
+            time.sleep(2.0)
+
+    t0 = time.time()
+    d, writer, scheduler, runner, pool = _drill_stack(
+        workdir, "lease_expire", boundary_hook)
+    state["unit_id"] = None
+
+    def injector():
+        from dib_tpu.faults import expire_lease
+
+        stalled.wait(timeout=120)
+        for row in scheduler.status()["units"]:
+            if row["status"] == "leased" and row["beta"] == BETAS[0]:
+                expire_lease(scheduler, row["unit_id"], telemetry=writer)
+                state["unit_id"] = row["unit_id"]
+                return
+
+    injector_thread = threading.Thread(target=injector, daemon=True)
+    injector_thread.start()
+    stats = pool.run()
+    injector_thread.join(timeout=5)
+    return _finish("lease_expire", "lease_expire",
+                   state["unit_id"] is not None
+                   and stats["stale_abandoned"] == 1,
+                   d, writer, scheduler, runner, baseline, stats, t0,
+                   expired_unit=state["unit_id"])
+
+
+def run_preempt_drill(workdir: str, baseline: dict, log) -> dict:
+    """A unit unwinds with TrainingPreempted at a chunk boundary (the
+    checkpoint hook already saved): re-queued lease-free — no retry
+    burned — and finished bit-identically by the next acquire."""
+    log("chaos preempt: cooperative preemption re-queues lease-free")
+    fired = threading.Event()
+    state = {}
+
+    def boundary_hook(unit, epoch):
+        from dib_tpu.train.preempt import TrainingPreempted
+
+        if unit.beta == BETAS[0] and not fired.is_set():
+            fired.set()
+            state["writer"].fault(kind="preempt", detail=unit.unit_id,
+                                  epoch=epoch)
+            raise TrainingPreempted(epoch, checkpoint_saved=True)
+
+    t0 = time.time()
+    d, writer, scheduler, runner, pool = _drill_stack(
+        workdir, "preempt", boundary_hook)
+    state["writer"] = writer
+    stats = pool.run()
+    # lease-free: the preempted attempt must not have burned the budget
+    retries = (_stream_evidence_retries(d))
+    return _finish("preempt", "preempt",
+                   stats["released"] == 1 and retries == 0,
+                   d, writer, scheduler, runner, baseline, stats, t0,
+                   retries_burned=retries)
+
+
+def _stream_evidence_retries(run_dir: str) -> int:
+    from dib_tpu.telemetry import summarize
+
+    sched = summarize(run_dir).get("scheduler") or {}
+    return int(sched.get("retries_max") or 0)
+
+
+def run_journal_torn_drill(workdir: str, baseline: dict, log) -> dict:
+    """The journal is torn mid-append (the SIGKILL shape) with a lease in
+    flight, and the scheduler restarted: replay skips the torn line
+    (journal_recovered), the orphaned lease is stolen, the queue drains."""
+    from dib_tpu.faults import tear_journal
+    from dib_tpu.sched import (
+        JOURNAL_FILENAME,
+        Scheduler,
+        TrainingUnitRunner,
+        WorkerPool,
+    )
+    from dib_tpu.telemetry import EventWriter, runtime_manifest
+
+    log("chaos journal_torn: torn journal + scheduler restart under load")
+    t0 = time.time()
+    d = os.path.join(workdir, "journal_torn")
+    writer = EventWriter(d, run_id="chaos-journal_torn")
+    writer.run_start(runtime_manifest(extra={"mode": "chaos_sched",
+                                             "drill": "journal_torn"}))
+    # phase A: a scheduler submits the job, grants one short lease to a
+    # ghost holder, then dies mid-append (the torn final line)
+    sched_a = Scheduler(d, telemetry=writer, lease_s=0.2)
+    sched_a.submit(_job_spec())
+    ghost = sched_a.acquire("ghost-worker")
+    sched_a.close()
+    tear_journal(os.path.join(d, JOURNAL_FILENAME), telemetry=writer)
+
+    # phase B: a fresh scheduler replays the journal (skipping the torn
+    # line, surfacing journal_recovered) and a pool drains the queue —
+    # the ghost's expired lease is stolen on the first reap
+    scheduler = Scheduler(d, telemetry=writer, lease_s=60.0)
+    torn_seen = scheduler.replayed_torn
+    runner = TrainingUnitRunner(d, telemetry=writer)
+    pool = WorkerPool(scheduler, runner, num_workers=2, telemetry=writer,
+                      poll_s=0.01, reap_every_s=0.05)
+    stats = pool.run()
+    return _finish("journal_torn", "journal_torn",
+                   torn_seen == 1 and ghost is not None
+                   and stats["stolen"] >= 1,
+                   d, writer, scheduler, runner, baseline, stats, t0,
+                   replayed_torn=torn_seen)
+
+
+# ----------------------------------------------------- subprocess drill
+def _worker_env(**extra) -> dict:
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "DIB_COMPILE_CACHE": "",
+        "JAX_COMPILATION_CACHE_DIR":
+            os.path.expanduser("~/.cache/jax_comp_cache_cpu"),
+        "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "0.2",
+        "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES": "0",
+    })
+    env.update(extra)
+    return env
+
+
+def run_pool_kill_drill(workdir: str, baseline: dict, log) -> dict:
+    """Process-level graceful degradation: the whole `sched run-pool`
+    worker process is SIGKILLed mid-run and a fresh one launched — the
+    durable journal resumes the exact queue across processes, and every
+    unit still completes exactly once, bit-identically."""
+    import numpy as np
+
+    from dib_tpu.sched import JOURNAL_FILENAME, Scheduler, TrainingUnitRunner
+    from dib_tpu.sched.journal import read_journal
+    from dib_tpu.telemetry import EventWriter
+
+    log("chaos pool_kill: SIGKILL the run-pool process, relaunch it")
+    t0 = time.time()
+    d = os.path.join(workdir, "pool_kill")
+    os.makedirs(d, exist_ok=True)
+    run_id = "chaos-pool_kill"
+    env = _worker_env(DIB_TELEMETRY_RUN_ID=run_id)
+    submit = subprocess.run(
+        [sys.executable, "-m", "dib_tpu.cli", "sched", "submit",
+         "--sched-dir", d, "--betas", *[str(b) for b in BETAS],
+         "--seeds", *[str(s) for s in SEEDS],
+         *sum((["--set", f"{k}={v}"] for k, v in TRAIN_SPEC.items()), [])],
+        env=env, capture_output=True, text=True, timeout=120)
+    if submit.returncode != 0:
+        return _drill_record("pool_kill", "sched_worker_kill", False,
+                             error=submit.stderr[-1000:])
+    pool_cmd = [sys.executable, "-m", "dib_tpu.cli", "sched", "run-pool",
+                "--sched-dir", d, "--workers", "1", "--lease-s", "1.0"]
+    journal = os.path.join(d, JOURNAL_FILENAME)
+    # the injection is a SIGKILL, which leaves no room for the worker to
+    # emit its own fault event — record it from the drill harness instead
+    writer = EventWriter(d, run_id=run_id, process_index=0,
+                         tags={"src": "chaos"})
+    proc = subprocess.Popen(pool_cmd, env=env, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    try:
+        deadline = time.time() + 300
+        while time.time() < deadline:
+            records, _ = read_journal(journal)
+            if any(r.get("kind") == "done" for r in records):
+                break
+            if proc.poll() is not None:
+                break
+            time.sleep(0.2)
+        killed = proc.poll() is None
+        writer.fault(kind="sched_worker_kill", detail="run-pool process",
+                     via="SIGKILL")
+        if killed:
+            proc.kill()
+        proc.wait()
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    relaunch = subprocess.run(pool_cmd, env=env, capture_output=True,
+                              text=True, timeout=600)
+    writer.close()
+    scheduler = Scheduler(d)
+    runner = TrainingUnitRunner(d)
+    invariants = _journal_invariants(d)
+    identical = _histories_identical(runner, baseline, scheduler)
+    counts = scheduler.status()["counts"]
+    scheduler.close()
+    evidence = _stream_evidence(d)
+    ok = (killed and relaunch.returncode == 0
+          and counts["done"] == len(BETAS) * len(SEEDS)
+          and invariants["zero_lost_units"]
+          and invariants["no_double_execution"]
+          and identical)
+    return _drill_record(
+        "pool_kill", "sched_worker_kill", ok,
+        killed_mid_run=killed,
+        relaunch_returncode=relaunch.returncode,
+        zero_lost_units=invariants["zero_lost_units"],
+        no_double_execution=invariants["no_double_execution"],
+        bit_identical_histories=identical,
+        wall_s=round(time.time() - t0, 1),
+        evidence=evidence,
+        **({} if relaunch.returncode == 0
+           else {"stderr_tail": relaunch.stderr[-1500:]}),
+    )
+
+
+# ----------------------------------------------------------------- driver
+def run_chaos(workdir: str | None = None, quick: bool = False,
+              log=lambda m: print(m, file=sys.stderr, flush=True)) -> dict:
+    """Run the chaos matrix; returns the bench-shaped record."""
+    owned = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="dib_chaos_sched_")
+    matrix: list[dict] = []
+    try:
+        baseline = run_baseline(workdir, log)
+        matrix.append(run_worker_kill_drill(workdir, baseline, log))
+        matrix.append(run_lease_expire_drill(workdir, baseline, log))
+        matrix.append(run_preempt_drill(workdir, baseline, log))
+        matrix.append(run_journal_torn_drill(workdir, baseline, log))
+        if not quick:
+            matrix.append(run_pool_kill_drill(workdir, baseline, log))
+    finally:
+        if owned:
+            shutil.rmtree(workdir, ignore_errors=True)
+    passed = sum(1 for d in matrix if d["ok"])
+    return {
+        "metric": METRIC,
+        "value": passed,
+        "unit": "drills_passed",
+        "total": len(matrix),
+        "quick": quick,
+        "all_passed": passed == len(matrix),
+        "betas": list(BETAS),
+        "seeds": list(SEEDS),
+        "matrix": matrix,
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+
+
+def _register(record: dict, runs_root: str | None, log) -> None:
+    """Fleet-registry registration (docs/observability.md): explicit-
+    root-only (--runs-root / DIB_RUNS_ROOT) — ad-hoc local runs must not
+    grow the committed runs/index.jsonl; see register_drill_record."""
+    from dib_tpu.telemetry.registry import register_drill_record
+
+    if register_drill_record(record, root=runs_root) is not None:
+        log("chaos suite: registered in the fleet registry")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--out", default=None,
+                        help="Also write the JSON record to this path.")
+    parser.add_argument("--quick", action="store_true",
+                        help="Skip the subprocess pool_kill drill "
+                             "(in-process drills only).")
+    parser.add_argument("--workdir", default=None,
+                        help="Keep drill artifacts here (default: a temp "
+                             "dir, removed afterwards).")
+    parser.add_argument("--runs-root", "--runs_root", dest="runs_root",
+                        default=None,
+                        help="Register this run in the fleet registry "
+                             "(<runs-root>/index.jsonl; default: "
+                             "DIB_RUNS_ROOT when set, else off).")
+    args = parser.parse_args(argv)
+    log = lambda m: print(m, file=sys.stderr, flush=True)  # noqa: E731
+    record = run_chaos(workdir=args.workdir, quick=args.quick, log=log)
+    print(json.dumps(record), flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(json.dumps(record, indent=1) + "\n")
+    _register(record, args.runs_root, log)
+    return 0 if record["all_passed"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,6 +1,7 @@
 """Validate committed run artifacts against the shared record schema.
 
-Every ``BENCH_*.json`` / ``NORTHSTAR_*.json`` at the repo root is part of
+Every ``BENCH_*.json`` / ``NORTHSTAR_*.json`` / ``FAULT_DRILL*.json`` /
+``CHAOS_SCHED*.json`` at the repo root is part of
 the measured history the paper's claims rest on, so each must stay
 machine-readable forever. Two record shapes are legal:
 
@@ -49,7 +50,8 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-ARTIFACT_GLOBS = ("BENCH_*.json", "NORTHSTAR_*.json", "FAULT_DRILL*.json")
+ARTIFACT_GLOBS = ("BENCH_*.json", "NORTHSTAR_*.json", "FAULT_DRILL*.json",
+                  "CHAOS_SCHED*.json")
 
 # Null-value excuses: at least one must be present when value is null.
 _NULL_VALUE_EXCUSES = ("degraded", "error", "per_run_minutes", "runs_completed")
@@ -114,6 +116,54 @@ def _check_fault_drill_matrix(record: dict, problems: list[str]) -> None:
                         "'straggler_bounded' must both be true")
 
 
+# Drills every committed full chaos_sched_matrix record must carry
+# (scripts/chaos_suite.py): the scheduler-under-load half of the
+# robustness evidence (docs/robustness.md "Sweep as a service").
+_REQUIRED_CHAOS_SCHED_DRILLS = (
+    "worker_kill", "lease_expire", "preempt", "journal_torn", "pool_kill",
+)
+
+
+def _check_chaos_sched_matrix(record: dict, problems: list[str]) -> None:
+    """chaos_sched_matrix-specific schema: every drill present (full
+    records), zero failures, and the three scheduler invariants — zero
+    lost units, no double-executed unit, bit-identical per-β histories —
+    asserted per row as typed evidence."""
+    matrix = record.get("matrix")
+    if not isinstance(matrix, list) or not matrix:
+        problems.append("'matrix' must be a non-empty list of drill records")
+        return
+    by_name: dict[str, dict] = {}
+    for i, drill in enumerate(matrix):
+        if not isinstance(drill, dict):
+            problems.append(f"matrix[{i}] must be an object")
+            continue
+        for key in ("drill", "kind"):
+            if not (isinstance(drill.get(key), str) and drill[key]):
+                problems.append(f"matrix[{i}]: {key!r} must be a non-empty "
+                                "string")
+        if not isinstance(drill.get("ok"), bool):
+            problems.append(f"matrix[{i}]: 'ok' must be a bool")
+        if isinstance(drill.get("drill"), str):
+            by_name[drill["drill"]] = drill
+    if record.get("quick") is False:
+        missing = [d for d in _REQUIRED_CHAOS_SCHED_DRILLS
+                   if d not in by_name]
+        if missing:
+            problems.append(
+                f"full chaos record is missing drill(s) {missing} — "
+                "re-run scripts/chaos_suite.py --out CHAOS_SCHED.json"
+            )
+    failed = [name for name, d in by_name.items() if d.get("ok") is False]
+    if failed:
+        problems.append(f"committed chaos record shows failures: {failed}")
+    for name, d in by_name.items():
+        for invariant in ("zero_lost_units", "no_double_execution",
+                          "bit_identical_histories"):
+            if d.get(invariant) is not True:
+                problems.append(f"{name}: {invariant!r} must be true")
+
+
 def _reject_constant(name: str):
     raise ValueError(f"non-finite JSON constant {name!r}")
 
@@ -164,6 +214,8 @@ def check_record(record: dict, problems: list[str]) -> None:
                 )
         if record.get("metric") == "fault_drill_matrix":
             _check_fault_drill_matrix(record, problems)
+        if record.get("metric") == "chaos_sched_matrix":
+            _check_chaos_sched_matrix(record, problems)
     elif {"cmd", "rc"} <= set(record):
         # ---- driver capture
         if not isinstance(record["cmd"], str):

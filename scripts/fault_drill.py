@@ -780,6 +780,17 @@ def run_drills(workdir: str | None = None, quick: bool = False,
     }
 
 
+def register_record(record: dict, runs_root: str | None, log) -> None:
+    """Fleet-registry registration (docs/observability.md): the drill
+    record lands as a bench entry in <runs-root>/index.jsonl, so
+    `telemetry runs trajectory` carries the robustness history alongside
+    the perf history. Explicit-root-only; see register_drill_record."""
+    from dib_tpu.telemetry.registry import register_drill_record
+
+    if register_drill_record(record, root=runs_root) is not None:
+        log("fault drill: registered in the fleet registry")
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     parser.add_argument("--out", default=None,
@@ -791,6 +802,11 @@ def main(argv=None) -> int:
     parser.add_argument("--workdir", default=None,
                         help="Keep drill artifacts here (default: a "
                              "temp dir, removed afterwards).")
+    parser.add_argument("--runs-root", "--runs_root", dest="runs_root",
+                        default=None,
+                        help="Register this run in the fleet registry "
+                             "(<runs-root>/index.jsonl; default: "
+                             "DIB_RUNS_ROOT when set, else off).")
     args = parser.parse_args(argv)
     record = run_drills(workdir=args.workdir, quick=args.quick)
     line = json.dumps(record)
@@ -798,6 +814,8 @@ def main(argv=None) -> int:
     if args.out:
         with open(args.out, "w") as f:
             f.write(json.dumps(record, indent=1) + "\n")
+    register_record(record, args.runs_root,
+                    log=lambda m: print(m, file=sys.stderr, flush=True))
     return 0 if record["all_passed"] else 1
 
 

@@ -86,11 +86,13 @@ def test_plan_from_env(monkeypatch, tmp_path):
 
 def test_registry_covers_the_drill_matrix():
     scopes = {scope for scope, _, _ in FAULT_KINDS.values()}
-    assert scopes == {"train", "checkpoint", "serve", "http", "multihost"}
+    assert scopes == {"train", "checkpoint", "serve", "http", "multihost",
+                      "sched"}
     for kind in ("stall", "kill", "nan", "ckpt_truncate",
                  "ckpt_bitflip_manifest", "replica_error", "replica_slow",
                  "batcher_crash", "http_malformed",
-                 "replica_nan", "preempt", "desync"):
+                 "replica_nan", "preempt", "desync",
+                 "sched_worker_kill", "lease_expire", "journal_torn"):
         assert kind in FAULT_KINDS
 
 

@@ -126,10 +126,16 @@ def test_host_sync_targets_only_chunk_loop_modules():
     from dib_tpu.analysis.core import get_pass
 
     host = get_pass("host-sync")
+    # the three fit chunk loops plus the scheduler's hot modules (the
+    # worker pool runs MANY units' chunk loops concurrently — a hidden
+    # blocking fetch there serializes the whole pool)
     assert set(host.target_modules) == {
         "dib_tpu/train/loop.py",
         "dib_tpu/parallel/sweep.py",
         "dib_tpu/workloads/boolean.py",
+        "dib_tpu/sched/runner.py",
+        "dib_tpu/sched/pool.py",
+        "dib_tpu/sched/scheduler.py",
     }
 
 
