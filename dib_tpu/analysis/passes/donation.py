@@ -21,7 +21,17 @@ it, two ways:
    ``.copy()`` clears the taint; synchronous writers (``np.save`` etc.)
    are exempt.
 
-Both analyses are intraprocedural over lexical statement order — precise
+3. **overlap-alias read-after-donation** (the raw-speed-PR bug shape): a
+   plain ALIAS of a donated name's subtree (``snap = state.params``) taken
+   before the donating call and read after it — the exact hazard of an
+   overlapped measurement dispatched on "a snapshot" that is not actually
+   a copy: by the time the measurement executes, the aliased buffers
+   belong to the next chunk's donation. A rebind through ANY call —
+   ``dib_tpu.train.overlap.snapshot_params``, ``jax.device_get``,
+   ``jnp.copy`` — is not an alias and stays clean; only bare
+   attribute/subscript chains are tracked.
+
+All analyses are intraprocedural over lexical statement order — precise
 enough to flag the PR 4 shape (see tests/test_lint/fixtures/) while
 leaving the fixed ``train/checkpoint.py`` (which waits on CPU) clean.
 """
@@ -82,6 +92,12 @@ class DonationSafetyPass(LintPass):
         findings: list[Finding] = []
         # name -> (donating call lineno, callee name); dead after donation
         dead: dict[str, tuple[int, str]] = {}
+        # alias name -> (root name, aliased expr line): bare
+        # attribute/subscript views of a (potentially donated) tree —
+        # `snap = state.params`. Dead when their root is donated.
+        aliases: dict[str, tuple[str, int]] = {}
+        # alias name -> (donating call lineno, callee, root)
+        dead_aliases: dict[str, tuple[int, str, str]] = {}
         # name -> (assigning lineno, callee name); device-fresh jit results
         fresh: dict[str, tuple[int, str]] = {}
         for stmt in statements_in_order(fn):
@@ -98,6 +114,19 @@ class DonationSafetyPass(LintPass):
                         "and may hold the next call's output; rebind the "
                         "name to the call's result or fetch what you need "
                         "before the donating call",
+                    ))
+                    continue
+                alias_hit = dead_aliases.get(name_node.id)
+                if alias_hit is not None:
+                    call_line, callee, root = alias_hit
+                    findings.append(self.finding(
+                        module, name_node.lineno,
+                        f"`{name_node.id}` is a bare alias of `{root}`, "
+                        f"which was donated to `{callee}` at line "
+                        f"{call_line} — an overlapped measurement reading "
+                        "it races XLA's reuse of the donated buffers; "
+                        "take a real on-device copy BEFORE the donating "
+                        "call (dib_tpu.train.overlap.snapshot_params)",
                     ))
             # 2. async checkpoint saves of device-fresh jit results
             for call in _calls(stmt):
@@ -130,13 +159,18 @@ class DonationSafetyPass(LintPass):
                         "PR 4 incident); `jax.device_get` it first, or "
                         "wait for the save before the next chunk",
                     ))
-            # 3. this stmt's donations kill their argument names …
+            # 3. this stmt's donations kill their argument names — and any
+            #    bare alias taken from them earlier (the overlap hazard) …
             for call in _calls(stmt):
                 target = match_callable(call, registry)
                 if target is None or not target.donated:
                     continue
                 for name, _line in target.donated_args(call).items():
                     dead[name] = (call.lineno, target.name)
+                    for alias, (root, _aline) in aliases.items():
+                        if root == name:
+                            dead_aliases[alias] = (
+                                call.lineno, target.name, name)
             # 4. … and any (re)assignment resurrects / re-taints names.
             #    Assignment runs after the RHS call, so the
             #    `x, y = f(x, y)` rebind idiom ends up alive, and a name
@@ -147,8 +181,17 @@ class DonationSafetyPass(LintPass):
                 value = getattr(stmt, "value", None)
                 value_jit = (match_callable(value, registry)
                              if isinstance(value, ast.Call) else None)
+                alias_root = _bare_chain_root(value)
                 for name in assigned:
                     dead.pop(name, None)
+                    dead_aliases.pop(name, None)
+                    aliases.pop(name, None)
+                    # a rebind of an alias's ROOT orphans the alias: it
+                    # views the PREVIOUS (nameless, never-donated) tree, so
+                    # a later donation of the new binding must not kill it
+                    for alias in [a for a, (root, _l) in aliases.items()
+                                  if root == name]:
+                        aliases.pop(alias, None)
                     if value_jit is not None:
                         fresh[name] = (stmt.lineno, value_jit.name)
                     else:
@@ -156,9 +199,28 @@ class DonationSafetyPass(LintPass):
                         # (jax.device_get / np.array / .copy()) — clears
                         # the device-buffer taint
                         fresh.pop(name, None)
+                    if alias_root is not None and len(assigned) == 1:
+                        # `snap = state.params`: a bare view, NOT a copy —
+                        # dies with its root's donation. Any Call on the
+                        # RHS (snapshot_params, jnp.copy, device_get)
+                        # breaks the chain and is not recorded.
+                        aliases[name] = (alias_root, stmt.lineno)
             if isinstance(stmt, ast.Delete):
                 for target in stmt.targets:
                     if isinstance(target, ast.Name):
                         dead.pop(target.id, None)
                         fresh.pop(target.id, None)
+                        aliases.pop(target.id, None)
+                        dead_aliases.pop(target.id, None)
         return findings
+
+
+def _bare_chain_root(node) -> str | None:
+    """The root Name of a PURE attribute/subscript chain (`state.params`,
+    `states.params["model"]`) — None when the expression involves a call
+    or anything else (those produce fresh values, not aliases)."""
+    if not isinstance(node, (ast.Attribute, ast.Subscript)):
+        return None
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None

@@ -60,9 +60,16 @@ class HostSyncPass(LintPass):
     # else may fetch freely — drivers and hooks run between chunks. The
     # sched modules are included from day one: the scheduler's worker
     # pool runs MANY units' chunk loops concurrently, so a hidden
-    # blocking fetch there serializes the whole pool, not one run.
+    # blocking fetch there serializes the whole pool, not one run. The
+    # overlap/prefetch modules and the measurement trainer joined with the
+    # raw-speed PR: an implicit sync in the overlap plumbing would
+    # silently re-serialize exactly the boundary the overlap exists to
+    # hide.
     target_modules = (
         "dib_tpu/train/loop.py",
+        "dib_tpu/train/measurement.py",
+        "dib_tpu/train/overlap.py",
+        "dib_tpu/train/prefetch.py",
         "dib_tpu/parallel/sweep.py",
         "dib_tpu/workloads/boolean.py",
         "dib_tpu/sched/runner.py",

@@ -91,6 +91,62 @@ def _log_density_blocked(u: Array, mus: Array, logvars: Array, row_block: int | 
     return rows.reshape(-1, mus.shape[0])[:n]
 
 
+def _mi_row_stats(
+    u: Array, mus: Array, logvars: Array, row_block: int | None
+) -> tuple[Array, Array, Array]:
+    """Per-row ``(diag, lse_full, lse_off)`` of the square log-density matrix.
+
+    These three reductions are ALL the sandwich bounds consume. Pallas path:
+    the one-pass fused kernel (``mi_row_stats_pallas``) — the [B, B] matrix
+    never materializes in HBM, the outputs are O(B)."""
+    if _use_pallas():
+        from dib_tpu.ops.pallas_density import mi_row_stats_pallas
+
+        return mi_row_stats_pallas(u, mus, logvars)
+    return _mi_row_stats_xla(u, mus, logvars, row_block)
+
+
+def _mi_row_stats_xla(
+    u: Array, mus: Array, logvars: Array, row_block: int | None
+) -> tuple[Array, Array, Array]:
+    """The XLA implementation of :func:`_mi_row_stats`, dispatch-free (the
+    kernel microbench times it AGAINST the fused kernel, so it must never
+    route back to Pallas). Without ``row_block`` the full matrix is formed
+    once and reduced (bit-identical to the historical implementation);
+    with ``row_block`` the rows stream through ``lax.map`` in blocks and
+    only the three per-row reductions are kept — peak memory [block, B]
+    instead of [B, B], and the per-row logsumexp values are identical to
+    the unblocked path (rowwise reductions don't see the blocking)."""
+    n = u.shape[0]
+    if row_block is None or row_block >= n:
+        log_p = gaussian_log_density_mat(u, mus, logvars)        # [B, B]
+        diag = jnp.diagonal(log_p)
+        lse_full = jax.scipy.special.logsumexp(log_p, axis=1)
+        log_p_off = jnp.where(jnp.eye(n, dtype=bool), _NEG_INF, log_p)
+        lse_off = jax.scipy.special.logsumexp(log_p_off, axis=1)
+        return diag, lse_full, lse_off
+    pad = (-n) % row_block
+    u_padded = jnp.pad(u, ((0, pad), (0, 0)))
+    blocks = u_padded.reshape(-1, row_block, u.shape[-1])
+    row0 = jnp.arange(blocks.shape[0]) * row_block               # per block
+
+    def one_block(args):
+        ub, r0 = args
+        log_p = gaussian_log_density_mat(ub, mus, logvars)       # [rb, B]
+        rows = r0 + jnp.arange(row_block)
+        cols = jnp.arange(mus.shape[0])[None, :]
+        is_diag = rows[:, None] == cols
+        diag = jnp.sum(jnp.where(is_diag, log_p, 0.0), axis=1)
+        lse_full = jax.scipy.special.logsumexp(log_p, axis=1)
+        lse_off = jax.scipy.special.logsumexp(
+            jnp.where(is_diag, _NEG_INF, log_p), axis=1)
+        return diag, lse_full, lse_off
+
+    diag, lse_full, lse_off = jax.lax.map(one_block, (blocks, row0))
+    return (diag.reshape(-1)[:n], lse_full.reshape(-1)[:n],
+            lse_off.reshape(-1)[:n])
+
+
 @partial(jax.jit, static_argnames=("row_block",))
 def mi_sandwich_from_params(
     key: Array, mus: Array, logvars: Array, row_block: int | None = None
@@ -100,21 +156,21 @@ def mi_sandwich_from_params(
     Args:
       key: PRNG key for the reparameterized sample u_i ~ p(u|x_i).
       mus, logvars: [B, d] diagonal-Gaussian channel parameters.
-      row_block: optional row-chunk size for the [B, B] log-density matrix.
+      row_block: optional row-chunk size for the [B, B] log-density rows
+        (XLA path; the Pallas kernel tiles internally and never forms the
+        matrix at all).
 
     Returns:
       (infonce_lower, loo_upper) in nats.
     """
     batch = mus.shape[0]
     u = reparameterize(key, mus, logvars)
-    log_p = _log_density_blocked(u, mus, logvars, row_block)     # [B, B]
-    log_p_ii = jnp.diagonal(log_p)
+    log_p_ii, lse_full, lse_off = _mi_row_stats(u, mus, logvars, row_block)
     log_batch = jnp.log(jnp.float32(batch))
     # log mean_j p_ij = logsumexp_j - log B
-    lower = jnp.mean(log_p_ii - (jax.scipy.special.logsumexp(log_p, axis=1) - log_batch))
+    lower = jnp.mean(log_p_ii - (lse_full - log_batch))
     # LOO: exclude the diagonal from the logsumexp but keep /B (reference semantics).
-    log_p_off = jnp.where(jnp.eye(batch, dtype=bool), _NEG_INF, log_p)
-    upper = jnp.mean(log_p_ii - (jax.scipy.special.logsumexp(log_p_off, axis=1) - log_batch))
+    upper = jnp.mean(log_p_ii - (lse_off - log_batch))
     return lower, upper
 
 
@@ -192,13 +248,23 @@ def mi_sandwich_probe(
         + jnp.sum(probe_logvars, axis=-1)
         + d * jnp.log(2.0 * jnp.pi)
     )                                                             # [M]
-    log_p_data = _log_density_blocked(u, data_mus, data_logvars, None)  # [M, N]
-    # lower: denominator mean over N+1 terms including the probe's own density
-    lse_with_self = jax.scipy.special.logsumexp(
-        jnp.concatenate([log_p_ii[:, None], log_p_data], axis=1), axis=1
-    )
+    if _use_pallas():
+        # fused one-pass row reduction: the [M, N] matrix never hits HBM.
+        # The with-self denominator folds the own density in via logaddexp
+        # (float32-roundoff-identical to concatenating it into the row).
+        from dib_tpu.ops.pallas_density import mi_row_stats_pallas
+
+        _, lse_data, _ = mi_row_stats_pallas(
+            u, data_mus, data_logvars, diagonal=False)
+        lse_with_self = jnp.logaddexp(log_p_ii, lse_data)
+    else:
+        log_p_data = _log_density_blocked(u, data_mus, data_logvars, None)  # [M, N]
+        # lower denominator: mean over N+1 terms incl. the probe's own density
+        lse_with_self = jax.scipy.special.logsumexp(
+            jnp.concatenate([log_p_ii[:, None], log_p_data], axis=1), axis=1
+        )
+        lse_data = jax.scipy.special.logsumexp(log_p_data, axis=1)
     lower = log_p_ii - (lse_with_self - jnp.log(jnp.float32(n + 1)))
     # upper: denominator mean over the N data terms only
-    lse_data = jax.scipy.special.logsumexp(log_p_data, axis=1)
     upper = log_p_ii - (lse_data - jnp.log(jnp.float32(n)))
     return lower, upper

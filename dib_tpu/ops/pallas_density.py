@@ -32,6 +32,9 @@ from jax.experimental import pallas as pl
 Array = jax.Array
 
 _LOG_2PI = 1.8378770664093453
+_NEG_INF = -1e30   # large-finite mask value (inf-inf NaN traps; matches
+                   # dib_tpu.ops.info_bounds._NEG_INF and pallas_attention)
+_LANES = 128       # TPU vector lane count: running stats live [bm, 128]
 
 
 def _density_kernel(u_ref, mu_ref, lv_ref, out_ref):
@@ -90,3 +93,187 @@ def gaussian_log_density_mat_pallas(
         interpret=interpret,
     )(u_p, mus_p, lv_p)
     return out[:n, :m]
+
+
+# ==========================================================================
+# One-pass fused MI-sandwich row statistics
+# ==========================================================================
+#
+# The sandwich bounds only ever consume THREE per-row reductions of the
+# log-density matrix: the diagonal entry log p_ii, logsumexp over the full
+# row, and logsumexp over the off-diagonal entries (reference utils.py
+# semantics — the LOO bound excludes the diagonal but still divides by B).
+# Materializing the [B, B] matrix in HBM just to reduce it is pure memory
+# traffic: this kernel accumulates all three online (flash-attention-style
+# running max / rescaled sum, the same recurrence as
+# ``pallas_attention._flash_kernel``) while streaming column tiles through
+# VMEM, so the matrix never exists anywhere — HBM holds O(B) outputs
+# instead of O(B^2).
+
+
+def _row_stats_kernel(u_ref, mu_ref, lv_ref, *refs,
+                      num_col_blocks: int, cols: int,
+                      block_rows: int, block_cols: int, diagonal: bool):
+    """One (row-block, col-block) step of the online sandwich reduction.
+
+    The column axis is the innermost, sequentially-executed grid dimension;
+    scratch (running max ``m``, rescaled sum ``s`` for the full and —
+    ``diagonal`` mode only — off-diagonal reductions, plus the captured
+    diagonal) persists across it. All math in float32 regardless of input
+    dtype. ``refs`` holds outputs then scratch: probe mode
+    (``diagonal=False``) allocates only the full-row reduction's.
+    """
+    if diagonal:
+        (diag_ref, full_ref, off_ref,
+         mf_ref, sf_ref, mo_ref, so_ref, d_acc_ref) = refs
+    else:
+        full_ref, mf_ref, sf_ref = refs
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        mf_ref[:] = jnp.full_like(mf_ref, _NEG_INF)
+        sf_ref[:] = jnp.zeros_like(sf_ref)
+        if diagonal:
+            mo_ref[:] = jnp.full_like(mo_ref, _NEG_INF)
+            so_ref[:] = jnp.zeros_like(so_ref)
+            d_acc_ref[:] = jnp.full_like(d_acc_ref, _NEG_INF)
+
+    u = u_ref[:].astype(jnp.float32)                    # [bm, d]
+    mu = mu_ref[:].astype(jnp.float32)                  # [bn, d]
+    lv = lv_ref[:].astype(jnp.float32)                  # [bn, d]
+    inv_std = jnp.exp(-0.5 * lv)
+    z = (u[:, None, :] - mu[None, :, :]) * inv_std[None, :, :]
+    quad = jnp.sum(z * z, axis=-1)                      # [bm, bn]
+    log_norm = jnp.sum(lv, axis=-1)[None, :]
+    d = u.shape[-1]
+    block = -0.5 * (quad + log_norm + d * _LOG_2PI)     # [bm, bn] f32
+
+    # mask padded columns out of every reduction
+    col = j * block_cols + jax.lax.broadcasted_iota(jnp.int32, block.shape, 1)
+    block = jnp.where(col < cols, block, _NEG_INF)
+
+    def accumulate(vals, m_ref, s_ref):
+        m_prev = m_ref[:]                               # [bm, LANES]
+        m_new = jnp.maximum(m_prev, jnp.max(vals, axis=-1, keepdims=True))
+        corr = jnp.exp(m_prev - m_new)
+        s_ref[:] = s_ref[:] * corr + jnp.sum(
+            jnp.exp(vals - m_new[:, :1]), axis=-1, keepdims=True)
+        m_ref[:] = m_new
+
+    accumulate(block, mf_ref, sf_ref)
+    if diagonal:
+        row = i * block_rows + jax.lax.broadcasted_iota(
+            jnp.int32, block.shape, 0)
+        is_diag = row == col
+        accumulate(jnp.where(is_diag, _NEG_INF, block), mo_ref, so_ref)
+        # exactly one tile per row contains the diagonal entry: fold it in
+        # with a running max (everything else is _NEG_INF)
+        d_here = jnp.max(jnp.where(is_diag, block, _NEG_INF),
+                         axis=-1, keepdims=True)        # [bm, 1]
+        d_acc_ref[:] = jnp.maximum(d_acc_ref[:], d_here)
+
+    @pl.when(j == num_col_blocks - 1)
+    def _finalize():
+        full_ref[:] = mf_ref[:] + jnp.log(sf_ref[:])
+        if diagonal:
+            off_ref[:] = mo_ref[:] + jnp.log(so_ref[:])
+            diag_ref[:] = d_acc_ref[:]
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("block_rows", "block_cols", "interpret", "diagonal"),
+)
+def mi_row_stats_pallas(
+    u: Array,
+    mus: Array,
+    logvars: Array,
+    block_rows: int = 128,
+    block_cols: int = 128,
+    interpret: bool | None = None,
+    diagonal: bool = True,
+) -> tuple[Array, Array, Array]:
+    """Per-row sandwich statistics in ONE pass — no [N, M] matrix in HBM.
+
+    Returns ``(diag, lse_full, lse_off)``, each ``[N]`` float32:
+
+      - ``diag[i]``     = log p(u_i | x_i)           (``diagonal=True`` only)
+      - ``lse_full[i]`` = logsumexp_j log p(u_i | x_j)
+      - ``lse_off[i]``  = logsumexp_{j != i} log p(u_i | x_j)
+
+    With ``diagonal=False`` (the asymmetric [M, N] probe case, where no
+    entry is "own") only the full-row reduction is computed — and only its
+    output/scratch allocated; ``diag``/``lse_off`` come back as
+    ``lse_full`` so the return shape is stable.
+
+    Numerics: the online max/rescaled-sum recurrence matches a one-shot
+    ``logsumexp`` to float32 roundoff (tested at 2e-5 rel); masked/absent
+    entries use the same large-finite ``_NEG_INF`` convention as the XLA
+    path, so degenerate rows (B=1 off-diagonal) agree too. Inputs of any
+    float dtype are accumulated in float32.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n, d = u.shape
+    m = mus.shape[0]
+    bm = min(block_rows, max(n, 1))
+    bn = min(block_cols, max(m, 1))
+    pad_n = (-n) % bm
+    pad_m = (-m) % bn
+    u_p = jnp.pad(u, ((0, pad_n), (0, 0)))
+    mus_p = jnp.pad(mus, ((0, pad_m), (0, 0)))
+    lv_p = jnp.pad(logvars, ((0, pad_m), (0, 0)))
+    num_col_blocks = mus_p.shape[0] // bn
+    grid = (u_p.shape[0] // bm, num_col_blocks)
+    lane_shape = jax.ShapeDtypeStruct((u_p.shape[0], _LANES), jnp.float32)
+    out_spec = pl.BlockSpec((bm, _LANES), lambda i, j: (i, 0))
+    full_scratch = [
+        _vmem((bm, _LANES), jnp.float32),       # running max, full
+        _vmem((bm, _LANES), jnp.float32),       # rescaled sum, full
+    ]
+    kernel = functools.partial(
+        _row_stats_kernel,
+        num_col_blocks=num_col_blocks, cols=m,
+        block_rows=bm, block_cols=bn, diagonal=diagonal,
+    )
+    in_specs = [
+        pl.BlockSpec((bm, d), lambda i, j: (i, 0)),
+        pl.BlockSpec((bn, d), lambda i, j: (j, 0)),
+        pl.BlockSpec((bn, d), lambda i, j: (j, 0)),
+    ]
+    if not diagonal:
+        # probe mode computes ONLY the full-row reduction — allocate
+        # exactly its output and scratch
+        full = pl.pallas_call(
+            kernel,
+            out_shape=lane_shape,
+            grid=grid,
+            in_specs=in_specs,
+            out_specs=out_spec,
+            scratch_shapes=full_scratch,
+            interpret=interpret,
+        )(u_p, mus_p, lv_p)
+        full = full[:n, 0]
+        return full, full, full
+    diag, full, off = pl.pallas_call(
+        kernel,
+        out_shape=(lane_shape, lane_shape, lane_shape),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=(out_spec, out_spec, out_spec),
+        scratch_shapes=full_scratch + [
+            _vmem((bm, _LANES), jnp.float32),   # running max, off-diagonal
+            _vmem((bm, _LANES), jnp.float32),   # rescaled sum, off-diagonal
+            _vmem((bm, _LANES), jnp.float32),   # captured diagonal entry
+        ],
+        interpret=interpret,
+    )(u_p, mus_p, lv_p)
+    return diag[:n, 0], full[:n, 0], off[:n, 0]
+
+
+def _vmem(shape, dtype):
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.VMEM(shape, dtype)

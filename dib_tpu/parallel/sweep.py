@@ -137,7 +137,45 @@ class BetaSweepTrainer:
 
         Stacked replica states/histories are donated (see
         ``DIBTrainer.run_chunk``) — at R replicas the in-place reuse saves a
-        full copy of R x (params + opt state + history) in HBM per chunk."""
+        full copy of R x (params + opt state + history) in HBM per chunk.
+
+        Permutation sampling with ``prefetch_epochs`` pre-stages every
+        replica's NEXT-epoch permutation gather inside the current epoch's
+        scan iteration, mirroring ``DIBTrainer.run_chunk``'s prefetching
+        pipeline (bit-identical gathers, sharded over the β axis like the
+        batches themselves)."""
+        spmd = BETA_AXIS if self.mesh is not None else None
+
+        # per-replica epoch key chains, identical in structure to the serial
+        # trainer's split(k_chunk, num_epochs)
+        epoch_keys = jax.vmap(lambda k: jax.random.split(k, num_epochs))(keys)
+        epoch_keys = jnp.moveaxis(epoch_keys, 1, 0)          # [E, R]
+        cfg = self.base.config
+        if cfg.batch_sampling == "permutation" and cfg.prefetch_epochs:
+            gather = jax.vmap(self.base._epoch_batches, spmd_axis_name=spmd)
+
+            def epoch(carry, ks_pair):
+                states, hists, staged = carry
+                ks, ks_next = ks_pair
+                staged_next = gather(ks_next)    # overlaps this epoch's steps
+
+                def one(state, hist, k, b0, b1, buf):
+                    state, row = self.base._epoch_body(
+                        state, k, (b0, b1), batches=buf)
+                    return state, history_record(hist, row)
+
+                states, hists = jax.vmap(one, spmd_axis_name=spmd)(
+                    states, hists, ks, self.beta_starts, self.beta_ends,
+                    staged,
+                )
+                return (states, hists, staged_next), None
+
+            next_keys = jnp.concatenate([epoch_keys[1:], epoch_keys[:1]])
+            staged0 = gather(epoch_keys[0])
+            (states, histories, _), _ = jax.lax.scan(
+                epoch, (states, histories, staged0), (epoch_keys, next_keys)
+            )
+            return states, histories
 
         def epoch(carry, ks):
             states, hists = carry
@@ -146,15 +184,10 @@ class BetaSweepTrainer:
                 state, row = self.base._epoch_body(state, k, (b0, b1))
                 return state, history_record(hist, row)
 
-            states, hists = jax.vmap(
-                one, spmd_axis_name=BETA_AXIS if self.mesh is not None else None
-            )(states, hists, ks, self.beta_starts, self.beta_ends)
+            states, hists = jax.vmap(one, spmd_axis_name=spmd)(
+                states, hists, ks, self.beta_starts, self.beta_ends)
             return (states, hists), None
 
-        # per-replica epoch key chains, identical in structure to the serial
-        # trainer's split(k_chunk, num_epochs)
-        epoch_keys = jax.vmap(lambda k: jax.random.split(k, num_epochs))(keys)
-        epoch_keys = jnp.moveaxis(epoch_keys, 1, 0)          # [E, R]
         (states, histories), _ = jax.lax.scan(epoch, (states, histories), epoch_keys)
         return states, histories
 

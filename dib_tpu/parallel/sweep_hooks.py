@@ -57,13 +57,21 @@ class SweepInfoPerFeatureHook:
         row_block: int | None = None,
         persist: str | None = None,
         telemetry=None,
+        overlap: bool = False,
     ):
         self.evaluation_batch_size = evaluation_batch_size
         self.number_evaluation_batches = number_evaluation_batches
         self.row_block = row_block
         self.telemetry = telemetry   # EventWriter: one mi_bounds event/checkpoint
+        # overlap=True: dispatch each checkpoint's measurement on a
+        # donation-decoupled params snapshot and collect it at the NEXT
+        # checkpoint (or first ``records`` read) — it rides the async
+        # queue under the following training chunk instead of serializing
+        # the β checkpoint (docs/performance.md "Overlapped measurement").
+        self.overlap = overlap
         self._base_key = jax.random.key(seed)
-        self.records: list[dict] = []
+        self._records: list[dict] = []
+        self._pending = None
         self._fn = None
         self._device_rows = None
         self._beta_ends = None
@@ -93,6 +101,47 @@ class SweepInfoPerFeatureHook:
                     "epoch": int(data["epoch"]),
                     "bounds": np.asarray(data["bounds"]),
                 })
+
+    @property
+    def records(self) -> list[dict]:
+        """Collected measurements (flushes an overlapped one in flight, so
+        readers always see the full trajectory)."""
+        self._flush_pending()
+        return self._records
+
+    @records.setter
+    def records(self, value) -> None:
+        self._pending = None
+        self._records = value
+
+    def _flush_pending(self) -> None:
+        if self._pending is None:
+            return
+        pending, self._pending = self._pending, None
+        from dib_tpu.train.overlap import collect_overlapped
+
+        fetched = collect_overlapped(pending)
+        self._file_record(pending.meta["epoch"],
+                          np.stack([fetched["lower"], fetched["upper"]],
+                                   axis=-1))
+
+    def _file_record(self, epoch: int, bounds: np.ndarray) -> None:
+        """Append one [R, F, 2]-nats record + its event and npz mirror."""
+        self._records.append({"epoch": epoch, "bounds": bounds})
+        if self.telemetry is not None:
+            ln2 = np.log(2.0)
+            # per-replica feature means in bits, tagged with each replica's
+            # annealing endpoint so sweep streams stay beta-attributable
+            self.telemetry.mi_bounds(
+                epoch=epoch,
+                lower_bits=[float(x) for x in bounds[..., 0].mean(-1) / ln2],
+                upper_bits=[float(x) for x in bounds[..., 1].mean(-1) / ln2],
+                beta_end=self._beta_ends,
+            )
+        if self.persist:
+            path = os.path.join(self.persist, f"epoch{epoch}.npz")
+            np.savez(f"{path}.tmp.npz", epoch=epoch, bounds=bounds)
+            os.replace(f"{path}.tmp.npz", path)
 
     def _key_for_call(self, n: int):
         """The n-th call's evaluation key (0-indexed), derived by walking
@@ -128,32 +177,33 @@ class SweepInfoPerFeatureHook:
         # A resumed worker re-measures from its restore point: drop any
         # preloaded records at/after this epoch (their npz mirrors are
         # simply overwritten) so the call index — and with it the key
-        # chain — matches the uninterrupted run's.
+        # chain — matches the uninterrupted run's. (``records`` flushes an
+        # overlapped measurement in flight first, so the call index below
+        # counts it.)
         if self.records and self.records[-1]["epoch"] >= epoch:
             self.records = [r for r in self.records if r["epoch"] < epoch]
-        k = self._key_for_call(len(self.records))
+        k = self._key_for_call(len(self._records))
         keys = jax.random.split(k, sweep.num_replicas)
-        lower, upper = self._fn(
-            _model_params(states.params), self._device_rows, keys
-        )
+        params = _model_params(states.params)
+        if self.overlap:
+            # measure through a snapshot — the sweep's next run_chunk
+            # donates the stacked state buffers (dib_tpu/train/overlap.py)
+            from dib_tpu.train.overlap import snapshot_params
+
+            params = snapshot_params(params)
+        lower, upper = self._fn(params, self._device_rows, keys)
+        if self.overlap:
+            # defer collection to the next checkpoint / first records read:
+            # the dispatch rides the queue under the next training chunk
+            from dib_tpu.train.overlap import begin_overlapped
+
+            self._pending = begin_overlapped(
+                {"lower": lower, "upper": upper}, epoch=epoch)
+            return
         bounds = np.stack(
             [np.asarray(lower), np.asarray(upper)], axis=-1
         )  # [R, F, 2] nats
-        self.records.append({"epoch": epoch, "bounds": bounds})
-        if self.telemetry is not None:
-            ln2 = np.log(2.0)
-            # per-replica feature means in bits, tagged with each replica's
-            # annealing endpoint so sweep streams stay beta-attributable
-            self.telemetry.mi_bounds(
-                epoch=epoch,
-                lower_bits=[float(x) for x in bounds[..., 0].mean(-1) / ln2],
-                upper_bits=[float(x) for x in bounds[..., 1].mean(-1) / ln2],
-                beta_end=self._beta_ends,
-            )
-        if self.persist:
-            path = os.path.join(self.persist, f"epoch{epoch}.npz")
-            np.savez(f"{path}.tmp.npz", epoch=epoch, bounds=bounds)
-            os.replace(f"{path}.tmp.npz", path)
+        self._file_record(epoch, bounds)
 
     @property
     def epochs(self) -> np.ndarray:

@@ -133,8 +133,12 @@ EVENT_SCHEMA: dict[str, EventKindSpec] = {
     "span": EventKindSpec(
         required=("name", "path", "span", "parent", "seconds"),
         optional=("epoch", "replica", "beta_end", "op", "bucket",
-                  "status", "rows", "fill", "queued_s", "padded_rows"),
-        doc="one closed trace span (serving emits request/batch spans)"),
+                  "status", "rows", "fill", "queued_s", "padded_rows",
+                  "overlapped"),
+        doc="one closed trace span (serving emits request/batch spans; "
+            "overlapped=true marks a measurement that rode the async "
+            "queue — seconds is then the EXPOSED wait, queued_s the "
+            "dispatch→ready window)"),
     "mi_bounds": EventKindSpec(
         required=("epoch",),
         optional=("lower_bits", "upper_bits", "beta", "replica",

@@ -177,6 +177,12 @@ class FitRecorder:
             return contextlib.nullcontext(_NullPhase())
         return self.tracer.span(name, **tags)
 
+    # NOTE: overlapped-measurement span accounting lives in
+    # dib_tpu/train/overlap.py (begin_overlapped / collect_overlapped) —
+    # the dispatch captures the bound tracer (this recorder's, via the
+    # fit loop's use_tracer), so collection emits on the run's stream
+    # even when it happens after the loop.
+
     def record_compile(self, name: str, jitfn, *args,
                        epochs: int | None = None, **kwargs) -> dict | None:
         """Cost-analyze ``jitfn`` at this call signature, once per ``name``.

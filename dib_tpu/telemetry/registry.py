@@ -200,7 +200,8 @@ def bench_entry(record: dict, extra: dict | None = None) -> dict:
         "unit": record.get("unit"),
     }
     for key in ("vs_baseline", "steps_per_s", "mfu", "achieved_tflops",
-                "device_kind", "compile_cache", "degraded", "measured_at"):
+                "device_kind", "compile_cache", "degraded", "measured_at",
+                "stale_seconds", "cache_measured_at"):
         if record.get(key) is not None:
             entry[key] = record[key]
     telemetry = record.get("telemetry") or {}
@@ -322,14 +323,23 @@ def runs_main(args) -> int:
               "artifacts")
         return 0
     print(f"{'#':>3} {'measured_at':20} {'value':>9} {'unit':9} "
-          f"{'steps/s':>9} {'mfu':>8} {'vs_baseline':>11}  device")
+          f"{'steps/s':>9} {'mfu':>8} {'vs_baseline':>11} {'stale':>9}  "
+          "device")
     for i, entry in enumerate(bench):
+        # stale_seconds: how old the SERVED value was at emission time — a
+        # cached value rides degraded records (BENCH_r05 served a 59,446 s
+        # stale number); fresh measurements have none and print "—". The
+        # committed SLO caps accepted staleness (SLO.json
+        # bench_cache_staleness_ceiling).
+        stale = entry.get("stale_seconds")
+        stale_s = "—" if stale is None else f"{int(stale)}s"
         print(f"{i:>3} {_fmt(entry.get('measured_at'), 20)} "
               f"{_fmt(entry.get('value')):>9} "
               f"{_fmt(entry.get('unit'), 9)} "
               f"{_fmt(entry.get('steps_per_s')):>9} "
               f"{_fmt(entry.get('mfu')):>8} "
-              f"{_fmt(entry.get('vs_baseline')):>11}  "
+              f"{_fmt(entry.get('vs_baseline')):>11} "
+              f"{stale_s:>9}  "
               f"{_fmt(entry.get('device_kind'))}"
               + ("  [degraded]" if entry.get("degraded") else ""))
     return 0

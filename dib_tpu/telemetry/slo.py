@@ -408,6 +408,29 @@ class SLOEngine:
 
 
 # -------------------------------------------------------------- terminal
+def _load_bench_record(path: str) -> dict | None:
+    """A bench.py one-liner as a rule-evaluable view, or None when ``path``
+    is not one (run dirs / event streams take the summarize path). The
+    view is the embedded telemetry summary (when present) with the bench
+    record's own top-level fields — ``stale_seconds``, ``mfu``,
+    ``degraded`` — layered on top, so both vocabularies resolve."""
+    if not os.path.isfile(path):
+        return None
+    try:
+        with open(path) as f:
+            record = json.load(f)
+    except (json.JSONDecodeError, UnicodeDecodeError, OSError):
+        return None
+    if not isinstance(record, dict) or "metric" not in record:
+        return None
+    if record.get("metric") == "run_telemetry_summary":
+        return record
+    embedded = record.get("telemetry")
+    view = dict(embedded) if isinstance(embedded, dict) else {}
+    view.update({k: v for k, v in record.items() if k != "telemetry"})
+    return view
+
+
 def check_run(path: str, slo_path: str = DEFAULT_SLO_PATH, *,
               run_id: str | None = None, process_index: int | None = None,
               write: bool = True) -> dict:
@@ -423,6 +446,23 @@ def check_run(path: str, slo_path: str = DEFAULT_SLO_PATH, *,
     from dib_tpu.telemetry.summary import summarize
 
     spec = load_slo(slo_path)
+    bench = _load_bench_record(path)
+    if bench is not None:
+        # a bench.py one-liner is a valid check operand (the compare
+        # convention): rules evaluate against the record's top-level
+        # fields (stale_seconds, mfu, degraded...) merged over its
+        # embedded telemetry summary. Nothing durable to write to.
+        rows = evaluate_rules(spec.get("rules") or [], bench)
+        violations = [r for r in rows if r["status"] == "violated"]
+        return {
+            "slo": os.path.basename(slo_path),
+            "run_id": bench.get("run_id"),
+            "rules": rows,
+            "violations": len(violations),
+            "skipped": sum(r["status"] == "skipped" for r in rows),
+            "transitions": [],
+            "written": {"alerts": 0, "transitions": 0},
+        }
     summary = summarize(path, process_index=process_index, run_id=run_id)
     events = list(read_events(path, process_index=process_index))
     if run_id is not None:

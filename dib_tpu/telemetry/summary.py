@@ -37,9 +37,9 @@ from dib_tpu.telemetry.events import (
     read_events,
 )
 
-__all__ = ["summarize", "compare", "faults_rollup", "scheduler_rollup",
-           "serving_rollup", "span_rollup", "span_hotspots",
-           "telemetry_main"]
+__all__ = ["summarize", "compare", "faults_rollup", "overlap_rollup",
+           "scheduler_rollup", "serving_rollup", "span_rollup",
+           "span_hotspots", "telemetry_main"]
 
 _LN2 = log(2.0)
 
@@ -132,6 +132,36 @@ def span_hotspots(rollup: dict, n: int = 3) -> list[dict]:
     ]
     rows.sort(key=lambda r: -r["self_s"])
     return rows[:n]
+
+
+def overlap_rollup(span_events) -> dict | None:
+    """Measurement-overlap accounting over ``overlapped`` spans
+    (docs/performance.md "Overlapped measurement"): an overlapped span's
+    ``seconds`` is the EXPOSED wait its collection boundary actually paid
+    and ``queued_s`` the dispatch→ready window it rode under other work.
+    ``hidden_s`` = queued − exposed (wall-clock the measurement spent in
+    flight without the host waiting); ``exposed_frac`` = exposed/queued —
+    the number ``compare`` gates (a measurement that starts serializing
+    boundaries again shows up as the fraction rising toward 1). None when
+    the stream carries no overlapped spans."""
+    rows = [e for e in span_events if e.get("overlapped")]
+    if not rows:
+        return None
+    exposed = sum(e.get("seconds") or 0.0 for e in rows)
+    queued = sum(e.get("queued_s") or 0.0 for e in rows)
+    out = {
+        "spans": len(rows),
+        "exposed_s": round(exposed, 4),
+        "queued_s": round(queued, 4),
+        "hidden_s": round(max(queued - exposed, 0.0), 4),
+    }
+    if queued > 0:
+        out["exposed_frac"] = round(min(exposed / queued, 1.0), 6)
+    by_name: dict[str, int] = {}
+    for e in rows:
+        by_name[e.get("name", "?")] = by_name.get(e.get("name", "?"), 0) + 1
+    out["by_name"] = by_name
+    return out
 
 
 def _percentile(ordered: list[float], q: float) -> float:
@@ -648,6 +678,13 @@ def summarize(path: str, process_index: int | None = None,
         serving = serving_rollup(span_events)
         if serving:
             summary["serving"] = serving
+        overlap = overlap_rollup(span_events)
+        if overlap:
+            summary["overlap"] = overlap
+            if overlap.get("exposed_frac") is not None:
+                # flat alias the compare gate reads (a regression = the
+                # overlapped measurement exposing more of its wall-clock)
+                summary["overlap_exposed_frac"] = overlap["exposed_frac"]
 
     mem_device = [((c.get("memory") or {}).get("peak_bytes_in_use"))
                   for c in chunks]
@@ -762,6 +799,10 @@ _GATES: Sequence[tuple[str, str]] = (
     # a run that goes dark for longer than its baseline did is a liveness
     # regression even when throughput held (docs/observability.md)
     ("heartbeat_max_gap_s", "up"),
+    # overlap regression: the overlapped measurement's exposed fraction
+    # grew — MI bounds are serializing chunk boundaries again
+    # (docs/performance.md "Overlapped measurement")
+    ("overlap_exposed_frac", "up"),
 )
 
 

@@ -44,3 +44,10 @@ from dib_tpu.train.measurement import (
     MeasurementTrainState,
     make_state_windows,
 )
+from dib_tpu.train.overlap import (
+    PendingDispatch,
+    begin_overlapped,
+    collect_overlapped,
+    snapshot_params,
+)
+from dib_tpu.train.prefetch import HostStager

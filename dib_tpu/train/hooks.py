@@ -182,12 +182,19 @@ class InfoPerFeatureHook:
         number_evaluation_batches: int = 8,
         seed: int = 0,
         row_block: int | None = None,
+        overlap: bool = False,
     ):
         self.evaluation_batch_size = evaluation_batch_size
         self.number_evaluation_batches = number_evaluation_batches
         self.row_block = row_block   # chunk the [B, B] density rows (memory)
         self.key = jax.random.key(seed)
-        self.records: list[dict] = []
+        # overlap=True defers the result fetch to the NEXT invocation (or
+        # the first read of ``records``): the measurement is dispatched on
+        # a donation-decoupled params snapshot and rides the async queue
+        # under the following training chunk (docs/performance.md).
+        self.overlap = overlap
+        self._records: list[dict] = []
+        self._pending = None
         self._batched_fn = None
         self._device_rows = None    # x_valid uploaded once, reused per call
         self._cache_for = None      # STRONG refs (model, bundle) the caches
@@ -196,6 +203,30 @@ class InfoPerFeatureHook:
                                     # CPython id reuse, and sweep replica
                                     # views sharing one model/bundle keep
                                     # the caches warm across checkpoints
+
+    @property
+    def records(self) -> list[dict]:
+        """Collected measurements (flushes any overlapped one in flight,
+        so readers always see the full trajectory)."""
+        self._flush_pending()
+        return self._records
+
+    @records.setter
+    def records(self, value) -> None:
+        self._pending = None
+        self._records = value
+
+    def _flush_pending(self) -> None:
+        if self._pending is None:
+            return
+        pending, self._pending = self._pending, None
+        from dib_tpu.train.overlap import collect_overlapped
+
+        fetched = collect_overlapped(pending)
+        bounds = [(float(a), float(b))
+                  for a, b in zip(fetched["lower"], fetched["upper"])]
+        self._records.append(
+            {"epoch": pending.meta["epoch"], "bounds": bounds})
 
     def __call__(self, trainer, state, epoch: int):
         # Note: batch size deliberately NOT capped at the dataset size —
@@ -226,7 +257,22 @@ class InfoPerFeatureHook:
             if self._device_rows is None:
                 self._device_rows = jnp.asarray(trainer.bundle.x_valid)
             self.key, k = jax.random.split(self.key)
+            if self.overlap:
+                # collect the previous boundary's measurement (it rode the
+                # queue under the chunk that just ran), then measure
+                # through a snapshot — the fit's next run_chunk donates
+                # the live state buffers (dib_tpu/train/overlap.py)
+                from dib_tpu.train.overlap import snapshot_params
+
+                self._flush_pending()
+                params = snapshot_params(params)
             lower, upper = self._batched_fn(params, self._device_rows, k)
+            if self.overlap:
+                from dib_tpu.train.overlap import begin_overlapped
+
+                self._pending = begin_overlapped(
+                    {"lower": lower, "upper": upper}, epoch=epoch)
+                return
             bounds = [(float(a), float(b)) for a, b in zip(lower, upper)]
         else:
             bounds = []

@@ -55,6 +55,13 @@ class TrainConfig:
     # VERDICT round 3 item 4a). Epoch buffer is steps_per_epoch x batch_size
     # rows of HBM.
     batch_sampling: str = "replacement"
+    # Permutation-mode prefetch (docs/performance.md "Prefetching epoch
+    # pipeline"): stage epoch e+1's permutation gather during epoch e's
+    # step scan, so the gather leaves the epoch boundary's critical path.
+    # Bit-identical numerics (same keys, same gather); costs a second
+    # epoch buffer of HBM plus one dead gather per chunk. Ignored for
+    # 'replacement' sampling.
+    prefetch_epochs: bool = True
 
     @property
     def num_epochs(self) -> int:
@@ -171,12 +178,41 @@ class DIBTrainer:
         return loss, {"task": task, "kl": kl_per_feature, "metric": metric}
 
     # ------------------------------------------------------------ epoch scan
+    def _epoch_batches(self, key: Array) -> tuple[Array, Array]:
+        """The epoch's permutation-gathered batch buffers, from its epoch
+        key (same derivation ``_epoch_body`` uses inline, so prefetched and
+        inline epochs are bit-identical): ONE gather of
+        ``steps_per_epoch x batch_size`` rows, fed through the step scan's
+        xs. The prefetching chunk scan calls this with epoch e+1's key
+        DURING epoch e (docs/performance.md, "Prefetching epoch
+        pipeline")."""
+        cfg = self.config
+        n = self._x_train.shape[0]
+        total = self.steps_per_epoch * cfg.batch_size
+        # derived from the epoch key, independent of the step/val keys
+        k_perm = jax.random.fold_in(key, 1)
+        perms = [
+            jax.random.permutation(jax.random.fold_in(k_perm, i), n)
+            for i in range(-(-total // n))
+        ]
+        idx = jnp.concatenate(perms)[:total]
+        x_epoch = self._x_train[idx].reshape(
+            self.steps_per_epoch, cfg.batch_size, *self._x_train.shape[1:]
+        )
+        y_epoch = self._y_train[idx].reshape(
+            self.steps_per_epoch, cfg.batch_size, *self._y_train.shape[1:]
+        )
+        return x_epoch, y_epoch
+
     def _epoch_body(
-        self, state: TrainState, key: Array, beta_endpoints=None
+        self, state: TrainState, key: Array, beta_endpoints=None,
+        batches: tuple[Array, Array] | None = None,
     ) -> tuple[TrainState, dict]:
         """One epoch. ``beta_endpoints`` optionally overrides the config's
         static (beta_start, beta_end) with traced values — the sweep trainer
-        vmaps this body over a grid of endpoints."""
+        vmaps this body over a grid of endpoints. ``batches`` optionally
+        supplies pre-staged permutation buffers (``_epoch_batches``) so the
+        gather can run ahead of the epoch boundary."""
         cfg = self.config
         b0, b1 = (
             (cfg.beta_start, cfg.beta_end) if beta_endpoints is None else beta_endpoints
@@ -204,19 +240,10 @@ class DIBTrainer:
             # ONE gather for the epoch (device PRNG permutations, tiled when
             # the epoch needs more rows than the dataset), batches then ride
             # the scan's xs as contiguous slices — no per-step gather ops.
-            total = self.steps_per_epoch * cfg.batch_size
-            # derived from the epoch key, independent of the step/val keys
-            k_perm = jax.random.fold_in(key, 1)
-            perms = [
-                jax.random.permutation(jax.random.fold_in(k_perm, i), n)
-                for i in range(-(-total // n))
-            ]
-            idx = jnp.concatenate(perms)[:total]
-            x_epoch = self._x_train[idx].reshape(
-                self.steps_per_epoch, cfg.batch_size, *self._x_train.shape[1:]
-            )
-            y_epoch = self._y_train[idx].reshape(
-                self.steps_per_epoch, cfg.batch_size, *self._y_train.shape[1:]
+            # ``batches`` carries the pre-staged buffers when the chunk scan
+            # prefetches (run_chunk); inline otherwise.
+            x_epoch, y_epoch = (
+                self._epoch_batches(key) if batches is None else batches
             )
 
             def step_body(carry, xs):
@@ -289,7 +316,38 @@ class DIBTrainer:
         ``state``/``history`` buffers are donated: the inputs are dead after
         the call (callers rebind to the returned values), so XLA reuses their
         HBM in place instead of holding params + optimizer state + history
-        twice."""
+        twice.
+
+        Permutation sampling with ``prefetch_epochs`` (the default) runs
+        the PREFETCHING pipeline: epoch e+1's permutation gather is issued
+        inside epoch e's scan iteration, data-independent of e's step scan,
+        so the scheduler can hide the gather under the steps instead of
+        serializing it at the epoch boundary. Same keys, same gather —
+        bit-identical to the inline path — at the cost of a second epoch
+        buffer and one dead gather on the chunk's last epoch."""
+        keys = jax.random.split(key, num_epochs)
+        if (self.config.batch_sampling == "permutation"
+                and self.config.prefetch_epochs):
+
+            def body(carry, ks):
+                state, history, staged = carry
+                k, k_next = ks
+                # pre-stage the NEXT epoch's buffers before this epoch's
+                # step scan consumes `staged` — no data dependency, so the
+                # gather overlaps the steps
+                staged_next = self._epoch_batches(k_next)
+                state, row = self._epoch_body(state, k, batches=staged)
+                history = history_record(history, row)
+                return (state, history, staged_next), None
+
+            # epoch e prefetches e+1; the final epoch's prefetch re-gathers
+            # epoch 0's buffers (dead work, sliced off by the carry drop)
+            next_keys = jnp.concatenate([keys[1:], keys[:1]])
+            staged0 = self._epoch_batches(keys[0])
+            (state, history, _), _ = jax.lax.scan(
+                body, (state, history, staged0), (keys, next_keys)
+            )
+            return state, history
 
         def body(carry, k):
             state, history = carry
@@ -297,7 +355,6 @@ class DIBTrainer:
             history = history_record(history, row)
             return (state, history), None
 
-        keys = jax.random.split(key, num_epochs)
         (state, history), _ = jax.lax.scan(body, (state, history), keys)
         return state, history
 

@@ -175,7 +175,7 @@ class MeasurementTrainer:
 
     # -------------------------------------------------------------------- fit
     def fit(self, key: Array, state: MeasurementTrainState | None = None,
-            hooks=()):
+            hooks=(), overlap: bool = False):
         """Train with the MI early stop. Returns (state, history dict).
 
         ``hooks`` are called as ``hook(trainer, state, step)`` after every
@@ -184,37 +184,123 @@ class MeasurementTrainer:
         ``MeasurementCheckpointer`` save in a hook captures the exact resume
         point — ``fit(restored_key, state=restored_state)`` continues the key
         chain bit-identically at the same chunk boundaries.
+
+        ``overlap=True`` runs the SPECULATIVE pipeline (docs/performance.md
+        "Overlapped measurement"): each boundary's MI check is dispatched
+        on a donation-decoupled snapshot and the NEXT training chunk is
+        dispatched before the check's value is read, so the measurement
+        rides the async queue under the chunk. If the check fires the
+        stop, the speculative chunk's outputs are discarded and the
+        snapshot is returned — histories, stop step, and the published
+        ``resume_key`` chain are bit-identical to the serial schedule (one
+        chunk of device work is wasted at the stop, the price of hiding
+        every check before it).
         """
         cfg = self.config
         if state is None:
             key, k_init = jax.random.split(key)
             state = self.init(k_init)
         history = {"loss": [], "match": [], "kl": [], "beta": [], "mi_bounds": []}
-        stopped = False
         self.resume_key = key    # defined even if the loop body never runs
         self.latest_history = history
-        while int(state.step) < cfg.num_steps and not stopped:
-            chunk = min(cfg.check_every, cfg.num_steps - int(state.step))
+        if overlap:
+            return self._fit_overlapped(key, state, hooks, history)
+        stopped = False
+        # one-off pre-loop fetch; the boundary loop tracks steps on host
+        step = int(jax.device_get(state.step))
+        while step < cfg.num_steps and not stopped:
+            chunk = min(cfg.check_every, cfg.num_steps - step)
             key, k_chunk, k_mi = jax.random.split(key, 3)
             state, stats = self.run_chunk(state, k_chunk, chunk)
-            for name in ("loss", "match", "kl", "beta"):
-                history[name].append(np.asarray(stats[name]))
             lower, upper = self.channel_mi_bounds(state, k_mi)
-            lower_bits = float(lower) / np.log(2.0)
-            history["mi_bounds"].append(
-                {"step": int(state.step), "lower": float(lower), "upper": float(upper)}
-            )
-            stopped = lower_bits >= cfg.mi_stop_bits
+            # ONE blocking boundary fetch (the blocking-fetch idiom the
+            # host-sync lint pass enforces, docs/static-analysis.md)
+            fetched = jax.device_get(
+                {"stats": stats, "lower": lower, "upper": upper})
+            step += chunk
+            stopped = self._record_check(
+                history, fetched, step) >= cfg.mi_stop_bits
             self.resume_key = key
             self.latest_history = history
             for hook in hooks:
-                hook(self, state, int(state.step))
+                hook(self, state, step)
+        return state, self._finalize_history(history, stopped)
+
+    def _record_check(self, history, fetched: dict, step: int) -> float:
+        """File one boundary's fetched stats + MI check; returns the lower
+        bound in bits (the stop criterion's operand)."""
+        for name in ("loss", "match", "kl", "beta"):
+            history[name].append(np.asarray(fetched["stats"][name]))
+        lower = float(fetched["lower"])
+        history["mi_bounds"].append(
+            {"step": step, "lower": lower, "upper": float(fetched["upper"])}
+        )
+        return lower / np.log(2.0)
+
+    @staticmethod
+    def _finalize_history(history, stopped: bool):
         for name in ("loss", "match", "kl", "beta"):
             history[name] = (
                 np.concatenate(history[name]) if history[name] else np.zeros(0)
             )
         history["stopped_early"] = stopped
-        return state, history
+        return history
+
+    def _fit_overlapped(self, key, state, hooks, history):
+        """The speculative boundary pipeline of :meth:`fit` (overlap=True).
+
+        Invariants vs the serial loop: the PRNG split order is identical
+        (a resumed ``fit(resume_key, state=...)`` recomputes exactly the
+        chunk the speculation ran); history rows and the stop decision are
+        made from the same values in the same order; hooks fire at the
+        same boundaries with a state equal to the serial one (an on-device
+        copy — the live buffers belong to the speculative chunk's
+        donation)."""
+        from dib_tpu.train.overlap import snapshot_params
+
+        cfg = self.config
+        step = int(jax.device_get(state.step))
+        stopped = False
+        inflight = None   # the boundary whose MI check is riding the queue
+        final_state = state
+        while True:
+            if step < cfg.num_steps and not stopped:
+                chunk = min(cfg.check_every, cfg.num_steps - step)
+                key, k_chunk, k_mi = jax.random.split(key, 3)
+                state, stats = self.run_chunk(state, k_chunk, chunk)
+                # donation-decoupled copy: the NEXT (speculative) chunk
+                # donates `state`, so both the MI check and a potential
+                # stop-rollback read the snapshot, never the live buffers
+                keep = snapshot_params(state)
+                lower, upper = self.channel_mi_bounds(keep, k_mi)
+                step += chunk
+                this = {"keep": keep, "stats": stats, "lower": lower,
+                        "upper": upper, "step": step, "key_after": key}
+            else:
+                this = None
+            if inflight is not None:
+                fetched = jax.device_get({
+                    "stats": inflight["stats"], "lower": inflight["lower"],
+                    "upper": inflight["upper"],
+                })
+                lower_bits = self._record_check(
+                    history, fetched, inflight["step"])
+                self.resume_key = inflight["key_after"]
+                self.latest_history = history
+                final_state = inflight["keep"]
+                if lower_bits >= cfg.mi_stop_bits:
+                    # the chunk dispatched above was speculative: discard
+                    # it and rewind the key so a resume replays nothing
+                    stopped = True
+                    key = inflight["key_after"]
+                    step = inflight["step"]
+                    this = None
+                for hook in hooks:
+                    hook(self, final_state, inflight["step"])
+            if this is None and inflight is None:
+                break
+            inflight = this
+        return final_state, self._finalize_history(history, stopped)
 
     # ------------------------------------------------------------ symbolizer
     def symbolize_trajectory(
@@ -232,26 +318,40 @@ class MeasurementTrainer:
         deterministic function of ``key`` and the trained parameters. Chunks
         of ``chunk_size`` states keep the [draws, chunk, dim] sample tensor
         inside device memory for arbitrarily long trajectories.
+
+        Input pipeline: the trajectory lives on HOST (it can be far larger
+        than HBM), so chunks are staged through a double-buffered
+        ``device_put`` (:class:`dib_tpu.train.prefetch.HostStager`) — chunk
+        i+1's host→device transfer overlaps chunk i's compute — and the
+        symbol outputs (small int arrays) are fetched in ONE device_get at
+        the end instead of a blocking fetch per chunk.
         """
+        from dib_tpu.train.prefetch import HostStager
+
         traj = np.asarray(trajectory, np.float32)
         if traj.ndim == 1:
             traj = traj[:, None]
-        out = []
         pad = (-len(traj)) % chunk_size
         padded = np.concatenate([traj, traj[-pad:]]) if pad else traj
-        for start in range(0, len(padded), chunk_size):
-            chunk = jnp.asarray(padded[start : start + chunk_size])
+        host_chunks = [padded[start: start + chunk_size]
+                       for start in range(0, len(padded), chunk_size)]
+        out = []
+        for chunk in HostStager(host_chunks):
             out.append(
-                np.asarray(
-                    # lint-ok(prng-reuse): deterministic symbolization —
-                    # every chunk reuses the same measurement noise by
-                    # design; fresh keys would make the symbol stream
-                    # depend on the chunking and invalidate the committed
-                    # characterization artifacts
-                    self._symbolize_chunk(state.params, chunk, key, num_noise_draws)
-                )
+                # lint-ok(prng-reuse): deterministic symbolization —
+                # every chunk reuses the same measurement noise by
+                # design; fresh keys would make the symbol stream
+                # depend on the chunking and invalidate the committed
+                # characterization artifacts
+                self._symbolize_chunk(state.params, chunk, key, num_noise_draws)
             )
-        return np.concatenate(out)[: len(traj)]
+            if len(out) >= 3:
+                # sliding sync: bound the dispatch depth so at most ~3
+                # chunks' INPUT buffers are in flight at once — chunking
+                # exists precisely for trajectories larger than HBM, and
+                # an unbounded enqueue would stage them all resident
+                jax.block_until_ready(out[-3])
+        return np.concatenate(jax.device_get(out))[: len(traj)]
 
     @partial(jax.jit, static_argnames=("self", "num_noise_draws"))
     def _symbolize_chunk(self, params, flat: Array, key: Array, num_noise_draws: int):
@@ -396,14 +496,18 @@ class MeasurementRepeatTrainer:
             split = jax.vmap(lambda k: jax.random.split(k, 3))(keys)
             keys, k_chunk, k_mi = split[:, 0], split[:, 1], split[:, 2]
             states, stats = self.run_chunk(states, k_chunk, active, chunk)
-            for name in series:
-                series[name].append(np.asarray(stats[name]))
             lower, upper = self.channel_mi_bounds(states, k_mi)
-            lower_bits = np.asarray(lower) / np.log(2.0)
+            # ONE blocking boundary fetch (blocking-fetch idiom,
+            # docs/static-analysis.md)
+            fetched = jax.device_get(
+                {"stats": stats, "lower": lower, "upper": upper})
+            for name in series:
+                series[name].append(np.asarray(fetched["stats"][name]))
+            lower_bits = np.asarray(fetched["lower"]) / np.log(2.0)
             checks.append({
                 "step": done + chunk,
-                "lower": np.asarray(lower),
-                "upper": np.asarray(upper),
+                "lower": np.asarray(fetched["lower"]),
+                "upper": np.asarray(fetched["upper"]),
                 "active": np.asarray(active),
             })
             done += chunk

@@ -1,0 +1,118 @@
+"""Donation-safe overlapped measurement: snapshots + pending dispatches.
+
+The raw-speed overlap pattern (docs/performance.md "Overlapped
+measurement"): at a chunk boundary, dispatch the MI-bound measurement on a
+SNAPSHOT of the parameters and collect it at the NEXT boundary, so the
+measurement rides the async dispatch queue under the following training
+chunk instead of serializing the boundary.
+
+The snapshot is load-bearing, not a style choice: every chunked trainer
+donates its state buffers (``donate_argnames``), so by the time an
+overlapped measurement executes, the parameter buffers it was dispatched
+on belong to XLA and may hold the NEXT chunk's values. ``snapshot_params``
+is an on-device copy (no host round-trip) that decouples the measurement's
+inputs from the donation. The static-analysis suite flags the unsafe alias
+shape (``dib_tpu/analysis/passes/donation.py``, overlap-alias extension);
+this module is the blessed escape.
+
+Host-side pipelining lives in :class:`PendingDispatch`: a tiny record of
+in-flight device outputs plus the wall-clock bookkeeping ``telemetry
+summarize`` rolls into the ``overlap`` section (exposed vs hidden
+measurement seconds).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["PendingDispatch", "begin_overlapped", "collect_overlapped",
+           "snapshot_params"]
+
+_SNAPSHOT = None
+
+
+def _snapshot_fn():
+    global _SNAPSHOT
+    if _SNAPSHOT is None:
+        # jit guarantees fresh output buffers (XLA never aliases an input
+        # to an output without donation), so the copy is a true decouple
+        _SNAPSHOT = jax.jit(lambda tree: jax.tree.map(jnp.copy, tree))
+    return _SNAPSHOT
+
+
+def snapshot_params(tree):
+    """On-device copy of a parameter pytree, decoupled from buffer donation.
+
+    Dispatch is async (the copy rides the queue like any other op); the
+    returned arrays share no buffers with the inputs, so a later donating
+    call (``run_chunk``) cannot invalidate a measurement dispatched on the
+    snapshot. Non-array leaves pass through unchanged.
+    """
+    return _snapshot_fn()(tree)
+
+
+@dataclass
+class PendingDispatch:
+    """One overlapped measurement in flight.
+
+    ``outputs`` are the un-fetched device arrays; ``meta`` carries whatever
+    the collection site needs to file the result (epoch/step, extra
+    fields); ``token`` is the dispatch-time wall-clock anchor set by
+    :func:`begin_overlapped` (None on a hand-built dispatch — the
+    collection span then omits ``queued_s``); ``tracer`` is the tracer
+    captured at DISPATCH, because collection may happen after the fit's
+    ``use_tracer`` context has exited (a post-fit ``records`` read) and
+    the span must still land on the run's stream.
+    """
+
+    outputs: Any
+    meta: dict = field(default_factory=dict)
+    token: Any = None
+    tracer: Any = None
+
+    def collect(self):
+        """Block on the outputs and fetch them to host (one transfer)."""
+        return jax.device_get(self.outputs)
+
+
+def begin_overlapped(outputs, *, epoch: int, **meta) -> PendingDispatch:
+    """Record an overlapped dispatch: outputs in flight, the wall-clock
+    anchor for ``queued_s``, and the CURRENTLY bound tracer (so the
+    collection span reaches the event stream even when the collect
+    happens after the fit loop's tracer binding is gone)."""
+    from dib_tpu.telemetry import trace
+
+    return PendingDispatch(
+        outputs=outputs, meta={"epoch": int(epoch), **meta},
+        # timing-ok: dispatch anchor for the overlap window, not a
+        # measured jitted interval (collect_overlapped measures the wait)
+        token=time.perf_counter(),
+        tracer=trace.current_tracer(),
+    )
+
+
+def collect_overlapped(pending: PendingDispatch, name: str = "mi_bounds"):
+    """Block on an overlapped dispatch and account for it honestly: one
+    span on the dispatch-time tracer with ``overlapped=True``,
+    ``seconds`` = the EXPOSED wait this collection actually paid, and
+    ``queued_s`` = the dispatch→ready window (docs/observability.md,
+    overlap accounting). Returns the fetched outputs."""
+    from dib_tpu.telemetry import trace
+
+    # timing-ok: blocked-wait across an explicit fetch (the overlap
+    # accounting contract; the span below carries the interval)
+    t0 = time.perf_counter()
+    fetched = pending.collect()
+    now = time.perf_counter()   # timing-ok: end of the blocked wait
+    tracer = (pending.tracer if pending.tracer is not None
+              else trace.current_tracer())
+    fields = {"overlapped": True, "epoch": int(pending.meta.get("epoch", 0))}
+    if isinstance(pending.token, (int, float)):
+        fields["queued_s"] = round(now - pending.token, 4)
+    tracer.add(name, now - t0, **fields)
+    return fetched

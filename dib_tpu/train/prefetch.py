@@ -1,0 +1,55 @@
+"""Double-buffered host→device input staging.
+
+For data that lives RESIDENT on device (every DIB trainer's training set)
+the prefetch problem is solved inside the jitted chunk program
+(``train/loop.py`` pre-stages the next epoch's permutation gather during
+the current epoch's step scan). This module covers the other half: inputs
+that stream from HOST memory — long trajectories symbolized in chunks
+(``train/measurement.py``), or any workload whose dataset exceeds HBM.
+
+:class:`HostStager` issues the ``jax.device_put`` of item ``i+1`` BEFORE
+yielding item ``i``, so the (async) host→device transfer of the next chunk
+overlaps the consumer's compute on the current one — classic double
+buffering, at most two staged buffers live at a time.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Sequence
+
+import jax
+
+__all__ = ["HostStager"]
+
+
+class HostStager:
+    """Iterate host arrays as device arrays, transferring one item ahead.
+
+    ``device=None`` uses the default device. The sequence is indexed, not
+    consumed lazily, so ``len(items)`` buffers are never staged at once —
+    only the current and the next.
+    """
+
+    def __init__(self, items: Sequence, device=None):
+        self._items = items
+        self._device = device
+
+    def _put(self, x):
+        return (jax.device_put(x, self._device) if self._device is not None
+                else jax.device_put(x))
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def __iter__(self) -> Iterator:
+        if not len(self._items):
+            return
+        nxt = self._put(self._items[0])
+        for i in range(len(self._items)):
+            cur = nxt
+            if i + 1 < len(self._items):
+                # stage the NEXT chunk before the consumer blocks on the
+                # current one — device_put is async, so the transfer rides
+                # under the consumer's compute
+                nxt = self._put(self._items[i + 1])
+            yield cur

@@ -176,6 +176,15 @@ class BooleanTrainer:
         return BooleanTrainState(params, opt_state, step), stats
 
     @partial(jax.jit, static_argnames=("self",))
+    def _channel_mi_from_params(self, params, key: Array):
+        """The jitted core of :meth:`channel_mi_bounds`, taking bare params
+        so the overlapped fit loop can dispatch it on a donation-decoupled
+        snapshot (``dib_tpu.train.overlap.snapshot_params``)."""
+        _, aux = self.model.apply(params, self._x, key, sample=False)
+        mus, logvars = aux["mus"], aux["logvars"]                # [F, B, d]
+        keys = jax.random.split(key, mus.shape[0])
+        return jax.vmap(mi_sandwich_from_params)(keys, mus, logvars)
+
     def channel_mi_bounds(self, state: BooleanTrainState, key: Array):
         """Sandwich bounds (nats) for ALL channels on the full truth table.
 
@@ -185,10 +194,7 @@ class BooleanTrainer:
         full-table batch is the exact analogue of the reference's
         batch-of-the-table evaluation.
         """
-        _, aux = self.model.apply(state.params, self._x, key, sample=False)
-        mus, logvars = aux["mus"], aux["logvars"]                # [F, B, d]
-        keys = jax.random.split(key, mus.shape[0])
-        return jax.vmap(mi_sandwich_from_params)(keys, mus, logvars)
+        return self._channel_mi_from_params(state.params, key)
 
     @partial(jax.jit, static_argnames=("self",))
     def full_table_eval(self, state: BooleanTrainState, key: Array):
@@ -239,63 +245,106 @@ class BooleanTrainer:
 
     def _fit_loop(self, key, state, recorder, telemetry, series, checks):
         """The chunked measurement loop of :meth:`fit` (factored so the
-        heartbeat context wraps exactly the in-flight portion)."""
+        heartbeat context wraps exactly the in-flight portion).
+
+        The MI measurement is OVERLAPPED (docs/performance.md): it is
+        dispatched at its boundary on a donation-decoupled params snapshot
+        and collected at the NEXT boundary, so it rides the async queue
+        under the following chunk's device work instead of serializing the
+        boundary. Numerics are untouched — the measurement still sees
+        exactly the post-chunk parameters (the snapshot is an on-device
+        copy) and the same keys, so histories are bit-identical to the
+        serial schedule."""
+        from dib_tpu.telemetry import trace
+        from dib_tpu.train.overlap import (
+            PendingDispatch,
+            begin_overlapped,
+            snapshot_params,
+        )
+
         cfg = self.config
         first = True
         step = int(state.step)   # one-off pre-loop fetch; tracked on host
-        while step < cfg.num_steps:
-            chunk = min(cfg.mi_cadence, cfg.num_steps - step)
-            key, k_chunk, k_mi = jax.random.split(key, 3)
-            if telemetry is not None and first:
-                # FLOPs/bytes of both compiled programs (the O(n^2) MI
-                # kernel is the one the roofline section is after). The
-                # probes get DERIVED keys: lowering only needs the
-                # signature, and reusing k_chunk/k_mi would alias the keys
-                # the real calls below consume.
-                recorder.record_compile(
-                    "run_chunk", type(self).run_chunk,
-                    self, state, jax.random.fold_in(k_chunk, 0), chunk,
-                    epochs=chunk,
-                )
-                recorder.record_compile(
-                    "channel_mi_bounds", type(self).channel_mi_bounds,
-                    self, state, jax.random.fold_in(k_mi, 0),
-                )
-                first = False
-            with recorder.chunk_phase() as ph:
-                state, stats = self.run_chunk(state, k_chunk, chunk)
-                ph.block_on(state.params)
-            with recorder.span("mi_bounds") as sp:
-                lower, upper = self.channel_mi_bounds(state, k_mi)
-                sp.block_on((lower, upper))
-            # ONE blocking boundary fetch — every host-side read below
-            # comes out of this transfer (the blocking-fetch idiom the
-            # host-sync lint pass enforces, docs/static-analysis.md)
-            fetched = jax.device_get({
-                "stats": stats, "lower": lower, "upper": upper,
-                "step": state.step,
-            })
-            stats_h = fetched["stats"]
-            step = int(fetched["step"])
-            for name in series:
-                series[name].append(np.asarray(stats_h[name]))
-            checks["step"].append(step)
-            checks["beta"].append(float(stats_h["beta"][-1]))
-            checks["lower_bits"].append(np.asarray(fetched["lower"]) / LN2)
-            checks["upper_bits"].append(np.asarray(fetched["upper"]) / LN2)
-            if telemetry is not None:
-                recorder.record_chunk(
-                    epoch=step, chunk_epochs=chunk,
-                    beta=float(stats_h["beta"][-1]),
-                    loss=float(np.asarray(stats_h["task"])[-1]),
-                    kl_per_feature=[float(x) for x in np.asarray(stats_h["kl"])[-1]],
-                )
-                telemetry.mi_bounds(
-                    epoch=step,
-                    lower_bits=[float(x) for x in checks["lower_bits"][-1]],
-                    upper_bits=[float(x) for x in checks["upper_bits"][-1]],
-                )
+        pending: PendingDispatch | None = None
+        # the recorder's tracer is bound for the loop so the overlapped
+        # spans (emitted at collection) land on this run's stream —
+        # begin_overlapped also CAPTURES it, so the final post-loop
+        # collection still emits; no-op (fallback tracer) when telemetry
+        # is off
+        with trace.use_tracer(recorder.tracer):
+            while step < cfg.num_steps:
+                chunk = min(cfg.mi_cadence, cfg.num_steps - step)
+                key, k_chunk, k_mi = jax.random.split(key, 3)
+                if telemetry is not None and first:
+                    # FLOPs/bytes of both compiled programs (the O(n^2) MI
+                    # kernel is the one the roofline section is after). The
+                    # probes get DERIVED keys: lowering only needs the
+                    # signature, and reusing k_chunk/k_mi would alias the
+                    # keys the real calls below consume.
+                    recorder.record_compile(
+                        "run_chunk", type(self).run_chunk,
+                        self, state, jax.random.fold_in(k_chunk, 0), chunk,
+                        epochs=chunk,
+                    )
+                    recorder.record_compile(
+                        "channel_mi_bounds",
+                        type(self)._channel_mi_from_params,
+                        self, state.params, jax.random.fold_in(k_mi, 0),
+                    )
+                    first = False
+                with recorder.chunk_phase() as ph:
+                    state, stats = self.run_chunk(state, k_chunk, chunk)
+                    ph.block_on(state.params)
+                # the PREVIOUS boundary's measurement overlapped this
+                # chunk; by the time the chunk above has blocked, it is
+                # (almost always) done — collect with ~zero exposed wait
+                if pending is not None:
+                    self._collect_mi(pending, telemetry, checks)
+                    pending = None
+                step += chunk    # chunk sizes are deterministic: host side
+                # dispatch THIS boundary's measurement on a snapshot: the
+                # next run_chunk donates `state`, so the measurement must
+                # not read the live buffers (dib-lint donation-safety)
+                snap = snapshot_params(state.params)
+                lower, upper = self._channel_mi_from_params(snap, k_mi)
+                pending = begin_overlapped(
+                    {"lower": lower, "upper": upper}, epoch=step)
+                # ONE blocking boundary fetch for the chunk's own signal —
+                # every host-side read below comes out of this transfer
+                # (the blocking-fetch idiom, docs/static-analysis.md)
+                stats_h = jax.device_get(stats)
+                for name in series:
+                    series[name].append(np.asarray(stats_h[name]))
+                if telemetry is not None:
+                    recorder.record_chunk(
+                        epoch=step, chunk_epochs=chunk,
+                        beta=float(stats_h["beta"][-1]),
+                        loss=float(np.asarray(stats_h["task"])[-1]),
+                        kl_per_feature=[
+                            float(x) for x in np.asarray(stats_h["kl"])[-1]],
+                    )
+                checks["beta"].append(float(stats_h["beta"][-1]))
+        if pending is not None:
+            self._collect_mi(pending, telemetry, checks)
         return state, series, checks
+
+    def _collect_mi(self, pending, telemetry, checks) -> None:
+        """File one overlapped MI measurement: block + account the
+        exposed wait (``collect_overlapped``'s span), record the check
+        row, emit the ``mi_bounds`` event at the step it MEASURED."""
+        from dib_tpu.train.overlap import collect_overlapped
+
+        fetched = collect_overlapped(pending)
+        step = pending.meta["epoch"]
+        checks["step"].append(step)
+        checks["lower_bits"].append(np.asarray(fetched["lower"]) / LN2)
+        checks["upper_bits"].append(np.asarray(fetched["upper"]) / LN2)
+        if telemetry is not None:
+            telemetry.mi_bounds(
+                epoch=step,
+                lower_bits=[float(x) for x in checks["lower_bits"][-1]],
+                upper_bits=[float(x) for x in checks["upper_bits"][-1]],
+            )
 
 
 # --------------------------------------------------------------------------

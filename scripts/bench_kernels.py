@@ -1,0 +1,232 @@
+"""Shape-swept microbench of the MI-sandwich density kernels.
+
+Compares, per shape, the implementations behind
+``dib_tpu.ops.info_bounds``'s sandwich bounds:
+
+  - ``xla_full``    materialize the [N, M] log-density matrix, reduce it
+                    (the historical path)
+  - ``xla_blocked`` stream row blocks through ``lax.map``, keep only the
+                    three per-row reductions (the non-TPU fallback)
+  - ``pallas_mat``  the tiled Pallas density kernel, matrix still
+                    materialized, reductions outside
+  - ``fused``       the one-pass Pallas MI-sandwich kernel
+                    (``mi_row_stats_pallas``) — no matrix anywhere
+
+over square [B, B] shapes (diagonal semantics, incl. the LOO
+off-diagonal reduction) and asymmetric [M, N] probe shapes, INCLUDING
+non-tile-divisible sizes (the padding/masking paths). Every row carries a
+fused-vs-xla parity check, so the committed record doubles as
+interpreter-mode validation evidence (`PALLAS_TPU_VALIDATION`-style; see
+also scripts/tpu_validate_pallas.py for the on-hardware run).
+
+Emits ONE bench-shaped JSON line (metric/value/unit) with per-shape rows,
+validated per-row by ``scripts/check_run_artifacts.py``. On non-TPU
+backends the Pallas variants run in INTERPRETER mode — orders of
+magnitude slower than compiled, so the committed CPU record's speedups
+answer "is the kernel correct and the harness honest", not "how fast is
+the TPU" (the ``interpret`` field says which reading applies).
+
+    python scripts/bench_kernels.py --out BENCH_KERNELS.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+METRIC = "mi_kernel_bench"
+
+# (kind, rows, cols, d): rows==cols for 'square'; ragged sizes exercise
+# the padding/masking paths (satellite requirement: non-tile-divisible)
+CPU_SHAPES = (
+    ("square", 128, 128, 8),
+    ("square", 192, 192, 8),     # not divisible by the 128 tile
+    ("square", 256, 256, 16),
+    ("probe", 96, 200, 8),       # ragged both axes
+)
+TPU_SHAPES = (
+    ("square", 512, 512, 16),
+    ("square", 1024, 1024, 32),
+    ("square", 1000, 1000, 32),  # not divisible by the 128 tile
+    ("square", 4096, 4096, 32),
+    ("probe", 1000, 4096, 32),
+)
+
+
+def _honor_platform_env() -> None:
+    import jax
+
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        jax.config.update("jax_platforms", plat)
+
+
+def make_variants(kind: str, interpret: bool):
+    """{name: jitted (u, mus, logvars) -> outputs} for one shape kind."""
+    import jax
+    import jax.numpy as jnp
+    from jax.scipy.special import logsumexp
+
+    from dib_tpu.ops.gaussian import gaussian_log_density_mat
+    from dib_tpu.ops.pallas_density import (
+        gaussian_log_density_mat_pallas,
+        mi_row_stats_pallas,
+    )
+
+    neg_inf = -1e30
+
+    def reduce_square(log_p):
+        n = log_p.shape[0]
+        diag = jnp.diagonal(log_p)
+        lse_full = logsumexp(log_p, axis=1)
+        lse_off = logsumexp(
+            jnp.where(jnp.eye(n, dtype=bool), neg_inf, log_p), axis=1)
+        return diag, lse_full, lse_off
+
+    if kind == "square":
+
+        def xla_full(u, mus, lvs):
+            return reduce_square(gaussian_log_density_mat(u, mus, lvs))
+
+        def xla_blocked(u, mus, lvs):
+            # the dispatch-free spelling: _mi_row_stats would route to the
+            # fused Pallas kernel on TPU and this row would time
+            # fused-vs-fused
+            from dib_tpu.ops.info_bounds import _mi_row_stats_xla
+
+            return _mi_row_stats_xla(u, mus, lvs, row_block=128)
+
+        def pallas_mat(u, mus, lvs):
+            return reduce_square(gaussian_log_density_mat_pallas(
+                u, mus, lvs, interpret=interpret))
+
+        def fused(u, mus, lvs):
+            return mi_row_stats_pallas(u, mus, lvs, interpret=interpret)
+
+        return {"xla_full": xla_full, "xla_blocked": xla_blocked,
+                "pallas_mat": pallas_mat, "fused": fused}
+
+    def xla_full_probe(u, mus, lvs):
+        return logsumexp(gaussian_log_density_mat(u, mus, lvs), axis=1)
+
+    def pallas_mat_probe(u, mus, lvs):
+        return logsumexp(gaussian_log_density_mat_pallas(
+            u, mus, lvs, interpret=interpret), axis=1)
+
+    def fused_probe(u, mus, lvs):
+        return mi_row_stats_pallas(
+            u, mus, lvs, interpret=interpret, diagonal=False)[1]
+
+    return {"xla_full": xla_full_probe, "pallas_mat": pallas_mat_probe,
+            "fused": fused_probe}
+
+
+def time_variant(fn, args, reps: int) -> float:
+    """Best-of-``reps`` blocked wall-clock (after a warmup/compile call)."""
+    import jax
+
+    jax.block_until_ready(fn(*args))       # compile + warm
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def bench_shape(kind: str, rows: int, cols: int, d: int, reps: int,
+                interpret: bool, rng) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    u = jnp.asarray(rng.normal(scale=2.0, size=(rows, d)), jnp.float32)
+    mus = jnp.asarray(rng.normal(scale=2.0, size=(cols, d)), jnp.float32)
+    lvs = jnp.asarray(rng.normal(scale=0.7, size=(cols, d)) - 1.0,
+                      jnp.float32)
+    variants = make_variants(kind, interpret)
+    jitted = {name: jax.jit(fn) for name, fn in variants.items()}
+    seconds = {name: time_variant(fn, (u, mus, lvs), reps)
+               for name, fn in jitted.items()}
+    # parity: fused vs the materialize-and-reduce oracle
+    want = jax.device_get(jitted["xla_full"](u, mus, lvs))
+    got = jax.device_get(jitted["fused"](u, mus, lvs))
+    err = max(float(np.max(np.abs(np.asarray(g) - np.asarray(w))))
+              for g, w in zip(jax.tree.leaves(got), jax.tree.leaves(want)))
+    ok = all(
+        np.allclose(np.asarray(g), np.asarray(w), rtol=2e-4, atol=2e-4)
+        for g, w in zip(jax.tree.leaves(got), jax.tree.leaves(want))
+    )
+    row = {
+        "kind": kind, "rows": rows, "cols": cols, "d": d,
+        "tile_divisible": rows % 128 == 0 and cols % 128 == 0,
+        "variants": {name: {"seconds": round(s, 6)}
+                     for name, s in seconds.items()},
+        "parity": {"max_abs_err": err, "ok": bool(ok)},
+    }
+    if seconds.get("fused"):
+        row["speedup_fused_vs_xla_full"] = round(
+            seconds["xla_full"] / seconds["fused"], 4)
+    return row
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(
+        description="MI-sandwich kernel microbench (docs/performance.md).")
+    parser.add_argument("--reps", type=int, default=3)
+    parser.add_argument("--out", default=None,
+                        help="Also write the JSON record to this path.")
+    parser.add_argument("--tpu-shapes", action="store_true",
+                        help="Force the large TPU shape sweep.")
+    args = parser.parse_args()
+    _honor_platform_env()
+    import jax
+    import numpy as np
+
+    device = jax.devices()[0]
+    interpret = device.platform != "tpu"
+    shapes = TPU_SHAPES if (args.tpu_shapes or not interpret) else CPU_SHAPES
+    rng = np.random.default_rng(0)
+    rows = [bench_shape(kind, r, c, d, args.reps, interpret, rng)
+            for kind, r, c, d in shapes]
+    headline = next(
+        (row.get("speedup_fused_vs_xla_full")
+         for row in reversed(rows) if row["kind"] == "square"), None)
+    record = {
+        "metric": METRIC,
+        "value": headline,
+        "unit": "x_speedup",
+        "detail": "fused one-pass kernel vs materialize-and-reduce XLA at "
+                  "the largest square shape; Pallas variants run "
+                  "INTERPRETED off-TPU (correctness evidence, not speed)",
+        "device_kind": device.device_kind,
+        "device_platform": device.platform,
+        "interpret": interpret,
+        "reps": args.reps,
+        "rows": rows,
+        "all_parity_ok": all(r["parity"]["ok"] for r in rows),
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    line = json.dumps(record)
+    print(line, flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    # fleet registry: only under an explicit root (ad-hoc runs must not
+    # grow the committed index) — same contract as the drill scripts
+    root = os.environ.get("DIB_RUNS_ROOT")
+    if root:
+        from dib_tpu.telemetry.registry import RunRegistry, bench_entry
+
+        RunRegistry(root).append(bench_entry(record))
+    return 0 if record["all_parity_ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

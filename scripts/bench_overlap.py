@@ -1,0 +1,108 @@
+"""Measure the overlapped-MI-measurement pipeline on a real telemetry run.
+
+Runs the boolean workload's chunked fit (the inline overlap site:
+``BooleanTrainer._fit_loop`` dispatches each boundary's channel-MI
+measurement on a params snapshot and collects it at the next boundary)
+with the event stream on, then reports the ``overlap`` rollup the stream
+carries: how much of the measurement's dispatch→ready window the
+boundaries actually waited for (``exposed_frac``), and the span-hotspots
+table showing ``mi_bounds`` charged only its exposed wait.
+
+Emits ONE bench-shaped JSON line (metric/value/unit; value =
+``exposed_frac``, lower is better — 1.0 would mean the measurement
+serializes its boundary again, which `telemetry compare` gates via
+``overlap_exposed_frac``). Honest-scope note: on CPU this evidences the
+MECHANISM (spans, rollup, bit-identical numerics are pinned by
+tests/test_overlap.py); the north-star TPU MFU delta needs a hardware
+round (`python bench.py` + scripts/northstar_run.py, overlap on by
+default).
+
+    python scripts/bench_overlap.py --out BENCH_OVERLAP_CPU.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+METRIC = "boolean_mi_overlap"
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(
+        description="Overlapped-measurement evidence run "
+                    "(docs/performance.md).")
+    parser.add_argument("--steps", type=int, default=2000)
+    parser.add_argument("--mi-every", type=int, default=250)
+    parser.add_argument("--out", default=None)
+    args = parser.parse_args()
+
+    import jax
+
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        jax.config.update("jax_platforms", plat)
+
+    from dib_tpu.data import get_dataset
+    from dib_tpu.telemetry import EventWriter, runtime_manifest, summarize
+    from dib_tpu.workloads.boolean import BooleanTrainer, BooleanWorkloadConfig
+
+    bundle = get_dataset("boolean_circuit", number_inputs=10, seed=0)
+    config = BooleanWorkloadConfig(num_steps=args.steps,
+                                   mi_every=args.mi_every)
+    trainer = BooleanTrainer(bundle, config)
+    telemetry_dir = tempfile.mkdtemp(prefix="bench_overlap_")
+    writer = EventWriter(telemetry_dir)
+    writer.run_start(runtime_manifest(
+        config=config, extra={"bench": METRIC}))
+    t0 = time.time()
+    trainer.fit(jax.random.key(0), telemetry=writer)
+    wall_s = time.time() - t0
+    writer.run_end(status="ok")
+    writer.close()
+    summary = summarize(telemetry_dir, run_id=writer.run_id)
+    overlap = summary.get("overlap") or {}
+    record = {
+        "metric": METRIC,
+        "value": overlap.get("exposed_frac"),
+        "unit": "exposed_frac",
+        "detail": "fraction of the MI measurements' dispatch→ready window "
+                  "the chunk boundaries actually blocked on (1.0 = the "
+                  "measurement serializes boundaries; gated by `telemetry "
+                  "compare` overlap_exposed_frac)",
+        "num_steps": args.steps,
+        "mi_checkpoints": summary.get("mi_checkpoints"),
+        "wall_clock_s": round(wall_s, 2),
+        "steps_per_s": summary.get("steps_per_s"),
+        "overlap": overlap,
+        "span_hotspots": summary.get("span_hotspots"),
+        "device_kind": summary.get("device_kind"),
+        "device_platform": summary.get("device_platform"),
+        "telemetry": summary,
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    line = json.dumps(record)
+    print(line, flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+    root = os.environ.get("DIB_RUNS_ROOT")
+    if root:
+        from dib_tpu.telemetry.registry import RunRegistry, bench_entry
+
+        RunRegistry(root).append(bench_entry(record))
+    import shutil
+
+    shutil.rmtree(telemetry_dir, ignore_errors=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -164,6 +164,58 @@ def _check_chaos_sched_matrix(record: dict, problems: list[str]) -> None:
                 problems.append(f"{name}: {invariant!r} must be true")
 
 
+def _check_kernel_bench(record: dict, problems: list[str]) -> None:
+    """mi_kernel_bench-specific schema (scripts/bench_kernels.py): every
+    row carries typed shape/variant/parity fields, every parity check
+    passed, and the sweep includes at least one NON-tile-divisible shape
+    (the padding/masking paths are the ones that silently break)."""
+    rows = record.get("rows")
+    if not isinstance(rows, list) or not rows:
+        problems.append("'rows' must be a non-empty list of shape records")
+        return
+    ragged_seen = False
+    for i, row in enumerate(rows):
+        if not isinstance(row, dict):
+            problems.append(f"rows[{i}] must be an object")
+            continue
+        if row.get("kind") not in ("square", "probe"):
+            problems.append(f"rows[{i}]: 'kind' must be square|probe")
+        for key in ("rows", "cols", "d"):
+            v = row.get(key)
+            if not isinstance(v, int) or isinstance(v, bool) or v <= 0:
+                problems.append(f"rows[{i}]: {key!r} must be a positive int")
+        if not isinstance(row.get("tile_divisible"), bool):
+            problems.append(f"rows[{i}]: 'tile_divisible' must be a bool")
+        elif not row["tile_divisible"]:
+            ragged_seen = True
+        variants = row.get("variants")
+        if not isinstance(variants, dict) or not variants:
+            problems.append(f"rows[{i}]: 'variants' must be a non-empty "
+                            "object")
+        else:
+            for name, entry in variants.items():
+                if not (isinstance(entry, dict)
+                        and _is_finite_number(entry.get("seconds"))
+                        and entry["seconds"] > 0):
+                    problems.append(
+                        f"rows[{i}]: variant {name!r} needs a positive "
+                        "finite 'seconds'")
+        parity = row.get("parity")
+        if not (isinstance(parity, dict)
+                and _is_finite_number(parity.get("max_abs_err"))
+                and isinstance(parity.get("ok"), bool)):
+            problems.append(f"rows[{i}]: 'parity' needs finite "
+                            "'max_abs_err' + bool 'ok'")
+        elif parity["ok"] is not True:
+            problems.append(f"rows[{i}]: parity check FAILED "
+                            f"(max_abs_err={parity['max_abs_err']})")
+    if not ragged_seen:
+        problems.append("no non-tile-divisible shape in the sweep — the "
+                        "padding/masking paths are unvalidated")
+    if record.get("all_parity_ok") is not True:
+        problems.append("'all_parity_ok' must be true on a committed record")
+
+
 def _reject_constant(name: str):
     raise ValueError(f"non-finite JSON constant {name!r}")
 
@@ -216,6 +268,8 @@ def check_record(record: dict, problems: list[str]) -> None:
             _check_fault_drill_matrix(record, problems)
         if record.get("metric") == "chaos_sched_matrix":
             _check_chaos_sched_matrix(record, problems)
+        if record.get("metric") == "mi_kernel_bench":
+            _check_kernel_bench(record, problems)
     elif {"cmd", "rc"} <= set(record):
         # ---- driver capture
         if not isinstance(record["cmd"], str):

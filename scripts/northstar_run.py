@@ -132,6 +132,12 @@ def main() -> int:
     parser.add_argument("--report", default="NORTHSTAR_RUN.json")
     parser.add_argument("--no-render", action="store_true",
                         help="skip post-run PNG rasterization")
+    parser.add_argument("--no-overlap", action="store_true",
+                        help="serialize the MI-bound measurement at each "
+                             "beta checkpoint instead of overlapping it "
+                             "with the next training chunk (A/B knob; "
+                             "overlap is the default — "
+                             "docs/performance.md)")
     parser.add_argument("--compile-cache", default="",
                         help="persistent XLA compilation cache dir ('' = off; "
                              "compile_s in the report says which applied)")
@@ -217,10 +223,15 @@ def main() -> int:
 
     resuming = bool(args.checkpoint_dir)
     comp = SweepCompressionHook(args.outdir, features=(0,), resume=resuming)
+    # overlap (default): each checkpoint's measurement is dispatched on a
+    # params snapshot and collected at the NEXT checkpoint, riding the
+    # async queue under the following 1250-step chunk — the mi_bounds
+    # span stops serializing checkpoint boundaries (docs/performance.md)
     info = SweepInfoPerFeatureHook(
         config.mi_eval_batch_size, config.mi_eval_batches,
         persist=os.path.join(args.outdir, "mi_bounds") if resuming else None,
         telemetry=telemetry,
+        overlap=not args.no_overlap,
     )
 
     # Per-checkpoint chunk-vs-instrumentation wall clocks (round 4: the
@@ -244,7 +255,11 @@ def main() -> int:
 
     hooks = [phases.pre,
              SpannedHook("compression_pull", comp),
-             SpannedHook("mi_bounds", info),
+             # overlapped measurement emits its OWN `mi_bounds` spans
+             # (overlapped=true, exposed-wait seconds) at collection time;
+             # wrapping the dispatch in a second same-named span would
+             # double-count the boundary
+             (SpannedHook("mi_bounds", info) if args.no_overlap else info),
              phases.post]
     if args.heartbeat:
         from dib_tpu.train.watchdog import HeartbeatHook

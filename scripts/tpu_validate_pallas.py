@@ -121,6 +121,44 @@ def main() -> int:
                                        rtol=1e-4, atol=1e-4)
             checks[f"density_b{b}_d{d}"] = "ok"
 
+        # ---- fused one-pass MI-sandwich row stats vs materialize+reduce
+        # (incl. a non-tile-divisible shape: padding/masking lowering) ----
+        from dib_tpu.ops.pallas_density import mi_row_stats_pallas
+
+        for b, d in [(256, 8), (1000, 32), (4096, 32)]:
+            u = jnp.asarray(rng.standard_normal((b, d)), jnp.float32)
+            mus = jnp.asarray(rng.standard_normal((b, d)), jnp.float32)
+            lvs = jnp.asarray(rng.standard_normal((b, d)) * 0.3, jnp.float32)
+            log_p = gaussian_log_density_mat(u, mus, lvs)
+            want_diag = jnp.diagonal(log_p)
+            want_full = jax.scipy.special.logsumexp(log_p, axis=1)
+            want_off = jax.scipy.special.logsumexp(
+                jnp.where(jnp.eye(b, dtype=bool), -1e30, log_p), axis=1)
+            diag, full, off = mi_row_stats_pallas(u, mus, lvs,
+                                                  interpret=False)
+            np.testing.assert_allclose(np.asarray(diag),
+                                       np.asarray(want_diag),
+                                       rtol=2e-4, atol=2e-4)
+            np.testing.assert_allclose(np.asarray(full),
+                                       np.asarray(want_full),
+                                       rtol=2e-4, atol=2e-4)
+            np.testing.assert_allclose(np.asarray(off),
+                                       np.asarray(want_off),
+                                       rtol=2e-4, atol=2e-4)
+            checks[f"fused_row_stats_b{b}_d{d}"] = "ok"
+
+        # probe variant (no diagonal), ragged both axes
+        u = jnp.asarray(rng.standard_normal((1000, 16)), jnp.float32)
+        mus = jnp.asarray(rng.standard_normal((2050, 16)), jnp.float32)
+        lvs = jnp.asarray(rng.standard_normal((2050, 16)) * 0.3, jnp.float32)
+        want = jax.scipy.special.logsumexp(
+            gaussian_log_density_mat(u, mus, lvs), axis=1)
+        _, full, _ = mi_row_stats_pallas(u, mus, lvs, interpret=False,
+                                         diagonal=False)
+        np.testing.assert_allclose(np.asarray(full), np.asarray(want),
+                                   rtol=2e-4, atol=2e-4)
+        checks["fused_probe_m1000_n2050"] = "ok"
+
     commit = subprocess.run(
         ["git", "rev-parse", "--short", "HEAD"],
         capture_output=True, text=True,

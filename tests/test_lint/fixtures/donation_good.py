@@ -29,6 +29,9 @@ def good_save(manager, state, history, key, steps):
 
 
 def good_fetch_before(state, history, key):
-    last_loss = history["loss"]
+    # a REAL fetch (host copy) before the donating call — a bare
+    # `history["loss"]` alias would die with the donation (the
+    # overlap-alias shape, see overlap_alias_bad.py)
+    last_loss = jax.device_get(history["loss"])
     state, history = run_chunk(state, history, key, 8)
     return state, history, last_loss

@@ -1,0 +1,24 @@
+"""Fixture: overlapped measurement dispatched on a bare ALIAS of donated
+params — the raw-speed-PR bug shape (docs/performance.md "Overlapped
+measurement"). `snap` is a view, not a copy: after `run_chunk` donates
+`state`, the measurement reads buffers XLA is already reusing."""
+
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, donate_argnames=("state", "history"))
+def run_chunk(state, history, key, num_epochs):
+    return state, history
+
+
+def measure(params, key):
+    return params, key
+
+
+def bad_overlap(state, history, key):
+    snap = state.params            # bare alias, NOT a copy
+    state, history = run_chunk(state, history, key, 8)
+    lower = measure(snap, key)     # BUG: snap aliases donated buffers
+    return state, history, lower

@@ -1,0 +1,27 @@
+"""Fixture: the blessed overlap idiom — a REAL on-device copy
+(`snapshot_params`) taken before the donating call decouples the
+measurement from the donation, and a fresh alias taken AFTER the rebind
+points at live buffers."""
+
+from functools import partial
+
+import jax
+
+from dib_tpu.train.overlap import snapshot_params
+
+
+@partial(jax.jit, donate_argnames=("state", "history"))
+def run_chunk(state, history, key, num_epochs):
+    return state, history
+
+
+def measure(params, key):
+    return params, key
+
+
+def good_overlap(state, history, key):
+    snap = snapshot_params(state.params)   # real copy: survives donation
+    state, history = run_chunk(state, history, key, 8)
+    lower = measure(snap, key)
+    fresh_view = state.params              # alias of the REBOUND state: live
+    return state, history, lower, fresh_view

@@ -43,6 +43,51 @@ def test_donation_good_idioms_are_clean(load_fixture):
     assert _findings(module, "donation-safety") == []
 
 
+def test_donation_flags_overlap_alias_shape(load_fixture):
+    """The raw-speed-PR bug shape: a bare alias (`snap = state.params`)
+    taken BEFORE the donating call, read (by an overlapped measurement)
+    after it — the buffers belong to the next chunk by then."""
+    module = load_fixture("overlap_alias_bad.py")
+    findings = _findings(module, "donation-safety")
+    assert len(findings) == 1
+    assert findings[0].line == line_of(module, "measure(snap, key)")
+    assert "alias" in findings[0].message
+    assert "snapshot_params" in findings[0].message
+
+
+def test_donation_overlap_snapshot_idiom_is_clean(load_fixture):
+    """`snapshot_params(state.params)` is a Call, not an alias — clean;
+    an alias taken AFTER the rebind points at live buffers — clean."""
+    module = load_fixture("overlap_snapshot_good.py")
+    assert _findings(module, "donation-safety") == []
+
+
+def test_donation_alias_orphaned_by_root_rebind_is_clean(tmp_path):
+    """Review regression: a NON-donating rebind of the root orphans the
+    alias (it views the previous, never-donated tree) — a later donation
+    of the NEW binding must not flag reads of it."""
+    from dib_tpu.analysis.core import load_module
+
+    src = (
+        "from functools import partial\n"
+        "import jax\n"
+        "@partial(jax.jit, donate_argnames=('state',))\n"
+        "def run_chunk(state, key):\n"
+        "    return state\n"
+        "def g(state):\n"
+        "    return state\n"
+        "def f(state, key):\n"
+        "    snap = state.params\n"
+        "    state = g(state)\n"          # non-donating rebind: snap views
+        "    state = run_chunk(state, key)\n"   # the OLD tree, not this one
+        "    return snap, state\n"
+    )
+    path = tmp_path / "snippet.py"
+    path.write_text(src)
+    module = load_module(str(path), "snippet.py")
+    assert _findings(module, "donation-safety") == []
+
+
 def test_donation_pragma_suppresses(tmp_path):
     from dib_tpu.analysis.core import load_module
 
@@ -126,11 +171,16 @@ def test_host_sync_targets_only_chunk_loop_modules():
     from dib_tpu.analysis.core import get_pass
 
     host = get_pass("host-sync")
-    # the three fit chunk loops plus the scheduler's hot modules (the
-    # worker pool runs MANY units' chunk loops concurrently — a hidden
-    # blocking fetch there serializes the whole pool)
+    # the fit chunk loops, the scheduler's hot modules (the worker pool
+    # runs MANY units' chunk loops concurrently — a hidden blocking fetch
+    # there serializes the whole pool), and the overlap/prefetch plumbing
+    # (an implicit sync there re-serializes the boundary it exists to
+    # hide)
     assert set(host.target_modules) == {
         "dib_tpu/train/loop.py",
+        "dib_tpu/train/measurement.py",
+        "dib_tpu/train/overlap.py",
+        "dib_tpu/train/prefetch.py",
         "dib_tpu/parallel/sweep.py",
         "dib_tpu/workloads/boolean.py",
         "dib_tpu/sched/runner.py",

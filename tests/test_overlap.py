@@ -1,0 +1,383 @@
+"""Overlapped-measurement + prefetch pipeline tests (docs/performance.md).
+
+The overlap contract is BIT-IDENTITY: dispatching a measurement on a
+donation-decoupled snapshot and collecting it a boundary later must
+produce exactly the values the serial schedule produces — overlap is a
+scheduling change, never a numerics change. These tests pin that for
+every overlapped site (boolean fit loop, measurement trainer's
+speculative pipeline, serial + sweep MI hooks), the prefetching epoch
+pipeline, the host-staging double buffer, and the telemetry accounting
+(`overlap` rollup + the compare gate).
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dib_tpu.train.overlap import PendingDispatch, snapshot_params
+from dib_tpu.train.prefetch import HostStager
+
+
+# ------------------------------------------------------------ primitives
+def test_snapshot_params_is_a_real_copy():
+    tree = {"w": jnp.arange(8.0), "b": {"x": jnp.ones((3, 2))}}
+    snap = snapshot_params(tree)
+    for a, b in zip(jax.tree.leaves(tree), jax.tree.leaves(snap)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert a is not b
+        # distinct device buffers: donation of the original cannot touch
+        # the snapshot
+        assert (a.unsafe_buffer_pointer() != b.unsafe_buffer_pointer())
+
+
+def test_snapshot_survives_donation_of_source():
+    donating_fn = jax.jit(lambda t: jax.tree.map(lambda x: x * 2.0, t),
+                          donate_argnums=0)
+    tree = {"w": jnp.arange(8.0)}
+    snap = snapshot_params(tree)
+    out = donating_fn(tree)
+    jax.block_until_ready(out)
+    # the snapshot still reads the PRE-donation values
+    np.testing.assert_array_equal(np.asarray(snap["w"]), np.arange(8.0))
+
+
+def test_pending_dispatch_collects_device_outputs():
+    pending = PendingDispatch(outputs={"x": jnp.arange(4) * 3},
+                              meta={"epoch": 7})
+    fetched = pending.collect()
+    np.testing.assert_array_equal(fetched["x"], np.arange(4) * 3)
+    assert pending.meta["epoch"] == 7
+
+
+def test_collect_tolerates_hand_built_dispatch_without_token():
+    """Review regression: a PendingDispatch built directly (token=None)
+    must collect cleanly — the span just omits queued_s."""
+    from dib_tpu.train.overlap import collect_overlapped
+
+    pending = PendingDispatch(outputs={"x": jnp.arange(3)})
+    fetched = collect_overlapped(pending)
+    np.testing.assert_array_equal(fetched["x"], np.arange(3))
+
+
+def test_collect_after_tracer_context_still_emits_the_span(tmp_path):
+    """Review regression: the FINAL checkpoint's pending measurement is
+    flushed by a post-fit ``records`` read — after the fit's use_tracer
+    context has exited. The span must still land on the run's stream (it
+    is the one boundary that pays the full wait; dropping it biased
+    overlap_exposed_frac low), so the dispatch captures the tracer."""
+    from dib_tpu.telemetry import EventWriter, Tracer, use_tracer
+    from dib_tpu.train.overlap import begin_overlapped, collect_overlapped
+
+    writer = EventWriter(str(tmp_path))
+    tracer = Tracer(writer)
+    with use_tracer(tracer):
+        pending = begin_overlapped({"x": jnp.arange(3)}, epoch=5)
+    # tracer binding gone: a naive current_tracer() here would be the
+    # no-op fallback and the span would vanish
+    collect_overlapped(pending)
+    writer.close()
+    spans = [e for e in _read_events(tmp_path) if e.get("type") == "span"]
+    assert len(spans) == 1
+    assert spans[0]["name"] == "mi_bounds"
+    assert spans[0]["overlapped"] is True
+    assert spans[0]["epoch"] == 5
+    assert "queued_s" in spans[0]
+
+
+def test_host_stager_order_and_values():
+    items = [np.full((4,), i, np.float32) for i in range(5)]
+    staged = list(HostStager(items))
+    assert len(staged) == 5
+    for i, arr in enumerate(staged):
+        assert isinstance(arr, jax.Array)
+        np.testing.assert_array_equal(np.asarray(arr), items[i])
+    assert list(HostStager([])) == []
+
+
+# ------------------------------------------- boolean fit loop (inline site)
+def test_boolean_overlapped_fit_matches_serial_replay():
+    """The overlapped _fit_loop must reproduce, bit for bit, the history a
+    hand-rolled serial schedule (same key chain) produces."""
+    from dib_tpu.data import get_dataset
+    from dib_tpu.workloads.boolean import BooleanTrainer, BooleanWorkloadConfig
+
+    bundle = get_dataset("boolean_circuit", number_inputs=4, seed=0)
+    config = BooleanWorkloadConfig(num_steps=30, mi_every=10, batch_size=32,
+                                   integration_hidden=(16,))
+    trainer = BooleanTrainer(bundle, config)
+    state, history = trainer.fit(jax.random.key(0))
+
+    # serial replay of the exact same key schedule
+    key = jax.random.key(0)
+    key, k_init = jax.random.split(key)
+    s = trainer.init(k_init)
+    steps, lowers = [], []
+    step = 0
+    while step < config.num_steps:
+        chunk = min(config.mi_cadence, config.num_steps - step)
+        key, k_chunk, k_mi = jax.random.split(key, 3)
+        s, stats = trainer.run_chunk(s, k_chunk, chunk)
+        lower, upper = trainer.channel_mi_bounds(s, k_mi)
+        step += chunk
+        steps.append(step)
+        from dib_tpu.ops.entropy import LN2
+
+        lowers.append(np.asarray(lower) / LN2)
+    np.testing.assert_array_equal(history["mi_steps"], np.asarray(steps))
+    np.testing.assert_array_equal(history["mi_lower_bits"],
+                                  np.stack(lowers))
+    for a, b in zip(jax.tree.leaves(jax.device_get(state.params)),
+                    jax.tree.leaves(jax.device_get(s.params))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# -------------------------------------- measurement trainer (speculative)
+@pytest.fixture(scope="module")
+def measurement_setup():
+    from dib_tpu.models import MeasurementStack
+    from dib_tpu.train.measurement import make_state_windows
+
+    rng = np.random.default_rng(0)
+    windows = make_state_windows(rng.normal(size=(300,)).astype(np.float32), 3)
+    stack = MeasurementStack(ib_embedding_dim=2, alphabet_size=3,
+                             num_states=3, infonce_dim=4,
+                             encoder_hidden=(8,), vq_hidden=(8,),
+                             aggregator_hidden=(8,), reference_hidden=(8,))
+    return stack, windows
+
+
+@pytest.mark.parametrize("stop_bits", [1e9, -1.0])
+def test_measurement_overlap_is_bit_identical(measurement_setup, stop_bits):
+    """overlap=True (speculative next chunk + snapshot measurement) must
+    match the serial fit exactly: history, stop step, final state, AND the
+    published resume_key chain (a resumed run replays the speculated
+    chunk identically)."""
+    from dib_tpu.train.measurement import MeasurementConfig, MeasurementTrainer
+
+    stack, windows = measurement_setup
+    cfg = MeasurementConfig(batch_size=32, num_steps=30, check_every=10,
+                            mi_eval_batch_size=32, mi_eval_batches=1,
+                            mi_stop_bits=stop_bits)
+
+    def run(overlap):
+        t = MeasurementTrainer(stack, windows, cfg)
+        state, hist = t.fit(jax.random.key(0), overlap=overlap)
+        return jax.device_get(state), hist, t.resume_key
+
+    s_serial, h_serial, k_serial = run(False)
+    s_overlap, h_overlap, k_overlap = run(True)
+    assert h_serial["stopped_early"] == h_overlap["stopped_early"]
+    assert h_serial["mi_bounds"] == h_overlap["mi_bounds"]
+    for name in ("loss", "match", "kl", "beta"):
+        np.testing.assert_array_equal(h_serial[name], h_overlap[name])
+    for a, b in zip(jax.tree.leaves(s_serial), jax.tree.leaves(s_overlap)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_array_equal(
+        np.asarray(jax.random.key_data(k_serial)),
+        np.asarray(jax.random.key_data(k_overlap)))
+
+
+# ------------------------------------------------- MI hooks (serial+sweep)
+def _tiny_dib_trainer():
+    from dib_tpu.data import get_dataset
+    from dib_tpu.models import DistributedIBModel
+    from dib_tpu.train import DIBTrainer, TrainConfig
+
+    bundle = get_dataset("boolean_circuit", number_inputs=4, seed=1)
+    model = DistributedIBModel(
+        feature_dimensionalities=tuple(bundle.feature_dimensionalities),
+        encoder_hidden=(8,), integration_hidden=(16,),
+        output_dim=bundle.output_dimensionality, embedding_dim=2,
+        output_activation=bundle.output_activation,
+    )
+    config = TrainConfig(batch_size=16, num_pretraining_epochs=1,
+                         num_annealing_epochs=3, steps_per_epoch=2,
+                         max_val_points=16)
+    return DIBTrainer(model, bundle, config)
+
+
+def test_info_hook_overlap_matches_serial():
+    from dib_tpu.train.hooks import InfoPerFeatureHook
+
+    trainer = _tiny_dib_trainer()
+
+    def run(overlap):
+        hook = InfoPerFeatureHook(evaluation_batch_size=32,
+                                  number_evaluation_batches=1,
+                                  overlap=overlap)
+        trainer.fit(jax.random.key(0), hooks=[hook], hook_every=2)
+        return hook.records   # property: flushes the last pending
+
+    serial = run(False)
+    overlapped = run(True)
+    assert [r["epoch"] for r in serial] == [r["epoch"] for r in overlapped]
+    np.testing.assert_allclose(
+        np.asarray([r["bounds"] for r in serial]),
+        np.asarray([r["bounds"] for r in overlapped]), rtol=0, atol=0)
+
+
+@pytest.mark.slow
+def test_sweep_info_hook_overlap_matches_serial(tmp_path):
+    from dib_tpu.data import get_dataset
+    from dib_tpu.models import DistributedIBModel
+    from dib_tpu.parallel import BetaSweepTrainer
+    from dib_tpu.parallel.sweep_hooks import SweepInfoPerFeatureHook
+    from dib_tpu.train import TrainConfig
+
+    bundle = get_dataset("boolean_circuit", number_inputs=4, seed=1)
+    model = DistributedIBModel(
+        feature_dimensionalities=tuple(bundle.feature_dimensionalities),
+        encoder_hidden=(8,), integration_hidden=(16,),
+        output_dim=bundle.output_dimensionality, embedding_dim=2,
+        output_activation=bundle.output_activation,
+    )
+    config = TrainConfig(batch_size=16, num_pretraining_epochs=1,
+                         num_annealing_epochs=3, steps_per_epoch=2,
+                         max_val_points=16)
+
+    def run(overlap, persist):
+        sweep = BetaSweepTrainer(model, bundle, config, 1e-3, [0.5, 1.0])
+        hook = SweepInfoPerFeatureHook(
+            evaluation_batch_size=32, number_evaluation_batches=1,
+            overlap=overlap, persist=persist)
+        keys = jax.random.split(jax.random.key(0), 2)
+        sweep.fit(keys, hooks=[hook], hook_every=2)
+        return hook
+
+    serial = run(False, None)
+    overlapped = run(True, str(tmp_path / "mi"))
+    assert list(serial.epochs) == list(overlapped.epochs)
+    np.testing.assert_array_equal(
+        np.stack([r["bounds"] for r in serial.records]),
+        np.stack([r["bounds"] for r in overlapped.records]))
+    # the persist mirror carries the flushed trajectory too
+    mirrored = sorted(os.listdir(tmp_path / "mi"))
+    assert len(mirrored) == len(overlapped.records)
+
+
+# --------------------------------------------------- prefetch epoch pipeline
+def test_permutation_prefetch_is_bit_identical():
+    import dataclasses
+
+    trainer_on = _tiny_dib_trainer()
+    cfg = dataclasses.replace(trainer_on.config,
+                              batch_sampling="permutation",
+                              prefetch_epochs=True)
+    cfg_off = dataclasses.replace(cfg, prefetch_epochs=False)
+    from dib_tpu.train import DIBTrainer
+
+    def run(config):
+        t = DIBTrainer(trainer_on.model, trainer_on.bundle, config)
+        state, history = t.init(jax.random.key(0))
+        state, history = t.run_chunk(state, history, jax.random.key(1), 3)
+        return jax.device_get((state.params, history))
+
+    for a, b in zip(jax.tree.leaves(run(cfg)), jax.tree.leaves(run(cfg_off))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# --------------------------------------------------- telemetry accounting
+def test_summarize_overlap_rollup_and_compare_gate(tmp_path):
+    from dib_tpu.telemetry import EventWriter
+    from dib_tpu.telemetry.summary import compare, summarize
+
+    def write_run(directory, exposed):
+        writer = EventWriter(str(directory))
+        writer.run_start({"config_hash": "x"})
+        writer.chunk(epoch=1, steps=100, seconds=2.0)
+        writer.chunk(epoch=2, steps=100, seconds=2.0)
+        writer.span(name="mi_bounds", path="mi_bounds", span_id=1,
+                    parent_id=None, seconds=exposed, overlapped=True,
+                    queued_s=2.0)
+        writer.run_end(status="ok")
+        writer.close()
+
+    write_run(tmp_path / "a", exposed=0.1)
+    write_run(tmp_path / "b", exposed=1.8)
+    summary_a = summarize(str(tmp_path / "a"))
+    assert summary_a["overlap"]["spans"] == 1
+    assert summary_a["overlap"]["exposed_s"] == 0.1
+    assert summary_a["overlap"]["queued_s"] == 2.0
+    assert summary_a["overlap"]["hidden_s"] == 1.9
+    assert summary_a["overlap_exposed_frac"] == 0.05
+    summary_b = summarize(str(tmp_path / "b"))
+    # the candidate's measurement re-serialized its boundary: gated
+    report, regressed = compare(summary_a, summary_b)
+    assert regressed
+    assert report["fields"]["overlap_exposed_frac"]["regressed"]
+    # reverse direction (overlap improved) is not a regression
+    _, regressed_rev = compare(summary_b, summary_a)
+    assert not regressed_rev
+
+
+def test_overlapped_spans_land_on_the_boolean_stream(tmp_path):
+    """End-to-end: a telemetry-on boolean fit emits overlapped mi_bounds
+    spans and summarize rolls them up (the hotspots table no longer
+    charges the boundary for the measurement's device time)."""
+    from dib_tpu.data import get_dataset
+    from dib_tpu.telemetry import EventWriter
+    from dib_tpu.telemetry.summary import summarize
+    from dib_tpu.workloads.boolean import BooleanTrainer, BooleanWorkloadConfig
+
+    bundle = get_dataset("boolean_circuit", number_inputs=4, seed=0)
+    config = BooleanWorkloadConfig(num_steps=20, mi_every=10, batch_size=32,
+                                   integration_hidden=(16,))
+    trainer = BooleanTrainer(bundle, config)
+    writer = EventWriter(str(tmp_path))
+    from dib_tpu.telemetry import runtime_manifest
+
+    writer.run_start(runtime_manifest())
+    trainer.fit(jax.random.key(0), telemetry=writer)
+    writer.run_end(status="ok")
+    writer.close()
+    summary = summarize(str(tmp_path))
+    assert summary["overlap"]["spans"] == 2          # one per MI boundary
+    assert summary["overlap"]["queued_s"] >= summary["overlap"]["exposed_s"]
+    mi_spans = [e for e in _read_events(tmp_path)
+                if e.get("type") == "span" and e.get("name") == "mi_bounds"]
+    assert all(e.get("overlapped") for e in mi_spans)
+    assert all("queued_s" in e for e in mi_spans)
+    # mi_bounds events still land at the step they MEASURED
+    mi_events = [e for e in _read_events(tmp_path)
+                 if e.get("type") == "mi_bounds"]
+    assert [e["epoch"] for e in mi_events] == [10, 20]
+
+
+def _read_events(directory):
+    with open(os.path.join(str(directory), "events.jsonl")) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+# ----------------------------------------------------- bench staleness SLO
+def test_slo_check_gates_stale_bench_records(tmp_path):
+    from dib_tpu.telemetry.slo import check_run
+
+    slo = {
+        "slo_version": 1,
+        "rules": [{"name": "bench_cache_staleness_ceiling",
+                   "metric": "stale_seconds", "max": 86400.0,
+                   "severity": "warn"}],
+    }
+    slo_path = tmp_path / "SLO.json"
+    slo_path.write_text(json.dumps(slo))
+
+    def bench(stale):
+        record = {"metric": "m", "value": 1.0, "unit": "minutes",
+                  "degraded": "no_device"}
+        if stale is not None:
+            record["stale_seconds"] = stale
+        path = tmp_path / f"bench_{stale}.json"
+        path.write_text(json.dumps(record) + "\n")
+        return str(path)
+
+    fresh = check_run(bench(None), str(slo_path))
+    assert fresh["violations"] == 0          # no stale_seconds: skipped
+    ok = check_run(bench(3600), str(slo_path))
+    assert ok["violations"] == 0
+    stale = check_run(bench(200_000), str(slo_path))
+    assert stale["violations"] == 1
+    assert stale["rules"][0]["status"] == "violated"

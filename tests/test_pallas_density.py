@@ -74,3 +74,121 @@ def test_backend_dispatch_roundtrip(rng):
 def test_backend_rejects_unknown():
     with pytest.raises(ValueError):
         set_density_backend("cuda")
+
+
+# ----------------------------------------------------------------------
+# Fused one-pass MI-sandwich row statistics (interpreter-mode tier-1 gate:
+# CPU CI exercises the Pallas code path on every run)
+# ----------------------------------------------------------------------
+
+def _reference_stats(u, mus, logvars):
+    log_p = gaussian_log_density_mat(u, mus, logvars)
+    n = log_p.shape[0]
+    diag = jnp.diagonal(log_p)
+    lse_full = jax.scipy.special.logsumexp(log_p, axis=1)
+    lse_off = jax.scipy.special.logsumexp(
+        jnp.where(jnp.eye(n, dtype=bool), -1e30, log_p), axis=1)
+    return diag, lse_full, lse_off
+
+
+@pytest.mark.parametrize("n,d,bm,bn", [
+    (64, 8, 32, 32),       # exact tiling
+    (50, 12, 32, 32),      # ragged -> padding/masking path
+    (130, 16, 64, 32),     # ragged, different block shapes
+    (8, 4, 128, 128),      # single tile larger than the problem
+])
+def test_fused_row_stats_match_reduced_matrix(rng, n, d, bm, bn):
+    from dib_tpu.ops.pallas_density import mi_row_stats_pallas
+
+    u, mus, logvars = random_params(rng, n, n, d)
+    want = _reference_stats(u, mus, logvars)
+    got = mi_row_stats_pallas(u, mus, logvars, block_rows=bm, block_cols=bn,
+                              interpret=True)
+    for g, w in zip(got, want):
+        assert g.shape == (n,)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_fused_row_stats_probe_variant(rng):
+    """diagonal=False (the [M, N] probe map): only the full-row lse, no
+    own-density entry anywhere in the matrix."""
+    from dib_tpu.ops.pallas_density import mi_row_stats_pallas
+
+    u, mus, logvars = random_params(rng, 30, 70, 8)
+    want = jax.scipy.special.logsumexp(
+        gaussian_log_density_mat(u, mus, logvars), axis=1)
+    _, full, _ = mi_row_stats_pallas(u, mus, logvars, block_rows=16,
+                                     block_cols=32, interpret=True,
+                                     diagonal=False)
+    np.testing.assert_allclose(np.asarray(full), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_fused_row_stats_bfloat16_inputs(rng):
+    """bf16 channel params accumulate in f32 inside the kernel; parity vs
+    the f32-cast XLA reference at bf16-rounding tolerance."""
+    from dib_tpu.ops.pallas_density import mi_row_stats_pallas
+
+    u, mus, logvars = random_params(rng, 48, 48, 8)
+    u16 = u.astype(jnp.bfloat16)
+    m16 = mus.astype(jnp.bfloat16)
+    l16 = logvars.astype(jnp.bfloat16)
+    want = _reference_stats(u16.astype(jnp.float32),
+                            m16.astype(jnp.float32),
+                            l16.astype(jnp.float32))
+    got = mi_row_stats_pallas(u16, m16, l16, block_rows=32, block_cols=32,
+                              interpret=True)
+    for g, w in zip(got, want):
+        assert g.dtype == jnp.float32
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=2e-2, atol=2e-2)
+
+
+def test_fused_backend_sandwich_bounds_parity(rng):
+    """End-to-end through the jitted estimator: forcing 'pallas' routes
+    mi_sandwich_from_params through the FUSED one-pass kernel; the bounds
+    must match the XLA path — including the LOO reference semantics
+    (diagonal excluded from the logsumexp, denominator still /B)."""
+    u, mus, logvars = random_params(rng, 96, 96, 8)
+    key = jax.random.key(3)
+    want = mi_sandwich_from_params(key, mus, logvars)
+    want_blocked = mi_sandwich_from_params(key, mus, logvars, row_block=32)
+    # XLA row-blocked streaming path == unblocked path (rowwise reductions
+    # cannot see the blocking)
+    np.testing.assert_allclose(np.asarray(want), np.asarray(want_blocked),
+                               rtol=1e-6, atol=1e-6)
+    try:
+        set_density_backend("pallas")
+        got = mi_sandwich_from_params(key, mus, logvars)
+    finally:
+        set_density_backend("auto")
+    np.testing.assert_allclose(float(got[0]), float(want[0]), rtol=1e-5,
+                               atol=1e-5)
+    np.testing.assert_allclose(float(got[1]), float(want[1]), rtol=1e-5,
+                               atol=1e-5)
+    # LOO /B semantics: upper bound differs from a /(B-1) denominator by
+    # exactly log(B/(B-1)) — pin the fused path to the reference's /B
+    b = mus.shape[0]
+    assert abs(float(got[1] - want[1])) < 1e-4 * abs(float(want[1])) + 1e-5
+    assert float(want[1]) != pytest.approx(
+        float(want[1]) + np.log(b / (b - 1)), abs=1e-6)
+
+
+def test_fused_backend_probe_parity(rng):
+    """mi_sandwich_probe through the fused kernel (logaddexp own-density
+    fold-in) matches the XLA concatenate-and-logsumexp path."""
+    from dib_tpu.ops.info_bounds import mi_sandwich_probe
+
+    key = jax.random.key(5)
+    pm, dm, dl = random_params(rng, 40, 120, 8)
+    pl_ = jnp.asarray(
+        np.float32(np.random.default_rng(7).normal(size=(40, 8)) * 0.4 - 1.0))
+    want = mi_sandwich_probe(key, pm, pl_, dm, dl)
+    try:
+        set_density_backend("pallas")
+        got = mi_sandwich_probe(key, pm, pl_, dm, dl)
+    finally:
+        set_density_backend("auto")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
