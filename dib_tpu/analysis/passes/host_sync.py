@@ -64,7 +64,10 @@ class HostSyncPass(LintPass):
     # overlap/prefetch modules and the measurement trainer joined with the
     # raw-speed PR: an implicit sync in the overlap plumbing would
     # silently re-serialize exactly the boundary the overlap exists to
-    # hide.
+    # hide. The async serving modules joined with ISSUE 10: the serving
+    # hot path handles thousands of requests/s on one event loop plus the
+    # batcher threads, so an implicit device fetch there stalls EVERY
+    # in-flight request, not one chunk.
     target_modules = (
         "dib_tpu/train/loop.py",
         "dib_tpu/train/measurement.py",
@@ -75,6 +78,11 @@ class HostSyncPass(LintPass):
         "dib_tpu/sched/runner.py",
         "dib_tpu/sched/pool.py",
         "dib_tpu/sched/scheduler.py",
+        "dib_tpu/serve/engine.py",
+        "dib_tpu/serve/batcher.py",
+        "dib_tpu/serve/server.py",
+        "dib_tpu/serve/pool.py",
+        "dib_tpu/serve/zoo.py",
     )
 
     def check_module(self, module: Module) -> list[Finding]:

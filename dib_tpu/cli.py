@@ -946,6 +946,45 @@ def serve_parser() -> argparse.ArgumentParser:
     parser.add_argument("--num_devices", type=int, default=0,
                         help="Local devices to replicate over (0 = all; "
                              "ignored when serving a sweep).")
+    # Async serving engine knobs (docs/serving.md "The async front end")
+    parser.add_argument("--prefork", type=int, default=0,
+                        help="Spawn this many FULL server processes "
+                             "sharing one port via SO_REUSEPORT (the "
+                             "kernel load-balances connections; N event "
+                             "loops, N GILs). 0 = single process. The "
+                             "parent supervises and respawns dead "
+                             "workers.")
+    parser.add_argument("--reuse_port", action="store_true",
+                        help="Bind with SO_REUSEPORT (set automatically "
+                             "on prefork workers).")
+    parser.add_argument("--workers", type=int, default=0,
+                        help="Run this many replica engines in worker "
+                             "SUBPROCESSES behind the pipe request plane "
+                             "(0 = in-process replicas; ignored when "
+                             "serving a sweep). Escapes the GIL on CPU.")
+    parser.add_argument("--model_name", type=str, default="default",
+                        help="Zoo name this checkpoint serves under "
+                             "(clients select with {\"model\": name}).")
+    parser.add_argument("--quota_rps", type=float, default=0.0,
+                        help="Per-tenant token-bucket rate (requests/s); "
+                             "a tenant over budget gets 429 + Retry-After "
+                             "(0 disables quotas).")
+    parser.add_argument("--quota_burst", type=float, default=None,
+                        help="Per-tenant burst headroom (default: "
+                             "max(quota_rps, 1)).")
+    parser.add_argument("--admission_limit", type=int, default=0,
+                        help="Global bound on in-flight requests; beyond "
+                             "it requests shed with 503 + Retry-After "
+                             "(0 disables).")
+    parser.add_argument("--response_cache", type=int, default=0,
+                        help="Response-cache capacity (entries) for "
+                             "repeated (input, beta, checkpoint) queries "
+                             "(0 disables).")
+    parser.add_argument("--exec_cache", type=int, default=0,
+                        help="Capacity of the shared AOT-executable LRU; "
+                             "engines then compile lazily and cold "
+                             "(op, bucket) entries evict (0 = eager "
+                             "per-engine compilation).")
     parser.add_argument("--serve_seconds", type=float, default=0.0,
                         help="Auto-shutdown after this many seconds "
                              "(0 = run until SIGINT/SIGTERM).")
@@ -957,6 +996,16 @@ def serve_parser() -> argparse.ArgumentParser:
 
 def serve_main(argv: Sequence[str]) -> int:
     args = serve_parser().parse_args(argv)
+    if args.prefork > 0:
+        # prefork supervisor: N worker re-execs of this same command on
+        # one SO_REUSEPORT-shared port (serve/prefork.py) — no jax import
+        # in the parent
+        from dib_tpu.serve.prefork import supervise_prefork
+
+        return supervise_prefork(
+            list(argv), prefork=args.prefork, host=args.host,
+            port=args.port, outdir=args.outdir,
+            serve_seconds=args.serve_seconds)
     _enable_cli_compile_cache()
 
     import threading
@@ -964,7 +1013,14 @@ def serve_main(argv: Sequence[str]) -> int:
     import jax
     import numpy as np
 
-    from dib_tpu.serve import DEFAULT_BUCKETS, DIBServer, ReplicaRouter
+    from dib_tpu.serve import (
+        DEFAULT_BUCKETS,
+        DIBServer,
+        ModelZoo,
+        ReplicaRouter,
+        TenantQuotas,
+        pool_router,
+    )
     from dib_tpu.telemetry import (
         MetricsRegistry,
         Tracer,
@@ -1000,9 +1056,18 @@ def serve_main(argv: Sequence[str]) -> int:
             "checkpoint_dir": os.path.abspath(args.checkpoint_dir),
             "buckets": [int(b) for b in args.buckets],
             "max_batch": args.max_batch, "max_wait_ms": args.max_wait_ms,
-            "sweep": sweep_mode,
+            "sweep": sweep_mode, "workers": args.workers,
+            "quota_rps": args.quota_rps,
+            "admission_limit": args.admission_limit,
+            "response_cache": args.response_cache,
+            "exec_cache": args.exec_cache,
         }))
 
+    zoo = ModelZoo(
+        exec_capacity=args.exec_cache or None,
+        response_capacity=args.response_cache or None,
+        telemetry=telemetry, registry=registry,
+    )
     batcher_kwargs = dict(
         batch_buckets=args.buckets, telemetry=telemetry, registry=registry,
         tracer=tracer, max_batch=args.max_batch,
@@ -1020,28 +1085,50 @@ def serve_main(argv: Sequence[str]) -> int:
             sweep = BetaSweepTrainer(model, bundle, config, args.beta_start,
                                      ends, y_encoder=y_encoder)
             states, _, _ = ckpt.restore(sweep)
-            router = ReplicaRouter.from_sweep(sweep, states, **batcher_kwargs)
+            router = zoo.add_sweep(args.model_name, sweep, states,
+                                   **batcher_kwargs)
         else:
             trainer = DIBTrainer(model, bundle, config, y_encoder=y_encoder)
             state, _, _ = ckpt.restore(trainer)
-            devices = jax.local_devices()
-            if args.num_devices > 0:
-                devices = devices[: args.num_devices]
-            router = ReplicaRouter.from_params(
-                model, state.params["model"], devices=devices,
-                **batcher_kwargs,
-            )
+            if args.workers > 0:
+                # multi-process replica pool: each engine in a worker
+                # subprocess behind the pipe request plane — the GIL
+                # stops serializing request handling (docs/serving.md)
+                pool_kwargs = dict(batcher_kwargs)
+                pool_kwargs["batch_buckets"] = pool_kwargs.pop(
+                    "batch_buckets", args.buckets)
+                pool_kwargs.pop("telemetry", None)
+                router = pool_router(
+                    model, state.params["model"], args.workers,
+                    telemetry=telemetry, **pool_kwargs)
+                zoo.register(args.model_name, router,
+                             checkpoint_dir=args.checkpoint_dir)
+            else:
+                devices = jax.local_devices()
+                if args.num_devices > 0:
+                    devices = devices[: args.num_devices]
+                router = zoo.add_params(
+                    args.model_name, model, state.params["model"],
+                    devices=devices, checkpoint_dir=args.checkpoint_dir,
+                    **batcher_kwargs,
+                )
     finally:
         ckpt.close()
 
-    server = DIBServer(router, host=args.host, port=args.port,
-                       telemetry=telemetry, registry=registry)
+    quotas = (TenantQuotas(args.quota_rps, args.quota_burst)
+              if args.quota_rps > 0 else None)
+    server = DIBServer(zoo, host=args.host, port=args.port,
+                       telemetry=telemetry, registry=registry,
+                       tracer=tracer, quotas=quotas,
+                       admission_limit=args.admission_limit or None,
+                       reuse_port=args.reuse_port)
     server.start()
     # machine-readable first line: the loadgen (and tests) read the bound
     # port from here rather than racing a log scrape
     print(json.dumps({
         "serving": server.url, "port": server.port,
         "replicas": len(router.entries), "run_dir": args.outdir,
+        "models": zoo.names(), "workers": args.workers,
     }), flush=True)
 
     stop = threading.Event()

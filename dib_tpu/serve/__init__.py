@@ -5,17 +5,26 @@ See ``docs/serving.md``. The pieces:
   - :mod:`dib_tpu.serve.engine` — bucket-compiled deterministic inference
     callables (posterior-mean predict / per-feature encode / per-channel
     KL) over one checkpointed model, cost-analyzed for online roofline
-    gauges.
-  - :mod:`dib_tpu.serve.batcher` — bounded micro-batching queue: coalesce,
-    pad to bucket, dispatch, split; per-request timeouts, backpressure,
-    and error isolation.
+    gauges; compiles lazily through the zoo's executable LRU when one is
+    attached.
+  - :mod:`dib_tpu.serve.batcher` — bounded CONTINUOUS micro-batching
+    queue: requests join the next dispatch the moment an executable
+    returns; pad to bucket, dispatch, split; per-request timeouts,
+    backpressure, and error isolation.
   - :mod:`dib_tpu.serve.replicas` — round-robin dispatch across local
     devices and across β-sweep members ("the model at β≈x"), with
     per-replica health: consecutive-failure ejection, periodic probe
     re-admission, batcher-worker revival (docs/robustness.md).
-  - :mod:`dib_tpu.serve.server` — stdlib JSON HTTP API
-    (``/v1/predict``, ``/v1/encode``, ``/healthz``, ``/metrics``) behind
-    ``python -m dib_tpu serve``.
+  - :mod:`dib_tpu.serve.pool` — replicas in worker SUBPROCESSES behind a
+    pipe request plane, so request handling stops serializing on one GIL;
+    worker death degrades to the surviving replicas and probes respawn.
+  - :mod:`dib_tpu.serve.zoo` — many checkpoints behind one endpoint:
+    named model registry, capacity-bounded LRU of AOT executables, keyed
+    response cache with reload invalidation.
+  - :mod:`dib_tpu.serve.server` — asyncio event-loop JSON HTTP API
+    (``/v1/predict``, ``/v1/encode``, ``/v1/models``, ``/healthz``,
+    ``/metrics``) with admission control and per-tenant token-bucket
+    quotas (429), behind ``python -m dib_tpu serve``.
 """
 
 from dib_tpu.serve.batcher import (
@@ -25,22 +34,35 @@ from dib_tpu.serve.batcher import (
     RequestTimeout,
 )
 from dib_tpu.serve.engine import DEFAULT_BUCKETS, InferenceEngine
+from dib_tpu.serve.pool import (
+    WorkerDiedError,
+    WorkerReplica,
+    pool_router,
+)
 from dib_tpu.serve.replicas import (
     NoHealthyReplicaError,
     ReplicaEntry,
     ReplicaRouter,
 )
-from dib_tpu.serve.server import DIBServer
+from dib_tpu.serve.server import DIBServer, TenantQuotas
+from dib_tpu.serve.zoo import ExecutableLRU, ModelZoo, ResponseCache
 
 __all__ = [
     "DEFAULT_BUCKETS",
     "BatcherClosed",
     "DIBServer",
+    "ExecutableLRU",
     "InferenceEngine",
     "MicroBatcher",
+    "ModelZoo",
     "NoHealthyReplicaError",
     "QueueFullError",
     "ReplicaEntry",
     "ReplicaRouter",
     "RequestTimeout",
+    "ResponseCache",
+    "TenantQuotas",
+    "WorkerDiedError",
+    "WorkerReplica",
+    "pool_router",
 ]

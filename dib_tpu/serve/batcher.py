@@ -1,4 +1,4 @@
-"""Bounded micro-batching in front of an :class:`InferenceEngine`.
+"""Bounded CONTINUOUS micro-batching in front of an :class:`InferenceEngine`.
 
 Single requests are cheap to make and expensive to dispatch one-by-one —
 the engine's compiled buckets want full batches. The batcher coalesces
@@ -7,6 +7,17 @@ concurrent requests into padded micro-batches under two bounds:
   - ``max_batch``: dispatch as soon as this many rows are waiting;
   - ``max_wait_ms``: never hold the FIRST request of a batch longer than
     this, even at depth 1 (the latency floor a lone request pays).
+
+Batching is **continuous** (in-flight): requests keep entering the queue
+WHILE an engine dispatch is running, and the moment the executable
+returns, everything that queued up during it forms the next batch and
+dispatches immediately — no fresh ``max_wait_ms`` window is waited out
+while the engine sits idle over a non-empty queue. The wait window only
+applies when the engine is idle AND the queue was empty (the lone-request
+latency floor, unchanged). Under load the engine therefore runs
+back-to-back full-as-possible dispatches, which is where the throughput
+comes from; a request arriving mid-dispatch is guaranteed to ride the
+VERY NEXT dispatch (``tests/test_serve_async.py`` pins this).
 
 Contracts the tests pin:
 
@@ -63,12 +74,15 @@ class _Request:
     """One submitted request: rows + a one-shot result slot."""
 
     __slots__ = ("op", "rows", "deadline", "submitted", "dispatched",
-                 "_event", "_result", "_error")
+                 "tenant", "_event", "_result", "_error", "_cb_lock",
+                 "_callbacks")
 
-    def __init__(self, op: str, rows: np.ndarray, deadline: float | None):
+    def __init__(self, op: str, rows: np.ndarray, deadline: float | None,
+                 tenant: str | None = None):
         self.op = op
         self.rows = rows
         self.deadline = deadline
+        self.tenant = tenant
         self.submitted = time.perf_counter()   # timing-ok: host-side queue/latency clock, no jitted call in the interval
         # flipped by the worker the moment the engine dispatch carrying
         # these rows starts: a timeout BEFORE that is queue wait (the
@@ -78,18 +92,38 @@ class _Request:
         self._event = threading.Event()
         self._result = None
         self._error = None
+        self._cb_lock = threading.Lock()
+        self._callbacks: list = []
 
     # -------------------------------------------------------------- future
     def done(self) -> bool:
         return self._event.is_set()
 
+    def add_done_callback(self, fn) -> None:
+        """Call ``fn()`` (no args) when the result/error lands — from the
+        completing thread, so ``fn`` must be thread-safe and cheap (the
+        asyncio front end passes a ``call_soon_threadsafe`` trampoline).
+        A request that is already done calls back immediately."""
+        with self._cb_lock:
+            if not self._event.is_set():
+                self._callbacks.append(fn)
+                return
+        fn()
+
+    def _complete(self) -> None:
+        with self._cb_lock:
+            self._event.set()
+            callbacks, self._callbacks = self._callbacks, []
+        for fn in callbacks:
+            fn()
+
     def set_result(self, result) -> None:
         self._result = result
-        self._event.set()
+        self._complete()
 
     def set_error(self, error: BaseException) -> None:
         self._error = error
-        self._event.set()
+        self._complete()
 
     def result(self, timeout: float | None = None):
         """Block for the result; raises the request's error if it failed."""
@@ -99,6 +133,38 @@ class _Request:
                                    f"(request {where})")
             error.in_queue = not self.dispatched
             raise error
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+    async def wait_async(self, timeout: float | None = None):
+        """Awaitable twin of :meth:`result` for the asyncio server: parks
+        the coroutine (never the event loop thread) until the batcher
+        worker completes this request."""
+        import asyncio
+
+        loop = asyncio.get_running_loop()
+        future = loop.create_future()
+
+        def _wake():
+            # completing thread -> loop thread; the future may already be
+            # cancelled by wait_for's timeout, or the loop itself torn
+            # down (a shutdown racing the completion)
+            try:
+                loop.call_soon_threadsafe(
+                    lambda: future.done() or future.set_result(None))
+            except RuntimeError:
+                pass
+
+        self.add_done_callback(_wake)
+        try:
+            await asyncio.wait_for(future, timeout)
+        except asyncio.TimeoutError:
+            where = "in flight" if self.dispatched else "still queued"
+            error = RequestTimeout(f"no result within {timeout}s "
+                                   f"(request {where})")
+            error.in_queue = not self.dispatched
+            raise error from None
         if self._error is not None:
             raise self._error
         return self._result
@@ -152,9 +218,12 @@ class MicroBatcher:
 
     # --------------------------------------------------------------- client
     def submit(self, x, op: str = "predict",
-               timeout_s: float | None = None) -> _Request:
+               timeout_s: float | None = None,
+               tenant: str | None = None) -> _Request:
         """Enqueue one request; returns its future. Validation is eager —
-        a malformed request never reaches a batch."""
+        a malformed request never reaches a batch. ``tenant`` is an
+        optional label carried onto the request's span event (the server's
+        per-tenant quota accounting reads the stream by it)."""
         if self._closed:
             raise BatcherClosed("batcher is closed")
         if op not in ("predict", "encode"):
@@ -176,7 +245,7 @@ class MicroBatcher:
         deadline = (
             time.perf_counter() + timeout_s if timeout_s is not None else None   # timing-ok: host-side queue/latency clock, no jitted call in the interval
         )
-        request = _Request(op, rows, deadline)
+        request = _Request(op, rows, deadline, tenant=tenant)
         with self._lifecycle:
             if self._closed:
                 raise BatcherClosed("batcher is closed")
@@ -247,9 +316,30 @@ class MicroBatcher:
             request.set_error(BatcherClosed("batcher closed before dispatch"))
 
     # --------------------------------------------------------------- worker
-    def _collect(self) -> list[_Request]:
-        """Block for the first request, then gather batch-mates until
-        ``max_batch`` rows or ``max_wait_ms`` after the first arrival."""
+    def _collect(self, continuous: bool = False) -> list[_Request]:
+        """Gather the next micro-batch.
+
+        ``continuous=True`` means an engine dispatch JUST returned: if
+        anything queued up during it, it dispatches immediately — drained
+        without blocking, no ``max_wait_ms`` window (those requests
+        already waited out a whole dispatch; holding the now-idle engine
+        for batch-mates would only add latency under load). When the
+        queue is empty at return time the engine is genuinely idle and
+        the classic path applies: block for the first request, then hold
+        it ``max_wait_ms`` for batch-mates (the depth-1 latency floor).
+        """
+        if continuous:
+            batch: list[_Request] = []
+            rows = 0
+            while rows < self.max_batch:
+                try:
+                    request = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                batch.append(request)
+                rows += request.rows.shape[0]
+            if batch:
+                return batch
         try:
             first = self._queue.get(timeout=0.05)
         except queue.Empty:
@@ -270,8 +360,10 @@ class MicroBatcher:
         return batch
 
     def _run(self) -> None:
+        just_dispatched = False
         while not (self._closed and self._queue.empty()):
-            batch = self._collect()
+            batch = self._collect(continuous=just_dispatched)
+            just_dispatched = bool(batch)
             if not batch:
                 continue
             if self.registry is not None:
@@ -355,8 +447,11 @@ class MicroBatcher:
     def _finish(self, request: _Request, status: str, now: float) -> None:
         latency = now - request.submitted
         if self.tracer is not None:
+            tags = {}
+            if request.tenant is not None:
+                tags["tenant"] = request.tenant
             self.tracer.add("request", latency, op=request.op, status=status,
-                            rows=int(request.rows.shape[0]))
+                            rows=int(request.rows.shape[0]), **tags)
         if self.registry is not None:
             self.registry.counter(f"serve.requests.{status}").inc()
             self.registry.histogram("serve.request_latency_s").record(latency)

@@ -65,6 +65,15 @@ class InferenceEngine:
       registry: optional ``MetricsRegistry`` — dispatch updates achieved-
         FLOP/s / bandwidth gauges and per-op dispatch histograms.
       beta_end: optional β label carried into events (sweep-replica serving).
+      exec_cache: optional :class:`~dib_tpu.serve.zoo.ExecutableLRU` —
+        when given, executables are compiled LAZILY through the shared
+        capacity-bounded cache instead of eagerly at init (the model-zoo
+        path: a zoo of checkpoints cannot hold every (op, bucket)
+        executable resident, and a cold model must cost nothing until
+        queried). Evicted entries recompile on next use.
+      cache_key: this engine's identity inside ``exec_cache`` (the zoo
+        keys engines ``<model>/r<i>`` so a checkpoint reload can evict
+        exactly its own executables).
     """
 
     def __init__(
@@ -76,6 +85,8 @@ class InferenceEngine:
         telemetry=None,
         registry=None,
         beta_end: float | None = None,
+        exec_cache=None,
+        cache_key: str | None = None,
     ):
         buckets = sorted(set(int(b) for b in batch_buckets))
         if not buckets or buckets[0] < 1:
@@ -91,11 +102,17 @@ class InferenceEngine:
         self.beta_end = beta_end
         self.feature_width = int(sum(model.feature_dimensionalities))
         self.num_features = len(model.feature_dimensionalities)
+        self._exec_cache = exec_cache
+        self._cache_key = cache_key if cache_key is not None \
+            else f"engine-{id(self):x}"
         self._compiled: dict[tuple[str, int], object] = {}
         self._costs: dict[tuple[str, int], dict | None] = {}
         self._peaks = None
         self._dtype = jnp.float32
-        self._compile_all()
+        if exec_cache is None:
+            self._compile_all()
+        else:
+            self._init_peaks()
 
     # ------------------------------------------------------------- forward fns
     def _predict_fn(self, params, x):
@@ -118,38 +135,59 @@ class InferenceEngine:
         }
 
     # --------------------------------------------------------------- compile
-    def _compile_all(self) -> None:
+    def _compile_one(self, op: str, bucket: int):
+        """AOT-compile one (op, bucket) executable, recording its cost
+        analysis and ``compile`` event — the unit both the eager path and
+        the lazy exec-cache path share."""
         from dib_tpu.telemetry import xla_stats
 
         fns = {"predict": self._predict_fn, "encode": self._encode_fn}
+        jitted = jax.jit(fns[op])
+        spec = jax.ShapeDtypeStruct(
+            (bucket, self.feature_width), self._dtype
+        )
+        t0 = time.perf_counter()   # timing-ok: lower()/compile() are synchronous host calls
+        compiled = jitted.lower(self.params, spec).compile()
+        seconds = time.perf_counter() - t0   # timing-ok: lower()/compile() are synchronous host calls
+        cost = (xla_stats.executable_cost_stats(compiled)
+                if xla_stats.cost_analysis_enabled() else None)
+        self._costs[(op, bucket)] = cost
+        if self.telemetry is not None:
+            self.telemetry.compile(
+                name=f"serve.{op}", seconds=seconds,
+                # AOT executables never hit jit's dispatch cache;
+                # "aot" says so instead of faking a cache status
+                cache="aot", bucket=bucket,
+                cost_source="xla_cost_analysis" if cost else None,
+                **(cost or {}),
+                **({"beta_end": self.beta_end}
+                   if self.beta_end is not None else {}),
+            )
+        return compiled
+
+    def _compile_all(self) -> None:
         for op in OPS:
-            jitted = jax.jit(fns[op])
             for bucket in self.buckets:
-                spec = jax.ShapeDtypeStruct(
-                    (bucket, self.feature_width), self._dtype
-                )
-                t0 = time.perf_counter()   # timing-ok: lower()/compile() are synchronous host calls
-                compiled = jitted.lower(self.params, spec).compile()
-                seconds = time.perf_counter() - t0   # timing-ok: lower()/compile() are synchronous host calls
-                cost = (xla_stats.executable_cost_stats(compiled)
-                        if xla_stats.cost_analysis_enabled() else None)
-                key = (op, bucket)
-                self._compiled[key] = compiled
-                self._costs[key] = cost
-                if self.telemetry is not None:
-                    self.telemetry.compile(
-                        name=f"serve.{op}", seconds=seconds,
-                        # AOT executables never hit jit's dispatch cache;
-                        # "aot" says so instead of faking a cache status
-                        cache="aot", bucket=bucket,
-                        cost_source="xla_cost_analysis" if cost else None,
-                        **(cost or {}),
-                        **({"beta_end": self.beta_end}
-                           if self.beta_end is not None else {}),
-                    )
+                self._compiled[(op, bucket)] = self._compile_one(op, bucket)
+        self._init_peaks()
+
+    def _init_peaks(self) -> None:
+        from dib_tpu.telemetry import xla_stats
+
         if self.registry is not None:
             device = self.device if self.device is not None else jax.devices()[0]
             self._peaks = xla_stats.backend_peaks(device.device_kind) or {}
+
+    def _executable(self, op: str, bucket: int):
+        """The (op, bucket) executable: direct on the eager path, through
+        the shared LRU (compile-on-miss, eviction-tolerant) on the zoo's
+        lazy path."""
+        if self._exec_cache is not None:
+            return self._exec_cache.get(
+                (self._cache_key, op, bucket),
+                lambda: self._compile_one(op, bucket),
+            )
+        return self._compiled[(op, bucket)]
 
     def bucket_for(self, n: int) -> int:
         """Smallest compiled bucket holding ``n`` rows (top bucket if none)."""
@@ -191,8 +229,9 @@ class InferenceEngine:
         x_dev = jnp.asarray(x_pad)
         if self.device is not None:
             x_dev = jax.device_put(x_dev, self.device)
+        executable = self._executable(op, bucket)
         t0 = time.perf_counter()   # timing-ok: end timestamp follows jax.device_get (blocking)
-        out = self._compiled[(op, bucket)](self.params, x_dev)
+        out = executable(self.params, x_dev)
         out = jax.device_get(out)   # block: the interval is honest dispatch
         seconds = time.perf_counter() - t0   # timing-ok: end timestamp follows jax.device_get (blocking)
         self._observe(op, bucket, seconds)

@@ -262,7 +262,12 @@ class ReplicaRouter:
             def _probe(entry=entry, outcome=outcome):
                 t0 = time.monotonic()
                 try:
-                    entry.engine.predict(np.zeros(
+                    # an engine with a dedicated probe op gets it (pool
+                    # workers respawn their dead subprocess there —
+                    # something a live-traffic dispatch must never do)
+                    probe_fn = getattr(entry.engine, "probe",
+                                       entry.engine.predict)
+                    probe_fn(np.zeros(
                         (1, entry.engine.feature_width), np.float32))
                 except Exception as exc:
                     outcome["error"] = f"{type(exc).__name__}: {exc}"
@@ -359,16 +364,23 @@ class ReplicaRouter:
         eject_after: int = 3,
         probe_after_s: float = 5.0,
         probe_timeout_s: float = 5.0,
+        exec_cache=None,
+        cache_key: str | None = None,
         **batcher_kwargs,
     ) -> "ReplicaRouter":
         """One engine+batcher per local device (default: every local
-        device), all serving the same params."""
+        device), all serving the same params. ``exec_cache``/``cache_key``
+        thread the model zoo's shared executable LRU into each engine
+        (keyed ``<cache_key>/r<i>``), switching them to lazy compilation."""
         devices = list(devices) if devices is not None else jax.local_devices()
         entries = []
         for i, device in enumerate(devices):
             engine = InferenceEngine(
                 model, params, batch_buckets=batch_buckets, device=device,
                 telemetry=telemetry, registry=registry,
+                exec_cache=exec_cache,
+                cache_key=(f"{cache_key}/r{i}"
+                           if cache_key is not None else None),
             )
             batcher = MicroBatcher(engine, tracer=tracer, registry=registry,
                                    **batcher_kwargs)
@@ -390,6 +402,8 @@ class ReplicaRouter:
         eject_after: int = 3,
         probe_after_s: float = 5.0,
         probe_timeout_s: float = 5.0,
+        exec_cache=None,
+        cache_key: str | None = None,
         **batcher_kwargs,
     ) -> "ReplicaRouter":
         """One β-labeled engine per sweep member, unstacked from the sweep's
@@ -402,6 +416,9 @@ class ReplicaRouter:
                 sweep.base.model, state_r.params["model"],
                 batch_buckets=batch_buckets, telemetry=telemetry,
                 registry=registry, beta_end=beta_ends[r],
+                exec_cache=exec_cache,
+                cache_key=(f"{cache_key}/r{r}"
+                           if cache_key is not None else None),
             )
             batcher = MicroBatcher(engine, tracer=tracer, registry=registry,
                                    **batcher_kwargs)

@@ -1,0 +1,330 @@
+"""Model zoo: many checkpoints behind one endpoint, with two caches.
+
+The paper's deliverable is the whole β-trajectory of compression schemes,
+so a serving deployment holds MANY trained checkpoints — different
+datasets, different β grids, reloaded as training refreshes them. This
+module generalizes the single-checkpoint ``ReplicaRouter`` story into a
+registry:
+
+  - :class:`ModelZoo` — named models, each backed by its own
+    ``ReplicaRouter`` (device replicas, β replicas, or process-pool
+    workers); requests select with ``{"model": name}`` and the zoo
+    resolves a default for single-model deployments.
+  - :class:`ExecutableLRU` — a capacity-bounded cache of AOT executables
+    shared by every lazily-compiled engine in the zoo. A zoo serving
+    dozens of checkpoints × ops × buckets cannot hold every executable
+    hot; the LRU keeps the working set compiled and EVICTS cold
+    ``(model, op, bucket)`` entries (the executable is dropped, its
+    device memory freed; the next request pays one recompile, counted as
+    a miss).
+  - :class:`ResponseCache` — a keyed LRU over full responses for repeated
+    ``(input, β, checkpoint)`` queries. Serving is deterministic
+    (posterior-mean, no sampling), so for an unchanged checkpoint the
+    cached response IS the response. Reloading a checkpoint invalidates
+    every cached response (and evicts the model's executables) — proven
+    by ``tests/test_serve_zoo.py``.
+
+Both caches publish hit/miss/eviction counters to the ``MetricsRegistry``
+(``/metrics``, the final ``metrics`` event, and the ``serving`` summarize
+rollup's ``response_cache``/``exec_cache`` keys).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+__all__ = ["ExecutableLRU", "ModelZoo", "ResponseCache"]
+
+
+class ExecutableLRU:
+    """Capacity-bounded LRU of AOT executables, keyed
+    ``(engine_key, op, bucket)``.
+
+    Engines constructed with ``exec_cache=`` compile LAZILY through
+    :meth:`get` instead of eagerly at init — the zoo's cold models cost
+    nothing until queried, and the capacity bound caps total resident
+    executables across every model in the zoo.
+    """
+
+    def __init__(self, capacity: int, registry=None):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self.registry = registry
+        self._entries: "OrderedDict[tuple, object]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    def _count(self, name: str) -> None:
+        if self.registry is not None:
+            self.registry.counter(f"serve.cache.exec.{name}").inc()
+
+    def get(self, key: tuple, compile_fn):
+        """The executable for ``key``, compiling (and possibly evicting
+        the coldest entry) on a miss."""
+        with self._lock:
+            if key in self._entries:
+                self._entries.move_to_end(key)
+                self._count("hits")
+                return self._entries[key]
+        # Compile outside the lock: a cold model's ~100ms compile must not
+        # block every other model's cache hits. Two racing threads may
+        # both compile the same key; the second insert wins harmlessly
+        # (executables are interchangeable) and both count as misses.
+        self._count("misses")
+        executable = compile_fn()
+        with self._lock:
+            self._entries[key] = executable
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+                self._count("evictions")
+        return executable
+
+    def invalidate(self, engine_key_prefix: str) -> int:
+        """Drop every entry whose engine key starts with the prefix (a
+        model's engines are keyed ``<model>/r<i>``) — the checkpoint-
+        reload path. Returns the number of entries dropped."""
+        with self._lock:
+            stale = [k for k in self._entries
+                     if str(k[0]).startswith(engine_key_prefix)]
+            for k in stale:
+                del self._entries[k]
+        if stale:
+            self._count("invalidations")
+        return len(stale)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._entries),
+                    "capacity": self.capacity}
+
+
+def response_key(model: str, op: str, beta: float | None,
+                 rows: np.ndarray) -> tuple:
+    """Cache key for one request: the checkpoint identity, the op, the β
+    routing target, and a digest of the exact input bytes."""
+    digest = hashlib.sha1(
+        rows.tobytes() + repr(rows.shape).encode()).hexdigest()
+    return (model, op, None if beta is None else float(beta), digest)
+
+
+class ResponseCache:
+    """Bounded LRU over full responses for repeated deterministic queries.
+
+    Values are the result dicts the engine returned (numpy arrays); a hit
+    skips queueing, batching, and dispatch entirely. Keys carry the model
+    name, so :meth:`invalidate` can drop exactly one checkpoint's entries
+    when it reloads.
+    """
+
+    def __init__(self, capacity: int, registry=None):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self.registry = registry
+        self._entries: "OrderedDict[tuple, dict]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    def _count(self, name: str) -> None:
+        if self.registry is not None:
+            self.registry.counter(f"serve.cache.response.{name}").inc()
+
+    def get(self, key: tuple) -> dict | None:
+        with self._lock:
+            value = self._entries.get(key)
+            if value is not None:
+                self._entries.move_to_end(key)
+        self._count("hits" if value is not None else "misses")
+        return value
+
+    def put(self, key: tuple, value: dict) -> None:
+        with self._lock:
+            self._entries[key] = value
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+
+    def invalidate(self, model: str) -> int:
+        """Drop every cached response for ``model`` (checkpoint reload:
+        yesterday's params must never answer today's queries)."""
+        with self._lock:
+            stale = [k for k in self._entries if k[0] == model]
+            for k in stale:
+                del self._entries[k]
+        if stale:
+            self._count("invalidations")
+        return len(stale)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._entries),
+                    "capacity": self.capacity}
+
+
+class _ZooModel:
+    """One registered model: its router + provenance."""
+
+    __slots__ = ("name", "router", "checkpoint_dir", "reloads")
+
+    def __init__(self, name: str, router, checkpoint_dir: str | None):
+        self.name = name
+        self.router = router
+        self.checkpoint_dir = checkpoint_dir
+        self.reloads = 0
+
+
+class ModelZoo:
+    """Named checkpoints behind one serving endpoint.
+
+    ``exec_capacity`` > 0 arms the shared :class:`ExecutableLRU` (builder
+    methods thread it into lazily-compiled engines); ``response_capacity``
+    > 0 arms the :class:`ResponseCache` the server consults before
+    admission. The first registered model is the default a body without
+    ``"model"`` resolves to.
+    """
+
+    def __init__(self, exec_capacity: int | None = None,
+                 response_capacity: int | None = None,
+                 telemetry=None, registry=None):
+        self.telemetry = telemetry
+        self.registry = registry
+        self.exec_cache = (ExecutableLRU(exec_capacity, registry=registry)
+                           if exec_capacity else None)
+        self.response_cache = (
+            ResponseCache(response_capacity, registry=registry)
+            if response_capacity else None)
+        self._models: "OrderedDict[str, _ZooModel]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    # ---------------------------------------------------------- registry
+    def register(self, name: str, router,
+                 checkpoint_dir: str | None = None) -> None:
+        """Add (or error on a duplicate of) one named model."""
+        if not name:
+            raise ValueError("model name must be non-empty")
+        with self._lock:
+            if name in self._models:
+                raise ValueError(
+                    f"model {name!r} already registered (use reload())")
+            self._models[name] = _ZooModel(name, router, checkpoint_dir)
+
+    def reload(self, name: str, router,
+               checkpoint_dir: str | None = None) -> None:
+        """Swap a model's router for a freshly-restored one, invalidating
+        BOTH caches for it: cached responses computed against the old
+        params are dropped, and the old engines' executables are evicted
+        (same-name keys must never serve the new checkpoint stale). The
+        old router is closed after the swap, so in-flight requests drain
+        against the old params and new requests see only the new ones."""
+        with self._lock:
+            entry = self._models.get(name)
+            if entry is None:
+                raise KeyError(f"model {name!r} is not registered "
+                               f"(have: {list(self._models)})")
+            old_router = entry.router
+            entry.router = router
+            if checkpoint_dir is not None:
+                entry.checkpoint_dir = checkpoint_dir
+            entry.reloads += 1
+        if self.response_cache is not None:
+            self.response_cache.invalidate(name)
+        if self.exec_cache is not None:
+            self.exec_cache.invalidate(name + "/")
+        if self.registry is not None:
+            self.registry.counter("serve.zoo.reloads").inc()
+        if self.telemetry is not None:
+            self.telemetry.mitigation(mtype="zoo_reloaded", model=name)
+        old_router.close()
+
+    # ---------------------------------------------------------- builders
+    def add_params(self, name: str, model, params,
+                   checkpoint_dir: str | None = None, **router_kwargs):
+        """Register device replicas over one params set, engines compiled
+        lazily through the shared executable LRU (when armed)."""
+        from dib_tpu.serve.replicas import ReplicaRouter
+
+        router = ReplicaRouter.from_params(
+            model, params, exec_cache=self.exec_cache, cache_key=name,
+            **router_kwargs)
+        self.register(name, router, checkpoint_dir=checkpoint_dir)
+        return router
+
+    def add_sweep(self, name: str, sweep, states, **router_kwargs):
+        """Register a β-sweep checkpoint's members as ONE zoo model with
+        β-labeled replicas (the ``from_sweep`` story, zoo-scoped)."""
+        from dib_tpu.serve.replicas import ReplicaRouter
+
+        router = ReplicaRouter.from_sweep(
+            sweep, states, exec_cache=self.exec_cache, cache_key=name,
+            **router_kwargs)
+        self.register(name, router)
+        return router
+
+    # ----------------------------------------------------------- resolve
+    def resolve(self, name: str | None = None):
+        """(name, router) for a request's model selector; None resolves
+        the default (first-registered) model."""
+        with self._lock:
+            if not self._models:
+                raise KeyError("zoo is empty: no models registered")
+            if name is None:
+                name = next(iter(self._models))
+            entry = self._models.get(name)
+            if entry is None:
+                raise KeyError(
+                    f"unknown model {name!r} (have: {list(self._models)})")
+            return entry.name, entry.router
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return list(self._models)
+
+    def describe(self) -> list[dict]:
+        """The ``/v1/models`` surface."""
+        with self._lock:
+            entries = list(self._models.values())
+        out = []
+        for entry in entries:
+            row = {
+                "model": entry.name,
+                "replicas": len(entry.router.entries),
+                "reloads": entry.reloads,
+                "beta_ends": [e.beta_end for e in entry.router.entries
+                              if e.beta_end is not None] or None,
+            }
+            if entry.checkpoint_dir:
+                row["checkpoint_dir"] = entry.checkpoint_dir
+            out.append({k: v for k, v in row.items() if v is not None})
+        return out
+
+    def routers(self) -> list:
+        with self._lock:
+            return [entry.router for entry in self._models.values()]
+
+    def cache_stats(self) -> dict:
+        out = {}
+        if self.exec_cache is not None:
+            out["exec"] = self.exec_cache.stats()
+        if self.response_cache is not None:
+            out["response"] = self.response_cache.stats()
+        return out
+
+    def close(self) -> None:
+        for router in self.routers():
+            router.close()
+
+    # ------------------------------------------------------ construction
+    @classmethod
+    def single(cls, router, name: str = "default",
+               response_capacity: int | None = None,
+               telemetry=None, registry=None) -> "ModelZoo":
+        """Wrap one pre-built router as a single-model zoo — the shim the
+        server uses so every deployment routes through the same code."""
+        zoo = cls(response_capacity=response_capacity,
+                  telemetry=telemetry, registry=registry)
+        zoo.register(name, router)
+        return zoo

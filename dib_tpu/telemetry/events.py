@@ -120,7 +120,7 @@ EVENT_SCHEMA: dict[str, EventKindSpec] = {
                   "skipped_steps", "timeout_s", "grace_s", "exit_code",
                   "signal", "run_id", "replicas", "consecutive_failures",
                   "healthy", "ejected", "batchers_dead",
-                  "checkpoint_saved", "grace_remaining_s"),
+                  "checkpoint_saved", "grace_remaining_s", "model"),
         doc="one self-healing action (watchdog, rollback, serve health)"),
     "fault": EventKindSpec(
         required=("kind",),
@@ -134,8 +134,10 @@ EVENT_SCHEMA: dict[str, EventKindSpec] = {
         required=("name", "path", "span", "parent", "seconds"),
         optional=("epoch", "replica", "beta_end", "op", "bucket",
                   "status", "rows", "fill", "queued_s", "padded_rows",
-                  "overlapped"),
+                  "overlapped", "tenant", "cached", "model"),
         doc="one closed trace span (serving emits request/batch spans; "
+            "request spans may carry the tenant label, cached=true for "
+            "response-cache hits, and the zoo model name; "
             "overlapped=true marks a measurement that rode the async "
             "queue — seconds is then the EXPOSED wait, queued_s the "
             "dispatch→ready window)"),

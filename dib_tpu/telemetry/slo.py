@@ -55,7 +55,7 @@ from dib_tpu.telemetry.events import EventWriter, read_events
 
 __all__ = ["SLOEngine", "TransitionTracker", "check_run",
            "detect_transitions", "evaluate_rules", "load_slo",
-           "resolve_metric", "validate_slo"]
+           "resolve_metric", "slo_budget", "validate_slo"]
 
 DEFAULT_SLO_PATH = "SLO.json"
 SLO_VERSION = 1
@@ -121,6 +121,28 @@ def validate_slo(spec) -> list[str]:
             problems.append("'transitions' must be an object with a "
                             "positive 'kl_threshold_nats'")
     return problems
+
+
+def slo_budget(rule_name: str, default: float,
+               path: str | None = None) -> float:
+    """One committed rule's min/max budget, for tools that need the
+    NUMBER outside a full check — the loadgen's ``within_slo`` verdicts
+    and ``check_run_artifacts``'s artifact gates read it here so they can
+    never drift from the rule ``telemetry check`` enforces. Falls back to
+    ``default`` when the file or rule is absent/unreadable."""
+    if path is None:
+        from dib_tpu.telemetry.summary import _default_slo_path
+
+        path = _default_slo_path()
+    try:
+        with open(path) as f:
+            spec = json.load(f)
+        for rule in spec.get("rules") or []:
+            if rule.get("name") == rule_name:
+                return float(rule.get("min", rule.get("max")))
+    except (OSError, ValueError, TypeError):
+        pass
+    return default
 
 
 def resolve_metric(summary: dict, dotted: str):

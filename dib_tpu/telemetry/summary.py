@@ -170,10 +170,13 @@ def _percentile(ordered: list[float], q: float) -> float:
     return ordered[min(int(q * len(ordered)), len(ordered) - 1)]
 
 
-def serving_rollup(span_events) -> dict | None:
+def serving_rollup(span_events, counters: dict | None = None) -> dict | None:
     """Latency/throughput view of a SERVING stream's ``request``/``batch``
     spans (docs/serving.md): request count + status mix + latency
-    percentiles, micro-batch count + mean fill ratio. None when the stream
+    percentiles, micro-batch count + mean fill ratio, multi-tenancy and
+    cache accounting. ``counters`` is the final ``metrics`` event's
+    counter snapshot — the zoo's cache hit/miss/eviction counters ride it
+    into ``response_cache``/``exec_cache`` keys. None when the stream
     carries no serving spans (training runs)."""
     requests = [e for e in span_events if e.get("name") == "request"]
     batches = [e for e in span_events if e.get("name") == "batch"]
@@ -183,9 +186,16 @@ def serving_rollup(span_events) -> dict | None:
     if requests:
         latencies = sorted(e.get("seconds") or 0.0 for e in requests)
         statuses: dict[str, int] = {}
+        tenants: dict[str, int] = {}
+        cached = 0
         for e in requests:
             s = e.get("status", "?")
             statuses[s] = statuses.get(s, 0) + 1
+            t = e.get("tenant")
+            if t is not None:
+                tenants[t] = tenants.get(t, 0) + 1
+            if e.get("cached"):
+                cached += 1
         span = (max(e.get("mono", 0.0) for e in requests)
                 - min(e.get("mono", 0.0) for e in requests))
         out.update({
@@ -197,6 +207,30 @@ def serving_rollup(span_events) -> dict | None:
             "request_mean_ms": round(
                 sum(latencies) / len(latencies) * 1e3, 3),
         })
+        # The UNCACHED view is what the latency SLO means: cache hits
+        # answer from memory in ~µs and quota/shed rejections never
+        # dispatch at all — enough of either would drag the blended
+        # percentile below what a real dispatch costs and wave a breach
+        # past the gate. Always present (= the blended view when nothing
+        # is cached/rejected), so serve_uncached_p99_ceiling can gate it.
+        uncached = sorted(e.get("seconds") or 0.0 for e in requests
+                          if not e.get("cached")
+                          and e.get("status") not in ("quota", "shed"))
+        if uncached:
+            out["uncached_request_p99_ms"] = round(
+                _percentile(uncached, 0.99) * 1e3, 3)
+        if cached:
+            out["cached_requests"] = cached
+            out["cache_hit_frac"] = round(cached / len(requests), 6)
+        if tenants:
+            out["tenants"] = dict(sorted(tenants.items()))
+        # quota/shed rejections (server-side spans): the rejection-rate
+        # SLO guard reads the fraction — a well-behaved tenant mix must
+        # keep 429s bounded (docs/serving.md "Tenancy and quotas")
+        quota = statuses.get("quota", 0)
+        if quota:
+            out["quota_rejected"] = quota
+        out["quota_rejected_frac"] = round(quota / len(requests), 6)
         if span > 0:
             out["requests_per_s"] = round(len(requests) / span, 3)
     if batches:
@@ -205,6 +239,16 @@ def serving_rollup(span_events) -> dict | None:
         out["batches"] = len(batches)
         if fills:
             out["batch_fill_mean"] = round(sum(fills) / len(fills), 4)
+    for prefix, key in (("serve.cache.response.", "response_cache"),
+                        ("serve.cache.exec.", "exec_cache")):
+        stats = {name[len(prefix):]: int(value)
+                 for name, value in (counters or {}).items()
+                 if name.startswith(prefix)}
+        if stats:
+            hits, misses = stats.get("hits", 0), stats.get("misses", 0)
+            if hits + misses:
+                stats["hit_frac"] = round(hits / (hits + misses), 6)
+            out[key] = stats
     return out
 
 
@@ -675,7 +719,17 @@ def summarize(path: str, process_index: int | None = None,
                                    summary.get("device_kind"))
         if util:
             summary["utilization"] = util
-        serving = serving_rollup(span_events)
+        # the final metrics event's counters carry the zoo cache stats
+        # (snapshots are flat dicts: "counters.serve.cache.response.hits")
+        counter_snaps = of_type("metrics", per_run)
+        counters = None
+        if counter_snaps:
+            snaps = counter_snaps[-1].get("snapshots") or []
+            if snaps:
+                counters = {k[len("counters."):]: v
+                            for k, v in snaps[0].items()
+                            if k.startswith("counters.")}
+        serving = serving_rollup(span_events, counters=counters)
         if serving:
             summary["serving"] = serving
         overlap = overlap_rollup(span_events)

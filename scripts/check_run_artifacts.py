@@ -216,6 +216,75 @@ def _check_kernel_bench(record: dict, problems: list[str]) -> None:
         problems.append("'all_parity_ok' must be true on a committed record")
 
 
+def _slo_budget(rule_name: str, default: float) -> float:
+    """A budget from the committed SLO.json (the ONE shared reader in
+    telemetry/slo.py), so the artifact gate and the `telemetry check`
+    rule cannot drift apart."""
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from dib_tpu.telemetry.slo import slo_budget
+
+    return slo_budget(rule_name, default,
+                      path=os.path.join(REPO, "SLO.json"))
+
+
+def _check_serve_async_bench(record: dict, problems: list[str]) -> None:
+    """serve_async_loadgen_sweep-specific schema (scripts/serve_loadgen.py
+    --rates): every row carries mode/target_rate/p99/cache-counter
+    evidence, at least one UNCACHED row held the serving SLO, and the
+    headline clears the committed req/s floor (>= 3x the PR 3 baseline)."""
+    rows = record.get("rows")
+    if not isinstance(rows, list) or not rows:
+        problems.append("'rows' must be a non-empty list of rate steps")
+        return
+    ceiling_ms = _slo_budget("serve_p99_ceiling", 20.0)
+    floor = _slo_budget("serve_req_per_s_floor", 1110.0)
+    compliant_uncached = False
+    for i, row in enumerate(rows):
+        if not isinstance(row, dict):
+            problems.append(f"rows[{i}] must be an object")
+            continue
+        if row.get("mode") != "open":
+            problems.append(f"rows[{i}]: 'mode' must be 'open' (the sweep "
+                            "is open-loop by construction)")
+        rate = row.get("target_rate")
+        if not (_is_finite_number(rate) and rate > 0):
+            problems.append(f"rows[{i}]: 'target_rate' must be a positive "
+                            "finite number")
+        if not isinstance(row.get("cached"), bool):
+            problems.append(f"rows[{i}]: 'cached' must be a bool")
+        cache = row.get("cache")
+        if not (isinstance(cache, dict)
+                and all(isinstance(cache.get(k), int)
+                        for k in ("response_hits", "response_misses",
+                                  "quota_rejected"))):
+            problems.append(f"rows[{i}]: 'cache' must carry integer "
+                            "response_hits/response_misses/quota_rejected "
+                            "counters")
+        if row.get("value") is not None:
+            p99 = (row.get("latency_ms") or {}).get("p99")
+            if not _is_finite_number(p99):
+                problems.append(f"rows[{i}]: a measured row needs a finite "
+                                "'latency_ms.p99'")
+            elif (row.get("within_slo") and not row.get("cached")
+                  and p99 <= ceiling_ms):
+                compliant_uncached = True
+    if not compliant_uncached:
+        problems.append(
+            "no uncached row held p99 under the serve_p99_ceiling budget "
+            f"({ceiling_ms} ms) — the sweep never demonstrates compliant "
+            "throughput")
+    value = record.get("value")
+    if _is_finite_number(value) and value < floor:
+        problems.append(
+            f"headline value {value} req/s is below the committed "
+            f"serve_req_per_s_floor ({floor}) — the async rebuild's "
+            "throughput evidence regressed")
+    if not _is_finite_number(record.get("baseline_req_per_s")):
+        problems.append("'baseline_req_per_s' must record the PR 3 "
+                        "baseline the speedup is measured against")
+
+
 def _reject_constant(name: str):
     raise ValueError(f"non-finite JSON constant {name!r}")
 
@@ -270,6 +339,8 @@ def check_record(record: dict, problems: list[str]) -> None:
             _check_chaos_sched_matrix(record, problems)
         if record.get("metric") == "mi_kernel_bench":
             _check_kernel_bench(record, problems)
+        if record.get("metric") == "serve_async_loadgen_sweep":
+            _check_serve_async_bench(record, problems)
     elif {"cmd", "rc"} <= set(record):
         # ---- driver capture
         if not isinstance(record["cmd"], str):

@@ -3,39 +3,60 @@
 Drives ``/v1/predict`` with synthetic rows shaped by the server's own
 ``/healthz`` surface and emits ONE bench-shaped JSON line (the repo's
 ``metric``/``value``/``unit`` artifact schema, validated by
-``scripts/check_run_artifacts.py``): throughput, latency percentiles, and
-the server-side batch-fill ratio.
+``scripts/check_run_artifacts.py``).
 
-Two traffic shapes:
+Traffic shapes:
 
-  - **closed loop** (default): ``--concurrency`` workers, each issuing its
-    next request when the previous one returns — measures the server at
-    its natural saturation for that client count.
+  - **closed loop** (default): ``--concurrency`` thread workers, each
+    issuing its next request when the previous one returns — measures the
+    server at its natural saturation for that client count.
   - **open loop** (``--rate R``): requests are *scheduled* at R/s
     regardless of completions, the honest way to measure queueing delay
     under a fixed offered load (a closed loop self-throttles and hides
     queue growth).
+  - **open-loop rate sweep** (``--rates R1,R2,...``): the asyncio client
+    (persistent keep-alive connections, latencies measured from the
+    SCHEDULED send time so coordinated omission cannot hide queueing)
+    walks a ladder of offered loads across a well-behaved multi-tenant
+    mix, emitting one row per rate plus an optional CACHED-path row
+    (``--cached-rate``) that hammers one repeated input through the
+    response cache. The record's headline ``value`` is the best uncached
+    rate whose p99 held under the SLO ceiling — the shape committed as
+    ``BENCH_SERVE_ASYNC_CPU.json``.
 
-Two targets:
+Targets:
 
   - ``--url`` points at a running server (``python -m dib_tpu serve``);
   - ``--self-contained`` trains a tiny boolean-circuit model for a few
-    epochs, checkpoints it, serves it in-process on an ephemeral port, and
-    load-tests that — the zero-setup CPU path CI and the committed
-    artifact use. ``--serve-run-dir`` keeps the serving event stream for
-    ``python -m dib_tpu telemetry report``.
+    epochs, checkpoints it, and serves it — in-process for the classic
+    single-rate modes, or (sweep mode) as a REAL ``python -m dib_tpu
+    serve`` subprocess with the async engine flags (``--serve-workers``
+    process pool, response cache, per-tenant quotas), so the client and
+    server never share a GIL and the measurement exercises the shipped
+    CLI end to end. ``--serve-run-dir`` keeps the serving event stream
+    for ``python -m dib_tpu telemetry report``.
+
+Registry: with an EXPLICIT runs root (``--runs-root`` / ``DIB_RUNS_ROOT``
+— never the ``./runs`` default, ad-hoc local runs must not grow the
+committed index) the emitted record is registered as a fleet ``bench``
+entry, so ``telemetry runs trajectory`` carries the serving history.
 
 Usage::
 
     python scripts/serve_loadgen.py --url http://127.0.0.1:8100 --duration 10
     python scripts/serve_loadgen.py --self-contained --duration 3 --out BENCH_SERVE_CPU.json
+    python scripts/serve_loadgen.py --self-contained --rates 400,800,1200,1600 \
+        --cached-rate 2000 --duration 5 --out BENCH_SERVE_ASYNC_CPU.json
 """
 
 from __future__ import annotations
 
 import argparse
+import asyncio
 import json
 import os
+import signal
+import subprocess
 import sys
 import threading
 import time
@@ -45,6 +66,8 @@ import urllib.request
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 METRIC = "serve_cpu_loadgen"
+SWEEP_METRIC = "serve_async_loadgen_sweep"
+BASELINE_REQ_PER_S = 370.0   # BENCH_SERVE_CPU.json (PR 3 ThreadingHTTPServer)
 
 
 def _get_json(url: str, timeout: float = 10.0) -> dict:
@@ -112,6 +135,16 @@ def _percentile(ordered: list[float], q: float) -> float:
     return ordered[min(int(q * len(ordered)), len(ordered) - 1)]
 
 
+def _latency_block(ordered: list[float]) -> dict:
+    n = len(ordered)
+    return {
+        "p50": round(_percentile(ordered, 0.5) * 1e3, 3),
+        "p90": round(_percentile(ordered, 0.9) * 1e3, 3),
+        "p99": round(_percentile(ordered, 0.99) * 1e3, 3),
+        "mean": round(sum(ordered) / n * 1e3, 3),
+    }
+
+
 def _one_request(url: str, row: list[float], stats: _Stats) -> None:
     t0 = time.perf_counter()
     try:
@@ -125,12 +158,20 @@ def _one_request(url: str, row: list[float], stats: _Stats) -> None:
         stats.error()
 
 
+def _row(i: int, width: int) -> list[float]:
+    """Deterministic pseudo-input by GLOBAL index — DISTINCT for every
+    ``i`` < 10^6 (the leading coordinates encode the index digits), so
+    the uncached sweep cannot accidentally ride the response cache."""
+    row = [((i * 31 + j * 7) % 997 - 498) / 498.0 for j in range(width)]
+    row[0] = (i % 1000) / 1000.0
+    if width > 1:
+        row[1] = (i // 1000 % 1000) / 1000.0
+    return row
+
+
 def _make_rows(width: int, n: int = 64) -> list[list[float]]:
-    """Deterministic pseudo-input pool (no numpy needed at loadgen side)."""
-    rows = []
-    for i in range(n):
-        rows.append([((i * 31 + j * 7) % 13 - 6) / 6.0 for j in range(width)])
-    return rows
+    """Small fixed pool for the classic closed/open loops."""
+    return [_row(i, width) for i in range(n)]
 
 
 def run_closed_loop(url: str, width: int, duration_s: float,
@@ -156,9 +197,10 @@ def run_closed_loop(url: str, width: int, duration_s: float,
 
 def run_open_loop(url: str, width: int, duration_s: float,
                   rate: float, max_inflight: int = 64) -> _Stats:
-    """Schedule sends at ``rate``/s; completions never gate the schedule
-    (bounded only by ``max_inflight`` so a dead server cannot spawn
-    unbounded threads)."""
+    """Thread-based open loop (the classic single-rate mode): schedule
+    sends at ``rate``/s; completions never gate the schedule (bounded only
+    by ``max_inflight`` so a dead server cannot spawn unbounded
+    threads)."""
     stats = _Stats()
     rows = _make_rows(width)
     interval = 1.0 / rate
@@ -194,35 +236,265 @@ def run_open_loop(url: str, width: int, duration_s: float,
     return stats
 
 
-def _batch_fill_from_metrics(url: str) -> float | None:
+# ------------------------------------------------------- asyncio open loop
+class _SweepStats:
+    """One rate step's accounting (single-threaded: the client loop)."""
+
+    def __init__(self):
+        self.latencies: list[float] = []   # steady-state only (post-warmup)
+        self.statuses: dict[str, int] = {}
+        self.transport_errors = 0
+        self.sent = 0
+        self.completed_ok = 0              # ALL 200s, warmup included
+        self.last_done = 0.0
+        self.window_s = 0.0
+
+
+async def _read_http_response(reader) -> int:
+    """Minimal HTTP/1.1 response read on a keep-alive connection: status
+    code out, body drained by Content-Length."""
+    line = await reader.readline()
+    if not line:
+        raise ConnectionResetError("server closed the connection")
+    status = int(line.split()[1])
+    length = 0
+    while True:
+        header = await reader.readline()
+        if header in (b"\r\n", b"\n", b""):
+            break
+        if header.lower().startswith(b"content-length:"):
+            length = int(header.split(b":", 1)[1])
+    if length:
+        await reader.readexactly(length)
+    return status
+
+
+async def _conn_worker(host: str, port: int, queue: asyncio.Queue,
+                       stats: _SweepStats, warmup_until: float) -> None:
+    """One persistent keep-alive connection draining the send queue.
+    Latency is measured from the SCHEDULED send time, so a backed-up
+    connection pool shows up as latency, not as silence. The connection
+    is opened BEFORE any request is pulled, and requests scheduled inside
+    the warmup window count for throughput but not latency (the t=0
+    connect/compile burst must not masquerade as steady-state tail)."""
+    reader = writer = None
     try:
-        metrics = _get_json(url + "/metrics")
-        return metrics["histograms"]["serve.batch_fill"]["mean"]
-    except Exception:
-        return None
+        try:
+            reader, writer = await asyncio.open_connection(host, port)
+        except Exception:
+            reader = writer = None
+        while True:
+            item = await queue.get()
+            if item is None:
+                return
+            t_sched, payload = item
+            try:
+                if writer is None:
+                    reader, writer = await asyncio.open_connection(host, port)
+                writer.write(payload)
+                await writer.drain()
+                status = await _read_http_response(reader)
+            except Exception:
+                stats.transport_errors += 1
+                if writer is not None:
+                    writer.close()
+                reader = writer = None
+                continue
+            now = time.perf_counter()
+            stats.last_done = max(stats.last_done, now)
+            stats.statuses[str(status)] = \
+                stats.statuses.get(str(status), 0) + 1
+            if status == 200:
+                stats.completed_ok += 1
+                if t_sched >= warmup_until:
+                    stats.latencies.append(now - t_sched)
+    finally:
+        if writer is not None:
+            writer.close()
 
 
-def _self_contained_server(run_dir: str | None, train_epochs: int):
-    """Train a tiny model, checkpoint it, serve it in-process.
+async def _open_loop_async(host: str, port: int, rate: float,
+                           duration_s: float, make_payload,
+                           connections: int,
+                           warmup_s: float = 0.5) -> _SweepStats:
+    stats = _SweepStats()
+    queue: asyncio.Queue = asyncio.Queue()
+    start = time.perf_counter() + 0.05   # let workers pre-connect
+    warmup_until = start + warmup_s
+    workers = [asyncio.create_task(
+        _conn_worker(host, port, queue, stats, warmup_until))
+        for _ in range(connections)]
+    n = max(int(rate * duration_s), 1)
+    for i in range(n):
+        target = start + i / rate
+        delay = target - time.perf_counter()
+        if delay > 0:
+            await asyncio.sleep(delay)
+        queue.put_nowait((target, make_payload(i)))
+        stats.sent += 1
+    for _ in workers:
+        queue.put_nowait(None)
+    await asyncio.gather(*workers)
+    stats.window_s = max(stats.last_done - start, duration_s)
+    return stats
 
-    Returns ``(server, cleanup)`` — the checkpoint round-trip is part of
-    the point: the loadgen path exercises save → manifest-verified restore
-    → AOT compile, not just a params dict in memory.
-    """
+
+def _payload_maker(host: str, width: int, tenants: int,
+                   cached_row: bool = False, index_offset: int = 0):
+    """Raw HTTP/1.1 request bytes by send index: tenant round-robins the
+    well-behaved mix; uncached mode makes every input DISTINCT (a sweep
+    must never accidentally measure the response cache — ``index_offset``
+    keeps indices unique ACROSS rate steps too), cached mode repeats one
+    row forever (measuring exactly it)."""
+    fixed = json.dumps({"x": _row(0, width)}).encode() if cached_row else None
+
+    def make(i: int) -> bytes:
+        tenant = f"tenant{i % max(tenants, 1)}"
+        body = fixed if cached_row else json.dumps(
+            {"x": _row(index_offset + i + 1, width)}).encode()
+        head = (f"POST /v1/predict HTTP/1.1\r\n"
+                f"Host: {host}\r\n"
+                f"Content-Type: application/json\r\n"
+                f"X-DIB-Tenant: {tenant}\r\n"
+                f"Content-Length: {len(body)}\r\n\r\n").encode()
+        return head + body
+
+    return make
+
+
+_COUNTER_NAMES = (
+    ("response_hits", "serve.cache.response.hits"),
+    ("response_misses", "serve.cache.response.misses"),
+    ("exec_hits", "serve.cache.exec.hits"),
+    ("exec_misses", "serve.cache.exec.misses"),
+    ("exec_evictions", "serve.cache.exec.evictions"),
+    ("quota_rejected", "serve.requests.quota"),
+    ("shed", "serve.requests.shed"),
+)
+
+
+def _cache_counters(url: str, processes: int = 1) -> dict:
+    """The zoo cache/quota counters from /metrics, SUMMED across the
+    server processes. Under the prefork plane each worker keeps its own
+    registry and the kernel routes every scrape to one of them, so the
+    scrape repeats on fresh connections until ``processes`` distinct pids
+    answered (bounded attempts — a worker the kernel never routes to just
+    goes unsampled, which under-counts honestly). Zeros when the server
+    has no registry or caches."""
+    by_pid: dict = {}
+    attempts = max(processes * 6, 1)
+    for _ in range(attempts):
+        try:
+            snapshot = _get_json(url + "/metrics")
+        except Exception:
+            break
+        by_pid[snapshot.get("pid", 0)] = snapshot.get("counters", {})
+        if len(by_pid) >= processes:
+            break
+    out = {}
+    for short, name in _COUNTER_NAMES:
+        out[short] = int(sum(c.get(name, 0) for c in by_pid.values()))
+    return out
+
+
+def run_rate_sweep(url: str, width: int, rates: list[float],
+                   duration_s: float, tenants: int, connections: int,
+                   ceiling_ms: float, cached_rate: float = 0.0,
+                   server_processes: int = 1) -> dict:
+    """The open-loop ladder: one row per offered rate (uncached, distinct
+    inputs, tenant mix) + an optional cached-path row; per-row cache
+    counters are /metrics DELTAS around that row."""
+    host, _, port = url.removeprefix("http://").partition(":")
+    port = int(port)
+    rows = []
+    specs = [(r, False) for r in rates]
+    if cached_rate > 0:
+        specs.append((cached_rate, True))
+    import gc
+
+    # Warmup phase OUTSIDE any measurement: the first dispatch through
+    # each bucket (XLA executable first-run, cache fills, allocator
+    # growth) is slow, and under an open loop a cold-start hiccup builds
+    # a STANDING queue the fixed-rate schedule never drains — the whole
+    # step would then measure the backlog, not the server.
+    asyncio.run(_open_loop_async(
+        host, port, 200.0, 1.5,
+        _payload_maker(host, width, tenants, index_offset=10_000_000),
+        connections, warmup_s=1.5))
+    time.sleep(1.0)
+
+    index_offset = 0
+    for rate, cached in specs:
+        before = _cache_counters(url, processes=server_processes)
+        # the measurement tool must not charge its own GC pauses to the
+        # server's tail: a step allocates a few MB, collected afterwards
+        gc.collect()
+        gc.disable()
+        try:
+            stats = asyncio.run(_open_loop_async(
+                host, port, rate, duration_s,
+                _payload_maker(host, width, tenants, cached_row=cached,
+                               index_offset=index_offset),
+                connections))
+        finally:
+            gc.enable()
+        index_offset += stats.sent
+        after = _cache_counters(url, processes=server_processes)
+        row: dict = {
+            "mode": "open",
+            "cached": cached,
+            "target_rate": rate,
+            "duration_s": duration_s,
+            "tenants": tenants,
+            "requests_sent": stats.sent,
+            "ok": stats.completed_ok,
+            "statuses": stats.statuses,
+            "transport_errors": stats.transport_errors,
+            # clamped at 0: a prefork worker the kernel did not route a
+            # scrape to leaves its share out of one side of the delta
+            "cache": {k: max(after[k] - before[k], 0) for k in after},
+        }
+        if stats.latencies:
+            ordered = sorted(stats.latencies)
+            row["value"] = round(stats.completed_ok / stats.window_s, 3)
+            row["latency_ms"] = _latency_block(ordered)
+            error_frac = 1.0 - stats.completed_ok / max(stats.sent, 1)
+            row["error_frac"] = round(error_frac, 6)
+            row["within_slo"] = bool(
+                row["latency_ms"]["p99"] <= ceiling_ms
+                and error_frac <= 0.01)
+        else:
+            row["value"] = None
+            row["within_slo"] = False
+            row["degraded"] = "no_successful_requests"
+        rows.append(row)
+        time.sleep(1.0)   # settle: let any residual queue drain fully
+    return {"rows": rows}
+
+
+def _slo_p99_ceiling_ms(default: float = 20.0) -> float:
+    """The committed serve_p99_ceiling budget, through the ONE shared
+    reader (telemetry/slo.py:slo_budget), so the sweep's within_slo
+    verdicts and the committed rule cannot drift apart."""
+    from dib_tpu.telemetry.slo import slo_budget
+
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return slo_budget("serve_p99_ceiling", default,
+                      path=os.path.join(here, "SLO.json"))
+
+
+# ----------------------------------------------------- self-contained mode
+def _train_tiny_checkpoint(train_epochs: int) -> tuple[str, object, object]:
+    """Train + checkpoint the tiny boolean model the self-contained modes
+    serve. The architecture mirrors the serve CLI's flag mapping
+    (cli._model_from_args) so a subprocess server can restore it from
+    flags alone."""
     import tempfile
 
     import jax
 
     from dib_tpu.data import get_dataset
     from dib_tpu.models import DistributedIBModel
-    from dib_tpu.serve import DIBServer, ReplicaRouter
-    from dib_tpu.serve.engine import InferenceEngine
-    from dib_tpu.telemetry import (
-        EventWriter,
-        MetricsRegistry,
-        Tracer,
-        runtime_manifest,
-    )
     from dib_tpu.train import (
         CheckpointHook,
         DIBCheckpointer,
@@ -234,7 +506,8 @@ def _self_contained_server(run_dir: str | None, train_epochs: int):
     model = DistributedIBModel(
         feature_dimensionalities=tuple(bundle.feature_dimensionalities),
         encoder_hidden=(16,), integration_hidden=(32,),
-        output_dim=1, embedding_dim=4,
+        output_dim=bundle.output_dimensionality, embedding_dim=4,
+        output_activation=bundle.output_activation,
     )
     config = TrainConfig(
         batch_size=64, num_pretraining_epochs=train_epochs // 2,
@@ -247,12 +520,44 @@ def _self_contained_server(run_dir: str | None, train_epochs: int):
     trainer.fit(jax.random.key(0), hooks=[CheckpointHook(ckpt)],
                 hook_every=config.num_epochs)
     ckpt.close()
+    return ckpt_dir, model, trainer
+
+
+# Serve-CLI flags matching _train_tiny_checkpoint's architecture.
+_TINY_ARCH_FLAGS = [
+    "--dataset", "boolean_circuit",
+    "--feature_encoder_architecture", "16",
+    "--integration_network_architecture", "32",
+    "--feature_embedding_dimension", "4",
+]
+
+
+def _self_contained_server(run_dir: str | None, train_epochs: int):
+    """Train a tiny model, checkpoint it, serve it IN-PROCESS (classic
+    single-rate modes; the sweep uses the subprocess path).
+
+    Returns ``(server, cleanup)`` — the checkpoint round-trip is part of
+    the point: the loadgen path exercises save → manifest-verified restore
+    → AOT compile, not just a params dict in memory.
+    """
+    import shutil
+
+    from dib_tpu.serve import DIBServer, MicroBatcher, ReplicaEntry, ReplicaRouter
+    from dib_tpu.serve.engine import InferenceEngine
+    from dib_tpu.telemetry import (
+        EventWriter,
+        MetricsRegistry,
+        Tracer,
+        runtime_manifest,
+    )
+
+    ckpt_dir, model, trainer = _train_tiny_checkpoint(train_epochs)
 
     writer = None
     registry = MetricsRegistry()
     if run_dir:
         writer = EventWriter(run_dir)
-        writer.run_start(runtime_manifest(config=config, extra={
+        writer.run_start(runtime_manifest(config=trainer.config, extra={
             "mode": "serve", "dataset": "boolean_circuit",
             "checkpoint_dir": ckpt_dir, "loadgen": "self_contained",
         }))
@@ -261,22 +566,74 @@ def _self_contained_server(run_dir: str | None, train_epochs: int):
         trainer, ckpt_dir, batch_buckets=(1, 8, 32),
         telemetry=writer, registry=registry,
     )
-    from dib_tpu.serve.batcher import MicroBatcher
-    from dib_tpu.serve.replicas import ReplicaEntry
-
     batcher = MicroBatcher(engine, max_batch=32, max_wait_ms=2.0,
                            tracer=tracer, registry=registry)
     router = ReplicaRouter([ReplicaEntry(engine, batcher, 0)])
     server = DIBServer(router, port=0, telemetry=writer,
-                       registry=registry).start()
+                       registry=registry, tracer=tracer).start()
 
     def cleanup():
         server.close()
-        import shutil
-
         shutil.rmtree(ckpt_dir, ignore_errors=True)
 
     return server, cleanup
+
+
+def _self_contained_subprocess(run_dir: str | None, train_epochs: int,
+                               serve_args: list[str]):
+    """Train a tiny checkpoint, then serve it through the REAL CLI in a
+    SUBPROCESS — the sweep's client loop and the server never share a
+    GIL, and the measurement covers the shipped entry point (argument
+    parsing, checkpoint restore, zoo/quota wiring, graceful shutdown).
+
+    Returns ``(url, cleanup)``.
+    """
+    import shutil
+
+    ckpt_dir, _, _ = _train_tiny_checkpoint(train_epochs)
+    cmd = [sys.executable, "-m", "dib_tpu", "serve",
+           "--checkpoint_dir", ckpt_dir, "--port", "0",
+           *_TINY_ARCH_FLAGS, *serve_args]
+    if run_dir:
+        cmd += ["--outdir", run_dir]
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.DEVNULL, text=True)
+    first = proc.stdout.readline()
+    try:
+        hello = json.loads(first)
+        url = hello["serving"]
+    except (ValueError, KeyError):
+        proc.kill()
+        raise RuntimeError(
+            f"serve subprocess never announced its port: {first!r}")
+
+    def cleanup():
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+    return url, cleanup
+
+
+# ------------------------------------------------------------ registration
+def _register_bench(record: dict, runs_root: str | None) -> None:
+    """Fleet-registry registration, ONLY under an explicit root (the
+    register_drill_record idiom: ad-hoc local runs must not grow the
+    committed ./runs index)."""
+    root = runs_root or os.environ.get("DIB_RUNS_ROOT")
+    if not root:
+        return
+    from dib_tpu.telemetry.registry import RunRegistry, bench_entry
+
+    extra = {}
+    for key in ("mode", "target_rate", "speedup_vs_baseline",
+                "cached_req_per_s"):
+        if record.get(key) is not None:
+            extra[key] = record[key]
+    RunRegistry(root).append(bench_entry(record, extra=extra))
 
 
 def main(argv=None) -> int:
@@ -285,20 +642,58 @@ def main(argv=None) -> int:
                         help="Target server base URL (e.g. http://127.0.0.1:8100).")
     parser.add_argument("--self-contained", action="store_true",
                         help="Train+checkpoint+serve a tiny CPU model "
-                             "in-process and load-test that.")
+                             "and load-test that.")
     parser.add_argument("--duration", type=float, default=5.0,
-                        help="Seconds of load.")
+                        help="Seconds of load (per rate step in sweep mode).")
     parser.add_argument("--concurrency", type=int, default=4,
                         help="Closed-loop client threads.")
     parser.add_argument("--rate", type=float, default=None,
-                        help="Open-loop offered load (requests/s); omits "
-                             "the closed loop.")
+                        help="Single-rate open loop (requests/s).")
+    parser.add_argument("--rates", type=str, default=None,
+                        help="Comma-separated offered-load ladder for the "
+                             "asyncio open-loop sweep (e.g. 400,800,1600); "
+                             "emits the serve_async_loadgen_sweep record.")
+    parser.add_argument("--cached-rate", type=float, default=0.0,
+                        help="Extra sweep row hammering ONE repeated input "
+                             "through the response cache at this rate "
+                             "(0 = skip).")
+    parser.add_argument("--tenants", type=int, default=8,
+                        help="Tenant ids round-robined across sweep "
+                             "requests (the well-behaved mix).")
+    parser.add_argument("--connections", type=int, default=64,
+                        help="Persistent client connections in sweep mode.")
     parser.add_argument("--train-epochs", type=int, default=20,
                         help="Self-contained mode's training budget.")
+    parser.add_argument("--serve-prefork", type=int, default=3,
+                        help="Sweep self-contained server: full server "
+                             "processes sharing the port via SO_REUSEPORT "
+                             "(the HTTP-plane GIL escape; 0 = single "
+                             "process).")
+    parser.add_argument("--serve-workers", type=int, default=0,
+                        help="Sweep self-contained server: per-process "
+                             "engine-pool workers behind the pipe plane "
+                             "(0 = in-process engine; pays off when model "
+                             "dispatch dominates, not for the tiny bench "
+                             "model).")
+    parser.add_argument("--serve-response-cache", type=int, default=4096,
+                        help="Sweep self-contained server: response-cache "
+                             "capacity (0 disables).")
+    parser.add_argument("--serve-quota-rps", type=float, default=0.0,
+                        help="Sweep self-contained server: per-tenant "
+                             "quota rate (0 disables; pick comfortably "
+                             "above offered-rate/tenants for a "
+                             "well-behaved mix).")
+    parser.add_argument("--serve-admission-limit", type=int, default=0,
+                        help="Sweep self-contained server: in-flight bound "
+                             "(0 disables).")
     parser.add_argument("--serve-run-dir", default=None,
                         help="Self-contained mode: keep the serving event "
                              "stream here (renderable by `python -m "
                              "dib_tpu telemetry report`).")
+    parser.add_argument("--runs-root", default=None,
+                        help="Register the record in the fleet run "
+                             "registry under this root (or DIB_RUNS_ROOT; "
+                             "never the committed ./runs by default).")
     parser.add_argument("--out", default=None,
                         help="Also write the JSON record to this path.")
     args = parser.parse_args(argv)
@@ -306,18 +701,51 @@ def main(argv=None) -> int:
     if bool(args.url) == bool(args.self_contained):
         parser.error("pass exactly one of --url / --self-contained")
 
+    sweep_rates = ([float(r) for r in args.rates.split(",") if r.strip()]
+                   if args.rates else None)
+
     cleanup = None
     if args.self_contained:
-        server, cleanup = _self_contained_server(
-            args.serve_run_dir, args.train_epochs
-        )
-        url = server.url
+        if sweep_rates:
+            serve_args = ["--workers", str(args.serve_workers),
+                          "--response_cache", str(args.serve_response_cache),
+                          "--max_batch", "128"]
+            if args.serve_prefork > 0:
+                serve_args += ["--prefork", str(args.serve_prefork)]
+            if args.serve_quota_rps > 0:
+                serve_args += ["--quota_rps", str(args.serve_quota_rps)]
+            if args.serve_admission_limit > 0:
+                serve_args += ["--admission_limit",
+                               str(args.serve_admission_limit)]
+            url, cleanup = _self_contained_subprocess(
+                args.serve_run_dir, args.train_epochs, serve_args)
+        else:
+            server, cleanup = _self_contained_server(
+                args.serve_run_dir, args.train_epochs
+            )
+            url = server.url
     else:
         url = args.url.rstrip("/")
 
-    record: dict = {"metric": METRIC, "unit": "req_per_s",
-                    "mode": "open" if args.rate else "closed",
-                    "duration_s": args.duration}
+    if sweep_rates:
+        record: dict = {"metric": SWEEP_METRIC, "unit": "req_per_s",
+                        "mode": "open_sweep",
+                        "duration_s": args.duration,
+                        "tenants": args.tenants,
+                        "connections": args.connections,
+                        "baseline_req_per_s": BASELINE_REQ_PER_S}
+        if args.self_contained:
+            record["server"] = {
+                "prefork": args.serve_prefork,
+                "pool_workers": args.serve_workers,
+                "response_cache": args.serve_response_cache,
+                "quota_rps": args.serve_quota_rps,
+                "admission_limit": args.serve_admission_limit,
+            }
+    else:
+        record = {"metric": METRIC, "unit": "req_per_s",
+                  "mode": "open" if args.rate else "closed",
+                  "duration_s": args.duration}
     try:
         # /healthz between phases: the pre-load poll shapes the traffic
         # (feature width) and pins the starting health; the post-load poll
@@ -333,16 +761,57 @@ def main(argv=None) -> int:
             )
         width = int(health["feature_width"])
         record["replicas"] = len(health.get("replicas", []))
-        t0 = time.perf_counter()
-        if args.rate:
-            stats = run_open_loop(url, width, args.duration, args.rate)
-            record["target_rate"] = args.rate
+
+        if sweep_rates:
+            ceiling_ms = _slo_p99_ceiling_ms()
+            record["p99_ceiling_ms"] = ceiling_ms
+            sweep = run_rate_sweep(
+                url, width, sweep_rates, args.duration, args.tenants,
+                args.connections, ceiling_ms,
+                cached_rate=args.cached_rate,
+                server_processes=(max(args.serve_prefork, 1)
+                                  if args.self_contained else 1))
+            record["rows"] = sweep["rows"]
+            # headline: best sustained UNCACHED rate that held the SLO
+            good = [r for r in sweep["rows"]
+                    if not r["cached"] and r.get("within_slo")]
+            if good:
+                best = max(good, key=lambda r: r["value"])
+                record["value"] = best["value"]
+                record["target_rate"] = best["target_rate"]
+                record["latency_ms"] = best["latency_ms"]
+                record["speedup_vs_baseline"] = round(
+                    best["value"] / BASELINE_REQ_PER_S, 2)
+            else:
+                record["value"] = None
+                record["degraded"] = "no_rate_within_slo"
+            cached_rows = [r for r in sweep["rows"]
+                           if r["cached"] and r.get("value")]
+            if cached_rows:
+                best_cached = max(cached_rows, key=lambda r: r["value"])
+                record["cached_req_per_s"] = best_cached["value"]
+                cache = best_cached["cache"]
+                lookups = (cache.get("response_hits", 0)
+                           + cache.get("response_misses", 0))
+                if lookups:
+                    record["response_cache_hit_frac"] = round(
+                        cache["response_hits"] / lookups, 6)
+            total_sent = sum(r["requests_sent"] for r in sweep["rows"])
+            total_quota = sum(r["cache"].get("quota_rejected", 0)
+                              for r in sweep["rows"])
+            record["quota_rejected_frac"] = round(
+                total_quota / max(total_sent, 1), 6)
         else:
-            stats = run_closed_loop(url, width, args.duration,
-                                    args.concurrency)
-            record["concurrency"] = args.concurrency
-        elapsed = time.perf_counter() - t0
-        record["batch_fill_ratio"] = _batch_fill_from_metrics(url)
+            t0 = time.perf_counter()
+            if args.rate:
+                stats = run_open_loop(url, width, args.duration, args.rate)
+                record["target_rate"] = args.rate
+            else:
+                stats = run_closed_loop(url, width, args.duration,
+                                        args.concurrency)
+                record["concurrency"] = args.concurrency
+            elapsed = time.perf_counter() - t0
+            record["batch_fill_ratio"] = _batch_fill_from_metrics(url)
         status, health = _poll_health(url)
         record["health"]["after"] = _health_snapshot(status, health)
         if status != 200:
@@ -358,21 +827,17 @@ def main(argv=None) -> int:
             cleanup()
         return 1
 
-    n = len(stats.latencies)
-    record["num_requests"] = n
-    record["errors"] = stats.errors
-    if n:
-        ordered = sorted(stats.latencies)
-        record["value"] = round(n / elapsed, 3)
-        record["latency_ms"] = {
-            "p50": round(_percentile(ordered, 0.5) * 1e3, 3),
-            "p90": round(_percentile(ordered, 0.9) * 1e3, 3),
-            "p99": round(_percentile(ordered, 0.99) * 1e3, 3),
-            "mean": round(sum(ordered) / n * 1e3, 3),
-        }
-    else:
-        record["value"] = None
-        record["degraded"] = "no_successful_requests"
+    if not sweep_rates:
+        n = len(stats.latencies)
+        record["num_requests"] = n
+        record["errors"] = stats.errors
+        if n:
+            ordered = sorted(stats.latencies)
+            record["value"] = round(n / elapsed, 3)
+            record["latency_ms"] = _latency_block(ordered)
+        else:
+            record["value"] = None
+            record["degraded"] = "no_successful_requests"
     record["measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
     if cleanup is not None:
         cleanup()   # graceful: drains batchers, writes run_end
@@ -384,7 +849,16 @@ def main(argv=None) -> int:
     if args.out:
         with open(args.out, "w") as f:
             f.write(line + "\n")
+    _register_bench(record, args.runs_root)
     return 0 if record.get("value") is not None else 1
+
+
+def _batch_fill_from_metrics(url: str) -> float | None:
+    try:
+        metrics = _get_json(url + "/metrics")
+        return metrics["histograms"]["serve.batch_fill"]["mean"]
+    except Exception:
+        return None
 
 
 if __name__ == "__main__":

@@ -136,7 +136,9 @@ def test_scope_and_target_modules():
     assert not timing.applies_to("scripts/bench_driver.py")
     host = get_pass("host-sync")
     assert host.applies_to("dib_tpu/train/loop.py")
-    assert not host.applies_to("dib_tpu/serve/engine.py")
+    # the serving hot path joined the target set with ISSUE 10
+    assert host.applies_to("dib_tpu/serve/engine.py")
+    assert not host.applies_to("dib_tpu/telemetry/report.py")
 
 
 def test_statement_linearization_and_assigned_names():

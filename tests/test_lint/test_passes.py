@@ -176,6 +176,8 @@ def test_host_sync_targets_only_chunk_loop_modules():
     # there serializes the whole pool), and the overlap/prefetch plumbing
     # (an implicit sync there re-serializes the boundary it exists to
     # hide)
+    # ...and (ISSUE 10) the async serving hot path, where one implicit
+    # device fetch stalls every in-flight request on the event loop
     assert set(host.target_modules) == {
         "dib_tpu/train/loop.py",
         "dib_tpu/train/measurement.py",
@@ -186,7 +188,28 @@ def test_host_sync_targets_only_chunk_loop_modules():
         "dib_tpu/sched/runner.py",
         "dib_tpu/sched/pool.py",
         "dib_tpu/sched/scheduler.py",
+        "dib_tpu/serve/engine.py",
+        "dib_tpu/serve/batcher.py",
+        "dib_tpu/serve/server.py",
+        "dib_tpu/serve/pool.py",
+        "dib_tpu/serve/zoo.py",
     }
+
+
+def test_thread_state_covers_the_async_serving_modules():
+    """thread-shared-state is TREE-WIDE (no target_modules), so the new
+    async serving modules are covered by construction — this pins that
+    they are not allowlisted away and that every serve class mutating
+    state from a thread target holds a lock (zero findings on the real
+    tree is asserted by the full-tree gate; here we pin the coverage
+    contract itself)."""
+    from dib_tpu.analysis.core import get_pass
+
+    thread_pass = get_pass("thread-shared-state")
+    assert not getattr(thread_pass, "target_modules", None)
+    for module in ("dib_tpu/serve/server.py", "dib_tpu/serve/pool.py",
+                   "dib_tpu/serve/zoo.py", "dib_tpu/serve/batcher.py"):
+        assert module not in getattr(thread_pass, "allowlist", {})
 
 
 # -------------------------------------------------- thread-shared-state

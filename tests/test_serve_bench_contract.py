@@ -1,0 +1,150 @@
+"""The async-serving bench artifact contract (ISSUE 10).
+
+BENCH_SERVE_ASYNC_CPU.json is the committed evidence the serving rebuild
+rests on: an open-loop rate sweep through the real ``python -m dib_tpu
+serve`` prefork stack, headline = best sustained uncached rate whose p99
+held the committed SLO ceiling. These tests pin the record's schema
+(per-row mode/target_rate/p99/cache counters via
+``scripts/check_run_artifacts.py``), the >= 3x-baseline floor, and the
+fleet-registry idiom (registration ONLY under an explicit runs root; the
+committed ``runs/index.jsonl`` carries the seeded serving history).
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPTS = os.path.join(REPO, "scripts")
+ARTIFACT = os.path.join(REPO, "BENCH_SERVE_ASYNC_CPU.json")
+
+
+def _load(script):
+    spec = importlib.util.spec_from_file_location(
+        script, os.path.join(SCRIPTS, script + ".py"))
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+@pytest.fixture(scope="module")
+def checker():
+    return _load("check_run_artifacts")
+
+
+@pytest.fixture(scope="module")
+def loadgen():
+    return _load("serve_loadgen")
+
+
+@pytest.fixture(scope="module")
+def committed():
+    with open(ARTIFACT) as f:
+        return json.load(f)
+
+
+def test_committed_sweep_artifact_validates(checker):
+    assert checker.check_file(ARTIFACT) == []
+
+
+def test_committed_sweep_meets_the_3x_acceptance(committed):
+    assert committed["metric"] == "serve_async_loadgen_sweep"
+    assert committed["value"] >= 3 * committed["baseline_req_per_s"]
+    assert committed["latency_ms"]["p99"] <= 20.0
+    # the cached path is reported SEPARATELY from the uncached headline
+    assert committed["cached_req_per_s"] > 0
+    assert committed["response_cache_hit_frac"] >= 0.9
+    # well-behaved tenant mix: 429s bounded (here: none)
+    assert committed["quota_rejected_frac"] <= 0.01
+    uncached = [r for r in committed["rows"] if not r["cached"]]
+    assert all(r["cache"]["response_hits"] == 0 for r in uncached), \
+        "uncached rows rode the response cache — the headline is tainted"
+
+
+def test_checker_rejects_broken_sweep_shapes(checker, committed):
+    def problems_of(mutate):
+        record = json.loads(json.dumps(committed))
+        mutate(record)
+        problems: list[str] = []
+        checker.check_record(record, problems)
+        return problems
+
+    def drop_cache(r):
+        for row in r["rows"]:
+            del row["cache"]
+
+    def no_compliant(r):
+        for row in r["rows"]:
+            row["within_slo"] = False
+
+    def below_floor(r):
+        r["value"] = 500.0
+
+    def closed_row(r):
+        r["rows"][0]["mode"] = "closed"
+
+    def no_baseline(r):
+        del r["baseline_req_per_s"]
+
+    assert any("cache" in p for p in problems_of(drop_cache))
+    assert any("never demonstrates" in p for p in problems_of(no_compliant))
+    assert any("serve_req_per_s_floor" in p for p in problems_of(below_floor))
+    assert any("'mode'" in p for p in problems_of(closed_row))
+    assert any("baseline_req_per_s" in p for p in problems_of(no_baseline))
+    assert checker.check_file(ARTIFACT) == []   # the committed one is clean
+
+
+def test_loadgen_registers_only_under_explicit_root(
+        loadgen, tmp_path, monkeypatch):
+    """The register_drill_record idiom: no explicit root (flag or
+    DIB_RUNS_ROOT) -> NOTHING is written (ad-hoc runs must not grow the
+    committed ./runs index); an explicit root gets the bench entry."""
+    record = {"metric": "serve_async_loadgen_sweep", "unit": "req_per_s",
+              "value": 1500.0, "mode": "open_sweep", "target_rate": 1600.0,
+              "speedup_vs_baseline": 4.05,
+              "measured_at": "2026-08-03T00:00:00Z"}
+    monkeypatch.delenv("DIB_RUNS_ROOT", raising=False)
+    monkeypatch.chdir(tmp_path)
+    loadgen._register_bench(record, None)
+    assert not os.path.exists(tmp_path / "runs" / "index.jsonl")
+
+    root = tmp_path / "fleet"
+    loadgen._register_bench(record, str(root))
+    lines = (root / "index.jsonl").read_text().splitlines()
+    entry = json.loads(lines[-1])
+    assert entry["kind"] == "bench"
+    assert entry["metric"] == "serve_async_loadgen_sweep"
+    assert entry["value"] == 1500.0
+    assert entry["speedup_vs_baseline"] == 4.05
+
+    from dib_tpu.telemetry.registry import validate_index_entry
+
+    assert validate_index_entry(entry) == []
+
+    # the env-var spelling works too
+    monkeypatch.setenv("DIB_RUNS_ROOT", str(root))
+    loadgen._register_bench(record, None)
+    assert len((root / "index.jsonl").read_text().splitlines()) == 2
+
+
+def test_committed_registry_carries_the_serving_history():
+    """`telemetry runs trajectory` over the committed ./runs shows the
+    seeded async-serving measurement."""
+    from dib_tpu.telemetry.registry import RunRegistry
+
+    bench = RunRegistry(os.path.join(REPO, "runs")).bench_history()
+    serving = [e for e in bench
+               if e.get("metric") == "serve_async_loadgen_sweep"]
+    assert serving, "runs/index.jsonl is missing the seeded serving entry"
+    assert serving[-1]["value"] >= 1110.0
+    assert serving[-1]["seeded_from"] == "BENCH_SERVE_ASYNC_CPU.json"
+
+
+def test_sweep_row_generator_is_distinct(loadgen):
+    """The uncached sweep's inputs must be pairwise distinct (a collision
+    would silently measure the response cache)."""
+    rows = [tuple(loadgen._row(i, 10)) for i in range(5000)]
+    assert len(set(rows)) == len(rows)

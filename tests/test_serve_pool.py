@@ -1,0 +1,151 @@
+"""Multi-process replica pool: worker death degrades, probes respawn
+(docs/serving.md "The multi-process replica pool").
+
+The PR 4 ejection drill shape, re-proven for PROCESSES: SIGKILL a worker
+subprocess mid-service and every client call still answers 200 off the
+surviving replica (zero client-visible 5xx), the dead replica ejects,
+and the re-admission probe respawns the subprocess and brings the pool
+back to full width.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from dib_tpu.data import get_dataset
+from dib_tpu.models import DistributedIBModel
+from dib_tpu.serve import DIBServer, WorkerDiedError, pool_router
+from dib_tpu.telemetry import MetricsRegistry
+
+
+@pytest.fixture(scope="module")
+def bundle():
+    return get_dataset("boolean_circuit")
+
+
+@pytest.fixture(scope="module")
+def model(bundle):
+    return DistributedIBModel(
+        feature_dimensionalities=tuple(bundle.feature_dimensionalities),
+        encoder_hidden=(8,), integration_hidden=(16,),
+        output_dim=1, embedding_dim=2,
+    )
+
+
+@pytest.fixture(scope="module")
+def params(bundle, model):
+    x0 = np.asarray(bundle.x_train[:4], np.float32)
+    return model.init(jax.random.key(0), x0, jax.random.key(1))
+
+
+def _post(url: str, payload: dict):
+    request = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(request, timeout=60) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as exc:
+        return exc.code, json.loads(exc.read())
+
+
+def test_pool_worker_death_degrades_then_probe_respawns(
+        model, params, bundle):
+    """One long test (worker spawns are the expensive part): healthy pool
+    serves bit-identically to an in-process engine; a SIGKILLed worker
+    yields ZERO client-visible 5xx while the survivor carries the load;
+    the probe respawns the dead process and re-admits the replica."""
+    from dib_tpu.serve import InferenceEngine
+
+    registry = MetricsRegistry()
+    router = pool_router(
+        model, params, num_workers=2, batch_buckets=(1, 4),
+        max_wait_ms=1.0, eject_after=1,
+        probe_after_s=0.0,       # no background thread: probes are manual
+        probe_timeout_s=60.0,    # a respawn IS slow; the probe waits it out
+        registry=registry,
+    )
+    server = DIBServer(router, port=0, registry=registry).start()
+    try:
+        rows = np.asarray(bundle.x_valid[:4], np.float32)
+        width = rows.shape[1]
+
+        # ---- healthy pool: results identical to an in-process engine
+        want = InferenceEngine(model, params,
+                               batch_buckets=(1, 4)).predict(rows)
+        for i in range(4):
+            status, payload = _post(server.url + "/v1/predict",
+                                    {"x": rows[i].tolist()})
+            assert status == 200
+            np.testing.assert_allclose(payload["prediction"][0],
+                                       want["prediction"][i], rtol=1e-6)
+        # both subprocess replicas took traffic (round-robin)
+        pids = {router.entries[i].engine.pid for i in range(2)}
+        assert len(pids) == 2 and all(p for p in pids)
+
+        # ---- SIGKILL worker 0 mid-service
+        victim = router.entries[0].engine
+        victim.kill()
+
+        # every call during degradation still answers 200: the dead
+        # replica's failure marks it and the request retries on the
+        # survivor — zero client-visible 5xx
+        codes = []
+
+        def client(i):
+            status, _ = _post(server.url + "/v1/predict",
+                              {"x": rows[i % 4].tolist()})
+            codes.append(status)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert codes == [200] * 8
+        assert router.entries[0].ejected
+        status, health = urllib.request.urlopen(
+            server.url + "/healthz", timeout=30).status, None
+        assert status == 200   # still serviceable on the survivor
+
+        # ---- probe-driven respawn: the ejected entry's probe dispatch
+        # relaunches the subprocess, and a fresh interpreter + engine
+        # re-admits it
+        readmitted = router.probe_ejected(force=True)
+        assert readmitted == 1
+        assert not router.entries[0].ejected
+        assert victim.respawns == 1
+        assert victim.pid not in (None,) and victim.alive()
+        # the respawned worker serves bit-identically
+        status, payload = _post(server.url + "/v1/predict",
+                                {"x": rows[0].tolist()})
+        assert status == 200
+        np.testing.assert_allclose(payload["prediction"][0],
+                                   want["prediction"][0], rtol=1e-6)
+    finally:
+        server.close()
+
+
+def test_worker_spec_rejects_dead_worker_without_respawn(model, params):
+    """respawn=False is the hard-degradation mode: a dead worker stays a
+    WorkerDiedError (the router ejects it permanently)."""
+    from dib_tpu.serve.pool import WorkerReplica, worker_spec
+
+    spec = worker_spec(model, params, batch_buckets=(1,))
+    worker = WorkerReplica(spec, respawn=False)
+    try:
+        worker.wait_ready(120.0)
+        out = worker.predict(np.zeros(worker.feature_width, np.float32))
+        assert out["prediction"].shape == (1, 1)
+        worker.kill()
+        with pytest.raises(WorkerDiedError):
+            worker.predict(np.zeros(worker.feature_width, np.float32))
+    finally:
+        worker.close()

@@ -1,0 +1,178 @@
+"""Prefork socket request plane (serve/prefork.py): N full server
+processes on one SO_REUSEPORT port, supervised.
+
+The committed BENCH_SERVE_ASYNC_CPU.json headline flows through this
+plane, so the fleet smoke here is the CI anchor for it: spawn a 2-worker
+fleet through the REAL CLI, prove the kernel spreads connections across
+distinct worker pids, SIGKILL one worker and see traffic survive on the
+other while the supervisor respawns the dead one.
+"""
+
+import importlib.util
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from dib_tpu.serve.prefork import reserve_port, strip_flag
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------------ units
+def test_strip_flag_is_positional():
+    argv = ["--workers", "2", "--port", "8100", "--model_name", "port",
+            "--prefork=3", "--prefork", "4"]
+    # value-equality filtering would eat the "port" MODEL-NAME VALUE; the
+    # positional strip removes only flag occurrences + their values, in
+    # both "--f v" and "--f=v" spellings
+    assert strip_flag(argv, "--prefork", True) == [
+        "--workers", "2", "--port", "8100", "--model_name", "port"]
+    assert strip_flag(argv, "--port", True) == [
+        "--workers", "2", "--model_name", "port", "--prefork=3",
+        "--prefork", "4"]
+    assert strip_flag(["--reuse_port", "--x"], "--reuse_port", False) \
+        == ["--x"]
+
+
+def test_strip_flag_matches_argparse_prefix_abbreviations():
+    """The fork-bomb regression (the PR 8 --watchdog bug class): argparse
+    accepts `--prefor 3` as --prefork, so the supervisor must strip the
+    ABBREVIATED spellings too — otherwise every worker re-exec parses
+    prefork=3 again and spawns its own fleet, recursively."""
+    for spelling in ("--prefork", "--prefor", "--pref", "--prefork=3",
+                     "--prefor=3"):
+        argv = ["--workers", "2", spelling]
+        if "=" not in spelling:
+            argv.append("3")
+        assert strip_flag(argv, "--prefork", True) == ["--workers", "2"], \
+            spelling
+    # a DIFFERENT flag sharing no prefix relationship is untouched
+    assert strip_flag(["--prefork", "3", "--probe_after_s", "5"],
+                      "--prefork", True) == ["--probe_after_s", "5"]
+
+
+def test_reserve_port_does_not_listen():
+    sock, port = reserve_port("127.0.0.1")
+    try:
+        assert port > 0
+        # a listening reuseport socket can bind the same port...
+        worker = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        worker.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        worker.bind(("127.0.0.1", port))
+        worker.listen(8)
+        # ...and receives the connections (the reserver never listens, so
+        # the kernel routes nothing to it)
+        client = socket.create_connection(("127.0.0.1", port), timeout=5)
+        conn, _ = worker.accept()
+        conn.close()
+        client.close()
+        worker.close()
+    finally:
+        sock.close()
+
+
+def test_supervise_prefork_rejects_zero():
+    from dib_tpu.serve.prefork import supervise_prefork
+
+    with pytest.raises(ValueError, match="prefork"):
+        supervise_prefork([], prefork=0, host="127.0.0.1", port=0,
+                          outdir=".")
+
+
+# ------------------------------------------------------------ fleet smoke
+def _load_loadgen():
+    spec = importlib.util.spec_from_file_location(
+        "serve_loadgen", os.path.join(REPO, "scripts", "serve_loadgen.py"))
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+def _post(url, payload):
+    request = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(request, timeout=30) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def test_prefork_fleet_smoke(tmp_path):
+    """2 workers on one port through `python -m dib_tpu serve --prefork`:
+    distinct pids answer, worker death degrades without an outage, the
+    supervisor respawns, SIGTERM shuts the fleet down cleanly."""
+    lg = _load_loadgen()
+    ckpt_dir, _, _ = lg._train_tiny_checkpoint(6)
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dib_tpu", "serve",
+         "--checkpoint_dir", ckpt_dir, *lg._TINY_ARCH_FLAGS,
+         "--prefork", "2", "--port", "0",
+         "--buckets", "1", "8", "--max_batch", "8",
+         "--outdir", str(tmp_path / "fleet")],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=REPO, env=env)
+    try:
+        hello = json.loads(proc.stdout.readline())
+        assert hello["prefork"] == 2
+        assert len(hello["workers"]) == 2
+        url = hello["serving"]
+
+        health = _get(url + "/healthz")
+        assert health["status"] == "ok"
+        width = health["feature_width"]
+        row = [0.0] * width
+
+        # the kernel spreads fresh connections across BOTH worker pids
+        pids = set()
+        for _ in range(24):
+            pids.add(_get(url + "/metrics").get("pid"))
+            if len(pids) == 2:
+                break
+        assert len(pids) == 2, "kernel never balanced across the fleet"
+
+        status, payload = _post(url + "/v1/predict", {"x": row})
+        assert status == 200 and "prediction" in payload
+
+        # ---- SIGKILL one worker: the survivor carries traffic, the
+        # supervisor respawns the dead one (stderr log + healed capacity)
+        victim = hello["workers"][0]
+        os.kill(victim, signal.SIGKILL)
+        ok = 0
+        for _ in range(20):
+            try:
+                status, _ = _post(url + "/v1/predict", {"x": row})
+                ok += status == 200
+            except OSError:
+                pass   # a connection routed at the kill instant may reset
+            time.sleep(0.05)
+        assert ok >= 15, "fleet lost service during single-worker death"
+
+        deadline = time.monotonic() + 60
+        new_pids = set()
+        while time.monotonic() < deadline:
+            new_pids.add(_get(url + "/metrics").get("pid"))
+            if len(new_pids - {victim}) == 2:
+                break
+            time.sleep(0.25)
+        assert len(new_pids - {victim}) == 2, \
+            "supervisor never respawned the killed worker"
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+    assert proc.returncode == 0
+    assert "respawning" in proc.stderr.read()

@@ -1,0 +1,241 @@
+"""Model zoo: executable LRU, response cache, reload invalidation
+(docs/serving.md "The model zoo and its two caches").
+
+The load-bearing contracts:
+
+  - lazy engines produce BIT-IDENTICAL results to eager ones, through
+    compile-on-miss, cache hits, and recompile-after-eviction;
+  - a reloaded checkpoint NEVER serves stale cached responses (the
+    invalidation test the ISSUE names);
+  - model selection over one endpoint routes to the named checkpoint.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from dib_tpu.data import get_dataset
+from dib_tpu.models import DistributedIBModel
+from dib_tpu.serve import (
+    DIBServer,
+    ExecutableLRU,
+    InferenceEngine,
+    MicroBatcher,
+    ModelZoo,
+    ReplicaEntry,
+    ReplicaRouter,
+    ResponseCache,
+)
+from dib_tpu.telemetry import MetricsRegistry
+
+
+@pytest.fixture(scope="module")
+def bundle():
+    return get_dataset("boolean_circuit")
+
+
+@pytest.fixture(scope="module")
+def model(bundle):
+    return DistributedIBModel(
+        feature_dimensionalities=tuple(bundle.feature_dimensionalities),
+        encoder_hidden=(8,), integration_hidden=(16,),
+        output_dim=1, embedding_dim=2,
+    )
+
+
+@pytest.fixture(scope="module")
+def params(bundle, model):
+    x0 = np.asarray(bundle.x_train[:4], np.float32)
+    return model.init(jax.random.key(0), x0, jax.random.key(1))
+
+
+@pytest.fixture(scope="module")
+def params_b(bundle, model):
+    x0 = np.asarray(bundle.x_train[:4], np.float32)
+    return model.init(jax.random.key(7), x0, jax.random.key(8))
+
+
+# --------------------------------------------------------------- exec LRU
+def test_lazy_engine_matches_eager_through_hits_and_evictions(
+        model, params, bundle):
+    """Lazy compile-on-miss, hit, evict, recompile — every path returns
+    exactly what the eager engine returns, and the counters tell the
+    story."""
+    registry = MetricsRegistry()
+    lru = ExecutableLRU(2, registry=registry)
+    lazy = InferenceEngine(model, params, batch_buckets=(1, 4),
+                           exec_cache=lru, cache_key="m/r0")
+    eager = InferenceEngine(model, params, batch_buckets=(1, 4))
+    rows = np.asarray(bundle.x_valid[:3], np.float32)
+
+    def counters():
+        c = registry.snapshot()["counters"]
+        return (c.get("serve.cache.exec.hits", 0),
+                c.get("serve.cache.exec.misses", 0),
+                c.get("serve.cache.exec.evictions", 0))
+
+    assert counters() == (0, 0, 0)   # nothing compiled at init (lazy)
+    one = lazy.predict(rows[0])                      # miss: (predict, 1)
+    np.testing.assert_array_equal(one["prediction"],
+                                  eager.predict(rows[0])["prediction"])
+    assert counters() == (0, 1, 0)
+    lazy.predict(rows[0])                            # hit
+    assert counters() == (1, 1, 0)
+    batch = lazy.predict(rows)                       # miss: (predict, 4)
+    np.testing.assert_array_equal(batch["prediction"],
+                                  eager.predict(rows)["prediction"])
+    assert counters() == (1, 2, 0)
+    enc = lazy.encode(rows[0])                       # miss -> EVICTS (predict,1)
+    np.testing.assert_array_equal(enc["mus"],
+                                  eager.encode(rows[0])["mus"])
+    assert counters() == (1, 3, 1)
+    assert lru.stats() == {"entries": 2, "capacity": 2}
+    # the evicted executable recompiles transparently, bit-identical
+    again = lazy.predict(rows[0])
+    np.testing.assert_array_equal(again["prediction"], one["prediction"])
+    assert counters()[1] == 4
+
+
+def test_exec_lru_invalidate_by_prefix(model, params):
+    registry = MetricsRegistry()
+    lru = ExecutableLRU(8, registry=registry)
+    a = InferenceEngine(model, params, batch_buckets=(1,),
+                        exec_cache=lru, cache_key="a/r0")
+    b = InferenceEngine(model, params, batch_buckets=(1,),
+                        exec_cache=lru, cache_key="b/r0")
+    x = np.zeros(a.feature_width, np.float32)
+    a.predict(x), b.predict(x)
+    assert lru.stats()["entries"] == 2
+    assert lru.invalidate("a/") == 1
+    assert lru.stats()["entries"] == 1
+    b.predict(x)   # b's executable survived
+    assert registry.snapshot()["counters"]["serve.cache.exec.hits"] == 1
+
+
+# ---------------------------------------------------------- response cache
+def test_response_cache_lru_and_stats():
+    cache = ResponseCache(2, registry=MetricsRegistry())
+    k1, k2, k3 = ("m", "predict", None, "d1"), ("m", "predict", None, "d2"), \
+        ("m", "predict", None, "d3")
+    assert cache.get(k1) is None
+    cache.put(k1, {"v": 1})
+    cache.put(k2, {"v": 2})
+    assert cache.get(k1) == {"v": 1}
+    cache.put(k3, {"v": 3})          # evicts k2 (k1 was touched)
+    assert cache.get(k2) is None
+    assert cache.get(k1) == {"v": 1}
+    assert cache.stats() == {"entries": 2, "capacity": 2}
+
+
+def _zoo_server(zoo):
+    return DIBServer(zoo, port=0)   # handle_post facade; no socket needed
+
+
+def _router(model, params, zoo=None, name=None, registry=None):
+    engine = InferenceEngine(
+        model, params, batch_buckets=(1, 4), registry=registry,
+        exec_cache=zoo.exec_cache if zoo is not None else None,
+        cache_key=f"{name}/r0" if name is not None else None)
+    return ReplicaRouter(
+        [ReplicaEntry(engine, MicroBatcher(engine, max_wait_ms=0.0), 0)])
+
+
+def test_response_cache_invalidated_on_checkpoint_reload(
+        model, params, params_b, bundle):
+    """THE invalidation contract: after ``ModelZoo.reload``, a repeated
+    query re-dispatches against the NEW params — yesterday's cached
+    answer never survives the swap (and the old executables are
+    evicted)."""
+    registry = MetricsRegistry()
+    zoo = ModelZoo(exec_capacity=8, response_capacity=32,
+                   registry=registry)
+    zoo.register("m", _router(model, params, zoo=zoo, name="m"))
+    server = _zoo_server(zoo)
+    try:
+        row = np.asarray(bundle.x_valid[0], np.float32).tolist()
+        status, first = server.handle_post("/v1/predict", {"x": row})
+        assert status == 200 and "cached" not in first
+        status, second = server.handle_post("/v1/predict", {"x": row})
+        assert status == 200 and second.get("cached") is True
+        assert second["prediction"] == first["prediction"]
+
+        zoo.reload("m", _router(model, params_b, zoo=zoo, name="m"))
+
+        status, third = server.handle_post("/v1/predict", {"x": row})
+        assert status == 200
+        # NOT served from the stale cache...
+        assert "cached" not in third
+        # ...and numerically the NEW checkpoint's answer
+        want = InferenceEngine(model, params_b,
+                               batch_buckets=(4,)).predict(
+            np.asarray([row], np.float32))
+        np.testing.assert_allclose(third["prediction"],
+                                   want["prediction"], rtol=1e-6)
+        assert third["prediction"] != first["prediction"]
+        # a repeat is cached again, against the new params
+        status, fourth = server.handle_post("/v1/predict", {"x": row})
+        assert fourth.get("cached") is True
+        assert fourth["prediction"] == third["prediction"]
+        counters = registry.snapshot()["counters"]
+        assert counters["serve.cache.response.invalidations"] == 1
+        assert counters["serve.zoo.reloads"] == 1
+    finally:
+        server.close()
+
+
+def test_reload_unknown_model_raises(model, params):
+    zoo = ModelZoo()
+    zoo.register("m", _router(model, params))
+    with pytest.raises(KeyError, match="not registered"):
+        zoo.reload("nope", _router(model, params))
+    zoo.close()
+
+
+# ------------------------------------------------------------ zoo routing
+def test_model_selection_routes_to_named_checkpoint(
+        model, params, params_b, bundle):
+    """Two checkpoints behind one endpoint: {"model": name} selects, the
+    default resolves to the first registered, unknown names 404."""
+    zoo = ModelZoo(response_capacity=8)
+    zoo.register("alpha", _router(model, params))
+    zoo.register("bravo", _router(model, params_b))
+    server = _zoo_server(zoo)
+    try:
+        row = np.asarray(bundle.x_valid[1], np.float32).tolist()
+        status, default = server.handle_post("/v1/predict", {"x": row})
+        assert status == 200 and default["model"] == "alpha"
+        status, named = server.handle_post("/v1/predict",
+                                           {"x": row, "model": "bravo"})
+        assert status == 200 and named["model"] == "bravo"
+        assert named["prediction"] != default["prediction"]
+        # per-(model, input) cache keys never cross checkpoints
+        status, named2 = server.handle_post("/v1/predict",
+                                            {"x": row, "model": "bravo"})
+        assert named2.get("cached") is True
+        assert named2["prediction"] == named["prediction"]
+        status, missing = server.handle_post("/v1/predict",
+                                             {"x": row, "model": "zulu"})
+        assert status == 404 and "zulu" in missing["error"]
+        # the registry surface
+        status, listing = server.handle_get("/v1/models")
+        assert status == 200
+        assert [m["model"] for m in listing["models"]] == ["alpha", "bravo"]
+    finally:
+        server.close()
+
+
+def test_zoo_add_params_and_describe(model, params, bundle):
+    zoo = ModelZoo(exec_capacity=4, response_capacity=4)
+    zoo.add_params("m", model, params, batch_buckets=(1, 4),
+                   max_wait_ms=0.0, checkpoint_dir="/tmp/ckpt-m")
+    name, router = zoo.resolve(None)
+    assert name == "m" and len(router.entries) >= 1
+    x = np.asarray(bundle.x_valid[:2], np.float32)
+    got = router.entries[0].batcher(x, timeout_s=30.0)
+    want = InferenceEngine(model, params, batch_buckets=(4,)).predict(x)
+    np.testing.assert_array_equal(got["prediction"], want["prediction"])
+    desc = zoo.describe()
+    assert desc[0]["model"] == "m"
+    assert desc[0]["checkpoint_dir"] == "/tmp/ckpt-m"
+    assert zoo.cache_stats()["exec"]["capacity"] == 4
+    zoo.close()

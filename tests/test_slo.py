@@ -359,3 +359,110 @@ def test_check_run_bare_filename_operand(tmp_path, monkeypatch):
                            "slo.json"]) == 1
     (alert,) = read_events(str(tmp_path), types=("alert",))
     assert alert["rule"] == "floor"
+
+
+# ===================================================== serving SLO (ISSUE 10)
+COMMITTED_SLO = os.path.join(REPO, "SLO.json")
+
+
+def _sweep_record(**overrides) -> dict:
+    """A minimal serve_async_loadgen_sweep bench one-liner the serve
+    rules evaluate (the committed-record shape, small)."""
+    record = {
+        "metric": "serve_async_loadgen_sweep",
+        "unit": "req_per_s",
+        "value": 1597.7,
+        "response_cache_hit_frac": 0.999,
+        "quota_rejected_frac": 0.0,
+        "baseline_req_per_s": 370.0,
+    }
+    record.update(overrides)
+    return record
+
+
+def test_serve_sweep_rules_exit_codes(tmp_path):
+    """`telemetry check` exit codes for each new serving rule, against
+    the COMMITTED SLO.json: clean record -> 0; a throughput regression,
+    a cold cached-path, and an over-quota tenant mix each -> 1 with the
+    matching rule violated."""
+    cases = {
+        "clean": (_sweep_record(), 0, None),
+        "req_floor": (_sweep_record(value=900.0), 1,
+                      "serve_req_per_s_floor"),
+        "cache_hit": (_sweep_record(response_cache_hit_frac=0.5), 1,
+                      "serve_cache_hit_floor"),
+        "quota": (_sweep_record(quota_rejected_frac=0.05), 1,
+                  "serve_quota_rejection_ceiling"),
+    }
+    for label, (record, want_rc, rule) in cases.items():
+        path = tmp_path / f"{label}.json"
+        path.write_text(json.dumps(record))
+        report = check_run(str(path), COMMITTED_SLO)
+        assert (1 if report["violations"] else 0) == want_rc, (label, report)
+        if rule is not None:
+            violated = [r["rule"] for r in report["rules"]
+                        if r["status"] == "violated"]
+            assert violated == [rule], (label, violated)
+        assert telemetry_main(["check", str(path), "--slo",
+                               COMMITTED_SLO]) == want_rc
+
+
+def test_serve_rules_skip_non_serving_operands():
+    """The when-guard keeps the serving rules off every other record
+    kind: the committed training fixture and the north-star bench lines
+    must not trip them."""
+    report = check_run(FIXTURE_RUN, COMMITTED_SLO, write=False)
+    serving_rows = {r["rule"]: r for r in report["rules"]
+                    if r["rule"].startswith("serve_")}
+    for name in ("serve_req_per_s_floor", "serve_cache_hit_floor",
+                 "serve_quota_rejection_ceiling"):
+        assert serving_rows[name]["status"] == "skipped"
+
+
+def test_committed_serve_async_bench_passes_committed_slo():
+    """The committed BENCH_SERVE_ASYNC_CPU.json + SLO.json pair stays
+    green: the acceptance evidence is re-validated on every run."""
+    record_path = os.path.join(REPO, "BENCH_SERVE_ASYNC_CPU.json")
+    report = check_run(record_path, COMMITTED_SLO)
+    assert report["violations"] == 0, report
+    by_rule = {r["rule"]: r for r in report["rules"]}
+    assert by_rule["serve_req_per_s_floor"]["status"] == "ok"
+    assert by_rule["serve_cache_hit_floor"]["status"] == "ok"
+    assert by_rule["serve_quota_rejection_ceiling"]["status"] == "ok"
+    # the headline actually clears 3x the PR 3 baseline
+    with open(record_path) as f:
+        record = json.load(f)
+    assert record["value"] >= 3 * record["baseline_req_per_s"]
+
+
+def test_serve_stream_rejection_rule(tmp_path):
+    """The stream-level rejection guard: a serving stream whose request
+    spans are >5% quota rejections violates; a clean mix passes; streams
+    without request spans skip."""
+    from dib_tpu.telemetry import Tracer, runtime_manifest
+
+    def write_stream(directory, quota, ok):
+        writer = EventWriter(str(directory))
+        writer.run_start(runtime_manifest(extra={"mode": "serve"}))
+        tracer = Tracer(writer)
+        for _ in range(ok):
+            tracer.add("request", 0.002, op="predict", status="ok", rows=1,
+                       tenant="polite")
+        for _ in range(quota):
+            tracer.add("request", 0.0001, op="predict", status="quota",
+                       rows=0, tenant="greedy")
+        writer.run_end(status="ok")
+        writer.close()
+
+    write_stream(tmp_path / "noisy", quota=10, ok=10)
+    report = check_run(str(tmp_path / "noisy"), COMMITTED_SLO,
+                       write=False)
+    violated = [r["rule"] for r in report["rules"]
+                if r["status"] == "violated"]
+    assert "serve_stream_rejection_ceiling" in violated
+
+    write_stream(tmp_path / "clean", quota=0, ok=20)
+    report = check_run(str(tmp_path / "clean"), COMMITTED_SLO,
+                       write=False)
+    by_rule = {r["rule"]: r for r in report["rules"]}
+    assert by_rule["serve_stream_rejection_ceiling"]["status"] == "ok"
