@@ -5,15 +5,24 @@ PR 4's fault drills found two latent buffer-donation bugs at *runtime*
 already reused) — a defect class that is decidable from the AST. This
 package is the static-analysis layer that catches those bug classes
 before a drill (or production) has to: one shared AST walker with
-parent/scope tracking (``core.py``), a pass registry, one pragma
-grammar (``# lint-ok(<pass>): <reason>`` — reasons mandatory), per-pass
-module allowlists with justifications, and one CLI::
+parent/scope tracking (``core.py``), an INTERPROCEDURAL dataflow engine
+(``project.py``: project-wide symbol table, call-graph edges with
+bound/unbound argument mapping, fixpoint taint summaries so donation /
+key-consumption / loop-blocking / resource facts cross helper
+boundaries), a pass registry, one pragma grammar
+(``# lint-ok(<pass>): <reason>`` — reasons mandatory), per-pass module
+allowlists with justifications, and one CLI::
 
-    python -m dib_tpu lint [paths...] [--select pass,...] [--json]
+    python -m dib_tpu lint [paths...] [--select pass,...]
+                           [--json | --sarif] [--changed] [--stats]
 
-Exit codes: 0 clean, 1 findings, 2 bad usage. See docs/static-analysis.md
-for the pass catalog (each pass names the runtime incident it prevents)
-and how to add a pass.
+Exit codes: 0 clean, 1 findings (or budget violation under ``--stats``),
+2 bad usage. ``--changed`` is the incremental mode (content-hash cache
+under ``.dib_lint_cache/``, bit-identical to a cold run); ``--stats``
+gates the per-pass suppression counts against the committed
+``LINT_BUDGET.json``. See docs/static-analysis.md for the pass catalog
+(each pass names the runtime incident it prevents) and how to add a
+pass.
 """
 
 from dib_tpu.analysis.core import (
@@ -26,15 +35,20 @@ from dib_tpu.analysis.core import (
     run_passes,
 )
 from dib_tpu.analysis.cli import lint_main
+from dib_tpu.analysis.cache import run_tree
+from dib_tpu.analysis.project import Project
 
 # Importing the pass modules registers them (each module calls @register
 # at import time). Keep this list in sync with docs/static-analysis.md.
 from dib_tpu.analysis.passes import (  # noqa: F401
+    async_blocking,
     donation,
     event_schema,
     exceptions,
     host_sync,
+    mesh,
     prng,
+    resource_lifecycle,
     thread_state,
     timing,
 )
@@ -43,9 +57,11 @@ __all__ = [
     "Finding",
     "LintPass",
     "Module",
+    "Project",
     "all_passes",
     "get_pass",
     "lint_main",
     "register",
     "run_passes",
+    "run_tree",
 ]

@@ -1,0 +1,267 @@
+"""Content-hash incremental cache: ``lint --changed`` re-analyzes only
+dirty files plus their reverse-dependency closure.
+
+The suite is CI-grade only if running it on every commit is cheap. The
+parse pass is cheap by construction (one ``ast.parse`` per file); the
+expensive part is the passes themselves — so the cache stores each
+file's PER-MODULE findings keyed by its content hash, and an
+incremental run replays cached findings for every file whose analysis
+provably cannot have changed.
+
+The correctness argument (the ``--changed`` ≡ cold-run bit-identity the
+tier-1 test pins): a file's per-module findings depend on (a) its own
+content and (b) the content of the modules it transitively imports
+inside the lint roots — that is exactly what the interprocedural
+summaries read (analysis/project.py resolves nothing outside the
+project). So the re-analysis set is the dirty files plus the REVERSE
+closure of the fresh import graph over them; everything outside that
+set replays byte-identically from the cache. Facts still come from the
+FULL fresh project (every file is re-parsed every run), so a dirty
+helper's new summary is visible to every re-analyzed caller.
+
+Invalidation is total when the analyzer itself changes: the cache key
+includes a fingerprint of every ``dib_tpu/analysis/`` source file plus
+the registered pass ids, so editing a pass (or this module) discards
+the whole cache instead of replaying findings a different analyzer
+produced. The same treatment covers the two PROJECT-GLOBAL fact sets
+that deliberately escape the import graph — the mesh axis facts the
+``mesh-consistency`` pass collects from every module, and the runtime
+``EVENT_SCHEMA`` rows the ``event-schema`` pass checks call sites
+against: their digest rides the cache, and a change discards the whole
+cache rather than letting a module outside the closure replay findings
+computed against old global facts. Project-level checks (docs drift)
+are re-run every time — they are cheap and depend on files outside the
+roots.
+
+Cache location: ``<root>/.dib_lint_cache/cache.json`` (gitignored).
+A missing/corrupt/stale-versioned cache degrades to a cold run, never
+an error.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+from typing import Iterable
+
+from dib_tpu.analysis import core
+from dib_tpu.analysis.core import Finding, Module
+
+CACHE_VERSION = 1
+CACHE_DIRNAME = ".dib_lint_cache"
+
+
+def cache_path(root: str) -> str:
+    return os.path.join(root, CACHE_DIRNAME, "cache.json")
+
+
+def _content_hash(source: str) -> str:
+    return hashlib.sha256(source.encode("utf-8")).hexdigest()
+
+
+def analyzer_fingerprint() -> str:
+    """Hash of the analyzer's own sources + registered pass ids — a pass
+    edit must invalidate every cached finding."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    digest = hashlib.sha256()
+    for dirpath, dirnames, filenames in os.walk(here):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for fname in sorted(filenames):
+            if fname.endswith(".py"):
+                with open(os.path.join(dirpath, fname), "rb") as f:
+                    digest.update(f.read())
+    digest.update(",".join(sorted(core.REGISTRY)).encode())
+    return digest.hexdigest()
+
+
+def global_facts_digest(project) -> str:
+    """Digest of the project-global facts that per-module findings may
+    read WITHOUT an import edge: the mesh axis facts (collected from
+    every module) and the runtime EVENT_SCHEMA rows. A change in either
+    invalidates the whole cache — the reverse-dep closure cannot bound
+    their blast radius."""
+    from dib_tpu.analysis.passes.mesh import mesh_facts
+
+    facts = mesh_facts(project)
+    digest = hashlib.sha256()
+    digest.update(repr((sorted(facts.axes), facts.max_rank)).encode())
+    try:
+        from dib_tpu.telemetry.events import EVENT_SCHEMA
+
+        digest.update(repr(sorted(
+            (kind, tuple(spec.required), tuple(spec.optional))
+            for kind, spec in EVENT_SCHEMA.items())).encode())
+    except Exception:   # a tree without the runtime package still lints
+        digest.update(b"no-event-schema")
+    return digest.hexdigest()
+
+
+@dataclasses.dataclass
+class TreeResult:
+    """One full-tree lint outcome with incrementality accounting."""
+
+    findings: list[Finding]
+    analyzed: list[str]          # rels whose passes actually ran
+    cached: list[str]            # rels replayed from the cache
+    total_files: int
+    modules: dict[str, Module]   # the parsed tree (stats/budget reuse it)
+
+    @property
+    def analyzed_count(self) -> int:
+        return len(self.analyzed)
+
+
+def _serialize(findings: Iterable[Finding]) -> list[list]:
+    return [[f.pass_id, f.path, f.line, f.message] for f in findings]
+
+
+def _deserialize(rows) -> list[Finding]:
+    return [Finding(str(p), str(path), int(line), str(msg))
+            for p, path, line, msg in rows]
+
+
+def load_cache(root: str) -> dict | None:
+    try:
+        with open(cache_path(root), encoding="utf-8") as f:
+            cache = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(cache, dict) or cache.get("version") != CACHE_VERSION:
+        return None
+    if cache.get("analyzer") != analyzer_fingerprint():
+        return None
+    # the files payload must hold the shape run_tree indexes into — a
+    # hand-mangled (but JSON-valid) cache degrades to a cold run like
+    # every other corruption, never a traceback
+    files = cache.get("files")
+    if not isinstance(files, dict) or not all(
+            isinstance(entry, dict)
+            and isinstance(entry.get("hash"), str)
+            and isinstance(entry.get("deps"), list)
+            and isinstance(entry.get("findings"), list)
+            for entry in files.values()):
+        return None
+    return cache
+
+
+def save_cache(root: str, modules: dict[str, Module],
+               per_module: dict[str, list[Finding]],
+               deps: dict[str, set[str]], global_facts: str) -> None:
+    payload = {
+        "version": CACHE_VERSION,
+        "analyzer": analyzer_fingerprint(),
+        "global_facts": global_facts,
+        "files": {
+            rel: {
+                "hash": _content_hash(modules[rel].source),
+                "deps": sorted(deps.get(rel, ())),
+                "findings": _serialize(per_module.get(rel, ())),
+            }
+            for rel in modules
+        },
+    }
+    path = cache_path(root)
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)
+    except OSError:
+        pass   # an unwritable cache degrades to cold runs, never an error
+
+
+def _reverse_closure(seeds: set[str], reverse_deps: dict[str, set[str]],
+                     ) -> set[str]:
+    out = set(seeds)
+    frontier = list(seeds)
+    while frontier:
+        rel = frontier.pop()
+        for dependent in reverse_deps.get(rel, ()):
+            if dependent not in out:
+                out.add(dependent)
+                frontier.append(dependent)
+    return out
+
+
+def run_tree(root: str = core.REPO,
+             roots: Iterable[str] = core.DEFAULT_ROOTS,
+             select: Iterable[str] | None = None,
+             changed: bool = False,
+             write_cache: bool | None = None,
+             read_cache: bool = True) -> TreeResult:
+    """Full-tree lint with optional incrementality.
+
+    ``changed=False`` is a cold run over every file (and — unless
+    ``write_cache=False`` — primes the cache for the next ``--changed``
+    run). ``changed=True`` replays cached findings for every file
+    outside the dirty set's reverse-dependency closure; with no usable
+    cache it degrades to a cold run. ``select`` forces a cold,
+    cache-less run (a partial pass set must never poison the full-run
+    cache). ``read_cache=False`` (the CLI's ``--no-cache``) ignores an
+    existing cache entirely — the stale/corrupt-cache escape hatch.
+    """
+    passes = core.selected_passes(select)
+    known_ids = set(core.REGISTRY)
+    modules = core.load_tree(root, roots)
+    project = core.build_project(modules.values())
+    use_cache = select is None
+    if write_cache is None:
+        write_cache = use_cache
+    facts_digest = global_facts_digest(project) if use_cache else ""
+
+    cache = (load_cache(root)
+             if (changed and use_cache and read_cache) else None)
+    if cache is not None and cache.get("global_facts") != facts_digest:
+        cache = None   # global facts escape the import graph: full cold run
+    to_analyze = set(modules)
+    if cache is not None:
+        files = cache.get("files", {})
+        dirty = {rel for rel, module in modules.items()
+                 if rel not in files
+                 or files[rel].get("hash") != _content_hash(module.source)}
+        removed = set(files) - set(modules)
+        # a deleted module changes its importers' resolution: their
+        # cached deps say who they were
+        removed_dependents = {
+            rel for rel, entry in files.items()
+            if any(dep in removed for dep in entry.get("deps", ()))
+        }
+        seeds = dirty | (removed_dependents & set(modules))
+        to_analyze = _reverse_closure(seeds, project.reverse_deps) \
+            & set(modules)
+
+    per_module: dict[str, list[Finding]] = {}
+    for rel in sorted(modules):
+        if rel not in to_analyze:
+            try:
+                per_module[rel] = _deserialize(
+                    cache["files"][rel]["findings"])
+                continue
+            except (KeyError, TypeError, ValueError):
+                # a mangled row degrades THIS file to a fresh analysis,
+                # never the whole run to a traceback (the corrupt-cache
+                # contract load_cache covers for the other shapes)
+                to_analyze.add(rel)
+        per_module[rel] = core.check_one_module(
+            modules[rel], passes, project=project, known_ids=known_ids)
+
+    findings: list[Finding] = []
+    for rel in sorted(per_module):
+        findings.extend(per_module[rel])
+    for lint in passes:
+        findings.extend(lint.check_project(root))
+    findings.sort(key=lambda f: (f.path, f.line, f.pass_id, f.message))
+
+    if write_cache and use_cache:
+        save_cache(root, modules, per_module, project.module_deps,
+                   facts_digest)
+    return TreeResult(
+        findings=findings,
+        analyzed=sorted(to_analyze),
+        cached=sorted(set(modules) - to_analyze),
+        total_files=len(modules),
+        modules=modules,
+    )

@@ -1,10 +1,21 @@
 """``python -m dib_tpu lint`` — the one CLI over every pass.
 
 Exit codes follow the repo's gate convention (``telemetry check``,
-``compare``): 0 clean, 1 findings, 2 bad usage. ``--json`` emits a
-stable machine-readable report (the shape tests/test_lint/test_cli.py
-pins); the default output is one ``path:line: [pass] message`` per
-finding, clickable in a terminal.
+``compare``): 0 clean, 1 findings (or a suppression-budget violation
+under ``--stats``), 2 bad usage. Output modes:
+
+- default: one ``path:line: [pass] message`` per finding, clickable;
+- ``--json``: the stable machine-readable report
+  (tests/test_lint/test_cli.py pins the shape);
+- ``--sarif``: SARIF 2.1.0 for code-scanning consumers
+  (tests/test_lint/test_tooling.py validates the required properties);
+- ``--stats``: the suppression-budget report gated against the
+  committed ``LINT_BUDGET.json`` (docs/static-analysis.md).
+
+``--changed`` replays the content-hash cache under ``.dib_lint_cache/``
+and re-analyzes only dirty files plus their reverse-dependency closure
+— bit-identical findings to a cold run (pinned by test), one cheap
+parse pass over everything else.
 """
 
 from __future__ import annotations
@@ -35,7 +46,7 @@ def _resolve_paths(paths: Sequence[str], root: str):
     return pairs
 
 
-def lint_main(argv: Sequence[str] | None = None) -> int:
+def _build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="dib_tpu lint",
         description="JAX-correctness static analysis over dib_tpu/ and "
@@ -51,10 +62,30 @@ def lint_main(argv: Sequence[str] | None = None) -> int:
                              "report.")
     parser.add_argument("--json", action="store_true", dest="as_json",
                         help="Machine-readable report on stdout.")
+    parser.add_argument("--sarif", action="store_true",
+                        help="SARIF 2.1.0 report on stdout (code-scanning "
+                             "consumers).")
+    parser.add_argument("--changed", action="store_true",
+                        help="Incremental full-tree run: re-analyze only "
+                             "files whose content hash changed since the "
+                             "last run, plus their reverse-dependency "
+                             "closure (.dib_lint_cache/). Findings are "
+                             "bit-identical to a cold run.")
+    parser.add_argument("--no-cache", action="store_true",
+                        help="Do not read or write .dib_lint_cache/.")
+    parser.add_argument("--stats", action="store_true",
+                        help="Suppression-budget report: per-pass pragma "
+                             "counts gated against LINT_BUDGET.json "
+                             "(exit 1 on violation).")
     parser.add_argument("--list", action="store_true", dest="list_passes",
                         help="Print the pass catalog and exit 0.")
     parser.add_argument("--root", default=core.REPO,
                         help=argparse.SUPPRESS)  # tests point at fixtures
+    return parser
+
+
+def lint_main(argv: Sequence[str] | None = None) -> int:
+    parser = _build_parser()
     try:
         args = parser.parse_args(list(argv) if argv is not None else None)
     except SystemExit as exc:
@@ -68,31 +99,72 @@ def lint_main(argv: Sequence[str] | None = None) -> int:
             print(f"    prevents: {lint.incident}")
         return 0
 
+    def usage_error(message: str) -> int:
+        print(f"dib_tpu lint: {message}", file=sys.stderr)
+        return 2
+
+    if args.as_json and args.sarif:
+        return usage_error("--json and --sarif are exclusive output modes")
+    if args.stats and (args.sarif or args.changed or args.paths
+                       or args.select):
+        return usage_error("--stats is its own mode (combine only with "
+                           "--json)")
+    if args.changed and args.paths:
+        return usage_error("--changed is a full-tree mode; drop the "
+                           "explicit paths")
+    if args.changed and args.select:
+        return usage_error("--changed caches full-pass results only; "
+                           "drop --select")
+
     select = None
     if args.select is not None:
         select = [s.strip() for s in args.select.split(",") if s.strip()]
         if not select:
-            print("dib_tpu lint: --select needs at least one pass id",
-                  file=sys.stderr)
-            return 2
+            return usage_error("--select needs at least one pass id")
 
-    files = None
+    if args.stats:
+        return _stats_main(args)
+
+    from dib_tpu.analysis import cache as cache_mod
+
+    analyzed = cached = None
     if args.paths:
         try:
             files = _resolve_paths(args.paths, args.root)
         except FileNotFoundError as exc:
-            print(f"dib_tpu lint: no such path: {exc}", file=sys.stderr)
-            return 2
-    try:
-        findings = core.run_passes(root=args.root, select=select,
-                                   files=files)
-    except KeyError as exc:
-        print(f"dib_tpu lint: {exc.args[0]}", file=sys.stderr)
-        return 2
+            return usage_error(f"no such path: {exc}")
+        try:
+            findings = core.run_passes(root=args.root, select=select,
+                                       files=files)
+        except KeyError as exc:
+            return usage_error(str(exc.args[0]))
+    else:
+        try:
+            result = cache_mod.run_tree(
+                root=args.root, select=select, changed=args.changed,
+                write_cache=False if args.no_cache else None,
+                read_cache=not args.no_cache)
+        except KeyError as exc:
+            return usage_error(str(exc.args[0]))
+        findings = result.findings
+        analyzed, cached = result.analyzed_count, len(result.cached)
+
+    if args.sarif:
+        from dib_tpu.analysis.sarif import sarif_report
+
+        selected = (passes if select is None
+                    else [core.get_pass(s) for s in sorted(set(select))])
+        print(json.dumps(sarif_report(findings, selected), indent=1,
+                         sort_keys=True))
+        return 1 if findings else 0
 
     if args.as_json:
         selected = (passes if select is None
                     else [core.get_pass(s) for s in sorted(set(select))])
+        summary: dict = {"findings": len(findings)}
+        if analyzed is not None:
+            summary["analyzed_files"] = analyzed
+            summary["cached_files"] = cached
         print(json.dumps({
             "version": JSON_VERSION,
             "passes": [
@@ -105,7 +177,7 @@ def lint_main(argv: Sequence[str] | None = None) -> int:
                  "message": f.message}
                 for f in findings
             ],
-            "summary": {"findings": len(findings)},
+            "summary": summary,
         }, indent=1, sort_keys=True))
     else:
         for f in findings:
@@ -113,7 +185,10 @@ def lint_main(argv: Sequence[str] | None = None) -> int:
         n = len(findings)
         scope_desc = ("selected passes" if select is not None
                       else f"{len(passes)} passes")
-        where = "given paths" if files is not None else "dib_tpu/ + scripts/"
+        where = ("given paths" if args.paths else "dib_tpu/ + scripts/")
+        if analyzed is not None and args.changed:
+            where += (f" ({analyzed} analyzed, {cached} replayed from "
+                      "cache)")
         if n:
             print(f"\ndib-lint: {n} finding(s) from {scope_desc} over "
                   f"{where}. Suppress a reviewed exception with "
@@ -121,3 +196,23 @@ def lint_main(argv: Sequence[str] | None = None) -> int:
         else:
             print(f"dib-lint: ok ({scope_desc} over {where})")
     return 1 if findings else 0
+
+
+def _stats_main(args) -> int:
+    from dib_tpu.analysis import stats as stats_mod
+
+    modules = core.load_tree(args.root)
+    counts = stats_mod.suppression_stats(modules.values())
+    try:
+        budget = stats_mod.load_budget(args.root)
+    except ValueError as exc:
+        print(f"dib_tpu lint: {exc}", file=sys.stderr)
+        return 2
+    violations = ([] if budget is None
+                  else stats_mod.budget_violations(counts, budget))
+    if args.as_json:
+        print(json.dumps(stats_mod.stats_report(counts, budget, violations),
+                         indent=1, sort_keys=True))
+    else:
+        print(stats_mod.format_stats(counts, budget, violations))
+    return 1 if violations else 0

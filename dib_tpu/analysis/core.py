@@ -364,6 +364,14 @@ class LintPass:
     def check_module(self, module: Module) -> list[Finding]:
         return []
 
+    def check_module_with_project(self, module: Module,
+                                  project) -> list[Finding]:
+        """Hook for interprocedural passes: ``project`` is the
+        :class:`~dib_tpu.analysis.project.Project` built over the whole
+        lint tree (None when a caller runs a pass standalone). The
+        default delegates to the intraprocedural :meth:`check_module`."""
+        return self.check_module(module)
+
     def check_project(self, root: str) -> list[Finding]:
         return []
 
@@ -422,6 +430,65 @@ def load_module(path: str, rel: str) -> Module:
         return Module(path, rel, f.read())
 
 
+def load_tree(root: str, roots: Iterable[str] = DEFAULT_ROOTS,
+              ) -> dict[str, Module]:
+    """Parse every source file under the lint roots once — the shared
+    parse pass both the per-module passes and the interprocedural
+    project index reason over."""
+    return {rel: load_module(path, rel)
+            for path, rel in iter_source_files(root, roots)}
+
+
+def build_project(modules: Iterable[Module]):
+    from dib_tpu.analysis.project import Project
+
+    return Project(modules)
+
+
+def check_one_module(module: Module, passes: list[LintPass],
+                     project=None, known_ids: set[str] | None = None,
+                     ) -> list[Finding]:
+    """Every per-module finding for one file: pragma-grammar problems,
+    unknown-pass pragmas, parse errors, and the (selected) passes with
+    suppression + allowlists applied. This is the unit the incremental
+    cache stores and replays — it must depend only on the module's
+    content and (through ``project``) its transitive imports."""
+    known_ids = known_ids if known_ids is not None else set(REGISTRY)
+    findings: list[Finding] = list(module.pragma_findings)
+    for lineno, pragma in module.pragmas.items():
+        for pid in pragma.passes:
+            if pid not in known_ids:
+                findings.append(Finding(
+                    PRAGMA_PASS_ID, module.rel, lineno,
+                    f"pragma suppresses unknown pass {pid!r} "
+                    f"(available: {sorted(known_ids)})"))
+    if module.parse_error is not None:
+        findings.append(Finding(
+            PRAGMA_PASS_ID, module.rel, module.parse_error.lineno or 1,
+            f"file does not parse: {module.parse_error.msg}"))
+        return findings
+    for lint in passes:
+        if not lint.applies_to(module.rel):
+            continue
+        if module.rel in lint.allowlist:
+            continue
+        for finding in lint.check_module_with_project(module, project):
+            if not module.suppressed(lint.id, finding.line):
+                findings.append(finding)
+    return findings
+
+
+def selected_passes(select: Iterable[str] | None) -> list[LintPass]:
+    if select is None:
+        return all_passes()
+    select = sorted(set(select))
+    unknown = [s for s in select if s not in REGISTRY]
+    if unknown:
+        raise KeyError(
+            f"unknown pass id(s) {unknown}; available: {sorted(REGISTRY)}")
+    return [REGISTRY[s] for s in select]
+
+
 def run_passes(
     root: str = REPO,
     roots: Iterable[str] = DEFAULT_ROOTS,
@@ -436,46 +503,46 @@ def run_passes(
     under the reserved ``pragma`` id regardless of ``select``: a
     suppression that doesn't parse silently changes what the suite
     checks, so it can never be filtered out.
+
+    Interprocedural facts always come from the WHOLE tree under
+    ``root``/``roots`` (plus any explicit ``files`` outside it): linting
+    one file still sees project-wide donation/key/blocking summaries,
+    so a helper boundary never truncates a fact. With explicit
+    ``files``, per-module findings are reported only for those files and
+    project-level checks are skipped (unchanged CLI semantics).
     """
-    passes = all_passes()
-    if select is not None:
-        select = sorted(set(select))
-        unknown = [s for s in select if s not in REGISTRY]
-        if unknown:
-            raise KeyError(
-                f"unknown pass id(s) {unknown}; available: "
-                f"{sorted(REGISTRY)}")
-        passes = [REGISTRY[s] for s in select]
+    passes = selected_passes(select)
     known_ids = set(REGISTRY)
+    tree = load_tree(root, roots)
+    explicit: list[Module] = []
+    if files is not None:
+        for path, rel in files:
+            explicit.append(tree[rel] if rel in tree
+                            else load_module(path, rel))
+    project = build_project(
+        list(tree.values())
+        + [m for m in explicit if m.rel not in tree])
 
     findings: list[Finding] = []
-    pairs = list(files) if files is not None else list(
-        iter_source_files(root, roots))
-    for path, rel in pairs:
-        module = load_module(path, rel)
-        findings.extend(module.pragma_findings)
-        for lineno, pragma in module.pragmas.items():
-            for pid in pragma.passes:
-                if pid not in known_ids:
-                    findings.append(Finding(
-                        PRAGMA_PASS_ID, rel, lineno,
-                        f"pragma suppresses unknown pass {pid!r} "
-                        f"(available: {sorted(known_ids)})"))
-        if module.parse_error is not None:
-            findings.append(Finding(
-                PRAGMA_PASS_ID, rel, module.parse_error.lineno or 1,
-                f"file does not parse: {module.parse_error.msg}"))
-            continue
-        for lint in passes:
-            if not lint.applies_to(rel):
-                continue
-            if rel in lint.allowlist:
-                continue
-            for finding in lint.check_module(module):
-                if not module.suppressed(lint.id, finding.line):
-                    findings.append(finding)
+    targets = explicit if files is not None else list(tree.values())
+    for module in targets:
+        findings.extend(check_one_module(module, passes, project=project,
+                                         known_ids=known_ids))
     if files is None:  # project-level checks run only on full-tree runs
         for lint in passes:
             findings.extend(lint.check_project(root))
     findings.sort(key=lambda f: (f.path, f.line, f.pass_id, f.message))
     return findings
+
+
+def pragma_counts(modules: Iterable[Module]) -> dict[str, int]:
+    """Per-pass suppression counts over a set of parsed modules — the
+    raw material of the ``lint --stats`` suppression-budget report. Each
+    (anchor line, pass id) pair counts once; legacy pragmas count under
+    the pass they map to."""
+    counts: dict[str, int] = {}
+    for module in modules:
+        for pragma in module.pragmas.values():
+            for pid in pragma.passes:
+                counts[pid] = counts.get(pid, 0) + 1
+    return dict(sorted(counts.items()))

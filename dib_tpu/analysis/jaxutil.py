@@ -14,41 +14,62 @@ import dataclasses
 from dib_tpu.analysis.core import Module, call_name, dotted_name
 
 
+def bind_call_args(call: ast.Call, params: tuple[str, ...],
+                   is_method: bool) -> dict[str, ast.expr]:
+    """``{parameter name: argument expression}`` for one call site — the
+    bound/unbound-method argument mapping every interprocedural fact
+    flows through. A bound-method call (``self.run_chunk(state, ...)``)
+    maps positionals one parameter later than an unbound call — and an
+    unbound call through an attribute (``type(self).run_chunk(self,
+    state, ...)``, ``Trainer.run_chunk(self, ...)``) is recognized by
+    its explicit leading ``self`` argument, which a bound call never
+    passes. Keyword arguments map by name; ``*args``/``**kwargs`` at the
+    call site are left unmapped (callers treat unmapped as unknown)."""
+    offset = 0
+    if is_method and isinstance(call.func, ast.Attribute):
+        first = call.args[0] if call.args else None
+        explicit_self = (params
+                         and isinstance(first, ast.Name)
+                         and first.id == params[0])
+        offset = 0 if explicit_self else 1
+    out: dict[str, ast.expr] = {}
+    for i, arg in enumerate(call.args):
+        if isinstance(arg, ast.Starred):
+            # positions after a *args splat depend on its runtime length
+            # — leave them (and the splat itself) unmapped, never
+            # mis-mapped to the wrong parameter
+            break
+        idx = i + offset
+        if idx < len(params):
+            out[params[idx]] = arg
+    for kw in call.keywords:
+        if kw.arg is not None and kw.arg in params:
+            out[kw.arg] = kw.value
+    return out
+
+
 @dataclasses.dataclass(frozen=True)
 class JittedFn:
-    """One locally-defined jitted callable."""
+    """One jitted (or donation-summarized) callable."""
 
     name: str
     params: tuple[str, ...]      # positional-or-keyword params, in order
     donated: frozenset[str]      # subset of params donated to XLA
     is_method: bool              # defined inside a class (self-first)
     lineno: int
+    #: For interprocedural summaries (analysis/project.py): the helper
+    #: chain through which the donation actually happens ("fit →
+    #: run_chunk"). Empty for directly-jitted callables.
+    via: str = ""
 
     def donated_args(self, call: ast.Call) -> dict[str, int]:
         """``{variable name: lineno}`` for every bare-Name argument the
-        call binds to a donated parameter. A bound-method call
-        (``self.run_chunk(state, ...)``) maps positionals one parameter
-        later than an unbound call — and an unbound call through an
-        attribute (``type(self).run_chunk(self, state, ...)``,
-        ``Trainer.run_chunk(self, ...)``) is recognized by its explicit
-        leading ``self`` argument, which a bound call never passes."""
-        offset = 0
-        if self.is_method and isinstance(call.func, ast.Attribute):
-            first = call.args[0] if call.args else None
-            explicit_self = (self.params
-                             and isinstance(first, ast.Name)
-                             and first.id == self.params[0])
-            offset = 0 if explicit_self else 1
-        out: dict[str, int] = {}
-        for i, arg in enumerate(call.args):
-            idx = i + offset
-            if idx < len(self.params) and self.params[idx] in self.donated \
-                    and isinstance(arg, ast.Name):
-                out[arg.id] = arg.lineno
-        for kw in call.keywords:
-            if kw.arg in self.donated and isinstance(kw.value, ast.Name):
-                out[kw.value.id] = kw.value.lineno
-        return out
+        call binds to a donated parameter (see :func:`bind_call_args`
+        for the bound/unbound mapping rules)."""
+        return {arg.id: arg.lineno
+                for param, arg in bind_call_args(
+                    call, self.params, self.is_method).items()
+                if param in self.donated and isinstance(arg, ast.Name)}
 
 
 def _jit_decoration(node: ast.expr) -> dict | None:

@@ -1,0 +1,212 @@
+"""async-blocking: nothing reachable from a coroutine may block the loop.
+
+The incident shape (docs/serving.md): ``serve/server.py`` runs the WHOLE
+HTTP surface on one asyncio event loop — every connection is a
+coroutine. One synchronous ``time.sleep``, one blocking socket read,
+one ``subprocess.run``, one implicit device fetch executed ON the loop
+stalls every in-flight request at once: the continuous batcher keeps
+dispatching, but nothing can be parsed, queued, or answered until the
+blocking call returns. At 1.6k req/s (BENCH_SERVE_ASYNC_CPU.json) a
+10 ms block is sixteen requests' worth of added latency — and the bug
+is invisible in single-request tests.
+
+The rule, interprocedural (analysis/project.py): inside any ``async
+def``, a call that is NOT awaited and either (a) matches a known
+blocking primitive — ``time.sleep``, ``subprocess.run``/``check_*``,
+synchronous socket/urllib connects, ``Future.result()``,
+``jax.device_get`` / ``.block_until_ready()`` (an implicit device sync
+parks the host exactly like a sleep), ``asyncio.run`` (nested loops
+deadlock) — or (b) resolves to a project function whose summary says it
+(transitively) makes such a call, is flagged with the chain named.
+Additionally, a call that resolves to a project COROUTINE but is not
+awaited is flagged (`never awaited` — the coroutine silently never
+runs).
+
+The blessed escapes are what the serving code actually uses: park the
+blocking callable on an executor (``loop.run_in_executor(None, fn)`` /
+``asyncio.to_thread(fn)`` — the callable is passed, not called, so
+this pass never sees a call), or ``await asyncio.sleep`` instead of
+``time.sleep``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from dib_tpu.analysis.core import (
+    Finding,
+    LintPass,
+    Module,
+    call_name,
+    register,
+    statements_in_order,
+    walk_stmt_exprs,
+)
+
+#: Dotted call names that block the calling thread.
+_BLOCKING_DOTTED = {
+    "time.sleep": "time.sleep blocks the loop thread; await "
+                  "asyncio.sleep instead",
+    "subprocess.run": "subprocess.run blocks until the child exits; use "
+                      "asyncio.create_subprocess_exec",
+    "subprocess.call": "subprocess.call blocks until the child exits",
+    "subprocess.check_call": "subprocess.check_call blocks until the "
+                             "child exits",
+    "subprocess.check_output": "subprocess.check_output blocks until the "
+                               "child exits",
+    "socket.create_connection": "a synchronous socket connect blocks the "
+                                "loop; use loop.sock_connect / "
+                                "asyncio.open_connection",
+    "urllib.request.urlopen": "a synchronous HTTP fetch blocks the loop",
+    "os.system": "os.system blocks until the shell exits",
+    "asyncio.run": "asyncio.run inside a running loop raises (and a "
+                   "fresh loop would block this one)",
+    "jax.device_get": "an implicit device sync parks the loop thread "
+                      "until the dispatched program finishes — every "
+                      "in-flight request stalls behind it",
+    "jax.block_until_ready": "an explicit device sync parks the loop "
+                             "thread until the dispatched program "
+                             "finishes",
+}
+
+#: Terminal attribute names (any receiver) that block.
+_BLOCKING_ATTRS = {
+    "block_until_ready": "an explicit device sync parks the loop thread",
+    "result": "Future.result() blocks the loop (and deadlocks when the "
+              "future completes on this same loop); await it instead",
+}
+
+
+def _blocking_primitive(call: ast.Call) -> str | None:
+    """The reason string when a call is a known blocking primitive."""
+    name = call_name(call)
+    if name is not None and name in _BLOCKING_DOTTED:
+        return _BLOCKING_DOTTED[name]
+    if isinstance(call.func, ast.Attribute):
+        attr = call.func.attr
+        if attr in _BLOCKING_ATTRS:
+            # `.result()` in the Future shapes: bare or with a timeout
+            # (positional or keyword) — result(timeout) parks the loop
+            # for up to the timeout, same stall. More arguments is some
+            # other API's `.result`.
+            if attr == "result" and len(call.args) > 1:
+                return None
+            return _BLOCKING_ATTRS[attr]
+    return None
+
+
+@register
+class AsyncBlockingPass(LintPass):
+    id = "async-blocking"
+    description = ("blocking calls (sleep/subprocess/sync socket/"
+                   "Future.result/implicit device sync) reachable from a "
+                   "coroutine without an executor hop; project coroutines "
+                   "called but never awaited")
+    incident = ("serve/server.py's event loop serves every connection as "
+                "a coroutine — ONE synchronous sleep/socket/device fetch "
+                "on the loop stalls every in-flight request at once "
+                "(invisible in single-request tests, catastrophic at the "
+                "measured 1.6k req/s)")
+
+    def check_module(self, module: Module) -> list[Finding]:
+        return self.check_module_with_project(module, None)
+
+    def check_module_with_project(self, module: Module,
+                                  project) -> list[Finding]:
+        if module.tree is None or "async def" not in module.source:
+            return []
+        summaries = (self._blocking_summaries(project)
+                     if project is not None else {})
+        findings: list[Finding] = []
+        for fn in module.functions():
+            if isinstance(fn, ast.AsyncFunctionDef):
+                findings.extend(self._check_coroutine(
+                    module, fn, project, summaries))
+        return findings
+
+    # ----------------------------------------------- blocking summaries
+    def _blocking_summaries(self, project) -> dict[str, tuple[int, str]]:
+        """``{qualname: (lineno, reason)}`` for every SYNC project
+        function that (transitively) makes a blocking call — the shared
+        call-graph fixpoint (Project.fixpoint), cached on the project."""
+        def transfer(info, facts):
+            if info.is_async:
+                return None
+            return self._first_blocking_call(
+                project.modules[info.rel], info.node, project, facts)
+
+        return project.fixpoint("_async_blocking_facts", transfer)
+
+    def _first_blocking_call(self, module, fn, project, facts,
+                             ) -> tuple[int, str] | None:
+        for stmt in statements_in_order(fn):
+            for call in (n for n in walk_stmt_exprs(stmt)
+                         if isinstance(n, ast.Call)):
+                reason = _blocking_primitive(call)
+                if reason is not None:
+                    return call.lineno, reason
+                if project is None:
+                    continue
+                info = project.resolve_call(module, call, scope=fn)
+                if info is not None and not info.is_async \
+                        and info.qualname in facts:
+                    # embed only the callee's NAME and LINE, never its
+                    # reason string: a reason embedding another fact's
+                    # reason grows without bound through recursion
+                    # cycles (engine._dispatch calls itself) and the
+                    # fixpoint would never converge
+                    callee_line, _reason = facts[info.qualname]
+                    return call.lineno, (
+                        f"calls `{info.name}` → blocking at "
+                        f"{info.rel}:{callee_line}")
+        return None
+
+    # ------------------------------------------------- coroutine checks
+    def _check_coroutine(self, module, fn, project, summaries,
+                         ) -> list[Finding]:
+        findings: list[Finding] = []
+        for stmt in statements_in_order(fn):
+            for call in (n for n in walk_stmt_exprs(stmt)
+                         if isinstance(n, ast.Call)):
+                if isinstance(module.parent_of(call), ast.Await):
+                    continue
+                reason = _blocking_primitive(call)
+                if reason is not None:
+                    findings.append(self.finding(
+                        module, call.lineno,
+                        f"blocking call on the event loop in coroutine "
+                        f"`{fn.name}`: {reason} (one blocked loop stalls "
+                        "every in-flight request — run it in an executor "
+                        "or use the async equivalent)",
+                    ))
+                    continue
+                if project is None:
+                    continue
+                info = project.resolve_call(module, call, scope=fn)
+                if info is None:
+                    continue
+                if info.is_async:
+                    # only the unambiguous shape: a bare coroutine call
+                    # as a statement (passing the coroutine object into
+                    # create_task/gather — or binding it for a later
+                    # await — is legitimate and common)
+                    if isinstance(module.parent_of(call), ast.Expr):
+                        findings.append(self.finding(
+                            module, call.lineno,
+                            f"coroutine `{info.name}` is called but its "
+                            f"coroutine object is discarded in "
+                            f"`{fn.name}` — it will never run; `await` "
+                            "it (or wrap it in asyncio.create_task)",
+                        ))
+                    continue
+                hit = summaries.get(info.qualname)
+                if hit is not None:
+                    line, reason = hit
+                    findings.append(self.finding(
+                        module, call.lineno,
+                        f"`{info.name}` blocks the event loop (via its "
+                        f"line {line}: {reason}) and is called from "
+                        f"coroutine `{fn.name}` — park it on an executor "
+                        "(loop.run_in_executor) or make the chain async",
+                    ))
+        return findings

@@ -31,9 +31,18 @@ it, two ways:
    ``jnp.copy`` — is not an alias and stays clean; only bare
    attribute/subscript chains are tracked.
 
-All analyses are intraprocedural over lexical statement order — precise
-enough to flag the PR 4 shape (see tests/test_lint/fixtures/) while
-leaving the fixed ``train/checkpoint.py`` (which waits on CPU) clean.
+All three analyses run over lexical statement order per scope — and,
+since the interprocedural engine (``analysis/project.py``), the set of
+"donating callables" is no longer just the module's own jitted defs: a
+helper that passes its parameter into a donating call (transitively,
+across modules, through re-exported imports and ``self.method`` /
+typed-local calls) donates that parameter too, and a helper that
+returns an un-copied jitted result propagates the async-save taint to
+its callers. Findings through a helper boundary name the chain
+(``fit → run_chunk``) so the reader sees where the donation actually
+happens. Dynamic dispatch (``for hook in hooks: hook(...)``) stays
+invisible by design — see the project-engine docstring for the exact
+boundary contract.
 """
 
 from __future__ import annotations
@@ -80,15 +89,35 @@ class DonationSafetyPass(LintPass):
                 "epoch's bytes (docs/robustness.md)")
 
     def check_module(self, module: Module) -> list[Finding]:
-        registry = jitted_callables(module)
+        return self.check_module_with_project(module, None)
+
+    def check_module_with_project(self, module: Module,
+                                  project) -> list[Finding]:
+        registry = dict(jitted_callables(module))
+        fresh_returners: set[str] = set()
+        if project is not None:
+            # summarized donating callables visible in this module — local
+            # jit facts win on a name collision (they are the precise ones)
+            for name, fn in project.donation_registry(module).items():
+                registry.setdefault(name, fn)
+            fresh_returners = project.fresh_returners()
         if not registry:
             return []
         findings: list[Finding] = []
         for fn in module.functions():
-            findings.extend(self._check_scope(module, fn, registry))
+            findings.extend(self._check_scope(module, fn, registry,
+                                              project, fresh_returners))
         return findings
 
-    def _check_scope(self, module, fn, registry) -> list[Finding]:
+    @staticmethod
+    def _display(target) -> str:
+        """How a finding names the donating callee: the call-site name,
+        plus the helper chain when the donation is interprocedural."""
+        return (f"{target.name} [donates through: {target.via}]"
+                if target.via else target.name)
+
+    def _check_scope(self, module, fn, registry, project=None,
+                     fresh_returners=frozenset()) -> list[Finding]:
         findings: list[Finding] = []
         # name -> (donating call lineno, callee name); dead after donation
         dead: dict[str, tuple[int, str]] = {}
@@ -161,16 +190,24 @@ class DonationSafetyPass(LintPass):
                     ))
             # 3. this stmt's donations kill their argument names — and any
             #    bare alias taken from them earlier (the overlap hazard) …
+            #    EXCEPT when the donating call rides a `return`: control
+            #    has left the scope, so lexically-later statements are
+            #    unreachable from it (the `return self._fit_overlapped(
+            #    key, state, ...)` dispatch shape the interprocedural
+            #    summaries made visible — a real donation for the
+            #    caller's summary, never a hazard for this scope's tail)
+            if isinstance(stmt, ast.Return):
+                continue
             for call in _calls(stmt):
                 target = match_callable(call, registry)
                 if target is None or not target.donated:
                     continue
                 for name, _line in target.donated_args(call).items():
-                    dead[name] = (call.lineno, target.name)
+                    dead[name] = (call.lineno, self._display(target))
                     for alias, (root, _aline) in aliases.items():
                         if root == name:
                             dead_aliases[alias] = (
-                                call.lineno, target.name, name)
+                                call.lineno, self._display(target), name)
             # 4. … and any (re)assignment resurrects / re-taints names.
             #    Assignment runs after the RHS call, so the
             #    `x, y = f(x, y)` rebind idiom ends up alive, and a name
@@ -181,6 +218,18 @@ class DonationSafetyPass(LintPass):
                 value = getattr(stmt, "value", None)
                 value_jit = (match_callable(value, registry)
                              if isinstance(value, ast.Call) else None)
+                # device-fresh taint also flows OUT of helpers: a call
+                # resolved to a project function that returns an
+                # un-copied jitted result taints its binding the same way
+                # a direct jitted call does (analysis/project.py)
+                fresh_name: str | None = None
+                if value_jit is not None and not value_jit.via:
+                    fresh_name = value_jit.name
+                elif project is not None and isinstance(value, ast.Call):
+                    resolved = project.resolve_call(module, value, scope=fn)
+                    if (resolved is not None
+                            and resolved.qualname in fresh_returners):
+                        fresh_name = resolved.name
                 alias_root = _bare_chain_root(value)
                 for name in assigned:
                     dead.pop(name, None)
@@ -192,8 +241,8 @@ class DonationSafetyPass(LintPass):
                     for alias in [a for a, (root, _l) in aliases.items()
                                   if root == name]:
                         aliases.pop(alias, None)
-                    if value_jit is not None:
-                        fresh[name] = (stmt.lineno, value_jit.name)
+                    if fresh_name is not None:
+                        fresh[name] = (stmt.lineno, fresh_name)
                     else:
                         # any other assignment — including a host copy
                         # (jax.device_get / np.array / .copy()) — clears

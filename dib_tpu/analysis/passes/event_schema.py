@@ -18,7 +18,14 @@ truth; this pass holds the other two surfaces to it:
   ``DIB_TELEMETRY_STRICT=1`` still gates kind membership);
 - **docs**: the record-type table in docs/observability.md must list
   exactly the schema's kinds (``request``/``batch`` are documented
-  aliases of ``span``).
+  aliases of ``span``);
+- **docs, serving rollup** (ISSUE 11 — the PR 10 rollup grew faster
+  than its table): the "Serving-rollup keys" list in
+  docs/observability.md must name EXACTLY the keys
+  ``telemetry/summary.py``'s ``serving_rollup`` emits — extracted from
+  the function's AST (``out[...] =`` assigns, ``out.update({...})``
+  literals, and keys bound through a for-loop over a literal tuple), so
+  the next rollup key cannot ship undocumented.
 
 Writers are recognized conservatively by receiver shape (``telemetry``,
 ``writer``, ``self.telemetry``, ``self._telemetry``, or a local assigned
@@ -56,6 +63,10 @@ _HELPER_PARAM_ALIASES = {
 }
 
 _DOC_KIND_RE = re.compile(r"\*\*`([a-z_]+)`\*\*")
+#: The docs line that opens the serving-rollup key list (the list itself
+#: is the backticked names from here to the next blank line).
+_SERVING_KEYS_MARKER = "Serving-rollup keys"
+_BACKTICKED_RE = re.compile(r"`([a-z_0-9]+)`")
 
 
 def _schema():
@@ -155,9 +166,128 @@ class EventSchemaPass(LintPass):
         return findings
 
     # ------------------------------------------------------ project level
+    @staticmethod
+    def serving_rollup_keys(root: str) -> set[str] | None:
+        """The top-level keys ``serving_rollup`` actually emits, read
+        from telemetry/summary.py's AST (None when the function cannot
+        be found — the caller reports that as its own drift)."""
+        path = os.path.join(root, "dib_tpu", "telemetry", "summary.py")
+        try:
+            with open(path, encoding="utf-8") as f:
+                tree = ast.parse(f.read())
+        except (OSError, SyntaxError):
+            return None
+        fn = next((node for node in tree.body
+                   if isinstance(node, ast.FunctionDef)
+                   and node.name == "serving_rollup"), None)
+        if fn is None:
+            return None
+        keys: set[str] = set()
+        # loop-bound key names: `for prefix, key in ((..., "x"), ...):`
+        loop_keys: dict[str, set[str]] = {}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.For) \
+                    and isinstance(node.target, ast.Tuple) \
+                    and isinstance(node.iter, (ast.Tuple, ast.List)):
+                for pos, elt in enumerate(node.target.elts):
+                    if not isinstance(elt, ast.Name):
+                        continue
+                    values = {
+                        row.elts[pos].value
+                        for row in node.iter.elts
+                        if isinstance(row, (ast.Tuple, ast.List))
+                        and pos < len(row.elts)
+                        and isinstance(row.elts[pos], ast.Constant)
+                        and isinstance(row.elts[pos].value, str)
+                    }
+                    if values:
+                        loop_keys.setdefault(elt.id, set()).update(values)
+        for node in ast.walk(fn):
+            target = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target = node.targets[0]
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                target = node.target
+            if isinstance(target, ast.Subscript) \
+                    and isinstance(target.value, ast.Name) \
+                    and target.value.id == "out":
+                index = target.slice
+                if isinstance(index, ast.Constant) \
+                        and isinstance(index.value, str):
+                    keys.add(index.value)
+                elif isinstance(index, ast.Name):
+                    keys.update(loop_keys.get(index.id, ()))
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "update" \
+                    and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id == "out":
+                for arg in node.args:
+                    if isinstance(arg, ast.Dict):
+                        keys.update(k.value for k in arg.keys
+                                    if isinstance(k, ast.Constant)
+                                    and isinstance(k.value, str))
+        return keys
+
+    def _check_serving_rollup_docs(self, root: str,
+                                   lines: list[str]) -> list[Finding]:
+        """The serving-rollup key list in docs/observability.md must name
+        exactly what summary.serving_rollup emits (the PR 10 rollup grew
+        faster than the docs table — this pins the two together)."""
+        doc_rel = "docs/observability.md"
+        summary_rel = "dib_tpu/telemetry/summary.py"
+        emitted = self.serving_rollup_keys(root)
+        if emitted is None:
+            # a tree without the summary module at all (synthetic test
+            # roots) has nothing to hold the docs to — but a tree that
+            # HAS the module with no findable serving_rollup means the
+            # guard's anchor moved: that is drift, not a green pass
+            if os.path.exists(os.path.join(root, summary_rel)):
+                return [Finding(
+                    self.id, summary_rel, 1,
+                    "serving_rollup not found as a top-level function in "
+                    "telemetry/summary.py — the serving-rollup docs "
+                    "guard has lost its anchor; update "
+                    "EventSchemaPass.serving_rollup_keys alongside the "
+                    "refactor")]
+            return []
+        marker_line = None
+        documented: dict[str, int] = {}
+        for lineno, line in enumerate(lines, 1):
+            if marker_line is None:
+                if _SERVING_KEYS_MARKER in line:
+                    marker_line = lineno
+                continue
+            if not line.strip():
+                break
+            for key in _BACKTICKED_RE.findall(line):
+                documented.setdefault(key, lineno)
+        if marker_line is None:
+            return [Finding(
+                self.id, doc_rel, 1,
+                f"docs/observability.md has no '{_SERVING_KEYS_MARKER}' "
+                "list — the serving rollup's keys must stay documented "
+                "(telemetry/summary.py serving_rollup)")]
+        findings: list[Finding] = []
+        for key in sorted(emitted - set(documented)):
+            findings.append(Finding(
+                self.id, doc_rel, marker_line,
+                f"serving-rollup key {key!r} is emitted by "
+                "telemetry/summary.py serving_rollup but missing from "
+                f"the '{_SERVING_KEYS_MARKER}' list"))
+        for key, lineno in sorted(documented.items()):
+            if key not in emitted:
+                findings.append(Finding(
+                    self.id, doc_rel, lineno,
+                    f"documented serving-rollup key {key!r} is not "
+                    "emitted by telemetry/summary.py serving_rollup — "
+                    "the code is the source of truth"))
+        return findings
+
     def check_project(self, root: str) -> list[Finding]:
         """Schema ↔ docs drift: docs/observability.md's record-type list
-        must contain exactly EVENT_SCHEMA's kinds (+ the span aliases)."""
+        must contain exactly EVENT_SCHEMA's kinds (+ the span aliases),
+        and its serving-rollup key list exactly what summary.py emits."""
         schema = _schema()
         doc_rel = "docs/observability.md"
         path = os.path.join(root, doc_rel)
@@ -193,4 +323,5 @@ class EventSchemaPass(LintPass):
                     f"documented record type {kind!r} has no EVENT_SCHEMA "
                     "row — the registry is the source of truth",
                 ))
+        findings.extend(self._check_serving_rollup_docs(root, lines))
         return findings

@@ -1,0 +1,484 @@
+"""mesh-consistency: PartitionSpecs, shard_map specs, and donation must
+agree with the meshes the project actually builds.
+
+The incident class this pass exists for is the ROADMAP's next move: the
+2D ``Mesh(('sweep','data'))`` pjit refactor. Sharding bugs are the worst
+JAX bug shape — a ``PartitionSpec`` naming an axis the mesh doesn't
+have, or a ``shard_map`` whose in_specs don't match its function's
+arguments, fails deep inside XLA with an error naming neither the spec
+nor the call site; and a checkpoint RESTORED under a different sharding
+constraint than it was saved with doesn't fail at all — it silently
+reshards, and the resumed β-sweep trains on differently-laid-out
+buffers (the reshard-on-restore shape a stacked-replica restore lives
+or dies on, docs/parallelism.md).
+
+Four decidable checks, all against the project-wide mesh facts the
+interprocedural engine collects (axis names from ``Mesh(...)``
+constructions plus the repo's ``*_AXIS`` module constants, resolved
+through imports):
+
+1. **unknown axis**: a ``PartitionSpec``/``P`` literal naming an axis no
+   project mesh defines;
+2. **rank overflow**: a spec with more entries than the widest project
+   mesh has axes; duplicate axis names in one ``Mesh`` construction;
+3. **shard_map arity**: literal ``in_specs`` tuples vs the wrapped
+   function's parameter count (when the function resolves locally);
+4. **donation × sharding**: a ``jax.jit`` call carrying BOTH
+   ``donate_argnums``/``argnames`` AND literal ``in_shardings``/
+   ``out_shardings`` where a donated argument's input spec differs from
+   the output spec at the same position — XLA cannot reuse the buffer
+   in place, so the donation buys nothing while the input is still
+   invalidated; and **save/restore spec drift**: inside one class, a
+   ``save``-named method applying a sharding constraint ``P(a)`` to the
+   tree it persists while a ``restore``-named method applies a
+   different ``P(b)`` to what it loads.
+
+Unresolvable axis expressions (computed specs, meshes built from
+variables) are skipped, never guessed at.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from dib_tpu.analysis.core import (
+    Finding,
+    LintPass,
+    Module,
+    call_name,
+    register,
+)
+
+_SPEC_NAMES = {"PartitionSpec", "P"}
+_CONSTRAINT_CALLS = {"with_sharding_constraint", "device_put"}
+
+
+def _spec_call(call: ast.Call) -> bool:
+    name = call_name(call)
+    return name is not None and name.split(".")[-1] in _SPEC_NAMES
+
+
+def _mesh_ctor(call: ast.Call) -> bool:
+    name = call_name(call)
+    return name is not None and name.split(".")[-1] == "Mesh"
+
+
+def _mesh_axis_names(module: Module, call: ast.Call, project=None,
+                     ) -> list[str | None] | None:
+    """The resolved axis names of one ``Mesh(...)`` construction —
+    positional ``args[1]`` or the ``axis_names`` keyword, each entry a
+    string (or None when unresolvable) — or None when the axis tuple is
+    not a literal at all. The ONE extraction both the project-wide
+    MeshFacts collection and the duplicate-axis check read."""
+    names_arg = call.args[1] if len(call.args) >= 2 else None
+    for kw in call.keywords:
+        if kw.arg == "axis_names":
+            names_arg = kw.value
+    if not isinstance(names_arg, (ast.Tuple, ast.List)):
+        return None
+    return [_const_str(module, e, project) for e in names_arg.elts]
+
+
+def _const_str(module: Module, node: ast.expr,
+               project=None) -> str | None:
+    """A string constant, directly or through a module-level constant
+    (``BETA_AXIS``), following project imports for cross-module
+    constants."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.Name):
+        return _module_const(module, node.id, project)
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
+        # mesh.BETA_AXIS through an imported module alias
+        if project is not None:
+            imported = project._imports.get(module.rel, {}).get(node.value.id)
+            if imported is not None and imported[1] is None:
+                target = project.modules.get(imported[0])
+                if target is not None:
+                    return _module_const(target, node.attr, project)
+    return None
+
+
+def _module_const(module: Module, name: str, project=None,
+                  _depth: int = 0) -> str | None:
+    if module.tree is None or _depth > 4:
+        return None
+    for node in module.tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == name
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)):
+            return node.value.value
+    if project is not None:
+        imported = project._imports.get(module.rel, {}).get(name)
+        if imported is not None and imported[1] is not None:
+            target = project.modules.get(imported[0])
+            if target is not None:
+                return _module_const(target, imported[1], project,
+                                     _depth + 1)
+    return None
+
+
+def _spec_axes(module: Module, call: ast.Call, project=None,
+               ) -> list[str | None]:
+    """One resolved entry per spec position: the axis name(s) as strings,
+    or None for an unresolvable/None entry. Tuple entries (an axis pair
+    sharding one dim over two mesh axes) contribute each name."""
+    out: list[str | None] = []
+    for arg in call.args:
+        if isinstance(arg, ast.Constant) and arg.value is None:
+            out.append(None)
+            continue
+        if isinstance(arg, (ast.Tuple, ast.List)):
+            for elt in arg.elts:
+                out.append(_const_str(module, elt, project))
+            continue
+        out.append(_const_str(module, arg, project))
+    return out
+
+
+def _spec_signature(module: Module, call: ast.Call, project=None) -> tuple:
+    """A comparable signature for one literal spec (position-wise resolved
+    axis names; unresolvable entries compare as the marker ``...``)."""
+    sig = []
+    for arg in call.args:
+        if isinstance(arg, (ast.Tuple, ast.List)):
+            sig.append(tuple(_const_str(module, e, project) or ...
+                             for e in arg.elts))
+        elif isinstance(arg, ast.Constant) and arg.value is None:
+            sig.append(None)
+        else:
+            sig.append(_const_str(module, arg, project) or ...)
+    return tuple(sig)
+
+
+def mesh_facts(project) -> "MeshFacts":
+    """The project's mesh facts, built once and cached on the project —
+    the ONE accessor both the pass and the cache's global-facts digest
+    read, so they can never compute facts from different inputs."""
+    facts = getattr(project, "_mesh_facts", None)
+    if facts is None:
+        facts = MeshFacts(project.modules.values(), project)
+        project._mesh_facts = facts
+    return facts
+
+
+class MeshFacts:
+    """Project-wide mesh knowledge: every axis name any mesh defines and
+    the widest mesh rank — collected from ``Mesh(...)`` constructions
+    with literal/constant axis tuples and the ``*_AXIS`` module-constant
+    convention (``parallel/mesh.py``)."""
+
+    def __init__(self, modules, project=None):
+        self.axes: set[str] = set()
+        self.max_rank: int | None = None
+        for module in modules:
+            if module.tree is None:
+                continue
+            for node in module.tree.body:
+                if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)
+                        and node.targets[0].id.endswith("_AXIS")
+                        and isinstance(node.value, ast.Constant)
+                        and isinstance(node.value.value, str)):
+                    self.axes.add(node.value.value)
+            for call in ast.walk(module.tree):
+                if not (isinstance(call, ast.Call) and _mesh_ctor(call)):
+                    continue
+                resolved = _mesh_axis_names(module, call, project)
+                if resolved is None or any(r is None for r in resolved):
+                    continue
+                self.axes.update(resolved)
+                rank = len(resolved)
+                self.max_rank = (rank if self.max_rank is None
+                                 else max(self.max_rank, rank))
+
+
+@register
+class MeshConsistencyPass(LintPass):
+    id = "mesh-consistency"
+    description = ("PartitionSpec axes vs project mesh axis names, "
+                   "shard_map in_specs arity vs the wrapped function, "
+                   "donation composed with mismatched pjit shardings, "
+                   "save/restore sharding-constraint drift")
+    incident = ("the 2D Mesh(('sweep','data')) pjit refactor's failure "
+                "shapes: a spec axis the mesh lacks dies deep in XLA "
+                "naming neither; a checkpoint restored under a different "
+                "constraint than its save site silently RESHARDS the "
+                "resumed sweep (the reshard-on-restore bug, "
+                "docs/parallelism.md)")
+
+    def check_module(self, module: Module) -> list[Finding]:
+        return self.check_module_with_project(module, None)
+
+    def check_module_with_project(self, module: Module,
+                                  project) -> list[Finding]:
+        if module.tree is None:
+            return []
+        src = module.source
+        if not any(tok in src for tok in ("PartitionSpec", "Mesh",
+                                          "shard_map", "P(")):
+            return []
+        facts = (mesh_facts(project) if project is not None
+                 else MeshFacts([module]))
+        findings: list[Finding] = []
+        findings.extend(self._check_specs(module, facts, project))
+        findings.extend(self._check_mesh_ctors(module, project))
+        findings.extend(self._check_shard_maps(module, project))
+        findings.extend(self._check_jit_sharding(module, project))
+        findings.extend(self._check_save_restore(module, project))
+        return findings
+
+    # ------------------------------------------------------------- axes
+    def _check_specs(self, module, facts: MeshFacts, project):
+        findings = []
+        for call in ast.walk(module.tree):
+            if not (isinstance(call, ast.Call) and _spec_call(call)):
+                continue
+            axes = _spec_axes(module, call, project)
+            if facts.axes:
+                for axis in axes:
+                    if axis is not None and axis not in facts.axes:
+                        findings.append(self.finding(
+                            module, call.lineno,
+                            f"PartitionSpec axis {axis!r} is not an axis "
+                            "of any mesh this project builds (known: "
+                            f"{sorted(facts.axes)}) — the pjit/shard_map "
+                            "using it will fail deep in XLA, or worse, "
+                            "fall back to replication",
+                        ))
+            # spec LENGTH is the array's rank, not the mesh's — a 3D
+            # array on a 2D mesh legitimately writes P('sweep','data',
+            # None). What IS decidable: one axis cannot shard two
+            # dimensions, and a spec cannot name more DISTINCT axes
+            # than the widest mesh has.
+            named = [a for a in axes if a is not None]
+            dupes = sorted({a for a in named if named.count(a) > 1})
+            for axis in dupes:
+                findings.append(self.finding(
+                    module, call.lineno,
+                    f"PartitionSpec uses axis {axis!r} for two "
+                    "dimensions — a mesh axis can shard at most one "
+                    "array dimension",
+                ))
+            if facts.max_rank is not None and not dupes \
+                    and len(set(named)) > facts.max_rank:
+                findings.append(self.finding(
+                    module, call.lineno,
+                    f"PartitionSpec names {len(set(named))} distinct "
+                    f"axes but the widest project mesh has "
+                    f"{facts.max_rank} — no single mesh this project "
+                    "builds can satisfy the spec",
+                ))
+        return findings
+
+    def _check_mesh_ctors(self, module, project):
+        findings = []
+        for call in ast.walk(module.tree):
+            if not (isinstance(call, ast.Call) and _mesh_ctor(call)):
+                continue
+            resolved = _mesh_axis_names(module, call, project)
+            if resolved is None:
+                continue
+            named = [r for r in resolved if r is not None]
+            if len(named) != len(set(named)):
+                findings.append(self.finding(
+                    module, call.lineno,
+                    f"Mesh axis names {named} contain a duplicate — every "
+                    "axis must be unique for PartitionSpecs to be "
+                    "unambiguous",
+                ))
+        return findings
+
+    # -------------------------------------------------------- shard_map
+    def _check_shard_maps(self, module, project):
+        findings = []
+        local_defs = {}
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                local_defs[node.name] = node
+        for call in ast.walk(module.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            name = call_name(call)
+            if name is None or name.split(".")[-1] != "shard_map":
+                continue
+            target = call.args[0] if call.args else None
+            fn = (local_defs.get(target.id)
+                  if isinstance(target, ast.Name) else None)
+            if fn is None and isinstance(target, ast.Name) \
+                    and project is not None:
+                resolved = project.resolve_symbol(module.rel, target.id)
+                if resolved is not None and resolved[0] == "func":
+                    fn = resolved[1].node
+            if fn is None:
+                continue
+            n_params = len(fn.args.posonlyargs) + len(fn.args.args)
+            for kw in call.keywords:
+                if kw.arg != "in_specs":
+                    continue
+                if isinstance(kw.value, (ast.Tuple, ast.List)):
+                    n_specs = len(kw.value.elts)
+                    if n_specs != n_params and not fn.args.vararg:
+                        findings.append(self.finding(
+                            module, call.lineno,
+                            f"shard_map in_specs has {n_specs} entries "
+                            f"but `{fn.name}` takes {n_params} "
+                            "argument(s) — every argument needs exactly "
+                            "one spec (XLA's error will not name either "
+                            "side)",
+                        ))
+        return findings
+
+    # ------------------------------------------------ donation × sharding
+    def _check_jit_sharding(self, module, project):
+        """Both jit spellings the repo uses: direct ``jax.jit(fn, ...)``
+        rebindings AND the dominant decorator forms
+        (``@partial(jax.jit, ...)`` / ``@jax.jit(...)``) — the 2D-mesh
+        refactor will write the decorator shape, so skipping it would
+        skip the check entirely."""
+        findings = []
+        for call in ast.walk(module.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            name = call_name(call)
+            if name not in ("jax.jit", "jit", "pjit", "jax.pjit"):
+                continue
+            parent = module.parent_of(call)
+            if isinstance(parent, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and call in parent.decorator_list:
+                continue   # `@jax.jit(...)`: the decorator walk owns it
+            wrapped = call.args[0] if call.args else None
+            fn = None
+            if isinstance(wrapped, ast.Name):
+                fn = self._local_def(module, wrapped.id)
+            findings.extend(self._jit_sharding_site(
+                module, project, call, fn))
+        for node in ast.walk(module.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            for deco in node.decorator_list:
+                if not isinstance(deco, ast.Call):
+                    continue
+                from dib_tpu.analysis.jaxutil import _jit_decoration
+
+                if _jit_decoration(deco) is None:
+                    continue
+                findings.extend(self._jit_sharding_site(
+                    module, project, deco, node))
+        return findings
+
+    @staticmethod
+    def _local_def(module, name: str):
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name == name:
+                return node
+        return None
+
+    def _jit_sharding_site(self, module, project, call: ast.Call,
+                           fn) -> list[Finding]:
+        """One jit application (call or decorator): donated positions
+        whose literal in/out sharding specs differ."""
+        from dib_tpu.analysis.jaxutil import _int_elts, _string_elts
+
+        kws = {kw.arg: kw.value for kw in call.keywords if kw.arg}
+        donate_nums = _int_elts(kws.get("donate_argnums",
+                                        ast.Tuple(elts=[])))
+        donate_names = _string_elts(kws.get("donate_argnames",
+                                            ast.Tuple(elts=[])))
+        in_sh = kws.get("in_shardings")
+        out_sh = kws.get("out_shardings")
+        if not (donate_nums or donate_names) or in_sh is None \
+                or out_sh is None:
+            return []
+        if not isinstance(in_sh, (ast.Tuple, ast.List)):
+            return []
+        positions = set(donate_nums)
+        if donate_names and fn is not None:
+            params = [a.arg for a in (*fn.args.posonlyargs,
+                                      *fn.args.args)]
+            positions.update(params.index(p) for p in donate_names
+                             if p in params)
+        out_elts = (out_sh.elts
+                    if isinstance(out_sh, (ast.Tuple, ast.List))
+                    else [out_sh])
+        findings = []
+        for pos in sorted(positions):
+            if pos >= len(in_sh.elts) or pos >= len(out_elts):
+                continue
+            in_spec, out_spec = in_sh.elts[pos], out_elts[pos]
+            if not (isinstance(in_spec, ast.Call)
+                    and _spec_call(in_spec)
+                    and isinstance(out_spec, ast.Call)
+                    and _spec_call(out_spec)):
+                continue
+            if _spec_signature(module, in_spec, project) != \
+                    _spec_signature(module, out_spec, project):
+                findings.append(self.finding(
+                    module, call.lineno,
+                    f"argument {pos} is donated but its in_sharding "
+                    "and out_sharding specs differ — XLA cannot "
+                    "reuse a donated buffer across a reshard, so "
+                    "the donation saves nothing while the input is "
+                    "still invalidated; align the specs or drop the "
+                    "donation",
+                ))
+        return findings
+
+    # -------------------------------------------------- save vs restore
+    def _constraints_in(self, module, fn, project) -> list[tuple]:
+        """Literal spec signatures applied via with_sharding_constraint /
+        device_put(..., NamedSharding(mesh, P(...))) inside one function."""
+        out = []
+        for call in ast.walk(fn):
+            if not isinstance(call, ast.Call):
+                continue
+            name = call_name(call)
+            terminal = name.split(".")[-1] if name else None
+            if terminal not in _CONSTRAINT_CALLS:
+                continue
+            for node in ast.walk(call):
+                if node is call:
+                    continue
+                if isinstance(node, ast.Call) and _spec_call(node):
+                    out.append(_spec_signature(module, node, project))
+        # repr key: signatures mix None/str/tuple/Ellipsis, which do not
+        # order under < — a bare sorted() would crash the whole run
+        return sorted(out, key=repr)
+
+    def _check_save_restore(self, module, project):
+        findings = []
+        for cls in ast.walk(module.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            save_specs: list[tuple] = []
+            restore_specs: list[tuple] = []
+            restore_line = None
+            for item in cls.body:
+                if not isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                specs = self._constraints_in(module, item, project)
+                if not specs:
+                    continue
+                if "save" in item.name:
+                    save_specs.extend(specs)
+                elif "restore" in item.name or "load" in item.name:
+                    restore_specs.extend(specs)
+                    restore_line = restore_line or item.lineno
+            if save_specs and restore_specs \
+                    and sorted(save_specs, key=repr) \
+                    != sorted(restore_specs, key=repr):
+                findings.append(self.finding(
+                    module, restore_line,
+                    f"`{cls.name}` restores under sharding constraint(s) "
+                    f"{restore_specs} but saves under {save_specs} — a "
+                    "restore whose constraint differs from the save site "
+                    "silently RESHARDS the checkpoint (the "
+                    "reshard-on-restore bug the 2D mesh refactor must "
+                    "not ship with); make both sites read one spec",
+                ))
+        return findings

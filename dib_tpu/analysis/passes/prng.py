@@ -17,6 +17,14 @@ sampler, a model ``init``/``apply``, a fit) consumes it. A second
 consumption without an intervening rebind is flagged, as is a consumption
 inside a loop whose body never rebinds the key (every iteration reuses
 the same key — the classic copy-paste bug).
+
+Interprocedural (analysis/project.py): a call resolved to a project
+function consumes a key argument only when that function's summary says
+the bound parameter is consumed (transitively) — so a helper that only
+``split``\\s its key no longer burns the caller's one allowed
+consumption, while a helper that samples with it counts exactly like a
+direct ``jax.random.normal``. Unresolvable calls keep the conservative
+rule (they consume).
 """
 
 from __future__ import annotations
@@ -72,6 +80,10 @@ class PrngReusePass(LintPass):
                 "crash")
 
     def check_module(self, module: Module) -> list[Finding]:
+        return self.check_module_with_project(module, None)
+
+    def check_module_with_project(self, module: Module,
+                                  project) -> list[Finding]:
         findings: list[Finding] = []
         # Key-shaped PARAMETER names only mean "PRNG key" in modules that
         # actually touch jax.random — elsewhere `key` is a dict key
@@ -80,11 +92,20 @@ class PrngReusePass(LintPass):
         params_are_keys = "jax.random" in module.source
         for fn in module.functions():
             findings.extend(
-                self._check_scope(module, fn, params_are_keys))
+                self._check_scope(module, fn, params_are_keys, project))
         return findings
 
+    def _consumes(self, module: Module, call: ast.Call, argname: str,
+                  fn, project) -> bool:
+        """Does this (non-deriving) call consume the key ``argname``?
+        Project-resolved callees answer from their interprocedural
+        summary; everything else conservatively consumes."""
+        if project is None:
+            return True
+        return project.call_consumes_key(module, call, argname, scope=fn)
+
     def _check_scope(self, module: Module, fn,
-                     params_are_keys: bool) -> list[Finding]:
+                     params_are_keys: bool, project=None) -> list[Finding]:
         findings: list[Finding] = []
         stmts = statements_in_order(fn)
         # name -> line of the assignment that made it a key (or 0 = param)
@@ -107,6 +128,9 @@ class PrngReusePass(LintPass):
                         continue
                     if deriving:
                         continue  # split/fold_in derive, never consume
+                    if not self._consumes(module, call, arg.id, fn,
+                                          project):
+                        continue  # resolved helper only derives/ignores it
                     prior = consumed.get(arg.id)
                     if prior is not None:
                         findings.append(self.finding(

@@ -1,0 +1,337 @@
+"""resource-lifecycle: every spawned process/pipe/socket/thread must have
+a reachable close/join/terminate.
+
+The incident shape (PR 8/PR 10, found by chaos drills as fd
+exhaustion): the serving and scheduling planes now spawn real OS
+resources — ``subprocess.Popen`` re-exec workers (``serve/prefork.py``,
+the flag-stripping fork-bomb postmortem's blast radius), ``Pipe()``
+request planes and spawn-context worker ``Process``\\es
+(``serve/pool.py``), worker/reaper ``Thread``\\s (``sched/pool.py``),
+listener sockets (``serve/server.py``). A resource created on some path
+with NO reachable ``close``/``join``/``terminate``/``kill``/``wait``
+leaks a process table entry or fd per respawn; the chaos suites find it
+hours later as ``EMFILE``, not at the creation site.
+
+Decidable rules (conservative in the right direction — a resource that
+ESCAPES its scope is the next scope's problem, never a finding here):
+
+- **locals**: a name bound directly from a resource constructor
+  (``subprocess.Popen``, ``multiprocessing``/ctx ``Pipe``/``Process``,
+  ``threading.Thread``, ``socket.socket``/``create_server``/
+  ``create_connection``) — or from a project function whose summary
+  says it RETURNS such a resource (interprocedural: factories like
+  ``spawn(k)`` / ``reserve_port(host)`` taint their callers) — must be
+  closed in the scope (a closer-method call on the name, or a ``with``
+  block) unless it escapes: returned/yielded, passed as a call
+  argument, stored into an attribute/subscript/container, or aliased.
+- **self attributes**: ``self.x = <resource ctor>`` must have SOME
+  method of the class calling a closer on ``self.x`` (or passing it
+  out). The class closing its resources in ``close()`` is the contract;
+  whether ``close()`` is called is the caller's lifecycle.
+- ``daemon=True`` **threads** are exempt (fire-and-forget by declared
+  intent; the interpreter reaps them) — daemon PROCESSES are not (a
+  spawned process holds pipes and a pid either way).
+
+Tuple-unpacked constructors (``parent, child = Pipe()``) bind every
+target as a resource; factory summaries carry which tuple positions
+are resources, so ``sock, port = reserve_port(host)`` taints exactly
+``sock``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from dib_tpu.analysis.core import (
+    Finding,
+    LintPass,
+    Module,
+    call_name,
+    register,
+    statements_in_order,
+    walk_stmt_exprs,
+)
+
+#: Closer method names: any of these called on the resource counts as a
+#: reachable lifecycle end (correct USE of them is runtime's problem).
+_CLOSERS = {"close", "terminate", "kill", "join", "wait", "shutdown",
+            "communicate", "stop", "release", "detach", "unlink"}
+
+#: Terminal ctor names accepted on ANY receiver (spawn contexts:
+#: ``self._ctx.Pipe()``), vs those requiring their canonical module base.
+#: The terminal name itself is the "kind" findings print.
+_CTOR_ANY_BASE = {"Popen", "Pipe", "Process", "Thread"}
+_CTOR_SOCKET = {"socket", "create_server", "create_connection"}
+
+
+def _resource_ctor(call: ast.Call) -> str | None:
+    """The resource kind a constructor call creates, else None."""
+    name = call_name(call)
+    if name is None:
+        return None
+    parts = name.split(".")
+    terminal = parts[-1]
+    if terminal in _CTOR_ANY_BASE:
+        if terminal == "Thread" and _is_daemon(call):
+            return None
+        if terminal == "Process" and parts[0] not in (
+                "multiprocessing", "mp", "self", "ctx") \
+                and len(parts) == 1:
+            return None   # a bare local Process() class is not stdlib's
+        return terminal
+    if terminal in _CTOR_SOCKET and parts[0] == "socket":
+        return "socket"
+    return None
+
+
+def _is_daemon(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+            return bool(kw.value.value)
+    return False
+
+
+@register
+class ResourceLifecyclePass(LintPass):
+    id = "resource-lifecycle"
+    description = ("subprocess/pipe/socket/thread objects with no "
+                   "reachable close/join/terminate in their owning scope "
+                   "or class (escapes are the next scope's problem)")
+    incident = ("the PR 10 prefork/pool planes respawn worker processes "
+                "and pipes on every heal; a handle dropped on any path "
+                "leaks a pid+fds per respawn — the chaos suites find it "
+                "hours later as fd exhaustion (EMFILE), never at the "
+                "creation site (docs/serving.md, docs/robustness.md)")
+
+    def check_module(self, module: Module) -> list[Finding]:
+        return self.check_module_with_project(module, None)
+
+    def check_module_with_project(self, module: Module,
+                                  project) -> list[Finding]:
+        if module.tree is None:
+            return []
+        factories = (self._factory_summaries(project)
+                     if project is not None else {})
+        if not factories and not any(
+                tok in module.source
+                for tok in ("Popen", "Pipe", "Process", "Thread", "socket")):
+            return []   # no ctor tokens AND no factories to flow in from
+        findings: list[Finding] = []
+        for fn in module.functions():
+            findings.extend(self._check_scope(module, fn, project,
+                                              factories))
+        findings.extend(self._check_self_attrs(module))
+        return findings
+
+    # ----------------------------------------------- factory summaries
+    def _factory_summaries(self, project) -> dict[str, dict]:
+        """``{qualname: {position or None: kind}}`` for project functions
+        returning live resources (position None = the bare return value;
+        ints index a returned tuple). The shared call-graph fixpoint
+        (Project.fixpoint), so factory-of-factory chains resolve."""
+        return project.fixpoint(
+            "_resource_factory_facts",
+            lambda info, facts: self._returned_resources(
+                project.modules[info.rel], info.node, project, facts))
+
+    def _resource_locals(self, module, fn, project, facts,
+                         ) -> dict[str, tuple[int, str]]:
+        """name -> (creation line, kind) for locals bound from resource
+        ctors or summarized factories (tuple-unpack aware)."""
+        out: dict[str, tuple[int, str]] = {}
+        for stmt in statements_in_order(fn):
+            if not (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1):
+                continue
+            target, value = stmt.targets[0], stmt.value
+            if not isinstance(value, ast.Call):
+                continue
+            kind = _resource_ctor(value)
+            positions: dict = {}
+            if kind is not None:
+                positions = ({0: kind, 1: kind} if kind == "Pipe"
+                             else {None: kind})
+            elif project is not None:
+                info = project.resolve_call(module, value, scope=fn)
+                if info is not None:
+                    positions = facts.get(info.qualname, {})
+            if not positions:
+                continue
+            if isinstance(target, ast.Name):
+                # bare binding: one resource (or a holder of several —
+                # closing the elements needs an unpack first either way)
+                out[target.id] = (stmt.lineno,
+                                  next(iter(positions.values())))
+            elif isinstance(target, ast.Tuple):
+                for i, elt in enumerate(target.elts):
+                    tkind = positions.get(i)
+                    if isinstance(elt, ast.Name) and tkind is not None:
+                        out[elt.id] = (stmt.lineno, tkind)
+        return out
+
+    def _returned_resources(self, module, fn, project, facts) -> dict:
+        locals_ = self._resource_locals(module, fn, project, facts)
+        closed = self._closed_names(fn)
+        out: dict = {}
+        for stmt in statements_in_order(fn):
+            if not isinstance(stmt, ast.Return) or stmt.value is None:
+                continue
+            value = stmt.value
+            if isinstance(value, ast.Call):
+                kind = _resource_ctor(value)
+                if kind is not None:
+                    out[None] = kind
+            elif isinstance(value, ast.Name) and value.id in locals_ \
+                    and value.id not in closed:
+                out[None] = locals_[value.id][1]
+            elif isinstance(value, ast.Tuple):
+                for i, elt in enumerate(value.elts):
+                    if isinstance(elt, ast.Call):
+                        kind = _resource_ctor(elt)
+                        if kind is not None:
+                            out[i] = kind
+                    elif isinstance(elt, ast.Name) \
+                            and elt.id in locals_ \
+                            and elt.id not in closed:
+                        out[i] = locals_[elt.id][1]
+        return out
+
+    # ------------------------------------------------------ scope check
+    @staticmethod
+    def _closed_names(fn) -> set[str]:
+        """Names with a reachable closer in the scope: ``name.close()``
+        etc anywhere (order-insensitive — a lint proves reachability
+        exists, not that every path takes it), or managed by ``with``."""
+        closed: set[str] = set()
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _CLOSERS
+                    and isinstance(node.func.value, ast.Name)):
+                closed.add(node.func.value.id)
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    ctx = item.context_expr
+                    if isinstance(ctx, ast.Name):
+                        closed.add(ctx.id)
+                    if item.optional_vars is not None and isinstance(
+                            item.optional_vars, ast.Name):
+                        closed.add(item.optional_vars.id)
+        return closed
+
+    @staticmethod
+    def _handle_names(module, root: ast.AST):
+        """Bare Names in ``root`` whose VALUE (the handle itself) flows
+        out — a Name that is merely the base of an attribute chain
+        (``proc.pid``, ``proc.returncode``) passes an attribute, never
+        the handle, and must not launder the leak."""
+        for sub in ast.walk(root):
+            if isinstance(sub, ast.Name) and not isinstance(
+                    module.parent_of(sub), ast.Attribute):
+                yield sub.id
+
+    def _escaped_names(self, module, fn) -> set[str]:
+        """Names whose value leaves the scope: returned/yielded, passed
+        to any call, stored into an attribute/subscript/container, or
+        aliased by a plain assignment. Receiver-position uses
+        (``proc.poll()``) and attribute reads handed elsewhere
+        (``log.info('%s', proc.pid)``) do NOT escape — only the bare
+        handle transfers ownership."""
+        escaped: set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                for arg in (*node.args,
+                            *(kw.value for kw in node.keywords)):
+                    escaped.update(self._handle_names(module, arg))
+            elif isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)):
+                value = getattr(node, "value", None)
+                if value is not None:
+                    escaped.update(self._handle_names(module, value))
+            elif isinstance(node, ast.Assign):
+                # aliasing / storing: any bare-Name RHS element escapes
+                # when the target is not a plain Name rebind of itself
+                stores = any(not isinstance(t, ast.Name)
+                             for t in node.targets)
+                if isinstance(node.value, ast.Name):
+                    escaped.add(node.value.id)      # plain alias
+                elif stores or isinstance(node.value,
+                                          (ast.Tuple, ast.List, ast.Dict)):
+                    escaped.update(self._handle_names(module, node.value))
+        return escaped
+
+    def _check_scope(self, module, fn, project, factories) -> list[Finding]:
+        findings: list[Finding] = []
+        # a resource constructor whose handle is DISCARDED outright — a
+        # bare `subprocess.Popen(cmd)` statement — can never be closed
+        for stmt in statements_in_order(fn):
+            if isinstance(stmt, ast.Expr) \
+                    and isinstance(stmt.value, ast.Call):
+                kind = _resource_ctor(stmt.value)
+                if kind is not None:
+                    findings.append(self.finding(
+                        module, stmt.lineno,
+                        f"{kind} handle discarded — nothing can ever "
+                        "close, join, or terminate it; bind it and end "
+                        "its life (or hand it to an owner that does)",
+                    ))
+        locals_ = self._resource_locals(module, fn, project, factories)
+        if not locals_:
+            return findings
+        closed = self._closed_names(fn)
+        escaped = self._escaped_names(module, fn)
+        for name, (line, kind) in sorted(locals_.items()):
+            if name in closed or name in escaped:
+                continue
+            findings.append(self.finding(
+                module, line,
+                f"`{name}` ({kind}) is created here but no path in "
+                f"`{fn.name}` closes, joins, or hands it off — each "
+                "leaked handle is a pid/fd the chaos drills find later "
+                "as EMFILE; close it in a finally (or return it to an "
+                "owner that does)",
+            ))
+        return findings
+
+    # -------------------------------------------------- self attributes
+    def _check_self_attrs(self, module) -> list[Finding]:
+        findings: list[Finding] = []
+        for cls in ast.walk(module.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            created: dict[str, tuple[int, str]] = {}
+            managed: set[str] = set()
+            for node in ast.walk(cls):
+                if (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Attribute)
+                        and isinstance(node.targets[0].value, ast.Name)
+                        and node.targets[0].value.id == "self"
+                        and isinstance(node.value, ast.Call)):
+                    kind = _resource_ctor(node.value)
+                    if kind is not None:
+                        created.setdefault(
+                            node.targets[0].attr, (node.lineno, kind))
+                if (isinstance(node, ast.Attribute)
+                        and isinstance(node.value, ast.Attribute)
+                        and isinstance(node.value.value, ast.Name)
+                        and node.value.value.id == "self"
+                        and node.attr in _CLOSERS):
+                    managed.add(node.value.attr)   # self.X.close reachable
+                if (isinstance(node, ast.Call)):
+                    for arg in (*node.args,
+                                *(kw.value for kw in node.keywords)):
+                        if (isinstance(arg, ast.Attribute)
+                                and isinstance(arg.value, ast.Name)
+                                and arg.value.id == "self"):
+                            managed.add(arg.attr)  # handed off
+            for attr, (line, kind) in sorted(created.items()):
+                if attr in managed:
+                    continue
+                findings.append(self.finding(
+                    module, line,
+                    f"`self.{attr}` ({kind}) is created but no method of "
+                    f"`{cls.name}` ever closes/joins/terminates it — the "
+                    "class cannot possibly end the resource's life; add "
+                    "it to close() (the serve/pool.py WorkerReplica "
+                    "contract)",
+                ))
+        return findings

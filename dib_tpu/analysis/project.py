@@ -1,0 +1,611 @@
+"""Interprocedural dataflow engine: one parse pass, project-wide facts.
+
+PR 7's passes stop at function boundaries — ``helper(state)`` hides a
+donation from ``donation-safety`` exactly the way the PR 4 incident hid
+from review. This module is the second layer: a project-wide symbol
+table built in the same single parse pass the framework already does
+(module → class → function defs, import resolution inside the lint
+roots), call-graph edges with bound/unbound-method argument mapping
+(the ``donated_args`` machinery, generalized), and fixpoint taint
+propagation so facts like "donated tree", "consumed PRNG key",
+"returns an un-copied device buffer", "blocks the calling thread", and
+"returns a live OS resource" flow THROUGH helper-function boundaries
+instead of stopping at them.
+
+What crosses a function boundary (docs/static-analysis.md spells the
+same contract for users):
+
+- **bare-name calls** to functions defined in the same module or
+  imported by name (``from dib_tpu.train.overlap import snapshot_params``),
+  re-export chains followed through package ``__init__`` modules;
+- **module-attribute calls** through an imported module alias
+  (``overlap.snapshot_params(...)``);
+- **``self.method(...)``** calls, resolved in the enclosing class;
+- **bound-instance calls** on locals with a locally decidable type
+  (``trainer = DIBTrainer(...); trainer.fit(...)``) — a name assigned
+  from exactly one project-class constructor and never rebound.
+
+What deliberately does NOT cross: dynamic dispatch (``for hook in
+hooks: hook(...)``), attributes of attributes (``self.zoo.resolve``),
+inherited methods, and anything a conditional rebinds — an
+interprocedural lint must stay decidable, so the unresolvable stays
+with the intraprocedural rules (conservative for PRNG consumption,
+silent for donation).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Iterable
+
+from dib_tpu.analysis.core import (
+    Module,
+    assigned_names,
+    call_name,
+    dotted_name,
+    statements_in_order,
+    walk_stmt_exprs,
+)
+from dib_tpu.analysis.jaxutil import JittedFn, bind_call_args, jitted_callables
+
+
+@dataclasses.dataclass(frozen=True)
+class FunctionInfo:
+    """One project function/method, addressable across modules."""
+
+    rel: str                      # owning module, repo-relative
+    name: str                     # bare name
+    qualname: str                 # "<rel>::<Class.>name"
+    cls: str | None               # enclosing class name, if a method
+    params: tuple[str, ...]       # positional-or-keyword params in order
+    is_async: bool
+    lineno: int
+    node: ast.FunctionDef | ast.AsyncFunctionDef
+
+    @property
+    def is_method(self) -> bool:
+        return self.cls is not None
+
+
+def _module_name(rel: str) -> str | None:
+    """Dotted import name for a repo-relative path (``dib_tpu/train/
+    overlap.py`` → ``dib_tpu.train.overlap``; package ``__init__`` maps to
+    the package itself)."""
+    if not rel.endswith(".py"):
+        return None
+    parts = rel[:-3].split("/")
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts) if parts else None
+
+
+class Project:
+    """The project-wide symbol table + call-graph resolution + summaries.
+
+    Built once per lint run from the already-parsed :class:`Module`\\s;
+    summaries are computed lazily (a run selecting only intraprocedural
+    passes never pays for the fixpoints) and cached.
+    """
+
+    def __init__(self, modules: Iterable[Module]):
+        self.modules: dict[str, Module] = {m.rel: m for m in modules}
+        # dotted module name -> rel ("dib_tpu.train.overlap" -> ".../overlap.py")
+        self._by_name: dict[str, str] = {}
+        # bare script name -> rel (scripts import each other unqualified)
+        self._script_names: dict[str, str] = {}
+        for rel in self.modules:
+            name = _module_name(rel)
+            if name is not None:
+                self._by_name[name] = rel
+                if rel.startswith("scripts/") and "." not in name.partition(
+                        "scripts.")[2]:
+                    self._script_names[rel[len("scripts/"):-3]] = rel
+        # per-module tables (built eagerly: one cheap AST walk per module)
+        self._functions: dict[str, dict[str, FunctionInfo]] = {}
+        self._classes: dict[str, dict[str, ast.ClassDef]] = {}
+        self._methods: dict[str, dict[str, dict[str, FunctionInfo]]] = {}
+        self._imports: dict[str, dict[str, tuple[str, str | None]]] = {}
+        # dep edges with no name binding (`import a.b` binds `a`, but the
+        # file's analysis still depends on a/b.py's content)
+        self._extra_deps: dict[str, set[str]] = {}
+        for rel, module in self.modules.items():
+            self._index_module(rel, module)
+        self.module_deps: dict[str, set[str]] = {
+            rel: ({target for target, _sym in self._imports[rel].values()}
+                  | self._extra_deps.get(rel, set()))
+            for rel in self.modules
+        }
+        self.reverse_deps: dict[str, set[str]] = {r: set() for r in self.modules}
+        for rel, deps in self.module_deps.items():
+            for dep in deps:
+                self.reverse_deps.setdefault(dep, set()).add(rel)
+        # caches (summaries land lazily via :meth:`fixpoint`)
+        self._jitted: dict[str, dict[str, JittedFn]] = {}
+        self._instance_types: dict[str, dict[str, tuple[str, str]]] = {}
+
+    # ------------------------------------------------------------ indexing
+    def _index_module(self, rel: str, module: Module) -> None:
+        funcs: dict[str, FunctionInfo] = {}
+        classes: dict[str, ast.ClassDef] = {}
+        methods: dict[str, dict[str, FunctionInfo]] = {}
+        imports: dict[str, tuple[str, str | None]] = {}
+        self._functions[rel] = funcs
+        self._classes[rel] = classes
+        self._methods[rel] = methods
+        self._imports[rel] = imports
+        if module.tree is None:
+            return
+        for node in module.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                funcs[node.name] = self._info(rel, node, cls=None)
+            elif isinstance(node, ast.ClassDef):
+                classes[node.name] = node
+                methods[node.name] = {
+                    item.name: self._info(rel, item, cls=node.name)
+                    for item in node.body
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))
+                }
+        # imports anywhere in the file — this repo imports inside functions
+        # heavily (lazy jax), and a linter's name resolution does not need
+        # scope sensitivity to be right about which module a name means
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    target = self._resolve_module(node, alias.name, rel)
+                    if alias.asname:
+                        if target is not None:
+                            imports[alias.asname] = (target, None)
+                        continue
+                    # Python binds the ROOT package name: `import a.b`
+                    # puts `a` (not a.b) in the namespace — resolve the
+                    # bound name against the root, and keep the dep edge
+                    # to the actually-imported submodule
+                    root_name = alias.name.split(".")[0]
+                    root_target = (target if root_name == alias.name
+                                   else self._resolve_module(
+                                       node, root_name, rel))
+                    if root_target is not None:
+                        imports[root_name] = (root_target, None)
+                    if target is not None and target != root_target:
+                        self._extra_deps.setdefault(rel, set()).add(target)
+            elif isinstance(node, ast.ImportFrom):
+                base = self._import_from_base(node, rel)
+                if base is None:
+                    continue
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    # `from pkg import sub` may name a submodule rather
+                    # than a symbol — prefer the submodule when it exists
+                    sub = self._by_name.get(
+                        f"{_module_name(base)}.{alias.name}"
+                        if _module_name(base) else "")
+                    if sub is not None:
+                        imports[alias.asname or alias.name] = (sub, None)
+                    else:
+                        imports[alias.asname or alias.name] = (
+                            base, alias.name)
+
+    def _info(self, rel: str, node, cls: str | None) -> FunctionInfo:
+        args = node.args
+        params = tuple(a.arg for a in (*args.posonlyargs, *args.args))
+        qual = f"{rel}::{cls + '.' if cls else ''}{node.name}"
+        return FunctionInfo(
+            rel=rel, name=node.name, qualname=qual, cls=cls, params=params,
+            is_async=isinstance(node, ast.AsyncFunctionDef),
+            lineno=node.lineno, node=node,
+        )
+
+    def _resolve_module(self, node: ast.Import, dotted: str,
+                        rel: str) -> str | None:
+        if dotted in self._by_name:
+            return self._by_name[dotted]
+        return self._script_names.get(dotted) \
+            if rel.startswith("scripts/") else None
+
+    def _import_from_base(self, node: ast.ImportFrom,
+                          rel: str) -> str | None:
+        """The rel of the module a ``from X import ...`` reads from."""
+        if node.level == 0:
+            if node.module is None:
+                return None
+            if node.module in self._by_name:
+                return self._by_name[node.module]
+            if rel.startswith("scripts/"):
+                return self._script_names.get(node.module)
+            return None
+        # relative import: walk up from the importing module's package.
+        # The strip is unconditional — a plain module drops its own file
+        # name, a package __init__ drops the "__init__" segment: both
+        # land on the containing package (keeping "__init__" would build
+        # lookups like "pkg.__init__.x" that match nothing, silently
+        # dropping every fact and dep edge of a package's re-exports)
+        parts = rel[:-3].split("/")[:-1]
+        parts = parts[: len(parts) - (node.level - 1)]
+        if node.module:
+            parts = parts + node.module.split(".")
+        return self._by_name.get(".".join(parts))
+
+    # ---------------------------------------------------------- resolution
+    def jitted(self, rel: str) -> dict[str, JittedFn]:
+        if rel not in self._jitted:
+            module = self.modules.get(rel)
+            self._jitted[rel] = (jitted_callables(module)
+                                 if module is not None else {})
+        return self._jitted[rel]
+
+    def function(self, rel: str, name: str) -> FunctionInfo | None:
+        return self._functions.get(rel, {}).get(name)
+
+    def method(self, rel: str, cls: str, name: str) -> FunctionInfo | None:
+        return self._methods.get(rel, {}).get(cls, {}).get(name)
+
+    def resolve_symbol(self, rel: str, name: str, _depth: int = 0):
+        """A top-level name in ``rel`` → ``("func", FunctionInfo)``,
+        ``("class", rel, ClassDef)``, or None — following re-export
+        chains through package ``__init__`` modules (bounded)."""
+        if _depth > 8:
+            return None
+        info = self.function(rel, name)
+        if info is not None:
+            return ("func", info)
+        cls = self._classes.get(rel, {}).get(name)
+        if cls is not None:
+            return ("class", rel, cls)
+        imported = self._imports.get(rel, {}).get(name)
+        if imported is None:
+            return None
+        target, symbol = imported
+        if symbol is None:
+            return None                   # a module alias is not a callable
+        return self.resolve_symbol(target, symbol, _depth + 1)
+
+    def instance_types(self, module: Module, fn) -> dict[str, tuple[str, str]]:
+        """Locals of ``fn`` with a decidable project-class type: assigned
+        from exactly one ``Cls(...)`` constructor (Cls a project class
+        visible in the module) and never reassigned anything else.
+        Returns ``{name: (rel, class name)}``."""
+        key = f"{module.rel}:{getattr(fn, 'lineno', 0)}"
+        cached = self._instance_types.get(key)
+        if cached is not None:
+            return cached
+        counts: dict[str, int] = {}
+        typed: dict[str, tuple[str, str]] = {}
+        for stmt in statements_in_order(fn):
+            for name in assigned_names(stmt):
+                counts[name] = counts.get(name, 0) + 1
+            value = getattr(stmt, "value", None)
+            if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and isinstance(value, ast.Call)
+                    and isinstance(value.func, ast.Name)):
+                resolved = self.resolve_symbol(module.rel, value.func.id)
+                if resolved is not None and resolved[0] == "class":
+                    typed[stmt.targets[0].id] = (resolved[1], resolved[2].name)
+        out = {n: t for n, t in typed.items() if counts.get(n, 0) == 1}
+        self._instance_types[key] = out
+        return out
+
+    def resolve_call(self, module: Module, call: ast.Call,
+                     scope=None) -> FunctionInfo | None:
+        """The project function a call site resolves to, or None.
+
+        ``scope`` is the enclosing function node (for bound-instance
+        locals); the enclosing class for ``self.m(...)`` comes from the
+        module's parent links.
+        """
+        func = call.func
+        if isinstance(func, ast.Name):
+            resolved = self.resolve_symbol(module.rel, func.id)
+            return resolved[1] if resolved and resolved[0] == "func" else None
+        if not isinstance(func, ast.Attribute):
+            return None
+        base = func.value
+        if isinstance(base, ast.Name):
+            if base.id == "self":
+                cls = module.enclosing_class(call)
+                if cls is not None:
+                    return self.method(module.rel, cls.name, func.attr)
+                return None
+            imported = self._imports.get(module.rel, {}).get(base.id)
+            if imported is not None and imported[1] is None:
+                return self.function(imported[0], func.attr)
+            if scope is not None:
+                typed = self.instance_types(module, scope).get(base.id)
+                if typed is not None:
+                    return self.method(typed[0], typed[1], func.attr)
+        return None
+
+    def all_functions(self):
+        for rel in sorted(self._functions):
+            yield from self._functions[rel].values()
+            for cls in sorted(self._methods.get(rel, {})):
+                yield from self._methods[rel][cls].values()
+
+    def fixpoint(self, cache_attr: str, transfer) -> dict:
+        """Generic MONOTONE call-graph fixpoint, cached on the project.
+
+        ``transfer(info, facts) -> fact`` recomputes one function's fact
+        from the current facts map; a falsy fact is "nothing" and is
+        never stored (absent ≡ empty). Facts must only grow under
+        iteration — every summary here does (donation/consumption/
+        blocking/resource sets), which is what guarantees termination.
+        All four pass summaries share this loop so the next summary is
+        one transfer function, not a copied driver.
+        """
+        cached = getattr(self, cache_attr, None)
+        if cached is not None:
+            return cached
+        facts: dict = {}
+        changed = True
+        while changed:
+            changed = False
+            for info in self.all_functions():
+                fact = transfer(info, facts)
+                if fact and fact != facts.get(info.qualname):
+                    facts[info.qualname] = fact
+                    changed = True
+        setattr(self, cache_attr, facts)
+        return facts
+
+    # ------------------------------------------------- donation summaries
+    def donation_summaries(self) -> dict[str, dict[str, str]]:
+        """``{qualname: {param: via-chain}}`` for every project function
+        that passes one of ITS OWN parameters (before any rebind) into a
+        call that donates it — a jitted ``donate_argnames`` callee, or
+        (transitively) another summarized function. The caller's
+        parameter is dead after such a call exactly as if the caller were
+        jitted with the donation itself."""
+        facts = self.fixpoint("_donation_facts", self._donation_fact)
+        return {q: fact["donated"] for q, fact in facts.items()
+                if fact.get("donated")}
+
+    def fresh_returners(self) -> set[str]:
+        """Qualnames of functions whose return value is (or contains) the
+        un-copied result of a jitted call — the device buffers the PR 4
+        async-save incident raced. A host copy (``jax.device_get`` /
+        ``np.array``) inside the function clears it."""
+        facts = self.fixpoint("_donation_facts", self._donation_fact)
+        return {q for q, fact in facts.items() if fact.get("fresh")}
+
+    def _donation_fact(self, info: FunctionInfo, facts) -> dict:
+        """One combined donation fact: ``{"donated": {param: chain},
+        "fresh": bool}`` — the two taints share one statement walk."""
+        donated, returns_fresh = self._donation_transfer(info, facts)
+        fact: dict = {}
+        if donated:
+            fact["donated"] = donated
+        if returns_fresh:
+            fact["fresh"] = True
+        return fact
+
+    def _donation_target(self, module: Module, call: ast.Call, scope,
+                         facts) -> tuple[tuple[str, ...], frozenset | dict,
+                                         bool, str] | None:
+        """(params, donated, is_method, name) for a call that donates —
+        via local jit facts or a project summary."""
+        local = self.jitted(module.rel)
+        func = call.func
+        jit = None
+        if isinstance(func, ast.Name):
+            jit = local.get(func.id)
+        elif isinstance(func, ast.Attribute):
+            jit = local.get(func.attr)
+        if jit is not None and jit.donated:
+            return jit.params, jit.donated, jit.is_method, jit.name
+        info = self.resolve_call(module, call, scope=scope)
+        if info is not None:
+            target_jit = self.jitted(info.rel).get(info.name)
+            if (target_jit is not None and target_jit.donated
+                    and target_jit.lineno == info.lineno):
+                return (target_jit.params, target_jit.donated,
+                        target_jit.is_method, target_jit.name)
+            summary = facts.get(info.qualname, {}).get("donated")
+            if summary:
+                return info.params, summary, info.is_method, info.name
+        return None
+
+    def _is_jitted_call(self, module: Module, call: ast.Call, scope) -> bool:
+        local = self.jitted(module.rel)
+        func = call.func
+        if isinstance(func, ast.Name) and func.id in local:
+            return True
+        if isinstance(func, ast.Attribute) and func.attr in local:
+            return True
+        info = self.resolve_call(module, call, scope=scope)
+        return (info is not None
+                and info.name in self.jitted(info.rel)
+                and self.jitted(info.rel)[info.name].lineno == info.lineno)
+
+    def _donation_transfer(self, info: FunctionInfo, facts,
+                           ) -> tuple[dict[str, str], bool]:
+        module = self.modules[info.rel]
+        donated: dict[str, str] = {}
+        rebound: set[str] = set()
+        fresh_names: set[str] = set()
+        returns_fresh = False
+        for stmt in statements_in_order(info.node):
+            for call in (n for n in walk_stmt_exprs(stmt)
+                         if isinstance(n, ast.Call)):
+                target = self._donation_target(module, call, info.node, facts)
+                if target is None:
+                    continue
+                params, tdonated, is_method, tname = target
+                for param, arg in bind_call_args(
+                        call, params, is_method).items():
+                    if param in tdonated and isinstance(arg, ast.Name) \
+                            and arg.id in info.params \
+                            and arg.id not in rebound \
+                            and arg.id not in donated:
+                        chain = (f"{tname} → {tdonated[param]}"
+                                 if isinstance(tdonated, dict) else tname)
+                        # cap the chain: through a recursion cycle the
+                        # embedded callee chain would otherwise grow on
+                        # every fixpoint sweep and never converge — the
+                        # first four hops identify the path, "…" says
+                        # there is more
+                        hops = chain.split(" → ")
+                        if len(hops) > 4:
+                            chain = " → ".join(hops[:4]) + " → …"
+                        donated[arg.id] = chain
+            value = getattr(stmt, "value", None)
+            assigned = assigned_names(stmt)
+            if assigned and isinstance(value, ast.Call):
+                if self._is_jitted_call(module, value, info.node):
+                    fresh_names.update(assigned)
+                else:
+                    resolved = self.resolve_call(module, value,
+                                                 scope=info.node)
+                    if resolved is not None and facts.get(
+                            resolved.qualname, {}).get("fresh"):
+                        fresh_names.update(assigned)
+                    else:
+                        fresh_names.difference_update(assigned)
+            else:
+                fresh_names.difference_update(assigned)
+            rebound.update(assigned)
+            if isinstance(stmt, ast.Return) and stmt.value is not None:
+                for node in ast.walk(stmt.value):
+                    if isinstance(node, ast.Call):
+                        if self._is_jitted_call(module, node, info.node):
+                            returns_fresh = True
+                        else:
+                            resolved = self.resolve_call(
+                                module, node, scope=info.node)
+                            if resolved is not None and facts.get(
+                                    resolved.qualname, {}).get("fresh"):
+                                returns_fresh = True
+                    elif isinstance(node, ast.Name) \
+                            and node.id in fresh_names:
+                        returns_fresh = True
+        return donated, returns_fresh
+
+    def donation_registry(self, module: Module) -> dict[str, JittedFn]:
+        """Donating callables VISIBLE in ``module`` beyond its own jit
+        facts: imported jitted functions, plus local/imported/project
+        functions whose summary says they donate a parameter. Keyed by
+        the name a call site would use, as :class:`JittedFn` rows the
+        donation pass's machinery consumes unchanged."""
+        out: dict[str, JittedFn] = {}
+        summaries = self.donation_summaries()
+
+        def add(name: str, info: FunctionInfo) -> None:
+            target_jit = self.jitted(info.rel).get(info.name)
+            if (target_jit is not None and target_jit.donated
+                    and target_jit.lineno == info.lineno):
+                out[name] = dataclasses.replace(target_jit, name=name)
+                return
+            summary = summaries.get(info.qualname)
+            if summary:
+                out[name] = JittedFn(
+                    name=name, params=info.params,
+                    donated=frozenset(summary),
+                    is_method=info.is_method, lineno=info.lineno,
+                    via=", ".join(f"{p} → {chain}"
+                                  for p, chain in sorted(summary.items())),
+                )
+
+        for name, info in self._functions.get(module.rel, {}).items():
+            add(name, info)
+        for cls, methods in self._methods.get(module.rel, {}).items():
+            for name, info in methods.items():
+                add(name, info)
+        for name, (target, symbol) in self._imports.get(
+                module.rel, {}).items():
+            if symbol is None:
+                continue
+            resolved = self.resolve_symbol(module.rel, name)
+            if resolved is not None and resolved[0] == "func":
+                add(name, resolved[1])
+        return out
+
+    # ----------------------------------------------------- PRNG summaries
+    def key_consumers(self) -> dict[str, set[str]]:
+        """``{qualname: {param}}``: parameters a function passes (before
+        any rebind) into a call that CONSUMES key entropy — an unresolved
+        non-deriving call (conservative, the intraprocedural rule), a
+        jitted callee, or transitively another summarized consumer. A
+        helper that only ``split``\\s its key never lands here, which is
+        what lets call sites pass one key to a deriving helper and then
+        legitimately consume it once themselves."""
+        return self.fixpoint("_key_consumer_facts", self._consumer_transfer)
+
+    def _consumer_transfer(self, info: FunctionInfo, facts) -> set[str]:
+        from dib_tpu.analysis.passes.prng import _is_deriving_call as \
+            is_deriving
+
+        module = self.modules[info.rel]
+        consumed: set[str] = set()
+        rebound: set[str] = set()
+        for stmt in statements_in_order(info.node):
+            direct_args: set[int] = set()
+            for call in (n for n in walk_stmt_exprs(stmt)
+                         if isinstance(n, ast.Call)):
+                for arg in (*call.args, *(kw.value for kw in call.keywords)):
+                    if not (isinstance(arg, ast.Name)
+                            and arg.id in info.params
+                            and arg.id not in rebound):
+                        continue
+                    direct_args.add(id(arg))
+                    if is_deriving(call):
+                        continue
+                    if self.call_consumes_key(module, call, arg.id,
+                                              scope=info.node, facts=facts):
+                        consumed.add(arg.id)
+            # conservative escape hatch: a param key read in ANY context
+            # other than a direct call argument — a bare alias
+            # (`k = key`), a container literal, a subscript — may be
+            # consumed through the alias, which this summary does not
+            # track; mark it consumed so callers keep the conservative
+            # intraprocedural behavior instead of a silent pass
+            for node in walk_stmt_exprs(stmt):
+                if isinstance(node, ast.Name) \
+                        and isinstance(node.ctx, ast.Load) \
+                        and node.id in info.params \
+                        and node.id not in rebound \
+                        and id(node) not in direct_args:
+                    consumed.add(node.id)
+            rebound.update(assigned_names(stmt))
+        # the same escape hatch for CLOSURE capture: statements_in_order/
+        # walk_stmt_exprs prune nested def/lambda bodies, but a nested
+        # function reading the param consumes through the closure —
+        # untrackable here, so conservatively consuming (unless the
+        # nested scope shadows the name with its own binding)
+        for nested in ast.walk(info.node):
+            if nested is info.node or not isinstance(
+                    nested, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+                continue
+            own = {a.arg for a in (*nested.args.posonlyargs,
+                                   *nested.args.args,
+                                   *nested.args.kwonlyargs)}
+            for node in ast.walk(nested):
+                if isinstance(node, ast.Name) \
+                        and isinstance(node.ctx, ast.Load) \
+                        and node.id in info.params \
+                        and node.id not in own:
+                    consumed.add(node.id)
+        return consumed
+
+    def call_consumes_key(self, module: Module, call: ast.Call,
+                          argname: str, scope=None, facts=None) -> bool:
+        """Does passing ``argname`` to this (non-deriving) call consume
+        its entropy? Resolved project functions answer from their
+        summary; jitted callees and everything unresolvable answer yes
+        (the conservative intraprocedural rule)."""
+        if facts is None:
+            facts = self.key_consumers()
+        info = self.resolve_call(module, call, scope=scope)
+        if info is None:
+            return True
+        target_jit = self.jitted(info.rel).get(info.name)
+        if target_jit is not None and target_jit.lineno == info.lineno:
+            return True                   # jitted leaves use their keys
+        bound = bind_call_args(call, info.params, info.is_method)
+        params = {p for p, arg in bound.items()
+                  if isinstance(arg, ast.Name) and arg.id == argname}
+        if not params:
+            return True                   # *args/**kwargs: can't map — be safe
+        return bool(params & facts.get(info.qualname, set()))

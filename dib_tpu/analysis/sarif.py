@@ -1,0 +1,72 @@
+"""SARIF 2.1.0 rendering: ``lint --sarif`` for code-scanning consumers.
+
+SARIF (Static Analysis Results Interchange Format) is the interchange
+shape CI code-scanning UIs ingest (GitHub code scanning, VS Code SARIF
+viewers). One run, one tool (``dib-lint``), one rule per registered
+pass (the reserved ``pragma`` id included — suppression-grammar
+problems must surface in the same UI), one result per finding with a
+physical location. ``tests/test_lint/test_tooling.py`` validates the
+required-property subset of the 2.1.0 schema.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+from dib_tpu.analysis.core import PRAGMA_PASS_ID, Finding, LintPass
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
+
+
+def sarif_report(findings: Iterable[Finding],
+                 passes: Iterable[LintPass]) -> dict:
+    """The complete SARIF log object for one lint run."""
+    rules = [
+        {
+            "id": lint.id,
+            "shortDescription": {"text": lint.description},
+            "fullDescription": {"text": f"Prevents: {lint.incident}"},
+            "helpUri": "docs/static-analysis.md",
+        }
+        for lint in passes
+    ]
+    rules.append({
+        "id": PRAGMA_PASS_ID,
+        "shortDescription": {
+            "text": "suppression-grammar problems (reasonless, malformed, "
+                    "or unknown-pass lint-ok pragmas; unparseable files)"},
+        "fullDescription": {
+            "text": "Prevents: a suppression that does not parse silently "
+                    "changes what the suite checks"},
+        "helpUri": "docs/static-analysis.md",
+    })
+    results = [
+        {
+            "ruleId": finding.pass_id,
+            "level": "error",
+            "message": {"text": finding.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": finding.path},
+                    "region": {"startLine": finding.line},
+                },
+            }],
+        }
+        for finding in findings
+    ]
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "dib-lint",
+                    "informationUri": "docs/static-analysis.md",
+                    "rules": rules,
+                },
+            },
+            "results": results,
+        }],
+    }

@@ -1,0 +1,138 @@
+"""Suppression-budget accounting: ``lint --stats`` vs ``LINT_BUDGET.json``.
+
+A pragma is a debt note: a place the suite was told to look away, with
+a reason. Debts are fine — uncounted debts rot. ``lint --stats`` counts
+every suppression per pass across the tree (legacy ``timing-ok``/
+``fault-ok`` spellings count under the pass they map to) and gates the
+counts against the committed budget, ``telemetry check``-style (exit 1
+on violation):
+
+- a pass OVER its budget fails — new suppressions need the budget row
+  raised in the same commit, which is what code review sees;
+- a pass UNDER its budget fails too, unless the budget row carries a
+  justification: un-justified slack means pragmas were removed without
+  ratcheting the budget down, and un-ratcheted budgets are how the
+  count silently creeps back up. The budget can therefore only SHRINK
+  without paperwork; holding it above the current count requires a
+  ``justifications`` row saying why the headroom exists.
+
+``LINT_BUDGET.json``::
+
+    {
+      "version": 1,
+      "budget": {"timing-hygiene": 33, ...},
+      "justifications": {"<pass>": "why this row may exceed the count"}
+    }
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Iterable
+
+from dib_tpu.analysis import core
+from dib_tpu.analysis.core import Module
+
+BUDGET_VERSION = 1
+BUDGET_FILENAME = "LINT_BUDGET.json"
+
+
+def load_budget(root: str) -> dict | None:
+    """The committed budget, or None when the repo has none (counting
+    still works; gating is skipped). Raises ValueError on a malformed
+    budget — a broken committed gate must fail loudly, not skip."""
+    path = os.path.join(root, BUDGET_FILENAME)
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as f:
+        budget = json.load(f)
+    problems = validate_budget(budget)
+    if problems:
+        raise ValueError(f"{BUDGET_FILENAME}: " + "; ".join(problems))
+    return budget
+
+
+def validate_budget(budget) -> list[str]:
+    problems: list[str] = []
+    if not isinstance(budget, dict):
+        return ["must be a JSON object"]
+    if budget.get("version") != BUDGET_VERSION:
+        problems.append(f"version must be {BUDGET_VERSION}")
+    rows = budget.get("budget")
+    if not isinstance(rows, dict):
+        problems.append("'budget' must map pass ids to integer counts")
+        rows = {}
+    for pass_id, count in rows.items():
+        if not isinstance(count, int) or count < 0:
+            problems.append(f"budget[{pass_id!r}] must be a non-negative "
+                            "integer")
+        if pass_id not in core.REGISTRY \
+                and pass_id != core.PRAGMA_PASS_ID:
+            problems.append(f"budget names unknown pass {pass_id!r}")
+    just = budget.get("justifications", {})
+    if not isinstance(just, dict) or not all(
+            isinstance(v, str) and v.strip() for v in just.values()):
+        problems.append("'justifications' must map pass ids to non-empty "
+                        "reasons")
+    return problems
+
+
+def suppression_stats(modules: Iterable[Module]) -> dict[str, int]:
+    """Per-pass pragma counts over the parsed tree (sorted)."""
+    return core.pragma_counts(modules)
+
+
+def budget_violations(stats: dict[str, int], budget: dict) -> list[str]:
+    """The gate: over-budget passes, and un-justified slack (the
+    shrink-only ratchet — see the module docstring)."""
+    rows: dict[str, int] = budget.get("budget", {})
+    just: dict[str, str] = budget.get("justifications", {})
+    problems: list[str] = []
+    for pass_id, count in sorted(stats.items()):
+        allowed = rows.get(pass_id, 0)
+        if count > allowed:
+            problems.append(
+                f"{pass_id}: {count} suppression(s), budget {allowed} — "
+                "either remove the new pragma or raise the budget row "
+                "(and let review see it)")
+    for pass_id, allowed in sorted(rows.items()):
+        count = stats.get(pass_id, 0)
+        if allowed > count and pass_id not in just:
+            problems.append(
+                f"{pass_id}: budget {allowed} exceeds the actual count "
+                f"{count} with no justification row — ratchet the budget "
+                "down to the count (the budget only shrinks for free)")
+    return problems
+
+
+def stats_report(stats: dict[str, int], budget: dict | None,
+                 violations: list[str]) -> dict:
+    """The machine-readable ``--stats --json`` payload."""
+    return {
+        "version": BUDGET_VERSION,
+        "suppressions": stats,
+        "total": sum(stats.values()),
+        "budget": None if budget is None else budget.get("budget", {}),
+        "violations": violations,
+    }
+
+
+def format_stats(stats: dict[str, int], budget: dict | None,
+                 violations: list[str]) -> str:
+    lines = ["suppressions per pass (lint --stats):"]
+    rows = budget.get("budget", {}) if budget else {}
+    for pass_id in sorted(set(stats) | set(rows)):
+        count = stats.get(pass_id, 0)
+        allowed = rows.get(pass_id)
+        budget_txt = f" / budget {allowed}" if allowed is not None else ""
+        lines.append(f"  {pass_id}: {count}{budget_txt}")
+    lines.append(f"  total: {sum(stats.values())}")
+    if budget is None:
+        lines.append(f"no {BUDGET_FILENAME} committed — counts reported, "
+                     "nothing gated")
+    for problem in violations:
+        lines.append(f"BUDGET VIOLATION: {problem}")
+    if budget is not None and not violations:
+        lines.append("suppression budget: ok")
+    return "\n".join(lines)

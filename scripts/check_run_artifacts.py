@@ -431,16 +431,41 @@ def test_committed_run_artifacts():
     assert not bad, f"artifact schema violations: {json.dumps(bad, indent=1)}"
 
 
-def run_lint(repo: str = REPO) -> list[str]:
+def run_lint(repo: str = REPO) -> tuple[list[str], str]:
     """The static-analysis suite (docs/static-analysis.md) as formatted
     finding strings — the standalone gate runs it alongside the artifact
     schemas so one command covers everything committed. (The pytest path
-    covers lint separately via tests/test_lint/.)"""
+    covers lint separately via tests/test_lint/.)
+
+    Runs INCREMENTALLY (the ``lint --changed`` engine): the first run is
+    cold and primes ``.dib_lint_cache/``; later gate runs re-analyze
+    only dirty files plus their reverse-dependency closure, with
+    findings bit-identical to a cold run (pinned by
+    tests/test_lint/test_tooling.py). Also gates the suppression budget
+    (``LINT_BUDGET.json``) so the one-command path covers everything
+    ``lint`` + ``lint --stats`` would."""
     if repo not in sys.path:
         sys.path.insert(0, repo)
-    from dib_tpu.analysis import run_passes
+    from dib_tpu.analysis import stats as lint_stats
+    from dib_tpu.analysis.cache import run_tree
 
-    return [f.format() for f in run_passes(root=repo)]
+    result = run_tree(root=repo, changed=True)
+    problems = [f.format() for f in result.findings]
+    try:
+        budget = lint_stats.load_budget(repo)
+    except ValueError as exc:
+        # a malformed committed budget is a gate violation, not a
+        # traceback that hides the artifact results already computed
+        problems.append(str(exc))
+        budget = None
+    if budget is not None:
+        counts = lint_stats.suppression_stats(result.modules.values())
+        problems.extend(
+            f"{lint_stats.BUDGET_FILENAME}: {violation}"
+            for violation in lint_stats.budget_violations(counts, budget))
+    detail = (f"{result.analyzed_count} analyzed, "
+              f"{len(result.cached)} replayed from cache")
+    return problems, detail
 
 
 def main() -> int:
@@ -454,11 +479,12 @@ def main() -> int:
         else:
             print(f"{path}: ok")
     print(f"{len(results)} artifacts checked, {bad} with violations")
-    findings = run_lint()
+    findings, detail = run_lint()
     for finding in findings:
         print(finding)
     print("dib-lint: " + (f"{len(findings)} finding(s)" if findings
-                          else "ok (python -m dib_tpu lint)"))
+                          else f"ok (python -m dib_tpu lint --changed; "
+                               f"{detail})"))
     return 1 if bad or findings else 0
 
 

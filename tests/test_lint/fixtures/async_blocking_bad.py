@@ -1,0 +1,51 @@
+"""async-blocking BAD fixture: every loop-stall shape the pass must trip.
+
+The incident this family pins is the PR 10 serving plane: every
+connection is a coroutine on ONE event loop, so a single synchronous
+sleep/subprocess/device fetch stalls every in-flight request at once.
+"""
+
+import asyncio
+import subprocess
+import time
+
+import jax
+
+
+def _drain_queue(batch):
+    """A sync helper that blocks — the interprocedural chain target."""
+    time.sleep(0.01)
+    return batch
+
+
+async def handler_direct(request):
+    time.sleep(0.05)                       # BAD: sleep on the loop
+    return request
+
+
+async def handler_via_helper(batch):
+    out = _drain_queue(batch)              # BAD: blocking chain (helper sleeps)
+    return out
+
+
+async def handler_subprocess(cmd):
+    return subprocess.run(cmd)             # BAD: child-wait on the loop
+
+
+async def handler_device_fetch(outputs):
+    fetched = jax.device_get(outputs)      # BAD: implicit device sync
+    return fetched
+
+
+async def handler_future(fut):
+    value = fut.result()                   # BAD: Future.result deadlock shape
+    return value
+
+
+async def _probe(replica):
+    return replica
+
+
+async def handler_discarded_coroutine(replica):
+    _probe(replica)                        # BAD: coroutine object discarded
+    return replica

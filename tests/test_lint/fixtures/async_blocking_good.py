@@ -1,0 +1,38 @@
+"""async-blocking GOOD fixture: the blessed escapes — await the async
+equivalent, park blocking callables on an executor (passed, not
+called), schedule coroutines as tasks."""
+
+import asyncio
+import functools
+import time
+
+
+def _drain_queue(batch):
+    time.sleep(0.01)        # blocking, but only ever called OFF the loop
+    return batch
+
+
+async def handler_async_sleep(request):
+    await asyncio.sleep(0.05)
+    return request
+
+
+async def handler_executor(batch):
+    loop = asyncio.get_running_loop()
+    # the blocking callable is PASSED to the executor, never called here
+    out = await loop.run_in_executor(
+        None, functools.partial(_drain_queue, batch))
+    return out
+
+
+async def _probe(replica):
+    return replica
+
+
+async def handler_task(replica):
+    task = asyncio.create_task(_probe(replica))
+    return await task
+
+
+async def handler_awaited_chain(replica):
+    return await _probe(replica)

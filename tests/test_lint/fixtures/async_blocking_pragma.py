@@ -1,0 +1,13 @@
+"""async-blocking PRAGMA fixture: a reviewed exception with a reason —
+a startup-only coroutine that deliberately sleeps before the loop
+serves traffic (no in-flight requests exist yet to stall)."""
+
+import time
+
+
+async def warmup_once():
+    # lint-ok(async-blocking): startup-only coroutine, runs to completion
+    # before the listener accepts its first connection — nothing in
+    # flight can stall behind this deliberate settle delay
+    time.sleep(0.2)
+    return True

@@ -1,0 +1,74 @@
+"""mesh-consistency BAD fixture: every shape the pass must trip.
+
+Line numbers matter to tests only by content (conftest.line_of); each
+bad site is labeled. The mesh here is the 2D sweep mesh the ROADMAP's
+pjit refactor builds — ``Mesh(devices, ("sweep", "data"))`` — so the
+pass has project-local mesh facts to check specs against.
+"""
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+import numpy as np
+
+
+def make_mesh():
+    devices = np.asarray(jax.devices()).reshape(-1, 1)
+    return Mesh(devices, ("sweep", "data"))
+
+
+def make_dup_mesh():
+    devices = np.asarray(jax.devices()).reshape(-1, 1)
+    return Mesh(devices, ("sweep", "sweep"))            # BAD: duplicate axis
+
+
+def shard_states(mesh, states):
+    # BAD: 'model' is not an axis of any mesh this project builds
+    return jax.device_put(states, NamedSharding(mesh, P("model")))
+
+
+def shard_axis_twice(mesh, states):
+    # BAD: one mesh axis cannot shard two array dimensions
+    return jax.device_put(states, NamedSharding(mesh, P("sweep", "sweep")))
+
+
+def two_arg_kernel(block, scale):
+    return block * scale
+
+
+def bad_shard_map(mesh, x):
+    # BAD: one in_spec for a two-argument function
+    mapped = shard_map(two_arg_kernel, mesh=mesh,
+                       in_specs=(P("sweep"),),
+                       out_specs=P("sweep"))
+    return mapped(x)
+
+
+def step(states, batch):
+    return states
+
+
+# BAD: `states` is donated but its in_sharding P("sweep") != out P("data")
+bad_donating_step = jax.jit(
+    step,
+    donate_argnums=(0,),
+    in_shardings=(P("sweep"), P("data")),
+    out_shardings=(P("data"),),
+)
+
+
+class SweepCheckpointer:
+    """The reshard-on-restore bug shape: save constrains the stacked tree
+    over 'sweep', restore constrains it over 'data'."""
+
+    def __init__(self, mesh):
+        self.mesh = mesh
+
+    def save(self, manager, step_index, states):
+        placed = jax.device_put(states, NamedSharding(self.mesh, P("sweep")))
+        manager.save(step_index, placed)
+
+    def restore(self, manager, step_index):                 # BAD: spec drift
+        states = manager.restore(step_index)
+        return jax.device_put(states, NamedSharding(self.mesh, P("data")))

@@ -1,0 +1,72 @@
+"""mesh-consistency GOOD fixture: the same shapes done right — axes the
+mesh defines, arity-matched shard_map specs, donation with aligned
+shardings, save/restore reading ONE spec."""
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+import numpy as np
+
+SWEEP_AXIS = "sweep"
+DATA_AXIS = "data"
+
+#: The one spec both checkpoint directions read (the fix for the
+#: reshard-on-restore drift shape).
+REPLICA_SPEC = P(SWEEP_AXIS)
+
+
+def make_mesh():
+    devices = np.asarray(jax.devices()).reshape(-1, 1)
+    return Mesh(devices, (SWEEP_AXIS, DATA_AXIS))
+
+
+def shard_states(mesh, states):
+    return jax.device_put(states, NamedSharding(mesh, P(SWEEP_AXIS)))
+
+
+def shard_batches(mesh, batch):
+    return jax.device_put(batch, NamedSharding(mesh, P(SWEEP_AXIS, DATA_AXIS)))
+
+
+def shard_stacked(mesh, stacked):
+    # a 3D array on the 2D mesh: spec length is the ARRAY's rank — the
+    # trailing None (replicated dim) must not trip a rank check
+    return jax.device_put(
+        stacked, NamedSharding(mesh, P(SWEEP_AXIS, DATA_AXIS, None)))
+
+
+def two_arg_kernel(block, scale):
+    return block * scale
+
+
+def good_shard_map(mesh, x, scale):
+    mapped = shard_map(two_arg_kernel, mesh=mesh,
+                       in_specs=(P(SWEEP_AXIS), P()),
+                       out_specs=P(SWEEP_AXIS))
+    return mapped(x, scale)
+
+
+def step(states, batch):
+    return states
+
+
+good_donating_step = jax.jit(
+    step,
+    donate_argnums=(0,),
+    in_shardings=(P(SWEEP_AXIS), P(DATA_AXIS)),
+    out_shardings=(P(SWEEP_AXIS),),
+)
+
+
+class SweepCheckpointer:
+    def __init__(self, mesh):
+        self.mesh = mesh
+
+    def save(self, manager, step_index, states):
+        placed = jax.device_put(states, NamedSharding(self.mesh, REPLICA_SPEC))
+        manager.save(step_index, placed)
+
+    def restore(self, manager, step_index):
+        states = manager.restore(step_index)
+        return jax.device_put(states, NamedSharding(self.mesh, REPLICA_SPEC))

@@ -1,0 +1,19 @@
+"""mesh-consistency PRAGMA fixture: a reviewed exception suppressed with
+a reason — an axis name that genuinely lives in another repo's mesh
+(cross-repo serving import), which this project cannot see."""
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import numpy as np
+
+
+def make_mesh():
+    devices = np.asarray(jax.devices()).reshape(-1, 1)
+    return Mesh(devices, ("sweep", "data"))
+
+
+def shard_foreign(mesh, states):
+    # lint-ok(mesh-consistency): 'tensor' is an axis of the upstream
+    # serving repo's mesh; this helper only forwards the spec verbatim
+    return jax.device_put(states, NamedSharding(mesh, P("tensor")))

@@ -1,0 +1,53 @@
+"""resource-lifecycle BAD fixture: leaked handles in every shape the
+pass must trip — the fd-exhaustion bug class the chaos drills find
+hours later as EMFILE.
+"""
+
+import socket
+import subprocess
+import threading
+import multiprocessing
+
+
+def leaky_popen(cmd):
+    proc = subprocess.Popen(cmd)           # BAD: never waited/terminated
+    return 0                               # (and not returned either)
+
+
+def leaky_pipe():
+    parent, child = multiprocessing.Pipe()  # BAD x2: neither side closed
+    return 0
+
+
+def leaky_socket(host):
+    sock = socket.create_connection((host, 80))   # BAD: never closed
+    sock.sendall(b"ping")
+    return 0
+
+
+def leaky_thread(target):
+    worker = threading.Thread(target=target)      # BAD: non-daemon, no join
+    worker.start()
+    return 0
+
+
+def factory(cmd):
+    """Returns a LIVE resource — the caller owns it now (summary)."""
+    return subprocess.Popen(cmd)
+
+
+def leaky_via_factory(cmd):
+    proc = factory(cmd)                    # BAD: factory's resource dropped
+    return 0
+
+
+class LeakyOwner:
+    """The self-attribute shape: a class that creates a worker process
+    and has NO method that could ever end it."""
+
+    def __init__(self, ctx, spec):
+        self._proc = ctx.Process(target=spec)     # BAD: no closer anywhere
+        self._proc.start()
+
+    def alive(self):
+        return self._proc.is_alive()

@@ -1,0 +1,81 @@
+"""resource-lifecycle GOOD fixture: the blessed lifecycles — close in a
+finally, hand the handle to an owner, join the worker, daemonize the
+fire-and-forget beat thread, close the class's resources in close()."""
+
+import socket
+import subprocess
+import threading
+import multiprocessing
+
+
+def waited_popen(cmd):
+    proc = subprocess.Popen(cmd)
+    try:
+        return proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
+def handed_off_popen(cmd, registry):
+    proc = subprocess.Popen(cmd)
+    registry.adopt(proc)                   # escaped: the registry owns it
+    return 0
+
+
+def closed_pipe():
+    parent, child = multiprocessing.Pipe()
+    child.close()
+    try:
+        return parent.recv()
+    finally:
+        parent.close()
+
+
+def with_socket(host):
+    with socket.create_connection((host, 80)) as sock:
+        sock.sendall(b"ping")
+    return 0
+
+
+def joined_thread(target):
+    worker = threading.Thread(target=target)
+    worker.start()
+    worker.join(timeout=5.0)
+    return 0
+
+
+def daemon_beat_thread(target):
+    # fire-and-forget by declared intent: the interpreter reaps daemons
+    beat = threading.Thread(target=target, daemon=True)
+    beat.start()
+    return 0
+
+
+def factory(cmd):
+    return subprocess.Popen(cmd)
+
+
+def caller_closes_factory_resource(cmd):
+    proc = factory(cmd)
+    try:
+        return proc.wait(timeout=60)
+    finally:
+        proc.terminate()
+
+
+class ManagedOwner:
+    """The serve/pool.py WorkerReplica contract: the class that creates
+    the process/pipe is the class whose close() ends them."""
+
+    def __init__(self, ctx, spec):
+        parent, child = ctx.Pipe()
+        self._proc = ctx.Process(target=spec, args=(child,))
+        self._proc.start()
+        child.close()
+        self._conn = parent
+
+    def close(self):
+        self._conn.close()
+        self._proc.terminate()
+        self._proc.join(timeout=5.0)

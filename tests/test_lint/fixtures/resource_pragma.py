@@ -1,0 +1,13 @@
+"""resource-lifecycle PRAGMA fixture: a reviewed exception with a
+reason — an intentionally orphaned double-fork daemon whose handle the
+parent must NOT hold."""
+
+import subprocess
+
+
+def detach_daemon(cmd):
+    # lint-ok(resource-lifecycle): deliberate double-fork detach — the
+    # intermediate child exits immediately and init adopts the daemon;
+    # holding (or waiting) the handle would defeat the detach
+    subprocess.Popen(cmd, start_new_session=True)
+    return 0

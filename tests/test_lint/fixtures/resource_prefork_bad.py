@@ -1,0 +1,39 @@
+"""Committed regression fixture: the PR 10 prefork re-exec supervisor
+bug shape (docs/serving.md, "Review hardening").
+
+The incident: ``serve/prefork.py`` re-execs ``python -m dib_tpu serve``
+workers with ``--prefork`` stripped from argv; the first strip_flag
+missed argparse's prefix abbreviations, so ``--prefor 3`` survived into
+the worker command and every worker became a supervisor of N more — a
+recursive fork bomb. The decidable residue of that incident is the
+supervisor's RESPWN loop: each heal cycle builds a re-exec command and
+spawns a replacement worker. Binding the replacement to a bare local
+and dropping it (instead of storing it where the shutdown fan-out can
+reach it) leaks one pid + stdout pipe per respawn — under a crash loop
+(exactly the fork-bomb aftermath) that is the fd-exhaustion curve the
+chaos drills read as EMFILE. ``resource-lifecycle`` must keep flagging
+this shape; ``tests/test_lint/test_passes.py`` pins it.
+"""
+
+import subprocess
+import sys
+
+
+def worker_cmd(argv, port):
+    # the re-exec command: argv with the supervisor flag stripped (the
+    # strip itself is prefork.strip_flag's job; this fixture pins what
+    # the supervisor does with the spawned handle)
+    return [sys.executable, "-m", "dib_tpu", "serve", *argv,
+            "--port", str(port), "--reuse_port"]
+
+
+def respawn_loop(argv, port, dead_indices):
+    respawned = 0
+    for _k in dead_indices:
+        # BAD: the replacement worker's Popen handle is dropped on the
+        # floor — SIGTERM fan-out and the final wait() can never reach
+        # it, and each heal cycle leaks a pid + a stdout pipe fd
+        proc = subprocess.Popen(worker_cmd(argv, port),
+                                stdout=subprocess.PIPE, text=True)
+        respawned += 1
+    return respawned

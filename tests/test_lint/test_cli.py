@@ -104,6 +104,8 @@ def test_cli_list_names_every_pass():
     assert proc.returncode == 0
     for pass_id in ("donation-safety", "prng-reuse", "host-sync",
                     "thread-shared-state", "event-schema",
-                    "timing-hygiene", "exception-hygiene"):
+                    "timing-hygiene", "exception-hygiene",
+                    "mesh-consistency", "async-blocking",
+                    "resource-lifecycle"):
         assert f"{pass_id}:" in proc.stdout
     assert "prevents:" in proc.stdout

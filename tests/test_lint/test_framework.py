@@ -8,14 +8,18 @@ from tests.test_lint.conftest import FIXTURES, REPO, line_of
 
 
 # ------------------------------------------------------------- registry
-def test_registry_has_the_seven_passes():
+def test_registry_has_the_ten_passes():
     from dib_tpu.analysis import all_passes
 
     ids = [p.id for p in all_passes()]
     assert ids == sorted(ids)
     for expected in ("donation-safety", "prng-reuse", "host-sync",
                      "thread-shared-state", "event-schema",
-                     "timing-hygiene", "exception-hygiene"):
+                     "timing-hygiene", "exception-hygiene",
+                     # the ISSUE 11 pass family for the upcoming
+                     # mesh/asyncio subsystems
+                     "mesh-consistency", "async-blocking",
+                     "resource-lifecycle"):
         assert expected in ids
 
 

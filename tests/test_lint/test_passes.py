@@ -15,6 +15,33 @@ def _findings(module, pass_id):
             if not module.suppressed(pass_id, f.line)]
 
 
+def _project_findings(modules, pass_id, target=None):
+    """Run one pass over ``target`` (default: the first module) with an
+    interprocedural Project built from ``modules``."""
+    from dib_tpu.analysis.core import get_pass
+    from dib_tpu.analysis.project import Project
+
+    if not isinstance(modules, (list, tuple)):
+        modules = [modules]
+    target = target if target is not None else modules[0]
+    project = Project(modules)
+    lint = get_pass(pass_id)
+    return [f for f in lint.check_module_with_project(target, project)
+            if not target.suppressed(pass_id, f.line)]
+
+
+def _load_tree(tmp_path, files: dict):
+    from dib_tpu.analysis.core import load_module
+
+    modules = []
+    for rel, src in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(src)
+        modules.append(load_module(str(path), rel))
+    return modules
+
+
 # ------------------------------------------------------ donation-safety
 def test_donation_flags_the_pr4_async_save_shape(load_fixture):
     """THE acceptance fixture: run_chunk's donated outputs handed to an
@@ -345,3 +372,474 @@ def test_exception_pass_scope_is_the_whole_tree():
     lint = get_pass("exception-hygiene")
     assert lint.applies_to("dib_tpu/train/loop.py")
     assert lint.applies_to("scripts/fault_drill.py")
+
+
+# ----------------------------------------- interprocedural donation/prng
+def test_donation_interprocedural_helper_wrapped_donation(tmp_path):
+    """The tentpole shape: a helper wraps the donating call; reading the
+    argument after the HELPER call is the same use-after-free, and the
+    finding names the chain."""
+    modules = _load_tree(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/chunks.py": (
+            "from functools import partial\n"
+            "import jax\n"
+            "@partial(jax.jit, donate_argnames=('state',))\n"
+            "def run_chunk(state, key):\n"
+            "    return state\n"
+            "def train_step(state, key):\n"
+            "    return run_chunk(state, key)\n"
+        ),
+        "pkg/driver.py": (
+            "from pkg.chunks import train_step\n"
+            "def outer(state, key):\n"
+            "    out = train_step(state, key)\n"
+            "    stale = state['params']\n"
+            "    return out, stale\n"
+        ),
+    })
+    driver = modules[2]
+    findings = _project_findings(modules, "donation-safety", target=driver)
+    assert len(findings) == 1
+    assert findings[0].line == line_of(driver, "stale = state")
+    assert "train_step" in findings[0].message
+    assert "run_chunk" in findings[0].message   # the chain is named
+
+
+def test_donation_interprocedural_fresh_returner_async_save(tmp_path):
+    """Async-save taint through a helper: a function returning the
+    un-copied jitted result taints its caller's binding."""
+    modules = _load_tree(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/chunks.py": (
+            "from functools import partial\n"
+            "import jax\n"
+            "@partial(jax.jit, donate_argnames=('state',))\n"
+            "def run_chunk(state, key):\n"
+            "    return state\n"
+            "def step(state, key):\n"
+            "    return run_chunk(state, key)\n"
+        ),
+        "pkg/saver.py": (
+            "from pkg.chunks import step\n"
+            "def save_loop(manager, state, key):\n"
+            "    out = step(state, key)\n"
+            "    manager.save(0, args=out)\n"
+            "    return out\n"
+        ),
+    })
+    saver = modules[2]
+    findings = _project_findings(modules, "donation-safety", target=saver)
+    assert len(findings) == 1
+    assert findings[0].line == line_of(saver, "manager.save(")
+    assert "async checkpoint" in findings[0].message
+
+
+def test_donation_in_return_does_not_poison_unreachable_tail(tmp_path):
+    """Review regression (found live on train/measurement.py): a
+    donating call riding a `return` cannot poison lexically-later
+    statements — control already left the scope."""
+    modules = _load_tree(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/m.py": (
+            "from functools import partial\n"
+            "import jax\n"
+            "@partial(jax.jit, donate_argnames=('state',))\n"
+            "def run_chunk(state, key):\n"
+            "    return state\n"
+            "def helper(state, key):\n"
+            "    return run_chunk(state, key)\n"
+            "def fit(state, key, overlap):\n"
+            "    if overlap:\n"
+            "        return helper(state, key)\n"
+            "    state2 = run_chunk(state, key)\n"
+            "    return state2\n"
+        ),
+    })
+    m = modules[1]
+    assert _project_findings(modules, "donation-safety", target=m) == []
+
+
+def test_prng_interprocedural_deriving_helper_not_a_consumption(tmp_path):
+    """A helper that only splits its key is no longer a consumption at
+    the call site (the refinement that retired the checkpoint.py
+    pragma); a helper that samples still is."""
+    modules = _load_tree(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/keys.py": (
+            "import jax\n"
+            "def derive_only(key):\n"
+            "    return jax.random.split(key, 2)\n"
+            "def sampler(key):\n"
+            "    return jax.random.normal(key, (3,))\n"
+            "def clean_use(key):\n"
+            "    kd = derive_only(key)\n"          # derives: not consumed
+            "    out = jax.random.normal(key, (3,))\n"  # the ONE consumption
+            "    return kd, out\n"
+            "def double_use(key):\n"
+            "    a = sampler(key)\n"               # consumption #1 (helper)
+            "    b = jax.random.normal(key, (3,))\n"    # consumption #2
+            "    return a, b\n"
+        ),
+    })
+    keys_mod = modules[1]
+    findings = _project_findings(modules, "prng-reuse", target=keys_mod)
+    assert len(findings) == 1
+    assert findings[0].line == line_of(
+        keys_mod, "b = jax.random.normal(key")
+
+
+# ------------------------------------------------------ mesh-consistency
+def test_mesh_bad_fixture_trips_every_shape(load_fixture):
+    module = load_fixture("mesh_bad.py")
+    findings = _project_findings(module, "mesh-consistency")
+    lines = {f.line for f in findings}
+    messages = "\n".join(f.message for f in findings)
+    assert line_of(module, '("sweep", "sweep"))') in lines  # dup Mesh axis
+    assert line_of(module, 'P("model")') in lines           # unknown axis
+    assert line_of(module, 'P("sweep", "sweep")') in lines  # axis-twice spec
+    assert line_of(module, "mapped = shard_map(two_arg_kernel") in lines  # arity
+    assert "donated" in messages                            # jit sharding
+    assert "reshard" in messages.lower()                    # save/restore
+    assert line_of(module, "def restore") in lines
+    assert len(findings) == 6
+
+
+def test_mesh_good_fixture_is_clean(load_fixture):
+    module = load_fixture("mesh_good.py")
+    assert _project_findings(module, "mesh-consistency") == []
+
+
+def test_mesh_pragma_suppresses(load_fixture):
+    module = load_fixture("mesh_pragma.py")
+    assert _project_findings(module, "mesh-consistency") == []
+
+
+def test_mesh_axes_resolve_through_project_constants(load_fixture):
+    """The real tree's axis constants (parallel/mesh.py BETA_AXIS etc.)
+    are project facts: a fixture spec over 'beta' would be legal when
+    the project is the repo tree."""
+    from dib_tpu.analysis.passes.mesh import MeshFacts
+    from dib_tpu.analysis.core import load_module
+    from dib_tpu.analysis.project import Project
+
+    path = os.path.join(REPO, "dib_tpu", "parallel", "mesh.py")
+    module = load_module(path, "dib_tpu/parallel/mesh.py")
+    project = Project([module])
+    facts = MeshFacts([module], project)
+    assert {"beta", "data", "seq"} <= facts.axes
+
+
+# -------------------------------------------------------- async-blocking
+def test_async_blocking_bad_fixture_trips_every_shape(load_fixture):
+    module = load_fixture("async_blocking_bad.py")
+    findings = _project_findings(module, "async-blocking")
+    lines = {f.line for f in findings}
+    messages = "\n".join(f.message for f in findings)
+    assert line_of(module, "time.sleep(0.05)") in lines        # direct
+    assert line_of(module, "out = _drain_queue(batch)") in lines  # chain
+    assert "_drain_queue" in messages and "via its line" in messages
+    assert line_of(module, "subprocess.run(cmd)") in lines
+    assert line_of(module, "jax.device_get(outputs)") in lines
+    assert line_of(module, "fut.result()") in lines
+    # nth=1: the 0th hit is `async def _probe(replica):` itself
+    assert line_of(module, "_probe(replica)", nth=1) in lines  # discarded
+    assert "never run" in messages
+    assert len(findings) == 6
+
+
+def test_async_blocking_good_fixture_is_clean(load_fixture):
+    module = load_fixture("async_blocking_good.py")
+    assert _project_findings(module, "async-blocking") == []
+
+
+def test_async_blocking_pragma_suppresses(load_fixture):
+    module = load_fixture("async_blocking_pragma.py")
+    assert _project_findings(module, "async-blocking") == []
+
+
+def test_async_blocking_chain_crosses_modules(tmp_path):
+    """Interprocedural: the blocking primitive lives two modules away
+    from the coroutine that reaches it."""
+    modules = _load_tree(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/low.py": ("import time\n"
+                       "def drain(q):\n"
+                       "    time.sleep(0.01)\n"
+                       "    return q\n"),
+        "pkg/mid.py": ("from pkg.low import drain\n"
+                       "def handle(q):\n"
+                       "    return drain(q)\n"),
+        "pkg/server.py": ("from pkg.mid import handle\n"
+                          "async def conn(q):\n"
+                          "    return handle(q)\n"),
+    })
+    server = modules[3]
+    findings = _project_findings(modules, "async-blocking", target=server)
+    assert len(findings) == 1
+    assert "handle" in findings[0].message
+    assert "blocks the event loop" in findings[0].message
+
+
+def test_async_blocking_quiet_on_sync_only_modules():
+    """No coroutines, no findings — the pass gates on `async def`."""
+    from dib_tpu.analysis.core import Module
+
+    module = Module("x.py", "pkg/x.py",
+                    "import time\ndef f():\n    time.sleep(1)\n")
+    assert _project_findings(module, "async-blocking") == []
+
+
+# ----------------------------------------------------- resource-lifecycle
+def test_resource_bad_fixture_trips_every_shape(load_fixture):
+    module = load_fixture("resource_bad.py")
+    findings = _project_findings(module, "resource-lifecycle")
+    lines = {f.line for f in findings}
+    messages = "\n".join(f.message for f in findings)
+    assert line_of(module, "proc = subprocess.Popen(cmd)",
+                   nth=0) in lines                       # bare local leak
+    assert line_of(module, "multiprocessing.Pipe()") in lines
+    assert line_of(module, "socket.create_connection") in lines
+    assert line_of(module, "threading.Thread(target=target)") in lines
+    assert line_of(module, "proc = factory(cmd)") in lines  # via summary
+    assert line_of(module, "ctx.Process(target=spec)") in lines
+    assert "LeakyOwner" in messages
+    # parent AND child sides of the pipe each leak
+    assert len(findings) == 7
+
+
+def test_resource_good_fixture_is_clean(load_fixture):
+    module = load_fixture("resource_good.py")
+    assert _project_findings(module, "resource-lifecycle") == []
+
+
+def test_resource_pragma_suppresses(load_fixture):
+    module = load_fixture("resource_pragma.py")
+    assert _project_findings(module, "resource-lifecycle") == []
+
+
+def test_resource_prefork_regression_fixture_still_trips(load_fixture):
+    """THE committed PR 10 incident fixture: the prefork supervisor's
+    respawn loop dropping the replacement worker's Popen handle must
+    keep tripping resource-lifecycle — if a refactor stops flagging it,
+    the fork-bomb aftermath's leak shape has gone invisible."""
+    module = load_fixture("resource_prefork_bad.py")
+    findings = _project_findings(module, "resource-lifecycle")
+    assert len(findings) == 1
+    assert findings[0].line == line_of(module, "proc = subprocess.Popen")
+    assert "leak" in findings[0].message
+
+
+def test_resource_factory_summary_crosses_modules(tmp_path):
+    modules = _load_tree(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/spawn.py": ("import subprocess\n"
+                         "def spawn(cmd):\n"
+                         "    return subprocess.Popen(cmd)\n"),
+        "pkg/user.py": ("from pkg.spawn import spawn\n"
+                        "def leaky(cmd):\n"
+                        "    proc = spawn(cmd)\n"
+                        "    return 0\n"
+                        "def fine(cmd):\n"
+                        "    proc = spawn(cmd)\n"
+                        "    try:\n"
+                        "        return proc.wait(timeout=5)\n"
+                        "    finally:\n"
+                        "        proc.kill()\n"),
+    })
+    user = modules[2]
+    findings = _project_findings(modules, "resource-lifecycle",
+                                 target=user)
+    assert [f.line for f in findings] == [line_of(user, "proc = spawn")]
+
+
+def test_mesh_heterogeneous_save_specs_do_not_crash(tmp_path):
+    """Review regression: save/restore spec signatures mix None/str —
+    a bare sorted() raised TypeError and took down the whole run."""
+    from dib_tpu.analysis.core import load_module
+
+    src = (
+        "import jax\n"
+        "from jax.sharding import Mesh, NamedSharding, PartitionSpec as P\n"
+        "class C:\n"
+        "    def save(self, m, mgr, x, y):\n"
+        "        a = jax.device_put(x, NamedSharding(m, P('data')))\n"
+        "        b = jax.device_put(y, NamedSharding(m, P(None, 'data')))\n"
+        "        mgr.save(0, (a, b))\n"
+        "    def restore(self, m, mgr):\n"
+        "        t = mgr.restore(0)\n"
+        "        return jax.device_put(t, NamedSharding(m, P('data')))\n"
+    )
+    path = tmp_path / "hetero.py"
+    path.write_text(src)
+    module = load_module(str(path), "hetero.py")
+    findings = _project_findings(module, "mesh-consistency")
+    assert any("restores under" in f.message for f in findings)
+
+
+def test_prng_aliased_consumption_inside_helper_stays_conservative(tmp_path):
+    """Review regression: a helper consuming its key through a local
+    alias (`k = key; normal(k)`) must still summarize as consuming —
+    otherwise callers reusing the key twice go silently unflagged."""
+    modules = _load_tree(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/keys.py": (
+            "import jax\n"
+            "def helper(key):\n"
+            "    k = key\n"
+            "    return jax.random.normal(k, (3,))\n"
+            "def double(key):\n"
+            "    a = helper(key)\n"
+            "    b = helper(key)\n"
+            "    return a, b\n"
+        ),
+    })
+    keys_mod = modules[1]
+    findings = _project_findings(modules, "prng-reuse", target=keys_mod)
+    assert [f.line for f in findings] == [
+        line_of(keys_mod, "b = helper(key)")]
+
+
+def test_bind_call_args_stops_mapping_after_starred():
+    """Review regression: positions after a *args splat depend on its
+    runtime length — they must be left unmapped, not mis-mapped."""
+    import ast as ast_mod
+
+    from dib_tpu.analysis.jaxutil import bind_call_args
+
+    call = ast_mod.parse("h(*keys, key)").body[0].value
+    assert bind_call_args(call, ("a", "b"), is_method=False) == {}
+
+
+def test_mesh_3d_spec_on_2d_mesh_is_valid(tmp_path):
+    """Review regression: spec length is the ARRAY's rank, not the
+    mesh's — P('sweep','data',None) for a 3D array on the 2D mesh must
+    not trip; P('sweep','sweep') (one axis, two dims) must."""
+    from dib_tpu.analysis.core import load_module
+
+    src = (
+        "import jax\n"
+        "from jax.sharding import Mesh, NamedSharding, PartitionSpec as P\n"
+        "def make(devices):\n"
+        "    return Mesh(devices, ('sweep', 'data'))\n"
+        "def ok(m, x):\n"
+        "    return jax.device_put(x, NamedSharding(m, P('sweep', 'data', None)))\n"
+        "def bad(m, x):\n"
+        "    return jax.device_put(x, NamedSharding(m, P('sweep', 'sweep')))\n"
+    )
+    path = tmp_path / "specs.py"
+    path.write_text(src)
+    module = load_module(str(path), "specs.py")
+    findings = _project_findings(module, "mesh-consistency")
+    assert [f.line for f in findings] == [line_of(module, "def bad") + 1]
+    assert "two" in findings[0].message
+
+
+def test_resource_pid_logging_does_not_launder_the_leak(tmp_path):
+    """Review regression: `log.info('%s', proc.pid)` passes an int, not
+    the handle — the prefork respawn leak must still flag."""
+    from dib_tpu.analysis.core import load_module
+
+    src = (
+        "import subprocess\n"
+        "def respawn(cmd, log):\n"
+        "    proc = subprocess.Popen(cmd)\n"
+        "    log.info('spawned %s', proc.pid)\n"
+        "    return 0\n"
+    )
+    path = tmp_path / "respawn.py"
+    path.write_text(src)
+    module = load_module(str(path), "respawn.py")
+    findings = _project_findings(module, "resource-lifecycle")
+    assert [f.line for f in findings] == [line_of(module, "Popen(cmd)")]
+
+
+def test_async_blocking_result_with_timeout_still_flags(tmp_path):
+    """Review regression: Future.result(5) parks the loop for up to the
+    timeout — the positional-timeout form is the same stall."""
+    from dib_tpu.analysis.core import load_module
+
+    src = (
+        "async def handler(fut):\n"
+        "    return fut.result(5)\n"
+    )
+    path = tmp_path / "fut.py"
+    path.write_text(src)
+    module = load_module(str(path), "fut.py")
+    findings = _project_findings(module, "async-blocking")
+    assert len(findings) == 1 and "result" in findings[0].message
+
+
+def test_event_schema_guard_flags_a_vanished_serving_rollup(tmp_path):
+    """Review regression: a tree that HAS telemetry/summary.py but no
+    findable serving_rollup is drift, not a silent green pass."""
+    from dib_tpu.analysis.core import get_pass
+
+    tel = tmp_path / "dib_tpu" / "telemetry"
+    tel.mkdir(parents=True)
+    (tel / "summary.py").write_text("def rollup_renamed(events):\n"
+                                    "    return {}\n")
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "observability.md").write_text(
+        "Record types and their payloads:\n\n"
+        "Serving-rollup keys: `requests`.\n")
+    findings = get_pass("event-schema").check_project(str(tmp_path))
+    assert any("serving_rollup not found" in f.message for f in findings)
+
+
+def test_mesh_donation_sharding_flags_decorator_forms(tmp_path):
+    """Review regression: @partial(jax.jit, ...) and @jax.jit(...) are
+    the repo's dominant jit spellings — the donation×sharding check
+    must fire on them, not only on direct jax.jit(fn, ...) calls (and
+    must not double-report the @jax.jit form)."""
+    from dib_tpu.analysis.core import load_module
+
+    src = (
+        "from functools import partial\n"
+        "import jax\n"
+        "from jax.sharding import Mesh, PartitionSpec as P\n"
+        "def make(devices):\n"
+        "    return Mesh(devices, ('sweep', 'data'))\n"
+        "@partial(jax.jit, donate_argnames=('states',),\n"
+        "         in_shardings=(P('sweep'), P('data')),\n"
+        "         out_shardings=(P('data'),))\n"
+        "def step(states, batch):\n"
+        "    return states\n"
+        "@jax.jit(donate_argnums=(0,),\n"
+        "         in_shardings=(P('sweep'), P('data')),\n"
+        "         out_shardings=(P('data'),))\n"
+        "def step2(states, batch):\n"
+        "    return states\n"
+    )
+    path = tmp_path / "deco.py"
+    path.write_text(src)
+    module = load_module(str(path), "deco.py")
+    findings = [f for f in _project_findings(module, "mesh-consistency")
+                if "donated" in f.message]
+    assert len(findings) == 2
+    assert {f.line for f in findings} == {
+        line_of(module, "@partial(jax.jit"), line_of(module, "@jax.jit(")}
+
+
+def test_prng_closure_capture_inside_helper_stays_conservative(tmp_path):
+    """Review regression: a helper consuming its key through a nested
+    def's closure must still summarize as consuming."""
+    modules = _load_tree(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/keys.py": (
+            "import jax\n"
+            "def helper(key):\n"
+            "    def inner():\n"
+            "        return jax.random.normal(key, (3,))\n"
+            "    return inner()\n"
+            "def double(key):\n"
+            "    a = helper(key)\n"
+            "    b = helper(key)\n"
+            "    return a, b\n"
+        ),
+    })
+    keys_mod = modules[1]
+    findings = _project_findings(modules, "prng-reuse", target=keys_mod)
+    assert [f.line for f in findings] == [
+        line_of(keys_mod, "b = helper(key)")]

@@ -1,0 +1,254 @@
+"""Interprocedural engine tests (analysis/project.py): import/symbol
+resolution, call resolution with bound/unbound argument mapping, and the
+donation / device-fresh / key-consumption fixpoint summaries."""
+
+import ast
+import os
+
+import pytest
+
+
+def _write_tree(tmp_path, files: dict):
+    """Write a {rel: source} tree and return (root, Project, modules)."""
+    from dib_tpu.analysis.core import load_module
+    from dib_tpu.analysis.project import Project
+
+    modules = []
+    for rel, src in files.items():
+        path = tmp_path / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(src)
+        modules.append(load_module(str(path), rel))
+    by_rel = {m.rel: m for m in modules}
+    return Project(modules), by_rel
+
+
+# ------------------------------------------------------- bind_call_args
+def test_bind_call_args_bound_unbound_and_keywords():
+    from dib_tpu.analysis.jaxutil import bind_call_args
+
+    params = ("self", "state", "key")
+    bound = ast.parse("x.run(state, key)").body[0].value
+    mapping = bind_call_args(bound, params, is_method=True)
+    assert mapping["state"].id == "state" and mapping["key"].id == "key"
+    unbound = ast.parse("T.run(self, state, key)").body[0].value
+    mapping = bind_call_args(unbound, params, is_method=True)
+    assert mapping["self"].id == "self" and mapping["state"].id == "state"
+    kw = ast.parse("run(key=k2, state=s)").body[0].value
+    mapping = bind_call_args(kw, ("state", "key"), is_method=False)
+    assert mapping["state"].id == "s" and mapping["key"].id == "k2"
+
+
+# ------------------------------------------------------------ resolution
+def test_symbol_resolution_follows_reexport_chain(tmp_path):
+    project, modules = _write_tree(tmp_path, {
+        "pkg/__init__.py": "from pkg.inner import helper\n",
+        "pkg/inner.py": "def helper(x):\n    return x\n",
+        "pkg/user.py": (
+            "from pkg import helper\n"
+            "def use(x):\n"
+            "    return helper(x)\n"
+        ),
+    })
+    resolved = project.resolve_symbol("pkg/user.py", "helper")
+    assert resolved is not None and resolved[0] == "func"
+    assert resolved[1].rel == "pkg/inner.py"
+
+
+def test_relative_import_and_module_alias_resolution(tmp_path):
+    project, modules = _write_tree(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/a.py": "def fa(x):\n    return x\n",
+        "pkg/b.py": (
+            "from . import a\n"
+            "from .a import fa\n"
+            "def use(x):\n"
+            "    return a.fa(x)\n"
+        ),
+    })
+    module = modules["pkg/b.py"]
+    call = None
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Call):
+            call = node
+    info = project.resolve_call(module, call)
+    assert info is not None and info.qualname == "pkg/a.py::fa"
+
+
+def test_self_method_and_typed_local_resolution(tmp_path):
+    project, modules = _write_tree(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/t.py": (
+            "class Trainer:\n"
+            "    def fit(self, key):\n"
+            "        return self.step(key)\n"
+            "    def step(self, key):\n"
+            "        return key\n"
+        ),
+        "pkg/driver.py": (
+            "from pkg.t import Trainer\n"
+            "def run(key):\n"
+            "    trainer = Trainer()\n"
+            "    return trainer.fit(key)\n"
+        ),
+    })
+    t = modules["pkg/t.py"]
+    self_call = next(n for n in ast.walk(t.tree)
+                     if isinstance(n, ast.Call))
+    assert project.resolve_call(t, self_call).qualname \
+        == "pkg/t.py::Trainer.step"
+    driver = modules["pkg/driver.py"]
+    fn = driver.tree.body[1]
+    fit_call = next(n for n in ast.walk(fn) if isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute))
+    info = project.resolve_call(driver, fit_call, scope=fn)
+    assert info is not None and info.qualname == "pkg/t.py::Trainer.fit"
+
+
+def test_dynamic_dispatch_stays_unresolved(tmp_path):
+    """The documented boundary: `for hook in hooks: hook(...)` and
+    attribute-of-attribute calls never resolve."""
+    project, modules = _write_tree(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/m.py": (
+            "def run(self, hooks, state):\n"
+            "    for hook in hooks:\n"
+            "        hook(state)\n"
+            "    return self.zoo.resolve(state)\n"
+        ),
+    })
+    m = modules["pkg/m.py"]
+    fn = m.tree.body[0]
+    for call in (n for n in ast.walk(fn) if isinstance(n, ast.Call)):
+        assert project.resolve_call(m, call, scope=fn) is None
+
+
+# ------------------------------------------------------------ summaries
+_DONATING_TREE = {
+    "pkg/__init__.py": "",
+    "pkg/chunks.py": (
+        "from functools import partial\n"
+        "import jax\n"
+        "@partial(jax.jit, donate_argnames=('state',))\n"
+        "def run_chunk(state, key):\n"
+        "    return state\n"
+        "def train_step(state, key):\n"
+        "    out = run_chunk(state, key)\n"
+        "    return out\n"
+        "def safe_step(state, key):\n"
+        "    state = prepare(state)\n"      # rebound BEFORE the donation:
+        "    out = run_chunk(state, key)\n"  # the param itself is safe
+        "    return out\n"
+        "def prepare(state):\n"
+        "    return state\n"
+    ),
+    "pkg/driver.py": (
+        "from pkg.chunks import train_step\n"
+        "def outer(state, key):\n"
+        "    out = train_step(state, key)\n"
+        "    return out\n"
+    ),
+}
+
+
+def test_donation_summary_crosses_module_boundaries(tmp_path):
+    project, _ = _write_tree(tmp_path, _DONATING_TREE)
+    summaries = project.donation_summaries()
+    assert "state" in summaries["pkg/chunks.py::train_step"]
+    assert "run_chunk" in summaries["pkg/chunks.py::train_step"]["state"]
+    # two-hop chain: outer -> train_step -> run_chunk, chain named
+    assert "state" in summaries["pkg/driver.py::outer"]
+    assert "train_step" in summaries["pkg/driver.py::outer"]["state"]
+    # a param rebound before the donating call is NOT donated by the fn
+    # (absent from the facts map means the empty summary)
+    assert summaries.get("pkg/chunks.py::safe_step", {}) == {}
+
+
+def test_fresh_returner_summary(tmp_path):
+    project, _ = _write_tree(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/m.py": (
+            "from functools import partial\n"
+            "import jax\n"
+            "@partial(jax.jit, donate_argnames=('state',))\n"
+            "def run_chunk(state, key):\n"
+            "    return state\n"
+            "def step(state, key):\n"
+            "    return run_chunk(state, key)\n"   # fresh: un-copied
+            "def fetched_step(state, key):\n"
+            "    out = run_chunk(state, key)\n"
+            "    out = jax.device_get(out)\n"      # host copy clears it
+            "    return out\n"
+        ),
+    })
+    fresh = project.fresh_returners()
+    assert "pkg/m.py::step" in fresh
+    assert "pkg/m.py::fetched_step" not in fresh
+
+
+def test_key_consumption_summary_distinguishes_deriving_helpers(tmp_path):
+    project, modules = _write_tree(tmp_path, {
+        "pkg/__init__.py": "",
+        "pkg/keys.py": (
+            "import jax\n"
+            "def derive_only(key):\n"
+            "    k1, k2 = jax.random.split(key)\n"
+            "    return k1, k2\n"
+            "def sampler(key):\n"
+            "    return jax.random.normal(key, (3,))\n"
+            "def chained(key):\n"
+            "    return sampler(key)\n"
+        ),
+    })
+    consumers = project.key_consumers()
+    assert consumers.get("pkg/keys.py::derive_only", set()) == set()
+    assert consumers["pkg/keys.py::sampler"] == {"key"}
+    assert consumers["pkg/keys.py::chained"] == {"key"}   # transitive
+
+
+def test_reverse_deps_follow_imports(tmp_path):
+    project, _ = _write_tree(tmp_path, _DONATING_TREE)
+    assert "pkg/driver.py" in project.reverse_deps["pkg/chunks.py"]
+    assert project.module_deps["pkg/driver.py"] == {"pkg/chunks.py"}
+
+
+def test_import_submodule_binds_root_package_name(tmp_path):
+    """Review regression: `import a.b` binds `a` (the root package) in
+    the namespace — `a.func(...)` must resolve against a/__init__.py,
+    not a/b.py — while the dep edge to a/b.py is kept for the
+    reverse-dependency closure."""
+    project, modules = _write_tree(tmp_path, {
+        "pkg/__init__.py": "def root_fn(x):\n    return x\n",
+        "pkg/sub.py": "def root_fn(x):\n    return -x\n",
+        "pkg/user.py": (
+            "import pkg.sub\n"
+            "def use(x):\n"
+            "    return pkg.root_fn(x)\n"
+        ),
+    })
+    user = modules["pkg/user.py"]
+    fn = user.tree.body[1]
+    call = next(n for n in ast.walk(fn) if isinstance(n, ast.Call))
+    info = project.resolve_call(user, call, scope=fn)
+    assert info is not None and info.rel == "pkg/__init__.py"
+    assert "pkg/sub.py" in project.module_deps["pkg/user.py"]
+
+
+def test_relative_import_inside_package_init_resolves(tmp_path):
+    """Review regression: `from .x import f` inside a package __init__
+    must resolve (the old guard kept the '__init__' segment and built
+    lookups like 'pkg.__init__.x' that matched nothing — dropping both
+    the re-export facts and the cache's dep edge)."""
+    project, modules = _write_tree(tmp_path, {
+        "pkg/__init__.py": "from .inner import helper\n",
+        "pkg/inner.py": "def helper(x):\n    return x\n",
+        "pkg/user.py": (
+            "from pkg import helper\n"
+            "def use(x):\n"
+            "    return helper(x)\n"
+        ),
+    })
+    resolved = project.resolve_symbol("pkg/user.py", "helper")
+    assert resolved is not None and resolved[0] == "func"
+    assert resolved[1].rel == "pkg/inner.py"
+    assert "pkg/inner.py" in project.module_deps["pkg/__init__.py"]

@@ -1,0 +1,418 @@
+"""CI-grade tooling tests: the incremental cache (`lint --changed`),
+SARIF 2.1.0 output, and the suppression-budget gate (`lint --stats` vs
+LINT_BUDGET.json). THE tier-1 acceptance pins live here:
+
+- touch one file → only its reverse-dependency closure re-analyzes, and
+  the findings are BIT-IDENTICAL to a cold full run;
+- an incremental re-lint analyzes measurably fewer files than the cold
+  run (asserted via analyzed-file counts, never wall clock);
+- the SARIF report carries every 2.1.0 required property;
+- `--stats` exits 1 when a pass exceeds its committed budget, and when
+  budget slack is held without a justification row (the shrink-only
+  ratchet); the committed LINT_BUDGET.json is green.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tests.test_lint.conftest import FIXTURES, REPO
+
+BAD_FIXTURE = os.path.join(FIXTURES, "donation_async_save_bad.py")
+
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "dib_tpu", "lint", *args],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+
+
+# A tiny synthetic lint tree: `a` is imported by `b`, which is imported
+# by `c`; `lone` imports nothing and nothing imports it. The bad sleep
+# inside a coroutine in `lone` proves cached findings replay verbatim.
+_TREE = {
+    "dib_tpu/__init__.py": "",
+    "dib_tpu/a.py": "def fa(x):\n    return x\n",
+    "dib_tpu/b.py": ("from dib_tpu.a import fa\n"
+                     "def fb(x):\n    return fa(x)\n"),
+    "dib_tpu/c.py": ("from dib_tpu.b import fb\n"
+                     "def fc(x):\n    return fb(x)\n"),
+    "dib_tpu/lone.py": ("import time\n"
+                        "async def handler(x):\n"
+                        "    time.sleep(0.1)\n"
+                        "    return x\n"),
+}
+
+
+def _write_tree(root, files):
+    for rel, src in files.items():
+        path = os.path.join(root, rel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write(src)
+
+
+@pytest.fixture
+def tree_root(tmp_path):
+    _write_tree(str(tmp_path), _TREE)
+    return str(tmp_path)
+
+
+def _findings_key(findings):
+    return [(f.pass_id, f.path, f.line, f.message) for f in findings]
+
+
+# --------------------------------------------------------------- cache
+def test_cold_run_analyzes_everything_and_primes_cache(tree_root):
+    from dib_tpu.analysis.cache import cache_path, run_tree
+
+    result = run_tree(root=tree_root)
+    assert result.analyzed_count == result.total_files == len(_TREE)
+    assert result.cached == []
+    # project-level checks (event-schema docs drift) also run on the
+    # synthetic tree; the per-module finding is the coroutine sleep
+    per_module = [f for f in result.findings
+                  if not f.path.startswith("docs/")]
+    assert [f.pass_id for f in per_module] == ["async-blocking"]
+    assert os.path.exists(cache_path(tree_root))
+
+
+def test_warm_run_analyzes_nothing_and_replays_bit_identical(tree_root):
+    from dib_tpu.analysis.cache import run_tree
+
+    cold = run_tree(root=tree_root)
+    warm = run_tree(root=tree_root, changed=True)
+    assert warm.analyzed_count == 0
+    assert len(warm.cached) == len(_TREE)
+    assert _findings_key(warm.findings) == _findings_key(cold.findings)
+
+
+def test_touch_one_file_reanalyzes_exactly_the_reverse_closure(tree_root):
+    """THE incremental acceptance pin: touching `a` re-analyzes a, b, c
+    (the reverse-dependency closure) and nothing else; results are
+    bit-identical to a fresh cold run; the analyzed-file count is
+    measurably smaller than the cold run's."""
+    from dib_tpu.analysis.cache import cache_path, run_tree
+
+    cold = run_tree(root=tree_root)
+    with open(os.path.join(tree_root, "dib_tpu/a.py"), "a") as f:
+        f.write("\n# a trailing comment changes the content hash\n")
+    incremental = run_tree(root=tree_root, changed=True)
+    assert set(incremental.analyzed) == {
+        "dib_tpu/a.py", "dib_tpu/b.py", "dib_tpu/c.py"}
+    assert "dib_tpu/lone.py" in incremental.cached
+    assert incremental.analyzed_count < cold.analyzed_count
+    # bit-identity vs a fresh cold run over the SAME (touched) tree
+    os.remove(cache_path(tree_root))
+    fresh = run_tree(root=tree_root)
+    assert _findings_key(incremental.findings) == _findings_key(
+        fresh.findings)
+
+
+def test_edit_that_changes_findings_propagates_through_cache(tree_root):
+    from dib_tpu.analysis.cache import run_tree
+
+    run_tree(root=tree_root)   # prime
+    with open(os.path.join(tree_root, "dib_tpu/lone.py"), "w") as f:
+        f.write("import asyncio\n"
+                "async def handler(x):\n"
+                "    await asyncio.sleep(0.1)\n"
+                "    return x\n")
+    incremental = run_tree(root=tree_root, changed=True)
+    assert incremental.analyzed == ["dib_tpu/lone.py"]
+    assert [f for f in incremental.findings
+            if not f.path.startswith("docs/")] == []
+
+
+def test_analyzer_change_invalidates_cache(tree_root, monkeypatch):
+    from dib_tpu.analysis import cache as cache_mod
+
+    cache_mod.run_tree(root=tree_root)   # prime
+    monkeypatch.setattr(cache_mod, "analyzer_fingerprint",
+                        lambda root=None: "a-different-analyzer")
+    result = cache_mod.run_tree(root=tree_root, changed=True)
+    assert result.analyzed_count == len(_TREE)   # cold: cache discarded
+
+
+def test_select_never_reads_or_writes_cache(tree_root):
+    from dib_tpu.analysis.cache import cache_path, run_tree
+
+    run_tree(root=tree_root, select=["timing-hygiene"])
+    assert not os.path.exists(cache_path(tree_root))
+
+
+def test_real_tree_incremental_matches_run_passes():
+    """run_tree over the committed repo agrees with run_passes (the
+    zero-findings gate reads either), and a warm --changed run
+    re-analyzes nothing."""
+    from dib_tpu.analysis import run_passes
+    from dib_tpu.analysis.cache import run_tree
+
+    cold = run_tree(root=REPO)
+    assert _findings_key(cold.findings) == _findings_key(
+        run_passes(root=REPO))
+    warm = run_tree(root=REPO, changed=True)
+    assert warm.analyzed_count == 0
+    assert _findings_key(warm.findings) == _findings_key(cold.findings)
+
+
+def test_cli_changed_flags_usage(tree_root):
+    proc = _run_cli("--changed", BAD_FIXTURE)
+    assert proc.returncode == 2
+    assert "full-tree" in proc.stderr
+    proc = _run_cli("--changed", "--select", "prng-reuse")
+    assert proc.returncode == 2
+    assert "--select" in proc.stderr
+
+
+# --------------------------------------------------------------- SARIF
+def test_sarif_report_carries_required_properties():
+    proc = _run_cli("--sarif", BAD_FIXTURE)
+    assert proc.returncode == 1
+    report = json.loads(proc.stdout)
+    # SARIF 2.1.0 required properties (the subset consumers validate)
+    assert report["version"] == "2.1.0"
+    assert report["$schema"].endswith("sarif-schema-2.1.0.json")
+    assert isinstance(report["runs"], list) and report["runs"]
+    run = report["runs"][0]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "dib-lint"
+    rule_ids = {rule["id"] for rule in driver["rules"]}
+    assert "donation-safety" in rule_ids
+    assert "pragma" in rule_ids          # grammar findings surface too
+    for rule in driver["rules"]:
+        assert rule["shortDescription"]["text"]
+    assert run["results"], "the bad fixture must yield results"
+    for result in run["results"]:
+        assert result["ruleId"] in rule_ids
+        assert result["message"]["text"]
+        loc = result["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"].endswith(".py")
+        assert isinstance(loc["region"]["startLine"], int)
+
+
+def test_sarif_and_json_are_exclusive():
+    proc = _run_cli("--sarif", "--json", BAD_FIXTURE)
+    assert proc.returncode == 2
+
+
+# --------------------------------------------------------------- stats
+def _stats_root(tmp_path, budget: dict | None, pragmas: int = 2):
+    lines = ["import time", "def f():"]
+    for i in range(pragmas):
+        lines.append(f"    t{i} = time.time()   "
+                     "# lint-ok(timing-hygiene): host-only driver clock")
+    lines.append("    return 0")
+    _write_tree(str(tmp_path), {"dib_tpu/__init__.py": "",
+                                "dib_tpu/mod.py": "\n".join(lines) + "\n"})
+    if budget is not None:
+        with open(os.path.join(str(tmp_path), "LINT_BUDGET.json"), "w") as f:
+            json.dump(budget, f)
+    return str(tmp_path)
+
+
+def _budget(rows, justifications=None):
+    return {"version": 1, "budget": rows,
+            "justifications": justifications or {}}
+
+
+def test_stats_green_at_budget(tmp_path):
+    from dib_tpu.analysis.cli import lint_main
+
+    root = _stats_root(tmp_path, _budget({"timing-hygiene": 2}))
+    assert lint_main(["--stats", "--root", root]) == 0
+
+
+def test_stats_exit_1_over_budget(tmp_path, capsys):
+    from dib_tpu.analysis.cli import lint_main
+
+    root = _stats_root(tmp_path, _budget({"timing-hygiene": 1}))
+    assert lint_main(["--stats", "--root", root]) == 1
+    assert "BUDGET VIOLATION" in capsys.readouterr().out
+
+
+def test_stats_exit_1_on_unjustified_slack(tmp_path):
+    """The shrink-only ratchet: a budget held ABOVE the actual count
+    with no justification row fails — removing a pragma must ratchet
+    the budget down in the same commit."""
+    from dib_tpu.analysis.cli import lint_main
+
+    root = _stats_root(tmp_path, _budget({"timing-hygiene": 5}))
+    assert lint_main(["--stats", "--root", root]) == 1
+    root2 = _stats_root(tmp_path / "justified", _budget(
+        {"timing-hygiene": 5},
+        {"timing-hygiene": "headroom for the planned bench refactor"}))
+    assert lint_main(["--stats", "--root", root2]) == 0
+
+
+def test_stats_exit_2_on_malformed_budget(tmp_path):
+    from dib_tpu.analysis.cli import lint_main
+
+    root = _stats_root(tmp_path, {"version": 99, "budget": {}})
+    assert lint_main(["--stats", "--root", root]) == 2
+    root2 = _stats_root(tmp_path / "unknown",
+                        _budget({"not-a-pass": 1}))
+    assert lint_main(["--stats", "--root", root2]) == 2
+
+
+def test_stats_json_shape(tmp_path, capsys):
+    from dib_tpu.analysis.cli import lint_main
+
+    root = _stats_root(tmp_path, _budget({"timing-hygiene": 2}))
+    assert lint_main(["--stats", "--json", "--root", root]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["suppressions"] == {"timing-hygiene": 2}
+    assert report["total"] == 2
+    assert report["budget"] == {"timing-hygiene": 2}
+    assert report["violations"] == []
+
+
+def test_committed_budget_is_green_and_exact():
+    """The committed LINT_BUDGET.json matches the tree's actual counts
+    exactly (no over-budget pass, no unjustified slack) — the
+    telemetry-check-style subprocess gate."""
+    proc = _run_cli("--stats")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "suppression budget: ok" in proc.stdout
+
+
+def test_stats_is_its_own_mode():
+    proc = _run_cli("--stats", "--changed")
+    assert proc.returncode == 2
+    proc = _run_cli("--stats", BAD_FIXTURE)
+    assert proc.returncode == 2
+
+
+# ------------------------------------------------- check_run_artifacts
+def test_check_run_artifacts_runs_incremental_lint_and_budget(tmp_path):
+    """The standalone gate path uses the --changed engine and folds the
+    suppression budget in (one command covers lint + stats)."""
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import check_run_artifacts
+
+        problems, detail = check_run_artifacts.run_lint(REPO)
+        assert problems == []
+        assert "analyzed" in detail and "cache" in detail
+    finally:
+        sys.path.remove(os.path.join(REPO, "scripts"))
+
+
+# ------------------------------------------- review-hardening regressions
+def test_global_mesh_fact_change_invalidates_whole_cache(tmp_path):
+    """Review regression: mesh axis facts are PROJECT-GLOBAL (collected
+    from every module, no import edge required), so renaming an axis in
+    one module must not let an unrelated module replay stale spec
+    findings — the whole cache is discarded instead."""
+    from dib_tpu.analysis.cache import run_tree
+
+    files = dict(_TREE)
+    files["dib_tpu/meshes.py"] = (
+        "from jax.sharding import Mesh\n"
+        "def make(devices):\n"
+        "    return Mesh(devices, ('sweep', 'data'))\n")
+    files["dib_tpu/user.py"] = (   # does NOT import meshes.py
+        "from jax.sharding import NamedSharding, PartitionSpec as P\n"
+        "def place(mesh, states):\n"
+        "    import jax\n"
+        "    return jax.device_put(states, NamedSharding(mesh, P('sweep')))\n")
+    _write_tree(str(tmp_path), files)
+    root = str(tmp_path)
+    clean = run_tree(root=root)
+    assert not any(f.pass_id == "mesh-consistency" for f in clean.findings)
+    # rename the axis out from under user.py's spec
+    with open(os.path.join(root, "dib_tpu/meshes.py"), "w") as f:
+        f.write("from jax.sharding import Mesh\n"
+                "def make(devices):\n"
+                "    return Mesh(devices, ('beta', 'data'))\n")
+    incremental = run_tree(root=root, changed=True)
+    assert incremental.analyzed_count == incremental.total_files  # cold
+    mesh_findings = [f for f in incremental.findings
+                     if f.pass_id == "mesh-consistency"]
+    assert any("'sweep'" in f.message and f.path == "dib_tpu/user.py"
+               for f in mesh_findings)
+
+
+def test_no_cache_disables_reads_too(tree_root):
+    """Review regression: --no-cache must IGNORE an existing (possibly
+    stale/corrupt) cache, not just skip writing one."""
+    import json as json_mod
+
+    from dib_tpu.analysis.cache import cache_path, run_tree
+
+    run_tree(root=tree_root)   # prime
+    with open(cache_path(tree_root)) as f:
+        payload = json_mod.load(f)
+    some_rel = "dib_tpu/a.py"
+    payload["files"][some_rel]["findings"] = [
+        ["pragma", some_rel, 1, "planted stale finding"]]
+    with open(cache_path(tree_root), "w") as f:
+        json_mod.dump(payload, f)
+    poisoned = run_tree(root=tree_root, changed=True)
+    assert any("planted" in f.message for f in poisoned.findings)
+    bypassed = run_tree(root=tree_root, changed=True,
+                        read_cache=False, write_cache=False)
+    assert bypassed.analyzed_count == len(_TREE)
+    assert not any("planted" in f.message for f in bypassed.findings)
+
+
+def test_malformed_cache_rows_degrade_to_fresh_analysis(tree_root):
+    """Review regression: a cache that parses as JSON but carries a
+    mangled finding row re-analyzes that file instead of crashing the
+    run (the corrupt-cache contract)."""
+    import json as json_mod
+
+    from dib_tpu.analysis.cache import cache_path, run_tree
+
+    cold = run_tree(root=tree_root)
+    with open(cache_path(tree_root)) as f:
+        payload = json_mod.load(f)
+    payload["files"]["dib_tpu/lone.py"]["findings"] = [["wrong-arity"]]
+    with open(cache_path(tree_root), "w") as f:
+        json_mod.dump(payload, f)
+    recovered = run_tree(root=tree_root, changed=True)
+    assert "dib_tpu/lone.py" in recovered.analyzed
+    assert _findings_key(recovered.findings) == _findings_key(
+        cold.findings)
+
+
+def test_check_run_artifacts_reports_malformed_budget_as_violation(
+        tmp_path):
+    """Review regression: a malformed committed LINT_BUDGET.json is a
+    formatted gate violation from run_lint, not a traceback."""
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import check_run_artifacts
+
+        _write_tree(str(tmp_path), _TREE)
+        with open(os.path.join(str(tmp_path), "LINT_BUDGET.json"),
+                  "w") as f:
+            json.dump({"version": 99, "budget": {}}, f)
+        problems, _detail = check_run_artifacts.run_lint(str(tmp_path))
+        assert any("version" in p for p in problems)
+    finally:
+        sys.path.remove(os.path.join(REPO, "scripts"))
+
+
+def test_mangled_files_payload_degrades_to_cold_run(tree_root):
+    """Review regression: a JSON-valid cache whose `files` field is null
+    (or holds non-dict entries) is corruption like any other — a cold
+    run, never a traceback."""
+    import json as json_mod
+
+    from dib_tpu.analysis.cache import cache_path, run_tree
+
+    run_tree(root=tree_root)   # prime
+    for mangle in (None, {"dib_tpu/a.py": "not-a-dict"}):
+        with open(cache_path(tree_root)) as f:
+            payload = json_mod.load(f)
+        payload["files"] = mangle
+        with open(cache_path(tree_root), "w") as f:
+            json_mod.dump(payload, f)
+        result = run_tree(root=tree_root, changed=True)
+        assert result.analyzed_count == len(_TREE)   # cold
