@@ -68,6 +68,16 @@ _DOC_KIND_RE = re.compile(r"\*\*`([a-z_]+)`\*\*")
 _SERVING_KEYS_MARKER = "Serving-rollup keys"
 _BACKTICKED_RE = re.compile(r"`([a-z_0-9]+)`")
 
+#: Every summarize rollup whose key list docs/observability.md must
+#: mirror exactly: (summary.py function name, docs marker line). The
+#: serving row is the PR 10 incident's guard; the streaming row extends
+#: it to the dib_tpu/stream control plane (ISSUE 12) — same rule, the
+#: code is the source of truth.
+_ROLLUP_DOC_CHECKS = (
+    ("serving_rollup", _SERVING_KEYS_MARKER),
+    ("streaming_rollup", "Streaming-rollup keys"),
+)
+
 
 def _schema():
     from dib_tpu.telemetry.events import EVENT_SCHEMA
@@ -168,7 +178,13 @@ class EventSchemaPass(LintPass):
     # ------------------------------------------------------ project level
     @staticmethod
     def serving_rollup_keys(root: str) -> set[str] | None:
-        """The top-level keys ``serving_rollup`` actually emits, read
+        """The serving rollup's emitted keys (back-compat spelling of
+        :meth:`rollup_keys`)."""
+        return EventSchemaPass.rollup_keys(root, "serving_rollup")
+
+    @staticmethod
+    def rollup_keys(root: str, fn_name: str) -> set[str] | None:
+        """The top-level keys a summarize rollup actually emits, read
         from telemetry/summary.py's AST (None when the function cannot
         be found — the caller reports that as its own drift)."""
         path = os.path.join(root, "dib_tpu", "telemetry", "summary.py")
@@ -179,7 +195,7 @@ class EventSchemaPass(LintPass):
             return None
         fn = next((node for node in tree.body
                    if isinstance(node, ast.FunctionDef)
-                   and node.name == "serving_rollup"), None)
+                   and node.name == fn_name), None)
         if fn is None:
             return None
         keys: set[str] = set()
@@ -229,33 +245,33 @@ class EventSchemaPass(LintPass):
                                     and isinstance(k.value, str))
         return keys
 
-    def _check_serving_rollup_docs(self, root: str,
-                                   lines: list[str]) -> list[Finding]:
-        """The serving-rollup key list in docs/observability.md must name
-        exactly what summary.serving_rollup emits (the PR 10 rollup grew
-        faster than the docs table — this pins the two together)."""
+    def _check_rollup_docs(self, root: str, lines: list[str],
+                           fn_name: str, marker: str) -> list[Finding]:
+        """A rollup's key list in docs/observability.md must name exactly
+        what the summary.py function emits (the PR 10 serving rollup grew
+        faster than the docs table — this pins the two together; the
+        streaming rollup rides the same rule)."""
         doc_rel = "docs/observability.md"
         summary_rel = "dib_tpu/telemetry/summary.py"
-        emitted = self.serving_rollup_keys(root)
+        emitted = self.rollup_keys(root, fn_name)
         if emitted is None:
             # a tree without the summary module at all (synthetic test
             # roots) has nothing to hold the docs to — but a tree that
-            # HAS the module with no findable serving_rollup means the
+            # HAS the module with no findable rollup fn means the
             # guard's anchor moved: that is drift, not a green pass
             if os.path.exists(os.path.join(root, summary_rel)):
                 return [Finding(
                     self.id, summary_rel, 1,
-                    "serving_rollup not found as a top-level function in "
-                    "telemetry/summary.py — the serving-rollup docs "
-                    "guard has lost its anchor; update "
-                    "EventSchemaPass.serving_rollup_keys alongside the "
-                    "refactor")]
+                    f"{fn_name} not found as a top-level function in "
+                    f"telemetry/summary.py — the {marker!r} docs guard "
+                    "has lost its anchor; update "
+                    "_ROLLUP_DOC_CHECKS alongside the refactor")]
             return []
         marker_line = None
         documented: dict[str, int] = {}
         for lineno, line in enumerate(lines, 1):
             if marker_line is None:
-                if _SERVING_KEYS_MARKER in line:
+                if marker in line:
                     marker_line = lineno
                 continue
             if not line.strip():
@@ -265,22 +281,22 @@ class EventSchemaPass(LintPass):
         if marker_line is None:
             return [Finding(
                 self.id, doc_rel, 1,
-                f"docs/observability.md has no '{_SERVING_KEYS_MARKER}' "
-                "list — the serving rollup's keys must stay documented "
-                "(telemetry/summary.py serving_rollup)")]
+                f"docs/observability.md has no {marker!r} "
+                "list — the rollup's keys must stay documented "
+                f"(telemetry/summary.py {fn_name})")]
         findings: list[Finding] = []
         for key in sorted(emitted - set(documented)):
             findings.append(Finding(
                 self.id, doc_rel, marker_line,
-                f"serving-rollup key {key!r} is emitted by "
-                "telemetry/summary.py serving_rollup but missing from "
-                f"the '{_SERVING_KEYS_MARKER}' list"))
+                f"rollup key {key!r} is emitted by "
+                f"telemetry/summary.py {fn_name} but missing from "
+                f"the {marker!r} list"))
         for key, lineno in sorted(documented.items()):
             if key not in emitted:
                 findings.append(Finding(
                     self.id, doc_rel, lineno,
-                    f"documented serving-rollup key {key!r} is not "
-                    "emitted by telemetry/summary.py serving_rollup — "
+                    f"documented rollup key {key!r} is not "
+                    f"emitted by telemetry/summary.py {fn_name} — "
                     "the code is the source of truth"))
         return findings
 
@@ -323,5 +339,7 @@ class EventSchemaPass(LintPass):
                     f"documented record type {kind!r} has no EVENT_SCHEMA "
                     "row — the registry is the source of truth",
                 ))
-        findings.extend(self._check_serving_rollup_docs(root, lines))
+        for fn_name, marker in _ROLLUP_DOC_CHECKS:
+            findings.extend(self._check_rollup_docs(root, lines,
+                                                    fn_name, marker))
         return findings

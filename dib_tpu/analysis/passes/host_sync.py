@@ -83,6 +83,12 @@ class HostSyncPass(LintPass):
         "dib_tpu/serve/server.py",
         "dib_tpu/serve/pool.py",
         "dib_tpu/serve/zoo.py",
+        # the streaming control plane joined with ISSUE 12: the online
+        # loop IS a chunk loop (an implicit fetch serializes every
+        # round), and the deployer restores/probes checkpoints while the
+        # fleet serves — a hidden sync there stalls promotion under load
+        "dib_tpu/stream/online.py",
+        "dib_tpu/stream/deployer.py",
     )
 
     def check_module(self, module: Module) -> list[Finding]:

@@ -91,7 +91,7 @@ def build_parser() -> argparse.ArgumentParser:
     )
     parser.add_argument("command", nargs="?", default="train",
                         choices=["train", "workload", "telemetry", "serve",
-                                 "lint", "sched"],
+                                 "lint", "sched", "stream"],
                         help="Subcommand: 'train' (flags below), 'workload' "
                              "(paper workloads; see `dib_tpu workload --help`), "
                              "'telemetry' (summarize/compare/report run "
@@ -99,9 +99,11 @@ def build_parser() -> argparse.ArgumentParser:
                              "'serve' (inference over a checkpoint; see "
                              "`dib_tpu serve --help`), 'lint' (static "
                              "analysis over the tree; see "
-                             "`dib_tpu lint --help`), or 'sched' (the "
+                             "`dib_tpu lint --help`), 'sched' (the "
                              "fault-tolerant β-grid scheduler; see "
-                             "`dib_tpu sched --help`).")
+                             "`dib_tpu sched --help`), or 'stream' (the "
+                             "always-on train-to-serve control plane; see "
+                             "`dib_tpu stream --help`).")
     _add_model_flags(parser)
     parser.add_argument("--artifact_outdir", type=str, default="./training_artifacts/")
     parser.add_argument("--learning_rate", type=float, default=3e-4)
@@ -1251,9 +1253,15 @@ def main(argv: Sequence[str] | None = None) -> int:
             from dib_tpu.sched.cli import sched_main
 
             return sched_main(argv[1:])
+        if argv and argv[0] == "stream":
+            # status is pure journal file analysis; run/deploy initialize
+            # the backend themselves when they train/serve
+            from dib_tpu.stream.cli import stream_main
+
+            return stream_main(argv[1:])
         args = build_parser().parse_args(argv)
         if args.command in ("workload", "telemetry", "serve", "lint",
-                            "sched"):
+                            "sched", "stream"):
             # parsed from a non-leading position (flags first): these
             # subcommands' flags are not the train flags, so re-dispatching
             # would misparse. Name the flag that displaced the subcommand

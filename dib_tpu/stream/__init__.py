@@ -1,0 +1,52 @@
+"""Always-on DIB: the streaming train-to-serve control plane.
+
+Composes the pieces the earlier PRs proved separately — chunk-aligned
+resumable training (PR 8's scheduler idioms), a model zoo with
+reload-exact cache invalidation (PR 10), journal-style durability
+(``dib_tpu/sched/journal.py``) — into one live loop: a trainer that
+learns continuously from a stream and publishes checkpoints atomically,
+and a deployer that tails the publish journal and hot-swaps the serving
+fleet under live traffic. See docs/streaming.md.
+"""
+
+from dib_tpu.stream.deployer import (
+    DEPLOYS_FILENAME,
+    CanaryFailure,
+    Deployer,
+    read_deploys,
+    stream_status,
+)
+from dib_tpu.stream.online import (
+    PUBLISHES_FILENAME,
+    OnlineConfig,
+    OnlineDIBTrainer,
+    publishes_path,
+    read_publishes,
+)
+from dib_tpu.stream.source import (
+    DriftSpec,
+    ReservoirSource,
+    RowStream,
+    SlidingWindowSource,
+    make_source,
+    parse_drift_specs,
+)
+
+__all__ = [
+    "CanaryFailure",
+    "DEPLOYS_FILENAME",
+    "Deployer",
+    "DriftSpec",
+    "OnlineConfig",
+    "OnlineDIBTrainer",
+    "PUBLISHES_FILENAME",
+    "ReservoirSource",
+    "RowStream",
+    "SlidingWindowSource",
+    "make_source",
+    "parse_drift_specs",
+    "publishes_path",
+    "read_deploys",
+    "read_publishes",
+    "stream_status",
+]

@@ -1,0 +1,469 @@
+"""``python -m dib_tpu stream run|deploy|status`` — the always-on loop.
+
+``run`` trains continuously on a stream over the named dataset and
+publishes chunk-aligned checkpoints through the atomic publish protocol
+(``stream/online.py``); ``deploy`` serves the fleet and hot-swaps each
+published checkpoint in via canary-gated ``ModelZoo.reload``
+(``stream/deployer.py``); ``status`` replays both journals into a
+snapshot. Trainer and deployer run as SEPARATE processes sharing only
+``<stream-dir>/publishes.jsonl`` — each side optionally supervised
+(``--watchdog``) with journal-record progress gating its budget-free
+preemption relaunches, exactly like the PR 8 scheduler pool
+(docs/streaming.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Sequence
+
+__all__ = ["stream_main"]
+
+
+def _add_stream_dir(parser) -> None:
+    parser.add_argument("--stream-dir", "--stream_dir", dest="stream_dir",
+                        required=True,
+                        help="Stream directory: publishes.jsonl plus the "
+                             "staging/ and checkpoints/ trees the publish "
+                             "protocol writes.")
+
+
+def _add_watchdog(parser, what: str) -> None:
+    parser.add_argument("--watchdog", action="store_true",
+                        help=f"Supervise this {what} (train/watchdog.py "
+                             "supervise_pool): crashes relaunch with "
+                             "backoff against a restart budget; rc-75 "
+                             "preemptions relaunch immediately and "
+                             "budget-free while journal records keep "
+                             "landing.")
+    parser.add_argument("--max-restarts", type=int, default=3,
+                        dest="max_restarts")
+
+
+def build_stream_parser() -> argparse.ArgumentParser:
+    from dib_tpu.cli import _add_model_flags, _add_telemetry_dir_flag
+
+    parser = argparse.ArgumentParser(
+        prog="dib_tpu stream",
+        description="Always-on DIB: streaming train-to-serve control "
+                    "plane (docs/streaming.md).",
+    )
+    sub = parser.add_subparsers(dest="action", required=True)
+
+    p_run = sub.add_parser(
+        "run", help="Train continuously on a stream; publish chunk-"
+                    "aligned checkpoints on a cadence.")
+    _add_stream_dir(p_run)
+    _add_model_flags(p_run)
+    p_run.add_argument("--window", type=int, default=256,
+                       help="Working-set rows per round (>= batch_size).")
+    p_run.add_argument("--stride", type=int, default=0,
+                       help="Fresh rows consumed per round "
+                            "(default: window // 2).")
+    p_run.add_argument("--chunk-epochs", type=int, default=2,
+                       dest="chunk_epochs",
+                       help="Epochs per jitted chunk (= one round; the "
+                            "checkpoint chunk-size contract).")
+    p_run.add_argument("--publish-every", type=int, default=1,
+                       dest="publish_every",
+                       help="Publish a checkpoint every N rounds.")
+    p_run.add_argument("--keep-publishes", type=int, default=0,
+                       dest="keep_publishes",
+                       help="Retain only the newest N published checkpoint "
+                            "dirs on disk (0 = keep all). The journals "
+                            "always keep every record; set this on "
+                            "always-on streams so the disk stays bounded.")
+    p_run.add_argument("--rounds", type=int, default=8,
+                       help="Rounds this invocation runs (resume "
+                            "continues the count from the journal).")
+    p_run.add_argument("--stream-source", default="sliding",
+                       dest="stream_source",
+                       choices=["sliding", "reservoir"],
+                       help="Working-set policy over the stream.")
+    p_run.add_argument("--drift", action="append", default=[],
+                       metavar="AT[:KIND[:MAGNITUDE]]",
+                       help="Scripted drift injection (repeatable), e.g. "
+                            "--drift 512:mean_shift:2.0 (tests/chaos).")
+    p_run.add_argument("--drift-threshold", type=float, default=1.0,
+                       dest="drift_threshold",
+                       help="Window-mean shift (baseline-σ units) that "
+                            "counts as drift.")
+    p_run.add_argument("--reanneal", action=argparse.BooleanOptionalAction,
+                       default=True,
+                       help="Re-anneal β from the anneal start on "
+                            "detected drift (--no-reanneal holds β).")
+    p_run.add_argument("--learning_rate", type=float, default=3e-4)
+    p_run.add_argument("--batch_size", type=int, default=64)
+    p_run.add_argument("--beta_start", type=float, default=1e-4)
+    p_run.add_argument("--beta_end", type=float, default=3e0)
+    p_run.add_argument("--number_pretraining_epochs", type=int, default=4)
+    p_run.add_argument("--number_annealing_epochs", type=int, default=12)
+    p_run.add_argument("--optimizer", type=str, default="adam")
+    p_run.add_argument("--preempt_grace_s", type=float, default=30.0,
+                       help="SIGTERM/SIGINT grace: the round finishes, a "
+                            "final checkpoint publishes, and the process "
+                            "exits with the preemption code (75). "
+                            "0 disables.")
+    _add_watchdog(p_run, "trainer")
+    _add_telemetry_dir_flag(p_run, "--stream-dir")
+
+    p_dep = sub.add_parser(
+        "deploy", help="Serve the fleet; tail the publish journal and "
+                       "hot-swap each new checkpoint in (canary-gated).")
+    _add_stream_dir(p_dep)
+    _add_model_flags(p_dep)
+    p_dep.add_argument("--deploy-dir", "--deploy_dir", dest="deploy_dir",
+                       required=True,
+                       help="Deployer run directory: deploys.jsonl, the "
+                            "serving event stream.")
+    p_dep.add_argument("--model_name", type=str, default="stream",
+                       help="Zoo name the published checkpoints serve "
+                            "under.")
+    p_dep.add_argument("--batch_size", type=int, default=64)
+    p_dep.add_argument("--beta_start", type=float, default=1e-4)
+    p_dep.add_argument("--beta_end", type=float, default=3e0)
+    p_dep.add_argument("--number_pretraining_epochs", type=int, default=4)
+    p_dep.add_argument("--number_annealing_epochs", type=int, default=12)
+    p_dep.add_argument("--optimizer", type=str, default="adam")
+    p_dep.add_argument("--host", type=str, default="127.0.0.1")
+    p_dep.add_argument("--port", type=int, default=0,
+                       help="0 binds an ephemeral port (printed on "
+                            "stdout).")
+    p_dep.add_argument("--buckets", type=int, nargs="+", default=[1, 8, 32],
+                       help="Padded batch sizes to compile.")
+    p_dep.add_argument("--max_batch", type=int, default=32)
+    p_dep.add_argument("--max_wait_ms", type=float, default=2.0)
+    p_dep.add_argument("--poll-s", type=float, default=0.25, dest="poll_s",
+                       help="Publish-journal tail interval.")
+    p_dep.add_argument("--wait-first-s", type=float, default=60.0,
+                       dest="wait_first_s",
+                       help="How long to wait for the FIRST publish "
+                            "before serving starts (the fleet needs one "
+                            "checkpoint to answer at all).")
+    p_dep.add_argument("--serve_seconds", type=float, default=0.0,
+                       help="Auto-shutdown after this many seconds "
+                            "(0 = run until SIGINT/SIGTERM).")
+    p_dep.add_argument("--response_cache", type=int, default=64,
+                       help="Response-cache capacity (0 disables); "
+                            "reloads invalidate exactly the swapped "
+                            "model's entries.")
+    p_dep.add_argument("--exec_cache", type=int, default=16,
+                       help="Shared AOT-executable LRU capacity "
+                            "(0 = eager per-engine compilation).")
+    _add_watchdog(p_dep, "deployer")
+    _add_telemetry_dir_flag(p_dep, "--deploy-dir")
+
+    p_stat = sub.add_parser(
+        "status", help="Replay the publish/deploy journals into a "
+                       "snapshot.")
+    _add_stream_dir(p_stat)
+    p_stat.add_argument("--deploy-dir", "--deploy_dir", dest="deploy_dir",
+                        default=None,
+                        help="Also fold this deployer's deploys.jsonl "
+                             "(promotion/rollback/lag view).")
+    p_stat.add_argument("--json", action="store_true",
+                        help="Machine-readable snapshot.")
+    return parser
+
+
+def _supervised(args, argv: Sequence[str], journal_file: str,
+                terminal_kind: str, run_dir: str) -> int:
+    """Re-exec this stream command as a supervised worker process: the
+    publish/deploy journal makes a relaunch resume exactly, so progress
+    is journal records of the terminal kind (the sched run-pool idiom)."""
+    from dib_tpu.telemetry import open_writer, shared_run_id
+    from dib_tpu.train.watchdog import WatchdogConfig, supervise_pool
+
+    run_id = shared_run_id()
+    os.environ["DIB_TELEMETRY_RUN_ID"] = run_id
+    telemetry = open_writer(args.telemetry_dir, run_dir,
+                            run_id=run_id, process_index=0,
+                            tags={"src": "supervisor"})
+    # strip only the FIRST token spelling the flag — argparse accepts
+    # unambiguous prefixes, and option values can never start with "--"
+    # (the sched run-pool idiom, regression-tested there)
+    worker = list(argv)
+    for i, token in enumerate(worker):
+        if token.startswith("--wa") and "--watchdog".startswith(token):
+            del worker[i]
+            break
+    result = supervise_pool(
+        [sys.executable, "-m", "dib_tpu.cli", "stream", *worker],
+        config=WatchdogConfig(max_restarts=args.max_restarts),
+        telemetry=telemetry,
+        journal_path=os.path.join(run_dir, journal_file),
+        terminal_kinds=(terminal_kind,),
+    )
+    if telemetry is not None:
+        telemetry.close()
+    print(json.dumps({"watchdog": result}))
+    return 0 if result["returncode"] == 0 else 1
+
+
+def _run_main(args, argv: Sequence[str]) -> int:
+    from dib_tpu.stream.online import PUBLISHES_FILENAME
+
+    if args.watchdog:
+        return _supervised(args, argv, PUBLISHES_FILENAME, "publish",
+                           args.stream_dir)
+
+    from dib_tpu.cli import (
+        _bundle_from_args,
+        _enable_cli_compile_cache,
+        _model_from_args,
+    )
+
+    _enable_cli_compile_cache()
+
+    import jax
+
+    from dib_tpu.stream.online import OnlineConfig, OnlineDIBTrainer
+    from dib_tpu.stream.source import parse_drift_specs
+    from dib_tpu.telemetry import open_writer, runtime_manifest, shared_run_id
+    from dib_tpu.train import TrainConfig
+    from dib_tpu.train.preempt import (
+        PREEMPT_EXIT_CODE,
+        PreemptionGuard,
+        TrainingPreempted,
+    )
+
+    bundle = _bundle_from_args(args)
+    model, y_encoder = _model_from_args(args, bundle)
+    config = TrainConfig(
+        learning_rate=args.learning_rate,
+        batch_size=args.batch_size,
+        beta_start=args.beta_start,
+        beta_end=args.beta_end,
+        num_pretraining_epochs=args.number_pretraining_epochs,
+        num_annealing_epochs=args.number_annealing_epochs,
+        optimizer=args.optimizer,
+        infonce_similarity=args.infonce_similarity
+        if hasattr(args, "infonce_similarity") else "l2",
+    )
+    online = OnlineConfig(
+        window=args.window,
+        stride=args.stride or None,
+        chunk_epochs=args.chunk_epochs,
+        publish_every=args.publish_every,
+        rounds=args.rounds,
+        source=args.stream_source,
+        seed=args.seed,
+        drift=parse_drift_specs(args.drift),
+        drift_threshold=args.drift_threshold,
+        reanneal_on_drift=args.reanneal,
+        keep_publishes=args.keep_publishes,
+    )
+    os.makedirs(args.stream_dir, exist_ok=True)
+    telemetry = open_writer(args.telemetry_dir, args.stream_dir,
+                            run_id=shared_run_id(),
+                            process_index=jax.process_index())
+    if telemetry is not None:
+        telemetry.run_start(runtime_manifest(config=config, extra={
+            "mode": "stream_run", "dataset": args.dataset,
+            "stream_dir": os.path.abspath(args.stream_dir),
+            "window": online.window, "stride": online.stride,
+            "chunk_epochs": online.chunk_epochs,
+            "publish_every": online.publish_every,
+            "source": online.source,
+        }))
+    guard = None
+    if args.preempt_grace_s and args.preempt_grace_s > 0:
+
+        def _grace_flush():
+            if telemetry is not None:
+                telemetry.run_end(status="preempted", aborted_chunk=True)
+                telemetry.close()
+
+        guard = PreemptionGuard(args.preempt_grace_s,
+                                on_grace_expired=_grace_flush)
+
+    online_trainer = OnlineDIBTrainer(
+        model, bundle, config, online, args.stream_dir,
+        telemetry=telemetry, y_encoder=y_encoder)
+    key = jax.random.key(args.seed)
+    try:
+        if guard is not None:
+            with guard:
+                summary = online_trainer.run(key, preempt=guard)
+        else:
+            summary = online_trainer.run(key)
+    except TrainingPreempted:
+        if telemetry is not None:
+            telemetry.run_end(status="preempted")
+            telemetry.close()
+        print(json.dumps({"status": "preempted",
+                          "publishes": online_trainer.publishes}))
+        return PREEMPT_EXIT_CODE
+    summary["status"] = "ok"
+    if telemetry is not None:
+        telemetry.run_end(status="ok", epoch=summary["epochs"])
+        telemetry.close()
+        _maybe_register(args, telemetry)
+    print(json.dumps(summary))
+    return 0
+
+
+def _maybe_register(args, telemetry) -> None:
+    root = args.runs_root or os.environ.get("DIB_RUNS_ROOT")
+    if root:
+        from dib_tpu.telemetry.registry import register_run
+
+        register_run(os.path.dirname(telemetry.path), root=root)
+
+
+def _deploy_main(args, argv: Sequence[str]) -> int:
+    from dib_tpu.stream.deployer import DEPLOYS_FILENAME
+
+    if args.watchdog:
+        return _supervised(args, argv, DEPLOYS_FILENAME, "deploy",
+                           args.deploy_dir)
+
+    from dib_tpu.cli import (
+        _bundle_from_args,
+        _enable_cli_compile_cache,
+        _model_from_args,
+    )
+
+    _enable_cli_compile_cache()
+
+    import threading
+    import time
+
+    import jax
+
+    from dib_tpu.serve import DIBServer, ModelZoo
+    from dib_tpu.stream.deployer import Deployer
+    from dib_tpu.stream.online import read_publishes
+    from dib_tpu.telemetry import (
+        MetricsRegistry,
+        Tracer,
+        open_writer,
+        runtime_manifest,
+        shared_run_id,
+    )
+    from dib_tpu.train import DIBTrainer, TrainConfig
+
+    bundle = _bundle_from_args(args)
+    model, y_encoder = _model_from_args(args, bundle)
+    config = TrainConfig(
+        batch_size=args.batch_size,
+        beta_start=args.beta_start,
+        beta_end=args.beta_end,
+        num_pretraining_epochs=args.number_pretraining_epochs,
+        num_annealing_epochs=args.number_annealing_epochs,
+        optimizer=args.optimizer,
+    )
+    trainer = DIBTrainer(model, bundle, config, y_encoder=y_encoder)
+
+    os.makedirs(args.deploy_dir, exist_ok=True)
+    telemetry = open_writer(args.telemetry_dir, args.deploy_dir,
+                            run_id=shared_run_id(),
+                            process_index=jax.process_index())
+    registry = MetricsRegistry()
+    tracer = Tracer(telemetry)
+    if telemetry is not None:
+        telemetry.run_start(runtime_manifest(config=config, extra={
+            "mode": "stream_deploy", "dataset": args.dataset,
+            "stream_dir": os.path.abspath(args.stream_dir),
+            "deploy_dir": os.path.abspath(args.deploy_dir),
+            "model_name": args.model_name,
+            "poll_s": args.poll_s,
+        }))
+
+    zoo = ModelZoo(
+        exec_capacity=args.exec_cache or None,
+        response_capacity=args.response_cache or None,
+        telemetry=telemetry, registry=registry,
+    )
+    deployer = Deployer(
+        args.stream_dir, args.deploy_dir, trainer, zoo,
+        model_name=args.model_name, telemetry=telemetry,
+        registry=registry, poll_s=args.poll_s,
+        router_kwargs=dict(
+            batch_buckets=args.buckets, telemetry=telemetry,
+            tracer=tracer, max_batch=args.max_batch,
+            max_wait_ms=args.max_wait_ms,
+        ))
+
+    # the fleet needs one promoted checkpoint before it can answer —
+    # wait for the trainer's first publish (they only share the journal)
+    deadline = time.monotonic() + args.wait_first_s
+    while not read_publishes(args.stream_dir)[0]:
+        if time.monotonic() >= deadline:
+            print(json.dumps({
+                "error": f"no publish within {args.wait_first_s}s in "
+                         f"{args.stream_dir} — is `stream run` up?"}),
+                file=sys.stderr)
+            if telemetry is not None:
+                telemetry.run_end(status="error", error="no_publish")
+                telemetry.close()
+            deployer.close()
+            return 1
+        time.sleep(min(args.poll_s, 0.2))
+    deployer.catch_up()
+
+    server = DIBServer(zoo, host=args.host, port=args.port,
+                       telemetry=telemetry, registry=registry,
+                       tracer=tracer)
+    server.start()
+    deployer.start()
+    print(json.dumps({
+        "serving": server.url, "port": server.port,
+        "model": args.model_name, "run_dir": args.deploy_dir,
+        **deployer.status(),
+    }), flush=True)
+
+    stop = threading.Event()
+    if threading.current_thread() is threading.main_thread():
+        import signal
+
+        for signum in (signal.SIGINT, signal.SIGTERM):
+            signal.signal(signum, lambda *_: stop.set())
+    try:
+        if args.serve_seconds > 0:
+            stop.wait(args.serve_seconds)
+        else:
+            stop.wait()
+    finally:
+        deployer.close()
+        server.close()
+    if telemetry is not None:
+        _maybe_register(args, telemetry)
+    print(json.dumps(deployer.status()), flush=True)
+    return 0
+
+
+def _status_main(args) -> int:
+    from dib_tpu.stream.deployer import stream_status
+
+    snapshot = stream_status(args.stream_dir, args.deploy_dir)
+    if args.json:
+        print(json.dumps(snapshot, indent=1))
+        return 0
+    print(f"publishes: {snapshot['publishes']}"
+          + (f"  (latest {snapshot['latest_publish']})"
+             if snapshot["latest_publish"] else ""))
+    if "deploys" in snapshot:
+        print(f"deploys: {snapshot['deploys']} "
+              f"({snapshot['promoted']} promoted / "
+              f"{snapshot['rollbacks']} rolled back / "
+              f"{snapshot['pending']} pending)")
+        print(f"invariants: lost={snapshot['lost_publishes']} "
+              f"double={snapshot['double_promotions']}")
+    return 0
+
+
+def stream_main(argv: Sequence[str]) -> int:
+    argv = list(argv)
+    args = build_stream_parser().parse_args(argv)
+    if args.action == "status":
+        return _status_main(args)
+    # argv keeps the leading action token: the --watchdog path re-execs
+    # `python -m dib_tpu.cli stream <argv minus --watchdog>` and the
+    # worker's parser needs `run`/`deploy` back in first position
+    if args.action == "run":
+        return _run_main(args, argv)
+    return _deploy_main(args, argv)

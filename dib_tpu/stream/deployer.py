@@ -1,0 +1,457 @@
+"""Deployer: tails the publish journal and hot-swaps the serving fleet.
+
+The serving half of the always-on control plane (docs/streaming.md). A
+:class:`Deployer` owns one zoo model name and the durable record of what
+it promoted:
+
+  - it TAILS ``<stream-dir>/publishes.jsonl`` (the only thing trainer
+    and deployer share) and processes publish records strictly in order;
+  - each candidate checkpoint is restored into a fresh router and
+    HEALTH-GATED: the canary rows run through the new engine before any
+    traffic routes to it — a canary that throws or returns non-finite
+    numbers ROLLS the promotion BACK (the candidate is closed, the
+    previous checkpoint keeps answering, and the rollback is durably
+    recorded);
+  - a healthy candidate is promoted via ``ModelZoo.reload`` — the atomic
+    router swap plus exactly-the-reloaded-model response/executable cache
+    invalidation pinned by ``tests/test_serve_zoo.py``, so live traffic
+    rides the swap with every response numerically from exactly one
+    published checkpoint, never a params/cache hybrid;
+  - every decision lands as ONE ``deploy`` record in the deployer's own
+    ``deploys.jsonl`` (same torn-line-tolerant journal idiom), appended
+    AFTER the swap. A deployer SIGKILLed at any point therefore restarts
+    into exact catch-up: publishes with no deploy record are processed
+    (the kill-between-reload-and-append case re-runs an idempotent
+    reload of the same checkpoint), publishes with one are never
+    re-promoted — no skipped and no double-promoted checkpoint
+    (``tests/test_stream_deploy.py``).
+
+The tail loop runs on a plain daemon-free worker thread, never on the
+server's event loop: a reload (restore + compile) costs real seconds and
+the serving loop must not block on it (the ``async-blocking`` lint pass
+guards the invariant).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+from dib_tpu.sched.journal import JobJournal, read_journal
+from dib_tpu.stream.online import maybe_kill, publishes_path, read_publishes
+
+__all__ = ["CanaryFailure", "DEPLOYS_FILENAME", "Deployer",
+           "deploys_path", "read_deploys", "stream_status"]
+
+DEPLOYS_FILENAME = "deploys.jsonl"
+
+
+def _publish_key(rec: dict) -> str:
+    """Stable identity of a publish record for exactly-once accounting.
+
+    A record a foreign writer left without ``publish_id`` still needs an
+    identity that is deterministic across polls and restarts — otherwise
+    its rolled_back decision can never match it on the next read and the
+    journal grows one duplicate decision per poll."""
+    pid = rec.get("publish_id")
+    if pid:
+        return pid
+    return f"malformed-idx{rec.get('index')}-step{rec.get('step')}"
+
+
+def deploys_path(deploy_dir: str) -> str:
+    return os.path.join(deploy_dir, DEPLOYS_FILENAME)
+
+
+def read_deploys(deploy_dir: str) -> tuple[list[dict], int]:
+    """All parseable ``deploy`` records of a deploy dir, oldest first,
+    plus the torn-line count."""
+    records, torn = read_journal(deploys_path(deploy_dir))
+    return [r for r in records if r.get("kind") == "deploy"], torn
+
+
+class CanaryFailure(RuntimeError):
+    """The candidate checkpoint failed its canary probe."""
+
+
+class Deployer:
+    """Tails ``publishes.jsonl``, canary-gates, and hot-swaps via the zoo.
+
+    Args:
+      stream_dir: the trainer's stream directory (the shared journal).
+      deploy_dir: this deployer's durable state (``deploys.jsonl``).
+      trainer: a ``DIBTrainer`` restore template (architecture must match
+        the published checkpoints; the integrity manifest enforces it).
+      zoo: the serving ``ModelZoo`` the fleet routes through.
+      model_name: the zoo name promotions swap (first promotion registers
+        it; later ones ``reload`` it).
+      canary_rows: [k, width] probe input; default is the bundle's first
+        validation rows via ``trainer``.
+      router_kwargs: forwarded to ``ReplicaRouter.from_params`` (batcher
+        knobs, telemetry, registry, tracer).
+    """
+
+    def __init__(self, stream_dir: str, deploy_dir: str, trainer, zoo,
+                 model_name: str = "stream", canary_rows=None,
+                 telemetry=None, registry=None, poll_s: float = 0.25,
+                 router_kwargs: dict | None = None):
+        self.stream_dir = os.path.abspath(stream_dir)
+        self.deploy_dir = os.path.abspath(deploy_dir)
+        self.trainer = trainer
+        self.zoo = zoo
+        self.model_name = model_name
+        self.telemetry = telemetry
+        self.registry = registry
+        self.poll_s = float(poll_s)
+        self.router_kwargs = dict(router_kwargs or {})
+        if canary_rows is None:
+            canary_rows = np.asarray(trainer.bundle.x_valid[:4], np.float32)
+        self.canary_rows = np.asarray(canary_rows, np.float32)
+        os.makedirs(self.deploy_dir, exist_ok=True)
+        self._journal = JobJournal(self.deploy_dir,
+                                   filename=DEPLOYS_FILENAME)
+        # all counters/flags below are mutated from the tail thread and
+        # read from callers; one lock guards them (and journal appends
+        # pair with counter updates under it)
+        self._lock = threading.Lock()
+        self._processed: set[str] = set()
+        self.promoted = 0
+        self.rollbacks = 0
+        self.publishes_seen = 0
+        # the newest promoted publish_id from the journal replay: a
+        # restart re-registers it into the fresh (empty) zoo so the fleet
+        # answers immediately instead of waiting for the NEXT publish
+        self._warm_restore_id: str | None = None
+        records, _ = read_deploys(self.deploy_dir)
+        for rec in records:
+            self._processed.add(rec.get("publish_id", ""))
+            if rec.get("action") == "promoted":
+                self.promoted += 1
+                self._warm_restore_id = rec.get("publish_id")
+            elif rec.get("action") == "rolled_back":
+                self.rollbacks += 1
+        # a non-empty deploy journal means THIS is a restart: the first
+        # catch-up emits the deployer_caught_up mitigation (the chaos
+        # suite's SIGKILL-detection marker)
+        self._resumed = bool(records)
+        # byte size of publishes.jsonl at the last full read: the journal
+        # is append-only, so an unchanged size means no new records and
+        # the idle poll can skip re-parsing the whole file
+        self._publishes_size = -1
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # ----------------------------------------------------------- candidates
+    def _build_router(self, checkpoint_dir: str):
+        """Restore the published checkpoint into a fresh router wired to
+        the zoo's shared executable cache under THIS model's key prefix —
+        so ``reload`` invalidation hits exactly these executables."""
+        from dib_tpu.serve.replicas import ReplicaRouter
+        from dib_tpu.train import DIBCheckpointer
+
+        ckpt = DIBCheckpointer(checkpoint_dir)
+        try:
+            state, _, _ = ckpt.restore(self.trainer)
+        finally:
+            ckpt.close()
+        return ReplicaRouter.from_params(
+            self.trainer.model, state.params["model"],
+            exec_cache=self.zoo.exec_cache, cache_key=self.model_name,
+            registry=self.registry, **self.router_kwargs)
+
+    def _canary(self, router) -> float:
+        """Probe the candidate's engine directly (no traffic routes to it
+        yet); raises :class:`CanaryFailure` on any unhealthy signal."""
+        t0 = time.monotonic()
+        try:
+            out = router.entries[0].engine.predict(self.canary_rows)
+        except Exception as exc:
+            raise CanaryFailure(f"canary dispatch failed: {exc}") from exc
+        prediction = np.asarray(out.get("prediction"))
+        if prediction.shape[0] != self.canary_rows.shape[0]:
+            raise CanaryFailure(
+                f"canary returned {prediction.shape[0]} rows for "
+                f"{self.canary_rows.shape[0]} inputs")
+        if not np.all(np.isfinite(prediction)):
+            raise CanaryFailure("canary prediction is non-finite — the "
+                                "checkpoint serves garbage")
+        return time.monotonic() - t0
+
+    # ------------------------------------------------------------ promotion
+    def _process(self, rec: dict) -> str:
+        """Promote (or roll back) ONE publish record; returns the action.
+
+        The deploy record appends AFTER the swap: a kill in between makes
+        the restart re-run an idempotent reload of the same checkpoint —
+        exactly-once is defined by the journal, and the journal gets at
+        most one record per publish."""
+        pub_id = rec["publish_id"]
+        path = os.path.join(self.stream_dir, rec["path"])
+        try:
+            router = self._build_router(path)
+        except Exception as exc:
+            # a restore that fails is gated exactly like a failed canary:
+            # the previous checkpoint keeps answering
+            return self._record(pub_id, rec, "rolled_back",
+                                error=f"restore failed: {exc}")
+        try:
+            canary_s = self._canary(router)
+        except CanaryFailure as exc:
+            router.close()
+            return self._record(pub_id, rec, "rolled_back",
+                                error=str(exc))
+        if self.model_name in self.zoo.names():
+            self.zoo.reload(self.model_name, router, checkpoint_dir=path)
+        else:
+            self.zoo.register(self.model_name, router, checkpoint_dir=path)
+        return self._record(pub_id, rec, "promoted",
+                            canary_s=canary_s)
+
+    def _record(self, pub_id: str, rec: dict, action: str,
+                **fields) -> str:
+        # wall-clock vs the publish record's journal stamp, taken AFTER
+        # the decision completed — restore + canary + hot swap are INSIDE
+        # the interval, so this is the full publish→serve latency the
+        # stream_publish_to_serve_p99_ceiling SLO gates
+        # lint-ok(timing-hygiene): host-side latency vs a journal unix
+        # timestamp; no jitted work inside the interval
+        t_done = time.time()
+        latency_s = round(max(t_done - rec.get("t", t_done), 0.0), 6)
+        with self._lock:
+            self._journal.append(
+                "deploy", publish_id=pub_id, action=action,
+                publish_index=rec.get("index"), step=rec.get("step"),
+                model=self.model_name, latency_s=latency_s, **fields)
+            self._processed.add(pub_id)
+            if action == "promoted":
+                self.promoted += 1
+            else:
+                self.rollbacks += 1
+        if self.telemetry is not None:
+            # best-effort once the journal append landed: the decision is
+            # durable, and letting an events.jsonl write error escape here
+            # would make catch_up's guard append a SECOND record for this
+            # publish — the exact double-decision the journal forbids
+            try:
+                self.telemetry.deploy(
+                    publish_id=pub_id, action=action, model=self.model_name,
+                    step=rec.get("step"), index=rec.get("index"),
+                    latency_s=latency_s,
+                    **({"error": fields["error"]}
+                       if "error" in fields else {}))
+                if action == "rolled_back":
+                    self.telemetry.mitigation(
+                        mtype="canary_rollback", model=self.model_name,
+                        detail=pub_id, error=fields.get("error"))
+            except Exception as exc:
+                # the one failure with no telemetry channel left: say so
+                # on stderr rather than roll back a healthy promotion
+                print(f"stream deployer: telemetry write failed for "
+                      f"{pub_id} ({action}): {exc}", file=sys.stderr)
+        return action
+
+    def _warm_restore(self, pub_id: str, publishes: list[dict]) -> None:
+        """Re-register the newest PROMOTED checkpoint after a restart.
+
+        The deploy journal is the durable record of WHAT was promoted,
+        but the zoo is in-memory: a deployer restarted when every publish
+        is already decided would otherwise serve NOTHING until the
+        trainer's next publish (unbounded if the trainer is between
+        publishes or down). No new deploy record lands — rebuilding
+        in-memory state is not a promotion decision, and a second record
+        for the same publish would read as a double promotion. A failed
+        restore/canary is only a mitigation: pending publishes (or the
+        next one) will supply a fresh checkpoint."""
+        rec = next((r for r in publishes
+                    if r.get("publish_id") == pub_id), None)
+        if rec is None:
+            return
+        path = os.path.join(self.stream_dir, rec["path"])
+        try:
+            router = self._build_router(path)
+        except Exception as exc:
+            self._warm_restore_failed(pub_id, f"restore failed: {exc}")
+            return
+        try:
+            self._canary(router)
+        except CanaryFailure as exc:
+            router.close()
+            self._warm_restore_failed(pub_id, str(exc))
+            return
+        self.zoo.register(self.model_name, router, checkpoint_dir=path)
+        if self.telemetry is not None:
+            self.telemetry.mitigation(
+                mtype="deployer_warm_restore", model=self.model_name,
+                detail=pub_id)
+
+    def _warm_restore_failed(self, pub_id: str, error: str) -> None:
+        if self.telemetry is not None:
+            self.telemetry.mitigation(
+                mtype="warm_restore_failed", model=self.model_name,
+                detail=pub_id, error=error)
+
+    # -------------------------------------------------------------- tailing
+    def catch_up(self) -> int:
+        """Process every publish record not yet in the deploy journal, in
+        publish order. Returns how many were processed.
+
+        The idle poll (every ``poll_s`` forever on an always-on stream)
+        stats the publish journal instead of re-parsing it: append-only
+        means an unchanged byte size is "nothing new". The size stored is
+        the PRE-read stat, so a record appended mid-read just costs one
+        extra re-read on the next poll, never a miss."""
+        try:
+            size = os.path.getsize(publishes_path(self.stream_dir))
+        except OSError:
+            size = -1
+        with self._lock:
+            if (size >= 0 and size == self._publishes_size
+                    and self._warm_restore_id is None
+                    and not self._resumed):
+                return 0
+        records, _ = read_publishes(self.stream_dir)
+        with self._lock:
+            self.publishes_seen = len(records)
+            pending = [r for r in records
+                       if _publish_key(r) not in self._processed]
+            # consumed exactly once, on the restart's first catch-up
+            warm_id, self._warm_restore_id = self._warm_restore_id, None
+        if warm_id is not None and self.model_name not in self.zoo.names():
+            self._warm_restore(warm_id, records)
+        if self._resumed:
+            self._resumed = False
+            if self.telemetry is not None:
+                self.telemetry.mitigation(
+                    mtype="deployer_caught_up", model=self.model_name,
+                    detail=f"{len(self._processed)} decided, "
+                           f"{len(pending)} pending")
+        done = 0
+        for rec in pending:
+            try:
+                self._process(rec)
+            except Exception as exc:
+                # _process gates restore and canary failures itself;
+                # anything ELSE (the zoo swap raising, a malformed
+                # record) must neither kill the tail thread nor wedge
+                # the loop retrying one poisoned record forever: decide
+                # it as rolled_back so the journal moves on. Only a
+                # failing journal append escapes, to the run-loop guard.
+                # Never re-decide: if _record already journaled this
+                # publish before the failure, a second append would read
+                # as a double decision.
+                with self._lock:
+                    decided = _publish_key(rec) in self._processed
+                if not decided:
+                    self._record(_publish_key(rec), rec,
+                                 "rolled_back",
+                                 error=f"deploy failed: {exc}")
+            done += 1
+            maybe_kill("deployer_tail", self.telemetry)
+        # recorded only once every pending record is decided: an append
+        # failure that escaped above leaves the size stale, so the next
+        # poll re-reads and retries instead of short-circuiting past the
+        # undecided tail
+        with self._lock:
+            self._publishes_size = size
+        return done
+
+    def run(self, duration_s: float | None = None) -> dict:
+        """Tail until :meth:`stop` (or ``duration_s``); returns status."""
+        deadline = (None if not duration_s
+                    else time.monotonic() + float(duration_s))
+        while not self._stop.is_set():
+            try:
+                self.catch_up()
+            except Exception as exc:
+                # the tail thread must never die silently — the fleet
+                # would pin to a stale checkpoint with no decision and
+                # no signal. Whatever escaped catch_up (a journal append
+                # failing, the publish journal unreadable) lands as a
+                # durable mitigation and is retried on the next poll.
+                if self.telemetry is not None:
+                    self.telemetry.mitigation(
+                        mtype="deployer_tail_error",
+                        model=self.model_name, error=str(exc))
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            self._stop.wait(self.poll_s)
+        return self.status()
+
+    def start(self) -> "Deployer":
+        """Run the tail loop on a worker thread (NOT the serving event
+        loop: restores and compiles block for real seconds)."""
+        self._thread = threading.Thread(
+            target=self.run, name="dib-stream-deployer")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def close(self) -> None:
+        self.stop()
+        if self._thread is not None:
+            self._thread.join(timeout=60.0)
+            self._thread = None
+        with self._lock:
+            self._journal.close()
+
+    def __enter__(self) -> "Deployer":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()
+
+    # --------------------------------------------------------------- status
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "publishes_seen": self.publishes_seen,
+                "processed": len(self._processed),
+                "promoted": self.promoted,
+                "rollbacks": self.rollbacks,
+                "model": self.model_name,
+            }
+
+
+def stream_status(stream_dir: str, deploy_dir: str | None = None) -> dict:
+    """Pure file-analysis snapshot of a stream (the ``stream status``
+    CLI): publish/deploy counts, catch-up lag, and the two invariants'
+    live values (lost = a gap below the newest processed publish;
+    double = more than one deploy record for one publish)."""
+    publishes, pub_torn = read_publishes(stream_dir)
+    out = {
+        "stream_dir": os.path.abspath(stream_dir),
+        "publishes": len(publishes),
+        "publishes_torn": pub_torn,
+        "latest_publish": publishes[-1]["publish_id"] if publishes else None,
+    }
+    if deploy_dir is None:
+        return out
+    deploys, dep_torn = read_deploys(deploy_dir)
+    by_publish: dict[str, int] = {}
+    for rec in deploys:
+        pid = rec.get("publish_id", "")
+        by_publish[pid] = by_publish.get(pid, 0) + 1
+    seen = {rec.get("publish_index") for rec in deploys
+            if rec.get("publish_index") is not None}
+    # distinct indices absent INSIDE the decided range = span - count;
+    # anchored at min(seen) like streaming_rollup — indices below the
+    # oldest record in view were decided before this ledger began, not
+    # skipped
+    lost = max(seen) - min(seen) + 1 - len(seen) if seen else 0
+    out.update({
+        "deploy_dir": os.path.abspath(deploy_dir),
+        "deploys": len(deploys),
+        "deploys_torn": dep_torn,
+        "promoted": sum(r.get("action") == "promoted" for r in deploys),
+        "rollbacks": sum(r.get("action") == "rolled_back" for r in deploys),
+        "pending": len(publishes) - len(by_publish),
+        "lost_publishes": lost,
+        "double_promotions": sum(1 for c in by_publish.values() if c > 1),
+    })
+    return out

@@ -1,0 +1,457 @@
+"""Online DIB training on a stream, publishing chunk-aligned checkpoints.
+
+The trainer half of the always-on control plane (docs/streaming.md): a
+``DIBTrainer`` driven window-by-window over a :mod:`dib_tpu.stream.source`
+stream, β annealing exactly as in a batch run — and, past the anneal,
+HOLDING at ``beta_end`` while the model tracks the moving window. On
+detected drift (window feature means shifted beyond the threshold, in
+baseline-σ units) β optionally RE-ANNEALS: the schedule epoch rewinds to
+the anneal start so the model re-explores compression against the new
+distribution, while the history cursor (and the published trajectory)
+keeps counting forward.
+
+Checkpoints publish on a cadence through the atomic protocol the
+deployer's promotion safety rests on:
+
+  1. save the full resume payload (state, history, next key, chunk size)
+     to ``<stream-dir>/staging/<publish-id>``;
+  2. fsync every staged file and directory;
+  3. ``os.replace`` the staging dir to
+     ``<stream-dir>/checkpoints/<publish-id>`` (atomic on POSIX);
+  4. append ONE ``publish`` record to ``publishes.jsonl`` — the same
+     O_APPEND torn-line-tolerant journal idiom as the PR 8 scheduler
+     (:class:`dib_tpu.sched.journal.JobJournal`, reused directly).
+
+A trainer SIGKILLed anywhere in 1–3 leaves at most a torn staging dir or
+an orphaned-but-complete checkpoint dir — never a publish record
+pointing at torn bytes, so the deployer can never promote one. The
+publish record carries the source snapshot, the drift baseline, and the
+round counter, so a relaunched trainer resumes the EXACT stream position
+and detector state — the continuation is bit-identical
+(``tests/test_stream.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+import shutil
+from dataclasses import dataclass
+
+import numpy as np
+
+from dib_tpu.sched.journal import JobJournal, read_journal
+from dib_tpu.stream.source import DriftSpec, RowStream, make_source
+
+__all__ = ["OnlineConfig", "OnlineDIBTrainer", "PUBLISHES_FILENAME",
+           "publishes_path", "read_publishes"]
+
+PUBLISHES_FILENAME = "publishes.jsonl"
+CHECKPOINTS_DIRNAME = "checkpoints"
+STAGING_DIRNAME = "staging"
+
+
+def publishes_path(stream_dir: str) -> str:
+    return os.path.join(stream_dir, PUBLISHES_FILENAME)
+
+
+def read_publishes(stream_dir: str) -> tuple[list[dict], int]:
+    """All parseable ``publish`` records of a stream dir, oldest first,
+    plus the torn-line count (the journal contract's replay)."""
+    records, torn = read_journal(publishes_path(stream_dir))
+    return [r for r in records if r.get("kind") == "publish"], torn
+
+
+@dataclass(frozen=True)
+class OnlineConfig:
+    """Knobs of the online loop, separate from the model's TrainConfig."""
+
+    window: int = 256            # working-set rows per round
+    stride: int = 64             # fresh rows consumed per round
+    chunk_epochs: int = 2        # epochs per jitted chunk (= one round)
+    publish_every: int = 1       # publish a checkpoint every N rounds
+    rounds: int = 8              # total rounds this invocation runs
+    source: str = "sliding"      # 'sliding' | 'reservoir'
+    seed: int = 0                # RowStream shuffle/reservoir seed
+    drift: tuple = ()            # scripted DriftSpec schedule (tests/chaos)
+    drift_threshold: float = 1.0  # baseline-σ units of window-mean shift
+    reanneal_on_drift: bool = True
+    keep_publishes: int = 0      # retain newest N checkpoint dirs (0 = all)
+
+    def __post_init__(self):
+        if self.chunk_epochs < 1:
+            raise ValueError("chunk_epochs must be >= 1")
+        if self.publish_every < 1:
+            raise ValueError("publish_every must be >= 1")
+        if self.keep_publishes < 0:
+            raise ValueError("keep_publishes must be >= 0")
+
+
+#: Deliberate SIGKILL-shaped fault injection for the chaos suite
+#: (scripts/chaos_stream.py): ``DIB_STREAM_FAULT="<point>:<n>"`` makes
+#: the n-th (0-based) arrival at ``<point>`` emit a durable ``fault``
+#: event and die with ``os._exit`` — the same "record lands before the
+#: signal" contract as ``dib_tpu/faults``.
+FAULT_ENV = "DIB_STREAM_FAULT"
+_FAULT_KINDS = {
+    "mid_publish": "stream_mid_publish_kill",
+    "post_rename": "stream_mid_publish_kill",
+    "deployer_tail": "stream_deployer_kill",
+}
+_fault_hits: dict[str, int] = {}
+
+
+def maybe_kill(point: str, telemetry=None) -> None:
+    """Die at ``point`` when the chaos suite scheduled a kill there."""
+    spec = os.environ.get(FAULT_ENV, "")
+    if ":" not in spec:
+        return
+    p, _, n = spec.rpartition(":")
+    if p != point:
+        return
+    hit = _fault_hits.get(point, 0)
+    _fault_hits[point] = hit + 1
+    if hit != int(n):
+        return
+    if telemetry is not None:
+        # one O_APPEND write — durable before the exit below
+        telemetry.fault(kind=_FAULT_KINDS[point], via=point)
+    os._exit(137)
+
+
+def _fsync_tree(directory: str) -> None:
+    """fsync every file and directory under ``directory`` (bottom-up), so
+    the subsequent rename publishes fully-durable bytes."""
+    for dirpath, _, filenames in os.walk(directory, topdown=False):
+        for name in filenames:
+            fd = os.open(os.path.join(dirpath, name), os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+        fd = os.open(dirpath, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+
+
+def _fsync_dir(directory: str) -> None:
+    fd = os.open(directory, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+class OnlineDIBTrainer:
+    """Drives a ``DIBTrainer`` on a streaming source and publishes
+    chunk-aligned checkpoints through the atomic publish protocol.
+
+    ``bundle`` supplies the stream's row pool and the FIXED held-out
+    validation split (val_loss stays comparable across windows — under
+    drift it is exactly the signal that decays). The jitted hot path is
+    ``DIBTrainer.run_stream_chunk``, which takes the window as real
+    arguments: one compile serves every round.
+    """
+
+    def __init__(self, model, bundle, config, online: OnlineConfig,
+                 stream_dir: str, telemetry=None, y_encoder=None):
+        from dib_tpu.train import DIBTrainer
+
+        if online.window < config.batch_size:
+            raise ValueError(
+                f"window ({online.window}) must be >= batch_size "
+                f"({config.batch_size}) — an epoch needs one full batch")
+        # steps_per_epoch must reflect the WINDOW, not the backing pool
+        # (DIBTrainer derives it from bundle.x_train otherwise)
+        if not config.steps_per_epoch:
+            config = dataclasses.replace(
+                config,
+                steps_per_epoch=-(-online.window // config.batch_size))
+        self.online = online
+        self.stream_dir = os.path.abspath(stream_dir)
+        self.telemetry = telemetry
+        self.trainer = DIBTrainer(model, bundle, config, y_encoder=y_encoder)
+        self.config = config
+        drift = tuple(d if isinstance(d, DriftSpec) else DriftSpec(**d)
+                      for d in online.drift)
+        self.stream = RowStream(bundle.x_train, bundle.y_train,
+                                seed=online.seed, drift=drift)
+        self.source = make_source(online.source, self.stream,
+                                  online.window, online.stride)
+        os.makedirs(self.stream_dir, exist_ok=True)
+        self._journal: JobJournal | None = None
+        self._baseline: tuple[np.ndarray, np.ndarray] | None = None
+        self.publishes = 0
+        self.drifts = 0
+
+    # ------------------------------------------------------------- resume
+    def _restore_or_init(self, key):
+        """(state, history, key, round0, epochs_done): from the newest
+        publish record when one exists (the exact resume point — source
+        offset, drift baseline, and PRNG chain included), else fresh."""
+        import jax
+
+        from dib_tpu.train import DIBCheckpointer
+
+        records, torn = read_publishes(self.stream_dir)
+        if torn and self.telemetry is not None:
+            self.telemetry.mitigation(mtype="journal_recovered",
+                                      detail=f"publishes.jsonl: {torn} "
+                                             "torn line(s) skipped")
+        # sweep away torn staging remains of a dead trainer — they were
+        # never published, so nothing references them
+        shutil.rmtree(os.path.join(self.stream_dir, STAGING_DIRNAME),
+                      ignore_errors=True)
+        if not records:
+            key, k_init = jax.random.split(key)
+            state, history = self.trainer.init(k_init)
+            return state, history, key, 0, 0
+        rec = records[-1]
+        ckpt = DIBCheckpointer(os.path.join(self.stream_dir, rec["path"]))
+        try:
+            state, history, key = ckpt.restore(
+                self.trainer, chunk_size=self.online.chunk_epochs)
+        finally:
+            ckpt.close()
+        self.source.restore(rec["source"])
+        # the snapshot was taken mid-round (before the round's advance);
+        # resuming at round+1 owes exactly the one advance the dead
+        # trainer performed (or would have performed) after publishing
+        self.source.advance()
+        if rec.get("baseline") is not None:
+            self._baseline = (np.asarray(rec["baseline"]["mean"]),
+                              np.asarray(rec["baseline"]["std"]))
+        self.publishes = int(rec.get("index", 0)) + 1
+        self.drifts = int(rec.get("drifts", 0))
+        if self.telemetry is not None:
+            self.telemetry.mitigation(
+                mtype="stream_resumed", detail=rec["publish_id"],
+                restored_epoch=int(rec["step"]))
+        return state, history, key, int(rec["round"]) + 1, int(rec["step"])
+
+    # -------------------------------------------------------------- drift
+    def _detect_drift(self, x_win: np.ndarray) -> float | None:
+        """Normalized worst-feature shift of the window mean vs the
+        baseline window, or None below threshold. The first window (and
+        each post-drift window) becomes the new baseline."""
+        mean = x_win.mean(axis=0)
+        std = x_win.std(axis=0)
+        if self._baseline is None:
+            self._baseline = (mean, std)
+            return None
+        base_mean, base_std = self._baseline
+        shift = float(np.max(np.abs(mean - base_mean)
+                             / np.maximum(base_std, 1e-6)))
+        if shift <= self.online.drift_threshold:
+            return None
+        self._baseline = (mean, std)
+        return shift
+
+    # ------------------------------------------------------------ publish
+    def _publish(self, state, history, key, *, step: int, round_index: int,
+                 beta: float) -> dict:
+        """The atomic publish protocol: stage → fsync → rename → journal.
+
+        The record lands ONLY after the checkpoint is fully durable under
+        its final path, so a record is a promotion-safe pointer by
+        construction — a kill at any earlier point leaves staging litter
+        the next launch sweeps, never a torn promoted checkpoint."""
+        from dib_tpu.train import DIBCheckpointer
+
+        pub_id = f"pub-{step:08d}"
+        rel = os.path.join(CHECKPOINTS_DIRNAME, pub_id)
+        staging = os.path.join(self.stream_dir, STAGING_DIRNAME, pub_id)
+        final = os.path.join(self.stream_dir, rel)
+        shutil.rmtree(staging, ignore_errors=True)
+        ckpt = DIBCheckpointer(staging, max_to_keep=1)
+        try:
+            ckpt.save(step, state, history, key,
+                      chunk_size=self.online.chunk_epochs)
+        finally:
+            ckpt.close()   # waits for any async write
+        _fsync_tree(staging)
+        maybe_kill("mid_publish", self.telemetry)
+        os.makedirs(os.path.dirname(final), exist_ok=True)
+        # An existing final dir is an ORPHAN: a previous trainer died
+        # between rename and journal append, so no record references it,
+        # the deployer never saw it — and the resumed (bit-identical)
+        # trainer is republishing the very same step. Replace it.
+        shutil.rmtree(final, ignore_errors=True)
+        os.replace(staging, final)
+        _fsync_dir(os.path.dirname(final))
+        maybe_kill("post_rename", self.telemetry)
+        base = self._baseline
+        record = self._journal.append(
+            "publish",
+            publish_id=pub_id,
+            index=self.publishes,
+            step=int(step),
+            round=int(round_index),
+            path=rel,
+            beta=float(beta),
+            chunk_epochs=self.online.chunk_epochs,
+            source=self.source.snapshot(),
+            drifts=self.drifts,
+            baseline=(None if base is None else
+                      {"mean": [float(v) for v in base[0]],
+                       "std": [float(v) for v in base[1]]}),
+        )
+        self.publishes += 1
+        if self.telemetry is not None:
+            self.telemetry.publish(publish_id=pub_id, step=int(step),
+                                   path=rel, round=int(round_index),
+                                   beta=float(beta))
+        self._prune_checkpoints()
+        return record
+
+    def _prune_checkpoints(self) -> None:
+        """Bound on-disk checkpoints to the newest ``keep_publishes``
+        (0 = unlimited). The journals only grow — they are the durable
+        ledger — but an always-on stream must not fill the disk with one
+        full resume payload per cadence. The newest publish (the resume
+        anchor) is always in the kept tail; a deployer catching up past a
+        pruned checkpoint gates the restore failure like a failed canary
+        (rolled_back, the previous checkpoint keeps answering)."""
+        keep = self.online.keep_publishes
+        if keep <= 0:
+            return
+        root = os.path.join(self.stream_dir, CHECKPOINTS_DIRNAME)
+        # pub-%08d: lexicographic order IS publish order
+        names = sorted(n for n in os.listdir(root) if n.startswith("pub-"))
+        for name in names[:-keep]:
+            shutil.rmtree(os.path.join(root, name), ignore_errors=True)
+
+    # ---------------------------------------------------------------- run
+    def run(self, key, rounds: int | None = None, preempt=None,
+            boundary_hook=None) -> dict:
+        """Train ``rounds`` rounds (one chunk per round), publishing on
+        the configured cadence. Resumes from the newest publish record
+        when the stream dir already holds one. ``preempt`` (a
+        ``PreemptionGuard``) makes SIGTERM land as a final publish at the
+        next boundary; ``boundary_hook(round_index, epochs_done)`` is the
+        chaos suite's fault-injection point (called after each round's
+        publish decision, exactly like the sched runner's hook)."""
+        import jax
+        import jax.numpy as jnp
+
+        from dib_tpu.train.history import history_extend
+        from dib_tpu.train.preempt import TrainingPreempted
+        from dib_tpu.utils.profiling import PhaseTimer
+
+        online = self.online
+        cfg = self.config
+        rounds = online.rounds if rounds is None else rounds
+        self._journal = JobJournal(self.stream_dir,
+                                   filename=PUBLISHES_FILENAME)
+        timer = PhaseTimer()
+        row = {"loss": float("nan"), "val_loss": float("nan"),
+               "beta": float("nan")}
+        try:
+            state, history, key, round0, epochs_done = \
+                self._restore_or_init(key)
+            # capacity for THIS invocation's rounds (resume may land past
+            # the template's preallocation)
+            capacity = int(history["beta"].shape[0])
+            needed = epochs_done + (rounds - round0) * online.chunk_epochs
+            if needed > capacity:
+                history = history_extend(history, needed - capacity)
+            for round_index in range(round0, rounds):
+                x_win, y_win = self.source.window()
+                shift = self._detect_drift(x_win)
+                if shift is not None:
+                    self.drifts += 1
+                    action = ("reanneal" if online.reanneal_on_drift
+                              else "hold")
+                    if self.telemetry is not None:
+                        self.telemetry.drift(
+                            round=round_index, detector="window_mean",
+                            shift=round(shift, 4),
+                            threshold=online.drift_threshold,
+                            action=action, epoch=epochs_done)
+                    self._journal.append(
+                        "drift", round=round_index, shift=round(shift, 4),
+                        action=action)
+                    if online.reanneal_on_drift:
+                        # rewind the SCHEDULE epoch to the anneal start: β
+                        # re-anneals β_start → β_end against the drifted
+                        # distribution; params/optimizer/history continue
+                        state = type(state)(
+                            state.params, state.opt_state,
+                            jnp.asarray(cfg.num_pretraining_epochs,
+                                        jnp.int32))
+                key, k_chunk = jax.random.split(key)
+                with timer.phase("stream_chunk"):
+                    state, history = self.trainer.run_stream_chunk(
+                        state, history, k_chunk,
+                        jnp.asarray(x_win), jnp.asarray(y_win),
+                        online.chunk_epochs)
+                    epochs_done += online.chunk_epochs
+                    # ONE explicit blocking fetch per boundary (the
+                    # honest sync point): the boundary row + the
+                    # schedule epoch, inside the blocking phase
+                    cursor = epochs_done - 1
+                    row = jax.device_get({
+                        "loss": history["loss"][cursor],
+                        "val_loss": history["val_loss"][cursor],
+                        "beta": history["beta"][cursor],
+                        "epoch": state.epoch,
+                    })
+                if self.telemetry is not None:
+                    self.telemetry.chunk(
+                        epoch=epochs_done,
+                        steps=online.chunk_epochs * self.trainer.steps_per_epoch,
+                        seconds=timer.intervals["stream_chunk"][-1],
+                        loss=float(row["loss"]),
+                        val_loss=float(row["val_loss"]),
+                        beta=float(row["beta"]))
+                # ABSOLUTE cadence (not relative to this launch's first
+                # round), so a resumed run publishes at the same rounds an
+                # uninterrupted one would — the bit-identity tests compare
+                # the two journals record for record
+                published = ((round_index + 1) % online.publish_every == 0
+                             or round_index == rounds - 1)
+                if published:
+                    self._publish(state, history, key, step=epochs_done,
+                                  round_index=round_index,
+                                  beta=float(row["beta"]))
+                if boundary_hook is not None:
+                    boundary_hook(round_index, epochs_done)
+                if preempt is not None and preempt.requested:
+                    if not published:
+                        # chunk-aligned grace checkpoint: the publish IS
+                        # the resume point, so a preempted round must
+                        # leave one before unwinding
+                        self._publish(state, history, key,
+                                      step=epochs_done,
+                                      round_index=round_index,
+                                      beta=float(row["beta"]))
+                    if self.telemetry is not None:
+                        self.telemetry.mitigation(
+                            mtype="preempt_checkpoint", epoch=epochs_done)
+                    raise TrainingPreempted(
+                        f"preempted at round {round_index} "
+                        f"(epoch {epochs_done}); latest publish is the "
+                        "resume point")
+                self.source.advance()
+        finally:
+            self._journal.close()
+            self._journal = None
+        # None, not NaN, when this invocation ran zero rounds (a resume
+        # already past --rounds): json.dumps would emit a bare NaN token
+        # that strict parsers reject (the EventWriter sanitation rule)
+        def _finite(v):
+            f = float(v)
+            return f if math.isfinite(f) else None
+
+        return {
+            "rounds": rounds,
+            "epochs": epochs_done,
+            "publishes": self.publishes,
+            "drifts": self.drifts,
+            "final_loss": _finite(row["loss"]),
+            "final_val_loss": _finite(row["val_loss"]),
+            "final_beta": _finite(row["beta"]),
+        }

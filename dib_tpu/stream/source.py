@@ -1,0 +1,256 @@
+"""Streaming batch sources over the dataset loaders.
+
+An always-on DIB deployment (docs/streaming.md) trains on a *stream*,
+not a fixed array: rows arrive forever, the trainer sees a bounded
+working set, and a preempted trainer must resume the EXACT stream
+position it died at. This module turns any ``DatasetBundle``'s arrays
+into that stream:
+
+  - :class:`RowStream` — a deterministic infinite row sequence over the
+    bundle's ``(x_train, y_train)``: global row index ``i`` maps to a
+    PRNG-permuted pass over the data (a fresh permutation per epoch-sized
+    block, derived from ``(seed, block)`` — no mutable RNG state to
+    snapshot), with a scripted :class:`DriftSpec` schedule applied as a
+    pure function of the index. Same ``(seed, drift, i)`` → same row,
+    always — the property every resumability claim below reduces to.
+  - :class:`SlidingWindowSource` — the trainer's working set is the last
+    ``window`` rows; ``advance()`` slides it by ``stride``. State is ONE
+    integer (the stream offset).
+  - :class:`ReservoirSource` — classic reservoir sampling (capacity-sized
+    uniform sample over everything seen so far); per-row accept/replace
+    decisions derive from ``(seed, index)``, so state is the count plus
+    the reservoir's row INDICES — snapshot/restore is exact, and a
+    resumed source is bit-identical to one that never stopped
+    (``tests/test_stream.py``).
+  - :class:`DriftSpec` — the scripted drift injector the chaos suite and
+    the drift-detection tests drive: from global row ``at`` onward the
+    feature distribution shifts (``mean_shift``) or stretches
+    (``scale``). Scripted means deterministic: replaying the stream
+    replays the drift.
+
+Sources expose the same surface: ``window() -> (x, y)``, ``advance()``,
+``snapshot() -> dict`` / ``restore(state)``, so ``stream/online.py``
+treats them interchangeably.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+__all__ = ["DriftSpec", "ReservoirSource", "RowStream",
+           "SlidingWindowSource", "make_source", "parse_drift_specs"]
+
+
+@dataclass(frozen=True)
+class DriftSpec:
+    """One scripted distribution shift: rows with global index >= ``at``
+    are transformed. ``mean_shift`` adds ``magnitude`` to every feature;
+    ``scale`` multiplies features by ``1 + magnitude``. Specs stack (a
+    second spec compounds on the first)."""
+
+    at: int
+    kind: str = "mean_shift"
+    magnitude: float = 1.0
+
+    def __post_init__(self):
+        if self.kind not in ("mean_shift", "scale"):
+            raise ValueError(
+                f"unknown drift kind {self.kind!r} "
+                "(expected 'mean_shift' or 'scale')")
+        if self.at < 0:
+            raise ValueError(f"drift 'at' must be >= 0, got {self.at}")
+
+
+def parse_drift_specs(pairs) -> tuple[DriftSpec, ...]:
+    """CLI spelling ``AT[:KIND[:MAGNITUDE]]`` (repeatable) → specs."""
+    specs = []
+    for pair in pairs or ():
+        parts = str(pair).split(":")
+        at = int(parts[0])
+        kind = parts[1] if len(parts) > 1 and parts[1] else "mean_shift"
+        magnitude = float(parts[2]) if len(parts) > 2 else 1.0
+        specs.append(DriftSpec(at=at, kind=kind, magnitude=magnitude))
+    return tuple(sorted(specs, key=lambda s: s.at))
+
+
+class RowStream:
+    """Deterministic infinite row stream over fixed ``(x, y)`` arrays.
+
+    Global index ``i`` lives in pass (block) ``i // n`` at position
+    ``i % n``; each block's permutation derives from ``(seed, block)``
+    via a fresh ``np.random.default_rng`` — stateless, so arbitrary
+    index sets (:meth:`take`) are as cheap as sequential reads and a
+    resumed consumer needs no RNG snapshot. ``shuffle=False`` streams
+    the data in storage order (time-ordered datasets)."""
+
+    def __init__(self, x, y, seed: int = 0, drift=(), shuffle: bool = True):
+        self.x = np.asarray(x)
+        self.y = np.asarray(y)
+        if self.x.shape[0] != self.y.shape[0]:
+            raise ValueError(
+                f"x has {self.x.shape[0]} rows but y has {self.y.shape[0]}")
+        if self.x.shape[0] == 0:
+            raise ValueError("cannot stream an empty dataset")
+        self.n = self.x.shape[0]
+        self.seed = int(seed)
+        self.shuffle = bool(shuffle)
+        self.drift = tuple(sorted(drift, key=lambda s: s.at))
+        self._perm_cache: dict[int, np.ndarray] = {}
+
+    def _perm(self, block: int) -> np.ndarray:
+        perm = self._perm_cache.get(block)
+        if perm is None:
+            if self.shuffle:
+                perm = np.random.default_rng(
+                    [self.seed, int(block)]).permutation(self.n)
+            else:
+                perm = np.arange(self.n)
+            # keep only a handful of passes hot, evicting ONE oldest
+            # entry (insertion order) — clearing the whole cache would
+            # make a reservoir window spanning >4 blocks rebuild every
+            # block's permutation on every take()
+            if len(self._perm_cache) > 4:
+                self._perm_cache.pop(next(iter(self._perm_cache)))
+            self._perm_cache[block] = perm
+        return perm
+
+    def take(self, indices) -> tuple[np.ndarray, np.ndarray]:
+        """Rows for arbitrary GLOBAL indices (drift applied per row at its
+        own index — a reservoir holding pre-drift rows keeps them
+        pre-drift)."""
+        gidx = np.asarray(list(indices), dtype=np.int64)
+        rows = np.empty(gidx.shape[0], dtype=np.int64)
+        # one _perm lookup per DISTINCT block, not per row: reservoir
+        # windows interleave blocks, and per-row lookups would turn each
+        # cache miss into a full permutation rebuild
+        blocks = gidx // self.n
+        offsets = gidx % self.n
+        for block in np.unique(blocks):
+            sel = blocks == block
+            rows[sel] = self._perm(int(block))[offsets[sel]]
+        x = np.array(self.x[rows], copy=True)
+        y = np.array(self.y[rows], copy=True)
+        for spec in self.drift:
+            mask = gidx >= spec.at
+            if not mask.any():
+                continue
+            if spec.kind == "mean_shift":
+                x[mask] = x[mask] + spec.magnitude
+            else:   # scale
+                x[mask] = x[mask] * (1.0 + spec.magnitude)
+        return x, y
+
+    def rows(self, start: int, count: int) -> tuple[np.ndarray, np.ndarray]:
+        """``count`` consecutive rows starting at global index ``start``."""
+        return self.take(range(start, start + count))
+
+
+class SlidingWindowSource:
+    """Working set = the most recent ``window`` rows of the stream.
+
+    ``advance()`` slides by ``stride`` rows. The whole state is one
+    integer offset, so ``snapshot()``/``restore()`` are trivially exact
+    and the restored window is bit-identical (the stream itself is a
+    pure function of the index)."""
+
+    kind = "sliding"
+
+    def __init__(self, stream: RowStream, window: int, stride: int | None = None):
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        self.stream = stream
+        self.window_size = int(window)
+        self.stride = int(stride) if stride else max(window // 2, 1)
+        if self.stride < 1:
+            raise ValueError(f"stride must be >= 1, got {stride}")
+        self.offset = self.window_size   # prefilled: rows [0, window)
+
+    def window(self) -> tuple[np.ndarray, np.ndarray]:
+        return self.stream.rows(self.offset - self.window_size,
+                                self.window_size)
+
+    def advance(self) -> None:
+        self.offset += self.stride
+
+    @property
+    def rows_consumed(self) -> int:
+        return self.offset
+
+    def snapshot(self) -> dict:
+        return {"kind": self.kind, "offset": int(self.offset)}
+
+    def restore(self, state: dict) -> None:
+        if state.get("kind") != self.kind:
+            raise ValueError(
+                f"source state kind {state.get('kind')!r} does not match "
+                f"this {self.kind!r} source — the resumed run was "
+                "configured with a different --stream-source")
+        self.offset = int(state["offset"])
+
+
+class ReservoirSource:
+    """Capacity-bounded uniform sample over everything seen so far
+    (Vitter's algorithm R). Each arriving row ``i >= capacity`` replaces
+    slot ``j ~ U[0, i]`` when ``j < capacity``; ``j`` derives from
+    ``(seed, i)``, so the decision sequence is a pure function of the
+    stream position and the snapshot is just ``(count, indices)``."""
+
+    kind = "reservoir"
+
+    def __init__(self, stream: RowStream, window: int, stride: int | None = None):
+        if window < 1:
+            raise ValueError(f"window (capacity) must be >= 1, got {window}")
+        self.stream = stream
+        self.window_size = int(window)
+        self.stride = int(stride) if stride else max(window // 2, 1)
+        if self.stride < 1:
+            raise ValueError(f"stride must be >= 1, got {stride}")
+        # prefill: the first `capacity` rows fill the reservoir directly
+        self.count = self.window_size
+        self.indices = list(range(self.window_size))
+
+    def window(self) -> tuple[np.ndarray, np.ndarray]:
+        return self.stream.take(self.indices)
+
+    def advance(self) -> None:
+        for i in range(self.count, self.count + self.stride):
+            j = int(np.random.default_rng(
+                [self.stream.seed, 7919, i]).integers(0, i + 1))
+            if j < self.window_size:
+                self.indices[j] = i
+        self.count += self.stride
+
+    @property
+    def rows_consumed(self) -> int:
+        return self.count
+
+    def snapshot(self) -> dict:
+        return {"kind": self.kind, "count": int(self.count),
+                "indices": [int(i) for i in self.indices]}
+
+    def restore(self, state: dict) -> None:
+        if state.get("kind") != self.kind:
+            raise ValueError(
+                f"source state kind {state.get('kind')!r} does not match "
+                f"this {self.kind!r} source — the resumed run was "
+                "configured with a different --stream-source")
+        self.count = int(state["count"])
+        self.indices = [int(i) for i in state["indices"]]
+        if len(self.indices) != self.window_size:
+            raise ValueError(
+                f"restored reservoir holds {len(self.indices)} indices "
+                f"but this source's capacity is {self.window_size} — the "
+                "resumed run was configured with a different --window")
+
+
+def make_source(kind: str, stream: RowStream, window: int,
+                stride: int | None = None):
+    """Factory for the CLI's ``--stream-source`` flag."""
+    if kind == "sliding":
+        return SlidingWindowSource(stream, window, stride)
+    if kind == "reservoir":
+        return ReservoirSource(stream, window, stride)
+    raise ValueError(
+        f"unknown source kind {kind!r} (expected 'sliding' or 'reservoir')")

@@ -173,6 +173,28 @@ EVENT_SCHEMA: dict[str, EventKindSpec] = {
                   "queue_wait_s", "attempt", "reason"),
         doc="one work-unit lease transition (dib_tpu/sched): granted / "
             "renewed / released / expired / rejected"),
+    "publish": EventKindSpec(
+        required=("publish_id", "step"),
+        optional=("path", "round", "beta", "epoch", "seconds"),
+        doc="one chunk-aligned checkpoint published by the streaming "
+            "trainer (dib_tpu/stream): staged, fsynced, renamed, then "
+            "journaled — the record lands only after the checkpoint is "
+            "fully durable under its final path"),
+    "deploy": EventKindSpec(
+        required=("publish_id", "action"),
+        optional=("model", "step", "index", "latency_s", "canary_s",
+                  "error"),
+        doc="one deployer decision on a published checkpoint "
+            "(dib_tpu/stream): promoted (canary passed, hot-swapped via "
+            "ModelZoo.reload) or rolled_back (canary/restore failed; the "
+            "previous checkpoint keeps answering); latency_s is the "
+            "publish→serve window the streaming SLO gates"),
+    "drift": EventKindSpec(
+        required=("round", "detector"),
+        optional=("shift", "threshold", "action", "epoch"),
+        doc="one detected input-distribution drift on the training "
+            "stream (dib_tpu/stream): the normalized shift, the "
+            "threshold it crossed, and the β response (reanneal/hold)"),
     "metrics": EventKindSpec(
         required=("snapshots",),
         doc="counter/gauge/histogram snapshots"),
@@ -615,6 +637,26 @@ class EventWriter:
         ``rejected`` (a superseded lease's completion or renewal — the
         double-execution guard firing)."""
         return self.emit("lease", unit=unit, action=action, **fields)
+
+    def publish(self, *, publish_id: str, step: int, **fields) -> dict:
+        """One published streaming checkpoint (``dib_tpu/stream``):
+        emitted after the atomic stage→fsync→rename→journal protocol
+        completed, so the event mirrors a durable ``publishes.jsonl``
+        record."""
+        return self.emit("publish", publish_id=publish_id, step=int(step),
+                         **fields)
+
+    def deploy(self, *, publish_id: str, action: str, **fields) -> dict:
+        """One deployer decision (``dib_tpu/stream``): ``action`` is
+        ``promoted`` (hot-swapped into the fleet) or ``rolled_back``
+        (canary/restore failure; previous checkpoint keeps serving)."""
+        return self.emit("deploy", publish_id=publish_id, action=action,
+                         **fields)
+
+    def drift(self, *, round: int, detector: str, **fields) -> dict:
+        """One detected training-stream drift (``dib_tpu/stream``)."""
+        return self.emit("drift", round=int(round), detector=detector,
+                         **fields)
 
     def metrics(self, snapshots) -> dict:
         return self.emit("metrics", snapshots=snapshots)

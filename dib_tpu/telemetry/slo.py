@@ -34,6 +34,13 @@ Semantics:
   ``"required": true`` to make absence itself a violation.
 - ``when`` guards applicability: every key (dotted, same resolution) must
   equal the given value (or be IN it, when a list) for the rule to apply.
+  A key ABSENT from the summary means the guard is unmatched (skipped).
+- ``when_not`` excludes: the rule is skipped when any key resolves AND
+  matches its value (or is IN it, when a list). A key absent from the
+  summary excludes nothing — so a rule scoped by exclusion still gates
+  streams that never tagged themselves (the fail-closed direction for
+  page-severity rules; an inclusion ``when`` would silently un-gate
+  them).
 - **Transitions** are detections, not violations: a channel's per-feature
   KL crossing ``kl_threshold_nats`` between chunk boundaries is an
   info-plane transition — the β-grid refinement signal the scheduler
@@ -109,9 +116,10 @@ def validate_slo(spec) -> list[str]:
             if not isinstance(v, (int, float)) or isinstance(v, bool) \
                     or not math.isfinite(v):
                 problems.append(f"{label}: {k!r} must be a finite number")
-        when = rule.get("when")
-        if when is not None and not isinstance(when, dict):
-            problems.append(f"{label}: 'when' must be an object")
+        for guard in ("when", "when_not"):
+            v = rule.get(guard)
+            if v is not None and not isinstance(v, dict):
+                problems.append(f"{label}: {guard!r} must be an object")
     transitions = spec.get("transitions")
     if transitions is not None:
         thr = (transitions or {}).get("kl_threshold_nats") \
@@ -179,17 +187,28 @@ def _scalarize(v):
     return None
 
 
+def _guard_key_matches(summary: dict, key: str, want) -> bool | None:
+    """Whether dotted ``key`` resolves in ``summary`` and matches
+    ``want`` (membership when a list); None when the key is absent."""
+    node = summary
+    for part in key.split("."):
+        if not isinstance(node, dict) or part not in node:
+            return None
+        node = node[part]
+    if isinstance(want, list):
+        return node in want
+    return node == want
+
+
 def _when_applies(rule: dict, summary: dict) -> bool:
     for key, want in (rule.get("when") or {}).items():
-        node = summary
-        for part in key.split("."):
-            if not isinstance(node, dict) or part not in node:
-                return False
-            node = node[part]
-        if isinstance(want, list):
-            if node not in want:
-                return False
-        elif node != want:
+        # absent key = guard unmatched: an inclusion guard fails closed
+        if _guard_key_matches(summary, key, want) is not True:
+            return False
+    for key, want in (rule.get("when_not") or {}).items():
+        # absent key excludes NOTHING: an exclusion guard keeps untagged
+        # streams gated (the page-severity direction)
+        if _guard_key_matches(summary, key, want) is True:
             return False
     return True
 

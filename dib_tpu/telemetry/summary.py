@@ -39,6 +39,7 @@ from dib_tpu.telemetry.events import (
 
 __all__ = ["summarize", "compare", "faults_rollup", "overlap_rollup",
            "scheduler_rollup", "serving_rollup", "span_rollup",
+           "streaming_rollup",
            "span_hotspots", "telemetry_main"]
 
 _LN2 = log(2.0)
@@ -286,6 +287,15 @@ _FAULT_DETECTORS: dict[str, tuple[str, ...]] = {
     "sched_worker_kill": ("worker_dead", "lease_stolen"),
     "lease_expire": ("lease_stolen",),
     "journal_torn": ("journal_recovered",),
+    # streaming control-plane faults (dib_tpu/stream, docs/streaming.md):
+    # a trainer SIGKILLed mid-publish is detected by the relaunch
+    # resuming from the newest durable publish (stream_resumed) or by the
+    # publish journal's torn-line replay; a deployer SIGKILL by the
+    # restart's exactly-once catch-up; a poisoned published checkpoint by
+    # the canary gate rolling the promotion back
+    "stream_mid_publish_kill": ("stream_resumed", "journal_recovered"),
+    "stream_deployer_kill": ("deployer_caught_up",),
+    "stream_poison": ("canary_rollback",),
 }
 
 # Recovery markers per kind, evaluated on events AFTER the detection:
@@ -304,6 +314,12 @@ _SERVE_RECOVERERS: dict[str, tuple[str, ...]] = {
 # would also say so, but the job event is the sharper signal.
 _SCHED_FAULT_KINDS = ("sched_worker_kill", "lease_expire", "journal_torn")
 
+# Streaming faults recover when the control plane demonstrably moves
+# again AFTER detection: a fresh publish (trainer side) or a promoted
+# deploy (deployer side) — the always-on loop's own terminal records.
+_STREAM_FAULT_KINDS = ("stream_mid_publish_kill", "stream_deployer_kill",
+                       "stream_poison")
+
 
 def _chunk_loss_finite(event: dict) -> bool:
     vals = _as_floats(event.get("loss"))
@@ -317,6 +333,12 @@ def _marks_recovery(kind: str, event: dict) -> bool:
     if kind in _SCHED_FAULT_KINDS:
         return (event.get("type") == "job"
                 and event.get("action") in ("unit_done", "done"))
+    if kind in _STREAM_FAULT_KINDS:
+        return (event.get("type") == "publish"
+                or (event.get("type") == "deploy"
+                    and event.get("action") == "promoted")
+                or (event.get("type") == "run_end"
+                    and event.get("status") == "ok"))
     if event.get("type") == "chunk":
         return _chunk_loss_finite(event)
     return (event.get("type") == "run_end"
@@ -462,6 +484,67 @@ def scheduler_rollup(events) -> dict | None:
         out["queue_wait_p50_s"] = round(_percentile(waits, 0.5), 3)
         out["queue_wait_p99_s"] = round(_percentile(waits, 0.99), 3)
         out["queue_wait_max_s"] = round(waits[-1], 3)
+    return out
+
+
+def streaming_rollup(events) -> dict | None:
+    """Control-plane view of a stream's ``publish``/``deploy``/``drift``
+    events (``dib_tpu/stream``, docs/streaming.md). A trainer stream
+    carries publishes and drifts; a deployer stream carries deploys —
+    the rollup reports whichever are present, and the deploy-side keys
+    are what the streaming SLO rules gate: ``publish_to_serve_p50_s``/
+    ``publish_to_serve_p99_s`` from each deploy's ``latency_s``,
+    ``rollbacks``, and the two journal invariants — ``lost_publishes``
+    (a publish index below the newest processed one with no deploy
+    decision: the deployer skipped it) and ``double_promotions`` (two
+    decisions for one publish id). None when the stream carries no
+    streaming events."""
+    publishes = [e for e in events if e.get("type") == "publish"]
+    deploys = [e for e in events if e.get("type") == "deploy"]
+    drifts = [e for e in events if e.get("type") == "drift"]
+    if not publishes and not deploys and not drifts:
+        return None
+    out: dict = {}
+    if publishes:
+        out["publishes"] = len(publishes)
+    if drifts:
+        out["drifts"] = len(drifts)
+    if deploys:
+        out["deploys"] = len(deploys)
+        out["promoted"] = sum(e.get("action") == "promoted"
+                              for e in deploys)
+        out["rollbacks"] = sum(e.get("action") == "rolled_back"
+                               for e in deploys)
+        latencies = sorted(e.get("latency_s") for e in deploys
+                           if isinstance(e.get("latency_s"), (int, float)))
+        if latencies:
+            out["publish_to_serve_p50_s"] = round(
+                _percentile(latencies, 0.5), 3)
+            out["publish_to_serve_p99_s"] = round(
+                _percentile(latencies, 0.99), 3)
+        by_publish: dict[str, int] = {}
+        for e in deploys:
+            pid = str(e.get("publish_id"))
+            by_publish[pid] = by_publish.get(pid, 0) + 1
+        out["double_promotions"] = sum(
+            1 for c in by_publish.values() if c > 1)
+        # lost = a gap in the processed publish-INDEX sequence: the
+        # trainer numbers publishes 0, 1, 2, … and deploy events copy the
+        # index, so an index missing below the newest decided one means
+        # the deployer decided a LATER publish without ever deciding this
+        # one — the skip the exactly-once contract forbids. Anchored at
+        # the SMALLEST index in view, not 0: a restarted deployer with a
+        # fresh telemetry dir only carries events for the publishes it
+        # decided this launch (earlier ones live in the prior launch's
+        # stream), and the deployer structurally processes in order from
+        # the journal head — so indices below the view are decided, not
+        # lost, and counting them would page stream_lost_publish_max
+        # falsely
+        indices = {int(e["index"]) for e in deploys
+                   if isinstance(e.get("index"), (int, float))}
+        out["lost_publishes"] = (
+            max(indices) - min(indices) + 1 - len(indices)
+            if indices else 0)
     return out
 
 
@@ -625,7 +708,8 @@ def summarize(path: str, process_index: int | None = None,
         manifest = run_starts[-1].get("manifest", {})
         summary["run_id"] = run_starts[-1]["run"]
         for key in ("git_sha", "device_kind", "device_platform",
-                    "device_count", "process_count", "config_hash"):
+                    "device_count", "process_count", "config_hash",
+                    "mode"):
             if key in manifest:
                 summary[key] = manifest[key]
     if run_starts and run_ends:
@@ -693,6 +777,13 @@ def summarize(path: str, process_index: int | None = None,
     sched = scheduler_rollup(events)
     if sched is not None:
         summary["scheduler"] = sched
+
+    # streaming control plane (dib_tpu/stream): publish/deploy/drift
+    # events are global for the same reason — a supervised trainer's
+    # relaunches and its supervisor share one stream
+    streaming = streaming_rollup(events)
+    if streaming is not None:
+        summary["streaming"] = streaming
 
     if compiles:
         by_cache: dict[str, int] = {}

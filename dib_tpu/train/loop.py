@@ -178,16 +178,21 @@ class DIBTrainer:
         return loss, {"task": task, "kl": kl_per_feature, "metric": metric}
 
     # ------------------------------------------------------------ epoch scan
-    def _epoch_batches(self, key: Array) -> tuple[Array, Array]:
+    def _epoch_batches(self, key: Array, data=None) -> tuple[Array, Array]:
         """The epoch's permutation-gathered batch buffers, from its epoch
         key (same derivation ``_epoch_body`` uses inline, so prefetched and
         inline epochs are bit-identical): ONE gather of
         ``steps_per_epoch x batch_size`` rows, fed through the step scan's
         xs. The prefetching chunk scan calls this with epoch e+1's key
         DURING epoch e (docs/performance.md, "Prefetching epoch
-        pipeline")."""
+        pipeline"). ``data`` optionally overrides the resident
+        ``(x_train, y_train)`` with traced arrays — the streaming path
+        (``run_stream_chunk``) feeds the current window as real jit
+        ARGUMENTS instead of baked constants."""
         cfg = self.config
-        n = self._x_train.shape[0]
+        x_train, y_train = (self._x_train, self._y_train) if data is None \
+            else data
+        n = x_train.shape[0]
         total = self.steps_per_epoch * cfg.batch_size
         # derived from the epoch key, independent of the step/val keys
         k_perm = jax.random.fold_in(key, 1)
@@ -196,23 +201,26 @@ class DIBTrainer:
             for i in range(-(-total // n))
         ]
         idx = jnp.concatenate(perms)[:total]
-        x_epoch = self._x_train[idx].reshape(
-            self.steps_per_epoch, cfg.batch_size, *self._x_train.shape[1:]
+        x_epoch = x_train[idx].reshape(
+            self.steps_per_epoch, cfg.batch_size, *x_train.shape[1:]
         )
-        y_epoch = self._y_train[idx].reshape(
-            self.steps_per_epoch, cfg.batch_size, *self._y_train.shape[1:]
+        y_epoch = y_train[idx].reshape(
+            self.steps_per_epoch, cfg.batch_size, *y_train.shape[1:]
         )
         return x_epoch, y_epoch
 
     def _epoch_body(
         self, state: TrainState, key: Array, beta_endpoints=None,
-        batches: tuple[Array, Array] | None = None,
+        batches: tuple[Array, Array] | None = None, data=None,
     ) -> tuple[TrainState, dict]:
         """One epoch. ``beta_endpoints`` optionally overrides the config's
         static (beta_start, beta_end) with traced values — the sweep trainer
         vmaps this body over a grid of endpoints. ``batches`` optionally
         supplies pre-staged permutation buffers (``_epoch_batches``) so the
-        gather can run ahead of the epoch boundary."""
+        gather can run ahead of the epoch boundary. ``data`` optionally
+        overrides the resident ``(x_train, y_train)`` with traced arrays
+        (the streaming window path, ``run_stream_chunk``); validation stays
+        on the bundle's held-out split either way."""
         cfg = self.config
         b0, b1 = (
             (cfg.beta_start, cfg.beta_end) if beta_endpoints is None else beta_endpoints
@@ -221,7 +229,9 @@ class DIBTrainer:
             state.epoch, b0, b1,
             cfg.num_annealing_epochs, cfg.num_pretraining_epochs,
         )
-        n = self._x_train.shape[0]
+        x_train, y_train = (self._x_train, self._y_train) if data is None \
+            else data
+        n = x_train.shape[0]
         grad_fn = jax.value_and_grad(self._forward_loss, has_aux=True)
 
         def train_step(params, opt_state, x_b, y_b, k_noise):
@@ -243,7 +253,8 @@ class DIBTrainer:
             # ``batches`` carries the pre-staged buffers when the chunk scan
             # prefetches (run_chunk); inline otherwise.
             x_epoch, y_epoch = (
-                self._epoch_batches(key) if batches is None else batches
+                self._epoch_batches(key, data=data)
+                if batches is None else batches
             )
 
             def step_body(carry, xs):
@@ -266,7 +277,7 @@ class DIBTrainer:
                 k_batch, k_noise = jax.random.split(k)
                 idx = jax.random.randint(k_batch, (cfg.batch_size,), 0, n)
                 params, opt_state, stats = train_step(
-                    params, opt_state, self._x_train[idx], self._y_train[idx], k_noise
+                    params, opt_state, x_train[idx], y_train[idx], k_noise
                 )
                 return (params, opt_state), stats
 
@@ -325,7 +336,16 @@ class DIBTrainer:
         serializing it at the epoch boundary. Same keys, same gather —
         bit-identical to the inline path — at the cost of a second epoch
         buffer and one dead gather on the chunk's last epoch."""
-        keys = jax.random.split(key, num_epochs)
+        return self._scan_epochs(state, history,
+                                 jax.random.split(key, num_epochs))
+
+    def _scan_epochs(self, state: TrainState, history: dict, keys: Array,
+                     data=None):
+        """The shared epoch-scan body of ``run_chunk`` /
+        ``run_stream_chunk`` (one traced implementation, so the
+        prefetched-vs-inline bit-identity invariant has a single site).
+        ``data`` optionally overrides the resident training arrays with
+        traced ones (the streaming window path)."""
         if (self.config.batch_sampling == "permutation"
                 and self.config.prefetch_epochs):
 
@@ -335,15 +355,16 @@ class DIBTrainer:
                 # pre-stage the NEXT epoch's buffers before this epoch's
                 # step scan consumes `staged` — no data dependency, so the
                 # gather overlaps the steps
-                staged_next = self._epoch_batches(k_next)
-                state, row = self._epoch_body(state, k, batches=staged)
+                staged_next = self._epoch_batches(k_next, data=data)
+                state, row = self._epoch_body(state, k, batches=staged,
+                                              data=data)
                 history = history_record(history, row)
                 return (state, history, staged_next), None
 
             # epoch e prefetches e+1; the final epoch's prefetch re-gathers
             # epoch 0's buffers (dead work, sliced off by the carry drop)
             next_keys = jnp.concatenate([keys[1:], keys[:1]])
-            staged0 = self._epoch_batches(keys[0])
+            staged0 = self._epoch_batches(keys[0], data=data)
             (state, history, _), _ = jax.lax.scan(
                 body, (state, history, staged0), (keys, next_keys)
             )
@@ -351,12 +372,39 @@ class DIBTrainer:
 
         def body(carry, k):
             state, history = carry
-            state, row = self._epoch_body(state, k)
+            state, row = self._epoch_body(state, k, data=data)
             history = history_record(history, row)
             return (state, history), None
 
         (state, history), _ = jax.lax.scan(body, (state, history), keys)
         return state, history
+
+    @partial(
+        jax.jit,
+        static_argnames=("self", "num_epochs"),
+        donate_argnames=("state", "history"),
+    )
+    def run_stream_chunk(
+        self, state: TrainState, history: dict, key: Array,
+        x_train: Array, y_train: Array, num_epochs: int,
+    ):
+        """``run_chunk`` over a STREAMING window: the training data arrives
+        as real jit arguments instead of the resident closed-over arrays.
+
+        ``run_chunk`` is jitted with ``self`` static, so ``self._x_train``
+        is baked into the executable as a CONSTANT — an online trainer
+        that mutated the attribute between windows would keep training on
+        the stale first window through the jit cache. Here the window is
+        an argument: one compile serves every window of the same shape
+        (the always-on loop's hot path, ``dib_tpu/stream/online.py``).
+        Validation stays on the bundle's fixed held-out split, so val_loss
+        is comparable across windows — under drift it is exactly the
+        signal that decays. Buffers donate like ``run_chunk``'s; callers
+        rebind ``state, history = run_stream_chunk(state, history, ...)``.
+        """
+        return self._scan_epochs(state, history,
+                                 jax.random.split(key, num_epochs),
+                                 data=(x_train, y_train))
 
     # ------------------------------------------------------------------ fit
     def fit(

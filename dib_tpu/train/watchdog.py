@@ -316,6 +316,8 @@ def supervise_pool(
     log=lambda msg: print(msg, file=sys.stderr, flush=True),
     telemetry=None,
     journal_path: str | None = None,
+    terminal_kinds: Sequence[str] = ("done", "fail", "job_done",
+                                     "job_failed"),
 ) -> dict:
     """Run a scheduler worker-pool command under crash/preemption
     supervision until it exits 0 (docs/robustness.md "Sweep as a
@@ -338,22 +340,29 @@ def supervise_pool(
 
     ``telemetry`` mirrors every mitigation onto the run's event stream
     as it happens, exactly like :func:`supervise`.
+
+    ``terminal_kinds`` names the journal record kinds that count as
+    progress — the scheduler's unit/job terminals by default; the
+    streaming control plane supervises its trainer on ``("publish",)``
+    and its deployer on ``("deploy",)`` (``dib_tpu/stream/cli.py``),
+    because those are the records that only land when a whole unit of
+    work actually finished.
     """
     cfg = config or WatchdogConfig()
     mitigations = _mirrored_mitigations(telemetry, log)
+    terminal = tuple(terminal_kinds)
     t_start = time.time()
 
     def _journal_terminal_count() -> int:
-        """Terminal unit/job transitions in the journal — the progress
-        signal. Lease/renew/release records don't count: a flapping
-        preemption appends those every cycle without finishing a thing."""
+        """Terminal transitions in the journal — the progress signal.
+        Lease/renew/release records don't count: a flapping preemption
+        appends those every cycle without finishing a thing."""
         if not journal_path:
             return -1
         from dib_tpu.sched.journal import read_journal
 
         records, _ = read_journal(journal_path)
-        return sum(r.get("kind") in ("done", "fail", "job_done",
-                                     "job_failed") for r in records)
+        return sum(r.get("kind") in terminal for r in records)
 
     launches = 0
     quick_failures = 0

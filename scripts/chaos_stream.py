@@ -1,0 +1,804 @@
+"""Streaming chaos suite: the COMBINED train-and-serve loop under faults.
+
+``scripts/chaos_suite.py`` proves the scheduling layer; this suite
+proves the always-on control plane (``dib_tpu/stream``,
+docs/streaming.md) keeps its three invariants while faults land on a
+LIVE train→publish→hot-swap→serve loop:
+
+  - **zero lost publishes** — every durable publish record gets exactly
+    one deployer decision; none is skipped past;
+  - **no double promotion** — a publish is never promoted twice (the
+    deploy journal is the exactly-once ledger across SIGKILL+restart);
+  - **single-checkpoint responses** — every served response is
+    numerically the output of exactly ONE published checkpoint, never a
+    params/cache hybrid (the reload-invalidation contract under load).
+
+Drills:
+
+  - ``clean_loop``       — the full CLI loop, no faults: ``stream run``
+    and ``stream deploy`` as separate processes sharing only the publish
+    journal, live HTTP traffic riding a hot swap, ``telemetry check``
+    green against the committed SLO.json;
+  - ``mid_publish_kill`` — the trainer process is SIGKILL-shaped-killed
+    MID-PUBLISH (after fsync, before rename): the staging litter is
+    never promotable (no journal record references it), the relaunch
+    resumes bit-identically from the last durable publish and
+    republishes;
+  - ``deployer_kill``    — the deployer process dies between a publish
+    and its reload: the restart catches up through the deploy journal,
+    promoting each pending publish exactly once;
+  - ``reload_storm``     — hot swaps racing a cache-hot multi-tenant
+    request storm over the real asyncio server: every response matches
+    exactly one published checkpoint;
+  - ``canary_rollback``  — a poisoned (NaN-params) checkpoint is
+    published: the canary gate rolls the promotion back and the previous
+    checkpoint keeps answering.
+
+Every injection lands as a durable ``fault`` event and every recovery as
+a ``mitigation``/``publish``/``deploy`` event, so ``telemetry
+summarize`` reproduces injected/detected/recovered independently of this
+script. The committed record is ``CHAOS_STREAM.json`` (validated
+per-row by ``scripts/check_run_artifacts.py``).
+
+Usage::
+
+    python scripts/chaos_stream.py --out CHAOS_STREAM.json   # full
+    python scripts/chaos_stream.py --quick                   # in-process
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+METRIC = "chaos_stream_matrix"
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: Tiny always-on spec: 2-epoch chunks over a 64-row sliding window of
+#: the boolean-circuit stream — enough rounds to publish, kill, resume,
+#: and swap against.
+WINDOW, STRIDE, CHUNK_EPOCHS, BATCH = 64, 16, 2, 32
+PRE_EPOCHS, ANNEAL_EPOCHS = 2, 4
+
+#: One flag surface for every process in a drill (trainer, deployer,
+#: in-process template) — architecture drift between them would trip the
+#: checkpoint integrity manifest, which is exactly the point.
+MODEL_FLAGS = [
+    "--dataset", "boolean_circuit",
+    "--feature_embedding_dimension", "2",
+    "--feature_encoder_architecture", "8",
+    "--integration_network_architecture", "16",
+]
+TRAIN_FLAGS = [
+    "--batch_size", str(BATCH),
+    "--number_pretraining_epochs", str(PRE_EPOCHS),
+    "--number_annealing_epochs", str(ANNEAL_EPOCHS),
+]
+STREAM_FLAGS = [
+    "--window", str(WINDOW), "--stride", str(STRIDE),
+    "--chunk-epochs", str(CHUNK_EPOCHS),
+]
+
+
+def _worker_env(**extra) -> dict:
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.pop("DIB_STREAM_FAULT", None)
+    env.pop("DIB_RUNS_ROOT", None)   # drills must not grow the registry
+    env.update(extra)
+    return env
+
+
+def _trainer_cmd(stream_dir: str, rounds: int, publish_every: int = 1):
+    return [sys.executable, "-m", "dib_tpu", "stream", "run",
+            "--stream-dir", stream_dir, *MODEL_FLAGS, *TRAIN_FLAGS,
+            *STREAM_FLAGS, "--publish-every", str(publish_every),
+            "--rounds", str(rounds), "--seed", "0"]
+
+
+def _deployer_cmd(stream_dir: str, deploy_dir: str, serve_seconds: float,
+                  wait_first_s: float = 300.0):
+    return [sys.executable, "-m", "dib_tpu", "stream", "deploy",
+            "--stream-dir", stream_dir, "--deploy-dir", deploy_dir,
+            *MODEL_FLAGS, *TRAIN_FLAGS,
+            "--serve_seconds", str(serve_seconds),
+            "--wait-first-s", str(wait_first_s),
+            "--poll-s", "0.25", "--port", "0"]
+
+
+# --------------------------------------------------------- in-proc stack
+def _model_args():
+    """The MODEL_FLAGS surface parsed exactly as the CLI parses it, so
+    in-process templates are architecture-identical to the subprocess
+    runs' checkpoints."""
+    from dib_tpu.cli import _add_model_flags
+
+    parser = argparse.ArgumentParser()
+    _add_model_flags(parser)
+    return parser.parse_args(MODEL_FLAGS)
+
+
+def _stack():
+    """(bundle, model, train_config) for the drill spec."""
+    from dib_tpu.cli import _bundle_from_args, _model_from_args
+    from dib_tpu.train import TrainConfig
+
+    args = _model_args()
+    bundle = _bundle_from_args(args)
+    model, _ = _model_from_args(args, bundle)
+    config = TrainConfig(batch_size=BATCH,
+                         num_pretraining_epochs=PRE_EPOCHS,
+                         num_annealing_epochs=ANNEAL_EPOCHS)
+    return bundle, model, config
+
+
+def _template():
+    """A fresh restore-template trainer (architecture == MODEL_FLAGS)."""
+    from dib_tpu.train import DIBTrainer
+
+    bundle, model, config = _stack()
+    return DIBTrainer(model, bundle, config)
+
+
+def _run_trainer_inproc(stream_dir: str, rounds: int,
+                        publish_every: int = 1, telemetry=None) -> dict:
+    import jax
+
+    from dib_tpu.stream.online import OnlineConfig, OnlineDIBTrainer
+
+    bundle, model, config = _stack()
+    online = OnlineConfig(window=WINDOW, stride=STRIDE,
+                          chunk_epochs=CHUNK_EPOCHS,
+                          publish_every=publish_every, rounds=rounds,
+                          seed=0)
+    trainer = OnlineDIBTrainer(model, bundle, config, online, stream_dir,
+                               telemetry=telemetry)
+    return trainer.run(jax.random.key(0))
+
+
+def _probe_rows():
+    import numpy as np
+
+    bundle, _, _ = _stack()
+    return np.asarray(bundle.x_valid[:4], np.float32)
+
+
+def _expected_outputs(stream_dir: str, rows) -> dict:
+    """{publish_id: [B, out] prediction} per durable publish record —
+    the candidate set every served response must match exactly one of."""
+    import numpy as np
+
+    from dib_tpu.serve import InferenceEngine
+    from dib_tpu.stream.online import read_publishes
+    from dib_tpu.train import DIBCheckpointer
+
+    out = {}
+    records, _ = read_publishes(stream_dir)
+    for rec in records:
+        trainer = _template()
+        ckpt = DIBCheckpointer(os.path.join(stream_dir, rec["path"]))
+        try:
+            state, _, _ = ckpt.restore(trainer)
+        except Exception:
+            out[rec["publish_id"]] = None   # poisoned/unrestorable
+            continue
+        finally:
+            ckpt.close()
+        engine = InferenceEngine(trainer.model, state.params["model"],
+                                 batch_buckets=(1, 8))
+        prediction = np.asarray(engine.predict(rows)["prediction"])
+        out[rec["publish_id"]] = (None if not np.all(np.isfinite(prediction))
+                                  else prediction)
+    return out
+
+
+def _match_counts(responses, candidates) -> dict:
+    """Join each response against the candidate set: a response must
+    equal exactly one candidate (rtol guards float64 JSON round-trips;
+    checkpoints differ by whole training rounds, so cross-matching two
+    candidates would mean the trainer stopped learning, which the loss
+    series refutes)."""
+    import numpy as np
+
+    per_candidate = {pid: 0 for pid in candidates}
+    mismatched = 0
+    multi = 0
+    for resp in responses:
+        got = np.asarray(resp)
+        hits = [pid for pid, cand in candidates.items()
+                if cand is not None and cand.shape == got.shape
+                and np.allclose(got, cand, rtol=1e-6, atol=1e-8)]
+        if len(hits) == 1:
+            per_candidate[hits[0]] += 1
+        elif not hits:
+            mismatched += 1
+        else:
+            multi += 1
+    return {"responses": len(responses), "per_candidate": per_candidate,
+            "mismatched": mismatched, "ambiguous": multi}
+
+
+def _invariants(stream_dir: str, deploy_dir: str) -> dict:
+    from dib_tpu.stream.deployer import stream_status
+
+    status = stream_status(stream_dir, deploy_dir)
+    return {
+        "status": status,
+        "zero_lost_publishes": (status["lost_publishes"] == 0
+                                and status["pending"] == 0),
+        "no_double_promotion": status["double_promotions"] == 0,
+    }
+
+
+def _stream_evidence(run_dir: str) -> dict:
+    from dib_tpu.telemetry import summarize
+
+    summary = summarize(run_dir)
+    return {
+        "faults": summary.get("faults"),
+        "streaming": summary.get("streaming"),
+        "mitigations": summary.get("mitigations"),
+        "status": summary.get("status"),
+    }
+
+
+def _drill_record(name: str, kind: str, ok: bool, **details) -> dict:
+    return {"drill": name, "kind": kind, "ok": bool(ok), **details}
+
+
+def _post(url: str, payload: dict, timeout: float = 10.0) -> dict:
+    req = urllib.request.Request(
+        url + "/v1/predict",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _open_writer(run_dir: str):
+    from dib_tpu.telemetry import EventWriter, runtime_manifest
+
+    writer = EventWriter(run_dir)
+    writer.run_start(runtime_manifest(extra={"mode": "chaos_stream"}))
+    return writer
+
+
+def _read_hello(proc) -> dict:
+    """The CLI's machine-readable serving line (skipping any warning
+    lines a dependency printed to stdout first)."""
+    while True:
+        line = proc.stdout.readline()
+        if not line:
+            raise RuntimeError("deployer exited before its serving line")
+        try:
+            payload = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(payload, dict) and "serving" in payload:
+            return payload
+
+
+# ------------------------------------------------------------ the drills
+def run_clean_loop_drill(workdir: str, log) -> dict:
+    """Full-CLI always-on loop with live traffic riding a hot swap.
+
+    The trainer runs as TWO sequenced ``stream run`` invocations (the
+    second resumes from the publish journal), so live traffic
+    deterministically lands on the first checkpoint BEFORE the second
+    publish exists, then rides the hot swap onto it — the ordering a
+    free-running race only gives by luck on a contended box."""
+    import numpy as np
+
+    t0 = time.time()
+    stream_dir = os.path.join(workdir, "clean", "stream")
+    deploy_dir = os.path.join(workdir, "clean", "deploy")
+    os.makedirs(stream_dir, exist_ok=True)
+    log("clean_loop: first trainer leg (one publish), then the fleet")
+    first_leg = subprocess.run(
+        _trainer_cmd(stream_dir, rounds=2, publish_every=2),
+        env=_worker_env(), capture_output=True, text=True)
+    deployer = subprocess.Popen(
+        _deployer_cmd(stream_dir, deploy_dir, serve_seconds=0),
+        env=_worker_env(), stdout=subprocess.PIPE, text=True)
+    responses = []
+    trainer_rc = first_leg.returncode
+    try:
+        hello = _read_hello(deployer)
+        url = hello["serving"]
+        log(f"clean_loop: fleet up at {url}; traffic on checkpoint one")
+        rows = _probe_rows()
+        row = [float(v) for v in rows[0]]
+        from dib_tpu.stream.deployer import read_deploys
+
+        deadline = time.time() + 300
+        while len(responses) < 3 and time.time() < deadline:
+            try:
+                payload = _post(url, {"x": row, "tenant": "t0"})
+                responses.append(payload["prediction"])
+            except Exception:   # lint-ok(exception-hygiene): open-loop client; the response-count assertion below catches a dead fleet
+                pass
+            time.sleep(0.02)
+        log("clean_loop: second trainer leg resumes; traffic rides the "
+            "hot swap")
+        second_leg = subprocess.Popen(
+            _trainer_cmd(stream_dir, rounds=4, publish_every=2),
+            env=_worker_env(), stdout=subprocess.PIPE, text=True)
+        swapped = 0
+        while time.time() < deadline:
+            try:
+                payload = _post(url, {"x": row, "tenant": "t0"})
+                responses.append(payload["prediction"])
+            except Exception:   # lint-ok(exception-hygiene): open-loop client; the response-count assertion below catches a dead fleet
+                pass
+            deploys, _ = read_deploys(deploy_dir)
+            swapped = sum(r.get("action") == "promoted" for r in deploys)
+            if swapped >= 2 and second_leg.poll() is not None:
+                break
+            time.sleep(0.02)
+        if second_leg.poll() is None:
+            second_leg.kill()
+        second_leg.wait()
+        trainer_rc = trainer_rc or second_leg.returncode
+        # a few more requests against the final checkpoint
+        for _ in range(5):
+            responses.append(_post(url, {"x": row, "tenant": "t1"})
+                             ["prediction"])
+    finally:
+        deployer.send_signal(signal.SIGTERM)
+        try:
+            deployer.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            deployer.kill()
+            deployer.wait()
+    candidates = {pid: (None if cand is None else cand[:1])
+                  for pid, cand in
+                  _expected_outputs(stream_dir, _probe_rows()).items()}
+    match = _match_counts(responses, candidates)
+    rode_the_swap = sum(1 for n in match["per_candidate"].values()
+                        if n > 0) >= 2
+    check = subprocess.run(
+        [sys.executable, "-m", "dib_tpu", "telemetry", "check",
+         deploy_dir],
+        cwd=REPO, env=_worker_env(), capture_output=True, text=True)
+    inv = _invariants(stream_dir, deploy_dir)
+    single = match["mismatched"] == 0 and match["ambiguous"] == 0 \
+        and match["responses"] > 0
+    ok = (trainer_rc == 0 and inv["zero_lost_publishes"]
+          and inv["no_double_promotion"] and single and rode_the_swap
+          and check.returncode == 0)
+    detail = {
+        "zero_lost_publishes": inv["zero_lost_publishes"],
+        "no_double_promotion": inv["no_double_promotion"],
+        "single_checkpoint_responses": single,
+        "rode_the_swap": rode_the_swap,
+        "slo_check_rc": check.returncode,
+        "traffic": match,
+        "wall_s": round(time.time() - t0, 1),
+        "evidence": {
+            "trainer": _stream_evidence(stream_dir),
+            "deployer": _stream_evidence(deploy_dir),
+            "status": inv["status"],
+        },
+    }
+    return _drill_record("clean_loop", "stream_clean_loop", ok, **detail)
+
+
+def run_mid_publish_kill_drill(workdir: str, log) -> dict:
+    """Trainer killed mid-publish: staging litter never promoted, the
+    relaunch resumes from the last durable publish."""
+    t0 = time.time()
+    stream_dir = os.path.join(workdir, "midkill", "stream")
+    deploy_dir = os.path.join(workdir, "midkill", "deploy")
+    os.makedirs(stream_dir, exist_ok=True)
+    log("mid_publish_kill: trainer with a scheduled mid-publish kill")
+    first = subprocess.run(
+        _trainer_cmd(stream_dir, rounds=4),
+        env=_worker_env(DIB_STREAM_FAULT="mid_publish:1"),
+        capture_output=True, text=True)
+    staging = os.path.join(stream_dir, "staging")
+    torn_staging = bool(os.path.isdir(staging) and os.listdir(staging))
+    from dib_tpu.stream.online import read_publishes
+
+    publishes_before = len(read_publishes(stream_dir)[0])
+    log(f"mid_publish_kill: killed rc={first.returncode} "
+        f"(staging litter: {torn_staging}); relaunching")
+    second = subprocess.run(_trainer_cmd(stream_dir, rounds=4),
+                            env=_worker_env(), capture_output=True,
+                            text=True)
+    records, _ = read_publishes(stream_dir)
+    indices = [r.get("index") for r in records]
+    # deploy + serve the published history in-process
+    from dib_tpu.serve import DIBServer, ModelZoo
+    from dib_tpu.stream.deployer import Deployer
+
+    writer = _open_writer(deploy_dir)
+    zoo = ModelZoo(exec_capacity=16, response_capacity=32,
+                   telemetry=writer)
+    deployer = Deployer(stream_dir, deploy_dir, _template(), zoo,
+                        telemetry=writer,
+                        router_kwargs=dict(batch_buckets=(1, 8)))
+    processed = deployer.catch_up()
+    rows = _probe_rows()
+    server = DIBServer(zoo)
+    status, payload = server.handle_post(
+        "/v1/predict", {"x": [[float(v) for v in r] for r in rows]})
+    deployer.close()
+    server.close()   # never started: releases the socket, closes the zoo
+    writer.run_end(status="ok")
+    writer.close()
+    candidates = _expected_outputs(stream_dir, rows)
+    match = _match_counts([payload.get("prediction")], candidates)
+    inv = _invariants(stream_dir, deploy_dir)
+    single = (status == 200 and match["mismatched"] == 0
+              and match["ambiguous"] == 0)
+    ok = (first.returncode == 137 and torn_staging
+          and second.returncode == 0
+          and publishes_before == 1
+          and indices == sorted(set(indices))
+          and inv["zero_lost_publishes"] and inv["no_double_promotion"]
+          and single and processed == len(records))
+    return _drill_record(
+        "mid_publish_kill", "stream_mid_publish_kill", ok,
+        zero_lost_publishes=inv["zero_lost_publishes"],
+        no_double_promotion=inv["no_double_promotion"],
+        single_checkpoint_responses=single,
+        kill_rc=first.returncode, torn_staging=torn_staging,
+        publishes_at_kill=publishes_before, publishes_final=len(records),
+        wall_s=round(time.time() - t0, 1),
+        evidence={"trainer": _stream_evidence(stream_dir),
+                  "deployer": _stream_evidence(deploy_dir),
+                  "status": inv["status"]})
+
+
+def run_deployer_kill_drill(workdir: str, log) -> dict:
+    """Deployer SIGKILLed between publish and reload: restart catches up
+    exactly once per publish."""
+    t0 = time.time()
+    stream_dir = os.path.join(workdir, "depkill", "stream")
+    deploy_dir = os.path.join(workdir, "depkill", "deploy")
+    log("deployer_kill: training 3 publishes in-process")
+    _run_trainer_inproc(stream_dir, rounds=3)
+    log("deployer_kill: deployer with a scheduled tail kill")
+    first = subprocess.run(
+        _deployer_cmd(stream_dir, deploy_dir, serve_seconds=2,
+                      wait_first_s=10),
+        env=_worker_env(DIB_STREAM_FAULT="deployer_tail:0"),
+        capture_output=True, text=True)
+    from dib_tpu.stream.deployer import read_deploys
+
+    after_kill = len(read_deploys(deploy_dir)[0])
+    log(f"deployer_kill: killed rc={first.returncode} "
+        f"({after_kill} decided); relaunching")
+    responses = []
+    second = subprocess.Popen(
+        _deployer_cmd(stream_dir, deploy_dir, serve_seconds=8,
+                      wait_first_s=10),
+        env=_worker_env(), stdout=subprocess.PIPE, text=True)
+    try:
+        hello = _read_hello(second)
+        url = hello["serving"]
+        rows = _probe_rows()
+        row = [float(v) for v in rows[0]]
+        for _ in range(20):
+            try:
+                responses.append(_post(url, {"x": row})["prediction"])
+            except Exception:   # lint-ok(exception-hygiene): open-loop client; the response-count assertion below catches a dead fleet
+                pass
+            time.sleep(0.05)
+    finally:
+        second.wait(timeout=60)
+    deploys, _ = read_deploys(deploy_dir)
+    candidates = {pid: (None if cand is None else cand[:1])
+                  for pid, cand in
+                  _expected_outputs(stream_dir, _probe_rows()).items()}
+    match = _match_counts(responses, candidates)
+    inv = _invariants(stream_dir, deploy_dir)
+    single = (match["mismatched"] == 0 and match["ambiguous"] == 0
+              and match["responses"] > 0)
+    ok = (first.returncode == 137 and after_kill == 1
+          and second.returncode == 0 and len(deploys) == 3
+          and inv["zero_lost_publishes"] and inv["no_double_promotion"]
+          and single)
+    return _drill_record(
+        "deployer_kill", "stream_deployer_kill", ok,
+        zero_lost_publishes=inv["zero_lost_publishes"],
+        no_double_promotion=inv["no_double_promotion"],
+        single_checkpoint_responses=single,
+        kill_rc=first.returncode, decided_at_kill=after_kill,
+        decided_final=len(deploys), traffic=match,
+        wall_s=round(time.time() - t0, 1),
+        evidence={"deployer": _stream_evidence(deploy_dir),
+                  "status": inv["status"]})
+
+
+def run_reload_storm_drill(workdir: str, log) -> dict:
+    """Hot swaps racing a cache-hot tenant storm over the real asyncio
+    server: every response from exactly one published checkpoint."""
+    import numpy as np
+
+    t0 = time.time()
+    stream_dir = os.path.join(workdir, "storm", "stream")
+    deploy_dir = os.path.join(workdir, "storm", "deploy")
+    log("reload_storm: first publish")
+    _run_trainer_inproc(stream_dir, rounds=1)
+
+    from dib_tpu.serve import DIBServer, ModelZoo
+    from dib_tpu.stream.deployer import Deployer
+    from dib_tpu.telemetry import MetricsRegistry
+
+    writer = _open_writer(deploy_dir)
+    registry = MetricsRegistry()
+    zoo = ModelZoo(exec_capacity=16, response_capacity=64,
+                   telemetry=writer, registry=registry)
+    deployer = Deployer(stream_dir, deploy_dir, _template(), zoo,
+                        telemetry=writer, registry=registry,
+                        router_kwargs=dict(batch_buckets=(1, 8)))
+    deployer.catch_up()
+    server = DIBServer(zoo, telemetry=writer, registry=registry)
+    server.start()
+    rows = _probe_rows()[:2]
+    storm_rows = [[float(v) for v in r] for r in rows]
+    responses: list[tuple[int, list]] = []
+    resp_lock = threading.Lock()
+    stop = threading.Event()
+
+    def storm(tenant: str, which: int):
+        while not stop.is_set():
+            try:
+                payload = _post(server.url,
+                                {"x": storm_rows[which],
+                                 "tenant": tenant}, timeout=5)
+                with resp_lock:
+                    responses.append((which, payload["prediction"]))
+            except Exception:   # lint-ok(exception-hygiene): storm client; the response-count assertion below catches a dead fleet
+                pass
+
+    threads = [threading.Thread(target=storm, args=(f"t{i}", i % 2))
+               for i in range(6)]
+    for t in threads:
+        t.start()
+    try:
+        log("reload_storm: storming through two hot swaps")
+        for rounds in (2, 3):
+            time.sleep(1.0)
+            _run_trainer_inproc(stream_dir, rounds=rounds)
+            deployer.catch_up()
+        time.sleep(1.0)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        deployer.close()
+        # writes the final metrics rollup (cache counters) + run_end and
+        # closes the writer; also closes the zoo
+        server.close()
+    expected = _expected_outputs(stream_dir, np.asarray(rows))
+    per_row_candidates = [
+        {pid: (None if cand is None else cand[which:which + 1])
+         for pid, cand in expected.items()}
+        for which in (0, 1)
+    ]
+    match0 = _match_counts([p for w, p in responses if w == 0],
+                           per_row_candidates[0])
+    match1 = _match_counts([p for w, p in responses if w == 1],
+                           per_row_candidates[1])
+    counters = registry.snapshot()["counters"]
+    cache_hits = counters.get("serve.cache.response.hits", 0)
+    invalidations = counters.get("serve.cache.response.invalidations", 0)
+    inv = _invariants(stream_dir, deploy_dir)
+    total = match0["responses"] + match1["responses"]
+    single = (match0["mismatched"] + match1["mismatched"] == 0
+              and match0["ambiguous"] + match1["ambiguous"] == 0
+              and total > 0)
+    status = deployer.status()
+    ok = (single and status["promoted"] == 3 and cache_hits > 0
+          and invalidations >= 2 and inv["zero_lost_publishes"]
+          and inv["no_double_promotion"])
+    return _drill_record(
+        "reload_storm", "stream_reload_storm", ok,
+        zero_lost_publishes=inv["zero_lost_publishes"],
+        no_double_promotion=inv["no_double_promotion"],
+        single_checkpoint_responses=single,
+        responses=total, cache_hits=int(cache_hits),
+        cache_invalidations=int(invalidations),
+        promoted=status["promoted"],
+        traffic={"row0": match0, "row1": match1},
+        wall_s=round(time.time() - t0, 1),
+        evidence={"deployer": _stream_evidence(deploy_dir),
+                  "status": inv["status"]})
+
+
+def run_canary_rollback_drill(workdir: str, log) -> dict:
+    """A poisoned published checkpoint is rolled back by the canary gate
+    while the previous checkpoint keeps answering."""
+    import numpy as np
+
+    t0 = time.time()
+    stream_dir = os.path.join(workdir, "canary", "stream")
+    deploy_dir = os.path.join(workdir, "canary", "deploy")
+    log("canary_rollback: two good publishes + one poisoned")
+    _run_trainer_inproc(stream_dir, rounds=2)
+    _publish_poison(stream_dir)
+
+    from dib_tpu.serve import DIBServer, ModelZoo
+    from dib_tpu.stream.deployer import Deployer
+    from dib_tpu.stream.online import read_publishes
+
+    writer = _open_writer(deploy_dir)
+    writer.fault(kind="stream_poison", detail="pub-poison")
+    zoo = ModelZoo(exec_capacity=16, response_capacity=32,
+                   telemetry=writer)
+    deployer = Deployer(stream_dir, deploy_dir, _template(), zoo,
+                        telemetry=writer,
+                        router_kwargs=dict(batch_buckets=(1, 8)))
+    deployer.catch_up()
+    rows = _probe_rows()
+    server = DIBServer(zoo)
+    status_code, payload = server.handle_post(
+        "/v1/predict", {"x": [[float(v) for v in r] for r in rows]})
+    status = deployer.status()
+    deployer.close()
+    server.close()   # never started: releases the socket, closes the zoo
+    writer.run_end(status="ok")
+    writer.close()
+    records, _ = read_publishes(stream_dir)
+    candidates = _expected_outputs(stream_dir, rows)
+    # the poisoned candidate is None (non-finite) — the response must
+    # match exactly one REAL candidate, and that one must be the LAST
+    # good publish (the fleet kept answering from it)
+    last_good = [r["publish_id"] for r in records
+                 if candidates.get(r["publish_id"]) is not None][-1]
+    match = _match_counts([payload.get("prediction")], candidates)
+    inv = _invariants(stream_dir, deploy_dir)
+    single = (status_code == 200 and match["mismatched"] == 0
+              and match["ambiguous"] == 0)
+    served_previous = match["per_candidate"].get(last_good, 0) == 1
+    ok = (status["rollbacks"] == 1 and status["promoted"] == 2
+          and single and served_previous
+          and inv["zero_lost_publishes"] and inv["no_double_promotion"])
+    return _drill_record(
+        "canary_rollback", "stream_poison", ok,
+        zero_lost_publishes=inv["zero_lost_publishes"],
+        no_double_promotion=inv["no_double_promotion"],
+        single_checkpoint_responses=single,
+        served_previous_checkpoint=served_previous,
+        rollbacks=status["rollbacks"], promoted=status["promoted"],
+        wall_s=round(time.time() - t0, 1),
+        evidence={"deployer": _stream_evidence(deploy_dir),
+                  "status": inv["status"]})
+
+
+def _publish_poison(stream_dir: str) -> None:
+    """Publish a NaN-params checkpoint through the REAL protocol (stage,
+    fsync, rename, journal) — the shape of a trainer whose model
+    diverged between the divergence guard's boundaries."""
+    import jax
+    import jax.numpy as jnp
+
+    from dib_tpu.sched.journal import JobJournal
+    from dib_tpu.stream.online import (
+        CHECKPOINTS_DIRNAME,
+        PUBLISHES_FILENAME,
+        STAGING_DIRNAME,
+        _fsync_tree,
+        read_publishes,
+    )
+    from dib_tpu.train import DIBCheckpointer
+
+    records, _ = read_publishes(stream_dir)
+    last = records[-1]
+    trainer = _template()
+    ckpt = DIBCheckpointer(os.path.join(stream_dir, last["path"]))
+    try:
+        state, history, key = ckpt.restore(trainer)
+    finally:
+        ckpt.close()
+    poisoned = state._replace(
+        params=jax.tree.map(lambda a: jnp.full_like(a, jnp.nan),
+                            state.params))
+    step = int(last["step"]) + CHUNK_EPOCHS
+    pub_id = "pub-poison"
+    rel = os.path.join(CHECKPOINTS_DIRNAME, pub_id)
+    staging = os.path.join(stream_dir, STAGING_DIRNAME, pub_id)
+    out = DIBCheckpointer(staging, max_to_keep=1)
+    try:
+        out.save(step, poisoned, history, key, chunk_size=CHUNK_EPOCHS)
+    finally:
+        out.close()
+    _fsync_tree(staging)
+    os.replace(staging, os.path.join(stream_dir, rel))
+    journal = JobJournal(stream_dir, filename=PUBLISHES_FILENAME)
+    try:
+        journal.append("publish", publish_id=pub_id,
+                       index=int(last["index"]) + 1, step=step,
+                       round=int(last["round"]) + 1, path=rel,
+                       beta=float(last.get("beta") or 0.0),
+                       chunk_epochs=CHUNK_EPOCHS,
+                       source=last.get("source"), drifts=0, baseline=None)
+    finally:
+        journal.close()
+
+
+# --------------------------------------------------------------- harness
+def run_chaos(workdir: str | None = None, quick: bool = False,
+              log=lambda m: print(m, file=sys.stderr, flush=True)) -> dict:
+    """Run the streaming chaos matrix; returns the bench-shaped record."""
+    owned = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="dib_chaos_stream_")
+    matrix: list[dict] = []
+    try:
+        matrix.append(run_reload_storm_drill(workdir, log))
+        matrix.append(run_canary_rollback_drill(workdir, log))
+        if not quick:
+            matrix.append(run_mid_publish_kill_drill(workdir, log))
+            matrix.append(run_deployer_kill_drill(workdir, log))
+            matrix.append(run_clean_loop_drill(workdir, log))
+    finally:
+        if owned:
+            shutil.rmtree(workdir, ignore_errors=True)
+    passed = sum(1 for d in matrix if d["ok"])
+    return {
+        "metric": METRIC,
+        "value": passed,
+        "unit": "drills_passed",
+        "total": len(matrix),
+        "quick": quick,
+        "all_passed": passed == len(matrix),
+        "window": WINDOW,
+        "stride": STRIDE,
+        "chunk_epochs": CHUNK_EPOCHS,
+        "matrix": matrix,
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+
+
+def _register(record: dict, runs_root: str | None, log) -> None:
+    """Fleet-registry registration (docs/observability.md): explicit-
+    root-only (--runs-root / DIB_RUNS_ROOT) — ad-hoc local runs must not
+    grow the committed runs/index.jsonl; see register_drill_record."""
+    from dib_tpu.telemetry.registry import register_drill_record
+
+    if register_drill_record(record, root=runs_root) is not None:
+        log("chaos stream: registered in the fleet registry")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--out", default=None,
+                        help="Also write the JSON record to this path.")
+    parser.add_argument("--quick", action="store_true",
+                        help="In-process drills only (reload_storm + "
+                             "canary_rollback); skips the subprocess "
+                             "kill/CLI drills.")
+    parser.add_argument("--workdir", default=None,
+                        help="Keep drill artifacts here (default: a temp "
+                             "dir, removed afterwards).")
+    parser.add_argument("--runs-root", "--runs_root", dest="runs_root",
+                        default=None,
+                        help="Register this run in the fleet registry "
+                             "(<runs-root>/index.jsonl; default: "
+                             "DIB_RUNS_ROOT when set, else off).")
+    args = parser.parse_args(argv)
+    log = lambda m: print(m, file=sys.stderr, flush=True)  # noqa: E731
+    record = run_chaos(workdir=args.workdir, quick=args.quick, log=log)
+    print(json.dumps(record), flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(json.dumps(record, indent=1) + "\n")
+    _register(record, args.runs_root, log)
+    return 0 if record["all_passed"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

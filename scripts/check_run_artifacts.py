@@ -51,7 +51,7 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 ARTIFACT_GLOBS = ("BENCH_*.json", "NORTHSTAR_*.json", "FAULT_DRILL*.json",
-                  "CHAOS_SCHED*.json")
+                  "CHAOS_SCHED*.json", "CHAOS_STREAM*.json")
 
 # Null-value excuses: at least one must be present when value is null.
 _NULL_VALUE_EXCUSES = ("degraded", "error", "per_run_minutes", "runs_completed")
@@ -124,10 +124,18 @@ _REQUIRED_CHAOS_SCHED_DRILLS = (
 )
 
 
-def _check_chaos_sched_matrix(record: dict, problems: list[str]) -> None:
-    """chaos_sched_matrix-specific schema: every drill present (full
-    records), zero failures, and the three scheduler invariants — zero
-    lost units, no double-executed unit, bit-identical per-β histories —
+#: The three scheduler invariants asserted per drill row: zero lost
+#: units, no double-executed unit, bit-identical per-β histories.
+_CHAOS_SCHED_INVARIANTS = ("zero_lost_units", "no_double_execution",
+                           "bit_identical_histories")
+
+
+def _check_chaos_matrix(record: dict, problems: list[str], *,
+                        required_drills: tuple[str, ...],
+                        invariants: tuple[str, ...],
+                        rerun_hint: str) -> None:
+    """Shared chaos-matrix schema (sched + stream records): every drill
+    present on full records, zero failures, and the suite's invariants
     asserted per row as typed evidence."""
     matrix = record.get("matrix")
     if not isinstance(matrix, list) or not matrix:
@@ -147,21 +155,56 @@ def _check_chaos_sched_matrix(record: dict, problems: list[str]) -> None:
         if isinstance(drill.get("drill"), str):
             by_name[drill["drill"]] = drill
     if record.get("quick") is False:
-        missing = [d for d in _REQUIRED_CHAOS_SCHED_DRILLS
-                   if d not in by_name]
+        missing = [d for d in required_drills if d not in by_name]
         if missing:
             problems.append(
                 f"full chaos record is missing drill(s) {missing} — "
-                "re-run scripts/chaos_suite.py --out CHAOS_SCHED.json"
+                f"re-run {rerun_hint}"
             )
     failed = [name for name, d in by_name.items() if d.get("ok") is False]
     if failed:
         problems.append(f"committed chaos record shows failures: {failed}")
     for name, d in by_name.items():
-        for invariant in ("zero_lost_units", "no_double_execution",
-                          "bit_identical_histories"):
+        for invariant in invariants:
             if d.get(invariant) is not True:
                 problems.append(f"{name}: {invariant!r} must be true")
+
+
+def _check_chaos_sched_matrix(record: dict, problems: list[str]) -> None:
+    """chaos_sched_matrix-specific schema: every drill present (full
+    records), zero failures, and the three scheduler invariants
+    asserted per row as typed evidence."""
+    _check_chaos_matrix(
+        record, problems,
+        required_drills=_REQUIRED_CHAOS_SCHED_DRILLS,
+        invariants=_CHAOS_SCHED_INVARIANTS,
+        rerun_hint="scripts/chaos_suite.py --out CHAOS_SCHED.json")
+
+
+# Drills every committed full chaos_stream_matrix record must carry
+# (scripts/chaos_stream.py): the always-on train-to-serve control plane
+# under faults (docs/streaming.md "Chaos invariants").
+_REQUIRED_CHAOS_STREAM_DRILLS = (
+    "clean_loop", "mid_publish_kill", "deployer_kill", "reload_storm",
+    "canary_rollback",
+)
+
+#: The three streaming invariants asserted per drill row: no publish
+#: skipped, no publish promoted twice, and every served response
+#: numerically from exactly one published checkpoint.
+_CHAOS_STREAM_INVARIANTS = ("zero_lost_publishes", "no_double_promotion",
+                            "single_checkpoint_responses")
+
+
+def _check_chaos_stream_matrix(record: dict, problems: list[str]) -> None:
+    """chaos_stream_matrix-specific schema: every drill present (full
+    records), zero failures, and the three streaming invariants asserted
+    per row as typed evidence."""
+    _check_chaos_matrix(
+        record, problems,
+        required_drills=_REQUIRED_CHAOS_STREAM_DRILLS,
+        invariants=_CHAOS_STREAM_INVARIANTS,
+        rerun_hint="scripts/chaos_stream.py --out CHAOS_STREAM.json")
 
 
 def _check_kernel_bench(record: dict, problems: list[str]) -> None:
@@ -337,6 +380,8 @@ def check_record(record: dict, problems: list[str]) -> None:
             _check_fault_drill_matrix(record, problems)
         if record.get("metric") == "chaos_sched_matrix":
             _check_chaos_sched_matrix(record, problems)
+        if record.get("metric") == "chaos_stream_matrix":
+            _check_chaos_stream_matrix(record, problems)
         if record.get("metric") == "mi_kernel_bench":
             _check_kernel_bench(record, problems)
         if record.get("metric") == "serve_async_loadgen_sweep":

@@ -205,6 +205,9 @@ def test_host_sync_targets_only_chunk_loop_modules():
     # hide)
     # ...and (ISSUE 10) the async serving hot path, where one implicit
     # device fetch stalls every in-flight request on the event loop
+    # ...and (ISSUE 12) the streaming control plane: the online loop is
+    # a chunk loop, and the deployer restores/probes while the fleet
+    # serves
     assert set(host.target_modules) == {
         "dib_tpu/train/loop.py",
         "dib_tpu/train/measurement.py",
@@ -220,6 +223,8 @@ def test_host_sync_targets_only_chunk_loop_modules():
         "dib_tpu/serve/server.py",
         "dib_tpu/serve/pool.py",
         "dib_tpu/serve/zoo.py",
+        "dib_tpu/stream/online.py",
+        "dib_tpu/stream/deployer.py",
     }
 
 
@@ -235,7 +240,9 @@ def test_thread_state_covers_the_async_serving_modules():
     thread_pass = get_pass("thread-shared-state")
     assert not getattr(thread_pass, "target_modules", None)
     for module in ("dib_tpu/serve/server.py", "dib_tpu/serve/pool.py",
-                   "dib_tpu/serve/zoo.py", "dib_tpu/serve/batcher.py"):
+                   "dib_tpu/serve/zoo.py", "dib_tpu/serve/batcher.py",
+                   "dib_tpu/stream/online.py",
+                   "dib_tpu/stream/deployer.py"):
         assert module not in getattr(thread_pass, "allowlist", {})
 
 

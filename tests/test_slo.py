@@ -121,6 +121,23 @@ def test_when_guard_membership_list():
     assert cpu["status"] == "skipped"
 
 
+def test_when_not_guard_excludes_and_fails_closed_on_absent_key():
+    """`when_not` skips on a MATCH, but an absent key excludes nothing —
+    a stream that never tagged its mode stays gated (the inclusion-guard
+    regression: `when` would silently un-gate it)."""
+    rules = [{"name": "r", "metric": "x", "max": 0.5,
+              "when_not": {"mode": ["stream_deploy", "fault_drill"]}}]
+    (excluded,) = evaluate_rules(rules, {"x": 1.0, "mode": "stream_deploy"})
+    (gated,) = evaluate_rules(rules, {"x": 1.0, "mode": "serve"})
+    (untagged,) = evaluate_rules(rules, {"x": 1.0})
+    assert excluded["status"] == "skipped"
+    assert gated["status"] == "violated"
+    assert untagged["status"] == "violated"
+    # grammar: when_not must be an object, like when
+    assert validate_slo({"rules": [
+        {"name": "r", "metric": "m", "min": 0, "when_not": "x"}]})
+
+
 # ============================================================== transitions
 def test_detect_transitions_crossings():
     chunks = [
@@ -466,3 +483,120 @@ def test_serve_stream_rejection_rule(tmp_path):
                        write=False)
     by_rule = {r["rule"]: r for r in report["rules"]}
     assert by_rule["serve_stream_rejection_ceiling"]["status"] == "ok"
+
+
+# =========================================================== streaming rules
+def _write_deployer_stream(directory, *, indices=(0, 1, 2), rollbacks=0,
+                           latency_s=0.5, request_ms=None):
+    """A stream_deploy-shaped stream: deploy decisions per publish index
+    (the streaming SLO surface), optionally with serving request spans."""
+    from dib_tpu.telemetry import Tracer, runtime_manifest
+
+    writer = EventWriter(str(directory))
+    writer.run_start(runtime_manifest(extra={"mode": "stream_deploy"}))
+    for n, index in enumerate(indices):
+        writer.deploy(publish_id=f"pub-{index:08d}", action="promoted",
+                      index=index, latency_s=latency_s)
+    for n in range(rollbacks):
+        writer.deploy(publish_id=f"pub-bad-{n}", action="rolled_back",
+                      index=max(indices, default=-1) + 1 + n,
+                      latency_s=latency_s, error="canary: non-finite")
+    if request_ms is not None:
+        tracer = Tracer(writer)
+        for _ in range(10):
+            tracer.add("request", request_ms / 1e3, op="predict",
+                       status="ok", rows=1, tenant="t0")
+    writer.run_end(status="ok")
+    writer.close()
+
+
+def test_streaming_rules_clean_deployer_stream_exits_zero(tmp_path):
+    """A healthy deployer stream (every publish decided once, fast,
+    one rollback allowed for the deliberate canary drill) passes the
+    committed SLO.json in-process."""
+    _write_deployer_stream(tmp_path, indices=(0, 1, 2), rollbacks=1,
+                           latency_s=2.5, request_ms=150.0)
+    assert telemetry_main(["check", str(tmp_path), "--slo",
+                           COMMITTED_SLO, "--no-write"]) == 0
+    report = check_run(str(tmp_path), COMMITTED_SLO, write=False)
+    by_rule = {r["rule"]: r for r in report["rules"]}
+    assert by_rule["stream_lost_publish_max"]["status"] == "ok"
+    assert by_rule["stream_rollback_ceiling"]["status"] == "ok"
+    assert by_rule["stream_publish_to_serve_p99_ceiling"]["status"] == "ok"
+    # the mode guard routes the fleet's latency to the streaming ceiling,
+    # not the dedicated-host 20 ms rules (a hot swap co-hosts compiles
+    # with traffic by design)
+    assert by_rule["stream_serve_p99_ceiling"]["status"] == "ok"
+    assert by_rule["serve_p99_ceiling"]["status"] == "skipped"
+    assert by_rule["serve_uncached_p99_ceiling"]["status"] == "skipped"
+
+
+def test_untagged_serving_stream_stays_gated_by_dedicated_p99(tmp_path):
+    """A serving stream whose run_start manifest never tagged a `mode`
+    (e.g. a DIBServer driven via the Python API) must STILL trip the
+    page-severity p99 ceiling — the stream_deploy carve-out is an
+    exclusion, not an inclusion list."""
+    from dib_tpu.telemetry import Tracer, runtime_manifest
+
+    writer = EventWriter(str(tmp_path))
+    writer.run_start(runtime_manifest())          # no mode tag
+    tracer = Tracer(writer)
+    for _ in range(10):
+        tracer.add("request", 0.5, op="predict", status="ok", rows=1,
+                   tenant="t0")
+    writer.run_end(status="ok")
+    writer.close()
+    report = check_run(str(tmp_path), COMMITTED_SLO, write=False)
+    by_rule = {r["rule"]: r for r in report["rules"]}
+    assert by_rule["serve_p99_ceiling"]["status"] == "violated"
+    # the streaming ceiling stays scoped to tagged stream_deploy fleets
+    assert by_rule["stream_serve_p99_ceiling"]["status"] == "skipped"
+
+
+def test_streaming_rules_each_violation_kind(tmp_path):
+    """Every streaming SLO rule fires on its own seeded breach."""
+    cases = {
+        "lost": (dict(indices=(0, 2)), "stream_lost_publish_max"),
+        "rollbacks": (dict(rollbacks=2), "stream_rollback_ceiling"),
+        "lag": (dict(latency_s=120.0),
+                "stream_publish_to_serve_p99_ceiling"),
+        "wedged": (dict(request_ms=5000.0), "stream_serve_p99_ceiling"),
+    }
+    for label, (spec, rule) in cases.items():
+        directory = tmp_path / label
+        _write_deployer_stream(directory, **spec)
+        report = check_run(str(directory), COMMITTED_SLO, write=False)
+        violated = [r["rule"] for r in report["rules"]
+                    if r["status"] == "violated"]
+        assert violated == [rule], (label, violated)
+        assert telemetry_main(["check", str(directory), "--slo",
+                               COMMITTED_SLO, "--no-write"]) == 1
+
+
+def test_streaming_rules_skip_non_streaming_streams():
+    """Streams without publish/deploy events skip every streaming rule —
+    the committed fixture stays exit 0 (pinned above) and reports the
+    streaming rules as skipped."""
+    report = check_run(FIXTURE_RUN, COMMITTED_SLO, write=False)
+    by_rule = {r["rule"]: r for r in report["rules"]}
+    for rule in ("stream_publish_to_serve_p99_ceiling",
+                 "stream_rollback_ceiling", "stream_lost_publish_max",
+                 "stream_serve_p99_ceiling"):
+        assert by_rule[rule]["status"] == "skipped", rule
+
+
+def test_streaming_lost_publish_pages_via_subprocess(tmp_path):
+    """The page-severity invariant breach exits 1 through the real CLI
+    against the committed SLO.json."""
+    _write_deployer_stream(tmp_path / "run", indices=(0, 2))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.run(
+        [sys.executable, "-m", "dib_tpu", "telemetry", "check",
+         str(tmp_path / "run"), "--slo", COMMITTED_SLO, "--no-write"],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert proc.returncode == 1, proc.stderr
+    report = json.loads(proc.stdout)
+    violated = [r["rule"] for r in report["rules"]
+                if r["status"] == "violated"]
+    assert violated == ["stream_lost_publish_max"]
+    assert report["violations"] == 1

@@ -1,0 +1,190 @@
+"""The streaming chaos-suite artifact contract + the always-on loop
+under faults (``scripts/chaos_stream.py``, docs/streaming.md "Chaos
+invariants").
+
+Fast tier (``-m fault``): the committed ``CHAOS_STREAM.json`` must
+exist, validate against the artifact schema (per-row streaming
+invariants included), cover every drill, and show all of them passing —
+"zero lost publishes / no double promotion / single-checkpoint
+responses" are only as good as the committed evidence. The in-process
+drill half (reload storm, canary rollback) re-runs in tier 1, as does
+the end-to-end ``clean_loop`` drill: real ``stream run`` / ``stream
+deploy`` CLI processes sharing only the publish journal, with live HTTP
+traffic riding a hot swap. The full matrix with the subprocess kill
+drills is ``@slow``.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.fault
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ARTIFACT = os.path.join(REPO, "CHAOS_STREAM.json")
+
+EXPECTED_DRILLS = {
+    "clean_loop", "mid_publish_kill", "deployer_kill", "reload_storm",
+    "canary_rollback",
+}
+QUICK_DRILLS = {"reload_storm", "canary_rollback"}
+INVARIANTS = ("zero_lost_publishes", "no_double_promotion",
+              "single_checkpoint_responses")
+
+
+def _load_chaos_module():
+    spec = importlib.util.spec_from_file_location(
+        "chaos_stream", os.path.join(REPO, "scripts", "chaos_stream.py"))
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def test_committed_chaos_stream_artifact_validates():
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    from check_run_artifacts import check_file
+
+    assert os.path.exists(ARTIFACT), (
+        "CHAOS_STREAM.json missing — run `python scripts/chaos_stream.py "
+        "--out CHAOS_STREAM.json` and commit the record")
+    assert check_file(ARTIFACT) == []
+
+
+def test_committed_chaos_stream_matrix_is_complete_and_green():
+    with open(ARTIFACT) as f:
+        record = json.load(f)
+    assert record["metric"] == "chaos_stream_matrix"
+    assert record["unit"] == "drills_passed"
+    drills = {d["drill"]: d for d in record["matrix"]}
+    assert set(drills) == EXPECTED_DRILLS
+    failed = [name for name, d in drills.items() if not d["ok"]]
+    assert not failed, f"committed chaos record shows failures: {failed}"
+    assert record["all_passed"] is True
+    assert record["value"] == record["total"] == len(EXPECTED_DRILLS)
+    # the committed record must be the FULL matrix, not a --quick run
+    assert record["quick"] is False
+    # every drill holds all three streaming invariants
+    for name, d in drills.items():
+        for invariant in INVARIANTS:
+            assert d[invariant] is True, (name, invariant)
+
+
+def test_committed_chaos_stream_evidence_detection_and_recovery():
+    """The stream-side join (telemetry summarize, embedded as evidence)
+    must agree with the suite's bookkeeping: every injected fault
+    detected AND recovered, the journal invariants zero on every
+    deployer stream, and the end-to-end drill green against the
+    committed SLO.json with traffic on BOTH sides of the swap."""
+    with open(ARTIFACT) as f:
+        record = json.load(f)
+    by_name = {d["drill"]: d for d in record["matrix"]}
+    for d in record["matrix"]:
+        for side in ("trainer", "deployer"):
+            evidence = (d.get("evidence") or {}).get(side) or {}
+            faults = evidence.get("faults")
+            if faults is not None:
+                assert faults["undetected"] == [], (d["drill"], side)
+                assert faults["detected"] == faults["injected"]
+                assert faults["recovered"] == faults["injected"]
+            streaming = evidence.get("streaming")
+            if streaming is not None and "deploys" in streaming:
+                assert streaming["lost_publishes"] == 0, d["drill"]
+                assert streaming["double_promotions"] == 0, d["drill"]
+    # the kill drills actually killed (rc 137 = SIGKILL-shaped os._exit)
+    assert by_name["mid_publish_kill"]["kill_rc"] == 137
+    assert by_name["mid_publish_kill"]["torn_staging"] is True
+    assert by_name["deployer_kill"]["kill_rc"] == 137
+    # the poisoned publish was rolled back, the rest promoted
+    assert by_name["canary_rollback"]["rollbacks"] == 1
+    # the storm rode the response cache through real invalidations
+    assert by_name["reload_storm"]["cache_hits"] > 0
+    assert by_name["reload_storm"]["cache_invalidations"] >= 2
+    # the end-to-end loop: SLO-green, traffic on both sides of the swap
+    clean = by_name["clean_loop"]
+    assert clean["slo_check_rc"] == 0
+    assert clean["rode_the_swap"] is True
+    served_per_checkpoint = clean["traffic"]["per_candidate"]
+    assert sum(1 for n in served_per_checkpoint.values() if n > 0) >= 2
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_quick_chaos_stream_matrix_end_to_end(tmp_path):
+    """Run the in-process streaming drills for real in tier 1: hot swaps
+    racing a cache-hot tenant storm, and a poisoned checkpoint rolled
+    back by the canary gate — all three invariants must hold."""
+    module = _load_chaos_module()
+    record = module.run_chaos(workdir=str(tmp_path), quick=True,
+                              log=lambda m: None)
+    failed = [d for d in record["matrix"] if not d["ok"]]
+    assert not failed, json.dumps(failed, indent=1, default=str)[:4000]
+    assert {d["drill"] for d in record["matrix"]} == QUICK_DRILLS
+    assert record["all_passed"]
+
+
+def test_clean_loop_cli_end_to_end(tmp_path):
+    """The acceptance drill in tier 1: `stream run` trains and publishes
+    through the real CLI, `stream deploy` serves and hot-swaps through
+    the real CLI (separate processes sharing only the publish journal),
+    live HTTP traffic rides the swap, and every response is numerically
+    from exactly one published checkpoint."""
+    module = _load_chaos_module()
+    drill = module.run_clean_loop_drill(str(tmp_path), log=lambda m: None)
+    assert drill["ok"], json.dumps(
+        {k: v for k, v in drill.items() if k != "evidence"}, indent=1,
+        default=str)[:4000]
+    assert drill["rode_the_swap"] is True
+    assert drill["single_checkpoint_responses"] is True
+    assert drill["slo_check_rc"] == 0
+
+
+@pytest.mark.slow
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_full_chaos_stream_matrix_end_to_end(tmp_path):
+    """The full matrix including the subprocess kill drills."""
+    module = _load_chaos_module()
+    record = module.run_chaos(workdir=str(tmp_path), quick=False,
+                              log=lambda m: None)
+    failed = [d for d in record["matrix"] if not d["ok"]]
+    assert not failed, json.dumps(failed, indent=1, default=str)[:4000]
+    assert record["all_passed"]
+
+
+def test_chaos_stream_registers_in_fleet_registry(tmp_path):
+    """Satellite: drill records land in the fleet registry under an
+    explicit runs root, so `telemetry runs trajectory` carries the
+    always-on robustness history."""
+    module = _load_chaos_module()
+    with open(ARTIFACT) as f:
+        record = json.load(f)
+    root = str(tmp_path / "runs")
+    module._register(record, root, log=lambda m: None)
+    from dib_tpu.telemetry.registry import RunRegistry, validate_index_entry
+
+    entries = RunRegistry(root).bench_history()
+    assert len(entries) == 1
+    assert entries[0]["metric"] == "chaos_stream_matrix"
+    assert entries[0]["all_passed"] is True
+    assert validate_index_entry(entries[0]) == []
+    # ... and NOT without one (the committed index must not grow from
+    # ad-hoc local runs)
+    os.environ.pop("DIB_RUNS_ROOT", None)
+    module._register(record, None, log=lambda m: None)
+    assert len(RunRegistry(root).bench_history()) == 1
+
+
+def test_committed_registry_carries_streaming_history():
+    """The committed runs/index.jsonl is seeded with the streaming drill
+    evidence, next to the scheduler chaos history."""
+    from dib_tpu.telemetry.registry import RunRegistry
+
+    entries = RunRegistry(os.path.join(REPO, "runs")).bench_history()
+    stream = [e for e in entries
+              if e.get("metric") == "chaos_stream_matrix"]
+    assert len(stream) == 1
+    assert stream[0]["all_passed"] is True
+    assert stream[0]["value"] == stream[0]["total"] == 5

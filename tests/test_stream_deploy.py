@@ -1,0 +1,597 @@
+"""Deployer: journal tailing, exactly-once catch-up across every crash
+window, canary-gated promotion, rollback (``dib_tpu/stream/deployer.py``,
+docs/streaming.md "Promotion and rollback").
+
+The deploy journal is the exactly-once ledger. A deployer can die in
+three windows and each has a pinned recovery:
+
+  - AFTER a deploy record landed: the restart preloads the processed set
+    from the journal and never re-promotes (no double promotion);
+  - BETWEEN the reload and its record: the restart re-runs an IDEMPOTENT
+    reload of the same checkpoint — the journal still ends with at most
+    one record per publish;
+  - BEFORE anything: plain catch-up in publish order, none skipped.
+
+The canary gate: a poisoned (NaN-params) published checkpoint is rolled
+back and the previous checkpoint keeps answering — bit-for-bit.
+"""
+
+import json
+import os
+import shutil
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dib_tpu.data import get_dataset
+from dib_tpu.models import DistributedIBModel
+from dib_tpu.serve import DIBServer, InferenceEngine, ModelZoo
+from dib_tpu.stream.deployer import (
+    Deployer,
+    deploys_path,
+    read_deploys,
+    stream_status,
+)
+from dib_tpu.stream.online import (
+    OnlineConfig,
+    OnlineDIBTrainer,
+    read_publishes,
+)
+from dib_tpu.train import DIBCheckpointer, DIBTrainer, TrainConfig
+
+WINDOW, STRIDE, CHUNK_EPOCHS, BATCH = 32, 8, 1, 16
+
+
+@pytest.fixture(scope="module")
+def bundle():
+    return get_dataset("boolean_circuit")
+
+
+@pytest.fixture(scope="module")
+def model(bundle):
+    return DistributedIBModel(
+        feature_dimensionalities=tuple(bundle.feature_dimensionalities),
+        encoder_hidden=(8,), integration_hidden=(16,),
+        output_dim=1, embedding_dim=2,
+    )
+
+
+def _config():
+    return TrainConfig(batch_size=BATCH, num_pretraining_epochs=1,
+                       num_annealing_epochs=2)
+
+
+@pytest.fixture(scope="module")
+def published_stream(model, bundle, tmp_path_factory):
+    """One stream dir with three real publishes, trained once and shared
+    read-only; tests that mutate copy it first."""
+    stream_dir = tmp_path_factory.mktemp("stream")
+    online = OnlineConfig(window=WINDOW, stride=STRIDE,
+                          chunk_epochs=CHUNK_EPOCHS, publish_every=1,
+                          rounds=3, seed=0)
+    OnlineDIBTrainer(model, bundle, _config(), online,
+                     str(stream_dir)).run(jax.random.key(0))
+    records, torn = read_publishes(str(stream_dir))
+    assert torn == 0 and len(records) == 3
+    return str(stream_dir)
+
+
+def _template(model, bundle):
+    return DIBTrainer(model, bundle, _config())
+
+
+def _deployer(model, bundle, stream_dir, deploy_dir, **kwargs):
+    zoo = kwargs.pop("zoo", None) or ModelZoo(exec_capacity=8,
+                                              response_capacity=16)
+    return zoo, Deployer(str(stream_dir), str(deploy_dir),
+                         _template(model, bundle), zoo,
+                         router_kwargs=dict(batch_buckets=(1, 8)),
+                         **kwargs)
+
+
+def _expected(model, bundle, stream_dir, rows):
+    """{publish_id: prediction | None(poisoned)} over the journal."""
+    out = {}
+    for rec in read_publishes(str(stream_dir))[0]:
+        template = _template(model, bundle)
+        ckpt = DIBCheckpointer(os.path.join(str(stream_dir), rec["path"]))
+        try:
+            state, _, _ = ckpt.restore(template)
+        finally:
+            ckpt.close()
+        engine = InferenceEngine(template.model, state.params["model"],
+                                 batch_buckets=(1, 8))
+        prediction = np.asarray(engine.predict(rows)["prediction"])
+        out[rec["publish_id"]] = (prediction if np.all(np.isfinite(prediction))
+                                  else None)
+    return out
+
+
+def _serve_once(zoo, rows):
+    server = DIBServer(zoo)
+    try:
+        status, payload = server.handle_post(
+            "/v1/predict", {"x": [[float(v) for v in r] for r in rows]})
+    finally:
+        server.close()   # never started: releases the socket + the zoo
+    assert status == 200
+    return np.asarray(payload["prediction"])
+
+
+def test_catch_up_is_exactly_once_and_restart_safe(
+        model, bundle, published_stream, tmp_path):
+    """Catch-up promotes each publish once, in order; a second pass and
+    a restarted deployer (records already journaled) promote nothing
+    again."""
+    deploy_dir = tmp_path / "deploy"
+    zoo, deployer = _deployer(model, bundle, published_stream, deploy_dir)
+    with deployer:
+        assert deployer.catch_up() == 3
+        assert deployer.catch_up() == 0          # idempotent second pass
+        assert deployer.status()["promoted"] == 3
+
+    records, torn = read_deploys(str(deploy_dir))
+    assert torn == 0
+    assert [r["action"] for r in records] == ["promoted"] * 3
+    assert [r["publish_index"] for r in records] == [0, 1, 2]
+
+    # the restart window AFTER a record landed: never re-promoted
+    zoo2, restarted = _deployer(model, bundle, published_stream,
+                                deploy_dir)
+    with restarted:
+        assert restarted.catch_up() == 0
+        assert restarted.status()["promoted"] == 3   # from the journal
+    assert len(read_deploys(str(deploy_dir))[0]) == 3
+
+    status = stream_status(published_stream, str(deploy_dir))
+    assert status["pending"] == 0
+    assert status["lost_publishes"] == 0
+    assert status["double_promotions"] == 0
+    zoo.close()
+    zoo2.close()
+
+
+def test_restart_between_reload_and_record_is_idempotent(
+        model, bundle, published_stream, tmp_path):
+    """The kill window between ``ModelZoo.reload`` and the journal
+    append: the restart re-runs the reload of the same checkpoint and
+    the journal ends with exactly one record per publish — and the fleet
+    answers from the final checkpoint."""
+    deploy_dir = tmp_path / "deploy"
+    zoo, deployer = _deployer(model, bundle, published_stream, deploy_dir)
+    with deployer:
+        deployer.catch_up()
+
+    # simulate the crash: the LAST reload happened but its record never
+    # landed (SIGKILL between the swap and the append)
+    records, _ = read_deploys(str(deploy_dir))
+    with open(deploys_path(str(deploy_dir))) as f:
+        lines = f.readlines()
+    with open(deploys_path(str(deploy_dir)), "w") as f:
+        f.writelines(lines[:-1])
+
+    zoo2, restarted = _deployer(model, bundle, published_stream,
+                                deploy_dir)
+    with restarted:
+        assert restarted.catch_up() == 1     # exactly the undecided one
+        assert restarted.catch_up() == 0
+        rows = np.asarray(bundle.x_valid[:4], np.float32)
+        served = _serve_once(zoo2, rows)
+
+    records, _ = read_deploys(str(deploy_dir))
+    by_publish = {}
+    for rec in records:
+        by_publish[rec["publish_id"]] = by_publish.get(rec["publish_id"],
+                                                       0) + 1
+    assert all(count == 1 for count in by_publish.values()), \
+        "at most one deploy record per publish across the crash window"
+    status = stream_status(published_stream, str(deploy_dir))
+    assert status["lost_publishes"] == 0
+    assert status["double_promotions"] == 0
+
+    expected = _expected(model, bundle, published_stream, rows)
+    final = read_publishes(published_stream)[0][-1]["publish_id"]
+    np.testing.assert_allclose(served, expected[final], rtol=1e-6)
+    zoo.close()
+
+
+def test_canary_failure_rolls_back_previous_keeps_answering(
+        model, bundle, published_stream, tmp_path):
+    """A poisoned (NaN-params) checkpoint published through the real
+    protocol is rolled back by the canary gate; the previous checkpoint
+    keeps answering bit-for-bit, and the rollback is durably recorded."""
+    stream_dir = tmp_path / "stream"
+    shutil.copytree(published_stream, stream_dir)
+    _publish_poison(model, bundle, str(stream_dir))
+
+    deploy_dir = tmp_path / "deploy"
+    zoo, deployer = _deployer(model, bundle, stream_dir, deploy_dir)
+    with deployer:
+        assert deployer.catch_up() == 4
+        status = deployer.status()
+        assert status["promoted"] == 3 and status["rollbacks"] == 1
+        rows = np.asarray(bundle.x_valid[:4], np.float32)
+        served = _serve_once(zoo, rows)
+
+    records, _ = read_deploys(str(deploy_dir))
+    assert [r["action"] for r in records] == ["promoted"] * 3 \
+        + ["rolled_back"]
+    assert "non-finite" in records[-1]["error"]
+
+    expected = _expected(model, bundle, str(stream_dir), rows)
+    assert expected["pub-poison"] is None
+    last_good = [pid for pid, out in expected.items()
+                 if out is not None][-1]
+    np.testing.assert_allclose(served, expected[last_good], rtol=1e-6)
+
+    status = stream_status(str(stream_dir), str(deploy_dir))
+    assert status["pending"] == 0 and status["double_promotions"] == 0
+
+
+def test_unrestorable_publish_is_gated_like_a_failed_canary(
+        model, bundle, published_stream, tmp_path):
+    """A publish record whose checkpoint bytes cannot restore (wrong
+    architecture, torn by an outside force) rolls back instead of
+    wedging the tail loop."""
+    stream_dir = tmp_path / "stream"
+    shutil.copytree(published_stream, stream_dir)
+    records, _ = read_publishes(str(stream_dir))
+    shutil.rmtree(stream_dir / records[-1]["path"])
+    (stream_dir / records[-1]["path"]).mkdir()   # exists, but empty
+
+    deploy_dir = tmp_path / "deploy"
+    zoo, deployer = _deployer(model, bundle, stream_dir, deploy_dir)
+    with deployer:
+        assert deployer.catch_up() == 3
+        status = deployer.status()
+        assert status["promoted"] == 2 and status["rollbacks"] == 1
+    zoo.close()
+    out = read_deploys(str(deploy_dir))[0][-1]
+    assert out["action"] == "rolled_back"
+    assert "restore failed" in out["error"]
+
+
+def _publish_poison(model, bundle, stream_dir: str) -> None:
+    """Publish a NaN-params checkpoint through the REAL protocol (stage,
+    fsync, rename, journal) — a trainer whose model diverged between the
+    divergence guard's boundaries."""
+    from dib_tpu.sched.journal import JobJournal
+    from dib_tpu.stream.online import (
+        CHECKPOINTS_DIRNAME,
+        PUBLISHES_FILENAME,
+        STAGING_DIRNAME,
+        _fsync_tree,
+    )
+
+    last = read_publishes(stream_dir)[0][-1]
+    template = _template(model, bundle)
+    ckpt = DIBCheckpointer(os.path.join(stream_dir, last["path"]))
+    try:
+        state, history, key = ckpt.restore(template)
+    finally:
+        ckpt.close()
+    poisoned = state._replace(
+        params=jax.tree.map(lambda a: jnp.full_like(a, jnp.nan),
+                            state.params))
+    step = int(last["step"]) + CHUNK_EPOCHS
+    rel = os.path.join(CHECKPOINTS_DIRNAME, "pub-poison")
+    staging = os.path.join(stream_dir, STAGING_DIRNAME, "pub-poison")
+    out = DIBCheckpointer(staging, max_to_keep=1)
+    try:
+        out.save(step, poisoned, history, key, chunk_size=CHUNK_EPOCHS)
+    finally:
+        out.close()
+    _fsync_tree(staging)
+    os.replace(staging, os.path.join(stream_dir, rel))
+    journal = JobJournal(stream_dir, filename=PUBLISHES_FILENAME)
+    try:
+        journal.append("publish", publish_id="pub-poison",
+                       index=int(last["index"]) + 1, step=step,
+                       round=int(last["round"]) + 1, path=rel,
+                       beta=float(last.get("beta") or 0.0),
+                       chunk_epochs=CHUNK_EPOCHS,
+                       source=last.get("source"), drifts=0, baseline=None)
+    finally:
+        journal.close()
+
+
+def test_deploy_events_land_on_the_telemetry_stream(
+        model, bundle, published_stream, tmp_path):
+    """Promotions and rollbacks are visible to `telemetry summarize`:
+    the streaming rollup reports them with the journal invariants."""
+    from dib_tpu.telemetry import EventWriter, summarize
+
+    stream_dir = tmp_path / "stream"
+    shutil.copytree(published_stream, stream_dir)
+    _publish_poison(model, bundle, str(stream_dir))
+
+    run_dir = tmp_path / "deploy"
+    writer = EventWriter(str(run_dir))
+    writer.run_start({"mode": "stream_deploy"})
+    zoo, deployer = _deployer(model, bundle, stream_dir, run_dir,
+                              telemetry=writer)
+    with deployer:
+        deployer.catch_up()
+    zoo.close()
+    writer.run_end(status="ok")
+    writer.close()
+
+    summary = summarize(str(run_dir))
+    assert summary["mode"] == "stream_deploy"
+    streaming = summary["streaming"]
+    assert streaming["deploys"] == 4
+    assert streaming["promoted"] == 3
+    assert streaming["rollbacks"] == 1
+    assert streaming["lost_publishes"] == 0
+    assert streaming["double_promotions"] == 0
+    assert streaming["publish_to_serve_p99_s"] >= 0
+    # the rollback is also a mitigation (the canary gate firing)
+    assert summary["mitigations"].get("canary_rollback") == 1
+
+
+def test_restart_with_decided_journal_warm_restores_the_fleet(
+        model, bundle, published_stream, tmp_path):
+    """A deployer restarted when EVERY publish is already decided
+    re-registers the newest promoted checkpoint from the journal: the
+    fleet answers immediately (the always-on contract) instead of
+    serving nothing until the trainer's next publish — and NO new deploy
+    record lands, because rebuilding in-memory state is not a promotion
+    decision and a second record would read as a double promotion."""
+    deploy_dir = tmp_path / "deploy"
+    zoo, deployer = _deployer(model, bundle, published_stream, deploy_dir)
+    with deployer:
+        assert deployer.catch_up() == 3
+    zoo.close()
+
+    zoo2, restarted = _deployer(model, bundle, published_stream,
+                                deploy_dir)
+    with restarted:
+        assert restarted.catch_up() == 0          # nothing undecided
+        rows = np.asarray(bundle.x_valid[:4], np.float32)
+        served = _serve_once(zoo2, rows)          # ...yet it answers
+
+    assert len(read_deploys(str(deploy_dir))[0]) == 3   # no new record
+    expected = _expected(model, bundle, published_stream, rows)
+    final = read_publishes(published_stream)[0][-1]["publish_id"]
+    np.testing.assert_allclose(served, expected[final], rtol=1e-6)
+    status = stream_status(published_stream, str(deploy_dir))
+    assert status["double_promotions"] == 0
+    assert status["lost_publishes"] == 0
+
+
+def test_swap_failure_is_gated_once_and_tail_continues(
+        model, bundle, published_stream, tmp_path):
+    """A zoo swap that raises — the one promotion step ``_process`` does
+    not gate itself — is decided as rolled_back: the tail neither dies
+    nor wedges retrying the same record, later publishes still promote,
+    and a restart never re-decides it."""
+    deploy_dir = tmp_path / "deploy"
+    zoo, deployer = _deployer(model, bundle, published_stream, deploy_dir)
+    real_reload, calls = zoo.reload, {"n": 0}
+
+    def flaky_reload(*args, **kwargs):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("swap infrastructure hiccup")
+        return real_reload(*args, **kwargs)
+
+    zoo.reload = flaky_reload
+    with deployer:
+        assert deployer.catch_up() == 3
+        status = deployer.status()
+        assert status["promoted"] == 2 and status["rollbacks"] == 1
+    zoo.close()
+
+    records, _ = read_deploys(str(deploy_dir))
+    assert [r["action"] for r in records] == [
+        "promoted", "rolled_back", "promoted"]
+    assert "deploy failed" in records[1]["error"]
+
+    zoo2, restarted = _deployer(model, bundle, published_stream,
+                                deploy_dir)
+    with restarted:
+        assert restarted.catch_up() == 0
+    zoo2.close()
+    assert len(read_deploys(str(deploy_dir))[0]) == 3
+    status = stream_status(published_stream, str(deploy_dir))
+    assert status["double_promotions"] == 0
+
+
+def test_malformed_publish_record_is_decided_exactly_once(
+        model, bundle, published_stream, tmp_path):
+    """A parseable journal record WITHOUT ``publish_id`` (a foreign
+    writer broke the trainer's contract) gets one durable rolled_back
+    decision under a deterministic fallback identity — later polls that
+    re-read a grown journal must not re-decide it, or the deploy journal
+    grows one duplicate per publish forever."""
+    from dib_tpu.sched.journal import JobJournal
+    from dib_tpu.stream.online import PUBLISHES_FILENAME
+
+    stream_dir = tmp_path / "stream"
+    shutil.copytree(published_stream, stream_dir)
+    journal = JobJournal(str(stream_dir), filename=PUBLISHES_FILENAME)
+    try:
+        journal.append("publish", index=99, step=99,
+                       path="checkpoints/nowhere")
+    finally:
+        journal.close()
+
+    deploy_dir = tmp_path / "deploy"
+    zoo, deployer = _deployer(model, bundle, stream_dir, deploy_dir)
+    with deployer:
+        assert deployer.catch_up() == 4
+        # grow the publish journal so the next poll re-parses it (the
+        # idle short-circuit would otherwise mask a re-decide bug)
+        journal = JobJournal(str(stream_dir), filename=PUBLISHES_FILENAME)
+        try:
+            journal.append("publish", publish_id="pub-gone", index=4,
+                           step=9, path="checkpoints/also-nowhere")
+        finally:
+            journal.close()
+        assert deployer.catch_up() == 1        # only the NEW record
+    zoo.close()
+
+    records, _ = read_deploys(str(deploy_dir))
+    assert len(records) == 5
+    by_publish = {}
+    for rec in records:
+        by_publish[rec["publish_id"]] = by_publish.get(
+            rec["publish_id"], 0) + 1
+    assert all(c == 1 for c in by_publish.values()), \
+        "one decision per record, malformed included"
+
+
+def test_tail_loop_survives_append_failure_and_retries(
+        model, bundle, published_stream, tmp_path):
+    """The one failure class that escapes ``catch_up`` — the deploy
+    journal append itself failing — lands as a durable mitigation and
+    the NEXT poll retries the undecided records: the idle short-circuit
+    must not treat the failed pass's journal size as 'done'."""
+    from dib_tpu.telemetry import EventWriter, summarize
+
+    run_dir = tmp_path / "deploy"
+    writer = EventWriter(str(run_dir))
+    writer.run_start({"mode": "stream_deploy"})
+    zoo, deployer = _deployer(model, bundle, published_stream, run_dir,
+                              telemetry=writer, poll_s=0.05)
+    real_append, calls = deployer._journal.append, {"n": 0}
+
+    def flaky_append(*args, **kwargs):
+        calls["n"] += 1
+        if calls["n"] <= 2:     # the decision append AND its rollback
+            raise OSError("disk went away")
+        return real_append(*args, **kwargs)
+
+    deployer._journal.append = flaky_append
+    deployer.start()
+    deadline = time.monotonic() + 180.0
+    while time.monotonic() < deadline:
+        if deployer.status()["promoted"] == 3:
+            break
+        time.sleep(0.05)
+    deployer.close()
+    zoo.close()
+    writer.run_end(status="ok")
+    writer.close()
+
+    records, _ = read_deploys(str(run_dir))
+    assert [r["action"] for r in records] == ["promoted"] * 3
+    summary = summarize(str(run_dir))
+    assert summary["mitigations"].get("deployer_tail_error") == 1
+    status = stream_status(published_stream, str(run_dir))
+    assert status["lost_publishes"] == 0
+    assert status["double_promotions"] == 0
+
+
+def test_idle_poll_short_circuits_without_reparsing(
+        model, bundle, published_stream, tmp_path, monkeypatch):
+    """An unchanged publish journal costs the idle poll one stat, never
+    a full re-parse — an always-on deployer polls forever, so the idle
+    path must be O(1) in journal length."""
+    import dib_tpu.stream.deployer as deployer_mod
+
+    real, calls = deployer_mod.read_publishes, {"n": 0}
+
+    def counting(stream_dir):
+        calls["n"] += 1
+        return real(stream_dir)
+
+    monkeypatch.setattr(deployer_mod, "read_publishes", counting)
+    deploy_dir = tmp_path / "deploy"
+    zoo, deployer = _deployer(model, bundle, published_stream, deploy_dir)
+    with deployer:
+        assert deployer.catch_up() == 3
+        after_first = calls["n"]
+        assert deployer.catch_up() == 0
+        assert deployer.catch_up() == 0
+        assert calls["n"] == after_first
+    zoo.close()
+
+
+def test_telemetry_write_failure_never_escapes_a_decided_record(
+        model, bundle, published_stream, tmp_path):
+    """The journal append is the decision; telemetry is best-effort
+    AFTER it. An events.jsonl write error on a decided record must not
+    escape _record — it would land in catch_up's guard and append a
+    SECOND (rolled_back) decision for a publish that promoted fine."""
+    class BrokenTelemetry:
+        def deploy(self, **kw):
+            raise OSError("events.jsonl: no space left on device")
+
+        def mitigation(self, **kw):
+            raise OSError("events.jsonl: no space left on device")
+
+    deploy_dir = tmp_path / "deploy"
+    zoo, deployer = _deployer(model, bundle, published_stream, deploy_dir,
+                              telemetry=BrokenTelemetry())
+    with deployer:
+        assert deployer.catch_up() == 3
+        assert deployer.catch_up() == 0          # idle, nothing re-decided
+    zoo.close()
+
+    records = read_deploys(str(deploy_dir))[0]
+    assert [r["action"] for r in records] == ["promoted"] * 3
+    status = stream_status(published_stream, str(deploy_dir))
+    assert status["double_promotions"] == 0
+    assert status["rollbacks"] == 0
+
+
+def test_failure_after_decision_is_not_redecided(
+        model, bundle, published_stream, tmp_path, monkeypatch):
+    """catch_up's poisoned-record guard decides ONLY undecided records:
+    an error raised after _process journaled its decision (any
+    post-append failure) must not append a contradicting rollback."""
+    deploy_dir = tmp_path / "deploy"
+    zoo, deployer = _deployer(model, bundle, published_stream, deploy_dir)
+    real_process = deployer._process
+
+    def process_then_boom(rec):
+        real_process(rec)
+        raise RuntimeError("failure after the journal append")
+
+    monkeypatch.setattr(deployer, "_process", process_then_boom)
+    with deployer:
+        deployer.catch_up()
+    zoo.close()
+
+    records = read_deploys(str(deploy_dir))[0]
+    assert [r["action"] for r in records] == ["promoted"] * 3
+    status = stream_status(published_stream, str(deploy_dir))
+    assert status["double_promotions"] == 0
+
+
+def test_partial_view_rollup_anchors_lost_publishes_at_the_oldest_seen(
+        tmp_path):
+    """A deployer restarted with a FRESH telemetry dir only carries
+    deploy events for publishes decided this launch (say indices 7, 8);
+    indices below the view were decided in the prior launch's stream.
+    Counting them as lost would page stream_lost_publish_max falsely —
+    the gap count anchors at min(index) in view, where a real skip
+    still shows (7 then 9 without 8)."""
+    from dib_tpu.telemetry.summary import streaming_rollup
+
+    def deploy_event(index):
+        return {"type": "deploy", "action": "promoted",
+                "publish_id": f"pub-{index}", "index": index,
+                "latency_s": 0.25}
+
+    partial = streaming_rollup([deploy_event(7), deploy_event(8)])
+    assert partial["lost_publishes"] == 0
+    gapped = streaming_rollup([deploy_event(7), deploy_event(9)])
+    assert gapped["lost_publishes"] == 1
+
+    # the journal-based view (stream status CLI) uses the same anchor
+    deploy_dir = tmp_path / "deploy"
+    deploy_dir.mkdir()
+    with open(deploys_path(str(deploy_dir)), "w") as fh:
+        for index in (7, 8):
+            fh.write(json.dumps({
+                "kind": "deploy", "publish_id": f"pub-{index}",
+                "action": "promoted", "publish_index": index}) + "\n")
+    stream_dir = tmp_path / "stream"
+    stream_dir.mkdir()
+    status = stream_status(str(stream_dir), str(deploy_dir))
+    assert status["lost_publishes"] == 0
