@@ -131,9 +131,20 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--sweep_repeats", type=int, default=1,
                         help="Independent seeds per sweep endpoint.")
     parser.add_argument("--mesh_beta", type=int, default=None,
-                        help="Mesh beta-axis size (default: all devices).")
+                        help="Mesh replica-axis size (default: the widest "
+                             "factor of the device count that divides the "
+                             "sweep width).")
     parser.add_argument("--mesh_data", type=int, default=None,
                         help="Mesh data-axis size.")
+    parser.add_argument("--engine", choices=("auto", "vmap", "shard_map"),
+                        default="auto",
+                        help="Sweep execution engine: 'shard_map' runs the "
+                             "explicit ('sweep','data') mesh engine "
+                             "(per-shard replica blocks, bit-identical to "
+                             "the serial trainer at one replica per "
+                             "shard); 'vmap' the legacy trace-axis path; "
+                             "'auto' picks shard_map whenever a mesh is "
+                             "available (docs/parallelism.md).")
     parser.add_argument("--checkpoint_dir", type=str, default="",
                         help="Enable Orbax checkpoint/resume (serial AND "
                              "sweep paths): save every --checkpoint_frequency "
@@ -361,11 +372,21 @@ def run(args, compile_cache_status: str | None = None) -> dict:
         ends = np.repeat(np.asarray(args.sweep_beta_ends, np.float64),
                          args.sweep_repeats)
         mesh = None
-        if len(jax.devices()) > 1:
-            nb = args.mesh_beta or int(np.gcd(len(ends), len(jax.devices())))
-            mesh = make_sweep_mesh(num_beta=nb, num_data=args.mesh_data)
+        if len(jax.devices()) > 1 or args.engine == "shard_map":
+            from dib_tpu.parallel import factor_devices, make_sweep_engine_mesh
+
+            nb = args.mesh_beta or factor_devices(
+                len(jax.devices()), num_replicas=len(ends))[0]
+            if args.engine == "vmap":
+                # legacy GSPMD path: the ('beta', 'data') mesh
+                mesh = make_sweep_mesh(num_beta=nb, num_data=args.mesh_data)
+            else:
+                # the explicit shard_map engine's ('sweep', 'data') mesh
+                mesh = make_sweep_engine_mesh(
+                    num_sweep=nb, num_data=args.mesh_data)
         sweep = BetaSweepTrainer(model, bundle, config, args.beta_start, ends,
-                                 mesh=mesh, y_encoder=y_encoder)
+                                 mesh=mesh, y_encoder=y_encoder,
+                                 engine=args.engine)
         replica_info_hooks: dict[int, object] = {}
 
         def make_replica_hook(r: int):
@@ -382,7 +403,8 @@ def run(args, compile_cache_status: str | None = None) -> dict:
             # inter-beat intervals are true chunk wall-clocks
             hooks.insert(0, HeartbeatHook(args.heartbeat))
         _telemetry_run_start(
-            extra={"beta_ends": [float(b) for b in ends]},
+            extra={"beta_ends": [float(b) for b in ends],
+                   "sweep_engine": sweep.engine},
             mesh_shape=(dict(zip(mesh.axis_names, mesh.devices.shape))
                         if mesh is not None else None),
         )
@@ -402,6 +424,19 @@ def run(args, compile_cache_status: str | None = None) -> dict:
                     sweep, chunk_size=hook_every,
                     on_fallback=_ckpt_fallback_reporter(telemetry),
                 )
+                reshard = getattr(ckpt, "last_restore_reshard", None)
+                if reshard is not None:
+                    # the checkpoint's recorded mesh layout differs from
+                    # this process's — the payload was resharded on
+                    # restore (mesh-shape-portable checkpoints,
+                    # docs/parallelism.md); the stream must say so
+                    if telemetry is not None:
+                        telemetry.mitigation(
+                            mtype="sweep_reshard", action="reshard",
+                            **reshard)
+                    print(f"resharded sweep checkpoint: saved mesh "
+                          f"{reshard.get('saved_mesh_axes')} -> restored "
+                          f"{reshard.get('mesh_axes')}", file=sys.stderr)
                 done = int(np.max(jax.device_get(resume_states.epoch)))
                 remaining = max(config.num_epochs - done, 0)
                 capacity = resume_histories["beta"].shape[-1]

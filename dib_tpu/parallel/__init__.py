@@ -12,18 +12,25 @@ from dib_tpu.parallel.context import (
     sharded_probe_bounds,
     ulysses_self_attention,
 )
+from dib_tpu.parallel.elastic import (
+    backfill_member,
+    restore_sweep_resharded,
+)
 from dib_tpu.parallel.mesh import (
     BETA_AXIS,
     DATA_AXIS,
     SEQ_AXIS,
+    SWEEP_AXIS,
     batch_sharding,
     factor_devices,
     make_context_mesh,
+    make_sweep_engine_mesh,
     make_sweep_mesh,
     replica_sharding,
     replicate,
     replicated_sharding,
     shard_replicas,
+    sweep_axis_name,
     validate_sweep_shapes,
 )
 from dib_tpu.parallel.multihost import (
@@ -43,12 +50,14 @@ __all__ = [
     "BETA_AXIS",
     "DATA_AXIS",
     "SEQ_AXIS",
+    "SWEEP_AXIS",
     "BetaSweepTrainer",
     "HostDesyncError",
     "PerReplicaHook",
     "assert_same_chunk",
     "SweepCompressionHook",
     "SweepInfoPerFeatureHook",
+    "backfill_member",
     "batch_sharding",
     "context_model_view",
     "context_parallel_apply",
@@ -59,14 +68,17 @@ __all__ = [
     "initialize",
     "process_local_batch",
     "make_context_mesh",
+    "make_sweep_engine_mesh",
     "make_sweep_mesh",
     "replica_sharding",
     "replicate",
     "replicated_sharding",
+    "restore_sweep_resharded",
     "ring_self_attention",
     "self_attention",
     "shard_replicas",
     "sharded_probe_bounds",
+    "sweep_axis_name",
     "sweep_records",
     "ulysses_self_attention",
     "validate_sweep_shapes",

@@ -1,0 +1,379 @@
+"""Elastic sweep operations: width-portable restore, member backfill,
+consolidation for serving.
+
+A sweep checkpoint is a stacked [R, ...] payload plus a manifest whose
+``mesh`` block records the LOGICAL grid — width and the (β_start, β_end)
+endpoint of every member (``BetaSweepTrainer.mesh_manifest``). That makes
+the checkpoint portable across BOTH kinds of shape change:
+
+  - **mesh shape**: the payload reshards to whatever mesh the restoring
+    process has (``DIBCheckpointer.restore`` places it onto the trainer's
+    replica sharding; a pod-trained sweep consolidates onto one host's
+    devices for serving).
+  - **logical width**: :func:`restore_sweep_resharded` matches members by
+    their β endpoints, never by position — a checkpoint saved at width R
+    restores into a sweep of width R′: shrink to a subset (R′ < R), grow
+    mid-run with fresh members (R′ > R, matched members continue their
+    exact trajectories), or carve out width 1 for an isolated re-run.
+
+Because the shard_map engine's per-replica numerics are width-independent
+(one replica per shard traces exactly the serial epoch body —
+``parallel/sweep.py``), a matched member's continued trajectory is
+BIT-IDENTICAL to the uninterrupted width-R run; pinned by
+``tests/test_reshard.py``.
+
+:func:`backfill_member` is the elastic answer to ejection: instead of a
+sweep permanently degrading to R−1 when a member is lost or ejected
+(docs/robustness.md), the member is re-admitted — restored from its last
+intact chunk, the gap replayed at the original width, and the healed lane
+spliced back into the live stack.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "backfill_member",
+    "consolidate_sweep_checkpoint",
+    "restore_sweep_resharded",
+]
+
+
+def _match_members(saved_starts, saved_ends, want_starts, want_ends):
+    """Map each wanted member to a saved index by (β_start, β_end).
+
+    Endpoints compare as float32 (the dtype they train under), and
+    duplicate endpoints — repeated-seed sweeps — are consumed in saved
+    order, so a repeated grid restores members positionally within each
+    endpoint group. Returns ``[saved_index | None]`` per wanted member.
+    """
+    pool: dict[tuple[float, float], list[int]] = {}
+    for i, (s, e) in enumerate(zip(saved_starts, saved_ends)):
+        pool.setdefault((float(np.float32(s)), float(np.float32(e))),
+                        []).append(i)
+    out = []
+    for s, e in zip(want_starts, want_ends):
+        bucket = pool.get((float(np.float32(s)), float(np.float32(e))))
+        out.append(bucket.pop(0) if bucket else None)
+    return out
+
+
+def _member_slice(tree, r: int):
+    import jax
+
+    return jax.tree.map(lambda a: a[r], tree)
+
+
+def _stack_members(members: list):
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *members)
+
+
+def _pad_history(history: dict, capacity: int) -> dict:
+    """Pad an UNSTACKED member history's record buffers to ``capacity``
+    rows (cursor and recorded rows untouched) so saved and fresh members
+    stack despite differing preallocated horizons."""
+    import jax.numpy as jnp
+
+    out = {}
+    for name, buf in history.items():
+        if name == "cursor" or buf.shape[0] >= capacity:
+            out[name] = buf
+            continue
+        pad = [(0, capacity - buf.shape[0])] + [(0, 0)] * (buf.ndim - 1)
+        out[name] = jnp.pad(buf, pad)
+    return out
+
+
+def restore_sweep_resharded(ckpt, sweep, *, chunk_size: int | None = None,
+                            new_member_keys=None, on_fallback=None,
+                            telemetry=None):
+    """Restore a sweep checkpoint saved at ANY width into ``sweep``.
+
+    ``sweep`` (a ``BetaSweepTrainer`` of width R′, on whatever mesh — or
+    no mesh — this process has) defines the TARGET grid; the checkpoint's
+    manifest defines the SAVED grid. Members are matched by β endpoints:
+
+      - matched members carry their exact state, history rows, and resume
+        key — their continued training is bit-identical to the
+        uninterrupted saved-width run (shard_map engine, one replica per
+        shard; see module docstring);
+      - unmatched (new) members are freshly initialized from
+        ``new_member_keys`` (one key per new member, consumed in target
+        order) with the same split structure ``fit`` uses, starting at
+        epoch 0 on their own β schedule;
+      - saved members absent from the target grid are dropped (shrink /
+        carve-out).
+
+    Pre-mesh checkpoints (no manifest ``mesh`` block) restore through the
+    plain path — widths must then match, and the reshard is vacuous.
+
+    Returns ``(states, histories, keys, info)`` where ``info`` carries
+    ``saved_width`` / ``restored_width`` / ``matched`` / ``new`` plus the
+    mesh-axes transition; a ``sweep_reshard`` mitigation is emitted on
+    ``telemetry`` whenever width or mesh layout changed.
+    """
+    import jax
+
+    from dib_tpu.train.checkpoint import read_manifest
+
+    manifest = read_manifest(ckpt.directory) or {}
+    block = manifest.get("mesh")
+    current = sweep.mesh_manifest()
+
+    def _plain_restore(trainer):
+        if hasattr(ckpt, "restore_latest_intact"):
+            return ckpt.restore_latest_intact(
+                trainer, chunk_size=chunk_size, on_fallback=on_fallback)
+        return ckpt.restore(trainer, chunk_size=chunk_size)
+
+    if block is None:
+        # pre-mesh checkpoint: no recorded grid to match against — the
+        # stacked payload must already have the target width (vacuous
+        # reshard; the template mismatch error names the problem if not)
+        states, histories, keys = _plain_restore(sweep)
+        info = {
+            "saved_width": sweep.num_replicas,
+            "restored_width": sweep.num_replicas,
+            "matched": list(range(sweep.num_replicas)),
+            "new": [],
+            "saved_mesh_axes": None,
+            "mesh_axes": current.get("mesh_axes"),
+        }
+        return states, histories, keys, info
+
+    saved_starts = block["beta_starts"]
+    saved_ends = block["beta_ends"]
+    saved_width = int(block["logical_grid"][0])
+    matches = _match_members(saved_starts, saved_ends,
+                             sweep.beta_starts_host, sweep.beta_ends_host)
+    identity = (saved_width == sweep.num_replicas
+                and all(m == i for i, m in enumerate(matches)))
+    if identity:
+        # same grid: the plain restore already reshards onto the sweep's
+        # mesh (DIBCheckpointer.restore's reshard-on-restore step)
+        states, histories, keys = _plain_restore(sweep)
+        reshard = getattr(ckpt, "last_restore_reshard", None)
+        info = {
+            "saved_width": saved_width,
+            "restored_width": sweep.num_replicas,
+            "matched": list(range(sweep.num_replicas)),
+            "new": [],
+            "saved_mesh_axes": block.get("mesh_axes"),
+            "mesh_axes": current.get("mesh_axes"),
+        }
+        if telemetry is not None and reshard is not None:
+            telemetry.mitigation(mtype="sweep_reshard", **{
+                **reshard, "action": "reshard"})
+        return states, histories, keys, info
+
+    new_members = [i for i, m in enumerate(matches) if m is None]
+    if new_members and new_member_keys is None:
+        raise ValueError(
+            f"Restoring width {saved_width} -> {sweep.num_replicas} adds "
+            f"{len(new_members)} member(s) with β endpoints not in the "
+            f"checkpoint (target indices {new_members}); pass "
+            f"new_member_keys (one PRNG key per new member, e.g. "
+            f"jax.random.split(key, {len(new_members)})) to initialize "
+            "them."
+        )
+    if new_members:
+        new_member_keys = jax.numpy.asarray(new_member_keys)
+        if new_member_keys.shape[0] < len(new_members):
+            raise ValueError(
+                f"new_member_keys has {new_member_keys.shape[0]} key(s) "
+                f"but the target grid adds {len(new_members)} new "
+                "member(s); surplus keys are allowed (callers that cannot "
+                "know the overlap pass one per target member), missing "
+                "ones are not"
+            )
+
+    # restore the SAVED grid consolidated (no mesh) through a template
+    # sweep of the recorded width, then re-assemble the target stack
+    template = type(sweep)(
+        sweep.base.model, sweep.base.bundle, sweep.base.config,
+        saved_starts, saved_ends, y_encoder=sweep.base.y_encoder,
+    )
+    saved_state, saved_history, saved_keys = _plain_restore(template)
+
+    capacity = max(
+        int(saved_history["beta"].shape[1]),
+        int(sweep.base.config.num_epochs),
+    )
+    state_members, history_members, key_members = [], [], []
+    fresh_cursor = 0
+    for target_index, saved_index in enumerate(matches):
+        if saved_index is not None:
+            member_history = _pad_history(
+                _member_slice(saved_history, saved_index), capacity)
+            state_members.append(_member_slice(saved_state, saved_index))
+            history_members.append(member_history)
+            key_members.append(saved_keys[saved_index])
+            continue
+        # fresh member: the same key discipline fit uses on a cold start —
+        # split once, init from one half, resume from the other
+        k = new_member_keys[fresh_cursor]
+        fresh_cursor += 1
+        resume_k, init_k = jax.random.split(k)
+        member_state, member_history = sweep.base.init(init_k)
+        state_members.append(member_state)
+        history_members.append(_pad_history(member_history, capacity))
+        key_members.append(resume_k)
+
+    states = _stack_members(state_members)
+    histories = _stack_members(history_members)
+    keys = _stack_members(key_members)
+    if sweep.mesh is not None:
+        from dib_tpu.parallel.mesh import shard_replicas
+
+        states = shard_replicas(states, sweep.mesh)
+        histories = shard_replicas(histories, sweep.mesh)
+        keys = shard_replicas(keys, sweep.mesh)
+
+    info = {
+        "saved_width": saved_width,
+        "restored_width": sweep.num_replicas,
+        "matched": [i for i, m in enumerate(matches) if m is not None],
+        "new": new_members,
+        "saved_mesh_axes": block.get("mesh_axes"),
+        "mesh_axes": current.get("mesh_axes"),
+    }
+    if telemetry is not None:
+        telemetry.mitigation(
+            mtype="sweep_reshard", action="reshard",
+            saved_width=saved_width, restored_width=sweep.num_replicas,
+            saved_mesh_axes=info["saved_mesh_axes"],
+            mesh_axes=info["mesh_axes"],
+        )
+    return states, histories, keys, info
+
+
+def consolidate_sweep_checkpoint(ckpt, model, bundle, config,
+                                 y_encoder=None, chunk_size: int | None = None):
+    """Restore a (possibly pod-trained) sweep checkpoint CONSOLIDATED onto
+    this host — no mesh, the whole stack on the default device — at the
+    grid the manifest records.
+
+    The serving recipe (docs/parallelism.md, "Consolidation for
+    serving"): the returned ``(sweep, states)`` pair feeds
+    ``ReplicaRouter.from_sweep`` / ``ModelZoo.add_sweep`` directly, so a
+    sweep trained across a pod serves from one process.
+    """
+    from dib_tpu.parallel.sweep import BetaSweepTrainer
+    from dib_tpu.train.checkpoint import read_manifest
+
+    manifest = read_manifest(ckpt.directory) or {}
+    block = manifest.get("mesh")
+    if block is None:
+        raise ValueError(
+            f"Checkpoint {ckpt.directory} has no mesh manifest block — it "
+            "was not written by a sweep trainer (or predates manifest "
+            "v2). Restore it through the trainer that wrote it instead."
+        )
+    sweep = BetaSweepTrainer(
+        model, bundle, config, block["beta_starts"], block["beta_ends"],
+        y_encoder=y_encoder,
+    )
+    states, histories, keys, _ = restore_sweep_resharded(
+        ckpt, sweep, chunk_size=chunk_size)
+    return sweep, states, histories, keys
+
+
+def backfill_member(sweep, states, histories, keys, r: int, ckpt, *,
+                    chunk: int, telemetry=None):
+    """Re-admit sweep member ``r``: restore its last intact chunk, replay
+    the gap at the original width, splice the healed lane into the live
+    stack.
+
+    The elastic alternative to permanent ejection (docs/robustness.md):
+    a member whose lane was lost or poisoned — a dead shard, an ejection
+    the operator wants to retry, a transient fault that outlived the
+    quarantine — rejoins the sweep at the next chunk boundary. The walk
+    picks the NEWEST checkpoint step whose member-``r`` params are
+    finite (later steps may already hold the poisoned lane), replays the
+    gap as one original-width sweep (healthy lanes reproduce their live
+    values exactly; the replay is the trajectory the fault never
+    touched), and splices only member ``r``'s state/history/key back.
+    Per-β histories end bit-identical to an uninterrupted run — the
+    fault-drill matrix's ``sweep_member_backfill`` arm pins it.
+
+    ``chunk`` must be the fit's ``hook_every`` (the PRNG chain is keyed
+    to chunk boundaries). Returns the healed
+    ``(states, histories, keys, info)`` and clears the member from
+    ``sweep.ejected_replicas``.
+    """
+    import jax
+
+    from dib_tpu.train.checkpoint import CheckpointCorruptionError
+
+    # read BEFORE the gap replay below — fit() rewrites ejected_replicas
+    # with the replay's own (empty) ejection record
+    was_ejected = r in sweep.ejected_replicas
+    live_epoch = int(np.max(np.asarray(jax.device_get(states.epoch))))
+    steps = sorted(ckpt.manager.all_steps(), reverse=True)
+    chosen = None
+    last_error = None
+    for step in steps:
+        try:
+            st0, hi0, k0 = ckpt.restore(sweep, step=step, chunk_size=chunk)
+        except CheckpointCorruptionError as exc:
+            last_error = exc
+            continue
+        lane_finite = all(
+            bool(np.isfinite(np.asarray(jax.device_get(leaf[r]))).all())
+            for leaf in jax.tree.leaves(st0.params)
+        )
+        if lane_finite:
+            chosen = (step, st0, hi0, k0)
+            break
+    if chosen is None:
+        raise RuntimeError(
+            f"backfill of sweep member {r} failed: no checkpoint step in "
+            f"{ckpt.directory} holds a finite lane for it "
+            f"(steps tried: {steps}; last corruption: {last_error})"
+        )
+    step, st0, hi0, k0 = chosen
+    restored_epoch = int(np.max(np.asarray(jax.device_get(st0.epoch))))
+    gap = live_epoch - restored_epoch
+    if gap > 0:
+        # original-width replay: embarrassingly parallel lanes, so the
+        # healthy members reproduce their live values exactly and member
+        # r's lane is the trajectory the fault never touched. The replay
+        # shares ``sweep``; snapshot the live run id (the replay's
+        # telemetry is None and would blank it for later checkpoint
+        # barriers — the quarantine-replay idiom, parallel/sweep.py).
+        outer_run_id = getattr(sweep, "_telemetry_run_id", "")
+        try:
+            replay_states, _ = sweep.fit(
+                k0, num_epochs=gap, hook_every=chunk,
+                states=st0, histories=hi0,
+            )
+        finally:
+            sweep._telemetry_run_id = outer_run_id
+        replay_histories = sweep.latest_history
+        replay_keys = sweep.resume_key
+    else:
+        replay_states, replay_histories, replay_keys = st0, hi0, k0
+    from dib_tpu.parallel.sweep import _splice_keys, _splice_member
+
+    states = _splice_member(states, replay_states, r)
+    histories = _splice_member(histories, replay_histories, r)
+    keys = _splice_keys(keys, r, replay_keys)
+    sweep.ejected_replicas.pop(r, None)
+    info = {
+        "replica": r,
+        "restored_epoch": restored_epoch,
+        "epoch": live_epoch,
+        "step": step,
+        "was_ejected": was_ejected,
+    }
+    if telemetry is not None:
+        telemetry.mitigation(
+            mtype="member_backfill", replica=r, epoch=live_epoch,
+            restored_epoch=restored_epoch, step=step,
+            beta_end=float(sweep.beta_ends_host[r]),
+        )
+    return states, histories, keys, info

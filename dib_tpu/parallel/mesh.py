@@ -32,6 +32,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 BETA_AXIS = "beta"
 DATA_AXIS = "data"
 SEQ_AXIS = "seq"
+# The shard_map sweep engine's replica axis. The legacy vmap engine shards
+# a vmap trace axis over 'beta'; the explicit-mesh engine makes the replica
+# axis a TRUE mesh axis named 'sweep' (docs/parallelism.md). Both spell the
+# same logical thing — which one a mesh carries selects the engine.
+SWEEP_AXIS = "sweep"
 
 
 def _make_mesh(axis_names: tuple[str, str], sizes: tuple[int | None, int | None],
@@ -85,9 +90,35 @@ def make_sweep_mesh(
                       default_axis=0)
 
 
+def make_sweep_engine_mesh(
+    num_sweep: int | None = None,
+    num_data: int | None = None,
+    devices: Sequence | None = None,
+) -> Mesh:
+    """A ``(sweep, data)`` mesh for the shard_map sweep engine.
+
+    Same construction rules as :func:`make_sweep_mesh`, but the replica
+    axis is named ``'sweep'`` — ``BetaSweepTrainer`` dispatches on the
+    axis name: a ``'sweep'`` mesh runs the explicit shard_map engine
+    (per-shard replica blocks, manual data parallelism), a ``'beta'``
+    mesh the legacy vmap engine. With one replica per shard the engine's
+    per-replica numerics are bit-identical to the serial ``DIBTrainer``
+    (docs/parallelism.md, "Numerical contract").
+    """
+    return _make_mesh((SWEEP_AXIS, DATA_AXIS), (num_sweep, num_data), devices,
+                      default_axis=0)
+
+
+def sweep_axis_name(mesh: Mesh) -> str:
+    """The mesh's replica axis: ``'sweep'`` (shard_map engine) when
+    present, else the legacy ``'beta'``."""
+    return SWEEP_AXIS if SWEEP_AXIS in mesh.axis_names else BETA_AXIS
+
+
 def replica_sharding(mesh: Mesh) -> NamedSharding:
-    """Leading-axis-over-'beta' sharding for stacked replica pytrees."""
-    return NamedSharding(mesh, P(BETA_AXIS))
+    """Leading-axis-over-the-replica-axis sharding for stacked replica
+    pytrees (``'sweep'`` or legacy ``'beta'``, whichever the mesh has)."""
+    return NamedSharding(mesh, P(sweep_axis_name(mesh)))
 
 
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
@@ -96,8 +127,9 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
-    """[R, B, ...] batches: replicas over 'beta', batch rows over 'data'."""
-    return NamedSharding(mesh, P(BETA_AXIS, DATA_AXIS))
+    """[R, B, ...] batches: replicas over the replica axis, batch rows
+    over 'data'."""
+    return NamedSharding(mesh, P(sweep_axis_name(mesh), DATA_AXIS))
 
 
 def shard_replicas(tree, mesh: Mesh):
@@ -111,22 +143,50 @@ def replicate(tree, mesh: Mesh):
 
 
 def validate_sweep_shapes(mesh: Mesh, num_replicas: int, batch_size: int) -> None:
-    """Divisibility checks that turn opaque XLA sharding errors into messages."""
-    nb = mesh.shape[BETA_AXIS]
+    """Divisibility checks that turn opaque XLA sharding errors into messages.
+
+    Errors NAME the fix: which of ``num_replicas`` / ``batch_size`` to pad
+    and to what, or how to rebuild the mesh so the run fits as-is.
+    """
+    axis = sweep_axis_name(mesh)
+    nb = mesh.shape[axis]
     nd = mesh.shape[DATA_AXIS]
     if num_replicas % nb:
+        padded = -(-num_replicas // nb) * nb
         raise ValueError(
-            f"num_replicas={num_replicas} not divisible by mesh beta axis {nb}"
+            f"num_replicas={num_replicas} is not divisible by the mesh "
+            f"{axis!r} axis ({nb}): pad the sweep grid to num_replicas="
+            f"{padded} (repeat endpoints/seeds), or rebuild the mesh with "
+            f"a {axis!r} axis that divides {num_replicas} — "
+            f"factor_devices(n, num_replicas={num_replicas}) picks one."
         )
     if batch_size % nd:
+        padded = -(-batch_size // nd) * nd
         raise ValueError(
-            f"batch_size={batch_size} not divisible by mesh data axis {nd}"
+            f"batch_size={batch_size} is not divisible by the mesh "
+            f"'data' axis ({nd}): pad batch_size to {padded}, or rebuild "
+            f"the mesh with a 'data' axis that divides {batch_size} "
+            f"(e.g. num_data={math.gcd(batch_size, nd)})."
         )
 
 
-def factor_devices(n: int) -> tuple[int, int]:
-    """Default (beta, data) split of ``n`` devices: the most-square factoring
-    biased toward beta (sweep parallelism first, data parallelism second)."""
+def factor_devices(n: int, num_replicas: int | None = None) -> tuple[int, int]:
+    """Default (sweep, data) split of ``n`` devices.
+
+    Without ``num_replicas``: the most-square factoring biased toward the
+    sweep axis (sweep parallelism first, data parallelism second).
+
+    With ``num_replicas``: the sweep axis is never factored wider than the
+    sweep is — and always DIVIDES it, so ``validate_sweep_shapes`` passes
+    without padding. The widest such axis is ``gcd(n, num_replicas)``
+    (every usable sweep factor divides both); leftover devices go to
+    'data'.
+    """
+    if num_replicas is not None:
+        if num_replicas < 1:
+            raise ValueError(f"num_replicas must be >= 1, got {num_replicas}")
+        sweep = math.gcd(n, num_replicas)
+        return sweep, n // sweep
     for d in range(int(math.isqrt(n)), 0, -1):
         if n % d == 0:
             return n // d, d

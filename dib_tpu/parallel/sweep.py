@@ -10,15 +10,33 @@ sweep is a *leading replica axis*:
     PRNG chain (the papers' "20 repeats per config" = repeated endpoints with
     different seeds);
   - params / optimizer state / history stacked [R, ...] and sharded over the
-    mesh ``'beta'`` axis — embarrassingly parallel, zero collectives until the
-    final history fetch;
-  - within each replica, batch rows sharded over the mesh ``'data'`` axis via a
-    sharding constraint inside the vmapped epoch body (``spmd_axis_name`` keeps
-    the axes composable); XLA inserts the gradient all-reduce over ICI itself.
+    mesh replica axis — embarrassingly parallel, zero collectives until the
+    final history fetch.
 
-Numerical contract: a sweep replica reproduces the serial ``DIBTrainer`` run
-with the same key and endpoints exactly — same key-split structure, same epoch
-body (it literally vmaps ``DIBTrainer._epoch_body``).
+Two execution engines share one epoch-scan body (``_chunk_epochs``):
+
+  - **vmap** (legacy, the no-mesh fallback): the replica axis is a vmap
+    trace axis inside one jitted program, optionally GSPMD-sharded over a
+    ``('beta', 'data')`` mesh via device placement + a batch sharding
+    constraint (``spmd_axis_name`` keeps the axes composable; XLA inserts
+    the gradient all-reduce itself).
+  - **shard_map** (the explicit-mesh engine): the chunk body runs under a
+    full-manual ``jax.shard_map`` over a ``('sweep', 'data')`` mesh
+    (``make_sweep_engine_mesh``). The replica axis is a TRUE mesh axis —
+    each shard traces only its own replica block — and batch rows are
+    data-parallel by explicit per-shard slicing + gradient ``pmean``
+    inside ``DIBTrainer._epoch_body``. Donation composes with the in/out
+    shardings (same ``P(sweep)`` layout both sides).
+
+Numerical contract: with ONE replica per shard (the engine default —
+``make_sweep_engine_mesh()`` puts all devices on 'sweep') a shard_map sweep
+replica reproduces the serial ``DIBTrainer`` run with the same key and
+endpoints BIT-IDENTICALLY — the traced block is exactly the serial epoch
+body — and the identity survives width changes (a member restored into a
+different-width sweep continues the same bitstream;
+``parallel/elastic.py``). The vmap engine traces all R replicas as one
+program, so its per-replica numerics agree with serial to float tolerance
+only (fusion differs with the trace-axis width); at R=1 it is bit-exact.
 """
 
 from __future__ import annotations
@@ -32,10 +50,11 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from dib_tpu.parallel.mesh import (
-    BETA_AXIS,
     DATA_AXIS,
+    SWEEP_AXIS,
     replica_sharding,
     shard_replicas,
+    sweep_axis_name,
     validate_sweep_shapes,
 )
 from dib_tpu.train.history import HistoryRecord, history_record
@@ -52,9 +71,15 @@ class BetaSweepTrainer:
       beta_starts, beta_ends: [R] endpoint grids (scalars broadcast to R; the
         common cases are a grid of end-betas with a shared start, or repeated
         identical endpoints with different seeds).
-      mesh: optional ``(beta, data)`` mesh from ``make_sweep_mesh``. Without a
-        mesh the sweep still runs (single device, vmapped) — useful for tests
-        and small grids.
+      mesh: optional device mesh. A ``('sweep', 'data')`` mesh from
+        ``make_sweep_engine_mesh`` selects the shard_map engine; a legacy
+        ``('beta', 'data')`` mesh from ``make_sweep_mesh`` the GSPMD vmap
+        path. Without a mesh the sweep still runs (single device, vmapped)
+        — useful for tests and small grids.
+      engine: ``"auto"`` (dispatch on the mesh's replica-axis name),
+        ``"vmap"``, or ``"shard_map"`` (requires a ``'sweep'`` mesh).
+        Forcing ``"vmap"`` on a ``'sweep'`` mesh is allowed — that is the
+        A/B parity configuration the engine tests pin.
     """
 
     def __init__(
@@ -66,6 +91,7 @@ class BetaSweepTrainer:
         beta_ends,
         mesh=None,
         y_encoder=None,
+        engine: str = "auto",
     ):
         starts = jnp.atleast_1d(jnp.asarray(beta_starts, jnp.float32))
         ends = jnp.atleast_1d(jnp.asarray(beta_ends, jnp.float32))
@@ -81,13 +107,43 @@ class BetaSweepTrainer:
         self.beta_ends_host = np.asarray(ends, np.float64)
         self.num_replicas = int(starts.shape[0])
         self.mesh = mesh
+        if engine not in ("auto", "vmap", "shard_map"):
+            raise ValueError(
+                f"engine must be 'auto', 'vmap' or 'shard_map', got {engine!r}"
+            )
+        if mesh is None:
+            if engine == "shard_map":
+                raise ValueError(
+                    "engine='shard_map' needs an explicit device mesh — "
+                    "build one with make_sweep_engine_mesh(num_sweep, "
+                    "num_data); without a mesh the sweep runs the vmap "
+                    "fallback."
+                )
+            self.engine = "vmap"
+        else:
+            axis = sweep_axis_name(mesh)
+            if engine == "auto":
+                self.engine = "shard_map" if axis == SWEEP_AXIS else "vmap"
+            else:
+                self.engine = engine
+            if self.engine == "shard_map" and axis != SWEEP_AXIS:
+                raise ValueError(
+                    f"engine='shard_map' needs a ('{SWEEP_AXIS}', "
+                    f"'{DATA_AXIS}') mesh (make_sweep_engine_mesh); this "
+                    f"mesh has axes {tuple(mesh.axis_names)} — the legacy "
+                    "'beta' mesh drives the vmap engine."
+                )
         self.base = DIBTrainer(model, bundle, config, y_encoder)
         # members ejected by the divergence quarantine, r -> info dict
         # (populated by fit; see docs/robustness.md "Sweep and pod failures")
         self.ejected_replicas: dict[int, dict] = {}
         if mesh is not None:
             validate_sweep_shapes(mesh, self.num_replicas, config.batch_size)
-            self.base.batch_constraint = NamedSharding(mesh, P(DATA_AXIS))
+            if self.engine == "vmap":
+                self.base.batch_constraint = NamedSharding(mesh, P(DATA_AXIS))
+            # shard_map engine: data parallelism is MANUAL (per-shard batch
+            # slice + gradient pmean in _epoch_body) — a GSPMD constraint
+            # cannot apply inside a full-manual shard_map body
             self.beta_starts = jax.device_put(
                 self.beta_starts, replica_sharding(mesh)
             )
@@ -127,32 +183,35 @@ class BetaSweepTrainer:
         return keys
 
     # ------------------------------------------------------------ chunk scan
-    @partial(
-        jax.jit,
-        static_argnames=("self", "num_epochs"),
-        donate_argnames=("states", "histories"),
-    )
-    def run_chunk(self, states, histories, keys, num_epochs: int):
-        """Scan ``num_epochs`` epochs for all replicas, fully on device.
+    def _chunk_epochs(self, states, histories, keys, beta_starts, beta_ends,
+                      num_epochs: int, spmd=None, data_axis=None,
+                      data_shards: int = 1):
+        """The ONE epoch-scan body both engines trace.
 
-        Stacked replica states/histories are donated (see
-        ``DIBTrainer.run_chunk``) — at R replicas the in-place reuse saves a
-        full copy of R x (params + opt state + history) in HBM per chunk.
+        ``spmd``: the vmap engine's GSPMD replica axis name (None inside
+        the shard_map engine — the replica axis is already manual there).
+        ``data_axis``/``data_shards``: the shard_map engine's manual data
+        parallelism, threaded to ``DIBTrainer._epoch_body``. ``beta_starts``
+        / ``beta_ends`` arrive as arguments (not closure reads) so the
+        shard_map engine can hand each shard its LOCAL endpoint block.
 
-        Permutation sampling with ``prefetch_epochs`` pre-stages every
-        replica's NEXT-epoch permutation gather inside the current epoch's
-        scan iteration, mirroring ``DIBTrainer.run_chunk``'s prefetching
-        pipeline (bit-identical gathers, sharded over the β axis like the
-        batches themselves)."""
-        spmd = BETA_AXIS if self.mesh is not None else None
-
-        # per-replica epoch key chains, identical in structure to the serial
-        # trainer's split(k_chunk, num_epochs)
+        Per-replica epoch key chains are identical in structure to the
+        serial trainer's ``split(k_chunk, num_epochs)``, and permutation
+        sampling with ``prefetch_epochs`` pre-stages every replica's
+        next-epoch gather inside the current epoch's scan iteration,
+        mirroring ``DIBTrainer.run_chunk`` (bit-identical gathers).
+        """
         epoch_keys = jax.vmap(lambda k: jax.random.split(k, num_epochs))(keys)
         epoch_keys = jnp.moveaxis(epoch_keys, 1, 0)          # [E, R]
         cfg = self.base.config
+        body = partial(self.base._epoch_body, data_axis=data_axis,
+                       data_shards=data_shards)
         if cfg.batch_sampling == "permutation" and cfg.prefetch_epochs:
-            gather = jax.vmap(self.base._epoch_batches, spmd_axis_name=spmd)
+            gather = jax.vmap(
+                partial(self.base._epoch_batches, data_axis=data_axis,
+                        data_shards=data_shards),
+                spmd_axis_name=spmd,
+            )
 
             def epoch(carry, ks_pair):
                 states, hists, staged = carry
@@ -160,13 +219,11 @@ class BetaSweepTrainer:
                 staged_next = gather(ks_next)    # overlaps this epoch's steps
 
                 def one(state, hist, k, b0, b1, buf):
-                    state, row = self.base._epoch_body(
-                        state, k, (b0, b1), batches=buf)
+                    state, row = body(state, k, (b0, b1), batches=buf)
                     return state, history_record(hist, row)
 
                 states, hists = jax.vmap(one, spmd_axis_name=spmd)(
-                    states, hists, ks, self.beta_starts, self.beta_ends,
-                    staged,
+                    states, hists, ks, beta_starts, beta_ends, staged,
                 )
                 return (states, hists, staged_next), None
 
@@ -181,15 +238,91 @@ class BetaSweepTrainer:
             states, hists = carry
 
             def one(state, hist, k, b0, b1):
-                state, row = self.base._epoch_body(state, k, (b0, b1))
+                state, row = body(state, k, (b0, b1))
                 return state, history_record(hist, row)
 
             states, hists = jax.vmap(one, spmd_axis_name=spmd)(
-                states, hists, ks, self.beta_starts, self.beta_ends)
+                states, hists, ks, beta_starts, beta_ends)
             return (states, hists), None
 
         (states, histories), _ = jax.lax.scan(epoch, (states, histories), epoch_keys)
         return states, histories
+
+    @partial(
+        jax.jit,
+        static_argnames=("self", "num_epochs"),
+        donate_argnames=("states", "histories"),
+    )
+    def _run_chunk_vmap(self, states, histories, keys, num_epochs: int):
+        """The vmap engine's chunk program: replica axis as a trace axis,
+        optionally GSPMD-sharded over the mesh replica axis (the legacy —
+        and no-mesh fallback — path). Stacked states/histories are donated
+        (see ``DIBTrainer.run_chunk``) — at R replicas the in-place reuse
+        saves a full copy of R x (params + opt state + history) in HBM per
+        chunk."""
+        spmd = sweep_axis_name(self.mesh) if self.mesh is not None else None
+        return self._chunk_epochs(
+            states, histories, keys, self.beta_starts, self.beta_ends,
+            num_epochs, spmd=spmd,
+        )
+
+    @partial(
+        jax.jit,
+        static_argnames=("self", "num_epochs"),
+        donate_argnames=("states", "histories"),
+    )
+    def _run_chunk_shard_map(self, states, histories, keys, num_epochs: int):
+        """The explicit-mesh engine's chunk program: the epoch scan runs
+        under a full-manual ``shard_map`` over the ``('sweep', 'data')``
+        mesh. Each shard traces ONLY its local replica block — with one
+        replica per shard the traced program is exactly the serial epoch
+        body, which is what makes the engine bit-identical to
+        ``DIBTrainer`` (and width-portable; see the module docstring).
+        Batch rows are data-parallel by explicit slicing + gradient pmean
+        over ``'data'`` inside ``_epoch_body``; at ``num_data == 1`` both
+        vanish. Donation composes with the shardings: inputs and outputs
+        share the ``P('sweep')`` layout, so XLA reuses the stacked buffers
+        in place."""
+        from jax.experimental.shard_map import shard_map
+
+        mesh = self.mesh
+        spec = P(sweep_axis_name(mesh))
+        data_shards = int(mesh.shape[DATA_AXIS])
+
+        def replica_block(states, histories, keys, beta_starts, beta_ends):
+            return self._chunk_epochs(
+                states, histories, keys, beta_starts, beta_ends, num_epochs,
+                spmd=None,
+                data_axis=DATA_AXIS if data_shards > 1 else None,
+                data_shards=data_shards,
+            )
+
+        # check_rep=False: with num_data > 1 the outputs are replicated
+        # across 'data' by construction (pmean-ed grads, deterministic
+        # optimizer), which the static replication checker cannot prove.
+        return shard_map(
+            replica_block,
+            mesh=mesh,
+            in_specs=(spec, spec, spec, spec, spec),
+            out_specs=(spec, spec),
+            check_rep=False,
+        )(states, histories, keys, self.beta_starts, self.beta_ends)
+
+    def run_chunk(self, states, histories, keys, num_epochs: int):
+        """Scan ``num_epochs`` epochs for all replicas, fully on device,
+        through the trainer's resolved engine (``self.engine``)."""
+        if self.engine == "shard_map":
+            return self._run_chunk_shard_map(states, histories, keys,
+                                             num_epochs)
+        return self._run_chunk_vmap(states, histories, keys, num_epochs)
+
+    @property
+    def chunk_callable(self):
+        """The engine's underlying jitted chunk program — what cost
+        analysis (``FitRecorder.record_compile``) lowers."""
+        return (type(self)._run_chunk_shard_map
+                if self.engine == "shard_map"
+                else type(self)._run_chunk_vmap)
 
     # ------------------------------------------------------------------ fit
     def fit(
@@ -303,7 +436,7 @@ class BetaSweepTrainer:
                 keys, chunk_keys = split[:, 0], split[:, 1]
                 if telemetry is not None and done == 0:
                     recorder.record_compile(
-                        "run_chunk", type(self).run_chunk,
+                        "run_chunk", self.chunk_callable,
                         self, states, histories, chunk_keys, this_chunk,
                         epochs=this_chunk,
                     )
@@ -529,6 +662,34 @@ class BetaSweepTrainer:
         )
 
 
+    # ------------------------------------------------------------- manifest
+    def mesh_manifest(self) -> dict:
+        """The checkpoint manifest's ``mesh`` block (docs/parallelism.md,
+        "Mesh-shape-portable checkpoints").
+
+        Records the LOGICAL sweep grid — width plus the β endpoints of
+        every member — and the physical layout it trained under (mesh axis
+        sizes, replica axis name, engine). Restore matches members by
+        their β endpoints, never by position or device layout, which is
+        what lets a checkpoint saved at width R restore at width R′ on a
+        different mesh (``parallel/elastic.py:restore_sweep_resharded``).
+        ``CheckpointHook`` reads this from any trainer that publishes it;
+        the serial ``DIBTrainer`` has none, so its manifests carry no mesh
+        block (and restore vacuously, pre-mesh style)."""
+        info = {
+            "logical_grid": [int(self.num_replicas)],
+            "beta_starts": [float(b) for b in self.beta_starts_host],
+            "beta_ends": [float(b) for b in self.beta_ends_host],
+            "engine": self.engine,
+        }
+        if self.mesh is not None:
+            info["mesh_axes"] = {
+                str(name): int(size)
+                for name, size in self.mesh.shape.items()
+            }
+            info["replica_axis"] = sweep_axis_name(self.mesh)
+        return info
+
     # ------------------------------------------------------------ inspection
     def replica_state(self, states: TrainState, r: int) -> TrainState:
         """One replica's (unstacked) train state, fetched as needed."""
@@ -705,20 +866,25 @@ def _member_row_detail(row: dict, r: int) -> dict:
     }
 
 
-def _splice_member(full, healed, r: int):
-    """Replace member ``r`` in a stacked pytree with the corresponding
-    member of another same-shape stacked pytree."""
-    return jax.tree.map(lambda a, b: a.at[r].set(b[r]), full, healed)
+def _splice_member(full, healed, r: int, src: int | None = None):
+    """Replace member ``r`` in a stacked pytree with member ``src`` of
+    another stacked pytree (``src`` defaults to ``r`` — the same-width
+    heal/backfill splice; a differently-indexed source is the carve-out
+    splice, sched/runner.py's grow-at-resume leveling)."""
+    s = r if src is None else src
+    return jax.tree.map(lambda a, b: a.at[r].set(b[s]), full, healed)
 
 
-def _splice_keys(keys: Array, r: int, healed: Array) -> Array:
+def _splice_keys(keys: Array, r: int, healed: Array,
+                 src: int | None = None) -> Array:
     """Member splice for PRNG key arrays (typed keys have no ``.at`` set
     path across all JAX versions — go through the raw key data)."""
+    s = r if src is None else src
     if jax.dtypes.issubdtype(keys.dtype, jax.dtypes.prng_key):
         data = jax.random.key_data(keys).at[r].set(
-            jax.random.key_data(healed)[r]
+            jax.random.key_data(healed)[s]
         )
         return jax.random.wrap_key_data(
             data, impl=str(jax.random.key_impl(keys))
         )
-    return keys.at[r].set(healed[r])
+    return keys.at[r].set(healed[s])

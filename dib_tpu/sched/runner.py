@@ -1,4 +1,5 @@
-"""Per-unit trainer: one β point × seed, chunk-checkpointed, resumable.
+"""Per-unit trainer: one β point × seed — or one whole β-sweep on a mesh —
+chunk-checkpointed, resumable.
 
 The runner is where the scheduling layer meets the PR 4/5 worker
 machinery: every unit trains with a ``CheckpointHook`` at every chunk
@@ -22,6 +23,15 @@ Boundary hook order is load-bearing:
      runs LAST, so a kill/preempt fault always finds the checkpoint it
      will be resumed from already durable — the ``apply_train_fault``
      ordering, one layer up.
+
+Mesh units: a unit whose train spec carries ``betas`` (a list of end-β
+values) trains the WHOLE grid as one ``BetaSweepTrainer`` on the mesh the
+runner was handed (``TrainingUnitRunner(mesh=...)``) — the scheduler
+gives one job a whole mesh instead of one device. Resume goes through
+``parallel/elastic.py:restore_sweep_resharded``, so a unit stolen by a
+worker with a DIFFERENT mesh (or re-submitted at a different grid width)
+reshards its checkpoint instead of wedging: matched members continue
+bit-identically, the mesh layout is whatever the new holder has.
 """
 
 from __future__ import annotations
@@ -59,11 +69,14 @@ class TrainingUnitRunner:
     """
 
     def __init__(self, base_dir: str, telemetry=None, boundary_hook=None,
-                 preempt=None):
+                 preempt=None, mesh=None):
         self.base_dir = base_dir
         self._telemetry = telemetry
         self._boundary_hook = boundary_hook
         self._preempt = preempt
+        # the whole mesh this runner's units may use (sweep units; None =
+        # single-device serial units, the legacy shape)
+        self._mesh = mesh
 
     def unit_dir(self, unit) -> str:
         return os.path.join(self.base_dir, "units",
@@ -110,6 +123,10 @@ class TrainingUnitRunner:
             steps_per_epoch=int(spec["steps_per_epoch"]),
             max_val_points=int(spec["max_val_points"]),
         )
+        if spec.get("betas"):
+            # mesh unit: the whole β grid as ONE sweep on the runner's mesh
+            return self._run_sweep_unit(unit, spec, heartbeat, model,
+                                        bundle, config)
         trainer = DIBTrainer(model, bundle, config)
         chunk = int(spec["chunk_epochs"])
         udir = self.unit_dir(unit)
@@ -160,3 +177,168 @@ class TrainingUnitRunner:
             "final_val_loss": float(bits.val_loss[-1]),
             "history_path": self.history_path(unit),
         }
+
+    def _run_sweep_unit(self, unit, spec, heartbeat, model, bundle,
+                        config) -> dict:
+        """One WHOLE β-sweep as a single unit on the runner's mesh.
+
+        The unit's ``betas`` spec is the logical grid; the mesh (if any)
+        is whatever this runner was handed — a unit resumed on a holder
+        with a different mesh, or re-submitted at a different width,
+        reshards through ``restore_sweep_resharded`` (matched members
+        continue bit-identically; new members need ``unit.seed``-derived
+        keys). The hook order contract is the serial unit's."""
+        import jax
+        import numpy as np
+
+        from dib_tpu.parallel import BetaSweepTrainer, restore_sweep_resharded
+        from dib_tpu.train import CheckpointHook, DIBCheckpointer
+
+        ends = [float(b) for b in spec["betas"]]
+        sweep = BetaSweepTrainer(
+            model, bundle, config, float(spec["beta_start"]), ends,
+            mesh=self._mesh,
+        )
+        chunk = int(spec["chunk_epochs"])
+        udir = self.unit_dir(unit)
+        os.makedirs(udir, exist_ok=True)
+        ckpt = DIBCheckpointer(os.path.join(udir, "ckpt"))
+
+        hooks = []
+        if heartbeat is not None:
+            # FIRST: a stolen lease aborts here, before any write (the
+            # serial unit's hook-order contract, __call__ above)
+            hooks.append(lambda trainer, state, epoch: heartbeat())
+        hooks.append(CheckpointHook(ckpt))
+        if self._boundary_hook is not None:
+            boundary_hook = self._boundary_hook
+            hooks.append(
+                lambda trainer, state, epoch: boundary_hook(unit, epoch))
+
+        try:
+            resume_state = resume_history = None
+            remaining = None
+            keys = jax.random.split(jax.random.key(int(unit.seed)),
+                                    sweep.num_replicas)
+            if ckpt.latest_step is not None:
+                # width- and mesh-portable resume: the previous holder may
+                # have run a different mesh (or grid) — matched members
+                # continue their exact trajectories
+                resume_state, resume_history, keys, reshard_info = (
+                    restore_sweep_resharded(
+                        ckpt, sweep, chunk_size=chunk,
+                        # folded namespace, NOT key(seed + 1): consecutive
+                        # unit seeds are the natural grid convention, and
+                        # key(seed + 1) IS the cold-start stream of the
+                        # seed+1 unit — two "independent" members would
+                        # share init and noise draws
+                        new_member_keys=jax.random.split(
+                            jax.random.fold_in(
+                                jax.random.key(int(unit.seed)), 1),
+                            sweep.num_replicas),
+                        on_fallback=self._fallback_reporter,
+                        telemetry=self._telemetry,
+                    )
+                )
+                member_epochs = np.asarray(jax.device_get(
+                    resume_state.epoch)).astype(int).reshape(-1)
+                done = int(member_epochs.max())
+                remaining = max(config.num_epochs - done, 0)
+                if (member_epochs < done).any():
+                    resume_state, resume_history, keys = (
+                        self._level_new_members(
+                            model, bundle, config,
+                            float(spec["beta_start"]), ends,
+                            resume_state, resume_history, keys,
+                            member_epochs, done, chunk, heartbeat))
+            _, records = sweep.fit(
+                keys, num_epochs=remaining, hooks=hooks, hook_every=chunk,
+                states=resume_state, histories=resume_history,
+                preempt=self._preempt,
+            )
+        finally:
+            ckpt.close()
+
+        bits = [r.to_bits(bundle.loss_is_info_based) for r in records]
+
+        def stack_padded(arrs):
+            # _level_new_members keeps grow-at-resume lanes rectangular,
+            # but a preempted/partial lane can still fall short; NaN-pad
+            # each lane's tail so the stacked npz stays rectangular
+            # without inventing training that never ran
+            epochs = max(a.shape[0] for a in arrs)
+            return np.stack([
+                np.pad(np.asarray(a, np.float64),
+                       [(0, epochs - a.shape[0])] + [(0, 0)] * (a.ndim - 1),
+                       constant_values=np.nan)
+                for a in arrs
+            ])
+
+        np.savez(
+            self.history_path(unit),
+            beta=stack_padded([b.beta for b in bits]),
+            kl_per_feature=stack_padded([b.kl_per_feature for b in bits]),
+            loss=stack_padded([b.loss for b in bits]),
+            val_loss=stack_padded([b.val_loss for b in bits]),
+            beta_ends=np.asarray(ends),
+        )
+        return {
+            "betas": ends,
+            "replicas": sweep.num_replicas,
+            "seed": int(unit.seed),
+            "engine": sweep.engine,
+            "epochs": max(int(b.loss.shape[0]) for b in bits),
+            "final_loss": [float(b.loss[-1]) if b.loss.size else None
+                           for b in bits],
+            "final_val_loss": [float(b.val_loss[-1]) if b.val_loss.size
+                               else None for b in bits],
+            "history_path": self.history_path(unit),
+        }
+
+    def _level_new_members(self, model, bundle, config, beta_start, ends,
+                           states, histories, keys, member_epochs, done,
+                           chunk, heartbeat):
+        """Bring grow-at-resume members up to the matched members' epoch.
+
+        ``restore_sweep_resharded`` hands fresh members back at epoch 0
+        while the matched members sit at ``done``; the lockstep fit
+        advances every member by the SAME count, so without leveling a
+        new member would finish ``done`` epochs short of its β schedule —
+        zero epochs, on a unit that was already complete — while the unit
+        still reported success. Each lagging group trains in its own
+        carve-out sub-sweep (member lanes are embarrassingly parallel, so
+        a carve-out realizes the same schedule) up to ``done`` and is
+        spliced back; a retried unit replays the same seed-derived keys,
+        so the top-up is deterministic. Returns the leveled
+        ``(states, histories, keys)``."""
+        import jax
+        import numpy as np
+
+        from dib_tpu.parallel import BetaSweepTrainer
+        from dib_tpu.parallel.sweep import _splice_keys, _splice_member
+
+        def member_gather(tree, idx):
+            return jax.tree.map(lambda a: a[idx], tree)
+
+        hooks = ([] if heartbeat is None
+                 else [lambda trainer, state, epoch: heartbeat()])
+        for epoch in sorted({int(e) for e in member_epochs if e < done}):
+            idx = np.asarray([r for r, e in enumerate(member_epochs)
+                              if int(e) == epoch])
+            sub = BetaSweepTrainer(model, bundle, config, beta_start,
+                                   [ends[int(r)] for r in idx])
+            sub_states, _ = sub.fit(
+                member_gather(keys, idx), num_epochs=done - epoch,
+                hooks=hooks, hook_every=chunk,
+                states=member_gather(states, idx),
+                histories=member_gather(histories, idx),
+                preempt=self._preempt,
+            )
+            sub_histories = sub.latest_history
+            sub_keys = sub.resume_key
+            for j, r in enumerate(idx.tolist()):
+                states = _splice_member(states, sub_states, r, src=j)
+                histories = _splice_member(histories, sub_histories, r,
+                                           src=j)
+                keys = _splice_keys(keys, r, sub_keys, src=j)
+        return states, histories, keys

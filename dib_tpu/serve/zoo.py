@@ -253,7 +253,8 @@ class ModelZoo:
         self.register(name, router, checkpoint_dir=checkpoint_dir)
         return router
 
-    def add_sweep(self, name: str, sweep, states, **router_kwargs):
+    def add_sweep(self, name: str, sweep, states,
+                  checkpoint_dir: str | None = None, **router_kwargs):
         """Register a β-sweep checkpoint's members as ONE zoo model with
         β-labeled replicas (the ``from_sweep`` story, zoo-scoped)."""
         from dib_tpu.serve.replicas import ReplicaRouter
@@ -261,8 +262,32 @@ class ModelZoo:
         router = ReplicaRouter.from_sweep(
             sweep, states, exec_cache=self.exec_cache, cache_key=name,
             **router_kwargs)
-        self.register(name, router)
+        self.register(name, router, checkpoint_dir=checkpoint_dir)
         return router
+
+    def add_sweep_checkpoint(self, name: str, checkpoint_dir: str, model,
+                             bundle, config, y_encoder=None,
+                             **router_kwargs):
+        """Register a sweep CHECKPOINT directly — the consolidation-for-
+        serving recipe (docs/parallelism.md).
+
+        The checkpoint may have been trained on any mesh (a pod's worth of
+        devices): the manifest's mesh block records the logical grid, and
+        ``parallel/elastic.py:consolidate_sweep_checkpoint`` restores the
+        whole stack onto THIS host's default device — the reshard is the
+        restore. Every member then serves as a β-labeled replica behind
+        one model name."""
+        from dib_tpu.parallel.elastic import consolidate_sweep_checkpoint
+        from dib_tpu.train.checkpoint import DIBCheckpointer
+
+        ckpt = DIBCheckpointer(checkpoint_dir)
+        try:
+            sweep, states, _, _ = consolidate_sweep_checkpoint(
+                ckpt, model, bundle, config, y_encoder=y_encoder)
+        finally:
+            ckpt.close()
+        return self.add_sweep(name, sweep, states,
+                              checkpoint_dir=checkpoint_dir, **router_kwargs)
 
     # ----------------------------------------------------------- resolve
     def resolve(self, name: str | None = None):

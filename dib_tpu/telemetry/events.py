@@ -120,8 +120,12 @@ EVENT_SCHEMA: dict[str, EventKindSpec] = {
                   "skipped_steps", "timeout_s", "grace_s", "exit_code",
                   "signal", "run_id", "replicas", "consecutive_failures",
                   "healthy", "ejected", "batchers_dead",
-                  "checkpoint_saved", "grace_remaining_s", "model"),
-        doc="one self-healing action (watchdog, rollback, serve health)"),
+                  "checkpoint_saved", "grace_remaining_s", "model",
+                  "saved_width", "restored_width", "saved_mesh_axes",
+                  "mesh_axes"),
+        doc="one self-healing action (watchdog, rollback, serve health; "
+            "sweep_reshard / member_backfill carry the mesh-portability "
+            "fields: saved/restored sweep widths and mesh axis sizes)"),
     "fault": EventKindSpec(
         required=("kind",),
         optional=("spec", "chunk", "epoch", "replica", "op", "host",

@@ -37,7 +37,8 @@ from dib_tpu.telemetry.events import (
     read_events,
 )
 
-__all__ = ["summarize", "compare", "faults_rollup", "overlap_rollup",
+__all__ = ["summarize", "compare", "faults_rollup", "mesh_rollup",
+           "overlap_rollup",
            "scheduler_rollup", "serving_rollup", "span_rollup",
            "streaming_rollup",
            "span_hotspots", "telemetry_main"]
@@ -548,6 +549,46 @@ def streaming_rollup(events) -> dict | None:
     return out
 
 
+def mesh_rollup(events) -> dict | None:
+    """Mesh-execution view of a run (``parallel/sweep.py`` shard_map
+    engine + mesh-shape-portable checkpoints, docs/parallelism.md).
+
+    ``axes``/``engine`` come from the run_start provenance manifest
+    (``mesh_shape``/``sweep_engine``); ``reshards``/``backfills`` count
+    the ``sweep_reshard``/``member_backfill`` mitigations restores emit,
+    with each reshard's width/layout transition listed under
+    ``reshard_events``. None for runs with neither a mesh manifest nor
+    elastic activity — serial runs carry no mesh block.
+    """
+    out: dict = {}
+    for e in events:
+        if e.get("type") != "run_start":
+            continue
+        manifest = e.get("manifest") or {}
+        if manifest.get("mesh_shape"):
+            out["axes"] = manifest["mesh_shape"]
+        if manifest.get("sweep_engine"):
+            out["engine"] = manifest["sweep_engine"]
+    reshards = [e for e in events if e.get("type") == "mitigation"
+                and e.get("mtype") == "sweep_reshard"]
+    backfills = [e for e in events if e.get("type") == "mitigation"
+                 and e.get("mtype") == "member_backfill"]
+    if reshards:
+        out["reshards"] = len(reshards)
+        out["reshard_events"] = [
+            {k: e.get(k) for k in ("saved_width", "restored_width",
+                                   "saved_mesh_axes", "mesh_axes")
+             if e.get(k) is not None}
+            for e in reshards
+        ]
+    if backfills:
+        out["backfills"] = len(backfills)
+        out["backfilled_replicas"] = sorted(
+            {e.get("replica") for e in backfills
+             if e.get("replica") is not None})
+    return out or None
+
+
 def _utilization_rollup(compiles, rollup: dict, device_kind) -> dict:
     """Join cost-analyzed ``compile`` events with span durations into
     per-callable roofline coordinates. A compiled callable matches the span
@@ -784,6 +825,13 @@ def summarize(path: str, process_index: int | None = None,
     streaming = streaming_rollup(events)
     if streaming is not None:
         summary["streaming"] = streaming
+
+    # mesh execution plane (parallel/sweep.py shard_map engine +
+    # mesh-shape-portable checkpoints): axis sizes from the run_start
+    # provenance, reshard/backfill mitigations from restores
+    mesh = mesh_rollup(events)
+    if mesh is not None:
+        summary["mesh"] = mesh
 
     if compiles:
         by_cache: dict[str, int] = {}

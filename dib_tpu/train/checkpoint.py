@@ -42,8 +42,17 @@ from dib_tpu.train.history import history_init
 # Version of the {state, history, key, chunk_size} payload layout. Bumped
 # when the payload structure changes incompatibly; the manifest records it
 # so a reader from a different era fails with one line instead of a deep
-# Orbax structure error.
-CHECKPOINT_SCHEMA_VERSION = 1
+# Orbax structure error. v2 adds the OPTIONAL mesh/sharding metadata rows
+# (logical sweep grid, mesh axis sizes, per-leaf PartitionSpec) that make
+# checkpoints mesh-shape-portable — the payload itself is unchanged, so
+# v1 checkpoints restore under v2 readers (vacuous reshard). A manifest
+# WITHOUT the mesh block still writes as v1 (MESH_FREE_CHECKPOINT_SCHEMA):
+# the schema names the content, not the writer's era, so serial
+# checkpoints saved by upgraded workers stay restorable by v1-only
+# readers during a rolling fleet upgrade.
+CHECKPOINT_SCHEMA_VERSION = 2
+MESH_FREE_CHECKPOINT_SCHEMA = 1
+COMPATIBLE_CHECKPOINT_SCHEMAS = (1, 2)
 MANIFEST_FILENAME = "dib_manifest.json"
 
 
@@ -78,7 +87,25 @@ def param_structure_hash(params) -> str:
     return hashlib.sha256(blob.encode()).hexdigest()[:16]
 
 
-def write_manifest(directory: str, params) -> dict:
+def sharding_spec_rows(state, history) -> list[str]:
+    """Canonical ``"path spec"`` row per checkpoint leaf, sorted.
+
+    Records the per-leaf ``PartitionSpec`` the payload was SAVED under
+    (``None`` for unsharded/single-device leaves), so restore can tell a
+    vacuous reshard from a real one and `check_run_artifacts`-style
+    tooling can validate the layout without opening the Orbax payload.
+    """
+    rows = []
+    for prefix, tree in (("state", state), ("history", history)):
+        for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+            spec = getattr(getattr(leaf, "sharding", None), "spec", None)
+            spec_str = "None" if spec is None else str(tuple(spec))
+            rows.append(f"{prefix}{jax.tree_util.keystr(path)} {spec_str}")
+    return sorted(rows)
+
+
+def write_manifest(directory: str, params, mesh: dict | None = None,
+                   sharding_rows: list[str] | None = None) -> dict:
     """Write the checkpoint-integrity manifest next to the step dirs.
 
     Recorded once per checkpoint directory (rewritten on every save — the
@@ -86,12 +113,30 @@ def write_manifest(directory: str, params) -> dict:
     param-tree structure hash, and the full row list so a mismatch at
     restore can NAME the differing leaves instead of leaving the operator
     with a deep pytree shape error.
+
+    ``mesh`` (schema v2): the logical sweep grid + physical layout block
+    from ``BetaSweepTrainer.mesh_manifest`` — what makes the checkpoint
+    mesh-shape-portable (restore reshards to the restoring process's
+    mesh; width R restores at width R′ via
+    ``parallel/elastic.py:restore_sweep_resharded``). ``sharding_rows``:
+    per-leaf :func:`sharding_spec_rows` evidence of the saved layout.
+    Serial trainers pass neither, and their manifests stay v1 — the
+    schema names the payload-plus-metadata CONTENT, not the writer's
+    era, so a v1-era reader (a not-yet-upgraded fleet member stealing a
+    serial unit mid-rolling-upgrade) keeps restoring the serial
+    checkpoints it fully understands instead of hard-rejecting them.
     """
+    versioned = mesh is not None or sharding_rows is not None
     manifest = {
-        "checkpoint_schema": CHECKPOINT_SCHEMA_VERSION,
+        "checkpoint_schema": (CHECKPOINT_SCHEMA_VERSION if versioned
+                              else MESH_FREE_CHECKPOINT_SCHEMA),
         "param_structure_hash": param_structure_hash(params),
         "param_structure_rows": param_structure_rows(params),
     }
+    if mesh is not None:
+        manifest["mesh"] = dict(mesh)
+    if sharding_rows is not None:
+        manifest["sharding_rows"] = list(sharding_rows)
     path = os.path.join(directory, MANIFEST_FILENAME)
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
@@ -139,12 +184,12 @@ def verify_manifest(directory: str, params, context: str = "restore") -> None:
     if manifest is None:
         return
     schema = manifest.get("checkpoint_schema")
-    if schema != CHECKPOINT_SCHEMA_VERSION:
+    if schema not in COMPATIBLE_CHECKPOINT_SCHEMAS:
         raise ValueError(
             f"Checkpoint {directory} was written with checkpoint schema "
-            f"{schema!r} but this code reads schema "
-            f"{CHECKPOINT_SCHEMA_VERSION} — upgrade/downgrade dib_tpu to a "
-            f"matching version before {context}."
+            f"{schema!r} but this code reads schemas "
+            f"{COMPATIBLE_CHECKPOINT_SCHEMAS} — upgrade/downgrade dib_tpu "
+            f"to a matching version before {context}."
         )
     want = manifest.get("param_structure_hash")
     got = param_structure_hash(params)
@@ -205,7 +250,8 @@ class DIBCheckpointer:
         )
 
     def save(self, step: int, state: Any, history: dict, key: jax.Array,
-             chunk_size: int | None = None) -> None:
+             chunk_size: int | None = None,
+             mesh_info: dict | None = None) -> None:
         payload = {
             "state": state,
             "history": history,
@@ -221,7 +267,14 @@ class DIBCheckpointer:
         # Integrity manifest BEFORE the (async) payload write: schema
         # version + param-tree structure hash, so restore/serving can fail
         # with an actionable one-liner instead of a deep pytree mismatch.
-        write_manifest(self.directory, state.params)
+        # ``mesh_info`` (sweep trainers' ``mesh_manifest()``) plus the
+        # per-leaf sharding rows make the checkpoint mesh-shape-portable:
+        # restore reshards to whatever mesh the restoring process has.
+        write_manifest(
+            self.directory, state.params, mesh=mesh_info,
+            sharding_rows=(sharding_spec_rows(state, history)
+                           if mesh_info is not None else None),
+        )
         # Async: the write overlaps the next training chunk; readers
         # (restore / latest_step) wait for in-flight saves first.
         self.manager.save(step, args=ocp.args.StandardSave(payload))
@@ -375,6 +428,36 @@ class DIBCheckpointer:
         # insurance premium.
         restored_state = jax.tree.map(jnp.copy, restored["state"])
         restored_history = jax.tree.map(jnp.copy, restored["history"])
+        # Reshard-on-restore: when the restoring trainer carries a mesh,
+        # the payload is placed onto THAT mesh's replica sharding — the
+        # checkpoint's layout is whatever the saving process had, and the
+        # manifest (not the buffers) is the contract. A layout change is
+        # recorded on ``self.last_restore_reshard`` so callers can emit a
+        # ``sweep_reshard`` mitigation; an unchanged layout (or a serial /
+        # pre-mesh checkpoint) reshards vacuously and records None.
+        self.last_restore_reshard = None
+        mesh = getattr(trainer, "mesh", None)
+        if mesh is not None:
+            from dib_tpu.parallel.mesh import replica_sharding
+
+            sharding = replica_sharding(mesh)
+            restored_state = jax.device_put(restored_state, sharding)
+            restored_history = jax.device_put(restored_history, sharding)
+            saved_block = (read_manifest(self.directory) or {}).get("mesh")
+            current = (trainer.mesh_manifest()
+                       if hasattr(trainer, "mesh_manifest") else None)
+            if saved_block is not None and current is not None:
+                saved_axes = saved_block.get("mesh_axes")
+                current_axes = current.get("mesh_axes")
+                if saved_axes != current_axes:
+                    self.last_restore_reshard = {
+                        "saved_mesh_axes": saved_axes,
+                        "mesh_axes": current_axes,
+                        "saved_width": (saved_block.get("logical_grid")
+                                        or [None])[0],
+                        "restored_width": (current.get("logical_grid")
+                                           or [None])[0],
+                    }
         return restored_state, restored_history, _unpack_key(restored["key"])
 
     def restore_latest_intact(self, trainer, template_key=None,
@@ -480,7 +563,13 @@ class CheckpointHook:
             or os.environ.get("DIB_TELEMETRY_RUN_ID", ""),
             epoch,
         )
+        # Sweep trainers publish their logical grid + mesh layout; the
+        # manifest's mesh block is what makes the checkpoint
+        # mesh-shape-portable. Serial trainers publish nothing and their
+        # manifests stay mesh-free (restore reshards vacuously).
+        mesh_manifest = getattr(trainer, "mesh_manifest", None)
         self.checkpointer.save(
             epoch, state, history, key,
             chunk_size=getattr(trainer, "resume_chunk", None),
+            mesh_info=mesh_manifest() if callable(mesh_manifest) else None,
         )

@@ -178,7 +178,9 @@ class DIBTrainer:
         return loss, {"task": task, "kl": kl_per_feature, "metric": metric}
 
     # ------------------------------------------------------------ epoch scan
-    def _epoch_batches(self, key: Array, data=None) -> tuple[Array, Array]:
+    def _epoch_batches(self, key: Array, data=None,
+                       data_axis: str | None = None,
+                       data_shards: int = 1) -> tuple[Array, Array]:
         """The epoch's permutation-gathered batch buffers, from its epoch
         key (same derivation ``_epoch_body`` uses inline, so prefetched and
         inline epochs are bit-identical): ONE gather of
@@ -188,7 +190,15 @@ class DIBTrainer:
         pipeline"). ``data`` optionally overrides the resident
         ``(x_train, y_train)`` with traced arrays — the streaming path
         (``run_stream_chunk``) feeds the current window as real jit
-        ARGUMENTS instead of baked constants."""
+        ARGUMENTS instead of baked constants.
+
+        ``data_axis``/``data_shards``: inside the shard_map engine's
+        manual data parallelism, each shard slices ITS row block out of
+        the permutation index array and gathers only that — the rows are
+        identical to slicing the gathered batch (``_epoch_body``'s
+        fallback for per-step sampling), but the gather work and the
+        staged buffer are ``1/data_shards`` of the full batch instead of
+        every shard staging everything."""
         cfg = self.config
         x_train, y_train = (self._x_train, self._y_train) if data is None \
             else data
@@ -201,17 +211,26 @@ class DIBTrainer:
             for i in range(-(-total // n))
         ]
         idx = jnp.concatenate(perms)[:total]
+        rows = cfg.batch_size
+        if data_axis is not None and data_shards > 1:
+            rows = cfg.batch_size // data_shards
+            shard = jax.lax.axis_index(data_axis)
+            idx = jax.lax.dynamic_slice_in_dim(
+                idx.reshape(self.steps_per_epoch, cfg.batch_size),
+                shard * rows, rows, axis=1,
+            ).reshape(-1)
         x_epoch = x_train[idx].reshape(
-            self.steps_per_epoch, cfg.batch_size, *x_train.shape[1:]
+            self.steps_per_epoch, rows, *x_train.shape[1:]
         )
         y_epoch = y_train[idx].reshape(
-            self.steps_per_epoch, cfg.batch_size, *y_train.shape[1:]
+            self.steps_per_epoch, rows, *y_train.shape[1:]
         )
         return x_epoch, y_epoch
 
     def _epoch_body(
         self, state: TrainState, key: Array, beta_endpoints=None,
         batches: tuple[Array, Array] | None = None, data=None,
+        data_axis: str | None = None, data_shards: int = 1,
     ) -> tuple[TrainState, dict]:
         """One epoch. ``beta_endpoints`` optionally overrides the config's
         static (beta_start, beta_end) with traced values — the sweep trainer
@@ -220,7 +239,19 @@ class DIBTrainer:
         gather can run ahead of the epoch boundary. ``data`` optionally
         overrides the resident ``(x_train, y_train)`` with traced arrays
         (the streaming window path, ``run_stream_chunk``); validation stays
-        on the bundle's held-out split either way."""
+        on the bundle's held-out split either way.
+
+        ``data_axis``/``data_shards``: MANUAL data parallelism for bodies
+        traced inside a full-manual ``shard_map`` (the explicit-mesh sweep
+        engine, ``parallel/sweep.py``). Each data shard trains on its
+        ``batch_size / data_shards`` slice of the batch and the gradients
+        and batch statistics are ``pmean``-ed over ``data_axis`` — the
+        replica-axis GSPMD path uses ``batch_constraint`` instead (the two
+        are mutually exclusive). With ``data_shards == 1`` the slice and
+        the collective vanish, so the single-data-shard engine stays
+        bit-identical to the serial path. Validation runs replicated (the
+        full held-out split on every shard, identical results by
+        construction — no collective needed)."""
         cfg = self.config
         b0, b1 = (
             (cfg.beta_start, cfg.beta_end) if beta_endpoints is None else beta_endpoints
@@ -234,16 +265,43 @@ class DIBTrainer:
         n = x_train.shape[0]
         grad_fn = jax.value_and_grad(self._forward_loss, has_aux=True)
 
+        shard_data = data_axis is not None and data_shards > 1
+
         def train_step(params, opt_state, x_b, y_b, k_noise):
             if self.batch_constraint is not None:
                 x_b = jax.lax.with_sharding_constraint(x_b, self.batch_constraint)
                 y_b = jax.lax.with_sharding_constraint(y_b, self.batch_constraint)
+            if shard_data:
+                # manual data parallelism (shard_map engine): this shard
+                # trains on its contiguous row block; pmean below restores
+                # the full-batch mean gradient/statistics. The noise key is
+                # folded with the shard index — every row block must draw
+                # INDEPENDENT encoder noise (the same key at the same local
+                # shape would hand every block identical noise rows, i.e.
+                # correlated reparameterization samples across the batch).
+                # This makes the nd>1 run a different — equally valid —
+                # stochastic realization than serial; bit-identity to the
+                # serial trainer holds at nd == 1, where this branch
+                # vanishes (docs/parallelism.md, "Numerical contract").
+                rows = cfg.batch_size // data_shards
+                i = jax.lax.axis_index(data_axis)
+                if x_b.shape[0] != rows:
+                    # per-step sampling paths hand every shard the full
+                    # batch; the permutation path pre-slices the index
+                    # array in _epoch_batches (same rows, 1/nd the gather)
+                    x_b = jax.lax.dynamic_slice_in_dim(x_b, i * rows, rows)
+                    y_b = jax.lax.dynamic_slice_in_dim(y_b, i * rows, rows)
+                k_noise = jax.random.fold_in(k_noise, i)
             (loss, aux), grads = grad_fn(params, x_b, y_b, beta, k_noise)
-            updates, opt_state = self.optimizer.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            return params, opt_state, {
+            stats = {
                 "task": aux["task"], "kl": aux["kl"], "metric": aux["metric"],
             }
+            if shard_data:
+                grads = jax.lax.pmean(grads, data_axis)
+                stats = jax.lax.pmean(stats, data_axis)
+            updates, opt_state = self.optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, stats
 
         keys = jax.random.split(key, self.steps_per_epoch + 1)
         if cfg.batch_sampling == "permutation":
@@ -253,7 +311,8 @@ class DIBTrainer:
             # ``batches`` carries the pre-staged buffers when the chunk scan
             # prefetches (run_chunk); inline otherwise.
             x_epoch, y_epoch = (
-                self._epoch_batches(key, data=data)
+                self._epoch_batches(key, data=data, data_axis=data_axis,
+                                    data_shards=data_shards)
                 if batches is None else batches
             )
 

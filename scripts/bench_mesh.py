@@ -1,0 +1,228 @@
+"""Mesh-engine evidence run: reshard save/restore round-trips + parity.
+
+Exercises the PR 13 contract end to end on whatever backend is available
+(CPU in CI — the committed ``BENCH_MESH_CPU.json`` is CORRECTNESS
+evidence, not speed; the on-hardware MFU re-measure rides the next TPU
+tunnel round, see ROADMAP):
+
+  - **serial parity**: a shard_map sweep replica vs the serial
+    ``DIBTrainer`` on the same key — must be bit-identical;
+  - **reshard round-trips**: save a width-R sweep checkpoint mid-run,
+    restore at R' in {R/2, 1, 2R}, continue training — matched members'
+    full histories must be bit-identical to the uninterrupted width-R
+    run (``parallel/elastic.py:restore_sweep_resharded``), with the
+    save/restore wall-clocks reported per row.
+
+Emits ONE bench-shaped JSON line (metric/value/unit; value =
+``parity_failures``, gated at 0 by SLO.json's
+``mesh_reshard_parity_failures_max`` — `telemetry check
+BENCH_MESH_CPU.json` evaluates the rule directly) and registers a fleet
+registry entry only under an explicit --runs-root/DIB_RUNS_ROOT.
+
+    python scripts/bench_mesh.py --out BENCH_MESH_CPU.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+METRIC = "mesh_reshard_bench"
+
+#: The width-R β grid every scenario shares, and the widths restored.
+ENDS = (0.03, 0.1, 0.3, 1.0)
+SHRINK = (0.1, 1.0)      # lanes 1, 3
+CARVE = (0.3,)           # lane 2
+GROW_EXTRA = (3.0, 10.0, 0.01, 0.05)
+CHUNK = 4
+
+
+def _setup():
+    import jax
+
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        jax.config.update("jax_platforms", plat)
+
+    from dib_tpu.data import get_dataset
+    from dib_tpu.models import DistributedIBModel
+    from dib_tpu.train import TrainConfig
+
+    bundle = get_dataset("boolean_circuit")
+    model = DistributedIBModel(
+        feature_dimensionalities=tuple(bundle.feature_dimensionalities),
+        encoder_hidden=(8,), integration_hidden=(16,),
+        output_dim=1, embedding_dim=2,
+    )
+    config = TrainConfig(
+        batch_size=64, beta_start=1e-3, beta_end=1.0,
+        num_pretraining_epochs=2, num_annealing_epochs=6,
+        steps_per_epoch=2, max_val_points=128,
+    )
+    return model, bundle, config
+
+
+def _identical(rec_a, rec_b) -> bool:
+    import numpy as np
+
+    return (np.array_equal(rec_a.loss, rec_b.loss)
+            and np.array_equal(rec_a.kl_per_feature, rec_b.kl_per_feature)
+            and np.array_equal(rec_a.beta, rec_b.beta))
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(
+        description="Mesh-engine reshard/parity evidence run "
+                    "(docs/parallelism.md).")
+    parser.add_argument("--out", default=None)
+    parser.add_argument("--runs-root", default=None,
+                        help="Fleet registry root; registration happens "
+                             "ONLY when this (or DIB_RUNS_ROOT) is set.")
+    args = parser.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    model, bundle, config = _setup()
+
+    from dib_tpu.parallel import (
+        BetaSweepTrainer,
+        factor_devices,
+        make_sweep_engine_mesh,
+        restore_sweep_resharded,
+    )
+    from dib_tpu.train import CheckpointHook, DIBCheckpointer, DIBTrainer
+
+    n_dev = len(jax.devices())
+    width = len(ENDS)
+    # the num_replicas-aware factoring: never a sweep axis wider than R
+    n_sweep, _ = factor_devices(n_dev, num_replicas=width)
+
+    def engine_mesh(r):
+        sweep_axis, _ = factor_devices(n_dev, num_replicas=r)
+        return make_sweep_engine_mesh(sweep_axis, 1)
+
+    keys = jax.random.split(jax.random.key(0), width)
+    rows: list[dict] = []
+
+    # ---- serial parity: shard_map replica == DIBTrainer, bit for bit
+    key = jax.random.key(7)
+    t0 = time.time()
+    serial = DIBTrainer(model, bundle, config)
+    _, hist = serial.fit(key)
+    sweep1 = BetaSweepTrainer(model, bundle, config, config.beta_start,
+                              jnp.asarray([config.beta_end]),
+                              mesh=make_sweep_engine_mesh(1, 1))
+    _, recs1 = sweep1.fit(jnp.stack([key]))
+    ok = (np.array_equal(np.asarray(recs1[0].loss), np.asarray(hist.loss))
+          and np.array_equal(np.asarray(recs1[0].kl_per_feature),
+                             np.asarray(hist.kl_per_feature)))
+    rows.append({
+        "scenario": "serial_parity", "engine": "shard_map",
+        "saved_width": 1, "restored_width": 1, "bit_identical": bool(ok),
+        "seconds": round(time.time() - t0, 3),
+    })
+
+    # ---- uninterrupted width-R baseline + mid-run checkpoint
+    full = BetaSweepTrainer(model, bundle, config, 1e-3, jnp.asarray(ENDS),
+                            mesh=engine_mesh(width))
+    _, rec_full = full.fit(keys, hook_every=CHUNK)
+
+    workdir = tempfile.mkdtemp(prefix="dib_bench_mesh_")
+    ckpt_dir = os.path.join(workdir, "ckpt")
+    saver = BetaSweepTrainer(model, bundle, config, 1e-3, jnp.asarray(ENDS),
+                             mesh=engine_mesh(width))
+    ckpt = DIBCheckpointer(ckpt_dir)
+    t0 = time.time()
+    # lint-ok(prng-reuse): the interrupted run MUST replay the baseline's
+    # exact keys — bit-identical continuation is the thing being measured
+    saver.fit(keys, num_epochs=CHUNK, hooks=[CheckpointHook(ckpt)],
+              hook_every=CHUNK)
+    ckpt.close()
+    save_s = round(time.time() - t0, 3)
+
+    lane_of = {float(np.float32(b)): i for i, b in enumerate(ENDS)}
+
+    def round_trip(name, ends, new_keys=None, meshless=False):
+        mesh = None if meshless else engine_mesh(len(ends))
+        sweep = BetaSweepTrainer(model, bundle, config, 1e-3,
+                                 jnp.asarray(ends), mesh=mesh)
+        ck = DIBCheckpointer(ckpt_dir)
+        t0 = time.time()
+        try:
+            states, histories, ks, info = restore_sweep_resharded(
+                ck, sweep, chunk_size=CHUNK, new_member_keys=new_keys)
+        finally:
+            ck.close()
+        restore_s = round(time.time() - t0, 3)
+        done = int(np.max(np.asarray(jax.device_get(states.epoch))))
+        _, recs = sweep.fit(ks, num_epochs=config.num_epochs - done,
+                            states=states, histories=histories,
+                            hook_every=CHUNK)
+        matched = [i for i, b in enumerate(ends)
+                   if float(np.float32(b)) in lane_of]
+        ok = all(_identical(rec_full[lane_of[float(np.float32(ends[i]))]],
+                            recs[i]) for i in matched)
+        rows.append({
+            "scenario": name, "engine": sweep.engine,
+            "saved_width": info["saved_width"],
+            "restored_width": info["restored_width"],
+            "matched_members": len(matched),
+            "new_members": len(info["new"]),
+            "bit_identical": bool(ok),
+            "save_s": save_s, "restore_s": restore_s,
+            "seconds": restore_s,
+        })
+
+    round_trip("reshard_shrink", SHRINK)
+    round_trip("reshard_carveout", CARVE, meshless=True)
+    round_trip("reshard_grow", ENDS + GROW_EXTRA,
+               new_keys=jax.random.split(jax.random.key(99),
+                                         len(GROW_EXTRA)))
+
+    failures = sum(1 for r in rows if not r["bit_identical"])
+    record = {
+        "metric": METRIC,
+        "value": failures,
+        "unit": "parity_failures",
+        "parity_failures": failures,
+        "all_parity_ok": failures == 0,
+        "detail": "shard_map sweep engine vs serial trainer + "
+                  "reshard-on-restore round-trips (width "
+                  f"{width} -> {{{len(SHRINK)}, {len(CARVE)}, "
+                  f"{width + len(GROW_EXTRA)}}}); bit-identity evidence, "
+                  "not speed — CPU",
+        "device_kind": jax.devices()[0].device_kind,
+        "device_platform": jax.devices()[0].platform,
+        "num_devices": n_dev,
+        "mesh_axes": {"sweep": n_sweep, "data": 1},
+        "rows": rows,
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    line = json.dumps(record)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+
+    from dib_tpu.telemetry.registry import register_drill_record
+
+    entry = register_drill_record(record, root=args.runs_root, extra={
+        "parity_failures": failures,
+    })
+    if entry is not None:
+        print("bench_mesh: registered in the fleet registry",
+              file=sys.stderr)
+    return 0 if failures == 0 else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

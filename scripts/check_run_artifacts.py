@@ -61,7 +61,8 @@ _NULL_VALUE_EXCUSES = ("degraded", "error", "per_run_minutes", "runs_completed")
 # evidence). The sweep/preempt/desync rows are the ISSUE-5 additions.
 _REQUIRED_FAULT_DRILLS = (
     "train_stall", "train_kill", "train_nan", "preempt",
-    "sweep_replica_nan", "sweep_replica_ejected", "desync",
+    "sweep_replica_nan", "sweep_replica_ejected", "sweep_member_backfill",
+    "desync",
     "ckpt_truncate", "ckpt_bitflip_manifest",
     "serve_replica_error", "serve_replica_slow", "serve_batcher_crash",
     "http_malformed",
@@ -109,6 +110,12 @@ def _check_fault_drill_matrix(record: dict, problems: list[str]) -> None:
     if d is not None and d.get("neighbor_bit_identical") is not True:
         problems.append(
             "sweep_replica_ejected: 'neighbor_bit_identical' must be true")
+    d = by_name.get("sweep_member_backfill")
+    if d is not None and d.get("healed_bit_identical") is not True:
+        problems.append(
+            "sweep_member_backfill: 'healed_bit_identical' must be true — "
+            "the elastic backfill contract is per-β histories bit-identical "
+            "to an uninterrupted run (docs/parallelism.md)")
     d = by_name.get("desync")
     if d is not None and (d.get("lagging_host_named") is not True
                           or d.get("straggler_bounded") is not True):
@@ -328,6 +335,71 @@ def _check_serve_async_bench(record: dict, problems: list[str]) -> None:
                         "baseline the speedup is measured against")
 
 
+def _check_mesh_bench(record: dict, problems: list[str]) -> None:
+    """mesh_reshard_bench-specific schema (scripts/bench_mesh.py): every
+    round-trip row carries typed width/engine/bit-identity fields, the
+    sweep covers a serial-parity row AND at least one genuine width
+    change, and parity failures sit at the committed SLO budget (0 —
+    ``mesh_reshard_parity_failures_max``; a reshard that is not
+    bit-identical is corruption, not a perf regression)."""
+    rows = record.get("rows")
+    if not isinstance(rows, list) or not rows:
+        problems.append("'rows' must be a non-empty list of round-trip "
+                        "records")
+        return
+    serial_seen = width_change_seen = False
+    failed = 0
+    for i, row in enumerate(rows):
+        if not isinstance(row, dict):
+            problems.append(f"rows[{i}] must be an object")
+            continue
+        if not (isinstance(row.get("scenario"), str) and row["scenario"]):
+            problems.append(f"rows[{i}]: 'scenario' must be a non-empty "
+                            "string")
+        if row.get("engine") not in ("shard_map", "vmap"):
+            problems.append(f"rows[{i}]: 'engine' must be shard_map|vmap")
+        for key in ("saved_width", "restored_width"):
+            v = row.get(key)
+            if not isinstance(v, int) or isinstance(v, bool) or v <= 0:
+                problems.append(f"rows[{i}]: {key!r} must be a positive int")
+        if not isinstance(row.get("bit_identical"), bool):
+            problems.append(f"rows[{i}]: 'bit_identical' must be a bool")
+        elif not row["bit_identical"]:
+            failed += 1
+        if not (_is_finite_number(row.get("seconds"))
+                and row["seconds"] >= 0):
+            problems.append(f"rows[{i}]: 'seconds' must be a finite "
+                            "non-negative number")
+        if row.get("scenario") == "serial_parity":
+            serial_seen = True
+        if (isinstance(row.get("saved_width"), int)
+                and isinstance(row.get("restored_width"), int)
+                and row["saved_width"] != row["restored_width"]):
+            width_change_seen = True
+    if not serial_seen:
+        problems.append("no 'serial_parity' row — the shard_map-vs-serial "
+                        "bit-identity contract is unvalidated")
+    if not width_change_seen:
+        problems.append("no row restores at a width different from the "
+                        "saved one — the reshard-on-restore contract is "
+                        "unvalidated")
+    budget = _slo_budget("mesh_reshard_parity_failures_max", 0)
+    declared = record.get("parity_failures")
+    if not isinstance(declared, int) or isinstance(declared, bool):
+        problems.append("'parity_failures' must be an int")
+    elif declared != failed:
+        problems.append(f"'parity_failures' ({declared}) disagrees with "
+                        f"the row evidence ({failed} non-bit-identical "
+                        "row(s))")
+    if failed > budget:
+        problems.append(
+            f"{failed} round-trip(s) were not bit-identical (SLO budget "
+            f"{budget}) — a reshard that changes the numbers is silent "
+            "corruption")
+    if record.get("all_parity_ok") is not True:
+        problems.append("'all_parity_ok' must be true on a committed record")
+
+
 def _reject_constant(name: str):
     raise ValueError(f"non-finite JSON constant {name!r}")
 
@@ -386,6 +458,8 @@ def check_record(record: dict, problems: list[str]) -> None:
             _check_kernel_bench(record, problems)
         if record.get("metric") == "serve_async_loadgen_sweep":
             _check_serve_async_bench(record, problems)
+        if record.get("metric") == "mesh_reshard_bench":
+            _check_mesh_bench(record, problems)
     elif {"cmd", "rc"} <= set(record):
         # ---- driver capture
         if not isinstance(record["cmd"], str):

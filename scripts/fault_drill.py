@@ -355,15 +355,15 @@ def run_sweep_drills(workdir: str, log) -> list[dict]:
     t0 = time.time()
     with warnings.catch_warnings():
         warnings.simplefilter("ignore")
-        _, recs_c = sweep.fit(keys, hooks=[CheckpointHook(sick)],
-                              hook_every=2, telemetry=writer,
-                              fault_plan=plan)
+        states_c, recs_c = sweep.fit(keys, hooks=[CheckpointHook(sick)],
+                                     hook_every=2, telemetry=writer,
+                                     fault_plan=plan)
     writer.run_end(status="ok")
     writer.close()
-    ckpt.close()
     neighbor_identical = history_identical(recs_a[0], recs_c[0])
     evidence = _stream_evidence(run_dir)
     faults = evidence.get("faults") or {}
+    ejection_info = dict(sweep.ejected_replicas.get(1) or {})
     ok = (recs_c[1].ejected and not recs_c[0].ejected
           and neighbor_identical
           and list(sweep.ejected_replicas) == [1]
@@ -372,7 +372,51 @@ def run_sweep_drills(workdir: str, log) -> list[dict]:
     records.append(_drill_record(
         "sweep_replica_ejected", "replica_nan", ok,
         ejected_replica=1, neighbor_bit_identical=neighbor_identical,
-        ejection_info=sweep.ejected_replicas.get(1),
+        ejection_info=ejection_info,
+        wall_s=round(time.time() - t0, 1), evidence=evidence,
+    ))
+
+    # --- elastic backfill: the ejected member re-admitted, not written off
+    log("drill sweep_member_backfill: ejected member backfilled from its "
+        "last intact chunk")
+    from dib_tpu.parallel import backfill_member
+    from dib_tpu.parallel.sweep import sweep_records
+
+    run_dir = os.path.join(workdir, "sweep_member_backfill")
+    writer = EventWriter(run_dir)
+    writer.run_start(runtime_manifest(extra={"mode": "fault_drill"}))
+    t0 = time.time()
+    try:
+        # the backfill walks the REAL checkpoint (the poisoned-restore
+        # wrapper only corrupted what the quarantine read back), picks the
+        # newest step with a finite member-1 lane, replays the gap at the
+        # original width, and splices the healed lane into the live stack
+        healed_states, healed_histories, _, info = backfill_member(
+            sweep, states_c, sweep.latest_history, sweep.resume_key, 1,
+            ckpt, chunk=2, telemetry=writer,
+        )
+        writer.run_end(status="ok")
+    finally:
+        writer.close()
+        ckpt.close()
+    healed_recs = sweep_records(healed_histories, ejected={})
+    healed_identical = all(
+        history_identical(a, b) for a, b in zip(recs_a, healed_recs))
+    params_identical = all(
+        np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree.leaves(states_a.params),
+                        jax.tree.leaves(healed_states.params)))
+    evidence = _stream_evidence(run_dir)
+    mitigations = evidence.get("mitigations") or {}
+    ok = (healed_identical and params_identical
+          and info["was_ejected"]
+          and not sweep.ejected_replicas
+          and mitigations.get("member_backfill", 0) == 1)
+    records.append(_drill_record(
+        "sweep_member_backfill", "replica_nan", ok,
+        backfilled_replica=1, healed_bit_identical=healed_identical,
+        bit_identical_params=params_identical,
+        restored_epoch=info["restored_epoch"],
         wall_s=round(time.time() - t0, 1), evidence=evidence,
     ))
     return records

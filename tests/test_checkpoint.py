@@ -350,7 +350,7 @@ def test_manifest_written_and_verified(tmp_path):
     """ISSUE 3 satellite: every save records a schema version + param-tree
     structure hash; restore verifies the template against it."""
     from dib_tpu.train.checkpoint import (
-        CHECKPOINT_SCHEMA_VERSION,
+        MESH_FREE_CHECKPOINT_SCHEMA,
         param_structure_hash,
         read_manifest,
         verify_manifest,
@@ -364,7 +364,10 @@ def test_manifest_written_and_verified(tmp_path):
     ckpt.manager.wait_until_finished()
 
     manifest = read_manifest(ckpt.directory)
-    assert manifest["checkpoint_schema"] == CHECKPOINT_SCHEMA_VERSION
+    # a serial (mesh-free) save stays on the v1 schema: the schema names
+    # the manifest CONTENT, so v1-only readers keep restoring it through
+    # a rolling fleet upgrade
+    assert manifest["checkpoint_schema"] == MESH_FREE_CHECKPOINT_SCHEMA
     assert manifest["param_structure_hash"] == param_structure_hash(state.params)
     assert any("encoders" in row for row in manifest["param_structure_rows"])
 

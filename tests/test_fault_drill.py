@@ -22,7 +22,8 @@ ARTIFACT = os.path.join(REPO, "FAULT_DRILL.json")
 
 EXPECTED_DRILLS = {
     "train_stall", "train_kill", "train_nan", "preempt",
-    "sweep_replica_nan", "sweep_replica_ejected", "desync",
+    "sweep_replica_nan", "sweep_replica_ejected", "sweep_member_backfill",
+    "desync",
     "ckpt_truncate", "ckpt_bitflip_manifest",
     "serve_replica_error", "serve_replica_slow", "serve_batcher_crash",
     "http_malformed",
@@ -120,7 +121,8 @@ def test_quick_serve_and_ckpt_drills(tmp_path):
     failed = [d for d in record["matrix"] if not d["ok"]]
     assert not failed, json.dumps(failed, indent=1, default=str)[:4000]
     assert {d["drill"] for d in record["matrix"]} == {
-        "sweep_replica_nan", "sweep_replica_ejected", "desync",
+        "sweep_replica_nan", "sweep_replica_ejected",
+        "sweep_member_backfill", "desync",
         "ckpt_truncate", "ckpt_bitflip_manifest", "serve_replica_error",
         "serve_replica_slow", "serve_batcher_crash", "http_malformed",
     }

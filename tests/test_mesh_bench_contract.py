@@ -1,0 +1,133 @@
+"""The mesh-engine bench artifact contract (ISSUE 13).
+
+BENCH_MESH_CPU.json is the committed evidence the shard_map sweep engine
+and the mesh-shape-portable checkpoints rest on: a serial-parity row
+(shard_map replica == ``DIBTrainer``, bit for bit) plus
+reshard-on-restore round-trips at widths {R/2, 1, 2R}, each continued
+and compared bit-identically against the uninterrupted width-R run.
+These tests pin the record's per-row schema
+(``scripts/check_run_artifacts.py:_check_mesh_bench``), the
+zero-parity-failure gate (SLO.json ``mesh_reshard_parity_failures_max``
+— evaluated directly by ``telemetry check BENCH_MESH_CPU.json``), and
+the seeded fleet-registry history.
+"""
+
+import copy
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ARTIFACT = os.path.join(REPO, "BENCH_MESH_CPU.json")
+
+
+@pytest.fixture(scope="module")
+def checker():
+    spec = importlib.util.spec_from_file_location(
+        "check_run_artifacts",
+        os.path.join(REPO, "scripts", "check_run_artifacts.py"))
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+@pytest.fixture(scope="module")
+def committed():
+    with open(ARTIFACT) as f:
+        return json.load(f)
+
+
+def test_committed_mesh_artifact_validates(checker):
+    assert os.path.exists(ARTIFACT), (
+        "BENCH_MESH_CPU.json missing — run `python scripts/bench_mesh.py "
+        "--out BENCH_MESH_CPU.json` and commit the record")
+    assert checker.check_file(ARTIFACT) == []
+
+
+def test_committed_record_is_green(committed):
+    assert committed["metric"] == "mesh_reshard_bench"
+    assert committed["unit"] == "parity_failures"
+    assert committed["value"] == committed["parity_failures"] == 0
+    assert committed["all_parity_ok"] is True
+    rows = {r["scenario"]: r for r in committed["rows"]}
+    assert "serial_parity" in rows
+    # shrink, carve-out AND grow are all in the committed sweep
+    saved = max(r["saved_width"] for r in committed["rows"])
+    restored = {r["restored_width"] for r in committed["rows"]}
+    assert restored >= {saved // 2, 1, 2 * saved}
+    assert all(r["bit_identical"] for r in committed["rows"])
+
+
+def test_checker_rejects_broken_shapes(checker, committed):
+    def problems_of(record):
+        problems: list[str] = []
+        checker.check_record(record, problems)
+        return problems
+
+    broken = copy.deepcopy(committed)
+    broken["rows"][1]["bit_identical"] = False
+    probs = problems_of(broken)
+    assert any("bit-identical" in p for p in probs)
+    assert any("disagrees" in p for p in probs)
+
+    no_serial = copy.deepcopy(committed)
+    no_serial["rows"] = [r for r in no_serial["rows"]
+                         if r["scenario"] != "serial_parity"]
+    assert any("serial_parity" in p for p in problems_of(no_serial))
+
+    no_reshard = copy.deepcopy(committed)
+    no_reshard["rows"] = [r for r in no_reshard["rows"]
+                          if r["saved_width"] == r["restored_width"]]
+    assert any("width different" in p for p in problems_of(no_reshard))
+
+    bad_engine = copy.deepcopy(committed)
+    bad_engine["rows"][0]["engine"] = "pmap"
+    assert any("engine" in p for p in problems_of(bad_engine))
+
+
+def test_slo_gate_exit_codes(tmp_path, committed):
+    """`telemetry check` on the committed record is green; a record with
+    a parity failure trips the page-severity rule at rc 1."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    ok = subprocess.run(
+        [sys.executable, "-m", "dib_tpu", "telemetry", "check", ARTIFACT,
+         "--slo", os.path.join(REPO, "SLO.json")],
+        capture_output=True, text=True, cwd=REPO, env=env)
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+
+    bad = copy.deepcopy(committed)
+    bad["parity_failures"] = bad["value"] = 1
+    bad["all_parity_ok"] = False
+    bad_path = tmp_path / "BENCH_MESH_BAD.json"
+    bad_path.write_text(json.dumps(bad))
+    trip = subprocess.run(
+        [sys.executable, "-m", "dib_tpu", "telemetry", "check",
+         str(bad_path), "--slo", os.path.join(REPO, "SLO.json")],
+        capture_output=True, text=True, cwd=REPO, env=env)
+    assert trip.returncode == 1, trip.stdout + trip.stderr
+    report = json.loads(trip.stdout)
+    violated = [r for r in report["rules"]
+                if r.get("status") == "violated"]
+    assert [r["rule"] for r in violated] == [
+        "mesh_reshard_parity_failures_max"]
+
+
+def test_registry_seeded_with_mesh_history():
+    entries = []
+    with open(os.path.join(REPO, "runs", "index.jsonl")) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                entries.append(json.loads(line))
+    mesh = [e for e in entries if e.get("metric") == "mesh_reshard_bench"]
+    assert mesh, "runs/index.jsonl must carry the seeded mesh bench entry"
+    assert mesh[-1]["value"] == 0
+    assert mesh[-1]["parity_failures"] == 0
+    drills = [e for e in entries
+              if e.get("metric") == "fault_drill_matrix"]
+    # the refreshed 14-drill record (sweep_member_backfill included)
+    assert drills[-1]["value"] == 14 and drills[-1]["all_passed"] is True

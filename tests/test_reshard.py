@@ -1,0 +1,485 @@
+"""Mesh-shape-portable checkpoints + the shard_map sweep engine.
+
+The PR 13 acceptance contract (docs/parallelism.md):
+
+  - the explicit ``('sweep', 'data')`` shard_map engine matches the serial
+    ``DIBTrainer`` BIT-identically on the same keys (one replica per shard
+    traces exactly the serial epoch body);
+  - a checkpoint saved at sweep width R restores and CONTINUES training at
+    width R' != R — shrink, grow, width-1 carve-out — with the matched
+    members' histories, resume keys, and continued trajectories
+    bit-identical to the uninterrupted width-R run
+    (``parallel/elastic.py:restore_sweep_resharded``);
+  - pre-mesh (manifest v1) checkpoints still restore: the reshard is
+    vacuous, widths must match, nothing breaks.
+
+Fit-driving tests share the module-scoped width-4 baseline + checkpoint
+fixtures; the grow case (2R) rides the slow tier with the rest of the
+heavy sweep matrix (tests/test_parallel.py convention).
+"""
+
+import json
+import os
+import shutil
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dib_tpu.data import get_dataset
+from dib_tpu.models import DistributedIBModel
+from dib_tpu.parallel import (
+    BetaSweepTrainer,
+    backfill_member,
+    factor_devices,
+    make_sweep_engine_mesh,
+    make_sweep_mesh,
+    restore_sweep_resharded,
+    validate_sweep_shapes,
+)
+from dib_tpu.train import CheckpointHook, DIBCheckpointer, DIBTrainer, TrainConfig
+from dib_tpu.train.checkpoint import (
+    MANIFEST_FILENAME,
+    read_manifest,
+    write_manifest,
+)
+
+CHUNK = 4
+ENDS = (0.03, 0.1, 0.3, 1.0)
+
+CFG = TrainConfig(
+    batch_size=64,
+    beta_start=1e-3,
+    beta_end=1.0,
+    num_pretraining_epochs=2,
+    num_annealing_epochs=6,
+    steps_per_epoch=2,
+    max_val_points=128,
+)
+
+
+@pytest.fixture(scope="module")
+def bundle():
+    return get_dataset("boolean_circuit")
+
+
+@pytest.fixture(scope="module")
+def model(bundle):
+    return DistributedIBModel(
+        feature_dimensionalities=tuple(bundle.feature_dimensionalities),
+        encoder_hidden=(8,),
+        integration_hidden=(16,),
+        output_dim=1,
+        embedding_dim=2,
+    )
+
+
+def _keys():
+    return jax.random.split(jax.random.key(0), len(ENDS))
+
+
+def _history_identical(a, b):
+    return (np.array_equal(a.loss, b.loss)
+            and np.array_equal(a.kl_per_feature, b.kl_per_feature)
+            and np.array_equal(a.beta, b.beta))
+
+
+@pytest.fixture(scope="module")
+def full_run(model, bundle):
+    """The uninterrupted width-4 shard_map run every reshard compares to.
+
+    ``hook_every=CHUNK`` pins the chunk boundaries — the PRNG chain is
+    keyed to them, so bit-identical continuation (like bit-identical
+    resume everywhere else in the tree) is defined at matching chunk
+    size."""
+    mesh = make_sweep_engine_mesh(len(ENDS), 1)
+    sweep = BetaSweepTrainer(model, bundle, CFG, 1e-3, jnp.asarray(ENDS),
+                             mesh=mesh)
+    assert sweep.engine == "shard_map"
+    states, records = sweep.fit(_keys(), hook_every=CHUNK)
+    return {
+        "states": states,
+        "records": records,
+        "resume_key": sweep.resume_key,
+    }
+
+
+@pytest.fixture(scope="module")
+def ckpt_dir(model, bundle, tmp_path_factory):
+    """A width-4 checkpoint saved mid-run (epoch 4 of 8) on the shard_map
+    mesh — the artifact every reshard test restores from."""
+    path = tmp_path_factory.mktemp("reshard") / "ckpt"
+    mesh = make_sweep_engine_mesh(len(ENDS), 1)
+    sweep = BetaSweepTrainer(model, bundle, CFG, 1e-3, jnp.asarray(ENDS),
+                             mesh=mesh)
+    ckpt = DIBCheckpointer(str(path))
+    sweep.fit(_keys(), num_epochs=CHUNK, hooks=[CheckpointHook(ckpt)],
+              hook_every=CHUNK)
+    ckpt.close()
+    return str(path)
+
+
+# ------------------------------------------------------- engine contract
+def test_shard_map_engine_matches_serial_bit_identical(model, bundle):
+    """THE numerical contract: a shard_map sweep replica with the serial
+    trainer's key reproduces it bit for bit (not tolerance — equality)."""
+    key = jax.random.key(7)
+    serial = DIBTrainer(model, bundle, CFG)
+    _, hist = serial.fit(key)
+
+    mesh = make_sweep_engine_mesh(1, 1)
+    sweep = BetaSweepTrainer(model, bundle, CFG, CFG.beta_start,
+                             jnp.asarray([CFG.beta_end]), mesh=mesh)
+    assert sweep.engine == "shard_map"
+    _, records = sweep.fit(jnp.stack([key]))
+
+    assert np.array_equal(np.asarray(records[0].loss), np.asarray(hist.loss))
+    assert np.array_equal(np.asarray(records[0].kl_per_feature),
+                          np.asarray(hist.kl_per_feature))
+    assert np.array_equal(np.asarray(records[0].beta), np.asarray(hist.beta))
+
+
+def test_data_sharded_engine_trains_deterministically(model, bundle):
+    """The nd>1 arm: each data shard gathers only ITS permutation row
+    block (`_epoch_batches` pre-slices the index array) and draws
+    shard-folded noise, so the run is a different — equally valid —
+    stochastic realization than nd=1 (docs/parallelism.md, "Numerical
+    contract"). Pin what the contract does promise: the run trains,
+    and it is bit-reproducible."""
+    mesh = make_sweep_engine_mesh(2, 2)
+
+    def run():
+        sweep = BetaSweepTrainer(model, bundle, CFG, 1e-3,
+                                 jnp.asarray(ENDS[:2]), mesh=mesh)
+        assert sweep.engine == "shard_map"
+        _, records = sweep.fit(_keys()[:2], hook_every=CHUNK)
+        return records
+
+    first, second = run(), run()
+    for ra, rb in zip(first, second):
+        assert np.isfinite(np.asarray(ra.loss)).all()
+        assert _history_identical(ra, rb)
+
+
+def test_engine_selection_and_validation(model, bundle):
+    ends = jnp.asarray([0.1, 1.0])
+    # no mesh: vmap fallback; forcing shard_map without a mesh is an error
+    plain = BetaSweepTrainer(model, bundle, CFG, 1e-3, ends)
+    assert plain.engine == "vmap"
+    with pytest.raises(ValueError, match="make_sweep_engine_mesh"):
+        BetaSweepTrainer(model, bundle, CFG, 1e-3, ends, engine="shard_map")
+    # 'sweep' mesh: auto resolves to shard_map, forcing vmap stays allowed
+    # (the A/B parity configuration)
+    mesh = make_sweep_engine_mesh(2, 1)
+    assert BetaSweepTrainer(model, bundle, CFG, 1e-3, ends,
+                            mesh=mesh).engine == "shard_map"
+    assert BetaSweepTrainer(model, bundle, CFG, 1e-3, ends, mesh=mesh,
+                            engine="vmap").engine == "vmap"
+    # legacy 'beta' mesh cannot drive the shard_map engine
+    legacy = make_sweep_mesh(2, 1)
+    assert BetaSweepTrainer(model, bundle, CFG, 1e-3, ends,
+                            mesh=legacy).engine == "vmap"
+    with pytest.raises(ValueError, match="'beta' mesh drives the vmap"):
+        BetaSweepTrainer(model, bundle, CFG, 1e-3, ends, mesh=legacy,
+                         engine="shard_map")
+    with pytest.raises(ValueError, match="engine must be"):
+        BetaSweepTrainer(model, bundle, CFG, 1e-3, ends, engine="pjit")
+
+
+# --------------------------------------------------- reshard-on-restore
+def test_reshard_shrink_continues_bit_identically(model, bundle, full_run,
+                                                  ckpt_dir):
+    """Width 4 -> 2: the surviving members' continued trajectories AND
+    final resume keys match the uninterrupted width-4 run exactly."""
+    mesh = make_sweep_engine_mesh(2, 1)
+    sweep = BetaSweepTrainer(model, bundle, CFG, 1e-3,
+                             jnp.asarray([ENDS[1], ENDS[3]]), mesh=mesh)
+    ckpt = DIBCheckpointer(ckpt_dir)
+    try:
+        states, histories, keys, info = restore_sweep_resharded(
+            ckpt, sweep, chunk_size=CHUNK)
+    finally:
+        ckpt.close()
+    assert info["saved_width"] == 4 and info["restored_width"] == 2
+    assert info["matched"] == [0, 1] and info["new"] == []
+
+    done = int(np.max(np.asarray(jax.device_get(states.epoch))))
+    _, records = sweep.fit(keys, num_epochs=CFG.num_epochs - done,
+                           states=states, histories=histories,
+                           hook_every=CHUNK)
+    for lane, rec in zip((1, 3), records):
+        assert _history_identical(full_run["records"][lane], rec)
+    # the resume-key chain is the SAME bitstream the width-4 run ended on
+    want = np.asarray(jax.random.key_data(full_run["resume_key"]))[[1, 3]]
+    got = np.asarray(jax.random.key_data(sweep.resume_key))
+    assert np.array_equal(got, want)
+
+
+def test_reshard_carveout_width_one_no_mesh(model, bundle, full_run,
+                                            ckpt_dir):
+    """Width 4 -> 1, meshless: carve one member out of a pod-trained
+    checkpoint and continue it on a single device."""
+    sweep = BetaSweepTrainer(model, bundle, CFG, 1e-3,
+                             jnp.asarray([ENDS[2]]))
+    ckpt = DIBCheckpointer(ckpt_dir)
+    try:
+        states, histories, keys, info = restore_sweep_resharded(
+            ckpt, sweep, chunk_size=CHUNK)
+    finally:
+        ckpt.close()
+    assert info["saved_width"] == 4 and info["restored_width"] == 1
+    assert info["matched"] == [0]
+
+    done = int(np.max(np.asarray(jax.device_get(states.epoch))))
+    _, records = sweep.fit(keys, num_epochs=CFG.num_epochs - done,
+                           states=states, histories=histories,
+                           hook_every=CHUNK)
+    assert _history_identical(full_run["records"][2], records[0])
+
+
+@pytest.mark.slow
+def test_reshard_grow_matches_and_inits_new(model, bundle, full_run,
+                                            ckpt_dir):
+    """Width 4 -> 8: matched members continue bit-identically; the four
+    new endpoints start fresh from their own keys at epoch 0."""
+    ends8 = jnp.asarray(list(ENDS) + [3.0, 10.0, 0.01, 0.05])
+    mesh = make_sweep_engine_mesh(8, 1)
+    sweep = BetaSweepTrainer(model, bundle, CFG, 1e-3, ends8, mesh=mesh)
+    ckpt = DIBCheckpointer(ckpt_dir)
+    try:
+        new_keys = jax.random.split(jax.random.key(99), 4)
+        states, histories, keys, info = restore_sweep_resharded(
+            ckpt, sweep, chunk_size=CHUNK, new_member_keys=new_keys)
+    finally:
+        ckpt.close()
+    assert info["matched"] == [0, 1, 2, 3] and info["new"] == [4, 5, 6, 7]
+    epochs = np.asarray(jax.device_get(states.epoch))
+    assert list(epochs) == [CHUNK] * 4 + [0] * 4
+
+    done = int(np.max(epochs))
+    _, records = sweep.fit(keys, num_epochs=CFG.num_epochs - done,
+                           states=states, histories=histories,
+                           hook_every=CHUNK)
+    for lane in range(4):
+        assert _history_identical(full_run["records"][lane], records[lane])
+    # new members actually trained (their own beta ramps, finite losses)
+    for lane in range(4, 8):
+        tail = np.asarray(records[lane].loss)[-(CFG.num_epochs - done):]
+        assert np.isfinite(tail).all()
+
+
+def test_reshard_grow_requires_new_member_keys(model, bundle, ckpt_dir):
+    ends = jnp.asarray(list(ENDS) + [42.0])
+    sweep = BetaSweepTrainer(model, bundle, CFG, 1e-3, ends)
+    ckpt = DIBCheckpointer(ckpt_dir)
+    try:
+        with pytest.raises(ValueError, match="new_member_keys"):
+            restore_sweep_resharded(ckpt, sweep, chunk_size=CHUNK)
+    finally:
+        ckpt.close()
+
+
+def test_premesh_checkpoint_restores_vacuously(model, bundle, ckpt_dir,
+                                               tmp_path):
+    """Backward compat: a manifest-v1 checkpoint (no mesh block) restores
+    through the plain path — same width, vacuous reshard, no error."""
+    legacy = tmp_path / "legacy_ckpt"
+    shutil.copytree(ckpt_dir, legacy)
+    path = legacy / MANIFEST_FILENAME
+    manifest = json.loads(path.read_text())
+    manifest.pop("mesh", None)
+    manifest.pop("sharding_rows", None)
+    manifest["checkpoint_schema"] = 1
+    path.write_text(json.dumps(manifest))
+
+    mesh = make_sweep_engine_mesh(len(ENDS), 1)
+    sweep = BetaSweepTrainer(model, bundle, CFG, 1e-3, jnp.asarray(ENDS),
+                             mesh=mesh)
+    ckpt = DIBCheckpointer(str(legacy))
+    try:
+        states, histories, keys, info = restore_sweep_resharded(
+            ckpt, sweep, chunk_size=CHUNK)
+    finally:
+        ckpt.close()
+    assert info["saved_width"] == info["restored_width"] == len(ENDS)
+    assert info["saved_mesh_axes"] is None
+    assert int(np.max(np.asarray(jax.device_get(states.epoch)))) == CHUNK
+
+
+# ----------------------------------------------------- manifest contract
+def test_manifest_v2_mesh_rows(ckpt_dir):
+    manifest = read_manifest(ckpt_dir)
+    assert manifest["checkpoint_schema"] == 2
+    block = manifest["mesh"]
+    assert block["logical_grid"] == [len(ENDS)]
+    assert block["beta_ends"] == [pytest.approx(b) for b in ENDS]
+    assert block["engine"] == "shard_map"
+    assert block["mesh_axes"] == {"sweep": len(ENDS), "data": 1}
+    assert block["replica_axis"] == "sweep"
+    rows = manifest["sharding_rows"]
+    assert rows == sorted(rows) and rows
+    # every row is "leaf-path partition-spec"
+    assert all(len(r.split(" ", 1)) == 2 for r in rows)
+    assert any(r.startswith("state") for r in rows)
+    assert any(r.startswith("history") for r in rows)
+
+
+def test_serial_manifest_carries_no_mesh_block(tmp_path):
+    params = {"w": jnp.zeros((2,))}
+    write_manifest(str(tmp_path), params)
+    manifest = read_manifest(str(tmp_path))
+    # mesh-free manifests stay on schema 1: the payload is unchanged, so
+    # a v1-only reader (a not-yet-upgraded worker stealing a serial unit
+    # mid-rolling-upgrade) must keep restoring them
+    assert manifest["checkpoint_schema"] == 1
+    assert "mesh" not in manifest and "sharding_rows" not in manifest
+
+
+# ------------------------------------------------------------ mesh utils
+def test_factor_devices_num_replicas_mode():
+    # never factors the sweep axis wider than R — and it always DIVIDES R
+    assert factor_devices(8, num_replicas=6) == (2, 4)
+    assert factor_devices(8, num_replicas=8) == (8, 1)
+    assert factor_devices(8, num_replicas=3) == (1, 8)
+    assert factor_devices(8, num_replicas=16) == (8, 1)
+    assert factor_devices(6, num_replicas=4) == (2, 3)
+    assert factor_devices(8, num_replicas=1) == (1, 8)
+    with pytest.raises(ValueError, match="num_replicas"):
+        factor_devices(8, num_replicas=0)
+    # legacy mode unchanged
+    assert factor_devices(8) == (4, 2)
+
+
+def test_validate_sweep_shapes_errors_name_the_fix():
+    mesh = make_sweep_engine_mesh(4, 2)
+    with pytest.raises(ValueError, match=r"num_replicas=8"):
+        validate_sweep_shapes(mesh, 6, 64)
+    with pytest.raises(ValueError, match=r"factor_devices"):
+        validate_sweep_shapes(mesh, 6, 64)
+    with pytest.raises(ValueError, match=r"pad batch_size to 64"):
+        validate_sweep_shapes(mesh, 4, 63)
+    # clean shapes pass for both mesh flavors
+    validate_sweep_shapes(mesh, 4, 64)
+    validate_sweep_shapes(make_sweep_mesh(4, 2), 4, 64)
+
+
+def test_sweep_engine_mesh_axes():
+    mesh = make_sweep_engine_mesh(4, 2)
+    assert mesh.shape == {"sweep": 4, "data": 2}
+    from dib_tpu.parallel import sweep_axis_name
+
+    assert sweep_axis_name(mesh) == "sweep"
+    assert sweep_axis_name(make_sweep_mesh(4, 2)) == "beta"
+
+
+# ---------------------------------------------------- scheduler mesh unit
+def test_sched_runner_sweep_unit_and_reshard_resume(tmp_path):
+    """The scheduler hands a job a whole mesh: a unit whose spec carries
+    ``betas`` trains the full grid as ONE sweep, and a re-submission at a
+    different grid width reshards the unit's checkpoint instead of
+    wedging (the stolen-by-a-differently-shaped-holder path)."""
+    from dib_tpu.sched import TrainingUnitRunner
+    from dib_tpu.sched.scheduler import WorkUnit
+
+    spec = {"betas": [0.1, 1.0], "chunk_epochs": 2}
+    unit = WorkUnit(unit_id="u1", job_id="j1", beta=1.0, seed=3,
+                    train=spec)
+    mesh = make_sweep_engine_mesh(2, 1)
+    runner = TrainingUnitRunner(str(tmp_path), mesh=mesh)
+    result = runner(unit)
+    assert result["betas"] == [0.1, 1.0]
+    assert result["replicas"] == 2
+    assert result["engine"] == "shard_map"
+    assert result["epochs"] == 8
+    saved = np.load(runner.history_path(unit))
+    assert saved["loss"].shape[0] == 2
+    assert np.isfinite(saved["loss"]).all()
+
+    # re-submit the SAME unit dir at a wider grid on a different mesh:
+    # matched members restore, new ones initialize from the unit seed
+    wide = WorkUnit(unit_id="u1", job_id="j1", beta=1.0, seed=3,
+                    train={"betas": [0.1, 1.0, 3.0], "chunk_epochs": 2})
+    meshless_runner = TrainingUnitRunner(str(tmp_path))
+    result2 = meshless_runner(wide)
+    assert result2["replicas"] == 3
+    wide_hist = np.load(meshless_runner.history_path(wide))
+    assert wide_hist["loss"].shape[0] == 3
+    # matched members carried their exact trajectories through the reshard
+    assert np.array_equal(wide_hist["loss"][0], saved["loss"][0])
+    assert np.array_equal(wide_hist["loss"][1], saved["loss"][1])
+    # the grown member trained to COMPLETION: the re-submitted unit was
+    # already finished, so the lockstep fit alone would have given it
+    # zero epochs — the runner's leveling carve-out owes it the full
+    # schedule, and the unit must not report an untrained lane as done
+    assert np.isfinite(wide_hist["loss"][2]).all()
+    assert wide_hist["loss"].shape[1] == 8
+    assert all(loss is not None for loss in result2["final_loss"])
+
+
+# ------------------------------------------------- consolidation serving
+def test_consolidated_sweep_checkpoint_serves_from_zoo(model, bundle,
+                                                       ckpt_dir):
+    """The consolidation-for-serving recipe (docs/parallelism.md): a
+    mesh-trained sweep checkpoint registers on a zoo directly — the
+    restore IS the reshard onto the serving host — and every member
+    serves as a β-labeled replica."""
+    from dib_tpu.serve.zoo import ModelZoo
+
+    zoo = ModelZoo(response_capacity=8)
+    router = zoo.add_sweep_checkpoint("sweep", ckpt_dir, model, bundle,
+                                      CFG, max_wait_ms=0.0)
+    try:
+        assert len(router.entries) == len(ENDS)
+        assert sorted(e.beta_end for e in router.entries) == pytest.approx(
+            sorted(ENDS))
+        # log-nearest β routing picks the right member
+        assert router.route(beta=0.09).beta_end == pytest.approx(0.1)
+        x = np.asarray(bundle.x_valid[:1], np.float32)
+        out = router.route(beta=1.0).engine.predict(x)
+        assert np.isfinite(np.asarray(out["prediction"])).all()
+        name, resolved = zoo.resolve("sweep")
+        assert name == "sweep" and resolved is router
+    finally:
+        zoo.close()
+
+    # a serial (mesh-block-free) checkpoint is rejected with a named error
+    from dib_tpu.parallel.elastic import consolidate_sweep_checkpoint
+    from dib_tpu.train.checkpoint import DIBCheckpointer
+
+    with pytest.raises(ValueError, match="no mesh manifest block"):
+        import tempfile
+
+        empty = tempfile.mkdtemp()
+        write_manifest(empty, {"w": jnp.zeros((2,))})
+        ck = DIBCheckpointer(empty)
+        try:
+            consolidate_sweep_checkpoint(ck, model, bundle, CFG)
+        finally:
+            ck.close()
+
+
+# -------------------------------------------------------- telemetry view
+def test_mesh_rollup():
+    from dib_tpu.telemetry.summary import mesh_rollup
+
+    events = [
+        {"type": "run_start",
+         "manifest": {"mesh_shape": {"sweep": 4, "data": 2},
+                      "sweep_engine": "shard_map"}},
+        {"type": "mitigation", "mtype": "sweep_reshard",
+         "saved_width": 4, "restored_width": 2,
+         "saved_mesh_axes": {"sweep": 4, "data": 2},
+         "mesh_axes": {"sweep": 2, "data": 1}},
+        {"type": "mitigation", "mtype": "member_backfill", "replica": 1},
+        {"type": "mitigation", "mtype": "watchdog"},  # unrelated
+    ]
+    rollup = mesh_rollup(events)
+    assert rollup["axes"] == {"sweep": 4, "data": 2}
+    assert rollup["engine"] == "shard_map"
+    assert rollup["reshards"] == 1
+    assert rollup["reshard_events"][0]["restored_width"] == 2
+    assert rollup["backfills"] == 1
+    assert rollup["backfilled_replicas"] == [1]
+    # serial runs carry no mesh plane at all
+    assert mesh_rollup([{"type": "run_start", "manifest": {}}]) is None
