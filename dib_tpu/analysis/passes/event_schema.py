@@ -76,6 +76,8 @@ _BACKTICKED_RE = re.compile(r"`([a-z_0-9]+)`")
 _ROLLUP_DOC_CHECKS = (
     ("serving_rollup", _SERVING_KEYS_MARKER),
     ("streaming_rollup", "Streaming-rollup keys"),
+    # ISSUE 14: the numerical-integrity rollup (anomaly/quarantine view)
+    ("integrity_rollup", "Integrity-rollup keys"),
 )
 
 

@@ -89,6 +89,14 @@ class HostSyncPass(LintPass):
         # fleet serves — a hidden sync there stalls promotion under load
         "dib_tpu/stream/online.py",
         "dib_tpu/stream/deployer.py",
+        # the integrity plane joined with ISSUE 14: the anomaly detector
+        # runs INSIDE the chunk loop on every boundary (it must consume
+        # only the row fetch the boundary already pays for — an implicit
+        # sync there re-serializes training), and the digest/scrub layer
+        # walks restored payloads (explicit device_get only)
+        "dib_tpu/train/anomaly.py",
+        "dib_tpu/train/scrub.py",
+        "dib_tpu/train/checkpoint.py",
     )
 
     def check_module(self, module: Module) -> list[Finding]:

@@ -91,7 +91,7 @@ def build_parser() -> argparse.ArgumentParser:
     )
     parser.add_argument("command", nargs="?", default="train",
                         choices=["train", "workload", "telemetry", "serve",
-                                 "lint", "sched", "stream"],
+                                 "lint", "sched", "stream", "ckpt"],
                         help="Subcommand: 'train' (flags below), 'workload' "
                              "(paper workloads; see `dib_tpu workload --help`), "
                              "'telemetry' (summarize/compare/report run "
@@ -101,9 +101,11 @@ def build_parser() -> argparse.ArgumentParser:
                              "analysis over the tree; see "
                              "`dib_tpu lint --help`), 'sched' (the "
                              "fault-tolerant β-grid scheduler; see "
-                             "`dib_tpu sched --help`), or 'stream' (the "
+                             "`dib_tpu sched --help`), 'stream' (the "
                              "always-on train-to-serve control plane; see "
-                             "`dib_tpu stream --help`).")
+                             "`dib_tpu stream --help`), or 'ckpt' "
+                             "(checkpoint content-integrity tooling: "
+                             "`dib_tpu ckpt scrub <dir>`).")
     _add_model_flags(parser)
     parser.add_argument("--artifact_outdir", type=str, default="./training_artifacts/")
     parser.add_argument("--learning_rate", type=float, default=3e-4)
@@ -627,17 +629,14 @@ def _preempted_summary(args, summary, telemetry, outdir, exc) -> dict:
 
 def _ckpt_fallback_reporter(telemetry):
     """on_fallback for ``restore_latest_intact``: every corrupt step skipped
-    during auto-resume is a mitigation (``checkpoint_fallback``) on the run
-    stream and a loud stderr line — recovery must never be silent."""
+    during auto-resume is a mitigation (``checkpoint_fallback``) plus a
+    ``quarantine`` event on the run stream and a loud stderr line —
+    recovery must never be silent (train/checkpoint.py:fallback_reporter)."""
+    from dib_tpu.train.checkpoint import fallback_reporter
 
-    def report(info: dict) -> None:
-        print(f"warning: checkpoint step {info['step']} is corrupt, "
-              f"falling back to the previous step ({info['error']})",
-              file=sys.stderr)
-        if telemetry is not None:
-            telemetry.mitigation(mtype="checkpoint_fallback", **info)
-
-    return report
+    return fallback_reporter(
+        telemetry, source="auto-resume",
+        log=lambda msg: print(f"warning: {msg}", file=sys.stderr))
 
 
 def _save_info_bounds(path: str, epochs, bounds_bits,
@@ -1294,9 +1293,16 @@ def main(argv: Sequence[str] | None = None) -> int:
             from dib_tpu.stream.cli import stream_main
 
             return stream_main(argv[1:])
+        if argv and argv[0] == "ckpt":
+            # content-integrity scrub over a checkpoint directory
+            # (docs/robustness.md "Numerical integrity"); restores run on
+            # whatever backend is configured (CPU is fine)
+            from dib_tpu.train.scrub import ckpt_main
+
+            return ckpt_main(argv[1:])
         args = build_parser().parse_args(argv)
         if args.command in ("workload", "telemetry", "serve", "lint",
-                            "sched", "stream"):
+                            "sched", "stream", "ckpt"):
             # parsed from a non-leading position (flags first): these
             # subcommands' flags are not the train flags, so re-dispatching
             # would misparse. Name the flag that displaced the subcommand

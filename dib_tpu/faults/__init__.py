@@ -19,12 +19,15 @@ mitigations they provoked into an injected/detected/recovered rollup, and
 """
 
 from dib_tpu.faults.inject import (
+    SDC_SCALE,
     PoisonedReplicaRestore,
     apply_due_train_faults,
     corrupt_checkpoint,
     expire_lease,
     poison_params,
     poison_replica_params,
+    scale_params,
+    scale_replica_params,
     tear_journal,
 )
 from dib_tpu.faults.plan import FAULT_KINDS, FaultPlan, FaultSpec
@@ -36,6 +39,7 @@ from dib_tpu.faults.serve import (
 
 __all__ = [
     "FAULT_KINDS",
+    "SDC_SCALE",
     "FaultPlan",
     "FaultSpec",
     "FlakyEngine",
@@ -47,5 +51,7 @@ __all__ = [
     "kill_batcher_worker",
     "poison_params",
     "poison_replica_params",
+    "scale_params",
+    "scale_replica_params",
     "tear_journal",
 ]

@@ -50,6 +50,58 @@ def poison_params(params, value: float):
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
+#: Finite corruption factor for the `replica_sdc` plan kind (the serial
+#: `sdc` kind carries its factor as the spec arg; the replica kind's arg
+#: slot names the member). Large enough that the boundary metrics leave
+#: the trailing window's robust band by orders of magnitude, small enough
+#: that float32 forward passes AND the chunk of training that follows
+#: stay finite — the whole point: garbage the non-finite guard cannot
+#: see. (Factors ≥ ~32 compound through the layers into inf/NaN within
+#: one chunk, which collapses this fault into the classic `nan` drill.)
+SDC_SCALE = 4.0
+
+
+def scale_params(params, factor: float):
+    """Return ``params`` with EVERY leaf scaled by a finite ``factor`` —
+    the silent-data-corruption injector: the model still runs, every
+    number is finite, and every number is wrong. Only the β-aware
+    anomaly detector (train/anomaly.py) can catch the resulting boundary
+    metrics; the non-finite divergence guard is blind to them.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    if not leaves:
+        raise ValueError("cannot corrupt an empty param tree")
+    factor = jnp.asarray(factor)
+    return jax.tree_util.tree_unflatten(
+        treedef, [leaf * factor.astype(leaf.dtype) for leaf in leaves])
+
+
+def scale_replica_params(params, replica: int, factor: float):
+    """Finite SDC on ONE sweep member: scale replica ``replica``'s slice
+    of every stacked ``[R, ...]`` leaf by ``factor`` (the per-member
+    analogue of :func:`scale_params`; other lanes untouched)."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    if not leaves:
+        raise ValueError("cannot corrupt an empty param tree")
+    out = []
+    for leaf in leaves:
+        if leaf.ndim < 1 or not 0 <= replica < leaf.shape[0]:
+            raise ValueError(
+                f"replica_sdc target {replica} is out of range for a "
+                f"stacked leaf of shape {tuple(leaf.shape)} — the fault "
+                "targets a sweep member index in [0, R)"
+            )
+        out.append(leaf.at[replica].multiply(
+            jnp.asarray(factor, leaf.dtype)))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
 def poison_replica_params(params, replica: int, value: float):
     """Poison ONE sweep member: set replica ``replica``'s slice of the
     first (path-sorted) stacked ``[R, ...]`` leaf to ``value``.
@@ -145,7 +197,7 @@ def apply_due_train_faults(plan: FaultPlan, chunk_index: int, state,
             # sweeps carry [R] epochs advancing in lockstep
             epoch = int(np.max(np.asarray(jax.device_get(state.epoch))))
         extra = ({"replica": int(spec.arg)}
-                 if spec.kind == "replica_nan" else {})
+                 if spec.kind in ("replica_nan", "replica_sdc") else {})
         _emit_fault(telemetry, spec, epoch=epoch, **extra)
         log(f"fault injection: {spec.raw} firing at chunk boundary "
             f"{chunk_index} (epoch {epoch})")
@@ -163,6 +215,12 @@ def apply_due_train_faults(plan: FaultPlan, chunk_index: int, state,
         elif spec.kind == "replica_nan":
             state = state._replace(params=poison_replica_params(
                 state.params, int(spec.arg), float("nan")))
+        elif spec.kind == "sdc":
+            state = state._replace(params=scale_params(
+                state.params, float(spec.arg)))
+        elif spec.kind == "replica_sdc":
+            state = state._replace(params=scale_replica_params(
+                state.params, int(spec.arg), SDC_SCALE))
         else:  # parse() rejects non-train scopes; guard against drift
             raise ValueError(f"fault kind {spec.kind!r} is not train-scoped")
     return state
@@ -199,6 +257,22 @@ def expire_lease(scheduler, unit_id: str, telemetry=None) -> bool:
     return scheduler.force_expire(unit_id, "injected lease expiry")
 
 
+def _largest_file(root_dir: str, data_plane_only: bool = False):
+    """(path, size) of the largest file under ``root_dir`` — optionally
+    restricted to the tensorstore/ocdbt DATA plane (files under a ``d/``
+    dir). (None, 0) when nothing matches."""
+    largest, size = None, 0
+    for root, _, files in os.walk(root_dir):
+        if data_plane_only and os.path.basename(root) != "d":
+            continue
+        for name in files:
+            path = os.path.join(root, name)
+            s = os.path.getsize(path)
+            if s > size:
+                largest, size = path, s
+    return largest, size
+
+
 def _latest_step_dir(directory: str) -> str:
     """Newest numeric step dir of an Orbax checkpoint directory."""
     steps = [d for d in os.listdir(directory)
@@ -209,29 +283,59 @@ def _latest_step_dir(directory: str) -> str:
 
 
 def corrupt_checkpoint(directory: str, mode: str,
-                       telemetry=None) -> dict:
+                       telemetry=None, step: int | None = None) -> dict:
     """Corrupt a ``DIBCheckpointer`` directory the way hardware would.
 
     Modes:
       - ``ckpt_truncate``: truncate the largest file of the LATEST step dir
         to half its size (torn write / partial flush at kill time);
       - ``ckpt_bitflip_manifest``: XOR one byte in the middle of
-        ``dib_manifest.json`` (bit rot).
+        ``dib_manifest.json`` (bit rot);
+      - ``ckpt_bitflip_payload``: flip ONE BIT in the middle of the
+        largest file of a step dir (``step`` selects it; default the
+        latest) — the silent-data-corruption shape: the step's structure
+        stays intact and only the v3 content digests (or, when the flip
+        breaks the reader's framing, the corruption translation) can
+        catch it.
 
     Returns a description of what was damaged. Emits a ``fault`` event
     when ``telemetry`` is given.
     """
     from dib_tpu.train.checkpoint import MANIFEST_FILENAME
 
+    if mode == "ckpt_bitflip_payload":
+        step_dir = (_latest_step_dir(directory) if step is None
+                    else os.path.join(directory, str(step)))
+        # Prefer the tensorstore/ocdbt DATA plane (files under a d/
+        # dir): flipping array bytes leaves the step's structure fully
+        # readable — Orbax restores silently and ONLY the v3 content
+        # digest can catch it, which is the SDC shape this mode exists
+        # to inject. Metadata files would fail the reader instead (a
+        # different, easier fault). Fall back to largest-anything when
+        # the layout has no d/ plane.
+        largest, size = _largest_file(step_dir, data_plane_only=True)
+        if largest is None:
+            largest, size = _largest_file(step_dir)
+        if largest is None:
+            raise FileNotFoundError(f"nothing to corrupt under {step_dir}")
+        pos = size // 2
+        with open(largest, "rb+") as f:
+            f.seek(pos)
+            byte = f.read(1)
+            f.seek(pos)
+            f.write(bytes([byte[0] ^ 0x01]))
+        detail = {"kind": mode, "path": largest, "flipped_byte": pos,
+                  "flipped_bit": 0, "step_dir": step_dir}
+        if telemetry is not None:
+            telemetry.fault(**detail)
+        return detail
+    if step is not None:
+        raise ValueError(
+            f"corrupt_checkpoint mode {mode!r} does not take a step "
+            "(only ckpt_bitflip_payload targets a specific step)")
     if mode == "ckpt_truncate":
         step_dir = _latest_step_dir(directory)
-        largest, size = None, -1
-        for root, _, files in os.walk(step_dir):
-            for name in files:
-                path = os.path.join(root, name)
-                s = os.path.getsize(path)
-                if s > size:
-                    largest, size = path, s
+        largest, size = _largest_file(step_dir)
         if largest is None or size == 0:
             raise FileNotFoundError(f"nothing to truncate under {step_dir}")
         with open(largest, "rb+") as f:
@@ -253,7 +357,8 @@ def corrupt_checkpoint(directory: str, mode: str,
     else:
         raise ValueError(
             f"unknown checkpoint corruption mode {mode!r} "
-            "(ckpt_truncate | ckpt_bitflip_manifest)"
+            "(ckpt_truncate | ckpt_bitflip_manifest | "
+            "ckpt_bitflip_payload)"
         )
     if telemetry is not None:
         telemetry.fault(**detail)

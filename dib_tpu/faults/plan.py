@@ -84,6 +84,22 @@ FAULT_KINDS: dict[str, tuple[str, str | None, str]] = {
                "one host arrives at the chunk barrier with a stale "
                "(run_id, chunk, git_sha) — the desync guard names it "
                "instead of hanging; injected by the drill harness"),
+    "sdc": ("train", "scale",
+            "silent data corruption: scale every param leaf by a FINITE "
+            "factor so the next boundary's metrics are garbage but never "
+            "NaN — the β-aware anomaly-rollback path (train/anomaly.py); "
+            "arg = the scale factor"),
+    "replica_sdc": ("train", "replica",
+                    "finite SDC on ONE sweep member: scale member r's "
+                    "param slices so its lane goes anomalous without a "
+                    "NaN — the per-replica anomaly quarantine/ejection "
+                    "path (arg = replica index; the scale factor is "
+                    "faults.inject.SDC_SCALE)"),
+    "ckpt_bitflip_payload": ("checkpoint", None,
+                             "flip ONE BIT in a retained step's payload "
+                             "bytes (structure intact, bytes wrong) — "
+                             "the content-digest / scrub detection path "
+                             "(manifest schema v3)"),
     "sched_worker_kill": ("sched", "chunk",
                           "kill one pool worker dead mid-unit (no release, "
                           "no fail — its lease just goes silent): the "
@@ -103,7 +119,7 @@ FAULT_KINDS: dict[str, tuple[str, str | None, str]] = {
 }
 
 # Plan-grammar kinds whose ARG is mandatory (the others default sensibly).
-_ARG_REQUIRED = ("stall", "replica_nan")
+_ARG_REQUIRED = ("stall", "replica_nan", "sdc", "replica_sdc")
 
 _SPEC_RE = re.compile(
     r"^(?P<kind>[a-z_]+)@chunk(?P<chunk>\d+)(?::(?P<arg>[\d.]+)s?)?$"

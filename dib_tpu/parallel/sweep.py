@@ -62,6 +62,13 @@ from dib_tpu.train.loop import DIBTrainer, TrainConfig, TrainState
 
 Array = jax.Array
 
+#: Per-member global parameter L2 norm over the stacked [R, ...] params —
+#: the anomaly detector's gradient-norm stand-in channel, one tiny jitted
+#: reduction fetched with the stacked boundary row.
+_member_norms = jax.jit(jax.vmap(
+    lambda p: jnp.sqrt(sum(jnp.sum(jnp.square(x))
+                           for x in jax.tree.leaves(p)))))
+
 
 class BetaSweepTrainer:
     """Trains R DIB replicas over a grid of beta endpoints in one program.
@@ -410,6 +417,17 @@ class BetaSweepTrainer:
         start_epoch = cursor
         chunk_index = 0          # 1-based fit-boundary ordinal (fault plans)
         ejected: dict[int, dict] = {}
+        # one β-aware anomaly detector per member (train/anomaly.py): a
+        # lane whose finite metrics spike rides the same quarantine/
+        # ejection machinery as a NaN lane — the sweep ejects rather
+        # than poisons a member whose lane goes anomalous
+        from dib_tpu.train.anomaly import (
+            BoundaryAnomalyDetector,
+            boundary_channels,
+        )
+
+        detectors = [BoundaryAnomalyDetector.for_config(self.base.config)
+                     for _ in range(self.num_replicas)]
         diverged_warned = False
         self._telemetry_run_id = telemetry.run_id if telemetry else ""
         # desync guard: every host must enter this fit at the same chunk
@@ -455,11 +473,14 @@ class BetaSweepTrainer:
                 self.latest_history = histories
                 self.resume_chunk = chunk
                 # stacked boundary row: telemetry tags AND the per-replica
-                # divergence quarantine read it (one small fetch per chunk)
+                # divergence quarantine read it (one small fetch per
+                # chunk); per-member param norms ride the same fetch as
+                # the anomaly detector's gradient-norm stand-in channel
                 row = jax.device_get({
-                    name: histories[name][:, cursor + done - 1]
-                    for name in ("beta", "loss", "val_loss",
-                                 "kl_per_feature")
+                    "param_norm": _member_norms(states.params),
+                    **{name: histories[name][:, cursor + done - 1]
+                       for name in ("beta", "loss", "val_loss",
+                                    "kl_per_feature")},
                 })
                 if telemetry is not None:
                     recorder.record_chunk(
@@ -472,7 +493,27 @@ class BetaSweepTrainer:
                         kl_total=[float(x)
                                   for x in row["kl_per_feature"].sum(-1)],
                     )
-                bad = [r for r in _nonfinite_members(row)
+                nonfinite = set(_nonfinite_members(row))
+                anomalous: dict[int, list] = {}
+                for r in range(self.num_replicas):
+                    if r in ejected or r in nonfinite:
+                        continue
+                    member_findings = detectors[r].observe(
+                        cursor + done,
+                        _member_channels(row, r, boundary_channels))
+                    if member_findings:
+                        anomalous[r] = member_findings
+                        if telemetry is not None:
+                            for f in member_findings:
+                                telemetry.anomaly(
+                                    epoch=cursor + done,
+                                    channel=f.channel, kind=f.kind,
+                                    value=f.value, zscore=f.zscore,
+                                    threshold=f.threshold, phase=f.phase,
+                                    replica=r,
+                                    beta_end=beta_end_list[r],
+                                )
+                bad = [r for r in sorted(nonfinite | set(anomalous))
                        if r not in ejected]
                 if bad:
                     states, histories, keys, diverged_warned = (
@@ -482,6 +523,7 @@ class BetaSweepTrainer:
                             start_epoch=start_epoch, row=row,
                             beta_end_list=beta_end_list,
                             diverged_warned=diverged_warned,
+                            detectors=detectors, anomalous=anomalous,
                         )
                     )
                     self.resume_key = keys
@@ -504,8 +546,15 @@ class BetaSweepTrainer:
     def _quarantine_divergence(self, bad, states, histories, keys, hooks,
                                telemetry, chunk, ejected, *, epoch,
                                start_epoch, row, beta_end_list,
-                               diverged_warned):
-        """Heal (or eject) the non-finite members in ``bad``.
+                               diverged_warned, detectors=None,
+                               anomalous=None):
+        """Heal (or eject) the non-finite OR anomalous members in ``bad``.
+
+        ``anomalous`` maps member index -> the finite-SDC findings that
+        flagged it (train/anomaly.py); ``detectors`` are the per-member
+        detectors, re-consulted (peek mode) on the replayed row so a lane
+        that is STILL anomalous after the heal — finite garbage restored
+        from a poisoned source — is ejected rather than spliced back.
 
         Restores the stacked chunk-aligned checkpoint once, replays the
         gap at the ORIGINAL sweep width (the only width where the replay
@@ -548,14 +597,10 @@ class BetaSweepTrainer:
                 )
             return states, histories, keys, True
 
-        def report_fallback(info: dict) -> None:
-            if telemetry is not None:
-                telemetry.mitigation(mtype="checkpoint_fallback", **info)
-            warnings.warn(
-                f"sweep quarantine: checkpoint step {info['step']} is "
-                f"corrupt and was skipped (deleted={info.get('deleted')}): "
-                f"{info['error']}"
-            )
+        from dib_tpu.train.checkpoint import fallback_reporter
+
+        report_fallback = fallback_reporter(
+            telemetry, source="sweep quarantine")
 
         try:
             if hasattr(ckpt, "restore_latest_intact"):
@@ -608,30 +653,65 @@ class BetaSweepTrainer:
             self._telemetry_run_id = outer_run_id
         replay_histories = self.latest_history
         replay_keys = self.resume_key
+        from dib_tpu.train.anomaly import boundary_channels
+
         healed_row = jax.device_get({
-            name: replay_histories[name][:, epoch - 1]
-            for name in ("loss", "val_loss", "kl_per_feature")
+            "param_norm": _member_norms(replay_states.params),
+            **{name: replay_histories[name][:, epoch - 1]
+               for name in ("loss", "val_loss", "kl_per_feature")},
         })
         still_bad = set(_nonfinite_members(healed_row))
+        anomalous = anomalous or {}
+        if detectors is not None:
+            # decontaminate every flagged member's window first: channels
+            # that did NOT individually trip still recorded this
+            # boundary's (corrupt) values when the member was flagged by
+            # a sibling channel — drop everything observed at this epoch
+            # so both the recheck below and the healed commit judge
+            # against clean points only
+            for r in bad:
+                detectors[r].rewind(epoch - 1)
+        for r in anomalous:
+            # peek (record=False): judge the replayed value against the
+            # member's clean window without committing it twice
+            if detectors is not None and detectors[r].observe(
+                    epoch, _member_channels(healed_row, r,
+                                            boundary_channels),
+                    record=False):
+                still_bad.add(r)
         for r in bad:
             if r in still_bad:
-                self._eject_replica(r, ejected, telemetry, epoch=epoch,
-                                    beta_end=beta_end_list[r],
-                                    reason="re-diverged during the "
-                                           "quarantine replay")
+                self._eject_replica(
+                    r, ejected, telemetry, epoch=epoch,
+                    beta_end=beta_end_list[r],
+                    reason=("still anomalous after the quarantine replay"
+                            if r in anomalous else
+                            "re-diverged during the quarantine replay"))
                 continue
             states = _splice_member(states, replay_states, r)
             histories = _splice_member(histories, replay_histories, r)
             keys = _splice_keys(keys, r, replay_keys)
+            if detectors is not None:
+                # the healed (clean) boundary row joins the member's
+                # window — the anomalous one never did, and the corrupt
+                # sub-threshold channels were rewound away above
+                detectors[r].observe(
+                    epoch, _member_channels(healed_row, r,
+                                            boundary_channels))
             detail = _member_row_detail(row, r)
+            was_anomalous = r in anomalous
             if telemetry is not None:
                 telemetry.mitigation(
-                    mtype="divergence_rollback", epoch=epoch, replica=r,
+                    mtype=("anomaly_rollback" if was_anomalous
+                           else "divergence_rollback"),
+                    epoch=epoch, replica=r,
                     beta_end=beta_end_list[r],
                     restored_epoch=restored_epoch, **detail,
                 )
+            what = ("anomalous (finite-SDC-shaped)" if was_anomalous
+                    else "non-finite")
             warnings.warn(
-                f"non-finite loss/KL at epoch {epoch} in sweep member {r} "
+                f"{what} loss/KL at epoch {epoch} in sweep member {r} "
                 f"(β_end={beta_end_list[r]:g}); member rolled back to the "
                 f"chunk-aligned checkpoint at epoch {restored_epoch} and "
                 "healed by an original-width replay (bit-identical splice)"
@@ -840,6 +920,18 @@ def sweep_records(histories: dict, ejected=()) -> list[HistoryRecord]:
 
 
 # ----------------------------------------------------- quarantine plumbing
+def _member_channels(row: dict, r: int, boundary_channels) -> dict:
+    """Member ``r``'s anomaly-detector channel dict from a stacked
+    boundary row (``train/anomaly.py:boundary_channels`` over the
+    member's slice; ``param_norm`` when the fetch carried it)."""
+    member = {name: np.asarray(row[name])[r]
+              for name in ("loss", "val_loss", "kl_per_feature")}
+    norm = row.get("param_norm")
+    return boundary_channels(
+        member,
+        param_norm=None if norm is None else float(np.asarray(norm)[r]))
+
+
 def _nonfinite_members(row: dict) -> list[int]:
     """Replica indices whose boundary metrics contain any non-finite value.
 

@@ -86,10 +86,13 @@ class TrainingUnitRunner:
         return os.path.join(self.unit_dir(unit), "history.npz")
 
     def _fallback_reporter(self, info: dict) -> None:
-        """A corrupt step skipped during a unit resume is a mitigation on
-        the scheduler's stream — recovery is never silent."""
-        if self._telemetry is not None:
-            self._telemetry.mitigation(mtype="checkpoint_fallback", **info)
+        """A corrupt step skipped during a unit resume is a mitigation
+        (plus a ``quarantine`` event for the moved step) on the
+        scheduler's stream — recovery is never silent."""
+        from dib_tpu.train.checkpoint import fallback_reporter
+
+        fallback_reporter(self._telemetry, source="sched unit resume",
+                          log=lambda msg: None)(info)
 
     def __call__(self, unit, heartbeat=None) -> dict:
         import jax

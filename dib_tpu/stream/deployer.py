@@ -95,6 +95,15 @@ class Deployer:
         knobs, telemetry, registry, tracer).
     """
 
+    #: Per-channel KL tolerance of the canary's publisher-stats check: a
+    #: candidate channel may sit within KL_BAND× of the publisher's
+    #: recorded boundary KL (plus KL_SLACK_NATS absolute slack so
+    #: compressed-away channels near zero never trip). Generous on
+    #: purpose — canary rows differ from the training batch — while
+    #: finite-garbage params put the KL orders of magnitude out.
+    KL_BAND = 8.0
+    KL_SLACK_NATS = 0.5
+
     def __init__(self, stream_dir: str, deploy_dir: str, trainer, zoo,
                  model_name: str = "stream", canary_rows=None,
                  telemetry=None, registry=None, poll_s: float = 0.25,
@@ -163,12 +172,25 @@ class Deployer:
             exec_cache=self.zoo.exec_cache, cache_key=self.model_name,
             registry=self.registry, **self.router_kwargs)
 
-    def _canary(self, router) -> float:
+    def _canary(self, router, rec: dict | None = None) -> float:
         """Probe the candidate's engine directly (no traffic routes to it
-        yet); raises :class:`CanaryFailure` on any unhealthy signal."""
+        yet); raises :class:`CanaryFailure` on any unhealthy signal.
+
+        Three gates, in escalating subtlety: (1) ``predict`` must return
+        finite, right-shaped rows; (2) ``encode`` must run and return
+        finite channel params (a checkpoint can predict while its
+        encoder plane is garbage — both ops serve live traffic); (3)
+        when the publish record carries the publisher's boundary stats,
+        the canary's per-channel KL must land within ``KL_BAND``× of the
+        recorded values — the gate that catches a checkpoint predicting
+        FINITE garbage, which ``np.isfinite`` waves straight through
+        (ISSUE 14; docs/robustness.md "Numerical integrity"). Records
+        without stats (older publishers) skip gate 3.
+        """
         t0 = time.monotonic()
+        engine = router.entries[0].engine
         try:
-            out = router.entries[0].engine.predict(self.canary_rows)
+            out = engine.predict(self.canary_rows)
         except Exception as exc:
             raise CanaryFailure(f"canary dispatch failed: {exc}") from exc
         prediction = np.asarray(out.get("prediction"))
@@ -179,6 +201,37 @@ class Deployer:
         if not np.all(np.isfinite(prediction)):
             raise CanaryFailure("canary prediction is non-finite — the "
                                 "checkpoint serves garbage")
+        try:
+            encoded = engine.encode(self.canary_rows)
+        except Exception as exc:
+            raise CanaryFailure(f"canary encode failed: {exc}") from exc
+        for name, arr in encoded.items():
+            if not np.all(np.isfinite(np.asarray(arr))):
+                raise CanaryFailure(
+                    f"canary encode returned non-finite {name!r} — the "
+                    "checkpoint's encoder plane serves garbage")
+        recorded = ((rec or {}).get("boundary") or {}).get("kl_per_feature")
+        if recorded:
+            canary_kl = np.asarray(out.get("kl_per_feature")).mean(axis=0)
+            if canary_kl.shape[0] != len(recorded):
+                raise CanaryFailure(
+                    f"canary KL has {canary_kl.shape[0]} channels but "
+                    f"the publish record holds {len(recorded)} — the "
+                    "checkpoint does not match the publishing trainer")
+            band, slack = self.KL_BAND, self.KL_SLACK_NATS
+            bad = [
+                i for i, (c, r) in enumerate(zip(canary_kl, recorded))
+                if c > r * band + slack or c < r / band - slack
+            ]
+            if bad:
+                detail = ", ".join(
+                    f"channel {i}: {float(canary_kl[i]):.3g} vs recorded "
+                    f"{float(recorded[i]):.3g}" for i in bad[:3])
+                raise CanaryFailure(
+                    f"canary per-channel KL disagrees with the "
+                    f"publisher's boundary stats on {len(bad)} "
+                    f"channel(s) ({detail}; band ×{band:g} + {slack:g} "
+                    "nats) — the checkpoint predicts finite garbage")
         return time.monotonic() - t0
 
     # ------------------------------------------------------------ promotion
@@ -199,7 +252,7 @@ class Deployer:
             return self._record(pub_id, rec, "rolled_back",
                                 error=f"restore failed: {exc}")
         try:
-            canary_s = self._canary(router)
+            canary_s = self._canary(router, rec)
         except CanaryFailure as exc:
             router.close()
             return self._record(pub_id, rec, "rolled_back",
@@ -277,7 +330,7 @@ class Deployer:
             self._warm_restore_failed(pub_id, f"restore failed: {exc}")
             return
         try:
-            self._canary(router)
+            self._canary(router, rec)
         except CanaryFailure as exc:
             router.close()
             self._warm_restore_failed(pub_id, str(exc))

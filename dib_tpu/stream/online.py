@@ -190,11 +190,26 @@ class OnlineDIBTrainer:
     # ------------------------------------------------------------- resume
     def _restore_or_init(self, key):
         """(state, history, key, round0, epochs_done): from the newest
-        publish record when one exists (the exact resume point — source
-        offset, drift baseline, and PRNG chain included), else fresh."""
+        INTACT publish record when one exists (the exact resume point —
+        source offset, drift baseline, and PRNG chain included), else
+        fresh.
+
+        Intact means the restore — including the v3 content-digest
+        verification — succeeds: a publish whose bytes rotted (or were
+        bit-flipped) after the rename must not crash-loop the always-on
+        trainer any more than it may be promoted by the deployer. Corrupt
+        publishes are skipped newest→oldest with a durable
+        ``checkpoint_fallback`` mitigation each. The artifact is
+        deliberately left IN PLACE (skip-only, unlike the train-side
+        quarantine): the journal is an append-only ledger, the deployer
+        owns its own decision on the same artifact, and the resumed
+        trainer republishes the skipped step with clean bytes anyway —
+        each later restart re-walks (and re-reports) the corrupt dir
+        until retention prunes it, which is the honest trade for never
+        mutating the published plane."""
         import jax
 
-        from dib_tpu.train import DIBCheckpointer
+        from dib_tpu.train import CheckpointCorruptionError, DIBCheckpointer
 
         records, torn = read_publishes(self.stream_dir)
         if torn and self.telemetry is not None:
@@ -205,17 +220,42 @@ class OnlineDIBTrainer:
         # never published, so nothing references them
         shutil.rmtree(os.path.join(self.stream_dir, STAGING_DIRNAME),
                       ignore_errors=True)
-        if not records:
+        rec = state = history = None
+        last_exc = None
+        for candidate in reversed(records):
+            ckpt_dir = os.path.join(self.stream_dir, candidate["path"])
+            if not os.path.isdir(ckpt_dir):
+                continue   # pruned by keep_publishes — older ones remain
+            ckpt = DIBCheckpointer(ckpt_dir)
+            try:
+                state, history, key = ckpt.restore(
+                    self.trainer, chunk_size=self.online.chunk_epochs)
+            except CheckpointCorruptionError as exc:
+                last_exc = exc
+                if self.telemetry is not None:
+                    self.telemetry.mitigation(
+                        mtype="checkpoint_fallback",
+                        step=int(candidate.get("step", -1)),
+                        detail=candidate.get("publish_id"),
+                        error=str(exc))
+                continue
+            finally:
+                ckpt.close()
+            rec = candidate
+            break
+        if rec is None:
+            if records and last_exc is not None:
+                # every on-disk publish is corrupt: restarting fresh
+                # would silently fork the published trajectory — raise
+                # with the evidence instead
+                raise CheckpointCorruptionError(
+                    f"no intact publish checkpoint under "
+                    f"{self.stream_dir} ({len(records)} record(s) "
+                    f"walked); last error: {last_exc}"
+                ) from last_exc
             key, k_init = jax.random.split(key)
             state, history = self.trainer.init(k_init)
             return state, history, key, 0, 0
-        rec = records[-1]
-        ckpt = DIBCheckpointer(os.path.join(self.stream_dir, rec["path"]))
-        try:
-            state, history, key = ckpt.restore(
-                self.trainer, chunk_size=self.online.chunk_epochs)
-        finally:
-            ckpt.close()
         self.source.restore(rec["source"])
         # the snapshot was taken mid-round (before the round's advance);
         # resuming at round+1 owes exactly the one advance the dead
@@ -252,7 +292,7 @@ class OnlineDIBTrainer:
 
     # ------------------------------------------------------------ publish
     def _publish(self, state, history, key, *, step: int, round_index: int,
-                 beta: float) -> dict:
+                 beta: float, boundary: dict | None = None) -> dict:
         """The atomic publish protocol: stage → fsync → rename → journal.
 
         The record lands ONLY after the checkpoint is fully durable under
@@ -298,6 +338,19 @@ class OnlineDIBTrainer:
             baseline=(None if base is None else
                       {"mean": [float(v) for v in base[0]],
                        "std": [float(v) for v in base[1]]}),
+            # the publisher's boundary stats: the deployer's canary
+            # compares the candidate's per-channel KL against these, so
+            # a checkpoint predicting finite garbage fails promotion
+            # (stream/deployer.py; docs/robustness.md "Numerical
+            # integrity"). Older records without them canary vacuously.
+            boundary=(None if boundary is None else {
+                "loss": float(boundary["loss"]),
+                "val_loss": float(boundary["val_loss"]),
+                "kl_per_feature": [float(v) for v in
+                                   np.asarray(
+                                       boundary["kl_per_feature"]
+                                   ).ravel()],
+            }),
         )
         self.publishes += 1
         if self.telemetry is not None:
@@ -397,6 +450,12 @@ class OnlineDIBTrainer:
                         "loss": history["loss"][cursor],
                         "val_loss": history["val_loss"][cursor],
                         "beta": history["beta"][cursor],
+                        # per-channel KL rides the same fetch: the publish
+                        # record carries it as the deployer's canary
+                        # reference (a promoted checkpoint must reproduce
+                        # the publisher's boundary KL, not just predict
+                        # finite numbers — stream/deployer.py)
+                        "kl_per_feature": history["kl_per_feature"][cursor],
                         "epoch": state.epoch,
                     })
                 if self.telemetry is not None:
@@ -416,7 +475,7 @@ class OnlineDIBTrainer:
                 if published:
                     self._publish(state, history, key, step=epochs_done,
                                   round_index=round_index,
-                                  beta=float(row["beta"]))
+                                  beta=float(row["beta"]), boundary=row)
                 if boundary_hook is not None:
                     boundary_hook(round_index, epochs_done)
                 if preempt is not None and preempt.requested:
@@ -427,7 +486,8 @@ class OnlineDIBTrainer:
                         self._publish(state, history, key,
                                       step=epochs_done,
                                       round_index=round_index,
-                                      beta=float(row["beta"]))
+                                      beta=float(row["beta"]),
+                                      boundary=row)
                     if self.telemetry is not None:
                         self.telemetry.mitigation(
                             mtype="preempt_checkpoint", epoch=epochs_done)

@@ -122,10 +122,31 @@ EVENT_SCHEMA: dict[str, EventKindSpec] = {
                   "healthy", "ejected", "batchers_dead",
                   "checkpoint_saved", "grace_remaining_s", "model",
                   "saved_width", "restored_width", "saved_mesh_axes",
-                  "mesh_axes"),
+                  "mesh_axes", "quarantined"),
         doc="one self-healing action (watchdog, rollback, serve health; "
             "sweep_reshard / member_backfill carry the mesh-portability "
-            "fields: saved/restored sweep widths and mesh axis sizes)"),
+            "fields: saved/restored sweep widths and mesh axis sizes; "
+            "checkpoint_fallback carries `quarantined` — the quarantine "
+            "path of the corrupt step, or false when it was kept)"),
+    "anomaly": EventKindSpec(
+        required=("epoch", "channel", "kind"),
+        optional=("value", "zscore", "threshold", "phase", "replica",
+                  "beta_end", "action"),
+        doc="one boundary anomaly verdict from the β-aware detector "
+            "(train/anomaly.py): a non-finite or robust-z-spiking "
+            "boundary metric (kind nonfinite/spike) on `channel` "
+            "(loss / val_loss / kl/<i> / param_norm), conditioned on "
+            "the β-annealing `phase` — emitted BEFORE the rollback/"
+            "ejection mitigation it provokes"),
+    "quarantine": EventKindSpec(
+        required=("step", "reason"),
+        optional=("path", "source", "error", "scope", "epoch",
+                  "directory", "replica"),
+        doc="one checkpoint step moved into its directory's quarantine/ "
+            "subdir (train/checkpoint.py): corrupt at restore, flagged "
+            "by `ckpt scrub`, or written during an anomalous window — "
+            "the step's bytes stay inspectable but no restore or "
+            "rollback path can ever select it again"),
     "fault": EventKindSpec(
         required=("kind",),
         optional=("spec", "chunk", "epoch", "replica", "op", "host",
@@ -609,6 +630,21 @@ class EventWriter:
         """One durable SLO violation (``telemetry/slo.py``): the rule
         name plus the observed value vs its budget."""
         return self.emit("alert", rule=rule, **fields)
+
+    def anomaly(self, *, epoch: int, channel: str, kind: str,
+                **fields) -> dict:
+        """One boundary anomaly verdict (``train/anomaly.py``): a
+        non-finite or robust-z-spiking boundary metric, emitted before
+        the rollback/ejection mitigation it provokes."""
+        return self.emit("anomaly", epoch=int(epoch), channel=channel,
+                         kind=kind, **fields)
+
+    def quarantine(self, *, step: int, reason: str, **fields) -> dict:
+        """One checkpoint step moved into ``quarantine/``
+        (``train/checkpoint.py``): corrupt bytes, or a step written
+        during an anomalous window — never restorable again."""
+        return self.emit("quarantine", step=int(step), reason=reason,
+                         **fields)
 
     def transition(self, *, channel: int, epoch: int, direction: str,
                    **fields) -> dict:

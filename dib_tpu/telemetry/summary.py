@@ -297,6 +297,18 @@ _FAULT_DETECTORS: dict[str, tuple[str, ...]] = {
     "stream_mid_publish_kill": ("stream_resumed", "journal_recovered"),
     "stream_deployer_kill": ("deployer_caught_up",),
     "stream_poison": ("canary_rollback",),
+    # silent-data-corruption faults (ISSUE 14, docs/robustness.md
+    # "Numerical integrity"): a finite param corruption is detected by
+    # the β-aware anomaly detector's rollback (or, if the garbage
+    # overflows mid-chunk, by the classic divergence rollback); a
+    # flipped payload bit by the content-digest gate's fallback walk on
+    # any restore path — or by the deployer's canary refusing the
+    # poisoned artifact before it ever answers a request
+    "sdc": ("anomaly_rollback", "anomaly_detected",
+            "divergence_rollback", "divergence_detected"),
+    "replica_sdc": ("anomaly_rollback", "replica_ejected",
+                    "anomaly_detected"),
+    "ckpt_bitflip_payload": ("checkpoint_fallback", "canary_rollback"),
 }
 
 # Recovery markers per kind, evaluated on events AFTER the detection:
@@ -546,6 +558,41 @@ def streaming_rollup(events) -> dict | None:
         out["lost_publishes"] = (
             max(indices) - min(indices) + 1 - len(indices)
             if indices else 0)
+    return out
+
+
+def integrity_rollup(events) -> dict | None:
+    """Numerical-integrity view of a stream (ISSUE 14,
+    docs/robustness.md "Numerical integrity"): the β-aware anomaly
+    detector's verdicts (``anomaly`` events), the rollbacks they
+    provoked, and every checkpoint step moved to ``quarantine/``
+    (``quarantine`` events) — corrupt at restore, flagged by ``ckpt
+    scrub``, or written during an anomalous window. ``anomaly_rollbacks``
+    is what the ``anomaly_rollback_ceiling`` SLO rule gates. None when
+    the stream carries no integrity events (clean runs skip the rule).
+    """
+    anomalies = [e for e in events if e.get("type") == "anomaly"]
+    quarantines = [e for e in events if e.get("type") == "quarantine"]
+    mitigations = [e for e in events if e.get("type") == "mitigation"]
+    anomaly_rollbacks = [m for m in mitigations
+                         if m.get("mtype") == "anomaly_rollback"]
+    divergence_rollbacks = [m for m in mitigations
+                            if m.get("mtype") == "divergence_rollback"]
+    fallbacks = [m for m in mitigations
+                 if m.get("mtype") == "checkpoint_fallback"]
+    if not anomalies and not quarantines and not anomaly_rollbacks:
+        return None
+    out: dict = {}
+    out["anomalies"] = len(anomalies)
+    out["anomaly_channels"] = sorted(
+        {str(e.get("channel")) for e in anomalies if e.get("channel")})
+    out["anomaly_rollbacks"] = len(anomaly_rollbacks)
+    out["divergence_rollbacks"] = len(divergence_rollbacks)
+    out["quarantines"] = len(quarantines)
+    out["quarantined_steps"] = sorted(
+        {int(e["step"]) for e in quarantines
+         if isinstance(e.get("step"), (int, float))})
+    out["checkpoint_fallbacks"] = len(fallbacks)
     return out
 
 
@@ -832,6 +879,14 @@ def summarize(path: str, process_index: int | None = None,
     mesh = mesh_rollup(events)
     if mesh is not None:
         summary["mesh"] = mesh
+
+    # numerical-integrity plane (train/anomaly.py + the v3 content-digest
+    # checkpoints): anomaly verdicts, the rollbacks they provoked, and
+    # quarantined checkpoint steps — global like mitigations (a scrub or
+    # supervisor may emit onto the worker's stream)
+    integrity = integrity_rollup(events)
+    if integrity is not None:
+        summary["integrity"] = integrity
 
     if compiles:
         by_cache: dict[str, int] = {}

@@ -26,13 +26,17 @@ from dib_tpu.train.preempt import (
     PreemptionGuard,
     TrainingPreempted,
 )
+from dib_tpu.train.anomaly import AnomalyFinding, BoundaryAnomalyDetector
 from dib_tpu.train.checkpoint import (
     CHECKPOINT_SCHEMA_VERSION,
     CheckpointCorruptionError,
     CheckpointHook,
     DIBCheckpointer,
+    content_digest_rows,
+    fallback_reporter,
     param_structure_hash,
     read_manifest,
+    verify_content_digests,
     verify_manifest,
     write_manifest,
 )

@@ -45,15 +45,38 @@ from dib_tpu.train.history import history_init
 # Orbax structure error. v2 adds the OPTIONAL mesh/sharding metadata rows
 # (logical sweep grid, mesh axis sizes, per-leaf PartitionSpec) that make
 # checkpoints mesh-shape-portable — the payload itself is unchanged, so
-# v1 checkpoints restore under v2 readers (vacuous reshard). A manifest
-# WITHOUT the mesh block still writes as v1 (MESH_FREE_CHECKPOINT_SCHEMA):
-# the schema names the content, not the writer's era, so serial
-# checkpoints saved by upgraded workers stay restorable by v1-only
-# readers during a rolling fleet upgrade.
-CHECKPOINT_SCHEMA_VERSION = 2
+# v1 checkpoints restore under v2 readers (vacuous reshard). v3 adds the
+# per-step CONTENT block: a sha256 digest per payload leaf, computed from
+# the host copy the save takes and re-verified on every restore — the
+# silent-data-corruption gate (docs/robustness.md "Numerical integrity").
+# Unlike the mesh block, the content block is integrity-critical in the
+# always-on train-to-serve loop (a reader that ignored it would promote
+# corrupt bytes into live traffic), so digest-bearing manifests are v3
+# REGARDLESS of mesh: a pre-digest reader refusing a v3 checkpoint is the
+# safe failure during a rolling upgrade. Set DIB_CKPT_CONTENT_DIGESTS=0
+# to write digest-free manifests (then mesh-free manifests stay v1,
+# MESH_FREE_CHECKPOINT_SCHEMA — the schema names the content, not the
+# writer's era) while a mixed fleet still carries v1/v2-only readers.
+# v1/v2 manifests verify their (absent) digests vacuously under the v3
+# reader, so old checkpoints restore unchanged.
+CHECKPOINT_SCHEMA_VERSION = 3
 MESH_FREE_CHECKPOINT_SCHEMA = 1
-COMPATIBLE_CHECKPOINT_SCHEMAS = (1, 2)
+MESH_CHECKPOINT_SCHEMA = 2
+COMPATIBLE_CHECKPOINT_SCHEMAS = (1, 2, 3)
 MANIFEST_FILENAME = "dib_manifest.json"
+#: Subdirectory corrupt step dirs are MOVED into (never deleted): the
+#: bytes stay inspectable/recoverable by the operator, while Orbax — and
+#: with it every restore / divergence-rollback path — can no longer
+#: select the step.
+QUARANTINE_DIRNAME = "quarantine"
+DIGESTS_ENV = "DIB_CKPT_CONTENT_DIGESTS"
+
+
+def content_digests_enabled() -> bool:
+    """Per-leaf content digests are written unless explicitly disabled
+    (``DIB_CKPT_CONTENT_DIGESTS=0`` — the rolling-upgrade escape for
+    fleets that still carry pre-v3 readers)."""
+    return os.environ.get(DIGESTS_ENV, "1") != "0"
 
 
 class CheckpointCorruptionError(RuntimeError):
@@ -104,8 +127,94 @@ def sharding_spec_rows(state, history) -> list[str]:
     return sorted(rows)
 
 
+def _digest_path(path) -> str:
+    """Container-spelling-independent slash path for one tree leaf.
+
+    ``jax.tree_util.keystr`` spells a NamedTuple field ``.epoch`` but a
+    dict key ``['epoch']`` — and Orbax's template-free metadata restore
+    (the scrub path) hands the SAME payload back as plain dicts. Keying
+    digests by the normalized component names (``state/opt_state/0/mu``)
+    makes a digest row match its leaf regardless of which container the
+    reader materialized.
+    """
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):          # DictKey / FlattenedIndexKey
+            parts.append(str(p.key))
+        elif hasattr(p, "name"):       # GetAttrKey (NamedTuple field)
+            parts.append(str(p.name))
+        elif hasattr(p, "idx"):        # SequenceKey
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def content_digest_rows(payload) -> dict[str, str]:
+    """sha256 per payload leaf, keyed by its normalized tree path.
+
+    The digest covers dtype, shape, and the raw little-layout bytes of
+    the MATERIALIZED host array — the exact bytes the (async) save hands
+    Orbax — so a restore that reproduces different bytes for the same
+    leaf is evidence of on-disk corruption (SDC, bit rot, torn write),
+    never of layout: shardings and device placement are not hashed, and
+    the path key is container-spelling-independent (:func:`_digest_path`).
+    """
+    host = jax.device_get(payload)
+    rows: dict[str, str] = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(host)[0]:
+        arr = np.asarray(leaf)
+        h = hashlib.sha256()
+        h.update(str(arr.dtype).encode())
+        h.update(repr(tuple(arr.shape)).encode())
+        h.update(np.ascontiguousarray(arr).tobytes())
+        rows[_digest_path(path)] = h.hexdigest()
+    return rows
+
+
+def _digest_mismatches(recorded: dict, got: dict) -> list[str]:
+    """Leaf paths whose digests disagree between the manifest's recorded
+    rows and a recomputed set — value differences plus keys present on
+    only one side. The ONE definition of "mismatch" shared by the
+    restore gate (:func:`verify_content_digests`) and the offline scrub
+    (:meth:`DIBCheckpointer.scrub`), so the two can never disagree on
+    whether a step is corrupt."""
+    return sorted(
+        set(k for k in recorded if recorded[k] != got.get(k))
+        | (set(recorded) - set(got)) | (set(got) - set(recorded))
+    )
+
+
+def verify_content_digests(directory: str, step: int, recorded: dict,
+                           payload, context: str = "restore") -> None:
+    """Fail with :class:`CheckpointCorruptionError` NAMING the offending
+    leaves when ``payload``'s content digests disagree with the manifest's
+    recorded rows for ``step``.
+
+    ``recorded`` is the manifest's ``content[str(step)]["leaves"]`` map;
+    callers pass the restored payload BEFORE any copy/reshard (bytes are
+    placement-invariant, so verifying pre-reshard is equivalent and
+    cheapest). An empty/absent record verifies vacuously — v1/v2
+    manifests, and steps written by pre-v3 writers into a v3 directory.
+    """
+    if not recorded:
+        return
+    bad = _digest_mismatches(recorded, content_digest_rows(payload))
+    if bad:
+        raise CheckpointCorruptionError(
+            f"Checkpoint step {step} in {directory} failed content-digest "
+            f"verification on {len(bad)} leaf/leaves: {', '.join(bad[:4])}"
+            f"{' …' if len(bad) > 4 else ''} — the bytes read back differ "
+            "from the bytes saved (silent data corruption / bit rot / "
+            "tampering). The step structure is intact, so only the digest "
+            "catches this. Restore an earlier step, or quarantine it with "
+            "`python -m dib_tpu ckpt scrub <dir> --quarantine`."
+        )
+
+
 def write_manifest(directory: str, params, mesh: dict | None = None,
-                   sharding_rows: list[str] | None = None) -> dict:
+                   sharding_rows: list[str] | None = None,
+                   content: dict | None = None) -> dict:
     """Write the checkpoint-integrity manifest next to the step dirs.
 
     Recorded once per checkpoint directory (rewritten on every save — the
@@ -120,16 +229,26 @@ def write_manifest(directory: str, params, mesh: dict | None = None,
     mesh; width R restores at width R′ via
     ``parallel/elastic.py:restore_sweep_resharded``). ``sharding_rows``:
     per-leaf :func:`sharding_spec_rows` evidence of the saved layout.
-    Serial trainers pass neither, and their manifests stay v1 — the
-    schema names the payload-plus-metadata CONTENT, not the writer's
-    era, so a v1-era reader (a not-yet-upgraded fleet member stealing a
-    serial unit mid-rolling-upgrade) keeps restoring the serial
-    checkpoints it fully understands instead of hard-rejecting them.
+    ``content`` (schema v3): the per-step content-digest table,
+    ``{str(step): {"leaves": {path: sha256}}}`` — what makes a byte flip
+    in a retained step's payload DETECTABLE at restore/scrub time. A
+    digest-bearing manifest is always v3 (the digests are
+    integrity-critical; see the schema-version note above). Without
+    digests, the mesh rules apply: mesh/sharding metadata makes v2,
+    serial digest-free manifests stay v1 — the schema names the
+    payload-plus-metadata CONTENT, not the writer's era, so a v1-era
+    reader (a not-yet-upgraded fleet member stealing a serial unit
+    mid-rolling-upgrade) keeps restoring the serial checkpoints it fully
+    understands instead of hard-rejecting them.
     """
-    versioned = mesh is not None or sharding_rows is not None
+    if content is not None:
+        schema = CHECKPOINT_SCHEMA_VERSION
+    elif mesh is not None or sharding_rows is not None:
+        schema = MESH_CHECKPOINT_SCHEMA
+    else:
+        schema = MESH_FREE_CHECKPOINT_SCHEMA
     manifest = {
-        "checkpoint_schema": (CHECKPOINT_SCHEMA_VERSION if versioned
-                              else MESH_FREE_CHECKPOINT_SCHEMA),
+        "checkpoint_schema": schema,
         "param_structure_hash": param_structure_hash(params),
         "param_structure_rows": param_structure_rows(params),
     }
@@ -137,6 +256,8 @@ def write_manifest(directory: str, params, mesh: dict | None = None,
         manifest["mesh"] = dict(mesh)
     if sharding_rows is not None:
         manifest["sharding_rows"] = list(sharding_rows)
+    if content is not None:
+        manifest["content"] = {k: dict(v) for k, v in content.items()}
     path = os.path.join(directory, MANIFEST_FILENAME)
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
@@ -270,10 +391,16 @@ class DIBCheckpointer:
         # ``mesh_info`` (sweep trainers' ``mesh_manifest()``) plus the
         # per-leaf sharding rows make the checkpoint mesh-shape-portable:
         # restore reshards to whatever mesh the restoring process has.
+        # ``content`` (schema v3): per-leaf sha256 of THIS step's payload
+        # bytes (a synchronous host fetch — the same D2H snapshot the
+        # async save takes anyway), merged with the digest rows of the
+        # steps still retained so every restorable step stays verifiable;
+        # rows for pruned steps are dropped, bounding the manifest.
         write_manifest(
             self.directory, state.params, mesh=mesh_info,
             sharding_rows=(sharding_spec_rows(state, history)
                            if mesh_info is not None else None),
+            content=self._merged_content(step, payload),
         )
         # Async: the write overlaps the next training chunk; readers
         # (restore / latest_step) wait for in-flight saves first.
@@ -289,6 +416,68 @@ class DIBCheckpointer:
         # overlap.
         if jax.default_backend() == "cpu":
             self.manager.wait_until_finished()
+
+    def _merged_content(self, step: int, payload) -> dict | None:
+        """The manifest's per-step content-digest table after adding
+        ``step``: prior rows for still-retained steps carried forward,
+        rows for pruned steps dropped, this step's digests computed from
+        the payload's host copy. None when digests are disabled (the
+        manifest then keeps its pre-v3 schema)."""
+        if not content_digests_enabled():
+            return None
+        prev: dict = {}
+        try:
+            prev = (read_manifest(self.directory) or {}).get("content") or {}
+        except CheckpointCorruptionError:
+            # an unreadable manifest is rewritten wholesale anyway (it
+            # already fails every restore); prior digest rows are lost —
+            # old steps then verify digest-vacuously, like pre-v3 steps
+            prev = {}
+        retained = {str(s) for s in self.manager.all_steps()}
+        content = {k: v for k, v in prev.items() if k in retained}
+        content[str(step)] = {"leaves": content_digest_rows(payload)}
+        return content
+
+    def _recorded_digests(self, step: int) -> dict:
+        """The manifest's digest rows for ``step`` (empty = vacuous)."""
+        manifest = read_manifest(self.directory) or {}
+        entry = (manifest.get("content") or {}).get(str(step)) or {}
+        return entry.get("leaves") or {}
+
+    def quarantine_step(self, step: int, reason: str) -> str:
+        """Move a step dir into ``quarantine/`` and make Orbax forget it.
+
+        The poisoned-target fix (docs/robustness.md "Numerical
+        integrity"): a corrupt (or anomalously-written) step left in
+        place would block the re-trained gap from ever checkpointing
+        again (Orbax refuses to re-save a step <= latest_step) and stay
+        the target of the next divergence rollback. Deletion destroys the
+        operator's evidence; a move does neither — the bytes stay
+        inspectable under ``quarantine/<step>`` with a ``QUARANTINE.json``
+        naming the reason, while ``all_steps``/``latest_step``/restore
+        can never select the step again. Returns the quarantine path.
+        """
+        self.manager.wait_until_finished()
+        src = os.path.join(self.directory, str(step))
+        if not os.path.isdir(src):
+            raise FileNotFoundError(
+                f"cannot quarantine step {step}: {src} is not a step dir")
+        qroot = os.path.join(self.directory, QUARANTINE_DIRNAME)
+        os.makedirs(qroot, exist_ok=True)
+        dst = os.path.join(qroot, str(step))
+        n = 1
+        while os.path.exists(dst):
+            n += 1
+            dst = os.path.join(qroot, f"{step}-{n}")
+        os.replace(src, dst)
+        with open(os.path.join(dst, "QUARANTINE.json"), "w") as f:
+            json.dump({"step": int(step), "reason": reason,
+                       "directory": self.directory}, f, indent=1)
+            f.write("\n")
+        # re-read the directory so the manager's step cache agrees with
+        # the filesystem (the moved step must vanish from all_steps)
+        self.manager.reload()
+        return dst
 
     @property
     def latest_step(self) -> int | None:
@@ -392,6 +581,19 @@ class DIBCheckpointer:
                 step, args=ocp.args.StandardRestore(abstract))
         except Exception as exc:
             raise _corrupt(exc) from exc
+        # Content-integrity gate (manifest schema v3): the restored bytes
+        # must hash to what the save recorded, or the step is silently
+        # corrupt — structure intact, bytes wrong, the one shape the
+        # structure hash and Orbax's own readers wave through. Verified
+        # on EVERY restore path (train resume, sched steal, elastic
+        # reshard, zoo load, stream promotion) because they all funnel
+        # here; a mismatch is a CheckpointCorruptionError, so
+        # restore_latest_intact quarantines the step and falls back.
+        # Pre-v3 manifests (and pre-v3 steps in a v3 dir) verify
+        # vacuously. Checked BEFORE the copy/reshard below — digests are
+        # placement-invariant.
+        verify_content_digests(
+            self.directory, step, self._recorded_digests(step), restored)
         saved_chunk = int(np.asarray(restored["chunk_size"])) if has_chunk else 0
         self.restored_chunk_size = saved_chunk or None
         if chunk_size is not None and saved_chunk:
@@ -471,15 +673,18 @@ class DIBCheckpointer:
         up. Here corrupt steps (``CheckpointCorruptionError`` only —
         template/chunk-contract ``ValueError``s still propagate, a wrong
         architecture is wrong at every step) are skipped newest→oldest
-        with ``on_fallback({"step", "error", "deleted"})`` called per skip
-        (the CLI emits a ``checkpoint_fallback`` mitigation event from
-        it), and each skipped step is DELETED: orbax refuses to re-save a
-        step ``<= latest_step``, so a corrupt step left on disk would
-        silently block the re-trained gap from ever checkpointing again —
-        and remain the poisoned target of the next divergence rollback.
-        The steps skipped are recorded on
-        ``self.fallback_skipped_steps``. Raises the last corruption error
-        when every step is damaged.
+        with ``on_fallback({"step", "error", "quarantined"})`` called per
+        skip (callers emit a ``checkpoint_fallback`` mitigation and a
+        ``quarantine`` event from it — :func:`fallback_reporter`), and
+        each skipped step is QUARANTINED via :meth:`quarantine_step`:
+        orbax refuses to re-save a step ``<= latest_step``, so a corrupt
+        step left on disk would silently block the re-trained gap from
+        ever checkpointing again — and remain the poisoned target of the
+        next divergence rollback. Moving (never deleting) keeps the bytes
+        under ``quarantine/`` for the operator while guaranteeing no
+        restore path can ever re-select the step. The steps skipped are
+        recorded on ``self.fallback_skipped_steps``. Raises the last
+        corruption error when every step is damaged.
         """
         self.manager.wait_until_finished()
         steps = sorted(self.manager.all_steps(), reverse=True)
@@ -491,14 +696,15 @@ class DIBCheckpointer:
         # would delete every intact step over one damaged JSON file. Raise
         # it here instead: the error names the one-file operator fix.
         manifest = read_manifest(self.directory)
-        # Deletion safety: with a verified manifest, a wrong-architecture
+        # Quarantine safety: with a verified manifest, a wrong-architecture
         # template fails at verify_manifest (a ValueError that propagates),
         # so a CheckpointCorruptionError really is an on-disk read failure
-        # — safe to delete. WITHOUT a manifest (pre-manifest dirs) a deep
-        # restore error could equally be a template mismatch at every
-        # step; deleting on that evidence would destroy a healthy
-        # checkpoint history over a flag typo. Skip-only there.
-        safe_to_delete = manifest is not None
+        # — safe to quarantine (and the move is non-destructive anyway).
+        # WITHOUT a manifest (pre-manifest dirs) a deep restore error
+        # could equally be a template mismatch at every step; moving every
+        # step on that evidence would wreck a healthy checkpoint history
+        # over a flag typo. Skip-only there.
+        safe_to_quarantine = manifest is not None
         self.fallback_skipped_steps: list[int] = []
         last_exc: CheckpointCorruptionError | None = None
         for step in steps:
@@ -509,20 +715,23 @@ class DIBCheckpointer:
             except CheckpointCorruptionError as exc:
                 last_exc = exc
                 self.fallback_skipped_steps.append(step)
-                if safe_to_delete:
+                info = {"step": step, "error": str(exc)}
+                if safe_to_quarantine:
                     try:
-                        self.manager.delete(step)
-                        deleted = True
-                    except Exception as delete_exc:
-                        # a half-torn dir orbax cannot delete must not
-                        # block the fallback walk; the skip is reported
-                        deleted = f"delete failed: {delete_exc}"
+                        info["quarantined"] = self.quarantine_step(
+                            step, reason=f"corrupt at restore: {exc}")
+                    except OSError as move_exc:
+                        # a dir the fs will not move must not block the
+                        # fallback walk; the skip is reported either way
+                        info["quarantined"] = False
+                        info["reason"] = f"quarantine failed: {move_exc}"
                 else:
-                    deleted = "kept: no integrity manifest, cannot rule " \
-                              "out a template mismatch"
+                    info["quarantined"] = False
+                    info["reason"] = ("kept in place: no integrity "
+                                      "manifest, cannot rule out a "
+                                      "template mismatch")
                 if on_fallback is not None:
-                    on_fallback({"step": step, "error": str(exc),
-                                 "deleted": deleted})
+                    on_fallback(info)
                 continue
             return out
         raise CheckpointCorruptionError(
@@ -530,9 +739,136 @@ class DIBCheckpointer:
             f"corrupt; last error: {last_exc}"
         ) from last_exc
 
+    def _restore_raw(self, step: int):
+        """Restore ``step``'s payload with NO trainer template — the
+        abstract tree comes from the step's own on-disk metadata. The
+        scrub path: content digests are about bytes, not architecture,
+        so verification must not require rebuilding the model."""
+        def _corrupt(exc: Exception) -> CheckpointCorruptionError:
+            return CheckpointCorruptionError(
+                f"Checkpoint step {step} in {self.directory} failed to "
+                f"read back ({type(exc).__name__}: {exc}) — the step "
+                "directory is likely corrupt (truncated file / torn "
+                "write / flipped bytes the reader cannot decode)."
+            )
+
+        try:
+            meta = self.manager.item_metadata(step)
+            abstract = jax.tree.map(
+                lambda m: jax.ShapeDtypeStruct(tuple(m.shape), m.dtype),
+                dict(meta),
+            )
+            return self.manager.restore(
+                step, args=ocp.args.StandardRestore(abstract))
+        except Exception as exc:
+            raise _corrupt(exc) from exc
+
+    def scrub(self, *, quarantine: bool = False) -> dict:
+        """Walk every retained step, re-verify its content digests, and
+        report (optionally quarantine) mismatches.
+
+        The offline half of the SDC defense (``python -m dib_tpu ckpt
+        scrub <dir>``): a restore only checks the step it restores, so a
+        flipped bit in an OLDER retained step — tomorrow's rollback
+        target — goes unnoticed until the worst moment. Scrub checks
+        them all, template-free. Returns a report dict::
+
+            {"directory", "schema", "steps": [{"step", "status",
+              "leaves"?, "error"?, "quarantined"?}, ...],
+             "corrupt": [step, ...], "clean": bool}
+
+        Step statuses: ``ok`` (digests match), ``no_digests`` (pre-v3
+        step — nothing to verify against), ``mismatch`` (digest
+        disagreement; ``leaves`` names the offenders), ``unreadable``
+        (the reader itself failed). ``quarantine=True`` moves mismatched/
+        unreadable steps via :meth:`quarantine_step`.
+        """
+        self.manager.wait_until_finished()
+        manifest_error = None
+        manifest = None
+        try:
+            manifest = read_manifest(self.directory)
+        except CheckpointCorruptionError as exc:
+            manifest_error = str(exc)
+        content = (manifest or {}).get("content") or {}
+        report: dict = {
+            "directory": self.directory,
+            "schema": (manifest or {}).get("checkpoint_schema"),
+            "steps": [],
+            "corrupt": [],
+        }
+        if manifest_error is not None:
+            report["manifest_error"] = manifest_error
+        for step in sorted(self.manager.all_steps()):
+            row: dict = {"step": int(step)}
+            try:
+                payload = self._restore_raw(step)
+            except CheckpointCorruptionError as exc:
+                row["status"] = "unreadable"
+                row["error"] = str(exc)
+            else:
+                recorded = (content.get(str(step)) or {}).get("leaves") or {}
+                if not recorded:
+                    row["status"] = "no_digests"
+                else:
+                    bad = _digest_mismatches(
+                        recorded, content_digest_rows(payload))
+                    if bad:
+                        row["status"] = "mismatch"
+                        row["leaves"] = bad
+                    else:
+                        row["status"] = "ok"
+            if row["status"] in ("mismatch", "unreadable"):
+                report["corrupt"].append(int(step))
+                if quarantine:
+                    # a step the fs will not move (read-only mount,
+                    # permissions) must not abort the walk: the report
+                    # still covers every step, with the failure recorded
+                    try:
+                        row["quarantined"] = self.quarantine_step(
+                            step,
+                            reason=f"scrub: {row['status']}"
+                                   + (f" on {row['leaves'][:4]}"
+                                      if row.get("leaves") else ""),
+                        )
+                    except OSError as exc:
+                        row["quarantined"] = False
+                        row["quarantine_error"] = str(exc)
+            report["steps"].append(row)
+        report["clean"] = not report["corrupt"] and manifest_error is None
+        return report
+
     def close(self) -> None:
         self.manager.wait_until_finished()
         self.manager.close()
+
+
+def fallback_reporter(telemetry, *, source: str, log=None):
+    """The shared ``on_fallback`` for every ``restore_latest_intact``
+    caller (CLI auto-resume, divergence rollback, sweep quarantine, sched
+    unit resume): a corrupt step skipped mid-recovery lands as a
+    ``checkpoint_fallback`` mitigation, its quarantine (when one
+    happened) as a durable ``quarantine`` event, and a loud host-side
+    line via ``log`` (default: ``warnings.warn``) — recovery is never
+    silent. ``telemetry`` may be None (events skipped, logging kept).
+    """
+    def report(info: dict) -> None:
+        import warnings
+
+        msg = (f"{source}: checkpoint step {info['step']} is corrupt and "
+               f"was skipped (quarantined={info.get('quarantined')}): "
+               f"{info['error']}")
+        (log if log is not None else warnings.warn)(msg)
+        if telemetry is None:
+            return
+        telemetry.mitigation(mtype="checkpoint_fallback", **info)
+        if info.get("quarantined"):
+            telemetry.quarantine(
+                step=info["step"], reason="corrupt at restore",
+                path=info["quarantined"], source=source,
+                error=info["error"])
+
+    return report
 
 
 class CheckpointHook:

@@ -528,6 +528,11 @@ class DIBTrainer:
         and unwinds with :class:`TrainingPreempted` so the CLI can exit
         with the preemption code the watchdog relaunches immediately.
         """
+        from dib_tpu.train.anomaly import (
+            BoundaryAnomalyDetector,
+            boundary_channels,
+        )
+
         num_epochs = self.config.num_epochs if num_epochs is None else num_epochs
         if (state is None) != (history is None):
             raise ValueError(
@@ -557,7 +562,12 @@ class DIBTrainer:
         done = 0
         start_epoch = cursor
         chunk_index = 0          # 1-based fit-boundary ordinal (fault plans)
-        last_rollback_epoch = None
+        # β-aware boundary anomaly detector (train/anomaly.py): the
+        # non-finite guard generalized to finite SDC. Rollback context:
+        # the last rollback's epoch + restored step, and how many
+        # suspect checkpoints this fit already quarantined.
+        detector = BoundaryAnomalyDetector.for_config(self.config)
+        rollback_ctx: dict = {"epoch": None, "step": None, "quarantines": 0}
         diverged_warned = False
         self._telemetry_run_id = telemetry.run_id if telemetry else ""
         # desync guard: every host must enter this fit at the same chunk
@@ -610,9 +620,10 @@ class DIBTrainer:
                 self.latest_history = history
                 self.resume_chunk = chunk
                 row = jax.device_get({
-                    name: history[name][cursor + done - 1]
-                    for name in ("beta", "loss", "val_loss",
-                                 "kl_per_feature")
+                    "param_norm": _param_global_norm(state.params),
+                    **{name: history[name][cursor + done - 1]
+                       for name in ("beta", "loss", "val_loss",
+                                    "kl_per_feature")},
                 })
                 if telemetry is not None:
                     recorder.record_chunk(
@@ -623,16 +634,36 @@ class DIBTrainer:
                         kl_per_feature=[float(x)
                                         for x in row["kl_per_feature"]],
                     )
-                if not _row_finite(row):
+                findings = detector.observe(
+                    cursor + done,
+                    boundary_channels(row, param_norm=row["param_norm"]),
+                )
+                if findings:
+                    # non-finite OR finite-but-anomalous boundary: both
+                    # feed the same rollback machinery; the mitigation
+                    # kind records which detector fired
+                    mtype = ("anomaly_rollback"
+                             if all(f.kind == "spike" for f in findings)
+                             else "divergence_rollback")
+                    if telemetry is not None:
+                        for f in findings:
+                            telemetry.anomaly(
+                                epoch=cursor + done, channel=f.channel,
+                                kind=f.kind, value=f.value,
+                                zscore=f.zscore, threshold=f.threshold,
+                                phase=f.phase,
+                            )
                     ckpt = _find_checkpointer(hooks)
                     if ckpt is not None and ckpt.latest_step is not None:
-                        state, history, key, done, last_rollback_epoch = (
+                        state, history, key, done = (
                             self._rollback_divergence(
                                 ckpt, telemetry, chunk, row,
                                 epoch=cursor + done, start_epoch=start_epoch,
-                                last_rollback_epoch=last_rollback_epoch,
+                                rollback_ctx=rollback_ctx, mtype=mtype,
+                                findings=findings,
                             )
                         )
+                        detector.rewind(cursor + done)
                         self.resume_key = key
                         self.latest_history = history
                         continue   # diverged boundary: no hooks, no faults
@@ -640,6 +671,7 @@ class DIBTrainer:
                         diverged_warned = True
                         self._warn_divergence_unrecoverable(
                             telemetry, row, epoch=cursor + done,
+                            findings=findings,
                         )
                     # nothing to roll back to: keep training (back-compat),
                     # but the stream + warning record the divergence
@@ -656,18 +688,25 @@ class DIBTrainer:
         recorder.finish()
         return state, HistoryRecord.from_device(history)
 
-    def _warn_divergence_unrecoverable(self, telemetry, row, *, epoch):
-        """Non-finite boundary with nothing to roll back to: say so, once."""
+    def _warn_divergence_unrecoverable(self, telemetry, row, *, epoch,
+                                       findings=()):
+        """Anomalous boundary with nothing to roll back to: say so, once."""
         import warnings
 
+        spikes_only = bool(findings) and all(
+            f.kind == "spike" for f in findings)
+        what = ("anomalous (finite-SDC-shaped)" if spikes_only
+                else "non-finite")
         if telemetry is not None:
             telemetry.mitigation(
-                mtype="divergence_detected", epoch=epoch, action="none",
+                mtype=("anomaly_detected" if spikes_only
+                       else "divergence_detected"),
+                epoch=epoch, action="none",
                 reason="no checkpoint hook / saved step to roll back to",
                 **_row_detail(row),
             )
         warnings.warn(
-            f"non-finite loss/KL at epoch {epoch} "
+            f"{what} loss/KL at epoch {epoch} "
             f"(loss={_row_detail(row).get('loss')}); no checkpoint to roll "
             "back to — training continues on a diverged state. Add a "
             "CheckpointHook to fit(hooks=...) to enable automatic "
@@ -675,41 +714,83 @@ class DIBTrainer:
         )
 
     def _rollback_divergence(self, ckpt, telemetry, chunk, row, *, epoch,
-                             start_epoch, last_rollback_epoch):
-        """Non-finite boundary: mitigation event + checkpoint rollback.
+                             start_epoch, rollback_ctx,
+                             mtype="divergence_rollback", findings=()):
+        """Anomalous boundary: mitigation event + checkpoint rollback.
 
-        Returns the new ``(state, history, key, done, last_rollback_epoch)``
-        for the fit loop. Raises when the divergence is deterministic (it
-        recurred at or before the last rollback's epoch) or the restore
-        itself fails.
+        ``rollback_ctx`` is the fit's mutable rollback memory
+        (``{"epoch", "step", "quarantines"}``). A divergence that RECURS
+        at or before the last rollback's epoch means the restored
+        checkpoint itself reproduces the anomaly — it was written during
+        an anomalous window the detector missed (finite SDC saved before
+        the spike cleared the threshold). That step is QUARANTINED
+        (``ckpt.quarantine_step``; durable ``quarantine`` event) and the
+        rollback retries from the next older step, up to
+        ``_MAX_ROLLBACK_QUARANTINES`` times; past the budget — or when the
+        checkpointer cannot quarantine — the divergence is deterministic
+        and raises. Returns the new ``(state, history, key, done)`` for
+        the fit loop.
         """
         import warnings
 
         detail = _row_detail(row)
-        if last_rollback_epoch is not None and epoch <= last_rollback_epoch:
-            raise RuntimeError(
-                f"training diverged again at epoch {epoch} after rolling "
-                f"back (previous divergence at epoch {last_rollback_epoch}) "
-                "— the trajectory diverges deterministically; lower the "
-                "learning rate or the β ceiling, or resume from an earlier "
-                "checkpoint (docs/robustness.md)."
+        last_epoch = rollback_ctx.get("epoch")
+        if last_epoch is not None and epoch <= last_epoch:
+            last_step = rollback_ctx.get("step")
+            can_quarantine = (
+                hasattr(ckpt, "quarantine_step") and last_step is not None
+                and rollback_ctx.get("quarantines", 0)
+                < _MAX_ROLLBACK_QUARANTINES
             )
-        def report_fallback(info: dict) -> None:
-            # a step skipped (and deleted) mid-rollback must be as loud as
-            # the CLI resume path's: mitigation event + warning — recovery
-            # is never silent
+            if not can_quarantine:
+                raise RuntimeError(
+                    f"training diverged again at epoch {epoch} after "
+                    f"rolling back (previous divergence at epoch "
+                    f"{last_epoch}"
+                    + (f"; {rollback_ctx['quarantines']} suspect "
+                       "checkpoint(s) already quarantined"
+                       if rollback_ctx.get("quarantines") else "")
+                    + ") — the trajectory diverges deterministically; "
+                    "lower the learning rate or the β ceiling, or resume "
+                    "from an earlier checkpoint (docs/robustness.md)."
+                )
+            reason = (f"restoring step {last_step} reproduced the "
+                      f"anomaly at epoch {epoch} — the checkpoint was "
+                      "written during an anomalous window and is not a "
+                      "safe rollback target")
+            try:
+                qpath = ckpt.quarantine_step(last_step, reason)
+            except OSError as exc:
+                raise RuntimeError(
+                    f"divergence recurred at epoch {epoch} and the "
+                    f"suspect checkpoint step {last_step} could not be "
+                    f"quarantined ({exc}); treat the divergence as "
+                    "deterministic (docs/robustness.md)."
+                ) from exc
+            rollback_ctx["quarantines"] = \
+                rollback_ctx.get("quarantines", 0) + 1
             if telemetry is not None:
-                telemetry.mitigation(mtype="checkpoint_fallback", **info)
+                telemetry.quarantine(
+                    step=last_step, reason=reason, path=qpath,
+                    epoch=epoch, source="divergence rollback")
             warnings.warn(
-                f"divergence rollback: checkpoint step {info['step']} is "
-                f"corrupt and was skipped (deleted={info.get('deleted')}): "
-                f"{info['error']}"
+                f"divergence recurred at epoch {epoch}: checkpoint step "
+                f"{last_step} reproduced it and was quarantined "
+                f"({qpath}); retrying the rollback from an older step"
             )
+
+        from dib_tpu.train.checkpoint import fallback_reporter
+
+        # a step skipped (and quarantined) mid-rollback must be as loud
+        # as the CLI resume path's: mitigation + quarantine event +
+        # warning — recovery is never silent
+        report_fallback = fallback_reporter(
+            telemetry, source="divergence rollback")
 
         try:
             # fallback-aware: a corrupt latest step (e.g. torn by an
-            # earlier kill) is skipped — and deleted so the re-trained gap
-            # can checkpoint again — instead of wedging every rollback
+            # earlier kill) is skipped — and quarantined so the re-trained
+            # gap can checkpoint again — instead of wedging every rollback
             if hasattr(ckpt, "restore_latest_intact"):
                 state, history, key = ckpt.restore_latest_intact(
                     self, chunk_size=chunk, on_fallback=report_fallback)
@@ -736,15 +817,20 @@ class DIBTrainer:
             )
         if telemetry is not None:
             telemetry.mitigation(
-                mtype="divergence_rollback", epoch=epoch,
+                mtype=mtype, epoch=epoch,
                 restored_epoch=restored_epoch, **detail,
             )
+        what = ("anomalous (finite-SDC-shaped)"
+                if mtype == "anomaly_rollback" else "non-finite")
         warnings.warn(
-            f"non-finite loss/KL at epoch {epoch}; rolled back to the "
+            f"{what} loss/KL at epoch {epoch}; rolled back to the "
             f"chunk-aligned checkpoint at epoch {restored_epoch} "
-            "(β-schedule-consistent resume)"
+            "(β-schedule-consistent resume, keys re-derived from the "
+            "checkpoint's boundary key)"
         )
-        return state, history, key, restored_epoch - start_epoch, epoch
+        rollback_ctx["epoch"] = epoch
+        rollback_ctx["step"] = ckpt.latest_step
+        return state, history, key, restored_epoch - start_epoch
 
     # ------------------------------------------------------------ inspection
     def encode_feature(self, state: TrainState, feature_index: int, x_feature):
@@ -762,6 +848,17 @@ class DIBTrainer:
 
 
 # ------------------------------------------------------- divergence guard
+#: Suspect rollback targets one fit may quarantine before declaring the
+#: divergence deterministic — bounds the walk so a genuinely diverging
+#: run (bad LR, β too high) cannot consume its whole checkpoint history.
+_MAX_ROLLBACK_QUARANTINES = 2
+
+#: Global parameter L2 norm — the anomaly detector's gradient-norm
+#: stand-in channel, one tiny jitted reduction fetched with the boundary
+#: row (train/anomaly.py module docstring).
+_param_global_norm = jax.jit(optax.global_norm)
+
+
 def _row_finite(row: dict) -> bool:
     """True iff every fetched boundary metric (loss/val_loss/KL) is finite."""
     return all(

@@ -1,0 +1,109 @@
+"""``python -m dib_tpu ckpt scrub <dir>`` — offline content-integrity scan.
+
+The operator half of the SDC defense (docs/robustness.md "Numerical
+integrity"): restores only verify the step they restore, so a flipped bit
+in an OLDER retained step — tomorrow's divergence-rollback target — sits
+undetected until the worst possible moment. Scrub walks EVERY retained
+step of a ``DIBCheckpointer`` directory, re-reads its payload
+template-free (the abstract tree comes from the step's own metadata, so
+no model flags are needed), re-hashes every leaf, and compares against
+the v3 manifest's recorded digests.
+
+Exit codes (the ``telemetry check`` convention):
+
+  - ``0`` — every step clean (digest match, or pre-v3 steps with nothing
+    recorded, reported as such);
+  - ``1`` — at least one step mismatched or unreadable (or the manifest
+    itself is corrupt); ``--quarantine`` additionally moves the damaged
+    steps into ``quarantine/`` so no restore path can select them;
+  - ``2`` — bad operand: the directory does not exist or holds no
+    checkpoint.
+
+``--json`` prints the full report record instead of the human lines.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Sequence
+
+__all__ = ["ckpt_main", "scrub_main"]
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="dib_tpu ckpt scrub",
+        description="Verify every retained checkpoint step's content "
+                    "digests (manifest schema v3); report — and with "
+                    "--quarantine, move aside — corrupt steps.",
+    )
+    parser.add_argument("directory",
+                        help="A DIBCheckpointer directory (holds "
+                             "dib_manifest.json + numeric step dirs).")
+    parser.add_argument("--quarantine", action="store_true",
+                        help="Move mismatched/unreadable steps into "
+                             "<dir>/quarantine/ (never deleted; a "
+                             "QUARANTINE.json names the reason).")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="Print the full report record as JSON.")
+    return parser
+
+
+def scrub_main(argv: Sequence[str]) -> int:
+    try:
+        args = _build_parser().parse_args(list(argv))
+    except SystemExit as exc:
+        return int(exc.code or 0)
+    import os
+
+    from dib_tpu.train.checkpoint import DIBCheckpointer
+
+    directory = os.path.abspath(args.directory)
+    if not os.path.isdir(directory):
+        print(f"ckpt scrub: {directory} is not a directory",
+              file=sys.stderr)
+        return 2
+    ckpt = DIBCheckpointer(directory)
+    try:
+        if not ckpt.manager.all_steps():
+            print(f"ckpt scrub: {directory} holds no checkpoint steps",
+                  file=sys.stderr)
+            return 2
+        report = ckpt.scrub(quarantine=args.quarantine)
+    finally:
+        ckpt.close()
+    if args.as_json:
+        print(json.dumps(report))
+    else:
+        schema = report.get("schema")
+        print(f"ckpt scrub: {directory} (manifest schema {schema})")
+        if report.get("manifest_error"):
+            print(f"  MANIFEST CORRUPT: {report['manifest_error']}")
+        for row in report["steps"]:
+            line = f"  step {row['step']}: {row['status']}"
+            if row.get("leaves"):
+                line += " (" + ", ".join(row["leaves"][:4]) + ")"
+            if row.get("quarantined"):
+                line += f" -> quarantined at {row['quarantined']}"
+            print(line)
+        n = len(report["steps"])
+        bad = len(report["corrupt"])
+        print(f"  {n} step(s) scanned, {bad} corrupt"
+              + (" — all clean" if report["clean"] else ""))
+    return 0 if report["clean"] else 1
+
+
+def ckpt_main(argv: Sequence[str]) -> int:
+    """Dispatch for the ``ckpt`` subcommand family."""
+    argv = list(argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print("usage: python -m dib_tpu ckpt scrub <dir> "
+              "[--quarantine] [--json]", file=sys.stderr)
+        return 0 if argv else 2
+    if argv[0] != "scrub":
+        print(f"dib_tpu ckpt: unknown action {argv[0]!r} "
+              "(expected: scrub)", file=sys.stderr)
+        return 2
+    return scrub_main(argv[1:])

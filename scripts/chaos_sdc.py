@@ -1,0 +1,462 @@
+"""Silent-data-corruption chaos suite: corruption defense in depth.
+
+``scripts/fault_drill.py`` proves the crash/NaN recovery paths and
+``scripts/chaos_stream.py`` the always-on control plane; this suite
+proves the ISSUE 14 integrity layer — content-digest checkpoints
+(manifest v3), the β-aware anomaly rollback, and the poison-proof
+artifact plane — against corruptions that are *finite but wrong*, the
+shape no earlier guard could see. Three drill families:
+
+  - ``payload_bitflip`` — train with chunk checkpoints, flip ONE BIT in
+    the middle of the latest retained step's payload (structure intact,
+    bytes wrong): ``python -m dib_tpu ckpt scrub`` (subprocess CLI) must
+    exit 1 naming the step, ``restore_latest_intact`` must QUARANTINE it
+    (never delete — the bytes stay under ``quarantine/`` for the
+    operator) and fall back to the previous intact step, and the resumed
+    run must finish BIT-IDENTICAL to an uninterrupted baseline;
+  - ``finite_spike_sdc`` — a ``sdc@chunkN:4`` plan fault scales every
+    param leaf by 4 mid-run (finite garbage; the non-finite guard is
+    blind): the anomaly detector must fire at the next boundary (durable
+    ``anomaly`` events, every verdict kind ``spike``), the
+    ``anomaly_rollback`` must restore the pre-fault checkpoint, and the
+    finished history must be bit-identical to the baseline;
+  - ``poisoned_publish`` — the streaming trainer publishes, the
+    published checkpoint's payload is bit-flipped BETWEEN publish and
+    promote: the deployer must refuse it (``rolled_back`` deploy record
+    + ``canary_rollback`` mitigation naming the corruption), the fleet
+    must keep answering bit-identically from the previous checkpoint,
+    and the next clean publish must promote normally — zero corrupt
+    bytes ever answer a request.
+
+Each drill row asserts the three SDC invariants
+(``corruption_detected`` / ``rollback_parity`` /
+``zero_corrupt_responses``) and the record carries
+``undetected_corruptions`` (structurally 0 — the ``sdc_undetected_max``
+SLO rule gates it; ``telemetry check CHAOS_SDC.json`` evaluates it
+directly). Committed as ``CHAOS_SDC.json``, validated per-row by
+``scripts/check_run_artifacts.py``.
+
+Usage::
+
+    python scripts/chaos_sdc.py --out CHAOS_SDC.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+import warnings
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+METRIC = "chaos_sdc_matrix"
+
+#: Tiny run shared by the train drills and their baseline: 20 epochs in
+#: 2-epoch chunks (10 boundaries) — enough anneal-phase boundaries that
+#: the anomaly detector's trailing window is primed before the fault.
+PRE_EPOCHS, ANNEAL_EPOCHS, CHUNK = 2, 18, 2
+SDC_CHUNK, SDC_SCALE = 8, 4
+
+#: Streaming drill shape (the test_stream scale): 1-epoch chunks over a
+#: 32-row sliding window, one publish per round.
+WINDOW, STRIDE, BATCH = 32, 8, 16
+
+
+def _log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _bundle():
+    from dib_tpu.data import get_dataset
+
+    return get_dataset("boolean_circuit")
+
+
+def _model(bundle):
+    from dib_tpu.models import DistributedIBModel
+
+    return DistributedIBModel(
+        feature_dimensionalities=tuple(bundle.feature_dimensionalities),
+        encoder_hidden=(8,), integration_hidden=(16,),
+        output_dim=bundle.output_dimensionality, embedding_dim=2,
+    )
+
+
+def _make_trainer(bundle):
+    from dib_tpu.train import DIBTrainer, TrainConfig
+
+    return DIBTrainer(_model(bundle), bundle, TrainConfig(
+        batch_size=64, beta_start=1e-4, beta_end=1.0,
+        num_pretraining_epochs=PRE_EPOCHS,
+        num_annealing_epochs=ANNEAL_EPOCHS,
+        steps_per_epoch=2, max_val_points=128,
+    ))
+
+
+def _histories_identical(a, b) -> bool:
+    import numpy as np
+
+    return all(
+        np.array_equal(getattr(a, f), getattr(b, f))
+        for f in ("beta", "kl_per_feature", "loss", "val_loss")
+    )
+
+
+def _stream_evidence(run_dir: str) -> dict:
+    from dib_tpu.telemetry import summarize
+
+    summary = summarize(run_dir)
+    return {
+        "faults": summary.get("faults"),
+        "mitigations": summary.get("mitigations"),
+        "integrity": summary.get("integrity"),
+        "status": summary.get("status"),
+    }
+
+
+def _baseline_history(bundle, workdir):
+    """The uninterrupted 20-epoch reference both train drills compare
+    against (fresh trainer, fresh checkpoint dir, same key/chunk grid)."""
+    import jax
+
+    from dib_tpu.train import CheckpointHook, DIBCheckpointer
+
+    ckpt = DIBCheckpointer(os.path.join(workdir, "baseline_ckpt"))
+    try:
+        _, history = _make_trainer(bundle).fit(
+            jax.random.key(0), hooks=[CheckpointHook(ckpt)],
+            hook_every=CHUNK)
+    finally:
+        ckpt.close()
+    return history
+
+
+# ---------------------------------------------------------- drill 1
+def drill_payload_bitflip(bundle, baseline, workdir) -> dict:
+    """Flip one bit in a retained step -> scrub detects, restore
+    quarantines + falls back, resumed run bit-identical."""
+    import jax
+
+    from dib_tpu.faults import corrupt_checkpoint
+    from dib_tpu.telemetry import EventWriter
+    from dib_tpu.train import (
+        CheckpointHook,
+        DIBCheckpointer,
+        fallback_reporter,
+    )
+
+    _log("drill payload_bitflip: one flipped bit in a retained step")
+    outdir = os.path.join(workdir, "payload_bitflip")
+    ckpt_dir = os.path.join(outdir, "ckpt")
+    os.makedirs(outdir, exist_ok=True)
+    writer = EventWriter(outdir, run_id="chaos-sdc-bitflip")
+    t0 = time.time()
+    try:
+        trainer = _make_trainer(bundle)
+        ckpt = DIBCheckpointer(ckpt_dir)
+        try:
+            trainer.fit(jax.random.key(0), num_epochs=12,
+                        hooks=[CheckpointHook(ckpt)], hook_every=CHUNK,
+                        telemetry=writer)
+            clean = ckpt.scrub()
+        finally:
+            ckpt.close()
+        scrub_clean = clean["clean"] and all(
+            r["status"] == "ok" for r in clean["steps"])
+
+        detail = corrupt_checkpoint(ckpt_dir, "ckpt_bitflip_payload",
+                                    telemetry=writer)
+
+        # detection layer 1: the scrub CLI (subprocess), report-only
+        proc = subprocess.run(
+            [sys.executable, "-m", "dib_tpu", "ckpt", "scrub", ckpt_dir,
+             "--json"],
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            capture_output=True, text=True, timeout=600,
+        )
+        scrub_rc = proc.returncode
+        scrub_report = json.loads(proc.stdout) if proc.stdout else {}
+        scrub_found = 12 in (scrub_report.get("corrupt") or [])
+
+        # detection layer 2: the restore path quarantines + falls back
+        trainer2 = _make_trainer(bundle)
+        ckpt = DIBCheckpointer(ckpt_dir)
+        try:
+            state, history, key = ckpt.restore_latest_intact(
+                trainer2, chunk_size=CHUNK,
+                on_fallback=fallback_reporter(writer,
+                                              source="sdc drill"))
+            skipped = list(ckpt.fallback_skipped_steps)
+            quarantined = sorted(os.listdir(
+                os.path.join(ckpt_dir, "quarantine")))
+            restored_epoch = int(jax.device_get(state.epoch))
+            _, healed = trainer2.fit(
+                key, num_epochs=PRE_EPOCHS + ANNEAL_EPOCHS - restored_epoch,
+                state=state, history=history,
+                hooks=[CheckpointHook(ckpt)], hook_every=CHUNK,
+                telemetry=writer)
+        finally:
+            ckpt.close()
+        writer.run_end(status="ok")
+    finally:
+        writer.close()
+
+    identical = _histories_identical(baseline, healed)
+    detected = (scrub_rc == 1 and scrub_found and skipped == [12]
+                and any(q.startswith("12") for q in quarantined))
+    never_restored = restored_epoch == 10
+    ok = (scrub_clean and detected and identical and never_restored)
+    return {
+        "drill": "payload_bitflip", "kind": "ckpt_bitflip_payload",
+        "ok": bool(ok),
+        "flipped": {"path": os.path.relpath(detail["path"], workdir),
+                    "byte": detail["flipped_byte"],
+                    "bit": detail["flipped_bit"]},
+        "scrub_clean_before": bool(scrub_clean),
+        "scrub_rc": scrub_rc,
+        "scrub_found_step": bool(scrub_found),
+        "quarantined_steps": skipped,
+        "restored_epoch": restored_epoch,
+        "corruption_detected": bool(detected),
+        "rollback_parity": bool(identical),
+        "zero_corrupt_responses": bool(never_restored),
+        "wall_s": round(time.time() - t0, 1),
+        "evidence": _stream_evidence(outdir),
+    }
+
+
+# ---------------------------------------------------------- drill 2
+def drill_finite_spike_sdc(bundle, baseline, workdir) -> dict:
+    """Finite param corruption mid-run -> anomaly rollback, history
+    bit-identical to the uninterrupted baseline."""
+    import jax
+
+    from dib_tpu.faults import FaultPlan
+    from dib_tpu.telemetry import EventWriter, read_events
+    from dib_tpu.train import CheckpointHook, DIBCheckpointer
+
+    _log(f"drill finite_spike_sdc: sdc@chunk{SDC_CHUNK}:{SDC_SCALE} "
+         "(finite garbage, anomaly-rollback path)")
+    outdir = os.path.join(workdir, "finite_spike_sdc")
+    os.makedirs(outdir, exist_ok=True)
+    writer = EventWriter(outdir, run_id="chaos-sdc-spike")
+    t0 = time.time()
+    try:
+        ckpt = DIBCheckpointer(os.path.join(outdir, "ckpt"))
+        try:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                _, history = _make_trainer(bundle).fit(
+                    jax.random.key(0), hooks=[CheckpointHook(ckpt)],
+                    hook_every=CHUNK, telemetry=writer,
+                    fault_plan=FaultPlan.parse(
+                        f"sdc@chunk{SDC_CHUNK}:{SDC_SCALE}",
+                        state_dir=outdir))
+        finally:
+            ckpt.close()
+        writer.run_end(status="ok")
+    finally:
+        writer.close()
+
+    events = list(read_events(outdir))
+    anomalies = [e for e in events if e.get("type") == "anomaly"]
+    finite_only = bool(anomalies) and all(
+        e.get("kind") == "spike" for e in anomalies)
+    mitigations = [e.get("mtype") for e in events
+                   if e.get("type") == "mitigation"]
+    rolled_back = mitigations.count("anomaly_rollback") == 1
+    identical = _histories_identical(baseline, history)
+    evidence = _stream_evidence(outdir)
+    faults = evidence.get("faults") or {}
+    detected = (finite_only and rolled_back
+                and faults.get("injected") == faults.get("detected") == 1
+                and faults.get("recovered") == 1)
+    ok = detected and identical
+    return {
+        "drill": "finite_spike_sdc", "kind": "sdc", "ok": bool(ok),
+        "anomaly_events": len(anomalies),
+        "all_verdicts_finite_spikes": bool(finite_only),
+        "anomaly_channels": sorted({e.get("channel") for e in anomalies}),
+        "corruption_detected": bool(detected),
+        "rollback_parity": bool(identical),
+        # the anomalous boundary never reaches hooks, so no corrupt
+        # state was ever checkpointed or served
+        "zero_corrupt_responses": bool(rolled_back),
+        "wall_s": round(time.time() - t0, 1),
+        "evidence": evidence,
+    }
+
+
+# ---------------------------------------------------------- drill 3
+def drill_poisoned_publish(bundle, workdir) -> dict:
+    """Corrupt a published checkpoint between publish and promote ->
+    the deployer refuses it, the fleet keeps answering from the previous
+    checkpoint bit-identically, the next clean publish promotes."""
+    import jax
+    import numpy as np
+
+    from dib_tpu.faults import corrupt_checkpoint
+    from dib_tpu.serve.zoo import ModelZoo
+    from dib_tpu.stream.deployer import Deployer, read_deploys
+    from dib_tpu.stream.online import (
+        OnlineConfig,
+        OnlineDIBTrainer,
+        read_publishes,
+    )
+    from dib_tpu.telemetry import EventWriter
+    from dib_tpu.train import DIBTrainer, TrainConfig
+
+    _log("drill poisoned_publish: bit-flip a published checkpoint "
+         "between publish and promote")
+    outdir = os.path.join(workdir, "poisoned_publish")
+    stream_dir = os.path.join(outdir, "stream")
+    deploy_dir = os.path.join(outdir, "deploy")
+    os.makedirs(outdir, exist_ok=True)
+    writer = EventWriter(outdir, run_id="chaos-sdc-poison")
+    t0 = time.time()
+    probe = np.asarray(bundle.x_valid[:4], np.float32)
+    try:
+        config = TrainConfig(batch_size=BATCH, num_pretraining_epochs=1,
+                             num_annealing_epochs=2)
+        online = OnlineConfig(window=WINDOW, stride=STRIDE,
+                              chunk_epochs=1, publish_every=1, rounds=1,
+                              seed=0)
+        template = DIBTrainer(_model(bundle), bundle, config)
+        zoo = ModelZoo(exec_capacity=8, response_capacity=16)
+        deployer = Deployer(stream_dir, deploy_dir, template, zoo,
+                            telemetry=writer,
+                            router_kwargs=dict(batch_buckets=(1, 8)))
+
+        def run_rounds(n):
+            trainer = OnlineDIBTrainer(_model(bundle), bundle, config,
+                                       OnlineConfig(**{
+                                           **online.__dict__,
+                                           "rounds": n}),
+                                       stream_dir, telemetry=writer)
+            trainer.run(jax.random.key(0), rounds=n)
+
+        def serve_probe():
+            _, router = zoo.resolve()
+            return np.asarray(
+                router.entries[0].engine.predict(probe)["prediction"])
+
+        # round 1: clean publish promotes, record the fleet's answers
+        run_rounds(1)
+        deployer.catch_up()
+        resp_clean = serve_probe()
+
+        # round 2: publish lands, then its bytes are corrupted BEFORE
+        # the deployer ever sees the record
+        run_rounds(2)
+        victim = read_publishes(stream_dir)[0][-1]
+        victim_dir = os.path.join(stream_dir, victim["path"])
+        corrupt_checkpoint(victim_dir, "ckpt_bitflip_payload",
+                           telemetry=writer)
+        deployer.catch_up()
+        resp_during = serve_probe()
+
+        # round 3: the next clean publish promotes normally
+        run_rounds(3)
+        deployer.catch_up()
+        resp_after = serve_probe()
+        status = deployer.status()
+        writer.run_end(status="ok")
+    finally:
+        writer.close()
+
+    deploys, _ = read_deploys(deploy_dir)
+    by_pub = {d.get("publish_id"): d for d in deploys}
+    victim_decision = by_pub.get(victim["publish_id"], {})
+    refused = (victim_decision.get("action") == "rolled_back"
+               and "corrupt" in str(victim_decision.get("error", "")).lower())
+    parity = bool(np.array_equal(resp_clean, resp_during))
+    promoted_after = status["promoted"] == 2 and status["rollbacks"] == 1
+    recovered = bool(np.all(np.isfinite(resp_after))
+                     and not np.array_equal(resp_during, resp_after))
+    ok = refused and parity and promoted_after and recovered
+    return {
+        "drill": "poisoned_publish", "kind": "ckpt_bitflip_payload",
+        "ok": bool(ok),
+        "victim_publish": victim["publish_id"],
+        "victim_decision": {k: victim_decision.get(k)
+                            for k in ("action", "error")},
+        "deployer_status": status,
+        "promoted_after_poison": bool(promoted_after),
+        "corruption_detected": bool(refused),
+        # during the poisoned window every answer is bit-identical to
+        # the pre-poison checkpoint's — the fleet never blended
+        "rollback_parity": bool(parity),
+        "zero_corrupt_responses": bool(parity and recovered),
+        "wall_s": round(time.time() - t0, 1),
+        "evidence": _stream_evidence(outdir),
+    }
+
+
+# ----------------------------------------------------------------- driver
+def run_drills(workdir: str | None = None,
+               log=_log) -> dict:
+    owned = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="dib_chaos_sdc_")
+    bundle = _bundle()
+    matrix: list[dict] = []
+    try:
+        log("chaos_sdc: uninterrupted 20-epoch baseline")
+        baseline = _baseline_history(bundle, workdir)
+        matrix.append(drill_payload_bitflip(bundle, baseline, workdir))
+        matrix.append(drill_finite_spike_sdc(bundle, baseline, workdir))
+        matrix.append(drill_poisoned_publish(bundle, workdir))
+    finally:
+        if owned:
+            shutil.rmtree(workdir, ignore_errors=True)
+    passed = sum(1 for d in matrix if d["ok"])
+    undetected = sum(1 for d in matrix
+                     if d.get("corruption_detected") is not True)
+    return {
+        "metric": METRIC,
+        "value": passed,
+        "unit": "drills_passed",
+        "total": len(matrix),
+        "quick": False,
+        "all_passed": passed == len(matrix),
+        "undetected_corruptions": undetected,
+        "matrix": matrix,
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--out", default=None,
+                        help="Also write the JSON record to this path.")
+    parser.add_argument("--workdir", default=None,
+                        help="Keep drill artifacts here (default: a temp "
+                             "dir, removed afterwards).")
+    parser.add_argument("--runs-root", "--runs_root", dest="runs_root",
+                        default=None,
+                        help="Register this run in the fleet registry "
+                             "(<runs-root>/index.jsonl; default: "
+                             "DIB_RUNS_ROOT when set, else off).")
+    args = parser.parse_args(argv)
+    record = run_drills(workdir=args.workdir)
+    print(json.dumps(record), flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(json.dumps(record, indent=1) + "\n")
+    from dib_tpu.telemetry.registry import register_drill_record
+
+    if register_drill_record(record, root=args.runs_root,
+                             extra={"undetected_corruptions":
+                                    record["undetected_corruptions"]}) \
+            is not None:
+        _log("chaos_sdc: registered in the fleet registry")
+    return 0 if record["all_passed"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

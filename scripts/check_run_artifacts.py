@@ -51,7 +51,8 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 ARTIFACT_GLOBS = ("BENCH_*.json", "NORTHSTAR_*.json", "FAULT_DRILL*.json",
-                  "CHAOS_SCHED*.json", "CHAOS_STREAM*.json")
+                  "CHAOS_SCHED*.json", "CHAOS_STREAM*.json",
+                  "CHAOS_SDC*.json")
 
 # Null-value excuses: at least one must be present when value is null.
 _NULL_VALUE_EXCUSES = ("degraded", "error", "per_run_minutes", "runs_completed")
@@ -212,6 +213,39 @@ def _check_chaos_stream_matrix(record: dict, problems: list[str]) -> None:
         required_drills=_REQUIRED_CHAOS_STREAM_DRILLS,
         invariants=_CHAOS_STREAM_INVARIANTS,
         rerun_hint="scripts/chaos_stream.py --out CHAOS_STREAM.json")
+
+
+# Drills every committed full chaos_sdc_matrix record must carry
+# (scripts/chaos_sdc.py): silent-data-corruption defense in depth
+# (docs/robustness.md "Numerical integrity").
+_REQUIRED_CHAOS_SDC_DRILLS = (
+    "payload_bitflip", "finite_spike_sdc", "poisoned_publish",
+)
+
+#: The three SDC invariants asserted per drill row: the injected
+#: corruption was caught by a named defense layer, the post-recovery
+#: history/fleet state is bit-identical to an uninterrupted baseline,
+#: and no response (and no restored training state) was ever computed
+#: from corrupt bytes.
+_CHAOS_SDC_INVARIANTS = ("corruption_detected", "rollback_parity",
+                         "zero_corrupt_responses")
+
+
+def _check_chaos_sdc_matrix(record: dict, problems: list[str]) -> None:
+    """chaos_sdc_matrix-specific schema: every drill present (full
+    records), zero failures, the three SDC invariants asserted per row,
+    and the record-level zero-undetected gate the sdc_undetected_max
+    SLO rule reads."""
+    _check_chaos_matrix(
+        record, problems,
+        required_drills=_REQUIRED_CHAOS_SDC_DRILLS,
+        invariants=_CHAOS_SDC_INVARIANTS,
+        rerun_hint="scripts/chaos_sdc.py --out CHAOS_SDC.json")
+    if record.get("undetected_corruptions") != 0:
+        problems.append(
+            "'undetected_corruptions' must be present and exactly 0 "
+            "(the sdc_undetected_max SLO gate) — got "
+            f"{record.get('undetected_corruptions')!r}")
 
 
 def _check_kernel_bench(record: dict, problems: list[str]) -> None:
@@ -454,6 +488,8 @@ def check_record(record: dict, problems: list[str]) -> None:
             _check_chaos_sched_matrix(record, problems)
         if record.get("metric") == "chaos_stream_matrix":
             _check_chaos_stream_matrix(record, problems)
+        if record.get("metric") == "chaos_sdc_matrix":
+            _check_chaos_sdc_matrix(record, problems)
         if record.get("metric") == "mi_kernel_bench":
             _check_kernel_bench(record, problems)
         if record.get("metric") == "serve_async_loadgen_sweep":

@@ -1,0 +1,363 @@
+"""β-aware boundary anomaly detection + the anomaly-rollback machinery
+(ISSUE 14, docs/robustness.md "Numerical integrity").
+
+Contracts pinned here:
+
+  - the detector fires on non-finite values unconditionally, on finite
+    spikes only past the robust-z threshold, never before ``min_points``
+    clean deltas exist in the current β phase, and never on a KL/loss
+    IMPROVEMENT (one-sided scoring — an info-plane KL collapse is the
+    physics, not a fault);
+  - a ``sdc`` plan fault (finite param corruption) is detected at the
+    next boundary, rolled back through the existing checkpoint
+    machinery, and the finished history is BIT-IDENTICAL to an
+    uninterrupted baseline — with durable ``anomaly`` events and an
+    ``anomaly_rollback`` mitigation on the stream;
+  - a rollback target that REPRODUCES the anomaly (a checkpoint written
+    during an anomalous window) is quarantined and the rollback retries
+    older, instead of raising "deterministic divergence" over a
+    poisoned step;
+  - an anomalous sweep member rides the per-replica quarantine: healed
+    and spliced back bit-identically when the replay comes back clean,
+    EJECTED when its restore source stays poisoned.
+"""
+
+import os
+import warnings
+
+import jax
+import numpy as np
+import pytest
+
+from dib_tpu.data import get_dataset
+from dib_tpu.models import DistributedIBModel
+from dib_tpu.train import (
+    BoundaryAnomalyDetector,
+    CheckpointHook,
+    DIBCheckpointer,
+    DIBTrainer,
+    TrainConfig,
+)
+from dib_tpu.train.anomaly import boundary_channels
+
+pytestmark = pytest.mark.fault
+
+PRE, ANNEAL, CHUNK = 2, 18, 2
+
+
+@pytest.fixture(scope="module")
+def bundle():
+    return get_dataset("boolean_circuit")
+
+
+def make_trainer(bundle):
+    model = DistributedIBModel(
+        feature_dimensionalities=tuple(bundle.feature_dimensionalities),
+        encoder_hidden=(8,), integration_hidden=(16,),
+        output_dim=bundle.output_dimensionality, embedding_dim=2,
+    )
+    return DIBTrainer(model, bundle, TrainConfig(
+        batch_size=64, beta_start=1e-4, beta_end=1.0,
+        num_pretraining_epochs=PRE, num_annealing_epochs=ANNEAL,
+        steps_per_epoch=2, max_val_points=128,
+    ))
+
+
+# --------------------------------------------------------- detector units
+def _prime(det, values, start_epoch=4, step=2, channel="loss"):
+    for i, v in enumerate(values):
+        assert det.observe(start_epoch + i * step, {channel: v}) == []
+
+
+def test_clean_decay_never_fires():
+    det = BoundaryAnomalyDetector(num_pretraining_epochs=2)
+    losses = [1.0, 0.9, 0.82, 0.75, 0.7, 0.66, 0.63, 0.61]
+    _prime(det, losses)
+
+
+def test_spike_fires_and_never_joins_the_window():
+    det = BoundaryAnomalyDetector(num_pretraining_epochs=2)
+    _prime(det, [1.0, 0.9, 0.82, 0.75, 0.7, 0.66])
+    findings = det.observe(16, {"loss": 50.0})
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.kind == "spike" and f.channel == "loss"
+    assert f.phase == "anneal" and f.zscore > f.threshold
+    # the anomalous value never contaminated the yardstick: the same
+    # spike at the next boundary still fires
+    assert det.observe(18, {"loss": 50.0})
+    # and the clean continuation is accepted
+    assert det.observe(18, {"loss": 0.63}) == []
+
+
+def test_nonfinite_fires_unconditionally_even_with_no_history():
+    det = BoundaryAnomalyDetector(num_pretraining_epochs=2)
+    findings = det.observe(2, {"loss": float("nan")})
+    assert [f.kind for f in findings] == ["nonfinite"]
+    findings = det.observe(4, {"val_loss": float("inf")})
+    assert [f.kind for f in findings] == ["nonfinite"]
+
+
+def test_min_points_guard_and_phase_reset():
+    det = BoundaryAnomalyDetector(num_pretraining_epochs=10)
+    # three pretrain boundaries -> only 2 deltas, below min_points: even
+    # a huge jump is observation-only
+    _prime(det, [1.0, 0.9, 0.8], start_epoch=2, step=4)
+    assert det.observe(14, {"loss": 1e6}) == []   # 1e6 at a fresh phase
+    # anneal phase starts its OWN window: pretrain deltas don't judge it
+    assert det.phase(14) == "anneal"
+    _prime(det, [2.0, 1.8, 1.65, 1.5, 1.4], start_epoch=16, step=2)
+    assert det.observe(26, {"loss": 500.0})
+
+
+def test_kl_collapse_is_one_sided_clean():
+    """A sharp KL drop is an info-plane transition — the thing the repo
+    measures — and must NEVER be anomalous; the same-magnitude jump UP
+    is."""
+    det = BoundaryAnomalyDetector(num_pretraining_epochs=2)
+    _prime(det, [3.0, 2.9, 2.85, 2.8, 2.76, 2.73], channel="kl/0")
+    # transition: KL collapses by 100x the trailing delta — clean
+    assert det.observe(16, {"kl/0": 0.05}) == []
+    # corruption: KL jumps up by the same magnitude — fires
+    assert det.observe(16, {"kl/0": 5.5})
+
+
+def test_param_norm_is_two_sided():
+    det = BoundaryAnomalyDetector(num_pretraining_epochs=2)
+    _prime(det, [10.0, 10.2, 10.35, 10.5, 10.6, 10.7],
+           channel="param_norm")
+    assert det.observe(16, {"param_norm": 0.01})   # zeroed tensor
+    assert det.observe(16, {"param_norm": 400.0})  # inflated tensor
+
+
+def test_rewind_drops_post_rollback_observations():
+    det = BoundaryAnomalyDetector(num_pretraining_epochs=2)
+    _prime(det, [1.0, 0.9, 0.82, 0.75, 0.7, 0.66, 0.63])
+    det.rewind(12)
+    # entries past epoch 12 dropped: the replay re-observes them
+    assert det.observe(14, {"loss": 0.66}) == []
+    assert det.observe(16, {"loss": 0.63}) == []
+
+
+def test_boundary_channels_shape():
+    row = {"loss": np.float32(0.5), "val_loss": np.float32(0.6),
+           "kl_per_feature": np.asarray([0.1, 0.2, 0.3], np.float32)}
+    channels = boundary_channels(row, param_norm=12.5)
+    assert channels == {"loss": pytest.approx(0.5),
+                        "val_loss": pytest.approx(0.6),
+                        "kl/0": pytest.approx(0.1),
+                        "kl/1": pytest.approx(0.2),
+                        "kl/2": pytest.approx(0.3),
+                        "param_norm": 12.5}
+
+
+# --------------------------------------------------- serial fit rollback
+def test_sdc_fault_anomaly_rollback_is_bit_identical(bundle, tmp_path):
+    from dib_tpu.faults import FaultPlan
+    from dib_tpu.telemetry import EventWriter, read_events
+
+    ckpt = DIBCheckpointer(str(tmp_path / "base"))
+    try:
+        _, base = make_trainer(bundle).fit(
+            jax.random.key(0), hooks=[CheckpointHook(ckpt)],
+            hook_every=CHUNK)
+    finally:
+        ckpt.close()
+
+    outdir = tmp_path / "sdc"
+    ckpt = DIBCheckpointer(str(outdir / "ck"))
+    try:
+        with EventWriter(str(outdir), run_id="anomaly-test") as writer, \
+                warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            _, victim = make_trainer(bundle).fit(
+                jax.random.key(0), hooks=[CheckpointHook(ckpt)],
+                hook_every=CHUNK, telemetry=writer,
+                fault_plan=FaultPlan.parse("sdc@chunk8:4"))
+    finally:
+        ckpt.close()
+
+    assert any("anomalous (finite-SDC-shaped)" in str(w.message)
+               for w in caught)
+    events = list(read_events(str(outdir)))
+    anomalies = [e for e in events if e.get("type") == "anomaly"]
+    assert anomalies and all(e["kind"] == "spike" for e in anomalies)
+    assert all(e["phase"] == "anneal" for e in anomalies)
+    mits = [e["mtype"] for e in events if e.get("type") == "mitigation"]
+    assert mits.count("anomaly_rollback") == 1
+    assert "divergence_rollback" not in mits
+    for field in ("beta", "kl_per_feature", "loss", "val_loss"):
+        assert np.array_equal(getattr(base, field), getattr(victim, field))
+    # the integrity rollup carries the story for the SLO gate
+    from dib_tpu.telemetry import summarize
+
+    integrity = summarize(str(outdir))["integrity"]
+    assert integrity["anomaly_rollbacks"] == 1
+    assert integrity["anomalies"] == len(anomalies)
+
+
+class _PoisonOnceRestore:
+    """Checkpointer proxy whose FIRST restore_latest_intact hands back a
+    finitely-corrupted state — the 'checkpoint written during an
+    anomalous window' shape: restoring it reproduces the anomaly."""
+
+    def __init__(self, ckpt, factor=4.0):
+        self._ckpt = ckpt
+        self._factor = factor
+        self.poisoned = 0
+
+    def restore_latest_intact(self, *args, **kwargs):
+        from dib_tpu.faults import scale_params
+
+        state, history, key = self._ckpt.restore_latest_intact(
+            *args, **kwargs)
+        if self.poisoned == 0:
+            self.poisoned += 1
+            state = state._replace(
+                params=scale_params(state.params, self._factor))
+        return state, history, key
+
+    def __getattr__(self, attr):
+        return getattr(self._ckpt, attr)
+
+
+def test_recurring_anomaly_quarantines_the_rollback_target(
+        bundle, tmp_path):
+    """The poisoned-target escalation: when the restored checkpoint
+    reproduces the anomaly, that step is QUARANTINED and the rollback
+    retries from an older step — the fit completes bit-identically
+    instead of raising over a poisoned step."""
+    from dib_tpu.faults import FaultPlan
+    from dib_tpu.telemetry import EventWriter, read_events
+
+    ckpt = DIBCheckpointer(str(tmp_path / "base"))
+    try:
+        _, base = make_trainer(bundle).fit(
+            jax.random.key(0), hooks=[CheckpointHook(ckpt)],
+            hook_every=CHUNK)
+    finally:
+        ckpt.close()
+
+    outdir = tmp_path / "poisoned"
+    real = DIBCheckpointer(str(outdir / "ck"))
+    wrapper = _PoisonOnceRestore(real)
+    try:
+        with EventWriter(str(outdir), run_id="quarantine-test") as writer, \
+                warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            _, victim = make_trainer(bundle).fit(
+                jax.random.key(0), hooks=[CheckpointHook(wrapper)],
+                hook_every=CHUNK, telemetry=writer,
+                fault_plan=FaultPlan.parse("sdc@chunk8:4"))
+    finally:
+        real.close()
+
+    assert wrapper.poisoned == 1
+    events = list(read_events(str(outdir)))
+    quars = [e for e in events if e.get("type") == "quarantine"]
+    assert len(quars) == 1
+    assert quars[0]["step"] == 16
+    assert "anomalous window" in quars[0]["reason"]
+    assert os.path.isdir(os.path.join(str(outdir / "ck"),
+                                      "quarantine", "16"))
+    mits = [e["mtype"] for e in events if e.get("type") == "mitigation"]
+    assert mits.count("anomaly_rollback") == 2   # original + retry
+    for field in ("beta", "kl_per_feature", "loss", "val_loss"):
+        assert np.array_equal(getattr(base, field), getattr(victim, field))
+
+
+def test_quarantine_budget_exhaustion_raises_deterministic(
+        bundle, tmp_path):
+    """A restore source that stays poisoned past the quarantine budget
+    is genuinely deterministic and must raise, not consume the whole
+    checkpoint history."""
+    from dib_tpu.faults import FaultPlan
+
+    outdir = tmp_path / "always_poisoned"
+    real = DIBCheckpointer(str(outdir / "ck"))
+
+    class _AlwaysPoison(_PoisonOnceRestore):
+        def restore_latest_intact(self, *args, **kwargs):
+            from dib_tpu.faults import scale_params
+
+            state, history, key = self._ckpt.restore_latest_intact(
+                *args, **kwargs)
+            self.poisoned += 1
+            return state._replace(
+                params=scale_params(state.params, self._factor)), \
+                history, key
+
+    wrapper = _AlwaysPoison(real)
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            with pytest.raises(RuntimeError,
+                               match="deterministic"):
+                make_trainer(bundle).fit(
+                    jax.random.key(0), hooks=[CheckpointHook(wrapper)],
+                    hook_every=CHUNK,
+                    fault_plan=FaultPlan.parse("sdc@chunk8:4"))
+    finally:
+        real.close()
+    # budget: 2 quarantines -> 3 poisoned restores, then the raise
+    assert wrapper.poisoned == 3
+
+
+# ------------------------------------------------------ sweep anomalies
+def test_replica_sdc_heals_member_bit_identically(bundle, tmp_path):
+    """A finite-garbage member lane rides the per-replica quarantine:
+    healed by the original-width replay, spliced back bit-identically,
+    neighbor untouched."""
+    from dib_tpu.faults import FaultPlan
+    from dib_tpu.parallel import BetaSweepTrainer
+    from dib_tpu.telemetry import EventWriter, read_events
+
+    def make_sweep():
+        model = DistributedIBModel(
+            feature_dimensionalities=tuple(
+                bundle.feature_dimensionalities),
+            encoder_hidden=(8,), integration_hidden=(16,),
+            output_dim=bundle.output_dimensionality, embedding_dim=2,
+        )
+        return BetaSweepTrainer(
+            model, bundle, TrainConfig(
+                batch_size=64, beta_start=1e-4,
+                num_pretraining_epochs=PRE, num_annealing_epochs=ANNEAL,
+                steps_per_epoch=2, max_val_points=128),
+            1e-4, [0.5, 1.0],
+        )
+
+    keys = jax.random.split(jax.random.key(0), 2)
+    ckpt = DIBCheckpointer(str(tmp_path / "base"))
+    try:
+        _, base_records = make_sweep().fit(
+            keys, hooks=[CheckpointHook(ckpt)], hook_every=CHUNK)
+    finally:
+        ckpt.close()
+
+    outdir = tmp_path / "victim"
+    ckpt = DIBCheckpointer(str(outdir / "ck"))
+    try:
+        with EventWriter(str(outdir), run_id="sweep-sdc") as writer, \
+                warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            _, victim_records = make_sweep().fit(
+                keys, hooks=[CheckpointHook(ckpt)], hook_every=CHUNK,
+                telemetry=writer,
+                fault_plan=FaultPlan.parse("replica_sdc@chunk8:1"))
+    finally:
+        ckpt.close()
+
+    events = list(read_events(str(outdir)))
+    anomalies = [e for e in events if e.get("type") == "anomaly"]
+    assert anomalies and all(e.get("replica") == 1 for e in anomalies)
+    mits = [e for e in events if e.get("type") == "mitigation"]
+    rollbacks = [m for m in mits if m["mtype"] == "anomaly_rollback"]
+    assert len(rollbacks) == 1 and rollbacks[0]["replica"] == 1
+    assert not any(m["mtype"] == "replica_ejected" for m in mits)
+    for r in range(2):
+        for field in ("beta", "kl_per_feature", "loss", "val_loss"):
+            assert np.array_equal(getattr(base_records[r], field),
+                                  getattr(victim_records[r], field)), \
+                f"member {r} field {field}"
+        assert victim_records[r].ejected is False

@@ -346,10 +346,15 @@ def test_history_extend_stacked_sweep_axis():
 
 
 # ------------------------------------------------------- integrity manifest
-def test_manifest_written_and_verified(tmp_path):
+def test_manifest_written_and_verified(tmp_path, monkeypatch):
     """ISSUE 3 satellite: every save records a schema version + param-tree
-    structure hash; restore verifies the template against it."""
+    structure hash; restore verifies the template against it. ISSUE 14:
+    digest-bearing manifests are v3; with digests disabled
+    (DIB_CKPT_CONTENT_DIGESTS=0) a serial save stays on the v1 schema —
+    the schema names the manifest CONTENT, so v1-only readers keep
+    restoring it through a rolling fleet upgrade."""
     from dib_tpu.train.checkpoint import (
+        CHECKPOINT_SCHEMA_VERSION,
         MESH_FREE_CHECKPOINT_SCHEMA,
         param_structure_hash,
         read_manifest,
@@ -364,15 +369,25 @@ def test_manifest_written_and_verified(tmp_path):
     ckpt.manager.wait_until_finished()
 
     manifest = read_manifest(ckpt.directory)
-    # a serial (mesh-free) save stays on the v1 schema: the schema names
-    # the manifest CONTENT, so v1-only readers keep restoring it through
-    # a rolling fleet upgrade
-    assert manifest["checkpoint_schema"] == MESH_FREE_CHECKPOINT_SCHEMA
+    # content digests on (the default): the manifest is v3 and carries a
+    # per-leaf digest row for the saved step
+    assert manifest["checkpoint_schema"] == CHECKPOINT_SCHEMA_VERSION
+    assert "0" in manifest["content"]
+    assert all(len(d) == 64
+               for d in manifest["content"]["0"]["leaves"].values())
     assert manifest["param_structure_hash"] == param_structure_hash(state.params)
     assert any("encoders" in row for row in manifest["param_structure_rows"])
 
     # the matching template verifies silently
     verify_manifest(ckpt.directory, state.params)
+
+    # digests disabled: the rolling-upgrade escape keeps serial saves v1
+    monkeypatch.setenv("DIB_CKPT_CONTENT_DIGESTS", "0")
+    ckpt.save(3, state, history, key)
+    ckpt.manager.wait_until_finished()
+    manifest = read_manifest(ckpt.directory)
+    assert manifest["checkpoint_schema"] == MESH_FREE_CHECKPOINT_SCHEMA
+    assert "content" not in manifest
     ckpt.close()
 
 
@@ -484,11 +499,15 @@ def test_restore_latest_intact_falls_back_past_corruption(tmp_path):
     assert ckpt.fallback_skipped_steps == [6]
     # the restored state actually continues: finite params, right cursor
     assert int(np.asarray(history["cursor"])) == 3
-    # the corrupt step was DELETED, not left as latest: orbax refuses to
-    # re-save step <= latest_step, so keeping it would silently block the
-    # re-trained gap from checkpointing and leave a poisoned rollback
-    # target (code review finding, verified by repro)
-    assert skipped[0]["deleted"] is True
+    # the corrupt step was QUARANTINED, not left as latest: orbax refuses
+    # to re-save step <= latest_step, so keeping it would silently block
+    # the re-trained gap from checkpointing and leave a poisoned rollback
+    # target — and ISSUE 14 moves (never deletes) so the operator keeps
+    # the evidence under quarantine/
+    qpath = skipped[0]["quarantined"]
+    assert qpath and os.path.isdir(qpath)
+    assert os.path.basename(os.path.dirname(qpath)) == "quarantine"
+    assert os.path.exists(os.path.join(qpath, "QUARANTINE.json"))
     assert 6 not in ckpt.manager.all_steps()
     trainer = make_trainer()
     state, hist2 = trainer.fit(key, num_epochs=3, state=state,

@@ -1,0 +1,304 @@
+"""Content-integrity checkpoints (ISSUE 14): manifest v3 per-leaf
+digests, v1/v2/v3 interop, the quarantine lifecycle, and the
+``python -m dib_tpu ckpt scrub`` CLI.
+
+The load-bearing contracts:
+
+  - v3 save → v3 restore verifies digests on EVERY restore path (they
+    all funnel through ``DIBCheckpointer.restore``);
+  - a digest mismatch raises ``CheckpointCorruptionError`` NAMING the
+    offending leaf — not a deep Orbax error (and for a bit flip in the
+    tensorstore data plane, Orbax raises NOTHING: the digest is the only
+    detector — pinned here);
+  - a v2/v1 manifest restores vacuously under the v3 reader (rolling
+    upgrade);
+  - corrupt steps are QUARANTINED (moved, never deleted) and no restore
+    or rollback path can ever re-select them;
+  - ``ckpt scrub`` exits 0 clean / 1 mismatch / 2 bad operand, in
+    process and through the subprocess CLI.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from dib_tpu.data import get_dataset
+from dib_tpu.models import DistributedIBModel
+from dib_tpu.train import (
+    CheckpointCorruptionError,
+    CheckpointHook,
+    DIBCheckpointer,
+    DIBTrainer,
+    TrainConfig,
+)
+from dib_tpu.train.checkpoint import MANIFEST_FILENAME, read_manifest
+from dib_tpu.train.scrub import scrub_main
+
+pytestmark = pytest.mark.fault
+
+
+@pytest.fixture(scope="module")
+def bundle():
+    return get_dataset("boolean_circuit")
+
+
+def make_trainer(bundle):
+    model = DistributedIBModel(
+        feature_dimensionalities=tuple(bundle.feature_dimensionalities),
+        encoder_hidden=(8,), integration_hidden=(16,),
+        output_dim=bundle.output_dimensionality, embedding_dim=2,
+    )
+    return DIBTrainer(model, bundle, TrainConfig(
+        batch_size=64, num_pretraining_epochs=2, num_annealing_epochs=4,
+        steps_per_epoch=2, max_val_points=128,
+    ))
+
+
+@pytest.fixture()
+def two_steps(bundle, tmp_path):
+    """A checkpoint dir holding intact steps 3 and 6 (v3 manifest)."""
+    trainer = make_trainer(bundle)
+    ckpt = DIBCheckpointer(str(tmp_path / "ck"))
+    trainer.fit(jax.random.key(0), hooks=[CheckpointHook(ckpt)],
+                hook_every=3)
+    yield ckpt, trainer
+    ckpt.close()
+
+
+def _flip_data_bit(ckpt_dir: str, step: int) -> str:
+    from dib_tpu.faults import corrupt_checkpoint
+
+    return corrupt_checkpoint(ckpt_dir, "ckpt_bitflip_payload",
+                              step=step)["path"]
+
+
+# ------------------------------------------------------------ v3 digests
+def test_v3_restore_verifies_digests_and_bitflip_is_orbax_silent(
+        bundle, two_steps):
+    """THE SDC shape: one flipped bit in the tensorstore data plane
+    restores silently through Orbax — only the v3 digest catches it,
+    and the error names the offending leaf path."""
+    ckpt, trainer = two_steps
+    manifest = read_manifest(ckpt.directory)
+    assert manifest["checkpoint_schema"] == 3
+    assert set(manifest["content"]) == {"3", "6"}
+
+    # clean restore verifies silently
+    state, _, _ = ckpt.restore(make_trainer(bundle), step=6, chunk_size=3)
+    assert int(state.epoch) == 6
+
+    _flip_data_bit(ckpt.directory, 6)
+    # Orbax itself reads the flipped step without complaint — prove it,
+    # because this is the reason the digest layer exists
+    ckpt._restore_raw(6)
+    with pytest.raises(CheckpointCorruptionError) as excinfo:
+        ckpt.restore(make_trainer(bundle), step=6, chunk_size=3)
+    msg = str(excinfo.value)
+    assert "content-digest" in msg
+    # the offending leaf is NAMED with the normalized slash path
+    assert "state/" in msg or "history/" in msg
+    assert "scrub" in msg
+
+
+def test_digest_tamper_in_manifest_raises_naming_leaf(bundle, two_steps):
+    """Flipping the RECORDED digest (not the bytes) must also fail the
+    restore — the manifest and the payload vouch for each other."""
+    ckpt, _ = two_steps
+    path = os.path.join(ckpt.directory, MANIFEST_FILENAME)
+    manifest = json.load(open(path))
+    leaf = sorted(manifest["content"]["6"]["leaves"])[0]
+    manifest["content"]["6"]["leaves"][leaf] = "0" * 64
+    with open(path, "w") as f:
+        json.dump(manifest, f)
+    with pytest.raises(CheckpointCorruptionError, match="content-digest"):
+        ckpt.restore(make_trainer(bundle), step=6, chunk_size=3)
+    # the older step still restores (its rows untouched)
+    state, _, _ = ckpt.restore(make_trainer(bundle), step=3, chunk_size=3)
+    assert int(state.epoch) == 3
+
+
+def test_v2_and_v1_manifests_restore_vacuously(bundle, two_steps):
+    """Rolling upgrade: stripping the content block (v2) or everything
+    versioned (v1) must restore cleanly under the v3 reader — and a
+    flipped bit is then INVISIBLE, which is exactly why v3 exists."""
+    ckpt, _ = two_steps
+    path = os.path.join(ckpt.directory, MANIFEST_FILENAME)
+    manifest = json.load(open(path))
+    manifest.pop("content")
+    manifest["checkpoint_schema"] = 2
+    manifest["mesh"] = None
+    manifest.pop("mesh")
+    with open(path, "w") as f:
+        json.dump(manifest, f)
+    state, _, _ = ckpt.restore(make_trainer(bundle), step=6, chunk_size=3)
+    assert int(state.epoch) == 6
+
+    manifest["checkpoint_schema"] = 1
+    with open(path, "w") as f:
+        json.dump(manifest, f)
+    _flip_data_bit(ckpt.directory, 6)
+    # vacuous: the v1 manifest has no digests to disagree with
+    state, _, _ = ckpt.restore(make_trainer(bundle), step=6, chunk_size=3)
+    assert int(state.epoch) == 6
+
+
+# ------------------------------------------------------------ quarantine
+def test_fallback_quarantines_and_rollback_never_reselects(
+        bundle, two_steps):
+    """The poisoned-target fix: the corrupt step moves to quarantine/
+    (bytes kept), vanishes from every step listing, and a re-save over
+    its step number works — so neither the divergence rollback nor a
+    later resume can ever pick it again."""
+    ckpt, trainer = two_steps
+    _flip_data_bit(ckpt.directory, 6)
+    skipped = []
+    state, history, key = ckpt.restore_latest_intact(
+        make_trainer(bundle), chunk_size=3, on_fallback=skipped.append)
+    assert int(state.epoch) == 3
+    assert [s["step"] for s in skipped] == [6]
+    qpath = skipped[0]["quarantined"]
+    assert os.path.isdir(qpath)
+    meta = json.load(open(os.path.join(qpath, "QUARANTINE.json")))
+    assert meta["step"] == 6 and "corrupt at restore" in meta["reason"]
+    assert 6 not in ckpt.manager.all_steps()
+    # the gap re-checkpoints over the freed step number
+    trainer2 = make_trainer(bundle)
+    trainer2.fit(key, num_epochs=3, state=state, history=history,
+                 hooks=[CheckpointHook(ckpt)], hook_every=3)
+    assert ckpt.latest_step == 6
+    state6, _, _ = ckpt.restore(make_trainer(bundle), step=6, chunk_size=3)
+    assert int(state6.epoch) == 6
+    # the quarantined bytes are still there for the operator
+    assert os.path.isdir(qpath)
+
+
+def test_quarantine_without_manifest_keeps_steps_in_place(
+        bundle, two_steps):
+    """No manifest -> a deep restore error could be a template mismatch;
+    the walk must skip WITHOUT moving anything."""
+    ckpt, _ = two_steps
+    os.remove(os.path.join(ckpt.directory, MANIFEST_FILENAME))
+    from dib_tpu.faults import corrupt_checkpoint
+
+    corrupt_checkpoint(ckpt.directory, "ckpt_truncate")
+    skipped = []
+    state, _, _ = ckpt.restore_latest_intact(
+        make_trainer(bundle), chunk_size=3, on_fallback=skipped.append)
+    assert int(state.epoch) == 3
+    assert skipped[0]["quarantined"] is False
+    assert "no integrity manifest" in skipped[0]["reason"]
+    assert sorted(ckpt.manager.all_steps()) == [3, 6]
+
+
+def test_fallback_reporter_emits_mitigation_and_quarantine_events(
+        bundle, two_steps, tmp_path):
+    from dib_tpu.telemetry import EventWriter, read_events
+    from dib_tpu.train import fallback_reporter
+
+    ckpt, _ = two_steps
+    _flip_data_bit(ckpt.directory, 6)
+    outdir = tmp_path / "events"
+    with EventWriter(str(outdir), run_id="integrity-test") as writer:
+        ckpt.restore_latest_intact(
+            make_trainer(bundle), chunk_size=3,
+            on_fallback=fallback_reporter(writer, source="test",
+                                          log=lambda m: None))
+    events = list(read_events(str(outdir)))
+    mits = [e for e in events if e.get("type") == "mitigation"]
+    assert [m["mtype"] for m in mits] == ["checkpoint_fallback"]
+    assert mits[0]["step"] == 6 and mits[0]["quarantined"]
+    quars = [e for e in events if e.get("type") == "quarantine"]
+    assert len(quars) == 1 and quars[0]["step"] == 6
+    assert quars[0]["path"] == mits[0]["quarantined"]
+
+
+# ----------------------------------------------------------------- scrub
+def test_scrub_exit_codes_in_process(bundle, two_steps, tmp_path):
+    ckpt, _ = two_steps
+    # 0: clean
+    assert scrub_main([ckpt.directory]) == 0
+    # 1: mismatch — report-only leaves the step in place
+    _flip_data_bit(ckpt.directory, 6)
+    assert scrub_main([ckpt.directory]) == 1
+    assert 6 in ckpt.manager.all_steps()
+    # 1 + --quarantine: the damaged step moves aside
+    assert scrub_main([ckpt.directory, "--quarantine"]) == 1
+    ckpt.manager.reload()
+    assert 6 not in ckpt.manager.all_steps()
+    assert os.path.isdir(os.path.join(ckpt.directory, "quarantine", "6"))
+    # 0 again: what remains is clean
+    assert scrub_main([ckpt.directory]) == 0
+    # 2: bad operands
+    assert scrub_main([str(tmp_path / "nope")]) == 2
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert scrub_main([str(empty)]) == 2
+
+
+def test_scrub_report_names_steps_and_statuses(bundle, two_steps, capsys):
+    ckpt, _ = two_steps
+    _flip_data_bit(ckpt.directory, 6)
+    rc = scrub_main([ckpt.directory, "--json"])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    by_step = {r["step"]: r for r in report["steps"]}
+    assert by_step[3]["status"] == "ok"
+    assert by_step[6]["status"] in ("mismatch", "unreadable")
+    if by_step[6]["status"] == "mismatch":
+        assert by_step[6]["leaves"]
+    assert report["corrupt"] == [6]
+    assert report["clean"] is False
+
+
+def test_scrub_subprocess_cli(bundle, two_steps):
+    """The committed acceptance: `python -m dib_tpu ckpt scrub` detects
+    a single flipped bit in a retained step's payload, via the real
+    CLI."""
+    ckpt, _ = two_steps
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    clean = subprocess.run(
+        [sys.executable, "-m", "dib_tpu", "ckpt", "scrub",
+         ckpt.directory],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert clean.returncode == 0, clean.stderr[-800:]
+    _flip_data_bit(ckpt.directory, 6)
+    dirty = subprocess.run(
+        [sys.executable, "-m", "dib_tpu", "ckpt", "scrub",
+         ckpt.directory, "--json"],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert dirty.returncode == 1, dirty.stderr[-800:]
+    assert 6 in json.loads(dirty.stdout)["corrupt"]
+    bad = subprocess.run(
+        [sys.executable, "-m", "dib_tpu", "ckpt", "scrub",
+         "/definitely/not/a/dir"],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert bad.returncode == 2
+    unknown = subprocess.run(
+        [sys.executable, "-m", "dib_tpu", "ckpt", "frobnicate"],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert unknown.returncode == 2
+
+
+def test_digests_disabled_env_restores_without_verification(
+        bundle, tmp_path, monkeypatch):
+    """DIB_CKPT_CONTENT_DIGESTS=0: the rolling-upgrade escape writes
+    pre-v3 manifests and scrub reports no_digests without failing."""
+    monkeypatch.setenv("DIB_CKPT_CONTENT_DIGESTS", "0")
+    trainer = make_trainer(bundle)
+    ckpt = DIBCheckpointer(str(tmp_path / "ck"))
+    try:
+        trainer.fit(jax.random.key(0), hooks=[CheckpointHook(ckpt)],
+                    hook_every=3)
+        manifest = read_manifest(ckpt.directory)
+        assert manifest["checkpoint_schema"] == 1
+        assert "content" not in manifest
+        report = ckpt.scrub()
+        assert report["clean"] is True
+        assert all(r["status"] == "no_digests" for r in report["steps"])
+    finally:
+        ckpt.close()

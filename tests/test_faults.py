@@ -89,9 +89,10 @@ def test_registry_covers_the_drill_matrix():
     assert scopes == {"train", "checkpoint", "serve", "http", "multihost",
                       "sched"}
     for kind in ("stall", "kill", "nan", "ckpt_truncate",
-                 "ckpt_bitflip_manifest", "replica_error", "replica_slow",
+                 "ckpt_bitflip_manifest", "ckpt_bitflip_payload",
+                 "replica_error", "replica_slow",
                  "batcher_crash", "http_malformed",
-                 "replica_nan", "preempt", "desync",
+                 "replica_nan", "preempt", "desync", "sdc", "replica_sdc",
                  "sched_worker_kill", "lease_expire", "journal_torn"):
         assert kind in FAULT_KINDS
 

@@ -208,6 +208,9 @@ def test_host_sync_targets_only_chunk_loop_modules():
     # ...and (ISSUE 12) the streaming control plane: the online loop is
     # a chunk loop, and the deployer restores/probes while the fleet
     # serves
+    # ...and (ISSUE 14) the integrity plane: the anomaly detector runs
+    # at every chunk boundary and must live off the row fetch the
+    # boundary already pays for; the digest/scrub layer syncs explicitly
     assert set(host.target_modules) == {
         "dib_tpu/train/loop.py",
         "dib_tpu/train/measurement.py",
@@ -225,6 +228,9 @@ def test_host_sync_targets_only_chunk_loop_modules():
         "dib_tpu/serve/zoo.py",
         "dib_tpu/stream/online.py",
         "dib_tpu/stream/deployer.py",
+        "dib_tpu/train/anomaly.py",
+        "dib_tpu/train/scrub.py",
+        "dib_tpu/train/checkpoint.py",
     }
 
 

@@ -310,7 +310,10 @@ def test_premesh_checkpoint_restores_vacuously(model, bundle, ckpt_dir,
 # ----------------------------------------------------- manifest contract
 def test_manifest_v2_mesh_rows(ckpt_dir):
     manifest = read_manifest(ckpt_dir)
-    assert manifest["checkpoint_schema"] == 2
+    # mesh rows unchanged since v2; the schema reads 3 because sweep
+    # saves also carry the ISSUE 14 content-digest block
+    assert manifest["checkpoint_schema"] == 3
+    assert manifest["content"]
     block = manifest["mesh"]
     assert block["logical_grid"] == [len(ENDS)]
     assert block["beta_ends"] == [pytest.approx(b) for b in ENDS]

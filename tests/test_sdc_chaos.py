@@ -1,0 +1,274 @@
+"""The SDC chaos-suite artifact contract + the canary KL gate
+(``scripts/chaos_sdc.py``, docs/robustness.md "Numerical integrity").
+
+Fast tier (``-m fault``): the committed ``CHAOS_SDC.json`` must
+validate against the artifact schema (per-row SDC invariants + the
+record-level zero-undetected gate), cover every drill family, and show
+all of them passing; ``telemetry check CHAOS_SDC.json`` must evaluate
+the ``sdc_undetected_max`` SLO rule green against the committed pair
+and exit 1 on a seeded violation. The deployer's widened canary — per-
+channel KL against the publisher's recorded boundary stats, the gate
+that catches FINITE garbage — runs end to end in-process. The full
+drill matrix re-run is exercised by the committed record's generator
+and stays out of tier 1 (each family trains real models).
+"""
+
+import copy
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.fault
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ARTIFACT = os.path.join(REPO, "CHAOS_SDC.json")
+
+EXPECTED_DRILLS = {"payload_bitflip", "finite_spike_sdc",
+                   "poisoned_publish"}
+INVARIANTS = ("corruption_detected", "rollback_parity",
+              "zero_corrupt_responses")
+
+
+# ------------------------------------------------------------- contract
+def test_committed_chaos_sdc_artifact_validates():
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    from check_run_artifacts import check_file
+
+    assert os.path.exists(ARTIFACT), (
+        "CHAOS_SDC.json missing — run `python scripts/chaos_sdc.py "
+        "--out CHAOS_SDC.json` and commit the record")
+    assert check_file(ARTIFACT) == []
+
+
+def test_committed_chaos_sdc_matrix_is_complete_and_green():
+    record = json.load(open(ARTIFACT))
+    assert record["metric"] == "chaos_sdc_matrix"
+    assert record["unit"] == "drills_passed"
+    drills = {d["drill"]: d for d in record["matrix"]}
+    assert set(drills) == EXPECTED_DRILLS
+    assert record["all_passed"] is True
+    assert record["value"] == record["total"] == len(EXPECTED_DRILLS)
+    assert record["undetected_corruptions"] == 0
+    for name, d in drills.items():
+        for invariant in INVARIANTS:
+            assert d[invariant] is True, (name, invariant)
+    # the headline evidence per family
+    bitflip = drills["payload_bitflip"]
+    assert bitflip["scrub_rc"] == 1 and bitflip["scrub_found_step"]
+    assert bitflip["quarantined_steps"] == [12]
+    spike = drills["finite_spike_sdc"]
+    assert spike["all_verdicts_finite_spikes"] is True
+    assert spike["anomaly_events"] >= 1
+    poison = drills["poisoned_publish"]
+    assert poison["victim_decision"]["action"] == "rolled_back"
+    assert "corrupt" in poison["victim_decision"]["error"].lower()
+    assert poison["deployer_status"]["rollbacks"] == 1
+    assert poison["deployer_status"]["promoted"] == 2
+
+
+def test_committed_chaos_sdc_evidence_detection_and_recovery():
+    """Every drill's embedded telemetry evidence agrees with the suite's
+    bookkeeping: injected == detected, nothing undetected."""
+    record = json.load(open(ARTIFACT))
+    for drill in record["matrix"]:
+        faults = (drill.get("evidence") or {}).get("faults") or {}
+        assert faults.get("injected", 0) >= 1, drill["drill"]
+        assert faults.get("detected") == faults.get("injected"), \
+            drill["drill"]
+        assert faults.get("undetected") == [], drill["drill"]
+
+
+def test_check_run_artifacts_rejects_broken_sdc_shapes(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    from check_run_artifacts import check_file
+
+    record = json.load(open(ARTIFACT))
+
+    def write(mutate):
+        bad = copy.deepcopy(record)
+        mutate(bad)
+        path = tmp_path / "CHAOS_SDC_BAD.json"
+        path.write_text(json.dumps(bad))
+        return check_file(str(path))
+
+    # a missing drill family on a full record
+    problems = write(lambda r: r["matrix"].pop(0))
+    assert any("missing drill" in p for p in problems)
+    # a failed drill
+    problems = write(
+        lambda r: r["matrix"][0].__setitem__("ok", False))
+    assert any("failures" in p for p in problems)
+    # a dropped invariant
+    problems = write(
+        lambda r: r["matrix"][1].__setitem__("rollback_parity", False))
+    assert any("rollback_parity" in p for p in problems)
+    # a nonzero undetected count
+    problems = write(
+        lambda r: r.__setitem__("undetected_corruptions", 1))
+    assert any("undetected_corruptions" in p for p in problems)
+
+
+# ---------------------------------------------------------- SLO pairing
+def test_telemetry_check_gates_the_committed_pair():
+    from dib_tpu.telemetry.slo import check_run
+
+    report = check_run(ARTIFACT, os.path.join(REPO, "SLO.json"),
+                       write=False)
+    rules = {r["rule"]: r for r in report["rules"]}
+    assert rules["sdc_undetected_max"]["status"] == "ok"
+    assert rules["sdc_undetected_max"]["value"] == 0
+    assert report["violations"] == 0
+
+
+def test_telemetry_check_pages_on_seeded_undetected(tmp_path):
+    record = json.load(open(ARTIFACT))
+    record["undetected_corruptions"] = 1
+    bad = tmp_path / "CHAOS_SDC_SEEDED.json"
+    bad.write_text(json.dumps(record))
+    proc = subprocess.run(
+        [sys.executable, "-m", "dib_tpu", "telemetry", "check",
+         str(bad), "--slo", os.path.join(REPO, "SLO.json")],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 1, proc.stdout[-500:] + proc.stderr[-500:]
+    assert "sdc_undetected_max" in proc.stdout
+
+
+def test_committed_registry_carries_sdc_history():
+    entries = [json.loads(line)
+               for line in open(os.path.join(REPO, "runs", "index.jsonl"))
+               if line.strip()]
+    sdc = [e for e in entries if e.get("metric") == "chaos_sdc_matrix"]
+    assert sdc, "runs/index.jsonl must carry the CHAOS_SDC evidence"
+    assert sdc[-1]["all_passed"] is True
+    assert sdc[-1]["undetected_corruptions"] == 0
+
+
+# ----------------------------------------------------- canary KL gate
+@pytest.fixture(scope="module")
+def bundle():
+    from dib_tpu.data import get_dataset
+
+    return get_dataset("boolean_circuit")
+
+
+def _model(bundle):
+    from dib_tpu.models import DistributedIBModel
+
+    return DistributedIBModel(
+        feature_dimensionalities=tuple(bundle.feature_dimensionalities),
+        encoder_hidden=(8,), integration_hidden=(16,),
+        output_dim=bundle.output_dimensionality, embedding_dim=2,
+    )
+
+
+def test_canary_kl_gate_refuses_finite_garbage(bundle, tmp_path):
+    """End to end: a published checkpoint whose params are FINITE
+    garbage (valid digests, finite predictions — every pre-ISSUE-14
+    gate green) fails promotion on the per-channel KL check against the
+    publisher's recorded boundary stats; the previous checkpoint keeps
+    answering, and a clean publish promotes normally."""
+    import jax
+
+    from dib_tpu.faults import scale_params
+    from dib_tpu.serve.zoo import ModelZoo
+    from dib_tpu.stream.deployer import Deployer, read_deploys
+    from dib_tpu.stream.online import (
+        OnlineConfig,
+        OnlineDIBTrainer,
+        read_publishes,
+    )
+    from dib_tpu.train import DIBCheckpointer, DIBTrainer, TrainConfig
+
+    stream_dir = tmp_path / "stream"
+    deploy_dir = tmp_path / "deploy"
+    config = TrainConfig(batch_size=16, num_pretraining_epochs=1,
+                         num_annealing_epochs=2)
+    online = OnlineConfig(window=32, stride=8, chunk_epochs=1,
+                          publish_every=1, rounds=2, seed=0)
+    template = DIBTrainer(_model(bundle), bundle, config)
+    zoo = ModelZoo(exec_capacity=8, response_capacity=16)
+    deployer = Deployer(str(stream_dir), str(deploy_dir), template, zoo,
+                        router_kwargs=dict(batch_buckets=(1, 8)))
+
+    OnlineDIBTrainer(_model(bundle), bundle, config, online,
+                     str(stream_dir)).run(jax.random.key(0), rounds=2)
+    publishes, _ = read_publishes(str(stream_dir))
+    assert len(publishes) == 2
+    # every publish record carries the publisher's boundary stats
+    assert all(p["boundary"]["kl_per_feature"] for p in publishes)
+
+    # promote publish 1 only, then poison publish 2's params IN PLACE
+    # with finite garbage re-saved under valid digests
+    victim = publishes[-1]
+    victim_dir = os.path.join(str(stream_dir), victim["path"])
+    ckpt = DIBCheckpointer(victim_dir)
+    try:
+        state, history, key = ckpt.restore(template)
+        poisoned = state._replace(
+            params=scale_params(state.params, 16.0))
+        ckpt.save(int(victim["step"]) + 1, poisoned, history, key)
+    finally:
+        ckpt.close()
+
+    assert deployer.catch_up() == 2
+    deploys, _ = read_deploys(str(deploy_dir))
+    by_pub = {d["publish_id"]: d for d in deploys}
+    first = by_pub[publishes[0]["publish_id"]]
+    assert first["action"] == "promoted"
+    refused = by_pub[victim["publish_id"]]
+    assert refused["action"] == "rolled_back"
+    assert "KL disagrees" in refused["error"]
+    # the fleet still answers from the promoted (clean) checkpoint
+    probe = np.asarray(bundle.x_valid[:4], np.float32)
+    _, router = zoo.resolve()
+    out = router.entries[0].engine.predict(probe)
+    assert np.all(np.isfinite(np.asarray(out["prediction"])))
+    zoo.close()
+
+
+def test_canary_without_recorded_stats_is_vacuous(bundle, tmp_path):
+    """Rolling upgrade: a publish record from a pre-ISSUE-14 trainer
+    (no boundary stats) canaries on the finite gates only."""
+    import jax
+
+    from dib_tpu.serve.zoo import ModelZoo
+    from dib_tpu.stream.deployer import Deployer, read_deploys
+    from dib_tpu.stream.online import (
+        OnlineConfig,
+        OnlineDIBTrainer,
+        publishes_path,
+        read_publishes,
+    )
+    from dib_tpu.train import DIBTrainer, TrainConfig
+
+    stream_dir = tmp_path / "stream"
+    deploy_dir = tmp_path / "deploy"
+    config = TrainConfig(batch_size=16, num_pretraining_epochs=1,
+                         num_annealing_epochs=2)
+    online = OnlineConfig(window=32, stride=8, chunk_epochs=1,
+                          publish_every=1, rounds=1, seed=0)
+    OnlineDIBTrainer(_model(bundle), bundle, config, online,
+                     str(stream_dir)).run(jax.random.key(0), rounds=1)
+    # strip the boundary stats from the journal, old-publisher style
+    records = [json.loads(line)
+               for line in open(publishes_path(str(stream_dir)))]
+    for rec in records:
+        rec.pop("boundary", None)
+    with open(publishes_path(str(stream_dir)), "w") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+    assert read_publishes(str(stream_dir))[0][0].get("boundary") is None
+
+    template = DIBTrainer(_model(bundle), bundle, config)
+    zoo = ModelZoo(exec_capacity=8, response_capacity=16)
+    deployer = Deployer(str(stream_dir), str(deploy_dir), template, zoo,
+                        router_kwargs=dict(batch_buckets=(1, 8)))
+    assert deployer.catch_up() == 1
+    deploys, _ = read_deploys(str(deploy_dir))
+    assert deploys[0]["action"] == "promoted"
+    zoo.close()
