@@ -78,6 +78,10 @@ _ROLLUP_DOC_CHECKS = (
     ("streaming_rollup", "Streaming-rollup keys"),
     # ISSUE 14: the numerical-integrity rollup (anomaly/quarantine view)
     ("integrity_rollup", "Integrity-rollup keys"),
+    # ISSUE 15: the closed-loop study rollup (dib_tpu/study) — the SLO
+    # gate keys (rounds_over_budget / unconverged_full_budget) must stay
+    # documented as they grow
+    ("study_rollup", "Study-rollup keys"),
 )
 
 

@@ -97,6 +97,12 @@ class HostSyncPass(LintPass):
         "dib_tpu/train/anomaly.py",
         "dib_tpu/train/scrub.py",
         "dib_tpu/train/checkpoint.py",
+        # the study controller joined with ISSUE 15: it drives the
+        # scheduler pool whose workers run MANY units' chunk loops —
+        # the decision core must stay on the unit histories' saved
+        # arrays, never on an implicit fetch that would serialize the
+        # round it is trying to steer
+        "dib_tpu/study/controller.py",
     )
 
     def check_module(self, module: Module) -> list[Finding]:

@@ -91,7 +91,8 @@ def build_parser() -> argparse.ArgumentParser:
     )
     parser.add_argument("command", nargs="?", default="train",
                         choices=["train", "workload", "telemetry", "serve",
-                                 "lint", "sched", "stream", "ckpt"],
+                                 "lint", "sched", "stream", "ckpt",
+                                 "study"],
                         help="Subcommand: 'train' (flags below), 'workload' "
                              "(paper workloads; see `dib_tpu workload --help`), "
                              "'telemetry' (summarize/compare/report run "
@@ -103,9 +104,11 @@ def build_parser() -> argparse.ArgumentParser:
                              "fault-tolerant β-grid scheduler; see "
                              "`dib_tpu sched --help`), 'stream' (the "
                              "always-on train-to-serve control plane; see "
-                             "`dib_tpu stream --help`), or 'ckpt' "
+                             "`dib_tpu stream --help`), 'ckpt' "
                              "(checkpoint content-integrity tooling: "
-                             "`dib_tpu ckpt scrub <dir>`).")
+                             "`dib_tpu ckpt scrub <dir>`), or 'study' "
+                             "(the closed-loop info-plane science "
+                             "engine; see `dib_tpu study --help`).")
     _add_model_flags(parser)
     parser.add_argument("--artifact_outdir", type=str, default="./training_artifacts/")
     parser.add_argument("--learning_rate", type=float, default=3e-4)
@@ -1293,6 +1296,13 @@ def main(argv: Sequence[str] | None = None) -> int:
             from dib_tpu.stream.cli import stream_main
 
             return stream_main(argv[1:])
+        if argv and argv[0] == "study":
+            # submit/status/report are pure journal/file analysis; run
+            # drains rounds through the scheduler pool, which
+            # initializes the backend itself when it trains
+            from dib_tpu.study.cli import study_main
+
+            return study_main(argv[1:])
         if argv and argv[0] == "ckpt":
             # content-integrity scrub over a checkpoint directory
             # (docs/robustness.md "Numerical integrity"); restores run on
@@ -1302,7 +1312,7 @@ def main(argv: Sequence[str] | None = None) -> int:
             return ckpt_main(argv[1:])
         args = build_parser().parse_args(argv)
         if args.command in ("workload", "telemetry", "serve", "lint",
-                            "sched", "stream", "ckpt"):
+                            "sched", "stream", "ckpt", "study"):
             # parsed from a non-leading position (flags first): these
             # subcommands' flags are not the train flags, so re-dispatching
             # would misparse. Name the flag that displaced the subcommand

@@ -1,0 +1,63 @@
+"""Closed-loop info-plane science engine (docs/study.md).
+
+``dib_tpu/study`` turns a dense-grid β study — hundreds of (β, seed)
+training units with error bars — into ONE submitted job: a controller
+that submits rounds of work through the β-grid scheduler
+(``dib_tpu/sched``), reads the finished units' per-channel KL curves,
+localizes the info-plane transitions the paper's physics lives at,
+and auto-submits ``refine_beta_grid`` + multi-seed ensemble rounds
+around them under an explicit compute budget, until the transition-β
+estimates stop moving (convergence) or the budget runs out
+(``unconverged`` — loudly, never silently).
+
+Every round's decisions are journaled append-only BEFORE they execute
+(``study/journal.py``), so a SIGKILLed controller restarts into the
+exact round with exactly-once job submission — the scheduler journal is
+the cross-check. The finished study renders as a single self-contained
+HTML artifact with ensemble-banded info-plane figures
+(``study/report.py``) plus a machine-readable record the SLO gates read.
+"""
+
+from dib_tpu.study.controller import (
+    StudyConfig,
+    StudyController,
+    aggregate_brackets,
+    channel_crossings,
+    curvature_centers,
+    ensemble_band_nats,
+    estimate_from_bracket,
+    plan_refinement,
+    unit_points,
+    watch_centers,
+)
+from dib_tpu.study.journal import (
+    STUDY_JOURNAL_FILENAME,
+    StudyJournal,
+    fold_study,
+    read_study_journal,
+)
+from dib_tpu.study.report import (
+    render_study_report,
+    study_record,
+    write_study_report,
+)
+
+__all__ = [
+    "STUDY_JOURNAL_FILENAME",
+    "StudyConfig",
+    "StudyController",
+    "StudyJournal",
+    "aggregate_brackets",
+    "channel_crossings",
+    "curvature_centers",
+    "ensemble_band_nats",
+    "estimate_from_bracket",
+    "fold_study",
+    "plan_refinement",
+    "read_study_journal",
+    "render_study_report",
+    "study_record",
+    "unit_points",
+    "watch_centers",
+    "write_study_report",
+]

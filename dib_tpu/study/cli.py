@@ -1,0 +1,298 @@
+"""``python -m dib_tpu study submit|status|run|report`` — one submitted job.
+
+``submit`` journals a study's configuration (durably, before anything
+runs); ``run`` drives the controller to its verdict — submitting rounds
+through the scheduler, draining them with an in-process worker pool, and
+resuming exactly-once after any kill; ``status`` is a read-only replay
+of the two journals; ``report`` renders the finished study as a single
+self-contained HTML artifact plus the machine-readable record the CI
+gates read. The study directory is also the run directory:
+``study.jsonl`` + ``journal.jsonl`` + ``events.jsonl`` + ``units/``
+side by side, so ``telemetry tail|summarize|check`` see the study's
+events next to the scheduler's (docs/study.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Sequence
+
+__all__ = ["study_main"]
+
+
+def _add_study_dir(parser) -> None:
+    parser.add_argument("--study-dir", "--study_dir", dest="study_dir",
+                        required=True,
+                        help="Study directory: holds study.jsonl (the "
+                             "controller's decisions), the scheduler's "
+                             "journal.jsonl, the shared events.jsonl, "
+                             "and per-unit artifacts under units/.")
+
+
+def _add_config_flags(parser) -> None:
+    parser.add_argument("--grid", type=float, nargs=3, default=None,
+                        metavar=("START", "STOP", "NUM"),
+                        help="Round-0 dense log-spaced β grid (default "
+                             "0.03 30 6).")
+    parser.add_argument("--seeds", type=int, nargs="+", default=None,
+                        help="Ensemble seeds per β point (default 0 1).")
+    parser.add_argument("--beta-start", type=float, default=None,
+                        dest="beta_start",
+                        help="Annealing start β for every unit.")
+    parser.add_argument("--threshold-nats", type=float, default=None,
+                        dest="threshold_nats",
+                        help="Per-channel KL transition threshold "
+                             "(default 0.1 nats).")
+    parser.add_argument("--tolerance-decades", type=float, default=None,
+                        dest="tolerance_decades",
+                        help="Convergence: max round-over-round "
+                             "transition-β move (default 0.15 decades).")
+    parser.add_argument("--max-bracket-decades", type=float, default=None,
+                        dest="max_bracket_decades",
+                        help="Localization required for a delta-based "
+                             "convergence verdict: every transition "
+                             "bracket must be at most this wide "
+                             "(default 1.0 — a stable midpoint of a "
+                             "multi-decade conflicted bracket is not "
+                             "convergence).")
+    parser.add_argument("--band-floor-nats", type=float, default=None,
+                        dest="band_floor_nats",
+                        help="Alternative convergence: ensemble error "
+                             "band below this floor (default 0 = off).")
+    parser.add_argument("--min-refine-rounds", type=int, default=None,
+                        dest="min_refine_rounds",
+                        help="Refinement rounds required before a "
+                             "delta-based convergence verdict "
+                             "(default 2 — one agreement is not "
+                             "evidence).")
+    parser.add_argument("--max-rounds", type=int, default=None,
+                        dest="max_rounds",
+                        help="Round budget (default 6).")
+    parser.add_argument("--max-units", type=int, default=None,
+                        dest="max_units",
+                        help="Total (β, seed) unit budget (default 64).")
+    parser.add_argument("--refine-num", type=int, default=None,
+                        dest="refine_num",
+                        help="Log-spaced points per refinement bracket "
+                             "(default 4).")
+    parser.add_argument("--retry-budget", type=int, default=None,
+                        dest="retry_budget",
+                        help="Per-round scheduler retry budget "
+                             "(default 3).")
+    parser.add_argument("--set", action="append", default=[],
+                        metavar="FIELD=VALUE",
+                        help="Unit training-spec override (repeatable), "
+                             "e.g. --set steps_per_epoch=16")
+    parser.add_argument("--watch", default=None,
+                        help="Seed round 0 from an existing run's event "
+                             "stream: refinement centers from its "
+                             "transition events + mi_bounds curvature "
+                             "(finished or live; see --watch-wait-s).")
+    parser.add_argument("--watch-wait-s", type=float, default=0.0,
+                        dest="watch_wait_s",
+                        help="Follow a LIVE --watch stream up to this "
+                             "long before falling back to what it "
+                             "yielded (default 0: one poll).")
+
+
+def build_study_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="dib_tpu study",
+        description="Closed-loop info-plane science engine "
+                    "(docs/study.md): dense-grid β studies with "
+                    "auto-refinement around detected transitions, "
+                    "multi-seed error bars, and budgeted convergence.",
+    )
+    sub = parser.add_subparsers(dest="action", required=True)
+
+    p_sub = sub.add_parser(
+        "submit", help="Journal the study's configuration (durable, "
+                       "before anything runs).")
+    _add_study_dir(p_sub)
+    _add_config_flags(p_sub)
+
+    p_run = sub.add_parser(
+        "run", help="Drive the study to its verdict (resumes a killed "
+                    "controller exactly-once).")
+    _add_study_dir(p_run)
+    _add_config_flags(p_run)
+    p_run.add_argument("--workers", type=int, default=2,
+                       help="Pool workers draining each round.")
+    p_run.add_argument("--telemetry-dir", "--telemetry_dir",
+                       dest="telemetry_dir", type=str, default=None,
+                       help="Events stream directory (default: the "
+                            "study dir; '' disables).")
+    p_run.add_argument("--runs-root", "--runs_root", dest="runs_root",
+                       type=str, default="",
+                       help="Register the study run in the fleet "
+                            "registry (default: DIB_RUNS_ROOT when "
+                            "set, else off).")
+
+    p_stat = sub.add_parser(
+        "status", help="Read-only replay of the study + scheduler "
+                       "journals.")
+    _add_study_dir(p_stat)
+    p_stat.add_argument("--json", action="store_true",
+                        help="Machine-readable snapshot.")
+
+    p_rep = sub.add_parser(
+        "report", help="Render the study's self-contained HTML report "
+                       "and machine-readable record.")
+    _add_study_dir(p_rep)
+    p_rep.add_argument("--out", default=None,
+                       help="HTML output path (default: "
+                            "<study-dir>/study_report.html).")
+    p_rep.add_argument("--json-out", default=None, dest="json_out",
+                       help="Also write the machine-readable study "
+                            "record here.")
+    return parser
+
+
+def _config_from_args(args) -> "StudyConfig | None":
+    """A StudyConfig from the CLI flags, or None when every science flag
+    was left at its default (an existing journal's config then wins)."""
+    from dib_tpu.cli import _parse_sets
+    from dib_tpu.study.controller import StudyConfig, watch_centers
+
+    kw: dict = {}
+    if args.grid is not None:
+        start, stop, num = args.grid
+        kw.update(grid_start=float(start), grid_stop=float(stop),
+                  grid_num=int(num))
+    if args.seeds is not None:
+        kw["seeds"] = tuple(args.seeds)
+    for name in ("beta_start", "threshold_nats", "tolerance_decades",
+                 "max_bracket_decades", "band_floor_nats",
+                 "min_refine_rounds", "max_rounds", "max_units",
+                 "refine_num", "retry_budget"):
+        value = getattr(args, name)
+        if value is not None:
+            kw[name] = value
+    train = _parse_sets(args.set)
+    if train:
+        kw["train"] = train
+    if args.watch:
+        centers = watch_centers(args.watch, wait_s=args.watch_wait_s)
+        if centers:
+            kw["centers"] = tuple(centers)
+        else:
+            print(f"study: --watch {args.watch} yielded no transition "
+                  "centers; round 0 falls back to the dense grid",
+                  file=sys.stderr)
+    if not kw:
+        return None
+    return StudyConfig(**kw)
+
+
+def _submit_main(args) -> int:
+    from dib_tpu.study.controller import StudyController
+
+    controller = StudyController(args.study_dir,
+                                 config=_config_from_args(args))
+    state = controller.ensure_config()
+    print(json.dumps({"study_dir": os.path.abspath(args.study_dir),
+                      "config": state["config"],
+                      "rounds": len(state["rounds"]),
+                      "verdict": state["verdict"]}))
+    return 0
+
+
+def _run_main(args) -> int:
+    from dib_tpu.study.controller import StudyController
+    from dib_tpu.telemetry import (
+        open_writer,
+        runtime_manifest,
+        shared_run_id,
+    )
+
+    os.makedirs(args.study_dir, exist_ok=True)
+    telemetry = open_writer(args.telemetry_dir, args.study_dir,
+                            run_id=shared_run_id(), process_index=0)
+    if telemetry is not None:
+        telemetry.run_start(runtime_manifest(device_info=False, extra={
+            "mode": "study",
+            "study_dir": os.path.abspath(args.study_dir),
+            "workers": args.workers,
+        }))
+    controller = StudyController(args.study_dir,
+                                 config=_config_from_args(args),
+                                 telemetry=telemetry)
+    try:
+        state = controller.run(workers=args.workers)
+    except BaseException:
+        if telemetry is not None:
+            telemetry.run_end(status="error")
+            telemetry.close()
+        raise
+    verdict = (state["verdict"] or {}).get("verdict")
+    if telemetry is not None:
+        telemetry.run_end(status="ok" if verdict else "incomplete")
+        telemetry.close()
+        root = args.runs_root or os.environ.get("DIB_RUNS_ROOT")
+        if root:
+            from dib_tpu.telemetry.registry import register_run
+
+            register_run(args.study_dir, root=root,
+                         extra={"study_verdict": verdict})
+    print(json.dumps(controller.status()))
+    return 0 if verdict in ("converged", "no_transitions") else 1
+
+
+def _status_main(args) -> int:
+    from dib_tpu.study.controller import StudyController
+
+    status = StudyController(args.study_dir).status()
+    if args.json:
+        print(json.dumps(status, indent=1))
+        return 0
+    verdict = status["verdict"] or {}
+    print(f"study {status['study_id']}: "
+          f"{verdict.get('verdict', 'in flight')}  "
+          f"rounds={len([r for r in status['rounds'] if r.get('done')])} "
+          f"budget={status['budget_spent']}"
+          + (f"/{status['config']['max_units']}"
+             if status.get("config") else ""))
+    for r in status["rounds"]:
+        est = r.get("estimates") or {}
+        print(f"  round {r['round']:2d}  "
+              f"{'done    ' if r.get('done') else 'pending '}"
+              f"betas={len(r.get('betas') or [])} "
+              f"units={r.get('units', '?')} "
+              f"job={r.get('job_id') or 'unsubmitted'}"
+              + (f"  estimates={ {c: round(float(v), 4) for c, v in est.items()} }"
+                 if est else ""))
+    if verdict.get("reason"):
+        print(f"  verdict: {verdict['reason']}")
+    sched = status["scheduler"]
+    print(f"  scheduler journal: {sched['jobs']} jobs, "
+          f"{sched['units_submitted']} units submitted, "
+          f"{sched['units_done']} done")
+    return 0
+
+
+def _report_main(args) -> int:
+    from dib_tpu.study.report import study_record, write_study_report
+
+    path = write_study_report(args.study_dir, out=args.out)
+    record = study_record(args.study_dir)
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            f.write(json.dumps(record, indent=1) + "\n")
+    print(json.dumps({"html": path, "verdict": record["verdict"],
+                      "rounds": record["value"],
+                      "estimates": record["estimates"]}))
+    return 0
+
+
+def study_main(argv: Sequence[str]) -> int:
+    args = build_study_parser().parse_args(list(argv))
+    if args.action == "submit":
+        return _submit_main(args)
+    if args.action == "run":
+        return _run_main(args)
+    if args.action == "status":
+        return _status_main(args)
+    return _report_main(args)

@@ -1,0 +1,816 @@
+"""Closed-loop study controller: transitions in, refinement rounds out.
+
+The decision core is pure and host-side (this module never imports jax;
+training happens in the scheduler's unit runners):
+
+  - every finished (β, seed) unit contributes its final per-channel KL
+    (``unit_points``, read from the unit histories the scheduler journal
+    names);
+  - per seed, per channel, the β axis is scanned for the LAST
+    down-crossing of the KL threshold — the bracket ``(lo, hi)`` of
+    adjacent grid points between which the channel's information was
+    compressed away (``channel_crossings``). The info-plane transition
+    lives inside that bracket;
+  - brackets are aggregated ACROSS seeds by union (``aggregate_brackets``):
+    seeds that disagree WIDEN the bracket — disagreement is evidence of
+    uncertainty, and a false-precision estimate would converge the study
+    on noise;
+  - the transition-β estimate is the bracket's log-midpoint, and the next
+    round is a log-spaced refinement grid INSIDE the brackets
+    (``plan_refinement``), so each round shrinks the brackets
+    geometrically and the estimates stabilize;
+  - convergence: the estimates moved less than ``tolerance_decades``
+    between rounds (after ``min_refine_rounds`` refinements — one
+    agreement is not evidence), or the ensemble error band shrank below
+    ``band_floor_nats``. Budget exhaustion (``max_rounds`` /
+    ``max_units``) stops the study with an explicit ``unconverged``
+    verdict instead of refining forever.
+
+The controller (:class:`StudyController`) wires the core to the durable
+plumbing: decisions land in the study journal BEFORE they execute
+(``study/journal.py``), jobs go through the PR 8 scheduler under
+deterministic per-round names (``study:<id>:r<n>``) so a SIGKILLed
+controller resumes with exactly-once submission (adopt the named job if
+the scheduler journal has it, submit it otherwise), rounds drain through
+a ``WorkerPool`` while a follower thread tails the run's own event
+stream for live progress, and every round/submission/verdict is a typed
+``study`` event on the stream (docs/observability.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+import signal
+import threading
+
+import numpy as np
+
+__all__ = ["StudyConfig", "StudyController", "aggregate_brackets",
+           "channel_crossings", "curvature_centers", "ensemble_band_nats",
+           "estimate_from_bracket", "plan_refinement", "unit_points",
+           "watch_centers"]
+
+_LN2 = math.log(2.0)
+
+#: ``DIB_STUDY_FAULT=kill@<stage>:<round>`` — the chaos suite's injector
+#: for the exactly-once windows: stage ``intent`` kills between the
+#: round's journal append and the scheduler submit, stage ``submit``
+#: between the scheduler submit and the journal ack.
+FAULT_ENV = "DIB_STUDY_FAULT"
+
+
+# ------------------------------------------------------------------ config
+@dataclasses.dataclass(frozen=True)
+class StudyConfig:
+    """One study's science parameters — journaled once, replayed on every
+    restart so a resumed controller cannot drift from its own decisions."""
+
+    beta_start: float = 1e-4
+    grid_start: float = 0.03
+    grid_stop: float = 30.0
+    grid_num: int = 6
+    seeds: tuple[int, ...] = (0, 1)
+    threshold_nats: float = 0.1
+    tolerance_decades: float = 0.15
+    max_bracket_decades: float = 1.0
+    band_floor_nats: float = 0.0      # 0 disables the band criterion
+    min_refine_rounds: int = 2
+    max_rounds: int = 6
+    max_units: int = 64
+    refine_num: int = 4
+    retry_budget: int = 3
+    train: dict = dataclasses.field(default_factory=dict)
+    centers: tuple[float, ...] = ()   # watch-seeded round-0 centers
+
+    def __post_init__(self):
+        if not (0 < self.grid_start <= self.grid_stop):
+            raise ValueError("need 0 < grid_start <= grid_stop")
+        if self.grid_num < 2 and not self.centers:
+            raise ValueError("grid_num must be >= 2 (a single β point "
+                             "has no crossing bracket)")
+        if not self.seeds:
+            raise ValueError("a study needs at least one seed")
+        if self.threshold_nats <= 0 or self.tolerance_decades <= 0:
+            raise ValueError("threshold_nats and tolerance_decades must "
+                             "be positive")
+        if self.max_bracket_decades <= 0:
+            raise ValueError("max_bracket_decades must be positive")
+        if self.max_rounds < 1 or self.max_units < 1:
+            raise ValueError("max_rounds and max_units must be >= 1")
+        if self.refine_num < 3:
+            raise ValueError("refine_num must be >= 3 (fewer adds no "
+                             "interior point to a bracket)")
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["seeds"] = [int(s) for s in self.seeds]
+        d["centers"] = [float(c) for c in self.centers]
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "StudyConfig":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        kw = {k: v for k, v in d.items() if k in fields}
+        if "seeds" in kw:
+            kw["seeds"] = tuple(int(s) for s in kw["seeds"])
+        if "centers" in kw:
+            kw["centers"] = tuple(float(c) for c in kw["centers"])
+        if "train" in kw:
+            kw["train"] = dict(kw["train"] or {})
+        return cls(**kw)
+
+    def initial_betas(self) -> list[float]:
+        from dib_tpu.sched.scheduler import dense_beta_grid, refine_beta_grid
+
+        if self.centers:
+            return refine_beta_grid(self.centers, num=self.refine_num)
+        return dense_beta_grid(self.grid_start, self.grid_stop,
+                               self.grid_num)
+
+
+# ------------------------------------------------------------ decision core
+def channel_crossings(curve, threshold_nats: float) -> dict[int, tuple[float, float]]:
+    """Per-channel transition brackets for ONE seed's β curve.
+
+    ``curve`` is ``[(beta, kl_vector_nats), ...]`` (any order; sorted by
+    β here). A channel's bracket is the LAST adjacent pair ``(lo, hi)``
+    where its KL falls from ≥ threshold to < threshold as β rises — the
+    annealing β compressed the channel away somewhere inside it. The
+    last crossing (not the first) is the transition that SURVIVES: a
+    noisy curve can wiggle through the threshold early, but above the
+    final crossing the channel stays compressed. Channels that never
+    cross have no bracket.
+    """
+    pts = sorted(((float(b), np.asarray(kl, dtype=np.float64))
+                  for b, kl in curve), key=lambda p: p[0])
+    out: dict[int, tuple[float, float]] = {}
+    if len(pts) < 2:
+        return out
+    channels = min(len(kl) for _, kl in pts)
+    for c in range(channels):
+        for (b_lo, kl_lo), (b_hi, kl_hi) in zip(pts, pts[1:]):
+            if (np.isfinite(kl_lo[c]) and np.isfinite(kl_hi[c])
+                    and kl_lo[c] >= threshold_nats
+                    and kl_hi[c] < threshold_nats):
+                out[c] = (b_lo, b_hi)
+    return out
+
+
+def aggregate_brackets(per_seed: list[dict]) -> dict[int, tuple[float, float]]:
+    """Union per-channel brackets across seeds: conflicting seeds WIDEN
+    the bracket (min lo, max hi) instead of averaging it away — a study
+    must not converge on an estimate its own ensemble disagrees about."""
+    out: dict[int, tuple[float, float]] = {}
+    for crossings in per_seed:
+        for c, (lo, hi) in crossings.items():
+            if c in out:
+                out[c] = (min(out[c][0], lo), max(out[c][1], hi))
+            else:
+                out[c] = (float(lo), float(hi))
+    return out
+
+
+def estimate_from_bracket(lo: float, hi: float) -> float:
+    """The bracket's log-midpoint — the transition-β estimate."""
+    return float(10 ** ((math.log10(lo) + math.log10(hi)) / 2.0))
+
+
+def plan_refinement(brackets: dict[int, tuple[float, float]], num: int,
+                    already: list[float]) -> list[float]:
+    """New β points refining the brackets: EACH channel bracket gets its
+    own ``num``-point log-spaced grid (overlapping brackets naturally
+    share points through the union), and points already trained (within
+    float tolerance) are dropped — refinement only ever pays for NEW
+    information. Per-bracket grids are load-bearing: collapsing
+    overlapping brackets into one merged span re-grids the union
+    coarsely, adds nothing inside the individual brackets, and the
+    refinement saturates after one round instead of shrinking every
+    bracket geometrically."""
+    from dib_tpu.sched.scheduler import dense_beta_grid
+
+    have = sorted(set(float(b) for b in already))
+
+    def is_new(beta: float) -> bool:
+        return all(abs(beta - b) > 1e-6 * max(beta, b) for b in have)
+
+    out: list[float] = []
+    for lo, hi in sorted(set(brackets.values())):
+        for b in dense_beta_grid(lo, hi, num):
+            if is_new(b) and all(abs(b - o) > 1e-6 * max(b, o)
+                                 for o in out):
+                out.append(b)
+    return sorted(out)
+
+
+def ensemble_band_nats(points_by_seed: dict[int, dict[float, np.ndarray]],
+                       brackets: dict[int, tuple[float, float]]) -> float | None:
+    """The ensemble error band: over β points every seed trained that lie
+    inside (or on) a bracket, the worst across-seed spread (max − min) of
+    any bracket channel's KL. None with fewer than two seeds or no shared
+    in-bracket points — an absent band never fakes convergence."""
+    if len(points_by_seed) < 2 or not brackets:
+        return None
+    shared = set.intersection(*(set(pts) for pts in points_by_seed.values()))
+    band = None
+    for beta in shared:
+        if not any(lo <= beta <= hi for lo, hi in brackets.values()):
+            continue
+        for c in brackets:
+            vals = [float(np.asarray(pts[beta], dtype=np.float64)[c])
+                    for pts in points_by_seed.values()
+                    if c < len(np.asarray(pts[beta]))]
+            finite = [v for v in vals if math.isfinite(v)]
+            if len(finite) >= 2:
+                spread = max(finite) - min(finite)
+                band = spread if band is None else max(band, spread)
+    return band
+
+
+def unit_points(directory: str) -> tuple[dict, dict]:
+    """Fold the SCHEDULER journal into the study's data view.
+
+    Returns ``(points_by_seed, counts)``: per seed, a ``{beta_end:
+    final_kl_vector_nats}`` map from every done unit's saved history
+    (the unit runner writes KL in bits; converted here), plus unit
+    outcome counts — cumulative across every round the directory ran.
+    Reading the scheduler's own journal — not controller memory — is
+    what makes a resumed study see exactly what actually ran, and what
+    makes the budget accounting cross-checkable.
+    """
+    from dib_tpu.sched.journal import read_journal
+
+    records, _ = read_journal(directory)
+    units: dict[str, dict] = {}
+    for r in records:
+        if r.get("kind") == "unit":
+            units[r["unit_id"]] = {"beta": float(r["beta"]),
+                                   "seed": int(r["seed"]),
+                                   "job_id": r.get("job_id")}
+    counts = {"submitted": len(units), "done": 0, "failed": 0}
+    points: dict[int, dict[float, np.ndarray]] = {}
+    failed_terminal: set[str] = set()
+    for r in records:
+        unit = units.get(r.get("unit_id") or "")
+        if unit is None:
+            continue
+        if r.get("kind") == "fail" and not r.get("requeued"):
+            failed_terminal.add(r["unit_id"])
+        if r.get("kind") != "done":
+            continue
+        counts["done"] += 1
+        result = r.get("result") or {}
+        path = result.get("history_path")
+        if not path or not os.path.exists(path):
+            continue
+        with np.load(path) as npz:
+            kl_bits = np.asarray(npz["kl_per_feature"], dtype=np.float64)
+        if kl_bits.ndim != 2 or not kl_bits.size:
+            continue
+        points.setdefault(unit["seed"], {})[unit["beta"]] = (
+            kl_bits[-1] * _LN2)
+    counts["failed"] = len(failed_terminal)
+    return points, counts
+
+
+# ---------------------------------------------------------- watch seeding
+def curvature_centers(points, max_centers: int = 4) -> list[float]:
+    """β values where an MI-bound series bends hardest — the info-plane
+    curvature signal. ``points`` is ``[(beta, mi_value), ...]``; the
+    discrete second difference of MI against log10 β is computed and the
+    local maxima of its magnitude above the series mean are returned
+    (strongest first, capped). Fewer than three finite points carry no
+    curvature."""
+    pts = sorted({(float(b), float(v)) for b, v in points
+                  if b and b > 0 and v is not None
+                  and math.isfinite(float(v))})
+    if len(pts) < 3:
+        return []
+    xs = [math.log10(b) for b, _ in pts]
+    ys = [v for _, v in pts]
+    curvature = []
+    for i in range(1, len(pts) - 1):
+        h1, h2 = xs[i] - xs[i - 1], xs[i + 1] - xs[i]
+        if h1 <= 0 or h2 <= 0:
+            continue
+        d2 = ((ys[i + 1] - ys[i]) / h2 - (ys[i] - ys[i - 1]) / h1) \
+            / ((h1 + h2) / 2.0)
+        curvature.append((abs(d2), pts[i][0]))
+    if not curvature:
+        return []
+    mean = sum(c for c, _ in curvature) / len(curvature)
+    peaks = sorted((c, b) for c, b in curvature if c > mean)[::-1]
+    return [b for _, b in peaks[:max_centers]]
+
+
+def watch_centers(run_dir: str, wait_s: float = 0.0,
+                  poll_s: float = 0.5) -> list[float]:
+    """Round-0 refinement centers from an existing run's event stream.
+
+    Tails the stream with :class:`StreamFollower` (finished streams read
+    in one poll; live ones are followed until ``run_end`` or the
+    ``wait_s`` budget): the β of every ``transition`` event plus the
+    curvature peaks of the ``mi_bounds`` series. An empty result means
+    the study falls back to its dense grid — a watched stream can only
+    FOCUS the budget, never silently shrink the science.
+    """
+    import time
+
+    from dib_tpu.telemetry.live import StreamFollower
+
+    follower = StreamFollower(run_dir)
+    centers: set[float] = set()
+    mi_points: list[tuple[float, float]] = []
+    deadline = time.monotonic() + max(wait_s, 0.0)
+    while True:
+        ended = False
+        for event in follower.poll():
+            etype = event.get("type")
+            if etype == "transition" and event.get("beta"):
+                beta = float(event["beta"])
+                if beta > 0 and math.isfinite(beta):
+                    centers.add(beta)
+            elif etype == "mi_bounds" and event.get("beta"):
+                lower = event.get("lower_bits")
+                if isinstance(lower, (list, tuple)) and lower:
+                    vals = [float(v) for v in lower
+                            if isinstance(v, (int, float))]
+                    if vals:
+                        mi_points.append((float(event["beta"]),
+                                          sum(vals) / len(vals)))
+                elif isinstance(lower, (int, float)):
+                    mi_points.append((float(event["beta"]), float(lower)))
+            elif etype == "run_end":
+                ended = True
+        if ended or time.monotonic() >= deadline:
+            break
+        time.sleep(poll_s)
+    return sorted(centers | set(curvature_centers(mi_points)))
+
+
+# -------------------------------------------------------------- controller
+class StudyController:
+    """Drives one study directory to a verdict.
+
+    The directory holds everything: ``study.jsonl`` (decisions),
+    ``journal.jsonl`` (the scheduler's state), ``events.jsonl`` (the
+    telemetry stream both layers share), and ``units/`` (per-unit
+    checkpoints + histories). ``telemetry`` is an ``EventWriter`` or
+    None. All mutable progress state shared with the follower thread is
+    guarded by ``_lock``.
+    """
+
+    def __init__(self, directory: str, config: StudyConfig | None = None,
+                 telemetry=None, lease_s: float = 120.0,
+                 study_id: str | None = None):
+        self.directory = directory
+        self.config = config
+        self.lease_s = float(lease_s)
+        self._telemetry = telemetry
+        self._lock = threading.Lock()
+        self._progress = {"units_done": 0, "units_failed": 0}
+        self._follower = None   # one per controller: offset persists
+        # across rounds so outcomes are never re-counted
+        self.study_id = study_id or os.path.basename(
+            os.path.normpath(directory)) or "study"
+        os.makedirs(directory, exist_ok=True)
+
+    # ----------------------------------------------------------- replay
+    def replay(self) -> dict:
+        """The journal's resume state (``journal.fold_study``) plus the
+        effective config: the journaled spec wins over the constructor's
+        — a restarted controller must re-decide with the parameters the
+        original decisions were made under."""
+        from dib_tpu.study.journal import fold_study, read_study_journal
+
+        records, torn = read_study_journal(self.directory)
+        state = fold_study(records)
+        state["torn"] = torn
+        if state["config"] is not None:
+            self.config = StudyConfig.from_dict(state["config"])
+        return state
+
+    def ensure_config(self) -> dict:
+        """Journal the config on first contact; replay it afterwards."""
+        from dib_tpu.study.journal import StudyJournal
+
+        state = self.replay()
+        if state["config"] is None:
+            if self.config is None:
+                self.config = StudyConfig()
+            with StudyJournal(self.directory) as journal:
+                journal.append("config", spec=self.config.to_dict())
+            state = self.replay()
+        return state
+
+    # ------------------------------------------------------------- fault
+    def _maybe_fault(self, stage: str, round_idx: int) -> None:
+        """The chaos suite's SIGKILL injector (``DIB_STUDY_FAULT``): a
+        durable ``fault`` event lands BEFORE the kill (the faults
+        contract), so the drill's stream carries the injection next to
+        the resumed controller's ``study_resumed`` mitigation."""
+        spec = os.environ.get(FAULT_ENV, "")
+        if spec != f"kill@{stage}:{round_idx}":
+            return
+        if self._telemetry is not None:
+            self._telemetry.fault(kind="study_kill", spec=spec,
+                                  step=round_idx, detail=stage)
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    # ------------------------------------------------------------ events
+    def _emit_study(self, action: str, **fields) -> None:
+        if self._telemetry is not None:
+            self._telemetry.study(study_id=self.study_id, action=action,
+                                  **fields)
+
+    # -------------------------------------------------------------- run
+    def run(self, workers: int = 2, max_rounds_this_run: int | None = None,
+            drain=None) -> dict:
+        """Drive the study to its verdict (or resume one mid-flight).
+
+        ``drain`` is injectable for tests (called with the live
+        ``Scheduler`` once per round; the default drains with a
+        ``WorkerPool`` of ``TrainingUnitRunner`` workers while the
+        follower thread tails the stream). Returns the final state.
+        """
+        from dib_tpu.sched.scheduler import Scheduler
+        from dib_tpu.study.journal import StudyJournal
+
+        state = self.ensure_config()
+        config = self.config
+        if state["torn"] and self._telemetry is not None:
+            self._telemetry.mitigation(
+                mtype="journal_recovered",
+                detail=(f"study journal replayed with {state['torn']} "
+                        "torn line(s) skipped"))
+        pending = [r for r in state["rounds"] if not r.get("done")]
+        if pending and self._telemetry is not None:
+            self._telemetry.mitigation(
+                mtype="study_resumed",
+                reason=(f"study {self.study_id} resumed into round "
+                        f"{pending[0]['round']} "
+                        + ("before its job was acknowledged — resolving "
+                           "submission exactly-once against the "
+                           "scheduler journal"
+                           if "job_id" not in pending[0]
+                           else "mid-drain")))
+        scheduler = Scheduler(self.directory, telemetry=self._telemetry,
+                              lease_s=self.lease_s)
+        journal = StudyJournal(self.directory)
+        rounds_run = 0
+        try:
+            while state["verdict"] is None:
+                open_rounds = [r for r in state["rounds"]
+                               if not r.get("done")]
+                if open_rounds:
+                    current = open_rounds[0]
+                else:
+                    decision = self._decide(state)
+                    if "verdict" in decision:
+                        journal.append("verdict", **decision)
+                        # the terminal action IS the verdict string:
+                        # converged / unconverged / no_transitions
+                        self._emit_study(
+                            decision["verdict"],
+                            verdict=decision["verdict"],
+                            reason=decision.get("reason"),
+                            estimates=decision.get("estimates"),
+                            budget_spent=state["budget_spent"],
+                            budget_max=config.max_units,
+                            max_rounds=config.max_rounds)
+                        break
+                    journal.append("round", **decision)
+                    self._maybe_fault("intent", decision["round"])
+                    state = self.replay()
+                    current = [r for r in state["rounds"]
+                               if not r.get("done")][0]
+                if "job_id" not in current:
+                    self._submit_round(scheduler, journal, current)
+                    state = self.replay()
+                    current = [r for r in state["rounds"]
+                               if not r.get("done")][0]
+                if drain is not None:
+                    drain(scheduler)
+                else:
+                    self._drain(scheduler, workers)
+                self._collect(journal, state, current)
+                state = self.replay()
+                rounds_run += 1
+                if (max_rounds_this_run is not None
+                        and rounds_run >= max_rounds_this_run
+                        and state["verdict"] is None):
+                    break
+        finally:
+            journal.close()
+            scheduler.close()
+        # the loop breaks right after appending the verdict — replay so
+        # the caller sees the terminal state, not the pre-verdict fold
+        return self.replay()
+
+    # ------------------------------------------------------------ decide
+    def _decide(self, state: dict) -> dict:
+        """The next move: a round plan (``round``/``betas``/...) or a
+        terminal verdict (``verdict``/``reason``). Pure function of the
+        replayed state — a restarted controller re-decides identically."""
+        config = self.config
+        done_rounds = [r for r in state["rounds"] if r.get("done")]
+        spent = state["budget_spent"]
+        seeds = [int(s) for s in config.seeds]
+
+        def plan(idx: int, betas: list[float]) -> dict:
+            return {
+                "round": idx,
+                "betas": [float(b) for b in betas],
+                "seeds": seeds,
+                "units": len(betas) * len(seeds),
+                "job_name": f"study:{self.study_id}:r{idx}",
+                "budget_spent_after": spent + len(betas) * len(seeds),
+            }
+
+        if not done_rounds:
+            betas = config.initial_betas()
+            cost = len(betas) * len(seeds)
+            if cost > config.max_units:
+                raise ValueError(
+                    f"round 0 needs {cost} units but max_units is "
+                    f"{config.max_units} — shrink the grid or raise the "
+                    "budget")
+            return plan(0, betas)
+
+        last = done_rounds[-1]
+        brackets = {int(c): tuple(b)
+                    for c, b in (last.get("brackets") or {}).items()}
+        estimates = {int(c): float(v)
+                     for c, v in (last.get("estimates") or {}).items()}
+        if not brackets:
+            # distinguish "measured, flat" from "measured NOTHING": a
+            # study whose units all failed terminally has no data, and
+            # reporting that as a clean scientific null result would
+            # hide a broken train spec behind exit code 0
+            if not last.get("units_done"):
+                return {"verdict": "unconverged",
+                        "reason": ("no unit produced results "
+                                   f"({last.get('units_failed', 0)} "
+                                   "failed terminally) — this is a "
+                                   "training failure, not a flat "
+                                   "information plane"),
+                        "rounds": len(done_rounds),
+                        "budget_spent": spent, "estimates": {}}
+            return {"verdict": "no_transitions",
+                    "reason": ("no channel crossed "
+                               f"{config.threshold_nats} nats anywhere "
+                               "on the grid — nothing to refine"),
+                    "rounds": len(done_rounds), "budget_spent": spent,
+                    "estimates": {}}
+
+        deltas = last.get("deltas_decades") or {}
+        delta_vals = [v for v in deltas.values() if v is not None]
+        refinements = last["round"]   # rounds beyond the initial grid
+        all_measured = (len(delta_vals) == len(brackets)
+                        and bool(delta_vals))
+        # localization: a stable estimate is only evidence when its
+        # bracket is NARROW — a conflicted multi-seed bracket spanning
+        # decades has a perfectly stable midpoint (the widened union
+        # never moves), and converging on it would report false
+        # precision the ensemble itself contradicts
+        widths = {c: math.log10(hi) - math.log10(lo)
+                  for c, (lo, hi) in brackets.items()}
+        widest = max(widths.values())
+        localized = widest <= config.max_bracket_decades
+        if (refinements >= config.min_refine_rounds and all_measured
+                and localized
+                and max(delta_vals) <= config.tolerance_decades):
+            return {"verdict": "converged",
+                    "reason": (f"max transition-β delta "
+                               f"{max(delta_vals):.4f} decades ≤ "
+                               f"tolerance {config.tolerance_decades} "
+                               f"after {refinements} refinement rounds "
+                               f"(all brackets ≤ "
+                               f"{config.max_bracket_decades} decades; "
+                               f"widest {widest:.2f})"),
+                    "rounds": len(done_rounds), "budget_spent": spent,
+                    "estimates": estimates}
+        band = last.get("band_nats")
+        if (config.band_floor_nats > 0 and refinements >= 1
+                and band is not None
+                and band <= config.band_floor_nats):
+            return {"verdict": "converged",
+                    "reason": (f"ensemble band {band:.4f} nats ≤ floor "
+                               f"{config.band_floor_nats}"),
+                    "rounds": len(done_rounds), "budget_spent": spent,
+                    "estimates": estimates}
+        disagreement = ("" if localized else
+                        f"; widest bracket {widest:.2f} decades exceeds "
+                        f"max_bracket_decades "
+                        f"{config.max_bracket_decades} — the ensemble "
+                        "disagrees about where the transition lives")
+        if len(done_rounds) >= config.max_rounds:
+            return {"verdict": "unconverged",
+                    "reason": (f"round budget ({config.max_rounds}) "
+                               "exhausted before the estimates "
+                               "stabilized" + disagreement),
+                    "rounds": len(done_rounds), "budget_spent": spent,
+                    "estimates": estimates}
+
+        already = [b for r in state["rounds"] for b in r.get("betas", [])]
+        betas = plan_refinement(brackets, config.refine_num, already)
+        if not betas:
+            if localized:
+                return {"verdict": "converged",
+                        "reason": ("refinement grid saturated — no new "
+                                   "β point distinguishes the brackets "
+                                   "at float resolution"),
+                        "rounds": len(done_rounds),
+                        "budget_spent": spent, "estimates": estimates}
+            return {"verdict": "unconverged",
+                    "reason": ("refinement grid saturated with "
+                               "unresolved ensemble disagreement"
+                               + disagreement),
+                    "rounds": len(done_rounds), "budget_spent": spent,
+                    "estimates": estimates}
+        affordable = (config.max_units - spent) // len(seeds)
+        if affordable < 1:
+            return {"verdict": "unconverged",
+                    "reason": (f"unit budget ({config.max_units}) "
+                               f"exhausted ({spent} spent) before the "
+                               "estimates stabilized" + disagreement),
+                    "rounds": len(done_rounds), "budget_spent": spent,
+                    "estimates": estimates}
+        if len(betas) > affordable:
+            # trim to the points nearest the current estimates — the
+            # remaining budget goes where the physics is
+            centers = [math.log10(v) for v in estimates.values()]
+            betas = sorted(sorted(
+                betas,
+                key=lambda b: min(abs(math.log10(b) - c)
+                                  for c in centers))[:affordable])
+        return plan(len(done_rounds), betas)
+
+    # ------------------------------------------------------------ submit
+    def _submit_round(self, scheduler, journal, current: dict) -> None:
+        """Exactly-once submission: the scheduler journal is consulted
+        for a job under this round's deterministic name — present means
+        a previous controller died between submit and ack (ADOPT it);
+        absent means the decision never executed (submit it now)."""
+        from dib_tpu.sched.scheduler import JobSpec
+
+        existing = {
+            job.get("name"): job_id
+            for job_id, job in scheduler.status()["jobs"].items()
+        }
+        job_name = current["job_name"]
+        if job_name in existing:
+            job_id = existing[job_name]
+            if self._telemetry is not None:
+                self._telemetry.mitigation(
+                    mtype="study_resumed",
+                    reason=(f"round {current['round']} job {job_id} "
+                            "adopted from the scheduler journal — the "
+                            "previous controller died between submit "
+                            "and ack; not resubmitting"))
+        else:
+            spec = JobSpec(
+                betas=tuple(current["betas"]),
+                seeds=tuple(current["seeds"]),
+                train=self._unit_train_spec(),
+                retry_budget=self.config.retry_budget,
+                name=job_name,
+            )
+            job_id = scheduler.submit(spec)
+            self._maybe_fault("submit", current["round"])
+        journal.append("submitted", round=current["round"], job_id=job_id)
+        self._emit_study("submit", round=current["round"], job_id=job_id,
+                         betas=current["betas"], seeds=current["seeds"],
+                         units=current["units"],
+                         budget_spent=current["budget_spent_after"],
+                         budget_max=self.config.max_units)
+
+    def _unit_train_spec(self) -> dict:
+        spec = dict(self.config.train)
+        spec.setdefault("beta_start", self.config.beta_start)
+        return spec
+
+    # ------------------------------------------------------------- drain
+    def _progress_follower(self, stop: threading.Event) -> None:
+        """Tail the study's OWN stream for unit outcomes while the pool
+        drains — the live progress view ``status`` reads. Runs on a
+        follower thread; shared counters update under the lock. ONE
+        follower per controller (``_follower``), so its byte offset
+        persists across rounds — a fresh follower per drain would
+        re-read the whole stream and double-count every earlier round's
+        outcomes. The final poll after ``stop`` catches the tail events
+        the last pool write raced."""
+        from dib_tpu.telemetry.live import StreamFollower
+
+        with self._lock:
+            if self._follower is None:
+                self._follower = StreamFollower(self.directory)
+            follower = self._follower
+        stopped = False
+        while True:
+            done = failed = 0
+            for event in follower.poll():
+                if event.get("type") != "job":
+                    continue
+                if event.get("action") == "unit_done":
+                    done += 1
+                elif event.get("action") == "unit_failed":
+                    failed += 1
+            if done or failed:
+                with self._lock:
+                    self._progress["units_done"] += done
+                    self._progress["units_failed"] += failed
+            if stopped:
+                return
+            stopped = stop.wait(0.25)
+
+    def progress(self) -> dict:
+        with self._lock:
+            return dict(self._progress)
+
+    def _drain(self, scheduler, workers: int) -> None:
+        from dib_tpu.sched.pool import WorkerPool
+        from dib_tpu.sched.runner import TrainingUnitRunner
+
+        runner = TrainingUnitRunner(self.directory,
+                                    telemetry=self._telemetry)
+        pool = WorkerPool(scheduler, runner, num_workers=workers,
+                          telemetry=self._telemetry, name="study")
+        stop = threading.Event()
+        follower = threading.Thread(target=self._progress_follower,
+                                    args=(stop,), name="study-follower")
+        follower.start()
+        try:
+            pool.run()
+        finally:
+            stop.set()
+            follower.join(timeout=10.0)
+
+    # ----------------------------------------------------------- collect
+    def _collect(self, journal, state: dict, current: dict) -> None:
+        """Fold the scheduler journal's results into this round's
+        estimates and journal them durably (+ the ``round`` event)."""
+        config = self.config
+        points, counts = unit_points(self.directory)
+        per_seed = [channel_crossings(pts.items(), config.threshold_nats)
+                    for pts in points.values()]
+        brackets = aggregate_brackets(per_seed)
+        estimates = {c: estimate_from_bracket(lo, hi)
+                     for c, (lo, hi) in brackets.items()}
+        done_rounds = [r for r in state["rounds"] if r.get("done")]
+        prev = {int(c): float(v) for c, v in
+                ((done_rounds[-1].get("estimates") or {}).items()
+                 if done_rounds else ())}
+        deltas = {
+            c: (round(abs(math.log10(estimates[c]) - math.log10(prev[c])),
+                      6) if c in prev else None)
+            for c in estimates
+        }
+        band = ensemble_band_nats(points, brackets)
+        journal.append(
+            "round_done", round=current["round"],
+            estimates={str(c): round(v, 8) for c, v in estimates.items()},
+            brackets={str(c): [round(lo, 8), round(hi, 8)]
+                      for c, (lo, hi) in brackets.items()},
+            deltas_decades={str(c): v for c, v in deltas.items()},
+            band_nats=None if band is None else round(band, 6),
+            units_done=counts["done"], units_failed=counts["failed"])
+        self._emit_study(
+            "round", round=current["round"],
+            estimates={str(c): round(v, 8) for c, v in estimates.items()},
+            deltas_decades={str(c): v for c, v in deltas.items()},
+            band_nats=None if band is None else round(band, 6),
+            units=counts["done"],
+            budget_spent=current["budget_spent_after"],
+            budget_max=config.max_units,
+            max_rounds=config.max_rounds)
+
+    # ------------------------------------------------------------ status
+    def status(self) -> dict:
+        """Read-only snapshot: journal state + scheduler queue counts.
+        Never opens a writer (a pure ``status`` must not seal journals
+        or take the one-controller-per-directory slot)."""
+        from dib_tpu.sched.journal import read_journal
+
+        state = self.replay()
+        sched_records, sched_torn = read_journal(self.directory)
+        jobs = sum(1 for r in sched_records if r.get("kind") == "job")
+        units = sum(1 for r in sched_records if r.get("kind") == "unit")
+        done = {r["unit_id"] for r in sched_records
+                if r.get("kind") == "done"}
+        out = {
+            "study_id": self.study_id,
+            "config": (self.config.to_dict()
+                       if self.config is not None else None),
+            "rounds": state["rounds"],
+            "budget_spent": state["budget_spent"],
+            "verdict": state["verdict"],
+            "journal_torn": state["torn"],
+            "scheduler": {"jobs": jobs, "units_submitted": units,
+                          "units_done": len(done),
+                          "journal_torn": sched_torn},
+        }
+        with self._lock:
+            out["progress"] = dict(self._progress)
+        return out

@@ -1,0 +1,111 @@
+"""Durable study journal: the controller's only persistent state.
+
+The study controller is a fold over ``<study_dir>/study.jsonl`` exactly
+the way the scheduler is a fold over its ``journal.jsonl`` — same
+append-only durability contract (one ``os.write`` of one newline-
+terminated line on an ``O_APPEND`` fd, torn-final-line tolerated and
+sealed), same class, different filename. The two journals live side by
+side in one study directory, which is what makes the exactly-once
+resubmission contract CHECKABLE: every round the study journal decides
+is visible in the scheduler journal as exactly one job.
+
+Record kinds (after the envelope ``v``/``seq``/``t``/``kind``):
+
+  - ``config``     the study spec, written once — a restarted controller
+                   re-reads its own configuration instead of trusting
+                   flags to be re-passed identically
+  - ``round``      one round DECIDED: the β grid, the seeds, the unit
+                   count, the deterministic scheduler job name, and the
+                   budget total after this round. Appended BEFORE the
+                   scheduler submit — the decision is durable even when
+                   the controller dies before acting on it.
+  - ``submitted``  the scheduler accepted the round's job (its job_id).
+                   A ``round`` with no ``submitted`` is the crash window
+                   the resolver replays exactly-once: the scheduler
+                   journal either has a job under the round's name
+                   (adopt it) or it does not (submit it now).
+  - ``round_done`` the round's results collected: per-channel transition
+                   estimates, brackets, round-over-round deltas, the
+                   ensemble band, and unit outcome counts.
+  - ``verdict``    terminal: ``converged`` / ``unconverged`` /
+                   ``no_transitions``, with the evidence.
+"""
+
+from __future__ import annotations
+
+import os
+
+from dib_tpu.sched.journal import JobJournal, read_journal
+
+__all__ = ["STUDY_JOURNAL_FILENAME", "StudyJournal", "fold_study",
+           "read_study_journal"]
+
+STUDY_JOURNAL_FILENAME = "study.jsonl"
+
+
+class StudyJournal(JobJournal):
+    """The scheduler journal's durability contract under the study's own
+    filename — ``study.jsonl`` next to the scheduler's ``journal.jsonl``
+    in one study directory. One controller per directory is the
+    deployment contract (the seal-on-open inherits it)."""
+
+    def __init__(self, directory: str):
+        super().__init__(directory, filename=STUDY_JOURNAL_FILENAME)
+
+
+def read_study_journal(directory: str) -> tuple[list[dict], int]:
+    """All parseable study records (oldest first) + torn-line count."""
+    return read_journal(os.path.join(directory, STUDY_JOURNAL_FILENAME))
+
+
+def fold_study(records: list[dict]) -> dict:
+    """Replay study records into the controller's resume state.
+
+    Returns ``{"config", "rounds", "verdict", "budget_spent"}`` where
+    ``rounds`` is a list of per-round dicts carrying whatever landed:
+    the decision (``betas``/``seeds``/``units``/``job_name``/
+    ``budget_spent_after``), the submission ack (``job_id``), and the
+    collection (``estimates``/``brackets``/``deltas_decades``/
+    ``band_nats``/``units_done``/``units_failed``, under ``done=True``).
+    The last round with no ``done`` is the round a restarted controller
+    resumes INTO — and if it also has no ``job_id``, submission itself
+    is unresolved (the exactly-once window).
+    """
+    state: dict = {"config": None, "rounds": [], "verdict": None,
+                   "budget_spent": 0}
+    by_round: dict[int, dict] = {}
+
+    def entry(r: dict) -> dict:
+        idx = int(r.get("round", len(by_round)))
+        if idx not in by_round:
+            by_round[idx] = {"round": idx, "done": False}
+            state["rounds"].append(by_round[idx])
+        return by_round[idx]
+
+    for r in records:
+        kind = r.get("kind")
+        if kind == "config":
+            state["config"] = dict(r.get("spec") or {})
+        elif kind == "round":
+            e = entry(r)
+            for key in ("betas", "seeds", "units", "job_name",
+                        "budget_spent_after"):
+                if key in r:
+                    e[key] = r[key]
+            state["budget_spent"] = int(r.get("budget_spent_after", 0))
+        elif kind == "submitted":
+            entry(r)["job_id"] = r.get("job_id")
+        elif kind == "round_done":
+            e = entry(r)
+            e["done"] = True
+            for key in ("estimates", "brackets", "deltas_decades",
+                        "band_nats", "units_done", "units_failed"):
+                if key in r:
+                    e[key] = r[key]
+        elif kind == "verdict":
+            state["verdict"] = {
+                k: r[k] for k in ("verdict", "reason", "rounds",
+                                  "budget_spent", "estimates")
+                if k in r
+            }
+    return state

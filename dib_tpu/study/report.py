@@ -1,0 +1,346 @@
+"""Study product: one self-contained HTML artifact + a machine record.
+
+``render_study_report`` turns a finished (or mid-flight) study directory
+into the ``telemetry report``-style static page: provenance tiles,
+ensemble-banded distributed-information-plane figures — per-channel
+final KL across the refined β grid, the across-seed min/max band shaded,
+the transition-β estimate annotated with its round-over-round history —
+plus the round/budget tables. Zero external resources, strict tag
+balance, light/dark via the same validated palette
+(``telemetry/report.py`` owns the CSS and the SVG helpers; this module
+reuses them rather than forking the design system).
+
+``study_record`` builds the machine-readable study record
+(``metric: "beta_study"``) the CI gates read: per-round estimates and
+deltas, budget accounting CROSS-CHECKED against the scheduler journal,
+and the ``study`` block the SLO rules resolve — the committed
+``STUDY_CPU.json`` is one of these, validated by
+``scripts/check_run_artifacts.py`` and gated by ``telemetry check``.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import time
+
+import numpy as np
+
+from dib_tpu.telemetry.report import (
+    _CSS,
+    _esc,
+    _fmt_tick,
+    _Scale,
+    _ticks,
+    _tiles,
+)
+
+__all__ = ["render_study_report", "study_record", "write_study_report"]
+
+_LN2 = math.log(2.0)
+
+
+# ------------------------------------------------------------------ record
+def study_record(directory: str) -> dict:
+    """The machine-readable study record for one study directory.
+
+    Budget accounting is cross-checked against the SCHEDULER journal
+    (``consistent``): the units the study journal decided must be
+    exactly the units the scheduler enqueued — the exactly-once
+    contract, as a committed number.
+    """
+    from dib_tpu.study.controller import StudyController
+
+    controller = StudyController(directory)
+    status = controller.status()
+    config = status["config"] or {}
+    rounds = [r for r in status["rounds"]]
+    submitted_units = sum(r.get("units") or 0 for r in rounds
+                          if r.get("job_id"))
+    sched = status["scheduler"]
+    verdict = status["verdict"] or {}
+    done_rounds = [r for r in rounds if r.get("done")]
+    last = done_rounds[-1] if done_rounds else {}
+    consistent = (
+        sched["units_submitted"] == submitted_units
+        and sched["jobs"] == sum(1 for r in rounds if r.get("job_id"))
+        and status["budget_spent"] == submitted_units
+    )
+    study_block = {
+        "study_id": status["study_id"],
+        "rounds": len(done_rounds),
+        "units_submitted": submitted_units,
+        "units_done": sched["units_done"],
+        "budget_spent": status["budget_spent"],
+        "budget_max": config.get("max_units"),
+        "max_rounds": config.get("max_rounds"),
+        "rounds_over_budget": max(
+            len(done_rounds) - int(config.get("max_rounds") or 0), 0)
+        if config.get("max_rounds") else 0,
+        "unconverged_full_budget": int(
+            verdict.get("verdict") == "unconverged"),
+    }
+    if verdict.get("verdict"):
+        study_block["verdict"] = verdict["verdict"]
+    if last.get("estimates"):
+        study_block["estimates"] = last["estimates"]
+    if last.get("deltas_decades"):
+        study_block["deltas_decades"] = last["deltas_decades"]
+    if last.get("band_nats") is not None:
+        study_block["band_nats"] = last["band_nats"]
+    return {
+        "metric": "beta_study",
+        "value": len(done_rounds),
+        "unit": "rounds",
+        "study_id": status["study_id"],
+        "verdict": verdict.get("verdict"),
+        "verdict_reason": verdict.get("reason"),
+        "threshold_nats": config.get("threshold_nats"),
+        "tolerance_decades": config.get("tolerance_decades"),
+        "seeds": config.get("seeds"),
+        "rounds": [
+            {k: r.get(k) for k in (
+                "round", "betas", "seeds", "units", "job_id",
+                "job_name", "budget_spent_after", "estimates",
+                "brackets", "deltas_decades", "band_nats",
+                "units_done", "units_failed") if r.get(k) is not None}
+            for r in rounds
+        ],
+        "estimates": last.get("estimates") or {},
+        "budget": {
+            "max_units": config.get("max_units"),
+            "max_rounds": config.get("max_rounds"),
+            "spent": status["budget_spent"],
+        },
+        "scheduler_journal": {
+            "jobs": sched["jobs"],
+            "units_submitted": sched["units_submitted"],
+            "units_done": sched["units_done"],
+            "consistent": bool(consistent),
+        },
+        "study": study_block,
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+
+
+# ------------------------------------------------------------------ charts
+def _band_chart(title: str, rows, vlines, *, width=420, height=170) -> str:
+    """One ensemble-banded KL-vs-β SVG: ``rows`` is ``[(log10_beta, lo,
+    mean, hi)]`` sorted by β; ``vlines`` is ``[(log10_beta, label)]`` —
+    the annotated transition estimates."""
+    rows = [r for r in rows
+            if all(isinstance(v, (int, float)) and math.isfinite(v)
+                   for v in r)]
+    if not rows:
+        return ""
+    pts_all = [[(x, lo) for x, lo, _, _ in rows],
+               [(x, hi) for x, _, _, hi in rows]]
+    sc = _Scale(pts_all, width, height)
+    parts = [f'<svg viewBox="0 0 {width} {height}" width="{width}" '
+             f'height="{height}" role="img" aria-label="{_esc(title)}">']
+    for t in _ticks(sc.y0, sc.y1):
+        if not (sc.y0 <= t <= sc.y1):
+            continue
+        y = sc.y(t)
+        parts.append(f'<line class="gridline" x1="{sc.pl}" y1="{y:.1f}" '
+                     f'x2="{width - sc.pr}" y2="{y:.1f}"/>')
+        parts.append(f'<text x="{sc.pl - 6}" y="{y + 3.5:.1f}" '
+                     f'text-anchor="end">{_fmt_tick(t)}</text>')
+    parts.append(f'<line class="axis" x1="{sc.pl}" y1="{height - sc.pb}" '
+                 f'x2="{width - sc.pr}" y2="{height - sc.pb}"/>')
+    for t in _ticks(sc.x0, sc.x1, 5):
+        if not (sc.x0 <= t <= sc.x1):
+            continue
+        parts.append(f'<text x="{sc.x(t):.1f}" y="{height - 6}" '
+                     f'text-anchor="middle">{_fmt_tick(t)}</text>')
+    parts.append(f'<text x="{width - sc.pr}" y="{height - 6}" '
+                 f'text-anchor="end">log10 β</text>')
+    band = ([f"{sc.x(x):.1f},{sc.y(hi):.1f}" for x, _, _, hi in rows]
+            + [f"{sc.x(x):.1f},{sc.y(lo):.1f}" for x, lo, _, _ in rows[::-1]])
+    parts.append(f'<polygon points="{" ".join(band)}" fill="var(--band)" '
+                 'stroke="none"/>')
+    mean_pts = " ".join(f"{sc.x(x):.1f},{sc.y(m):.1f}"
+                        for x, _, m, _ in rows)
+    parts.append(f'<polyline points="{mean_pts}" fill="none" '
+                 'stroke="var(--series-1)" stroke-width="2" '
+                 'stroke-linejoin="round"/>')
+    for x, lo, m, hi in rows:
+        parts.append(
+            f'<circle cx="{sc.x(x):.1f}" cy="{sc.y(m):.1f}" r="2.5" '
+            f'fill="var(--series-1)"><title>β=10^{_fmt_tick(x)}: '
+            f'mean {m:.4g} nats (band {lo:.4g}–{hi:.4g})</title>'
+            '</circle>')
+    for x, label in vlines:
+        if not (sc.x0 <= x <= sc.x1):
+            continue
+        parts.append(
+            f'<line x1="{sc.x(x):.1f}" y1="{sc.pt}" x2="{sc.x(x):.1f}" '
+            f'y2="{height - sc.pb}" stroke="var(--series-2)" '
+            'stroke-width="1.5" stroke-dasharray="4 3">'
+            f'<title>{_esc(label)}</title></line>')
+    parts.append("</svg>")
+    return (f'<div class="chart"><h3>{_esc(title)}</h3>'
+            f"{''.join(parts)}</div>")
+
+
+def _channel_rows(points_by_seed, channel: int):
+    """``[(log10_beta, lo, mean, hi)]`` for one channel across the
+    accumulated grid — the band is the across-seed min/max envelope."""
+    betas = sorted({b for pts in points_by_seed.values() for b in pts})
+    rows = []
+    for beta in betas:
+        vals = []
+        for pts in points_by_seed.values():
+            kl = pts.get(beta)
+            if kl is None:
+                continue
+            kl = np.asarray(kl, dtype=np.float64)
+            if channel < len(kl) and math.isfinite(float(kl[channel])):
+                vals.append(float(kl[channel]))
+        if vals:
+            rows.append((math.log10(beta), min(vals),
+                         sum(vals) / len(vals), max(vals)))
+    return rows
+
+
+# ------------------------------------------------------------------ render
+def render_study_report(directory: str) -> str:
+    """The study's self-contained HTML page (see module docstring)."""
+    from dib_tpu.study.controller import unit_points
+
+    record = study_record(directory)
+    points, _counts = unit_points(directory)
+    rounds = record["rounds"]
+    done_rounds = [r for r in rounds if r.get("estimates") is not None
+                   or r.get("deltas_decades") is not None]
+    estimates = {int(c): float(v)
+                 for c, v in (record["estimates"] or {}).items()}
+    verdict = record.get("verdict") or "in flight"
+    sched = record["scheduler_journal"]
+
+    head = (
+        "<!DOCTYPE html><html lang=\"en\"><head><meta charset=\"utf-8\">"
+        f"<title>DIB β study — {_esc(record['study_id'])}</title>"
+        f"<style>{_CSS}</style></head><body>"
+    )
+    parts = [head,
+             f"<h1>DIB β study — {_esc(record['study_id'])}</h1>",
+             '<p class="sub">Closed-loop info-plane study '
+             "(docs/study.md): transition-β refinement under budget, "
+             "ensemble error bands across seeds.</p>"]
+    parts.append(_tiles([
+        ("verdict", verdict),
+        ("rounds", record["value"]),
+        ("units submitted", sched["units_submitted"]),
+        ("units done", sched["units_done"]),
+        ("budget spent",
+         f"{record['budget']['spent']}/{record['budget']['max_units']}"),
+        ("transition channels", len(estimates) or None),
+        ("KL threshold (nats)", record.get("threshold_nats")),
+        ("tolerance (decades)", record.get("tolerance_decades")),
+        ("journal consistent", "yes" if sched["consistent"] else "NO"),
+    ]))
+    if record.get("verdict_reason"):
+        parts.append(f'<p class="note">{_esc(record["verdict_reason"])}'
+                     "</p>")
+
+    # ------------------------------------------- info-plane figures
+    parts.append("<h2>Distributed information plane "
+                 "(ensemble-banded)</h2>")
+    if points:
+        charts = []
+        channels = sorted(estimates) or list(range(
+            min(len(np.asarray(next(iter(pts.values()))))
+                for pts in points.values() if pts)
+            if any(points.values()) else 0))
+        for c in channels:
+            rows = _channel_rows(points, c)
+            if not rows:
+                continue
+            vlines = []
+            if c in estimates:
+                history = " → ".join(
+                    f"r{r['round']}: {float(r['estimates'][str(c)]):.3g}"
+                    for r in done_rounds
+                    if (r.get("estimates") or {}).get(str(c)) is not None
+                )
+                vlines.append((math.log10(estimates[c]),
+                               f"transition β ≈ {estimates[c]:.3g} "
+                               f"({history})"))
+            charts.append(_band_chart(
+                f"channel {c} — final KL (nats) vs β"
+                + (f" · transition ≈ {estimates[c]:.3g}"
+                   if c in estimates else " · no transition"),
+                rows, vlines))
+        parts.append('<div class="charts">' + "".join(charts) + "</div>")
+        parts.append(
+            '<p class="note">Band: across-seed min–max envelope of the '
+            "final per-channel KL at each trained β endpoint; dashed "
+            "line: the study's transition-β estimate with its "
+            "round-over-round history.</p>")
+    else:
+        parts.append('<p class="note">No finished units yet — figures '
+                     "appear once the first round drains.</p>")
+
+    # ------------------------------------------- estimates table
+    if done_rounds:
+        parts.append("<h2>Transition-β estimates by round</h2>")
+        channels = sorted({int(c) for r in done_rounds
+                           for c in (r.get("estimates") or {})})
+        header = "".join(f"<th>channel {c}</th>" for c in channels)
+        body_rows = []
+        for r in done_rounds:
+            cells = []
+            for c in channels:
+                est = (r.get("estimates") or {}).get(str(c))
+                delta = (r.get("deltas_decades") or {}).get(str(c))
+                cells.append(
+                    "<td>" + (f"{float(est):.4g}" if est is not None
+                              else "—")
+                    + (f" (Δ {float(delta):.3f} dec)"
+                       if delta is not None else "")
+                    + "</td>")
+            band = r.get("band_nats")
+            body_rows.append(
+                f"<tr><td>round {r['round']}</td>{''.join(cells)}"
+                + "<td>" + (f"{float(band):.4g}" if band is not None
+                            else "—") + "</td></tr>")
+        parts.append(
+            f"<table><thead><tr><th>round</th>{header}"
+            "<th>ensemble band (nats)</th></tr></thead>"
+            f"<tbody>{''.join(body_rows)}</tbody></table>")
+
+    # ------------------------------------------- rounds / budget table
+    parts.append("<h2>Rounds and budget</h2>")
+    round_rows = []
+    for r in rounds:
+        betas = r.get("betas") or []
+        round_rows.append(
+            f"<tr><td>round {r.get('round')}</td>"
+            f"<td>{len(betas)}</td>"
+            f"<td>{len(r.get('seeds') or [])}</td>"
+            f"<td>{r.get('units', '—')}</td>"
+            f"<td>{_esc(r.get('job_id') or 'unsubmitted')}</td>"
+            f"<td>{r.get('budget_spent_after', '—')}</td></tr>")
+    parts.append(
+        "<table><thead><tr><th>round</th><th>β points</th><th>seeds</th>"
+        "<th>units</th><th>scheduler job</th><th>budget after</th>"
+        f"</tr></thead><tbody>{''.join(round_rows)}</tbody></table>")
+    parts.append(
+        '<p class="note">Exactly-once contract: every decided round maps '
+        "to exactly one scheduler job; the scheduler journal counts "
+        f"({sched['jobs']} jobs / {sched['units_submitted']} units) "
+        + ("match" if sched["consistent"] else "DO NOT match")
+        + " the study journal's budget accounting.</p>")
+    parts.append("</body></html>")
+    return "".join(parts)
+
+
+def write_study_report(directory: str, out: str | None = None) -> str:
+    """Render and write ``study_report.html`` (or ``out``); returns the
+    path written."""
+    out = out or os.path.join(directory, "study_report.html")
+    content = render_study_report(directory)
+    with open(out, "w") as f:
+        f.write(content)
+    return out

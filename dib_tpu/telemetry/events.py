@@ -214,6 +214,19 @@ EVENT_SCHEMA: dict[str, EventKindSpec] = {
             "ModelZoo.reload) or rolled_back (canary/restore failed; the "
             "previous checkpoint keeps answering); latency_s is the "
             "publish→serve window the streaming SLO gates"),
+    "study": EventKindSpec(
+        required=("study_id", "action"),
+        optional=("round", "job_id", "betas", "seeds", "units",
+                  "estimates", "deltas_decades", "band_nats",
+                  "budget_spent", "budget_max", "max_rounds", "verdict",
+                  "reason"),
+        doc="one closed-loop study-controller transition (dib_tpu/study): "
+            "`submit` (a round's job handed to the scheduler — exactly "
+            "once, by decided-set replay), `round` (a round's results "
+            "collected: per-channel transition-β `estimates`, their "
+            "round-over-round `deltas_decades`, the ensemble "
+            "`band_nats`, budget spent), and the terminal verdict "
+            "actions `converged` / `unconverged` / `no_transitions`"),
     "drift": EventKindSpec(
         required=("round", "detector"),
         optional=("shift", "threshold", "action", "epoch"),
@@ -691,6 +704,15 @@ class EventWriter:
         ``promoted`` (hot-swapped into the fleet) or ``rolled_back``
         (canary/restore failure; previous checkpoint keeps serving)."""
         return self.emit("deploy", publish_id=publish_id, action=action,
+                         **fields)
+
+    def study(self, *, study_id: str, action: str, **fields) -> dict:
+        """One study-controller transition (``dib_tpu/study``):
+        ``action`` is ``submit`` (round job handed to the scheduler,
+        exactly-once), ``round`` (round results collected: transition-β
+        estimates + deltas + ensemble band), or a terminal verdict —
+        ``converged`` / ``unconverged`` / ``no_transitions``."""
+        return self.emit("study", study_id=study_id, action=action,
                          **fields)
 
     def drift(self, *, round: int, detector: str, **fields) -> dict:

@@ -40,7 +40,7 @@ from dib_tpu.telemetry.events import (
 __all__ = ["summarize", "compare", "faults_rollup", "mesh_rollup",
            "overlap_rollup",
            "scheduler_rollup", "serving_rollup", "span_rollup",
-           "streaming_rollup",
+           "streaming_rollup", "study_rollup",
            "span_hotspots", "telemetry_main"]
 
 _LN2 = log(2.0)
@@ -309,6 +309,13 @@ _FAULT_DETECTORS: dict[str, tuple[str, ...]] = {
     "replica_sdc": ("anomaly_rollback", "replica_ejected",
                     "anomaly_detected"),
     "ckpt_bitflip_payload": ("checkpoint_fallback", "canary_rollback"),
+    # closed-loop study controller (dib_tpu/study, docs/study.md): a
+    # controller SIGKILLed inside the exactly-once window (between the
+    # round's journal append and the scheduler submit, or between the
+    # submit and the journal ack) is detected by the restarted
+    # controller's resume — which resolves the unacked round against the
+    # scheduler journal instead of blindly resubmitting
+    "study_kill": ("study_resumed",),
 }
 
 # Recovery markers per kind, evaluated on events AFTER the detection:
@@ -558,6 +565,75 @@ def streaming_rollup(events) -> dict | None:
         out["lost_publishes"] = (
             max(indices) - min(indices) + 1 - len(indices)
             if indices else 0)
+    return out
+
+
+def study_rollup(events) -> dict | None:
+    """Closed-loop study view of a stream's ``study`` events
+    (``dib_tpu/study``, docs/study.md): rounds run, units
+    submitted/done, the latest transition-β ``estimates`` with their
+    round-over-round ``deltas_decades`` and ensemble ``band_nats``, the
+    budget accounting, and the terminal ``verdict``. The two derived
+    gate keys are what the SLO rows read: ``rounds_over_budget``
+    (``study_rounds_ceiling`` — a controller refining past its own round
+    budget is a runaway loop) and ``unconverged_full_budget``
+    (``study_unconverged_max`` — a study that spent its whole budget
+    without the estimates stabilizing needs a human, not more units).
+    None when the stream carries no study events (ordinary runs skip
+    both rules)."""
+    studies = [e for e in events if e.get("type") == "study"]
+    if not studies:
+        return None
+    out: dict = {}
+    study_id = next((e.get("study_id") for e in studies
+                     if e.get("study_id")), None)
+    if study_id is not None:
+        out["study_id"] = study_id
+    out["rounds"] = sum(1 for e in studies if e.get("action") == "round")
+    out["units_submitted"] = sum(
+        e.get("units") or 0 for e in studies
+        if e.get("action") == "submit")
+    # unit completions ride the scheduler's job events on the SAME
+    # stream (the controller hands its writer to the scheduler)
+    out["units_done"] = sum(
+        1 for e in events if e.get("type") == "job"
+        and e.get("action") == "unit_done")
+    last_round = next((e for e in reversed(studies)
+                       if e.get("action") == "round"), None)
+    if last_round is not None:
+        if last_round.get("estimates"):
+            out["estimates"] = last_round["estimates"]
+        if last_round.get("deltas_decades"):
+            out["deltas_decades"] = last_round["deltas_decades"]
+        if last_round.get("band_nats") is not None:
+            out["band_nats"] = last_round["band_nats"]
+    verdict = next((e for e in reversed(studies)
+                    if e.get("action") in ("converged", "unconverged",
+                                           "no_transitions")), None)
+    if verdict is not None:
+        out["verdict"] = verdict["action"]
+    spent = next((e.get("budget_spent") for e in reversed(studies)
+                  if e.get("budget_spent") is not None), None)
+    if spent is not None:
+        out["budget_spent"] = spent
+    budget_max = next((e.get("budget_max") for e in reversed(studies)
+                       if e.get("budget_max") is not None), None)
+    if budget_max is not None:
+        out["budget_max"] = budget_max
+    max_rounds = next((e.get("max_rounds") for e in reversed(studies)
+                       if e.get("max_rounds") is not None), None)
+    if max_rounds is not None:
+        out["max_rounds"] = max_rounds
+    out["rounds_over_budget"] = (
+        max(out["rounds"] - max_rounds, 0) if max_rounds is not None
+        else 0)
+    # the gate key is the verdict itself: the controller's _decide ends
+    # a study unconverged when it cannot produce a stable localized
+    # estimate — budget (rounds/units) exhausted, every unit failed, or
+    # refinement saturated with unresolved ensemble disagreement — and
+    # all of those need a human before more units are spent
+    out["unconverged_full_budget"] = int(
+        (verdict or {}).get("action") == "unconverged")
     return out
 
 
@@ -872,6 +948,13 @@ def summarize(path: str, process_index: int | None = None,
     streaming = streaming_rollup(events)
     if streaming is not None:
         summary["streaming"] = streaming
+
+    # closed-loop study controller (dib_tpu/study): study events are
+    # global like the scheduler's — the controller and the pool workers
+    # it drives share one stream
+    study = study_rollup(events)
+    if study is not None:
+        summary["study"] = study
 
     # mesh execution plane (parallel/sweep.py shard_map engine +
     # mesh-shape-portable checkpoints): axis sizes from the run_start

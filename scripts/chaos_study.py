@@ -1,0 +1,338 @@
+"""Chaos drills for the closed-loop study controller → CHAOS_STUDY.json.
+
+The study controller's durability claim (docs/study.md) is exactly-once
+round submission by decided-set replay: every round is journaled BEFORE
+it executes, and a restarted controller resolves an unacknowledged round
+against the SCHEDULER journal — adopt the named job if it exists, submit
+it if it does not — so a SIGKILL anywhere in the window can neither
+double-spend budget on a duplicate job nor silently skip a refinement
+round. Three drills, each through the REAL CLI
+(``python -m dib_tpu study run`` subprocesses):
+
+  - ``intent_kill`` — ``DIB_STUDY_FAULT=kill@intent:1`` SIGKILLs the
+    controller BETWEEN the round-1 journal append and the scheduler
+    submit (the decided-but-unsubmitted window). The restart must find
+    no job under the round's name and submit it exactly once.
+  - ``submit_ack_kill`` — ``kill@submit:1`` SIGKILLs BETWEEN the
+    scheduler submit and the journal ack (the submitted-but-unacked
+    window). The restart must ADOPT the existing job from the scheduler
+    journal — resubmitting here is the double-spend this suite exists
+    to catch.
+  - ``torn_journal`` — the finished study's final journal line (the
+    verdict) is torn mid-byte. The restart must seal + skip the torn
+    line (``journal_recovered``), re-derive the SAME verdict from the
+    surviving rounds, and submit nothing.
+
+Every drill asserts the three study invariants
+(``exactly_once_submission`` / ``zero_duplicate_units`` /
+``zero_lost_rounds``) with the scheduler journal as the cross-check,
+and the kill drills additionally prove fault-detection on the stream
+(the durable ``study_kill`` fault event joined to the restarted
+controller's ``study_resumed`` mitigation). Committed as
+``CHAOS_STUDY.json``, validated per-row by
+``scripts/check_run_artifacts.py``.
+
+Usage::
+
+    python scripts/chaos_study.py --out CHAOS_STUDY.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+METRIC = "chaos_study_matrix"
+
+#: Small-but-real study shape: 4-β grid, one seed, one refinement round
+#: expected before convergence (same unit scale as scripts/run_study.py).
+STUDY_FLAGS = [
+    "--grid", "0.03", "30", "4", "--seeds", "0",
+    "--threshold-nats", "0.1", "--tolerance-decades", "0.3",
+    # the coarse 4-point grid's cells are a decade wide; the drills
+    # prove exactly-once submission, not localization
+    "--max-bracket-decades", "2.0",
+    "--min-refine-rounds", "1", "--max-rounds", "3", "--max-units", "20",
+    "--refine-num", "3",
+    "--set", "steps_per_epoch=16", "--set", "num_annealing_epochs=20",
+    "--set", "batch_size=128", "--set", "chunk_epochs=11",
+]
+
+
+def _log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _run_cli(study_dir: str, fault: str | None = None,
+             configure: bool = True) -> subprocess.CompletedProcess:
+    cmd = [sys.executable, "-m", "dib_tpu", "study", "run",
+           "--study-dir", study_dir]
+    if configure:
+        cmd += STUDY_FLAGS
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("DIB_STUDY_FAULT", None)
+    if fault:
+        env["DIB_STUDY_FAULT"] = fault
+    return subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=900)
+
+
+# ------------------------------------------------------------- invariants
+def _journal_views(study_dir: str) -> dict:
+    from dib_tpu.sched.journal import read_journal
+    from dib_tpu.study.journal import fold_study, read_study_journal
+
+    sched_records, sched_torn = read_journal(study_dir)
+    study_records, study_torn = read_study_journal(study_dir)
+    state = fold_study(study_records)
+    jobs = [r for r in sched_records if r.get("kind") == "job"]
+    units = [r for r in sched_records if r.get("kind") == "unit"]
+    return {
+        "state": state,
+        "sched_job_names": [(r.get("spec") or {}).get("name")
+                            for r in jobs],
+        "sched_units": [(r.get("job_id"), r.get("beta"), r.get("seed"))
+                        for r in units],
+        "sched_torn": sched_torn,
+        "study_torn": study_torn,
+    }
+
+
+def _invariants(study_dir: str) -> dict:
+    """The three study invariants, from the two journals alone — the
+    decided rounds (study journal) against what actually got enqueued
+    (scheduler journal)."""
+    view = _journal_views(study_dir)
+    state = view["state"]
+    rounds = state["rounds"]
+    names = view["sched_job_names"]
+    exactly_once = (
+        bool(rounds)
+        and all(names.count(r.get("job_name")) == 1 for r in rounds)
+        and len(names) == len(rounds)
+    )
+    decided_units = sum(r.get("units") or 0 for r in rounds)
+    unit_keys = view["sched_units"]
+    zero_duplicates = (
+        len(unit_keys) == len(set(unit_keys))
+        and len(unit_keys) == decided_units
+        and state["budget_spent"] == decided_units
+    )
+    zero_lost = (
+        bool(rounds)
+        and all(r.get("done") and r.get("job_id") for r in rounds)
+        and state["verdict"] is not None
+    )
+    return {
+        "exactly_once_submission": bool(exactly_once),
+        "zero_duplicate_units": bool(zero_duplicates),
+        "zero_lost_rounds": bool(zero_lost),
+        "rounds": len(rounds),
+        "jobs": len(names),
+        "units": len(unit_keys),
+        "verdict": (state["verdict"] or {}).get("verdict"),
+    }
+
+
+def _stream_evidence(study_dir: str) -> dict:
+    from dib_tpu.telemetry import summarize
+
+    summary = summarize(study_dir)
+    return {
+        "faults": summary.get("faults"),
+        "mitigations": summary.get("mitigations"),
+        "study": summary.get("study"),
+        "status": summary.get("status"),
+    }
+
+
+# ----------------------------------------------------------------- drills
+def _kill_drill(name: str, fault_stage: str, workdir: str,
+                expect_adoption: bool) -> dict:
+    """Shared shape of the two SIGKILL-window drills: run with the fault
+    armed (must die by SIGKILL inside round 1's window), restart clean
+    (must finish), then prove exactly-once against the journals."""
+    study_dir = os.path.join(workdir, name)
+    fault = f"kill@{fault_stage}:1"
+    _log(f"drill {name}: SIGKILL via {fault}")
+    t0 = time.time()
+    first = _run_cli(study_dir, fault=fault)
+    killed = first.returncode == -signal.SIGKILL
+    mid_view = _journal_views(study_dir)
+    mid_rounds = mid_view["state"]["rounds"]
+    # the kill window is INSIDE round 1: the intent is journaled, the
+    # ack is not — and for the intent stage no scheduler job exists yet
+    # while for the submit stage exactly one does
+    open_rounds = [r for r in mid_rounds
+                   if not r.get("done") and "job_id" not in r]
+    window_names = [r.get("job_name") for r in open_rounds]
+    jobs_in_window = sum(
+        mid_view["sched_job_names"].count(n) for n in window_names)
+    window_ok = (len(open_rounds) == 1
+                 and jobs_in_window == (1 if expect_adoption else 0))
+
+    second = _run_cli(study_dir, configure=False)
+    inv = _invariants(study_dir)
+    evidence = _stream_evidence(study_dir)
+    mitigations = evidence.get("mitigations") or {}
+    resumed = mitigations.get("study_resumed", 0) >= 1
+    faults = evidence.get("faults") or {}
+    detected = (faults.get("injected") == 1
+                and faults.get("detected") == 1)
+    ok = (killed and window_ok and second.returncode == 0
+          and inv["exactly_once_submission"]
+          and inv["zero_duplicate_units"] and inv["zero_lost_rounds"]
+          and inv["verdict"] == "converged" and resumed and detected)
+    if not ok:
+        _log(f"  {name} FAILED: killed={killed} window_ok={window_ok} "
+             f"rc2={second.returncode} inv={inv} resumed={resumed} "
+             f"detected={detected}\n  stderr tail: "
+             f"{(second.stderr or '')[-500:]}")
+    return {
+        "drill": name, "kind": "study_kill", "ok": bool(ok),
+        "fault": fault,
+        "killed_by_sigkill": bool(killed),
+        "kill_window_state": {
+            "open_rounds": len(open_rounds),
+            "jobs_under_open_round_names": jobs_in_window,
+            "expected_jobs_in_window": 1 if expect_adoption else 0,
+        },
+        "resume_rc": second.returncode,
+        "adopted_existing_job": bool(expect_adoption),
+        "study_resumed_mitigations": mitigations.get("study_resumed", 0),
+        "fault_detected": bool(detected),
+        **{k: inv[k] for k in ("exactly_once_submission",
+                               "zero_duplicate_units",
+                               "zero_lost_rounds", "rounds", "jobs",
+                               "units", "verdict")},
+        "wall_s": round(time.time() - t0, 1),
+        "evidence": evidence,
+    }
+
+
+def drill_intent_kill(workdir: str) -> dict:
+    return _kill_drill("intent_kill", "intent", workdir,
+                       expect_adoption=False)
+
+
+def drill_submit_ack_kill(workdir: str) -> dict:
+    return _kill_drill("submit_ack_kill", "submit", workdir,
+                       expect_adoption=True)
+
+
+def drill_torn_journal(workdir: str) -> dict:
+    """Tear the finished study's final journal line (the verdict) →
+    the restart seals + skips it, re-derives the SAME verdict from the
+    surviving rounds, and submits nothing new."""
+    study_dir = os.path.join(workdir, "torn_journal")
+    _log("drill torn_journal: tear the verdict line, restart")
+    t0 = time.time()
+    first = _run_cli(study_dir)
+    before = _invariants(study_dir)
+    path = os.path.join(study_dir, "study.jsonl")
+    with open(path, "rb") as f:
+        raw = f.read()
+    lines = raw.rstrip(b"\n").split(b"\n")
+    torn = b"\n".join(lines[:-1]) + b"\n" + lines[-1][: len(lines[-1]) // 2]
+    with open(path, "wb") as f:
+        f.write(torn)
+
+    second = _run_cli(study_dir, configure=False)
+    after = _invariants(study_dir)
+    evidence = _stream_evidence(study_dir)
+    mitigations = evidence.get("mitigations") or {}
+    recovered = mitigations.get("journal_recovered", 0) >= 1
+    ok = (first.returncode == 0 and second.returncode == 0
+          and before["verdict"] == "converged"
+          and after["verdict"] == before["verdict"]
+          and after["jobs"] == before["jobs"]
+          and after["units"] == before["units"]
+          and after["exactly_once_submission"]
+          and after["zero_duplicate_units"] and after["zero_lost_rounds"]
+          and recovered)
+    if not ok:
+        _log(f"  torn_journal FAILED: rc=({first.returncode},"
+             f"{second.returncode}) before={before} after={after} "
+             f"recovered={recovered}")
+    return {
+        "drill": "torn_journal", "kind": "journal_torn", "ok": bool(ok),
+        "verdict_before": before["verdict"],
+        "verdict_after": after["verdict"],
+        "jobs_before": before["jobs"], "jobs_after": after["jobs"],
+        "journal_recovered_mitigations": mitigations.get(
+            "journal_recovered", 0),
+        **{k: after[k] for k in ("exactly_once_submission",
+                                 "zero_duplicate_units",
+                                 "zero_lost_rounds", "rounds", "jobs",
+                                 "units", "verdict")},
+        "wall_s": round(time.time() - t0, 1),
+        "evidence": evidence,
+    }
+
+
+# ----------------------------------------------------------------- driver
+def run_drills(workdir: str | None = None) -> dict:
+    owned = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="dib_chaos_study_")
+    matrix: list[dict] = []
+    try:
+        matrix.append(drill_intent_kill(workdir))
+        matrix.append(drill_submit_ack_kill(workdir))
+        matrix.append(drill_torn_journal(workdir))
+    finally:
+        if owned:
+            shutil.rmtree(workdir, ignore_errors=True)
+    passed = sum(1 for d in matrix if d["ok"])
+    duplicates = sum(1 for d in matrix
+                     if d.get("zero_duplicate_units") is not True)
+    return {
+        "metric": METRIC,
+        "value": passed,
+        "unit": "drills_passed",
+        "total": len(matrix),
+        "quick": False,
+        "all_passed": passed == len(matrix),
+        "duplicate_submissions": duplicates,
+        "matrix": matrix,
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--out", default=None,
+                        help="Also write the JSON record to this path.")
+    parser.add_argument("--workdir", default=None,
+                        help="Keep drill artifacts here (default: a temp "
+                             "dir, removed afterwards).")
+    parser.add_argument("--runs-root", "--runs_root", dest="runs_root",
+                        default=None,
+                        help="Register this run in the fleet registry "
+                             "(<runs-root>/index.jsonl; default: "
+                             "DIB_RUNS_ROOT when set, else off).")
+    args = parser.parse_args(argv)
+    record = run_drills(workdir=args.workdir)
+    print(json.dumps(record), flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(json.dumps(record, indent=1) + "\n")
+    from dib_tpu.telemetry.registry import register_drill_record
+
+    if register_drill_record(record, root=args.runs_root, extra={
+            "duplicate_submissions": record["duplicate_submissions"]}) \
+            is not None:
+        _log("chaos_study: registered in the fleet registry")
+    return 0 if record["all_passed"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -52,7 +52,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 ARTIFACT_GLOBS = ("BENCH_*.json", "NORTHSTAR_*.json", "FAULT_DRILL*.json",
                   "CHAOS_SCHED*.json", "CHAOS_STREAM*.json",
-                  "CHAOS_SDC*.json")
+                  "CHAOS_SDC*.json", "CHAOS_STUDY*.json", "STUDY_*.json")
 
 # Null-value excuses: at least one must be present when value is null.
 _NULL_VALUE_EXCUSES = ("degraded", "error", "per_run_minutes", "runs_completed")
@@ -246,6 +246,119 @@ def _check_chaos_sdc_matrix(record: dict, problems: list[str]) -> None:
             "'undetected_corruptions' must be present and exactly 0 "
             "(the sdc_undetected_max SLO gate) — got "
             f"{record.get('undetected_corruptions')!r}")
+
+
+# Drills every committed full chaos_study_matrix record must carry
+# (scripts/chaos_study.py): the study controller's exactly-once windows
+# (docs/study.md "Exactly-once submission").
+_REQUIRED_CHAOS_STUDY_DRILLS = (
+    "intent_kill", "submit_ack_kill", "torn_journal",
+)
+
+#: The three study invariants asserted per drill row: every decided
+#: round maps to exactly one scheduler job, no (job, β, seed) unit was
+#: enqueued twice (and the budget accounting matches the scheduler
+#: journal), and no decided round was skipped or left undone.
+_CHAOS_STUDY_INVARIANTS = ("exactly_once_submission",
+                           "zero_duplicate_units", "zero_lost_rounds")
+
+
+def _check_chaos_study_matrix(record: dict, problems: list[str]) -> None:
+    """chaos_study_matrix-specific schema: every drill present (full
+    records), zero failures, the three exactly-once invariants asserted
+    per row, and the record-level zero-duplicate gate."""
+    _check_chaos_matrix(
+        record, problems,
+        required_drills=_REQUIRED_CHAOS_STUDY_DRILLS,
+        invariants=_CHAOS_STUDY_INVARIANTS,
+        rerun_hint="scripts/chaos_study.py --out CHAOS_STUDY.json")
+    if record.get("duplicate_submissions") != 0:
+        problems.append(
+            "'duplicate_submissions' must be present and exactly 0 "
+            "(the exactly-once contract) — got "
+            f"{record.get('duplicate_submissions')!r}")
+
+
+def _check_beta_study(record: dict, problems: list[str]) -> None:
+    """beta_study-specific schema (scripts/run_study.py, docs/study.md):
+    a converged verdict reached through >= 2 refinement rounds with the
+    final round-over-round transition-β deltas under the committed
+    tolerance, budget accounting consistent with the scheduler journal,
+    and the `study` block the SLO rules resolve carried at zero
+    rounds-over-budget."""
+    if record.get("verdict") != "converged":
+        problems.append("committed beta_study record must carry verdict "
+                        f"'converged', got {record.get('verdict')!r}")
+    rounds = record.get("rounds")
+    if not isinstance(rounds, list) or not rounds:
+        problems.append("'rounds' must be a non-empty list of round "
+                        "records")
+        return
+    refinements = [r for r in rounds
+                   if isinstance(r, dict)
+                   and isinstance(r.get("round"), int) and r["round"] >= 1]
+    if len(refinements) < 2:
+        problems.append(
+            f"committed study must show >= 2 refinement rounds (rounds "
+            f"beyond the initial grid), got {len(refinements)} — re-run "
+            "scripts/run_study.py --out STUDY_CPU.json")
+    for i, r in enumerate(rounds):
+        if not isinstance(r, dict):
+            problems.append(f"rounds[{i}] must be an object")
+            continue
+        if not (isinstance(r.get("betas"), list) and r["betas"]):
+            problems.append(f"rounds[{i}]: 'betas' must be a non-empty "
+                            "list")
+        if not _is_finite_number(r.get("units")) or r.get("units", 0) <= 0:
+            problems.append(f"rounds[{i}]: 'units' must be a positive "
+                            "number")
+        if not (isinstance(r.get("job_id"), str) and r["job_id"]):
+            problems.append(f"rounds[{i}]: 'job_id' must be a non-empty "
+                            "string (every decided round was submitted)")
+    tolerance = record.get("tolerance_decades")
+    if not _is_finite_number(tolerance) or tolerance <= 0:
+        problems.append("'tolerance_decades' must be a positive number")
+    elif refinements:
+        last = refinements[-1]
+        deltas = [v for v in (last.get("deltas_decades") or {}).values()
+                  if _is_finite_number(v)]
+        if not deltas:
+            problems.append("final refinement round carries no finite "
+                            "'deltas_decades' — convergence evidence "
+                            "missing")
+        elif max(deltas) > tolerance:
+            problems.append(
+                f"final refinement round's max delta {max(deltas)} "
+                f"exceeds the committed tolerance {tolerance} — the "
+                "converged verdict is not supported by its own evidence")
+    estimates = record.get("estimates")
+    if not isinstance(estimates, dict) or not estimates:
+        problems.append("'estimates' must be a non-empty channel → "
+                        "transition-β map")
+    else:
+        for c, v in estimates.items():
+            if not _is_finite_number(v) or v <= 0:
+                problems.append(f"estimates[{c}] must be a positive "
+                                f"finite β, got {v!r}")
+    sched = record.get("scheduler_journal")
+    if not isinstance(sched, dict):
+        problems.append("'scheduler_journal' cross-check block missing")
+    elif sched.get("consistent") is not True:
+        problems.append("'scheduler_journal.consistent' must be true — "
+                        "the study journal's budget accounting must "
+                        "match what the scheduler actually enqueued")
+    study = record.get("study")
+    if not isinstance(study, dict):
+        problems.append("'study' SLO block missing (the "
+                        "study_rounds_ceiling / study_unconverged_max "
+                        "rules resolve against it)")
+    else:
+        if study.get("rounds_over_budget") != 0:
+            problems.append("'study.rounds_over_budget' must be 0, got "
+                            f"{study.get('rounds_over_budget')!r}")
+        if study.get("unconverged_full_budget") != 0:
+            problems.append("'study.unconverged_full_budget' must be 0, "
+                            f"got {study.get('unconverged_full_budget')!r}")
 
 
 def _check_kernel_bench(record: dict, problems: list[str]) -> None:
@@ -490,6 +603,10 @@ def check_record(record: dict, problems: list[str]) -> None:
             _check_chaos_stream_matrix(record, problems)
         if record.get("metric") == "chaos_sdc_matrix":
             _check_chaos_sdc_matrix(record, problems)
+        if record.get("metric") == "chaos_study_matrix":
+            _check_chaos_study_matrix(record, problems)
+        if record.get("metric") == "beta_study":
+            _check_beta_study(record, problems)
         if record.get("metric") == "mi_kernel_bench":
             _check_kernel_bench(record, problems)
         if record.get("metric") == "serve_async_loadgen_sweep":

@@ -1,0 +1,141 @@
+"""Run a real CPU boolean-workload closed-loop β study → STUDY_CPU.json.
+
+The acceptance evidence for the ISSUE 15 science engine
+(docs/study.md): a dense log-spaced β grid over the boolean-circuit
+workload is submitted as ONE study, the controller detects the
+per-channel info-plane transitions from the finished units' final KL
+curves, auto-submits multi-seed refinement rounds around them through
+the β-grid scheduler, and stops when the transition-β estimates move
+less than the tolerance round-over-round — a ``converged`` verdict with
+≥ 2 refinement rounds, budget accounting cross-checked against the
+scheduler journal, and the ensemble-banded HTML report rendered from
+the same directory.
+
+The committed record is ``study_record``'s machine-readable view plus
+the run provenance; ``scripts/check_run_artifacts.py`` validates it
+per-round and ``telemetry check STUDY_CPU.json`` gates it under the
+``study_rounds_ceiling`` / ``study_unconverged_max`` SLO rules.
+
+Usage::
+
+    python scripts/run_study.py --out STUDY_CPU.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+METRIC = "beta_study"
+
+#: The committed study's science parameters: a 6-point dense grid over
+#: 3 decades, 2-seed ensembles, 0.1-nat transition threshold, and a
+#: 0.15-decade convergence tolerance demanded over >= 2 refinement
+#: rounds (one agreement is not evidence) with every bracket localized
+#: to at most 1 decade. The unit scale is the smallest boolean-circuit
+#: training where the annealing β genuinely compresses channels through
+#: the threshold AND the two-seed ensemble agrees to within about a
+#: grid interval (measured: 26 epochs at 32 steps/epoch, ~2.5 s/unit on
+#: CPU; at half this training the seeds disagree across decades and the
+#: localization gate correctly refuses to converge).
+STUDY_KW = dict(
+    grid_start=0.03, grid_stop=30.0, grid_num=6, seeds=(0, 1),
+    threshold_nats=0.1, tolerance_decades=0.15, max_bracket_decades=1.0,
+    min_refine_rounds=2, max_rounds=6, max_units=96, refine_num=4,
+    train={"steps_per_epoch": 32, "num_annealing_epochs": 24,
+           "batch_size": 128, "chunk_epochs": 13},
+)
+
+
+def _log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def run_study(workdir: str, workers: int = 2) -> dict:
+    from dib_tpu.study.controller import StudyConfig, StudyController
+    from dib_tpu.study.report import study_record, write_study_report
+    from dib_tpu.telemetry import (
+        EventWriter,
+        runtime_manifest,
+        summarize,
+    )
+
+    study_dir = os.path.join(workdir, "study_cpu")
+    config = StudyConfig(**STUDY_KW)
+    _log(f"run_study: grid={config.initial_betas()} seeds={config.seeds} "
+         f"budget={config.max_units} units / {config.max_rounds} rounds")
+    t0 = time.time()
+    writer = EventWriter(study_dir, run_id="study-cpu")
+    try:
+        writer.run_start(runtime_manifest(extra={"mode": "study"}))
+        controller = StudyController(study_dir, config=config,
+                                     telemetry=writer,
+                                     study_id="study_cpu")
+        state = controller.run(workers=workers)
+        writer.run_end(status="ok")
+    finally:
+        writer.close()
+    wall_s = time.time() - t0
+
+    record = study_record(study_dir)
+    html_path = write_study_report(study_dir)
+    summary = summarize(study_dir)
+    record.update({
+        "workload": "boolean_circuit",
+        "wall_clock_s": round(wall_s, 1),
+        "workers": workers,
+        "report_html_bytes": os.path.getsize(html_path),
+        "device_platform": summary.get("device_platform"),
+        "device_kind": summary.get("device_kind"),
+        "scheduler": summary.get("scheduler"),
+        "verdict_detail": state["verdict"],
+    })
+    _log(f"run_study: verdict={record['verdict']} "
+         f"rounds={record['value']} wall={wall_s:.0f}s "
+         f"consistent={record['scheduler_journal']['consistent']}")
+    return record
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--out", default=None,
+                        help="Also write the JSON record to this path.")
+    parser.add_argument("--workdir", default=None,
+                        help="Keep the study directory here (default: a "
+                             "temp dir, removed afterwards).")
+    parser.add_argument("--workers", type=int, default=2)
+    parser.add_argument("--runs-root", "--runs_root", dest="runs_root",
+                        default=None,
+                        help="Register this study in the fleet registry "
+                             "(<runs-root>/index.jsonl; default: "
+                             "DIB_RUNS_ROOT when set, else off).")
+    args = parser.parse_args(argv)
+    owned = args.workdir is None
+    workdir = args.workdir or tempfile.mkdtemp(prefix="dib_study_cpu_")
+    try:
+        record = run_study(workdir, workers=args.workers)
+    finally:
+        if owned:
+            shutil.rmtree(workdir, ignore_errors=True)
+    print(json.dumps(record), flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(json.dumps(record, indent=1) + "\n")
+    from dib_tpu.telemetry.registry import register_drill_record
+
+    if register_drill_record(record, root=args.runs_root, extra={
+            "study_verdict": record["verdict"],
+            "rounds": record["value"]}) is not None:
+        _log("run_study: registered in the fleet registry")
+    return 0 if record["verdict"] == "converged" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -211,6 +211,8 @@ def test_host_sync_targets_only_chunk_loop_modules():
     # ...and (ISSUE 14) the integrity plane: the anomaly detector runs
     # at every chunk boundary and must live off the row fetch the
     # boundary already pays for; the digest/scrub layer syncs explicitly
+    # ...and (ISSUE 15) the study controller, which drives the pool's
+    # many concurrent chunk loops from its decision core
     assert set(host.target_modules) == {
         "dib_tpu/train/loop.py",
         "dib_tpu/train/measurement.py",
@@ -231,6 +233,7 @@ def test_host_sync_targets_only_chunk_loop_modules():
         "dib_tpu/train/anomaly.py",
         "dib_tpu/train/scrub.py",
         "dib_tpu/train/checkpoint.py",
+        "dib_tpu/study/controller.py",
     }
 
 
@@ -250,6 +253,27 @@ def test_thread_state_covers_the_async_serving_modules():
                    "dib_tpu/stream/online.py",
                    "dib_tpu/stream/deployer.py"):
         assert module not in getattr(thread_pass, "allowlist", {})
+
+
+def test_tree_wide_passes_cover_the_study_modules():
+    """ISSUE 15: the study controller tails streams from a follower
+    thread and talks to the scheduler — exactly the bug classes the
+    tree-wide passes exist for. Pin that thread-shared-state,
+    resource-lifecycle, and async-blocking stay tree-wide (no
+    target_modules) and that no study module is allowlisted away; the
+    zero-findings full-tree gate does the rest."""
+    from dib_tpu.analysis.core import get_pass
+
+    for pass_name in ("thread-shared-state", "resource-lifecycle",
+                      "async-blocking"):
+        p = get_pass(pass_name)
+        assert not getattr(p, "target_modules", None), pass_name
+        for module in ("dib_tpu/study/controller.py",
+                       "dib_tpu/study/journal.py",
+                       "dib_tpu/study/report.py",
+                       "dib_tpu/study/cli.py"):
+            assert module not in getattr(p, "allowlist", {}), (
+                pass_name, module)
 
 
 # -------------------------------------------------- thread-shared-state

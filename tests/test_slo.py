@@ -600,3 +600,94 @@ def test_streaming_lost_publish_pages_via_subprocess(tmp_path):
                 if r["status"] == "violated"]
     assert violated == ["stream_lost_publish_max"]
     assert report["violations"] == 1
+
+
+# ================================================== study rules (ISSUE 15)
+def _write_study_stream(directory, *, rounds=2, max_rounds=4,
+                        verdict="converged"):
+    """A synthetic study-controller stream (dib_tpu/study events) with
+    the violation knobs the two study SLO rules gate."""
+    with EventWriter(str(directory), run_id="study-slo") as writer:
+        writer.run_start({"mode": "study"})
+        for r in range(rounds):
+            writer.study(study_id="s", action="submit", round=r,
+                         job_id=f"job-{r}", units=4,
+                         budget_spent=4 * (r + 1), budget_max=40)
+            writer.study(study_id="s", action="round", round=r,
+                         estimates={"0": 0.3},
+                         deltas_decades={"0": 0.01}, units=4,
+                         budget_spent=4 * (r + 1), budget_max=40,
+                         max_rounds=max_rounds)
+        writer.study(study_id="s", action=verdict, verdict=verdict,
+                     reason="synthetic", budget_spent=4 * rounds,
+                     budget_max=40, max_rounds=max_rounds)
+        writer.run_end(status="ok")
+
+
+def test_study_rules_clean_converged_stream_exits_zero(tmp_path):
+    _write_study_stream(tmp_path / "run")
+    report = check_run(str(tmp_path / "run"), COMMITTED_SLO, write=False)
+    by_rule = {r["rule"]: r for r in report["rules"]}
+    assert by_rule["study_rounds_ceiling"]["status"] == "ok"
+    assert by_rule["study_unconverged_max"]["status"] == "ok"
+    assert telemetry_main(["check", str(tmp_path / "run"), "--slo",
+                           COMMITTED_SLO, "--no-write"]) == 0
+
+
+def test_study_rules_each_violation_kind(tmp_path):
+    cases = {
+        "runaway": (dict(rounds=5, max_rounds=3),
+                    "study_rounds_ceiling"),
+        "unconverged": (dict(verdict="unconverged"),
+                        "study_unconverged_max"),
+    }
+    for label, (spec, rule) in cases.items():
+        directory = tmp_path / label
+        _write_study_stream(directory, **spec)
+        report = check_run(str(directory), COMMITTED_SLO, write=False)
+        violated = [r["rule"] for r in report["rules"]
+                    if r["status"] == "violated"]
+        assert violated == [rule], (label, violated)
+        assert telemetry_main(["check", str(directory), "--slo",
+                               COMMITTED_SLO, "--no-write"]) == 1
+
+
+def test_study_rules_skip_non_study_streams():
+    report = check_run(FIXTURE_RUN, COMMITTED_SLO, write=False)
+    by_rule = {r["rule"]: r for r in report["rules"]}
+    for rule in ("study_rounds_ceiling", "study_unconverged_max"):
+        assert by_rule[rule]["status"] == "skipped", rule
+
+
+def test_study_runaway_pages_via_subprocess(tmp_path):
+    """The page-severity runaway-rounds breach exits 1 through the real
+    CLI against the committed SLO.json."""
+    _write_study_stream(tmp_path / "run", rounds=5, max_rounds=3)
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.run(
+        [sys.executable, "-m", "dib_tpu", "telemetry", "check",
+         str(tmp_path / "run"), "--slo", COMMITTED_SLO, "--no-write"],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert proc.returncode == 1, proc.stderr
+    report = json.loads(proc.stdout)
+    violated = [r["rule"] for r in report["rules"]
+                if r["status"] == "violated"]
+    assert violated == ["study_rounds_ceiling"]
+
+
+def test_committed_study_record_passes_committed_slo():
+    """STUDY_CPU.json is a valid `telemetry check` operand (the bench
+    one-liner path) and holds the study budgets — in-process and via
+    the real CLI."""
+    record_path = os.path.join(REPO, "STUDY_CPU.json")
+    report = check_run(record_path, COMMITTED_SLO, write=False)
+    assert report["violations"] == 0
+    by_rule = {r["rule"]: r for r in report["rules"]}
+    assert by_rule["study_rounds_ceiling"]["status"] == "ok"
+    assert by_rule["study_unconverged_max"]["status"] == "ok"
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.run(
+        [sys.executable, "-m", "dib_tpu", "telemetry", "check",
+         record_path, "--slo", COMMITTED_SLO],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
