@@ -63,6 +63,9 @@ _HELPER_PARAM_ALIASES = {
 }
 
 _DOC_KIND_RE = re.compile(r"\*\*`([a-z_]+)`\*\*")
+#: The docs table header that opens the envelope-field table (the rows
+#: from here to the first non-`|` line are the documented envelope).
+_ENVELOPE_MARKER = "| field | meaning |"
 #: The docs line that opens the serving-rollup key list (the list itself
 #: is the backticked names from here to the next blank line).
 _SERVING_KEYS_MARKER = "Serving-rollup keys"
@@ -251,6 +254,82 @@ class EventSchemaPass(LintPass):
                                     and isinstance(k.value, str))
         return keys
 
+    @staticmethod
+    def envelope_fields(root: str) -> set[str] | None:
+        """ENVELOPE_FIELDS as telemetry/events.py declares it, read from
+        the AST (None when the module or the tuple cannot be found)."""
+        path = os.path.join(root, "dib_tpu", "telemetry", "events.py")
+        try:
+            with open(path, encoding="utf-8") as f:
+                tree = ast.parse(f.read())
+        except (OSError, SyntaxError):
+            return None
+        for node in tree.body:
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == "ENVELOPE_FIELDS"):
+                continue
+            if isinstance(node.value, (ast.Tuple, ast.List)):
+                return {elt.value for elt in node.value.elts
+                        if isinstance(elt, ast.Constant)
+                        and isinstance(elt.value, str)}
+        return None
+
+    def _check_envelope_docs(self, root: str,
+                             lines: list[str]) -> list[Finding]:
+        """The envelope table in docs/observability.md must name exactly
+        events.py's ENVELOPE_FIELDS (ISSUE 16 — the `ctx` trace envelope
+        joined the wire format; the next envelope field cannot ship
+        undocumented, and a documented field the writer dropped is
+        drift)."""
+        doc_rel = "docs/observability.md"
+        events_rel = "dib_tpu/telemetry/events.py"
+        declared = self.envelope_fields(root)
+        if declared is None:
+            if os.path.exists(os.path.join(root, events_rel)):
+                return [Finding(
+                    self.id, events_rel, 1,
+                    "ENVELOPE_FIELDS not found as a top-level tuple in "
+                    "telemetry/events.py — the envelope-table docs guard "
+                    "has lost its anchor")]
+            return []
+        marker_line = None
+        documented: dict[str, int] = {}
+        for lineno, line in enumerate(lines, 1):
+            if marker_line is None:
+                if line.strip().startswith(_ENVELOPE_MARKER):
+                    marker_line = lineno
+                continue
+            stripped = line.strip()
+            if not stripped.startswith("|"):
+                break
+            cells = stripped.split("|")
+            if len(cells) > 1:
+                # first column only — `t` / `mono` share a row; prose in
+                # the meaning column may backtick anything
+                for field in _BACKTICKED_RE.findall(cells[1]):
+                    documented.setdefault(field, lineno)
+        if marker_line is None:
+            return [Finding(
+                self.id, doc_rel, 1,
+                "docs/observability.md has no envelope-field table "
+                f"({_ENVELOPE_MARKER!r}) — the wire envelope must stay "
+                "documented")]
+        findings: list[Finding] = []
+        for field in sorted(declared - set(documented)):
+            findings.append(Finding(
+                self.id, doc_rel, marker_line,
+                f"envelope field {field!r} is in telemetry/events.py "
+                "ENVELOPE_FIELDS but missing from the envelope table"))
+        for field, lineno in sorted(documented.items()):
+            if field not in declared and field != "---":
+                findings.append(Finding(
+                    self.id, doc_rel, lineno,
+                    f"documented envelope field {field!r} is not in "
+                    "telemetry/events.py ENVELOPE_FIELDS — the code is "
+                    "the source of truth"))
+        return findings
+
     def _check_rollup_docs(self, root: str, lines: list[str],
                            fn_name: str, marker: str) -> list[Finding]:
         """A rollup's key list in docs/observability.md must name exactly
@@ -345,6 +424,7 @@ class EventSchemaPass(LintPass):
                     f"documented record type {kind!r} has no EVENT_SCHEMA "
                     "row — the registry is the source of truth",
                 ))
+        findings.extend(self._check_envelope_docs(root, lines))
         for fn_name, marker in _ROLLUP_DOC_CHECKS:
             findings.extend(self._check_rollup_docs(root, lines,
                                                     fn_name, marker))

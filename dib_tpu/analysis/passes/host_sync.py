@@ -103,6 +103,13 @@ class HostSyncPass(LintPass):
         # arrays, never on an implicit fetch that would serialize the
         # round it is trying to steer
         "dib_tpu/study/controller.py",
+        # the fleet aggregator joined with ISSUE 16: `fleet tail` follows
+        # MANY runs' planes from one poll loop — an implicit device fetch
+        # (e.g. coercing a metrics payload that arrived as a jitted
+        # result in-process) would stall the merge for every source at
+        # once, exactly the cross-run serialization the sched pool entry
+        # guards against
+        "dib_tpu/telemetry/fleet.py",
     )
 
     def check_module(self, module: Module) -> list[Finding]:

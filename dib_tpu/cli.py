@@ -1038,9 +1038,12 @@ def serve_main(argv: Sequence[str]) -> int:
     if args.prefork > 0:
         # prefork supervisor: N worker re-execs of this same command on
         # one SO_REUSEPORT-shared port (serve/prefork.py) — no jax import
-        # in the parent
+        # in the parent. Pin the fleet's causal lineage in the env so
+        # every worker's event stream carries the same trace_id.
         from dib_tpu.serve.prefork import supervise_prefork
+        from dib_tpu.telemetry.context import ensure_context
 
+        ensure_context("serve").activate()
         return supervise_prefork(
             list(argv), prefork=args.prefork, host=args.host,
             port=args.port, outdir=args.outdir,
@@ -1223,12 +1226,18 @@ def _watchdog_main(args, argv: Sequence[str]) -> int:
     # run — supervisor mitigations plus every worker relaunch — ONE run,
     # so --run-id scoping keeps the mitigation gate in view.
     from dib_tpu.telemetry import open_writer, shared_run_id
+    from dib_tpu.telemetry.context import ensure_context
 
     run_id = shared_run_id()
     os.environ["DIB_TELEMETRY_RUN_ID"] = run_id
+    # same idiom for the causal lineage: worker relaunches inherit the
+    # supervisor's trace_id from the env (docs/observability.md
+    # "Fleet causality")
+    ctx = ensure_context("train")
+    ctx.activate()
     telemetry = open_writer(args.telemetry_dir, args.artifact_outdir,
                             run_id=run_id, process_index=0,
-                            tags={"src": "supervisor"})
+                            tags={"src": "supervisor"}, ctx=ctx)
     result = supervise_self(
         [sys.executable, "-m", "dib_tpu.cli"], argv,
         outdir=args.artifact_outdir,

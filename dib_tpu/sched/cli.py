@@ -65,6 +65,12 @@ def build_sched_parser() -> argparse.ArgumentParser:
                        metavar="FIELD=VALUE",
                        help="Unit training-spec override (repeatable), "
                             "e.g. --set num_annealing_epochs=6")
+    p_sub.add_argument("--trace-id", "--trace_id", dest="trace_id",
+                       default=None,
+                       help="Cross-plane trace id the job/unit journal "
+                            "records carry (docs/observability.md 'Fleet "
+                            "causality'; default: inherit DIB_TRACE_ID "
+                            "or mint a fresh one).")
 
     p_stat = sub.add_parser(
         "status", help="Replay the journal into a queue snapshot.")
@@ -137,12 +143,14 @@ def _parse_spec_sets(pairs: Sequence[str]) -> dict:
 
 def _submit_main(args) -> int:
     from dib_tpu.sched.scheduler import JobSpec, Scheduler
+    from dib_tpu.telemetry.context import ensure_context
 
     betas = _resolve_betas(args)
     spec = JobSpec(betas=tuple(betas), seeds=tuple(args.seeds),
                    train=_parse_spec_sets(args.set),
                    retry_budget=args.retry_budget, name=args.name)
-    scheduler = Scheduler(args.sched_dir)
+    ctx = ensure_context("sched", trace_id=args.trace_id)
+    scheduler = Scheduler(args.sched_dir, ctx=ctx)
     try:
         job_id = scheduler.submit(spec)
         counts = scheduler.status()["counts"]
@@ -150,7 +158,7 @@ def _submit_main(args) -> int:
         scheduler.close()
     print(json.dumps({"job_id": job_id, "units": len(betas) * len(args.seeds),
                       "betas": betas, "seeds": list(args.seeds),
-                      "queue": counts}))
+                      "queue": counts, "trace_id": ctx.trace_id}))
     return 0
 
 
@@ -193,13 +201,19 @@ def _run_pool_supervised(args, argv: Sequence[str]) -> int:
     journal-shaped twin)."""
     from dib_tpu.sched.journal import JOURNAL_FILENAME
     from dib_tpu.telemetry import open_writer, shared_run_id
+    from dib_tpu.telemetry.context import ensure_context
     from dib_tpu.train.watchdog import WatchdogConfig, supervise_pool
 
     run_id = shared_run_id()
     os.environ["DIB_TELEMETRY_RUN_ID"] = run_id
+    # pin the pool's causal lineage next to the run id: the re-exec'd
+    # worker processes (and any watchdog relaunches) inherit the same
+    # trace_id from the env instead of minting fresh roots
+    ctx = ensure_context("sched_pool")
+    ctx.activate()
     telemetry = open_writer(args.telemetry_dir, args.sched_dir,
                             run_id=run_id, process_index=0,
-                            tags={"src": "supervisor"})
+                            tags={"src": "supervisor"}, ctx=ctx)
     # remove only the FIRST token that spells the flag — argparse
     # accepts unambiguous prefixes (--watch, --watchd, ...), so exact
     # .remove("--watchdog") would crash on an abbreviated spelling; and

@@ -167,11 +167,19 @@ class Scheduler:
 
     def __init__(self, directory: str, telemetry=None,
                  lease_s: float = 60.0, backoff_base_s: float = 0.5,
-                 clock=time.time):
+                 clock=time.time, ctx=None):
+        from dib_tpu.telemetry.context import from_env
+
         self.directory = directory
         self.lease_s = float(lease_s)
         self.backoff_base_s = float(backoff_base_s)
         self._telemetry = telemetry
+        # the cross-plane trace context submissions are journaled under
+        # (telemetry/context.py): the caller's lineage (a study round, a
+        # CLI --trace-id) or whatever a parent process pinned via env —
+        # job records carry it verbatim, unit records carry a child ctx
+        # whose parent is the sched:job:<job_id> ref
+        self._ctx = ctx if ctx is not None else from_env()
         self._clock = clock
         self._lock = threading.RLock()
         self._jobs: dict[str, dict] = {}
@@ -285,15 +293,22 @@ class Scheduler:
         Returns the job id."""
         with self._lock:
             job_id = f"job-{len(self._jobs):04d}-{uuid.uuid4().hex[:6]}"
+            job_extra = ({"ctx": self._ctx.to_dict()}
+                         if self._ctx is not None else {})
             self._fold(self._journal.append(
-                "job", job_id=job_id, spec=spec.to_dict()))
+                "job", job_id=job_id, spec=spec.to_dict(), **job_extra))
+            unit_ctx = (self._ctx.child(f"sched:job:{job_id}",
+                                        origin="sched")
+                        if self._ctx is not None else None)
+            unit_extra = ({"ctx": unit_ctx.to_dict()}
+                          if unit_ctx is not None else {})
             for i, beta in enumerate(spec.betas):
                 for seed in spec.seeds:
                     unit_id = f"{job_id}/u{i:03d}s{seed}"
                     self._fold(self._journal.append(
                         "unit", unit_id=unit_id, job_id=job_id,
                         beta=float(beta), seed=int(seed),
-                        train=dict(spec.train)))
+                        train=dict(spec.train), **unit_extra))
             if self._telemetry is not None:
                 self._telemetry.job(
                     job_id=job_id, action="submitted",

@@ -43,6 +43,15 @@ def _add_watchdog(parser, what: str) -> None:
                         dest="max_restarts")
 
 
+def _add_trace_id(parser) -> None:
+    parser.add_argument("--trace-id", "--trace_id", dest="trace_id",
+                        default=None,
+                        help="Cross-plane trace id this run's records "
+                             "carry (docs/observability.md 'Fleet "
+                             "causality'; default: inherit DIB_TRACE_ID "
+                             "or mint a fresh one).")
+
+
 def build_stream_parser() -> argparse.ArgumentParser:
     from dib_tpu.cli import _add_model_flags, _add_telemetry_dir_flag
 
@@ -108,6 +117,7 @@ def build_stream_parser() -> argparse.ArgumentParser:
                             "exits with the preemption code (75). "
                             "0 disables.")
     _add_watchdog(p_run, "trainer")
+    _add_trace_id(p_run)
     _add_telemetry_dir_flag(p_run, "--stream-dir")
 
     p_dep = sub.add_parser(
@@ -154,6 +164,7 @@ def build_stream_parser() -> argparse.ArgumentParser:
                        help="Shared AOT-executable LRU capacity "
                             "(0 = eager per-engine compilation).")
     _add_watchdog(p_dep, "deployer")
+    _add_trace_id(p_dep)
     _add_telemetry_dir_flag(p_dep, "--deploy-dir")
 
     p_stat = sub.add_parser(
@@ -175,13 +186,18 @@ def _supervised(args, argv: Sequence[str], journal_file: str,
     publish/deploy journal makes a relaunch resume exactly, so progress
     is journal records of the terminal kind (the sched run-pool idiom)."""
     from dib_tpu.telemetry import open_writer, shared_run_id
+    from dib_tpu.telemetry.context import ensure_context
     from dib_tpu.train.watchdog import WatchdogConfig, supervise_pool
 
     run_id = shared_run_id()
     os.environ["DIB_TELEMETRY_RUN_ID"] = run_id
+    # pin the causal lineage next to the run id so watchdog relaunches
+    # of the worker process inherit the same trace_id
+    ctx = ensure_context("stream", trace_id=getattr(args, "trace_id", None))
+    ctx.activate()
     telemetry = open_writer(args.telemetry_dir, run_dir,
                             run_id=run_id, process_index=0,
-                            tags={"src": "supervisor"})
+                            tags={"src": "supervisor"}, ctx=ctx)
     # strip only the FIRST token spelling the flag — argparse accepts
     # unambiguous prefixes, and option values can never start with "--"
     # (the sched run-pool idiom, regression-tested there)
@@ -257,9 +273,13 @@ def _run_main(args, argv: Sequence[str]) -> int:
         keep_publishes=args.keep_publishes,
     )
     os.makedirs(args.stream_dir, exist_ok=True)
+    from dib_tpu.telemetry.context import ensure_context
+
+    ctx = ensure_context("stream", trace_id=args.trace_id)
+    ctx.activate()
     telemetry = open_writer(args.telemetry_dir, args.stream_dir,
                             run_id=shared_run_id(),
-                            process_index=jax.process_index())
+                            process_index=jax.process_index(), ctx=ctx)
     if telemetry is not None:
         telemetry.run_start(runtime_manifest(config=config, extra={
             "mode": "stream_run", "dataset": args.dataset,
@@ -359,9 +379,13 @@ def _deploy_main(args, argv: Sequence[str]) -> int:
     trainer = DIBTrainer(model, bundle, config, y_encoder=y_encoder)
 
     os.makedirs(args.deploy_dir, exist_ok=True)
+    from dib_tpu.telemetry.context import ensure_context
+
+    ctx = ensure_context("deploy", trace_id=args.trace_id)
+    ctx.activate()
     telemetry = open_writer(args.telemetry_dir, args.deploy_dir,
                             run_id=shared_run_id(),
-                            process_index=jax.process_index())
+                            process_index=jax.process_index(), ctx=ctx)
     registry = MetricsRegistry()
     tracer = Tracer(telemetry)
     if telemetry is not None:

@@ -96,6 +96,12 @@ def _add_config_flags(parser) -> None:
                         help="Follow a LIVE --watch stream up to this "
                              "long before falling back to what it "
                              "yielded (default 0: one poll).")
+    parser.add_argument("--trace-id", "--trace_id", dest="trace_id",
+                        default=None,
+                        help="Cross-plane trace id this study's records "
+                             "carry (docs/observability.md 'Fleet "
+                             "causality'; default: inherit DIB_TRACE_ID "
+                             "or mint a fresh one).")
 
 
 def build_study_parser() -> argparse.ArgumentParser:
@@ -189,14 +195,17 @@ def _config_from_args(args) -> "StudyConfig | None":
 
 def _submit_main(args) -> int:
     from dib_tpu.study.controller import StudyController
+    from dib_tpu.telemetry.context import ensure_context
 
+    ctx = ensure_context("study", trace_id=args.trace_id)
     controller = StudyController(args.study_dir,
-                                 config=_config_from_args(args))
+                                 config=_config_from_args(args), ctx=ctx)
     state = controller.ensure_config()
     print(json.dumps({"study_dir": os.path.abspath(args.study_dir),
                       "config": state["config"],
                       "rounds": len(state["rounds"]),
-                      "verdict": state["verdict"]}))
+                      "verdict": state["verdict"],
+                      "trace_id": ctx.trace_id}))
     return 0
 
 
@@ -208,9 +217,17 @@ def _run_main(args) -> int:
         shared_run_id,
     )
 
+    from dib_tpu.telemetry.context import ensure_context
+
     os.makedirs(args.study_dir, exist_ok=True)
+    # mint/inherit the study's causal lineage and pin it in the env (the
+    # DIB_TELEMETRY_RUN_ID idiom) so any process this run spawns — pool
+    # workers, watchdog relaunches — carries the same trace_id
+    ctx = ensure_context("study", trace_id=args.trace_id)
+    ctx.activate()
     telemetry = open_writer(args.telemetry_dir, args.study_dir,
-                            run_id=shared_run_id(), process_index=0)
+                            run_id=shared_run_id(), process_index=0,
+                            ctx=ctx)
     if telemetry is not None:
         telemetry.run_start(runtime_manifest(device_info=False, extra={
             "mode": "study",
@@ -219,7 +236,7 @@ def _run_main(args) -> int:
         }))
     controller = StudyController(args.study_dir,
                                  config=_config_from_args(args),
-                                 telemetry=telemetry)
+                                 telemetry=telemetry, ctx=ctx)
     try:
         state = controller.run(workers=args.workers)
     except BaseException:

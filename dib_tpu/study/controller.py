@@ -363,7 +363,9 @@ class StudyController:
 
     def __init__(self, directory: str, config: StudyConfig | None = None,
                  telemetry=None, lease_s: float = 120.0,
-                 study_id: str | None = None):
+                 study_id: str | None = None, ctx=None):
+        from dib_tpu.telemetry.context import from_env
+
         self.directory = directory
         self.config = config
         self.lease_s = float(lease_s)
@@ -374,7 +376,20 @@ class StudyController:
         # across rounds so outcomes are never re-counted
         self.study_id = study_id or os.path.basename(
             os.path.normpath(directory)) or "study"
+        # the cross-plane trace context (telemetry/context.py): study
+        # journal records carry it, and the scheduler is handed a child
+        # ctx parented on this study so every sched job/unit is reachable
+        # from the study's trace_id in the fleet timeline
+        self.ctx = ctx if ctx is not None else from_env()
         os.makedirs(directory, exist_ok=True)
+
+    def _journal_ctx(self) -> dict:
+        """Extra ``ctx`` field for study-journal appends (empty when
+        untraced — tracing never changes the journal shape otherwise)."""
+        if self.ctx is None:
+            return {}
+        return {"ctx": self.ctx.child(f"study:{self.study_id}",
+                                      origin="study").to_dict()}
 
     # ----------------------------------------------------------- replay
     def replay(self) -> dict:
@@ -400,7 +415,8 @@ class StudyController:
             if self.config is None:
                 self.config = StudyConfig()
             with StudyJournal(self.directory) as journal:
-                journal.append("config", spec=self.config.to_dict())
+                journal.append("config", spec=self.config.to_dict(),
+                               **self._journal_ctx())
             state = self.replay()
         return state
 
@@ -455,8 +471,11 @@ class StudyController:
                            "scheduler journal"
                            if "job_id" not in pending[0]
                            else "mid-drain")))
-        scheduler = Scheduler(self.directory, telemetry=self._telemetry,
-                              lease_s=self.lease_s)
+        scheduler = Scheduler(
+            self.directory, telemetry=self._telemetry,
+            lease_s=self.lease_s,
+            ctx=(self.ctx.child(f"study:{self.study_id}", origin="study")
+                 if self.ctx is not None else None))
         journal = StudyJournal(self.directory)
         rounds_run = 0
         try:
@@ -468,7 +487,8 @@ class StudyController:
                 else:
                     decision = self._decide(state)
                     if "verdict" in decision:
-                        journal.append("verdict", **decision)
+                        journal.append("verdict", **decision,
+                                       **self._journal_ctx())
                         # the terminal action IS the verdict string:
                         # converged / unconverged / no_transitions
                         self._emit_study(
@@ -480,7 +500,8 @@ class StudyController:
                             budget_max=config.max_units,
                             max_rounds=config.max_rounds)
                         break
-                    journal.append("round", **decision)
+                    journal.append("round", **decision,
+                                   **self._journal_ctx())
                     self._maybe_fault("intent", decision["round"])
                     state = self.replay()
                     current = [r for r in state["rounds"]
@@ -679,7 +700,8 @@ class StudyController:
             )
             job_id = scheduler.submit(spec)
             self._maybe_fault("submit", current["round"])
-        journal.append("submitted", round=current["round"], job_id=job_id)
+        journal.append("submitted", round=current["round"], job_id=job_id,
+                       **self._journal_ctx())
         self._emit_study("submit", round=current["round"], job_id=job_id,
                          betas=current["betas"], seeds=current["seeds"],
                          units=current["units"],
@@ -770,6 +792,7 @@ class StudyController:
         band = ensemble_band_nats(points, brackets)
         journal.append(
             "round_done", round=current["round"],
+            **self._journal_ctx(),
             estimates={str(c): round(v, 8) for c, v in estimates.items()},
             brackets={str(c): [round(lo, 8), round(hi, 8)]
                       for c, (lo, hi) in brackets.items()},

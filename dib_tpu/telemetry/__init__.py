@@ -30,6 +30,12 @@ See ``docs/observability.md``. The pieces:
     under a runs root (``telemetry runs list|show|trajectory``).
 """
 
+from dib_tpu.telemetry.context import (
+    TraceContext,
+    child_context,
+    ensure_context,
+    mint,
+)
 from dib_tpu.telemetry.events import (
     EVENTS_FILENAME,
     SCHEMA_VERSION,
@@ -105,15 +111,18 @@ __all__ = [
     "SLOEngine",
     "SpannedHook",
     "StreamFollower",
+    "TraceContext",
     "Tracer",
     "TransitionTracker",
     "check_run",
+    "child_context",
     "compare",
     "detect_transitions",
     "evaluate_rules",
     "heartbeat_interval_s",
     "liveness",
     "load_slo",
+    "mint",
     "register_run",
     "render_dashboard",
     "resolve_runs_root",
@@ -121,6 +130,7 @@ __all__ = [
     "config_fingerprint",
     "current_tracer",
     "device_memory_stats",
+    "ensure_context",
     "faults_rollup",
     "finalize_crashed",
     "finalize_open_writers",

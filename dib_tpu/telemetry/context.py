@@ -1,0 +1,170 @@
+"""Cross-plane trace context: WHO caused WHAT, fleet-wide.
+
+Every control plane (study controller, β-grid scheduler, unit runs,
+streaming trainer, deployer, serving zoo) writes its own durable file —
+events.jsonl, journal.jsonl, study.jsonl, publishes.jsonl. Before this
+module those files shared no identity: joining "which drift event caused
+this study, which units did round 2 submit, and which publish did the
+result gate?" meant hand-matching five files by wall clock. A
+:class:`TraceContext` is the shared identity: a ``trace_id`` minted once
+at the causal ROOT (a study submit, a sched job, a stream drift, a
+deploy publish), a ``parent`` ref naming the record that caused this one
+(``plane:record_ref`` grammar, below), and the human-readable ``origin``
+chain of entry points the context passed through.
+
+The context rides as the ``ctx`` ENVELOPE field on every telemetry
+event (:class:`~dib_tpu.telemetry.events.EventWriter` stamps it, like
+``tags``) and as a ``ctx`` field on sched/study journal records — so the
+fleet aggregator (``telemetry/fleet.py``) can reconstruct the whole
+study→units→publish DAG from the files alone.
+
+Parent-ref grammar (``plane:record_ref``)::
+
+    study:<study_id>          the study plane's root record
+    sched:job:<job_id>        a scheduler job record
+    sched:unit:<unit_id>      one (β, seed) work unit
+    run:<run_id>              a telemetry run (its run_start)
+    publish:<publish_id>      a streaming publish record
+    drift:<round>             a drift detection on a stream
+
+Cross-process inheritance mirrors the ``DIB_TELEMETRY_RUN_ID`` pinning
+idiom: :meth:`TraceContext.activate` exports ``DIB_TRACE_ID`` /
+``DIB_TRACE_PARENT`` / ``DIB_TRACE_ORIGIN`` so run-pool workers, prefork
+serve workers, and watchdog relaunches inherit the lineage of whatever
+spawned them; :func:`from_env` reads it back on the far side.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import uuid
+
+__all__ = [
+    "TRACE_ENV",
+    "TRACE_ORIGIN_ENV",
+    "TRACE_PARENT_ENV",
+    "TraceContext",
+    "child_context",
+    "ensure_context",
+    "from_env",
+    "mint",
+    "parse_parent_ref",
+]
+
+#: The env-inheritance triple (the ``DIB_TELEMETRY_RUN_ID`` idiom):
+#: a supervisor/parent pins these, spawned workers inherit the lineage.
+TRACE_ENV = "DIB_TRACE_ID"
+TRACE_PARENT_ENV = "DIB_TRACE_PARENT"
+TRACE_ORIGIN_ENV = "DIB_TRACE_ORIGIN"
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceContext:
+    """One causal lineage: ``trace_id`` names the root cause (shared by
+    every record the cause transitively produced), ``parent`` names the
+    immediate causing record (``plane:record_ref``; None at the root),
+    and ``origin`` is the ordered chain of entry points the context has
+    passed through (e.g. ``("study", "sched", "unit")``)."""
+
+    trace_id: str
+    parent: str | None = None
+    origin: tuple[str, ...] = ()
+
+    def to_dict(self) -> dict:
+        out: dict = {"trace_id": self.trace_id}
+        if self.parent:
+            out["parent"] = self.parent
+        if self.origin:
+            out["origin"] = list(self.origin)
+        return out
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TraceContext | None":
+        if not isinstance(d, dict) or not d.get("trace_id"):
+            return None
+        origin = d.get("origin") or ()
+        if not isinstance(origin, (list, tuple)):
+            origin = ()
+        return cls(trace_id=str(d["trace_id"]),
+                   parent=str(d["parent"]) if d.get("parent") else None,
+                   origin=tuple(str(o) for o in origin))
+
+    def child(self, parent_ref: str, origin: str | None = None
+              ) -> "TraceContext":
+        """The context a record CAUSED BY ``parent_ref`` carries: same
+        trace_id (one causal tree, one id), new parent edge, origin chain
+        extended when this is a new entry point."""
+        chain = self.origin
+        if origin and (not chain or chain[-1] != origin):
+            chain = chain + (origin,)
+        return TraceContext(self.trace_id, parent=parent_ref, origin=chain)
+
+    def activate(self, environ=None) -> None:
+        """Export to the environment so spawned processes inherit this
+        lineage (the ``DIB_TELEMETRY_RUN_ID`` pinning idiom — run-pool
+        workers, prefork serve workers, watchdog relaunches)."""
+        env = os.environ if environ is None else environ
+        env[TRACE_ENV] = self.trace_id
+        if self.parent:
+            env[TRACE_PARENT_ENV] = self.parent
+        else:
+            env.pop(TRACE_PARENT_ENV, None)
+        if self.origin:
+            env[TRACE_ORIGIN_ENV] = ",".join(self.origin)
+        else:
+            env.pop(TRACE_ORIGIN_ENV, None)
+
+
+def mint(origin: str, trace_id: str | None = None,
+         parent: str | None = None) -> TraceContext:
+    """A fresh context at a causal root (an entry point with no inherited
+    lineage). ``trace_id`` overrides the generated id — the CLI
+    ``--trace-id`` flag lands here so an external orchestrator can name
+    the trace it is about to follow."""
+    return TraceContext(trace_id or ("trace-" + uuid.uuid4().hex[:12]),
+                        parent=parent, origin=(origin,))
+
+
+def from_env(environ=None) -> TraceContext | None:
+    """The lineage a parent process pinned (None when unpinned)."""
+    env = os.environ if environ is None else environ
+    trace_id = env.get(TRACE_ENV)
+    if not trace_id:
+        return None
+    origin = tuple(o for o in (env.get(TRACE_ORIGIN_ENV) or "").split(",")
+                   if o)
+    return TraceContext(trace_id, parent=env.get(TRACE_PARENT_ENV) or None,
+                        origin=origin)
+
+
+def ensure_context(origin: str, trace_id: str | None = None
+                   ) -> TraceContext:
+    """The entry-point idiom: an explicit ``--trace-id`` wins, then an
+    env-inherited lineage (extended with this entry point's origin), else
+    a freshly minted root."""
+    inherited = from_env()
+    if trace_id and (inherited is None or inherited.trace_id != trace_id):
+        return mint(origin, trace_id=trace_id)
+    if inherited is None:
+        return mint(origin)
+    if inherited.origin and inherited.origin[-1] == origin:
+        return inherited
+    return dataclasses.replace(inherited,
+                               origin=inherited.origin + (origin,))
+
+
+def child_context(ctx: "TraceContext | None", parent_ref: str,
+                  origin: str | None = None) -> TraceContext | None:
+    """``ctx.child(...)`` that tolerates an absent context (tracing is
+    always optional — an untraced caller stays untraced)."""
+    if ctx is None:
+        return None
+    return ctx.child(parent_ref, origin=origin)
+
+
+def parse_parent_ref(ref: str) -> tuple[str, str]:
+    """Split ``plane:record_ref`` into its plane and record ref (the
+    record ref may itself contain colons — ``sched:unit:<job>/u0s0``)."""
+    plane, _, rest = ref.partition(":")
+    return plane, rest

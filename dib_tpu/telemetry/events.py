@@ -90,8 +90,13 @@ class EventKindSpec:
 
 
 #: Envelope fields every record carries (written by :meth:`EventWriter.emit`
-#: itself, never passed by callers).
-ENVELOPE_FIELDS = ("v", "run", "proc", "seq", "t", "mono", "type", "tags")
+#: itself, never passed by callers). ``ctx`` is the cross-plane trace
+#: context (``telemetry/context.py``): ``trace_id`` / ``parent`` /
+#: ``origin``, stamped on every record of a traced writer so the fleet
+#: aggregator can join planes causally (docs/observability.md "Fleet
+#: causality").
+ENVELOPE_FIELDS = ("v", "run", "proc", "seq", "t", "mono", "type", "tags",
+                   "ctx")
 
 #: kind -> field vocabulary; one row per documented record type
 #: (docs/observability.md "Record types and their payloads").
@@ -178,8 +183,11 @@ EVENT_SCHEMA: dict[str, EventKindSpec] = {
     "alert": EventKindSpec(
         required=("rule",),
         optional=("metric", "value", "bound", "budget", "severity",
-                  "source", "when"),
-        doc="one durable SLO violation (telemetry/slo.py)"),
+                  "source", "when", "burn_fast", "burn_slow", "windows_s",
+                  "threshold", "reason"),
+        doc="one durable SLO violation (telemetry/slo.py); burn-rate "
+            "alerts carry the fast/slow window evidence "
+            "(telemetry/fleet.py)"),
     "transition": EventKindSpec(
         required=("channel", "epoch", "direction"),
         optional=("kl_before", "kl_after", "beta", "threshold_nats",
@@ -233,6 +241,16 @@ EVENT_SCHEMA: dict[str, EventKindSpec] = {
         doc="one detected input-distribution drift on the training "
             "stream (dib_tpu/stream): the normalized shift, the "
             "threshold it crossed, and the β response (reanneal/hold)"),
+    "link": EventKindSpec(
+        required=("target",),
+        optional=("relation", "plane", "source_ref", "detail"),
+        doc="one cross-plane causal edge (telemetry/context.py): this "
+            "stream's work was caused-by / gated-by / adopted-from the "
+            "record named by `target` (plane:record_ref grammar — e.g. "
+            "study:<id>, sched:unit:<unit_id>, publish:<publish_id>); "
+            "`relation` names the edge kind, `plane` the target's plane, "
+            "`source_ref` this side's own record ref — the explicit edges "
+            "the fleet aggregator joins beyond the ctx envelope"),
     "metrics": EventKindSpec(
         required=("snapshots",),
         doc="counter/gauge/histogram snapshots"),
@@ -491,10 +509,19 @@ class EventWriter:
         process_index: int | None = None,
         tags: dict | None = None,
         filename: str = EVENTS_FILENAME,
+        ctx=None,
     ):
         os.makedirs(directory, exist_ok=True)
         self.path = os.path.join(directory, filename)
         self.run_id = run_id or _new_run_id()
+        # the cross-plane trace context (telemetry/context.py): None means
+        # untraced; unset means "inherit whatever a parent process pinned"
+        # — the DIB_TELEMETRY_RUN_ID idiom, extended to lineage
+        if ctx is None:
+            from dib_tpu.telemetry.context import from_env
+
+            ctx = from_env()
+        self.ctx = ctx
         if process_index is None:
             process_index = 0
             if "jax" in sys.modules:
@@ -547,6 +574,8 @@ class EventWriter:
             }
             if self.tags:
                 record["tags"] = self.tags
+            if self.ctx is not None:
+                record["ctx"] = self.ctx.to_dict()
             record.update(data)
             self._seq += 1
             # allow_nan=False: a diverged run's loss=NaN must not write a
@@ -719,6 +748,13 @@ class EventWriter:
         """One detected training-stream drift (``dib_tpu/stream``)."""
         return self.emit("drift", round=int(round), detector=detector,
                          **fields)
+
+    def link(self, *, target: str, **fields) -> dict:
+        """One cross-plane causal edge (``telemetry/context.py``):
+        ``target`` names the record this stream's work was caused by /
+        gated by (``plane:record_ref`` grammar) — the explicit DAG edge
+        the fleet aggregator joins beyond the ``ctx`` envelope."""
+        return self.emit("link", target=target, **fields)
 
     def metrics(self, snapshots) -> dict:
         return self.emit("metrics", snapshots=snapshots)

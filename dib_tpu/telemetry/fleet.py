@@ -1,0 +1,815 @@
+"""Fleet-wide causal tracing: merge many planes into ONE timeline.
+
+``python -m dib_tpu telemetry fleet tail|summarize|report <roots...>``
+attaches to any number of run directories (or whole runs roots) and
+incrementally merges every plane's append-only stream it finds there —
+``events.jsonl`` (run plane), ``journal.jsonl`` (sched), ``study.jsonl``
+(study), ``publishes.jsonl`` (stream), ``deploys.jsonl`` (deploy) — into
+one causally-ordered fleet timeline:
+
+  - **Sources** are discovered by filename under each root and followed
+    with the same incremental torn-line-tolerant reader ``telemetry
+    tail`` uses (:class:`~dib_tpu.telemetry.live.StreamFollower`): a
+    final line still being appended is buffered, a torn line mid-file
+    is skipped and counted.
+  - **Ordering** is deterministic under clock skew: entries sort by
+    ``(t, source, n)`` where ``n`` is the per-source record index —
+    within one source, FILE ORDER is authoritative (two records a
+    skewed clock stamped identically never reorder), and across
+    sources ties break on the stable source id. The durable timeline
+    (``--out``) is append-only by ARRIVAL; the merged view is the
+    sorted projection, so the merge digest is independent of poll
+    batching — kill the aggregator mid-merge, re-attach, and the
+    merged timeline is bit-identical (``timeline_digest``).
+  - **Causality** comes from the ``ctx`` envelope
+    (``telemetry/context.py``): every record's ``ctx.parent`` names the
+    record that caused it (``study:<id>``, ``sched:job:<id>``, ...).
+    A parent no merged source defines is an **orphan** — surfaced
+    loudly in the summary (and a nonzero ``telemetry fleet summarize``
+    exit code), never dropped: an orphan means a plane is missing from
+    the merge or a producer broke the propagation contract.
+  - **Burn-rate SLOs** (``SLO.json`` ``burn_rates``,
+    ``telemetry/slo.py``): ``fleet tail --slo`` evaluates fast/slow
+    windowed error-budget burn over the merged view and lands durable
+    ``alert`` events on the originating run's OWN stream — the existing
+    ``check``/``compare`` gates see them with no new machinery.
+
+Resume contract (``--out``): the durable ``timeline.jsonl`` is itself
+the cursor. On re-attach the aggregator replays it, seals a torn final
+line, counts how many records of each source were already consumed, and
+skips exactly that many on the first polls — zero duplicate, zero lost
+entries, chaos-drilled by ``scripts/fleet_drill.py``.
+
+Everything here is host-side file analysis: this module never imports
+jax.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+import time
+
+from dib_tpu.telemetry.live import StreamFollower
+
+__all__ = ["FleetAggregator", "TIMELINE_FILENAME", "discover_sources",
+           "fleet_main", "fleet_prometheus", "merge_key", "render_fleet",
+           "timeline_digest", "write_fleet_report"]
+
+TIMELINE_FILENAME = "timeline.jsonl"
+
+# plane by filename: which append-only streams a root can contribute
+PLANE_BY_FILENAME = {
+    "events.jsonl": "run",
+    "journal.jsonl": "sched",
+    "study.jsonl": "study",
+    "publishes.jsonl": "stream",
+    "deploys.jsonl": "deploy",
+}
+
+
+# --------------------------------------------------------------- discovery
+def discover_sources(roots) -> list[dict]:
+    """Every known plane file under each root (recursive, deterministic
+    order): ``{"source", "plane", "path", "root"}`` rows. The source id
+    is ``<root-label>/<relative-path>`` with ``/`` separators — stable
+    across polls and across processes looking at the same tree, which
+    is what makes the merge order and the resume cursor portable."""
+    sources: list[dict] = []
+    labels: dict[str, str] = {}
+    for i, root in enumerate(roots):
+        root = os.path.normpath(root)
+        label = os.path.basename(root) or "root"
+        if label in labels and labels[label] != root:
+            label = f"{label}#{i}"
+        labels[label] = root
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if not d.startswith("."))
+            for name in sorted(filenames):
+                plane = PLANE_BY_FILENAME.get(name)
+                if plane is None:
+                    continue
+                path = os.path.join(dirpath, name)
+                rel = os.path.relpath(path, root).replace(os.sep, "/")
+                sources.append({
+                    "source": f"{label}/{rel}",
+                    "plane": plane,
+                    "path": path,
+                    "root": root,
+                })
+    return sources
+
+
+def merge_key(entry: dict):
+    """The deterministic fleet order: wall-clock first (the causal
+    approximation), then source id, then the per-source file index —
+    within one source file order is authoritative, so skewed clocks can
+    never reorder one writer against itself."""
+    return (float(entry.get("t") or 0.0), entry.get("source", ""),
+            int(entry.get("n") or 0))
+
+
+def timeline_digest(entries) -> str:
+    """SHA-256 over the canonically-serialized MERGED order — the
+    batching-independent identity of a fleet timeline (the chaos
+    drill's bit-identical invariant)."""
+    h = hashlib.sha256()
+    for entry in sorted(entries, key=merge_key):
+        h.update(json.dumps(entry, sort_keys=True,
+                            separators=(",", ":")).encode())
+        h.update(b"\n")
+    return h.hexdigest()
+
+
+def _read_jsonl(path: str) -> list[dict]:
+    """All parseable records of a JSONL file, file order, torn lines
+    skipped (the journal replay contract, locally — the sched package
+    must not become a telemetry dependency)."""
+    try:
+        with open(path, "rb") as f:
+            raw = f.read()
+    except OSError:
+        return []
+    out: list[dict] = []
+    for line in raw.split(b"\n"):
+        if not line.strip():
+            continue
+        try:
+            record = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(record, dict):
+            out.append(record)
+    return out
+
+
+# -------------------------------------------------------------- aggregator
+class FleetAggregator:
+    """Incremental multi-plane merge over any number of roots.
+
+    Thread-safe: ``poll()`` may run on a background thread while a
+    renderer reads ``merged()``/``summary()`` — every access to the
+    shared timeline goes through the instance lock (the EventWriter
+    discipline; dib-lint's thread-shared-state pass pins this).
+    """
+
+    def __init__(self, roots, out_dir: str | None = None):
+        self.roots = [os.path.normpath(r) for r in roots]
+        self._lock = threading.Lock()
+        self._followers: dict[str, StreamFollower] = {}
+        self._sources: dict[str, dict] = {}
+        self._consumed: dict[str, int] = {}
+        self._skip: dict[str, int] = {}
+        self._entries: list[dict] = []
+        self._fd = None
+        self.out_path = None
+        if out_dir:
+            os.makedirs(out_dir, exist_ok=True)
+            self.out_path = os.path.join(out_dir, TIMELINE_FILENAME)
+            self._resume()
+        self._discover()
+
+    # -- durable timeline -------------------------------------------------
+    def _resume(self) -> None:
+        """Replay the durable timeline into the in-memory view and derive
+        the per-source consumed counts — the resume cursor IS the output
+        file, so a SIGKILLed aggregator re-attaches with zero duplicate
+        and zero lost entries."""
+        for entry in _read_jsonl(self.out_path):
+            if not isinstance(entry.get("source"), str):
+                continue
+            self._entries.append(entry)
+            sid = entry["source"]
+            self._skip[sid] = self._skip.get(sid, 0) + 1
+            self._consumed[sid] = self._skip[sid]
+        self._fd = os.open(self.out_path,
+                           os.O_APPEND | os.O_CREAT | os.O_WRONLY, 0o644)
+        # seal a torn final line (aggregator killed mid-append): the torn
+        # bytes were not replayed above, so the entry re-appends whole
+        try:
+            size = os.fstat(self._fd).st_size
+            if size > 0:
+                with open(self.out_path, "rb") as f:
+                    f.seek(size - 1)
+                    if f.read(1) != b"\n":
+                        os.write(self._fd, b"\n")
+        except OSError:
+            pass
+
+    def _discover(self) -> None:
+        for src in discover_sources(self.roots):
+            sid = src["source"]
+            if sid in self._followers:
+                continue
+            self._followers[sid] = StreamFollower(src["path"])
+            self._sources[sid] = src
+            self._consumed.setdefault(sid, 0)
+            self._skip.setdefault(sid, 0)
+
+    # -- polling ----------------------------------------------------------
+    def poll(self) -> list[dict]:
+        """Consume whatever every source appended since the last call;
+        returns the NEW timeline entries (arrival order)."""
+        self._discover()
+        fresh: list[dict] = []
+        for sid in sorted(self._followers):
+            follower = self._followers[sid]
+            plane = self._sources[sid]["plane"]
+            for record in follower.poll():
+                with self._lock:
+                    if self._skip[sid] > 0:
+                        # already durable from a previous attach — the
+                        # replay set _consumed past this prefix, so the
+                        # numbering must NOT advance here or every later
+                        # entry's n (and the merged order) would shift
+                        self._skip[sid] -= 1
+                        continue
+                    n = self._consumed[sid]
+                    self._consumed[sid] = n + 1
+                    entry = {"source": sid, "plane": plane, "n": n,
+                             "t": record.get("t"), "record": record}
+                    self._entries.append(entry)
+                if self._fd is not None:
+                    # allow_nan stays on: a source record that smuggled a
+                    # NaN through json.loads must not crash the merge
+                    line = json.dumps(entry) + "\n"
+                    # one write per line on an O_APPEND fd: a kill tears
+                    # at most the final line (the journal contract)
+                    os.write(self._fd, line.encode())
+                fresh.append(entry)
+        return fresh
+
+    @property
+    def torn(self) -> int:
+        return sum(f.torn for f in self._followers.values())
+
+    def merged(self) -> list[dict]:
+        """The full timeline in deterministic fleet order."""
+        with self._lock:
+            snapshot = list(self._entries)
+        return sorted(snapshot, key=merge_key)
+
+    def entries(self) -> list[dict]:
+        with self._lock:
+            return list(self._entries)
+
+    # -- causality --------------------------------------------------------
+    def _defined_refs(self, entries) -> set[str]:
+        """Every ``plane:record_ref`` some merged source DEFINES — the
+        resolution set orphan detection checks ``ctx.parent`` against."""
+        defined: set[str] = set()
+        for entry in entries:
+            record = entry.get("record") or {}
+            plane = entry.get("plane")
+            if plane == "run" and record.get("run"):
+                defined.add(f"run:{record['run']}")
+            kind = record.get("kind")
+            if plane == "sched":
+                if kind == "job" and record.get("job_id"):
+                    defined.add(f"sched:job:{record['job_id']}")
+                elif kind == "unit" and record.get("unit_id"):
+                    defined.add(f"sched:unit:{record['unit_id']}")
+            elif plane == "stream":
+                if kind == "publish" and record.get("publish_id"):
+                    defined.add(f"publish:{record['publish_id']}")
+                elif kind == "drift" and record.get("round") is not None:
+                    defined.add(f"drift:{record['round']}")
+            elif plane == "study":
+                # the study directory IS the study id (controller
+                # contract: study_id = basename of the study dir)
+                sid = entry.get("source", "")
+                parts = sid.split("/")
+                if len(parts) >= 2:
+                    defined.add(f"study:{parts[-2]}")
+            if record.get("study_id"):
+                defined.add(f"study:{record['study_id']}")
+        return defined
+
+    def analyze(self) -> dict:
+        """Causal analysis of the merged timeline: per-trace rollups and
+        the orphan list (records whose ``ctx.parent`` resolves to no
+        record any merged source contains)."""
+        entries = self.merged()
+        defined = self._defined_refs(entries)
+        orphans: list[dict] = []
+        traces: dict[str, dict] = {}
+        plane_counts: dict[str, int] = {}
+        for entry in entries:
+            record = entry.get("record") or {}
+            plane = entry.get("plane", "?")
+            plane_counts[plane] = plane_counts.get(plane, 0) + 1
+            ctx = record.get("ctx")
+            if not isinstance(ctx, dict) or not ctx.get("trace_id"):
+                continue
+            tid = ctx["trace_id"]
+            row = traces.setdefault(tid, {
+                "trace_id": tid, "records": 0, "planes": set(),
+                "origins": set(), "sched_units": 0, "run_events": 0,
+                "orphans": 0,
+            })
+            row["records"] += 1
+            row["planes"].add(plane)
+            row["origins"].update(ctx.get("origin") or ())
+            if plane == "sched" and record.get("kind") == "unit":
+                row["sched_units"] += 1
+            if plane == "run":
+                row["run_events"] += 1
+            parent = ctx.get("parent")
+            if parent and parent not in defined:
+                row["orphans"] += 1
+                orphans.append({
+                    "source": entry.get("source"),
+                    "plane": plane,
+                    "n": entry.get("n"),
+                    "parent": parent,
+                    "type": record.get("type") or record.get("kind"),
+                    "trace_id": tid,
+                })
+        for row in traces.values():
+            row["planes"] = sorted(row["planes"])
+            row["origins"] = sorted(row["origins"])
+        sched_units_total = sum(
+            1 for e in entries
+            if e.get("plane") == "sched"
+            and (e.get("record") or {}).get("kind") == "unit")
+        run_events_total = plane_counts.get("run", 0)
+        return {
+            "entries": len(entries),
+            "planes": plane_counts,
+            "defined_refs": len(defined),
+            "orphans": orphans,
+            "traces": sorted(traces.values(),
+                             key=lambda r: -r["records"]),
+            "sched_units_total": sched_units_total,
+            "run_events_total": run_events_total,
+        }
+
+    def summary(self) -> dict:
+        """The fleet view as a bench-record-shaped dict (``metric:
+        fleet_trace``) — directly evaluable by ``telemetry check`` /
+        ``check_run_artifacts`` against the committed SLO rows."""
+        analysis = self.analyze()
+        sources = [{
+            "source": sid,
+            "plane": self._sources[sid]["plane"],
+            "records": self._consumed.get(sid, 0),
+            "torn": self._followers[sid].torn,
+        } for sid in sorted(self._sources)]
+        return {
+            "metric": "fleet_trace",
+            "unit": "events",
+            "value": analysis["entries"],
+            "roots": [os.path.abspath(r) for r in self.roots],
+            "sources": sources,
+            "planes": analysis["planes"],
+            "torn": self.torn,
+            "defined_refs": analysis["defined_refs"],
+            "orphan_events": len(analysis["orphans"]),
+            "orphans": analysis["orphans"],
+            "traces": analysis["traces"],
+            "sched_units_total": analysis["sched_units_total"],
+            "run_events_total": analysis["run_events_total"],
+            "digest": timeline_digest(self.entries()),
+        }
+
+    def close(self) -> None:
+        if self._fd is not None:
+            os.close(self._fd)
+            self._fd = None
+
+
+# -------------------------------------------------------------- prometheus
+def fleet_prometheus(agg: FleetAggregator, prefix: str = "dib") -> str:
+    """Fleet-wide Prometheus exposition: the LAST ``metrics`` rollup of
+    every run-plane source, aggregated — counters summed across workers
+    (the prefork-supervisor view, pids and all, collapses into fleet
+    totals), gauges last-write-wins in fleet order, histograms merged on
+    their mergeable stats (count/sum/min/max; windowed percentiles do
+    not merge and are dropped) — plus the aggregator's own meta-gauges
+    (sources, entries, torn lines, orphans)."""
+    from dib_tpu.telemetry.metrics import prometheus_text
+
+    counters: dict[str, float] = {}
+    gauges: dict[str, float] = {}
+    hists: dict[str, dict] = {}
+    for entry in agg.merged():
+        record = entry.get("record") or {}
+        if entry.get("plane") != "run" or record.get("type") != "metrics":
+            continue
+        for snap in record.get("snapshots") or []:
+            if not isinstance(snap, dict):
+                continue
+            for key, value in snap.items():
+                if not isinstance(value, (int, float)) \
+                        or isinstance(value, bool):
+                    continue
+                group, _, rest = key.partition(".")
+                if group == "counters" and rest:
+                    counters[rest] = counters.get(rest, 0.0) + float(value)
+                elif group == "gauges" and rest:
+                    gauges[rest] = float(value)   # fleet-order last wins
+                elif group == "histograms" and rest:
+                    name, _, stat = rest.rpartition(".")
+                    if not name:
+                        continue
+                    h = hists.setdefault(name, {})
+                    if stat in ("count", "sum"):
+                        h[stat] = h.get(stat, 0.0) + float(value)
+                    elif stat == "min":
+                        h[stat] = min(h.get(stat, float(value)),
+                                      float(value))
+                    elif stat == "max":
+                        h[stat] = max(h.get(stat, float(value)),
+                                      float(value))
+    analysis = agg.analyze()
+    gauges["fleet.sources"] = float(len(agg._sources))
+    gauges["fleet.entries"] = float(analysis["entries"])
+    gauges["fleet.torn_lines"] = float(agg.torn)
+    gauges["fleet.orphan_events"] = float(len(analysis["orphans"]))
+    gauges["fleet.traces"] = float(len(analysis["traces"]))
+    snapshot = {"counters": counters, "gauges": gauges,
+                "histograms": hists}
+    return prometheus_text(snapshot, prefix=prefix)
+
+
+# ------------------------------------------------------------ html report
+def _trace_edges(entries) -> dict[str, dict]:
+    """Per-trace parent→children adjacency over DEFINED entity refs (the
+    study→units→publish DAG the mission-control page renders)."""
+    graphs: dict[str, dict] = {}
+    for entry in entries:
+        record = entry.get("record") or {}
+        ctx = record.get("ctx")
+        if not isinstance(ctx, dict) or not ctx.get("trace_id"):
+            continue
+        parent = ctx.get("parent")
+        if not parent:
+            continue
+        plane = entry.get("plane")
+        kind = record.get("kind")
+        child = None
+        if plane == "sched" and kind == "job" and record.get("job_id"):
+            child = f"sched:job:{record['job_id']}"
+        elif plane == "sched" and kind == "unit" and record.get("unit_id"):
+            child = f"sched:unit:{record['unit_id']}"
+        elif plane == "run" and record.get("run"):
+            child = f"run:{record['run']}"
+        elif plane == "stream" and kind == "publish" \
+                and record.get("publish_id"):
+            child = f"publish:{record['publish_id']}"
+        if child is None or child == parent:
+            continue
+        graph = graphs.setdefault(ctx["trace_id"],
+                                  {"edges": {}, "nodes": set()})
+        graph["nodes"].update((parent, child))
+        graph["edges"].setdefault(parent, set()).add(child)
+    return graphs
+
+
+def _render_dag(graph: dict, esc) -> str:
+    """One trace's DAG as a nested list, roots first (a node that is
+    never a child is a root — the study, usually)."""
+    children = graph["edges"]
+    all_children = {c for kids in children.values() for c in kids}
+    roots = sorted(n for n in graph["nodes"] if n not in all_children)
+
+    def render(node: str, seen: frozenset) -> str:
+        kids = sorted(children.get(node, ()))
+        inner = ""
+        if kids and node not in seen:
+            seen = seen | {node}
+            inner = "<ul>" + "".join(
+                render(k, seen) for k in kids) + "</ul>"
+        return f"<li><code>{esc(node)}</code>{inner}</li>"
+
+    if not roots:
+        return '<p class="note">no resolvable edges</p>'
+    return "<ul>" + "".join(render(r, frozenset()) for r in roots) + "</ul>"
+
+
+def render_fleet(agg: FleetAggregator) -> str:
+    """The fleet mission-control page: per-plane health tiles, the
+    per-trace causal DAG, and the orphan ledger — same self-contained
+    HTML contract as the per-run report (inline CSS, no external
+    assets)."""
+    from dib_tpu.telemetry.report import _CSS, _esc
+
+    entries = agg.merged()
+    analysis = agg.analyze()
+    summary = agg.summary()
+
+    tiles = []
+    for plane in ("study", "sched", "run", "stream", "deploy"):
+        count = analysis["planes"].get(plane, 0)
+        n_sources = sum(1 for s in agg._sources.values()
+                        if s["plane"] == plane)
+        torn = sum(agg._followers[sid].torn for sid, s
+                   in agg._sources.items() if s["plane"] == plane)
+        plane_orphans = sum(1 for o in analysis["orphans"]
+                            if o["plane"] == plane)
+        ok = n_sources > 0 and torn == 0 and plane_orphans == 0
+        tiles.append(
+            f'<div class="tile"><h3>{_esc(plane)}</h3>'
+            f"<p>{'✅' if ok else ('—' if n_sources == 0 else '⚠')} "
+            f"{n_sources} source(s) · {count} record(s)"
+            + (f" · {torn} torn" if torn else "")
+            + (f" · {plane_orphans} orphan(s)" if plane_orphans else "")
+            + "</p></div>")
+
+    graphs = _trace_edges(entries)
+    trace_html = []
+    for row in analysis["traces"]:
+        tid = row["trace_id"]
+        graph = graphs.get(tid)
+        dag = (_render_dag(graph, _esc) if graph
+               else '<p class="note">no resolvable edges</p>')
+        trace_html.append(
+            f"<h3><code>{_esc(tid)}</code></h3>"
+            f"<p class=\"note\">{row['records']} record(s) across "
+            f"{', '.join(row['planes'])} · origins "
+            f"{' → '.join(row['origins']) or '—'}"
+            + (f" · ⚠ {row['orphans']} orphan(s)" if row["orphans"]
+               else "")
+            + f"</p>{dag}")
+    orphan_rows = "".join(
+        "<tr>"
+        f"<td><code>{_esc(o.get('parent', ''))}</code></td>"
+        f"<td>{_esc(o.get('plane', ''))}</td>"
+        f"<td>{_esc(str(o.get('type', '')))}</td>"
+        f"<td><code>{_esc(o.get('source', ''))}</code>:{o.get('n')}</td>"
+        "</tr>" for o in analysis["orphans"])
+    orphans_html = (
+        "<table><thead><tr><th>unresolved parent</th><th>plane</th>"
+        "<th>record</th><th>source:n</th></tr></thead>"
+        f"<tbody>{orphan_rows}</tbody></table>" if orphan_rows else
+        '<p class="note">none — every ctx.parent resolves to a merged '
+        "record.</p>")
+
+    roots = " · ".join(f"<code>{_esc(r)}</code>" for r in summary["roots"])
+    return f"""<!DOCTYPE html>
+<html lang="en"><head><meta charset="utf-8">
+<meta name="viewport" content="width=device-width, initial-scale=1">
+<title>dib-tpu fleet mission control</title>
+<style>{_CSS}
+.tiles {{ display: flex; flex-wrap: wrap; gap: 0.6rem; }}
+.tile {{ border: 1px solid var(--border, #ccc); border-radius: 6px;
+         padding: 0.4rem 0.8rem; min-width: 10rem; }}
+.tile h3 {{ margin: 0.2rem 0; }}</style></head>
+<body>
+<h1>dib-tpu fleet mission control</h1>
+<p class="sub">{roots}
+ · {summary['value']} merged record(s) from {len(summary['sources'])}
+ source(s) · {len(summary['traces'])} trace(s)
+ · digest <code>{_esc(summary['digest'][:16])}…</code></p>
+<h2>Plane health</h2>
+<div class="tiles">{''.join(tiles)}</div>
+<h2>Causal DAG</h2>
+<p class="note">One tree per trace_id: every edge is a record whose
+<code>ctx.parent</code> names the parent entity
+(docs/observability.md "Fleet causality").</p>
+{''.join(trace_html) or '<p class="note">no traced records yet.</p>'}
+<h2>Orphan events</h2>
+{orphans_html}
+</body></html>
+"""
+
+
+def write_fleet_report(roots, out: str) -> str:
+    agg = FleetAggregator(roots)
+    agg.poll()
+    try:
+        html_text = render_fleet(agg)
+    finally:
+        agg.close()
+    with open(out, "w") as f:
+        f.write(html_text)
+    return out
+
+
+# ------------------------------------------------------------ burn alerts
+class _BurnAlerter:
+    """Routes firing burn-rate rules to the ORIGINATING run's own event
+    stream (durably, idempotently — the ``_AlertSink`` contract): for
+    each root that contributed bad-matching records, the alert lands in
+    that root's run-plane directory, where the existing ``telemetry
+    check``/``compare`` gates already look."""
+
+    def __init__(self, agg: FleetAggregator):
+        self._agg = agg
+        self._sinks: dict[str, object] = {}
+        self.written: list[dict] = []
+
+    def _sink_for(self, directory: str):
+        from dib_tpu.telemetry.events import read_events
+        from dib_tpu.telemetry.slo import _AlertSink
+
+        sink = self._sinks.get(directory)
+        if sink is None:
+            sink = _AlertSink(directory, run_id=None,
+                              existing_events=read_events(directory))
+            self._sinks[directory] = sink
+        return sink
+
+    def _origin_dirs(self, rule: dict, now: float) -> list[str]:
+        from dib_tpu.telemetry.slo import _entry_matches
+
+        lo = now - float(rule["slow_window_s"])
+        roots: set[str] = set()
+        for entry in self._agg.entries():
+            t = float(entry.get("t") or 0.0)
+            if t < lo or t > now:
+                continue
+            if _entry_matches(rule.get("bad") or {}, entry.get("plane", ""),
+                              entry.get("record") or {}):
+                src = self._agg._sources.get(entry.get("source"))
+                if src:
+                    roots.add(src["root"])
+        dirs = []
+        for root in sorted(roots):
+            run_dirs = sorted(
+                os.path.dirname(s["path"])
+                for s in self._agg._sources.values()
+                if s["root"] == root and s["plane"] == "run")
+            if run_dirs:
+                dirs.append(run_dirs[0])
+        return dirs
+
+    def land(self, rules_by_name: dict, rows, now: float) -> None:
+        for row in rows:
+            if row.get("status") != "firing":
+                continue
+            rule = rules_by_name.get(row["rule"])
+            if rule is None:
+                continue
+            for directory in self._origin_dirs(rule, now):
+                if self._sink_for(directory).burn(row, source="fleet"):
+                    self.written.append({"rule": row["rule"],
+                                         "dir": directory})
+
+    def close(self) -> None:
+        for sink in self._sinks.values():
+            sink.close()
+        self._sinks = {}
+
+
+# -------------------------------------------------------------------- CLI
+def build_fleet_parser():
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="dib_tpu telemetry fleet",
+        description="Merge many runs' planes into one causally-ordered "
+                    "fleet timeline (docs/observability.md 'Fleet "
+                    "causality').")
+    sub = parser.add_subparsers(dest="action", required=True)
+
+    def add_common(p):
+        p.add_argument("roots", nargs="+",
+                       help="Run directories or runs roots to merge "
+                            "(searched recursively for events.jsonl / "
+                            "journal.jsonl / study.jsonl / "
+                            "publishes.jsonl / deploys.jsonl).")
+
+    p_tail = sub.add_parser(
+        "tail", help="Follow the fleet live; --out makes the merge "
+                     "durable and resumable, --slo evaluates burn-rate "
+                     "rules.")
+    add_common(p_tail)
+    p_tail.add_argument("--out", default=None,
+                        help="Durable timeline directory (timeline.jsonl; "
+                             "re-attaching resumes with zero duplicate / "
+                             "zero lost entries).")
+    p_tail.add_argument("--slo", default=None,
+                        help="SLO.json with burn_rates rules to evaluate "
+                             "each refresh; firing rules land durable "
+                             "alert events on the originating run's "
+                             "stream.")
+    p_tail.add_argument("--refresh-s", type=float, default=1.0,
+                        dest="refresh_s")
+    p_tail.add_argument("--duration-s", type=float, default=None,
+                        dest="duration_s",
+                        help="Stop after this long (default: until the "
+                             "sources go quiet when --once, else until "
+                             "interrupted).")
+    p_tail.add_argument("--once", action="store_true",
+                        help="One poll cycle, then exit (scripting).")
+
+    p_sum = sub.add_parser(
+        "summarize", help="One-shot merge: print the fleet summary "
+                          "record (metric: fleet_trace); exits 1 when "
+                          "any orphan events exist.")
+    add_common(p_sum)
+    p_sum.add_argument("--out", default=None,
+                       help="Also write the summary record to this path.")
+
+    p_rep = sub.add_parser(
+        "report", help="Render the fleet mission-control HTML page.")
+    add_common(p_rep)
+    p_rep.add_argument("--out", default="fleet_report.html",
+                       help="HTML output path.")
+
+    p_prom = sub.add_parser(
+        "prometheus", help="Print the fleet-aggregated Prometheus "
+                           "exposition (per-worker metrics rollups "
+                           "summed).")
+    add_common(p_prom)
+    return parser
+
+
+def _tail_main(args) -> int:
+    agg = FleetAggregator(args.roots, out_dir=args.out)
+    spec = None
+    alerter = None
+    burn_rows: list[dict] = []
+    if args.slo:
+        from dib_tpu.telemetry.slo import load_slo
+
+        spec = load_slo(args.slo)
+        alerter = _BurnAlerter(agg)
+    deadline = (time.monotonic() + args.duration_s
+                if args.duration_s else None)
+    try:
+        while True:
+            fresh = agg.poll()
+            if spec is not None:
+                entries = agg.entries()
+                now = max((float(e.get("t") or 0.0) for e in entries),
+                          default=0.0)
+                from dib_tpu.telemetry.slo import evaluate_burn_rates
+
+                burn = spec.get("burn_rates") or []
+                burn_rows = evaluate_burn_rates(burn, entries, now=now)
+                alerter.land({r.get("name"): r for r in burn},
+                             burn_rows, now)
+            if fresh or args.once:
+                firing = [r["rule"] for r in burn_rows
+                          if r.get("status") == "firing"]
+                print(json.dumps({
+                    "entries": len(agg.entries()),
+                    "new": len(fresh), "torn": agg.torn,
+                    "firing": firing,
+                }), flush=True)
+            if args.once:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            time.sleep(args.refresh_s)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if alerter is not None:
+            alerter.close()
+        agg.close()
+    summary = agg.summary()
+    out = {"entries": summary["value"], "torn": summary["torn"],
+           "orphan_events": summary["orphan_events"],
+           "digest": summary["digest"]}
+    if burn_rows:
+        out["burn_rates"] = burn_rows
+    if alerter is not None:
+        out["alerts_written"] = alerter.written
+    print(json.dumps(out))
+    return 0
+
+
+def _summarize_main(args) -> int:
+    import sys
+
+    agg = FleetAggregator(args.roots)
+    agg.poll()
+    try:
+        summary = agg.summary()
+    finally:
+        agg.close()
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(json.dumps(summary, indent=1) + "\n")
+    print(json.dumps(summary, indent=1))
+    for orphan in summary["orphans"]:
+        print(f"fleet: ORPHAN {orphan['parent']!r} claimed by "
+              f"{orphan['source']}:{orphan['n']} "
+              f"({orphan['plane']}/{orphan['type']}) — no merged source "
+              "defines it", file=sys.stderr)
+    return 1 if summary["orphan_events"] else 0
+
+
+def fleet_main(argv) -> int:
+    args = build_fleet_parser().parse_args(list(argv))
+    if args.action == "tail":
+        return _tail_main(args)
+    if args.action == "summarize":
+        return _summarize_main(args)
+    if args.action == "prometheus":
+        agg = FleetAggregator(args.roots)
+        agg.poll()
+        try:
+            print(fleet_prometheus(agg), end="")
+        finally:
+            agg.close()
+        return 0
+    path = write_fleet_report(args.roots, args.out)
+    print(json.dumps({"html": path}))
+    return 0

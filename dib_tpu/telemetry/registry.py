@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 
 __all__ = ["INDEX_FILENAME", "RunRegistry", "bench_entry",
@@ -129,6 +130,24 @@ class RunRegistry:
 
 
 # ----------------------------------------------------------------- entries
+def _lineage_from_stream(run_dir: str) -> dict | None:
+    """The run's causal lineage — the first ``ctx`` envelope its event
+    stream carries (``telemetry/context.py``): ``{"trace_id", "parent",
+    "origin"}``. None for pre-tracing streams (absence stays absent —
+    the registry never invents lineage)."""
+    from dib_tpu.telemetry.events import read_events
+
+    try:
+        for event in read_events(run_dir):
+            ctx = event.get("ctx")
+            if isinstance(ctx, dict) and ctx.get("trace_id"):
+                return {k: ctx[k] for k in ("trace_id", "parent", "origin")
+                        if k in ctx}
+    except OSError:
+        pass
+    return None
+
+
 def run_entry(run_dir: str, summary: dict | None = None,
               extra: dict | None = None) -> dict:
     """Registry entry for a run directory, from its stream's summary."""
@@ -162,6 +181,9 @@ def run_entry(run_dir: str, summary: dict | None = None,
         "provenance": {k: summary[k] for k in _PROVENANCE_KEYS
                        if k in summary},
     }
+    lineage = _lineage_from_stream(run_dir)
+    if lineage:
+        entry["lineage"] = lineage
     if extra:
         entry.update(extra)
     return entry
@@ -294,16 +316,22 @@ def runs_main(args) -> int:
             print(f"no runs registered under {registry.path}")
             return 0
         print(f"{'run_id':32} {'status':11} {'device':14} "
-              f"{'steps/s':>9} {'mfu':>7} {'alerts':>6}  run_dir")
+              f"{'steps/s':>9} {'mfu':>7} {'alerts':>6} "
+              f"{'lineage':22}  run_dir")
         for run_id, entry in sorted(
                 latest.items(), key=lambda kv: kv[1].get("t", 0.0)):
             metrics = entry.get("metrics") or {}
             prov = entry.get("provenance") or {}
+            lineage = entry.get("lineage") or {}
+            # the trace_id, or the parent study when one is named — the
+            # cross-plane join key `telemetry fleet` merges on
+            trace = lineage.get("parent") or lineage.get("trace_id")
             print(f"{_fmt(run_id, 32)} {_fmt(entry.get('status'), 11)} "
                   f"{_fmt(prov.get('device_kind'), 14)} "
                   f"{_fmt(metrics.get('steps_per_s')):>9} "
                   f"{_fmt(metrics.get('mfu')):>7} "
-                  f"{_fmt(metrics.get('alerts', 0)):>6}  "
+                  f"{_fmt(metrics.get('alerts', 0)):>6} "
+                  f"{_fmt(trace, 22)}  "
                   f"{entry.get('run_dir', '—')}")
         return 0
     if args.runs_action == "show":
@@ -312,7 +340,17 @@ def runs_main(args) -> int:
             print(f"telemetry runs show: no entry for {args.run_id!r} "
                   f"in {registry.path}", flush=True)
             return 2
-        print(json.dumps(history[-1] if not args.full_history else history,
+        latest_entry = history[-1]
+        lineage = latest_entry.get("lineage") or {}
+        if lineage.get("trace_id"):
+            # the human-readable origin chain rides stderr: stdout stays
+            # pure JSON (the entry itself carries the lineage block) so
+            # `runs show <id> | jq` keeps working on traced runs
+            origin = " → ".join(lineage.get("origin") or ()) or "—"
+            print(f"lineage: trace {lineage['trace_id']}  "
+                  f"parent {lineage.get('parent') or '—'}  "
+                  f"origin {origin}", file=sys.stderr)
+        print(json.dumps(latest_entry if not args.full_history else history,
                          indent=1))
         return 0
     # trajectory

@@ -61,8 +61,8 @@ import os
 from dib_tpu.telemetry.events import EventWriter, read_events
 
 __all__ = ["SLOEngine", "TransitionTracker", "check_run",
-           "detect_transitions", "evaluate_rules", "load_slo",
-           "resolve_metric", "slo_budget", "validate_slo"]
+           "detect_transitions", "evaluate_burn_rates", "evaluate_rules",
+           "load_slo", "resolve_metric", "slo_budget", "validate_slo"]
 
 DEFAULT_SLO_PATH = "SLO.json"
 SLO_VERSION = 1
@@ -128,7 +128,136 @@ def validate_slo(spec) -> list[str]:
                 thr, (int, float)) or isinstance(thr, bool) or thr <= 0:
             problems.append("'transitions' must be an object with a "
                             "positive 'kl_threshold_nats'")
+    problems.extend(_validate_burn_rates(spec.get("burn_rates"), seen))
     return problems
+
+
+def _finite_pos(v) -> bool:
+    return (isinstance(v, (int, float)) and not isinstance(v, bool)
+            and math.isfinite(v) and v > 0)
+
+
+def _validate_burn_rates(burn, seen_names: set[str]) -> list[str]:
+    """Shape problems for the optional ``burn_rates`` section (see
+    docs/observability.md "Fleet causality"): windowed error-budget
+    burn rules ``telemetry fleet tail --slo`` evaluates over the merged
+    fleet timeline. Names share the rule namespace (an alert carries
+    only the name)."""
+    if burn is None:
+        return []
+    if not isinstance(burn, list):
+        return ["'burn_rates' must be a list"]
+    problems: list[str] = []
+    for i, rule in enumerate(burn):
+        label = f"burn_rates[{i}]"
+        if not isinstance(rule, dict):
+            problems.append(f"{label} must be an object")
+            continue
+        name = rule.get("name")
+        if not (isinstance(name, str) and name):
+            problems.append(f"{label}: 'name' must be a non-empty string")
+        elif name in seen_names:
+            problems.append(f"{label}: duplicate rule name {name!r}")
+        else:
+            seen_names.add(name)
+            label = f"burn rule {name!r}"
+        if not (isinstance(rule.get("bad"), dict) and rule["bad"]):
+            problems.append(f"{label}: 'bad' must be a non-empty object "
+                            "matcher")
+        total = rule.get("total")
+        if total is not None and not isinstance(total, dict):
+            problems.append(f"{label}: 'total' must be an object matcher")
+        budget = rule.get("budget")
+        if not _finite_pos(budget) or budget > 1:
+            problems.append(f"{label}: 'budget' must be a finite number "
+                            "in (0, 1]")
+        fast = rule.get("fast_window_s")
+        slow = rule.get("slow_window_s")
+        if not _finite_pos(fast):
+            problems.append(f"{label}: 'fast_window_s' must be a positive "
+                            "number")
+        if not _finite_pos(slow) or (_finite_pos(fast) and slow <= fast):
+            problems.append(f"{label}: 'slow_window_s' must be a positive "
+                            "number greater than 'fast_window_s'")
+        if not _finite_pos(rule.get("threshold")):
+            problems.append(f"{label}: 'threshold' must be a positive "
+                            "number")
+    return problems
+
+
+def _entry_matches(matcher: dict, plane: str, record: dict) -> bool:
+    """Whether one timeline record matches a burn-rate matcher: every key
+    must resolve and match (the ``when``-guard semantics, fail-closed).
+    ``plane`` matches the source's plane; any other key dotted-resolves
+    into the record itself (``type``, ``kind``, ``severity``, ...)."""
+    view = {"plane": plane, **record}
+    for key, want in matcher.items():
+        if _guard_key_matches(view, key, want) is not True:
+            return False
+    return True
+
+
+def evaluate_burn_rates(burn_rules, entries, now: float | None = None
+                        ) -> list[dict]:
+    """Evaluate burn-rate rules over a merged fleet timeline.
+
+    ``entries`` are fleet timeline entries (``telemetry/fleet.py``):
+    dicts with ``plane``, ``t``, and the source ``record``. For each
+    rule, the error ratio bad/total inside the fast and the slow
+    trailing window (ending at ``now``, default: the newest timestamp
+    seen) is divided by the rule's error ``budget`` — the burn rate.
+    The rule FIRES only when BOTH windows burn at ``threshold`` or more:
+    the fast window catches the cliff, the slow window keeps a brief
+    blip from paging (the multiwindow burn-rate idiom). A rule whose
+    slow window saw no ``total``-matching records is skipped, not fired
+    (no traffic is not an outage verdict).
+    """
+    rows: list[dict] = []
+    stamped = [(float(e.get("t") or 0.0), e.get("plane", ""),
+                e.get("record") or {}) for e in entries]
+    if now is None:
+        now = max((t for t, _, _ in stamped), default=0.0)
+    for rule in burn_rules or []:
+        bad_m = rule.get("bad") or {}
+        total_m = rule.get("total")
+        counts = {}
+        for label, window in (("fast", rule["fast_window_s"]),
+                              ("slow", rule["slow_window_s"])):
+            lo = now - float(window)
+            bad = total = 0
+            for t, plane, record in stamped:
+                if t < lo or t > now:
+                    continue
+                if total_m is None or _entry_matches(total_m, plane, record):
+                    total += 1
+                if _entry_matches(bad_m, plane, record):
+                    bad += 1
+            ratio = (bad / total) if total else 0.0
+            counts[label] = {"bad": bad, "total": total,
+                             "burn": ratio / float(rule["budget"])}
+        row = {
+            "rule": rule.get("name", "?"),
+            "budget": rule.get("budget"),
+            "threshold": rule.get("threshold"),
+            "windows_s": [rule["fast_window_s"], rule["slow_window_s"]],
+            "severity": rule.get("severity", "page"),
+            "burn_fast": round(counts["fast"]["burn"], 6),
+            "burn_slow": round(counts["slow"]["burn"], 6),
+            "bad_fast": counts["fast"]["bad"],
+            "total_fast": counts["fast"]["total"],
+            "bad_slow": counts["slow"]["bad"],
+            "total_slow": counts["slow"]["total"],
+        }
+        if counts["slow"]["total"] == 0:
+            row.update(status="skipped", reason="no matching traffic in "
+                                                "the slow window")
+        elif (counts["fast"]["burn"] >= rule["threshold"]
+                and counts["slow"]["burn"] >= rule["threshold"]):
+            row["status"] = "firing"
+        else:
+            row["status"] = "ok"
+        rows.append(row)
+    return rows
 
 
 def slo_budget(rule_name: str, default: float,
@@ -349,6 +478,21 @@ class _AlertSink:
             bound=row["bound"], budget=row["budget"],
             severity=row["severity"], source=source,
             **({"reason": row["reason"]} if row.get("reason") else {}),
+        )
+        return True
+
+    def burn(self, row: dict, source: str) -> bool:
+        """One durable burn-rate alert (same per-rule idempotence as
+        :meth:`alert` — the two kinds share the rule namespace)."""
+        key = row["rule"]
+        if key in self._seen_alerts:
+            return False
+        self._seen_alerts.add(key)
+        self._ensure_writer().alert(
+            rule=row["rule"], severity=row["severity"], source=source,
+            budget=row.get("budget"), threshold=row.get("threshold"),
+            burn_fast=row.get("burn_fast"), burn_slow=row.get("burn_slow"),
+            windows_s=row.get("windows_s"),
         )
         return True
 

@@ -1260,6 +1260,15 @@ def _load_side(path: str, process_index: int | None,
 
 
 def telemetry_main(argv: Sequence[str]) -> int:
+    argv = list(argv)
+    if argv and argv[0] == "fleet":
+        # the fleet aggregator owns its own subparser tree
+        # (tail|summarize|report|prometheus over many roots) — dispatch
+        # before the single-run parser (docs/observability.md "Fleet
+        # causality")
+        from dib_tpu.telemetry.fleet import fleet_main
+
+        return fleet_main(argv[1:])
     parser = argparse.ArgumentParser(
         prog="dib_tpu telemetry",
         description="Summarize or diff run event streams (docs/observability.md).",
@@ -1362,6 +1371,14 @@ def telemetry_main(argv: Sequence[str]) -> int:
         p.add_argument("--runs-root", "--runs_root", dest="runs_root",
                        default=None,
                        help="Runs root (default: DIB_RUNS_ROOT or ./runs).")
+    # listed for --help only; the real dispatch happens above, before
+    # this parser runs (fleet_main owns its own argument tree)
+    sub.add_parser(
+        "fleet",
+        help="Merge many runs' planes (events/sched/study/stream "
+             "journals) into one causally-ordered fleet timeline: "
+             "tail|summarize|report|prometheus <roots...> "
+             "(docs/observability.md 'Fleet causality').")
     args = parser.parse_args(argv)
 
     try:

@@ -52,7 +52,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 ARTIFACT_GLOBS = ("BENCH_*.json", "NORTHSTAR_*.json", "FAULT_DRILL*.json",
                   "CHAOS_SCHED*.json", "CHAOS_STREAM*.json",
-                  "CHAOS_SDC*.json", "CHAOS_STUDY*.json", "STUDY_*.json")
+                  "CHAOS_SDC*.json", "CHAOS_STUDY*.json", "STUDY_*.json",
+                  "FLEET_*.json")
 
 # Null-value excuses: at least one must be present when value is null.
 _NULL_VALUE_EXCUSES = ("degraded", "error", "per_run_minutes", "runs_completed")
@@ -547,6 +548,70 @@ def _check_mesh_bench(record: dict, problems: list[str]) -> None:
         problems.append("'all_parity_ok' must be true on a committed record")
 
 
+def _check_fleet_trace(record: dict, problems: list[str]) -> None:
+    """A committed fleet_trace record (`telemetry fleet summarize`,
+    ISSUE 16): a real study traced end-to-end — study → sched units →
+    unit runs — with zero orphan events and a reproducible merged-
+    timeline digest."""
+    budget = _slo_budget("fleet_orphan_ceiling", 0)
+    orphan_events = record.get("orphan_events")
+    if not isinstance(orphan_events, int) or isinstance(orphan_events, bool):
+        problems.append("'orphan_events' must be an int")
+        orphan_events = None
+    orphans = record.get("orphans")
+    if not isinstance(orphans, list):
+        problems.append("'orphans' must be a list")
+    elif orphan_events is not None and len(orphans) != orphan_events:
+        problems.append(f"'orphan_events' ({orphan_events}) disagrees with "
+                        f"the orphan evidence ({len(orphans)} row(s))")
+    if orphan_events is not None and orphan_events > budget:
+        problems.append(
+            f"{orphan_events} orphan event(s) (SLO budget {budget}) — a "
+            "ctx.parent no merged source defines means the causal "
+            "timeline lies; merge every plane or fix the propagation")
+    planes = record.get("planes")
+    if not isinstance(planes, dict):
+        problems.append("'planes' must be an object of per-plane counts")
+        planes = {}
+    for plane in ("study", "sched", "run"):
+        if not planes.get(plane):
+            problems.append(f"no {plane!r}-plane records in the merge — "
+                            "the end-to-end study trace is incomplete")
+    traces = record.get("traces")
+    if not isinstance(traces, list) or not traces:
+        problems.append("'traces' must be a non-empty list of per-trace "
+                        "rollups")
+        traces = []
+    end_to_end = [t for t in traces if isinstance(t, dict)
+                  and t.get("sched_units", 0) > 0
+                  and t.get("run_events", 0) > 0
+                  and "study" in (t.get("planes") or ())]
+    if traces and not end_to_end:
+        problems.append("no trace spans study → sched units → unit runs "
+                        "— the record does not evidence end-to-end "
+                        "propagation")
+    for key in ("sched_units_total", "run_events_total"):
+        n = record.get(key)
+        if not isinstance(n, int) or isinstance(n, bool) or n < 1:
+            problems.append(f"{key!r} must be a positive int")
+    digest = record.get("digest")
+    if not (isinstance(digest, str) and len(digest) == 64):
+        problems.append("'digest' must be the 64-hex merged-timeline "
+                        "sha256")
+
+
+def _check_fleet_chaos_matrix(record: dict, problems: list[str]) -> None:
+    """The fleet aggregator's kill/resume drill (scripts/fleet_drill.py
+    chaos): a SIGKILLed merge re-attached with zero duplicate and zero
+    lost timeline entries and a bit-identical merged digest."""
+    _check_chaos_matrix(
+        record, problems,
+        required_drills=("aggregator_kill_resume",),
+        invariants=("zero_duplicates", "zero_lost", "digest_identical"),
+        rerun_hint="scripts/fleet_drill.py chaos",
+    )
+
+
 def _reject_constant(name: str):
     raise ValueError(f"non-finite JSON constant {name!r}")
 
@@ -613,6 +678,10 @@ def check_record(record: dict, problems: list[str]) -> None:
             _check_serve_async_bench(record, problems)
         if record.get("metric") == "mesh_reshard_bench":
             _check_mesh_bench(record, problems)
+        if record.get("metric") == "fleet_trace":
+            _check_fleet_trace(record, problems)
+        if record.get("metric") == "fleet_chaos_matrix":
+            _check_fleet_chaos_matrix(record, problems)
     elif {"cmd", "rc"} <= set(record):
         # ---- driver capture
         if not isinstance(record["cmd"], str):

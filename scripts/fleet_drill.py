@@ -1,0 +1,294 @@
+"""Fleet causal-tracing drills → FLEET_CPU.json / FLEET_CHAOS.json.
+
+Two drills for the fleet aggregator (docs/observability.md "Fleet
+causality"), each through the REAL CLI:
+
+- ``trace`` — a real CPU boolean study driven end-to-end through
+  ``python -m dib_tpu study run --trace-id ...``, then its full
+  cross-plane timeline reconstructed by ``telemetry fleet summarize``:
+  every sched unit and every unit-run event must be reachable from the
+  study's trace_id and ``orphan_events`` must be 0. The summary record
+  (metric ``fleet_trace``) is committed as ``FLEET_CPU.json`` and gated
+  by ``check_run_artifacts`` + the ``fleet_orphan_ceiling`` SLO row.
+- ``chaos`` — a durable merge (``telemetry fleet tail --out``) over
+  skewed-clock multi-writer sources (one with a torn final line) is
+  SIGKILLed mid-merge, the writers keep writing, and a re-attached
+  aggregator finishes the merge: zero duplicate entries, zero lost
+  entries, and a merged-view digest **bit-identical** to an
+  uninterrupted baseline merge of the same sources. Committed as
+  ``FLEET_CHAOS.json`` (metric ``fleet_chaos_matrix``).
+
+Usage::
+
+    python scripts/fleet_drill.py trace --out FLEET_CPU.json
+    python scripts/fleet_drill.py chaos --out FLEET_CHAOS.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+TRACE_ID = "trace-fleetdrill0"
+
+#: Small-but-real study shape (the scripts/chaos_study.py scale): 4-β
+#: grid, one seed, a refinement round — enough to fan out jobs, units,
+#: and unit-run events across all three planes.
+STUDY_FLAGS = [
+    "--grid", "0.03", "30", "4", "--seeds", "0",
+    "--threshold-nats", "0.1", "--tolerance-decades", "0.3",
+    "--max-bracket-decades", "2.0",
+    "--min-refine-rounds", "1", "--max-rounds", "3", "--max-units", "20",
+    "--refine-num", "3",
+    "--set", "steps_per_epoch=16", "--set", "num_annealing_epochs=20",
+    "--set", "batch_size=128", "--set", "chunk_epochs=11",
+]
+
+
+def _log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _cli(*args: str, **kwargs) -> subprocess.CompletedProcess:
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    # a clean trace root: the drill's own env must not leak a parent
+    for var in ("DIB_TRACE_ID", "DIB_TRACE_PARENT", "DIB_TRACE_ORIGIN"):
+        env.pop(var, None)
+    return subprocess.run([sys.executable, "-m", "dib_tpu", *args],
+                          env=env, capture_output=True, text=True,
+                          **kwargs)
+
+
+# ----------------------------------------------------------------- trace
+def run_trace(work: str) -> dict:
+    study_dir = os.path.join(work, "study")
+    _log(f"fleet-drill: running traced CPU study under {study_dir}")
+    proc = _cli("study", "run", "--study-dir", study_dir,
+                "--trace-id", TRACE_ID, *STUDY_FLAGS, timeout=1800)
+    if proc.returncode != 0:
+        raise SystemExit(f"study run failed rc={proc.returncode}:\n"
+                         f"{proc.stdout}\n{proc.stderr}")
+    _log("fleet-drill: study done; merging the fleet timeline")
+    proc = _cli("telemetry", "fleet", "summarize", study_dir, timeout=300)
+    if proc.returncode != 0:
+        raise SystemExit(f"fleet summarize failed rc={proc.returncode} "
+                         f"(orphans?):\n{proc.stdout}\n{proc.stderr}")
+    summary = json.loads(proc.stdout)
+
+    if summary["orphan_events"] != 0:
+        raise SystemExit(f"orphan events: {summary['orphans']}")
+    rows = {t["trace_id"]: t for t in summary["traces"]}
+    if TRACE_ID not in rows:
+        raise SystemExit(f"study trace {TRACE_ID!r} not in merged view "
+                         f"({sorted(rows)})")
+    row = rows[TRACE_ID]
+    # end-to-end reachability: EVERY sched unit and EVERY unit-run event
+    # in the merge carries the study's trace_id
+    if row["sched_units"] != summary["sched_units_total"] \
+            or row["sched_units"] < 1:
+        raise SystemExit(
+            f"sched units reachable from {TRACE_ID}: {row['sched_units']} "
+            f"of {summary['sched_units_total']}")
+    if row["run_events"] != summary["run_events_total"] \
+            or row["run_events"] < 1:
+        raise SystemExit(
+            f"run events reachable from {TRACE_ID}: {row['run_events']} "
+            f"of {summary['run_events_total']}")
+    for plane in ("study", "sched", "run"):
+        if plane not in row["planes"]:
+            raise SystemExit(f"trace spans {row['planes']}, no {plane!r}")
+    summary["drill"] = {
+        "mode": "trace",
+        "trace_id": TRACE_ID,
+        "study_flags": STUDY_FLAGS,
+        "reachable_sched_units": row["sched_units"],
+        "reachable_run_events": row["run_events"],
+        "trace_planes": row["planes"],
+    }
+    # the committed record must not pin the drill's tempdir
+    summary["roots"] = [os.path.basename(r) for r in summary["roots"]]
+    return summary
+
+
+# ----------------------------------------------------------------- chaos
+def _write_lines(path: str, lines: list[str], torn_tail: str | None = None):
+    with open(path, "a") as f:
+        for line in lines:
+            f.write(line + "\n")
+        if torn_tail is not None:
+            f.write(torn_tail)  # no newline: a torn in-flight write
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def _records(run: str, start: int, count: int, t0: float) -> list[str]:
+    # skewed-clock writers: each source stamps t from its own offset
+    return [json.dumps({"v": 1, "run": run, "proc": 0, "seq": i,
+                        "t": t0 + 0.01 * i, "type": "metrics",
+                        "counters": {"steps": i}})
+            for i in range(start, start + count)]
+
+
+def _read_timeline(out_dir: str) -> list[dict]:
+    entries = []
+    with open(os.path.join(out_dir, "timeline.jsonl")) as f:
+        for line in f:
+            if line.endswith("\n"):
+                try:
+                    entries.append(json.loads(line))
+                except ValueError:
+                    pass
+    return entries
+
+
+def run_chaos(work: str) -> dict:
+    from dib_tpu.telemetry.fleet import timeline_digest
+
+    roots = [os.path.join(work, name) for name in ("a", "b", "c")]
+    for root in roots:
+        os.makedirs(root, exist_ok=True)
+    paths = {r: os.path.join(r, "events.jsonl") for r in roots}
+    # phase 1: three writers with skewed clocks; source b ends torn
+    counts = {roots[0]: 900, roots[1]: 700, roots[2]: 500}
+    skew = {roots[0]: 1000.0, roots[1]: 950.0, roots[2]: 1100.0}
+    torn = json.dumps({"v": 1, "run": "b", "seq": 10 ** 6, "t": 1.0,
+                       "type": "metrics"})[:17]
+    for root in roots:
+        _write_lines(paths[root],
+                     _records(os.path.basename(root), 0, counts[root],
+                              skew[root]),
+                     torn_tail=torn if root == roots[1] else None)
+    out_dir = os.path.join(work, "merged")
+    baseline_dir = os.path.join(work, "merged_baseline")
+
+    _log("fleet-drill: starting durable aggregator, then SIGKILL")
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    tail = subprocess.Popen(
+        [sys.executable, "-m", "dib_tpu", "telemetry", "fleet", "tail",
+         *roots, "--out", out_dir, "--refresh-s", "0.02"],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    timeline = os.path.join(out_dir, "timeline.jsonl")
+    deadline = time.time() + 60.0
+    while time.time() < deadline:
+        if os.path.exists(timeline) and os.path.getsize(timeline) > 0:
+            break
+        time.sleep(0.005)
+    else:
+        tail.kill()
+        raise SystemExit("aggregator never started writing the timeline")
+    tail.send_signal(signal.SIGKILL)
+    tail.wait(timeout=30)
+    killed_at = len(_read_timeline(out_dir))
+    _log(f"fleet-drill: killed mid-merge at {killed_at} durable entries")
+
+    # phase 2: the writers keep going while the aggregator is dead —
+    # b's torn line completes, every source appends fresh records
+    rest = json.dumps({"v": 1, "run": "b", "seq": 10 ** 6, "t": 1.0,
+                       "type": "metrics"})[17:]
+    _write_lines(paths[roots[1]], [], torn_tail=rest + "\n")
+    extra = {roots[0]: 300, roots[1]: 200, roots[2]: 400}
+    for root in roots:
+        _write_lines(paths[root],
+                     _records(os.path.basename(root), counts[root],
+                              extra[root], skew[root] + 500.0))
+    expected = {os.path.basename(r): counts[r] + extra[r] for r in roots}
+    expected["b"] += 1  # the healed torn line
+
+    _log("fleet-drill: re-attaching the aggregator (resume)")
+    proc = subprocess.run(
+        [sys.executable, "-m", "dib_tpu", "telemetry", "fleet", "tail",
+         *roots, "--out", out_dir, "--once"],
+        env=env, capture_output=True, text=True, timeout=300)
+    if proc.returncode != 0:
+        raise SystemExit(f"resume failed rc={proc.returncode}:"
+                         f"\n{proc.stdout}\n{proc.stderr}")
+
+    # uninterrupted baseline merge of the same (final) sources
+    proc = subprocess.run(
+        [sys.executable, "-m", "dib_tpu", "telemetry", "fleet", "tail",
+         *roots, "--out", baseline_dir, "--once"],
+        env=env, capture_output=True, text=True, timeout=300)
+    if proc.returncode != 0:
+        raise SystemExit(f"baseline merge failed rc={proc.returncode}:"
+                         f"\n{proc.stdout}\n{proc.stderr}")
+
+    resumed = _read_timeline(out_dir)
+    baseline = _read_timeline(baseline_dir)
+    seen_keys = [(e["source"], e["n"]) for e in resumed]
+    zero_duplicates = len(seen_keys) == len(set(seen_keys))
+    per_source: dict[str, int] = {}
+    for e in resumed:
+        label = e["source"].split("/")[0].split("#")[0]
+        per_source[label] = per_source.get(label, 0) + 1
+    zero_lost = per_source == expected
+    digest_resumed = timeline_digest(resumed)
+    digest_baseline = timeline_digest(baseline)
+    digest_identical = digest_resumed == digest_baseline
+    ok = zero_duplicates and zero_lost and digest_identical \
+        and 0 < killed_at < len(resumed)
+    row = {
+        "drill": "aggregator_kill_resume",
+        "kind": "sigkill",
+        "ok": bool(ok),
+        "zero_duplicates": bool(zero_duplicates),
+        "zero_lost": bool(zero_lost),
+        "digest_identical": bool(digest_identical),
+        "killed_at_entries": killed_at,
+        "entries_total": len(resumed),
+        "entries_per_source": per_source,
+        "expected_per_source": expected,
+        "torn_line_healed": per_source.get("b") == expected["b"],
+        "digest": digest_resumed,
+    }
+    if not ok:
+        raise SystemExit(f"chaos drill failed: {json.dumps(row, indent=1)}")
+    return {
+        "metric": "fleet_chaos_matrix",
+        "unit": "drills",
+        "value": 1,
+        "quick": False,
+        "matrix": [row],
+    }
+
+
+# ------------------------------------------------------------------ main
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("mode", choices=("trace", "chaos"))
+    parser.add_argument("--out", default=None,
+                        help="Output record path (default FLEET_CPU.json "
+                             "/ FLEET_CHAOS.json in the repo root).")
+    parser.add_argument("--work-dir", default=None,
+                        help="Working directory (default: a tempdir, "
+                             "removed on success).")
+    args = parser.parse_args(argv)
+    default_out = ("FLEET_CPU.json" if args.mode == "trace"
+                   else "FLEET_CHAOS.json")
+    out = args.out or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        default_out)
+    work = args.work_dir or tempfile.mkdtemp(prefix=f"fleet_{args.mode}_")
+    try:
+        record = (run_trace if args.mode == "trace" else run_chaos)(work)
+    finally:
+        if args.work_dir is None:
+            shutil.rmtree(work, ignore_errors=True)
+    record["measured_at"] = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                          time.gmtime())
+    with open(out, "w") as f:
+        f.write(json.dumps(record, indent=1) + "\n")
+    _log(f"fleet-drill: wrote {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

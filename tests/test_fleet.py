@@ -1,0 +1,446 @@
+"""Fleet causal tracing (telemetry/context.py + telemetry/fleet.py):
+trace-context minting/inheritance, the ctx envelope on events and
+journal records, deterministic multi-writer merge ordering under clock
+skew and torn lines, durable kill/resume with zero duplicate / zero
+lost entries, orphan surfacing, burn-rate evaluation and alert landing,
+and the `telemetry fleet` CLI exit codes.
+"""
+
+import json
+import os
+
+import pytest
+
+from dib_tpu.telemetry.context import (
+    TRACE_ENV,
+    TRACE_ORIGIN_ENV,
+    TRACE_PARENT_ENV,
+    TraceContext,
+    child_context,
+    ensure_context,
+    from_env,
+    mint,
+    parse_parent_ref,
+)
+from dib_tpu.telemetry.events import EventWriter, read_events
+from dib_tpu.telemetry.fleet import (
+    FleetAggregator,
+    discover_sources,
+    fleet_main,
+    fleet_prometheus,
+    merge_key,
+    timeline_digest,
+    write_fleet_report,
+)
+from dib_tpu.telemetry.summary import telemetry_main
+
+
+@pytest.fixture(autouse=True)
+def _clean_trace_env():
+    # purge on teardown too: activate() writes os.environ directly, and
+    # monkeypatch.delenv records no undo for a var absent at setup — a
+    # test that activates a ctx would otherwise leak lineage into every
+    # later test file's EventWriter
+    def _purge():
+        for var in (TRACE_ENV, TRACE_PARENT_ENV, TRACE_ORIGIN_ENV):
+            os.environ.pop(var, None)
+    _purge()
+    yield
+    _purge()
+
+
+# ============================================================ trace context
+def test_mint_child_and_parent_ref_grammar():
+    ctx = mint("study", trace_id="trace-abc")
+    assert ctx.trace_id == "trace-abc" and ctx.origin == ("study",)
+    child = ctx.child("study:s1", origin="sched")
+    assert child.trace_id == "trace-abc"
+    assert child.parent == "study:s1"
+    assert child.origin == ("study", "sched")
+    # same entry point does not stutter the chain
+    assert child.child("sched:job:j1", origin="sched").origin == \
+        ("study", "sched")
+    assert parse_parent_ref("sched:unit:job-1/u0s0") == \
+        ("sched", "unit:job-1/u0s0")
+    assert child_context(None, "study:s1") is None
+    generated = mint("study")
+    assert generated.trace_id.startswith("trace-")
+
+
+def test_env_roundtrip_and_ensure_context(monkeypatch):
+    assert from_env() is None
+    ctx = TraceContext("trace-env", parent="study:s1",
+                       origin=("study", "sched"))
+    ctx.activate()
+    assert from_env() == ctx
+    # inheriting entry point extends the origin chain, keeps the id
+    inherited = ensure_context("run")
+    assert inherited.trace_id == "trace-env"
+    assert inherited.origin == ("study", "sched", "run")
+    # same trailing origin: unchanged
+    assert ensure_context("sched").origin == ("study", "sched")
+    # an explicit non-matching --trace-id wins with a fresh root
+    explicit = ensure_context("study", trace_id="trace-other")
+    assert explicit.trace_id == "trace-other"
+    assert explicit.parent is None and explicit.origin == ("study",)
+    # a matching --trace-id keeps the inherited lineage
+    assert ensure_context("sched", trace_id="trace-env").parent == "study:s1"
+
+
+def test_event_writer_stamps_ctx_envelope(tmp_path):
+    ctx = mint("study", trace_id="trace-ev")
+    with EventWriter(str(tmp_path), run_id="r1", ctx=ctx) as w:
+        w.emit("metrics", counters={})
+        w.link(target="publish:p1", relation="gates")
+    events = list(read_events(str(tmp_path)))
+    assert events and all(
+        e["ctx"]["trace_id"] == "trace-ev" for e in events)
+    link = [e for e in events if e["type"] == "link"][0]
+    assert link["target"] == "publish:p1"
+
+
+def test_event_writer_inherits_ctx_from_env(tmp_path, monkeypatch):
+    mint("deploy", trace_id="trace-envw").activate()
+    with EventWriter(str(tmp_path), run_id="r1") as w:
+        w.emit("metrics", counters={})
+    (event,) = read_events(str(tmp_path))
+    assert event["ctx"]["trace_id"] == "trace-envw"
+
+
+def test_scheduler_journal_carries_child_ctx(tmp_path):
+    from dib_tpu.sched.journal import read_journal
+    from dib_tpu.sched.scheduler import JobSpec, Scheduler
+
+    ctx = mint("study", trace_id="trace-sched").child("study:s1",
+                                                      origin="study")
+    sched = Scheduler(str(tmp_path), ctx=ctx)
+    job_id = sched.submit(JobSpec(name="j", betas=(0.1,), seeds=(0,)))
+    records, torn = read_journal(str(tmp_path))
+    assert torn == 0
+    jobs = [r for r in records if r.get("kind") == "job"]
+    units = [r for r in records if r.get("kind") == "unit"]
+    # the job record carries the CALLER's ctx verbatim...
+    assert jobs[0]["ctx"]["parent"] == "study:s1"
+    # ...and every unit is a child of its job
+    assert units and all(
+        u["ctx"]["parent"] == f"sched:job:{job_id}"
+        and u["ctx"]["trace_id"] == "trace-sched" for u in units)
+
+
+# ========================================================== merge ordering
+def _write_events(directory, run_id, ts, ctx=None, torn_tail=None):
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, "events.jsonl")
+    with open(path, "a") as f:
+        for i, t in enumerate(ts):
+            record = {"v": 1, "run": run_id, "proc": 0, "seq": i, "t": t,
+                      "type": "metrics", "counters": {"i": i}}
+            if ctx:
+                record["ctx"] = ctx
+            f.write(json.dumps(record) + "\n")
+        if torn_tail:
+            f.write(torn_tail)
+    return path
+
+
+def test_skewed_clocks_and_torn_line_merge_deterministically(tmp_path):
+    """Two writers with skewed clocks plus a torn final line in one
+    source merge into one deterministic order: (t, source, n) — a skewed
+    clock can never reorder one writer against itself, and the torn line
+    is held back, counted, and never parsed into garbage."""
+    a = tmp_path / "a"
+    b = tmp_path / "b"
+    # b's clock runs 100 s behind; its records interleave among a's
+    _write_events(str(a), "a", [1000.0, 1001.0, 1002.0])
+    _write_events(str(b), "b", [900.5, 1000.5, 1001.5],
+                  torn_tail='{"v": 1, "run": "b", "t": 99')
+    agg = FleetAggregator([str(a), str(b)])
+    agg.poll()
+    merged = agg.merged()
+    order = [(e["source"].split("/")[0], e["n"]) for e in merged]
+    assert order == [("b", 0), ("a", 0), ("b", 1), ("a", 1), ("b", 2),
+                     ("a", 2)]
+    # per-source n is monotone in file order no matter the clock
+    assert [n for s, n in order if s == "b"] == [0, 1, 2]
+    assert agg.torn == 0  # an INCOMPLETE final line is in-flight, not torn
+    digest_once = timeline_digest(agg.entries())
+    agg.close()
+
+    # identical digest when the same sources are polled incrementally
+    # (batching must not leak into the merged view)
+    c = tmp_path / "c"
+    d = tmp_path / "d"
+    _write_events(str(c), "a", [1000.0, 1001.0])
+    _write_events(str(d), "b", [900.5, 1000.5])
+    agg2 = FleetAggregator([str(c), str(d)])
+    agg2.poll()
+    _write_events(str(c), "a", [1002.0])
+    _write_events(str(d), "b", [1001.5])
+    agg2.poll()
+    assert sorted(agg2.merged(), key=merge_key) == \
+        [dict(e, source=e["source"]) for e in agg2.merged()]
+    agg2.close()
+    assert digest_once  # 64-hex canonical digest
+    assert len(digest_once) == 64
+
+
+def test_merged_view_is_stable_under_arrival_order(tmp_path):
+    a, b = tmp_path / "a", tmp_path / "b"
+    _write_events(str(a), "a", [10.0, 20.0])
+    _write_events(str(b), "b", [15.0])
+    one = FleetAggregator([str(a), str(b)])
+    one.poll()
+    all_at_once = timeline_digest(one.entries())
+    one.close()
+
+    # second fleet: b exists from the start but a arrives later
+    c, d = tmp_path / "c", tmp_path / "d"
+    _write_events(str(d), "b", [15.0])
+    os.makedirs(c, exist_ok=True)
+    two = FleetAggregator([str(c), str(d)])
+    two.poll()
+    _write_events(str(c), "a", [10.0, 20.0])
+    two.poll()
+    incremental = timeline_digest(two.entries())
+    two.close()
+    # source ids differ (c/d vs a/b) so raw digests differ — compare the
+    # RECORDS in merged order instead
+    assert [e["record"] for e in sorted(one.merged(), key=merge_key)] == \
+        [e["record"] for e in sorted(two.merged(), key=merge_key)]
+    assert all_at_once and incremental
+
+
+# ============================================================= kill/resume
+def test_durable_resume_zero_dup_zero_lost(tmp_path):
+    """The durable timeline IS the resume cursor: an aggregator that
+    dies mid-merge (simulated by abandoning it between polls) re-attaches
+    with zero duplicate and zero lost entries and a bit-identical merged
+    digest vs an uninterrupted merge."""
+    src = tmp_path / "src"
+    out = tmp_path / "out"
+    baseline_out = tmp_path / "baseline"
+    _write_events(str(src), "w", [float(i) for i in range(50)])
+
+    first = FleetAggregator([str(src)], out_dir=str(out))
+    first.poll()
+    # the writer keeps writing while the (killed) aggregator is away
+    first.close()
+    _write_events(str(src), "w", [float(50 + i) for i in range(30)])
+
+    resumed = FleetAggregator([str(src)], out_dir=str(out))
+    resumed.poll()
+    entries = resumed.entries()
+    keys = [(e["source"], e["n"]) for e in entries]
+    assert len(keys) == len(set(keys)) == 80          # zero duplicates
+    assert [e["record"]["t"] for e in sorted(entries, key=merge_key)] \
+        == [float(i) for i in range(80)]               # zero lost
+    resumed_digest = timeline_digest(entries)
+    resumed.close()
+
+    baseline = FleetAggregator([str(src)], out_dir=str(baseline_out))
+    baseline.poll()
+    assert timeline_digest(baseline.entries()) == resumed_digest
+    baseline.close()
+
+    # a third attach with nothing new appends nothing
+    again = FleetAggregator([str(src)], out_dir=str(out))
+    assert again.poll() == []
+    assert timeline_digest(again.entries()) == resumed_digest
+    again.close()
+
+
+def test_resume_seals_torn_timeline_line(tmp_path):
+    src = tmp_path / "src"
+    out = tmp_path / "out"
+    _write_events(str(src), "w", [1.0, 2.0])
+    agg = FleetAggregator([str(src)], out_dir=str(out))
+    agg.poll()
+    agg.close()
+    # the aggregator was killed mid-append: tear the final durable line
+    timeline = os.path.join(str(out), "timeline.jsonl")
+    with open(timeline, "rb+") as f:
+        f.seek(0, os.SEEK_END)
+        f.truncate(f.tell() - 10)
+    resumed = FleetAggregator([str(src)], out_dir=str(out))
+    resumed.poll()
+    # the torn entry was not replayed, so its record re-appends whole
+    assert [e["record"]["seq"] for e in sorted(resumed.entries(),
+                                               key=merge_key)] == [0, 1]
+    resumed.close()
+
+
+# ================================================================= orphans
+def _ctx(trace_id, parent=None, origin=("study",)):
+    out = {"trace_id": trace_id, "origin": list(origin)}
+    if parent:
+        out["parent"] = parent
+    return out
+
+
+def test_orphans_surfaced_not_dropped(tmp_path):
+    run = tmp_path / "run"
+    _write_events(str(run), "r1",
+                  [1.0], ctx=_ctx("trace-x", parent="study:ghost"))
+    agg = FleetAggregator([str(run)])
+    agg.poll()
+    analysis = agg.analyze()
+    assert len(analysis["orphans"]) == 1
+    orphan = analysis["orphans"][0]
+    assert orphan["parent"] == "study:ghost"
+    assert analysis["traces"][0]["orphans"] == 1
+    summary = agg.summary()
+    assert summary["orphan_events"] == 1
+    assert summary["metric"] == "fleet_trace"
+    agg.close()
+
+
+def test_run_parent_resolves_against_run_records(tmp_path):
+    run = tmp_path / "run"
+    _write_events(str(run), "r1", [1.0],
+                  ctx=_ctx("trace-y", parent="run:r1"))
+    agg = FleetAggregator([str(run)])
+    agg.poll()
+    assert agg.summary()["orphan_events"] == 0
+    agg.close()
+
+
+def test_fleet_summarize_cli_exit_codes(tmp_path, capsys):
+    clean = tmp_path / "clean"
+    _write_events(str(clean), "r1", [1.0], ctx=_ctx("trace-z",
+                                                    parent="run:r1"))
+    assert telemetry_main(["fleet", "summarize", str(clean)]) == 0
+    summary = json.loads(capsys.readouterr().out)
+    assert summary["value"] == 1 and summary["orphan_events"] == 0
+
+    orphaned = tmp_path / "orphaned"
+    _write_events(str(orphaned), "r2", [1.0],
+                  ctx=_ctx("trace-w", parent="study:ghost"))
+    assert fleet_main(["summarize", str(orphaned)]) == 1
+    captured = capsys.readouterr()
+    assert "ORPHAN" in captured.err and "study:ghost" in captured.err
+
+
+def test_fleet_report_and_prometheus(tmp_path, capsys):
+    run = tmp_path / "run"
+    _write_events(str(run), "r1", [1.0], ctx=_ctx("trace-r",
+                                                  parent="run:r1"))
+    with open(os.path.join(str(run), "events.jsonl"), "a") as f:
+        f.write(json.dumps({
+            "v": 1, "run": "r1", "proc": 0, "seq": 9, "t": 2.0,
+            "type": "metrics",
+            "snapshots": [{"counters.requests": 7,
+                           "gauges.inflight": 2.0}]}) + "\n")
+    out = tmp_path / "fleet.html"
+    write_fleet_report([str(run)], str(out))
+    html = out.read_text()
+    assert "trace-r" in html and "run" in html
+
+    agg = FleetAggregator([str(run)])
+    agg.poll()
+    text = fleet_prometheus(agg)
+    agg.close()
+    assert "dib_fleet_sources" in text
+    assert "dib_fleet_orphan_events 0" in text
+    assert "dib_requests 7" in text
+    assert "dib_inflight 2" in text
+
+
+# ============================================================== burn rates
+def _entries(rows):
+    return [{"plane": p, "t": t, "record": r, "source": "s", "n": i}
+            for i, (p, t, r) in enumerate(rows)]
+
+
+def test_burn_rate_fires_only_when_both_windows_burn():
+    from dib_tpu.telemetry.slo import evaluate_burn_rates
+
+    rule = {"name": "b", "bad": {"type": "alert"}, "total": {},
+            "budget": 0.1, "fast_window_s": 10.0, "slow_window_s": 100.0,
+            "threshold": 2.0, "severity": "page"}
+    # cliff in the fast window AND sustained in the slow window: fires
+    rows = [("run", 100.0 - i, {"type": "alert" if i % 4 == 0 else "m"})
+            for i in range(100)]
+    (row,) = evaluate_burn_rates([rule], _entries(rows), now=100.0)
+    assert row["status"] == "firing"
+    assert row["burn_fast"] >= 2.0 and row["burn_slow"] >= 2.0
+
+    # a brief blip: fast window burns, slow window does not → ok
+    rows = ([("run", 99.0 - 0.1 * k, {"type": "alert"}) for k in range(4)]
+            + [("run", 100.0 - i, {"type": "m"}) for i in range(100)])
+    (row,) = evaluate_burn_rates([rule], _entries(rows), now=100.0)
+    assert row["status"] == "ok"
+    assert row["burn_fast"] > 2.0 > row["burn_slow"]
+
+    # no traffic in the slow window: skipped, never fired
+    (row,) = evaluate_burn_rates([rule], [], now=100.0)
+    assert row["status"] == "skipped"
+
+
+def test_burn_alerts_land_on_originating_run_stream(tmp_path):
+    """`fleet tail --slo` semantics in-process: a firing burn rule lands
+    ONE durable alert event on the originating run's own stream — where
+    the existing check/compare gates already look — idempotently."""
+    from dib_tpu.telemetry.fleet import _BurnAlerter
+    from dib_tpu.telemetry.slo import evaluate_burn_rates
+
+    run = tmp_path / "run"
+    ts = [float(i) for i in range(20)]
+    _write_events(str(run), "r1", ts)
+    with open(os.path.join(str(run), "events.jsonl"), "a") as f:
+        for t in (18.5, 19.5):
+            f.write(json.dumps({"v": 1, "run": "r1", "proc": 0, "seq": 99,
+                                "t": t, "type": "alert",
+                                "rule": "preexisting"}) + "\n")
+    agg = FleetAggregator([str(run)])
+    agg.poll()
+    rule = {"name": "fleet_alert_burn", "bad": {"type": "alert"},
+            "total": {"plane": "run"}, "budget": 0.01,
+            "fast_window_s": 5.0, "slow_window_s": 50.0,
+            "threshold": 2.0, "severity": "page"}
+    rows = evaluate_burn_rates([rule], agg.entries(), now=19.5)
+    assert rows[0]["status"] == "firing"
+    alerter = _BurnAlerter(agg)
+    alerter.land({rule["name"]: rule}, rows, now=19.5)
+    alerter.land({rule["name"]: rule}, rows, now=19.5)  # idempotent
+    alerter.close()
+    agg.close()
+    alerts = [e for e in read_events(str(run))
+              if e["type"] == "alert" and e.get("rule") == rule["name"]]
+    assert len(alerts) == 1
+    assert alerts[0]["source"] == "fleet"
+    assert alerts[0]["burn_fast"] >= 2.0
+    assert alerts[0]["windows_s"] == [5.0, 50.0]
+    assert alerter.written == [{"rule": "fleet_alert_burn",
+                                "dir": str(run)}]
+
+
+def test_fleet_tail_cli_once_with_slo(tmp_path, capsys):
+    run = tmp_path / "run"
+    _write_events(str(run), "r1", [1.0, 2.0])
+    slo = tmp_path / "slo.json"
+    slo.write_text(json.dumps({
+        "rules": [{"name": "r", "metric": "m", "min": 0.0}],
+        "burn_rates": [{"name": "quiet", "bad": {"type": "alert"},
+                        "budget": 0.5, "fast_window_s": 1.0,
+                        "slow_window_s": 10.0, "threshold": 2.0}],
+    }))
+    rc = fleet_main(["tail", str(run), "--out", str(tmp_path / "out"),
+                     "--slo", str(slo), "--once"])
+    assert rc == 0
+    line = json.loads(capsys.readouterr().out.splitlines()[0])
+    assert line["entries"] == 2 and line["firing"] == []
+    assert os.path.exists(tmp_path / "out" / "timeline.jsonl")
+
+
+# ============================================================== discovery
+def test_discover_sources_labels_and_planes(tmp_path):
+    root = tmp_path / "root"
+    _write_events(str(root / "runA"), "a", [1.0])
+    os.makedirs(root / "study")
+    for name in ("journal.jsonl", "study.jsonl", "publishes.jsonl"):
+        with open(root / "study" / name, "w") as f:
+            f.write(json.dumps({"v": 1, "t": 1.0, "kind": "x"}) + "\n")
+    sources = discover_sources([str(root)])
+    by_plane = {s["plane"] for s in sources}
+    assert by_plane == {"run", "sched", "study", "stream"}
+    assert all(s["source"].startswith("root/") for s in sources)

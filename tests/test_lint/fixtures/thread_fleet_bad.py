@@ -1,0 +1,26 @@
+"""Fixture: the fleet-aggregator race shape (ISSUE 16) — a background
+merge thread rebinds the shared timeline and advances the consumed
+cursor while readers snapshot them, and the class holds no lock."""
+
+import threading
+
+
+class UnlockedAggregator:
+    def __init__(self, sources):
+        self.sources = sources
+        self.timeline = []
+        self.consumed = 0
+        threading.Thread(target=self._merge_loop, daemon=True).start()
+
+    def _merge_loop(self):
+        while True:
+            for source in self.sources:
+                for record in source.poll():
+                    # BUG: readers snapshot timeline/consumed concurrently
+                    # — no lock anywhere in the class
+                    self.timeline = self.timeline + [record]
+                    self.consumed += 1
+                    self.last_t = record.get("t")
+
+    def merged(self):
+        return sorted(self.timeline, key=lambda e: e.get("t", 0.0))

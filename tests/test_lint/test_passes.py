@@ -213,6 +213,9 @@ def test_host_sync_targets_only_chunk_loop_modules():
     # boundary already pays for; the digest/scrub layer syncs explicitly
     # ...and (ISSUE 15) the study controller, which drives the pool's
     # many concurrent chunk loops from its decision core
+    # ...and (ISSUE 16) the fleet aggregator, whose one poll loop
+    # follows MANY runs' planes — an implicit fetch there stalls the
+    # merge for every source at once
     assert set(host.target_modules) == {
         "dib_tpu/train/loop.py",
         "dib_tpu/train/measurement.py",
@@ -234,6 +237,7 @@ def test_host_sync_targets_only_chunk_loop_modules():
         "dib_tpu/train/scrub.py",
         "dib_tpu/train/checkpoint.py",
         "dib_tpu/study/controller.py",
+        "dib_tpu/telemetry/fleet.py",
     }
 
 
@@ -289,6 +293,31 @@ def test_thread_flags_method_and_closure_targets(load_fixture):
 def test_thread_locked_class_is_trusted(load_fixture):
     module = load_fixture("thread_good.py")
     assert _findings(module, "thread-shared-state") == []
+
+
+def test_thread_flags_the_fleet_aggregator_shape(load_fixture):
+    """ISSUE 16: an aggregator thread mutating the shared timeline (and
+    its per-source cursors) without a lock is the exact race the real
+    FleetAggregator guards with self._lock — pin that the lockless shape
+    is flagged so the guard can never be silently dropped."""
+    module = load_fixture("thread_fleet_bad.py")
+    findings = _findings(module, "thread-shared-state")
+    lines = {f.line for f in findings}
+    assert line_of(module, "self.timeline = self.timeline + [record]") in lines
+    assert line_of(module, "self.consumed += 1") in lines
+    assert all("UnlockedAggregator" in f.message for f in findings)
+
+
+def test_thread_state_covers_the_fleet_aggregator():
+    """ISSUE 16 coverage pin: thread-shared-state stays tree-wide and
+    telemetry/fleet.py is not allowlisted away — the real aggregator's
+    lock discipline is enforced by the zero-findings full-tree gate."""
+    from dib_tpu.analysis.core import get_pass
+
+    thread_pass = get_pass("thread-shared-state")
+    assert not getattr(thread_pass, "target_modules", None)
+    assert "dib_tpu/telemetry/fleet.py" not in getattr(
+        thread_pass, "allowlist", {})
 
 
 def test_thread_target_resolves_in_the_spawning_class(tmp_path):

@@ -166,6 +166,48 @@ def test_runs_cli_list_show_trajectory(tmp_path, capsys):
     assert "no runs registered" in capsys.readouterr().out
 
 
+def test_runs_cli_lineage_column_and_origin_chain(tmp_path, capsys):
+    """Traced streams surface their causal lineage on the registry CLI:
+    `runs list` shows the cross-plane join key (parent ref, falling back
+    to the trace id) and `runs show` prints the origin chain line; a
+    pre-tracing stream stays blank instead of inventing lineage."""
+    from dib_tpu.telemetry.context import mint
+
+    root = str(tmp_path / "root")
+    ctx = mint("study", trace_id="trace-lin").child("sched:unit:u7",
+                                                    origin="sched")
+    with EventWriter(str(tmp_path / "traced"), run_id="traced-run",
+                     ctx=ctx) as w:
+        w.run_start({"device_kind": "cpu", "config_hash": "cafe"})
+        w.run_end(status="ok")
+    register_run(str(tmp_path / "traced"), root=root)
+    _write_stream(tmp_path / "plain", run_id="plain-run")
+    register_run(str(tmp_path / "plain"), root=root)
+
+    assert telemetry_main(["runs", "list", "--runs-root", root]) == 0
+    out = capsys.readouterr().out
+    assert "lineage" in out                      # the column header
+    traced_line = [l for l in out.splitlines() if "traced-run" in l][0]
+    assert "sched:unit:u7" in traced_line
+    plain_line = [l for l in out.splitlines() if "plain-run" in l][0]
+    assert "trace" not in plain_line
+
+    assert telemetry_main(["runs", "show", "traced-run",
+                           "--runs-root", root]) == 0
+    captured = capsys.readouterr()
+    # the origin chain rides stderr; stdout stays pure JSON for piping
+    assert "lineage: trace trace-lin" in captured.err
+    assert "parent sched:unit:u7" in captured.err
+    assert "study → sched" in captured.err
+    assert json.loads(captured.out)["lineage"]["trace_id"] == "trace-lin"
+
+    assert telemetry_main(["runs", "show", "plain-run",
+                           "--runs-root", root]) == 0
+    captured = capsys.readouterr()
+    assert "lineage:" not in captured.err
+    assert "lineage" not in json.loads(captured.out)
+
+
 def test_workload_cli_registers_run_at_end(tmp_path, capsys):
     """End-of-run registration through the real CLI surface: a boolean
     workload run with --runs-root lands in the index with its headline

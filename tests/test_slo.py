@@ -52,6 +52,46 @@ def test_validate_slo_accepts_minimal_and_rejects_shapes():
     assert any("kl_threshold_nats" in p for p in problems)
 
 
+def test_validate_burn_rates_grammar():
+    base = {"rules": [{"name": "a", "metric": "m", "min": 1.0}]}
+    good = dict(base, burn_rates=[
+        {"name": "br", "bad": {"type": "alert"}, "total": {},
+         "budget": 0.1, "fast_window_s": 60, "slow_window_s": 3600,
+         "threshold": 2.0, "severity": "page"}])
+    assert validate_slo(good) == []
+
+    bad = dict(base, burn_rates=[
+        {"bad": {"type": "alert"}, "budget": 0.1,          # no name
+         "fast_window_s": 60, "slow_window_s": 3600, "threshold": 2},
+        {"name": "a", "bad": {"type": "alert"},            # dup vs rules
+         "budget": 0.1, "fast_window_s": 60, "slow_window_s": 3600,
+         "threshold": 2},
+        {"name": "b", "bad": {},                           # empty matcher
+         "budget": 0.1, "fast_window_s": 60, "slow_window_s": 3600,
+         "threshold": 2},
+        {"name": "c", "bad": {"type": "alert"},            # budget > 1
+         "budget": 2.0, "fast_window_s": 60, "slow_window_s": 3600,
+         "threshold": 2},
+        {"name": "d", "bad": {"type": "alert"},            # slow <= fast
+         "budget": 0.1, "fast_window_s": 60, "slow_window_s": 60,
+         "threshold": 2},
+        {"name": "e", "bad": {"type": "alert"},            # bad threshold
+         "budget": 0.1, "fast_window_s": 60, "slow_window_s": 3600,
+         "threshold": 0},
+        "not-an-object",
+    ])
+    problems = validate_slo(bad)
+    assert any("'name' must be" in p for p in problems)
+    assert any("duplicate rule name 'a'" in p for p in problems)
+    assert any("'bad' must be" in p for p in problems)
+    assert any("'budget' must be" in p for p in problems)
+    assert any("greater than 'fast_window_s'" in p for p in problems)
+    assert any("'threshold' must be" in p for p in problems)
+    assert any("must be an object" in p for p in problems)
+    assert validate_slo(dict(base, burn_rates="x")) \
+        == ["'burn_rates' must be a list"]
+
+
 def test_load_slo_raises_on_invalid(tmp_path):
     path = tmp_path / "slo.json"
     path.write_text(json.dumps({"rules": []}))
@@ -66,6 +106,10 @@ def test_committed_slo_json_is_valid():
     assert "north_star_mfu_floor" in names
     assert "serve_p99_ceiling" in names
     assert "no_undetected_faults" in names
+    assert "fleet_orphan_ceiling" in names
+    burn_names = [r["name"] for r in spec.get("burn_rates") or []]
+    assert "fleet_alert_burn" in burn_names
+    assert "fleet_mitigation_burn" in burn_names
     assert spec["transitions"]["kl_threshold_nats"] > 0
 
 
