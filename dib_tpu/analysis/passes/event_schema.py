@@ -66,6 +66,10 @@ _DOC_KIND_RE = re.compile(r"\*\*`([a-z_]+)`\*\*")
 #: The docs table header that opens the envelope-field table (the rows
 #: from here to the first non-`|` line are the documented envelope).
 _ENVELOPE_MARKER = "| field | meaning |"
+#: The docs table header that opens the request-phase table (ISSUE 17
+#: "Request anatomy"): the documented phase vocabulary must mirror
+#: telemetry/events.py REQUEST_PHASES exactly.
+_PHASE_MARKER = "| phase | meaning |"
 #: The docs line that opens the serving-rollup key list (the list itself
 #: is the backticked names from here to the next blank line).
 _SERVING_KEYS_MARKER = "Serving-rollup keys"
@@ -275,6 +279,80 @@ class EventSchemaPass(LintPass):
                         and isinstance(elt.value, str)}
         return None
 
+    @staticmethod
+    def request_phases(root: str) -> set[str] | None:
+        """REQUEST_PHASES as telemetry/events.py declares it, read from
+        the AST (None when the module or the tuple cannot be found)."""
+        path = os.path.join(root, "dib_tpu", "telemetry", "events.py")
+        try:
+            with open(path, encoding="utf-8") as f:
+                tree = ast.parse(f.read())
+        except (OSError, SyntaxError):
+            return None
+        for node in tree.body:
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id == "REQUEST_PHASES"):
+                continue
+            if isinstance(node.value, (ast.Tuple, ast.List)):
+                return {elt.value for elt in node.value.elts
+                        if isinstance(elt, ast.Constant)
+                        and isinstance(elt.value, str)}
+        return None
+
+    def _check_phase_docs(self, root: str,
+                          lines: list[str]) -> list[Finding]:
+        """The request-phase table in docs/observability.md must name
+        exactly events.py's REQUEST_PHASES (ISSUE 17 — the phase clock's
+        vocabulary is closed: a phase the server stamps cannot ship
+        undocumented, and a documented phase the clock dropped is
+        drift)."""
+        doc_rel = "docs/observability.md"
+        events_rel = "dib_tpu/telemetry/events.py"
+        declared = self.request_phases(root)
+        if declared is None:
+            if os.path.exists(os.path.join(root, events_rel)):
+                return [Finding(
+                    self.id, events_rel, 1,
+                    "REQUEST_PHASES not found as a top-level tuple in "
+                    "telemetry/events.py — the phase-table docs guard "
+                    "has lost its anchor")]
+            return []
+        marker_line = None
+        documented: dict[str, int] = {}
+        for lineno, line in enumerate(lines, 1):
+            if marker_line is None:
+                if line.strip().startswith(_PHASE_MARKER):
+                    marker_line = lineno
+                continue
+            stripped = line.strip()
+            if not stripped.startswith("|"):
+                break
+            cells = stripped.split("|")
+            if len(cells) > 1:
+                for phase in _BACKTICKED_RE.findall(cells[1]):
+                    documented.setdefault(phase, lineno)
+        if marker_line is None:
+            return [Finding(
+                self.id, doc_rel, 1,
+                "docs/observability.md has no request-phase table "
+                f"({_PHASE_MARKER!r}) — the phase-clock vocabulary must "
+                "stay documented")]
+        findings: list[Finding] = []
+        for phase in sorted(declared - set(documented)):
+            findings.append(Finding(
+                self.id, doc_rel, marker_line,
+                f"request phase {phase!r} is in telemetry/events.py "
+                "REQUEST_PHASES but missing from the phase table"))
+        for phase, lineno in sorted(documented.items()):
+            if phase not in declared and phase != "---":
+                findings.append(Finding(
+                    self.id, doc_rel, lineno,
+                    f"documented request phase {phase!r} is not in "
+                    "telemetry/events.py REQUEST_PHASES — the code is "
+                    "the source of truth"))
+        return findings
+
     def _check_envelope_docs(self, root: str,
                              lines: list[str]) -> list[Finding]:
         """The envelope table in docs/observability.md must name exactly
@@ -425,6 +503,7 @@ class EventSchemaPass(LintPass):
                     "row — the registry is the source of truth",
                 ))
         findings.extend(self._check_envelope_docs(root, lines))
+        findings.extend(self._check_phase_docs(root, lines))
         for fn_name, marker in _ROLLUP_DOC_CHECKS:
             findings.extend(self._check_rollup_docs(root, lines,
                                                     fn_name, marker))

@@ -1034,6 +1034,13 @@ def serve_parser() -> argparse.ArgumentParser:
 
 
 def serve_main(argv: Sequence[str]) -> int:
+    if argv and argv[0] == "top":
+        # live fleet dashboard: attaches to a RUNNING fleet over HTTP, so
+        # it must not require (or parse) any of the serve flags — and it
+        # never imports jax
+        from dib_tpu.serve.top import serve_top_main
+
+        return serve_top_main(list(argv[1:]))
     args = serve_parser().parse_args(argv)
     if args.prefork > 0:
         # prefork supervisor: N worker re-execs of this same command on

@@ -74,11 +74,11 @@ class _Request:
     """One submitted request: rows + a one-shot result slot."""
 
     __slots__ = ("op", "rows", "deadline", "submitted", "dispatched",
-                 "tenant", "_event", "_result", "_error", "_cb_lock",
-                 "_callbacks")
+                 "collected", "dispatch_start", "server_span", "tenant",
+                 "_event", "_result", "_error", "_cb_lock", "_callbacks")
 
     def __init__(self, op: str, rows: np.ndarray, deadline: float | None,
-                 tenant: str | None = None):
+                 tenant: str | None = None, server_span: bool = False):
         self.op = op
         self.rows = rows
         self.deadline = deadline
@@ -89,6 +89,20 @@ class _Request:
         # replica never got to show whether it is slow), after it the
         # dispatch itself missed the deadline
         self.dispatched = False
+        # phase-clock stamps, written by the batcher worker and read by
+        # the HTTP server AFTER the future completes (so no torn reads):
+        # collected = dequeued into a micro-batch (queue wait ends),
+        # dispatch_start = the engine call carrying these rows began
+        # (batch-formation ends). perf_counter is process-wide, so these
+        # telescope onto the server's own stamp timeline.
+        self.collected = None
+        self.dispatch_start = None
+        # True when the HTTP front end owns the request span (it has the
+        # full read→write anatomy; the batcher only sees the middle) —
+        # _finish then skips span emission so each request lands exactly
+        # one span, but keeps the status counters (they are the
+        # authoritative "what did the batcher do" tally).
+        self.server_span = server_span
         self._event = threading.Event()
         self._result = None
         self._error = None
@@ -219,11 +233,16 @@ class MicroBatcher:
     # --------------------------------------------------------------- client
     def submit(self, x, op: str = "predict",
                timeout_s: float | None = None,
-               tenant: str | None = None) -> _Request:
+               tenant: str | None = None,
+               server_span: bool = False) -> _Request:
         """Enqueue one request; returns its future. Validation is eager —
         a malformed request never reaches a batch. ``tenant`` is an
         optional label carried onto the request's span event (the server's
-        per-tenant quota accounting reads the stream by it)."""
+        per-tenant quota accounting reads the stream by it).
+        ``server_span=True`` hands request-span ownership to the caller
+        (the asyncio server's phase clock) — must be set HERE, at
+        construction, because a fast dispatch can ``_finish`` before
+        ``submit`` even returns."""
         if self._closed:
             raise BatcherClosed("batcher is closed")
         if op not in ("predict", "encode"):
@@ -245,7 +264,8 @@ class MicroBatcher:
         deadline = (
             time.perf_counter() + timeout_s if timeout_s is not None else None   # timing-ok: host-side queue/latency clock, no jitted call in the interval
         )
-        request = _Request(op, rows, deadline, tenant=tenant)
+        request = _Request(op, rows, deadline, tenant=tenant,
+                           server_span=server_span)
         with self._lifecycle:
             if self._closed:
                 raise BatcherClosed("batcher is closed")
@@ -336,6 +356,7 @@ class MicroBatcher:
                     request = self._queue.get_nowait()
                 except queue.Empty:
                     break
+                request.collected = time.perf_counter()   # timing-ok: host-side queue/latency clock, no jitted call in the interval
                 batch.append(request)
                 rows += request.rows.shape[0]
             if batch:
@@ -344,6 +365,7 @@ class MicroBatcher:
             first = self._queue.get(timeout=0.05)
         except queue.Empty:
             return []
+        first.collected = time.perf_counter()   # timing-ok: host-side queue/latency clock, no jitted call in the interval
         batch = [first]
         rows = first.rows.shape[0]
         deadline = time.perf_counter() + self.max_wait_s   # timing-ok: host-side queue/latency clock, no jitted call in the interval
@@ -355,6 +377,7 @@ class MicroBatcher:
                 request = self._queue.get(timeout=remaining)
             except queue.Empty:
                 break
+            request.collected = time.perf_counter()   # timing-ok: host-side queue/latency clock, no jitted call in the interval
             batch.append(request)
             rows += request.rows.shape[0]
         return batch
@@ -401,8 +424,10 @@ class MicroBatcher:
         return capacity
 
     def _dispatch_group(self, op: str, requests: list[_Request]) -> None:
+        group_t0 = time.perf_counter()   # timing-ok: host-side queue/latency clock, no jitted call in the interval
         for request in requests:
             request.dispatched = True
+            request.dispatch_start = group_t0
         rows = np.concatenate([r.rows for r in requests])
         n = rows.shape[0]
         bucket = (self.engine.bucket_for(n)
@@ -446,7 +471,7 @@ class MicroBatcher:
 
     def _finish(self, request: _Request, status: str, now: float) -> None:
         latency = now - request.submitted
-        if self.tracer is not None:
+        if self.tracer is not None and not request.server_span:
             tags = {}
             if request.tenant is not None:
                 tags["tenant"] = request.tenant
@@ -454,4 +479,9 @@ class MicroBatcher:
                             rows=int(request.rows.shape[0]), **tags)
         if self.registry is not None:
             self.registry.counter(f"serve.requests.{status}").inc()
-            self.registry.histogram("serve.request_latency_s").record(latency)
+            if not request.server_span:
+                # server_span requests get their END-TO-END latency
+                # recorded by the HTTP server instead — recording the
+                # batcher-interior slice too would double count
+                self.registry.histogram(
+                    "serve.request_latency_s").record(latency)

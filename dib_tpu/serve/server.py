@@ -61,9 +61,26 @@ Telemetry: the server owns the run bracket (``run_start`` manifest with
 ``mode: "serve"`` … ``run_end`` on graceful shutdown) and emits a final
 ``metrics`` rollup, so a serving run directory summarizes and renders
 with the same ``telemetry summarize|report`` tooling as a training run.
-``request``/``batch`` span events keep their PR 3 meaning exactly:
-request = submit → result (queue + dispatch + split), batch = one padded
-engine dispatch.
+``batch`` span events keep their PR 3 meaning exactly: one padded engine
+dispatch.
+
+Request anatomy (docs/observability.md "Request anatomy"): every HTTP
+request through the op routes carries a :class:`_PhaseClock` — an ordered
+sequence of monotonic ``perf_counter`` stamps at read (headers+body), parse
+(JSON decode), admission (quota + shed checks), queue (batcher wait),
+batch (micro-batch formation), dispatch (engine execution + loop wake),
+serialize (``json.dumps``), and write (socket drain). The ``request``
+span is emitted by the SERVER after the socket write, end-to-end, with a
+``phases`` field whose values telescope to the span's ``seconds``
+exactly (consecutive stamp diffs of one timeline — the batcher worker
+stamps ``collected``/``dispatch_start`` onto the request object with the
+same process-wide clock). The batcher suppresses its own request span
+for these (``server_span=True``) so each request lands exactly one span;
+cached hits carry only read/parse/admission/dispatch/serialize/write,
+quota/shed rejections only read/parse/admission/serialize/write. Per
+phase, ``serve.phase.<name>`` histograms (and the end-to-end
+``serve.request_latency_s``) expose fleet-mergeable bucket counts on
+``/metrics`` — see ``python -m dib_tpu serve top``.
 """
 
 from __future__ import annotations
@@ -87,6 +104,48 @@ _DEFAULT_REQUEST_TIMEOUT_S = 30.0
 _MAX_BODY_BYTES = 8 << 20   # 8 MiB: ~1M f32 features as JSON text
 _IDLE_KEEPALIVE_S = 120.0   # reap silent keep-alive sockets
 _OPS = {"/v1/predict": "predict", "/v1/encode": "encode"}
+
+
+class _PhaseClock:
+    """Ordered monotonic stamp sequence for ONE HTTP request.
+
+    ``stamps`` is ``[(phase_name, perf_counter), ...]`` starting at the
+    request line's arrival; phases are the diffs of consecutive stamps,
+    each named by its LATER stamp (``phases()``), so they telescope to
+    exactly last-minus-first — the span's ``seconds`` — by construction.
+    Repeated names accumulate (a replica retry re-traverses
+    queue/batch/dispatch and each traversal adds to its phase).
+
+    ``meta`` is the span-emission payload (status/op/rows/tenant/cached)
+    or None — None means this request emits NO span, exactly the
+    statuses that never did (400/404, queue-full and no-replica 503s).
+    """
+
+    __slots__ = ("stamps", "meta")
+
+    def __init__(self, t0: float):
+        self.stamps: list[tuple[str, float]] = [("t0", t0)]
+        self.meta: dict | None = None
+
+    def stamp(self, name: str, t: float | None = None) -> None:
+        if t is None:
+            t = time.perf_counter()   # timing-ok: host-side queue/latency clock, no jitted call in the interval
+        # clamp: batcher-thread stamps sampled from request attributes can
+        # race a few ns behind the loop's own last stamp; clamping keeps
+        # every phase >= 0 without disturbing the telescoped total
+        prev = self.stamps[-1][1]
+        self.stamps.append((name, t if t > prev else prev))
+
+    def phases(self) -> dict[str, float]:
+        out: dict[str, float] = {}
+        prev = self.stamps[0][1]
+        for name, t in self.stamps[1:]:
+            out[name] = out.get(name, 0.0) + (t - prev)
+            prev = t
+        return out
+
+    def elapsed(self) -> float:
+        return self.stamps[-1][1] - self.stamps[0][1]
 
 
 class TenantQuotas:
@@ -181,6 +240,15 @@ class DIBServer:
                                          registry=registry))
         self.telemetry = telemetry
         self.registry = registry
+        if tracer is None and telemetry is not None:
+            # The server owns the request span (it has the full
+            # read→write anatomy; the batcher suppresses its own via
+            # server_span=True), so a telemetry-enabled server must be
+            # able to EMIT it even when the caller only wired a tracer
+            # into the batchers.
+            from dib_tpu.telemetry.trace import Tracer
+
+            tracer = Tracer(telemetry)
         self.tracer = tracer
         self.quotas = quotas
         self.admission_limit = (int(admission_limit)
@@ -318,6 +386,8 @@ class DIBServer:
                                       {"error": "malformed request line"},
                                       close=True)
                     break
+                clock = (_PhaseClock(time.perf_counter())   # timing-ok: host-side queue/latency clock, no jitted call in the interval
+                         if method == "POST" and path in _OPS else None)
                 headers = await self._read_headers(reader)
                 if headers is None:
                     break
@@ -339,10 +409,14 @@ class DIBServer:
                                       close=True)
                     break
                 body = await reader.readexactly(length) if length else b""
+                if clock is not None:
+                    clock.stamp("read")
                 try:
                     status, payload, extra_headers = await self._dispatch(
-                        method, path, headers, body)
+                        method, path, headers, body, clock)
                 except Exception as exc:   # never let a bug kill the socket
+                    if clock is not None:
+                        clock.meta = None   # escaped bugs never emitted spans
                     status, payload, extra_headers = 500, {
                         "error": f"{type(exc).__name__}: {exc}"}, {}
                 if isinstance(payload, str):
@@ -352,7 +426,9 @@ class DIBServer:
                 else:
                     await self._reply(writer, status, payload,
                                       headers=extra_headers,
-                                      close=not keep_alive)
+                                      close=not keep_alive, clock=clock)
+                    if clock is not None:
+                        self._finalize_request(clock)
                 if not keep_alive:
                     break
         except (asyncio.IncompleteReadError, ConnectionResetError,
@@ -380,10 +456,12 @@ class DIBServer:
 
     async def _reply(self, writer, status: int, payload: dict,
                      headers: dict | None = None,
-                     close: bool = False) -> None:
+                     close: bool = False, clock=None) -> None:
         blob = json.dumps(payload).encode()
+        if clock is not None:
+            clock.stamp("serialize")
         await self._write_response(
-            writer, status, blob, "application/json", headers, close)
+            writer, status, blob, "application/json", headers, close, clock)
 
     async def _reply_text(self, writer, status: int, text: str,
                           headers: dict | None = None,
@@ -395,7 +473,7 @@ class DIBServer:
     @staticmethod
     async def _write_response(writer, status: int, blob: bytes,
                               content_type: str, headers: dict | None,
-                              close: bool) -> None:
+                              close: bool, clock=None) -> None:
         reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
                   413: "Payload Too Large", 429: "Too Many Requests",
                   500: "Internal Server Error", 503: "Service Unavailable",
@@ -412,10 +490,12 @@ class DIBServer:
             head.append("Connection: close")
         writer.write(("\r\n".join(head) + "\r\n\r\n").encode() + blob)
         await writer.drain()
+        if clock is not None:
+            clock.stamp("write")
 
     # ----------------------------------------------------------- app logic
     async def _dispatch(self, method: str, path: str, headers: dict,
-                        body: bytes):
+                        body: bytes, clock=None):
         """(status, payload | prometheus text, extra headers) for one
         parsed request."""
         if method == "GET":
@@ -433,8 +513,10 @@ class DIBServer:
             return 400, {"error": f"invalid JSON: {exc}"}, {}
         tenant = headers.get("x-dib-tenant") \
             or (parsed.get("tenant") if isinstance(parsed, dict) else None)
+        if clock is not None:
+            clock.stamp("parse")
         status, payload, extra = await self.handle_post_async(
-            path, parsed, tenant=tenant)
+            path, parsed, tenant=tenant, clock=clock)
         return status, payload, extra
 
     def metrics_text(self) -> str:
@@ -594,9 +676,42 @@ class DIBServer:
             self.handle_post_async(path, body, tenant=tenant))
         return status, payload
 
+    def _finalize_request(self, clock: _PhaseClock) -> None:
+        """Emit the end-to-end request span (with its ``phases`` anatomy)
+        and the per-phase / end-to-end histograms — called by the
+        connection loop AFTER the socket write, so every phase including
+        ``write`` is on the span. ``meta is None`` means this request's
+        status never emitted a span (parity with the pre-phase-clock
+        behavior) and records nothing."""
+        meta = clock.meta
+        if meta is None:
+            return
+        phases = clock.phases()
+        seconds = clock.elapsed()
+        if self.tracer is not None:
+            tags: dict = {}
+            if meta.get("tenant") is not None:
+                tags["tenant"] = meta["tenant"]
+            if meta.get("cached"):
+                tags["cached"] = True
+            self.tracer.add(
+                "request", seconds, op=meta["op"], status=meta["status"],
+                rows=int(meta["rows"]),
+                phases={k: round(v, 9) for k, v in phases.items()}, **tags)
+        if self.registry is not None:
+            if meta["status"] in ("ok", "error", "timeout") \
+                    and not meta.get("cached"):
+                # same population the batcher used to record (requests
+                # that entered it), but now END-TO-END read->write
+                self.registry.histogram(
+                    "serve.request_latency_s").record(seconds)
+            for name, dt in phases.items():
+                self.registry.histogram(f"serve.phase.{name}").record(dt)
+
     async def handle_post_async(
             self, path: str, body: dict,
-            tenant: str | None = None) -> tuple[int, dict, dict]:
+            tenant: str | None = None,
+            clock: _PhaseClock | None = None) -> tuple[int, dict, dict]:
         op = _OPS.get(path)
         if op is None:
             return 404, {"error": f"no route {path!r}"}, {}
@@ -621,7 +736,12 @@ class DIBServer:
             if retry_after > 0:
                 if self.registry is not None:
                     self.registry.counter("serve.requests.quota").inc()
-                self._span("quota", op, 0, time.monotonic() - t0, tenant)
+                if clock is not None:
+                    clock.stamp("admission")
+                    clock.meta = {"status": "quota", "op": op, "rows": 0,
+                                  "tenant": tenant}
+                else:
+                    self._span("quota", op, 0, time.monotonic() - t0, tenant)
                 return 429, {
                     "error": f"tenant {tenant!r} is over its request "
                              "quota; retry after the indicated backoff",
@@ -632,11 +752,22 @@ class DIBServer:
                 and self._inflight >= self.admission_limit:
             if self.registry is not None:
                 self.registry.counter("serve.requests.shed").inc()
-            self._span("shed", op, 0, time.monotonic() - t0, tenant)
+            if clock is not None:
+                clock.stamp("admission")
+                clock.meta = {"status": "shed", "op": op, "rows": 0,
+                              "tenant": tenant}
+            else:
+                self._span("shed", op, 0, time.monotonic() - t0, tenant)
             return 503, {
                 "error": f"admission limit ({self.admission_limit} "
                          "in-flight requests) reached; retry with backoff",
             }, {}
+        if clock is not None:
+            # admission passed — everything from here to the batcher's
+            # queue pickup (model/cache resolution, submit) is "admission"
+            # only up to this stamp; cache hits charge resolution+lookup
+            # to "dispatch", queued requests to "queue"
+            clock.stamp("admission")
 
         # ---- model + cache resolution
         try:
@@ -658,21 +789,54 @@ class DIBServer:
                 payload["model"] = model_name
                 payload["cached"] = True
                 n = int(rows.shape[0]) if rows.ndim == 2 else 1
-                self._span("ok", op, n, time.monotonic() - t0, tenant,
-                           cached=True)
+                if clock is not None:
+                    clock.stamp("dispatch")
+                    clock.meta = {"status": "ok", "op": op, "rows": n,
+                                  "tenant": tenant, "cached": True}
+                else:
+                    self._span("ok", op, n, time.monotonic() - t0, tenant,
+                               cached=True)
                 return 200, payload, {}
 
         self._inflight += 1
         try:
             return await self._routed_dispatch(
                 router, model_name, op, body, beta, tenant, deadline,
-                timeout_s, cache, cache_key)
+                timeout_s, cache, cache_key, clock)
         finally:
             self._inflight -= 1
 
+    @staticmethod
+    def _request_rows(request) -> int:
+        rows = getattr(request, "rows", None)
+        return int(rows.shape[0]) if hasattr(rows, "shape") else 0
+
+    @staticmethod
+    def _stamp_batcher_phases(clock: _PhaseClock, request) -> None:
+        """Fold the batcher worker's stamps into the clock's timeline:
+        queue ends at ``collected`` (dequeued into a micro-batch), batch
+        at ``dispatch_start`` (engine call began), dispatch at NOW (the
+        result reached the loop — includes the loop-wake residual). A
+        request the batcher never collected charges its whole wait to
+        ``queue``; collected-but-undispatched charges the tail to
+        ``batch``. perf_counter is process-wide, so worker-thread stamps
+        telescope on the loop's own timeline."""
+        now = time.perf_counter()   # timing-ok: host-side queue/latency clock, no jitted call in the interval
+        collected = getattr(request, "collected", None)
+        if collected is None:
+            clock.stamp("queue", now)
+            return
+        clock.stamp("queue", collected)
+        dispatch_start = getattr(request, "dispatch_start", None)
+        if dispatch_start is None:
+            clock.stamp("batch", now)
+            return
+        clock.stamp("batch", dispatch_start)
+        clock.stamp("dispatch", now)
+
     async def _routed_dispatch(self, router, model_name, op, body, beta,
                                tenant, deadline, timeout_s, cache,
-                               cache_key) -> tuple[int, dict, dict]:
+                               cache_key, clock=None) -> tuple[int, dict, dict]:
         # Retry loop: an engine-side failure marks the replica and moves
         # the request to the next healthy one — a client call only fails
         # when EVERY routable replica failed it (or its own input/deadline
@@ -680,9 +844,16 @@ class DIBServer:
         # timeout_s must never wait num_replicas x timeout_s.
         tried: set[int] = set()
         last_error: Exception | None = None
+        request = None
+        owns_span = owned_any = False   # True: batcher span suppressed,
+        #                                 the server's clock owns it
         while len(tried) < len(router.entries):
             remaining = deadline - time.monotonic()
             if remaining <= 0:
+                if clock is not None and owned_any:
+                    clock.meta = {"status": "timeout", "op": op,
+                                  "rows": self._request_rows(request),
+                                  "tenant": tenant}
                 return 504, {
                     "error": f"request deadline ({timeout_s}s) exhausted "
                              f"after {len(tried)} failed replica "
@@ -701,9 +872,26 @@ class DIBServer:
             try:
                 submit = getattr(entry.batcher, "submit", None)
                 if submit is not None:
-                    request = submit(body["x"], op, timeout_s=remaining,
-                                     tenant=tenant)
+                    owns_span = False
+                    if clock is not None:
+                        try:
+                            request = submit(body["x"], op,
+                                             timeout_s=remaining,
+                                             tenant=tenant,
+                                             server_span=True)
+                            owns_span = owned_any = True
+                        except TypeError:
+                            # duck-typed fake without the kwarg: it (or
+                            # its inner batcher) keeps span ownership
+                            request = submit(body["x"], op,
+                                             timeout_s=remaining,
+                                             tenant=tenant)
+                    else:
+                        request = submit(body["x"], op, timeout_s=remaining,
+                                         tenant=tenant)
                     result = await request.wait_async(remaining)
+                    if clock is not None and owns_span:
+                        self._stamp_batcher_phases(clock, request)
                 else:
                     # duck-typed batcher with only the blocking-call
                     # interface (drill fakes): park it on the default
@@ -730,6 +918,11 @@ class DIBServer:
                 # replica. The deadline is spent either way — no retry.
                 if not getattr(exc, "in_queue", False):
                     router.report_failure(entry, exc)
+                if clock is not None and owns_span:
+                    self._stamp_batcher_phases(clock, request)
+                    clock.meta = {"status": "timeout", "op": op,
+                                  "rows": self._request_rows(request),
+                                  "tenant": tenant}
                 return 504, {"error": str(exc)}, {}
             except (ValueError, TypeError) as exc:
                 return 400, {"error": str(exc)}, {}
@@ -743,6 +936,11 @@ class DIBServer:
                 router.report_failure(entry, exc)
                 tried.add(entry.index)
                 last_error = exc
+                if clock is not None and owns_span:
+                    # charge the failed attempt's traversal now; the next
+                    # attempt's queue/batch/dispatch ACCUMULATE onto the
+                    # same phase names
+                    self._stamp_batcher_phases(clock, request)
                 continue
             router.report_success(entry)
             if cache is not None and cache_key is not None:
@@ -751,7 +949,15 @@ class DIBServer:
                        for key, value in result.items()}
             payload["replica"] = entry.describe()
             payload["model"] = model_name
+            if clock is not None and owns_span:
+                clock.meta = {"status": "ok", "op": op,
+                              "rows": self._request_rows(request),
+                              "tenant": tenant}
             return 200, payload, {}
+        if clock is not None and owned_any:
+            clock.meta = {"status": "error", "op": op,
+                          "rows": self._request_rows(request),
+                          "tenant": tenant}
         return 503, {
             "error": f"all {len(tried)} replica(s) failed this request; "
                      f"last: {type(last_error).__name__}: {last_error}",

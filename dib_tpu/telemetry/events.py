@@ -98,6 +98,16 @@ class EventKindSpec:
 ENVELOPE_FIELDS = ("v", "run", "proc", "seq", "t", "mono", "type", "tags",
                    "ctx")
 
+#: Closed vocabulary for the request span's ``phases`` field, in wire
+#: order. Every key the HTTP server stamps (serve/server.py) must come
+#: from this tuple — the event-schema lint pass checks it against the
+#: docs/observability.md phase table, and per-phase metric names are
+#: derived as ``serve.phase.<name>``. A request carries only the phases
+#: it actually traversed (cached hits skip queue/batch; quota/shed
+#: rejections skip queue/batch/dispatch).
+REQUEST_PHASES = ("read", "parse", "admission", "queue", "batch",
+                  "dispatch", "serialize", "write")
+
 #: kind -> field vocabulary; one row per documented record type
 #: (docs/observability.md "Record types and their payloads").
 EVENT_SCHEMA: dict[str, EventKindSpec] = {
@@ -164,10 +174,12 @@ EVENT_SCHEMA: dict[str, EventKindSpec] = {
         required=("name", "path", "span", "parent", "seconds"),
         optional=("epoch", "replica", "beta_end", "op", "bucket",
                   "status", "rows", "fill", "queued_s", "padded_rows",
-                  "overlapped", "tenant", "cached", "model"),
+                  "overlapped", "tenant", "cached", "model", "phases"),
         doc="one closed trace span (serving emits request/batch spans; "
             "request spans may carry the tenant label, cached=true for "
-            "response-cache hits, and the zoo model name; "
+            "response-cache hits, the zoo model name, and `phases` — "
+            "the per-phase latency anatomy {name: seconds} keyed by "
+            "REQUEST_PHASES, whose values sum to `seconds` exactly; "
             "overlapped=true marks a measurement that rode the async "
             "queue — seconds is then the EXPOSED wait, queued_s the "
             "dispatch→ready window)"),
@@ -276,6 +288,7 @@ __all__ = [
     "EVENTS_FILENAME",
     "ENVELOPE_FIELDS",
     "EVENT_SCHEMA",
+    "REQUEST_PHASES",
     "EventKindSpec",
     "EventWriter",
     "config_fingerprint",
@@ -701,6 +714,11 @@ class EventWriter:
         """One closed span (``telemetry/trace.py``): ``span``/``parent`` ids
         rebuild the tree, ``path`` is the full slash path (also the name
         under which the interval appears in captured XLA traces)."""
+        if _strict() and "phases" in fields:
+            bad = set(fields["phases"]) - set(REQUEST_PHASES)
+            if bad:
+                raise ValueError(
+                    f"span phases outside REQUEST_PHASES: {sorted(bad)}")
         return self.emit(
             "span", name=name, path=path, span=int(span_id),
             parent=parent_id if parent_id is None else int(parent_id),

@@ -387,9 +387,12 @@ def fleet_prometheus(agg: FleetAggregator, prefix: str = "dib") -> str:
     every run-plane source, aggregated — counters summed across workers
     (the prefork-supervisor view, pids and all, collapses into fleet
     totals), gauges last-write-wins in fleet order, histograms merged on
-    their mergeable stats (count/sum/min/max; windowed percentiles do
-    not merge and are dropped) — plus the aggregator's own meta-gauges
-    (sources, entries, torn lines, orphans)."""
+    their mergeable stats (count/sum/min/max plus the fixed-bound
+    ``le_*`` bucket counts, which sum exactly because every worker
+    buckets against the same fleet-wide BUCKET_BOUNDS; windowed
+    percentiles do not merge and are dropped — the merged ``_bucket``
+    series carry the fleet quantiles instead) — plus the aggregator's
+    own meta-gauges (sources, entries, torn lines, orphans)."""
     from dib_tpu.telemetry.metrics import prometheus_text
 
     counters: dict[str, float] = {}
@@ -416,7 +419,8 @@ def fleet_prometheus(agg: FleetAggregator, prefix: str = "dib") -> str:
                     if not name:
                         continue
                     h = hists.setdefault(name, {})
-                    if stat in ("count", "sum"):
+                    if stat in ("count", "sum") \
+                            or stat.startswith("le_"):
                         h[stat] = h.get(stat, 0.0) + float(value)
                     elif stat == "min":
                         h[stat] = min(h.get(stat, float(value)),

@@ -15,19 +15,81 @@ Non-zero processes return without touching the file.
 
 from __future__ import annotations
 
+import math
 import re
 import threading
+from bisect import bisect_left
 from collections import deque
 
 __all__ = [
+    "BUCKET_BOUNDS",
     "Counter",
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "bucket_counts",
+    "bucket_index",
+    "bucket_quantile",
     "gather_snapshots",
     "prometheus_text",
     "write_metrics",
 ]
+
+# Fleet-wide histogram bucket bounds, in seconds: log-spaced, 8 buckets per
+# decade from 1 µs to 100 s. FIXED across every process and every release —
+# N prefork workers' ``/metrics`` merge by plain per-bucket addition only
+# because every worker buckets identically. Changing these bounds is a
+# telemetry schema change (old and new workers would stop being mergeable).
+BUCKET_BOUNDS = tuple(10.0 ** (-6.0 + i / 8.0) for i in range(65))
+
+# Observations above the last bound land in the overflow bucket at this
+# index (``le_inf`` in snapshots, ``le="+Inf"`` in Prometheus text).
+_OVERFLOW = len(BUCKET_BOUNDS)
+
+
+def bucket_index(value: float) -> int:
+    """Dense bucket index for ``value``: smallest i with
+    ``value <= BUCKET_BOUNDS[i]``, or the overflow index past the end.
+    Pure function of the fixed bounds — every worker agrees."""
+    return bisect_left(BUCKET_BOUNDS, float(value))
+
+
+def bucket_counts(hist_snapshot: dict) -> list:
+    """Dense per-bucket counts (len ``len(BUCKET_BOUNDS)+1``) from a
+    histogram snapshot's sparse ``le_NNN``/``le_inf`` keys.
+
+    Snapshots store only nonzero buckets; this re-densifies them so
+    merged fleets can be summed index-wise and fed to
+    :func:`bucket_quantile`. Tolerates snapshots whose numeric values
+    were floated in flight (``_flatten``, JSON round-trips)."""
+    dense = [0] * (_OVERFLOW + 1)
+    for key, value in hist_snapshot.items():
+        if not key.startswith("le_"):
+            continue
+        tail = key[3:]
+        idx = _OVERFLOW if tail == "inf" else int(tail)
+        dense[idx] += int(round(float(value)))
+    return dense
+
+def bucket_quantile(counts, q: float):
+    """Nearest-rank quantile estimate from dense per-bucket counts:
+    the upper bound of the bucket holding the rank-``ceil(q*total)``
+    observation (overflow reports the last finite bound).
+
+    Deterministic pure function of the counts — merging two workers'
+    buckets by addition then calling this gives bit-identical results
+    to bucketing the combined stream, which is the whole point of
+    fixed fleet-wide bounds. Returns None when the histogram is empty."""
+    total = sum(counts)
+    if total <= 0:
+        return None
+    rank = max(1, math.ceil(q * total))
+    seen = 0
+    for i, c in enumerate(counts):
+        seen += c
+        if seen >= rank:
+            return BUCKET_BOUNDS[min(i, _OVERFLOW - 1)]
+    return BUCKET_BOUNDS[-1]
 
 
 class Counter:
@@ -63,10 +125,13 @@ class Gauge:
 class Histogram:
     """Streaming distribution summary over a bounded window.
 
-    Tracks exact count/sum/min/max over the full stream and percentiles
-    over the trailing ``window`` observations — chunk wall-clocks arrive a
-    few thousand times per run at most, so a plain deque beats bucketing
-    complexity here. ``record``/``snapshot`` are locked (see Counter).
+    Tracks exact count/sum/min/max over the full stream, percentiles
+    over the trailing ``window`` observations, and exact per-bucket
+    counts over the full stream against the fixed fleet-wide
+    :data:`BUCKET_BOUNDS` — the windowed percentiles answer "what is
+    this worker doing right now", the buckets make N workers'
+    snapshots mergeable by addition. ``record``/``snapshot`` are
+    locked (see Counter).
     """
 
     def __init__(self, window: int = 4096):
@@ -74,6 +139,7 @@ class Histogram:
         self.sum = 0.0
         self.min = None
         self.max = None
+        self._buckets = [0] * (_OVERFLOW + 1)
         self._window = deque(maxlen=window)
         self._lock = threading.Lock()
 
@@ -84,6 +150,7 @@ class Histogram:
             self.sum += value
             self.min = value if self.min is None else min(self.min, value)
             self.max = value if self.max is None else max(self.max, value)
+            self._buckets[bucket_index(value)] += 1
             self._window.append(value)
 
     def snapshot(self) -> dict:
@@ -95,6 +162,13 @@ class Histogram:
                 "max": self.max if self.max is not None else 0.0,
                 "mean": self.sum / self.count if self.count else 0.0,
             }
+            # Sparse flat keys, not a nested dict: snapshot leaves must
+            # stay one level deep so _flatten / fleet merging see
+            # "histograms.<name>.le_NNN" and sum them like any stat.
+            for i, c in enumerate(self._buckets):
+                if c:
+                    key = "le_inf" if i == _OVERFLOW else f"le_{i:03d}"
+                    out[key] = c
             window = list(self._window)
         if window:
             ordered = sorted(window)
@@ -145,12 +219,19 @@ def prometheus_text(snapshot: dict, prefix: str = "dib") -> str:
     exposition format (version 0.0.4 — what every Prometheus scraper and
     most collectors speak).
 
-    Counters map to ``counter``, gauges to ``gauge``; histograms map to a
-    ``summary`` (``_count``/``_sum`` plus ``quantile``-labelled samples
-    from the windowed p50/p90/p99) with ``_min``/``_max`` gauges — the
-    registry keeps nearest-rank percentiles, not cumulative buckets, so a
-    summary is the honest mapping. The serving ``/metrics`` endpoint
-    returns this under content negotiation (docs/serving.md)."""
+    Counters map to ``counter``, gauges to ``gauge``; histograms map to
+    TWO families. The legacy ``summary`` (``_count``/``_sum`` plus
+    ``quantile``-labelled samples from the windowed p50/p90/p99) with
+    ``_min``/``_max`` gauges is kept for back-compat — per-worker
+    quantiles are honest but mathematically impossible to aggregate
+    across a prefork fleet. The native ``{name}_hist`` ``histogram``
+    family renders the fixed fleet-wide :data:`BUCKET_BOUNDS` as
+    cumulative ``_bucket{le=...}`` samples (the ``+Inf`` bucket is
+    ALWAYS emitted, so ``histogram_quantile()`` works even on an empty
+    or bucket-less snapshot) with matching ``_hist_sum``/``_hist_count``
+    — those merge across workers by plain addition. The serving
+    ``/metrics`` endpoint returns this under content negotiation
+    (docs/serving.md)."""
     lines: list[str] = []
 
     def sample(name: str, value, labels: str = "") -> None:
@@ -182,6 +263,25 @@ def prometheus_text(snapshot: dict, prefix: str = "dib") -> str:
         for edge in ("min", "max"):
             lines.append(f"# TYPE {prom}_{edge} gauge")
             sample(f"{prom}_{edge}", hist.get(edge) or 0.0)
+        # Native histogram family: cumulative buckets against the fixed
+        # fleet-wide bounds. Only populated buckets get a finite-le line
+        # (keeps the exposition compact; a missing le series scrapes as
+        # zero), but +Inf is unconditional and _hist_count == _hist_sum's
+        # companion always equals the +Inf bucket — the consistency
+        # histogram_quantile() and rate() arithmetic rely on.
+        dense = bucket_counts(hist)
+        lines.append(f"# TYPE {prom}_hist histogram")
+        cumulative = 0
+        for i, c in enumerate(dense[:_OVERFLOW]):
+            cumulative += c
+            if c:
+                le = f"{BUCKET_BOUNDS[i]:.6g}"
+                sample(f"{prom}_hist_bucket", cumulative,
+                       labels='{le="%s"}' % le)
+        sample(f"{prom}_hist_bucket", hist.get("count", 0),
+               labels='{le="+Inf"}')
+        sample(f"{prom}_hist_sum", hist.get("sum", 0.0))
+        sample(f"{prom}_hist_count", hist.get("count", 0))
     return "\n".join(lines) + "\n"
 
 

@@ -235,6 +235,37 @@ def serving_rollup(span_events, counters: dict | None = None) -> dict | None:
         out["quota_rejected_frac"] = round(quota / len(requests), 6)
         if span > 0:
             out["requests_per_s"] = round(len(requests) / span, 3)
+        # Request anatomy: per-phase latency rollup from the spans'
+        # `phases` field (docs/observability.md "Request anatomy") — a
+        # request carries only the phases it traversed, so counts differ
+        # per phase (cache hits skip queue/batch, rejections skip
+        # dispatch). `share` is each phase's fraction of total phase
+        # time — the number the parse/serialize optimization campaign
+        # watches.
+        phase_values: dict[str, list[float]] = {}
+        for e in requests:
+            ph = e.get("phases")
+            if not isinstance(ph, dict):
+                continue
+            for name, dt in ph.items():
+                if isinstance(dt, (int, float)):
+                    phase_values.setdefault(name, []).append(float(dt))
+        if phase_values:
+            total_s = sum(sum(v) for v in phase_values.values())
+            out["phases"] = {
+                name: {
+                    "count": len(values),
+                    "p50_ms": round(
+                        _percentile(sorted(values), 0.5) * 1e3, 4),
+                    "p99_ms": round(
+                        _percentile(sorted(values), 0.99) * 1e3, 4),
+                    "mean_ms": round(
+                        sum(values) / len(values) * 1e3, 4),
+                    "share": round(sum(values) / total_s, 4)
+                    if total_s else 0.0,
+                }
+                for name, values in sorted(phase_values.items())
+            }
     if batches:
         fills = [e.get("fill") for e in batches
                  if isinstance(e.get("fill"), (int, float))]
@@ -1207,6 +1238,32 @@ def compare(
         "regressed": b_mit > a_mit,
     }
     regressed = regressed or b_mit > a_mit
+
+    # Per-phase latency gates (docs/observability.md "Request anatomy"):
+    # a serving phase's p99 growing past threshold is gated like any
+    # scalar, but with a small ABSOLUTE floor — µs-scale phases (parse on
+    # a tiny body) jitter by whole multiples without meaning anything, so
+    # only moves of at least 0.1 ms can regress. Gated dynamically over
+    # the phases PRESENT IN BOTH summaries (a phase one side never
+    # traversed is not comparable).
+    a_phases = (summary_a.get("serving") or {}).get("phases") or {}
+    b_phases = (summary_b.get("serving") or {}).get("phases") or {}
+    for phase in sorted(set(a_phases) & set(b_phases)):
+        a_p99 = scalarize((a_phases[phase] or {}).get("p99_ms"))
+        b_p99 = scalarize((b_phases[phase] or {}).get("p99_ms"))
+        row = {"a": a_p99, "b": b_p99, "bad_direction": "up"}
+        if a_p99 is not None and math.isfinite(a_p99) \
+                and b_p99 is not None and math.isfinite(b_p99):
+            row["delta"] = round(b_p99 - a_p99, 6)
+            rel = (b_p99 - a_p99) / max(abs(a_p99), 1e-12)
+            row["rel"] = round(rel, 6)
+            row["regressed"] = rel > threshold \
+                and (b_p99 - a_p99) > 0.1
+        else:
+            row["gated"] = False
+            row["regressed"] = False
+        regressed = regressed or row["regressed"]
+        fields[f"serving_phase_{phase}_p99_ms"] = row
 
     def undetected(summary):
         f = summary.get("faults") or {}

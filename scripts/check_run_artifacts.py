@@ -483,6 +483,99 @@ def _check_serve_async_bench(record: dict, problems: list[str]) -> None:
                         "baseline the speedup is measured against")
 
 
+def _check_serve_phases_bench(record: dict, problems: list[str]) -> None:
+    """serve_phase_anatomy-specific schema (scripts/serve_loadgen.py
+    --phases-out, docs/observability.md "Request anatomy"): every row's
+    per-phase breakdown telescopes back to the server-side end-to-end
+    mean (within 5% — rows are restricted to uncached traffic where the
+    invariant holds by construction), every reported quantile is finite,
+    phase names stay inside the closed REQUEST_PHASES vocabulary, and
+    the committed cumulative bucket series is monotone non-decreasing
+    (the Prometheus ``_bucket`` contract the fleet merge rests on)."""
+    from dib_tpu.telemetry.events import REQUEST_PHASES
+
+    rows = record.get("rows")
+    if not isinstance(rows, list) or not rows:
+        problems.append("'rows' must be a non-empty list of uncached "
+                        "sweep rows")
+        return
+    for i, row in enumerate(rows):
+        if not isinstance(row, dict):
+            problems.append(f"rows[{i}] must be an object")
+            continue
+        phases = row.get("phases")
+        if not (isinstance(phases, dict) and phases):
+            problems.append(f"rows[{i}]: 'phases' must be a non-empty "
+                            "object")
+            continue
+        bad_names = set(phases) - set(REQUEST_PHASES)
+        if bad_names:
+            problems.append(f"rows[{i}]: phases outside the closed "
+                            f"REQUEST_PHASES vocabulary: "
+                            f"{sorted(bad_names)}")
+        for name, stats in phases.items():
+            if not isinstance(stats, dict):
+                problems.append(f"rows[{i}].phases[{name!r}] must be an "
+                                "object")
+                continue
+            count = stats.get("count")
+            if not isinstance(count, int) or isinstance(count, bool) \
+                    or count <= 0:
+                problems.append(f"rows[{i}].phases[{name!r}]: 'count' "
+                                "must be a positive int")
+            for key in ("mean_ms", "p50_ms", "p99_ms"):
+                v = stats.get(key)
+                if not (_is_finite_number(v) and v >= 0):
+                    problems.append(f"rows[{i}].phases[{name!r}]: "
+                                    f"{key!r} must be a finite "
+                                    "non-negative number")
+        e2e = row.get("e2e_server")
+        if not (isinstance(e2e, dict)
+                and _is_finite_number(e2e.get("mean_ms"))
+                and isinstance(e2e.get("count"), int)
+                and e2e["count"] > 0):
+            problems.append(f"rows[{i}]: 'e2e_server' must carry a "
+                            "positive int 'count' and finite 'mean_ms'")
+            continue
+        phase_sum = row.get("phase_sum_ms")
+        if not _is_finite_number(phase_sum):
+            problems.append(f"rows[{i}]: 'phase_sum_ms' must be a finite "
+                            "number")
+        elif abs(phase_sum - e2e["mean_ms"]) > 0.05 * e2e["mean_ms"]:
+            problems.append(
+                f"rows[{i}]: phase sum {phase_sum} ms is not within 5% "
+                f"of the end-to-end mean {e2e['mean_ms']} ms — the phase "
+                "clock no longer telescopes (a phase is unstamped or "
+                "double-counted)")
+        cumulative = row.get("e2e_cumulative_buckets")
+        if not (isinstance(cumulative, list) and cumulative):
+            problems.append(f"rows[{i}]: 'e2e_cumulative_buckets' must "
+                            "be a non-empty list")
+        else:
+            if any(not isinstance(c, int) or isinstance(c, bool) or c < 0
+                   for c in cumulative):
+                problems.append(f"rows[{i}]: cumulative buckets must be "
+                                "non-negative ints")
+            elif any(b < a for a, b in zip(cumulative, cumulative[1:])):
+                problems.append(f"rows[{i}]: cumulative buckets must be "
+                                "monotone non-decreasing (Prometheus "
+                                "_bucket contract)")
+            elif cumulative[-1] != e2e["count"]:
+                problems.append(
+                    f"rows[{i}]: cumulative buckets end at "
+                    f"{cumulative[-1]} but e2e_server.count is "
+                    f"{e2e['count']} — the bucket series and the count "
+                    "disagree")
+    for key in ("parse_p99_ms", "serialize_p99_ms"):
+        if not _is_finite_number(record.get(key)):
+            problems.append(f"{key!r} must be a finite number (the "
+                            "headline the phase SLO ceilings gate)")
+    share = record.get("parse_serialize_share")
+    if not (_is_finite_number(share) and 0.0 <= share <= 1.0):
+        problems.append("'parse_serialize_share' must be a finite "
+                        "fraction in [0, 1]")
+
+
 def _check_mesh_bench(record: dict, problems: list[str]) -> None:
     """mesh_reshard_bench-specific schema (scripts/bench_mesh.py): every
     round-trip row carries typed width/engine/bit-identity fields, the
@@ -676,6 +769,8 @@ def check_record(record: dict, problems: list[str]) -> None:
             _check_kernel_bench(record, problems)
         if record.get("metric") == "serve_async_loadgen_sweep":
             _check_serve_async_bench(record, problems)
+        if record.get("metric") == "serve_phase_anatomy":
+            _check_serve_phases_bench(record, problems)
         if record.get("metric") == "mesh_reshard_bench":
             _check_mesh_bench(record, problems)
         if record.get("metric") == "fleet_trace":

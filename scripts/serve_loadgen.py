@@ -67,6 +67,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 METRIC = "serve_cpu_loadgen"
 SWEEP_METRIC = "serve_async_loadgen_sweep"
+PHASES_METRIC = "serve_phase_anatomy"
 BASELINE_REQ_PER_S = 370.0   # BENCH_SERVE_CPU.json (PR 3 ThreadingHTTPServer)
 
 
@@ -373,14 +374,13 @@ _COUNTER_NAMES = (
 )
 
 
-def _cache_counters(url: str, processes: int = 1) -> dict:
-    """The zoo cache/quota counters from /metrics, SUMMED across the
-    server processes. Under the prefork plane each worker keeps its own
-    registry and the kernel routes every scrape to one of them, so the
-    scrape repeats on fresh connections until ``processes`` distinct pids
-    answered (bounded attempts — a worker the kernel never routes to just
-    goes unsampled, which under-counts honestly). Zeros when the server
-    has no registry or caches."""
+def _fleet_metrics(url: str, processes: int = 1) -> dict:
+    """pid -> full /metrics snapshot, one per server process. Under the
+    prefork plane each worker keeps its own registry and the kernel
+    routes every scrape to one of them, so the scrape repeats on fresh
+    connections until ``processes`` distinct pids answered (bounded
+    attempts — a worker the kernel never routes to just goes unsampled,
+    which under-counts honestly)."""
     by_pid: dict = {}
     attempts = max(processes * 6, 1)
     for _ in range(attempts):
@@ -388,12 +388,113 @@ def _cache_counters(url: str, processes: int = 1) -> dict:
             snapshot = _get_json(url + "/metrics")
         except Exception:
             break
-        by_pid[snapshot.get("pid", 0)] = snapshot.get("counters", {})
+        by_pid[snapshot.get("pid", 0)] = snapshot
         if len(by_pid) >= processes:
             break
+    return by_pid
+
+
+def _cache_counters(url: str, processes: int = 1,
+                    snapshots: dict | None = None) -> dict:
+    """The zoo cache/quota counters, SUMMED across the server processes
+    (see :func:`_fleet_metrics`). Zeros when the server has no registry
+    or caches."""
+    if snapshots is None:
+        snapshots = _fleet_metrics(url, processes)
     out = {}
     for short, name in _COUNTER_NAMES:
-        out[short] = int(sum(c.get(name, 0) for c in by_pid.values()))
+        out[short] = int(sum(
+            (snap.get("counters") or {}).get(name, 0)
+            for snap in snapshots.values()))
+    return out
+
+
+# Request-anatomy histograms scraped per sweep row (docs/observability.md
+# "Request anatomy"): the end-to-end server-side latency plus one
+# histogram per phase the server stamps.
+_E2E_HIST = "serve.request_latency_s"
+
+
+def _phase_hist_names() -> list[tuple[str, str]]:
+    from dib_tpu.telemetry.events import REQUEST_PHASES
+
+    return [(p, f"serve.phase.{p}") for p in REQUEST_PHASES]
+
+
+def _hist_fleet_delta(before: dict, after: dict, name: str) -> dict | None:
+    """Fleet-summed delta of histogram ``name`` between two pid-keyed
+    snapshot maps: clamped per-pid count/sum deltas plus dense bucket
+    deltas (summable because the bounds are fixed fleet-wide). None when
+    nothing was observed in the window."""
+    from dib_tpu.telemetry.metrics import bucket_counts
+
+    dense_total: list | None = None
+    count = 0
+    total_s = 0.0
+    for pid, after_snap in after.items():
+        ah = (after_snap.get("histograms") or {}).get(name) or {}
+        bh = ((before.get(pid) or {}).get("histograms") or {}) \
+            .get(name) or {}
+        da, db = bucket_counts(ah), bucket_counts(bh)
+        # clamped at 0, same as the cache counters: a pid sampled on only
+        # one side of the window under-counts honestly
+        d = [max(a - b, 0) for a, b in zip(da, db)]
+        dense_total = (d if dense_total is None
+                       else [x + y for x, y in zip(dense_total, d)])
+        count += max(int(ah.get("count", 0)) - int(bh.get("count", 0)), 0)
+        total_s += max(float(ah.get("sum", 0.0))
+                       - float(bh.get("sum", 0.0)), 0.0)
+    if not count or dense_total is None:
+        return None
+    return {"count": count, "sum_s": total_s, "buckets": dense_total}
+
+
+def _phase_block(before: dict, after: dict) -> dict | None:
+    """Per-row request anatomy: fleet-summed per-phase histogram deltas
+    plus the server-side end-to-end delta, with p50/p99 estimated from
+    the merged buckets (exact across workers — fixed fleet-wide
+    bounds)."""
+    from dib_tpu.telemetry.metrics import bucket_quantile
+
+    e2e = _hist_fleet_delta(before, after, _E2E_HIST)
+    if e2e is None:
+        return None
+
+    def _stats(delta: dict) -> dict:
+        return {
+            "count": delta["count"],
+            "mean_ms": round(delta["sum_s"] / delta["count"] * 1e3, 4),
+            "p50_ms": round(
+                (bucket_quantile(delta["buckets"], 0.5) or 0.0) * 1e3, 4),
+            "p99_ms": round(
+                (bucket_quantile(delta["buckets"], 0.99) or 0.0) * 1e3, 4),
+        }
+
+    phases: dict = {}
+    phase_time_per_req = 0.0
+    for short, name in _phase_hist_names():
+        delta = _hist_fleet_delta(before, after, name)
+        if delta is None:
+            continue
+        phases[short] = _stats(delta)
+        # normalized by the END-TO-END request count: phases a request
+        # skipped contribute their 0 implicitly, so the per-request
+        # phase sum telescopes to the e2e mean
+        phase_time_per_req += delta["sum_s"] / e2e["count"]
+    out = {
+        "e2e": _stats(e2e),
+        "phases": phases,
+        "phase_sum_ms": round(phase_time_per_req * 1e3, 4),
+    }
+    # cumulative form of the merged end-to-end buckets: what a
+    # Prometheus _bucket series would expose, pinned per row so
+    # check_run_artifacts can assert monotonicity on committed records
+    cumulative = []
+    running = 0
+    for c in e2e["buckets"]:
+        running += c
+        cumulative.append(running)
+    out["e2e_cumulative_buckets"] = cumulative
     return out
 
 
@@ -425,7 +526,8 @@ def run_rate_sweep(url: str, width: int, rates: list[float],
 
     index_offset = 0
     for rate, cached in specs:
-        before = _cache_counters(url, processes=server_processes)
+        before_snaps = _fleet_metrics(url, processes=server_processes)
+        before = _cache_counters(url, snapshots=before_snaps)
         # the measurement tool must not charge its own GC pauses to the
         # server's tail: a step allocates a few MB, collected afterwards
         gc.collect()
@@ -439,7 +541,8 @@ def run_rate_sweep(url: str, width: int, rates: list[float],
         finally:
             gc.enable()
         index_offset += stats.sent
-        after = _cache_counters(url, processes=server_processes)
+        after_snaps = _fleet_metrics(url, processes=server_processes)
+        after = _cache_counters(url, snapshots=after_snaps)
         row: dict = {
             "mode": "open",
             "cached": cached,
@@ -454,6 +557,12 @@ def run_rate_sweep(url: str, width: int, rates: list[float],
             # scrape to leaves its share out of one side of the delta
             "cache": {k: max(after[k] - before[k], 0) for k in after},
         }
+        anatomy = _phase_block(before_snaps, after_snaps)
+        if anatomy is not None:
+            row["phases"] = anatomy["phases"]
+            row["e2e_server"] = anatomy["e2e"]
+            row["phase_sum_ms"] = anatomy["phase_sum_ms"]
+            row["e2e_cumulative_buckets"] = anatomy["e2e_cumulative_buckets"]
         if stats.latencies:
             ordered = sorted(stats.latencies)
             row["value"] = round(stats.completed_ok / stats.window_s, 3)
@@ -618,6 +727,75 @@ def _self_contained_subprocess(run_dir: str | None, train_epochs: int,
     return url, cleanup
 
 
+def _phase_record(sweep_record: dict) -> dict | None:
+    """The serve_phase_anatomy record distilled from a sweep record's
+    per-row request anatomy (docs/observability.md "Request anatomy").
+
+    Rows are restricted to UNCACHED sweep rows: a cache hit records its
+    traversed phases but not the end-to-end server histogram (population
+    parity with the pre-phase-clock latency metric), so the
+    phase-sum-vs-end-to-end invariant only telescopes on uncached rows.
+    """
+    rows = []
+    for row in sweep_record.get("rows") or []:
+        if row.get("cached") or "phases" not in row:
+            continue
+        e2e = row.get("e2e_server") or {}
+        phase_sum_ms = row.get("phase_sum_ms")
+        entry = {
+            "target_rate": row.get("target_rate"),
+            "duration_s": row.get("duration_s"),
+            "requests_sent": row.get("requests_sent"),
+            "ok": row.get("ok"),
+            "phases": row["phases"],
+            "e2e_server": e2e,
+            "phase_sum_ms": phase_sum_ms,
+            "e2e_cumulative_buckets": row.get("e2e_cumulative_buckets"),
+        }
+        if e2e.get("mean_ms"):
+            entry["phase_sum_frac"] = round(
+                phase_sum_ms / e2e["mean_ms"], 4)
+        rows.append(entry)
+    if not rows:
+        return None
+    # headline row: the sweep's chosen (best within-SLO) target rate when
+    # present, else the last uncached row
+    target = sweep_record.get("target_rate")
+    head = next((r for r in rows if r["target_rate"] == target), rows[-1])
+    phases = head["phases"]
+    parse = phases.get("parse") or {}
+    serialize = phases.get("serialize") or {}
+    e2e_mean = (head["e2e_server"] or {}).get("mean_ms") or 0.0
+    ps_ms = ((parse.get("mean_ms") or 0.0)
+             + (serialize.get("mean_ms") or 0.0))
+    totals = {p: s["count"] * s["mean_ms"] for p, s in phases.items()}
+    grand = sum(totals.values())
+    out = {
+        "metric": PHASES_METRIC,
+        "unit": "ms",
+        "mode": "open_sweep",
+        "target_rate": head["target_rate"],
+        "duration_s": sweep_record.get("duration_s"),
+        "tenants": sweep_record.get("tenants"),
+        "connections": sweep_record.get("connections"),
+        "rows": rows,
+        # headline value: mean parse+serialize milliseconds per request —
+        # the HTTP-plane overhead the anatomy exists to watch
+        "value": round(ps_ms, 4),
+        "parse_p99_ms": parse.get("p99_ms"),
+        "serialize_p99_ms": serialize.get("p99_ms"),
+        "parse_serialize_share": (round(ps_ms / e2e_mean, 4)
+                                  if e2e_mean else None),
+        "phase_share": ({p: round(v / grand, 4)
+                         for p, v in totals.items()} if grand else {}),
+    }
+    if sweep_record.get("server") is not None:
+        out["server"] = sweep_record["server"]
+    if sweep_record.get("measured_at"):
+        out["measured_at"] = sweep_record["measured_at"]
+    return out
+
+
 # ------------------------------------------------------------ registration
 def _register_bench(record: dict, runs_root: str | None) -> None:
     """Fleet-registry registration, ONLY under an explicit root (the
@@ -696,6 +874,12 @@ def main(argv=None) -> int:
                              "never the committed ./runs by default).")
     parser.add_argument("--out", default=None,
                         help="Also write the JSON record to this path.")
+    parser.add_argument("--phases-out", default=None,
+                        help="Sweep mode: also write the "
+                             "serve_phase_anatomy record (per-phase "
+                             "server-side latency breakdown from the "
+                             "fleet-merged native histograms) to this "
+                             "path.")
     args = parser.parse_args(argv)
 
     if bool(args.url) == bool(args.self_contained):
@@ -850,6 +1034,12 @@ def main(argv=None) -> int:
         with open(args.out, "w") as f:
             f.write(line + "\n")
     _register_bench(record, args.runs_root)
+    if sweep_rates and args.phases_out:
+        phase_record = _phase_record(record)
+        if phase_record is not None:
+            with open(args.phases_out, "w") as f:
+                f.write(json.dumps(phase_record) + "\n")
+            _register_bench(phase_record, args.runs_root)
     return 0 if record.get("value") is not None else 1
 
 

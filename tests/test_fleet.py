@@ -345,6 +345,38 @@ def test_fleet_report_and_prometheus(tmp_path, capsys):
     assert "dib_inflight 2" in text
 
 
+def test_fleet_prometheus_merges_native_buckets_by_addition(tmp_path):
+    """Two workers' fixed-bound ``le_*`` bucket counts sum into ONE
+    fleet ``_bucket`` series (exact — same BUCKET_BOUNDS everywhere);
+    windowed percentiles are dropped, the buckets carry the quantiles."""
+    for worker, (count, total) in enumerate([(3, 0.03), (5, 0.05)]):
+        run = tmp_path / f"w{worker}"
+        _write_events(str(run), f"rw{worker}", [1.0],
+                      ctx=_ctx(f"trace-{worker}",
+                               parent=f"run:rw{worker}"))
+        with open(os.path.join(str(run), "events.jsonl"), "a") as f:
+            f.write(json.dumps({
+                "v": 1, "run": f"rw{worker}", "proc": 0, "seq": 9,
+                "t": 2.0, "type": "metrics", "snapshots": [{
+                    "histograms.serve.request_latency_s.count": count,
+                    "histograms.serve.request_latency_s.sum": total,
+                    "histograms.serve.request_latency_s.le_032": count,
+                    "histograms.serve.request_latency_s.p99": 0.01,
+                }]}) + "\n")
+    agg = FleetAggregator([str(tmp_path / "w0"), str(tmp_path / "w1")])
+    agg.poll()
+    text = fleet_prometheus(agg)
+    agg.close()
+    assert 'dib_serve_request_latency_s_hist_bucket{le="+Inf"} 8' in text
+    assert "dib_serve_request_latency_s_hist_count 8" in text
+    # the merged finite bucket holds both workers' counts
+    bucket_lines = [l for l in text.splitlines()
+                    if "_hist_bucket" in l and "+Inf" not in l]
+    assert any(l.endswith(" 8") for l in bucket_lines), bucket_lines
+    # per-worker windowed percentiles never merge — they are dropped
+    assert "quantile" not in text
+
+
 # ============================================================== burn rates
 def _entries(rows):
     return [{"plane": p, "t": t, "record": r, "source": "s", "n": i}

@@ -854,6 +854,39 @@ def test_event_schema_guard_flags_a_vanished_serving_rollup(tmp_path):
     assert any("serving_rollup not found" in f.message for f in findings)
 
 
+def test_event_schema_guard_pins_phase_table_to_request_phases(tmp_path):
+    """ISSUE-17 docs drift, both directions: a phase the clock stamps
+    but the docs table omits, and a documented phase the vocabulary
+    dropped — the code (REQUEST_PHASES) is the source of truth."""
+    from dib_tpu.analysis.core import get_pass
+
+    tel = tmp_path / "dib_tpu" / "telemetry"
+    tel.mkdir(parents=True)
+    (tel / "events.py").write_text(
+        'REQUEST_PHASES = ("read", "parse", "write")\n')
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "observability.md").write_text(
+        "Record types and their payloads:\n\n"
+        "| phase | meaning |\n"
+        "|---|---|\n"
+        "| `read` | socket read |\n"
+        "| `warp` | not a real phase |\n")
+    findings = get_pass("event-schema").check_project(str(tmp_path))
+    messages = [f.message for f in findings]
+    assert any("request phase 'parse'" in m and "missing" in m
+               for m in messages), messages
+    assert any("request phase 'write'" in m and "missing" in m
+               for m in messages), messages
+    assert any("documented request phase 'warp'" in m
+               for m in messages), messages
+    # a tree whose events.py lost the tuple entirely is a lost anchor
+    (tel / "events.py").write_text("PHASES_RENAMED = ()\n")
+    findings = get_pass("event-schema").check_project(str(tmp_path))
+    assert any("REQUEST_PHASES not found" in f.message
+               for f in findings)
+
+
 def test_mesh_donation_sharding_flags_decorator_forms(tmp_path):
     """Review regression: @partial(jax.jit, ...) and @jax.jit(...) are
     the repo's dominant jit spellings — the donation×sharding check

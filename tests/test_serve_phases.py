@@ -1,0 +1,537 @@
+"""Request anatomy (docs/observability.md "Request anatomy"): the
+per-phase latency clock, the fleet-mergeable native histogram buckets,
+and the `serve top` dashboard.
+
+The contracts ISSUE-17 must prove:
+
+  - **the exact-sum invariant**: on REAL asyncio requests, a request
+    span's ``phases`` sum to its end-to-end ``seconds`` to rounding —
+    uncached (all 8 phases), cached (no queue/batch), 429 and shed
+    (admission-terminated) each carry exactly the phases they traversed;
+  - **bucket-merge bit-identity**: two workers' native bucket vectors
+    summed index-wise yield the SAME quantile as one combined stream —
+    the property `serve top` and the fleet Prometheus merge rest on;
+  - **Prometheus exposition**: the `_hist` family's cumulative
+    ``_bucket`` samples are consistent with ``_count``/``_sum`` and the
+    ``+Inf`` bucket is always emitted;
+  - **`serve top --once`** renders a live 2-worker prefork fleet through
+    the real CLI;
+  - **the committed-record schema**: `check_run_artifacts` rejects a
+    serve_phase_anatomy record whose phase sums no longer telescope or
+    whose cumulative buckets are non-monotone.
+"""
+
+import importlib.util
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from dib_tpu.data import get_dataset
+from dib_tpu.models import DistributedIBModel
+from dib_tpu.serve import (
+    DIBServer,
+    InferenceEngine,
+    MicroBatcher,
+    ModelZoo,
+    ReplicaEntry,
+    ReplicaRouter,
+    TenantQuotas,
+)
+from dib_tpu.serve.server import _PhaseClock
+from dib_tpu.telemetry import (
+    EventWriter,
+    MetricsRegistry,
+    Tracer,
+    read_events,
+    runtime_manifest,
+)
+from dib_tpu.telemetry.events import REQUEST_PHASES
+from dib_tpu.telemetry.metrics import (
+    BUCKET_BOUNDS,
+    Histogram,
+    MetricsRegistry as _Registry,
+    bucket_counts,
+    bucket_quantile,
+    prometheus_text,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# span seconds round to 6 decimals and phases to 9, so the telescoped
+# sum can differ from seconds by a few 1e-7 — never more
+_SUM_TOL = 2e-6
+
+
+@pytest.fixture(scope="module")
+def bundle():
+    return get_dataset("boolean_circuit")
+
+
+@pytest.fixture(scope="module")
+def model(bundle):
+    return DistributedIBModel(
+        feature_dimensionalities=tuple(bundle.feature_dimensionalities),
+        encoder_hidden=(8,), integration_hidden=(16,),
+        output_dim=1, embedding_dim=2,
+    )
+
+
+@pytest.fixture(scope="module")
+def params(bundle, model):
+    x0 = np.asarray(bundle.x_train[:4], np.float32)
+    return model.init(jax.random.key(0), x0, jax.random.key(1))
+
+
+def _post(url: str, payload: dict, headers: dict | None = None):
+    request = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    try:
+        with urllib.request.urlopen(request, timeout=30) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as exc:
+        return exc.code, json.loads(exc.read())
+
+
+def _stack(model, params, run_dir, quotas=None, admission_limit=None,
+           response_capacity=None):
+    writer = EventWriter(run_dir)
+    writer.run_start(runtime_manifest(extra={"mode": "serve"}))
+    tracer = Tracer(writer)
+    registry = MetricsRegistry()
+    engine = InferenceEngine(model, params, batch_buckets=(1, 4),
+                             telemetry=writer, registry=registry)
+    batcher = MicroBatcher(engine, max_batch=4, max_wait_ms=1.0,
+                           tracer=tracer, registry=registry)
+    router = ReplicaRouter([ReplicaEntry(engine, batcher, 0)])
+    zoo = ModelZoo.single(router, response_capacity=response_capacity,
+                          telemetry=writer, registry=registry)
+    server = DIBServer(zoo, port=0, telemetry=writer, registry=registry,
+                       tracer=tracer, quotas=quotas,
+                       admission_limit=admission_limit).start()
+    return server, registry
+
+
+def _request_spans(run_dir):
+    return [e for e in read_events(run_dir)
+            if e["type"] == "span" and e["name"] == "request"]
+
+
+# --------------------------------------------------- the exact-sum invariant
+def test_phases_sum_exactly_to_seconds_across_request_variants(
+        model, params, bundle, tmp_path):
+    """Real asyncio requests, four outcomes — uncached ok, cached ok,
+    quota 429 — each span's phases telescope to its end-to-end seconds,
+    and each variant carries exactly the phases it traversed."""
+    run_dir = str(tmp_path / "phases_run")
+    server, registry = _stack(
+        model, params, run_dir,
+        quotas=TenantQuotas(rate=0.25, burst=2.0),
+        response_capacity=64)
+    try:
+        rows = np.asarray(bundle.x_valid[:4], np.float32)
+        # two distinct-input requests for tenant a (burst=2 admits both)
+        assert _post(server.url + "/v1/predict",
+                     {"x": rows[0].tolist(), "tenant": "a"})[0] == 200
+        assert _post(server.url + "/v1/predict",
+                     {"x": rows[1].tolist(), "tenant": "a"})[0] == 200
+        # burst spent -> deterministic 429
+        assert _post(server.url + "/v1/predict",
+                     {"x": rows[2].tolist(), "tenant": "a"})[0] == 429
+        # repeat of rows[0] from a fresh tenant -> response-cache hit
+        status, payload = _post(server.url + "/v1/predict",
+                                {"x": rows[0].tolist(), "tenant": "b"})
+        assert status == 200 and payload.get("cached") is True
+    finally:
+        server.close()
+
+    spans = _request_spans(run_dir)
+    assert len(spans) == 4
+    for span in spans:
+        phases = span["phases"]
+        assert set(phases) <= set(REQUEST_PHASES)
+        assert all(v >= 0 for v in phases.values())
+        diff = abs(sum(phases.values()) - span["seconds"])
+        assert diff <= _SUM_TOL, \
+            f"{span['status']}: phase sum off by {diff:.2e}s"
+
+    by_status = {}
+    for span in spans:
+        by_status.setdefault(
+            (span["status"], bool(span.get("cached"))), span)
+    # uncached ok traverses the full pipeline
+    assert set(by_status[("ok", False)]["phases"]) == set(REQUEST_PHASES)
+    # a cache hit never queues or batches (answered on the event loop)
+    assert set(by_status[("ok", True)]["phases"]) == \
+        {"read", "parse", "admission", "dispatch", "serialize", "write"}
+    # a 429 stops at admission
+    assert set(by_status[("quota", False)]["phases"]) == \
+        {"read", "parse", "admission", "serialize", "write"}
+
+    # per-phase histograms landed on /metrics with native buckets
+    hists = registry.snapshot()["histograms"]
+    for phase in REQUEST_PHASES:
+        hist = hists[f"serve.phase.{phase}"]
+        assert hist["count"] >= 1
+        assert any(k.startswith("le_") for k in hist)
+
+
+def test_shed_request_carries_admission_terminated_phases(
+        model, params, tmp_path):
+    """A 503 shed by the in-flight bound stops at admission — and a
+    duck-typed replacement batcher (no server_span kwarg) falls back to
+    batcher-owned spans without ever double-emitting."""
+
+    class _SlowBatcher:
+        def __init__(self, inner):
+            self.inner = inner
+
+        def is_alive(self):
+            return True
+
+        def close(self):
+            self.inner.close()
+
+        def submit(self, x, op, timeout_s=None, tenant=None):
+            time.sleep(0.4)
+            return self.inner.submit(x, op, timeout_s=timeout_s,
+                                     tenant=tenant)
+
+    run_dir = str(tmp_path / "shed_run")
+    writer = EventWriter(run_dir)
+    writer.run_start(runtime_manifest(extra={"mode": "serve"}))
+    tracer = Tracer(writer)
+    engine = InferenceEngine(model, params, batch_buckets=(1,))
+    batcher = _SlowBatcher(MicroBatcher(engine, max_wait_ms=0.0,
+                                        tracer=tracer))
+    router = ReplicaRouter([ReplicaEntry(engine, batcher, 0)])
+    server = DIBServer(router, port=0, admission_limit=1, tracer=tracer,
+                       telemetry=writer,
+                       registry=MetricsRegistry()).start()
+    try:
+        row = [0.0] * engine.feature_width
+        results = []
+
+        def client():
+            results.append(_post(server.url + "/v1/predict", {"x": row}))
+
+        threads = [threading.Thread(target=client) for _ in range(3)]
+        threads[0].start()
+        time.sleep(0.15)
+        for t in threads[1:]:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        codes = sorted(status for status, _ in results)
+        assert codes[0] == 200 and codes[-1] == 503
+    finally:
+        server.close()
+
+    spans = _request_spans(run_dir)
+    shed = [s for s in spans if s["status"] == "shed"]
+    assert shed, "no shed span recorded"
+    for span in shed:
+        assert set(span["phases"]) == \
+            {"read", "parse", "admission", "serialize", "write"}
+        assert abs(sum(span["phases"].values()) - span["seconds"]) \
+            <= _SUM_TOL
+    # the duck-typed batcher kept span ownership for dispatched
+    # requests: exactly one span per request, no doubles
+    ok = [s for s in spans if s["status"] == "ok"]
+    assert len(ok) == len([r for r in results if r[0] == 200])
+    assert all("phases" not in s for s in ok), \
+        "legacy batcher-owned spans must not fabricate phases"
+
+
+# ------------------------------------------------- native histogram buckets
+def test_bucket_merge_is_bit_identical_to_combined_stream():
+    """THE fleet-merge contract: two workers' bucket vectors summed
+    index-wise give the same p50/p90/p99 as one histogram that saw every
+    value — exact, not approximate, because the bounds are fixed
+    fleet-wide."""
+    rng = np.random.default_rng(17)
+    worker_a, worker_b, combined = Histogram(), Histogram(), Histogram()
+    for i, value in enumerate(rng.lognormal(-6.0, 2.0, size=4001)):
+        (worker_a if i % 2 else worker_b).record(float(value))
+        combined.record(float(value))
+    merged = [a + b for a, b in zip(
+        bucket_counts(worker_a.snapshot()),
+        bucket_counts(worker_b.snapshot()))]
+    reference = bucket_counts(combined.snapshot())
+    assert merged == reference
+    for q in (0.5, 0.9, 0.99):
+        assert bucket_quantile(merged, q) == bucket_quantile(reference, q)
+
+
+def test_bucket_bounds_are_fixed_and_log_spaced():
+    assert len(BUCKET_BOUNDS) == 65
+    assert BUCKET_BOUNDS[0] == pytest.approx(1e-6)
+    assert BUCKET_BOUNDS[-1] == pytest.approx(100.0)
+    ratios = [b / a for a, b in zip(BUCKET_BOUNDS, BUCKET_BOUNDS[1:])]
+    assert all(r == pytest.approx(10 ** 0.125) for r in ratios)
+
+
+def test_prometheus_native_histogram_exposition():
+    """The `_hist` family: cumulative `_bucket` lines, `+Inf` ALWAYS
+    emitted (and equal to `_count`), `_hist_sum`/`_hist_count` agreeing
+    with the summary family — on both a populated and an EMPTY
+    histogram."""
+    registry = _Registry()
+    hist = registry.histogram("serve.request_latency_s")
+    for value in (0.001, 0.002, 0.004, 0.008, 5.0, 1000.0):
+        hist.record(value)
+    registry.histogram("serve.phase.parse")   # empty
+    text = prometheus_text(registry.snapshot())
+    lines = text.splitlines()
+
+    assert "# TYPE dib_serve_request_latency_s_hist histogram" in lines
+    bucket_lines = [l for l in lines if
+                    l.startswith("dib_serve_request_latency_s_hist_bucket")]
+    counts = [float(l.rsplit(" ", 1)[1]) for l in bucket_lines]
+    assert counts == sorted(counts), "buckets must be cumulative"
+    assert bucket_lines[-1].startswith(
+        'dib_serve_request_latency_s_hist_bucket{le="+Inf"}')
+    assert counts[-1] == 6.0
+    assert "dib_serve_request_latency_s_hist_count 6" in text
+    assert "dib_serve_request_latency_s_count 6" in text
+    # the 1000.0 value overflows the last bound: +Inf strictly exceeds
+    # the largest finite bucket
+    finite = [l for l in bucket_lines if '+Inf' not in l]
+    assert float(finite[-1].rsplit(" ", 1)[1]) == 5.0
+    # an empty histogram still exposes the +Inf bucket at 0
+    assert 'dib_serve_phase_parse_hist_bucket{le="+Inf"} 0' in text
+
+
+# ------------------------------------------------------- phase-clock overhead
+def test_phase_clock_overhead_under_2pct_of_request_latency(
+        model, params, tmp_path):
+    """Paired, same-run bound: a full clock cycle (8 stamps + the phases
+    rollup) must cost < 2% of the MEASURED p50 request latency on this
+    host — the stamping rides the existing <2% telemetry budget."""
+    run_dir = str(tmp_path / "overhead_run")
+    server, _ = _stack(model, params, run_dir)
+    latencies = []
+    try:
+        row = [0.0] * server.router.entries[0].engine.feature_width
+        for _ in range(30):
+            t0 = time.perf_counter()   # timing-ok: host-side HTTP latency, no jitted call in the interval
+            assert _post(server.url + "/v1/predict", {"x": row})[0] == 200
+            latencies.append(time.perf_counter() - t0)   # timing-ok: host-side HTTP latency, no jitted call in the interval
+    finally:
+        server.close()
+    p50 = sorted(latencies)[len(latencies) // 2]
+
+    n = 2000
+    t0 = time.perf_counter()   # timing-ok: host-side microbenchmark, no jitted call in the interval
+    for _ in range(n):
+        clock = _PhaseClock(time.perf_counter())   # timing-ok: the measured workload itself
+        for phase in REQUEST_PHASES:
+            clock.stamp(phase)
+        clock.phases()
+    per_request = (time.perf_counter() - t0) / n   # timing-ok: host-side microbenchmark, no jitted call in the interval
+    assert per_request < 0.02 * p50, \
+        f"clock cycle {per_request * 1e6:.1f}µs vs p50 {p50 * 1e3:.2f}ms"
+
+
+# ----------------------------------------------- rollup and regression gate
+def test_serving_rollup_phases_and_compare_gate(model, params, bundle,
+                                                tmp_path):
+    """`summarize` rolls span phases into serving.phases (count/p50/p99/
+    mean/share, shares summing to 1), and `compare` gates a per-phase
+    p99 regression — but not sub-floor µs jitter."""
+    from dib_tpu.telemetry import summarize
+    from dib_tpu.telemetry.summary import compare
+
+    run_dir = str(tmp_path / "rollup_run")
+    server, _ = _stack(model, params, run_dir)
+    try:
+        rows = np.asarray(bundle.x_valid[:8], np.float32)
+        for i in range(8):
+            assert _post(server.url + "/v1/predict",
+                         {"x": rows[i].tolist()})[0] == 200
+    finally:
+        server.close()
+
+    summary = summarize(run_dir)
+    phases = summary["serving"]["phases"]
+    assert set(phases) == set(REQUEST_PHASES)
+    for stats in phases.values():
+        assert stats["count"] == 8
+        assert stats["p99_ms"] >= stats["p50_ms"] >= 0
+        assert 0 <= stats["share"] <= 1
+    assert sum(s["share"] for s in phases.values()) == \
+        pytest.approx(1.0, abs=0.01)
+
+    # a 3x parse-p99 blowup past the 0.1 ms floor regresses...
+    import copy
+    worse = copy.deepcopy(summary)
+    worse["serving"]["phases"]["parse"]["p99_ms"] = \
+        max(phases["parse"]["p99_ms"] * 3, 1.0)
+    report, regressed = compare(summary, worse)
+    assert regressed
+    assert report["fields"]["serving_phase_parse_p99_ms"]["regressed"]
+    # ...while a large RELATIVE move inside the 0.1 ms absolute floor
+    # is jitter, not a page
+    tiny_a, tiny_b = copy.deepcopy(summary), copy.deepcopy(summary)
+    tiny_a["serving"]["phases"]["parse"]["p99_ms"] = 0.01
+    tiny_b["serving"]["phases"]["parse"]["p99_ms"] = 0.05
+    report, _ = compare(tiny_a, tiny_b)
+    assert not report["fields"]["serving_phase_parse_p99_ms"]["regressed"]
+
+
+# ------------------------------------------------------------- serve top
+def _load_loadgen():
+    spec = importlib.util.spec_from_file_location(
+        "serve_loadgen", os.path.join(REPO, "scripts", "serve_loadgen.py"))
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def test_serve_top_once_renders_live_prefork_fleet(tmp_path):
+    """`python -m dib_tpu serve top --once` against a REAL 2-worker
+    prefork fleet through the CLI: rc 0, both workers seen, the
+    fleet-merged end-to-end and per-phase rows render with data."""
+    lg = _load_loadgen()
+    ckpt_dir, _, _ = lg._train_tiny_checkpoint(6)
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dib_tpu", "serve",
+         "--checkpoint_dir", ckpt_dir, *lg._TINY_ARCH_FLAGS,
+         "--prefork", "2", "--port", "0",
+         "--buckets", "1", "8", "--max_batch", "8",
+         "--outdir", str(tmp_path / "fleet")],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        cwd=REPO, env=env)
+    try:
+        hello = json.loads(proc.stdout.readline())
+        url = hello["serving"]
+        with urllib.request.urlopen(url + "/healthz", timeout=30) as resp:
+            width = json.loads(resp.read())["feature_width"]
+        row = [0.0] * width
+        for i in range(12):
+            status, _ = _post(url + "/v1/predict",
+                              {"x": [float(i)] + row[1:]})
+            assert status == 200
+
+        top = subprocess.run(
+            [sys.executable, "-m", "dib_tpu", "serve", "top",
+             "--url", url, "--workers", "2", "--once"],
+            capture_output=True, text=True, timeout=120, cwd=REPO,
+            env=env)
+        assert top.returncode == 0, top.stderr
+        frame = top.stdout
+        assert "dib serve top" in frame
+        assert "2/2 worker(s) seen" in frame
+        assert "fleet end-to-end" in frame
+        for phase in REQUEST_PHASES:
+            assert phase in frame
+        # the merged end-to-end histogram saw every request
+        assert "n=12" in frame
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+def test_serve_top_reports_failure_when_no_fleet(tmp_path):
+    """No fleet behind the URL: one frame, honest empty render, rc 1."""
+    top = subprocess.run(
+        [sys.executable, "-m", "dib_tpu", "serve", "top",
+         "--url", "http://127.0.0.1:9", "--workers", "1", "--once"],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert top.returncode == 1
+    assert "no /metrics sample yet" in top.stdout
+
+
+# ------------------------------------------- committed-record schema checks
+def _load_checker():
+    spec = importlib.util.spec_from_file_location(
+        "check_run_artifacts",
+        os.path.join(REPO, "scripts", "check_run_artifacts.py"))
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def _good_phase_record():
+    phases = {
+        name: {"count": 100, "mean_ms": 0.5, "p50_ms": 0.4, "p99_ms": 1.0}
+        for name in REQUEST_PHASES
+    }
+    return {
+        "metric": "serve_phase_anatomy", "unit": "ms",
+        "mode": "open_sweep", "value": 1.0,
+        "parse_p99_ms": 1.0, "serialize_p99_ms": 1.0,
+        "parse_serialize_share": 0.25,
+        "rows": [{
+            "target_rate": 400.0, "requests_sent": 100, "ok": 100,
+            "phases": phases,
+            "e2e_server": {"count": 100, "mean_ms": 4.0, "p50_ms": 3.5,
+                           "p99_ms": 8.0},
+            "phase_sum_ms": 4.0,
+            "e2e_cumulative_buckets": [0, 10, 50, 100],
+        }],
+    }
+
+
+def test_check_run_artifacts_accepts_wellformed_phase_record():
+    checker = _load_checker()
+    problems: list = []
+    checker._check_serve_phases_bench(_good_phase_record(), problems)
+    assert problems == []
+
+
+@pytest.mark.parametrize("mutate, expect", [
+    (lambda r: r["rows"][0].update(phase_sum_ms=5.0),
+     "not within 5%"),
+    (lambda r: r["rows"][0].update(e2e_cumulative_buckets=[0, 50, 30, 100]),
+     "monotone"),
+    (lambda r: r["rows"][0].update(e2e_cumulative_buckets=[0, 10, 50, 99]),
+     "disagree"),
+    (lambda r: r["rows"][0]["phases"].update(
+        warp={"count": 1, "mean_ms": 1.0, "p50_ms": 1.0, "p99_ms": 1.0}),
+     "REQUEST_PHASES"),
+    (lambda r: r["rows"][0]["phases"]["parse"].update(p99_ms=float("nan")),
+     "finite"),
+    (lambda r: r.update(parse_p99_ms=None), "parse_p99_ms"),
+    (lambda r: r.update(parse_serialize_share=1.7), "fraction"),
+    (lambda r: r.update(rows=[]), "non-empty"),
+])
+def test_check_run_artifacts_rejects_broken_phase_records(mutate, expect):
+    checker = _load_checker()
+    record = _good_phase_record()
+    mutate(record)
+    problems: list = []
+    checker._check_serve_phases_bench(record, problems)
+    assert problems, f"mutation expecting {expect!r} went undetected"
+    assert any(expect in p for p in problems), problems
+
+
+def test_committed_phase_bench_passes_schema_and_slo():
+    """The committed BENCH_SERVE_PHASES_CPU.json validates per-row and
+    clears the phase SLO ceilings through `telemetry check`."""
+    path = os.path.join(REPO, "BENCH_SERVE_PHASES_CPU.json")
+    record = json.load(open(path))
+    checker = _load_checker()
+    problems: list = []
+    checker._check_serve_phases_bench(record, problems)
+    assert problems == [], problems
+    from dib_tpu.telemetry.slo import check_run
+
+    report = check_run(path, os.path.join(REPO, "SLO.json"), write=False)
+    assert report["violations"] == 0, report

@@ -101,7 +101,10 @@ def test_follower_handles_concurrent_writer(tmp_path):
     follower = StreamFollower(str(tmp_path))
     seen = []
     thread.start()
-    while not (stop.is_set() and not follower.poll()):
+    # NB: poll() CONSUMES — every call's result must land in `seen`
+    # (a poll inside the loop condition would silently eat events when
+    # the writer finishes before the first condition check).
+    while not stop.is_set():
         seen.extend(follower.poll())
     thread.join()
     seen.extend(follower.poll())
