@@ -89,6 +89,10 @@ _ROLLUP_DOC_CHECKS = (
     # gate keys (rounds_over_budget / unconverged_full_budget) must stay
     # documented as they grow
     ("study_rollup", "Study-rollup keys"),
+    # ISSUE 19: the drift-autopilot rollup (dib_tpu/autopilot) — the
+    # exactly-once gate key (duplicate_studies) and the breaker/latency
+    # gate keys must stay documented as the control plane grows
+    ("autopilot_rollup", "Autopilot-rollup keys"),
 )
 
 

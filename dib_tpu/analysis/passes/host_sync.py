@@ -110,6 +110,13 @@ class HostSyncPass(LintPass):
         # once, exactly the cross-run serialization the sched pool entry
         # guards against
         "dib_tpu/telemetry/fleet.py",
+        # the drift autopilot joined with ISSUE 19: its supervise loop
+        # tails a LIVE trainer's stream and drives mini-studies through
+        # the same worker pool — an implicit fetch in the loop (e.g.
+        # coercing a harvested estimate that arrived as a jitted result
+        # in-process) would park the supervisor mid-drift and stretch
+        # the drift→apply window the SLO gates
+        "dib_tpu/autopilot/loop.py",
     )
 
     def check_module(self, module: Module) -> list[Finding]:

@@ -1,0 +1,36 @@
+"""Drift autopilot: the closed traffic→drift→study→re-anneal loop.
+
+The supervisor (:mod:`dib_tpu.autopilot.loop`) tails an always-on
+stream's durable journals, mints a targeted mini-study per detected
+drift, and applies the refreshed transition-β estimates back to the
+trainer's re-anneal schedule and the serving zoo's routing metadata —
+crash-safe (intent/ack decided-set), poison-proof (content-digest
+verification before any publish seeds a study), and circuit-broken
+(K consecutive failed studies degrade to the fixed schedule).
+"""
+
+from dib_tpu.autopilot.loop import (
+    AUTOPILOT_FILENAME,
+    FAULT_ENV,
+    AutopilotConfig,
+    DriftAutopilot,
+    autopilot_journal_path,
+    autopilot_status,
+    build_reanneal_schedule,
+    build_routing_metadata,
+    fold_autopilot,
+    write_json_atomic,
+)
+
+__all__ = [
+    "AUTOPILOT_FILENAME",
+    "FAULT_ENV",
+    "AutopilotConfig",
+    "DriftAutopilot",
+    "autopilot_journal_path",
+    "autopilot_status",
+    "build_reanneal_schedule",
+    "build_routing_metadata",
+    "fold_autopilot",
+    "write_json_atomic",
+]

@@ -1,0 +1,721 @@
+"""Journal-backed drift autopilot: traffic→drift→study→re-anneal as ONE loop.
+
+The supervisor closes the loop ROADMAP leaves open between the streaming
+plane (PR 12: drift detection + re-anneal) and the study engine (PR 15:
+transition localization). It folds the stream's ``publishes.jsonl`` for
+``drift`` records, and for each one mints a targeted mini-study — seeded
+from the live stream's transition/curvature events (the ``watch_seed``
+harvest, :mod:`dib_tpu.study.controller`) — through the study controller
+under a per-drift unit budget. A converged verdict is applied back as
+
+  - ``<stream-dir>/reanneal.json`` — the online trainer's refreshed
+    re-anneal schedule (``stream/online.py`` rewinds the β schedule to
+    the floor BELOW the lowest refreshed transition instead of replaying
+    the whole ramp);
+  - ``<stream-dir>/routing.json`` — β-routing metadata the deployer
+    attaches to the serving zoo's checkpoints (``stream/deployer.py``).
+
+Robustness is the design, not a bolt-on:
+
+  - **Exactly-once drift→study** by the intent/ack decided-set idiom:
+    every decision lands in ``autopilot.jsonl`` BEFORE it executes
+    (``intent`` → ``submitted`` → ``verdict`` → ``apply_intent`` →
+    ``applied``), the per-drift study directory is deterministic
+    (``studies/drift-r<round>``), and the study controller's own
+    journal resolves submission exactly-once — a SIGKILL in ANY window
+    (before intent, intent→submit, mid-study, verdict→apply, mid-apply)
+    resumes without double-spending or skipping a drift round.
+  - **Poison-proof seeding**: before a published checkpoint may seed a
+    study, its v3 content digests are verified (the
+    :meth:`DIBCheckpointer.scrub` walk). A poisoned publish is refused
+    with a durable ``quarantine`` event + ``skip`` record — corrupt
+    bytes never reach a training unit.
+  - **Debounce/cooldown**: drifts within ``cooldown_rounds`` stream
+    rounds of the last studied drift are durably skipped, so a flapping
+    detector cannot fork-bomb the scheduler with studies.
+  - **Circuit breaker**: ``breaker_threshold`` CONSECUTIVE
+    failed/unconverged drift studies trip the breaker (durable record +
+    mitigation + alert); while open, drifts are skipped and the trainer
+    degrades gracefully to its fixed re-anneal schedule — never a crash
+    loop. Recovery is a half-open probe after ``breaker_probe_after``
+    skips, or an operator ``reset`` (``stream autopilot
+    --reset-breaker``).
+
+Causality: each drift's study runs under a trace context child with the
+``drift:<round>`` parent ref (telemetry/context.py grammar), so the
+merged fleet timeline walks traffic → drift → study → apply end to end.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+import signal
+import time
+
+from dib_tpu.sched.journal import JobJournal, read_journal
+from dib_tpu.stream.deployer import routing_path
+from dib_tpu.stream.online import read_publishes, reanneal_path
+
+__all__ = ["AUTOPILOT_FILENAME", "AutopilotConfig", "DriftAutopilot",
+           "FAULT_ENV", "autopilot_journal_path", "autopilot_status",
+           "build_reanneal_schedule", "build_routing_metadata",
+           "fold_autopilot", "write_json_atomic"]
+
+AUTOPILOT_FILENAME = "autopilot.jsonl"
+STUDIES_DIRNAME = "studies"
+
+#: ``DIB_AUTOPILOT_FAULT=kill@<stage>:<drift_round>`` — the chaos
+#: suite's SIGKILL injector for the supervisor's own exactly-once
+#: windows (the study controller's ``DIB_STUDY_FAULT`` covers the
+#: mid-study windows, since the mini-study runs in-process): stage
+#: ``intent`` kills between the intent append and the study submit,
+#: ``verdict`` between the verdict ack and the apply intent,
+#: ``apply`` between the apply intent and the durable schedule files.
+FAULT_ENV = "DIB_AUTOPILOT_FAULT"
+
+_SUCCESS_VERDICTS = ("converged", "no_transitions")
+
+
+def autopilot_journal_path(autopilot_dir: str) -> str:
+    return os.path.join(autopilot_dir, AUTOPILOT_FILENAME)
+
+
+def write_json_atomic(path: str, payload: dict) -> None:
+    """Durable atomic JSON publish: tmp → fsync → rename → dir fsync.
+    Bytes are canonical (sorted keys, fixed indent, trailing newline), so
+    two processes applying the same journaled payload write IDENTICAL
+    files — the apply-bit-identity invariant the chaos suite compares."""
+    blob = json.dumps(payload, sort_keys=True, indent=1,
+                      allow_nan=False) + "\n"
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        fh.write(blob)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+    fd = os.open(os.path.dirname(path) or ".", os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+# ------------------------------------------------------------------ config
+@dataclasses.dataclass(frozen=True)
+class AutopilotConfig:
+    """The loop's control parameters — journaled on first contact and
+    replayed on restart, so a resumed supervisor re-decides with the
+    parameters its durable decisions were made under. ``study`` holds
+    :class:`~dib_tpu.study.StudyConfig` overrides for the per-drift
+    mini-studies (``max_units`` there IS the per-drift budget cap)."""
+
+    cooldown_rounds: int = 4       # min stream rounds between drift studies
+    breaker_threshold: int = 3     # K consecutive failures open the breaker
+    breaker_probe_after: int = 0   # half-open probe after N breaker skips
+    #                                (0 = operator reset only)
+    margin_decades: float = 0.25   # re-anneal floor below lowest estimate
+    watch_wait_s: float = 0.0      # watch-harvest budget over a live stream
+    study: dict = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.cooldown_rounds < 0:
+            raise ValueError("cooldown_rounds must be >= 0")
+        if self.breaker_threshold < 1:
+            raise ValueError("breaker_threshold must be >= 1")
+        if self.breaker_probe_after < 0:
+            raise ValueError("breaker_probe_after must be >= 0")
+        if self.margin_decades <= 0:
+            raise ValueError("margin_decades must be positive")
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["study"] = dict(self.study)
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "AutopilotConfig":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        kw = {k: v for k, v in d.items() if k in fields}
+        if "study" in kw:
+            kw["study"] = dict(kw["study"] or {})
+        return cls(**kw)
+
+
+# ------------------------------------------------------------------- apply
+def build_reanneal_schedule(estimates: dict, *, drift_round: int,
+                            study_id: str,
+                            margin_decades: float) -> dict | None:
+    """The refreshed re-anneal schedule — a PURE function of the study
+    verdict, so an interrupted apply recomputed from the journaled
+    intent writes bit-identical bytes. ``beta_floor`` sits
+    ``margin_decades`` BELOW the lowest refreshed transition-β: the
+    re-anneal rewinds only far enough to re-explore every transition
+    against the drifted distribution instead of replaying the whole
+    ramp. None when the verdict carries no estimates (nothing to apply)."""
+    vals = {str(c): round(float(v), 8)
+            for c, v in sorted((estimates or {}).items(),
+                               key=lambda kv: str(kv[0]))
+            if v and math.isfinite(float(v)) and float(v) > 0}
+    if not vals:
+        return None
+    floor = 10 ** (math.log10(min(vals.values())) - margin_decades)
+    return {
+        "version": 1,
+        "drift_round": int(drift_round),
+        "study_id": str(study_id),
+        # filtered like the routing metadata: a non-finite estimate must
+        # never reach the canonical allow_nan=False apply bytes
+        "estimates": vals,
+        "beta_floor": round(floor, 8),
+        "margin_decades": float(margin_decades),
+    }
+
+
+def build_routing_metadata(estimates: dict, *, drift_round: int,
+                           study_id: str) -> dict | None:
+    """β-routing metadata for the serving zoo's sweep checkpoints: the
+    per-channel transition-β map a client (or the deployer's describe
+    view) uses to pick the β regime a request should be answered in.
+    Same purity contract as :func:`build_reanneal_schedule`."""
+    vals = {str(c): round(float(v), 8)
+            for c, v in sorted((estimates or {}).items(),
+                               key=lambda kv: str(kv[0]))
+            if v and math.isfinite(float(v)) and float(v) > 0}
+    if not vals:
+        return None
+    return {
+        "version": 1,
+        "drift_round": int(drift_round),
+        "study_id": str(study_id),
+        "transition_betas": vals,
+    }
+
+
+# ------------------------------------------------------------------- fold
+def fold_autopilot(records: list[dict]) -> dict:
+    """Replay autopilot records into the supervisor's resume state.
+
+    ``drifts`` maps each decided drift round to whatever landed
+    (``skip``/``intent``/``submitted``/``verdict``/``apply_intent``/
+    ``apply_skip``/``applied`` records keyed by kind); a round present
+    with an ``intent`` but no terminal record is the round a restarted
+    supervisor resumes INTO. ``breaker`` is derived the same replay-pure
+    way: ``consecutive`` counts verdict failures since the last success
+    or reset, ``open`` follows explicit ``breaker`` trip/reset records,
+    and ``skips_since_trip`` (reset by any probe intent) paces the
+    half-open probe."""
+    state: dict = {
+        "config": None,
+        "drifts": {},
+        "last_intent_round": None,
+        "breaker": {"open": False, "trips": 0, "resets": 0,
+                    "consecutive": 0, "skips_since_trip": 0},
+    }
+    brk = state["breaker"]
+    for r in records:
+        kind = r.get("kind")
+        if kind == "config":
+            state["config"] = dict(r.get("spec") or {})
+        elif kind == "breaker":
+            if r.get("action") == "trip":
+                brk["open"] = True
+                brk["trips"] += 1
+                brk["skips_since_trip"] = 0
+            elif r.get("action") == "reset":
+                brk["open"] = False
+                brk["resets"] += 1
+                brk["consecutive"] = 0
+        elif kind in ("skip", "intent", "submitted", "verdict",
+                      "apply_intent", "apply_skip", "applied"):
+            d = state["drifts"].setdefault(int(r["round"]), {})
+            d[kind] = r
+            if kind == "intent":
+                idx = int(r["round"])
+                if (state["last_intent_round"] is None
+                        or idx > state["last_intent_round"]):
+                    state["last_intent_round"] = idx
+                brk["skips_since_trip"] = 0
+            elif kind == "skip" and r.get("reason") == "breaker_open":
+                brk["skips_since_trip"] += 1
+            elif kind == "verdict":
+                if r.get("verdict") in _SUCCESS_VERDICTS:
+                    brk["consecutive"] = 0
+                else:
+                    brk["consecutive"] += 1
+    return state
+
+
+def autopilot_status(autopilot_dir: str,
+                     stream_dir: str | None = None) -> dict:
+    """Pure file-analysis snapshot (never opens a writer): decided-drift
+    counts, breaker state, and — with ``stream_dir`` — the last applied
+    re-anneal schedule and routing metadata, for ``stream status``."""
+    from dib_tpu.stream.deployer import load_routing
+    from dib_tpu.stream.online import load_reanneal_schedule
+
+    records, torn = read_journal(autopilot_journal_path(autopilot_dir))
+    state = fold_autopilot(records)
+    skip_reasons: dict[str, int] = {}
+    studies = applied = 0
+    for d in state["drifts"].values():
+        if "skip" in d:
+            reason = str(d["skip"].get("reason"))
+            skip_reasons[reason] = skip_reasons.get(reason, 0) + 1
+        if "intent" in d:
+            studies += 1
+        if "applied" in d:
+            applied += 1
+    out = {
+        "autopilot_dir": os.path.abspath(autopilot_dir),
+        "drifts_decided": len(state["drifts"]),
+        "studies": studies,
+        "applied": applied,
+        "skipped": sum(skip_reasons.values()),
+        "skip_reasons": skip_reasons,
+        "breaker": dict(state["breaker"]),
+        "journal_torn": torn,
+    }
+    if stream_dir is not None:
+        out["reanneal"] = load_reanneal_schedule(stream_dir)
+        out["routing"] = load_routing(stream_dir)
+    return out
+
+
+# -------------------------------------------------------------- supervisor
+class DriftAutopilot:
+    """Drives one stream's drift→study→apply loop from its journals.
+
+    ``autopilot_dir`` (default ``<stream-dir>/autopilot``) holds the
+    supervisor's own ``autopilot.jsonl`` plus one ``studies/drift-r<n>``
+    study directory per studied drift. One supervisor per directory is
+    the deployment contract (the journal's seal-on-open inherits it);
+    ``status``/``autopilot_status`` are the read-only views.
+    """
+
+    def __init__(self, stream_dir: str, autopilot_dir: str | None = None,
+                 config: AutopilotConfig | None = None, telemetry=None,
+                 ctx=None, workers: int = 2):
+        from dib_tpu.telemetry.context import from_env
+
+        self.stream_dir = os.path.abspath(stream_dir)
+        self.autopilot_dir = os.path.abspath(
+            autopilot_dir or os.path.join(stream_dir, "autopilot"))
+        self.config = config
+        self.telemetry = telemetry
+        self.workers = int(workers)
+        self.ctx = ctx if ctx is not None else from_env()
+        os.makedirs(self.autopilot_dir, exist_ok=True)
+        self._journal: JobJournal | None = None
+
+    # ----------------------------------------------------------- plumbing
+    def replay(self) -> dict:
+        records, torn = read_journal(
+            autopilot_journal_path(self.autopilot_dir))
+        state = fold_autopilot(records)
+        state["torn"] = torn
+        if state["config"] is not None:
+            self.config = AutopilotConfig.from_dict(state["config"])
+        return state
+
+    def _drift_ctx(self, drift_round: int):
+        """The per-drift trace child — ``drift:<round>`` is the parent
+        grammar the fleet timeline resolves against the stream's own
+        drift record (docs/observability.md 'Fleet causality')."""
+        if self.ctx is None:
+            return None
+        return self.ctx.child(f"drift:{drift_round}", origin="autopilot")
+
+    def _journal_ctx(self, drift_round: int) -> dict:
+        ctx = self._drift_ctx(drift_round)
+        return {} if ctx is None else {"ctx": ctx.to_dict()}
+
+    def _emit(self, action: str, drift_round: int, **fields) -> None:
+        if self.telemetry is not None:
+            self.telemetry.autopilot(action=action, round=drift_round,
+                                     **fields)
+
+    def _maybe_fault(self, stage: str, drift_round: int) -> None:
+        """The chaos suite's SIGKILL injector: a durable ``fault`` event
+        lands BEFORE the kill (the faults contract)."""
+        spec = os.environ.get(FAULT_ENV, "")
+        if spec != f"kill@{stage}:{drift_round}":
+            return
+        if self.telemetry is not None:
+            self.telemetry.fault(kind="autopilot_kill", spec=spec,
+                                 step=drift_round, detail=stage)
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    def ensure_config(self, reconfigure: bool = False) -> dict:
+        """Journal the config on first contact; replay it afterwards.
+        ``reconfigure`` appends a NEW config record (last-wins fold) — an
+        explicit operator action, e.g. fixing the study spec that tripped
+        the breaker before resetting it."""
+        # capture the operator's intended config BEFORE replay():
+        # replay folds the journaled config back into self.config, so
+        # reading it afterwards would silently discard the very spec a
+        # --reconfigure is trying to install
+        wanted = self.config
+        state = self.replay()
+        if state["config"] is None or (reconfigure and wanted is not None):
+            if wanted is None:
+                wanted = AutopilotConfig()
+            if state["config"] != wanted.to_dict():
+                with JobJournal(self.autopilot_dir,
+                                filename=AUTOPILOT_FILENAME) as journal:
+                    journal.append("config", spec=wanted.to_dict())
+            state = self.replay()
+        return state
+
+    # -------------------------------------------------------- poison gate
+    def _verify_seed(self, pub: dict) -> str | None:
+        """None when the publish's checkpoint passes the v3
+        content-digest scrub (template-free: no model flags needed);
+        else the refusal reason. The scrub never mutates the published
+        plane — refusal is recorded, the artifact stays in place for the
+        deployer's own independent decision."""
+        from dib_tpu.train.checkpoint import (
+            CheckpointCorruptionError,
+            DIBCheckpointer,
+        )
+
+        path = os.path.join(self.stream_dir, pub["path"])
+        if not os.path.isdir(path):
+            return "checkpoint directory missing (pruned by retention?)"
+        ckpt = DIBCheckpointer(path)
+        try:
+            if not ckpt.manager.all_steps():
+                return "checkpoint directory holds no steps"
+            report = ckpt.scrub()
+        except CheckpointCorruptionError as exc:
+            return str(exc)
+        finally:
+            ckpt.close()
+        if not report.get("clean"):
+            bad = ",".join(str(s) for s in report.get("corrupt", ()))
+            return f"content-digest scrub failed (corrupt step(s): {bad})"
+        return None
+
+    # ------------------------------------------------------------ harvest
+    def _harvest(self) -> tuple[list[float], list[float]]:
+        """Round-0 seeding from the live stream's own events: transition
+        βs + mi_bounds curvature peaks with their weights (the
+        ``watch_seed`` path the study CLI's ``--watch`` uses)."""
+        from dib_tpu.study.controller import watch_seed
+
+        assert self.config is not None
+        return watch_seed(self.stream_dir, wait_s=self.config.watch_wait_s)
+
+    def _study_config(self, centers: list[float], weights: list[float]):
+        from dib_tpu.study.controller import StudyConfig
+
+        assert self.config is not None
+        spec = dict(self.config.study)
+        if centers:
+            spec["centers"] = [float(c) for c in centers]
+            spec["center_weights"] = [float(w) for w in weights]
+        return StudyConfig.from_dict(spec)
+
+    # ---------------------------------------------------------------- run
+    def run_once(self) -> dict:
+        """One supervision pass: fold both journals, decide every
+        undecided drift round (oldest first), resume any round a dead
+        supervisor left mid-chain, and return the status snapshot."""
+        state = self.ensure_config()
+        if state["torn"] and self.telemetry is not None:
+            self.telemetry.mitigation(
+                mtype="journal_recovered",
+                detail=(f"autopilot journal replayed with {state['torn']} "
+                        "torn line(s) skipped"))
+        journal = JobJournal(self.autopilot_dir,
+                             filename=AUTOPILOT_FILENAME)
+        try:
+            # a supervisor killed between a failing verdict and the trip
+            # append re-decides the trip here (fold is replay-pure)
+            self._maybe_trip(journal, state)
+            drift_records = self._drift_records()
+            for rec in drift_records:
+                idx = int(rec["round"])
+                d = state["drifts"].get(idx, {})
+                if self._decided(d):
+                    continue
+                if "intent" in d and self.telemetry is not None:
+                    self.telemetry.mitigation(
+                        mtype="autopilot_resumed",
+                        reason=(f"drift round {idx} resumed mid-chain "
+                                f"(have: {sorted(d)}) — replaying the "
+                                "decided records exactly-once"))
+                self._handle_drift(journal, state, rec, d)
+                state = self.replay()
+        finally:
+            journal.close()
+        return self.status()
+
+    def run(self, duration_s: float = 0.0, poll_s: float = 2.0) -> dict:
+        """Supervise for ``duration_s`` seconds (0 = one pass)."""
+        snapshot = self.run_once()
+        if duration_s <= 0:
+            return snapshot
+        deadline = time.monotonic() + duration_s
+        while time.monotonic() < deadline:
+            time.sleep(min(poll_s, max(deadline - time.monotonic(), 0.0)))
+            snapshot = self.run_once()
+        return snapshot
+
+    # ------------------------------------------------------------ breaker
+    def reset_breaker(self, via: str = "operator") -> bool:
+        """Close an open breaker durably (no-op when closed)."""
+        state = self.ensure_config()
+        if not state["breaker"]["open"]:
+            return False
+        with JobJournal(self.autopilot_dir,
+                        filename=AUTOPILOT_FILENAME) as journal:
+            journal.append("breaker", action="reset", via=via)
+        if self.telemetry is not None:
+            self.telemetry.breaker(action="reset", via=via)
+            self.telemetry.mitigation(
+                mtype="autopilot_breaker_closed",
+                detail=f"breaker reset ({via}) — drift studies resume")
+        return True
+
+    def _maybe_trip(self, journal: JobJournal, state: dict) -> None:
+        assert self.config is not None
+        brk = state["breaker"]
+        if brk["open"] or brk["consecutive"] < self.config.breaker_threshold:
+            return
+        journal.append("breaker", action="trip",
+                       consecutive=brk["consecutive"],
+                       threshold=self.config.breaker_threshold)
+        brk["open"] = True
+        brk["trips"] += 1
+        brk["skips_since_trip"] = 0
+        if self.telemetry is not None:
+            self.telemetry.breaker(action="trip",
+                                   consecutive=brk["consecutive"],
+                                   threshold=self.config.breaker_threshold)
+            self.telemetry.mitigation(
+                mtype="autopilot_breaker_open",
+                detail=(f"{brk['consecutive']} consecutive drift studies "
+                        "failed — degrading to the fixed re-anneal "
+                        "schedule"))
+            self.telemetry.alert(
+                rule="autopilot_breaker", severity="warn",
+                reason=("drift-study circuit breaker OPEN; the stream "
+                        "re-anneals on its fixed schedule until the "
+                        "breaker is probed or reset"))
+
+    # -------------------------------------------------------------- drift
+    def _drift_records(self) -> list[dict]:
+        records, _ = read_journal(
+            os.path.join(self.stream_dir, "publishes.jsonl"))
+        return [r for r in records if r.get("kind") == "drift"]
+
+    @staticmethod
+    def _decided(d: dict) -> bool:
+        return ("skip" in d or "applied" in d or "apply_skip" in d)
+
+    def _skip(self, journal: JobJournal, idx: int, reason: str,
+              **fields) -> None:
+        journal.append("skip", round=idx, reason=reason, **fields,
+                       **self._journal_ctx(idx))
+        self._emit("skip", idx, reason=reason, **fields)
+
+    def _handle_drift(self, journal: JobJournal, state: dict,
+                      drift_rec: dict, d: dict) -> None:
+        """Walk one drift round through the chain, entering at whatever
+        record the journal already holds — each window replays
+        exactly-once because every step checks its own ack first."""
+        assert self.config is not None
+        config = self.config
+        idx = int(drift_rec["round"])
+        brk = state["breaker"]
+
+        if "intent" not in d:
+            # ---- fresh drift: breaker / debounce / poison gates run
+            # BEFORE anything is spent on it
+            if brk["open"]:
+                probe = (config.breaker_probe_after > 0
+                         and brk["skips_since_trip"]
+                         >= config.breaker_probe_after)
+                if not probe:
+                    self._skip(journal, idx, "breaker_open")
+                    brk["skips_since_trip"] += 1
+                    return
+                if self.telemetry is not None:
+                    self.telemetry.breaker(
+                        action="probe", round=idx,
+                        detail=(f"half-open probe after "
+                                f"{brk['skips_since_trip']} skips"))
+            last = state["last_intent_round"]
+            if (last is not None
+                    and idx - last < config.cooldown_rounds):
+                self._skip(journal, idx, "cooldown", last_study_round=last)
+                return
+            pubs, _ = read_publishes(self.stream_dir)
+            if not pubs:
+                self._skip(journal, idx, "no_publish")
+                return
+            seed_pub = pubs[-1]
+            refusal = self._verify_seed(seed_pub)
+            if refusal is not None:
+                if self.telemetry is not None:
+                    self.telemetry.quarantine(
+                        step=int(seed_pub.get("step", -1)),
+                        reason=f"autopilot seed refused: {refusal}",
+                        path=seed_pub.get("path"),
+                        source=seed_pub.get("publish_id"),
+                        scope="autopilot")
+                    self.telemetry.mitigation(
+                        mtype="autopilot_poisoned_seed",
+                        detail=(f"publish {seed_pub.get('publish_id')} "
+                                f"refused as study seed: {refusal}"))
+                self._skip(journal, idx, "poisoned_seed",
+                           seed_publish=seed_pub.get("publish_id"))
+                return
+            centers, weights = self._harvest()
+            study_id = f"drift-r{idx:04d}"
+            study_rel = os.path.join(STUDIES_DIRNAME, study_id)
+            journal.append("intent", round=idx, study_id=study_id,
+                           study_dir=study_rel,
+                           seed_publish=seed_pub.get("publish_id"),
+                           centers=[float(c) for c in centers],
+                           center_weights=[float(w) for w in weights],
+                           **self._journal_ctx(idx))
+            self._emit("intent", idx, study_id=study_id,
+                       seed_publish=seed_pub.get("publish_id"),
+                       centers=[float(c) for c in centers])
+            if self.telemetry is not None:
+                self.telemetry.link(target=f"drift:{idx}",
+                                    relation="caused_by", plane="stream",
+                                    source_ref=f"study:{study_id}")
+            d = {"intent": {"round": idx, "study_id": study_id,
+                            "study_dir": study_rel,
+                            "seed_publish": seed_pub.get("publish_id"),
+                            "centers": list(centers),
+                            "center_weights": list(weights)}}
+
+        intent = d["intent"]
+        study_id = intent["study_id"]
+        study_dir = os.path.join(self.autopilot_dir, intent["study_dir"])
+        self._maybe_fault("intent", idx)
+
+        # ---- mint/adopt the mini-study (the study journal is the
+        # durable submission; the ack below closes the intent→submit
+        # window on our side)
+        from dib_tpu.study.controller import StudyController
+
+        controller = StudyController(
+            study_dir,
+            config=self._study_config(intent.get("centers") or [],
+                                      intent.get("center_weights") or []),
+            telemetry=self.telemetry,
+            study_id=study_id,
+            ctx=self._drift_ctx(idx))
+        if "submitted" not in d:
+            controller.ensure_config()
+            journal.append("submitted", round=idx, study_id=study_id,
+                           **self._journal_ctx(idx))
+            self._emit("submitted", idx, study_id=study_id,
+                       budget_max=controller.config.max_units)
+
+        # ---- drive the study to a verdict (resumes exactly-once
+        # through its own journal when a previous supervisor died
+        # mid-study)
+        if "verdict" not in d:
+            try:
+                final = controller.run(workers=self.workers)
+                v = final.get("verdict") or {}
+                verdict = str(v.get("verdict", "unconverged"))
+                estimates = dict(v.get("estimates") or {})
+                reason = v.get("reason")
+                budget_spent = final.get("budget_spent", 0)
+            except Exception as exc:  # noqa: BLE001 — a broken study
+                # spec must trip the breaker, not crash-loop the
+                # supervisor
+                verdict, estimates = "error", {}
+                reason = f"{type(exc).__name__}: {exc}"
+                budget_spent = 0
+                if self.telemetry is not None:
+                    self.telemetry.mitigation(
+                        mtype="autopilot_study_error",
+                        detail=f"study {study_id}: {reason}")
+            journal.append("verdict", round=idx, study_id=study_id,
+                           verdict=verdict, reason=reason,
+                           estimates=estimates,
+                           budget_spent=budget_spent,
+                           **self._journal_ctx(idx))
+            self._emit("verdict", idx, study_id=study_id,
+                       verdict=verdict, reason=reason,
+                       estimates=estimates)
+            d["verdict"] = {"verdict": verdict, "estimates": estimates}
+            if verdict in _SUCCESS_VERDICTS:
+                brk["consecutive"] = 0
+                if brk["open"]:
+                    # a successful half-open probe closes the breaker
+                    journal.append("breaker", action="reset", via="probe")
+                    brk["open"] = False
+                    brk["resets"] += 1
+                    if self.telemetry is not None:
+                        self.telemetry.breaker(action="reset", via="probe")
+                        self.telemetry.mitigation(
+                            mtype="autopilot_breaker_closed",
+                            detail=(f"probe study {study_id} succeeded — "
+                                    "drift studies resume"))
+            else:
+                brk["consecutive"] += 1
+                self._maybe_trip(journal, state)
+
+        # ---- apply (or durably decline to)
+        verdict_rec = d["verdict"]
+        self._maybe_fault("verdict", idx)
+        if "apply_intent" not in d:
+            schedule = build_reanneal_schedule(
+                verdict_rec.get("estimates") or {}, drift_round=idx,
+                study_id=study_id,
+                margin_decades=self.config.margin_decades)
+            if (schedule is None
+                    or verdict_rec.get("verdict") not in _SUCCESS_VERDICTS):
+                journal.append("apply_skip", round=idx, study_id=study_id,
+                               reason=(f"verdict "
+                                       f"{verdict_rec.get('verdict')} "
+                                       "carries no applicable estimates"),
+                               **self._journal_ctx(idx))
+                self._emit("apply_skip", idx, study_id=study_id,
+                           verdict=verdict_rec.get("verdict"))
+                return
+            routing = build_routing_metadata(
+                verdict_rec.get("estimates") or {}, drift_round=idx,
+                study_id=study_id)
+            journal.append("apply_intent", round=idx, study_id=study_id,
+                           schedule=schedule, routing=routing,
+                           **self._journal_ctx(idx))
+            d["apply_intent"] = {"schedule": schedule, "routing": routing}
+        self._maybe_fault("apply", idx)
+        # write FROM the journaled intent (never recomputed from live
+        # state): a resumed apply emits byte-identical files
+        schedule = d["apply_intent"]["schedule"]
+        routing = d["apply_intent"].get("routing")
+        write_json_atomic(reanneal_path(self.stream_dir), schedule)
+        if routing is not None:
+            write_json_atomic(routing_path(self.stream_dir), routing)
+        drift_t = drift_rec.get("t")
+        # journal timestamps are epoch-seconds, so the latency must be
+        # too — nothing jitted in this window
+        latency = (round(max(time.time() - float(drift_t), 0.0), 3)  # lint-ok(timing-hygiene): diffed against a journal epoch timestamp, no JAX dispatch in the window
+                   if isinstance(drift_t, (int, float)) else None)
+        journal.append("applied", round=idx, study_id=study_id,
+                       drift_to_apply_s=latency,
+                       **self._journal_ctx(idx))
+        self._emit("applied", idx, study_id=study_id, schedule=schedule,
+                   drift_to_apply_s=latency)
+
+    # ------------------------------------------------------------- status
+    def status(self) -> dict:
+        """Read-only snapshot (never opens a writer)."""
+        out = autopilot_status(self.autopilot_dir, self.stream_dir)
+        out["stream_dir"] = self.stream_dir
+        if self.config is not None:
+            out["config"] = self.config.to_dict()
+        return out

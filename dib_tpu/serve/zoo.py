@@ -168,13 +168,17 @@ class ResponseCache:
 class _ZooModel:
     """One registered model: its router + provenance."""
 
-    __slots__ = ("name", "router", "checkpoint_dir", "reloads")
+    __slots__ = ("name", "router", "checkpoint_dir", "reloads", "routing")
 
     def __init__(self, name: str, router, checkpoint_dir: str | None):
         self.name = name
         self.router = router
         self.checkpoint_dir = checkpoint_dir
         self.reloads = 0
+        # advisory β-routing metadata (the autopilot's refreshed
+        # transition-β map); survives reloads — the estimates describe
+        # the DATA, not one checkpoint's params
+        self.routing: dict | None = None
 
 
 class ModelZoo:
@@ -308,6 +312,18 @@ class ModelZoo:
         with self._lock:
             return list(self._models)
 
+    def set_routing(self, name: str, metadata: dict | None) -> None:
+        """Attach (or clear, with None) advisory β-routing metadata —
+        the autopilot's refreshed transition-β map — to one model. Shown
+        on ``/v1/models`` via :meth:`describe`; never a serving gate, so
+        no cache is invalidated and no router is touched."""
+        with self._lock:
+            entry = self._models.get(name)
+            if entry is None:
+                raise KeyError(f"model {name!r} is not registered "
+                               f"(have: {list(self._models)})")
+            entry.routing = None if metadata is None else dict(metadata)
+
     def describe(self) -> list[dict]:
         """The ``/v1/models`` surface."""
         with self._lock:
@@ -323,6 +339,8 @@ class ModelZoo:
             }
             if entry.checkpoint_dir:
                 row["checkpoint_dir"] = entry.checkpoint_dir
+            if entry.routing is not None:
+                row["routing"] = entry.routing
             out.append({k: v for k, v in row.items() if v is not None})
         return out
 

@@ -1,4 +1,5 @@
-"""``python -m dib_tpu stream run|deploy|status`` — the always-on loop.
+"""``python -m dib_tpu stream run|deploy|autopilot|status`` — the
+always-on loop.
 
 ``run`` trains continuously on a stream over the named dataset and
 publishes chunk-aligned checkpoints through the atomic publish protocol
@@ -167,6 +168,68 @@ def build_stream_parser() -> argparse.ArgumentParser:
     _add_trace_id(p_dep)
     _add_telemetry_dir_flag(p_dep, "--deploy-dir")
 
+    p_auto = sub.add_parser(
+        "autopilot", help="Close the loop: tail the stream's drift "
+                          "events, mint a targeted mini-study per drift, "
+                          "and apply the refreshed transition-β estimates "
+                          "back as the re-anneal schedule + zoo routing "
+                          "metadata (crash-safe, poison-proof, "
+                          "circuit-broken; docs/streaming.md).")
+    _add_stream_dir(p_auto)
+    p_auto.add_argument("--autopilot-dir", "--autopilot_dir",
+                        dest="autopilot_dir", default=None,
+                        help="Supervisor state dir: autopilot.jsonl + the "
+                             "studies/ tree (default: "
+                             "<stream-dir>/autopilot).")
+    p_auto.add_argument("--duration-s", type=float, default=0.0,
+                        dest="duration_s",
+                        help="Tail this long (0 = one catch-up pass over "
+                             "the drift backlog, then exit).")
+    p_auto.add_argument("--poll-s", type=float, default=2.0, dest="poll_s",
+                        help="Drift-journal tail interval.")
+    p_auto.add_argument("--cooldown-rounds", type=int, default=None,
+                        dest="cooldown_rounds",
+                        help="Debounce: rounds a new drift must clear "
+                             "past the last study before it may seed "
+                             "another (default 4).")
+    p_auto.add_argument("--breaker-threshold", type=int, default=None,
+                        dest="breaker_threshold",
+                        help="Consecutive failed/unconverged drift "
+                             "studies that trip the circuit breaker "
+                             "(default 3).")
+    p_auto.add_argument("--breaker-probe-after", type=int, default=None,
+                        dest="breaker_probe_after",
+                        help="Half-open: after this many breaker-skipped "
+                             "drifts, let ONE probe study through "
+                             "(default 0 = operator reset only).")
+    p_auto.add_argument("--margin-decades", type=float, default=None,
+                        dest="margin_decades",
+                        help="Re-anneal floor margin below the lowest "
+                             "refreshed transition β (default 0.25).")
+    p_auto.add_argument("--watch-wait-s", type=float, default=None,
+                        dest="watch_wait_s",
+                        help="Follow the live stream this long when "
+                             "harvesting study centers (default 0: one "
+                             "poll).")
+    p_auto.add_argument("--study-set", action="append", default=[],
+                        dest="study_set", metavar="FIELD=VALUE",
+                        help="Mini-study config override (repeatable), "
+                             "e.g. --study-set max_units=20 "
+                             "--study-set max_rounds=3; max_units IS the "
+                             "per-drift budget cap.")
+    p_auto.add_argument("--workers", type=int, default=2,
+                        help="Pool workers draining each study round.")
+    p_auto.add_argument("--reset-breaker", action="store_true",
+                        dest="reset_breaker",
+                        help="Operator reset: durably close a tripped "
+                             "breaker before tailing.")
+    p_auto.add_argument("--reconfigure", action="store_true",
+                        help="Journal the flags' config even when a "
+                             "config record already exists (last record "
+                             "wins on replay).")
+    _add_trace_id(p_auto)
+    _add_telemetry_dir_flag(p_auto, "--autopilot-dir")
+
     p_stat = sub.add_parser(
         "status", help="Replay the publish/deploy journals into a "
                        "snapshot.")
@@ -175,6 +238,10 @@ def build_stream_parser() -> argparse.ArgumentParser:
                         default=None,
                         help="Also fold this deployer's deploys.jsonl "
                              "(promotion/rollback/lag view).")
+    p_stat.add_argument("--autopilot-dir", "--autopilot_dir",
+                        dest="autopilot_dir", default=None,
+                        help="Also fold this autopilot's journal (drift-"
+                             "study/breaker/applied-schedule view).")
     p_stat.add_argument("--json", action="store_true",
                         help="Machine-readable snapshot.")
     return parser
@@ -460,10 +527,63 @@ def _deploy_main(args, argv: Sequence[str]) -> int:
     return 0
 
 
+def _autopilot_main(args) -> int:
+    from dib_tpu.autopilot import AutopilotConfig, DriftAutopilot
+    from dib_tpu.cli import _parse_sets
+    from dib_tpu.telemetry import open_writer, runtime_manifest, shared_run_id
+    from dib_tpu.telemetry.context import ensure_context
+
+    autopilot_dir = args.autopilot_dir or os.path.join(
+        args.stream_dir, "autopilot")
+    os.makedirs(autopilot_dir, exist_ok=True)
+    kw: dict = {}
+    for name in ("cooldown_rounds", "breaker_threshold",
+                 "breaker_probe_after", "margin_decades", "watch_wait_s"):
+        value = getattr(args, name)
+        if value is not None:
+            kw[name] = value
+    study = _parse_sets(args.study_set)
+    if study:
+        kw["study"] = study
+    config = AutopilotConfig(**kw) if kw else None
+
+    ctx = ensure_context("autopilot", trace_id=args.trace_id)
+    ctx.activate()
+    telemetry = open_writer(args.telemetry_dir, autopilot_dir,
+                            run_id=shared_run_id(), process_index=0, ctx=ctx)
+    if telemetry is not None:
+        telemetry.run_start(runtime_manifest(device_info=False, extra={
+            "mode": "autopilot",
+            "stream_dir": os.path.abspath(args.stream_dir),
+            "autopilot_dir": os.path.abspath(autopilot_dir),
+        }))
+    pilot = DriftAutopilot(args.stream_dir, autopilot_dir, config=config,
+                           telemetry=telemetry, ctx=ctx,
+                           workers=args.workers)
+    pilot.ensure_config(reconfigure=args.reconfigure)
+    if args.reset_breaker:
+        pilot.reset_breaker()
+    # a tripped breaker is the DEGRADED-BUT-HEALTHY state (the stream
+    # re-anneals on its fixed schedule), so the supervisor always exits
+    # 0 — alerting is the telemetry plane's job, not the exit code's
+    snapshot = pilot.run(duration_s=args.duration_s, poll_s=args.poll_s)
+    snapshot["trace_id"] = ctx.trace_id
+    if telemetry is not None:
+        telemetry.run_end(status="ok")
+        telemetry.close()
+        _maybe_register(args, telemetry)
+    print(json.dumps(snapshot))
+    return 0
+
+
 def _status_main(args) -> int:
     from dib_tpu.stream.deployer import stream_status
 
     snapshot = stream_status(args.stream_dir, args.deploy_dir)
+    if args.autopilot_dir:
+        from dib_tpu.autopilot import autopilot_status
+
+        snapshot["autopilot"] = autopilot_status(args.autopilot_dir)
     if args.json:
         print(json.dumps(snapshot, indent=1))
         return 0
@@ -477,6 +597,19 @@ def _status_main(args) -> int:
               f"{snapshot['pending']} pending)")
         print(f"invariants: lost={snapshot['lost_publishes']} "
               f"double={snapshot['double_promotions']}")
+    if "reanneal" in snapshot:
+        re = snapshot["reanneal"]
+        print(f"reanneal: floor β={re['beta_floor']} "
+              f"(drift round {re['drift_round']}, {re['study_id']})")
+    if "autopilot" in snapshot:
+        auto = snapshot["autopilot"]
+        brk = auto["breaker"]
+        print(f"autopilot: {auto['drifts_decided']} drifts decided "
+              f"({auto['studies']} studied / {auto['applied']} applied / "
+              f"{auto['skipped']} skipped)")
+        print(f"breaker: {'OPEN' if brk['open'] else 'closed'} "
+              f"(trips={brk['trips']} resets={brk['resets']} "
+              f"consecutive={brk['consecutive']})")
     return 0
 
 
@@ -485,6 +618,8 @@ def stream_main(argv: Sequence[str]) -> int:
     args = build_stream_parser().parse_args(argv)
     if args.action == "status":
         return _status_main(args)
+    if args.action == "autopilot":
+        return _autopilot_main(args)
     # argv keeps the leading action token: the --watchdog path re-execs
     # `python -m dib_tpu.cli stream <argv minus --watchdog>` and the
     # worker's parser needs `run`/`deploy` back in first position

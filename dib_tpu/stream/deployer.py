@@ -42,12 +42,34 @@ import time
 import numpy as np
 
 from dib_tpu.sched.journal import JobJournal, read_journal
-from dib_tpu.stream.online import maybe_kill, publishes_path, read_publishes
+from dib_tpu.stream.online import (load_reanneal_schedule, maybe_kill,
+                                   publishes_path, read_publishes)
 
 __all__ = ["CanaryFailure", "DEPLOYS_FILENAME", "Deployer",
-           "deploys_path", "read_deploys", "stream_status"]
+           "ROUTING_FILENAME", "deploys_path", "load_routing",
+           "read_deploys", "routing_path", "stream_status"]
 
 DEPLOYS_FILENAME = "deploys.jsonl"
+ROUTING_FILENAME = "routing.json"
+
+
+def routing_path(stream_dir: str) -> str:
+    return os.path.join(stream_dir, ROUTING_FILENAME)
+
+
+def load_routing(stream_dir: str) -> dict | None:
+    """The autopilot-applied β-routing metadata (refreshed transition-β
+    map, ``dib_tpu/autopilot``), or None. Written atomically, so a
+    reader never sees torn bytes; anything unreadable is treated as
+    absent — routing metadata is advisory, never a serving gate."""
+    import json
+
+    try:
+        with open(routing_path(stream_dir), encoding="utf-8") as fh:
+            payload = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    return payload if isinstance(payload, dict) else None
 
 
 def _publish_key(rec: dict) -> str:
@@ -151,6 +173,10 @@ class Deployer:
         # is append-only, so an unchanged size means no new records and
         # the idle poll can skip re-parsing the whole file
         self._publishes_size = -1
+        # (mtime_ns, size) of routing.json at the last successful pickup:
+        # the autopilot replaces the file atomically, so a changed stat
+        # is the only signal the β-routing metadata needs re-attaching
+        self._routing_sig: tuple[int, int] | None = None
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -347,6 +373,38 @@ class Deployer:
                 mtype="warm_restore_failed", model=self.model_name,
                 detail=pub_id, error=error)
 
+    def _refresh_routing(self) -> None:
+        """Attach autopilot-applied β-routing metadata to the served
+        model. Stat-gated like the publish tail (``routing.json`` is
+        replaced atomically, so a changed stat is the only re-attach
+        signal); advisory only — an absent or unreadable file, or a zoo
+        with no model registered yet, just retries on a later poll."""
+        try:
+            st = os.stat(routing_path(self.stream_dir))
+            sig = (st.st_mtime_ns, st.st_size)
+        except OSError:
+            return
+        with self._lock:
+            if sig == self._routing_sig:
+                return
+        routing = load_routing(self.stream_dir)
+        if routing is None or self.model_name not in self.zoo.names():
+            return
+        self.zoo.set_routing(self.model_name, routing)
+        with self._lock:
+            self._routing_sig = sig
+        if self.telemetry is not None:
+            # best-effort: the zoo already carries the metadata, and an
+            # events.jsonl write error must not wedge the tail loop
+            try:
+                self.telemetry.link(
+                    target=f"study:{routing.get('study_id')}",
+                    relation="routes_by", plane="serve",
+                    detail=self.model_name)
+            except Exception as exc:
+                print(f"stream deployer: telemetry write failed for "
+                      f"routing refresh: {exc}", file=sys.stderr)
+
     # -------------------------------------------------------------- tailing
     def catch_up(self) -> int:
         """Process every publish record not yet in the deploy journal, in
@@ -357,6 +415,7 @@ class Deployer:
         means an unchanged byte size is "nothing new". The size stored is
         the PRE-read stat, so a record appended mid-read just costs one
         extra re-read on the next poll, never a miss."""
+        self._refresh_routing()
         try:
             size = os.path.getsize(publishes_path(self.stream_dir))
         except OSError:
@@ -483,6 +542,23 @@ def stream_status(stream_dir: str, deploy_dir: str | None = None) -> dict:
         "publishes_torn": pub_torn,
         "latest_publish": publishes[-1]["publish_id"] if publishes else None,
     }
+    # the autopilot's applied artifacts, when the closed loop has run:
+    # the operator sees WHICH drift round steers the trainer's re-anneal
+    # and the zoo's β routing without reading any journal
+    schedule = load_reanneal_schedule(stream_dir)
+    if schedule is not None:
+        out["reanneal"] = {
+            "drift_round": schedule.get("drift_round"),
+            "study_id": schedule.get("study_id"),
+            "beta_floor": schedule.get("beta_floor"),
+        }
+    routing = load_routing(stream_dir)
+    if routing is not None:
+        out["routing"] = {
+            "drift_round": routing.get("drift_round"),
+            "study_id": routing.get("study_id"),
+            "transition_betas": routing.get("transition_betas"),
+        }
     if deploy_dir is None:
         return out
     deploys, dep_torn = read_deploys(deploy_dir)

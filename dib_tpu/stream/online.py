@@ -45,15 +45,60 @@ from dib_tpu.sched.journal import JobJournal, read_journal
 from dib_tpu.stream.source import DriftSpec, RowStream, make_source
 
 __all__ = ["OnlineConfig", "OnlineDIBTrainer", "PUBLISHES_FILENAME",
-           "publishes_path", "read_publishes"]
+           "REANNEAL_FILENAME", "load_reanneal_schedule", "publishes_path",
+           "read_publishes", "reanneal_path", "reanneal_rewind_epoch"]
 
 PUBLISHES_FILENAME = "publishes.jsonl"
 CHECKPOINTS_DIRNAME = "checkpoints"
 STAGING_DIRNAME = "staging"
+REANNEAL_FILENAME = "reanneal.json"
 
 
 def publishes_path(stream_dir: str) -> str:
     return os.path.join(stream_dir, PUBLISHES_FILENAME)
+
+
+def reanneal_path(stream_dir: str) -> str:
+    return os.path.join(stream_dir, REANNEAL_FILENAME)
+
+
+def load_reanneal_schedule(stream_dir: str) -> dict | None:
+    """The autopilot-applied re-anneal schedule, or None when the stream
+    runs on its fixed schedule. Written atomically (tmp → fsync →
+    rename, ``dib_tpu/autopilot``) so a reader never sees torn bytes;
+    anything unreadable is treated as ABSENT — the fixed schedule is the
+    safe degradation, never a crash."""
+    import json
+
+    try:
+        with open(reanneal_path(stream_dir), encoding="utf-8") as fh:
+            payload = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    return payload if isinstance(payload, dict) else None
+
+
+def reanneal_rewind_epoch(schedule: dict, config) -> int:
+    """The schedule epoch a drift re-anneal rewinds to under an applied
+    schedule: the epoch where the β ramp sits at the schedule's
+    ``beta_floor`` (just below the lowest refreshed transition-β), so
+    the re-anneal re-explores every transition against the drifted
+    distribution without replaying the decades below them. Inverse of
+    :func:`dib_tpu.ops.schedules.log_annealed_beta`; clamps to the full
+    re-anneal (the fixed behavior) whenever the floor is absent, out of
+    range, or the ramp is degenerate."""
+    pre = int(config.num_pretraining_epochs)
+    ann = int(config.num_annealing_epochs)
+    b0, b1 = float(config.beta_start), float(config.beta_end)
+    floor = schedule.get("beta_floor")
+    if (not isinstance(floor, (int, float)) or not math.isfinite(floor)
+            or floor <= 0 or ann <= 0 or b0 <= 0 or b1 <= b0
+            or floor <= b0):
+        return pre
+    frac = (math.log(floor) - math.log(b0)) / (math.log(b1) - math.log(b0))
+    # at least one annealing epoch must remain: rewinding to (or past)
+    # the ramp's end would "re-anneal" at a constant beta_end
+    return pre + min(int(frac * ann), ann - 1)
 
 
 def read_publishes(stream_dir: str) -> tuple[list[dict], int]:
@@ -418,23 +463,43 @@ class OnlineDIBTrainer:
                     self.drifts += 1
                     action = ("reanneal" if online.reanneal_on_drift
                               else "hold")
+                    # an autopilot-applied schedule (reanneal.json,
+                    # dib_tpu/autopilot) narrows the rewind to the floor
+                    # below the refreshed transition-β estimates; absent
+                    # or unreadable, the fixed full re-anneal applies
+                    schedule = (load_reanneal_schedule(self.stream_dir)
+                                if online.reanneal_on_drift else None)
+                    rewind = (cfg.num_pretraining_epochs
+                              if schedule is None
+                              else reanneal_rewind_epoch(schedule, cfg))
                     if self.telemetry is not None:
                         self.telemetry.drift(
                             round=round_index, detector="window_mean",
                             shift=round(shift, 4),
                             threshold=online.drift_threshold,
-                            action=action, epoch=epochs_done)
+                            action=action, epoch=epochs_done,
+                            rewind_epoch=(int(rewind)
+                                          if online.reanneal_on_drift
+                                          else None),
+                            schedule_study=(None if schedule is None
+                                            else schedule.get("study_id")))
                     self._journal.append(
                         "drift", round=round_index, shift=round(shift, 4),
-                        action=action)
+                        action=action,
+                        rewind_epoch=(int(rewind)
+                                      if online.reanneal_on_drift
+                                      else None),
+                        schedule_study=(None if schedule is None
+                                        else schedule.get("study_id")))
                     if online.reanneal_on_drift:
-                        # rewind the SCHEDULE epoch to the anneal start: β
-                        # re-anneals β_start → β_end against the drifted
-                        # distribution; params/optimizer/history continue
+                        # rewind the SCHEDULE epoch: β re-anneals toward
+                        # beta_end against the drifted distribution from
+                        # the anneal start (fixed schedule) or from the
+                        # applied schedule's transition floor;
+                        # params/optimizer/history continue
                         state = type(state)(
                             state.params, state.opt_state,
-                            jnp.asarray(cfg.num_pretraining_epochs,
-                                        jnp.int32))
+                            jnp.asarray(rewind, jnp.int32))
                 key, k_chunk = jax.random.split(key)
                 with timer.phase("stream_chunk"):
                     state, history = self.trainer.run_stream_chunk(

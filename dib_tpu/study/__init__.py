@@ -24,11 +24,14 @@ from dib_tpu.study.controller import (
     aggregate_brackets,
     channel_crossings,
     curvature_centers,
+    ensemble_band_by_channel,
     ensemble_band_nats,
     estimate_from_bracket,
     plan_refinement,
     unit_points,
     watch_centers,
+    watch_seed,
+    weighted_point_allocation,
 )
 from dib_tpu.study.journal import (
     STUDY_JOURNAL_FILENAME,
@@ -50,6 +53,7 @@ __all__ = [
     "aggregate_brackets",
     "channel_crossings",
     "curvature_centers",
+    "ensemble_band_by_channel",
     "ensemble_band_nats",
     "estimate_from_bracket",
     "fold_study",
@@ -59,5 +63,7 @@ __all__ = [
     "study_record",
     "unit_points",
     "watch_centers",
+    "watch_seed",
+    "weighted_point_allocation",
     "write_study_report",
 ]

@@ -161,7 +161,7 @@ def _config_from_args(args) -> "StudyConfig | None":
     """A StudyConfig from the CLI flags, or None when every science flag
     was left at its default (an existing journal's config then wins)."""
     from dib_tpu.cli import _parse_sets
-    from dib_tpu.study.controller import StudyConfig, watch_centers
+    from dib_tpu.study.controller import StudyConfig, watch_seed
 
     kw: dict = {}
     if args.grid is not None:
@@ -181,9 +181,10 @@ def _config_from_args(args) -> "StudyConfig | None":
     if train:
         kw["train"] = train
     if args.watch:
-        centers = watch_centers(args.watch, wait_s=args.watch_wait_s)
+        centers, weights = watch_seed(args.watch, wait_s=args.watch_wait_s)
         if centers:
             kw["centers"] = tuple(centers)
+            kw["center_weights"] = tuple(weights)
         else:
             print(f"study: --watch {args.watch} yielded no transition "
                   "centers; round 0 falls back to the dense grid",

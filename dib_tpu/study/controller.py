@@ -48,9 +48,10 @@ import threading
 import numpy as np
 
 __all__ = ["StudyConfig", "StudyController", "aggregate_brackets",
-           "channel_crossings", "curvature_centers", "ensemble_band_nats",
+           "channel_crossings", "curvature_centers",
+           "ensemble_band_by_channel", "ensemble_band_nats",
            "estimate_from_bracket", "plan_refinement", "unit_points",
-           "watch_centers"]
+           "watch_centers", "watch_seed", "weighted_point_allocation"]
 
 _LN2 = math.log(2.0)
 
@@ -83,6 +84,10 @@ class StudyConfig:
     retry_budget: int = 3
     train: dict = dataclasses.field(default_factory=dict)
     centers: tuple[float, ...] = ()   # watch-seeded round-0 centers
+    #: per-center harvest weights (same length as ``centers`` or empty):
+    #: curvature/transition signal strength steering how much of the
+    #: round-0 budget each center's local grid gets (empty = equal)
+    center_weights: tuple[float, ...] = ()
 
     def __post_init__(self):
         if not (0 < self.grid_start <= self.grid_stop):
@@ -102,11 +107,21 @@ class StudyConfig:
         if self.refine_num < 3:
             raise ValueError("refine_num must be >= 3 (fewer adds no "
                              "interior point to a bracket)")
+        if self.center_weights:
+            if len(self.center_weights) != len(self.centers):
+                raise ValueError(
+                    f"center_weights has {len(self.center_weights)} "
+                    f"entries for {len(self.centers)} centers")
+            if any(not math.isfinite(w) or w <= 0
+                   for w in self.center_weights):
+                raise ValueError("center_weights must be finite and "
+                                 "positive")
 
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)
         d["seeds"] = [int(s) for s in self.seeds]
         d["centers"] = [float(c) for c in self.centers]
+        d["center_weights"] = [float(w) for w in self.center_weights]
         return d
 
     @classmethod
@@ -117,17 +132,33 @@ class StudyConfig:
             kw["seeds"] = tuple(int(s) for s in kw["seeds"])
         if "centers" in kw:
             kw["centers"] = tuple(float(c) for c in kw["centers"])
+        if "center_weights" in kw:
+            kw["center_weights"] = tuple(float(w)
+                                         for w in kw["center_weights"])
         if "train" in kw:
             kw["train"] = dict(kw["train"] or {})
         return cls(**kw)
 
     def initial_betas(self) -> list[float]:
+        """Round-0 grid. Watch-seeded centers each get a local log grid;
+        with ``center_weights`` the FIXED total (``refine_num`` ×
+        centers) is apportioned by signal strength, so the harvest's
+        strongest curvature/transition evidence gets the densest
+        coverage instead of an equal split."""
         from dib_tpu.sched.scheduler import dense_beta_grid, refine_beta_grid
 
-        if self.centers:
+        if not self.centers:
+            return dense_beta_grid(self.grid_start, self.grid_stop,
+                                   self.grid_num)
+        if not self.center_weights:
             return refine_beta_grid(self.centers, num=self.refine_num)
-        return dense_beta_grid(self.grid_start, self.grid_stop,
-                               self.grid_num)
+        counts = weighted_point_allocation(
+            list(self.center_weights),
+            self.refine_num * len(self.centers), floor=2)
+        out: set[float] = set()
+        for center, n in zip(self.centers, counts):
+            out.update(refine_beta_grid([center], num=n))
+        return sorted(out)
 
 
 # ------------------------------------------------------------ decision core
@@ -177,8 +208,37 @@ def estimate_from_bracket(lo: float, hi: float) -> float:
     return float(10 ** ((math.log10(lo) + math.log10(hi)) / 2.0))
 
 
+def weighted_point_allocation(weights: list[float], total: int,
+                              floor: int = 1) -> list[int]:
+    """Apportion ``total`` integer points across positive weights
+    (largest-remainder method), every share at least ``floor``. Pure and
+    deterministic (remainder ties break by position), so a replayed
+    decision allocates identically. Non-positive/empty weight vectors
+    fall back to an equal split — weighting can only FOCUS a fixed
+    budget, never change its size."""
+    n = len(weights)
+    if n == 0:
+        return []
+    total = max(int(total), floor * n)
+    wsum = float(sum(w for w in weights if math.isfinite(w) and w > 0))
+    if wsum <= 0:
+        base, extra = divmod(total, n)
+        return [base + (1 if i < extra else 0) for i in range(n)]
+    spare = total - floor * n
+    raw = [max(float(w), 0.0) / wsum * spare
+           if math.isfinite(w) and w > 0 else 0.0 for w in weights]
+    out = [floor + int(r) for r in raw]
+    order = sorted(range(n), key=lambda i: raw[i] - int(raw[i]),
+                   reverse=True)
+    for i in order[:total - sum(out)]:
+        out[i] += 1
+    return out
+
+
 def plan_refinement(brackets: dict[int, tuple[float, float]], num: int,
-                    already: list[float]) -> list[float]:
+                    already: list[float],
+                    band_widths: dict[int, float] | None = None
+                    ) -> list[float]:
     """New β points refining the brackets: EACH channel bracket gets its
     own ``num``-point log-spaced grid (overlapping brackets naturally
     share points through the union), and points already trained (within
@@ -187,7 +247,14 @@ def plan_refinement(brackets: dict[int, tuple[float, float]], num: int,
     overlapping brackets into one merged span re-grids the union
     coarsely, adds nothing inside the individual brackets, and the
     refinement saturates after one round instead of shrinking every
-    bracket geometrically."""
+    bracket geometrically.
+
+    ``band_widths`` (per-channel across-seed KL spread,
+    :func:`ensemble_band_by_channel`) re-apportions the SAME total
+    budget (``num`` × distinct brackets) toward the widest-band — most
+    ensemble-uncertain — bracket, each bracket keeping at least one
+    interior point. Without full band coverage the split stays equal:
+    a missing measurement must not silently starve a bracket."""
     from dib_tpu.sched.scheduler import dense_beta_grid
 
     have = sorted(set(float(b) for b in already))
@@ -195,25 +262,44 @@ def plan_refinement(brackets: dict[int, tuple[float, float]], num: int,
     def is_new(beta: float) -> bool:
         return all(abs(beta - b) > 1e-6 * max(beta, b) for b in have)
 
+    spans = sorted(set(brackets.values()))
+    counts = {span: num for span in spans}
+    if band_widths and len(spans) > 1:
+        width_by_span: dict[tuple[float, float], float] = {}
+        for c, span in brackets.items():
+            w = band_widths.get(c)
+            if w is not None and math.isfinite(w) and w > 0:
+                span = (float(span[0]), float(span[1]))
+                width_by_span[span] = max(width_by_span.get(span, 0.0),
+                                          float(w))
+        if len(width_by_span) == len(spans):
+            shares = weighted_point_allocation(
+                [width_by_span[s] for s in spans],
+                num * len(spans), floor=3)
+            counts = dict(zip(spans, shares))
+
     out: list[float] = []
-    for lo, hi in sorted(set(brackets.values())):
-        for b in dense_beta_grid(lo, hi, num):
+    for span in spans:
+        lo, hi = span
+        for b in dense_beta_grid(lo, hi, counts[span]):
             if is_new(b) and all(abs(b - o) > 1e-6 * max(b, o)
                                  for o in out):
                 out.append(b)
     return sorted(out)
 
 
-def ensemble_band_nats(points_by_seed: dict[int, dict[float, np.ndarray]],
-                       brackets: dict[int, tuple[float, float]]) -> float | None:
-    """The ensemble error band: over β points every seed trained that lie
-    inside (or on) a bracket, the worst across-seed spread (max − min) of
-    any bracket channel's KL. None with fewer than two seeds or no shared
-    in-bracket points — an absent band never fakes convergence."""
+def ensemble_band_by_channel(
+        points_by_seed: dict[int, dict[float, np.ndarray]],
+        brackets: dict[int, tuple[float, float]]) -> dict[int, float]:
+    """Per-channel ensemble error band: over β points every seed trained
+    that lie inside (or on) a bracket, each bracket channel's worst
+    across-seed KL spread (max − min). Channels with no shared
+    in-bracket measurement are absent — the weighted refinement policy
+    treats an absent band as "don't reweight", never as agreement."""
+    out: dict[int, float] = {}
     if len(points_by_seed) < 2 or not brackets:
-        return None
+        return out
     shared = set.intersection(*(set(pts) for pts in points_by_seed.values()))
-    band = None
     for beta in shared:
         if not any(lo <= beta <= hi for lo, hi in brackets.values()):
             continue
@@ -224,8 +310,19 @@ def ensemble_band_nats(points_by_seed: dict[int, dict[float, np.ndarray]],
             finite = [v for v in vals if math.isfinite(v)]
             if len(finite) >= 2:
                 spread = max(finite) - min(finite)
-                band = spread if band is None else max(band, spread)
-    return band
+                if c not in out or spread > out[c]:
+                    out[c] = spread
+    return out
+
+
+def ensemble_band_nats(points_by_seed: dict[int, dict[float, np.ndarray]],
+                       brackets: dict[int, tuple[float, float]]) -> float | None:
+    """The ensemble error band: the worst per-channel spread
+    (:func:`ensemble_band_by_channel`), or None with fewer than two
+    seeds or no shared in-bracket points — an absent band never fakes
+    convergence."""
+    by_channel = ensemble_band_by_channel(points_by_seed, brackets)
+    return max(by_channel.values()) if by_channel else None
 
 
 def unit_points(directory: str) -> tuple[dict, dict]:
@@ -275,13 +372,12 @@ def unit_points(directory: str) -> tuple[dict, dict]:
 
 
 # ---------------------------------------------------------- watch seeding
-def curvature_centers(points, max_centers: int = 4) -> list[float]:
-    """β values where an MI-bound series bends hardest — the info-plane
-    curvature signal. ``points`` is ``[(beta, mi_value), ...]``; the
-    discrete second difference of MI against log10 β is computed and the
-    local maxima of its magnitude above the series mean are returned
-    (strongest first, capped). Fewer than three finite points carry no
-    curvature."""
+def _curvature_peaks(points, max_centers: int = 4
+                     ) -> list[tuple[float, float]]:
+    """``(beta, |curvature|)`` peaks of an MI-bound series, strongest
+    first: the discrete second difference of MI against log10 β, local
+    maxima above the series' mean magnitude, capped. Fewer than three
+    finite points carry no curvature."""
     pts = sorted({(float(b), float(v)) for b, v in points
                   if b and b > 0 and v is not None
                   and math.isfinite(float(v))})
@@ -301,26 +397,38 @@ def curvature_centers(points, max_centers: int = 4) -> list[float]:
         return []
     mean = sum(c for c, _ in curvature) / len(curvature)
     peaks = sorted((c, b) for c, b in curvature if c > mean)[::-1]
-    return [b for _, b in peaks[:max_centers]]
+    return [(b, c) for c, b in peaks[:max_centers]]
 
 
-def watch_centers(run_dir: str, wait_s: float = 0.0,
-                  poll_s: float = 0.5) -> list[float]:
-    """Round-0 refinement centers from an existing run's event stream.
+def curvature_centers(points, max_centers: int = 4) -> list[float]:
+    """β values where an MI-bound series bends hardest — the info-plane
+    curvature signal (:func:`_curvature_peaks` without the weights)."""
+    return [b for b, _ in _curvature_peaks(points, max_centers)]
+
+
+def watch_seed(run_dir: str, wait_s: float = 0.0,
+               poll_s: float = 0.5) -> tuple[list[float], list[float]]:
+    """Round-0 seeding (centers AND weights) from an existing run's
+    event stream.
 
     Tails the stream with :class:`StreamFollower` (finished streams read
     in one poll; live ones are followed until ``run_end`` or the
     ``wait_s`` budget): the β of every ``transition`` event plus the
-    curvature peaks of the ``mi_bounds`` series. An empty result means
-    the study falls back to its dense grid — a watched stream can only
-    FOCUS the budget, never silently shrink the science.
+    curvature peaks of the ``mi_bounds`` series. Weights carry the
+    evidence strength into the round-0 grid placement
+    (``StudyConfig.initial_betas``): a detected transition counts 1.0, a
+    curvature peak counts its magnitude normalized to the strongest peak,
+    and a β both detect accumulates — double evidence earns the densest
+    local grid. An empty result means the study falls back to its dense
+    grid — a watched stream can only FOCUS the budget, never silently
+    shrink the science.
     """
     import time
 
     from dib_tpu.telemetry.live import StreamFollower
 
     follower = StreamFollower(run_dir)
-    centers: set[float] = set()
+    transitions: set[float] = set()
     mi_points: list[tuple[float, float]] = []
     deadline = time.monotonic() + max(wait_s, 0.0)
     while True:
@@ -330,7 +438,7 @@ def watch_centers(run_dir: str, wait_s: float = 0.0,
             if etype == "transition" and event.get("beta"):
                 beta = float(event["beta"])
                 if beta > 0 and math.isfinite(beta):
-                    centers.add(beta)
+                    transitions.add(beta)
             elif etype == "mi_bounds" and event.get("beta"):
                 lower = event.get("lower_bits")
                 if isinstance(lower, (list, tuple)) and lower:
@@ -346,7 +454,20 @@ def watch_centers(run_dir: str, wait_s: float = 0.0,
         if ended or time.monotonic() >= deadline:
             break
         time.sleep(poll_s)
-    return sorted(centers | set(curvature_centers(mi_points)))
+    weights: dict[float, float] = {b: 1.0 for b in transitions}
+    peaks = _curvature_peaks(mi_points)
+    top = max((m for _, m in peaks), default=0.0)
+    for beta, magnitude in peaks:
+        share = magnitude / top if top > 0 else 1.0
+        weights[beta] = weights.get(beta, 0.0) + share
+    centers = sorted(weights)
+    return centers, [round(weights[b], 6) for b in centers]
+
+
+def watch_centers(run_dir: str, wait_s: float = 0.0,
+                  poll_s: float = 0.5) -> list[float]:
+    """Back-compat view of :func:`watch_seed`: the centers alone."""
+    return watch_seed(run_dir, wait_s=wait_s, poll_s=poll_s)[0]
 
 
 # -------------------------------------------------------------- controller
@@ -635,7 +756,11 @@ class StudyController:
                     "estimates": estimates}
 
         already = [b for r in state["rounds"] for b in r.get("betas", [])]
-        betas = plan_refinement(brackets, config.refine_num, already)
+        band_widths = {int(c): float(v) for c, v in
+                       (last.get("band_by_channel") or {}).items()
+                       if v is not None}
+        betas = plan_refinement(brackets, config.refine_num, already,
+                                band_widths=band_widths or None)
         if not betas:
             if localized:
                 return {"verdict": "converged",
@@ -789,7 +914,8 @@ class StudyController:
                       6) if c in prev else None)
             for c in estimates
         }
-        band = ensemble_band_nats(points, brackets)
+        band_by_channel = ensemble_band_by_channel(points, brackets)
+        band = max(band_by_channel.values()) if band_by_channel else None
         journal.append(
             "round_done", round=current["round"],
             **self._journal_ctx(),
@@ -798,6 +924,8 @@ class StudyController:
                       for c, (lo, hi) in brackets.items()},
             deltas_decades={str(c): v for c, v in deltas.items()},
             band_nats=None if band is None else round(band, 6),
+            band_by_channel={str(c): round(v, 6)
+                             for c, v in band_by_channel.items()},
             units_done=counts["done"], units_failed=counts["failed"])
         self._emit_study(
             "round", round=current["round"],

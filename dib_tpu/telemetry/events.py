@@ -249,10 +249,39 @@ EVENT_SCHEMA: dict[str, EventKindSpec] = {
             "actions `converged` / `unconverged` / `no_transitions`"),
     "drift": EventKindSpec(
         required=("round", "detector"),
-        optional=("shift", "threshold", "action", "epoch"),
+        optional=("shift", "threshold", "action", "epoch",
+                  "rewind_epoch", "schedule_study"),
         doc="one detected input-distribution drift on the training "
             "stream (dib_tpu/stream): the normalized shift, the "
-            "threshold it crossed, and the β response (reanneal/hold)"),
+            "threshold it crossed, and the β response (reanneal/hold); "
+            "a re-anneal under an autopilot-applied schedule carries "
+            "`rewind_epoch` (the targeted restart point the refreshed "
+            "transition-β floor maps to) and `schedule_study` (the "
+            "study that produced it)"),
+    "autopilot": EventKindSpec(
+        required=("action", "round"),
+        optional=("study_id", "reason", "verdict", "estimates",
+                  "centers", "seed_publish", "schedule",
+                  "drift_to_apply_s", "budget_max", "last_study_round"),
+        doc="one drift-autopilot decision on a stream drift round "
+            "(dib_tpu/autopilot): `intent` (a targeted mini-study "
+            "minted for the drift, watch-seeded `centers`), "
+            "`submitted` (its config journaled through the study "
+            "controller under `budget_max` units), `verdict` (the "
+            "study's outcome + refreshed `estimates`), `applied` (the "
+            "re-anneal `schedule` + routing metadata durably written; "
+            "`drift_to_apply_s` is the drift→apply latency the SLO "
+            "gates), `apply_skip`, and `skip` (debounce/breaker/"
+            "poison gates; `reason` says which)"),
+    "breaker": EventKindSpec(
+        required=("action",),
+        optional=("consecutive", "threshold", "round", "via", "detail"),
+        doc="one autopilot circuit-breaker transition "
+            "(dib_tpu/autopilot): `trip` after `consecutive` failed "
+            "drift studies reached `threshold` (drift studies pause; "
+            "the stream degrades to its fixed re-anneal schedule), "
+            "`probe` (one half-open study let through), `reset` "
+            "(closed again, `via` probe/operator)"),
     "link": EventKindSpec(
         required=("target",),
         optional=("relation", "plane", "source_ref", "detail"),
@@ -766,6 +795,20 @@ class EventWriter:
         """One detected training-stream drift (``dib_tpu/stream``)."""
         return self.emit("drift", round=int(round), detector=detector,
                          **fields)
+
+    def autopilot(self, *, action: str, round: int, **fields) -> dict:
+        """One drift-autopilot decision (``dib_tpu/autopilot``):
+        ``action`` is ``intent`` / ``submitted`` / ``verdict`` /
+        ``applied`` / ``apply_skip`` / ``skip`` on drift round
+        ``round`` — the event mirror of the durable ``autopilot.jsonl``
+        chain."""
+        return self.emit("autopilot", action=action, round=int(round),
+                         **fields)
+
+    def breaker(self, *, action: str, **fields) -> dict:
+        """One autopilot circuit-breaker transition
+        (``dib_tpu/autopilot``): ``trip`` / ``probe`` / ``reset``."""
+        return self.emit("breaker", action=action, **fields)
 
     def link(self, *, target: str, **fields) -> dict:
         """One cross-plane causal edge (``telemetry/context.py``):

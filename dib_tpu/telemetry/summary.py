@@ -37,8 +37,8 @@ from dib_tpu.telemetry.events import (
     read_events,
 )
 
-__all__ = ["summarize", "compare", "faults_rollup", "mesh_rollup",
-           "overlap_rollup",
+__all__ = ["summarize", "compare", "autopilot_rollup", "faults_rollup",
+           "mesh_rollup", "overlap_rollup",
            "scheduler_rollup", "serving_rollup", "span_rollup",
            "streaming_rollup", "study_rollup",
            "span_hotspots", "telemetry_main"]
@@ -668,6 +668,66 @@ def study_rollup(events) -> dict | None:
     return out
 
 
+def autopilot_rollup(events) -> dict | None:
+    """Drift-autopilot view of a stream (``dib_tpu/autopilot``,
+    docs/streaming.md "Closed loop"): the traffic→drift→study→re-anneal
+    control plane's ``autopilot`` + ``breaker`` events folded into the
+    counts the SLO rules read. ``duplicate_studies`` (rounds that minted
+    more than one study intent) is the exactly-once gate
+    (``autopilot_duplicate_study_max``); ``breaker_trips`` feeds
+    ``autopilot_breaker_trip_ceiling``; ``drift_to_apply_p99_s`` —
+    drift event to re-anneal schedule applied, from the ``applied``
+    records' own clocks — feeds ``drift_to_apply_p99_ceiling``. None
+    when the stream carries no autopilot activity (ordinary runs skip
+    all three rules)."""
+    pilots = [e for e in events if e.get("type") == "autopilot"]
+    breakers = [e for e in events if e.get("type") == "breaker"]
+    if not pilots and not breakers:
+        return None
+    out: dict = {}
+    out["intents"] = sum(1 for e in pilots if e.get("action") == "intent")
+    out["studies"] = sum(1 for e in pilots
+                         if e.get("action") == "submitted")
+    out["applied"] = sum(1 for e in pilots if e.get("action") == "applied")
+    skips = [e for e in pilots if e.get("action") == "skip"]
+    out["skipped"] = len(skips)
+    reasons: dict[str, int] = {}
+    for e in skips:
+        reason = str(e.get("reason") or "unknown")
+        reasons[reason] = reasons.get(reason, 0) + 1
+    out["skip_reasons"] = {k: reasons[k] for k in sorted(reasons)}
+    # exactly-once gate: every drift round may mint AT MOST one study
+    # intent across every restart of the supervisor
+    intent_rounds: dict[int, int] = {}
+    for e in pilots:
+        if e.get("action") == "intent" and e.get("round") is not None:
+            idx = int(e["round"])
+            intent_rounds[idx] = intent_rounds.get(idx, 0) + 1
+    out["duplicate_studies"] = sum(
+        1 for n in intent_rounds.values() if n > 1)
+    out["breaker_trips"] = sum(1 for e in breakers
+                               if e.get("action") == "trip")
+    out["breaker_resets"] = sum(1 for e in breakers
+                                if e.get("action") == "reset")
+    last_flip = next((e for e in reversed(breakers)
+                      if e.get("action") in ("trip", "reset")), None)
+    out["breaker_open"] = int(
+        last_flip is not None and last_flip["action"] == "trip")
+    latencies = sorted(
+        float(e["drift_to_apply_s"]) for e in pilots
+        if e.get("action") == "applied"
+        and isinstance(e.get("drift_to_apply_s"), (int, float)))
+    if latencies:
+        out["drift_to_apply_p50_s"] = _percentile(latencies, 0.50)
+        out["drift_to_apply_p99_s"] = _percentile(latencies, 0.99)
+    last_applied = next((e.get("round") for e in reversed(pilots)
+                         if e.get("action") == "applied"
+                         and e.get("round") is not None), None)
+    if last_applied is not None:
+        out["last_applied_round"] = int(last_applied)
+    return out
+
+
 def integrity_rollup(events) -> dict | None:
     """Numerical-integrity view of a stream (ISSUE 14,
     docs/robustness.md "Numerical integrity"): the β-aware anomaly
@@ -986,6 +1046,14 @@ def summarize(path: str, process_index: int | None = None,
     study = study_rollup(events)
     if study is not None:
         summary["study"] = study
+
+    # drift-autopilot control plane (dib_tpu/autopilot): the supervisor
+    # journals exactly-once, but its telemetry is the fleet-visible view
+    # the SLO rules gate — intents/applies/breaker flips are global like
+    # the study's (the supervisor and its restarts share one stream)
+    autopilot = autopilot_rollup(events)
+    if autopilot is not None:
+        summary["autopilot"] = autopilot
 
     # mesh execution plane (parallel/sweep.py shard_map engine +
     # mesh-shape-portable checkpoints): axis sizes from the run_start

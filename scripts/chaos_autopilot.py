@@ -1,0 +1,668 @@
+"""Drift-autopilot chaos suite: the closed traffic→drift→study→re-anneal
+loop under faults → ``CHAOS_AUTOPILOT.json``.
+
+The autopilot's durability claim (docs/streaming.md "Closed loop",
+``dib_tpu/autopilot``) is exactly-once drift→study by the intent/ack
+decided-set idiom, a poison gate in front of every study seed, debounce
+against a flapping detector, and a circuit breaker that degrades — never
+crash-loops — when drift studies keep failing. Five drills, each through
+the REAL CLI (``python -m dib_tpu stream run`` / ``stream autopilot``
+subprocesses sharing only the journals):
+
+  - ``study_kill_adopt`` — ``DIB_STUDY_FAULT=kill@submit:0`` SIGKILLs
+    the supervisor INSIDE the drift mini-study's submitted-but-unacked
+    window (the study runs in-process). The restart must resume the
+    journaled intent, ADOPT the already-submitted scheduler job, and
+    carry the round to an applied schedule: exactly one intent, one
+    study directory, one job under the round-0 name.
+  - ``poisoned_seed`` — one bit is flipped in the newest publish's
+    payload (the SDC shape only the v3 content digests catch). The
+    autopilot must refuse the seed (durable ``quarantine`` +
+    ``autopilot_poisoned_seed`` mitigation + ``skip``), mint ZERO
+    studies, and write no schedule — corrupt bytes never reach a
+    training unit.
+  - ``apply_kill`` — two byte-identical copies of one stream; on copy B
+    ``DIB_AUTOPILOT_FAULT=kill@apply:<round>`` kills between the
+    journaled apply intent and the durable schedule files. The restart
+    replays the apply from the journal exactly once, and B's
+    ``reanneal.json``/``routing.json`` must be BIT-IDENTICAL to
+    uninterrupted copy A's.
+  - ``flap_debounce`` — a stream with several scripted drifts against a
+    large ``cooldown_rounds``: exactly ONE study, every later drift
+    durably ``skip(cooldown)`` — a flapping detector cannot fork-bomb
+    the scheduler.
+  - ``breaker_trip_recovery`` — a deliberately broken mini-study spec
+    (round-0 grid cost above ``max_units``) fails two consecutive drift
+    studies → the breaker trips (durable record, exit code still 0: the
+    stream degrades to its fixed re-anneal schedule); the operator path
+    (``--reconfigure`` good spec + ``--reset-breaker``) then carries a
+    fresh drift to a converged, applied study.
+
+Every drill asserts the three autopilot invariants
+(``exactly_once_study`` / ``zero_poisoned_seeds`` /
+``apply_bit_identical``) from the journals alone, and the committed
+record embeds the merged ``autopilot`` rollup so the SLO rules
+(``autopilot_duplicate_study_max``, ``autopilot_breaker_trip_ceiling``,
+``drift_to_apply_p99_ceiling``) evaluate against it directly via
+``telemetry check CHAOS_AUTOPILOT.json``. Validated per-row by
+``scripts/check_run_artifacts.py``.
+
+Usage::
+
+    python scripts/chaos_autopilot.py --out CHAOS_AUTOPILOT.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+METRIC = "chaos_autopilot_matrix"
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: Tiny always-on spec (the chaos_stream scale): 2-epoch chunks over a
+#: 64-row sliding window of the boolean-circuit stream, publishing every
+#: round so every drift has a seed checkpoint.
+WINDOW, STRIDE, CHUNK_EPOCHS, BATCH = 64, 16, 2, 32
+PRE_EPOCHS, ANNEAL_EPOCHS = 2, 4
+DRIFT_MAGNITUDE = 3.0
+DRIFT_THRESHOLD = 0.5
+
+#: One scripted drift, fully inside the window by round 5.
+SINGLE_ROUNDS = 7
+SINGLE_DRIFTS = [f"80:mean_shift:{DRIFT_MAGNITUDE}"]
+#: Repeated shifts a window apart — the flapping-detector shape.
+MULTI_ROUNDS = 14
+MULTI_DRIFTS = [f"{at}:mean_shift:{DRIFT_MAGNITUDE}"
+                for at in (80, 144, 208)]
+#: The breaker-recovery extension: resume the same stream past one more
+#: scripted drift (same earlier specs so the regenerated rows match).
+EXT_ROUNDS = 21
+EXT_DRIFTS = MULTI_DRIFTS + [f"320:mean_shift:{DRIFT_MAGNITUDE}"]
+
+MODEL_FLAGS = [
+    "--dataset", "boolean_circuit",
+    "--feature_embedding_dimension", "2",
+    "--feature_encoder_architecture", "8",
+    "--integration_network_architecture", "16",
+]
+TRAIN_FLAGS = [
+    "--batch_size", str(BATCH),
+    "--number_pretraining_epochs", str(PRE_EPOCHS),
+    "--number_annealing_epochs", str(ANNEAL_EPOCHS),
+]
+STREAM_FLAGS = [
+    "--window", str(WINDOW), "--stride", str(STRIDE),
+    "--chunk-epochs", str(CHUNK_EPOCHS),
+    "--drift-threshold", str(DRIFT_THRESHOLD),
+]
+
+#: Proven-converging mini-study scale (the chaos_study STUDY_FLAGS
+#: surface, expressed as the autopilot CLI's ``--study-set`` pairs).
+STUDY_SETS = [
+    "grid_start=0.03", "grid_stop=30.0", "grid_num=4", "seeds=[0]",
+    "threshold_nats=0.1", "tolerance_decades=0.3",
+    "max_bracket_decades=2.0", "min_refine_rounds=1", "max_rounds=3",
+    "max_units=20", "refine_num=3",
+    ("train={'steps_per_epoch': 16, 'num_annealing_epochs': 20, "
+     "'batch_size': 128, 'chunk_epochs': 11}"),
+]
+#: Deterministically broken: the round-0 grid costs 4 units against a
+#: 1-unit budget, so the controller raises before training anything —
+#: the repeatable study failure the breaker drill trips on.
+BROKEN_STUDY_SETS = [s if not s.startswith("max_units=") else "max_units=1"
+                     for s in STUDY_SETS]
+
+
+def _log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _env(**extra) -> dict:
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    for fault in ("DIB_AUTOPILOT_FAULT", "DIB_STUDY_FAULT",
+                  "DIB_STREAM_FAULT"):
+        env.pop(fault, None)
+    env.pop("DIB_RUNS_ROOT", None)   # drills must not grow the registry
+    env.update(extra)
+    return env
+
+
+def _build_stream(stream_dir: str, rounds: int, drifts: list[str]) -> None:
+    """Run (or resume) the tiny always-on trainer through the real CLI."""
+    cmd = [sys.executable, "-m", "dib_tpu", "stream", "run",
+           "--stream-dir", stream_dir, *MODEL_FLAGS, *TRAIN_FLAGS,
+           *STREAM_FLAGS, "--publish-every", "1",
+           "--rounds", str(rounds), "--seed", "0"]
+    for spec in drifts:
+        cmd += ["--drift", spec]
+    proc = subprocess.run(cmd, env=_env(), cwd=REPO, capture_output=True,
+                          text=True, timeout=900)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"stream run failed (rc={proc.returncode}) for {stream_dir}:\n"
+            f"{(proc.stderr or '')[-2000:]}")
+
+
+def _autopilot(stream_dir: str, *, cooldown: int,
+               threshold: int | None = None,
+               study_sets: list[str] = STUDY_SETS,
+               extra: list[str] | None = None,
+               fault_env: dict | None = None) -> subprocess.CompletedProcess:
+    cmd = [sys.executable, "-m", "dib_tpu", "stream", "autopilot",
+           "--stream-dir", stream_dir,
+           "--cooldown-rounds", str(cooldown), "--workers", "2"]
+    if threshold is not None:
+        cmd += ["--breaker-threshold", str(threshold)]
+    for pair in study_sets:
+        cmd += ["--study-set", pair]
+    cmd += list(extra or [])
+    return subprocess.run(cmd, env=_env(**(fault_env or {})), cwd=REPO,
+                          capture_output=True, text=True, timeout=900)
+
+
+# ------------------------------------------------------------- journals
+def _drift_rounds(stream_dir: str) -> list[int]:
+    from dib_tpu.sched.journal import read_journal
+
+    records, _ = read_journal(os.path.join(stream_dir, "publishes.jsonl"))
+    return sorted(int(r["round"]) for r in records
+                  if r.get("kind") == "drift")
+
+
+def _autopilot_state(stream_dir: str) -> tuple[dict, dict, int]:
+    """(fold state, intent counts per round, torn lines) from the
+    autopilot journal — the drills' single source of truth."""
+    from dib_tpu.autopilot import autopilot_journal_path, fold_autopilot
+    from dib_tpu.sched.journal import read_journal
+
+    records, torn = read_journal(
+        autopilot_journal_path(os.path.join(stream_dir, "autopilot")))
+    intents: dict[int, int] = {}
+    for r in records:
+        if r.get("kind") == "intent":
+            idx = int(r["round"])
+            intents[idx] = intents.get(idx, 0) + 1
+    return fold_autopilot(records), intents, torn
+
+
+def _round_kinds(stream_dir: str, idx: int) -> list[str]:
+    state, _, _ = _autopilot_state(stream_dir)
+    return sorted(state["drifts"].get(idx, {}))
+
+
+def _study_exactly_once(study_dir: str) -> bool:
+    """Study-side cross-check: every decided round maps to exactly one
+    scheduler job and nothing else was enqueued (the chaos_study
+    invariant, folded into the autopilot's)."""
+    from dib_tpu.sched.journal import read_journal
+    from dib_tpu.study.journal import fold_study, read_study_journal
+
+    sched_records, _ = read_journal(study_dir)
+    study_records, _ = read_study_journal(study_dir)
+    rounds = fold_study(study_records)["rounds"]
+    names = [(r.get("spec") or {}).get("name")
+             for r in sched_records if r.get("kind") == "job"]
+    return (all(names.count(r.get("job_name")) == 1 for r in rounds)
+            and len(names) == len(rounds))
+
+
+def _canonical(payload: dict) -> bytes:
+    # must mirror autopilot.write_json_atomic's canonical bytes
+    return (json.dumps(payload, sort_keys=True, indent=1,
+                       allow_nan=False) + "\n").encode()
+
+
+def _apply_bit_identical(stream_dir: str, state: dict) -> tuple[bool, int]:
+    """The on-disk schedule files must be byte-equal to the canonical
+    rendering of the LAST applied round's journaled apply intent.
+    Vacuously true (and 0 applies) when nothing applied."""
+    from dib_tpu.stream.deployer import routing_path
+    from dib_tpu.stream.online import reanneal_path
+
+    applied = [idx for idx, d in state["drifts"].items()
+               if "applied" in d and "apply_intent" in d]
+    if not applied:
+        return True, 0
+    intent = state["drifts"][max(applied)]["apply_intent"]
+    try:
+        with open(reanneal_path(stream_dir), "rb") as f:
+            ok = f.read() == _canonical(intent["schedule"])
+        routing = intent.get("routing")
+        if ok and routing is not None:
+            with open(routing_path(stream_dir), "rb") as f:
+                ok = f.read() == _canonical(routing)
+    except OSError:
+        ok = False
+    return bool(ok), len(applied)
+
+
+def _invariants(stream_dir: str) -> dict:
+    """The three autopilot invariants from the journals alone, plus the
+    counters the drills assert against."""
+    state, intents, torn = _autopilot_state(stream_dir)
+    studies_root = os.path.join(stream_dir, "autopilot", "studies")
+    study_dirs = (sorted(os.listdir(studies_root))
+                  if os.path.isdir(studies_root) else [])
+    exactly_once = (
+        all(n == 1 for n in intents.values())
+        and len(study_dirs) == len(intents)
+        and all(_study_exactly_once(os.path.join(studies_root, d))
+                for d in study_dirs))
+    poisoned = [idx for idx, d in state["drifts"].items()
+                if "skip" in d
+                and d["skip"].get("reason") == "poisoned_seed"]
+    zero_poisoned = all(idx not in intents
+                        and f"drift-r{idx:04d}" not in study_dirs
+                        for idx in poisoned)
+    apply_ok, applies = _apply_bit_identical(stream_dir, state)
+    skip_reasons: dict[str, int] = {}
+    for d in state["drifts"].values():
+        if "skip" in d:
+            reason = str(d["skip"].get("reason"))
+            skip_reasons[reason] = skip_reasons.get(reason, 0) + 1
+    return {
+        "exactly_once_study": bool(exactly_once),
+        "zero_poisoned_seeds": bool(zero_poisoned),
+        "apply_bit_identical": bool(apply_ok),
+        "duplicate_studies": sum(1 for n in intents.values() if n > 1),
+        "drifts_decided": len(state["drifts"]),
+        "intents": sum(intents.values()),
+        "applies": applies,
+        "poisoned_skips": len(poisoned),
+        "skip_reasons": skip_reasons,
+        "breaker": dict(state["breaker"]),
+        "journal_torn": torn,
+    }
+
+
+def _verdict_of(stream_dir: str, idx: int) -> str | None:
+    state, _, _ = _autopilot_state(stream_dir)
+    verdict = state["drifts"].get(idx, {}).get("verdict")
+    return None if verdict is None else verdict.get("verdict")
+
+
+def _evidence(stream_dir: str) -> dict:
+    """Independent reproduction from the telemetry plane — ``telemetry
+    summarize`` over the autopilot's own event stream."""
+    from dib_tpu.telemetry import summarize
+
+    summary = summarize(os.path.join(stream_dir, "autopilot"))
+    return {k: summary.get(k)
+            for k in ("autopilot", "faults", "mitigations", "status")}
+
+
+_INVARIANT_KEYS = ("exactly_once_study", "zero_poisoned_seeds",
+                   "apply_bit_identical", "duplicate_studies",
+                   "drifts_decided", "intents", "applies",
+                   "skip_reasons", "breaker")
+
+
+# ----------------------------------------------------------------- drills
+def drill_study_kill_adopt(donor: str, workdir: str) -> dict:
+    """SIGKILL the supervisor inside the mini-study's submitted-but-
+    unacked window; the restart must adopt, not resubmit."""
+    stream_dir = os.path.join(workdir, "study_kill_adopt")
+    shutil.copytree(donor, stream_dir)
+    rounds = _drift_rounds(stream_dir)
+    target = rounds[0]
+    fault = "kill@submit:0"
+    _log(f"drill study_kill_adopt: DIB_STUDY_FAULT={fault} at drift "
+         f"round {target}")
+    t0 = time.time()
+    first = _autopilot(stream_dir, cooldown=100,
+                       fault_env={"DIB_STUDY_FAULT": fault})
+    killed = first.returncode == -signal.SIGKILL
+    # the kill window: the autopilot's intent+submitted are durable, no
+    # verdict yet — and the scheduler already holds the round-0 job the
+    # restart must adopt
+    mid_kinds = _round_kinds(stream_dir, target)
+    study_id = f"drift-r{target:04d}"
+    study_dir = os.path.join(stream_dir, "autopilot", "studies", study_id)
+    from dib_tpu.sched.journal import read_journal
+
+    sched_records, _ = read_journal(study_dir)
+    jobs_r0 = sum(1 for r in sched_records if r.get("kind") == "job"
+                  and (r.get("spec") or {}).get("name")
+                  == f"study:{study_id}:r0")
+    window_ok = (mid_kinds == ["intent", "submitted"] and jobs_r0 == 1)
+
+    second = _autopilot(stream_dir, cooldown=100)
+    inv = _invariants(stream_dir)
+    evidence = _evidence(stream_dir)
+    mitigations = evidence.get("mitigations") or {}
+    resumed = (mitigations.get("autopilot_resumed", 0) >= 1
+               and mitigations.get("study_resumed", 0) >= 1)
+    faults = evidence.get("faults") or {}
+    ok = (killed and window_ok and second.returncode == 0
+          and inv["exactly_once_study"] and inv["zero_poisoned_seeds"]
+          and inv["apply_bit_identical"] and inv["intents"] == 1
+          and inv["applies"] == 1 and resumed
+          and _verdict_of(stream_dir, target) == "converged"
+          and faults.get("injected", 0) >= 1)
+    if not ok:
+        _log(f"  study_kill_adopt FAILED: killed={killed} "
+             f"window={mid_kinds}/{jobs_r0} rc2={second.returncode} "
+             f"inv={inv} resumed={resumed}\n  stderr tail: "
+             f"{(second.stderr or '')[-500:]}")
+    return {
+        "drill": "study_kill_adopt", "kind": "study_kill",
+        "ok": bool(ok), "fault": fault, "drift_round": target,
+        "killed_by_sigkill": bool(killed),
+        "kill_window_state": {"round_kinds": mid_kinds,
+                              "jobs_under_round0_name": jobs_r0},
+        "resume_rc": second.returncode,
+        "adopted_existing_job": bool(window_ok),
+        "verdict": _verdict_of(stream_dir, target),
+        **{k: inv[k] for k in _INVARIANT_KEYS},
+        "wall_s": round(time.time() - t0, 1),
+        "evidence": evidence,
+    }
+
+
+def drill_poisoned_seed(donor: str, workdir: str) -> dict:
+    """One flipped payload bit in the newest publish: the digest gate
+    must refuse the seed — zero studies, nothing trained, no schedule."""
+    stream_dir = os.path.join(workdir, "poisoned_seed")
+    shutil.copytree(donor, stream_dir)
+    from dib_tpu.faults.inject import corrupt_checkpoint
+    from dib_tpu.stream.online import read_publishes, reanneal_path
+
+    pubs, _ = read_publishes(stream_dir)
+    ckpt_dir = os.path.join(stream_dir, pubs[-1]["path"])
+    detail = corrupt_checkpoint(ckpt_dir, "ckpt_bitflip_payload")
+    _log("drill poisoned_seed: flipped one payload bit in "
+         f"{pubs[-1]['publish_id']}")
+    t0 = time.time()
+    proc = _autopilot(stream_dir, cooldown=0)
+    inv = _invariants(stream_dir)
+    evidence = _evidence(stream_dir)
+    mitigations = evidence.get("mitigations") or {}
+    refused = mitigations.get("autopilot_poisoned_seed", 0) >= 1
+    ok = (proc.returncode == 0 and inv["intents"] == 0
+          and inv["applies"] == 0 and inv["poisoned_skips"] >= 1
+          and inv["drifts_decided"] >= 1 and refused
+          and inv["exactly_once_study"] and inv["zero_poisoned_seeds"]
+          and inv["apply_bit_identical"]
+          and not os.path.exists(reanneal_path(stream_dir)))
+    if not ok:
+        _log(f"  poisoned_seed FAILED: rc={proc.returncode} inv={inv} "
+             f"refused={refused}\n  stderr tail: "
+             f"{(proc.stderr or '')[-500:]}")
+    return {
+        "drill": "poisoned_seed", "kind": "poison_gate", "ok": bool(ok),
+        "rc": proc.returncode,
+        "corrupted": {"publish_id": pubs[-1].get("publish_id"),
+                      "path": os.path.relpath(detail["path"], workdir),
+                      "byte": detail["flipped_byte"]},
+        "poisoned_seed_mitigations": mitigations.get(
+            "autopilot_poisoned_seed", 0),
+        "schedule_written": os.path.exists(reanneal_path(stream_dir)),
+        **{k: inv[k] for k in _INVARIANT_KEYS},
+        "wall_s": round(time.time() - t0, 1),
+        "evidence": evidence,
+    }
+
+
+def drill_apply_kill(donor: str, workdir: str) -> dict:
+    """Kill between the journaled apply intent and the schedule files;
+    the resumed apply must emit bytes identical to an uninterrupted
+    supervisor's over the same stream."""
+    from dib_tpu.autopilot import FAULT_ENV
+    from dib_tpu.stream.deployer import routing_path
+    from dib_tpu.stream.online import reanneal_path
+
+    a_dir = os.path.join(workdir, "apply_kill_a")
+    b_dir = os.path.join(workdir, "apply_kill_b")
+    shutil.copytree(donor, a_dir)
+    shutil.copytree(donor, b_dir)
+    target = _drift_rounds(b_dir)[0]
+    fault = f"kill@apply:{target}"
+    _log(f"drill apply_kill: {FAULT_ENV}={fault} on copy B, "
+         "uninterrupted copy A as the byte oracle")
+    t0 = time.time()
+    base = _autopilot(a_dir, cooldown=100)
+    first = _autopilot(b_dir, cooldown=100, fault_env={FAULT_ENV: fault})
+    killed = first.returncode == -signal.SIGKILL
+    mid_kinds = _round_kinds(b_dir, target)
+    window_ok = ("apply_intent" in mid_kinds
+                 and "applied" not in mid_kinds
+                 and not os.path.exists(reanneal_path(b_dir)))
+    second = _autopilot(b_dir, cooldown=100)
+
+    def _bytes(path: str) -> bytes | None:
+        try:
+            with open(path, "rb") as f:
+                return f.read()
+        except OSError:
+            return None
+
+    sched_a = _bytes(reanneal_path(a_dir))
+    sched_b = _bytes(reanneal_path(b_dir))
+    route_a = _bytes(routing_path(a_dir))
+    route_b = _bytes(routing_path(b_dir))
+    identical = (sched_a is not None and sched_a == sched_b
+                 and route_a == route_b)
+    inv = _invariants(b_dir)
+    inv_a = _invariants(a_dir)
+    evidence = _evidence(b_dir)
+    ok = (base.returncode == 0 and killed and window_ok
+          and second.returncode == 0 and identical
+          and inv["exactly_once_study"] and inv["zero_poisoned_seeds"]
+          and inv["apply_bit_identical"] and inv["intents"] == 1
+          and inv["applies"] == 1 and inv_a["apply_bit_identical"]
+          and inv_a["applies"] == 1)
+    if not ok:
+        _log(f"  apply_kill FAILED: rc_a={base.returncode} "
+             f"killed={killed} window={mid_kinds} "
+             f"rc2={second.returncode} identical={identical} inv={inv}\n"
+             f"  stderr tail: {(second.stderr or '')[-500:]}")
+    return {
+        "drill": "apply_kill", "kind": "apply_kill", "ok": bool(ok),
+        "fault": fault, "drift_round": target,
+        "killed_by_sigkill": bool(killed),
+        "kill_window_state": {"round_kinds": mid_kinds,
+                              "schedule_on_disk": not window_ok},
+        "resume_rc": second.returncode,
+        "schedule_bit_identical_to_uninterrupted": bool(identical),
+        "uninterrupted_applies": inv_a["applies"],
+        **{k: inv[k] for k in _INVARIANT_KEYS},
+        "wall_s": round(time.time() - t0, 1),
+        "evidence": evidence,
+    }
+
+
+def drill_flap_debounce(donor: str, workdir: str) -> dict:
+    """Several scripted drifts against a large cooldown: one study,
+    every later drift durably skipped as ``cooldown``."""
+    stream_dir = os.path.join(workdir, "flap_debounce")
+    shutil.copytree(donor, stream_dir)
+    rounds = _drift_rounds(stream_dir)
+    _log(f"drill flap_debounce: {len(rounds)} drift rounds {rounds}, "
+         "cooldown 100")
+    t0 = time.time()
+    proc = _autopilot(stream_dir, cooldown=100)
+    inv = _invariants(stream_dir)
+    evidence = _evidence(stream_dir)
+    cooldown_skips = inv["skip_reasons"].get("cooldown", 0)
+    ok = (proc.returncode == 0 and len(rounds) >= 2
+          and inv["intents"] == 1 and cooldown_skips == len(rounds) - 1
+          and inv["drifts_decided"] == len(rounds)
+          and inv["applies"] == 1
+          and inv["exactly_once_study"] and inv["zero_poisoned_seeds"]
+          and inv["apply_bit_identical"])
+    if not ok:
+        _log(f"  flap_debounce FAILED: rc={proc.returncode} "
+             f"rounds={rounds} inv={inv}\n  stderr tail: "
+             f"{(proc.stderr or '')[-500:]}")
+    return {
+        "drill": "flap_debounce", "kind": "debounce", "ok": bool(ok),
+        "rc": proc.returncode, "drift_rounds": rounds,
+        "cooldown_skips": cooldown_skips,
+        **{k: inv[k] for k in _INVARIANT_KEYS},
+        "wall_s": round(time.time() - t0, 1),
+        "evidence": evidence,
+    }
+
+
+def drill_breaker_trip_recovery(donor: str, workdir: str) -> dict:
+    """Two consecutive broken drift studies trip the breaker (exit code
+    stays 0 — degraded, not dead); reconfigure + reset then carries a
+    fresh drift to a converged, applied study."""
+    stream_dir = os.path.join(workdir, "breaker_trip_recovery")
+    shutil.copytree(donor, stream_dir)
+    rounds = _drift_rounds(stream_dir)
+    _log(f"drill breaker_trip_recovery: {len(rounds)} drift rounds, "
+         "breaker threshold 2, broken study spec")
+    t0 = time.time()
+    broken = _autopilot(stream_dir, cooldown=0, threshold=2,
+                        study_sets=BROKEN_STUDY_SETS)
+    tripped = _invariants(stream_dir)
+    trip_ok = (broken.returncode == 0 and len(rounds) >= 3
+               and tripped["breaker"]["open"]
+               and tripped["breaker"]["trips"] == 1
+               and tripped["skip_reasons"].get("breaker_open", 0) >= 1
+               and tripped["applies"] == 0)
+
+    # recovery: extend the stream past one more scripted drift, fix the
+    # study spec (--reconfigure), close the breaker (--reset-breaker)
+    _build_stream(stream_dir, rounds=EXT_ROUNDS, drifts=EXT_DRIFTS)
+    state, _, _ = _autopilot_state(stream_dir)
+    fresh = [r for r in _drift_rounds(stream_dir)
+             if r not in state["drifts"]]
+    last_intent = state["last_intent_round"] or 0
+    # pass the first fresh drift through the cooldown gate while keeping
+    # later flap records debounced
+    cooldown = max(fresh[0] - last_intent, 1) if fresh else 1
+    recover = _autopilot(stream_dir, cooldown=cooldown, threshold=2,
+                         extra=["--reset-breaker", "--reconfigure"])
+    inv = _invariants(stream_dir)
+    evidence = _evidence(stream_dir)
+    recover_ok = (recover.returncode == 0 and bool(fresh)
+                  and not inv["breaker"]["open"]
+                  and inv["breaker"]["trips"] == 1
+                  and inv["breaker"]["resets"] == 1
+                  and inv["applies"] >= 1
+                  and _verdict_of(stream_dir, fresh[0]) == "converged")
+    ok = (trip_ok and recover_ok and inv["exactly_once_study"]
+          and inv["zero_poisoned_seeds"] and inv["apply_bit_identical"])
+    if not ok:
+        _log(f"  breaker_trip_recovery FAILED: trip_ok={trip_ok} "
+             f"recover_ok={recover_ok} rc=({broken.returncode},"
+             f"{recover.returncode}) fresh={fresh} tripped={tripped} "
+             f"inv={inv}\n  stderr tail: "
+             f"{(recover.stderr or '')[-500:]}")
+    return {
+        "drill": "breaker_trip_recovery", "kind": "breaker",
+        "ok": bool(ok), "rc_broken": broken.returncode,
+        "rc_recover": recover.returncode,
+        "drift_rounds": rounds, "fresh_rounds": fresh,
+        "tripped_state": {"breaker": tripped["breaker"],
+                          "skip_reasons": tripped["skip_reasons"]},
+        "recovered_verdict": _verdict_of(stream_dir,
+                                         fresh[0]) if fresh else None,
+        **{k: inv[k] for k in _INVARIANT_KEYS},
+        "wall_s": round(time.time() - t0, 1),
+        "evidence": evidence,
+    }
+
+
+# ----------------------------------------------------------------- driver
+def run_drills(workdir: str | None = None) -> dict:
+    owned = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="dib_chaos_autopilot_")
+    matrix: list[dict] = []
+    try:
+        donor_single = os.path.join(workdir, "donor_single")
+        _log(f"building single-drift donor stream ({SINGLE_ROUNDS} "
+             "rounds)")
+        _build_stream(donor_single, rounds=SINGLE_ROUNDS,
+                      drifts=SINGLE_DRIFTS)
+        donor_multi = os.path.join(workdir, "donor_multi")
+        _log(f"building multi-drift donor stream ({MULTI_ROUNDS} rounds)")
+        _build_stream(donor_multi, rounds=MULTI_ROUNDS,
+                      drifts=MULTI_DRIFTS)
+        matrix.append(drill_study_kill_adopt(donor_single, workdir))
+        matrix.append(drill_poisoned_seed(donor_single, workdir))
+        matrix.append(drill_apply_kill(donor_single, workdir))
+        matrix.append(drill_flap_debounce(donor_multi, workdir))
+        matrix.append(drill_breaker_trip_recovery(donor_multi, workdir))
+    finally:
+        if owned:
+            shutil.rmtree(workdir, ignore_errors=True)
+    passed = sum(1 for d in matrix if d["ok"])
+    # the merged control-plane view the SLO rules gate
+    # (autopilot_breaker_trip_ceiling / drift_to_apply_p99_ceiling via
+    # the dotted `autopilot.` paths, duplicate_studies via the scoped
+    # exactly-once rule)
+    rollups = [d["evidence"]["autopilot"] for d in matrix
+               if isinstance((d.get("evidence") or {}).get("autopilot"),
+                             dict)]
+    p99s = [r["drift_to_apply_p99_s"] for r in rollups
+            if r.get("drift_to_apply_p99_s") is not None]
+    duplicates = sum(d.get("duplicate_studies", 0) for d in matrix)
+    autopilot = {
+        "intents": sum(d.get("intents", 0) for d in matrix),
+        "applies": sum(d.get("applies", 0) for d in matrix),
+        "duplicate_studies": duplicates,
+        "breaker_trips": sum((d.get("breaker") or {}).get("trips", 0)
+                             for d in matrix),
+        "breaker_resets": sum((d.get("breaker") or {}).get("resets", 0)
+                              for d in matrix),
+    }
+    if p99s:
+        autopilot["drift_to_apply_p99_s"] = max(p99s)
+    return {
+        "metric": METRIC,
+        "value": passed,
+        "unit": "drills_passed",
+        "total": len(matrix),
+        "quick": False,
+        "all_passed": passed == len(matrix),
+        "duplicate_studies": duplicates,
+        "autopilot": autopilot,
+        "window": WINDOW,
+        "stride": STRIDE,
+        "matrix": matrix,
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--out", default=None,
+                        help="Also write the JSON record to this path.")
+    parser.add_argument("--workdir", default=None,
+                        help="Keep drill artifacts here (default: a temp "
+                             "dir, removed afterwards).")
+    parser.add_argument("--runs-root", "--runs_root", dest="runs_root",
+                        default=None,
+                        help="Register this run in the fleet registry "
+                             "(<runs-root>/index.jsonl; default: "
+                             "DIB_RUNS_ROOT when set, else off).")
+    args = parser.parse_args(argv)
+    record = run_drills(workdir=args.workdir)
+    print(json.dumps(record), flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(json.dumps(record, indent=1) + "\n")
+    from dib_tpu.telemetry.registry import register_drill_record
+
+    if register_drill_record(record, root=args.runs_root, extra={
+            "duplicate_studies": record["duplicate_studies"]}) is not None:
+        _log("chaos_autopilot: registered in the fleet registry")
+    return 0 if record["all_passed"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -52,7 +52,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 ARTIFACT_GLOBS = ("BENCH_*.json", "NORTHSTAR_*.json", "FAULT_DRILL*.json",
                   "CHAOS_SCHED*.json", "CHAOS_STREAM*.json",
-                  "CHAOS_SDC*.json", "CHAOS_STUDY*.json", "STUDY_*.json",
+                  "CHAOS_SDC*.json", "CHAOS_STUDY*.json",
+                  "CHAOS_AUTOPILOT*.json", "STUDY_*.json",
                   "FLEET_*.json")
 
 # Null-value excuses: at least one must be present when value is null.
@@ -278,6 +279,41 @@ def _check_chaos_study_matrix(record: dict, problems: list[str]) -> None:
             "'duplicate_submissions' must be present and exactly 0 "
             "(the exactly-once contract) — got "
             f"{record.get('duplicate_submissions')!r}")
+
+
+# Drills every committed full chaos_autopilot_matrix record must carry
+# (scripts/chaos_autopilot.py): the drift autopilot's crash-safe,
+# poison-proof, circuit-broken control loop (docs/streaming.md
+# "Closed loop").
+_REQUIRED_CHAOS_AUTOPILOT_DRILLS = (
+    "study_kill_adopt", "poisoned_seed", "apply_kill", "flap_debounce",
+    "breaker_trip_recovery",
+)
+
+#: The three autopilot invariants asserted per drill row: every drift
+#: round minted at most one study across every SIGKILL window, no
+#: poisoned publish ever seeded a study (quarantined instead), and a
+#: resumed apply produced byte-identical schedule/routing files.
+_CHAOS_AUTOPILOT_INVARIANTS = ("exactly_once_study", "zero_poisoned_seeds",
+                               "apply_bit_identical")
+
+
+def _check_chaos_autopilot_matrix(record: dict,
+                                  problems: list[str]) -> None:
+    """chaos_autopilot_matrix-specific schema: every drill present (full
+    records), zero failures, the three closed-loop invariants asserted
+    per row, and the record-level zero-duplicate gate the
+    autopilot_duplicate_study_max SLO rule reads."""
+    _check_chaos_matrix(
+        record, problems,
+        required_drills=_REQUIRED_CHAOS_AUTOPILOT_DRILLS,
+        invariants=_CHAOS_AUTOPILOT_INVARIANTS,
+        rerun_hint="scripts/chaos_autopilot.py --out CHAOS_AUTOPILOT.json")
+    if record.get("duplicate_studies") != 0:
+        problems.append(
+            "'duplicate_studies' must be present and exactly 0 "
+            "(the exactly-once drift→study contract) — got "
+            f"{record.get('duplicate_studies')!r}")
 
 
 def _check_beta_study(record: dict, problems: list[str]) -> None:
@@ -763,6 +799,8 @@ def check_record(record: dict, problems: list[str]) -> None:
             _check_chaos_sdc_matrix(record, problems)
         if record.get("metric") == "chaos_study_matrix":
             _check_chaos_study_matrix(record, problems)
+        if record.get("metric") == "chaos_autopilot_matrix":
+            _check_chaos_autopilot_matrix(record, problems)
         if record.get("metric") == "beta_study":
             _check_beta_study(record, problems)
         if record.get("metric") == "mi_kernel_bench":

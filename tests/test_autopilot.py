@@ -1,0 +1,439 @@
+"""Drift autopilot (``dib_tpu/autopilot``, docs/streaming.md "Closed
+loop"): the pure decision/replay layer (config journaling, fold,
+schedule building, canonical applies), the weighted round-0 placement
+the drift studies seed with, the rollup the SLO rules read, the zoo's
+advisory β-routing surface — and the acceptance path: a scripted drift
+carried drift→study→re-anneal→routing through the REAL CLI.
+"""
+
+import importlib.util
+import json
+import math
+import os
+import subprocess
+import sys
+import types
+
+import pytest
+
+from dib_tpu.autopilot import (
+    AUTOPILOT_FILENAME,
+    AutopilotConfig,
+    DriftAutopilot,
+    autopilot_journal_path,
+    autopilot_status,
+    build_reanneal_schedule,
+    build_routing_metadata,
+    fold_autopilot,
+    write_json_atomic,
+)
+from dib_tpu.sched.journal import JobJournal
+from dib_tpu.telemetry.summary import autopilot_rollup
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ==================================================================== config
+def test_autopilot_config_validation_and_roundtrip():
+    config = AutopilotConfig(cooldown_rounds=7, breaker_threshold=2,
+                             breaker_probe_after=5, margin_decades=0.5,
+                             study={"max_units": 20, "seeds": [0]})
+    assert AutopilotConfig.from_dict(config.to_dict()) == config
+    # unknown keys are dropped (forward-compatible journals)
+    assert AutopilotConfig.from_dict(
+        {**config.to_dict(), "later_knob": 1}) == config
+    with pytest.raises(ValueError, match="cooldown_rounds"):
+        AutopilotConfig(cooldown_rounds=-1)
+    with pytest.raises(ValueError, match="breaker_threshold"):
+        AutopilotConfig(breaker_threshold=0)
+    with pytest.raises(ValueError, match="breaker_probe_after"):
+        AutopilotConfig(breaker_probe_after=-2)
+    with pytest.raises(ValueError, match="margin_decades"):
+        AutopilotConfig(margin_decades=0.0)
+
+
+# ====================================================================== fold
+def test_fold_autopilot_replays_decision_chain_and_breaker():
+    records = [
+        {"kind": "config", "spec": {"cooldown_rounds": 1}},
+        {"kind": "intent", "round": 2, "study_id": "drift-r0002"},
+        {"kind": "submitted", "round": 2},
+        {"kind": "verdict", "round": 2, "verdict": "error"},
+        {"kind": "apply_skip", "round": 2},
+        {"kind": "intent", "round": 3},
+        {"kind": "verdict", "round": 3, "verdict": "error"},
+        {"kind": "breaker", "action": "trip"},
+        {"kind": "skip", "round": 4, "reason": "breaker_open"},
+        {"kind": "skip", "round": 5, "reason": "breaker_open"},
+        {"kind": "breaker", "action": "reset"},
+        {"kind": "intent", "round": 9},
+        {"kind": "verdict", "round": 9, "verdict": "converged"},
+        {"kind": "apply_intent", "round": 9},
+        {"kind": "applied", "round": 9},
+    ]
+    state = fold_autopilot(records)
+    assert state["config"] == {"cooldown_rounds": 1}
+    assert sorted(state["drifts"]) == [2, 3, 4, 5, 9]
+    assert state["last_intent_round"] == 9
+    # the two errors counted, the reset zeroed, converged kept it at 0
+    assert state["breaker"] == {"open": False, "trips": 1, "resets": 1,
+                                "consecutive": 0, "skips_since_trip": 0}
+    # round 9 closed its full chain; round 3 never applied
+    assert set(state["drifts"][9]) == {"intent", "verdict", "apply_intent",
+                                       "applied"}
+    assert "applied" not in state["drifts"][3]
+
+
+def test_fold_autopilot_resume_window_and_skip_pacing():
+    """An intent with no terminal record is the round a restart resumes
+    into; breaker_open skips pace the half-open probe until the next
+    intent zeroes the pacer."""
+    state = fold_autopilot([
+        {"kind": "breaker", "action": "trip"},
+        {"kind": "skip", "round": 4, "reason": "breaker_open"},
+        {"kind": "skip", "round": 5, "reason": "breaker_open"},
+        {"kind": "intent", "round": 6, "study_id": "drift-r0006"},
+        {"kind": "submitted", "round": 6},
+    ])
+    assert state["breaker"]["open"] is True
+    assert state["breaker"]["skips_since_trip"] == 0   # probe intent reset
+    assert set(state["drifts"][6]) == {"intent", "submitted"}
+
+
+# ===================================================================== apply
+def test_build_reanneal_schedule_margin_math_and_none_cases():
+    schedule = build_reanneal_schedule(
+        {"0": 0.3, "1": 3.0}, drift_round=7, study_id="drift-r0007",
+        margin_decades=0.25)
+    assert schedule["drift_round"] == 7
+    assert schedule["study_id"] == "drift-r0007"
+    want_floor = 10 ** (math.log10(0.3) - 0.25)
+    assert schedule["beta_floor"] == pytest.approx(want_floor, rel=1e-6)
+    assert list(schedule["estimates"]) == ["0", "1"]
+    # nothing applicable -> None, never an empty schedule
+    assert build_reanneal_schedule({}, drift_round=1, study_id="s",
+                                   margin_decades=0.25) is None
+    assert build_reanneal_schedule(
+        {"0": 0.0, "1": float("nan"), "2": None}, drift_round=1,
+        study_id="s", margin_decades=0.25) is None
+    # non-finite estimates are filtered, not propagated
+    only_good = build_reanneal_schedule(
+        {"0": float("inf"), "1": 0.5}, drift_round=1, study_id="s",
+        margin_decades=0.25)
+    assert list(only_good["estimates"]) == ["1"]
+
+
+def test_build_routing_metadata_sorted_and_none():
+    routing = build_routing_metadata({"10": 1.0, "2": 0.25},
+                                     drift_round=3, study_id="s")
+    assert list(routing["transition_betas"]) == ["10", "2"]
+    assert routing["transition_betas"]["2"] == 0.25
+    assert build_routing_metadata({"0": -1.0}, drift_round=3,
+                                  study_id="s") is None
+
+
+def test_write_json_atomic_canonical_bytes(tmp_path):
+    """Two applies of the same journaled payload (any key order) write
+    IDENTICAL bytes — the bit-identity invariant the chaos suite's
+    apply_kill drill compares across processes."""
+    a, b = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+    write_json_atomic(a, {"beta_floor": 0.1, "estimates": {"1": 2.0}})
+    write_json_atomic(b, {"estimates": {"1": 2.0}, "beta_floor": 0.1})
+    blob_a, blob_b = open(a, "rb").read(), open(b, "rb").read()
+    assert blob_a == blob_b
+    assert blob_a.endswith(b"\n")
+    with pytest.raises(ValueError):
+        write_json_atomic(a, {"x": float("nan")})
+
+
+def test_reanneal_rewind_epoch_inverts_the_ramp_and_clamps():
+    from dib_tpu.stream.online import reanneal_rewind_epoch
+
+    config = types.SimpleNamespace(num_pretraining_epochs=4,
+                                   num_annealing_epochs=10,
+                                   beta_start=0.01, beta_end=10.0)
+    # log-midpoint of the ramp -> halfway through the annealing epochs
+    mid = 10 ** ((math.log10(0.01) + math.log10(10.0)) / 2)
+    assert reanneal_rewind_epoch({"beta_floor": mid}, config) == 4 + 5
+    # floor at/below beta_start, absent, or junk -> full re-anneal
+    for schedule in ({"beta_floor": 0.01}, {"beta_floor": 0.001},
+                     {"beta_floor": None}, {}):
+        assert reanneal_rewind_epoch(schedule, config) == 4
+    # floor at/above beta_end still leaves one annealing epoch
+    assert reanneal_rewind_epoch({"beta_floor": 10.0}, config) == 4 + 9
+    degenerate = types.SimpleNamespace(num_pretraining_epochs=2,
+                                       num_annealing_epochs=0,
+                                       beta_start=0.1, beta_end=0.1)
+    assert reanneal_rewind_epoch({"beta_floor": 1.0}, degenerate) == 2
+
+
+# ==================================================================== rollup
+def test_autopilot_rollup_counts_duplicates_and_latency():
+    events = [
+        {"type": "autopilot", "action": "intent", "round": 2},
+        {"type": "autopilot", "action": "submitted", "round": 2},
+        {"type": "autopilot", "action": "verdict", "round": 2},
+        {"type": "autopilot", "action": "applied", "round": 2,
+         "drift_to_apply_s": 12.0},
+        {"type": "autopilot", "action": "skip", "round": 3,
+         "reason": "cooldown"},
+        {"type": "autopilot", "action": "skip", "round": 4,
+         "reason": "cooldown"},
+        # a SECOND intent on round 2: the exactly-once breach the
+        # page-severity SLO rule gates
+        {"type": "autopilot", "action": "intent", "round": 2},
+        {"type": "breaker", "action": "trip"},
+        {"type": "breaker", "action": "reset"},
+    ]
+    rollup = autopilot_rollup(events)
+    assert rollup["intents"] == 2
+    assert rollup["applied"] == 1
+    assert rollup["duplicate_studies"] == 1
+    assert rollup["skip_reasons"] == {"cooldown": 2}
+    assert rollup["breaker_trips"] == 1
+    assert rollup["breaker_resets"] == 1
+    assert rollup["breaker_open"] == 0          # reset came last
+    assert rollup["drift_to_apply_p99_s"] == pytest.approx(12.0)
+    assert rollup["last_applied_round"] == 2
+    # ordinary runs carry no autopilot plane at all
+    assert autopilot_rollup([{"type": "metrics"}]) is None
+
+
+# ============================================================ status / reset
+def _journal(autopilot_dir, *records):
+    os.makedirs(autopilot_dir, exist_ok=True)
+    with JobJournal(autopilot_dir, filename=AUTOPILOT_FILENAME) as journal:
+        for kind, fields in records:
+            journal.append(kind, **fields)
+
+
+def test_autopilot_status_and_operator_breaker_reset(tmp_path):
+    stream_dir = str(tmp_path / "stream")
+    autopilot_dir = str(tmp_path / "stream" / "autopilot")
+    _journal(
+        autopilot_dir,
+        ("config", {"spec": AutopilotConfig().to_dict()}),
+        ("intent", {"round": 2, "study_id": "drift-r0002"}),
+        ("verdict", {"round": 2, "verdict": "error"}),
+        ("apply_skip", {"round": 2}),
+        ("breaker", {"action": "trip"}),
+        ("skip", {"round": 3, "reason": "breaker_open"}),
+    )
+    status = autopilot_status(autopilot_dir)
+    assert status["drifts_decided"] == 2
+    assert status["studies"] == 1 and status["applied"] == 0
+    assert status["skip_reasons"] == {"breaker_open": 1}
+    assert status["breaker"]["open"] is True
+    assert status["journal_torn"] == 0
+
+    pilot = DriftAutopilot(stream_dir, autopilot_dir)
+    assert pilot.reset_breaker(via="operator") is True
+    assert autopilot_status(autopilot_dir)["breaker"]["open"] is False
+    # idempotent: a closed breaker is a no-op, not a second reset record
+    assert pilot.reset_breaker(via="operator") is False
+    assert autopilot_status(autopilot_dir)["breaker"]["resets"] == 1
+
+
+def test_reconfigure_replaces_the_journaled_study_spec(tmp_path):
+    """The breaker-recovery operator path: a journaled (broken) config
+    must NOT shadow the --reconfigure one — the replayed journal wins
+    only on plain restarts."""
+    stream_dir = str(tmp_path / "stream")
+    broken = AutopilotConfig(study={"max_units": 1})
+    DriftAutopilot(stream_dir, config=broken).ensure_config()
+    good = AutopilotConfig(study={"max_units": 20})
+    # a plain restart keeps the journaled spec...
+    state = DriftAutopilot(stream_dir, config=good).ensure_config()
+    assert state["config"]["study"] == {"max_units": 1}
+    # ...reconfigure replaces it durably
+    state = DriftAutopilot(stream_dir, config=good).ensure_config(
+        reconfigure=True)
+    assert state["config"]["study"] == {"max_units": 20}
+    pilot = DriftAutopilot(stream_dir)
+    assert pilot.ensure_config()["config"]["study"] == {"max_units": 20}
+
+
+# ============================================= weighted round-0 (satellite)
+def test_weighted_point_allocation_contract():
+    from dib_tpu.study.controller import weighted_point_allocation
+
+    assert weighted_point_allocation([], 10) == []
+    # weights FOCUS a fixed budget: the total never changes
+    counts = weighted_point_allocation([3.0, 1.0], 8, floor=2)
+    assert sum(counts) == 8
+    assert counts[0] > counts[1] >= 2
+    # non-positive weights fall back to an equal split
+    assert weighted_point_allocation([0.0, -1.0, float("nan")], 7,
+                                     floor=1) == [3, 2, 2]
+    # deterministic remainder ties (replayed decisions re-allocate
+    # identically)
+    assert (weighted_point_allocation([1.0, 1.0, 1.0], 10)
+            == weighted_point_allocation([1.0, 1.0, 1.0], 10))
+    # the floor is a floor even when the budget undershoots it
+    assert weighted_point_allocation([1.0, 100.0], 1, floor=1) == [1, 1]
+
+
+def test_plan_refinement_band_widths_focus_the_same_budget():
+    from dib_tpu.study.controller import plan_refinement
+
+    brackets = {0: (0.1, 0.2), 1: (1.0, 8.0)}
+
+    def inside(points, span):
+        lo, hi = span
+        return [b for b in points if lo <= b <= hi]
+
+    equal = plan_refinement(brackets, 4, [])
+    assert len(inside(equal, brackets[0])) == len(inside(equal, brackets[1]))
+    # channel 1's band is far wider (ensemble-uncertain): it gets the
+    # denser grid, channel 0 keeps its floor, the total stays put
+    weighted = plan_refinement(brackets, 4, [],
+                               band_widths={0: 0.01, 1: 0.9})
+    assert len(weighted) == len(equal)
+    assert len(inside(weighted, brackets[1])) > len(inside(weighted,
+                                                           brackets[0]))
+    assert len(inside(weighted, brackets[0])) >= 3
+    # partial band coverage must NOT reweight (a missing measurement
+    # never starves a bracket)
+    partial = plan_refinement(brackets, 4, [], band_widths={1: 0.9})
+    assert (len(inside(partial, brackets[0]))
+            == len(inside(partial, brackets[1])))
+    # already-trained points are never re-bought
+    assert all(abs(b - w) > 1e-9 for w in plan_refinement(
+        brackets, 4, list(equal)) for b in equal)
+
+
+def test_initial_betas_apportions_by_center_weight():
+    from dib_tpu.study.controller import StudyConfig
+
+    flat = StudyConfig(centers=(0.1, 2.0), refine_num=4)
+    weighted = StudyConfig(centers=(0.1, 2.0),
+                           center_weights=(5.0, 1.0), refine_num=4)
+
+    def near(points, center):
+        return [b for b in points
+                if abs(math.log10(b / center)) <= 0.51]
+
+    flat_grid, weighted_grid = flat.initial_betas(), weighted.initial_betas()
+    # same FIXED total, denser where the harvest's evidence is strongest
+    assert len(weighted_grid) == len(flat_grid) == 8
+    assert len(near(weighted_grid, 0.1)) > len(near(weighted_grid, 2.0))
+    assert len(near(weighted_grid, 2.0)) >= 2
+    assert len(near(flat_grid, 0.1)) == len(near(flat_grid, 2.0))
+
+
+def test_watch_seed_harvests_transitions_and_curvature(tmp_path):
+    from dib_tpu.study.controller import watch_seed
+    from dib_tpu.telemetry.events import EventWriter
+
+    run_dir = str(tmp_path / "run")
+    betas = [0.05, 0.1, 0.3, 0.5, 1.0, 3.0, 10.0]
+    # an MI series with a hard bend at beta=0.5: curvature peaks there
+    values = [2.0, 2.0, 2.0, 2.0, 0.2, 0.1, 0.1]
+    with EventWriter(run_dir, run_id="seed") as writer:
+        writer.run_start({"mode": "stream"})
+        for epoch, (beta, val) in enumerate(zip(betas, values)):
+            writer.mi_bounds(epoch=epoch, beta=beta, lower_bits=val)
+        writer.transition(channel=0, epoch=3, direction="down", beta=0.5)
+        writer.transition(channel=1, epoch=5, direction="down", beta=3.0)
+        writer.run_end(status="ok")
+    centers, weights = watch_seed(run_dir)
+    assert centers == sorted(centers)
+    by_center = dict(zip(centers, weights))
+    # the double-evidence β (transition + curvature peak) accumulates
+    # past a transition-only one
+    assert 0.5 in by_center and 3.0 in by_center
+    assert by_center[0.5] > by_center[3.0] >= 1.0
+    assert all(w > 0 for w in weights)
+
+
+# ================================================== zoo routing (satellite)
+class _StubRouter:
+    entries = ()
+
+    def close(self):
+        pass
+
+
+def test_zoo_set_routing_describe_and_unknown_model():
+    from dib_tpu.serve import ModelZoo
+
+    zoo = ModelZoo()
+    zoo.register("m", _StubRouter())
+    assert "routing" not in zoo.describe()[0]
+    metadata = {"drift_round": 2, "study_id": "drift-r0002",
+                "transition_betas": {"0": 0.3}}
+    zoo.set_routing("m", metadata)
+    row = next(r for r in zoo.describe() if r["model"] == "m")
+    assert row["routing"]["transition_betas"] == {"0": 0.3}
+    # advisory only: clearing works, unknown models are loud
+    zoo.set_routing("m", None)
+    assert "routing" not in zoo.describe()[0]
+    with pytest.raises(KeyError, match="ghost"):
+        zoo.set_routing("ghost", metadata)
+
+
+# ============================================================== e2e (CLI)
+def _load_chaos_module():
+    spec = importlib.util.spec_from_file_location(
+        "chaos_autopilot",
+        os.path.join(REPO, "scripts", "chaos_autopilot.py"))
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+@pytest.mark.fault
+def test_autopilot_closed_loop_cli_end_to_end(tmp_path):
+    """The acceptance drill in tier 1: a scripted mid-stream drift,
+    `stream run` + `stream autopilot` through the real CLI (separate
+    processes sharing only the journals), ending in an applied
+    re-anneal schedule, β-routing metadata the zoo serves, and a clean
+    status surface."""
+    module = _load_chaos_module()
+    stream_dir = str(tmp_path / "stream")
+    module._build_stream(stream_dir, rounds=module.SINGLE_ROUNDS,
+                         drifts=module.SINGLE_DRIFTS)
+    drift_rounds = module._drift_rounds(stream_dir)
+    assert drift_rounds, "scripted drift was not detected"
+    proc = module._autopilot(stream_dir, cooldown=100)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+
+    # the loop closed: exactly one study, applied, invariants clean
+    inv = module._invariants(stream_dir)
+    assert inv["intents"] == 1 and inv["applies"] == 1
+    assert inv["exactly_once_study"] and inv["apply_bit_identical"]
+    assert module._verdict_of(stream_dir, drift_rounds[0]) == "converged"
+
+    # the trainer-facing apply: a rewindable schedule below the lowest
+    # refreshed transition-β
+    from dib_tpu.stream.online import load_reanneal_schedule
+    schedule = load_reanneal_schedule(stream_dir)
+    assert schedule["drift_round"] == drift_rounds[0]
+    assert schedule["estimates"]
+    assert schedule["beta_floor"] < min(
+        float(v) for v in schedule["estimates"].values())
+
+    # the serving-facing apply: routing metadata the zoo attaches
+    from dib_tpu.serve import ModelZoo
+    from dib_tpu.stream.deployer import load_routing
+    routing = load_routing(stream_dir)
+    assert routing["study_id"] == schedule["study_id"]
+    assert routing["transition_betas"]
+    zoo = ModelZoo()
+    zoo.register("m", _StubRouter())
+    zoo.set_routing("m", routing)
+    assert zoo.describe()[0]["routing"]["drift_round"] == drift_rounds[0]
+
+    # the operator surface: stream status --json carries all three planes
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    status_proc = subprocess.run(
+        [sys.executable, "-m", "dib_tpu", "stream", "status",
+         "--stream-dir", stream_dir, "--autopilot-dir",
+         os.path.join(stream_dir, "autopilot"), "--json"],
+        capture_output=True, text=True, timeout=120, env=env, cwd=REPO)
+    assert status_proc.returncode == 0, status_proc.stderr[-2000:]
+    snapshot = json.loads(status_proc.stdout)
+    assert snapshot["reanneal"]["beta_floor"] == schedule["beta_floor"]
+    assert snapshot["routing"]["drift_round"] == drift_rounds[0]
+    assert snapshot["autopilot"]["applied"] == 1
+    assert snapshot["autopilot"]["breaker"]["open"] is False

@@ -1,0 +1,233 @@
+"""The autopilot chaos-suite artifact contract
+(``scripts/chaos_autopilot.py``, docs/streaming.md "Closed loop").
+
+The committed ``CHAOS_AUTOPILOT.json`` must exist, validate against the
+artifact schema (all five drills, the three closed-loop invariants per
+row, the record-level zero-duplicate gate), and evaluate clean against
+the committed ``SLO.json`` — "exactly-once drift→study, poison-proof
+seeding, bit-identical applies" are only as good as the committed
+evidence. The schema's reject shapes are pinned here too: a validator
+that cannot refuse a doctored record protects nothing.
+"""
+
+import copy
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.fault
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ARTIFACT = os.path.join(REPO, "CHAOS_AUTOPILOT.json")
+COMMITTED_SLO = os.path.join(REPO, "SLO.json")
+
+EXPECTED_DRILLS = {
+    "study_kill_adopt", "poisoned_seed", "apply_kill", "flap_debounce",
+    "breaker_trip_recovery",
+}
+INVARIANTS = ("exactly_once_study", "zero_poisoned_seeds",
+              "apply_bit_identical")
+
+
+def _checker():
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    import check_run_artifacts
+
+    return check_run_artifacts
+
+
+def _record():
+    with open(ARTIFACT) as f:
+        return json.load(f)
+
+
+def test_committed_chaos_autopilot_artifact_validates():
+    assert os.path.exists(ARTIFACT), (
+        "CHAOS_AUTOPILOT.json missing — run `python "
+        "scripts/chaos_autopilot.py --out CHAOS_AUTOPILOT.json` and "
+        "commit the record")
+    assert _checker().check_file(ARTIFACT) == []
+
+
+def test_committed_chaos_autopilot_matrix_is_complete_and_green():
+    record = _record()
+    assert record["metric"] == "chaos_autopilot_matrix"
+    assert record["unit"] == "drills_passed"
+    drills = {d["drill"]: d for d in record["matrix"]}
+    assert set(drills) == EXPECTED_DRILLS
+    failed = [name for name, d in drills.items() if not d["ok"]]
+    assert not failed, f"committed chaos record shows failures: {failed}"
+    assert record["all_passed"] is True
+    assert record["value"] == record["total"] == len(EXPECTED_DRILLS)
+    # the committed record must be the FULL matrix
+    assert record["quick"] is False
+    # every drill holds the three closed-loop invariants, and no drift
+    # round anywhere minted a second study
+    for name, d in drills.items():
+        for invariant in INVARIANTS:
+            assert d[invariant] is True, (name, invariant)
+        assert d["duplicate_studies"] == 0, name
+    assert record["duplicate_studies"] == 0
+
+
+def test_committed_chaos_autopilot_drill_evidence():
+    """Each drill's own mechanism actually fired: the kill landed in the
+    intended journal window, the poison was refused (not missed), the
+    interrupted apply reproduced the oracle's bytes, the debounce held,
+    and the breaker tripped once then recovered to a converged study."""
+    by_name = {d["drill"]: d for d in _record()["matrix"]}
+
+    adopt = by_name["study_kill_adopt"]
+    assert adopt["killed_by_sigkill"] is True
+    assert adopt["kill_window_state"]["round_kinds"] == ["intent",
+                                                         "submitted"]
+    assert adopt["kill_window_state"]["jobs_under_round0_name"] == 1
+    assert adopt["verdict"] == "converged"
+    assert adopt["intents"] == 1 and adopt["applies"] == 1
+
+    poison = by_name["poisoned_seed"]
+    assert poison["intents"] == 0 and poison["applies"] == 0
+    assert poison["schedule_written"] is False
+    assert poison["poisoned_seed_mitigations"] >= 1
+    assert poison["skip_reasons"].get("poisoned_seed", 0) >= 1
+
+    apply_kill = by_name["apply_kill"]
+    assert apply_kill["killed_by_sigkill"] is True
+    assert apply_kill["kill_window_state"]["schedule_on_disk"] is False
+    assert "apply_intent" in apply_kill["kill_window_state"]["round_kinds"]
+    assert apply_kill["schedule_bit_identical_to_uninterrupted"] is True
+
+    flap = by_name["flap_debounce"]
+    assert flap["intents"] == 1
+    assert flap["cooldown_skips"] == len(flap["drift_rounds"]) - 1
+
+    breaker = by_name["breaker_trip_recovery"]
+    assert breaker["tripped_state"]["breaker"]["open"] is True
+    assert breaker["recovered_verdict"] == "converged"
+    assert breaker["breaker"] == {"open": False, "trips": 1, "resets": 1,
+                                  "consecutive": 0, "skips_since_trip": 0}
+
+    # the telemetry-plane join agrees with the journal bookkeeping
+    for name, d in by_name.items():
+        rollup = (d.get("evidence") or {}).get("autopilot")
+        assert rollup is not None, name
+        assert rollup["duplicate_studies"] == 0, name
+        assert rollup["intents"] == d["intents"], name
+
+
+# ============================================================ reject shapes
+def _problems(record):
+    problems: list[str] = []
+    _checker()._check_chaos_autopilot_matrix(record, problems)
+    return problems
+
+
+def test_chaos_autopilot_schema_rejects_doctored_records():
+    committed = _record()
+    assert _problems(committed) == []
+
+    missing = copy.deepcopy(committed)
+    missing["matrix"] = [d for d in missing["matrix"]
+                         if d["drill"] != "poisoned_seed"]
+    assert any("poisoned_seed" in p for p in _problems(missing))
+
+    failed = copy.deepcopy(committed)
+    failed["matrix"][0]["ok"] = False
+    assert any("fail" in p for p in _problems(failed))
+
+    broken_invariant = copy.deepcopy(committed)
+    broken_invariant["matrix"][2]["apply_bit_identical"] = False
+    assert any("apply_bit_identical" in p
+               for p in _problems(broken_invariant))
+
+    double_spend = copy.deepcopy(committed)
+    double_spend["duplicate_studies"] = 1
+    assert any("duplicate_studies" in p for p in _problems(double_spend))
+
+    unmarked = copy.deepcopy(committed)
+    del unmarked["duplicate_studies"]
+    assert any("duplicate_studies" in p for p in _problems(unmarked))
+
+
+# ================================================================= SLO pair
+def test_committed_chaos_autopilot_record_passes_committed_slo():
+    """CHAOS_AUTOPILOT.json is a valid `telemetry check` operand: the
+    three autopilot rules all evaluate (none skipped) and pass — in
+    process and through the real CLI."""
+    from dib_tpu.telemetry.slo import check_run
+
+    report = check_run(ARTIFACT, COMMITTED_SLO, write=False)
+    assert report["violations"] == 0
+    by_rule = {r["rule"]: r for r in report["rules"]}
+    for rule in ("autopilot_duplicate_study_max",
+                 "autopilot_breaker_trip_ceiling",
+                 "drift_to_apply_p99_ceiling"):
+        assert by_rule[rule]["status"] == "ok", rule
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.run(
+        [sys.executable, "-m", "dib_tpu", "telemetry", "check",
+         ARTIFACT, "--slo", COMMITTED_SLO],
+        capture_output=True, text=True, timeout=120, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_duplicate_study_breach_pages_via_subprocess(tmp_path):
+    """A doctored record with one double-spent drift round exits 1
+    against the committed SLO.json through the real CLI — the
+    page-severity exactly-once gate."""
+    doctored = _record()
+    doctored["duplicate_studies"] = 1
+    doctored["autopilot"]["duplicate_studies"] = 1
+    path = tmp_path / "doctored.json"
+    path.write_text(json.dumps(doctored))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.run(
+        [sys.executable, "-m", "dib_tpu", "telemetry", "check",
+         str(path), "--slo", COMMITTED_SLO, "--no-write"],
+        capture_output=True, text=True, timeout=120, env=env, cwd=REPO)
+    assert proc.returncode == 1, proc.stderr
+    report = json.loads(proc.stdout)
+    violated = [r["rule"] for r in report["rules"]
+                if r["status"] == "violated"]
+    assert violated == ["autopilot_duplicate_study_max"]
+
+
+# ================================================================= registry
+def test_chaos_autopilot_registers_in_fleet_registry(tmp_path):
+    """Drill records land in the fleet registry only under an EXPLICIT
+    runs root — ad-hoc local runs must not grow the committed index."""
+    from dib_tpu.telemetry.registry import (
+        RunRegistry,
+        register_drill_record,
+        validate_index_entry,
+    )
+
+    record = _record()
+    root = str(tmp_path / "runs")
+    assert register_drill_record(
+        record, root=root,
+        extra={"duplicate_studies": record["duplicate_studies"]}) is not None
+    entries = RunRegistry(root).bench_history()
+    assert len(entries) == 1
+    assert entries[0]["metric"] == "chaos_autopilot_matrix"
+    assert entries[0]["all_passed"] is True
+    assert entries[0]["duplicate_studies"] == 0
+    assert validate_index_entry(entries[0]) == []
+    os.environ.pop("DIB_RUNS_ROOT", None)
+    assert register_drill_record(record, root=None) is None
+    assert len(RunRegistry(root).bench_history()) == 1
+
+
+def test_committed_registry_carries_autopilot_history():
+    from dib_tpu.telemetry.registry import RunRegistry
+
+    entries = RunRegistry(os.path.join(REPO, "runs")).bench_history()
+    autopilot = [e for e in entries
+                 if e.get("metric") == "chaos_autopilot_matrix"]
+    assert len(autopilot) == 1
+    assert autopilot[0]["all_passed"] is True
+    assert autopilot[0]["value"] == autopilot[0]["total"] == 5
+    assert autopilot[0]["duplicate_studies"] == 0

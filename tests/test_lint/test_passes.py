@@ -216,6 +216,9 @@ def test_host_sync_targets_only_chunk_loop_modules():
     # ...and (ISSUE 16) the fleet aggregator, whose one poll loop
     # follows MANY runs' planes — an implicit fetch there stalls the
     # merge for every source at once
+    # ...and (ISSUE 19) the drift autopilot, whose supervise loop sits
+    # between the stream's publish tail and the study controller — a
+    # blocking fetch there delays every drift→re-anneal apply
     assert set(host.target_modules) == {
         "dib_tpu/train/loop.py",
         "dib_tpu/train/measurement.py",
@@ -238,6 +241,7 @@ def test_host_sync_targets_only_chunk_loop_modules():
         "dib_tpu/train/checkpoint.py",
         "dib_tpu/study/controller.py",
         "dib_tpu/telemetry/fleet.py",
+        "dib_tpu/autopilot/loop.py",
     }
 
 

@@ -719,6 +719,97 @@ def test_study_runaway_pages_via_subprocess(tmp_path):
     assert violated == ["study_rounds_ceiling"]
 
 
+# ============================================== autopilot rules (ISSUE 19)
+def _write_autopilot_stream(directory, *, breaker_trips=0,
+                            drift_to_apply_s=30.0, applied=True):
+    """A synthetic drift-autopilot stream (dib_tpu/autopilot events)
+    with the violation knobs the autopilot SLO rules gate."""
+    with EventWriter(str(directory), run_id="autopilot-slo") as writer:
+        writer.run_start({"mode": "autopilot"})
+        writer.autopilot(action="intent", round=2, study_id="drift-r0002")
+        writer.autopilot(action="submitted", round=2,
+                         study_id="drift-r0002")
+        if applied:
+            writer.autopilot(action="verdict", round=2,
+                             verdict="converged")
+            writer.autopilot(action="applied", round=2,
+                             drift_to_apply_s=drift_to_apply_s)
+        else:
+            writer.autopilot(action="verdict", round=2, verdict="error")
+            writer.autopilot(action="apply_skip", round=2)
+        for _ in range(breaker_trips):
+            writer.breaker(action="trip", consecutive=2, threshold=2)
+        writer.run_end(status="ok")
+
+
+def test_autopilot_rules_clean_stream_exits_zero(tmp_path):
+    _write_autopilot_stream(tmp_path / "run")
+    report = check_run(str(tmp_path / "run"), COMMITTED_SLO, write=False)
+    by_rule = {r["rule"]: r for r in report["rules"]}
+    assert by_rule["autopilot_breaker_trip_ceiling"]["status"] == "ok"
+    assert by_rule["drift_to_apply_p99_ceiling"]["status"] == "ok"
+    # the exactly-once gate is `when`-scoped to the committed chaos
+    # record — live streams never trip it by accident
+    assert by_rule["autopilot_duplicate_study_max"]["status"] == "skipped"
+    assert telemetry_main(["check", str(tmp_path / "run"), "--slo",
+                           COMMITTED_SLO, "--no-write"]) == 0
+
+
+def test_autopilot_rules_each_violation_kind(tmp_path):
+    cases = {
+        "trips": (dict(breaker_trips=2),
+                  "autopilot_breaker_trip_ceiling"),
+        "latency": (dict(drift_to_apply_s=400.0),
+                    "drift_to_apply_p99_ceiling"),
+    }
+    for label, (spec, rule) in cases.items():
+        directory = tmp_path / label
+        _write_autopilot_stream(directory, **spec)
+        report = check_run(str(directory), COMMITTED_SLO, write=False)
+        violated = [r["rule"] for r in report["rules"]
+                    if r["status"] == "violated"]
+        assert violated == [rule], (label, violated)
+        assert telemetry_main(["check", str(directory), "--slo",
+                               COMMITTED_SLO, "--no-write"]) == 1
+
+
+def test_autopilot_latency_rule_skips_when_nothing_applied(tmp_path):
+    """A breaker-open stream (drift detected, every study skipped or
+    failed) carries no drift→apply percentile: the latency rule skips
+    instead of inventing a number."""
+    _write_autopilot_stream(tmp_path / "run", applied=False,
+                            breaker_trips=1)
+    report = check_run(str(tmp_path / "run"), COMMITTED_SLO, write=False)
+    by_rule = {r["rule"]: r for r in report["rules"]}
+    assert by_rule["drift_to_apply_p99_ceiling"]["status"] == "skipped"
+    assert by_rule["autopilot_breaker_trip_ceiling"]["status"] == "ok"
+
+
+def test_autopilot_rules_skip_non_autopilot_streams():
+    report = check_run(FIXTURE_RUN, COMMITTED_SLO, write=False)
+    by_rule = {r["rule"]: r for r in report["rules"]}
+    for rule in ("autopilot_duplicate_study_max",
+                 "autopilot_breaker_trip_ceiling",
+                 "drift_to_apply_p99_ceiling"):
+        assert by_rule[rule]["status"] == "skipped", rule
+
+
+def test_autopilot_breaker_trips_fail_via_subprocess(tmp_path):
+    """Back-to-back breaker trips exit 1 through the real CLI against
+    the committed SLO.json."""
+    _write_autopilot_stream(tmp_path / "run", breaker_trips=2)
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    proc = subprocess.run(
+        [sys.executable, "-m", "dib_tpu", "telemetry", "check",
+         str(tmp_path / "run"), "--slo", COMMITTED_SLO, "--no-write"],
+        capture_output=True, text=True, timeout=120, env=env)
+    assert proc.returncode == 1, proc.stderr
+    report = json.loads(proc.stdout)
+    violated = [r["rule"] for r in report["rules"]
+                if r["status"] == "violated"]
+    assert violated == ["autopilot_breaker_trip_ceiling"]
+
+
 def test_committed_study_record_passes_committed_slo():
     """STUDY_CPU.json is a valid `telemetry check` operand (the bench
     one-liner path) and holds the study budgets — in-process and via
