@@ -297,7 +297,8 @@ class DriftAutopilot:
 
     def __init__(self, stream_dir: str, autopilot_dir: str | None = None,
                  config: AutopilotConfig | None = None, telemetry=None,
-                 ctx=None, workers: int = 2):
+                 ctx=None, workers: int = 2, fleet: str | None = None,
+                 tenant: str = "autopilot", priority: int = 0):
         from dib_tpu.telemetry.context import from_env
 
         self.stream_dir = os.path.abspath(stream_dir)
@@ -306,6 +307,12 @@ class DriftAutopilot:
         self.config = config
         self.telemetry = telemetry
         self.workers = int(workers)
+        # submit-only study mode (docs/scheduling.md): drift studies go
+        # to a shared external fleet under the autopilot's tenant
+        # instead of spawning an in-process pool per study
+        self.fleet = os.path.abspath(fleet) if fleet else None
+        self.tenant = str(tenant or "autopilot")
+        self.priority = int(priority)
         self.ctx = ctx if ctx is not None else from_env()
         os.makedirs(self.autopilot_dir, exist_ok=True)
         self._journal: JobJournal | None = None
@@ -612,7 +619,9 @@ class DriftAutopilot:
                                       intent.get("center_weights") or []),
             telemetry=self.telemetry,
             study_id=study_id,
-            ctx=self._drift_ctx(idx))
+            ctx=self._drift_ctx(idx),
+            fleet=self.fleet, tenant=self.tenant,
+            priority=self.priority)
         if "submitted" not in d:
             controller.ensure_config()
             journal.append("submitted", round=idx, study_id=study_id,

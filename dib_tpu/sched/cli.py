@@ -1,14 +1,21 @@
-"""``python -m dib_tpu sched submit|status|run-pool`` — sweep as a service.
+"""``python -m dib_tpu sched submit|status|policy|run-pool`` — sweep as
+a service.
 
 ``submit`` appends a β-grid job to a scheduler directory's durable
-journal; ``status`` replays the journal into a queue snapshot; and
-``run-pool`` drains the queue with a worker pool of training unit
-runners, optionally under watchdog supervision (``--watchdog``:
-crash-relaunched, rc-75 preemptions relaunched budget-free while the
-journal shows progress). The scheduler directory is also the run
-directory: ``journal.jsonl`` next to ``events.jsonl``, so
-``telemetry tail``/``summarize``/``check`` see the queue's ``job`` /
-``lease`` events alongside everything else (docs/robustness.md).
+journal (with ``--tenant``/``--study``/``--priority`` fleet identity;
+an over-bound submit is rejected with a retry horizon and exit 75);
+``status`` replays the journal into a queue snapshot (per-tenant queue
+views, starved/quarantined units); ``policy`` shows or sets the fleet's
+admission/fair-share/breaker policy; and ``run-pool`` drains the queue
+with a worker pool of training unit runners — with ``--serve`` it is
+the long-lived shared FLEET that submit-only study controllers target
+(docs/scheduling.md) — optionally under watchdog supervision
+(``--watchdog``: crash-relaunched, rc-75 preemptions relaunched
+budget-free while the journal shows progress or every runnable unit is
+shed-parked). The scheduler directory is also the run directory:
+``journal.jsonl`` next to ``events.jsonl``, so ``telemetry
+tail``/``summarize``/``check`` see the queue's ``job`` / ``lease``
+events alongside everything else (docs/robustness.md).
 """
 
 from __future__ import annotations
@@ -61,6 +68,15 @@ def build_sched_parser() -> argparse.ArgumentParser:
                        help="Per-job retry budget: unit failures beyond "
                             "it mark the job failed (default 3).")
     p_sub.add_argument("--name", default="", help="Job label.")
+    p_sub.add_argument("--tenant", default="",
+                       help="Fair-share tenant the job bills to "
+                            "(default: the shared 'default' tenant).")
+    p_sub.add_argument("--study", default="",
+                       help="Study id the job belongs to (submit-only "
+                            "study controllers set this).")
+    p_sub.add_argument("--priority", type=int, default=0,
+                       help="Shed priority: when the pool loses workers, "
+                            "LOWER priorities park first (default 0).")
     p_sub.add_argument("--set", action="append", default=[],
                        metavar="FIELD=VALUE",
                        help="Unit training-spec override (repeatable), "
@@ -78,6 +94,35 @@ def build_sched_parser() -> argparse.ArgumentParser:
     p_stat.add_argument("--json", action="store_true",
                         help="Machine-readable snapshot.")
 
+    p_pol = sub.add_parser(
+        "policy", help="Show or set the fleet's admission/fairness "
+                       "policy (policy.json next to the journal).")
+    _add_sched_dir(p_pol)
+    p_pol.add_argument("--max-pending", type=int, default=None,
+                       dest="max_pending",
+                       help="Fleet-wide bound on queued (pending) units; "
+                            "an over-bound submit is rejected with a "
+                            "retry horizon.")
+    p_pol.add_argument("--admission-retry-s", type=float, default=None,
+                       dest="admission_retry_s",
+                       help="Retry horizon returned with admission "
+                            "rejects (default 5).")
+    p_pol.add_argument("--breaker-threshold", type=int, default=None,
+                       dest="breaker_threshold",
+                       help="Consecutive unit failures that quarantine a "
+                            "job (0 disables the circuit breaker).")
+    p_pol.add_argument("--breaker-probe-after-s", type=float, default=None,
+                       dest="breaker_probe_after_s",
+                       help="Quarantine horizon before one half-open "
+                            "probe unit is allowed (default 30).")
+    p_pol.add_argument("--tenant", action="append", default=[],
+                       dest="tenant_specs",
+                       metavar="NAME=WEIGHT[:MAX_LEASES[:MAX_PENDING]]",
+                       help="Per-tenant policy (repeatable): fair-share "
+                            "weight, optional concurrent-lease cap, "
+                            "optional pending-queue cap — e.g. "
+                            "'autopilot=2' or 'greedy=1:4:40'.")
+
     p_pool = sub.add_parser(
         "run-pool", help="Drain the queue with a pool of training "
                          "workers (work-stealing, retry/backoff, "
@@ -92,6 +137,13 @@ def build_sched_parser() -> argparse.ArgumentParser:
                         dest="duration_s",
                         help="Stop the pool after this long even if the "
                              "queue is not drained.")
+    p_pool.add_argument("--serve", action="store_true",
+                        help="Fleet mode: stay alive past a drained "
+                             "queue (idling on exponential backoff) and "
+                             "fold cross-process submissions from the "
+                             "shared journal — the long-lived fleet that "
+                             "submit-only study controllers target. Ends "
+                             "at --duration-s (exit 0) or preemption.")
     p_pool.add_argument("--preempt_grace_s", type=float, default=30.0,
                         help="SIGTERM/SIGINT grace budget: in-flight "
                              "units checkpoint chunk-aligned, re-enqueue "
@@ -142,23 +194,69 @@ def _parse_spec_sets(pairs: Sequence[str]) -> dict:
 
 
 def _submit_main(args) -> int:
-    from dib_tpu.sched.scheduler import JobSpec, Scheduler
+    from dib_tpu.sched.scheduler import AdmissionRejected, JobSpec, Scheduler
     from dib_tpu.telemetry.context import ensure_context
+    from dib_tpu.train.preempt import PREEMPT_EXIT_CODE
 
     betas = _resolve_betas(args)
     spec = JobSpec(betas=tuple(betas), seeds=tuple(args.seeds),
                    train=_parse_spec_sets(args.set),
-                   retry_budget=args.retry_budget, name=args.name)
+                   retry_budget=args.retry_budget, name=args.name,
+                   tenant=args.tenant, study=args.study,
+                   priority=args.priority)
     ctx = ensure_context("sched", trace_id=args.trace_id)
     scheduler = Scheduler(args.sched_dir, ctx=ctx)
     try:
-        job_id = scheduler.submit(spec)
+        try:
+            job_id = scheduler.submit(spec)
+        except AdmissionRejected as exc:
+            # explicit reject with a retry horizon: the temp-failure exit
+            # code tells the caller to wait retry_after_s and resubmit
+            print(json.dumps({
+                "rejected": True, "tenant": exc.tenant,
+                "retry_after_s": exc.retry_after_s, "reason": exc.reason,
+            }))
+            return PREEMPT_EXIT_CODE
         counts = scheduler.status()["counts"]
     finally:
         scheduler.close()
     print(json.dumps({"job_id": job_id, "units": len(betas) * len(args.seeds),
                       "betas": betas, "seeds": list(args.seeds),
                       "queue": counts, "trace_id": ctx.trace_id}))
+    return 0
+
+
+def _policy_main(args) -> int:
+    from dib_tpu.sched.scheduler import FleetPolicy, TenantPolicy
+
+    current = FleetPolicy.load(args.sched_dir) or FleetPolicy()
+    changed = {}
+    for field in ("max_pending_units", "admission_retry_s",
+                  "breaker_threshold", "breaker_probe_after_s"):
+        arg = "max_pending" if field == "max_pending_units" else field
+        value = getattr(args, arg)
+        if value is not None:
+            changed[field] = value
+    tenants = dict(current.tenants)
+    for spec in args.tenant_specs:
+        name, _, rest = spec.partition("=")
+        if not name or not rest:
+            raise SystemExit(
+                f"sched policy: bad --tenant {spec!r} (want "
+                "NAME=WEIGHT[:MAX_LEASES[:MAX_PENDING]])")
+        parts = rest.split(":")
+        tenants[name] = TenantPolicy(
+            weight=float(parts[0]),
+            max_leases=int(parts[1]) if len(parts) > 1 and parts[1] else None,
+            max_pending=int(parts[2]) if len(parts) > 2 and parts[2] else None,
+        )
+    if changed or args.tenant_specs:
+        merged = FleetPolicy.from_dict(
+            {**current.to_dict(), **changed,
+             "tenants": {n: tp.to_dict() for n, tp in tenants.items()}})
+        merged.save(args.sched_dir)
+        current = merged
+    print(json.dumps({"policy": current.to_dict()}, indent=1))
     return 0
 
 
@@ -176,18 +274,42 @@ def _status_main(args) -> int:
         print(json.dumps(snapshot, indent=1))
         return 0
     counts = snapshot["counts"]
+    starved = snapshot.get("starved", 0)
     print(f"queue: {counts['pending']} pending / {counts['leased']} leased "
           f"/ {counts['done']} done / {counts['failed']} failed"
+          + (f" / {starved} starved (shed floor "
+             f"{snapshot.get('shed_floor')})" if starved else "")
           + (f"  (journal: {snapshot['replayed_records']} records, "
              f"{snapshot['replayed_torn']} torn)"
              if snapshot["replayed_torn"] else ""))
+    tenants = snapshot.get("tenants") or {}
+    if len(tenants) > 1 or any(t.get("admission_rejected")
+                               for t in tenants.values()):
+        for name in sorted(tenants):
+            t = tenants[name]
+            waits = ""
+            if t.get("queue_wait_p99_s") is not None:
+                waits = (f"  wait p50={t['queue_wait_p50_s']:.2f}s "
+                         f"p99={t['queue_wait_p99_s']:.2f}s")
+            rejects = (f"  rejected={t['admission_rejected']}"
+                       if t.get("admission_rejected") else "")
+            print(f"tenant {name:16} {t['pending']} pending / "
+                  f"{t['leased']} leased / {t['starved']} starved / "
+                  f"{t['done']} done / {t['failed']} failed  "
+                  f"share={t['service']:.0f}/{t['weight']:g}"
+                  f"{waits}{rejects}")
     for job_id, job in snapshot["jobs"].items():
+        breaker = " BREAKER-OPEN" if job.get("breaker_open") else ""
+        tenant = (f" tenant={job['tenant']}"
+                  if job.get("tenant", "default") != "default" else "")
         print(f"job {job_id}  {job['status']:8} units={job['units']} "
               f"retries={job['retries_used']}/{job['retry_budget']}"
+              f"{tenant}{breaker}"
               + (f"  [{job['name']}]" if job["name"] else ""))
     for row in snapshot["units"]:
         worker = f"  worker={row['worker']}" if row["worker"] else ""
-        print(f"  {row['unit_id']:28} {row['status']:8} "
+        shown = "starved" if row.get("starved") else row["status"]
+        print(f"  {row['unit_id']:28} {shown:8} "
               f"beta={row['beta']:<10g} seed={row['seed']} "
               f"attempts={row['attempts']}{worker}")
     return 0
@@ -260,6 +382,7 @@ def _run_pool_main(args, argv: Sequence[str]) -> int:
         telemetry.run_start(runtime_manifest(extra={
             "mode": "sched_pool", "sched_dir": os.path.abspath(args.sched_dir),
             "workers": args.workers, "lease_s": args.lease_s,
+            "serve": bool(args.serve),
         }))
     guard = None
     if args.preempt_grace_s and args.preempt_grace_s > 0:
@@ -277,7 +400,8 @@ def _run_pool_main(args, argv: Sequence[str]) -> int:
     runner = TrainingUnitRunner(args.sched_dir, telemetry=telemetry,
                                 preempt=guard)
     pool = WorkerPool(scheduler, runner, num_workers=args.workers,
-                      telemetry=telemetry, preempt=guard)
+                      telemetry=telemetry, preempt=guard,
+                      stay_alive=bool(args.serve))
     try:
         if guard is not None:
             with guard:
@@ -299,6 +423,16 @@ def _run_pool_main(args, argv: Sequence[str]) -> int:
     print(json.dumps(stats))
     if stats["preempted"]:
         return PREEMPT_EXIT_CODE
+    if args.serve:
+        # a fleet shift that reached its duration ended cleanly — an
+        # undrained queue is the NEXT shift's work, not a failure
+        return 0
+    if not stats["drained"] and stats.get("parked"):
+        # everything runnable is shed-parked below the capacity floor:
+        # a temporary condition (rc 75, like preemption), and the
+        # watchdog's parked-snapshot gate relaunches budget-free with
+        # restored capacity instead of counting a crash
+        return PREEMPT_EXIT_CODE
     return 0 if stats["drained"] else 1
 
 
@@ -309,6 +443,8 @@ def sched_main(argv: Sequence[str]) -> int:
         return _submit_main(args)
     if args.action == "status":
         return _status_main(args)
+    if args.action == "policy":
+        return _policy_main(args)
     # the subparser action is positionally first (the parser defines no
     # pre-subcommand flags); strip it by POSITION — filtering by value
     # would also eat e.g. a --sched-dir literally named "run-pool"

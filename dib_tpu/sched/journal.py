@@ -14,7 +14,10 @@ one lost transition is re-derived (an un-journaled lease grant simply
 never happened; the unit is still pending and is leased again).
 
 Record envelope: ``v`` (journal schema version), ``seq`` (per-writer
-sequence), ``t`` (unix time), ``kind``, then the transition's fields.
+sequence), ``t`` (unix time), ``w`` (writer id — distinguishes the
+records of concurrent appenders sharing one fleet journal, so a reader
+folding incrementally can skip its own already-folded records), ``kind``,
+then the transition's fields.
 """
 
 from __future__ import annotations
@@ -23,9 +26,10 @@ import json
 import os
 import threading
 import time
+import uuid
 
 __all__ = ["JOURNAL_FILENAME", "JOURNAL_VERSION", "JobJournal",
-           "read_journal"]
+           "read_journal", "read_journal_from"]
 
 JOURNAL_FILENAME = "journal.jsonl"
 JOURNAL_VERSION = 1
@@ -45,14 +49,21 @@ class JobJournal:
         self.path = os.path.join(directory, filename)
         self._lock = threading.Lock()
         self._seq = 0
+        # Writer id: a fleet journal has MANY appenders (one run-pool plus
+        # every submit-only study controller); each record names which one
+        # wrote it, so `Scheduler.refresh` can fold foreign records without
+        # double-folding its own.
+        self.writer_id = uuid.uuid4().hex[:8]
         self._fd = os.open(
             self.path, os.O_APPEND | os.O_CREAT | os.O_WRONLY, 0o644
         )
         # Seal a torn final line (the previous scheduler died mid-append):
         # without the newline, THIS writer's first record would glue onto
         # the torn bytes and be lost to every future replay as part of one
-        # unparseable line. One scheduler per directory is the deployment
-        # contract, so the seal can never split a live writer's record.
+        # unparseable line. A live writer's record is always one complete
+        # \n-terminated os.write, so the only way the file ends without a
+        # newline is a writer killed mid-append — the seal can never split
+        # a live writer's record, even with concurrent fleet appenders.
         try:
             size = os.fstat(self._fd).st_size
             if size > 0:
@@ -75,6 +86,7 @@ class JobJournal:
                 "seq": self._seq,
                 "t": round(time.time(), 6),   # timing-ok: record
                 # timestamp, not a measured interval
+                "w": self.writer_id,
                 "kind": kind,
                 **fields,
             }
@@ -130,3 +142,45 @@ def read_journal(path: str) -> tuple[list[dict], int]:
         else:
             torn += 1
     return records, torn
+
+
+def read_journal_from(path: str, offset: int) -> tuple[list[dict], int, int]:
+    """Incremental read: parseable records appended after ``offset`` bytes,
+    the count of torn COMPLETE lines skipped, and the new offset.
+
+    The incremental contract differs from :func:`read_journal` on the
+    final line: an un-terminated tail is NOT consumed — it may be a
+    concurrent writer's append caught mid-flight (the reader raced the
+    single ``os.write``, which is possible on some filesystems even though
+    the write itself is atomic once visible), so the returned offset stops
+    before it and the next call re-reads it once the newline lands. Only
+    ``\\n``-terminated lines that still fail to parse count as torn.
+    A missing file reads as empty at offset 0.
+    """
+    if os.path.isdir(path):
+        path = os.path.join(path, JOURNAL_FILENAME)
+    try:
+        with open(path, "rb") as f:
+            f.seek(offset)
+            raw = f.read()
+    except OSError:
+        return [], 0, 0
+    records: list[dict] = []
+    torn = 0
+    consumed = 0
+    for line in raw.splitlines(keepends=True):
+        if not line.endswith(b"\n"):
+            break                      # un-terminated tail: re-read later
+        consumed += len(line)
+        if not line.strip():
+            continue
+        try:
+            record = json.loads(line)
+        except json.JSONDecodeError:
+            torn += 1
+            continue
+        if isinstance(record, dict):
+            records.append(record)
+        else:
+            torn += 1
+    return records, torn, offset + consumed

@@ -27,11 +27,27 @@ terminal outcomes back. Its failure semantics mirror the watchdog's
   - Any other unit exception is a FAILURE: retried with exponential
     backoff against the job's retry budget (``Scheduler.fail``).
 
+Fleet mode (docs/scheduling.md): with ``stay_alive=True`` the pool is a
+long-lived shared fleet — workers do NOT exit when the queue drains;
+they idle on an exponential backoff (``poll_s`` doubling up to
+``idle_max_s``, so an empty or fully-parked queue costs a few wakeups a
+second, not a busy-spin) and the reaper folds OTHER writers' journal
+records (``Scheduler.refresh``) each cycle, which is how submit-only
+study controllers' cross-process submissions become visible. The reaper
+also feeds live worker capacity into ``Scheduler.set_capacity`` so
+worker death sheds load by priority (low-priority pending units park as
+``starved``) instead of letting the queue collapse.
+
+``DIB_POOL_FAULT=kill_worker@<n>`` arms the chaos injector: one worker
+raises :class:`WorkerKilled` mid-unit once ``n`` units have completed —
+the worker-loss drill's real-CLI entry point.
+
 The pool never imports jax — device work lives in the runner.
 """
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 import uuid
@@ -39,6 +55,8 @@ import uuid
 from dib_tpu.train.preempt import TrainingPreempted
 
 __all__ = ["LeaseLost", "WorkerKilled", "WorkerPool"]
+
+FAULT_ENV = "DIB_POOL_FAULT"
 
 
 class WorkerKilled(Exception):
@@ -64,12 +82,15 @@ class WorkerPool:
 
     def __init__(self, scheduler, runner, num_workers: int = 2,
                  poll_s: float = 0.05, reap_every_s: float = 0.25,
-                 telemetry=None, preempt=None, name: str = "pool"):
+                 telemetry=None, preempt=None, name: str = "pool",
+                 stay_alive: bool = False, idle_max_s: float = 1.0):
         self.scheduler = scheduler
         self.runner = runner
         self.num_workers = int(num_workers)
         self.poll_s = float(poll_s)
         self.reap_every_s = float(reap_every_s)
+        self.stay_alive = bool(stay_alive)
+        self.idle_max_s = float(idle_max_s)
         # Instance-unique worker-name prefix: a relaunched pool (same
         # process name, same worker indices) must NOT alias the dead
         # pool's lease holders in the journal, or _reap_dead_workers
@@ -86,6 +107,13 @@ class WorkerPool:
         self.stats = {"completed": 0, "failed": 0, "released": 0,
                       "stale_abandoned": 0, "stale_completions": 0,
                       "workers_died": 0, "stolen": 0}
+        # chaos injector: kill_worker@<n> kills ONE worker mid-unit once
+        # n units have completed (fired at most once per pool)
+        self._fault_kill_after: int | None = None
+        fault = os.environ.get(FAULT_ENV, "")
+        if fault.startswith("kill_worker@"):
+            self._fault_kill_after = int(fault.split("@", 1)[1])
+        self._fault_fired = False
 
     # ------------------------------------------------------------- workers
     def _heartbeat_for(self, lease):
@@ -99,15 +127,44 @@ class WorkerPool:
         return heartbeat
 
     def _worker(self, worker_name: str) -> None:
+        idle = 0
         while not self._stop.is_set():
             if self._preempt is not None and self._preempt.requested:
                 return
             lease = self.scheduler.acquire(worker_name)
             if lease is None:
-                if self.scheduler.drained():
+                if not self.stay_alive:
+                    if self.scheduler.drained():
+                        return
+                    parked_only = getattr(self.scheduler, "parked_only",
+                                          None)
+                    if parked_only is not None and parked_only():
+                        # everything runnable is shed-parked below the
+                        # capacity floor: nothing can progress until
+                        # capacity returns, so a bounded pool exits
+                        # instead of waiting out its whole duration
+                        return
+                # idle exponential backoff: an empty (or fully parked)
+                # queue must idle cheaply, not busy-spin at poll_s
+                idle += 1
+                delay = min(self.poll_s * (2 ** min(idle - 1, 6)),
+                            self.idle_max_s)
+                if self._stop.wait(delay):
                     return
-                time.sleep(self.poll_s)
                 continue
+            idle = 0
+            if (self._fault_kill_after is not None
+                    and not self._fault_fired
+                    and self.stats["completed"] >= self._fault_kill_after):
+                with self._lock:
+                    fire = not self._fault_fired
+                    self._fault_fired = True
+                if fire:
+                    # injected sudden death WITH a live lease: the reaper
+                    # must steal the unit and the pool must degrade
+                    with self._lock:
+                        self.stats["workers_died"] += 1
+                    return
             unit = self.scheduler.unit(lease.unit_id)["unit"]
             try:
                 result = self.runner(
@@ -174,9 +231,21 @@ class WorkerPool:
 
     def _reaper(self) -> None:
         while not self._workers_done.wait(self.reap_every_s):
+            # fold cross-process submissions first (fleet mode: submit-only
+            # controllers append to the same journal), then steal
+            refresh = getattr(self.scheduler, "refresh", None)
+            if refresh is not None:
+                refresh()
             self._reap_dead_workers()
             with self._lock:
                 self.stats["stolen"] += len(self.scheduler.reap())
+            # feed live capacity into the shed floor: worker death parks
+            # the lowest priority classes instead of collapsing the queue
+            set_capacity = getattr(self.scheduler, "set_capacity", None)
+            if set_capacity is not None:
+                alive = sum(1 for t in self._threads.values()
+                            if t.is_alive())
+                set_capacity(alive, self.num_workers)
 
     # ----------------------------------------------------------------- run
     def run(self, duration_s: float | None = None) -> dict:
@@ -186,7 +255,9 @@ class WorkerPool:
         it the pool stops accepting units, each worker finishes (and
         completes) its in-flight unit, and the rest of the queue is left
         for the next pool. ``duration_s=0`` stops after at most one unit
-        per worker."""
+        per worker. With ``stay_alive`` (fleet mode) workers idle past a
+        drained queue and only ``duration_s``, preemption, or a stop
+        ends the run."""
         for i in range(self.num_workers):
             worker_name = f"{self.name}-w{i}"
             thread = threading.Thread(
@@ -221,4 +292,10 @@ class WorkerPool:
         out["preempted"] = bool(
             self._preempt is not None and self._preempt.requested)
         out["workers"] = self.num_workers
+        out["stay_alive"] = self.stay_alive
+        starved = getattr(self.scheduler, "starved", None)
+        out["starved"] = int(starved()) if starved is not None else 0
+        parked_only = getattr(self.scheduler, "parked_only", None)
+        out["parked"] = bool(parked_only()) if parked_only is not None \
+            else False
         return out

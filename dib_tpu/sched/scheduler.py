@@ -29,10 +29,42 @@ The scheduling model (docs/robustness.md "Sweep as a service"):
     pool's problem, never the job's (the watchdog's budget-free rc-75
     relaunch, at the scheduling layer).
 
+The multi-tenant fleet layer (docs/scheduling.md):
+
+  - **Fair share**: jobs carry a ``tenant`` (and optionally a ``study``
+    and an integer ``priority``); :meth:`acquire` is deficit-weighted
+    fair-share ACROSS tenants — among tenants with an eligible unit, the
+    one with the least weighted service (journaled lease grants /
+    policy weight) wins; WITHIN a tenant the order stays FIFO and
+    retry-backoff holds are honored unchanged. Service counters fold
+    from ``lease`` records, so a SIGKILLed scheduler restarts into the
+    exact fair-share ledger.
+  - **Admission control**: :class:`FleetPolicy` (``policy.json`` in the
+    scheduler directory) bounds the pending queue fleet-wide and per
+    tenant and caps per-tenant concurrent leases; an over-bound
+    :meth:`submit` journals an ``admission`` record and raises
+    :class:`AdmissionRejected` with an explicit retry horizon — the
+    serve plane's ``TenantQuotas`` shape applied to the batch plane.
+  - **Load shedding**: when the pool shrinks (:meth:`set_capacity`),
+    pending units of the lowest priority classes PARK (reported as
+    ``starved`` in :meth:`status`; the stored state stays ``pending`` —
+    shedding is live-pool policy, never persisted) while leased units
+    finish; a recovered pool unparks them by reassessing capacity.
+  - **Circuit breaker**: a job whose units fail ``breaker_threshold``
+    times consecutively is quarantined (journaled ``breaker`` trip)
+    instead of burning the shared retry budget; after the probe horizon
+    one half-open probe unit is leased, and its success resets the
+    breaker while its failure re-trips it.
+
 Durability: every transition is journaled BEFORE the in-memory state
 changes (``sched/journal.py``); construction replays the journal, so a
 SIGKILLed scheduler restarts into the exact queue it died with, torn
 final line tolerated (surfaced as a ``journal_recovered`` mitigation).
+A fleet journal has MANY writers (one run-pool, N submit-only study
+controllers); :meth:`Scheduler.refresh` incrementally folds the OTHER
+writers' records (by journal writer id), which is how the pool sees
+cross-process submissions and a polling controller sees its units
+drain.
 
 Telemetry: with an ``EventWriter``, transitions land as typed ``job`` /
 ``lease`` events on the run's events.jsonl (docs/observability.md), and
@@ -45,16 +77,26 @@ joins injections with the scheduler's reactions.
 from __future__ import annotations
 
 import dataclasses
+import json
 import math
+import os
 import threading
 import time
 import uuid
 from typing import Sequence
 
-from dib_tpu.sched.journal import JobJournal, read_journal
+from dib_tpu.sched.journal import (
+    JOURNAL_FILENAME,
+    JobJournal,
+    read_journal,
+    read_journal_from,
+)
 
-__all__ = ["JobSpec", "Lease", "Scheduler", "WorkUnit", "dense_beta_grid",
-           "refine_beta_grid"]
+__all__ = ["AdmissionRejected", "FleetPolicy", "JobSpec", "Lease",
+           "POLICY_FILENAME", "Scheduler", "TenantPolicy", "WorkUnit",
+           "dense_beta_grid", "parked_snapshot", "refine_beta_grid"]
+
+POLICY_FILENAME = "policy.json"
 
 
 # ------------------------------------------------------------------ grids
@@ -98,13 +140,18 @@ def refine_beta_grid(around: Sequence[float], num: int = 4,
 @dataclasses.dataclass(frozen=True)
 class JobSpec:
     """One β-grid job: the grid, the seeds, the training parameters the
-    unit runner needs, and the job's retry budget."""
+    unit runner needs, the job's retry budget, and its fleet identity
+    (``tenant``/``study`` for fair share, ``priority`` for shedding —
+    higher numbers shed LAST)."""
 
     betas: tuple[float, ...]
     seeds: tuple[int, ...] = (0,)
     train: dict = dataclasses.field(default_factory=dict)
     retry_budget: int = 3
     name: str = ""
+    tenant: str = ""
+    study: str = ""
+    priority: int = 0
 
     def __post_init__(self):
         if not self.betas:
@@ -121,6 +168,9 @@ class JobSpec:
             "train": dict(self.train),
             "retry_budget": int(self.retry_budget),
             "name": self.name,
+            "tenant": self.tenant,
+            "study": self.study,
+            "priority": int(self.priority),
         }
 
     @classmethod
@@ -131,7 +181,118 @@ class JobSpec:
             train=dict(d.get("train") or {}),
             retry_budget=int(d.get("retry_budget", 3)),
             name=d.get("name", ""),
+            tenant=d.get("tenant", ""),
+            study=d.get("study", ""),
+            priority=int(d.get("priority", 0) or 0),
         )
+
+
+# ------------------------------------------------------------------ policy
+@dataclasses.dataclass(frozen=True)
+class TenantPolicy:
+    """One tenant's share of the fleet: fair-share ``weight``, a cap on
+    concurrent leases, and a cap on queued (pending) units."""
+
+    weight: float = 1.0
+    max_leases: int | None = None
+    max_pending: int | None = None
+
+    def to_dict(self) -> dict:
+        return {"weight": float(self.weight),
+                "max_leases": self.max_leases,
+                "max_pending": self.max_pending}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TenantPolicy":
+        return cls(
+            weight=float(d.get("weight", 1.0) or 1.0),
+            max_leases=(None if d.get("max_leases") is None
+                        else int(d["max_leases"])),
+            max_pending=(None if d.get("max_pending") is None
+                         else int(d["max_pending"])),
+        )
+
+
+_DEFAULT_TENANT_POLICY = TenantPolicy()
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetPolicy:
+    """The fleet's admission/fairness/breaker policy — the serve plane's
+    ``TenantQuotas`` shape applied to the batch plane. Persisted as
+    ``policy.json`` next to the journal so every writer sharing the
+    fleet directory (the run-pool AND each submitting controller)
+    enforces the same bounds. Policy gates LIVE decisions only; every
+    resulting state transition is journaled, so replay never needs the
+    policy that produced it."""
+
+    max_pending_units: int | None = None
+    admission_retry_s: float = 5.0
+    breaker_threshold: int = 0          # 0 disables the circuit breaker
+    breaker_probe_after_s: float = 30.0
+    tenants: dict = dataclasses.field(default_factory=dict)
+
+    def for_tenant(self, tenant: str) -> TenantPolicy:
+        return self.tenants.get(tenant, _DEFAULT_TENANT_POLICY)
+
+    def to_dict(self) -> dict:
+        return {
+            "max_pending_units": self.max_pending_units,
+            "admission_retry_s": float(self.admission_retry_s),
+            "breaker_threshold": int(self.breaker_threshold),
+            "breaker_probe_after_s": float(self.breaker_probe_after_s),
+            "tenants": {name: tp.to_dict()
+                        for name, tp in sorted(self.tenants.items())},
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FleetPolicy":
+        return cls(
+            max_pending_units=(None if d.get("max_pending_units") is None
+                               else int(d["max_pending_units"])),
+            admission_retry_s=float(d.get("admission_retry_s", 5.0) or 5.0),
+            breaker_threshold=int(d.get("breaker_threshold", 0) or 0),
+            breaker_probe_after_s=float(
+                d.get("breaker_probe_after_s", 30.0) or 30.0),
+            tenants={name: TenantPolicy.from_dict(tp or {})
+                     for name, tp in (d.get("tenants") or {}).items()},
+        )
+
+    @classmethod
+    def load(cls, directory: str) -> "FleetPolicy | None":
+        """The directory's persisted policy, or None without one (every
+        bound open — the single-tenant legacy behavior)."""
+        path = os.path.join(directory, POLICY_FILENAME)
+        try:
+            with open(path, encoding="utf-8") as f:
+                return cls.from_dict(json.load(f))
+        except (OSError, ValueError):
+            return None
+
+    def save(self, directory: str) -> str:
+        os.makedirs(directory, exist_ok=True)
+        path = os.path.join(directory, POLICY_FILENAME)
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(self.to_dict(), f, indent=2, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, path)
+        return path
+
+
+class AdmissionRejected(RuntimeError):
+    """An over-bound :meth:`Scheduler.submit` — the queue is full
+    (fleet-wide or for this tenant). Carries the explicit retry horizon:
+    the polite caller waits ``retry_after_s`` and resubmits; the journal
+    already holds the ``admission`` record either way."""
+
+    def __init__(self, tenant: str, retry_after_s: float, reason: str):
+        super().__init__(
+            f"admission rejected for tenant {tenant!r}: {reason} "
+            f"(retry after {retry_after_s:g}s)")
+        self.tenant = tenant
+        self.retry_after_s = float(retry_after_s)
+        self.reason = reason
 
 
 @dataclasses.dataclass(frozen=True)
@@ -167,7 +328,7 @@ class Scheduler:
 
     def __init__(self, directory: str, telemetry=None,
                  lease_s: float = 60.0, backoff_base_s: float = 0.5,
-                 clock=time.time, ctx=None):
+                 clock=time.time, ctx=None, policy: FleetPolicy | None = None):
         from dib_tpu.telemetry.context import from_env
 
         self.directory = directory
@@ -185,9 +346,24 @@ class Scheduler:
         self._jobs: dict[str, dict] = {}
         self._units: dict[str, dict] = {}
         self._order: list[str] = []      # unit submission order (FIFO base)
+        # fair-share ledger: cumulative journaled lease grants per tenant
+        # (folded from ``lease`` records, so replay restores it exactly)
+        self._service: dict[str, float] = {}
+        # per-tenant queue waits (bounded tail) for the status/rollup
+        # percentiles, and per-tenant admission-reject counts
+        self._tenant_waits: dict[str, list[float]] = {}
+        self._admission_rejects: dict[str, int] = {}
+        # load-shed floor: LIVE pool policy only (set_capacity), never
+        # replayed — a restarted pool reassesses its own capacity. The
+        # last journaled ``shed`` record is kept for observability.
+        self._shed_floor: int | None = None
+        self._last_shed: dict | None = None
+        self.policy = (policy if policy is not None
+                       else (FleetPolicy.load(directory) or FleetPolicy()))
         self.replayed_records = 0
         self.replayed_torn = 0
-        records, torn = read_journal(directory)
+        journal_path = os.path.join(directory, JOURNAL_FILENAME)
+        records, torn, offset = read_journal_from(journal_path, 0)
         for record in records:
             self._fold(record)
         self.replayed_records = len(records)
@@ -195,6 +371,11 @@ class Scheduler:
         # journal opened AFTER replay: the replay must never read the
         # fd this instance is about to append with
         self._journal = JobJournal(directory)
+        self._read_offset = offset
+        # the open sealed any torn tail and a concurrent fleet writer may
+        # have appended during replay — fold the remainder before serving
+        self.replayed_records += self.refresh()
+        torn = self.replayed_torn
         if torn:
             # crash recovery is never silent: a torn line means a writer
             # died mid-append and the transition it was recording is
@@ -202,8 +383,8 @@ class Scheduler:
             if telemetry is not None:
                 telemetry.mitigation(
                     mtype="journal_recovered", detail=(
-                        f"replayed {len(records)} journal record(s), "
-                        f"skipped {torn} torn line(s)"),
+                        f"replayed {self.replayed_records} journal "
+                        f"record(s), skipped {torn} torn line(s)"),
                 )
 
     # -------------------------------------------------------------- replay
@@ -212,9 +393,16 @@ class Scheduler:
         the live paths journal first, then call this)."""
         kind = r.get("kind")
         if kind == "job":
+            spec = JobSpec.from_dict(r.get("spec") or {})
             self._jobs[r["job_id"]] = {
-                "spec": JobSpec.from_dict(r.get("spec") or {}),
+                "spec": spec,
                 "status": "running", "retries_used": 0, "units": [],
+                "tenant": spec.tenant or "default",
+                "study": spec.study,
+                "priority": int(spec.priority),
+                "consec_fails": 0,
+                "breaker": None,          # open breaker: {until, probe_unit}
+                "breaker_trips": 0,
             }
         elif kind == "unit":
             unit = WorkUnit(
@@ -234,6 +422,14 @@ class Scheduler:
         elif kind == "lease":
             entry = self._units.get(r["unit_id"])
             if entry is not None:
+                tenant = self._tenant_of(entry)
+                # the fair-share ledger and queue-wait tail fold from the
+                # grant record itself, so they survive SIGKILL exactly
+                self._service[tenant] = self._service.get(tenant, 0.0) + 1.0
+                waits = self._tenant_waits.setdefault(tenant, [])
+                waits.append(max(r.get("t", 0.0) - entry["enqueue_t"], 0.0))
+                if len(waits) > 512:
+                    del waits[:len(waits) - 512]
                 entry["status"] = "leased"
                 entry["lease"] = {
                     "lease_id": r["lease_id"], "worker": r.get("worker"),
@@ -254,6 +450,7 @@ class Scheduler:
                 entry["status"] = "pending"
                 entry["lease"] = None
                 entry["enqueue_t"] = r.get("t", 0.0)
+                self._clear_probe(entry, r["unit_id"])
         elif kind == "fail":
             entry = self._units.get(r["unit_id"])
             if entry is not None:
@@ -272,12 +469,43 @@ class Scheduler:
                 # the sched_retry_ceiling SLO on correct fail-fast
                 if job is not None and r.get("requeued"):
                     job["retries_used"] += 1
+                if job is not None:
+                    job["consec_fails"] += 1
+                self._clear_probe(entry, r["unit_id"])
         elif kind == "done":
             entry = self._units.get(r["unit_id"])
             if entry is not None:
                 entry["status"] = "done"
                 entry["lease"] = None
                 entry["result"] = r.get("result")
+                job = self._jobs.get(entry["unit"].job_id)
+                if job is not None:
+                    job["consec_fails"] = 0
+                self._clear_probe(entry, r["unit_id"])
+        elif kind == "breaker":
+            job = self._jobs.get(r.get("job_id"))
+            if job is not None:
+                action = r.get("action")
+                if action == "trip":
+                    job["breaker"] = {"until": r.get("until", 0.0),
+                                      "probe_unit": None}
+                    job["breaker_trips"] += 1
+                elif action == "probe" and job["breaker"] is not None:
+                    job["breaker"]["probe_unit"] = r.get("unit_id")
+                elif action == "reset":
+                    job["breaker"] = None
+                    job["consec_fails"] = 0
+        elif kind == "admission":
+            if r.get("action") == "rejected":
+                tenant = r.get("tenant", "default")
+                self._admission_rejects[tenant] = (
+                    self._admission_rejects.get(tenant, 0) + 1)
+        elif kind == "shed":
+            # observability only: the floor itself is live-pool policy
+            # (set_capacity), never restored by replay
+            self._last_shed = {"floor": r.get("floor"),
+                               "alive": r.get("alive"),
+                               "total": r.get("total"), "t": r.get("t")}
         elif kind == "job_done":
             job = self._jobs.get(r["job_id"])
             if job is not None:
@@ -287,11 +515,65 @@ class Scheduler:
             if job is not None:
                 job["status"] = "failed"
 
+    def _tenant_of(self, entry: dict) -> str:
+        job = self._jobs.get(entry["unit"].job_id)
+        return job["tenant"] if job is not None else "default"
+
+    def _clear_probe(self, entry: dict, unit_id: str) -> None:
+        """A probe unit leaving the leased state (done/fail/release/
+        expire) clears the half-open marker; the breaker itself is only
+        closed by an explicit journaled reset, so a crash between the
+        probe's ``done`` and the ``reset`` merely costs one extra probe."""
+        job = self._jobs.get(entry["unit"].job_id)
+        if job is not None and job.get("breaker") is not None \
+                and job["breaker"].get("probe_unit") == unit_id:
+            job["breaker"]["probe_unit"] = None
+
     # --------------------------------------------------------------- submit
+    def _admit_locked(self, tenant: str, n_units: int) -> None:
+        """Admission control: reject a submit that would overflow the
+        bounded queue (fleet-wide or per tenant). The rejection is
+        journaled — replay restores the per-tenant reject counters — and
+        raised with the explicit retry horizon."""
+        cap = self.policy.max_pending_units
+        tp = self.policy.for_tenant(tenant)
+        reason = None
+        if cap is not None or tp.max_pending is not None:
+            pending = t_pending = 0
+            for e in self._units.values():
+                if e["status"] != "pending":
+                    continue
+                pending += 1
+                if self._tenant_of(e) == tenant:
+                    t_pending += 1
+            if cap is not None and pending + n_units > cap:
+                reason = (f"fleet queue full: {pending} pending + "
+                          f"{n_units} would exceed the {cap}-unit bound")
+            elif tp.max_pending is not None \
+                    and t_pending + n_units > tp.max_pending:
+                reason = (f"tenant queue full: {t_pending} pending + "
+                          f"{n_units} would exceed the tenant's "
+                          f"{tp.max_pending}-unit bound")
+        if reason is None:
+            return
+        retry_after = float(self.policy.admission_retry_s)
+        self._fold(self._journal.append(
+            "admission", action="rejected", tenant=tenant, units=n_units,
+            reason=reason, retry_after_s=retry_after))
+        if self._telemetry is not None:
+            self._telemetry.job(
+                job_id=f"admission:{tenant}", action="rejected",
+                tenant=tenant, units=n_units, reason=reason,
+                retry_after_s=retry_after)
+        raise AdmissionRejected(tenant, retry_after, reason)
+
     def submit(self, spec: JobSpec) -> str:
         """Decompose a job into (β, seed) units and enqueue them FIFO.
-        Returns the job id."""
+        Returns the job id. Raises :class:`AdmissionRejected` when the
+        policy's queue bounds would overflow."""
         with self._lock:
+            tenant = spec.tenant or "default"
+            self._admit_locked(tenant, len(spec.betas) * len(spec.seeds))
             job_id = f"job-{len(self._jobs):04d}-{uuid.uuid4().hex[:6]}"
             job_extra = ({"ctx": self._ctx.to_dict()}
                          if self._ctx is not None else {})
@@ -310,47 +592,111 @@ class Scheduler:
                         beta=float(beta), seed=int(seed),
                         train=dict(spec.train), **unit_extra))
             if self._telemetry is not None:
+                extra = {}
+                if spec.study:
+                    extra["study"] = spec.study
                 self._telemetry.job(
                     job_id=job_id, action="submitted",
                     units=len(spec.betas) * len(spec.seeds),
                     betas=[float(b) for b in spec.betas],
                     seeds=[int(s) for s in spec.seeds],
-                    retry_budget=spec.retry_budget)
+                    retry_budget=spec.retry_budget,
+                    tenant=tenant, priority=int(spec.priority), **extra)
             return job_id
 
     # -------------------------------------------------------------- leasing
+    def _parked_locked(self, job: dict) -> bool:
+        """True while the job's pending units are shed below the live
+        pool's capacity floor (priority classes are shed lowest-first)."""
+        return (self._shed_floor is not None
+                and job["priority"] < self._shed_floor)
+
     def acquire(self, worker: str, lease_s: float | None = None) -> Lease | None:
-        """Lease the oldest eligible pending unit to ``worker``; None when
-        nothing is currently eligible (empty queue or backoff holds)."""
+        """Lease one pending unit to ``worker``; None when nothing is
+        currently eligible (empty queue, backoff holds, shed parking,
+        quarantine, or quota).
+
+        Selection is deficit-weighted fair share: each tenant's FIRST
+        eligible unit in submission order is its candidate (FIFO within
+        the tenant), then the tenant with the least ``service/weight``
+        wins (ties to the older candidate). With one tenant this
+        degenerates to the original global FIFO. Ineligible means: the
+        unit's backoff hold, the job parked below the shed floor, the
+        job's breaker open (unless the probe horizon passed — then the
+        single half-open probe grant), or the tenant at its concurrent-
+        lease quota."""
         with self._lock:
             now = self._clock()
+            leased_by_tenant: dict[str, int] = {}
+            for e in self._units.values():
+                if e["status"] == "leased":
+                    t = self._tenant_of(e)
+                    leased_by_tenant[t] = leased_by_tenant.get(t, 0) + 1
+            # tenant -> (unit_id, entry, probe_job_id|None)
+            candidates: dict[str, tuple] = {}
             for unit_id in self._order:
                 entry = self._units[unit_id]
                 if entry["status"] != "pending" or entry["not_before"] > now:
                     continue
-                attempt = entry["attempts"] + 1
-                lease = Lease(
-                    unit_id=unit_id,
-                    lease_id=f"{unit_id}#a{attempt}-{uuid.uuid4().hex[:6]}",
-                    worker=worker,
-                    expires_t=now + (lease_s or self.lease_s),
-                    attempt=attempt,
-                )
-                queue_wait = max(now - entry["enqueue_t"], 0.0)
+                job = self._jobs.get(entry["unit"].job_id)
+                tenant = job["tenant"] if job is not None else "default"
+                if tenant in candidates:
+                    continue
+                tp = self.policy.for_tenant(tenant)
+                if tp.max_leases is not None \
+                        and leased_by_tenant.get(tenant, 0) >= tp.max_leases:
+                    continue
+                probe_job = None
+                if job is not None:
+                    if self._parked_locked(job):
+                        continue
+                    breaker = job.get("breaker")
+                    if breaker is not None:
+                        if breaker.get("probe_unit") is not None \
+                                or breaker.get("until", 0.0) > now:
+                            continue      # quarantined / probe in flight
+                        probe_job = entry["unit"].job_id
+                candidates[tenant] = (unit_id, entry, probe_job)
+            if not candidates:
+                return None
+
+            def _deficit(tenant: str):
+                weight = max(self.policy.for_tenant(tenant).weight, 1e-9)
+                return (self._service.get(tenant, 0.0) / weight,
+                        candidates[tenant][1]["enqueue_t"], tenant)
+
+            tenant = min(candidates, key=_deficit)
+            unit_id, entry, probe_job = candidates[tenant]
+            if probe_job is not None:
                 self._fold(self._journal.append(
-                    "lease", unit_id=unit_id, lease_id=lease.lease_id,
-                    worker=worker, expires_t=lease.expires_t,
-                    attempt=attempt))
+                    "breaker", job_id=probe_job, action="probe",
+                    unit_id=unit_id))
                 if self._telemetry is not None:
-                    self._telemetry.lease(
-                        unit=unit_id, action="granted", worker=worker,
-                        lease=lease.lease_id,
-                        job_id=entry["unit"].job_id,
-                        expires_s=round(lease.expires_t - now, 3),
-                        queue_wait_s=round(queue_wait, 3),
-                        attempt=attempt)
-                return lease
-            return None
+                    self._telemetry.breaker(
+                        action="probe", via="sched", job_id=probe_job,
+                        tenant=tenant, unit=unit_id)
+            attempt = entry["attempts"] + 1
+            lease = Lease(
+                unit_id=unit_id,
+                lease_id=f"{unit_id}#a{attempt}-{uuid.uuid4().hex[:6]}",
+                worker=worker,
+                expires_t=now + (lease_s or self.lease_s),
+                attempt=attempt,
+            )
+            queue_wait = max(now - entry["enqueue_t"], 0.0)
+            self._fold(self._journal.append(
+                "lease", unit_id=unit_id, lease_id=lease.lease_id,
+                worker=worker, expires_t=lease.expires_t,
+                attempt=attempt))
+            if self._telemetry is not None:
+                self._telemetry.lease(
+                    unit=unit_id, action="granted", worker=worker,
+                    lease=lease.lease_id,
+                    job_id=entry["unit"].job_id,
+                    expires_s=round(lease.expires_t - now, 3),
+                    queue_wait_s=round(queue_wait, 3),
+                    attempt=attempt, tenant=tenant)
+            return lease
 
     def _current(self, lease: Lease) -> dict | None:
         """The unit entry iff ``lease`` is still the unit's live lease."""
@@ -396,14 +742,29 @@ class Scheduler:
             if entry is None:
                 return self._reject_stale(lease, "complete")
             unit = entry["unit"]
+            job = self._jobs.get(unit.job_id)
+            was_probe = (job is not None and job.get("breaker") is not None
+                         and job["breaker"].get("probe_unit")
+                         == lease.unit_id)
             self._fold(self._journal.append(
                 "done", unit_id=lease.unit_id, lease_id=lease.lease_id,
                 result=result))
+            if was_probe:
+                # half-open probe succeeded: close the breaker (journaled,
+                # so replay restores the closed state)
+                self._fold(self._journal.append(
+                    "breaker", job_id=unit.job_id, action="reset",
+                    via="probe"))
+                if self._telemetry is not None:
+                    self._telemetry.breaker(
+                        action="reset", via="probe", job_id=unit.job_id,
+                        tenant=job["tenant"], unit=lease.unit_id)
             if self._telemetry is not None:
                 self._telemetry.job(
                     job_id=unit.job_id, action="unit_done",
                     unit=lease.unit_id, worker=lease.worker,
-                    beta=unit.beta, seed=unit.seed)
+                    beta=unit.beta, seed=unit.seed,
+                    tenant=job["tenant"])
             self._maybe_finish_job(unit.job_id)
             return True
 
@@ -422,17 +783,23 @@ class Scheduler:
             requeued = job["retries_used"] < budget
             backoff = (self.backoff_base_s * (2 ** entry["attempts"])
                        if requeued else 0.0)
+            was_probe = (job.get("breaker") is not None
+                         and job["breaker"].get("probe_unit")
+                         == lease.unit_id)
             self._fold(self._journal.append(
                 "fail", unit_id=lease.unit_id, lease_id=lease.lease_id,
                 error=str(error)[:500], requeued=requeued,
                 not_before=self._clock() + backoff))
+            self._maybe_trip_breaker(job, unit.job_id, lease.unit_id,
+                                     was_probe, requeued)
             if self._telemetry is not None:
                 self._telemetry.job(
                     job_id=unit.job_id, action="unit_failed",
                     unit=lease.unit_id, error=str(error)[:300],
                     retries=job["retries_used"],
                     retry_budget=budget,
-                    backoff_s=round(backoff, 3))
+                    backoff_s=round(backoff, 3),
+                    tenant=job["tenant"])
             if not requeued:
                 self._fold(self._journal.append(
                     "job_failed", job_id=unit.job_id))
@@ -448,6 +815,39 @@ class Scheduler:
                         reason="retry budget exhausted")
                 return "exhausted"
             return "requeued"
+
+    def _maybe_trip_breaker(self, job: dict, job_id: str, unit_id: str,
+                            was_probe: bool, requeued: bool) -> None:
+        """Trip (or re-trip) the per-job circuit breaker after a failure:
+        ``breaker_threshold`` consecutive failures quarantine the job
+        until the probe horizon instead of burning the shared retry
+        budget on a study that keeps failing; a failed half-open probe
+        re-trips immediately. Caller holds the lock; the ``fail`` record
+        is already folded (so ``consec_fails`` counts this failure)."""
+        threshold = int(self.policy.breaker_threshold)
+        if threshold <= 0 or not requeued or job["status"] != "running":
+            return
+        if not was_probe and (job.get("breaker") is not None
+                              or job["consec_fails"] < threshold):
+            return
+        until = self._clock() + float(self.policy.breaker_probe_after_s)
+        self._fold(self._journal.append(
+            "breaker", job_id=job_id, action="trip", until=until,
+            consecutive=job["consec_fails"]))
+        if self._telemetry is not None:
+            self._telemetry.breaker(
+                action="trip", via="probe" if was_probe else "sched",
+                job_id=job_id, tenant=job["tenant"],
+                consecutive=job["consec_fails"], threshold=threshold,
+                until=round(until, 3))
+            self._telemetry.mitigation(
+                mtype="breaker_quarantine", reason=(
+                    f"job {job_id} quarantined after "
+                    f"{job['consec_fails']} consecutive unit failures "
+                    f"(threshold {threshold}); one probe unit is allowed "
+                    f"after {self.policy.breaker_probe_after_s:g}s instead "
+                    "of burning the shared retry budget"),
+                detail=f"unit {unit_id}")
 
     def release(self, lease: Lease, reason: str = "preempt") -> bool:
         """Budget-free re-queue (cooperative preemption / clean worker
@@ -515,6 +915,125 @@ class Scheduler:
                     f"{lease.get('worker')} ({reason}); the next acquire "
                     "resumes it from its newest intact checkpoint"))
 
+    # ---------------------------------------------------- fleet operations
+    def refresh(self) -> int:
+        """Incrementally fold records OTHER writers appended to the
+        shared journal since the last read (by writer id — this
+        instance's own records were folded at append time). The fleet
+        pool calls this from its reaper to see cross-process
+        submissions; a submit-only controller calls it while polling its
+        round's units to completion. Returns the count folded."""
+        with self._lock:
+            records, torn, self._read_offset = read_journal_from(
+                self._journal.path, self._read_offset)
+            self.replayed_torn += torn
+            folded = 0
+            for r in records:
+                if r.get("w") == self._journal.writer_id:
+                    continue
+                self._fold(r)
+                folded += 1
+            return folded
+
+    def set_capacity(self, alive: int, total: int) -> dict:
+        """Reassess the load-shed floor for the pool's live capacity:
+        with ``alive`` of ``total`` workers left, only the top
+        ``ceil(classes * alive/total)`` priority classes stay runnable
+        (never fewer than one) and lower classes' pending units PARK —
+        reported as ``starved``, never failed or lost. Leased units are
+        untouched; a recovered pool clears the floor the same way."""
+        with self._lock:
+            alive = max(int(alive), 0)
+            total = max(int(total), 0)
+            floor: int | None = None
+            if total > 0 and alive < total:
+                prios = sorted(
+                    {job["priority"] for job in self._jobs.values()
+                     if any(self._units[u]["status"] in ("pending", "leased")
+                            for u in job["units"])},
+                    reverse=True)
+                if prios:
+                    keep = max(1, math.ceil(len(prios) * alive / total))
+                    if keep < len(prios):
+                        floor = prios[keep - 1]
+            if floor != self._shed_floor:
+                self._shed_floor = floor
+                self._fold(self._journal.append(
+                    "shed", floor=floor, alive=alive, total=total))
+                starved = self._starved_locked()
+                if self._telemetry is not None:
+                    if floor is not None:
+                        self._telemetry.mitigation(
+                            mtype="load_shed", floor=floor, reason=(
+                                f"pool at {alive}/{total} workers: parking "
+                                f"pending units below priority {floor} "
+                                f"({starved} starved) so the surviving "
+                                "capacity drains the highest classes"))
+                    else:
+                        self._telemetry.mitigation(
+                            mtype="load_shed_cleared", reason=(
+                                f"pool back at {alive}/{total} workers: "
+                                "parked units released"))
+            return {"floor": self._shed_floor,
+                    "starved": self._starved_locked()}
+
+    def _starved_locked(self) -> int:
+        starved = 0
+        for e in self._units.values():
+            if e["status"] != "pending":
+                continue
+            job = self._jobs.get(e["unit"].job_id)
+            if job is not None and self._parked_locked(job):
+                starved += 1
+        return starved
+
+    def parked_only(self) -> bool:
+        """True when the queue is blocked SOLELY by load shedding: no
+        live leases and every pending unit parked below the shed floor.
+        The pool uses this to idle cheaply (or exit) instead of
+        busy-spinning on a queue that cannot progress until capacity
+        returns; backoff/quarantine holds do NOT count — those horizons
+        pass on their own."""
+        with self._lock:
+            if self._shed_floor is None:
+                return False
+            saw_parked = False
+            for e in self._units.values():
+                if e["status"] == "leased":
+                    return False
+                if e["status"] != "pending":
+                    continue
+                job = self._jobs.get(e["unit"].job_id)
+                if job is None or not self._parked_locked(job):
+                    return False
+                saw_parked = True
+            return saw_parked
+
+    def job_units_terminal(self, job_id: str) -> bool:
+        """True when every unit of ``job_id`` is done or failed — the
+        submit-only controller's poll condition (a job can be terminal-
+        FAILED while stragglers still run; the controller must wait for
+        the units, not the job status)."""
+        with self._lock:
+            job = self._jobs.get(job_id)
+            if job is None or not job["units"]:
+                return False
+            return all(self._units[u]["status"] in ("done", "failed")
+                       for u in job["units"])
+
+    def job_unit_counts(self, job_id: str) -> dict:
+        """One job's unit outcome tally — the submit-only controller's
+        live progress view while it polls a shared fleet journal."""
+        with self._lock:
+            job = self._jobs.get(job_id)
+            units = [self._units[u] for u in (job["units"] if job else ())]
+            return {
+                "total": len(units),
+                "done": sum(1 for u in units if u["status"] == "done"),
+                "failed": sum(1 for u in units
+                              if u["status"] == "failed"),
+            }
+
     # ------------------------------------------------------------- queries
     def drained(self) -> bool:
         """True when every unit is terminal (done or failed)."""
@@ -535,21 +1054,59 @@ class Scheduler:
                     "not_before": entry["not_before"]}
 
     def status(self) -> dict:
-        """Queue snapshot for the CLI / tests: per-job and aggregate unit
-        state counts."""
+        """Queue snapshot for the CLI / tests: per-job, per-tenant, and
+        aggregate unit state counts. ``counts`` keeps its original four
+        keys (a parked unit still counts ``pending``); the fleet view
+        lives in ``tenants`` / ``starved`` / ``shed_floor``."""
         with self._lock:
             counts = {"pending": 0, "leased": 0, "done": 0, "failed": 0}
+            tenants: dict[str, dict] = {}
             units = []
             for unit_id in self._order:
                 entry = self._units[unit_id]
                 counts[entry["status"]] += 1
                 lease = entry.get("lease")
+                job = self._jobs.get(entry["unit"].job_id)
+                tenant = job["tenant"] if job is not None else "default"
+                starved = (entry["status"] == "pending" and job is not None
+                           and self._parked_locked(job))
+                quarantined = (entry["status"] == "pending"
+                               and job is not None
+                               and job.get("breaker") is not None)
+                tstats = tenants.setdefault(tenant, {
+                    "pending": 0, "leased": 0, "starved": 0,
+                    "quarantined": 0, "done": 0, "failed": 0})
+                tstats[entry["status"]] += 1
+                if starved:
+                    tstats["starved"] += 1
+                if quarantined:
+                    tstats["quarantined"] += 1
                 units.append({
                     "unit_id": unit_id, "status": entry["status"],
                     "beta": entry["unit"].beta, "seed": entry["unit"].seed,
                     "attempts": entry["attempts"],
                     "worker": lease.get("worker") if lease else None,
+                    "job_id": entry["unit"].job_id, "tenant": tenant,
+                    "starved": starved,
                 })
+            for tenant, tstats in tenants.items():
+                waits = sorted(self._tenant_waits.get(tenant, ()))
+                tstats["service"] = self._service.get(tenant, 0.0)
+                tstats["weight"] = self.policy.for_tenant(tenant).weight
+                tstats["queue_wait_p50_s"] = _pctl(waits, 0.50)
+                tstats["queue_wait_p99_s"] = _pctl(waits, 0.99)
+                tstats["admission_rejected"] = (
+                    self._admission_rejects.get(tenant, 0))
+            for tenant, rejects in self._admission_rejects.items():
+                # a tenant rejected before landing any unit still shows up
+                if tenant not in tenants:
+                    tenants[tenant] = {
+                        "pending": 0, "leased": 0, "starved": 0,
+                        "quarantined": 0, "done": 0, "failed": 0,
+                        "service": self._service.get(tenant, 0.0),
+                        "weight": self.policy.for_tenant(tenant).weight,
+                        "queue_wait_p50_s": None, "queue_wait_p99_s": None,
+                        "admission_rejected": rejects}
             jobs = {
                 job_id: {
                     "status": job["status"],
@@ -557,12 +1114,25 @@ class Scheduler:
                     "retry_budget": job["spec"].retry_budget,
                     "units": len(job["units"]),
                     "name": job["spec"].name,
+                    "tenant": job["tenant"],
+                    "study": job["study"],
+                    "priority": job["priority"],
+                    "consec_fails": job["consec_fails"],
+                    "breaker_open": job.get("breaker") is not None,
+                    "breaker_trips": job["breaker_trips"],
                 }
                 for job_id, job in self._jobs.items()
             }
             return {"jobs": jobs, "units": units, "counts": counts,
+                    "tenants": tenants,
+                    "starved": self._starved_locked(),
+                    "shed_floor": self._shed_floor,
                     "drained": all(e["status"] in ("done", "failed")
                                    for e in self._units.values())}
+
+    def starved(self) -> int:
+        with self._lock:
+            return self._starved_locked()
 
     def _maybe_finish_job(self, job_id: str) -> None:
         job = self._jobs.get(job_id)
@@ -576,3 +1146,57 @@ class Scheduler:
 
     def close(self) -> None:
         self._journal.close()
+
+
+def _pctl(sorted_vals: Sequence[float], q: float) -> float | None:
+    """Nearest-rank percentile of an ascending list; None when empty."""
+    if not sorted_vals:
+        return None
+    idx = min(int(q * len(sorted_vals)), len(sorted_vals) - 1)
+    return round(float(sorted_vals[idx]), 6)
+
+
+def parked_snapshot(path: str) -> dict:
+    """Journal-only view of how parked the queue died: unit terminality
+    plus the last journaled shed floor, WITHOUT opening a writer.
+
+    The watchdog uses this to tell 'the pool exited with every runnable
+    unit starved below the shed floor' (a healthy idle fleet — relaunch
+    budget-free) apart from zero-progress crash-looping (budgeted).
+    Returns ``nonterminal`` / ``parked`` / ``terminal`` counts and the
+    ``floor``; ``parked == nonterminal > 0`` is the all-parked signal.
+    """
+    records, _ = read_journal(path)
+    status: dict[str, str] = {}
+    unit_job: dict[str, str] = {}
+    job_prio: dict[str, int] = {}
+    floor = None
+    for r in records:
+        kind = r.get("kind")
+        if kind == "job":
+            spec = r.get("spec") or {}
+            job_prio[r.get("job_id", "")] = int(spec.get("priority", 0) or 0)
+        elif kind == "unit":
+            status[r["unit_id"]] = "pending"
+            unit_job[r["unit_id"]] = r.get("job_id", "")
+        elif kind == "lease":
+            if r.get("unit_id") in status:
+                status[r["unit_id"]] = "leased"
+        elif kind in ("release", "expire"):
+            if r.get("unit_id") in status:
+                status[r["unit_id"]] = "pending"
+        elif kind == "fail":
+            if r.get("unit_id") in status:
+                status[r["unit_id"]] = ("pending" if r.get("requeued")
+                                        else "failed")
+        elif kind == "done":
+            if r.get("unit_id") in status:
+                status[r["unit_id"]] = "done"
+        elif kind == "shed":
+            floor = r.get("floor")
+    nonterminal = [u for u, s in status.items() if s in ("pending", "leased")]
+    parked = [u for u in nonterminal
+              if status[u] == "pending" and floor is not None
+              and job_prio.get(unit_job.get(u, ""), 0) < floor]
+    return {"nonterminal": len(nonterminal), "parked": len(parked),
+            "terminal": len(status) - len(nonterminal), "floor": floor}

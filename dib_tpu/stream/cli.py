@@ -218,7 +218,20 @@ def build_stream_parser() -> argparse.ArgumentParser:
                              "--study-set max_rounds=3; max_units IS the "
                              "per-drift budget cap.")
     p_auto.add_argument("--workers", type=int, default=2,
-                        help="Pool workers draining each study round.")
+                        help="Pool workers draining each study round "
+                             "(ignored with --fleet).")
+    p_auto.add_argument("--fleet", default=None,
+                        help="Submit drift studies to this external "
+                             "scheduler directory (a long-lived 'sched "
+                             "run-pool --serve' fleet) instead of "
+                             "draining them in-process "
+                             "(docs/scheduling.md).")
+    p_auto.add_argument("--tenant", default="autopilot",
+                        help="Fair-share tenant the fleet-submitted "
+                             "studies bill to (default 'autopilot').")
+    p_auto.add_argument("--priority", type=int, default=0,
+                        help="Fleet job priority for drift studies "
+                             "(lower parks first under load shedding).")
     p_auto.add_argument("--reset-breaker", action="store_true",
                         dest="reset_breaker",
                         help="Operator reset: durably close a tripped "
@@ -559,7 +572,8 @@ def _autopilot_main(args) -> int:
         }))
     pilot = DriftAutopilot(args.stream_dir, autopilot_dir, config=config,
                            telemetry=telemetry, ctx=ctx,
-                           workers=args.workers)
+                           workers=args.workers, fleet=args.fleet,
+                           tenant=args.tenant, priority=args.priority)
     pilot.ensure_config(reconfigure=args.reconfigure)
     if args.reset_breaker:
         pilot.reset_breaker()

@@ -6,7 +6,10 @@ through the scheduler, draining them with an in-process worker pool, and
 resuming exactly-once after any kill; ``status`` is a read-only replay
 of the two journals; ``report`` renders the finished study as a single
 self-contained HTML artifact plus the machine-readable record the CI
-gates read. The study directory is also the run directory:
+gates read. With ``--fleet <sched-dir>`` the study runs submit-only:
+rounds go to a long-lived external ``sched run-pool --serve`` fleet
+under ``--tenant``/``--priority`` and the controller polls the fleet's
+journal until each round drains (docs/scheduling.md). The study directory is also the run directory:
 ``study.jsonl`` + ``journal.jsonl`` + ``events.jsonl`` + ``units/``
 side by side, so ``telemetry tail|summarize|check`` see the study's
 events next to the scheduler's (docs/study.md).
@@ -104,6 +107,24 @@ def _add_config_flags(parser) -> None:
                              "or mint a fresh one).")
 
 
+def _add_fleet_flags(parser) -> None:
+    parser.add_argument("--fleet", default=None,
+                        help="Submit-only mode: the external scheduler "
+                             "directory a long-lived 'sched run-pool "
+                             "--serve' fleet drains. Rounds are "
+                             "submitted there instead of being drained "
+                             "by an in-process pool; the binding is "
+                             "journaled so a resumed study re-enters "
+                             "the same fleet (docs/scheduling.md).")
+    parser.add_argument("--tenant", default="",
+                        help="Fair-share tenant the study's fleet jobs "
+                             "bill to (default: 'default').")
+    parser.add_argument("--priority", type=int, default=0,
+                        help="Job priority on the fleet: under load "
+                             "shedding, lower-priority pending units "
+                             "park first (default 0).")
+
+
 def build_study_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="dib_tpu study",
@@ -119,14 +140,21 @@ def build_study_parser() -> argparse.ArgumentParser:
                        "before anything runs).")
     _add_study_dir(p_sub)
     _add_config_flags(p_sub)
+    _add_fleet_flags(p_sub)
 
     p_run = sub.add_parser(
         "run", help="Drive the study to its verdict (resumes a killed "
                     "controller exactly-once).")
     _add_study_dir(p_run)
     _add_config_flags(p_run)
+    _add_fleet_flags(p_run)
     p_run.add_argument("--workers", type=int, default=2,
-                       help="Pool workers draining each round.")
+                       help="Pool workers draining each round "
+                            "(ignored in --fleet submit-only mode).")
+    p_run.add_argument("--poll-s", "--poll_s", dest="poll_s", type=float,
+                       default=0.5,
+                       help="Fleet-journal poll interval in submit-only "
+                            "mode (default 0.5).")
     p_run.add_argument("--telemetry-dir", "--telemetry_dir",
                        dest="telemetry_dir", type=str, default=None,
                        help="Events stream directory (default: the "
@@ -200,10 +228,13 @@ def _submit_main(args) -> int:
 
     ctx = ensure_context("study", trace_id=args.trace_id)
     controller = StudyController(args.study_dir,
-                                 config=_config_from_args(args), ctx=ctx)
+                                 config=_config_from_args(args), ctx=ctx,
+                                 fleet=args.fleet, tenant=args.tenant,
+                                 priority=args.priority)
     state = controller.ensure_config()
     print(json.dumps({"study_dir": os.path.abspath(args.study_dir),
                       "config": state["config"],
+                      "fleet": state.get("fleet"),
                       "rounds": len(state["rounds"]),
                       "verdict": state["verdict"],
                       "trace_id": ctx.trace_id}))
@@ -230,14 +261,23 @@ def _run_main(args) -> int:
                             run_id=shared_run_id(), process_index=0,
                             ctx=ctx)
     if telemetry is not None:
-        telemetry.run_start(runtime_manifest(device_info=False, extra={
+        extra = {
             "mode": "study",
             "study_dir": os.path.abspath(args.study_dir),
             "workers": args.workers,
-        }))
+        }
+        if args.fleet:
+            extra.update(fleet=os.path.abspath(args.fleet),
+                         tenant=args.tenant or "default",
+                         priority=args.priority)
+        telemetry.run_start(runtime_manifest(device_info=False,
+                                             extra=extra))
     controller = StudyController(args.study_dir,
                                  config=_config_from_args(args),
-                                 telemetry=telemetry, ctx=ctx)
+                                 telemetry=telemetry, ctx=ctx,
+                                 fleet=args.fleet, tenant=args.tenant,
+                                 priority=args.priority,
+                                 poll_s=args.poll_s)
     try:
         state = controller.run(workers=args.workers)
     except BaseException:
@@ -273,6 +313,10 @@ def _status_main(args) -> int:
           f"budget={status['budget_spent']}"
           + (f"/{status['config']['max_units']}"
              if status.get("config") else ""))
+    fleet = status.get("fleet")
+    if fleet:
+        print(f"  fleet: {fleet['sched_dir']} "
+              f"tenant={fleet['tenant']} priority={fleet['priority']}")
     for r in status["rounds"]:
         est = r.get("estimates") or {}
         print(f"  round {r['round']:2d}  "

@@ -35,6 +35,20 @@ the scheduler journal has it, submit it otherwise), rounds drain through
 a ``WorkerPool`` while a follower thread tails the run's own event
 stream for live progress, and every round/submission/verdict is a typed
 ``study`` event on the stream (docs/observability.md).
+
+**Submit-only fleet mode** (``fleet=<sched_dir>``, docs/scheduling.md):
+instead of draining rounds with its own in-process pool, the controller
+submits each round's job to a long-lived EXTERNAL ``sched run-pool
+--serve`` fleet — jobs carry the study's ``tenant``/``study``/
+``priority`` so the fleet's fair-share scheduler arbitrates between
+concurrent studies — and polls the fleet's journal
+(``Scheduler.refresh`` + ``job_units_terminal``) until the round
+drains. Admission rejections (:class:`AdmissionRejected`, the fleet's
+bounded queue) back off for the advertised retry horizon, emitting
+``study`` events with ``action="admission_wait"``. The fleet choice is
+journaled (the ``fleet`` record) so a SIGKILLed controller resumes into
+the same fleet with the same exactly-once submission contract — the
+deterministic job name is resolved against the FLEET's journal.
 """
 
 from __future__ import annotations
@@ -44,6 +58,7 @@ import math
 import os
 import signal
 import threading
+import time
 
 import numpy as np
 
@@ -58,7 +73,9 @@ _LN2 = math.log(2.0)
 #: ``DIB_STUDY_FAULT=kill@<stage>:<round>`` — the chaos suite's injector
 #: for the exactly-once windows: stage ``intent`` kills between the
 #: round's journal append and the scheduler submit, stage ``submit``
-#: between the scheduler submit and the journal ack.
+#: between the scheduler submit and the journal ack, stage ``poll``
+#: mid-wait in submit-only fleet mode (the round is live on the fleet
+#: when the controller dies).
 FAULT_ENV = "DIB_STUDY_FAULT"
 
 
@@ -325,7 +342,7 @@ def ensemble_band_nats(points_by_seed: dict[int, dict[float, np.ndarray]],
     return max(by_channel.values()) if by_channel else None
 
 
-def unit_points(directory: str) -> tuple[dict, dict]:
+def unit_points(directory: str, job_ids=None) -> tuple[dict, dict]:
     """Fold the SCHEDULER journal into the study's data view.
 
     Returns ``(points_by_seed, counts)``: per seed, a ``{beta_end:
@@ -335,13 +352,20 @@ def unit_points(directory: str) -> tuple[dict, dict]:
     Reading the scheduler's own journal — not controller memory — is
     what makes a resumed study see exactly what actually ran, and what
     makes the budget accounting cross-checkable.
+
+    ``job_ids`` restricts the fold to those jobs' units — submit-only
+    fleet mode reads a SHARED scheduler journal, and another study's
+    units must never leak into this study's β curves.
     """
     from dib_tpu.sched.journal import read_journal
 
     records, _ = read_journal(directory)
+    keep = None if job_ids is None else {j for j in job_ids if j}
     units: dict[str, dict] = {}
     for r in records:
         if r.get("kind") == "unit":
+            if keep is not None and r.get("job_id") not in keep:
+                continue
             units[r["unit_id"]] = {"beta": float(r["beta"]),
                                    "seed": int(r["seed"]),
                                    "job_id": r.get("job_id")}
@@ -480,16 +504,30 @@ class StudyController:
     checkpoints + histories). ``telemetry`` is an ``EventWriter`` or
     None. All mutable progress state shared with the follower thread is
     guarded by ``_lock``.
+
+    ``fleet`` switches the controller to submit-only mode: rounds are
+    submitted to that EXTERNAL scheduler directory (drained by a
+    long-lived ``sched run-pool --serve`` fleet) under this study's
+    ``tenant``/``priority``, and the controller polls the fleet journal
+    until each round drains. The fleet binding is journaled on first
+    contact and replayed afterwards — like ``config``, the journal wins
+    over the constructor on resume.
     """
 
     def __init__(self, directory: str, config: StudyConfig | None = None,
                  telemetry=None, lease_s: float = 120.0,
-                 study_id: str | None = None, ctx=None):
+                 study_id: str | None = None, ctx=None,
+                 fleet: str | None = None, tenant: str = "",
+                 priority: int = 0, poll_s: float = 0.5):
         from dib_tpu.telemetry.context import from_env
 
         self.directory = directory
         self.config = config
         self.lease_s = float(lease_s)
+        self.fleet = os.path.abspath(fleet) if fleet else None
+        self.tenant = str(tenant or "")
+        self.priority = int(priority)
+        self.poll_s = float(poll_s)
         self._telemetry = telemetry
         self._lock = threading.Lock()
         self._progress = {"units_done": 0, "units_failed": 0}
@@ -525,19 +563,35 @@ class StudyController:
         state["torn"] = torn
         if state["config"] is not None:
             self.config = StudyConfig.from_dict(state["config"])
+        if state.get("fleet"):
+            # like config, the journaled fleet binding wins: a resumed
+            # controller re-enters submit-only mode against the SAME
+            # fleet even when --fleet was not re-passed
+            self.fleet = state["fleet"]["sched_dir"]
+            self.tenant = state["fleet"].get("tenant") or self.tenant
+            self.priority = int(state["fleet"].get("priority") or 0)
         return state
 
     def ensure_config(self) -> dict:
-        """Journal the config on first contact; replay it afterwards."""
+        """Journal the config (and the fleet binding, when submit-only)
+        on first contact; replay them afterwards."""
         from dib_tpu.study.journal import StudyJournal
 
         state = self.replay()
-        if state["config"] is None:
-            if self.config is None:
+        need_config = state["config"] is None
+        need_fleet = self.fleet is not None and not state.get("fleet")
+        if need_config or need_fleet:
+            if need_config and self.config is None:
                 self.config = StudyConfig()
             with StudyJournal(self.directory) as journal:
-                journal.append("config", spec=self.config.to_dict(),
-                               **self._journal_ctx())
+                if need_config:
+                    journal.append("config", spec=self.config.to_dict(),
+                                   **self._journal_ctx())
+                if need_fleet:
+                    journal.append("fleet", sched_dir=self.fleet,
+                                   tenant=self.tenant or "default",
+                                   priority=self.priority,
+                                   **self._journal_ctx())
             state = self.replay()
         return state
 
@@ -569,7 +623,9 @@ class StudyController:
         ``drain`` is injectable for tests (called with the live
         ``Scheduler`` once per round; the default drains with a
         ``WorkerPool`` of ``TrainingUnitRunner`` workers while the
-        follower thread tails the stream). Returns the final state.
+        follower thread tails the stream — or, in submit-only fleet
+        mode, polls the external fleet's journal until the round's job
+        is terminal). Returns the final state.
         """
         from dib_tpu.sched.scheduler import Scheduler
         from dib_tpu.study.journal import StudyJournal
@@ -592,8 +648,12 @@ class StudyController:
                            "scheduler journal"
                            if "job_id" not in pending[0]
                            else "mid-drain")))
+        # submit-only mode opens the EXTERNAL fleet's scheduler — a
+        # concurrent-writer peer of the fleet pool and of every other
+        # submitting controller (journal writer ids + refresh make the
+        # shared journal safe; docs/scheduling.md)
         scheduler = Scheduler(
-            self.directory, telemetry=self._telemetry,
+            self.fleet or self.directory, telemetry=self._telemetry,
             lease_s=self.lease_s,
             ctx=(self.ctx.child(f"study:{self.study_id}", origin="study")
                  if self.ctx is not None else None))
@@ -634,6 +694,8 @@ class StudyController:
                                if not r.get("done")][0]
                 if drain is not None:
                     drain(scheduler)
+                elif self.fleet is not None:
+                    self._drain_fleet(scheduler, current)
                 else:
                     self._drain(scheduler, workers)
                 self._collect(journal, state, current)
@@ -798,40 +860,64 @@ class StudyController:
         """Exactly-once submission: the scheduler journal is consulted
         for a job under this round's deterministic name — present means
         a previous controller died between submit and ack (ADOPT it);
-        absent means the decision never executed (submit it now)."""
-        from dib_tpu.sched.scheduler import JobSpec
+        absent means the decision never executed (submit it now). In
+        fleet mode the journal consulted is the FLEET's (so adoption
+        works across processes), the job carries this study's
+        tenant/priority, and an admission rejection (the fleet's bounded
+        queue) backs off for the advertised retry horizon instead of
+        failing the study."""
+        from dib_tpu.sched.scheduler import AdmissionRejected, JobSpec
 
-        existing = {
-            job.get("name"): job_id
-            for job_id, job in scheduler.status()["jobs"].items()
-        }
         job_name = current["job_name"]
-        if job_name in existing:
-            job_id = existing[job_name]
-            if self._telemetry is not None:
-                self._telemetry.mitigation(
-                    mtype="study_resumed",
-                    reason=(f"round {current['round']} job {job_id} "
-                            "adopted from the scheduler journal — the "
-                            "previous controller died between submit "
-                            "and ack; not resubmitting"))
-        else:
+        job_id = None
+        while True:
+            scheduler.refresh()
+            existing = {
+                job.get("name"): jid
+                for jid, job in scheduler.status()["jobs"].items()
+            }
+            if job_name in existing:
+                job_id = existing[job_name]
+                if self._telemetry is not None:
+                    self._telemetry.mitigation(
+                        mtype="study_resumed",
+                        reason=(f"round {current['round']} job {job_id} "
+                                "adopted from the scheduler journal — "
+                                "the previous controller died between "
+                                "submit and ack; not resubmitting"))
+                break
             spec = JobSpec(
                 betas=tuple(current["betas"]),
                 seeds=tuple(current["seeds"]),
                 train=self._unit_train_spec(),
                 retry_budget=self.config.retry_budget,
                 name=job_name,
+                tenant=self.tenant,
+                study=self.study_id,
+                priority=self.priority,
             )
-            job_id = scheduler.submit(spec)
+            try:
+                job_id = scheduler.submit(spec)
+            except AdmissionRejected as exc:
+                self._emit_study(
+                    "admission_wait", round=current["round"],
+                    tenant=exc.tenant,
+                    retry_after_s=float(exc.retry_after_s),
+                    reason=exc.reason)
+                time.sleep(max(float(exc.retry_after_s), 0.05))  # timing-ok: admission backoff pacing
+                continue
             self._maybe_fault("submit", current["round"])
+            break
         journal.append("submitted", round=current["round"], job_id=job_id,
                        **self._journal_ctx())
         self._emit_study("submit", round=current["round"], job_id=job_id,
                          betas=current["betas"], seeds=current["seeds"],
                          units=current["units"],
                          budget_spent=current["budget_spent_after"],
-                         budget_max=self.config.max_units)
+                         budget_max=self.config.max_units,
+                         **({"tenant": self.tenant or "default",
+                             "fleet": self.fleet}
+                            if self.fleet else {}))
 
     def _unit_train_spec(self) -> dict:
         spec = dict(self.config.train)
@@ -894,12 +980,42 @@ class StudyController:
             stop.set()
             follower.join(timeout=10.0)
 
+    def _drain_fleet(self, scheduler, current: dict) -> None:
+        """Submit-only drain: poll the external fleet's journal until
+        this round's job is terminal. ``refresh`` folds the fleet pool's
+        (and other studies') records from the shared journal; no worker
+        runs in this process — the fleet's workers do the training. The
+        progress follower is not started: unit outcomes land on the
+        FLEET's stream, not this study's. The ``poll`` fault stage kills
+        the controller mid-wait — the resume drill for a round that is
+        live on the fleet when its controller dies."""
+        job_id = current["job_id"]
+        done = failed = 0
+        while True:
+            scheduler.refresh()
+            self._maybe_fault("poll", current["round"])
+            counts = scheduler.job_unit_counts(job_id)
+            with self._lock:
+                self._progress["units_done"] += counts["done"] - done
+                self._progress["units_failed"] += counts["failed"] - failed
+            done, failed = counts["done"], counts["failed"]
+            if scheduler.job_units_terminal(job_id):
+                return
+            time.sleep(self.poll_s)  # timing-ok: fleet-poll pacing
+
     # ----------------------------------------------------------- collect
     def _collect(self, journal, state: dict, current: dict) -> None:
         """Fold the scheduler journal's results into this round's
         estimates and journal them durably (+ the ``round`` event)."""
         config = self.config
-        points, counts = unit_points(self.directory)
+        # fleet mode reads the SHARED journal: restrict the fold to this
+        # study's jobs so a neighbor study's units never leak into the
+        # β curves or the budget accounting
+        job_ids = ({r.get("job_id") for r in state["rounds"]
+                    if r.get("job_id")} | {current.get("job_id")}
+                   if self.fleet else None)
+        points, counts = unit_points(self.fleet or self.directory,
+                                     job_ids=job_ids)
         per_seed = [channel_crossings(pts.items(), config.threshold_nats)
                     for pts in points.values()]
         brackets = aggregate_brackets(per_seed)
@@ -945,15 +1061,31 @@ class StudyController:
         from dib_tpu.sched.journal import read_journal
 
         state = self.replay()
-        sched_records, sched_torn = read_journal(self.directory)
-        jobs = sum(1 for r in sched_records if r.get("kind") == "job")
-        units = sum(1 for r in sched_records if r.get("kind") == "unit")
-        done = {r["unit_id"] for r in sched_records
-                if r.get("kind") == "done"}
+        sched_records, sched_torn = read_journal(
+            self.fleet or self.directory)
+        if self.fleet:
+            # shared fleet journal: count only this study's jobs/units
+            my_jobs = {r.get("job_id") for r in state["rounds"]
+                       if r.get("job_id")}
+            my_units = {r["unit_id"] for r in sched_records
+                        if r.get("kind") == "unit"
+                        and r.get("job_id") in my_jobs}
+            jobs = len(my_jobs)
+            units = len(my_units)
+            done = {r["unit_id"] for r in sched_records
+                    if r.get("kind") == "done"
+                    and r.get("unit_id") in my_units}
+        else:
+            jobs = sum(1 for r in sched_records if r.get("kind") == "job")
+            units = sum(1 for r in sched_records
+                        if r.get("kind") == "unit")
+            done = {r["unit_id"] for r in sched_records
+                    if r.get("kind") == "done"}
         out = {
             "study_id": self.study_id,
             "config": (self.config.to_dict()
                        if self.config is not None else None),
+            "fleet": state.get("fleet"),
             "rounds": state["rounds"],
             "budget_spent": state["budget_spent"],
             "verdict": state["verdict"],

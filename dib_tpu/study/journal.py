@@ -14,6 +14,12 @@ Record kinds (after the envelope ``v``/``seq``/``t``/``kind``):
   - ``config``     the study spec, written once — a restarted controller
                    re-reads its own configuration instead of trusting
                    flags to be re-passed identically
+  - ``fleet``      submit-only mode, written once: the external
+                   scheduler directory rounds are submitted to plus the
+                   tenant/priority the study's jobs carry
+                   (docs/scheduling.md). A resumed controller re-enters
+                   fleet mode from this record — ``--fleet`` does not
+                   have to be re-passed.
   - ``round``      one round DECIDED: the β grid, the seeds, the unit
                    count, the deterministic scheduler job name, and the
                    budget total after this round. Appended BEFORE the
@@ -61,7 +67,8 @@ def read_study_journal(directory: str) -> tuple[list[dict], int]:
 def fold_study(records: list[dict]) -> dict:
     """Replay study records into the controller's resume state.
 
-    Returns ``{"config", "rounds", "verdict", "budget_spent"}`` where
+    Returns ``{"config", "fleet", "rounds", "verdict", "budget_spent"}``
+    where
     ``rounds`` is a list of per-round dicts carrying whatever landed:
     the decision (``betas``/``seeds``/``units``/``job_name``/
     ``budget_spent_after``), the submission ack (``job_id``), and the
@@ -72,7 +79,7 @@ def fold_study(records: list[dict]) -> dict:
     is unresolved (the exactly-once window).
     """
     state: dict = {"config": None, "rounds": [], "verdict": None,
-                   "budget_spent": 0}
+                   "budget_spent": 0, "fleet": None}
     by_round: dict[int, dict] = {}
 
     def entry(r: dict) -> dict:
@@ -86,6 +93,12 @@ def fold_study(records: list[dict]) -> dict:
         kind = r.get("kind")
         if kind == "config":
             state["config"] = dict(r.get("spec") or {})
+        elif kind == "fleet":
+            state["fleet"] = {
+                "sched_dir": r.get("sched_dir"),
+                "tenant": r.get("tenant") or "default",
+                "priority": int(r.get("priority", 0) or 0),
+            }
         elif kind == "round":
             e = entry(r)
             for key in ("betas", "seeds", "units", "job_name",

@@ -137,7 +137,8 @@ EVENT_SCHEMA: dict[str, EventKindSpec] = {
                   "healthy", "ejected", "batchers_dead",
                   "checkpoint_saved", "grace_remaining_s", "model",
                   "saved_width", "restored_width", "saved_mesh_axes",
-                  "mesh_axes", "quarantined"),
+                  "mesh_axes", "quarantined", "floor", "parked",
+                  "launch", "at_s", "returncode"),
         doc="one self-healing action (watchdog, rollback, serve health; "
             "sweep_reshard / member_backfill carry the mesh-portability "
             "fields: saved/restored sweep widths and mesh axis sizes; "
@@ -209,15 +210,21 @@ EVENT_SCHEMA: dict[str, EventKindSpec] = {
         required=("job_id", "action"),
         optional=("unit", "units", "betas", "seeds", "beta", "seed",
                   "worker", "retries", "retry_budget", "backoff_s",
-                  "reason", "error", "status"),
+                  "reason", "error", "status", "tenant", "study",
+                  "priority", "retry_after_s"),
         doc="one β-grid scheduler job transition (dib_tpu/sched): "
-            "submitted / unit_done / unit_failed / done / failed"),
+            "submitted / unit_done / unit_failed / done / failed / "
+            "rejected (admission control: the fleet queue bound refused "
+            "the submit; carries tenant + retry_after_s); submitted "
+            "jobs carry their fleet identity (tenant / study / "
+            "priority)"),
     "lease": EventKindSpec(
         required=("unit", "action"),
         optional=("job_id", "worker", "lease", "expires_s",
-                  "queue_wait_s", "attempt", "reason"),
+                  "queue_wait_s", "attempt", "reason", "tenant"),
         doc="one work-unit lease transition (dib_tpu/sched): granted / "
-            "renewed / released / expired / rejected"),
+            "renewed / released / expired / rejected; grants carry the "
+            "tenant they bill to under fair-share scheduling"),
     "publish": EventKindSpec(
         required=("publish_id", "step"),
         optional=("path", "round", "beta", "epoch", "seconds"),
@@ -239,14 +246,17 @@ EVENT_SCHEMA: dict[str, EventKindSpec] = {
         optional=("round", "job_id", "betas", "seeds", "units",
                   "estimates", "deltas_decades", "band_nats",
                   "budget_spent", "budget_max", "max_rounds", "verdict",
-                  "reason"),
+                  "reason", "tenant", "fleet", "retry_after_s"),
         doc="one closed-loop study-controller transition (dib_tpu/study): "
             "`submit` (a round's job handed to the scheduler — exactly "
             "once, by decided-set replay), `round` (a round's results "
             "collected: per-channel transition-β `estimates`, their "
             "round-over-round `deltas_decades`, the ensemble "
             "`band_nats`, budget spent), and the terminal verdict "
-            "actions `converged` / `unconverged` / `no_transitions`"),
+            "actions `converged` / `unconverged` / `no_transitions`; "
+            "submit-only rounds carry `fleet` (the shared scheduler "
+            "directory) and `tenant`, and an admission-rejected submit "
+            "retries after `retry_after_s` (action `admission_wait`)"),
     "drift": EventKindSpec(
         required=("round", "detector"),
         optional=("shift", "threshold", "action", "epoch",
@@ -275,13 +285,16 @@ EVENT_SCHEMA: dict[str, EventKindSpec] = {
             "poison gates; `reason` says which)"),
     "breaker": EventKindSpec(
         required=("action",),
-        optional=("consecutive", "threshold", "round", "via", "detail"),
-        doc="one autopilot circuit-breaker transition "
-            "(dib_tpu/autopilot): `trip` after `consecutive` failed "
-            "drift studies reached `threshold` (drift studies pause; "
-            "the stream degrades to its fixed re-anneal schedule), "
-            "`probe` (one half-open study let through), `reset` "
-            "(closed again, `via` probe/operator)"),
+        optional=("consecutive", "threshold", "round", "via", "detail",
+                  "job_id", "tenant", "unit", "until"),
+        doc="one circuit-breaker transition: `trip` after `consecutive` "
+            "failures reached `threshold`, `probe` (one half-open "
+            "attempt let through), `reset` (closed again, `via` "
+            "probe/operator). The autopilot breaker (dib_tpu/autopilot) "
+            "gates drift studies by `round`; the scheduler's per-job "
+            "breaker (dib_tpu/sched) quarantines a repeatedly-failing "
+            "job — carrying `job_id`/`tenant`/`unit`/`until` — instead "
+            "of burning the shared retry budget"),
     "link": EventKindSpec(
         required=("target",),
         optional=("relation", "plane", "source_ref", "detail"),

@@ -168,6 +168,11 @@ class LiveRunState:
         self.sched_workers: set = set()
         self.sched_stolen = 0
         self.sched_rejected = 0
+        # multi-tenant fleet view (docs/scheduling.md): per-tenant unit
+        # outcomes + queue waits folded from tenant-tagged job/lease
+        # events; the shed floor tracks load_shed mitigations
+        self.sched_tenants: dict[str, dict] = {}
+        self.sched_shed_floor = None
 
     # ------------------------------------------------------------- update
     def update(self, event: dict) -> None:
@@ -219,17 +224,31 @@ class LiveRunState:
         elif etype in ("mitigation", "fault", "alert", "transition"):
             self.counts[etype] += 1
             self.ticker.append(self._ticker_row(etype, event))
+            if etype == "mitigation":
+                mtype = event.get("mtype")
+                if mtype == "load_shed":
+                    self.sched_shed_floor = event.get("floor")
+                elif mtype == "load_shed_cleared":
+                    self.sched_shed_floor = None
         elif etype == "job":
             action = event.get("action")
+            tenant = event.get("tenant")
             if action == "submitted":
                 self.sched_submitted += event.get("units") or 0
+                if tenant:
+                    self._tenant_row(tenant)["units"] += \
+                        event.get("units") or 0
             elif action == "unit_done":
                 self.sched_units[event.get("unit", "?")] = "done"
+                if tenant:
+                    self._tenant_row(tenant)["done"] += 1
             elif action == "unit_failed":
                 # requeued: pending again (a later grant re-leases it)
                 self.sched_units.pop(event.get("unit", "?"), None)
             elif action == "failed" and event.get("unit"):
                 self.sched_units[event["unit"]] = "failed"
+            elif action == "rejected" and tenant:
+                self._tenant_row(tenant)["rejected"] += 1
         elif etype == "lease":
             action = event.get("action")
             unit = event.get("unit", "?")
@@ -237,6 +256,12 @@ class LiveRunState:
                 self.sched_units[unit] = "leased"
                 if event.get("worker"):
                     self.sched_workers.add(event["worker"])
+                if (event.get("tenant")
+                        and isinstance(event.get("queue_wait_s"),
+                                       (int, float))):
+                    waits = self._tenant_row(event["tenant"])["waits"]
+                    waits.append(float(event["queue_wait_s"]))
+                    del waits[:-256]   # bounded: a tail is a dashboard
             elif action in ("released", "expired"):
                 if self.sched_units.get(unit) == "leased":
                     self.sched_units.pop(unit, None)
@@ -246,6 +271,10 @@ class LiveRunState:
                 self.sched_rejected += 1
         elif etype == "run_end":
             self.status = event.get("status", "?")
+
+    def _tenant_row(self, name: str) -> dict:
+        return self.sched_tenants.setdefault(
+            name, {"units": 0, "done": 0, "rejected": 0, "waits": []})
 
     @staticmethod
     def _ticker_row(etype: str, event: dict) -> str:
@@ -440,7 +469,26 @@ def render_dashboard(state: LiveRunState, now: float | None = None,
             queue += f" · {state.sched_stolen} stolen"
         if state.sched_rejected:
             queue += f" · {state.sched_rejected} stale-rejected"
+        if state.sched_shed_floor is not None:
+            queue += f" · SHED floor={state.sched_shed_floor}"
         lines.append(queue[:width])
+        # per-tenant fair-share rows (only when the fleet is actually
+        # multi-tenant or admission control rejected something)
+        if (len(state.sched_tenants) > 1
+                or any(t["rejected"]
+                       for t in state.sched_tenants.values())):
+            for name in sorted(state.sched_tenants):
+                row = state.sched_tenants[name]
+                waits = sorted(row["waits"])
+                line = (f"  tenant  {name:<12} {row['units']} units / "
+                        f"{row['done']} done")
+                if waits:
+                    p50 = waits[int(0.5 * (len(waits) - 1))]
+                    p99 = waits[int(0.99 * (len(waits) - 1))]
+                    line += f" · wait p50 {p50:.2f}s p99 {p99:.2f}s"
+                if row["rejected"]:
+                    line += f" · {row['rejected']} admission-rejected"
+                lines.append(line[:width])
 
     beat = ("no heartbeat yet" if live["silent_s"] is None else
             f"beat {live['silent_s']}s ago"

@@ -493,6 +493,16 @@ def scheduler_rollup(events) -> dict | None:
     ceiling), and queue-wait percentiles from lease grants
     (``queue_wait_p99_s`` vs its ceiling — see SLO.json). None when the
     stream carries no scheduler events (ordinary runs).
+
+    Multi-tenant fleets (docs/scheduling.md) additionally get a
+    ``tenants`` block (per-tenant job/unit outcomes, admission rejects,
+    and queue-wait percentiles), ``admission_rejected`` /
+    ``admission_reject_frac`` (rejects over admission attempts — the
+    ``sched_admission_reject_ceiling`` SLO metric), and
+    ``tenant_wait_p99_ratio`` (worst tenant queue-wait p99 over the
+    fleet median — the ``sched_starvation_ceiling`` metric; a fair
+    scheduler keeps it near 1 even under a greedy-tenant flood). All
+    absent on single-tenant streams whose events carry no tenant.
     """
     jobs = [e for e in events if e.get("type") == "job"]
     leases = [e for e in events if e.get("type") == "lease"]
@@ -535,6 +545,55 @@ def scheduler_rollup(events) -> dict | None:
         out["queue_wait_p50_s"] = round(_percentile(waits, 0.5), 3)
         out["queue_wait_p99_s"] = round(_percentile(waits, 0.99), 3)
         out["queue_wait_max_s"] = round(waits[-1], 3)
+
+    # ---- multi-tenant fleet view (only when events carry tenants)
+    tenants: dict[str, dict] = {}
+
+    def tenant_entry(name: str) -> dict:
+        return tenants.setdefault(name, {
+            "jobs": 0, "units": 0, "units_done": 0, "units_failed": 0,
+            "admission_rejected": 0,
+        })
+
+    for e in jobs:
+        name = e.get("tenant")
+        if not name:
+            continue
+        action = e.get("action")
+        if action == "submitted":
+            entry = tenant_entry(name)
+            entry["jobs"] += 1
+            entry["units"] += int(e.get("units") or 0)
+        elif action == "unit_done":
+            tenant_entry(name)["units_done"] += 1
+        elif action == "unit_failed":
+            tenant_entry(name)["units_failed"] += 1
+        elif action == "rejected":
+            tenant_entry(name)["admission_rejected"] += 1
+    tenant_waits: dict[str, list[float]] = {}
+    for e in leases:
+        if (e.get("action") == "granted" and e.get("tenant")
+                and isinstance(e.get("queue_wait_s"), (int, float))):
+            tenant_waits.setdefault(e["tenant"], []).append(
+                float(e["queue_wait_s"]))
+    for name, vals in tenant_waits.items():
+        vals.sort()
+        entry = tenant_entry(name)
+        entry["queue_wait_p50_s"] = round(_percentile(vals, 0.5), 3)
+        entry["queue_wait_p99_s"] = round(_percentile(vals, 0.99), 3)
+    if tenants:
+        out["tenants"] = tenants
+        rejected = sum(t["admission_rejected"] for t in tenants.values())
+        admitted = sum(t["jobs"] for t in tenants.values())
+        out["admission_rejected"] = rejected
+        out["admission_reject_frac"] = round(
+            rejected / max(rejected + admitted, 1), 4)
+        p99s = sorted(t["queue_wait_p99_s"] for t in tenants.values()
+                      if "queue_wait_p99_s" in t)
+        if len(p99s) >= 2:
+            median = _percentile(p99s, 0.5)
+            out["tenant_wait_p99_ratio"] = round(
+                p99s[-1] / max(median, 1e-9), 3)
     return out
 
 

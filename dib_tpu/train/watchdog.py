@@ -393,6 +393,38 @@ def supervise_pool(
             # (the operator opted out of the progress gate)
             progressed = (journal_path is None
                           or _journal_terminal_count() > terminal_before)
+            if not progressed and journal_path:
+                # multi-tenant parking is NOT crash-looping: a pool whose
+                # every runnable unit is shed-starved below the capacity
+                # floor exits without finishing anything, by design — the
+                # journal's shed record proves it, so the relaunch stays
+                # budget-free instead of burning the restart budget on a
+                # healthy degraded fleet
+                try:
+                    from dib_tpu.sched.scheduler import parked_snapshot
+
+                    snap = parked_snapshot(journal_path)
+                    if snap["nonterminal"] > 0 \
+                            and snap["parked"] == snap["nonterminal"]:
+                        progressed = True
+                        mitigations.append({
+                            "type": "parked_relaunch",
+                            "launch": launches,
+                            "parked": snap["parked"],
+                            "floor": snap["floor"],
+                            "at_s": round(time.time() - t_start, 1),
+                        })
+                        log("watchdog: pool exited with all "
+                            f"{snap['parked']} runnable unit(s) parked "
+                            f"below shed floor {snap['floor']} — degraded, "
+                            "not crash-looping; relaunch is budget-free")
+                except (OSError, ValueError, KeyError) as exc:
+                    # an unreadable/half-written journal just means no
+                    # parking evidence — fall through to the normal
+                    # crash-loop accounting, but say why
+                    log("watchdog: parked-pool check failed "
+                        f"({type(exc).__name__}: {exc}); treating exit "
+                        "as zero-progress")
             if progressed:
                 free_relaunches += 1
                 quick_failures = 0

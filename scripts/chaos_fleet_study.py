@@ -1,0 +1,722 @@
+"""Chaos drills for the multi-tenant study fleet → CHAOS_FLEET_STUDY.json.
+
+The fleet claim (docs/scheduling.md): N submit-only study controllers
+share ONE long-lived ``sched run-pool --serve`` fleet through the
+journal alone — fair-share keeps a flood from starving a polite study,
+process loss anywhere (fleet pool, worker thread, controller) loses no
+unit and double-executes none, and a repeatedly-failing job is
+quarantined by the circuit breaker instead of burning the fleet's
+attention. Five drills, each through the REAL CLIs (``python -m dib_tpu
+sched|study ...`` subprocesses) with REAL SIGKILLs:
+
+  - ``fleet_kill_resume`` — the fleet pool process is SIGKILLed while
+    TWO studies are mid-drain, then relaunched. The relaunched pool
+    force-expires the dead pool's silent leases, every unit reaches done
+    exactly once, and both studies converge with per-(β, seed) histories
+    bit-identical to an uninterrupted baseline.
+  - ``greedy_flood_fairness`` — a greedy tenant floods the queue (and
+    overflows its admission cap: explicit reject + retry horizon, exit
+    75) while a polite study runs. Starvation-freedom is quantitative:
+    the polite tenant's queue-wait p99 over the fleet median p99
+    (``fairness_ratio``) stays inside the committed
+    ``sched_starvation_ceiling`` budget, and the polite tenant is never
+    admission-rejected.
+  - ``controller_kill_adopt`` — ``DIB_STUDY_FAULT=kill@poll:0`` SIGKILLs
+    a controller mid-poll (its round live on the fleet). The restart
+    must ADOPT the live job from the fleet journal (``study_resumed``
+    mitigation, job count unchanged) — resubmitting here is the
+    double-spend this suite exists to catch.
+  - ``worker_loss_degrade`` — ``DIB_POOL_FAULT=kill_worker@1`` kills one
+    fleet worker mid-lease. The reaper steals the unit, capacity
+    feedback parks the low-priority filler (``shed`` floor journaled,
+    ``starved`` visible) while the high-priority study keeps draining,
+    and the floor clears once the high class drains — zero lost units
+    in either class.
+  - ``breaker_trip_probe`` — a poisoned job (its unit dirs blocked by
+    plain files) fails repeatedly: the breaker trips (journaled), the
+    healthy neighbor study converges meanwhile, and after the poison is
+    removed a half-open probe recovers the job to completion.
+
+Every drill asserts the three fleet invariants (``zero_lost_units`` /
+``no_double_execution`` / ``bit_identical_histories``) from the journals
+plus the unit histories. Committed as ``CHAOS_FLEET_STUDY.json``,
+validated per-row by ``scripts/check_run_artifacts.py`` (the
+greedy-flood row's ``fairness_ratio`` against the committed SLO budget).
+
+Usage::
+
+    python scripts/chaos_fleet_study.py --out CHAOS_FLEET_STUDY.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import shutil
+import signal
+import statistics
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+METRIC = "chaos_fleet_study_matrix"
+
+#: The proven small-but-real study shape (scripts/chaos_study.py): 4-β
+#: grid, one seed, one refinement round expected before convergence.
+#: Every drill study uses the SAME shape, so one uninterrupted baseline
+#: run yields the per-(β, seed) history fingerprints every interrupted
+#: study must reproduce bit-identically.
+STUDY_FLAGS = [
+    "--grid", "0.03", "30", "4", "--seeds", "0",
+    "--threshold-nats", "0.1", "--tolerance-decades", "0.3",
+    "--max-bracket-decades", "2.0",
+    "--min-refine-rounds", "1", "--max-rounds", "3", "--max-units", "20",
+    "--refine-num", "3",
+    "--set", "steps_per_epoch=16", "--set", "num_annealing_epochs=20",
+    "--set", "batch_size=128", "--set", "chunk_epochs=11",
+]
+
+
+def _log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _env(extra: dict | None = None) -> dict:
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    env.pop("DIB_STUDY_FAULT", None)
+    env.pop("DIB_POOL_FAULT", None)
+    if extra:
+        env.update(extra)
+    return env
+
+
+def _sched(args: list[str], timeout: float = 120.0,
+           env_extra: dict | None = None) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, "-m", "dib_tpu", "sched", *args],
+        env=_env(env_extra), capture_output=True, text=True,
+        timeout=timeout)
+
+
+def _start_fleet(sched_dir: str, workers: int = 2, lease_s: float = 8.0,
+                 env_extra: dict | None = None) -> subprocess.Popen:
+    """Launch the long-lived external fleet: ``sched run-pool --serve``."""
+    os.makedirs(sched_dir, exist_ok=True)
+    log = open(os.path.join(sched_dir, "pool.log"), "ab")
+    return subprocess.Popen(
+        [sys.executable, "-m", "dib_tpu", "sched", "run-pool",
+         "--sched-dir", sched_dir, "--workers", str(workers),
+         "--lease-s", str(lease_s), "--duration-s", "1800", "--serve",
+         "--preempt_grace_s", "0"],
+        env=_env(env_extra), stdout=log, stderr=log)
+
+
+def _start_study(study_dir: str, fleet: str, tenant: str,
+                 priority: int = 0, fault: str | None = None,
+                 configure: bool = True) -> subprocess.Popen:
+    """Launch one submit-only study controller against the fleet."""
+    cmd = [sys.executable, "-m", "dib_tpu", "study", "run",
+           "--study-dir", study_dir]
+    if configure:
+        cmd += STUDY_FLAGS + ["--fleet", fleet, "--tenant", tenant,
+                              "--priority", str(priority)]
+    cmd += ["--poll-s", "0.2"]
+    os.makedirs(study_dir, exist_ok=True)
+    log = open(os.path.join(study_dir, "study.log"), "ab")
+    extra = {"DIB_STUDY_FAULT": fault} if fault else None
+    return subprocess.Popen(cmd, env=_env(extra), stdout=log, stderr=log)
+
+
+def _wait_proc(proc: subprocess.Popen, timeout: float) -> int | None:
+    """Wait for a subprocess; on timeout SIGKILL it and return None."""
+    try:
+        return proc.wait(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait()
+        return None
+
+
+def _kill_hard(proc: subprocess.Popen | None) -> None:
+    if proc is not None and proc.poll() is None:
+        proc.kill()
+        proc.wait()
+
+
+def _wait_until(predicate, timeout: float, poll_s: float = 0.2) -> bool:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(poll_s)
+    return False
+
+
+def _tail(path: str, n: int = 500) -> str:
+    try:
+        with open(path, "r", errors="replace") as f:
+            return f.read()[-n:]
+    except OSError:
+        return ""
+
+
+# ----------------------------------------------------------- journal views
+def _fleet_records(fleet_dir: str) -> list[dict]:
+    from dib_tpu.sched.journal import read_journal
+
+    records, _ = read_journal(fleet_dir)
+    return records
+
+
+def _done_count(fleet_dir: str) -> int:
+    return sum(1 for r in _fleet_records(fleet_dir)
+               if r.get("kind") == "done")
+
+
+def _study_state(study_dir: str) -> dict:
+    from dib_tpu.study.journal import fold_study, read_study_journal
+
+    records, _ = read_study_journal(study_dir)
+    return fold_study(records)
+
+
+def _job_units(records: list[dict], job_ids: set) -> dict:
+    """unit_id -> (beta, seed) for the given jobs."""
+    return {r["unit_id"]: (float(r["beta"]), int(r["seed"]))
+            for r in records if r.get("kind") == "unit"
+            and r.get("job_id") in job_ids}
+
+
+def _history_fingerprints(sched_dir: str,
+                          job_ids: set | None = None) -> dict:
+    """{(beta_repr, seed): sha256-of-history} for every done unit —
+    the bit-identity evidence. Content-hashed, so the comparison is
+    independent of where the history file lives."""
+    import numpy as np
+
+    records = _fleet_records(sched_dir)
+    if job_ids is None:
+        job_ids = {r["job_id"] for r in records if r.get("kind") == "job"}
+    units = _job_units(records, job_ids)
+    out: dict = {}
+    for r in records:
+        if r.get("kind") != "done" or r.get("unit_id") not in units:
+            continue
+        path = (r.get("result") or {}).get("history_path")
+        if not path or not os.path.exists(path):
+            continue
+        digest = hashlib.sha256()
+        with np.load(path) as z:
+            for key in sorted(z.files):
+                digest.update(key.encode())
+                digest.update(np.ascontiguousarray(z[key]).tobytes())
+        beta, seed = units[r["unit_id"]]
+        out[(f"{beta:.12g}", seed)] = digest.hexdigest()
+    return out
+
+
+def _study_invariants(study_dir: str, fleet_dir: str,
+                      baseline: dict | None) -> dict:
+    """The three fleet invariants for ONE submit-only study, from the
+    study journal (decided rounds) crossed with the FLEET journal (what
+    actually ran) and the unit histories (bit identity)."""
+    state = _study_state(study_dir)
+    rounds = state["rounds"]
+    names = [r.get("job_name") for r in rounds]
+    records = _fleet_records(fleet_dir)
+    name_counts: dict[str, int] = {}
+    my_jobs: set = set()
+    for r in records:
+        if r.get("kind") == "job":
+            name = (r.get("spec") or {}).get("name")
+            if name in names:
+                name_counts[name] = name_counts.get(name, 0) + 1
+                my_jobs.add(r["job_id"])
+    units = _job_units(records, my_jobs)
+    done_counts: dict[str, int] = {}
+    for r in records:
+        if r.get("kind") == "done" and r.get("unit_id") in units:
+            done_counts[r["unit_id"]] = done_counts.get(r["unit_id"], 0) + 1
+    decided = sum(r.get("units") or 0 for r in rounds)
+    zero_lost = (bool(rounds) and len(units) == decided
+                 and all(done_counts.get(u) == 1 for u in units)
+                 and all(r.get("done") for r in rounds)
+                 and state["verdict"] is not None)
+    no_double = (bool(done_counts)
+                 and all(c == 1 for c in done_counts.values())
+                 and all(name_counts.get(n) == 1 for n in names))
+    fingerprints = _history_fingerprints(fleet_dir, my_jobs)
+    bit_identical = baseline is not None and fingerprints == baseline
+    return {
+        "zero_lost_units": bool(zero_lost),
+        "no_double_execution": bool(no_double),
+        "bit_identical_histories": bool(bit_identical),
+        "rounds": len(rounds),
+        "jobs": len(my_jobs),
+        "units": len(units),
+        "histories_compared": len(fingerprints),
+        "verdict": (state["verdict"] or {}).get("verdict"),
+    }
+
+
+def _fleet_tenants(fleet_dir: str) -> dict:
+    """Per-tenant queue stats from a read-only replay of the fleet."""
+    from dib_tpu.sched.scheduler import Scheduler
+
+    scheduler = Scheduler(fleet_dir)
+    try:
+        return scheduler.status().get("tenants") or {}
+    finally:
+        scheduler.close()
+
+
+def _run_baseline(workdir: str) -> dict:
+    """One uninterrupted LOCAL-mode study: the per-(β, seed) history
+    fingerprints every interrupted fleet study must reproduce."""
+    study_dir = os.path.join(workdir, "baseline")
+    _log("baseline: uninterrupted local-mode study")
+    proc = subprocess.run(
+        [sys.executable, "-m", "dib_tpu", "study", "run",
+         "--study-dir", study_dir, *STUDY_FLAGS],
+        env=_env(), capture_output=True, text=True, timeout=1200)
+    state = _study_state(study_dir)
+    verdict = (state["verdict"] or {}).get("verdict")
+    if proc.returncode != 0 or verdict != "converged":
+        raise RuntimeError(
+            f"baseline study failed: rc={proc.returncode} "
+            f"verdict={verdict}\n{(proc.stderr or '')[-500:]}")
+    return _history_fingerprints(study_dir)
+
+
+# ----------------------------------------------------------------- drills
+def drill_fleet_kill_resume(workdir: str, baseline: dict) -> dict:
+    """SIGKILL the shared fleet pool while two studies are mid-drain;
+    a relaunched pool must adopt the queue (stealing the dead pool's
+    silent leases) and both studies must converge bit-identically."""
+    fleet = os.path.join(workdir, "fleet_kill", "fleet")
+    _log("drill fleet_kill_resume: SIGKILL the fleet mid-multi-study")
+    t0 = time.time()
+    pool = _start_fleet(fleet)
+    alice = _start_study(os.path.join(workdir, "fleet_kill", "alice"),
+                         fleet, "alice")
+    bob = _start_study(os.path.join(workdir, "fleet_kill", "bob"),
+                       fleet, "bob")
+    pool2 = None
+    try:
+        # let the fleet get real work done, then kill it mid-flight
+        armed = _wait_until(lambda: _done_count(fleet) >= 2, timeout=600)
+        mid_flight = alice.poll() is None and bob.poll() is None
+        done_at_kill = _done_count(fleet)
+        pool.send_signal(signal.SIGKILL)
+        pool_rc = pool.wait()
+        killed = pool_rc == -signal.SIGKILL
+        pool2 = _start_fleet(fleet)
+        rc_a = _wait_proc(alice, timeout=1200)
+        rc_b = _wait_proc(bob, timeout=1200)
+    finally:
+        _kill_hard(pool)
+        _kill_hard(pool2)
+        _kill_hard(alice)
+        _kill_hard(bob)
+    inv_a = _study_invariants(os.path.join(workdir, "fleet_kill", "alice"),
+                              fleet, baseline)
+    inv_b = _study_invariants(os.path.join(workdir, "fleet_kill", "bob"),
+                              fleet, baseline)
+    merged = {k: bool(inv_a[k] and inv_b[k])
+              for k in ("zero_lost_units", "no_double_execution",
+                        "bit_identical_histories")}
+    ok = (armed and mid_flight and killed and rc_a == 0 and rc_b == 0
+          and inv_a["verdict"] == "converged"
+          and inv_b["verdict"] == "converged"
+          and all(merged.values()))
+    if not ok:
+        _log(f"  fleet_kill_resume FAILED: armed={armed} "
+             f"mid_flight={mid_flight} killed={killed} rc=({rc_a},{rc_b}) "
+             f"inv_a={inv_a} inv_b={inv_b}\n  pool log: "
+             f"{_tail(os.path.join(fleet, 'pool.log'))}")
+    return {
+        "drill": "fleet_kill_resume", "kind": "fleet_kill", "ok": bool(ok),
+        "fault": "SIGKILL run-pool --serve mid-drain",
+        "pool_killed_by_sigkill": bool(killed),
+        "studies_mid_flight_at_kill": bool(mid_flight),
+        "units_done_at_kill": done_at_kill,
+        "study_rcs": [rc_a, rc_b],
+        **merged,
+        "studies": {"alice": inv_a, "bob": inv_b},
+        "wall_s": round(time.time() - t0, 1),
+    }
+
+
+def drill_greedy_flood_fairness(workdir: str, baseline: dict) -> dict:
+    """A greedy tenant floods the fleet (overflowing its admission cap)
+    while a polite study runs to convergence; fair share must bound the
+    polite tenant's queue waits and admission must reject the overflow
+    explicitly — never the polite study."""
+    from dib_tpu.train.preempt import PREEMPT_EXIT_CODE
+
+    fleet = os.path.join(workdir, "flood", "fleet")
+    polite_dir = os.path.join(workdir, "flood", "polite")
+    _log("drill greedy_flood_fairness: greedy flood vs polite study")
+    t0 = time.time()
+    os.makedirs(fleet, exist_ok=True)
+    _sched(["policy", "--sched-dir", fleet, "--tenant", "greedy=1::8",
+            "--admission-retry-s", "0.5"])
+    # flood BEFORE the pool starts so the queue is saturated when the
+    # polite study arrives; the third job overflows greedy's pending cap
+    flood_rcs = []
+    for i in range(3):
+        cp = _sched(["submit", "--sched-dir", fleet, "--grid", "0.03",
+                     "30", "4", "--seeds", "0", "--tenant", "greedy",
+                     "--name", f"flood-{i}"])
+        flood_rcs.append(cp.returncode)
+    rejected = sum(1 for rc in flood_rcs if rc == PREEMPT_EXIT_CODE)
+    pool = _start_fleet(fleet)
+    polite = _start_study(polite_dir, fleet, "polite")
+    try:
+        rc_polite = _wait_proc(polite, timeout=1200)
+        # let the surviving flood drain too (tiny default-spec units)
+        greedy_jobs = {r["job_id"] for r in _fleet_records(fleet)
+                       if r.get("kind") == "job"
+                       and (r.get("spec") or {}).get("tenant") == "greedy"}
+
+        def flood_drained() -> bool:
+            records = _fleet_records(fleet)
+            units = _job_units(records, greedy_jobs)
+            done = {r["unit_id"] for r in records
+                    if r.get("kind") == "done" and r["unit_id"] in units}
+            return len(done) == len(units)
+
+        drained = _wait_until(flood_drained, timeout=300)
+    finally:
+        _kill_hard(pool)
+        _kill_hard(polite)
+    tenants = _fleet_tenants(fleet)
+    p99s = {name: t.get("queue_wait_p99_s")
+            for name, t in tenants.items()
+            if t.get("queue_wait_p99_s") is not None}
+    fairness_ratio = None
+    if "polite" in p99s and len(p99s) >= 2:
+        median = statistics.median(p99s.values())
+        fairness_ratio = round(p99s["polite"] / max(median, 1e-9), 3)
+    polite_rejects = (tenants.get("polite") or {}).get(
+        "admission_rejected", 0)
+    inv = _study_invariants(polite_dir, fleet, baseline)
+    ok = (rc_polite == 0 and inv["verdict"] == "converged"
+          and rejected >= 1 and polite_rejects == 0 and drained
+          and fairness_ratio is not None and fairness_ratio <= 10.0
+          and inv["zero_lost_units"] and inv["no_double_execution"]
+          and inv["bit_identical_histories"])
+    if not ok:
+        _log(f"  greedy_flood_fairness FAILED: rc={rc_polite} inv={inv} "
+             f"rejected={rejected} polite_rejects={polite_rejects} "
+             f"drained={drained} ratio={fairness_ratio} p99s={p99s}\n"
+             f"  study log: {_tail(os.path.join(polite_dir, 'study.log'))}")
+    return {
+        "drill": "greedy_flood_fairness", "kind": "tenant_flood",
+        "ok": bool(ok),
+        "fault": "greedy tenant floods past its admission cap",
+        "greedy_submit_rcs": flood_rcs,
+        "greedy_admission_rejects": rejected,
+        "polite_admission_rejects": polite_rejects,
+        "fairness_ratio": fairness_ratio,
+        "queue_wait_p99_s_by_tenant": p99s,
+        "flood_drained": bool(drained),
+        **{k: inv[k] for k in ("zero_lost_units", "no_double_execution",
+                               "bit_identical_histories", "rounds", "jobs",
+                               "units", "verdict")},
+        "wall_s": round(time.time() - t0, 1),
+    }
+
+
+def drill_controller_kill_adopt(workdir: str, baseline: dict) -> dict:
+    """SIGKILL a submit-only controller mid-poll (its round live on the
+    fleet); the restart must adopt the live job exactly-once from the
+    fleet journal and converge."""
+    fleet = os.path.join(workdir, "ctl_kill", "fleet")
+    study_dir = os.path.join(workdir, "ctl_kill", "carol")
+    _log("drill controller_kill_adopt: SIGKILL controller mid-poll")
+    t0 = time.time()
+    pool = _start_fleet(fleet)
+    first = _start_study(study_dir, fleet, "carol",
+                         fault="kill@poll:0")
+    try:
+        rc1 = _wait_proc(first, timeout=600)
+        killed = rc1 == -signal.SIGKILL
+        # the kill window: round 0 acked (job live on the fleet), not done
+        mid = _study_state(study_dir)
+        open_rounds = [r for r in mid["rounds"] if not r.get("done")]
+        window_ok = len(open_rounds) == 1 and "job_id" in open_rounds[0]
+        name = open_rounds[0].get("job_name") if open_rounds else None
+        jobs_mid = sum(1 for r in _fleet_records(fleet)
+                       if r.get("kind") == "job"
+                       and (r.get("spec") or {}).get("name") == name)
+        second = _start_study(study_dir, fleet, "carol", configure=False)
+        rc2 = _wait_proc(second, timeout=1200)
+    finally:
+        _kill_hard(pool)
+        _kill_hard(first)
+    inv = _study_invariants(study_dir, fleet, baseline)
+    jobs_after = sum(1 for r in _fleet_records(fleet)
+                     if r.get("kind") == "job"
+                     and (r.get("spec") or {}).get("name") == name)
+    from dib_tpu.telemetry import summarize
+
+    summary = summarize(study_dir)
+    mitigations = summary.get("mitigations") or {}
+    faults = summary.get("faults") or {}
+    resumed = mitigations.get("study_resumed", 0) >= 1
+    detected = (faults.get("injected") == 1
+                and faults.get("detected") == 1)
+    ok = (killed and window_ok and jobs_mid == 1 and jobs_after == 1
+          and rc2 == 0 and inv["verdict"] == "converged" and resumed
+          and detected and inv["zero_lost_units"]
+          and inv["no_double_execution"]
+          and inv["bit_identical_histories"])
+    if not ok:
+        _log(f"  controller_kill_adopt FAILED: killed={killed} "
+             f"window_ok={window_ok} jobs=({jobs_mid},{jobs_after}) "
+             f"rc2={rc2} resumed={resumed} detected={detected} inv={inv}\n"
+             f"  study log: {_tail(os.path.join(study_dir, 'study.log'))}")
+    return {
+        "drill": "controller_kill_adopt", "kind": "study_kill",
+        "ok": bool(ok),
+        "fault": "kill@poll:0",
+        "killed_by_sigkill": bool(killed),
+        "kill_window_state": {
+            "open_rounds": len(open_rounds),
+            "round_acked": bool(window_ok),
+            "jobs_under_round_name": jobs_mid,
+        },
+        "resume_rc": rc2,
+        "jobs_under_round_name_after": jobs_after,
+        "study_resumed_mitigations": mitigations.get("study_resumed", 0),
+        "fault_detected": bool(detected),
+        **{k: inv[k] for k in ("zero_lost_units", "no_double_execution",
+                               "bit_identical_histories", "rounds", "jobs",
+                               "units", "verdict")},
+        "wall_s": round(time.time() - t0, 1),
+    }
+
+
+def drill_worker_loss_degrade(workdir: str, baseline: dict) -> dict:
+    """Kill one fleet worker mid-lease: the reaper steals its unit,
+    capacity feedback parks the low-priority filler (journaled shed
+    floor, visible starvation) while the high-priority study drains,
+    and the floor clears once the high class is done — nothing lost."""
+    fleet = os.path.join(workdir, "worker_loss", "fleet")
+    study_dir = os.path.join(workdir, "worker_loss", "erin")
+    _log("drill worker_loss_degrade: kill one fleet worker mid-lease")
+    t0 = time.time()
+    os.makedirs(fleet, exist_ok=True)
+    # a low-priority filler class that must PARK when capacity halves
+    filler = _sched(["submit", "--sched-dir", fleet, "--grid", "0.1",
+                     "10", "3", "--seeds", "0", "--tenant", "filler",
+                     "--priority", "0", "--name", "filler"])
+    filler_job = (json.loads(filler.stdout.strip().splitlines()[-1])
+                  ["job_id"] if filler.returncode == 0 else None)
+    # the study's high-priority job must be QUEUED before the pool (and
+    # its worker-kill fault) starts: the shed floor only parks the
+    # filler class while a higher class still has runnable units
+    study = _start_study(study_dir, fleet, "erin", priority=1)
+    _wait_until(lambda: any(
+        r.get("kind") == "job"
+        and (r.get("spec") or {}).get("tenant") == "erin"
+        for r in _fleet_records(fleet)), timeout=120)
+    pool = _start_fleet(fleet, env_extra={"DIB_POOL_FAULT":
+                                          "kill_worker@1"})
+    try:
+        rc = _wait_proc(study, timeout=1200)
+
+        def filler_done() -> bool:
+            records = _fleet_records(fleet)
+            units = _job_units(records, {filler_job})
+            done = {r["unit_id"] for r in records
+                    if r.get("kind") == "done" and r["unit_id"] in units}
+            return bool(units) and len(done) == len(units)
+
+        filler_drained = _wait_until(filler_done, timeout=300)
+    finally:
+        _kill_hard(pool)
+        _kill_hard(study)
+    records = _fleet_records(fleet)
+    sheds = [r for r in records if r.get("kind") == "shed"]
+    shed_on = any(r.get("floor") == 1 for r in sheds)
+    shed_cleared = shed_on and sheds[-1].get("floor") is None
+    expires = sum(1 for r in records if r.get("kind") == "expire")
+    from dib_tpu.telemetry import summarize
+
+    mitigations = summarize(fleet).get("mitigations") or {}
+    worker_dead = mitigations.get("worker_dead", 0)
+    inv = _study_invariants(study_dir, fleet, baseline)
+    ok = (rc == 0 and inv["verdict"] == "converged" and worker_dead >= 1
+          and expires >= 1 and shed_on and shed_cleared and filler_drained
+          and inv["zero_lost_units"] and inv["no_double_execution"]
+          and inv["bit_identical_histories"])
+    if not ok:
+        _log(f"  worker_loss_degrade FAILED: rc={rc} "
+             f"worker_dead={worker_dead} expires={expires} "
+             f"shed_on={shed_on} cleared={shed_cleared} "
+             f"filler_drained={filler_drained} inv={inv}\n  pool log: "
+             f"{_tail(os.path.join(fleet, 'pool.log'))}")
+    return {
+        "drill": "worker_loss_degrade", "kind": "worker_loss",
+        "ok": bool(ok),
+        "fault": "kill_worker@1",
+        "worker_dead_mitigations": worker_dead,
+        "leases_stolen": expires,
+        "shed_floor_journaled": bool(shed_on),
+        "shed_floor_cleared": bool(shed_cleared),
+        "filler_drained": bool(filler_drained),
+        **{k: inv[k] for k in ("zero_lost_units", "no_double_execution",
+                               "bit_identical_histories", "rounds", "jobs",
+                               "units", "verdict")},
+        "wall_s": round(time.time() - t0, 1),
+    }
+
+
+def drill_breaker_trip_probe(workdir: str, baseline: dict) -> dict:
+    """A poisoned job fails repeatedly until the per-job circuit breaker
+    quarantines it (journaled trip) while a healthy study converges;
+    removing the poison lets a half-open probe recover the job."""
+    fleet = os.path.join(workdir, "breaker", "fleet")
+    study_dir = os.path.join(workdir, "breaker", "dave")
+    _log("drill breaker_trip_probe: poisoned job vs healthy study")
+    t0 = time.time()
+    os.makedirs(fleet, exist_ok=True)
+    _sched(["policy", "--sched-dir", fleet, "--breaker-threshold", "2",
+            "--breaker-probe-after-s", "1.5"])
+    poisoned = _sched(["submit", "--sched-dir", fleet, "--betas", "0.1",
+                       "1.0", "--seeds", "0", "--tenant", "mallory",
+                       "--retry-budget", "12", "--name", "poisoned"])
+    poison_job = json.loads(
+        poisoned.stdout.strip().splitlines()[-1])["job_id"]
+    # poison: a plain FILE where each unit's work dir must go — the
+    # runner's makedirs raises until the file is removed
+    unit_ids = list(_job_units(_fleet_records(fleet), {poison_job}))
+    os.makedirs(os.path.join(fleet, "units"), exist_ok=True)
+    blockers = []
+    for uid in unit_ids:
+        path = os.path.join(fleet, "units", uid.replace("/", "__"))
+        with open(path, "w") as f:
+            f.write("poison")
+        blockers.append(path)
+    pool = _start_fleet(fleet)
+    study = _start_study(study_dir, fleet, "dave")
+    try:
+        def tripped() -> bool:
+            return any(r.get("kind") == "breaker"
+                       and r.get("action") == "trip"
+                       and r.get("job_id") == poison_job
+                       for r in _fleet_records(fleet))
+
+        trip_seen = _wait_until(tripped, timeout=180)
+        for path in blockers:
+            os.unlink(path)
+
+        def poison_recovered() -> bool:
+            records = _fleet_records(fleet)
+            done = {r["unit_id"] for r in records
+                    if r.get("kind") == "done"
+                    and r.get("unit_id") in set(unit_ids)}
+            return len(done) == len(unit_ids)
+
+        recovered = _wait_until(poison_recovered, timeout=300)
+        rc = _wait_proc(study, timeout=1200)
+    finally:
+        _kill_hard(pool)
+        _kill_hard(study)
+    records = _fleet_records(fleet)
+    breaker = [r for r in records if r.get("kind") == "breaker"
+               and r.get("job_id") == poison_job]
+    trips = sum(1 for r in breaker if r.get("action") == "trip")
+    probes = sum(1 for r in breaker if r.get("action") == "probe")
+    resets = sum(1 for r in breaker if r.get("action") == "reset")
+    inv = _study_invariants(study_dir, fleet, baseline)
+    ok = (trip_seen and recovered and rc == 0 and trips >= 1
+          and probes >= 1 and resets >= 1
+          and inv["verdict"] == "converged" and inv["zero_lost_units"]
+          and inv["no_double_execution"]
+          and inv["bit_identical_histories"])
+    if not ok:
+        _log(f"  breaker_trip_probe FAILED: trip_seen={trip_seen} "
+             f"recovered={recovered} rc={rc} trips={trips} "
+             f"probes={probes} resets={resets} inv={inv}\n  pool log: "
+             f"{_tail(os.path.join(fleet, 'pool.log'))}")
+    return {
+        "drill": "breaker_trip_probe", "kind": "circuit_breaker",
+        "ok": bool(ok),
+        "fault": "unit work dirs blocked by plain files",
+        "breaker_trips": trips,
+        "breaker_probes": probes,
+        "breaker_resets": resets,
+        "poisoned_job_recovered": bool(recovered),
+        **{k: inv[k] for k in ("zero_lost_units", "no_double_execution",
+                               "bit_identical_histories", "rounds", "jobs",
+                               "units", "verdict")},
+        "wall_s": round(time.time() - t0, 1),
+    }
+
+
+# ----------------------------------------------------------------- driver
+def run_drills(workdir: str | None = None) -> dict:
+    owned = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="dib_chaos_fleet_")
+    matrix: list[dict] = []
+    try:
+        baseline = _run_baseline(workdir)
+        matrix.append(drill_fleet_kill_resume(workdir, baseline))
+        matrix.append(drill_greedy_flood_fairness(workdir, baseline))
+        matrix.append(drill_controller_kill_adopt(workdir, baseline))
+        matrix.append(drill_worker_loss_degrade(workdir, baseline))
+        matrix.append(drill_breaker_trip_probe(workdir, baseline))
+    finally:
+        if owned:
+            shutil.rmtree(workdir, ignore_errors=True)
+    passed = sum(1 for d in matrix if d["ok"])
+    lost = sum(1 for d in matrix if d.get("zero_lost_units") is not True)
+    return {
+        "metric": METRIC,
+        "value": passed,
+        "unit": "drills_passed",
+        "total": len(matrix),
+        "quick": False,
+        "all_passed": passed == len(matrix),
+        "lost_unit_drills": lost,
+        "matrix": matrix,
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--out", default=None,
+                        help="Also write the JSON record to this path.")
+    parser.add_argument("--workdir", default=None,
+                        help="Keep drill artifacts here (default: a temp "
+                             "dir, removed afterwards).")
+    parser.add_argument("--runs-root", "--runs_root", dest="runs_root",
+                        default=None,
+                        help="Register this run in the fleet registry "
+                             "(<runs-root>/index.jsonl; default: "
+                             "DIB_RUNS_ROOT when set, else off).")
+    args = parser.parse_args(argv)
+    record = run_drills(workdir=args.workdir)
+    print(json.dumps(record), flush=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(json.dumps(record, indent=1) + "\n")
+    from dib_tpu.telemetry.registry import register_drill_record
+
+    if register_drill_record(record, root=args.runs_root, extra={
+            "lost_unit_drills": record["lost_unit_drills"]}) is not None:
+        _log("chaos_fleet_study: registered in the fleet registry")
+    return 0 if record["all_passed"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -53,8 +53,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 ARTIFACT_GLOBS = ("BENCH_*.json", "NORTHSTAR_*.json", "FAULT_DRILL*.json",
                   "CHAOS_SCHED*.json", "CHAOS_STREAM*.json",
                   "CHAOS_SDC*.json", "CHAOS_STUDY*.json",
-                  "CHAOS_AUTOPILOT*.json", "STUDY_*.json",
-                  "FLEET_*.json")
+                  "CHAOS_AUTOPILOT*.json", "CHAOS_FLEET*.json",
+                  "STUDY_*.json", "FLEET_*.json")
 
 # Null-value excuses: at least one must be present when value is null.
 _NULL_VALUE_EXCUSES = ("degraded", "error", "per_run_minutes", "runs_completed")
@@ -314,6 +314,105 @@ def _check_chaos_autopilot_matrix(record: dict,
             "'duplicate_studies' must be present and exactly 0 "
             "(the exactly-once drift→study contract) — got "
             f"{record.get('duplicate_studies')!r}")
+
+
+# Drills every committed full chaos_fleet_study_matrix record must carry
+# (scripts/chaos_fleet_study.py): the multi-tenant study fleet under
+# process loss, floods, and repeated failure (docs/scheduling.md,
+# docs/robustness.md "Fault registry").
+_REQUIRED_CHAOS_FLEET_STUDY_DRILLS = (
+    "fleet_kill_resume", "greedy_flood_fairness", "controller_kill_adopt",
+    "worker_loss_degrade", "breaker_trip_probe",
+)
+
+#: The three fleet invariants asserted per drill row: no submitted unit
+#: was lost across a kill (every one reached done/failed exactly once),
+#: no (job, β, seed) unit's work landed twice, and every interrupted
+#: study's per-(β, seed) histories are bit-identical to an
+#: uninterrupted baseline's.
+_CHAOS_FLEET_STUDY_INVARIANTS = ("zero_lost_units", "no_double_execution",
+                                 "bit_identical_histories")
+
+
+def _check_chaos_fleet_study_matrix(record: dict,
+                                    problems: list[str]) -> None:
+    """chaos_fleet_study_matrix-specific schema: every drill present
+    (full records), zero failures, the three fleet invariants asserted
+    per row, and the greedy-flood row's quantitative fairness evidence —
+    the polite tenant's queue-wait p99 over the fleet median, bounded by
+    the committed sched_starvation_ceiling budget."""
+    _check_chaos_matrix(
+        record, problems,
+        required_drills=_REQUIRED_CHAOS_FLEET_STUDY_DRILLS,
+        invariants=_CHAOS_FLEET_STUDY_INVARIANTS,
+        rerun_hint="scripts/chaos_fleet_study.py --out "
+                   "CHAOS_FLEET_STUDY.json")
+    matrix = record.get("matrix")
+    rows = matrix if isinstance(matrix, list) else []
+    flood = next((d for d in rows if isinstance(d, dict)
+                  and d.get("drill") == "greedy_flood_fairness"), None)
+    if flood is not None:
+        ratio = flood.get("fairness_ratio")
+        budget = _slo_budget("sched_starvation_ceiling", 10.0)
+        if not _is_finite_number(ratio):
+            problems.append("greedy_flood_fairness: 'fairness_ratio' "
+                            "must be a finite number (polite-tenant "
+                            "queue-wait p99 / fleet median)")
+        elif ratio > budget:
+            problems.append(
+                f"greedy_flood_fairness: fairness_ratio {ratio} exceeds "
+                f"the committed sched_starvation_ceiling budget "
+                f"({budget}) — the fair-share scheduler let a flood "
+                "starve the polite study")
+
+
+def _check_study_fleet_demo(record: dict, problems: list[str]) -> None:
+    """study_fleet_demo-specific schema (scripts/study_fleet_demo.py,
+    docs/scheduling.md): >= 3 concurrent real studies (at least one
+    submitted by the autopilot) drained through ONE external fleet in
+    submit-only mode, every study converged, and the per-tenant
+    queue-wait/admission stats inside the committed SLO budgets."""
+    studies = record.get("studies")
+    if not isinstance(studies, list) or len(studies) < 3:
+        problems.append("'studies' must list >= 3 concurrent studies")
+        studies = studies if isinstance(studies, list) else []
+    autopilot_seen = False
+    for i, row in enumerate(studies):
+        if not isinstance(row, dict):
+            problems.append(f"studies[{i}] must be an object")
+            continue
+        for key in ("study_id", "tenant", "verdict"):
+            if not (isinstance(row.get(key), str) and row[key]):
+                problems.append(
+                    f"studies[{i}]: {key!r} must be a non-empty string")
+        if row.get("verdict") not in ("converged", "no_transitions"):
+            problems.append(
+                f"studies[{i}]: verdict {row.get('verdict')!r} — every "
+                "demo study must reach a clean verdict")
+        if row.get("autopilot") is True:
+            autopilot_seen = True
+    if studies and not autopilot_seen:
+        problems.append("'studies' must include at least one "
+                        "autopilot-submitted study (autopilot: true)")
+    reject_frac = record.get("admission_reject_frac")
+    reject_budget = _slo_budget("sched_admission_reject_ceiling", 0.01)
+    if not _is_finite_number(reject_frac):
+        problems.append("'admission_reject_frac' must be a finite number")
+    elif reject_frac > reject_budget:
+        problems.append(
+            f"admission_reject_frac {reject_frac} exceeds the committed "
+            f"sched_admission_reject_ceiling budget ({reject_budget}) — "
+            "a polite study mix was refused admission")
+    ratio = record.get("tenant_wait_p99_ratio")
+    ratio_budget = _slo_budget("sched_starvation_ceiling", 10.0)
+    if ratio is not None:
+        if not _is_finite_number(ratio):
+            problems.append("'tenant_wait_p99_ratio' must be a finite "
+                            "number when present")
+        elif ratio > ratio_budget:
+            problems.append(
+                f"tenant_wait_p99_ratio {ratio} exceeds the committed "
+                f"sched_starvation_ceiling budget ({ratio_budget})")
 
 
 def _check_beta_study(record: dict, problems: list[str]) -> None:
@@ -801,6 +900,10 @@ def check_record(record: dict, problems: list[str]) -> None:
             _check_chaos_study_matrix(record, problems)
         if record.get("metric") == "chaos_autopilot_matrix":
             _check_chaos_autopilot_matrix(record, problems)
+        if record.get("metric") == "chaos_fleet_study_matrix":
+            _check_chaos_fleet_study_matrix(record, problems)
+        if record.get("metric") == "study_fleet_demo":
+            _check_study_fleet_demo(record, problems)
         if record.get("metric") == "beta_study":
             _check_beta_study(record, problems)
         if record.get("metric") == "mi_kernel_bench":

@@ -1,0 +1,322 @@
+"""Submit-only study fleet demo → STUDY_FLEET_CPU.json.
+
+The deployment shape docs/scheduling.md promises: ONE long-lived
+``sched run-pool --serve`` fleet process owns all the workers, and every
+study is a submit-only client — the three CLI controllers here
+(``study run --fleet`` under tenants alice/bob/carol) plus one study the
+drift autopilot submits on its own (``stream autopilot --fleet`` against
+a real drifted stream, billed to the ``autopilot`` tenant). All four
+drain CONCURRENTLY through the shared fleet, coordinated only by the
+scheduler journal.
+
+The committed record is the acceptance evidence for the fleet layer:
+
+  - every study reaches a clean verdict (``converged`` /
+    ``no_transitions``), at least one row with ``autopilot: true``;
+  - ``admission_reject_frac`` from the fleet's telemetry rollup stays
+    inside the committed ``sched_admission_reject_ceiling`` budget — a
+    polite study mix is never refused admission;
+  - ``tenant_wait_p99_ratio`` (worst tenant queue-wait p99 over the
+    fleet median) stays inside ``sched_starvation_ceiling`` — fair-share
+    keeps concurrent tenants near parity.
+
+``scripts/check_run_artifacts.py`` re-validates all of that per-row
+against the committed SLO budgets on every run.
+
+Usage::
+
+    python scripts/study_fleet_demo.py --out STUDY_FLEET_CPU.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+METRIC = "study_fleet_demo"
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: The proven converging study shape (scripts/chaos_study.py /
+#: scripts/chaos_fleet_study.py): 4-β grid, one seed, refinement to a
+#: clean verdict in <= 3 rounds.
+STUDY_FLAGS = [
+    "--grid", "0.03", "30", "4", "--seeds", "0",
+    "--threshold-nats", "0.1", "--tolerance-decades", "0.3",
+    "--max-bracket-decades", "2.0",
+    "--min-refine-rounds", "1", "--max-rounds", "3", "--max-units", "20",
+    "--refine-num", "3",
+    "--set", "steps_per_epoch=16", "--set", "num_annealing_epochs=20",
+    "--set", "batch_size=128", "--set", "chunk_epochs=11",
+]
+
+#: The same shape as the autopilot CLI's ``--study-set`` overrides, so
+#: the drift study the autopilot mints is the same scale as the CLI
+#: studies it shares the fleet with.
+STUDY_SETS = [
+    "grid_start=0.03", "grid_stop=30.0", "grid_num=4", "seeds=[0]",
+    "threshold_nats=0.1", "tolerance_decades=0.3",
+    "max_bracket_decades=2.0", "min_refine_rounds=1", "max_rounds=3",
+    "max_units=20", "refine_num=3",
+    ("train={'steps_per_epoch': 16, 'num_annealing_epochs': 20, "
+     "'batch_size': 128, 'chunk_epochs': 11}"),
+]
+
+#: Tiny always-on stream (the chaos_autopilot scale) with one scripted
+#: drift — the autopilot needs a real drifted stream to mint its study.
+STREAM_ROUNDS = 7
+STREAM_DRIFT = "80:mean_shift:3.0"
+STREAM_FLAGS = [
+    "--dataset", "boolean_circuit",
+    "--feature_embedding_dimension", "2",
+    "--feature_encoder_architecture", "8",
+    "--integration_network_architecture", "16",
+    "--batch_size", "32",
+    "--number_pretraining_epochs", "2",
+    "--number_annealing_epochs", "4",
+    "--window", "64", "--stride", "16", "--chunk-epochs", "2",
+    "--drift-threshold", "0.5",
+]
+
+CLI_TENANTS = ("alice", "bob", "carol")
+
+
+def _log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def _env(extra: dict | None = None) -> dict:
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    for fault in ("DIB_STUDY_FAULT", "DIB_POOL_FAULT", "DIB_STREAM_FAULT",
+                  "DIB_AUTOPILOT_FAULT"):
+        env.pop(fault, None)
+    env.pop("DIB_RUNS_ROOT", None)  # only --runs-root grows the registry
+    if extra:
+        env.update(extra)
+    return env
+
+
+def _build_stream(stream_dir: str) -> None:
+    """Run the tiny drifted trainer stream through the real CLI."""
+    _log(f"stream fixture: {STREAM_ROUNDS} rounds, drift {STREAM_DRIFT}")
+    proc = subprocess.run(
+        [sys.executable, "-m", "dib_tpu", "stream", "run",
+         "--stream-dir", stream_dir, *STREAM_FLAGS,
+         "--publish-every", "1", "--rounds", str(STREAM_ROUNDS),
+         "--seed", "0", "--drift", STREAM_DRIFT],
+        env=_env(), cwd=REPO, capture_output=True, text=True, timeout=900)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"stream run failed (rc={proc.returncode}):\n"
+            f"{(proc.stderr or '')[-2000:]}")
+
+
+def _start_fleet(sched_dir: str, workers: int) -> subprocess.Popen:
+    """Launch THE long-lived external fleet: ``sched run-pool --serve``."""
+    os.makedirs(sched_dir, exist_ok=True)
+    log = open(os.path.join(sched_dir, "pool.log"), "ab")
+    return subprocess.Popen(
+        [sys.executable, "-m", "dib_tpu", "sched", "run-pool",
+         "--sched-dir", sched_dir, "--workers", str(workers),
+         "--lease-s", "8.0", "--duration-s", "3600", "--serve",
+         "--preempt_grace_s", "0"],
+        env=_env(), stdout=log, stderr=log)
+
+
+def _start_study(study_dir: str, fleet: str, tenant: str) -> subprocess.Popen:
+    """Launch one submit-only CLI study controller against the fleet."""
+    os.makedirs(study_dir, exist_ok=True)
+    log = open(os.path.join(study_dir, "study.log"), "ab")
+    return subprocess.Popen(
+        [sys.executable, "-m", "dib_tpu", "study", "run",
+         "--study-dir", study_dir, *STUDY_FLAGS,
+         "--fleet", fleet, "--tenant", tenant, "--poll-s", "0.2"],
+        env=_env(), stdout=log, stderr=log)
+
+
+def _start_autopilot(stream_dir: str, fleet: str) -> subprocess.Popen:
+    """Launch the drift autopilot in submit-only mode: it mints the
+    drift study itself and bills it to the ``autopilot`` tenant."""
+    log = open(os.path.join(stream_dir, "autopilot.log"), "ab")
+    cmd = [sys.executable, "-m", "dib_tpu", "stream", "autopilot",
+           "--stream-dir", stream_dir, "--cooldown-rounds", "0",
+           "--fleet", fleet, "--tenant", "autopilot"]
+    for pair in STUDY_SETS:
+        cmd += ["--study-set", pair]
+    return subprocess.Popen(cmd, env=_env(), cwd=REPO, stdout=log,
+                            stderr=log)
+
+
+def _wait_proc(proc: subprocess.Popen, timeout: float) -> int | None:
+    try:
+        return proc.wait(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait()
+        return None
+
+
+def _kill_hard(proc: subprocess.Popen | None) -> None:
+    if proc is not None and proc.poll() is None:
+        proc.kill()
+        proc.wait()
+
+
+def _tail(path: str, n: int = 800) -> str:
+    try:
+        with open(path, "r", errors="replace") as f:
+            return f.read()[-n:]
+    except OSError:
+        return ""
+
+
+def _study_verdict(study_dir: str) -> str | None:
+    from dib_tpu.study.journal import fold_study, read_study_journal
+
+    records, _ = read_study_journal(study_dir)
+    verdict = fold_study(records)["verdict"]
+    return None if verdict is None else verdict.get("verdict")
+
+
+def _autopilot_study(stream_dir: str) -> tuple[str, str | None]:
+    """(study_id, verdict) of the drift study the autopilot minted."""
+    from dib_tpu.autopilot import autopilot_journal_path, fold_autopilot
+    from dib_tpu.sched.journal import read_journal
+
+    records, _ = read_journal(
+        autopilot_journal_path(os.path.join(stream_dir, "autopilot")))
+    state = fold_autopilot(records)
+    decided = [(idx, d) for idx, d in sorted(state["drifts"].items())
+               if d.get("verdict") is not None]
+    if not decided:
+        return "drift-none", None
+    idx, drift = decided[-1]
+    return f"drift-r{idx:04d}", (drift["verdict"] or {}).get("verdict")
+
+
+def _fleet_stats(fleet_dir: str) -> dict:
+    """The SLO-facing queue stats, from the same telemetry rollup the
+    ``telemetry check`` gate reads (``scheduler_rollup``)."""
+    from dib_tpu.telemetry import summarize
+
+    sched = summarize(fleet_dir).get("scheduler") or {}
+    return {
+        "admission_reject_frac": sched.get("admission_reject_frac"),
+        "tenant_wait_p99_ratio": sched.get("tenant_wait_p99_ratio"),
+        "tenants": sched.get("tenants"),
+        "admission_rejected": sched.get("admission_rejected"),
+    }
+
+
+def run_demo(workdir: str, workers: int) -> dict:
+    stream_dir = os.path.join(workdir, "stream")
+    fleet = os.path.join(workdir, "fleet")
+    _build_stream(stream_dir)
+
+    _log(f"fleet: sched run-pool --serve, {workers} workers")
+    pool = _start_fleet(fleet, workers)
+    started = time.time()
+    studies: list[tuple[str, subprocess.Popen]] = []
+    autopilot = None
+    try:
+        for tenant in CLI_TENANTS:
+            studies.append((tenant, _start_study(
+                os.path.join(workdir, f"study-{tenant}"), fleet, tenant)))
+        autopilot = _start_autopilot(stream_dir, fleet)
+        _log(f"{len(studies)} CLI studies + autopilot submitted "
+             "concurrently; draining through the shared fleet")
+
+        rows = []
+        for tenant, proc in studies:
+            rc = _wait_proc(proc, timeout=2400)
+            study_dir = os.path.join(workdir, f"study-{tenant}")
+            verdict = _study_verdict(study_dir)
+            if rc != 0:
+                _log(f"study {tenant}: rc={rc} verdict={verdict}\n"
+                     + _tail(os.path.join(study_dir, "study.log")))
+            rows.append({"study_id": f"study-{tenant}", "tenant": tenant,
+                         "verdict": verdict, "autopilot": False,
+                         "rc": rc})
+        rc_auto = _wait_proc(autopilot, timeout=2400)
+        study_id, verdict = _autopilot_study(stream_dir)
+        if rc_auto != 0:
+            _log(f"autopilot: rc={rc_auto} verdict={verdict}\n"
+                 + _tail(os.path.join(stream_dir, "autopilot.log")))
+        rows.append({"study_id": study_id, "tenant": "autopilot",
+                     "verdict": verdict, "autopilot": True, "rc": rc_auto})
+        elapsed = round(time.time() - started, 1)
+    finally:
+        _kill_hard(pool)
+        for _, proc in studies:
+            _kill_hard(proc)
+        _kill_hard(autopilot)
+
+    stats = _fleet_stats(fleet)
+    converged = sum(1 for r in rows
+                    if r["verdict"] in ("converged", "no_transitions"))
+    all_passed = (converged == len(rows)
+                  and all(r["rc"] == 0 for r in rows)
+                  and isinstance(stats["admission_reject_frac"],
+                                 (int, float)))
+    record = {
+        "metric": METRIC,
+        "value": converged,
+        "unit": "studies_converged",
+        "quick": False,
+        "total": len(rows),
+        "all_passed": bool(all_passed),
+        "workers": workers,
+        "concurrent": True,
+        "elapsed_s": elapsed,
+        "studies": rows,
+        "admission_reject_frac": stats["admission_reject_frac"],
+        "admission_rejected": stats["admission_rejected"],
+        "tenants": stats["tenants"],
+        "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    if stats["tenant_wait_p99_ratio"] is not None:
+        record["tenant_wait_p99_ratio"] = stats["tenant_wait_p99_ratio"]
+    return record
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--out", default="STUDY_FLEET_CPU.json")
+    parser.add_argument("--workdir", default=None,
+                        help="Keep fleet/study dirs here (default: a "
+                             "temp dir, removed on success).")
+    parser.add_argument("--workers", type=int, default=3)
+    parser.add_argument("--runs-root", "--runs_root", dest="runs_root",
+                        default=None,
+                        help="Also append a bench entry to this runs "
+                             "registry (<runs-root>/index.jsonl; "
+                             "default: none).")
+    args = parser.parse_args(argv)
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="study_fleet_")
+    record = run_demo(workdir, args.workers)
+    with open(args.out, "w") as f:
+        json.dump(record, f, indent=2, sort_keys=True)
+        f.write("\n")
+    _log(f"wrote {args.out}: {record['value']}/{record['total']} studies "
+         f"converged, admission_reject_frac="
+         f"{record['admission_reject_frac']}")
+
+    from dib_tpu.telemetry.registry import register_drill_record
+
+    if register_drill_record(record, root=args.runs_root, extra={
+            "autopilot_studies": sum(
+                1 for r in record["studies"] if r["autopilot"])},
+            ) is not None:
+        _log(f"registered in {args.runs_root}/index.jsonl")
+    return 0 if record["all_passed"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

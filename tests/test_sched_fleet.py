@@ -1,0 +1,645 @@
+"""Multi-tenant study-fleet tests (ISSUE 20, docs/scheduling.md):
+deficit-weighted fair share, admission control, per-tenant quotas, the
+per-job circuit breaker, priority load shedding (``starved`` parking and
+the watchdog's parked-pool gate), the multi-writer ``refresh`` path the
+submit-only deployment rests on, the fleet-mode StudyController, the
+multi-tenant telemetry rollup + SLO rows, and the committed
+CHAOS_FLEET_STUDY.json / STUDY_FLEET_CPU.json artifact contracts.
+
+Everything here is host-side and fast: fake runners, an injectable
+clock, synthetic event streams. The real-training fleet paths (SIGKILL
+chaos, the three-study demo) live in scripts/chaos_fleet_study.py and
+scripts/study_fleet_demo.py, whose committed records these tests pin.
+"""
+
+from __future__ import annotations
+
+import copy
+import importlib.util
+import json
+import math
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from dib_tpu.sched import (  # noqa: E402
+    JobSpec,
+    Scheduler,
+    WorkerPool,
+    read_journal,
+)
+from dib_tpu.sched.cli import sched_main  # noqa: E402
+from dib_tpu.sched.scheduler import (  # noqa: E402
+    AdmissionRejected,
+    FleetPolicy,
+    TenantPolicy,
+    parked_snapshot,
+)
+from dib_tpu.telemetry import EventWriter, runtime_manifest  # noqa: E402
+from dib_tpu.telemetry.summary import (  # noqa: E402
+    scheduler_rollup,
+    telemetry_main,
+)
+
+_LN2 = math.log(2.0)
+
+
+class Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _sched(tmp_path, name="fleet", policy=None, clock=None, **kwargs):
+    return Scheduler(str(tmp_path / name), policy=policy,
+                     clock=clock or time.time, **kwargs)
+
+
+def _tenant_of(s, lease) -> str:
+    unit = s.unit(lease.unit_id)["unit"]
+    return s.status()["jobs"][unit.job_id]["tenant"]
+
+
+# --------------------------------------------------------------- fair share
+def test_fair_share_alternates_between_equal_tenants(tmp_path):
+    """Equal-weight tenants split the fleet's attention 1:1 even when one
+    submitted its whole backlog first — the anti-starvation core."""
+    s = _sched(tmp_path)
+    s.submit(JobSpec(betas=(0.1, 0.2, 0.3, 0.4), tenant="greedy"))
+    s.submit(JobSpec(betas=(1.0, 2.0, 3.0, 4.0), tenant="polite"))
+    order = [_tenant_of(s, s.acquire(f"w{i}")) for i in range(6)]
+    # first grant breaks the 0-service tie FIFO (greedy enqueued first),
+    # then the deficit ledger alternates strictly
+    assert order == ["greedy", "polite", "greedy", "polite",
+                     "greedy", "polite"]
+    s.close()
+
+
+def test_fair_share_weight_skews_service(tmp_path):
+    """A weight-3 tenant accrues ~3x the service of a weight-1 tenant
+    over a long acquire sequence."""
+    policy = FleetPolicy(tenants={"heavy": TenantPolicy(weight=3.0),
+                                  "light": TenantPolicy(weight=1.0)})
+    s = _sched(tmp_path, policy=policy)
+    s.submit(JobSpec(betas=tuple(float(i + 1) for i in range(12)),
+                     tenant="heavy"))
+    s.submit(JobSpec(betas=tuple(float(i + 1) for i in range(12)),
+                     tenant="light"))
+    grants = [_tenant_of(s, s.acquire(f"w{i}")) for i in range(8)]
+    assert grants.count("heavy") == 6 and grants.count("light") == 2
+    s.close()
+
+
+def test_single_tenant_degenerates_to_global_fifo(tmp_path):
+    s = _sched(tmp_path)
+    s.submit(JobSpec(betas=(0.1, 1.0), seeds=(0, 1)))
+    got = [s.acquire("w").unit_id for _ in range(4)]
+    assert got == sorted(got)          # submission order, untouched
+    s.close()
+
+
+def test_tenant_max_leases_quota_caps_concurrency(tmp_path):
+    """A tenant at its concurrent-lease quota is skipped — the other
+    tenant drains; nothing is granted past the cap."""
+    policy = FleetPolicy(tenants={"capped": TenantPolicy(max_leases=1)})
+    s = _sched(tmp_path, policy=policy)
+    s.submit(JobSpec(betas=(0.1, 0.2, 0.3), tenant="capped"))
+    s.submit(JobSpec(betas=(1.0,), tenant="free"))
+    first = s.acquire("w0")
+    assert _tenant_of(s, first) == "capped"
+    # capped is at quota: the next grants go to the other tenant, then dry
+    second = s.acquire("w1")
+    assert _tenant_of(s, second) == "free"
+    assert s.acquire("w2") is None
+    # completing the capped unit frees the quota slot
+    assert s.complete(first, {"ok": 1}) is True
+    third = s.acquire("w3")
+    assert third is not None and _tenant_of(s, third) == "capped"
+    s.close()
+
+
+# ---------------------------------------------------------------- admission
+def test_admission_reject_fleet_bound_is_journaled(tmp_path):
+    policy = FleetPolicy(max_pending_units=3, admission_retry_s=7.5)
+    s = _sched(tmp_path, policy=policy)
+    s.submit(JobSpec(betas=(0.1, 0.2), tenant="a"))
+    with pytest.raises(AdmissionRejected) as err:
+        s.submit(JobSpec(betas=(1.0, 2.0), tenant="b"))
+    assert err.value.tenant == "b"
+    assert err.value.retry_after_s == 7.5
+    records, _ = read_journal(s.directory)
+    rejects = [r for r in records if r.get("kind") == "admission"]
+    assert len(rejects) == 1 and rejects[0]["tenant"] == "b"
+    assert s.status()["tenants"]["b"]["admission_rejected"] == 1
+    # a fitting submit is still admitted
+    s.submit(JobSpec(betas=(5.0,), tenant="b"))
+    s.close()
+
+
+def test_admission_reject_tenant_bound_spares_other_tenants(tmp_path):
+    policy = FleetPolicy(
+        tenants={"bounded": TenantPolicy(max_pending=2)})
+    s = _sched(tmp_path, policy=policy)
+    s.submit(JobSpec(betas=(0.1, 0.2), tenant="bounded"))
+    with pytest.raises(AdmissionRejected):
+        s.submit(JobSpec(betas=(0.3,), tenant="bounded"))
+    # the bound is per-tenant: an unbounded tenant sails through
+    s.submit(JobSpec(betas=tuple(float(i + 1) for i in range(8)),
+                     tenant="open"))
+    s.close()
+
+
+def test_admission_rejects_survive_replay(tmp_path):
+    policy = FleetPolicy(max_pending_units=1)
+    s = _sched(tmp_path, policy=policy)
+    s.submit(JobSpec(betas=(0.1,), tenant="a"))
+    for _ in range(2):
+        with pytest.raises(AdmissionRejected):
+            s.submit(JobSpec(betas=(1.0,), tenant="b"))
+    s.close()
+    replayed = _sched(tmp_path)
+    assert replayed.status()["tenants"]["b"]["admission_rejected"] == 2
+    replayed.close()
+
+
+# ------------------------------------------------------------------ breaker
+def _fail_once(s, worker="w"):
+    lease = s.acquire(worker)
+    assert lease is not None
+    return s.fail(lease, "poisoned")
+
+
+def test_breaker_trips_probes_and_resets(tmp_path):
+    """threshold consecutive failures quarantine the job; after the
+    probe horizon ONE half-open probe is granted; its success closes
+    the breaker durably (journaled reset)."""
+    clock = Clock()
+    policy = FleetPolicy(breaker_threshold=2, breaker_probe_after_s=30.0)
+    s = _sched(tmp_path, policy=policy, clock=clock, backoff_base_s=0.0)
+    s.submit(JobSpec(betas=(0.5,), retry_budget=10, tenant="mallory"))
+    assert _fail_once(s) == "requeued"
+    assert _fail_once(s) == "requeued"
+    records, _ = read_journal(s.directory)
+    trips = [r for r in records if r.get("kind") == "breaker"
+             and r.get("action") == "trip"]
+    assert len(trips) == 1 and trips[0]["consecutive"] == 2
+    # quarantined: no grant inside the horizon
+    assert s.acquire("w") is None
+    clock.t += 31.0
+    probe = s.acquire("w")
+    assert probe is not None
+    # the probe is exclusive: no second unit of the job leaks out
+    assert s.acquire("w2") is None
+    assert s.complete(probe, {"ok": 1}) is True
+    records, _ = read_journal(s.directory)
+    actions = [r["action"] for r in records if r.get("kind") == "breaker"]
+    assert actions == ["trip", "probe", "reset"]
+    s.close()
+
+
+def test_breaker_failed_probe_retrips(tmp_path):
+    clock = Clock()
+    policy = FleetPolicy(breaker_threshold=2, breaker_probe_after_s=10.0)
+    s = _sched(tmp_path, policy=policy, clock=clock, backoff_base_s=0.0)
+    s.submit(JobSpec(betas=(0.5,), retry_budget=10))
+    _fail_once(s)
+    _fail_once(s)
+    clock.t += 11.0
+    probe = s.acquire("w")
+    assert probe is not None
+    assert s.fail(probe, "still poisoned") == "requeued"
+    records, _ = read_journal(s.directory)
+    actions = [r["action"] for r in records if r.get("kind") == "breaker"]
+    assert actions == ["trip", "probe", "trip"]   # immediate re-trip
+    assert s.acquire("w") is None                 # quarantined again
+    s.close()
+
+
+# ----------------------------------------------------------------- shedding
+def test_set_capacity_parks_low_priority_and_clears(tmp_path):
+    """Half the workers gone: the low class parks (``starved``), the
+    high class drains, and the floor clears once the high class is
+    terminal — zero lost units in either class."""
+    s = _sched(tmp_path)
+    s.submit(JobSpec(betas=(0.1, 0.2), tenant="filler", priority=0))
+    s.submit(JobSpec(betas=(1.0, 2.0), tenant="urgent", priority=1))
+    out = s.set_capacity(1, 2)
+    assert out["floor"] == 1 and out["starved"] == 2
+    status = s.status()
+    assert status["tenants"]["filler"]["starved"] == 2
+    assert status["counts"]["pending"] == 4       # parked still pending
+    # only the high class is grantable
+    for _ in range(2):
+        lease = s.acquire("w")
+        assert _tenant_of(s, lease) == "urgent"
+        assert s.complete(lease, {"ok": 1}) is True
+    assert s.acquire("w") is None and s.parked_only()
+    # high class terminal -> the same reassessment clears the floor
+    out = s.set_capacity(1, 2)
+    assert out["floor"] is None and out["starved"] == 0
+    records, _ = read_journal(s.directory)
+    floors = [r["floor"] for r in records if r.get("kind") == "shed"]
+    assert floors == [1, None]
+    lease = s.acquire("w")
+    assert lease is not None and _tenant_of(s, lease) == "filler"
+    s.close()
+
+
+def test_single_priority_class_never_parks(tmp_path):
+    s = _sched(tmp_path)
+    s.submit(JobSpec(betas=(0.1, 0.2), tenant="only"))
+    out = s.set_capacity(1, 4)
+    assert out["floor"] is None and out["starved"] == 0
+    assert not s.parked_only()
+    s.close()
+
+
+def test_parked_snapshot_matches_live_state(tmp_path):
+    """The watchdog's journal-only view agrees with the live scheduler:
+    an all-parked queue is visible WITHOUT opening a writer — the
+    terminal-progress gate that keeps a degraded fleet budget-free."""
+    s = _sched(tmp_path)
+    s.submit(JobSpec(betas=(0.1, 0.2), priority=0))
+    s.submit(JobSpec(betas=(1.0,), priority=1))
+    s.set_capacity(1, 2)
+    lease = s.acquire("w")
+    assert s.complete(lease, {"ok": 1}) is True
+    s.close()
+    snap = parked_snapshot(
+        os.path.join(str(tmp_path / "fleet"), "journal.jsonl"))
+    assert snap["nonterminal"] == 2
+    assert snap["parked"] == 2 and snap["floor"] == 1
+
+
+def test_pool_exits_promptly_when_everything_is_parked(tmp_path):
+    """A bounded pool over an all-parked queue exits without burning its
+    duration busy-polling, reporting ``parked`` so the watchdog's
+    relaunch stays budget-free (the ISSUE-20 idle-fleet fix)."""
+    s = _sched(tmp_path)
+    s.submit(JobSpec(betas=(0.1, 0.2), priority=0))
+    s.submit(JobSpec(betas=(1.0,), priority=1))
+    s.set_capacity(1, 2)
+    lease = s.acquire("w")
+    s.complete(lease, {"ok": 1})
+    assert s.parked_only()
+
+    def runner(unit, heartbeat=None):
+        raise AssertionError("parked units must never run")
+
+    pool = WorkerPool(s, runner, num_workers=1, poll_s=0.01)
+    t0 = time.time()
+    out = pool.run(duration_s=30.0)
+    assert time.time() - t0 < 10.0     # exited early, not at duration
+    assert out["parked"] is True and out["starved"] == 2
+    assert out["completed"] == 0 and out["drained"] is False
+    s.close()
+
+
+# ------------------------------------------------------------- multi-writer
+def test_refresh_folds_foreign_writer_without_double_lease(tmp_path):
+    """Two Scheduler instances share one journal (a submit-only
+    controller and the fleet pool): refresh folds the peer's records and
+    the lease guard holds across writers."""
+    a = _sched(tmp_path, name="shared")
+    b = Scheduler(str(tmp_path / "shared"))
+    job = a.submit(JobSpec(betas=(0.1, 1.0), tenant="alice"))
+    assert b.refresh() > 0
+    assert b.status()["counts"]["pending"] == 2
+    lease = b.acquire("pool-w0")
+    a.refresh()
+    # the peer sees the lease: the unit is not grantable twice
+    assert a.status()["counts"]["leased"] == 1
+    assert a.acquire("ctl-w0") is not None         # the OTHER unit
+    assert a.acquire("ctl-w1") is None
+    assert b.complete(lease, {"ok": 1}) is True
+    a.refresh()
+    assert a.status()["counts"]["done"] == 1
+    assert a.status()["jobs"][job]["tenant"] == "alice"
+    a.close()
+    b.close()
+
+
+# ------------------------------------------------------------------- CLI
+def test_cli_policy_set_and_overbound_submit_exits_75(tmp_path, capsys):
+    from dib_tpu.train.preempt import PREEMPT_EXIT_CODE
+
+    d = str(tmp_path / "cli-fleet")
+    assert sched_main(["policy", "--sched-dir", d, "--max-pending", "2",
+                       "--admission-retry-s", "3.0",
+                       "--tenant", "greedy=1:2:2"]) == 0
+    shown = json.loads(capsys.readouterr().out)["policy"]
+    assert shown["max_pending_units"] == 2
+    assert shown["tenants"]["greedy"] == {
+        "weight": 1.0, "max_leases": 2, "max_pending": 2}
+    assert sched_main(["submit", "--sched-dir", d, "--betas", "0.1", "1.0",
+                       "--tenant", "greedy"]) == 0
+    capsys.readouterr()
+    rc = sched_main(["submit", "--sched-dir", d, "--betas", "5.0",
+                     "--tenant", "greedy"])
+    assert rc == PREEMPT_EXIT_CODE
+    reject = json.loads(capsys.readouterr().out)
+    assert reject["rejected"] is True and reject["tenant"] == "greedy"
+    assert reject["retry_after_s"] == 3.0
+
+
+def test_cli_status_renders_tenant_rows(tmp_path, capsys):
+    d = str(tmp_path / "cli-status")
+    assert sched_main(["submit", "--sched-dir", d, "--betas", "0.1",
+                       "--tenant", "alice", "--study", "s-1"]) == 0
+    capsys.readouterr()
+    assert sched_main(["status", "--sched-dir", d]) == 0
+    out = capsys.readouterr().out
+    assert "alice" in out
+
+
+# ------------------------------------------------------- study fleet mode
+def _write_history(base_dir: str, unit) -> dict:
+    """Synthetic single-transition KL history at the fleet's unit dir —
+    the _FakeSchedRunner shape from test_study.py."""
+    x = (math.log10(unit.beta) - math.log10(0.3)) / 0.15
+    kl_nats = np.asarray([1.0 / (1.0 + math.exp(4.0 * x))])
+    udir = os.path.join(base_dir, "units", unit.unit_id.replace("/", "__"))
+    os.makedirs(udir, exist_ok=True)
+    path = os.path.join(udir, "history.npz")
+    np.savez(path, kl_per_feature=(kl_nats / _LN2)[None, :],
+             beta=np.asarray([unit.beta]), loss=np.asarray([0.1]),
+             val_loss=np.asarray([0.1]))
+    return {"beta": float(unit.beta), "seed": int(unit.seed),
+            "history_path": path}
+
+
+def test_study_fleet_mode_submits_polls_and_rebinds(tmp_path):
+    """Submit-only end to end, in process: a stay-alive fleet pool
+    thread drains what a fleet-bound StudyController submits; the
+    controller converges without ever running a unit itself; the fleet
+    binding is journaled so a bare resume re-enters fleet mode."""
+    from dib_tpu.study.controller import StudyConfig, StudyController
+    from dib_tpu.study.journal import read_study_journal
+
+    fleet_dir = str(tmp_path / "fleet-live")
+    fleet_sched = Scheduler(fleet_dir, lease_s=10.0)
+    pool = WorkerPool(
+        fleet_sched, lambda unit, heartbeat=None:
+        _write_history(fleet_dir, unit),
+        num_workers=2, poll_s=0.01, reap_every_s=0.05, stay_alive=True,
+        idle_max_s=0.05)
+    pool_thread = threading.Thread(
+        target=pool.run, kwargs={"duration_s": 60.0}, daemon=True)
+    pool_thread.start()
+    study_dir = str(tmp_path / "study-fleet")
+    config = StudyConfig(
+        grid_start=0.01, grid_stop=10.0, grid_num=4, seeds=(0,),
+        threshold_nats=0.5, tolerance_decades=0.2, min_refine_rounds=1,
+        max_rounds=5, max_units=40, refine_num=4)
+    try:
+        controller = StudyController(
+            study_dir, config=config, fleet=fleet_dir, tenant="alice",
+            priority=1, poll_s=0.02)
+        state = controller.run()
+    finally:
+        pool._stop.set()
+        pool_thread.join(timeout=10.0)
+    assert state["verdict"]["verdict"] == "converged"
+    # the fleet binding is journaled with the study's fleet identity
+    records, _ = read_study_journal(study_dir)
+    bindings = [r for r in records if r.get("kind") == "fleet"]
+    assert len(bindings) == 1
+    assert bindings[0]["sched_dir"] == os.path.abspath(fleet_dir)
+    assert bindings[0]["tenant"] == "alice"
+    # every fleet job of this study carries the tenant/study identity
+    fleet_status = Scheduler(fleet_dir)
+    jobs = fleet_status.status()["jobs"]
+    study_jobs = [j for j in jobs.values() if j["tenant"] == "alice"]
+    assert study_jobs and all(j["status"] == "done" for j in study_jobs)
+    fleet_status.close()
+    # a flag-free resume rebinds from the journal (journal wins)
+    resumed = StudyController(study_dir)
+    resumed.replay()
+    assert resumed.fleet == os.path.abspath(fleet_dir)
+    assert resumed.tenant == "alice" and resumed.priority == 1
+
+
+# ------------------------------------------------------------ rollup + SLO
+def _granted(writer, tenant, wait_s, unit="j/u0"):
+    writer.lease(unit=unit, action="granted", worker="w", lease="l",
+                 job_id="j", expires_s=5.0, queue_wait_s=wait_s,
+                 attempt=1, tenant=tenant)
+
+
+def test_scheduler_rollup_builds_tenant_block():
+    events = [
+        {"type": "job", "action": "submitted", "job_id": "j1", "units": 2,
+         "tenant": "a"},
+        {"type": "job", "action": "submitted", "job_id": "j2", "units": 1,
+         "tenant": "b"},
+        {"type": "job", "action": "submitted", "job_id": "j3", "units": 1,
+         "tenant": "c"},
+        {"type": "job", "action": "rejected", "job_id": "admission:b",
+         "tenant": "b", "units": 4},
+        {"type": "lease", "action": "granted", "queue_wait_s": 0.5,
+         "tenant": "a"},
+        {"type": "lease", "action": "granted", "queue_wait_s": 1.0,
+         "tenant": "b"},
+        {"type": "lease", "action": "granted", "queue_wait_s": 2.0,
+         "tenant": "c"},
+        {"type": "job", "action": "unit_done", "job_id": "j1",
+         "tenant": "a"},
+    ]
+    out = scheduler_rollup(events)
+    assert out["tenants"]["a"]["jobs"] == 1
+    assert out["tenants"]["a"]["units"] == 2
+    assert out["tenants"]["a"]["units_done"] == 1
+    assert out["tenants"]["b"]["admission_rejected"] == 1
+    assert out["admission_reject_frac"] == pytest.approx(0.25, abs=1e-4)
+    # nearest-rank median of p99s [0.5, 1.0, 2.0] is 1.0
+    assert out["tenant_wait_p99_ratio"] == pytest.approx(2.0)
+
+
+def test_scheduler_rollup_single_tenant_omits_fleet_keys():
+    out = scheduler_rollup([
+        {"type": "job", "action": "submitted", "job_id": "j", "units": 1},
+        {"type": "lease", "action": "granted", "queue_wait_s": 0.5},
+    ])
+    assert "tenants" not in out
+    assert "admission_reject_frac" not in out
+    assert "tenant_wait_p99_ratio" not in out
+
+
+def _fleet_stream(tmp_path, name, *, starving: bool, rejects: int) -> str:
+    d = str(tmp_path / name)
+    writer = EventWriter(d, run_id=name)
+    writer.run_start(runtime_manifest(device_info=False))
+    writer.job(job_id="j", action="submitted", units=3, tenant="a")
+    writer.job(job_id="k", action="submitted", units=3, tenant="b")
+    writer.job(job_id="l", action="submitted", units=3, tenant="c")
+    for _ in range(rejects):
+        writer.job(job_id="admission:c", action="rejected", tenant="c",
+                   units=4, reason="queue full", retry_after_s=5.0)
+    _granted(writer, "a", 0.1)
+    _granted(writer, "b", 0.1)
+    _granted(writer, "c", 50.0 if starving else 0.12)
+    writer.run_end(status="ok")
+    writer.close()
+    return d
+
+
+def test_slo_fleet_rows_gate_streams(tmp_path):
+    """sched_starvation_ceiling pages on a starving tenant;
+    sched_admission_reject_ceiling warns on sustained rejects; a fair
+    multi-tenant stream passes both."""
+    slo = os.path.join(REPO, "SLO.json")
+    clean = _fleet_stream(tmp_path, "clean", starving=False, rejects=0)
+    assert telemetry_main(["check", clean, "--slo", slo,
+                           "--no-write"]) == 0
+
+    starved = _fleet_stream(tmp_path, "starved", starving=True, rejects=0)
+    assert telemetry_main(["check", starved, "--slo", slo,
+                           "--no-write"]) == 1
+    proc = subprocess.run(
+        [sys.executable, "-m", "dib_tpu", "telemetry", "check", starved,
+         "--slo", slo, "--no-write"],
+        capture_output=True, text=True, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, timeout=120)
+    assert proc.returncode == 1
+    assert "sched_starvation_ceiling" in proc.stdout
+
+    flooded = _fleet_stream(tmp_path, "flooded", starving=False,
+                            rejects=2)
+    assert telemetry_main(["check", flooded, "--slo", slo,
+                           "--no-write"]) == 1
+
+
+# ----------------------------------------------------------- artifacts
+ARTIFACT_CHAOS = os.path.join(REPO, "CHAOS_FLEET_STUDY.json")
+ARTIFACT_DEMO = os.path.join(REPO, "STUDY_FLEET_CPU.json")
+
+
+@pytest.fixture(scope="module")
+def checker():
+    spec = importlib.util.spec_from_file_location(
+        "check_run_artifacts",
+        os.path.join(REPO, "scripts", "check_run_artifacts.py"))
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def _problems(checker, tmp_path, record, name="ARTIFACT.json"):
+    path = str(tmp_path / name)
+    with open(path, "w") as f:
+        json.dump(record, f)
+    return checker.check_file(path)
+
+
+def test_committed_fleet_artifacts_validate(checker):
+    assert checker.check_file(ARTIFACT_CHAOS) == []
+    assert checker.check_file(ARTIFACT_DEMO) == []
+
+
+def test_committed_chaos_record_covers_the_drill_matrix():
+    with open(ARTIFACT_CHAOS) as f:
+        record = json.load(f)
+    assert record["quick"] is False and record["all_passed"] is True
+    drills = {d["drill"] for d in record["matrix"]}
+    assert drills >= {"fleet_kill_resume", "greedy_flood_fairness",
+                      "controller_kill_adopt", "worker_loss_degrade",
+                      "breaker_trip_probe"}
+    for row in record["matrix"]:
+        assert row["zero_lost_units"] is True
+        assert row["no_double_execution"] is True
+        assert row["bit_identical_histories"] is True
+
+
+def test_chaos_fleet_record_rejects_broken_shapes(checker, tmp_path):
+    with open(ARTIFACT_CHAOS) as f:
+        good = json.load(f)
+    # a full record missing a required drill is rejected
+    broken = copy.deepcopy(good)
+    broken["matrix"] = [d for d in broken["matrix"]
+                        if d["drill"] != "breaker_trip_probe"]
+    assert any("breaker_trip_probe" in p
+               for p in _problems(checker, tmp_path, broken))
+    # an unasserted invariant is rejected
+    broken = copy.deepcopy(good)
+    broken["matrix"][0]["no_double_execution"] = False
+    assert any("no_double_execution" in p
+               for p in _problems(checker, tmp_path, broken))
+    # a fairness ratio past the committed SLO budget is rejected
+    broken = copy.deepcopy(good)
+    for row in broken["matrix"]:
+        if row["drill"] == "greedy_flood_fairness":
+            row["fairness_ratio"] = 99.0
+    assert any("fairness_ratio" in p
+               for p in _problems(checker, tmp_path, broken))
+
+
+def test_committed_demo_meets_the_fleet_acceptance():
+    with open(ARTIFACT_DEMO) as f:
+        record = json.load(f)
+    assert record["metric"] == "study_fleet_demo"
+    assert len(record["studies"]) >= 3
+    assert sum(1 for s in record["studies"] if s["autopilot"]) >= 1
+    assert all(s["verdict"] in ("converged", "no_transitions")
+               for s in record["studies"])
+    assert len({s["tenant"] for s in record["studies"]}) >= 3
+    assert record["admission_reject_frac"] <= 0.01
+    assert record.get("tenant_wait_p99_ratio", 0.0) <= 10.0
+
+
+def test_study_fleet_demo_rejects_broken_shapes(checker, tmp_path):
+    with open(ARTIFACT_DEMO) as f:
+        good = json.load(f)
+    # fewer than 3 studies
+    broken = copy.deepcopy(good)
+    broken["studies"] = broken["studies"][:2]
+    assert any(">= 3" in p for p in _problems(checker, tmp_path, broken))
+    # no autopilot-submitted study
+    broken = copy.deepcopy(good)
+    for s in broken["studies"]:
+        s["autopilot"] = False
+    assert any("autopilot" in p
+               for p in _problems(checker, tmp_path, broken))
+    # a dirty verdict
+    broken = copy.deepcopy(good)
+    broken["studies"][0]["verdict"] = "unconverged"
+    assert any("verdict" in p for p in _problems(checker, tmp_path, broken))
+    # admission rejects past the committed budget
+    broken = copy.deepcopy(good)
+    broken["admission_reject_frac"] = 0.5
+    assert any("admission_reject_frac" in p
+               for p in _problems(checker, tmp_path, broken))
+    # a starving tenant ratio past the committed budget
+    broken = copy.deepcopy(good)
+    broken["tenant_wait_p99_ratio"] = 50.0
+    assert any("tenant_wait_p99_ratio" in p
+               for p in _problems(checker, tmp_path, broken))
+
+
+# --------------------------------------------------------------- lint cov
+def test_fleet_modules_stay_lint_covered():
+    """Satellite: the thread-heavy fleet modules stay inside the
+    host-sync/thread-shared-state lint perimeter, findings-free."""
+    from dib_tpu.analysis import run_passes
+    from dib_tpu.analysis.passes.host_sync import HostSyncPass
+
+    for rel in ("dib_tpu/sched/scheduler.py", "dib_tpu/sched/pool.py",
+                "dib_tpu/study/controller.py", "dib_tpu/autopilot/loop.py"):
+        assert rel in HostSyncPass.target_modules
+    files = [(os.path.join(REPO, rel), rel) for rel in (
+        "dib_tpu/sched/scheduler.py", "dib_tpu/sched/pool.py",
+        "dib_tpu/sched/cli.py", "dib_tpu/study/controller.py",
+        "dib_tpu/study/cli.py", "dib_tpu/autopilot/loop.py",
+        "dib_tpu/train/watchdog.py")]
+    findings = run_passes(
+        root=REPO, select=["host-sync", "thread-shared-state"],
+        files=files)
+    assert findings == [], [f.format() for f in findings]
